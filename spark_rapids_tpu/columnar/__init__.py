@@ -1,0 +1,10 @@
+from .host import (HostBatch, arrow_to_dtype, dtype_to_arrow, schema_to_struct,
+                   struct_to_schema)
+from .device import (DeviceBatch, DeviceColumn, bucket_capacity, to_device,
+                     to_host, empty_device_batch)
+
+__all__ = [
+    "HostBatch", "arrow_to_dtype", "dtype_to_arrow", "schema_to_struct",
+    "struct_to_schema", "DeviceBatch", "DeviceColumn", "bucket_capacity",
+    "to_device", "to_host", "empty_device_batch",
+]

@@ -1,0 +1,178 @@
+"""Host-side columnar batches backed by Apache Arrow.
+
+Plays the role of the reference's RapidsHostColumnVector / host-side
+ColumnarBatch (sql-plugin/src/main/java/.../RapidsHostColumnVector.java) and
+of JCudfSerialization's host table format (GpuColumnarBatchSerializer.scala:127)
+— here the host format IS Arrow: pyarrow RecordBatch in memory, Arrow IPC
+stream on the wire (shuffle, spill, broadcast).
+"""
+from __future__ import annotations
+
+import io
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+from .. import types as t
+
+
+# ---------------------------------------------------------------------------
+# Arrow <-> logical type mapping
+# ---------------------------------------------------------------------------
+
+def arrow_to_dtype(at: pa.DataType) -> t.DataType:
+    if pa.types.is_boolean(at):
+        return t.BOOLEAN
+    if pa.types.is_int8(at):
+        return t.BYTE
+    if pa.types.is_int16(at):
+        return t.SHORT
+    if pa.types.is_int32(at):
+        return t.INT
+    if pa.types.is_int64(at):
+        return t.LONG
+    if pa.types.is_float32(at):
+        return t.FLOAT
+    if pa.types.is_float64(at):
+        return t.DOUBLE
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return t.STRING
+    if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+        return t.BINARY
+    if pa.types.is_date32(at):
+        return t.DATE
+    if pa.types.is_timestamp(at):
+        return t.TIMESTAMP
+    if pa.types.is_null(at):
+        return t.NULL
+    if pa.types.is_decimal(at):
+        return t.DecimalType(at.precision, at.scale)
+    if pa.types.is_dictionary(at):
+        return arrow_to_dtype(at.value_type)
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return t.ArrayType(arrow_to_dtype(at.value_type))
+    if pa.types.is_struct(at):
+        return t.StructType([t.StructField(f.name, arrow_to_dtype(f.type), f.nullable)
+                             for f in at])
+    if pa.types.is_map(at):
+        return t.MapType(arrow_to_dtype(at.key_type), arrow_to_dtype(at.item_type))
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def dtype_to_arrow(dt: t.DataType) -> pa.DataType:
+    if isinstance(dt, t.BooleanType):
+        return pa.bool_()
+    if isinstance(dt, t.ByteType):
+        return pa.int8()
+    if isinstance(dt, t.ShortType):
+        return pa.int16()
+    if isinstance(dt, t.IntegerType):
+        return pa.int32()
+    if isinstance(dt, t.LongType):
+        return pa.int64()
+    if isinstance(dt, t.FloatType):
+        return pa.float32()
+    if isinstance(dt, t.DoubleType):
+        return pa.float64()
+    if isinstance(dt, t.StringType):
+        return pa.string()
+    if isinstance(dt, t.BinaryType):
+        return pa.binary()
+    if isinstance(dt, t.DateType):
+        return pa.date32()
+    if isinstance(dt, t.TimestampType):
+        return pa.timestamp("us", tz="UTC")
+    if isinstance(dt, t.NullType):
+        return pa.null()
+    if isinstance(dt, t.DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, t.ArrayType):
+        return pa.list_(dtype_to_arrow(dt.element_type))
+    if isinstance(dt, t.StructType):
+        return pa.struct([pa.field(f.name, dtype_to_arrow(f.data_type), f.nullable)
+                          for f in dt.fields])
+    if isinstance(dt, t.MapType):
+        return pa.map_(dtype_to_arrow(dt.key_type), dtype_to_arrow(dt.value_type))
+    raise TypeError(f"unsupported logical type {dt}")
+
+
+def schema_to_struct(schema: pa.Schema) -> t.StructType:
+    return t.StructType([t.StructField(f.name, arrow_to_dtype(f.type), f.nullable)
+                         for f in schema])
+
+
+def struct_to_schema(st: t.StructType) -> pa.Schema:
+    return pa.schema([pa.field(f.name, dtype_to_arrow(f.data_type), f.nullable)
+                      for f in st.fields])
+
+
+class HostBatch:
+    """Thin wrapper over a pyarrow RecordBatch with the engine's schema view."""
+
+    def __init__(self, rb: pa.RecordBatch):
+        self.rb = rb
+        self.schema = schema_to_struct(rb.schema)
+
+    @property
+    def num_rows(self) -> int:
+        return self.rb.num_rows
+
+    @property
+    def num_columns(self) -> int:
+        return self.rb.num_columns
+
+    def column(self, i: int) -> pa.Array:
+        return self.rb.column(i)
+
+    @staticmethod
+    def from_pydict(data: dict, schema: Optional[pa.Schema] = None) -> "HostBatch":
+        return HostBatch(pa.RecordBatch.from_pydict(data, schema=schema))
+
+    @staticmethod
+    def from_table(tbl: pa.Table) -> "HostBatch":
+        return HostBatch(tbl.combine_chunks().to_batches(max_chunksize=tbl.num_rows or 1)[0]
+                         if tbl.num_rows else pa.RecordBatch.from_pydict(
+                             {n: [] for n in tbl.schema.names}, schema=tbl.schema))
+
+    def to_table(self) -> pa.Table:
+        return pa.Table.from_batches([self.rb])
+
+    @staticmethod
+    def concat(batches: List["HostBatch"]) -> "HostBatch":
+        if not batches:
+            raise ValueError("concat of zero batches")
+        tbl = pa.Table.from_batches([b.rb for b in batches])
+        return HostBatch.from_table(tbl.combine_chunks())
+
+    def slice(self, offset: int, length: int) -> "HostBatch":
+        return HostBatch(self.rb.slice(offset, length))
+
+    # ------------------------------------------------------------------
+    # Arrow IPC wire format — the JCudfSerialization analogue used by the
+    # shuffle writer/reader and the host/disk spill stores.
+    # ------------------------------------------------------------------
+    def serialize(self, compression: Optional[str] = "zstd") -> bytes:
+        sink = io.BytesIO()
+        codec = None if compression is None else str(compression).lower()
+        opts = pa.ipc.IpcWriteOptions(
+            compression=None if codec in (None, "none") else codec)
+        with pa.ipc.new_stream(sink, self.rb.schema, options=opts) as w:
+            w.write_batch(self.rb)
+        return sink.getvalue()
+
+    @staticmethod
+    def deserialize(buf: bytes) -> "HostBatch":
+        with pa.ipc.open_stream(pa.py_buffer(buf)) as r:
+            return HostBatch.from_table(r.read_all())
+
+    @staticmethod
+    def deserialize_stream(buf: bytes) -> Iterator["HostBatch"]:
+        with pa.ipc.open_stream(pa.py_buffer(buf)) as r:
+            for rb in r:
+                yield HostBatch(rb)
+
+    def nbytes(self) -> int:
+        return self.rb.nbytes
+
+    def __repr__(self):
+        return f"HostBatch({self.num_rows} rows, {self.schema.simple_string})"

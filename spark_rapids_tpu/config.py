@@ -1,0 +1,319 @@
+"""Typed configuration system for the TPU-native engine.
+
+Plays the role of the reference's RapidsConf (sql-plugin/.../RapidsConf.scala:
+3156 LoC, 225 `spark.rapids.*` entries): a registry of typed, documented
+config entries with defaults, validated setters, `startup_only`/`internal`
+markers and markdown doc generation (`python -m spark_rapids_tpu.config`
+mirrors RapidsConf.main writing docs/configs.md).
+
+Keys use the `spark.rapids.tpu.*` prefix.  Per-operator enable keys are
+generated automatically from rule names by the plan-rewrite engine
+(`spark.rapids.tpu.sql.expression.Abs=false` pattern, reference
+RapidsMeta.scala:301-316).
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+_REGISTRY: Dict[str, "ConfEntry"] = {}
+
+
+def _parse_bool(raw: Any) -> bool:
+    if isinstance(raw, bool):
+        return raw
+    return str(raw).strip().lower() in ("true", "1", "yes")
+
+
+@dataclasses.dataclass
+class ConfEntry:
+    key: str
+    default: Any
+    doc: str
+    conf_type: type
+    checker: Optional[Callable[[Any], Optional[str]]] = None
+    internal: bool = False
+    startup_only: bool = False
+    commonly_used: bool = False
+
+    def convert(self, raw: Any) -> Any:
+        if self.conf_type is bool:
+            val = _parse_bool(raw)
+        elif self.conf_type is int:
+            val = int(str(raw).strip())
+        elif self.conf_type is float:
+            val = float(str(raw).strip())
+        else:
+            val = str(raw)
+        if self.checker is not None:
+            err = self.checker(val)
+            if err:
+                raise ValueError(f"{self.key}: {err}")
+        return val
+
+
+def _register(entry: ConfEntry) -> ConfEntry:
+    if entry.key in _REGISTRY:
+        raise ValueError(f"duplicate conf key {entry.key}")
+    _REGISTRY[entry.key] = entry
+    return entry
+
+
+def conf(key, default, doc, conf_type=None, checker=None, internal=False,
+         startup_only=False, commonly_used=False) -> ConfEntry:
+    if conf_type is None:
+        conf_type = type(default) if default is not None else str
+    return _register(ConfEntry(key, default, doc, conf_type, checker,
+                               internal, startup_only, commonly_used))
+
+
+def _enum_checker(*allowed):
+    def check(v):
+        if str(v).upper() not in allowed:
+            return f"must be one of {allowed}, got {v}"
+        return None
+    return check
+
+
+def _positive(v):
+    return None if v > 0 else "must be positive"
+
+
+# --------------------------------------------------------------------------
+# Core entries (subset mirroring the commonly-used reference entries; grows).
+# --------------------------------------------------------------------------
+
+SQL_ENABLED = conf(
+    "spark.rapids.tpu.sql.enabled", True,
+    "Master kill-switch: when false, no operator is placed on the TPU.",
+    commonly_used=True)
+
+EXPLAIN = conf(
+    "spark.rapids.tpu.sql.explain", "NONE",
+    "Explain mode: NONE, ALL (log every placement decision), or NOT_ON_TPU "
+    "(log only operators that fell back to CPU with their reasons).",
+    checker=_enum_checker("NONE", "ALL", "NOT_ON_TPU"), commonly_used=True)
+
+MODE = conf(
+    "spark.rapids.tpu.sql.mode", "executeOnTPU",
+    "executeOnTPU runs supported operators on the TPU; explainOnly runs the "
+    "whole planning pipeline (tagging + reasons) but executes fully on CPU.",
+    checker=_enum_checker("EXECUTEONTPU", "EXPLAINONLY"))
+
+BATCH_SIZE_ROWS = conf(
+    "spark.rapids.tpu.sql.batchSizeRows", 1 << 22,
+    "Target maximum rows per device batch (reference batchSizeBytes analogue; "
+    "rows rather than bytes because XLA static shapes are row-bucketed).",
+    checker=_positive, commonly_used=True)
+
+BATCH_SIZE_BYTES = conf(
+    "spark.rapids.tpu.sql.batchSizeBytes", 1 << 30,
+    "Target maximum bytes per device batch when coalescing host batches.",
+    checker=_positive)
+
+CONCURRENT_TPU_TASKS = conf(
+    "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
+    "Number of concurrent tasks allowed to hold device memory at once "
+    "(reference GpuSemaphore concurrentGpuTasks default 2).",
+    checker=_positive, commonly_used=True)
+
+BUCKET_MIN_ROWS = conf(
+    "spark.rapids.tpu.sql.shape.minBucketRows", 1024,
+    "Smallest static-shape row bucket. Device batches are padded up to a "
+    "bounded geometric set of row capacities so XLA's jit cache stays small.",
+    checker=_positive, internal=True)
+
+BUCKET_GROWTH = conf(
+    "spark.rapids.tpu.sql.shape.bucketGrowth", 4,
+    "Geometric growth factor between static-shape row buckets.",
+    checker=lambda v: None if v >= 2 else "must be >= 2", internal=True)
+
+ANSI_ENABLED = conf(
+    "spark.rapids.tpu.sql.ansi.enabled", False,
+    "ANSI mode: overflow/invalid-cast raise instead of returning null.")
+
+IMPROVED_FLOAT_OPS = conf(
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled", True,
+    "Allow floating-point aggregations whose result can differ from CPU "
+    "Spark in last-ulp due to parallel reduction ordering (reference "
+    "docs/compatibility.md float semantics).")
+
+HASH_SUBPARTITION_FALLBACK = conf(
+    "spark.rapids.tpu.sql.join.subPartition.enabled", True,
+    "Re-hash-partition oversized join build sides into sub-joins "
+    "(reference GpuSubPartitionHashJoin).")
+
+RETRY_ENABLED = conf(
+    "spark.rapids.tpu.sql.retry.enabled", True,
+    "Retry device work with halved batches on HBM RESOURCE_EXHAUSTED "
+    "(reference RmmRapidsRetryIterator withSplitAndRetry analogue).")
+
+RETRY_MAX_SPLITS = conf(
+    "spark.rapids.tpu.sql.retry.maxSplits", 8,
+    "Maximum times a batch may be halved before the OOM is rethrown.",
+    checker=_positive)
+
+TEST_INJECT_RETRY_OOM = conf(
+    "spark.rapids.tpu.sql.test.injectRetryOOM", 0,
+    "Test-only: force a synthetic device OOM on the Nth retryable block "
+    "(reference spark.rapids.sql.test.injectRetryOOM).", internal=True)
+
+SHUFFLE_MODE = conf(
+    "spark.rapids.tpu.shuffle.mode", "MULTITHREADED",
+    "MULTITHREADED: host-side threaded Arrow-IPC shuffle (reference mode 1). "
+    "ICI: collective all-to-all exchange over the device mesh for co-located "
+    "partitions (reference UCX-mode analogue). CACHE_ONLY: in-process, tests.",
+    checker=_enum_checker("MULTITHREADED", "ICI", "CACHE_ONLY"))
+
+SHUFFLE_WRITER_THREADS = conf(
+    "spark.rapids.tpu.shuffle.multiThreaded.writer.threads", 8,
+    "Thread pool size for the multithreaded shuffle writer.", checker=_positive)
+
+SHUFFLE_READER_THREADS = conf(
+    "spark.rapids.tpu.shuffle.multiThreaded.reader.threads", 8,
+    "Thread pool size for the multithreaded shuffle reader.", checker=_positive)
+
+SHUFFLE_COMPRESSION = conf(
+    "spark.rapids.tpu.shuffle.compression.codec", "zstd",
+    "Codec for shuffle/spill Arrow IPC buffers: zstd, lz4, or none.",
+    checker=_enum_checker("ZSTD", "LZ4", "NONE"))
+
+HOST_SPILL_LIMIT_BYTES = conf(
+    "spark.rapids.tpu.memory.host.spillStorageSize", 8 << 30,
+    "Host spill store byte limit before batches overflow to disk "
+    "(reference RapidsHostMemoryStore limit).", checker=_positive)
+
+HBM_BUDGET_FRACTION = conf(
+    "spark.rapids.tpu.memory.tpu.allocFraction", 0.85,
+    "Fraction of per-chip HBM the engine budgets for batches; exceeding the "
+    "budget triggers spill-to-host before new device work is admitted.",
+    checker=lambda v: None if 0 < v <= 1 else "must be in (0, 1]")
+
+PARQUET_READER_TYPE = conf(
+    "spark.rapids.tpu.sql.format.parquet.reader.type", "AUTO",
+    "AUTO, PERFILE, COALESCING, or MULTITHREADED (reference 3 strategies).",
+    checker=_enum_checker("AUTO", "PERFILE", "COALESCING", "MULTITHREADED"))
+
+PARQUET_MT_THREADS = conf(
+    "spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads", 8,
+    "Thread pool for the multithreaded parquet reader.", checker=_positive)
+
+ENABLED_FORMATS = {
+    fmt: conf(
+        f"spark.rapids.tpu.sql.format.{fmt}.enabled", True,
+        f"Enable accelerated {fmt} scan.")
+    for fmt in ("parquet", "csv", "json", "orc", "avro")
+}
+
+CPU_ORACLE_VALIDATE = conf(
+    "spark.rapids.tpu.sql.test.validateWithCpu", False,
+    "Test-only: run every device operator's CPU fallback too and compare.",
+    internal=True)
+
+METRICS_LEVEL = conf(
+    "spark.rapids.tpu.sql.metrics.level", "MODERATE",
+    "ESSENTIAL, MODERATE, or DEBUG metric collection per operator.",
+    checker=_enum_checker("ESSENTIAL", "MODERATE", "DEBUG"))
+
+
+class TpuConf:
+    """An immutable-ish view over a dict of raw settings with typed access.
+
+    Like the reference, a fresh TpuConf is constructed from the session conf
+    at plan time so per-query overrides take effect (GpuOverrides.scala:4571).
+    """
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._raw = dict(settings or {})
+        self._cache: Dict[str, Any] = {}
+        for k in self._raw:
+            if (k.startswith("spark.rapids.tpu.") and k not in _REGISTRY
+                    and not self._is_dynamic_key(k)):
+                raise ValueError(f"unknown config key: {k}")
+
+    _DYNAMIC_RE = re.compile(
+        r"^spark\.rapids\.tpu\.sql\.(expression|exec|partitioning|command)\.\w+$")
+
+    @classmethod
+    def _is_dynamic_key(cls, key: str) -> bool:
+        return cls._DYNAMIC_RE.match(key) is not None
+
+    def get(self, entry: ConfEntry):
+        if entry.key not in self._cache:
+            raw = self._raw.get(entry.key, entry.default)
+            self._cache[entry.key] = entry.convert(raw) if raw is not None else None
+        return self._cache[entry.key]
+
+    def get_raw(self, key: str, default=None):
+        return self._raw.get(key, default)
+
+    def is_op_enabled(self, kind: str, name: str) -> bool:
+        """Per-operator auto-generated enable keys, default on."""
+        raw = self._raw.get(f"spark.rapids.tpu.sql.{kind}.{name}")
+        if raw is None:
+            return True
+        return _parse_bool(raw)
+
+    def with_overrides(self, **kv) -> "TpuConf":
+        merged = dict(self._raw)
+        merged.update({k.replace("__", "."): v for k, v in kv.items()})
+        return TpuConf(merged)
+
+    # Convenience typed accessors used widely by the engine.
+    @property
+    def sql_enabled(self):
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self):
+        return str(self.get(EXPLAIN)).upper()
+
+    @property
+    def explain_only(self):
+        return str(self.get(MODE)).upper() == "EXPLAINONLY"
+
+    @property
+    def batch_size_rows(self):
+        return self.get(BATCH_SIZE_ROWS)
+
+    @property
+    def ansi(self):
+        return self.get(ANSI_ENABLED)
+
+    @property
+    def bucket_min_rows(self):
+        return self.get(BUCKET_MIN_ROWS)
+
+    @property
+    def bucket_growth(self):
+        return self.get(BUCKET_GROWTH)
+
+
+DEFAULT_CONF = TpuConf()
+
+
+def generate_docs() -> str:
+    """Markdown config reference (reference RapidsConf.help / docs/configs.md)."""
+    lines = ["# spark-rapids-tpu configuration", "",
+             "| key | default | meaning |", "|---|---|---|"]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.internal:
+            continue
+        doc = e.doc.replace("|", "\\|").replace("\n", " ")
+        lines.append(f"| `{e.key}` | `{e.default}` | {doc} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def all_entries() -> List[ConfEntry]:
+    return list(_REGISTRY.values())
+
+
+if __name__ == "__main__":
+    import pathlib
+    out = pathlib.Path(__file__).resolve().parent.parent / "docs"
+    out.mkdir(exist_ok=True)
+    (out / "configs.md").write_text(generate_docs())
+    print(f"wrote {out / 'configs.md'}")

@@ -1,0 +1,24 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the task requirement: multi-chip sharding is validated on a virtual
+CPU mesh (xla_force_host_platform_device_count) since only one real TPU chip
+is reachable; bench.py runs on the real chip instead.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]

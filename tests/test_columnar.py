@@ -1,0 +1,93 @@
+"""Round-trip tests for the host/device columnar layer (ref L2 analogue)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.columnar import (HostBatch, bucket_capacity, to_device,
+                                       to_host)
+
+
+def roundtrip(data: dict, schema=None) -> tuple:
+    hb = HostBatch.from_pydict(data, schema)
+    db = to_device(hb)
+    back = to_host(db)
+    return hb, db, back
+
+
+def test_bucket_capacity_geometric():
+    assert bucket_capacity(1) == 1024
+    assert bucket_capacity(1024) == 1024
+    assert bucket_capacity(1025) == 4096
+    assert bucket_capacity(5000) == 16384
+
+
+def test_numeric_roundtrip_with_nulls():
+    hb, db, back = roundtrip({
+        "i": pa.array([1, None, 3, 4], pa.int32()),
+        "l": pa.array([10, 20, None, 40], pa.int64()),
+        "d": pa.array([1.5, None, 3.5, float("nan")], pa.float64()),
+        "b": pa.array([True, False, None, True], pa.bool_()),
+    })
+    assert db.num_rows == 4 and db.capacity == 1024
+    assert back.rb.column(0).to_pylist() == [1, None, 3, 4]
+    assert back.rb.column(1).to_pylist() == [10, 20, None, 40]
+    got = back.rb.column(2).to_pylist()
+    assert got[0] == 1.5 and got[1] is None and got[2] == 3.5 and np.isnan(got[3])
+    assert back.rb.column(3).to_pylist() == [True, False, None, True]
+
+
+def test_string_dictionary_roundtrip():
+    hb, db, back = roundtrip({"s": pa.array(["a", "bb", None, "a", "ccc"])})
+    col = db.column(0)
+    assert isinstance(col.dtype, t.StringType)
+    assert col.dictionary is not None
+    assert back.rb.column(0).to_pylist() == ["a", "bb", None, "a", "ccc"]
+
+
+def test_date_timestamp_roundtrip():
+    import datetime as dtm
+    dates = [dtm.date(2024, 1, 1), None, dtm.date(1969, 12, 31)]
+    ts = [dtm.datetime(2024, 1, 1, 12, 0, 0), None,
+          dtm.datetime(1960, 6, 1, 0, 0, 1)]
+    hb, db, back = roundtrip({
+        "dt": pa.array(dates, pa.date32()),
+        "ts": pa.array(ts, pa.timestamp("us")),
+    })
+    assert back.rb.column(0).to_pylist() == dates
+    got_ts = back.rb.column(1).to_pylist()
+    assert got_ts[1] is None
+    assert got_ts[0].replace(tzinfo=None) == ts[0]
+    assert got_ts[2].replace(tzinfo=None) == ts[2]
+
+
+def test_decimal64_roundtrip():
+    import decimal
+    vals = [decimal.Decimal("123.45"), None, decimal.Decimal("-0.01")]
+    hb, db, back = roundtrip({"dec": pa.array(vals, pa.decimal128(10, 2))})
+    assert back.rb.column(0).to_pylist() == vals
+    assert isinstance(db.column(0).dtype, t.DecimalType)
+
+
+def test_decimal128_roundtrip():
+    import decimal
+    vals = [decimal.Decimal("12345678901234567890.123"), None,
+            decimal.Decimal("-98765432109876543210.999")]
+    hb, db, back = roundtrip({"dec": pa.array(vals, pa.decimal128(30, 3))})
+    assert back.rb.column(0).to_pylist() == vals
+    assert db.column(0).data_hi is not None
+
+
+def test_ipc_serialization_roundtrip():
+    hb = HostBatch.from_pydict({"x": pa.array([1, 2, None], pa.int64()),
+                                "s": pa.array(["p", None, "q"])})
+    for codec in ("zstd", None):
+        buf = hb.serialize(codec)
+        back = HostBatch.deserialize(buf)
+        assert back.rb.equals(hb.rb)
+
+
+def test_empty_batch():
+    hb, db, back = roundtrip({"x": pa.array([], pa.int64())})
+    assert db.num_rows == 0
+    assert back.num_rows == 0
