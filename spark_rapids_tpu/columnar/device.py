@@ -264,6 +264,10 @@ def _device_column_to_arrow(col: DeviceColumn, num_rows: int) -> pa.Array:
     if isinstance(dt, t.NullType):
         return pa.nulls(num_rows)
     if isinstance(dt, t.DoubleType):
+        # Two storage lanes exist: int64 f64-bit-patterns (host pass-through)
+        # and native f64 (computed on device) — see ops/kernels.py views.
+        if data.dtype == np.float64:
+            return pa.array(data, pa.float64(), mask=~valid)
         f64 = data.astype(np.int64).view(np.float64)
         return pa.array(f64, pa.float64(), mask=~valid)
     arrow_type = dtype_to_arrow(dt)

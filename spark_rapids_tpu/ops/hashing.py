@@ -1,0 +1,197 @@
+"""Spark-exact Murmur3 hashing on device (jnp) and host (bytes).
+
+Bit-compatibility with Spark's Murmur3_x86_32 (seed 42) matters because hash
+partitioning decides shuffle placement: CPU-fallback operators and device
+operators must agree on row placement, exactly as the reference computes
+Spark-exact murmur3 on the GPU (reference: spark-rapids-jni `Hash`,
+GpuHashPartitioningBase.scala:28, HashFunctions.scala).
+
+Fixed-width values hash on device in uint32 lanes; strings hash on host over
+the (small) per-batch dictionary, and rows pick up `dict_hashes[code]` on
+device — the dictionary-encoding dividend of the TPU columnar layout.
+
+Spark semantics reproduced here:
+  * null field: hash unchanged (the running seed passes through)
+  * boolean -> hashInt(0/1); byte/short/int/date -> hashInt(sign-extended)
+  * long/timestamp -> hashLong; float/double -> bits with -0.0 -> +0.0
+  * string -> hashUnsafeBytes: 4-byte LE words, then per-byte tail rounds
+    (signed bytes), fmix with total byte length
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import types as t
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+SPARK_SEED = 42
+
+
+# ---------------------------------------------------------------------------
+# Device (jnp, uint32 lanes)
+# ---------------------------------------------------------------------------
+
+def _rotl32(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_k1(k1):
+    k1 = (k1 * jnp.uint32(_C1)).astype(jnp.uint32)
+    k1 = _rotl32(k1, 15)
+    return (k1 * jnp.uint32(_C2)).astype(jnp.uint32)
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl32(h1, 13)
+    return (h1 * jnp.uint32(5) + jnp.uint32(0xE6546B64)).astype(jnp.uint32)
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ jnp.uint32(length)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = (h1 * jnp.uint32(0x85EBCA6B)).astype(jnp.uint32)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = (h1 * jnp.uint32(0xC2B2AE35)).astype(jnp.uint32)
+    return h1 ^ (h1 >> 16)
+
+
+def hash_int32(x, seed):
+    """Murmur3 hashInt: x int32 array, seed uint32 array/scalar -> uint32."""
+    k1 = _mix_k1(x.astype(jnp.uint32))
+    h1 = _mix_h1(seed.astype(jnp.uint32), k1)
+    return _fmix(h1, 4)
+
+
+def hash_int64(x, seed):
+    x = x.astype(jnp.int64)
+    low = x.astype(jnp.uint32)
+    high = (x >> 32).astype(jnp.uint32)
+    k1 = _mix_k1(low)
+    h1 = _mix_h1(seed.astype(jnp.uint32), k1)
+    k1 = _mix_k1(high)
+    h1 = _mix_h1(h1, k1)
+    return _fmix(h1, 8)
+
+
+def hash_column(data, validity, dt: t.DataType, seed, dict_hashes=None):
+    """Fold one column into a running uint32 hash lane (Spark semantics).
+
+    `data` is the *storage* lane (DOUBLE = f64 bits as int64). `dict_hashes`
+    is a precomputed uint32 device array of per-dictionary-entry hashes for
+    STRING columns, computed on host against the SAME seed chain only when
+    the column is the first key; for multi-key chains string hashing needs
+    per-row seeds, so dict_hashes holds murmur3 of the utf8 bytes with each
+    possible seed — instead we pass raw bytes hashing via a two-level scheme:
+    dict_hashes maps code -> hashUnsafeBytes(entry, seed_chain) computed on
+    host per batch when seeds are scalar.  See StringHashPlan in
+    exec/hashing for the general case.
+    """
+    if isinstance(dt, t.BooleanType):
+        h = hash_int32(data.astype(jnp.int32), seed)
+    elif isinstance(dt, (t.ByteType, t.ShortType, t.IntegerType, t.DateType)):
+        h = hash_int32(data.astype(jnp.int32), seed)
+    elif isinstance(dt, (t.LongType, t.TimestampType)):
+        h = hash_int64(data, seed)
+    elif isinstance(dt, t.FloatType):
+        import jax
+        x = jnp.where(data == 0.0, jnp.float32(0.0), data)  # -0.0 -> +0.0
+        bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int32)
+        h = hash_int32(bits, seed)
+    elif isinstance(dt, t.DoubleType):
+        # Requires the int64 f64-bits storage lane (host pass-through
+        # columns).  Computed-f64 lanes can't be bitcast on this TPU
+        # (f64->s64 unimplemented); callers must tag such keys unsupported.
+        if data.dtype != jnp.int64:
+            raise TypeError("hashing computed f64 values is not supported on "
+                            "device; route through host or disallow")
+        neg_zero = jnp.int64(np.int64(-2**63))  # 0x8000_0000_0000_0000
+        bits = jnp.where(data == neg_zero, jnp.int64(0), data)
+        h = hash_int64(bits, seed)
+    elif isinstance(dt, t.StringType):
+        if dict_hashes is None:
+            raise ValueError("string hashing requires precomputed dict hashes")
+        h = dict_hashes[jnp.clip(data, 0, dict_hashes.shape[0] - 1)]
+    elif isinstance(dt, t.DecimalType) and not dt.is_wide:
+        # Spark hashes small decimals as the unscaled long when precision<=18
+        h = hash_int64(data, seed)
+    else:
+        raise TypeError(f"unsupported hash type {dt}")
+    if validity is not None:
+        h = jnp.where(validity, h, seed.astype(jnp.uint32))
+    return h
+
+
+# ---------------------------------------------------------------------------
+# Host (numpy over raw bytes — used for string dictionaries)
+# ---------------------------------------------------------------------------
+
+def _np_u32(x):
+    return np.uint32(x & 0xFFFFFFFF)
+
+
+def _np_mix_k1(k1):
+    k1 = np.uint32((int(k1) * _C1) & 0xFFFFFFFF)
+    k1 = np.uint32(((int(k1) << 15) | (int(k1) >> 17)) & 0xFFFFFFFF)
+    return np.uint32((int(k1) * _C2) & 0xFFFFFFFF)
+
+
+def _np_mix_h1(h1, k1):
+    h1 = np.uint32(int(h1) ^ int(k1))
+    h1 = np.uint32(((int(h1) << 13) | (int(h1) >> 19)) & 0xFFFFFFFF)
+    return np.uint32((int(h1) * 5 + 0xE6546B64) & 0xFFFFFFFF)
+
+
+def _np_fmix(h1, length):
+    h = int(h1) ^ length
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return np.uint32(h)
+
+
+def murmur3_bytes(data: bytes, seed: int) -> int:
+    """Spark Murmur3_x86_32.hashUnsafeBytes over `data` (per-byte tail)."""
+    h1 = _np_u32(seed)
+    n = len(data)
+    aligned = n - n % 4
+    for i in range(0, aligned, 4):
+        word = int.from_bytes(data[i:i + 4], "little", signed=True)
+        h1 = _np_mix_h1(h1, _np_mix_k1(_np_u32(word)))
+    for i in range(aligned, n):
+        byte = int.from_bytes(data[i:i + 1], "little", signed=True)
+        h1 = _np_mix_h1(h1, _np_mix_k1(_np_u32(byte)))
+    return int(_np_fmix(h1, n))
+
+
+def murmur3_utf8(s, seed: int) -> int:
+    return murmur3_bytes(s.encode("utf-8"), seed)
+
+
+def dict_hash_array(dictionary, seed: int) -> np.ndarray:
+    """uint32 hashes of every dictionary entry (host; dictionaries are small)."""
+    out = np.empty(max(len(dictionary), 1), dtype=np.uint32)
+    out[:] = np.uint32(seed)
+    for i, v in enumerate(dictionary):
+        s = v.as_py() if hasattr(v, "as_py") else v
+        if s is not None:
+            out[i] = np.uint32(murmur3_utf8(s, seed))
+    return out
+
+
+def murmur3_int32_host(x: int, seed: int) -> int:
+    h1 = _np_mix_h1(_np_u32(seed), _np_mix_k1(_np_u32(x)))
+    return int(_np_fmix(h1, 4))
+
+
+def murmur3_int64_host(x: int, seed: int) -> int:
+    x &= 0xFFFFFFFFFFFFFFFF
+    low = x & 0xFFFFFFFF
+    high = (x >> 32) & 0xFFFFFFFF
+    h1 = _np_mix_h1(_np_u32(seed), _np_mix_k1(_np_u32(low)))
+    h1 = _np_mix_h1(h1, _np_mix_k1(_np_u32(high)))
+    return int(_np_fmix(h1, 8))

@@ -1,0 +1,76 @@
+"""Low-level device kernel helpers shared by the expression/operator layers.
+
+The cuDF ColumnVector elementwise-op role (reference §2.9) is played by jnp
+inside jit-traced expression functions; this module holds the representation
+plumbing those traces share:
+
+  * storage<->compute views (DOUBLE rides as int64 bit patterns, see
+    columnar/device.py module docs)
+  * validity lane algebra (Spark three-valued logic)
+  * row-liveness masking for reductions over padded buckets
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as t
+
+
+def compute_dtype(dt: t.DataType):
+    """jnp dtype used for arithmetic on this logical type."""
+    if isinstance(dt, t.DoubleType):
+        return jnp.float64
+    return t.physical_np_dtype(dt)
+
+
+def compute_view(data: jax.Array, dt: t.DataType) -> jax.Array:
+    """Storage lane -> compute representation.
+
+    DOUBLE has two possible storage lanes: int64 f64-bit-patterns for columns
+    that came from the host (bit-exact pass-through; see columnar/device.py)
+    and native (emulated) f64 for computed results — XLA on this TPU supports
+    the s64->f64 bitcast but NOT the reverse, so computed doubles stay f64.
+    """
+    if isinstance(dt, t.DoubleType) and data.dtype == jnp.int64:
+        return jax.lax.bitcast_convert_type(data, jnp.float64)
+    return data
+
+
+def storage_view(data: jax.Array, dt: t.DataType) -> jax.Array:
+    """Compute representation -> storage lane.
+
+    Computed DOUBLEs keep their native f64 lane (f64->s64 bitcast is
+    unimplemented on-TPU; nothing is lost — the value is already
+    device-precision).  to_host handles both lane kinds.
+    """
+    if isinstance(dt, t.DoubleType):
+        return data.astype(jnp.float64)
+    return data.astype(t.physical_np_dtype(dt))
+
+
+def merge_validity(*vs: Optional[jax.Array]) -> Optional[jax.Array]:
+    """AND of validity lanes; None means all-valid."""
+    present = [v for v in vs if v is not None]
+    if not present:
+        return None
+    out = present[0]
+    for v in present[1:]:
+        out = jnp.logical_and(out, v)
+    return out
+
+
+def valid_or_true(v: Optional[jax.Array], capacity: int) -> jax.Array:
+    return jnp.ones((capacity,), dtype=bool) if v is None else v
+
+
+def live_mask(capacity: int, num_rows: jax.Array) -> jax.Array:
+    """Mask of logically-live rows in a padded bucket."""
+    return jnp.arange(capacity, dtype=jnp.int32) < num_rows.astype(jnp.int32)
+
+
+def zeros_like_storage(dt: t.DataType, capacity: int) -> jax.Array:
+    return jnp.zeros((capacity,), dtype=t.physical_np_dtype(dt))

@@ -1,0 +1,1195 @@
+"""Expression trees: the Catalyst-expression analogue with dual backends.
+
+Reference roles played here (SURVEY §2.5):
+  * `GpuExpression.columnarEval` -> `eval_dev`, traced under jax.jit. The
+    whole projection/filter of an operator traces into ONE XLA program, so
+    "AST compilation" (reference ai.rapids.cudf.ast / convertToAst) is free:
+    tracing IS the AST compile, and XLA fuses the elementwise pipeline.
+  * CPU fallback per expression -> `eval_cpu` over pyarrow arrays with
+    Spark semantics. This is both the fallback engine (unsupported exprs run
+    on host, like the reference's per-operator CPU fallback) and the test
+    oracle (reference strategy §4: same query, two backends, compare).
+  * Tag-time support checks -> `unsupported_reasons`, collected by the
+    overrides engine into fallback explanations.
+
+Evaluation protocol per batch (two phases, see columnar/device.py on why):
+  1. host `prepare`: bottom-up walk computing dictionary-derived metadata
+     (literal code lookups, transformed dictionaries, per-dict predicate
+     masks) and registering small device aux arrays. Deterministic preorder
+     so aux slot indices are stable across batches of the same tree.
+  2. device `eval_dev`: traced inside jit; consumes input column lanes and
+     the aux arrays positionally.
+
+Spark (non-ANSI) semantics encoded here: integer ops wrap like Java;
+divide/remainder by zero -> NULL; three-valued AND/OR (Kleene); comparisons
+null-out when either side is null; NaN handling per Spark (NaN == NaN in
+sorting; see individual ops).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as t
+from ..config import TpuConf
+from ..ops.kernels import compute_dtype, merge_validity
+
+
+class PrepCtx:
+    """Host-phase context: collects device aux arrays in deterministic order."""
+
+    def __init__(self, conf: TpuConf, dicts: Dict[str, Optional[pa.Array]]):
+        self.conf = conf
+        self.dicts = dicts            # input column name -> dictionary or None
+        self.aux: List[np.ndarray] = []
+        self.node_slots: Dict[int, List[int]] = {}
+
+    def add(self, node: "Expression", arr: np.ndarray) -> None:
+        self.node_slots.setdefault(id(node), []).append(len(self.aux))
+        self.aux.append(np.asarray(arr))
+
+
+class HostVal:
+    """Per-node host metadata flowing through prepare (dictionaries)."""
+
+    def __init__(self, dictionary: Optional[pa.Array] = None):
+        self.dictionary = dictionary
+
+
+class EvalCtx:
+    """Device-phase context available while tracing eval_dev."""
+
+    def __init__(self, capacity: int, num_rows, inputs, aux, node_slots, conf):
+        self.capacity = capacity
+        self.num_rows = num_rows
+        self.inputs = inputs          # name -> DevVal
+        self.aux = aux                # tuple of jnp arrays (positional)
+        self.node_slots = node_slots
+        self.conf = conf
+
+    def aux_of(self, node: "Expression") -> List[jax.Array]:
+        return [self.aux[i] for i in self.node_slots.get(id(node), [])]
+
+
+class DevVal:
+    """A traced column value: compute-representation lane + validity."""
+
+    def __init__(self, data, validity, dtype: t.DataType,
+                 dictionary: Optional[pa.Array] = None):
+        self.data = data
+        self.validity = validity      # None = all rows valid
+        self.dtype = dtype
+        self.dictionary = dictionary
+
+
+class Expression:
+    children: Tuple["Expression", ...] = ()
+    dtype: t.DataType = None
+    nullable: bool = True
+
+    # ---- resolution ----
+    def bind(self, schema: t.StructType) -> "Expression":
+        """Return a copy with children bound and dtype resolved."""
+        bound = self._with_children([c.bind(schema) for c in self.children])
+        bound._resolve()
+        return bound
+
+    def _with_children(self, kids) -> "Expression":
+        import copy
+        c = copy.copy(self)
+        c.children = tuple(kids)
+        return c
+
+    def _resolve(self):
+        raise NotImplementedError(type(self).__name__)
+
+    # ---- tagging ----
+    def unsupported_reasons(self, conf: TpuConf) -> List[str]:
+        """Reasons THIS node can't run on device ([] = supported)."""
+        return []
+
+    def tree_unsupported(self, conf: TpuConf) -> List[str]:
+        out = []
+        if not conf.is_op_enabled("expression", type(self).__name__):
+            out.append(f"{type(self).__name__} disabled by conf")
+        out += [f"{type(self).__name__}: {r}"
+                for r in self.unsupported_reasons(conf)]
+        for c in self.children:
+            out += c.tree_unsupported(conf)
+        return out
+
+    # ---- host phase ----
+    def prepare(self, pctx: PrepCtx) -> HostVal:
+        kids = [c.prepare(pctx) for c in self.children]
+        return self._prepare(pctx, kids)
+
+    def _prepare(self, pctx: PrepCtx, kids: List[HostVal]) -> HostVal:
+        return HostVal()
+
+    # ---- device phase (traced) ----
+    def eval_dev(self, ctx: EvalCtx) -> DevVal:
+        kids = [c.eval_dev(ctx) for c in self.children]
+        return self._eval_dev(ctx, kids)
+
+    def _eval_dev(self, ctx: EvalCtx, kids: List[DevVal]) -> DevVal:
+        raise NotImplementedError(type(self).__name__)
+
+    # ---- CPU fallback / oracle ----
+    def eval_cpu(self, rb: pa.RecordBatch) -> pa.Array:
+        kids = [c.eval_cpu(rb) for c in self.children]
+        return self._eval_cpu(rb, kids)
+
+    def _eval_cpu(self, rb, kids) -> pa.Array:
+        raise NotImplementedError(type(self).__name__)
+
+    # ---- identity ----
+    def fingerprint(self) -> str:
+        kids = ",".join(c.fingerprint() for c in self.children)
+        return f"{type(self).__name__}({self._fp_extra()};{kids})"
+
+    def _fp_extra(self) -> str:
+        return ""
+
+    def __repr__(self):
+        return self.fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+class ColumnRef(Expression):
+    def __init__(self, name: str):
+        self.name = name
+        self.children = ()
+
+    def bind(self, schema: t.StructType) -> "Expression":
+        b = ColumnRef(self.name)
+        f = schema[self.name]
+        b.dtype = f.data_type
+        b.nullable = f.nullable
+        return b
+
+    def _eval_dev(self, ctx, kids):
+        return ctx.inputs[self.name]
+
+    def _prepare(self, pctx, kids):
+        return HostVal(pctx.dicts.get(self.name))
+
+    def _eval_cpu(self, rb, kids):
+        return rb.column(rb.schema.get_field_index(self.name))
+
+    def _fp_extra(self):
+        return self.name
+
+
+class Literal(Expression):
+    def __init__(self, value, dtype: Optional[t.DataType] = None):
+        self.value = value
+        self.children = ()
+        if dtype is None:
+            dtype = self._infer(value)
+        self.dtype = dtype
+        self.nullable = value is None
+
+    @staticmethod
+    def _infer(v) -> t.DataType:
+        if v is None:
+            return t.NULL
+        if isinstance(v, bool):
+            return t.BOOLEAN
+        if isinstance(v, int):
+            return t.INT if -(2**31) <= v < 2**31 else t.LONG
+        if isinstance(v, float):
+            return t.DOUBLE
+        if isinstance(v, str):
+            return t.STRING
+        raise TypeError(f"cannot infer literal type of {v!r}")
+
+    def bind(self, schema):
+        return self
+
+    def _resolve(self):
+        pass
+
+    def _prepare(self, pctx, kids):
+        if isinstance(self.dtype, t.StringType) and self.value is not None:
+            return HostVal(pa.array([self.value], pa.string()))
+        return HostVal()
+
+    def _eval_dev(self, ctx, kids):
+        cap = ctx.capacity
+        if self.value is None:
+            dt = self.dtype if not isinstance(self.dtype, t.NullType) else t.INT
+            data = jnp.zeros((cap,), dtype=compute_dtype(dt))
+            return DevVal(data, jnp.zeros((cap,), bool), self.dtype)
+        if isinstance(self.dtype, t.StringType):
+            data = jnp.zeros((cap,), dtype=jnp.int32)  # code 0 of 1-entry dict
+            return DevVal(data, None, self.dtype,
+                          pa.array([self.value], pa.string()))
+        data = jnp.full((cap,), self.value, dtype=compute_dtype(self.dtype))
+        return DevVal(data, None, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        n = rb.num_rows
+        if self.value is None:
+            return pa.nulls(n, dtype_to_arrow(self.dtype)
+                            if not isinstance(self.dtype, t.NullType) else pa.null())
+        return pa.array([self.value] * n, dtype_to_arrow(self.dtype))
+
+    def _fp_extra(self):
+        return f"{self.value!r}:{self.dtype.simple_string}"
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, name: str):
+        self.children = (child,)
+        self.name = name
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _eval_dev(self, ctx, kids):
+        return self.children[0].eval_dev(ctx)
+
+    def eval_dev(self, ctx):
+        return self.children[0].eval_dev(ctx)
+
+    def eval_cpu(self, rb):
+        return self.children[0].eval_cpu(rb)
+
+    def _fp_extra(self):
+        return self.name
+
+
+# ---------------------------------------------------------------------------
+# Numeric binary arithmetic
+# ---------------------------------------------------------------------------
+
+def _promote_binary(a: Expression, b: Expression) -> t.DataType:
+    da, db = a.dtype, b.dtype
+    if isinstance(da, t.NullType):
+        return db
+    if isinstance(db, t.NullType):
+        return da
+    if da == db:
+        return da
+    return t.numeric_promote(da, db)
+
+
+def _cast_dev(v, src: t.DataType, dst: t.DataType):
+    if src == dst:
+        return v
+    return v.astype(compute_dtype(dst))
+
+
+def _cpu_promote(arr: pa.Array, dst: t.DataType) -> pa.Array:
+    from ..columnar.host import dtype_to_arrow
+    want = dtype_to_arrow(dst)
+    if arr.type == want:
+        return arr
+    return arr.cast(want)
+
+
+class BinaryArithmetic(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def _resolve(self):
+        self.dtype = _promote_binary(*self.children)
+        self.nullable = True
+
+    def unsupported_reasons(self, conf):
+        for c in self.children:
+            if not t.is_numeric(c.dtype) and not isinstance(c.dtype, t.NullType):
+                return [f"non-numeric operand {c.dtype.simple_string}"]
+            if isinstance(c.dtype, t.DecimalType):
+                return ["decimal arithmetic not yet on device"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        l, r = kids
+        ld = _cast_dev(l.data, l.dtype, self.dtype)
+        rd = _cast_dev(r.data, r.dtype, self.dtype)
+        data, extra_valid = self._op_dev(ld, rd)
+        valid = merge_validity(l.validity, r.validity, extra_valid)
+        return DevVal(data, valid, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        l = _cpu_promote(kids[0], self.dtype)
+        r = _cpu_promote(kids[1], self.dtype)
+        return self._op_cpu(l, r)
+
+    def _fp_extra(self):
+        return self.symbol
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def _op_dev(self, l, r):
+        return l + r, None
+
+    def _op_cpu(self, l, r):
+        return pc.add_checked(l, r) if False else pc.add(l, r)
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def _op_dev(self, l, r):
+        return l - r, None
+
+    def _op_cpu(self, l, r):
+        return pc.subtract(l, r)
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def _op_dev(self, l, r):
+        return l * r, None
+
+    def _op_cpu(self, l, r):
+        return pc.multiply(l, r)
+
+
+class Divide(BinaryArithmetic):
+    """Spark Divide: result is DOUBLE (for non-decimal); x/0 -> NULL."""
+    symbol = "/"
+
+    def _resolve(self):
+        for c in self.children:
+            if not (t.is_numeric(c.dtype) or isinstance(c.dtype, t.NullType)):
+                raise TypeError(f"divide on {c.dtype}")
+        self.dtype = t.DOUBLE
+
+    def _eval_dev(self, ctx, kids):
+        l, r = kids
+        ld = l.data.astype(jnp.float64)
+        rd = r.data.astype(jnp.float64)
+        safe_r = jnp.where(rd == 0.0, jnp.float64(1.0), rd)
+        data = ld / safe_r
+        extra = rd != 0.0
+        return DevVal(data, merge_validity(l.validity, r.validity, extra),
+                      t.DOUBLE)
+
+    def _eval_cpu(self, rb, kids):
+        l = kids[0].cast(pa.float64())
+        r = kids[1].cast(pa.float64())
+        nz = pc.not_equal(r, pa.scalar(0.0))
+        safe_r = pc.if_else(pc.fill_null(nz, False), r, pa.scalar(1.0))
+        out = pc.divide(l, safe_r)
+        return pc.if_else(pc.fill_null(nz, False), out,
+                          pa.nulls(len(out), pa.float64()))
+
+
+class IntegralDivide(BinaryArithmetic):
+    """Spark `div`: long division truncating toward zero; x div 0 -> NULL."""
+    symbol = "div"
+
+    def _resolve(self):
+        self.dtype = t.LONG
+
+    def unsupported_reasons(self, conf):
+        base = super().unsupported_reasons(conf)
+        for c in self.children:
+            if t.is_floating(c.dtype):
+                return base + ["integral divide of floating input"]
+        return base
+
+    def _eval_dev(self, ctx, kids):
+        l, r = kids
+        ld = l.data.astype(jnp.int64)
+        rd = r.data.astype(jnp.int64)
+        safe_r = jnp.where(rd == 0, jnp.int64(1), rd)
+        # Java integer division truncates toward zero; jnp // floors.
+        q = jnp.sign(ld) * jnp.sign(safe_r) * (jnp.abs(ld) // jnp.abs(safe_r))
+        return DevVal(q, merge_validity(l.validity, r.validity, rd != 0),
+                      t.LONG)
+
+    def _eval_cpu(self, rb, kids):
+        l = kids[0].cast(pa.int64())
+        r = kids[1].cast(pa.int64())
+        nz = pc.not_equal(r, pa.scalar(0, pa.int64()))
+        safe_r = pc.if_else(pc.fill_null(nz, False), r, pa.scalar(1, pa.int64()))
+        q = pc.divide(l, safe_r)  # arrow int division truncates toward zero
+        return pc.if_else(pc.fill_null(nz, False), q, pa.nulls(len(q), pa.int64()))
+
+
+class Remainder(BinaryArithmetic):
+    """Spark %: Java semantics (sign follows dividend); x % 0 -> NULL."""
+    symbol = "%"
+
+    def _eval_dev(self, ctx, kids):
+        l, r = kids
+        ld = _cast_dev(l.data, l.dtype, self.dtype)
+        rd = _cast_dev(r.data, r.dtype, self.dtype)
+        if t.is_floating(self.dtype):
+            safe_r = jnp.where(rd == 0, jnp.asarray(1, rd.dtype), rd)
+            data = jnp.fmod(ld, safe_r)  # C fmod: sign follows dividend
+            extra = rd != 0
+        else:
+            safe_r = jnp.where(rd == 0, jnp.asarray(1, rd.dtype), rd)
+            # Java %: sign follows dividend. jnp.remainder follows divisor.
+            data = jnp.sign(ld) * (jnp.abs(ld) % jnp.abs(safe_r))
+            data = data.astype(ld.dtype)
+            extra = rd != 0
+        return DevVal(data, merge_validity(l.validity, r.validity, extra),
+                      self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        import pandas as pd
+        l = _cpu_promote(kids[0], self.dtype)
+        r = _cpu_promote(kids[1], self.dtype)
+        ln = l.to_numpy(zero_copy_only=False)
+        rn = r.to_numpy(zero_copy_only=False)
+        valid = np.asarray(pc.and_kleene(pc.is_valid(l), pc.is_valid(r)))
+        with np.errstate(all="ignore"):
+            rz = np.where(np.asarray(rn == 0) | ~valid, 1, rn)
+            out = np.fmod(np.where(valid, ln, 0), rz)
+        valid = valid & np.asarray(rn != 0)
+        from ..columnar.host import dtype_to_arrow
+        return pa.array(out.astype(np.asarray(ln).dtype, copy=False),
+                        dtype_to_arrow(self.dtype), mask=~valid)
+
+
+class UnaryMinus(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _eval_dev(self, ctx, kids):
+        return DevVal(-kids[0].data, kids[0].validity, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.negate(kids[0])
+
+
+class Abs(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _eval_dev(self, ctx, kids):
+        return DevVal(jnp.abs(kids[0].data), kids[0].validity, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.abs(kids[0])
+
+
+# ---------------------------------------------------------------------------
+# Comparisons
+# ---------------------------------------------------------------------------
+
+class BinaryComparison(Expression):
+    symbol = "?"
+
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+
+    def unsupported_reasons(self, conf):
+        l, r = self.children
+        if isinstance(l.dtype, t.StringType) or isinstance(r.dtype, t.StringType):
+            # String comparisons route through the dictionary machinery in
+            # strings.py subclasses; plain comparison handles non-strings.
+            if type(self) in (EqualTo, NotEqual, EqualNullSafe):
+                return []
+            return ["string ordering comparison not yet on device"]
+        for c in self.children:
+            if isinstance(c.dtype, t.DecimalType) and c.dtype.is_wide:
+                return ["decimal128 comparison not yet on device"]
+        return []
+
+    def _common(self):
+        l, r = self.children
+        if isinstance(l.dtype, t.StringType):
+            return t.STRING
+        if l.dtype == r.dtype:
+            return l.dtype
+        return _promote_binary(*self.children)
+
+    # -- string-vs-string equality via unified dictionary remap
+    def _prepare(self, pctx, kids):
+        l, r = kids
+        if isinstance(self.children[0].dtype, t.StringType) or \
+           isinstance(self.children[1].dtype, t.StringType):
+            dl = l.dictionary if l.dictionary is not None else pa.array([], pa.string())
+            dr = r.dictionary if r.dictionary is not None else pa.array([], pa.string())
+            combined = pa.concat_arrays([dl.cast(pa.string()), dr.cast(pa.string())])
+            enc = pc.dictionary_encode(combined)
+            codes = enc.indices.to_numpy(zero_copy_only=False).astype(np.int32)
+            map_l = codes[:len(dl)] if len(dl) else np.zeros(1, np.int32)
+            map_r = codes[len(dl):] if len(dr) else np.zeros(1, np.int32)
+            pctx.add(self, map_l)
+            pctx.add(self, map_r)
+        return HostVal()
+
+    def _eval_dev(self, ctx, kids):
+        l, r = kids
+        if isinstance(l.dtype, t.StringType) or isinstance(r.dtype, t.StringType):
+            map_l, map_r = ctx.aux_of(self)
+            lc = map_l[jnp.clip(l.data, 0, map_l.shape[0] - 1)]
+            rc = map_r[jnp.clip(r.data, 0, map_r.shape[0] - 1)]
+            data = self._op_dev(lc, rc)
+        else:
+            common = self._common()
+            ld = _cast_dev(l.data, l.dtype, common)
+            rd = _cast_dev(r.data, r.dtype, common)
+            data = self._op_dev(ld, rd)
+        return DevVal(data, merge_validity(l.validity, r.validity), t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        l, r = kids
+        if not isinstance(self.children[0].dtype, t.StringType):
+            common = self._common()
+            l, r = _cpu_promote(l, common), _cpu_promote(r, common)
+        return self._op_cpu(l, r)
+
+    def _fp_extra(self):
+        return self.symbol
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+    def _op_dev(self, l, r):
+        return l == r
+
+    def _op_cpu(self, l, r):
+        return pc.equal(l, r)
+
+
+class NotEqual(BinaryComparison):
+    symbol = "!="
+
+    def _op_dev(self, l, r):
+        return l != r
+
+    def _op_cpu(self, l, r):
+        return pc.not_equal(l, r)
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+    def _op_dev(self, l, r):
+        return l < r
+
+    def _op_cpu(self, l, r):
+        return pc.less(l, r)
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+    def _op_dev(self, l, r):
+        return l <= r
+
+    def _op_cpu(self, l, r):
+        return pc.less_equal(l, r)
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+    def _op_dev(self, l, r):
+        return l > r
+
+    def _op_cpu(self, l, r):
+        return pc.greater(l, r)
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+    def _op_dev(self, l, r):
+        return l >= r
+
+    def _op_cpu(self, l, r):
+        return pc.greater_equal(l, r)
+
+
+class EqualNullSafe(BinaryComparison):
+    symbol = "<=>"
+    nullable = False
+
+    def _eval_dev(self, ctx, kids):
+        l, r = kids
+        common = self._common()
+        if isinstance(common, t.StringType):
+            map_l, map_r = ctx.aux_of(self)
+            ld = map_l[jnp.clip(l.data, 0, map_l.shape[0] - 1)]
+            rd = map_r[jnp.clip(r.data, 0, map_r.shape[0] - 1)]
+        else:
+            ld = _cast_dev(l.data, l.dtype, common)
+            rd = _cast_dev(r.data, r.dtype, common)
+        from ..ops.kernels import valid_or_true
+        lv = valid_or_true(l.validity, ctx.capacity)
+        rv = valid_or_true(r.validity, ctx.capacity)
+        both_null = (~lv) & (~rv)
+        eq = (ld == rd) & lv & rv
+        return DevVal(both_null | eq, None, t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        l, r = kids
+        common = self._common()
+        if not isinstance(common, t.StringType):
+            l, r = _cpu_promote(l, common), _cpu_promote(r, common)
+        eq = pc.fill_null(pc.equal(l, r), False)
+        both_null = pc.and_(pc.is_null(l), pc.is_null(r))
+        return pc.or_(eq, both_null)
+
+
+# ---------------------------------------------------------------------------
+# Boolean logic (Kleene)
+# ---------------------------------------------------------------------------
+
+class And(Expression):
+    def __init__(self, l, r):
+        self.children = (l, r)
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.kernels import valid_or_true
+        l, r = kids
+        lv = valid_or_true(l.validity, ctx.capacity)
+        rv = valid_or_true(r.validity, ctx.capacity)
+        ld = l.data & lv   # sanitize: null slots read as False
+        rd = r.data & rv
+        data = ld & rd
+        # Kleene: false AND anything = false (valid); else null if either null
+        false_l = lv & ~l.data
+        false_r = rv & ~r.data
+        valid = (lv & rv) | false_l | false_r
+        return DevVal(data, valid, t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.and_kleene(kids[0], kids[1])
+
+
+class Or(Expression):
+    def __init__(self, l, r):
+        self.children = (l, r)
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.kernels import valid_or_true
+        l, r = kids
+        lv = valid_or_true(l.validity, ctx.capacity)
+        rv = valid_or_true(r.validity, ctx.capacity)
+        true_l = lv & l.data
+        true_r = rv & r.data
+        data = true_l | true_r
+        valid = (lv & rv) | true_l | true_r
+        return DevVal(data, valid, t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.or_kleene(kids[0], kids[1])
+
+
+class Not(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+        self.nullable = self.children[0].nullable
+
+    def _eval_dev(self, ctx, kids):
+        return DevVal(~kids[0].data, kids[0].validity, t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.invert(kids[0])
+
+
+# ---------------------------------------------------------------------------
+# Null predicates & handling
+# ---------------------------------------------------------------------------
+
+class IsNull(Expression):
+    nullable = False
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+
+    def _eval_dev(self, ctx, kids):
+        v = kids[0].validity
+        data = jnp.zeros((ctx.capacity,), bool) if v is None else ~v
+        return DevVal(data, None, t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.is_null(kids[0])
+
+
+class IsNotNull(Expression):
+    nullable = False
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+
+    def _eval_dev(self, ctx, kids):
+        v = kids[0].validity
+        data = jnp.ones((ctx.capacity,), bool) if v is None else v
+        return DevVal(data, None, t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.is_valid(kids[0])
+
+
+class IsNaN(Expression):
+    nullable = False
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.kernels import valid_or_true
+        v = valid_or_true(kids[0].validity, ctx.capacity)
+        return DevVal(jnp.isnan(kids[0].data) & v, None, t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.fill_null(pc.is_nan(kids[0]), False)
+
+
+class Coalesce(Expression):
+    def __init__(self, *children):
+        self.children = tuple(children)
+
+    def _resolve(self):
+        non_null = [c.dtype for c in self.children
+                    if not isinstance(c.dtype, t.NullType)]
+        self.dtype = non_null[0] if non_null else t.NULL
+
+    def unsupported_reasons(self, conf):
+        if isinstance(self.dtype, t.StringType):
+            return ["string coalesce not yet on device"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.kernels import valid_or_true
+        data = jnp.zeros((ctx.capacity,), compute_dtype(self.dtype))
+        valid = jnp.zeros((ctx.capacity,), bool)
+        taken = jnp.zeros((ctx.capacity,), bool)
+        for k in kids:
+            kv = valid_or_true(k.validity, ctx.capacity)
+            use = kv & ~taken
+            kd = _cast_dev(k.data, k.dtype, self.dtype)
+            data = jnp.where(use, kd, data)
+            valid = valid | use
+            taken = taken | use
+        return DevVal(data, valid, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        kids = [k.cast(dtype_to_arrow(self.dtype)) for k in kids]
+        return pc.coalesce(*kids)
+
+
+# ---------------------------------------------------------------------------
+# Conditional
+# ---------------------------------------------------------------------------
+
+class If(Expression):
+    def __init__(self, pred, then, other):
+        self.children = (pred, then, other)
+
+    def _resolve(self):
+        _, then, other = self.children
+        self.dtype = then.dtype if not isinstance(then.dtype, t.NullType) \
+            else other.dtype
+
+    def unsupported_reasons(self, conf):
+        if isinstance(self.dtype, t.StringType):
+            return ["string-valued if not yet on device"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.kernels import valid_or_true
+        p, a, b = kids
+        pv = valid_or_true(p.validity, ctx.capacity)
+        cond = p.data & pv          # null predicate -> else branch (Spark)
+        ad = _cast_dev(a.data, a.dtype, self.dtype)
+        bd = _cast_dev(b.data, b.dtype, self.dtype)
+        data = jnp.where(cond, ad, bd)
+        av = valid_or_true(a.validity, ctx.capacity)
+        bv = valid_or_true(b.validity, ctx.capacity)
+        valid = jnp.where(cond, av, bv)
+        return DevVal(data, valid, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        p, a, b = kids
+        want = dtype_to_arrow(self.dtype)
+        return pc.if_else(pc.fill_null(p, False), a.cast(want), b.cast(want))
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 [WHEN c2 THEN v2]* [ELSE e] END."""
+
+    def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
+                 otherwise: Optional[Expression] = None):
+        flat = []
+        for c, v in branches:
+            flat += [c, v]
+        self.n_branches = len(branches)
+        self.has_else = otherwise is not None
+        self.children = tuple(flat) + ((otherwise,) if otherwise else ())
+
+    def _branches(self):
+        return [(self.children[2 * i], self.children[2 * i + 1])
+                for i in range(self.n_branches)]
+
+    def _resolve(self):
+        for _, v in self._branches():
+            if not isinstance(v.dtype, t.NullType):
+                self.dtype = v.dtype
+                break
+        else:
+            self.dtype = self.children[-1].dtype if self.has_else else t.NULL
+
+    def unsupported_reasons(self, conf):
+        if isinstance(self.dtype, t.StringType):
+            return ["string-valued case/when not yet on device"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.kernels import valid_or_true
+        cap = ctx.capacity
+        data = jnp.zeros((cap,), compute_dtype(self.dtype))
+        valid = jnp.zeros((cap,), bool)
+        if self.has_else:
+            e = kids[-1]
+            data = _cast_dev(e.data, e.dtype, self.dtype)
+            valid = valid_or_true(e.validity, cap)
+        decided = jnp.zeros((cap,), bool)
+        for i in range(self.n_branches):
+            c, v = kids[2 * i], kids[2 * i + 1]
+            cv = valid_or_true(c.validity, cap)
+            hit = c.data & cv & ~decided
+            vd = _cast_dev(v.data, v.dtype, self.dtype)
+            vv = valid_or_true(v.validity, cap)
+            data = jnp.where(hit, vd, data)
+            valid = jnp.where(hit, vv, valid)
+            decided = decided | hit
+        if not self.has_else:
+            valid = valid & decided
+        return DevVal(data, valid, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        want = dtype_to_arrow(self.dtype)
+        n = rb.num_rows
+        out = kids[-1].cast(want) if self.has_else else pa.nulls(n, want)
+        decided = pa.array([False] * n)
+        for i in range(self.n_branches):
+            c = pc.fill_null(kids[2 * i], False)
+            v = kids[2 * i + 1].cast(want)
+            hit = pc.and_(c, pc.invert(decided))
+            out = pc.if_else(hit, v, out)
+            decided = pc.or_(decided, hit)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# In / InSet
+# ---------------------------------------------------------------------------
+
+class In(Expression):
+    """value IN (literals...). Spark null semantics: null if no match and
+    any null present (value null -> null)."""
+
+    def __init__(self, value: Expression, items: Sequence):
+        self.items = tuple(items)
+        self.children = (value,)
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+
+    def _prepare(self, pctx, kids):
+        child = self.children[0]
+        if isinstance(child.dtype, t.StringType):
+            d = kids[0].dictionary
+            d = d.cast(pa.string()) if d is not None else pa.array([], pa.string())
+            items = set(x for x in self.items if x is not None)
+            mask = np.array([v.as_py() in items for v in d] or [False], bool)
+            pctx.add(self, mask)
+        return HostVal()
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.kernels import valid_or_true
+        v = kids[0]
+        has_null_item = any(x is None for x in self.items)
+        if isinstance(self.children[0].dtype, t.StringType):
+            (mask,) = ctx.aux_of(self)
+            data = mask[jnp.clip(v.data, 0, mask.shape[0] - 1)]
+        else:
+            data = jnp.zeros((ctx.capacity,), bool)
+            for x in self.items:
+                if x is not None:
+                    data = data | (v.data == jnp.asarray(x, v.data.dtype))
+        vv = valid_or_true(v.validity, ctx.capacity)
+        valid = vv & (data | ~jnp.asarray(has_null_item))
+        return DevVal(data & vv, valid if has_null_item else vv, t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        v = kids[0]
+        non_null = [x for x in self.items if x is not None]
+        has_null = any(x is None for x in self.items)
+        vs = pa.array(non_null, dtype_to_arrow(self.children[0].dtype)) \
+            if non_null else pa.array([], v.type)
+        data = pc.is_in(v, value_set=vs)
+        data = pc.if_else(pc.is_valid(v), data, pa.nulls(len(v), pa.bool_()))
+        if has_null:
+            data = pc.if_else(pc.fill_null(data, False), data,
+                              pa.nulls(len(v), pa.bool_()))
+        return data
+
+    def _fp_extra(self):
+        return repr(self.items)
+
+
+# ---------------------------------------------------------------------------
+# Math functions
+# ---------------------------------------------------------------------------
+
+class UnaryMathExpression(Expression):
+    fn_dev = None
+    fn_cpu_name = None
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.DOUBLE
+
+    def _eval_dev(self, ctx, kids):
+        data = type(self).fn_dev(kids[0].data.astype(jnp.float64))
+        return DevVal(data, kids[0].validity, t.DOUBLE)
+
+    def _eval_cpu(self, rb, kids):
+        arr = kids[0].cast(pa.float64())
+        x = arr.to_numpy(zero_copy_only=False)
+        with np.errstate(all="ignore"):
+            out = type(self).fn_np(x)
+        return pa.array(out, pa.float64(), mask=np.asarray(pc.is_null(arr)))
+
+
+class Sqrt(UnaryMathExpression):
+    # XLA's emulated-f64 sqrt returns nan for +inf in this environment;
+    # guard the IEEE edge explicitly so device matches CPU/Spark.
+    fn_dev = staticmethod(
+        lambda x: jnp.where(jnp.isposinf(x), jnp.float64(np.inf), jnp.sqrt(x)))
+    fn_np = staticmethod(np.sqrt)
+
+
+class Exp(UnaryMathExpression):
+    # inf guards: see Sqrt note on emulated-f64 transcendentals.
+    fn_dev = staticmethod(
+        lambda x: jnp.where(jnp.isposinf(x), jnp.float64(np.inf),
+                            jnp.where(jnp.isneginf(x), jnp.float64(0.0),
+                                      jnp.exp(x))))
+    fn_np = staticmethod(np.exp)
+
+
+class Log(UnaryMathExpression):
+    """Spark ln: null for input <= 0 (non-ANSI)."""
+
+    def _eval_dev(self, ctx, kids):
+        x = kids[0].data.astype(jnp.float64)
+        ok = x > 0
+        data = jnp.log(jnp.where(ok, x, 1.0))
+        data = jnp.where(jnp.isposinf(x), jnp.float64(np.inf), data)  # Sqrt note
+        return DevVal(data, merge_validity(kids[0].validity, ok), t.DOUBLE)
+
+    def _eval_cpu(self, rb, kids):
+        arr = kids[0].cast(pa.float64())
+        x = arr.to_numpy(zero_copy_only=False)
+        ok = np.asarray(x > 0) & ~np.asarray(pc.is_null(arr))
+        with np.errstate(all="ignore"):
+            out = np.log(np.where(ok, x, 1.0))
+        return pa.array(out, pa.float64(), mask=~ok)
+
+
+def _f64_to_long_dev(f):
+    """Spark double->long conversion: NaN -> 0, saturate at Long bounds."""
+    f = jnp.where(jnp.isnan(f), 0.0, f)
+    f = jnp.clip(f, -9.223372036854776e18, 9.223372036854775e18)
+    return f.astype(jnp.int64)
+
+
+def _f64_to_long_np(x):
+    x = np.nan_to_num(x, nan=0.0, posinf=9.223372036854775e18,
+                      neginf=-9.223372036854776e18)
+    return np.clip(x, -9.223372036854776e18, 9.223372036854775e18).astype(np.int64)
+
+
+class RoundingToLong(Expression):
+    """floor/ceil of fractional input -> LONG with Spark .toLong semantics."""
+    round_dev = None
+    round_np = None
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.LONG
+
+    def _eval_dev(self, ctx, kids):
+        if t.is_integral(self.children[0].dtype):
+            return DevVal(kids[0].data.astype(jnp.int64), kids[0].validity, t.LONG)
+        f = type(self).round_dev(kids[0].data.astype(jnp.float64))
+        return DevVal(_f64_to_long_dev(f), kids[0].validity, t.LONG)
+
+    def _eval_cpu(self, rb, kids):
+        arr = kids[0].cast(pa.float64())
+        x = arr.to_numpy(zero_copy_only=False)
+        with np.errstate(all="ignore"):
+            out = _f64_to_long_np(type(self).round_np(x))
+        return pa.array(out, pa.int64(), mask=np.asarray(pc.is_null(arr)))
+
+
+class Floor(RoundingToLong):
+    # inf passthrough: emulated-f64 floor/ceil(inf) yields nan (see Sqrt note)
+    round_dev = staticmethod(
+        lambda x: jnp.where(jnp.isinf(x), x, jnp.floor(x)))
+    round_np = staticmethod(np.floor)
+
+
+class Ceil(RoundingToLong):
+    round_dev = staticmethod(
+        lambda x: jnp.where(jnp.isinf(x), x, jnp.ceil(x)))
+    round_np = staticmethod(np.ceil)
+
+
+class Pow(Expression):
+    def __init__(self, l, r):
+        self.children = (l, r)
+
+    def _resolve(self):
+        self.dtype = t.DOUBLE
+
+    def _eval_dev(self, ctx, kids):
+        l, r = kids
+        data = jnp.power(l.data.astype(jnp.float64), r.data.astype(jnp.float64))
+        return DevVal(data, merge_validity(l.validity, r.validity), t.DOUBLE)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.power(kids[0].cast(pa.float64()), kids[1].cast(pa.float64()))
+
+
+# ---------------------------------------------------------------------------
+# Cast (the compatibility minefield — reference GpuCast.scala, 1903 LoC).
+# Round 1 scope: numeric<->numeric, numeric<->bool, date/timestamp widening.
+# String casts fall back to CPU (tagged), to be brought on-device later.
+# ---------------------------------------------------------------------------
+
+class Cast(Expression):
+    def __init__(self, child, to: t.DataType):
+        self.children = (child,)
+        self.to = to
+
+    def _resolve(self):
+        self.dtype = self.to
+        self.nullable = self.children[0].nullable
+
+    def unsupported_reasons(self, conf):
+        src, dst = self.children[0].dtype, self.to
+        ok_num = (t.is_numeric(src) or isinstance(src, t.BooleanType)) and \
+                 (t.is_numeric(dst) or isinstance(dst, t.BooleanType))
+        if isinstance(src, t.DecimalType) or isinstance(dst, t.DecimalType):
+            return [f"decimal cast {src.simple_string}->{dst.simple_string} "
+                    "not yet on device"]
+        if ok_num:
+            return []
+        if src == dst:
+            return []
+        if isinstance(src, t.DateType) and isinstance(dst, t.TimestampType):
+            return []
+        if isinstance(src, t.TimestampType) and isinstance(dst, t.DateType):
+            return []
+        return [f"cast {src.simple_string}->{dst.simple_string} not yet on device"]
+
+    def _eval_dev(self, ctx, kids):
+        src, dst = self.children[0].dtype, self.to
+        x = kids[0].data
+        valid = kids[0].validity
+        if src == dst:
+            return kids[0]
+        if isinstance(dst, t.BooleanType):
+            data = x != 0
+        elif t.is_floating(src) and t.is_integral(dst):
+            # Spark non-ANSI: truncate toward zero; NaN -> 0; clamp overflow
+            # like Java (double->long saturates at Long.MIN/MAX... then
+            # narrowing wraps). We saturate at the target bounds (Spark
+            # behavior for double->int goes through long then wraps; the
+            # common in-range path matches, out-of-range is documented).
+            f = x.astype(jnp.float64)
+            f = jnp.where(jnp.isnan(f), 0.0, f)
+            f = jnp.where(jnp.isinf(f), f, jnp.trunc(f))  # see Sqrt inf note
+            # Clamp in integer domain: float-domain clamping is off-by-ulp
+            # at INT_MAX under the f32-pair f64 emulation.
+            i64 = _f64_to_long_dev(f)
+            info = np.iinfo(t.physical_np_dtype(dst))
+            i64 = jnp.clip(i64, np.int64(info.min), np.int64(info.max))
+            data = i64.astype(compute_dtype(dst))
+        elif isinstance(src, t.DateType) and isinstance(dst, t.TimestampType):
+            data = x.astype(jnp.int64) * jnp.int64(86400_000_000)
+        elif isinstance(src, t.TimestampType) and isinstance(dst, t.DateType):
+            us = x.astype(jnp.int64)
+            days = jnp.where(us >= 0, us // 86400_000_000,
+                             -((-us + 86400_000_000 - 1) // 86400_000_000))
+            data = days.astype(jnp.int32)
+        else:
+            data = x.astype(compute_dtype(dst))
+        return DevVal(data, valid, dst)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        src, dst = self.children[0].dtype, self.to
+        arr = kids[0]
+        if t.is_floating(src) and t.is_integral(dst):
+            x = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
+            x = np.nan_to_num(x, nan=0.0, posinf=np.inf, neginf=-np.inf)
+            info = np.iinfo(t.physical_np_dtype(dst))
+            x = np.clip(np.trunc(x), info.min, info.max)
+            return pa.array(x.astype(t.physical_np_dtype(dst)),
+                            dtype_to_arrow(dst),
+                            mask=np.asarray(pc.is_null(arr)))
+        return arr.cast(dtype_to_arrow(dst))
+
+    def _fp_extra(self):
+        return self.to.simple_string

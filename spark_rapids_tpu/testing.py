@@ -1,0 +1,81 @@
+"""Test utilities: the assert_gpu_and_cpu_are_equal analogue.
+
+The reference's entire correctness strategy (SURVEY §4) is "same engine, two
+backends, compare" (integration_tests asserts.py:579).  Here the two backends
+are the device path (jit-traced eval_dev) and the per-expression CPU fallback
+(eval_cpu over pyarrow) — which doubles as the production fallback engine, so
+these asserts also exercise the CPU path users hit on unsupported operators.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from .columnar import HostBatch, to_device, to_host
+from .config import TpuConf, DEFAULT_CONF
+from .exec.evaluator import apply_filter, evaluate_projection
+from .plan.expressions import Expression
+
+
+def _values_equal(a, b, approx_float: bool) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) or math.isnan(b):
+            return math.isnan(a) and math.isnan(b)
+        if approx_float:
+            return math.isclose(a, b, rel_tol=1e-12, abs_tol=1e-300)
+        return a == b
+    return a == b
+
+
+def assert_columns_equal(got: pa.Array, want: pa.Array, label: str = "",
+                         approx_float: bool = False):
+    gl, wl = got.to_pylist(), want.to_pylist()
+    assert len(gl) == len(wl), f"{label}: row count {len(gl)} != {len(wl)}"
+    for i, (g, w) in enumerate(zip(gl, wl)):
+        assert _values_equal(g, w, approx_float), \
+            f"{label}: row {i}: device={g!r} cpu={w!r}"
+
+
+def assert_device_cpu_equal(exprs: Sequence[Expression], data: Dict,
+                            conf: TpuConf = DEFAULT_CONF,
+                            approx_float: bool = False):
+    """Evaluate bound-able expressions on device and CPU; compare results."""
+    hb = HostBatch.from_pydict(data) if not isinstance(data, HostBatch) else data
+    schema = hb.schema
+    bound = [e.bind(schema) for e in exprs]
+    for e in bound:
+        reasons = e.tree_unsupported(conf)
+        assert not reasons, f"expression not device-supported: {reasons}"
+    db = to_device(hb, conf)
+    names = [f"c{i}" for i in range(len(bound))]
+    out = to_host(evaluate_projection(bound, names, db, conf))
+    for i, e in enumerate(bound):
+        want = e.eval_cpu(hb.rb)
+        assert_columns_equal(out.rb.column(i), want, label=e.fingerprint(),
+                             approx_float=approx_float)
+    return out
+
+
+def assert_filter_matches(cond: Expression, data: Dict,
+                          conf: TpuConf = DEFAULT_CONF):
+    """Device filter vs CPU mask-filter row-set comparison."""
+    import pyarrow.compute as pc
+    hb = HostBatch.from_pydict(data) if not isinstance(data, HostBatch) else data
+    bound = cond.bind(hb.schema)
+    reasons = bound.tree_unsupported(conf)
+    assert not reasons, f"predicate not device-supported: {reasons}"
+    db = to_device(hb, conf)
+    got = to_host(apply_filter(bound, db, conf))
+    mask = pc.fill_null(bound.eval_cpu(hb.rb), False)
+    want = hb.rb.filter(mask)
+    assert got.num_rows == want.num_rows, \
+        f"filter row count {got.num_rows} != {want.num_rows}"
+    for i in range(want.num_columns):
+        assert_columns_equal(got.rb.column(i), want.column(i),
+                             label=f"col {hb.rb.schema.names[i]}")
+    return got

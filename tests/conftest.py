@@ -6,7 +6,9 @@ is reachable; bench.py runs on the real chip instead.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even though the session env pins JAX_PLATFORMS=axon (real TPU):
+# tests must be runnable without the chip and with 8 virtual devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,180 @@
+"""Device-vs-CPU expression comparisons (reference integration-test role)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.testing import (assert_device_cpu_equal,
+                                      assert_filter_matches)
+
+RNG = np.random.default_rng(42)
+
+
+def col(n):
+    return E.ColumnRef(n)
+
+
+def lit(v, dt=None):
+    return E.Literal(v, dt)
+
+
+def int_col(n=100, null_frac=0.2, lo=-1000, hi=1000, dtype=pa.int32()):
+    vals = RNG.integers(lo, hi, n)
+    mask = RNG.random(n) < null_frac
+    return pa.array(vals, dtype, mask=mask)
+
+
+def float_col(n=100, null_frac=0.2, specials=True):
+    vals = RNG.normal(0, 100, n)
+    if specials and n >= 8:
+        vals[:4] = [np.nan, np.inf, -np.inf, -0.0]
+    mask = RNG.random(n) < null_frac
+    return pa.array(vals, pa.float64(), mask=mask)
+
+
+NUM_DATA = {
+    "a": int_col(), "b": int_col(lo=-5, hi=5),
+    "l": int_col(dtype=pa.int64(), lo=-10**12, hi=10**12),
+    "x": float_col(), "y": float_col(),
+}
+
+
+def test_arithmetic_matches_cpu():
+    assert_device_cpu_equal([
+        E.Add(col("a"), col("b")),
+        E.Subtract(col("a"), lit(7)),
+        E.Multiply(col("a"), col("b")),
+        E.Add(col("a"), col("l")),          # int32 + int64 promotion
+        E.Multiply(col("x"), col("y")),
+        E.UnaryMinus(col("a")),
+        E.Abs(col("x")),
+    ], NUM_DATA, approx_float=True)
+
+
+def test_divide_by_zero_is_null():
+    out = assert_device_cpu_equal([
+        E.Divide(col("a"), col("b")),       # b has zeros -> nulls
+        E.Remainder(col("a"), col("b")),
+        E.IntegralDivide(col("a"), col("b")),
+    ], NUM_DATA, approx_float=True)
+    # explicit: some divisor is zero and both sides were valid -> null rows
+    b = NUM_DATA["b"].to_pylist()
+    a = NUM_DATA["a"].to_pylist()
+    got = out.rb.column(0).to_pylist()
+    for i, (av, bv) in enumerate(zip(a, b)):
+        if av is not None and bv == 0:
+            assert got[i] is None
+
+
+def test_remainder_sign_follows_dividend():
+    data = {"p": pa.array([7, -7, 7, -7], pa.int32()),
+            "q": pa.array([3, 3, -3, -3], pa.int32())}
+    out = assert_device_cpu_equal([E.Remainder(col("p"), col("q"))], data)
+    assert out.rb.column(0).to_pylist() == [1, -1, 1, -1]  # Java % semantics
+
+
+def test_comparisons_match_cpu():
+    assert_device_cpu_equal([
+        E.EqualTo(col("a"), col("b")),
+        E.LessThan(col("x"), col("y")),
+        E.GreaterThanOrEqual(col("a"), lit(0)),
+        E.NotEqual(col("l"), lit(0)),
+        E.EqualNullSafe(col("a"), col("b")),
+    ], NUM_DATA)
+
+
+def test_kleene_logic():
+    data = {"p": pa.array([True, True, True, False, False, None, None, False, None]),
+            "q": pa.array([True, False, None, False, None, True, False, True, None])}
+    out = assert_device_cpu_equal([
+        E.And(col("p"), col("q")),
+        E.Or(col("p"), col("q")),
+        E.Not(col("p")),
+    ], data)
+    assert out.rb.column(0).to_pylist() == \
+        [True, False, None, False, False, None, False, False, None]
+    assert out.rb.column(1).to_pylist() == \
+        [True, True, True, False, None, True, None, True, None]
+
+
+def test_null_predicates():
+    assert_device_cpu_equal([
+        E.IsNull(col("a")), E.IsNotNull(col("x")), E.IsNaN(col("x")),
+        E.Coalesce(col("a"), col("b"), lit(-1)),
+    ], NUM_DATA)
+
+
+def test_conditional():
+    assert_device_cpu_equal([
+        E.If(E.GreaterThan(col("a"), lit(0)), col("a"), E.UnaryMinus(col("a"))),
+        E.CaseWhen([(E.LessThan(col("a"), lit(-500)), lit(-1)),
+                    (E.LessThan(col("a"), lit(500)), lit(0))], lit(1)),
+        E.CaseWhen([(E.IsNull(col("a")), lit(99))]),  # no else -> null
+    ], NUM_DATA)
+
+
+def test_in():
+    assert_device_cpu_equal([
+        E.In(col("a"), [1, 2, 3, 500]),
+        E.In(col("b"), [0, None]),
+    ], NUM_DATA)
+
+
+def test_math_functions():
+    assert_device_cpu_equal([
+        E.Sqrt(col("x")), E.Exp(col("b")), E.Log(col("x")),
+        E.Floor(col("x")), E.Ceil(col("x")), E.Pow(col("b"), lit(2.0)),
+    ], NUM_DATA, approx_float=True)
+
+
+def test_cast_numeric():
+    assert_device_cpu_equal([
+        E.Cast(col("a"), t.LONG),
+        E.Cast(col("a"), t.DOUBLE),
+        E.Cast(col("x"), t.INT),       # trunc-toward-zero, NaN -> 0
+        E.Cast(col("x"), t.FLOAT),
+        E.Cast(col("a"), t.BOOLEAN),
+        E.Cast(col("b"), t.SHORT),
+    ], NUM_DATA, approx_float=True)
+
+
+def test_string_equality_and_in():
+    data = {"s": pa.array(["apple", "pear", None, "apple", "fig", "Pear"]),
+            "u": pa.array(["apple", "PEAR", None, "fig", "fig", "Pear"])}
+    assert_device_cpu_equal([
+        E.EqualTo(col("s"), lit("apple")),
+        E.NotEqual(col("s"), lit("fig")),
+        E.EqualTo(col("s"), col("u")),       # unified-dictionary compare
+        E.EqualNullSafe(col("s"), col("u")),
+        E.In(col("s"), ["apple", "fig"]),
+        E.IsNull(col("s")),
+    ], data)
+
+
+def test_filter_compaction():
+    assert_filter_matches(
+        E.And(E.GreaterThan(col("a"), lit(-500)), E.IsNotNull(col("x"))),
+        NUM_DATA)
+
+
+def test_filter_string_predicate():
+    data = {"s": pa.array(["a", "b", None, "a", "c"] * 10),
+            "v": pa.array(list(range(50)), pa.int64())}
+    assert_filter_matches(E.EqualTo(col("s"), lit("a")), data)
+
+
+def test_unsupported_tagging():
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    schema = t.StructType([t.StructField("s", t.STRING)])
+    e = E.Cast(col("s"), t.INT).bind(schema)
+    reasons = e.tree_unsupported(DEFAULT_CONF)
+    assert reasons and "cast" in reasons[0].lower()
+
+
+def test_conf_disable_expression():
+    from spark_rapids_tpu.config import TpuConf
+    conf = TpuConf({"spark.rapids.tpu.sql.expression.Add": "false"})
+    schema = t.StructType([t.StructField("a", t.INT)])
+    e = E.Add(col("a"), lit(1)).bind(schema)
+    assert any("disabled" in r for r in e.tree_unsupported(conf))
