@@ -1,0 +1,207 @@
+"""Hash-aggregate execution: partial -> merge -> final over device batches.
+
+The GpuHashAggregateExec analogue (reference GpuAggregateExec.scala:1711,
+call stack SURVEY §3.3): per input batch, project the aggregate inputs and
+run the update groupby (partial); accumulated partials are concatenated and
+re-grouped with the merge ops; the final projection evaluates each
+aggregate's result expression over the merged buffers.
+
+TPU-first deltas from the reference:
+  * partial aggregation is sort+segment (ops/groupby.py), not hash tables;
+  * merge is concat+regroup in one jit rather than cuDF concatenate+groupby;
+  * string group keys ride as unified dictionary codes, so regrouping
+    across batches is plain int comparison.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as t
+from ..config import TpuConf
+from ..columnar.device import DeviceBatch, DeviceColumn
+from ..ops import groupby as G
+from ..ops.batch_ops import concat_batches, shrink_to_rows, unify_dictionaries, \
+    remap_string_column
+from ..plan import expressions as E
+from ..plan.aggregates import AggregateFunction
+from .evaluator import evaluate_projection
+
+_GROUPBY_CACHE = {}
+_REDUCE_CACHE = {}
+
+
+def _ensure_unique_dict(col: DeviceColumn) -> DeviceColumn:
+    """Group keys compare by code, which requires a duplicate-free dict."""
+    d = col.dictionary
+    if d is None:
+        return col
+    unified, remaps = unify_dictionaries([d])
+    if len(unified) == len(d):
+        return col
+    return remap_string_column(col, remaps[0], unified)
+
+
+def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
+                 specs: List[G.AggSpec], num_rows: int, capacity: int):
+    key_cols = [_ensure_unique_dict(c) for c in key_cols]
+    info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
+    sig = (info, tuple((s.kind, s.input_idx, s.dtype) for s in specs),
+           capacity, tuple(str(c.data.dtype) for c in agg_cols))
+    fn = _GROUPBY_CACHE.get(sig)
+    if fn is None:
+        fn = jax.jit(G.groupby_trace(list(info), list(specs), capacity,
+                                     capacity))
+        _GROUPBY_CACHE[sig] = fn
+    out_keys, outs, num_groups = fn(
+        tuple(c.data for c in key_cols),
+        tuple(c.validity for c in key_cols),
+        tuple(c.data for c in agg_cols),
+        tuple(c.validity for c in agg_cols),
+        jnp.int32(num_rows))
+    return key_cols, out_keys, outs, int(num_groups)
+
+
+def _run_reduce(agg_cols: List[DeviceColumn], specs: List[G.AggSpec],
+                num_rows: int, capacity: int):
+    sig = (tuple((s.kind, s.input_idx, s.dtype) for s in specs), capacity,
+           tuple(str(c.data.dtype) for c in agg_cols))
+    fn = _REDUCE_CACHE.get(sig)
+    if fn is None:
+        fn = jax.jit(G.reduce_trace(list(specs), capacity))
+        _REDUCE_CACHE[sig] = fn
+    return fn(tuple(c.data for c in agg_cols),
+              tuple(c.validity for c in agg_cols), jnp.int32(num_rows))
+
+
+def _storage_zeros(dt: t.DataType, capacity: int):
+    if isinstance(dt, t.DoubleType):
+        return jnp.zeros((capacity,), jnp.float64)
+    return jnp.zeros((capacity,), t.physical_np_dtype(dt))
+
+
+class HashAggregate:
+    """Bound group-by aggregation over a stream of device batches."""
+
+    def __init__(self, key_exprs: Sequence[E.Expression],
+                 key_names: Sequence[str],
+                 aggs: Sequence[Tuple[AggregateFunction, str]],
+                 conf: TpuConf):
+        self.key_exprs = list(key_exprs)
+        self.key_names = list(key_names)
+        self.aggs = list(aggs)
+        self.conf = conf
+        # flatten buffers
+        self.update_specs: List[G.AggSpec] = []
+        self.merge_specs: List[G.AggSpec] = []
+        self.input_exprs: List[Optional[E.Expression]] = []
+        self.buffer_slices: List[Tuple[int, int]] = []
+        for fn, _name in self.aggs:
+            start = len(self.update_specs)
+            ins = fn.inputs()
+            for (kind, bdt), (mkind, mdt), inp in zip(
+                    fn.update_ops(), fn.merge_ops(), ins):
+                idx = -1
+                if inp is not None:
+                    idx = len(self.input_exprs)
+                    self.input_exprs.append(inp)
+                self.update_specs.append(G.AggSpec(kind, idx, bdt))
+            self.buffer_slices.append((start, len(self.update_specs)))
+        # merge specs operate on buffer columns positionally
+        mi = 0
+        for (fn, _name) in self.aggs:
+            for (mkind, mdt) in fn.merge_ops():
+                self.merge_specs.append(G.AggSpec(mkind, mi, mdt))
+                mi += 1
+
+    # ---- phases ----
+
+    def partial(self, db: DeviceBatch) -> DeviceBatch:
+        """One input batch -> (keys + buffer columns) partial result."""
+        key_batch = evaluate_projection(self.key_exprs, self.key_names, db,
+                                        self.conf) if self.key_exprs else None
+        agg_in = evaluate_projection(
+            [e for e in self.input_exprs],
+            [f"_in{i}" for i in range(len(self.input_exprs))], db, self.conf) \
+            if self.input_exprs else None
+        agg_cols = agg_in.columns if agg_in is not None else []
+        if not self.key_exprs:
+            outs = _run_reduce(agg_cols, self.update_specs, db.num_rows,
+                               db.capacity)
+            return self._reduce_outs_to_batch(outs)
+        key_cols, out_keys, outs, n_groups = _run_groupby(
+            key_batch.columns, agg_cols, self.update_specs, db.num_rows,
+            db.capacity)
+        return self._groupby_outs_to_batch(key_cols, out_keys, outs, n_groups)
+
+    def merge(self, partials: List[DeviceBatch]) -> DeviceBatch:
+        merged = concat_batches(partials, self.conf)
+        nkeys = len(self.key_exprs)
+        key_cols = merged.columns[:nkeys]
+        buf_cols = merged.columns[nkeys:]
+        if not self.key_exprs:
+            outs = _run_reduce(buf_cols, self.merge_specs, merged.num_rows,
+                               merged.capacity)
+            return self._reduce_outs_to_batch(outs)
+        key_cols, out_keys, outs, n_groups = _run_groupby(
+            key_cols, buf_cols, self.merge_specs, merged.num_rows,
+            merged.capacity)
+        return self._groupby_outs_to_batch(key_cols, out_keys, outs, n_groups)
+
+    def final(self, merged: DeviceBatch) -> DeviceBatch:
+        """Evaluate result expressions over (keys + buffers)."""
+        nkeys = len(self.key_exprs)
+        schema = merged.schema
+        out_exprs: List[E.Expression] = []
+        out_names: List[str] = []
+        for i, name in enumerate(self.key_names):
+            out_exprs.append(E.ColumnRef(name).bind(schema))
+            out_names.append(name)
+        for (fn, name), (start, end) in zip(self.aggs, self.buffer_slices):
+            refs = [E.ColumnRef(f"_buf{j}").bind(schema)
+                    for j in range(start, end)]
+            expr = fn.evaluate(refs)
+            from ..plan.aggregates import _resolved
+            out_exprs.append(_resolved(expr) if expr.dtype is None else expr)
+            out_names.append(name)
+        return evaluate_projection(out_exprs, out_names, merged, self.conf)
+
+    def execute(self, batches: Iterable[DeviceBatch]) -> DeviceBatch:
+        partials = [self.partial(db) for db in batches]
+        if not partials:
+            raise ValueError("aggregation over zero batches")
+        merged = self.merge(partials) if len(partials) > 1 else partials[0]
+        return self.final(merged)
+
+    # ---- plumbing ----
+
+    def _buffer_names(self):
+        return [f"_buf{i}" for i in range(len(self.update_specs))]
+
+    def _groupby_outs_to_batch(self, key_cols, out_keys, outs, n_groups):
+        cols = []
+        for (kd, kv), kc in zip(out_keys, key_cols):
+            cols.append(DeviceColumn(kd, kv, kc.dtype, kc.dictionary,
+                                     kc.data_hi))
+        # update and merge specs share buffer dtypes positionally
+        for (data, valid), spec in zip(outs, self.update_specs):
+            cols.append(DeviceColumn(data.astype(_storage_zeros(
+                spec.dtype, 1).dtype), valid, spec.dtype))
+        db = DeviceBatch(cols, n_groups, self.key_names + self._buffer_names())
+        return shrink_to_rows(db, n_groups, self.conf)
+
+    def _reduce_outs_to_batch(self, outs) -> DeviceBatch:
+        from ..columnar.device import bucket_capacity
+        cap = bucket_capacity(1, self.conf)
+        cols = []
+        for (data, valid), spec in zip(outs, self.update_specs):
+            d = jnp.zeros((cap,), _storage_zeros(spec.dtype, 1).dtype
+                          ).at[0].set(data.astype(_storage_zeros(
+                              spec.dtype, 1).dtype))
+            v = jnp.zeros((cap,), bool).at[0].set(valid)
+            cols.append(DeviceColumn(d, v, spec.dtype))
+        return DeviceBatch(cols, 1, self._buffer_names())

@@ -1,0 +1,94 @@
+"""Device batch utilities: concat, coalesce, slice (GpuCoalesceBatches role).
+
+Concat is the workhorse under aggregation-merge, sort and join build sides
+(reference Table.concatenate / GpuCoalesceBatches.scala:697).  String columns
+carry per-batch dictionaries, so concat first unifies dictionaries on host
+(dictionaries are small) and remaps codes on device.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
+from ..config import TpuConf, DEFAULT_CONF
+
+
+def unify_dictionaries(dicts: Sequence[Optional[pa.Array]]):
+    """-> (unified dict, [np remap array per input dict])."""
+    arrs = [d.cast(pa.string()) if d is not None else pa.array([], pa.string())
+            for d in dicts]
+    combined = pa.concat_arrays(arrs)
+    enc = pc.dictionary_encode(combined)
+    codes = enc.indices.to_numpy(zero_copy_only=False).astype(np.int32)
+    remaps = []
+    off = 0
+    for a in arrs:
+        n = len(a)
+        remaps.append(codes[off:off + n] if n else np.zeros(1, np.int32))
+        off += n
+    return enc.dictionary, remaps
+
+
+def remap_string_column(col: DeviceColumn, remap: np.ndarray,
+                        unified: pa.Array) -> DeviceColumn:
+    table = jnp.asarray(remap)
+    data = table[jnp.clip(col.data, 0, table.shape[0] - 1)]
+    return DeviceColumn(data, col.validity, col.dtype, unified)
+
+
+def concat_batches(batches: List[DeviceBatch],
+                   conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
+    """Concatenate device batches (same schema) into one bucketed batch."""
+    assert batches, "concat of zero batches"
+    if len(batches) == 1:
+        return batches[0]
+    total = sum(b.num_rows for b in batches)
+    cap = bucket_capacity(max(total, 1), conf)
+    names = list(batches[0].names)
+    ncols = batches[0].num_columns
+    out_cols = []
+    for ci in range(ncols):
+        cols = [b.column(ci) for b in batches]
+        dt = cols[0].dtype
+        unified = None
+        if isinstance(dt, t.StringType):
+            unified, remaps = unify_dictionaries([c.dictionary for c in cols])
+            cols = [remap_string_column(c, r, unified)
+                    for c, r in zip(cols, remaps)]
+        data_parts = [c.data[:b.num_rows] for c, b in zip(cols, batches)]
+        valid_parts = [c.validity[:b.num_rows] for c, b in zip(cols, batches)]
+        pad = cap - total
+        if pad:
+            data_parts.append(jnp.zeros((pad,), cols[0].data.dtype))
+            valid_parts.append(jnp.zeros((pad,), bool))
+        hi = None
+        if cols[0].data_hi is not None:
+            hi_parts = [c.data_hi[:b.num_rows] for c, b in zip(cols, batches)]
+            if pad:
+                hi_parts.append(jnp.zeros((pad,), jnp.int64))
+            hi = jnp.concatenate(hi_parts)
+        out_cols.append(DeviceColumn(jnp.concatenate(data_parts),
+                                     jnp.concatenate(valid_parts),
+                                     dt, unified, hi))
+    return DeviceBatch(out_cols, total, names)
+
+
+def shrink_to_rows(db: DeviceBatch, num_rows: int,
+                   conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
+    """Re-bucket a padded batch down to the bucket fitting `num_rows`
+    (used after groupby/filter when occupancy dropped a bucket or more)."""
+    cap = bucket_capacity(max(num_rows, 1), conf)
+    if cap >= db.capacity:
+        return DeviceBatch(db.columns, num_rows, db.names)
+    cols = [DeviceColumn(c.data[:cap], c.validity[:cap], c.dtype,
+                         c.dictionary,
+                         None if c.data_hi is None else c.data_hi[:cap])
+            for c in db.columns]
+    return DeviceBatch(cols, num_rows, db.names)

@@ -1,0 +1,328 @@
+"""Sort-based segmented group-by: the cuDF `Table.groupBy().aggregate()` role.
+
+cuDF uses a device hash table; on TPU the idiomatic shape is sort + segment
+reduction (static shapes, no scatter contention, MXU/VPU-friendly):
+
+  1. lexsort rows by (liveness, key lanes with validity)  — padding rows and
+     null keys each group cleanly (Spark groups nulls as equal)
+  2. boundary flags where any key lane differs from the previous row
+  3. segment_ids = cumsum(flags); group count = one scalar D2H
+  4. jax.ops.segment_{sum,min,max} per aggregate with null/live masking
+  5. group keys gathered from each segment's first row
+
+Everything is one jit per (shape-bucket, agg signature); outputs stay padded
+to capacity so downstream operators reuse the same bucket.
+
+Min/max float ordering follows Java's Double.compare (NaN greatest,
+-0.0 < 0.0) by running the comparison in bit-space when the column carries
+the int64-bits storage lane, else a NaN-tracked value-space fallback.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as t
+from .kernels import compute_view
+
+
+# Aggregate kernel op kinds understood by the kernel.
+# (update vs merge distinction lives in plan/aggregates.py; by kernel time
+# everything is one of these.)
+SUM = "sum"
+COUNT = "count"          # counts valid rows
+COUNT_ALL = "count_all"  # counts live rows (count(*) / count(1))
+MIN = "min"
+MAX = "max"
+FIRST = "first"          # first live row's value (Spark ignoreNulls=false)
+LAST = "last"
+FIRST_NN = "first_nn"    # first non-null (ignoreNulls=true)
+LAST_NN = "last_nn"
+ANY = "any"              # boolean or
+EVERY = "every"          # boolean and
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    kind: str
+    input_idx: int                 # index into the agg-input column list
+    dtype: object                  # logical result type (t.DataType)
+
+
+def _null_first_key_lanes(data, valid, dt):
+    """Lanes making (valid, data) lexsort-comparable; nulls group together."""
+    if valid is None:
+        valid_lane = None
+    else:
+        valid_lane = (~valid).astype(jnp.int8)   # nulls first among live rows
+    if dt is not None and isinstance(dt, t.DoubleType) and data.dtype == jnp.float64:
+        # computed f64: order by value; NaN needs a consistent slot — push to
+        # the top via isnan lane handled by caller. Grouping only needs
+        # consistency, and NaN != NaN would split groups: map NaN to a
+        # canonical key by replacing with +inf and adding an isnan lane.
+        isnan = jnp.isnan(data)
+        canon = jnp.where(isnan, jnp.float64(np.inf), data)
+        canon = jnp.where(canon == 0.0, jnp.float64(0.0), canon)  # -0.0==0.0
+        return [valid_lane, isnan.astype(jnp.int8), canon]
+    return [valid_lane, data]
+
+
+def _eq_prev(lane):
+    """Boundary lane: True where row differs from previous sorted row."""
+    return jnp.concatenate([jnp.ones((1,), bool), lane[1:] != lane[:-1]])
+
+
+def _segment_minmax_float(vals, valid_live, seg_ids, num_segments, is_min):
+    """Java-ordering min/max for float values (NaN greatest).
+
+    Value-space with NaN tracking; the exact bit-space path for int64-bits
+    DOUBLE lanes lives inline in groupby_trace via _bits_total_order."""
+    isnan = jnp.isnan(vals) & valid_live
+    has_nan = jax.ops.segment_max(isnan.astype(jnp.int32), seg_ids,
+                                  num_segments=num_segments) > 0
+    all_nan_ident = jnp.float64(np.inf) if is_min else jnp.float64(-np.inf)
+    clean = jnp.where(valid_live & ~isnan, vals, all_nan_ident)
+    red = (jax.ops.segment_min if is_min else jax.ops.segment_max)(
+        clean, seg_ids, num_segments=num_segments)
+    non_nan_count = jax.ops.segment_sum(
+        (valid_live & ~isnan).astype(jnp.int32), seg_ids,
+        num_segments=num_segments)
+    if is_min:
+        # min is NaN only when every valid value is NaN
+        return jnp.where(has_nan & (non_nan_count == 0), jnp.float64(np.nan),
+                         red)
+    return jnp.where(has_nan, jnp.float64(np.nan), red)
+
+
+_EXP_MASK = np.int64(0x7FF0000000000000)
+_MANT_MASK = np.int64(0x000FFFFFFFFFFFFF)
+_CANON_NAN = np.int64(0x7FF8000000000000)
+
+
+def _bits_total_order(b):
+    """Monotone int64 mapping of f64 bit patterns (Java Double.compare).
+
+    -inf < ... < -0.0 < 0.0 < ... < +inf < NaN.  NaN bits are canonicalized
+    first so the int64 extremes stay free for masking identities."""
+    is_nan = ((b & _EXP_MASK) == _EXP_MASK) & ((b & _MANT_MASK) != 0)
+    b = jnp.where(is_nan, jnp.int64(_CANON_NAN), b)
+    # int64 wraparound makes -2^63-1-b correct mod 2^64 for all negative b
+    return jnp.where(b >= 0, b, jnp.int64(-2**63) - jnp.int64(1) - b)
+
+
+def _bits_from_order(o):
+    return jnp.where(o >= 0, o, jnp.int64(-2**63) - jnp.int64(1) - o)
+
+
+_ORDER_MAX = np.int64(2**63 - 1)   # unreachable after NaN canonicalization
+_ORDER_MIN = np.int64(-2**63)
+
+
+def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity):
+    """Build the traced groupby fn for jit.
+
+    key_lanes_info: list of (dtype, has_validity, lane_dtype_str) — static.
+    Returns fn(keys_data, keys_valid, agg_data, agg_valid, num_rows) ->
+      (perm_keys (data, valid) per key, agg outs (data, valid) per spec,
+       num_groups scalar)
+    """
+    def run(keys, keys_valid, agg_data, agg_valid, num_rows):
+        live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        # --- 1. sort ---
+        lanes = []
+        for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, keys, keys_valid):
+            sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
+            lanes.extend([l for l in sub if l is not None])
+        # lexsort: LAST key is primary -> order [secondary..., primary]
+        sort_keys = list(reversed(lanes)) + [(~live).astype(jnp.int8)]
+        perm = jnp.lexsort(sort_keys)
+        s_live = live[perm]
+        s_keys = [k[perm] for k in keys]
+        s_keys_valid = [None if v is None else v[perm] for v in keys_valid]
+
+        # --- 2. boundaries ---
+        boundary = jnp.zeros((capacity,), bool)
+        boundary = boundary.at[0].set(True)
+        for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, s_keys, s_keys_valid):
+            sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
+            for lane in sub:
+                if lane is not None:
+                    boundary = boundary | _eq_prev(lane)
+        # first padding row opens its own (dead) segment
+        pad_start = jnp.concatenate([jnp.ones((1,), bool),
+                                     s_live[1:] != s_live[:-1]])
+        boundary = boundary | pad_start
+
+        seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+        num_groups = jnp.where(num_rows > 0, seg_ids[jnp.maximum(num_rows - 1, 0)] + 1, 0)
+
+        # --- 3. group keys: first row of each segment ---
+        big = jnp.int32(capacity)
+        start_idx = jax.ops.segment_min(
+            jnp.arange(capacity, dtype=jnp.int32), seg_ids,
+            num_segments=num_segments)
+        start_idx = jnp.clip(start_idx, 0, capacity - 1)
+        out_keys = []
+        for kd, kv in zip(s_keys, s_keys_valid):
+            okd = kd[start_idx]
+            okv = (jnp.ones((capacity,), bool) if kv is None else kv[start_idx])
+            group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+            out_keys.append((okd, okv & group_live))
+
+        # --- 4. aggregates ---
+        group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+        outs = []
+        for spec in agg_specs:
+            d = agg_data[spec.input_idx][perm] if spec.input_idx >= 0 else None
+            v = agg_valid[spec.input_idx]
+            v = (jnp.ones((capacity,), bool) if v is None else v)[perm] \
+                if spec.input_idx >= 0 else s_live
+            vl = (v & s_live) if d is not None else s_live
+            dt = spec.dtype
+            if spec.kind == COUNT_ALL:
+                data = jax.ops.segment_sum(s_live.astype(jnp.int64), seg_ids,
+                                           num_segments=num_segments)
+                outs.append((data, group_live))
+                continue
+            if spec.kind == COUNT:
+                data = jax.ops.segment_sum(vl.astype(jnp.int64), seg_ids,
+                                           num_segments=num_segments)
+                outs.append((data, group_live))
+                continue
+            valid_count = jax.ops.segment_sum(vl.astype(jnp.int32), seg_ids,
+                                              num_segments=num_segments)
+            out_valid = (valid_count > 0) & group_live
+            cd = compute_view(d, dt)
+            if spec.kind == SUM:
+                acc = cd.astype(jnp.float64 if t.is_floating(dt) else jnp.int64)
+                data = jax.ops.segment_sum(jnp.where(vl, acc, 0), seg_ids,
+                                           num_segments=num_segments)
+            elif spec.kind in (MIN, MAX):
+                is_min = spec.kind == MIN
+                if isinstance(dt, t.DoubleType) and d.dtype == jnp.int64:
+                    o = _bits_total_order(d)
+                    ident = jnp.int64(_ORDER_MAX if is_min else _ORDER_MIN)
+                    o = jnp.where(vl, o, ident)
+                    red = (jax.ops.segment_min if is_min
+                           else jax.ops.segment_max)(
+                        o, seg_ids, num_segments=num_segments)
+                    data = _bits_from_order(red)
+                elif t.is_floating(dt):
+                    data = _segment_minmax_float(cd, vl, seg_ids,
+                                                 num_segments, is_min)
+                else:
+                    info = np.iinfo(np.dtype(cd.dtype)) if not \
+                        isinstance(dt, t.BooleanType) else None
+                    if isinstance(dt, t.BooleanType):
+                        ident = jnp.asarray(True if is_min else False)
+                        acc = cd
+                    else:
+                        ident = jnp.asarray(info.max if is_min else info.min,
+                                            cd.dtype)
+                        acc = cd
+                    acc = jnp.where(vl, acc, ident)
+                    data = (jax.ops.segment_min if is_min
+                            else jax.ops.segment_max)(
+                        acc, seg_ids, num_segments=num_segments)
+            elif spec.kind in (FIRST, LAST, FIRST_NN, LAST_NN):
+                idx = jnp.arange(capacity, dtype=jnp.int32)
+                is_first = spec.kind in (FIRST, FIRST_NN)
+                sel = vl if spec.kind in (FIRST_NN, LAST_NN) else s_live
+                masked = jnp.where(sel, idx, big if is_first else -1)
+                pick = (jax.ops.segment_min if is_first
+                        else jax.ops.segment_max)(
+                    masked, seg_ids, num_segments=num_segments)
+                pick = jnp.clip(pick, 0, capacity - 1)
+                data = cd[pick]
+                out_valid = vl[pick] & group_live
+            elif spec.kind == ANY:
+                data = jax.ops.segment_max(
+                    jnp.where(vl, cd, False).astype(jnp.int8), seg_ids,
+                    num_segments=num_segments) > 0
+            elif spec.kind == EVERY:
+                data = jax.ops.segment_min(
+                    jnp.where(vl, cd, True).astype(jnp.int8), seg_ids,
+                    num_segments=num_segments) > 0
+            else:
+                raise ValueError(f"unknown agg kind {spec.kind}")
+            outs.append((data, out_valid))
+        return out_keys, outs, num_groups
+
+    return run
+
+
+def reduce_trace(agg_specs, capacity):
+    """No-key aggregation (single output row at index 0)."""
+    def run(agg_data, agg_valid, num_rows):
+        live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        outs = []
+        for spec in agg_specs:
+            d = agg_data[spec.input_idx] if spec.input_idx >= 0 else None
+            v = agg_valid[spec.input_idx] if spec.input_idx >= 0 else None
+            v = jnp.ones((capacity,), bool) if v is None else v
+            vl = (v & live) if d is not None else live
+            dt = spec.dtype
+            if spec.kind in (COUNT, COUNT_ALL):
+                val = jnp.sum(vl, dtype=jnp.int64)
+                data, valid = val, jnp.asarray(True)
+            else:
+                nvalid = jnp.sum(vl, dtype=jnp.int32)
+                valid = nvalid > 0
+                cd = compute_view(d, dt)
+                if spec.kind == SUM:
+                    acc = cd.astype(jnp.float64 if t.is_floating(dt)
+                                    else jnp.int64)
+                    data = jnp.sum(jnp.where(vl, acc, 0))
+                elif spec.kind in (MIN, MAX):
+                    is_min = spec.kind == MIN
+                    if isinstance(dt, t.DoubleType) and d.dtype == jnp.int64:
+                        o = _bits_total_order(d)
+                        ident = jnp.int64(_ORDER_MAX if is_min else _ORDER_MIN)
+                        o = jnp.where(vl, o, ident)
+                        red = jnp.min(o) if is_min else jnp.max(o)
+                        data = _bits_from_order(red)
+                    elif t.is_floating(dt):
+                        isnan = jnp.isnan(cd) & vl
+                        has_nan = jnp.any(isnan)
+                        ident = jnp.float64(np.inf) if is_min \
+                            else jnp.float64(-np.inf)
+                        clean = jnp.where(vl & ~isnan, cd, ident)
+                        red = jnp.min(clean) if is_min else jnp.max(clean)
+                        n_clean = jnp.sum(vl & ~isnan)
+                        if is_min:
+                            data = jnp.where(has_nan & (n_clean == 0),
+                                             jnp.float64(np.nan), red)
+                        else:
+                            data = jnp.where(has_nan, jnp.float64(np.nan), red)
+                    else:
+                        if isinstance(dt, t.BooleanType):
+                            ident = jnp.asarray(is_min)
+                        else:
+                            info = np.iinfo(np.dtype(cd.dtype))
+                            ident = jnp.asarray(info.max if is_min else info.min,
+                                                cd.dtype)
+                        acc = jnp.where(vl, cd, ident)
+                        data = jnp.min(acc) if is_min else jnp.max(acc)
+                elif spec.kind in (FIRST, LAST, FIRST_NN, LAST_NN):
+                    idx = jnp.arange(capacity, dtype=jnp.int32)
+                    is_first = spec.kind in (FIRST, FIRST_NN)
+                    sel = vl if spec.kind in (FIRST_NN, LAST_NN) else live
+                    masked = jnp.where(sel, idx, capacity if is_first else -1)
+                    pick = jnp.min(masked) if is_first else jnp.max(masked)
+                    pick = jnp.clip(pick, 0, capacity - 1)
+                    data = compute_view(d, dt)[pick]
+                    valid = vl[pick]
+                elif spec.kind == ANY:
+                    data = jnp.any(jnp.where(vl, cd, False))
+                elif spec.kind == EVERY:
+                    data = jnp.all(jnp.where(vl, cd, True))
+                else:
+                    raise ValueError(spec.kind)
+            outs.append((data, valid))
+        return outs
+
+    return run

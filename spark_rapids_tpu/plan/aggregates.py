@@ -1,0 +1,293 @@
+"""Aggregate functions: Spark's declarative update/merge/evaluate model.
+
+Mirrors the reference's GpuAggregateFunction family (reference
+org/.../rapids/aggregate/, GpuAggregateExec.scala AggHelper:175): every
+aggregate declares
+  * input projection(s)  - expressions evaluated per input batch
+  * update kernel ops    - ops/groupby.py kinds producing partial buffers
+  * merge kernel ops     - kinds combining partial buffers across batches
+  * evaluate expression  - final projection over merged buffers
+
+so partial (per-batch, device), merge (concat+regroup) and final phases all
+reuse the same sort-segment kernel.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as t
+from ..config import TpuConf
+from ..ops import groupby as G
+from . import expressions as E
+
+
+class AggregateFunction:
+    """Base declarative aggregate."""
+    name = "agg"
+
+    def __init__(self, child: Optional[E.Expression]):
+        self.child = child
+
+    def bind(self, schema: t.StructType) -> "AggregateFunction":
+        import copy
+        b = copy.copy(self)
+        if self.child is not None:
+            b.child = self.child.bind(schema)
+        b._resolve()
+        return b
+
+    def _resolve(self):
+        raise NotImplementedError
+
+    # input expressions evaluated per batch (one per update op)
+    def inputs(self) -> List[Optional[E.Expression]]:
+        raise NotImplementedError
+
+    # (kind, buffer dtype) per buffer column
+    def update_ops(self) -> List[Tuple[str, t.DataType]]:
+        raise NotImplementedError
+
+    def merge_ops(self) -> List[Tuple[str, t.DataType]]:
+        raise NotImplementedError
+
+    def evaluate(self, buffer_refs: List[E.Expression]) -> E.Expression:
+        """Final expression over buffer columns (already bound ColumnRefs)."""
+        raise NotImplementedError
+
+    def unsupported_reasons(self, conf: TpuConf) -> List[str]:
+        out = []
+        if not conf.is_op_enabled("expression", type(self).__name__):
+            out.append(f"{type(self).__name__} disabled by conf")
+        if self.child is not None:
+            out += self.child.tree_unsupported(conf)
+            if isinstance(self.child.dtype, (t.ArrayType, t.StructType,
+                                             t.MapType, t.BinaryType)):
+                out.append(f"{self.name} over {self.child.dtype.simple_string}")
+            if isinstance(self.child.dtype, t.DecimalType):
+                out.append("decimal aggregation not yet on device")
+        return out
+
+    # CPU fallback: (pyarrow TableGroupBy aggregation name, options)
+    def cpu_agg(self) -> Tuple[str, object]:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{self.name}({self.child!r})"
+
+
+class Count(AggregateFunction):
+    """count(expr) / count(*) — never null, 0 for empty group."""
+    name = "count"
+    result_type = t.LONG
+
+    def _resolve(self):
+        self.dtype = t.LONG
+        self.nullable = False
+
+    def inputs(self):
+        return [self.child]          # None for count(*)
+
+    def update_ops(self):
+        return [(G.COUNT if self.child is not None else G.COUNT_ALL, t.LONG)]
+
+    def merge_ops(self):
+        return [(G.SUM, t.LONG)]
+
+    def evaluate(self, refs):
+        # merged count may be "null" if kernel saw empty; coalesce to 0
+        return E.Coalesce(refs[0], E.Literal(0, t.LONG))
+
+    def unsupported_reasons(self, conf):
+        if self.child is None:
+            return []
+        return AggregateFunction.unsupported_reasons(self, conf)
+
+    def cpu_agg(self):
+        return ("count", pc.CountOptions(mode="only_valid")) \
+            if self.child is not None else ("count", pc.CountOptions(mode="all"))
+
+
+def _sum_result_type(dt: t.DataType) -> t.DataType:
+    if t.is_integral(dt):
+        return t.LONG
+    if isinstance(dt, (t.FloatType, t.DoubleType)):
+        return t.DOUBLE
+    if isinstance(dt, t.DecimalType):
+        return t.DecimalType(min(38, dt.precision + 10), dt.scale)
+    raise TypeError(f"sum over {dt}")
+
+
+class Sum(AggregateFunction):
+    name = "sum"
+
+    def _resolve(self):
+        self.dtype = _sum_result_type(self.child.dtype)
+        self.nullable = True
+
+    def inputs(self):
+        return [self.child]
+
+    def update_ops(self):
+        return [(G.SUM, self.dtype)]
+
+    def merge_ops(self):
+        return [(G.SUM, self.dtype)]
+
+    def evaluate(self, refs):
+        return refs[0]
+
+    def cpu_agg(self):
+        return ("sum", None)
+
+
+class Min(AggregateFunction):
+    name = "min"
+
+    def _resolve(self):
+        self.dtype = self.child.dtype
+        self.nullable = True
+
+    def inputs(self):
+        return [self.child]
+
+    def update_ops(self):
+        return [(G.MIN, self.dtype)]
+
+    def merge_ops(self):
+        return [(G.MIN, self.dtype)]
+
+    def evaluate(self, refs):
+        return refs[0]
+
+    def unsupported_reasons(self, conf):
+        out = AggregateFunction.unsupported_reasons(self, conf)
+        if isinstance(self.child.dtype, t.StringType):
+            out.append("string min/max not yet on device")
+        return out
+
+    def cpu_agg(self):
+        return ("min", None)
+
+
+class Max(Min):
+    name = "max"
+
+    def update_ops(self):
+        return [(G.MAX, self.dtype)]
+
+    def merge_ops(self):
+        return [(G.MAX, self.dtype)]
+
+    def cpu_agg(self):
+        return ("max", None)
+
+
+class Average(AggregateFunction):
+    name = "avg"
+
+    def _resolve(self):
+        if isinstance(self.child.dtype, t.DecimalType):
+            raise TypeError("decimal avg handled via fallback")
+        self.dtype = t.DOUBLE
+        self.nullable = True
+
+    def inputs(self):
+        # sum in double space (Spark: avg sums as double for non-decimal)
+        return [_resolved(E.Cast(self.child, t.DOUBLE)), self.child]
+
+    def update_ops(self):
+        return [(G.SUM, t.DOUBLE), (G.COUNT, t.LONG)]
+
+    def merge_ops(self):
+        return [(G.SUM, t.DOUBLE), (G.SUM, t.LONG)]
+
+    def evaluate(self, refs):
+        return E.Divide(refs[0], refs[1])
+
+    def cpu_agg(self):
+        return ("mean", None)
+
+
+class First(AggregateFunction):
+    name = "first"
+
+    def __init__(self, child, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def _resolve(self):
+        self.dtype = self.child.dtype
+        self.nullable = True
+
+    def inputs(self):
+        return [self.child]
+
+    def _kind(self):
+        return G.FIRST_NN if self.ignore_nulls else G.FIRST
+
+    def update_ops(self):
+        return [(self._kind(), self.dtype)]
+
+    def merge_ops(self):
+        return [(self._kind(), self.dtype)]
+
+    def evaluate(self, refs):
+        return refs[0]
+
+    def cpu_agg(self):
+        return ("first", pc.ScalarAggregateOptions(skip_nulls=self.ignore_nulls))
+
+
+class Last(First):
+    name = "last"
+
+    def _kind(self):
+        return G.LAST_NN if self.ignore_nulls else G.LAST
+
+    def cpu_agg(self):
+        return ("last", pc.ScalarAggregateOptions(skip_nulls=self.ignore_nulls))
+
+
+class BoolAnd(AggregateFunction):
+    name = "bool_and"
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+        self.nullable = True
+
+    def inputs(self):
+        return [self.child]
+
+    def update_ops(self):
+        return [(G.EVERY, t.BOOLEAN)]
+
+    def merge_ops(self):
+        return [(G.EVERY, t.BOOLEAN)]
+
+    def evaluate(self, refs):
+        return refs[0]
+
+    def cpu_agg(self):
+        return ("min", None)
+
+
+class BoolOr(BoolAnd):
+    name = "bool_or"
+
+    def update_ops(self):
+        return [(G.ANY, t.BOOLEAN)]
+
+    def merge_ops(self):
+        return [(G.ANY, t.BOOLEAN)]
+
+    def cpu_agg(self):
+        return ("max", None)
+
+
+def _resolved(e: E.Expression) -> E.Expression:
+    """Resolve an expression wrapped around already-bound children."""
+    e._resolve()
+    return e

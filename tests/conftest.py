@@ -1,26 +1,26 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh before jax imports.
+"""Test bootstrap: force an 8-device virtual CPU mesh.
 
-Mirrors the task requirement: multi-chip sharding is validated on a virtual
-CPU mesh (xla_force_host_platform_device_count) since only one real TPU chip
-is reachable; bench.py runs on the real chip instead.
+Tests must run without the real chip and with 8 virtual devices so
+multi-chip shardings are exercised (the driver separately dry-runs
+__graft_entry__.dryrun_multichip the same way).  jax is pre-imported by the
+environment's sitecustomize with JAX_PLATFORMS=axon, so env vars are too
+late — use jax.config, which applies because no backend is initialized yet.
 """
 import os
 
-# Force CPU even though the session env pins JAX_PLATFORMS=axon (real TPU):
-# tests must be runnable without the chip and with 8 virtual devices.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def eight_devices():
-    import jax
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs[:8]

@@ -1,0 +1,171 @@
+"""Group-by / reduction correctness vs a pyarrow oracle."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.columnar import HostBatch, to_device, to_host
+from spark_rapids_tpu.config import DEFAULT_CONF
+from spark_rapids_tpu.exec.aggregate import HashAggregate
+from spark_rapids_tpu.plan import aggregates as A
+from spark_rapids_tpu.plan import expressions as E
+
+RNG = np.random.default_rng(123)
+
+
+def make_data(n=2000, nkeys=17):
+    return {
+        "k": pa.array(RNG.integers(0, nkeys, n), pa.int32(),
+                      mask=RNG.random(n) < 0.05),
+        "s": pa.array(RNG.choice(["x", "y", "z", "w"], n)),
+        "v": pa.array(RNG.integers(-100, 100, n), pa.int64(),
+                      mask=RNG.random(n) < 0.1),
+        "f": pa.array(RNG.normal(0, 10, n), pa.float64(),
+                      mask=RNG.random(n) < 0.1),
+    }
+
+
+def run_agg(data, keys, aggs, n_batches=1):
+    hb = HostBatch.from_pydict(data)
+    schema = hb.schema
+    key_exprs = [E.ColumnRef(k).bind(schema) for k in keys]
+    bound = [(fn.bind(schema), name) for fn, name in aggs]
+    ha = HashAggregate(key_exprs, list(keys), bound, DEFAULT_CONF)
+    if n_batches == 1:
+        batches = [to_device(hb)]
+    else:
+        step = (hb.num_rows + n_batches - 1) // n_batches
+        batches = [to_device(hb.slice(i * step, step))
+                   for i in range(n_batches)]
+    return to_host(ha.execute(batches))
+
+
+def oracle(data, keys, arrow_aggs):
+    tbl = pa.Table.from_pydict(data)
+    return tbl.group_by(keys, use_threads=False).aggregate(arrow_aggs)
+
+
+def compare(got: HostBatch, want: pa.Table, keys, approx_cols=()):
+    got_t = got.to_table().sort_by([(k, "ascending") for k in keys])
+    want_t = want.sort_by([(k, "ascending") for k in keys])
+    assert got_t.num_rows == want_t.num_rows, \
+        f"group count {got_t.num_rows} != {want_t.num_rows}"
+    for name in want_t.schema.names:
+        g = got_t.column(got_t.schema.names.index(name)).to_pylist()
+        w = want_t.column(name).to_pylist()
+        for i, (a, b) in enumerate(zip(g, w)):
+            if name in approx_cols and a is not None and b is not None:
+                assert a == pytest.approx(b, rel=1e-9), f"{name}[{i}]: {a} != {b}"
+            else:
+                assert a == b or (a != a and b != b), f"{name}[{i}]: {a} != {b}"
+
+
+def test_groupby_int_key_sums():
+    data = make_data()
+    got = run_agg(data, ["k"], [
+        (A.Sum(E.ColumnRef("v")), "sum_v"),
+        (A.Count(E.ColumnRef("v")), "cnt_v"),
+        (A.Count(None), "cnt"),
+        (A.Min(E.ColumnRef("v")), "min_v"),
+        (A.Max(E.ColumnRef("v")), "max_v"),
+    ])
+    want = oracle(data, ["k"], [("v", "sum"), ("v", "count"),
+                                ([], "count_all"), ("v", "min"), ("v", "max")])
+    # arrow returns agg columns first, key columns last
+    want = want.rename_columns(["k", "sum_v", "cnt_v", "cnt", "min_v", "max_v"])
+    compare(got, want, ["k"])
+
+
+def test_groupby_string_key():
+    data = make_data()
+    got = run_agg(data, ["s"], [(A.Sum(E.ColumnRef("v")), "sum_v")])
+    want = oracle(data, ["s"], [("v", "sum")]).rename_columns(["s", "sum_v"])
+    compare(got, want, ["s"])
+
+
+def test_groupby_multi_key_multi_batch():
+    data = make_data(n=5000)
+    got = run_agg(data, ["k", "s"], [
+        (A.Sum(E.ColumnRef("f")), "sum_f"),
+        (A.Average(E.ColumnRef("v")), "avg_v"),
+    ], n_batches=4)
+    want = oracle(data, ["k", "s"], [("f", "sum"), ("v", "mean")]) \
+        .rename_columns(["k", "s", "sum_f", "avg_v"])
+    compare(got, want, ["k", "s"], approx_cols=("sum_f", "avg_v"))
+
+
+def test_groupby_float_minmax_with_nan():
+    n = 200
+    vals = RNG.normal(0, 10, n)
+    vals[:20] = np.nan
+    data = {"k": pa.array(RNG.integers(0, 5, n), pa.int32()),
+            "f": pa.array(vals, pa.float64(), mask=RNG.random(n) < 0.1)}
+    got = run_agg(data, ["k"], [(A.Min(E.ColumnRef("f")), "min_f"),
+                                (A.Max(E.ColumnRef("f")), "max_f")])
+    # Spark/Java ordering: NaN is greatest -> max = NaN when group has NaN
+    import pyarrow.compute as pc
+    got_t = got.to_table().sort_by([("k", "ascending")])
+    tbl = pa.Table.from_pydict(data)
+    for row in got_t.to_pylist():
+        grp = tbl.filter(pc.equal(tbl.column("k"), row["k"])).column("f")
+        vals = [x for x in grp.to_pylist() if x is not None]  # nulls skipped
+        non_nan = [x for x in vals if not np.isnan(x)]
+        has_nan = len(non_nan) < len(vals)
+        if has_nan:
+            assert np.isnan(row["max_f"])
+            if non_nan:
+                assert row["min_f"] == pytest.approx(min(non_nan))
+            else:
+                assert np.isnan(row["min_f"])
+        else:
+            assert row["max_f"] == pytest.approx(max(vals))
+            assert row["min_f"] == pytest.approx(min(vals))
+
+
+def test_reduction_no_keys():
+    data = make_data()
+    got = run_agg(data, [], [
+        (A.Sum(E.ColumnRef("v")), "sum_v"),
+        (A.Count(None), "cnt"),
+        (A.Min(E.ColumnRef("f")), "min_f"),
+        (A.Average(E.ColumnRef("f")), "avg_f"),
+    ], n_batches=3)
+    tbl = pa.Table.from_pydict(data)
+    import pyarrow.compute as pc
+    assert got.num_rows == 1
+    row = got.to_table().to_pylist()[0]
+    assert row["sum_v"] == pc.sum(tbl.column("v")).as_py()
+    assert row["cnt"] == tbl.num_rows
+    assert row["min_f"] == pytest.approx(pc.min(tbl.column("f")).as_py())
+    assert row["avg_f"] == pytest.approx(pc.mean(tbl.column("f")).as_py())
+
+
+def test_null_keys_form_groups():
+    data = {"k": pa.array([1, None, 1, None, 2], pa.int32()),
+            "v": pa.array([10, 20, 30, 40, 50], pa.int64())}
+    got = run_agg(data, ["k"], [(A.Sum(E.ColumnRef("v")), "s")])
+    rows = {r["k"]: r["s"] for r in got.to_table().to_pylist()}
+    assert rows == {1: 40, None: 60, 2: 50}
+
+
+def test_empty_groups_all_null_values():
+    data = {"k": pa.array([1, 1, 2], pa.int32()),
+            "v": pa.array([None, None, 5], pa.int64())}
+    got = run_agg(data, ["k"], [(A.Sum(E.ColumnRef("v")), "s"),
+                                (A.Count(E.ColumnRef("v")), "c")])
+    rows = {r["k"]: (r["s"], r["c"]) for r in got.to_table().to_pylist()}
+    assert rows == {1: (None, 0), 2: (5, 1)}
+
+
+def test_first_last_bool():
+    data = {"k": pa.array([1, 1, 2, 2], pa.int32()),
+            "b": pa.array([True, False, None, True]),
+            "v": pa.array([None, 3, 4, None], pa.int64())}
+    got = run_agg(data, ["k"], [
+        (A.First(E.ColumnRef("v"), ignore_nulls=True), "fv"),
+        (A.BoolAnd(E.ColumnRef("b")), "ba"),
+        (A.BoolOr(E.ColumnRef("b")), "bo"),
+    ])
+    rows = {r["k"]: (r["fv"], r["ba"], r["bo"])
+            for r in got.to_table().to_pylist()}
+    assert rows == {1: (3, False, True), 2: (4, True, True)}
