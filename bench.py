@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Headline benchmark: TPC-H q6 (SF1-sized lineitem) through the framework.
+
+Prints ONE json line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline = CPU time / TPU time (>1 means the TPU path wins) against an
+in-process vectorized pyarrow baseline — a *stronger* stand-in for CPU
+Spark than Spark itself (columnar C++ kernels, no JVM/task overhead), so
+the reported speedup is conservative vs the BASELINE.md north-star.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+SF1_ROWS = 6_001_215
+DATE_LO = 8766    # 1994-01-01 in days since epoch
+DATE_HI = 9131    # 1995-01-01
+
+
+def gen_lineitem(n: int) -> pa.Table:
+    rng = np.random.default_rng(20240706)
+    return pa.table({
+        "l_quantity": pa.array(rng.integers(1, 51, n), pa.int64()),
+        "l_extendedprice": pa.array(rng.uniform(900.0, 105000.0, n).round(2)),
+        "l_discount": pa.array(rng.integers(0, 11, n) / 100.0),
+        "l_shipdate": pa.array(rng.integers(8035, 10592, n).astype(np.int32),
+                               pa.int32()),
+    })
+
+
+def build_plan(scan):
+    from spark_rapids_tpu.plan import expressions as E
+    from spark_rapids_tpu.plan.aggregates import Sum
+    from spark_rapids_tpu.exec.plan import FilterExec, HashAggregateExec
+
+    c = E.ColumnRef
+    cond = E.And(
+        E.And(E.GreaterThanOrEqual(c("l_shipdate"), E.Literal(DATE_LO)),
+              E.LessThan(c("l_shipdate"), E.Literal(DATE_HI))),
+        E.And(E.And(E.GreaterThanOrEqual(c("l_discount"), E.Literal(0.05)),
+                    E.LessThanOrEqual(c("l_discount"), E.Literal(0.07))),
+              E.LessThan(c("l_quantity"), E.Literal(24))))
+    revenue = E.Multiply(c("l_extendedprice"), c("l_discount"))
+    return HashAggregateExec([], [], [(Sum(revenue), "revenue")],
+                             FilterExec(cond, scan))
+
+
+def time_runs(fn, iters=5):
+    fn()  # warm (compile + caches)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def run_tpu(table: pa.Table, batch_rows: int):
+    from spark_rapids_tpu.exec.plan import HostScanExec
+
+    def once():
+        plan = build_plan(HostScanExec.from_table(table, batch_rows))
+        return plan.collect().column("revenue").to_pylist()[0]
+
+    result = once()
+    return time_runs(once), result
+
+
+def run_tpu_resident(table: pa.Table, batch_rows: int):
+    """Compute-only: input batches already device-resident (buffer-cache
+    analogue of a hot scan)."""
+    import jax
+    from spark_rapids_tpu.columnar.device import to_device
+    from spark_rapids_tpu.columnar.host import HostBatch
+    from spark_rapids_tpu.exec.plan import HostScanExec, PlanNode
+
+    src = HostScanExec.from_table(table, batch_rows)
+    cached = [to_device(hb) for hb in src.batches]
+    jax.block_until_ready([c.data for b in cached for c in b.columns])
+
+    class DeviceScan(PlanNode):
+        output_schema = src.output_schema
+
+        def execute(self, ctx):
+            return iter(cached)
+
+    def once():
+        return build_plan(DeviceScan()).collect().column(
+            "revenue").to_pylist()[0]
+
+    result = once()
+    return time_runs(once), result
+
+
+def run_cpu(table: pa.Table):
+    def once():
+        m = pc.and_(
+            pc.and_(pc.greater_equal(table["l_shipdate"], DATE_LO),
+                    pc.less(table["l_shipdate"], DATE_HI)),
+            pc.and_(pc.and_(pc.greater_equal(table["l_discount"], 0.05),
+                            pc.less_equal(table["l_discount"], 0.07)),
+                    pc.less(table["l_quantity"], 24)))
+        ft = table.filter(m)
+        return pc.sum(pc.multiply(ft["l_extendedprice"],
+                                  ft["l_discount"])).as_py()
+
+    result = once()
+    return time_runs(once), result
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else SF1_ROWS
+    batch_rows = 1 << 23   # single fused batch: fewest dispatches wins
+    table = gen_lineitem(n)
+
+    cpu_t, cpu_r = run_cpu(table)
+    tpu_t, tpu_r = run_tpu(table, batch_rows)
+    res_t, res_r = run_tpu_resident(table, batch_rows)
+
+    for r in (tpu_r, res_r):
+        assert abs(r - cpu_r) / abs(cpu_r) < 1e-6, (r, cpu_r)
+
+    print(f"# rows={n} cpu(pyarrow)={cpu_t*1e3:.1f}ms "
+          f"tpu_e2e={tpu_t*1e3:.1f}ms tpu_resident={res_t*1e3:.1f}ms",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "tpch_q6_sf1_device_resident_ms",
+        "value": round(res_t * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_t / res_t, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -98,9 +98,15 @@ class DeviceColumn:
 
 @dataclasses.dataclass
 class DeviceBatch:
-    """A batch of device columns sharing one capacity and logical row count."""
+    """A batch of device columns sharing one capacity and logical row count.
+
+    `num_rows` is either a host int or a 0-d jax int scalar: operators whose
+    output count is data-dependent (filter, join) leave it on device so
+    chained device work never stalls on a D2H sync; host-side consumers
+    coerce with `int(db.num_rows)` (one sync) when they truly need the value
+    (coalesce sizing, limits, final collect)."""
     columns: List[DeviceColumn]
-    num_rows: int
+    num_rows: object   # int | jax.Array 0-d
     names: List[str]
 
     @property
@@ -240,9 +246,15 @@ def to_device(hb: HostBatch, conf: TpuConf = DEFAULT_CONF,
 # Device -> host (the ColumnarToRow / BringBackToHost analogue)
 # ---------------------------------------------------------------------------
 
-def _device_column_to_arrow(col: DeviceColumn, num_rows: int) -> pa.Array:
-    data = np.asarray(jax.device_get(col.data))[:num_rows]
-    valid = np.asarray(jax.device_get(col.validity))[:num_rows].astype(bool)
+def _device_column_to_arrow(col: DeviceColumn, num_rows: int,
+                            fetched=None) -> pa.Array:
+    if fetched is not None:
+        data_np, valid_np, hi_np = fetched
+    else:
+        data_np, valid_np, hi_np = jax.device_get(
+            (col.data, col.validity, col.data_hi))
+    data = np.asarray(data_np)[:num_rows]
+    valid = np.asarray(valid_np)[:num_rows].astype(bool)
     dt = col.dtype
     if isinstance(dt, t.StringType):
         codes = np.where(valid, data, -1).astype(np.int32)
@@ -252,7 +264,7 @@ def _device_column_to_arrow(col: DeviceColumn, num_rows: int) -> pa.Array:
     if isinstance(dt, t.DecimalType):
         if dt.is_wide:
             lo = data.astype(np.int64).view(np.uint64)
-            hi_lane = np.asarray(jax.device_get(col.data_hi))[:num_rows].view(np.uint64)
+            hi_lane = np.asarray(hi_np)[:num_rows].view(np.uint64)
             lanes = np.empty((num_rows, 2), dtype=np.uint64)
             lanes[:, 0] = lo
             lanes[:, 1] = hi_lane
@@ -279,7 +291,12 @@ def _device_column_to_arrow(col: DeviceColumn, num_rows: int) -> pa.Array:
 
 
 def to_host(db: DeviceBatch) -> HostBatch:
-    arrays = [_device_column_to_arrow(c, db.num_rows) for c in db.columns]
+    n = int(db.num_rows)
+    # one D2H round trip for every lane of every column
+    fetched = jax.device_get([(c.data, c.validity, c.data_hi)
+                              for c in db.columns])
+    arrays = [_device_column_to_arrow(c, n, f)
+              for c, f in zip(db.columns, fetched)]
     schema = pa.schema([pa.field(n, a.type) for n, a in zip(db.names, arrays)])
     if not arrays:
         return HostBatch(pa.RecordBatch.from_pydict({}))

@@ -47,7 +47,7 @@ def _ensure_unique_dict(col: DeviceColumn) -> DeviceColumn:
 
 
 def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
-                 specs: List[G.AggSpec], num_rows: int, capacity: int):
+                 specs: List[G.AggSpec], live, capacity: int):
     key_cols = [_ensure_unique_dict(c) for c in key_cols]
     info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
     sig = (info, tuple((s.kind, s.input_idx, s.dtype) for s in specs),
@@ -62,12 +62,12 @@ def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
         tuple(c.validity for c in key_cols),
         tuple(c.data for c in agg_cols),
         tuple(c.validity for c in agg_cols),
-        jnp.int32(num_rows))
+        live)
     return key_cols, out_keys, outs, int(num_groups)
 
 
 def _run_reduce(agg_cols: List[DeviceColumn], specs: List[G.AggSpec],
-                num_rows: int, capacity: int):
+                live, capacity: int):
     sig = (tuple((s.kind, s.input_idx, s.dtype) for s in specs), capacity,
            tuple(str(c.data.dtype) for c in agg_cols))
     fn = _REDUCE_CACHE.get(sig)
@@ -75,7 +75,19 @@ def _run_reduce(agg_cols: List[DeviceColumn], specs: List[G.AggSpec],
         fn = jax.jit(G.reduce_trace(list(specs), capacity))
         _REDUCE_CACHE[sig] = fn
     return fn(tuple(c.data for c in agg_cols),
-              tuple(c.validity for c in agg_cols), jnp.int32(num_rows))
+              tuple(c.validity for c in agg_cols), live)
+
+
+def check_agg_buffers_supported(aggs) -> None:
+    """The two-lane (hi, lo) decimal buffer path isn't built; plan-time
+    tagging rejects these (aggregates.py unsupported_reasons) — fail fast
+    for direct API users too."""
+    for fn, _name in aggs:
+        for _kind, bdt in fn.update_ops():
+            if isinstance(bdt, t.DecimalType):
+                raise NotImplementedError(
+                    f"decimal aggregation buffer ({fn.name}) not yet "
+                    "supported on device")
 
 
 def _storage_zeros(dt: t.DataType, capacity: int):
@@ -95,6 +107,7 @@ class HashAggregate:
         self.key_names = list(key_names)
         self.aggs = list(aggs)
         self.conf = conf
+        check_agg_buffers_supported(self.aggs)
         # flatten buffers
         self.update_specs: List[G.AggSpec] = []
         self.merge_specs: List[G.AggSpec] = []
@@ -120,8 +133,12 @@ class HashAggregate:
 
     # ---- phases ----
 
-    def partial(self, db: DeviceBatch) -> DeviceBatch:
-        """One input batch -> (keys + buffer columns) partial result."""
+    def partial(self, db: DeviceBatch, live=None) -> DeviceBatch:
+        """One input batch -> (keys + buffer columns) partial result.
+
+        `live` (optional bool mask) lets an upstream filter fuse into the
+        aggregation: filtered rows simply never contribute — no compaction
+        (= no TPU row gather) between filter and agg."""
         key_batch = evaluate_projection(self.key_exprs, self.key_names, db,
                                         self.conf) if self.key_exprs else None
         agg_in = evaluate_projection(
@@ -129,14 +146,146 @@ class HashAggregate:
             [f"_in{i}" for i in range(len(self.input_exprs))], db, self.conf) \
             if self.input_exprs else None
         agg_cols = agg_in.columns if agg_in is not None else []
+        if live is None:
+            live = db.row_mask()
         if not self.key_exprs:
-            outs = _run_reduce(agg_cols, self.update_specs, db.num_rows,
-                               db.capacity)
+            outs = _run_reduce(agg_cols, self.update_specs, live, db.capacity)
             return self._reduce_outs_to_batch(outs)
         key_cols, out_keys, outs, n_groups = _run_groupby(
-            key_batch.columns, agg_cols, self.update_specs, db.num_rows,
-            db.capacity)
+            key_batch.columns, agg_cols, self.update_specs, live, db.capacity)
         return self._groupby_outs_to_batch(key_cols, out_keys, outs, n_groups)
+
+    def can_fuse_filter(self) -> bool:
+        """String group keys need host-side dictionary unification, which
+        can't live inside one traced program — everything else fuses."""
+        return not any(isinstance(e.dtype, t.StringType) for e in self.key_exprs)
+
+    def partial_fused(self, db: DeviceBatch, conds: Sequence[E.Expression],
+                      raw: bool = False):
+        """Filter + key/input projection + update groupby in ONE program.
+
+        The whole map-side of an aggregation (predicate, projections,
+        sort-segment reduce) is a single XLA program per row bucket: one
+        dispatch, full fusion, no intermediate HBM round-trips.  The
+        reference runs these as separate cuDF kernel launches
+        (GpuFilterExec -> projections -> Table.groupBy); on TPU the fused
+        form is both lower-latency and lets XLA share subexpressions."""
+        from .evaluator import (_JIT_CACHE, _batch_meta, _build_inputs,
+                                _jit_key, _num_rows_scalar, _prepare)
+        from ..ops.kernels import live_mask, valid_or_true
+        exprs_all = list(conds) + self.key_exprs + self.input_exprs
+        pctx, hostvals, aux = _prepare(exprs_all, db, self.conf)
+        spec_sig = tuple((s.kind, s.input_idx, str(s.dtype))
+                         for s in self.update_specs)
+        key = _jit_key(exprs_all, db, aux, self.conf,
+                       ("fpartial", spec_sig, len(conds), len(self.key_exprs)))
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+            capacity = db.capacity
+            node_slots = dict(pctx.node_slots)
+            conf = self.conf
+            conds_t = tuple(conds)
+            keys_t = tuple(self.key_exprs)
+            ins_t = tuple(self.input_exprs)
+            specs = list(self.update_specs)
+            meta = _batch_meta(db)
+
+            def run(col_data, col_valid, num_rows, aux_arrs):
+                inputs = _build_inputs(meta, col_data, col_valid)
+                ctx = E.EvalCtx(capacity, num_rows, inputs, aux_arrs,
+                                node_slots, conf)
+                live = live_mask(capacity, num_rows)
+                for c in conds_t:
+                    dv = c.eval_dev(ctx)
+                    k = dv.data.astype(bool)
+                    if dv.validity is not None:
+                        k = k & dv.validity
+                    live = live & k
+                agg_data, agg_valid = [], []
+                for e in ins_t:
+                    dv = e.eval_dev(ctx)
+                    agg_data.append(dv.data)
+                    agg_valid.append(valid_or_true(dv.validity, capacity))
+                if not keys_t:
+                    red = G.reduce_trace(specs, capacity)
+                    return (None,
+                            red(tuple(agg_data), tuple(agg_valid), live),
+                            None)
+                kds, kvs, kinfo = [], [], []
+                for e in keys_t:
+                    dv = e.eval_dev(ctx)
+                    kds.append(dv.data)
+                    kvs.append(valid_or_true(dv.validity, capacity))
+                    kinfo.append((e.dtype, True, str(dv.data.dtype)))
+                gb = G.groupby_trace(kinfo, specs, capacity, capacity)
+                return gb(tuple(kds), tuple(kvs), tuple(agg_data),
+                          tuple(agg_valid), live)
+
+            fn = jax.jit(run)
+            _JIT_CACHE[key] = fn
+
+        out_keys, outs, ng = fn(tuple(c.data for c in db.columns),
+                                tuple(c.validity for c in db.columns),
+                                _num_rows_scalar(db.num_rows), aux)
+        if not self.key_exprs:
+            return outs if raw else self._reduce_outs_to_batch(outs)
+        nconds = len(conds)
+        key_cols = []
+        for i, e in enumerate(self.key_exprs):
+            hv = hostvals[nconds + i]
+            key_cols.append(DeviceColumn(
+                jnp.zeros((0,)), jnp.zeros((0,), bool), e.dtype,
+                hv.dictionary))
+        return self._groupby_outs_to_batch(key_cols, out_keys, outs, int(ng))
+
+    def merge_raw(self, partial_outs: List[List]) -> List:
+        """Merge per-batch global-agg scalar outputs into final buffer
+        scalars — one tiny jit over stacked scalars, no 1-row batches."""
+        if len(partial_outs) == 1:
+            return partial_outs[0]
+        k = len(partial_outs)
+        sig = (k, tuple((s.kind, s.input_idx, str(s.dtype))
+                        for s in self.merge_specs))
+        fn = _REDUCE_CACHE.get(sig)
+        if fn is None:
+            red = G.reduce_trace(self.merge_specs, k)
+
+            def run(stacks, valids):
+                return red(stacks, valids, jnp.ones((k,), bool))
+
+            fn = jax.jit(run)
+            _REDUCE_CACHE[sig] = fn
+        stacks = tuple(jnp.stack([p[i][0] for p in partial_outs])
+                       for i in range(len(self.update_specs)))
+        valids = tuple(jnp.stack([p[i][1] for p in partial_outs])
+                       for i in range(len(self.update_specs)))
+        return list(fn(stacks, valids))
+
+    def final_host(self, outs) -> pa.Table:
+        """Finish a global aggregation on host: one D2H fetch of the buffer
+        scalars, then the result expressions run via their CPU kernels on a
+        1-row Arrow batch (cheaper than dispatching a device program for a
+        single row)."""
+        from ..columnar.host import dtype_to_arrow
+        fetched = jax.device_get([(d, v) for d, v in outs])
+        arrays = []
+        for (d, v), spec in zip(fetched, self.update_specs):
+            val = d.item() if bool(v) else None
+            arrays.append(pa.array([val], dtype_to_arrow(spec.dtype)))
+        names = self._buffer_names()
+        rb = pa.RecordBatch.from_arrays(arrays, names)
+        schema = t.StructType([t.StructField(n, s.dtype)
+                               for n, s in zip(names, self.update_specs)])
+        out_arrays, out_names = [], []
+        for (fn, name), (start, end) in zip(self.aggs, self.buffer_slices):
+            refs = [E.ColumnRef(f"_buf{j}").bind(schema)
+                    for j in range(start, end)]
+            expr = fn.evaluate(refs)
+            from ..plan.aggregates import _resolved
+            expr = _resolved(expr) if expr.dtype is None else expr
+            out_arrays.append(expr.eval_cpu(rb))
+            out_names.append(name)
+        return pa.Table.from_arrays(out_arrays, out_names)
 
     def merge(self, partials: List[DeviceBatch]) -> DeviceBatch:
         merged = concat_batches(partials, self.conf)
@@ -144,11 +293,11 @@ class HashAggregate:
         key_cols = merged.columns[:nkeys]
         buf_cols = merged.columns[nkeys:]
         if not self.key_exprs:
-            outs = _run_reduce(buf_cols, self.merge_specs, merged.num_rows,
+            outs = _run_reduce(buf_cols, self.merge_specs, merged.row_mask(),
                                merged.capacity)
             return self._reduce_outs_to_batch(outs)
         key_cols, out_keys, outs, n_groups = _run_groupby(
-            key_cols, buf_cols, self.merge_specs, merged.num_rows,
+            key_cols, buf_cols, self.merge_specs, merged.row_mask(),
             merged.capacity)
         return self._groupby_outs_to_batch(key_cols, out_keys, outs, n_groups)
 

@@ -1,0 +1,411 @@
+"""Physical plan nodes producing streams of device batches.
+
+The reference's operator contract is `GpuExec.internalDoExecuteColumnar():
+RDD[ColumnarBatch]` (GpuExec.scala:365) — each exec pulls an iterator of
+batches from its child and pushes transformed batches downstream.  The TPU
+analogue keeps the pull-iterator shape (it is what enables out-of-core
+execution) but each operator's device work is one cached jit program per
+row-bucket (exec/evaluator.py), not a sequence of library kernel launches.
+
+Nodes here are *physical*: expressions arrive already bound to the child's
+schema (plan/overrides.py does the tagging/conversion from a logical tree).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import pyarrow as pa
+
+from .. import types as t
+from ..config import TpuConf, DEFAULT_CONF
+from ..columnar.device import DeviceBatch, to_device, to_host, empty_device_batch
+from ..columnar.host import HostBatch, schema_to_struct
+from ..ops.batch_ops import concat_batches, shrink_to_rows
+from ..ops.filter import compact_batch
+from ..plan import expressions as E
+from ..plan.aggregates import AggregateFunction
+from .aggregate import HashAggregate
+from .evaluator import evaluate_projection
+
+
+@dataclasses.dataclass
+class ExecContext:
+    """Per-query execution state threaded through the plan."""
+    conf: TpuConf = DEFAULT_CONF
+    metrics: dict = dataclasses.field(default_factory=dict)
+
+    def bump(self, name: str, n: int = 1):
+        self.metrics[name] = self.metrics.get(name, 0) + n
+
+
+class PlanNode:
+    """Base physical operator. Children first, Spark-style."""
+
+    def __init__(self, *children: "PlanNode"):
+        self.children = list(children)
+
+    @property
+    def child(self) -> "PlanNode":
+        return self.children[0]
+
+    @property
+    def output_schema(self) -> t.StructType:
+        raise NotImplementedError
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.describe()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return self.name()
+
+    # -- helpers -----------------------------------------------------------
+    def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
+        """Run the plan and bring results back to host (GpuBringBackToHost)."""
+        ctx = ctx or ExecContext()
+        hbs = [to_host(db) for db in self.execute(ctx)
+               if int(db.num_rows) > 0]
+        schema = None
+        batches = []
+        for hb in hbs:
+            schema = schema or hb.rb.schema
+            batches.append(hb.rb)
+        if not batches:
+            from ..columnar.host import struct_to_schema
+            return pa.Table.from_batches([], struct_to_schema(self.output_schema))
+        return pa.Table.from_batches(batches, schema)
+
+
+class HostScanExec(PlanNode):
+    """Leaf: uploads host Arrow batches to device (HostColumnarToGpu role)."""
+
+    def __init__(self, batches: Sequence[HostBatch],
+                 schema: Optional[t.StructType] = None):
+        super().__init__()
+        self.batches = list(batches)
+        self._schema = schema or (self.batches[0].schema if self.batches
+                                  else t.StructType([]))
+
+    @classmethod
+    def from_table(cls, table: pa.Table, max_rows: Optional[int] = None
+                   ) -> "HostScanExec":
+        rbs = table.to_batches(max_chunksize=max_rows) if max_rows \
+            else table.combine_chunks().to_batches()
+        return cls([HostBatch(rb) for rb in rbs],
+                   schema_to_struct(table.schema))
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        for hb in self.batches:
+            ctx.bump("scanned_rows", hb.num_rows)
+            yield to_device(hb, ctx.conf)
+
+    def describe(self):
+        return f"HostScanExec[{len(self.batches)} batches]"
+
+
+class ProjectExec(PlanNode):
+    """GpuProjectExec: one fused XLA program per row bucket
+    (reference basicPhysicalOperators.scala:350)."""
+
+    def __init__(self, exprs: Sequence[E.Expression], names: Sequence[str],
+                 child: PlanNode):
+        super().__init__(child)
+        self.exprs = [e.bind(child.output_schema) for e in exprs]
+        self.names = list(names)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return t.StructType([t.StructField(n, e.dtype)
+                             for n, e in zip(self.names, self.exprs)])
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        for db in self.child.execute(ctx):
+            yield evaluate_projection(self.exprs, self.names, db, ctx.conf)
+
+    def describe(self):
+        return f"ProjectExec[{', '.join(self.names)}]"
+
+
+class FilterExec(PlanNode):
+    """GpuFilterExec: predicate eval fused into one program, then stable
+    mask compaction (ops/filter.py) instead of cuDF apply_boolean_mask."""
+
+    def __init__(self, condition: E.Expression, child: PlanNode):
+        super().__init__(child)
+        self.condition = condition.bind(child.output_schema)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from .evaluator import compute_predicate
+        for db in self.child.execute(ctx):
+            keep = compute_predicate(self.condition, db, ctx.conf)
+            # lazy row count: downstream device ops keep running sync-free
+            yield compact_batch(db, keep, ctx.conf)
+
+    def describe(self):
+        return f"FilterExec[{self.condition!r}]"
+
+
+class HashAggregateExec(PlanNode):
+    """GpuHashAggregateExec (GpuAggregateExec.scala:1711): streaming partial
+    aggregation per batch, concat+merge regroup, final projection."""
+
+    def __init__(self, key_exprs: Sequence[E.Expression],
+                 key_names: Sequence[str],
+                 aggs: Sequence[Tuple[AggregateFunction, str]],
+                 child: PlanNode):
+        super().__init__(child)
+        schema = child.output_schema
+        self.key_exprs = [e.bind(schema) for e in key_exprs]
+        self.key_names = list(key_names)
+        self.aggs = [(fn.bind(schema), name) for fn, name in aggs]
+        from .aggregate import check_agg_buffers_supported
+        check_agg_buffers_supported(self.aggs)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        fields = []
+        for n, e in zip(self.key_names, self.key_exprs):
+            fields.append(t.StructField(n, e.dtype))
+        for fn, n in self.aggs:
+            fields.append(t.StructField(n, fn.result_type))
+        return t.StructType(fields)
+
+    def _strip_filters(self, can_fuse: bool):
+        """Peel the chain of FilterExec children this aggregate can fuse;
+        returns (batch source node, conditions outermost-last)."""
+        source: PlanNode = self.child
+        conds: List[E.Expression] = []
+        if can_fuse:
+            while isinstance(source, FilterExec):
+                conds.append(source.condition)
+                source = source.child
+            conds.reverse()
+        return source, conds
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        agg = HashAggregate(self.key_exprs, self.key_names, self.aggs,
+                            ctx.conf)
+        # Fuse a chain of upstream filters into the map-side program: the
+        # predicates become the groupby's live-mask, so filter + projections
+        # + update aggregation run as ONE dispatch with no compaction
+        # (TPU row gathers cost far more than masked reduction lanes).
+        source, conds = self._strip_filters(agg.can_fuse_filter())
+        partials: List[DeviceBatch] = []
+        seen = False
+        for db in source.execute(ctx):
+            if isinstance(db.num_rows, int) and db.num_rows == 0:
+                continue
+            seen = True
+            partials.append(agg.partial_fused(db, conds)
+                            if agg.can_fuse_filter() else agg.partial(db))
+            # Bound the pending set: merge when the partials would overflow
+            # one target batch (the reference's tryMergeAggregatedBatches).
+            if len(partials) > 1 and \
+                    sum(int(p.num_rows) for p in partials) > ctx.conf.batch_size_rows:
+                partials = [agg.merge(partials)]
+        if not seen:
+            if self.key_exprs:
+                return  # grouped agg over empty input -> no rows
+            # global agg over empty input still emits one row (e.g. COUNT=0)
+            empty = empty_device_batch(self.child.output_schema, ctx.conf)
+            partials = [agg.partial(empty)]
+        merged = agg.merge(partials) if len(partials) > 1 else partials[0]
+        yield agg.final(merged)
+
+    def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
+        """Global (no-key) aggregations finish on host from raw buffer
+        scalars: N fused partial dispatches + at most one merge dispatch +
+        ONE D2H fetch — no 1-row device batches, no device final
+        projection."""
+        if self.key_exprs:
+            return super().collect(ctx)
+        ctx = ctx or ExecContext()
+        agg = HashAggregate(self.key_exprs, self.key_names, self.aggs,
+                            ctx.conf)
+        source, conds = self._strip_filters(agg.can_fuse_filter())
+        raw = []
+        for db in source.execute(ctx):
+            if isinstance(db.num_rows, int) and db.num_rows == 0:
+                continue
+            raw.append(agg.partial_fused(db, conds, raw=True))
+        if not raw:
+            empty = empty_device_batch(source.output_schema, ctx.conf)
+            raw.append(agg.partial_fused(empty, conds, raw=True))
+        return agg.final_host(agg.merge_raw(raw))
+
+    def describe(self):
+        return (f"HashAggregateExec[keys={self.key_names}, "
+                f"aggs={[n for _, n in self.aggs]}]")
+
+
+class LocalLimitExec(PlanNode):
+    """Per-stream limit (GpuLocalLimitExec, limit.scala)."""
+
+    def __init__(self, limit: int, child: PlanNode):
+        super().__init__(child)
+        self.limit = limit
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        remaining = self.limit
+        for db in self.child.execute(ctx):
+            if remaining <= 0:
+                return
+            n = int(db.num_rows)
+            if n <= remaining:
+                remaining -= n
+                yield db
+            else:
+                yield shrink_to_rows(_truncate(db, remaining), remaining,
+                                     ctx.conf)
+                return
+
+    def describe(self):
+        return f"{self.name()}[{self.limit}]"
+
+
+class GlobalLimitExec(LocalLimitExec):
+    """Same device semantics as local limit; the global cut happens after
+    the single-partition exchange inserted by the planner."""
+
+
+def _truncate(db: DeviceBatch, rows: int) -> DeviceBatch:
+    from ..columnar.device import DeviceColumn
+    live = jnp.arange(db.capacity, dtype=jnp.int32) < jnp.int32(rows)
+    cols = [DeviceColumn(c.data, c.validity & live, c.dtype, c.dictionary,
+                         c.data_hi) for c in db.columns]
+    return DeviceBatch(cols, rows, db.names)
+
+
+class UnionExec(PlanNode):
+    """GpuUnionExec: concatenation of children streams (schema-aligned)."""
+
+    def __init__(self, *children: PlanNode):
+        super().__init__(*children)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.children[0].output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        names = list(self.output_schema.names)
+        for c in self.children:
+            for db in c.execute(ctx):
+                yield DeviceBatch(db.columns, db.num_rows, names)
+
+
+class CoalesceBatchesExec(PlanNode):
+    """GpuCoalesceBatches (GpuCoalesceBatches.scala:697): concatenate small
+    batches until the target row goal so downstream programs run on full
+    buckets."""
+
+    def __init__(self, child: PlanNode, target_rows: Optional[int] = None,
+                 require_single: bool = False):
+        super().__init__(child)
+        self.target_rows = target_rows
+        self.require_single = require_single
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        target = self.target_rows or ctx.conf.batch_size_rows
+        pending: List[DeviceBatch] = []
+        rows = 0
+        for db in self.child.execute(ctx):
+            n = int(db.num_rows)   # coalesce sizes batches -> sync point
+            if n == 0:
+                continue
+            if not self.require_single and rows and rows + n > target:
+                yield concat_batches(pending, ctx.conf)
+                pending, rows = [], 0
+            pending.append(db)
+            rows += n
+        if pending:
+            yield concat_batches(pending, ctx.conf)
+
+    def describe(self):
+        goal = "RequireSingleBatch" if self.require_single \
+            else f"target={self.target_rows or 'conf'}"
+        return f"CoalesceBatchesExec[{goal}]"
+
+
+class RangeExec(PlanNode):
+    """GpuRangeExec (basicPhysicalOperators.scala:838): generates id ranges
+    directly on device with iota."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 name: str = "id", batch_rows: Optional[int] = None):
+        super().__init__()
+        assert step != 0
+        self.start, self.end, self.step = start, end, step
+        self.col_name = name
+        self.batch_rows = batch_rows
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return t.StructType([t.StructField(self.col_name, t.LongType())])
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from ..columnar.device import DeviceColumn, bucket_capacity
+        total = max(0, -(-(self.end - self.start) // self.step))
+        chunk = self.batch_rows or ctx.conf.batch_size_rows
+        emitted = 0
+        while emitted < total:
+            n = min(chunk, total - emitted)
+            cap = bucket_capacity(n, ctx.conf)
+            base = self.start + emitted * self.step
+            data = jnp.int64(base) + jnp.arange(cap, dtype=jnp.int64) * self.step
+            live = jnp.arange(cap, dtype=jnp.int32) < jnp.int32(n)
+            yield DeviceBatch(
+                [DeviceColumn(data, live, t.LongType())], n, [self.col_name])
+            emitted += n
+        if total == 0:
+            return
+
+    def describe(self):
+        return f"RangeExec[{self.start},{self.end},{self.step}]"
+
+
+class ExpandExec(PlanNode):
+    """GpuExpandExec (GpuExpandExec.scala:70): N projections per input batch
+    (rollup/cube/grouping sets lowering)."""
+
+    def __init__(self, projections: Sequence[Sequence[E.Expression]],
+                 names: Sequence[str], child: PlanNode):
+        super().__init__(child)
+        self.projections = [[e.bind(child.output_schema) for e in p]
+                            for p in projections]
+        self.names = list(names)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return t.StructType([t.StructField(n, e.dtype) for n, e in
+                             zip(self.names, self.projections[0])])
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        for db in self.child.execute(ctx):
+            for proj in self.projections:
+                yield evaluate_projection(proj, self.names, db, ctx.conf)

@@ -49,6 +49,9 @@ def concat_batches(batches: List[DeviceBatch],
     assert batches, "concat of zero batches"
     if len(batches) == 1:
         return batches[0]
+    # concat makes host-side layout decisions, so lazy counts sync here
+    batches = [DeviceBatch(b.columns, int(b.num_rows), b.names)
+               for b in batches]
     total = sum(b.num_rows for b in batches)
     cap = bucket_capacity(max(total, 1), conf)
     names = list(batches[0].names)

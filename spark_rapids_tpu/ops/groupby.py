@@ -59,6 +59,9 @@ def _null_first_key_lanes(data, valid, dt):
         valid_lane = None
     else:
         valid_lane = (~valid).astype(jnp.int8)   # nulls first among live rows
+        # canonicalize null rows' payload so they compare equal regardless of
+        # what the producing kernel left in the data lane
+        data = jnp.where(valid, data, jnp.zeros((), data.dtype))
     if dt is not None and isinstance(dt, t.DoubleType) and data.dtype == jnp.float64:
         # computed f64: order by value; NaN needs a consistent slot — push to
         # the top via isnan lane handled by caller. Grouping only needs
@@ -126,12 +129,16 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity):
     """Build the traced groupby fn for jit.
 
     key_lanes_info: list of (dtype, has_validity, lane_dtype_str) — static.
-    Returns fn(keys_data, keys_valid, agg_data, agg_valid, num_rows) ->
+    Returns fn(keys_data, keys_valid, agg_data, agg_valid, live) ->
       (perm_keys (data, valid) per key, agg outs (data, valid) per spec,
        num_groups scalar)
+
+    `live` is an arbitrary row mask, NOT a prefix count: a filter feeding an
+    aggregation passes its keep-mask directly, so filtered rows die inside
+    the (sorted) segment reduce and no gather/compaction ever runs — row
+    gathers are the expensive op on TPU, masked VPU work is nearly free.
     """
-    def run(keys, keys_valid, agg_data, agg_valid, num_rows):
-        live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+    def run(keys, keys_valid, agg_data, agg_valid, live):
         # --- 1. sort ---
         lanes = []
         for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, keys, keys_valid):
@@ -158,7 +165,9 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity):
         boundary = boundary | pad_start
 
         seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-        num_groups = jnp.where(num_rows > 0, seg_ids[jnp.maximum(num_rows - 1, 0)] + 1, 0)
+        count = jnp.sum(live, dtype=jnp.int32)
+        num_groups = jnp.where(count > 0,
+                               seg_ids[jnp.maximum(count - 1, 0)] + 1, 0)
 
         # --- 3. group keys: first row of each segment ---
         big = jnp.int32(capacity)
@@ -256,9 +265,10 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity):
 
 
 def reduce_trace(agg_specs, capacity):
-    """No-key aggregation (single output row at index 0)."""
-    def run(agg_data, agg_valid, num_rows):
-        live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+    """No-key aggregation (single output row at index 0).
+
+    `live` is an arbitrary row mask (see groupby_trace)."""
+    def run(agg_data, agg_valid, live):
         outs = []
         for spec in agg_specs:
             d = agg_data[spec.input_idx] if spec.input_idx >= 0 else None
