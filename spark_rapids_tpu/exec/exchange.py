@@ -1,0 +1,164 @@
+"""Exchange execs: shuffle write/read + broadcast.
+
+Reference: GpuShuffleExchangeExecBase.scala:167 (device partition split ->
+serialize -> shuffle write), GpuShuffleCoalesceExec.scala:43 (reduce side:
+concat host payloads to target batch size, ONE upload), and
+GpuBroadcastExchangeExec.scala:352.
+
+Single-process realization: ShuffleExchangeExec materializes the child
+through the in-process ShuffleManager keyed by partition; downstream
+ShuffleReadExec streams any subset of partitions.  The two halves are
+separate plan nodes exactly so a runtime scheduler (runtime/) can run map
+and reduce stages as independent task sets — the same stage split Spark
+performs at every exchange.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, to_device, to_host
+from ..columnar.host import HostBatch, struct_to_schema
+from ..shuffle.manager import ShuffleManager, get_shuffle_manager
+from ..shuffle.partition import Partitioning, SinglePartitioning
+from .plan import ExecContext, PlanNode
+
+
+class ShuffleExchangeExec(PlanNode):
+    """Map side: partition every child batch and write to the shuffle
+    store.  `materialize(ctx)` runs the whole map stage; execute() yields
+    the read-back stream of all partitions (for single-process plans that
+    consume the exchange inline)."""
+
+    def __init__(self, partitioning: Partitioning, child: PlanNode):
+        super().__init__(child)
+        self.partitioning = partitioning
+        if hasattr(partitioning, "bind"):
+            partitioning.bind(child.output_schema)
+        self.shuffle_id: Optional[int] = None
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def materialize(self, ctx: ExecContext) -> int:
+        """Run the map stage; returns the shuffle id."""
+        if self.shuffle_id is not None:
+            return self.shuffle_id
+        mgr = get_shuffle_manager()
+        sid = mgr.new_shuffle()
+        n = self.partitioning.num_partitions
+        for db in self.child.execute(ctx):
+            if int(db.num_rows) == 0:
+                continue
+            ids = self.partitioning.partition_ids(db, ctx.conf)
+            hb = to_host(db)
+            mgr.write_batch(sid, hb, ids, n)
+            ctx.bump("shuffle_rows_written", int(db.num_rows))
+        self.shuffle_id = sid
+        return sid
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        sid = self.materialize(ctx)
+        reader = ShuffleReadExec(self, list(range(
+            self.partitioning.num_partitions)))
+        reader.shuffle_id = sid
+        yield from reader.execute(ctx)
+
+    def describe(self):
+        return (f"ShuffleExchangeExec[{type(self.partitioning).__name__}"
+                f"({self.partitioning.num_partitions})]")
+
+
+class ShuffleReadExec(PlanNode):
+    """Reduce side (GpuShuffleCoalesceExec role): read partition payloads,
+    concatenate on HOST up to the batch row target, upload once per
+    coalesced group."""
+
+    def __init__(self, exchange: ShuffleExchangeExec,
+                 partitions: Sequence[int]):
+        super().__init__(exchange)
+        self.exchange = exchange
+        self.partitions = list(partitions)
+        self.shuffle_id: Optional[int] = None
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.exchange.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        sid = self.shuffle_id if self.shuffle_id is not None \
+            else self.exchange.materialize(ctx)
+        mgr = get_shuffle_manager()
+        target = ctx.conf.batch_size_rows
+        pending: List[pa.RecordBatch] = []
+        rows = 0
+        for p in self.partitions:
+            for rb in mgr.read_partition(sid, p):
+                if rb.num_rows == 0:
+                    continue
+                if rows and rows + rb.num_rows > target:
+                    yield self._upload(pending, ctx)
+                    pending, rows = [], 0
+                pending.append(rb)
+                rows += rb.num_rows
+        if pending:
+            yield self._upload(pending, ctx)
+
+    def _upload(self, rbs: List[pa.RecordBatch], ctx) -> DeviceBatch:
+        tbl = pa.Table.from_batches(rbs).combine_chunks()
+        hb = HostBatch(tbl.to_batches()[0] if tbl.num_rows else
+                       pa.RecordBatch.from_pydict(
+                           {n: [] for n in tbl.schema.names},
+                           schema=tbl.schema))
+        ctx.bump("shuffle_rows_read", hb.num_rows)
+        return to_device(hb, ctx.conf)
+
+    def describe(self):
+        return f"ShuffleReadExec[{len(self.partitions)} parts]"
+
+
+class PartitionReadExec(PlanNode):
+    """Reduce-task view of ONE partition of an exchange — the unit the
+    runtime scheduler assigns to a task."""
+
+    def __init__(self, exchange: ShuffleExchangeExec, partition: int):
+        super().__init__(exchange)
+        self.exchange = exchange
+        self.partition = partition
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.exchange.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        reader = ShuffleReadExec(self.exchange, [self.partition])
+        yield from reader.execute(ctx)
+
+
+class BroadcastExchangeExec(PlanNode):
+    """GpuBroadcastExchangeExec analogue: materializes the child once and
+    replays the host copy to every consumer (single-process: a cache; the
+    mesh path broadcasts via replicated sharding in parallel/mesh.py)."""
+
+    def __init__(self, child: PlanNode):
+        super().__init__(child)
+        self._cached: Optional[pa.Table] = None
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        if self._cached is None:
+            hbs = [to_host(db).rb for db in self.child.execute(ctx)
+                   if int(db.num_rows) > 0]
+            schema = struct_to_schema(self.output_schema)
+            self._cached = pa.Table.from_batches(hbs, schema) if hbs \
+                else pa.Table.from_batches([], schema)
+        tbl = self._cached.combine_chunks()
+        for rb in tbl.to_batches():
+            yield to_device(HostBatch(rb), ctx.conf)

@@ -1,0 +1,224 @@
+"""Join exec nodes over the sorted-hash probe kernels (ops/join.py).
+
+Reference execs: GpuShuffledHashJoinExec (GpuShuffledHashJoinExec.scala:107),
+GpuBroadcastHashJoinExecBase, GpuHashJoin gather machinery
+(org/.../execution/GpuHashJoin.scala:104).  Output schema is
+left columns ++ right columns (Spark layout); the build side is fully
+materialized (concat of the build stream), probes stream batch-by-batch —
+the same shape as the reference's build-then-stream iterator.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import pyarrow as pa
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
+from ..ops import join as J
+from ..ops.batch_ops import concat_batches, unify_dictionaries, \
+    remap_string_column
+from ..ops.filter import compact_batch, gather_batch
+from ..plan import expressions as E
+from .evaluator import evaluate_projection
+from .plan import ExecContext, PlanNode
+
+
+def _null_columns(schema: t.StructType, capacity: int) -> List[DeviceColumn]:
+    cols = []
+    for f in schema.fields:
+        dt = f.data_type
+        np_dt = jnp.int64 if isinstance(dt, t.DoubleType) \
+            else t.physical_np_dtype(dt)
+        cols.append(DeviceColumn(jnp.zeros((capacity,), np_dt),
+                                 jnp.zeros((capacity,), bool), dt))
+    return cols
+
+
+def _unify_string_keys(a: DeviceColumn, b: DeviceColumn
+                       ) -> Tuple[DeviceColumn, DeviceColumn]:
+    """Remap both sides' codes into one union dictionary so code equality
+    == string equality."""
+    unified, (ra, rb) = None, (None, None)
+    unified, remaps = unify_dictionaries([a.dictionary, b.dictionary])
+    return (remap_string_column(a, remaps[0], unified),
+            remap_string_column(b, remaps[1], unified))
+
+
+class HashJoinExec(PlanNode):
+    """Equi-join: inner / left|right|full outer / left semi / left anti.
+
+    The RIGHT side is the build side (callers swap inputs to choose, as the
+    reference's GpuJoinUtils.getGpuBuildSide does)."""
+
+    def __init__(self, join_type: str, left_keys: Sequence[E.Expression],
+                 right_keys: Sequence[E.Expression],
+                 left: PlanNode, right: PlanNode):
+        super().__init__(left, right)
+        self.join_type = join_type
+        self.left_keys = [e.bind(left.output_schema) for e in left_keys]
+        self.right_keys = [e.bind(right.output_schema) for e in right_keys]
+        assert len(self.left_keys) == len(self.right_keys)
+        if join_type not in (INNER_TYPES := {J.INNER, J.LEFT_OUTER,
+                                             J.RIGHT_OUTER, J.FULL_OUTER,
+                                             J.LEFT_SEMI, J.LEFT_ANTI}):
+            raise ValueError(f"unsupported join type {join_type}")
+
+    @property
+    def left(self) -> PlanNode:
+        return self.children[0]
+
+    @property
+    def right(self) -> PlanNode:
+        return self.children[1]
+
+    @property
+    def output_schema(self) -> t.StructType:
+        lf = list(self.left.output_schema.fields)
+        if self.join_type in (J.LEFT_SEMI, J.LEFT_ANTI):
+            return t.StructType(lf)
+        rf = list(self.right.output_schema.fields)
+        return t.StructType(lf + rf)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _key_cols(self, db: DeviceBatch, exprs, ctx) -> List[DeviceColumn]:
+        kb = evaluate_projection(exprs, [f"_k{i}" for i in range(len(exprs))],
+                                 db, ctx.conf)
+        return list(kb.columns)
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        # ---- build (right side), fully materialized ----
+        right_batches = [db for db in self.right.execute(ctx)
+                         if int(db.num_rows) > 0]
+        if not right_batches:
+            build_batch = None
+        else:
+            build_batch = concat_batches(right_batches, ctx.conf)
+
+        if build_batch is None:
+            yield from self._empty_build_output(ctx)
+            return
+
+        build_keys = self._key_cols(build_batch, self.right_keys, ctx)
+        out_names = list(self.output_schema.names)
+        emit_right = self.join_type not in (J.LEFT_SEMI, J.LEFT_ANTI)
+
+        build_matched_acc = jnp.zeros((build_batch.capacity,), bool)
+
+        for pb in self.left.execute(ctx):
+            if int(pb.num_rows) == 0:
+                continue
+            probe_keys = self._key_cols(pb, self.left_keys, ctx)
+            # unify string dictionaries pairwise (per probe batch)
+            bk = list(build_keys)
+            for i, (b, p) in enumerate(zip(bk, probe_keys)):
+                if isinstance(b.dtype, t.StringType):
+                    bk[i], probe_keys[i] = _unify_string_keys(b, p)
+            build = J.BuildTable(build_batch, bk)
+            probe_lanes = [J.canonical_lane(c) for c in probe_keys]
+            probe_valid = pb.row_mask()
+            for c in probe_keys:
+                probe_valid = probe_valid & c.validity
+
+            lo, counts, cum, total = J.probe_counts(build, probe_lanes,
+                                                    probe_valid)
+            if self.join_type in (J.LEFT_SEMI, J.LEFT_ANTI):
+                if total == 0:
+                    matched = jnp.zeros((pb.capacity,), bool)
+                else:
+                    out_cap = bucket_capacity(total, ctx.conf)
+                    _, _, _, matched, _ = J.expand_pairs(
+                        build, probe_lanes, probe_valid, lo, cum, out_cap)
+                keep = matched if self.join_type == J.LEFT_SEMI \
+                    else pb.row_mask() & ~matched
+                out = compact_batch(pb, keep, ctx.conf)
+                yield DeviceBatch(out.columns, out.num_rows, out_names)
+                continue
+
+            if total > 0:
+                out_cap = bucket_capacity(total, ctx.conf)
+                probe_idx, build_idx, ok, probe_matched, build_matched = \
+                    J.expand_pairs(build, probe_lanes, probe_valid, lo, cum,
+                                   out_cap)
+                build_matched_acc = build_matched_acc | build_matched
+                lg = gather_batch(pb, probe_idx, total)
+                rg = gather_batch(build_batch, build_idx, total)
+                pairs = DeviceBatch(lg.columns + rg.columns, total, out_names)
+                pairs = compact_batch(pairs, ok, ctx.conf)
+                yield pairs
+            else:
+                probe_matched = jnp.zeros((pb.capacity,), bool)
+
+            if self.join_type in (J.LEFT_OUTER, J.FULL_OUTER):
+                unmatched = pb.row_mask() & ~probe_matched
+                left_cols = list(pb.columns)
+                right_nulls = _null_columns(self.right.output_schema,
+                                            pb.capacity)
+                padded = DeviceBatch(left_cols + right_nulls, pb.num_rows,
+                                     out_names)
+                yield compact_batch(padded, unmatched, ctx.conf)
+
+        if self.join_type in (J.RIGHT_OUTER, J.FULL_OUTER):
+            unmatched = build_batch.row_mask() & ~build_matched_acc
+            left_nulls = _null_columns(self.left.output_schema,
+                                       build_batch.capacity)
+            padded = DeviceBatch(left_nulls + list(build_batch.columns),
+                                 build_batch.num_rows, out_names)
+            yield compact_batch(padded, unmatched, ctx.conf)
+
+    def _empty_build_output(self, ctx) -> Iterator[DeviceBatch]:
+        """Empty build side: inner/semi/right produce nothing; left outer
+        and anti pass probe rows through (right side null)."""
+        if self.join_type in (J.INNER, J.LEFT_SEMI, J.RIGHT_OUTER):
+            return
+        out_names = list(self.output_schema.names)
+        for pb in self.left.execute(ctx):
+            if int(pb.num_rows) == 0:
+                continue
+            if self.join_type == J.LEFT_ANTI:
+                yield DeviceBatch(pb.columns, pb.num_rows, out_names)
+            else:   # left/full outer
+                right_nulls = _null_columns(self.right.output_schema,
+                                            pb.capacity)
+                yield DeviceBatch(list(pb.columns) + right_nulls,
+                                  pb.num_rows, out_names)
+
+    def describe(self):
+        return (f"HashJoinExec[{self.join_type}, "
+                f"keys={len(self.left_keys)}]")
+
+
+class CrossJoinExec(PlanNode):
+    """GpuCartesianProductExec analogue: every (probe, build) pair."""
+
+    def __init__(self, left: PlanNode, right: PlanNode):
+        super().__init__(left, right)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return t.StructType(list(self.children[0].output_schema.fields) +
+                            list(self.children[1].output_schema.fields))
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        right_batches = [db for db in self.children[1].execute(ctx)
+                         if int(db.num_rows) > 0]
+        if not right_batches:
+            return
+        build = concat_batches(right_batches, ctx.conf)
+        nb = int(build.num_rows)
+        out_names = list(self.output_schema.names)
+        for pb in self.children[0].execute(ctx):
+            npr = int(pb.num_rows)
+            if npr == 0:
+                continue
+            total = npr * nb
+            out_cap = bucket_capacity(total, ctx.conf)
+            i = jnp.arange(out_cap, dtype=jnp.int32)
+            probe_idx = i // nb
+            build_idx = i % nb
+            lg = gather_batch(pb, probe_idx, total)
+            rg = gather_batch(build, build_idx, total)
+            yield DeviceBatch(lg.columns + rg.columns, total, out_names)

@@ -352,6 +352,80 @@ class CoalesceBatchesExec(PlanNode):
         return f"CoalesceBatchesExec[{goal}]"
 
 
+class SortExec(PlanNode):
+    """GpuSortExec (GpuSortExec.scala:86): sorts by SortOrder keys.
+
+    global_sort concatenates the input stream (the single-partition case or
+    post-range-exchange per-partition totals); local sort orders each batch
+    independently (enough for sort-merge structures and windows).  The
+    out-of-core merge path of the reference (GpuOutOfCoreSortIterator:281)
+    maps to sorting coalesced sub-runs and merging via concat+resort —
+    TPU sort is one fused lexsort, so resorting merged runs is cheaper than
+    an N-way merge with its data-dependent control flow."""
+
+    def __init__(self, keys, child: PlanNode, global_sort: bool = True):
+        from ..ops.sort import SortKey
+        super().__init__(child)
+        self.keys = [k if isinstance(k, SortKey) else SortKey(*k)
+                     for k in keys]
+        self.global_sort = global_sort
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from ..ops.sort import sort_batch
+        if not self.global_sort:
+            for db in self.child.execute(ctx):
+                yield sort_batch(db, self.keys, ctx.conf)
+            return
+        batches = [db for db in self.child.execute(ctx)
+                   if int(db.num_rows) > 0]
+        if not batches:
+            return
+        merged = concat_batches(batches, ctx.conf)
+        yield sort_batch(merged, self.keys, ctx.conf)
+
+    def describe(self):
+        scope = "global" if self.global_sort else "local"
+        return f"SortExec[{scope}, {self.keys}]"
+
+
+class TopNExec(PlanNode):
+    """GpuTopN (limit.scala): sort + limit without materializing the full
+    sorted output — each batch keeps only its top-N prefix, pending rows
+    are re-sorted together and cut once more at the end."""
+
+    def __init__(self, limit: int, keys, child: PlanNode):
+        from ..ops.sort import SortKey
+        super().__init__(child)
+        self.limit = limit
+        self.keys = [k if isinstance(k, SortKey) else SortKey(*k)
+                     for k in keys]
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from ..ops.sort import sort_batch
+        pending: Optional[DeviceBatch] = None
+        for db in self.child.execute(ctx):
+            if int(db.num_rows) == 0:
+                continue
+            batch = db if pending is None \
+                else concat_batches([pending, db], ctx.conf)
+            s = sort_batch(batch, self.keys, ctx.conf)
+            n = min(self.limit, int(s.num_rows))
+            pending = shrink_to_rows(_truncate(s, n), n, ctx.conf)
+        if pending is not None:
+            yield pending
+
+    def describe(self):
+        return f"TopNExec[{self.limit}, {self.keys}]"
+
+
 class RangeExec(PlanNode):
     """GpuRangeExec (basicPhysicalOperators.scala:838): generates id ranges
     directly on device with iota."""

@@ -1,0 +1,207 @@
+"""Equi-join kernels: sorted-hash probe on canonical key lanes.
+
+Reference: GpuShuffledHashJoinExec / GpuHashJoin (GpuHashJoin.scala:104)
+builds a cuDF hash table and gathers via GatherMaps.  Hash tables are a
+poor fit for the MXU/VPU (serial probing, dynamic shapes), so the
+TPU-native join is sort-based with static shapes end to end:
+
+  1. every key column maps to a *canonical int64 lane* where Spark join
+     equality == integer equality (NaN canonicalized to one bit pattern,
+     -0.0 -> +0.0, strings -> codes in a dictionary unified across both
+     sides, narrow ints sign-extended);
+  2. multi-key rows fold their lanes into a 64-bit mixed hash; the build
+     side is sorted by it once (single key: the lane itself, exact);
+  3. probes binary-search the sorted lane (`searchsorted`) for candidate
+     ranges — O(log n) vectorized, no data-dependent loops;
+  4. candidate pairs expand into a static output bucket and are *verified*
+     lane-by-lane, so hash collisions cannot produce wrong results, they
+     only cost a masked-out row;
+  5. outer/semi/anti variants derive from verified-match flags via
+     segment/scatter max — never from the (overcounted) candidate ranges.
+
+One host sync per probe batch fetches the candidate-pair count (the
+reference syncs identically to size its gather maps).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
+from ..config import TpuConf, DEFAULT_CONF
+from .kernels import compute_view
+
+INNER = "inner"
+LEFT_OUTER = "left_outer"
+RIGHT_OUTER = "right_outer"
+FULL_OUTER = "full_outer"
+LEFT_SEMI = "left_semi"
+LEFT_ANTI = "left_anti"
+CROSS = "cross"
+
+_GOLDEN = 0x9E3779B97F4A7C15
+
+
+def _mix64(x: jax.Array) -> jax.Array:
+    """splitmix64 finalizer over uint64 lanes."""
+    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> 31)
+
+
+def canonical_lane(col: DeviceColumn) -> jax.Array:
+    """int64 lane with Spark join-equality semantics (see module doc).
+    Strings must already carry a side-unified dictionary."""
+    dt = col.dtype
+    data = col.data
+    if isinstance(dt, t.StringType):
+        return data.astype(jnp.int64)
+    if isinstance(dt, t.DoubleType):
+        cv = compute_view(data, dt)
+        if cv.dtype == jnp.float64:
+            # computed lane: no f64->bits on TPU; canonicalize by VALUE.
+            # Collisions across distinct doubles impossible; NaN/-0 fixed up
+            canon = jnp.where(jnp.isnan(cv), jnp.float64(np.nan), cv)
+            canon = jnp.where(canon == 0.0, jnp.float64(0.0), canon)
+            # order-preserving int mapping not needed (equality only):
+            # use the f32x2 split trick via two mixes of hi/lo halves
+            hi = canon.astype(jnp.float32).astype(jnp.float64)
+            lo = (canon - hi).astype(jnp.float32)
+            bits = (jax.lax.bitcast_convert_type(hi.astype(jnp.float32),
+                                                 jnp.int32).astype(jnp.int64)
+                    << 32) | jax.lax.bitcast_convert_type(
+                        lo, jnp.int32).astype(jnp.int64) & 0xFFFFFFFF
+            return bits
+        # storage bits: canonicalize NaN (any payload) and -0.0
+        f = jax.lax.bitcast_convert_type(data, jnp.float64)
+        isnan = jnp.isnan(f)
+        canon_nan = jnp.int64(0x7FF8000000000000)
+        bits = jnp.where(isnan, canon_nan, data)
+        neg_zero = jnp.int64(np.int64(np.uint64(0x8000000000000000)))
+        return jnp.where(bits == neg_zero, jnp.int64(0), bits)
+    if isinstance(dt, t.FloatType):
+        isnan = jnp.isnan(data)
+        canon = jnp.where(isnan, jnp.float32(np.nan), data)
+        canon = jnp.where(canon == 0.0, jnp.float32(0.0), canon)
+        return jax.lax.bitcast_convert_type(canon, jnp.int32).astype(jnp.int64)
+    if isinstance(dt, t.DecimalType) and dt.is_wide:
+        raise NotImplementedError("wide decimal join keys")
+    return data.astype(jnp.int64)
+
+
+def composite_hash(lanes: Sequence[jax.Array]) -> jax.Array:
+    """Fold canonical lanes into one uint64 hash lane (single lane: the
+    lane itself -> exact ranges, zero collisions)."""
+    if len(lanes) == 1:
+        # any order-consistent injective transform works; searchsorted only
+        # needs build and probe to agree
+        return lanes[0].astype(jnp.uint64)
+    h = jnp.zeros(lanes[0].shape, jnp.uint64)
+    for i, lane in enumerate(lanes):
+        u = lane.astype(jnp.uint64)
+        h = _mix64(h ^ _mix64(u + jnp.uint64(_GOLDEN * (i + 1) & (2**64 - 1))))
+    return h
+
+
+class BuildTable:
+    """Sorted build side of a join (the hash-table analogue)."""
+
+    def __init__(self, batch: DeviceBatch, key_cols: Sequence[DeviceColumn]):
+        self.batch = batch
+        lanes = [canonical_lane(c) for c in key_cols]
+        valid = batch.row_mask()
+        for c in key_cols:
+            valid = valid & c.validity      # null keys never match
+        h = composite_hash(lanes)
+        # dead/null-key rows get MAX and liveness-primary lexsort, so the
+        # array is globally non-decreasing (searchsorted-safe) and the
+        # searchable region is exactly [0, valid_count)
+        sort_h = jnp.where(valid, h, jnp.uint64(2**64 - 1))
+        perm = jnp.lexsort([sort_h, (~valid).astype(jnp.int8)])
+        self.perm = perm
+        self.sorted_hash = jnp.take(sort_h, perm)
+        self.valid_count = jnp.sum(valid, dtype=jnp.int32)
+        self.lanes = lanes
+        self.key_valid = valid
+
+    @property
+    def capacity(self) -> int:
+        return self.batch.capacity
+
+
+_PROBE_CACHE = {}
+
+
+def probe_counts(build: BuildTable, probe_lanes: List[jax.Array],
+                 probe_valid: jax.Array):
+    """-> (lo, hi, counts, total) ; total is a host int (one sync)."""
+    sig = ("probe_counts", build.capacity, probe_valid.shape[0],
+           len(probe_lanes))
+    fn = _PROBE_CACHE.get(sig)
+    if fn is None:
+        def run(sorted_hash, valid_count, lanes, pvalid):
+            h = composite_hash(lanes)
+            # restrict the search to the valid prefix
+            lo = jnp.searchsorted(sorted_hash, h, side="left")
+            hi = jnp.searchsorted(sorted_hash, h, side="right")
+            lo = jnp.minimum(lo, valid_count)
+            hi = jnp.minimum(hi, valid_count)
+            counts = jnp.where(pvalid, hi - lo, 0).astype(jnp.int32)
+            cum = jnp.cumsum(counts)
+            return lo.astype(jnp.int32), counts, cum
+        fn = jax.jit(run)
+        _PROBE_CACHE[sig] = fn
+    lo, counts, cum = fn(build.sorted_hash, build.valid_count,
+                         tuple(probe_lanes), probe_valid)
+    total = int(cum[-1]) if cum.shape[0] else 0
+    return lo, counts, cum, total
+
+
+def expand_pairs(build: BuildTable, probe_lanes: List[jax.Array],
+                 probe_valid: jax.Array, lo, cum, out_cap: int):
+    """-> (probe_idx, build_idx, verified, probe_matched, build_matched)
+
+    probe_idx/build_idx: (out_cap,) gather indices for candidate pairs;
+    verified: lane-equality check per pair; probe_matched: per probe row;
+    build_matched: per build row (for right/full outer)."""
+    sig = ("expand", build.capacity, probe_valid.shape[0], out_cap,
+           len(probe_lanes))
+    fn = _PROBE_CACHE.get(sig)
+    if fn is None:
+        pcap = probe_valid.shape[0]
+        bcap = build.capacity
+
+        def run(perm, b_lanes, b_key_valid, p_lanes, p_valid, lo_, cum_,
+                total):
+            i = jnp.arange(out_cap, dtype=jnp.int32)
+            pair_live = i < total
+            probe_idx = jnp.searchsorted(cum_, i, side="right"
+                                         ).astype(jnp.int32)
+            probe_idx = jnp.minimum(probe_idx, pcap - 1)
+            base = jnp.where(probe_idx > 0,
+                             jnp.take(cum_, jnp.maximum(probe_idx - 1, 0)), 0)
+            off = i - base.astype(jnp.int32)
+            pos = jnp.take(lo_, probe_idx) + off
+            pos = jnp.clip(pos, 0, bcap - 1)
+            build_idx = jnp.take(perm, pos)
+            # verify true key equality (kills hash collisions)
+            ok = pair_live
+            for bl, pl in zip(b_lanes, p_lanes):
+                ok = ok & (jnp.take(bl, build_idx) ==
+                           jnp.take(pl, probe_idx))
+            ok = ok & jnp.take(p_valid, probe_idx) & \
+                jnp.take(b_key_valid, build_idx)
+            probe_matched = jax.ops.segment_max(
+                ok.astype(jnp.int32), probe_idx, num_segments=pcap) > 0
+            build_matched = jax.ops.segment_max(
+                ok.astype(jnp.int32), build_idx, num_segments=bcap) > 0
+            return probe_idx, build_idx, ok, probe_matched, build_matched
+        fn = jax.jit(run, static_argnames=())
+        _PROBE_CACHE[sig] = fn
+    total = jnp.int32(min(int(cum[-1]) if cum.shape[0] else 0, out_cap))
+    return fn(build.perm, tuple(build.lanes), build.key_valid,
+              tuple(probe_lanes), probe_valid, lo, cum, total)

@@ -1,0 +1,164 @@
+"""Multi-key sort kernels (the cuDF Table.orderBy analogue).
+
+Reference: GpuSortExec / GpuSortOrder (GpuSortExec.scala) sorts via cuDF's
+radix/merge sort with per-key ascending/descending + null ordering.
+
+TPU-first realization: every key column is mapped to an *order lane* — an
+integer (or float) lane whose ascending order equals the requested logical
+order — and one `jnp.lexsort` produces the permutation:
+
+  * ints/dates/timestamps/bools: the lane is the value itself (descending =
+    bitwise negation on the unsigned view, exact for all values incl. MIN).
+  * DOUBLE (int64-bits storage): IEEE-754 total-order bit trick
+    (groupby._bits_total_order) makes NaN sort above +inf, matching Spark.
+  * strings: dictionary codes are unordered, so the host computes each
+    dictionary's rank permutation (tiny) and the lane is `ranks[code]`.
+  * nulls-first/last: an int8 null lane ordered before its value lane.
+  * padding rows always sink to the end (liveness is the primary lane).
+
+The permutation gather is the expensive part on TPU; sort is only used
+where the plan truly needs order (SortExec, sort-merge structures, window
+partitioning) — filters and aggregations never pay it (see groupby_trace).
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, DeviceColumn
+from ..config import TpuConf, DEFAULT_CONF
+from .groupby import _bits_total_order
+from .kernels import compute_view
+
+
+class SortKey(NamedTuple):
+    """Ordering spec for one key column (Spark SortOrder analogue)."""
+    col_index: int
+    ascending: bool = True
+    nulls_first: bool = True     # Spark default: NULLS FIRST for ASC
+
+
+def dictionary_ranks(dictionary: Optional[pa.Array]) -> np.ndarray:
+    """rank lane table: ranks[code] = position of the code's string in the
+    sorted dictionary (unicode code point order, Spark's string order)."""
+    if dictionary is None or len(dictionary) == 0:
+        return np.zeros(1, np.int32)
+    order = pc.sort_indices(dictionary).to_numpy(zero_copy_only=False)
+    ranks = np.empty(len(dictionary), np.int32)
+    ranks[order] = np.arange(len(dictionary), dtype=np.int32)
+    return ranks
+
+
+def _to_unsigned_comparable(lane: jax.Array) -> jax.Array:
+    """Int lane -> unsigned lane with the same order (so descending can be
+    exact bitwise negation, incl. at the type's MIN value)."""
+    if lane.dtype == jnp.bool_:
+        return lane.astype(jnp.uint8)
+    w = np.dtype(lane.dtype).itemsize
+    ubits = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[w]
+    if not np.issubdtype(np.dtype(lane.dtype), np.signedinteger):
+        return lane.astype(ubits)
+    sign = 1 << (8 * w - 1)
+    return lane.astype(ubits) ^ jnp.asarray(sign, ubits)
+
+
+def order_lanes(col: DeviceColumn, asc: bool, nulls_first: bool,
+                rank_table: Optional[jax.Array] = None) -> List[jax.Array]:
+    """[null lane, value lane] both ascending-comparable for the requested
+    order."""
+    dt = col.dtype
+    data = col.data
+    if isinstance(dt, t.StringType):
+        assert rank_table is not None
+        lane = rank_table[jnp.clip(data, 0, rank_table.shape[0] - 1)]
+        lane = _to_unsigned_comparable(lane)
+    elif isinstance(dt, t.DoubleType):
+        cv = compute_view(data, dt)
+        if cv.dtype == jnp.float64:
+            # computed f64 lane: total-order via bit tricks on the bitcast
+            # is unavailable (no f64->i64 bitcast on TPU); order by value
+            # with NaN pushed to the top explicitly
+            isnan = jnp.isnan(cv)
+            lane = jnp.where(isnan, jnp.float64(np.inf), cv)
+            nan_lane = isnan.astype(jnp.uint8)
+            lanes = [nan_lane, lane]
+            if not asc:
+                lanes = [1 - nan_lane, -lane]
+            null = _null_lane(col.validity, nulls_first)
+            return [null] + lanes
+        lane = _to_unsigned_comparable(_bits_total_order(data))
+    elif isinstance(dt, t.FloatType):
+        isnan = jnp.isnan(data)
+        lane = jnp.where(isnan, jnp.float32(np.inf), data)
+        nan_lane = isnan.astype(jnp.uint8)
+        lanes = [nan_lane, lane] if asc else [1 - nan_lane, -lane]
+        return [_null_lane(col.validity, nulls_first)] + lanes
+    else:
+        lane = _to_unsigned_comparable(data)
+    if not asc:
+        lane = ~lane
+    return [_null_lane(col.validity, nulls_first), lane]
+
+
+def _null_lane(validity: jax.Array, nulls_first: bool) -> jax.Array:
+    # ascending-comparable: smaller sorts earlier
+    return jnp.where(validity, jnp.uint8(1 if nulls_first else 0),
+                     jnp.uint8(0 if nulls_first else 1))
+
+
+_SORT_CACHE = {}
+
+
+def sort_permutation(db: DeviceBatch, keys: Sequence[SortKey]) -> jax.Array:
+    """Permutation putting live rows in key order, padding at the end."""
+    rank_tables = {}
+    for k in keys:
+        col = db.columns[k.col_index]
+        if isinstance(col.dtype, t.StringType):
+            rank_tables[k.col_index] = jnp.asarray(
+                dictionary_ranks(col.dictionary))
+    sig = ("sortperm", db.capacity, tuple(keys),
+           tuple((str(c.data.dtype), c.dtype.simple_string)
+                 for c in db.columns),
+           tuple((i, rt.shape) for i, rt in rank_tables.items()))
+    fn = _SORT_CACHE.get(sig)
+    if fn is None:
+        keys_t = tuple(keys)
+        dtypes = [c.dtype for c in db.columns]
+
+        def run(col_data, col_valid, live, ranks):
+            lanes: List[jax.Array] = []
+            for k in keys_t:
+                col = DeviceColumn(col_data[k.col_index],
+                                   col_valid[k.col_index],
+                                   dtypes[k.col_index])
+                lanes.extend(order_lanes(col, k.ascending, k.nulls_first,
+                                         ranks.get(k.col_index)))
+            # lexsort: last key is primary -> [minor..., major, liveness]
+            sort_keys = list(reversed(lanes)) + [(~live).astype(jnp.int8)]
+            return jnp.lexsort(sort_keys)
+
+        fn = jax.jit(run)
+        _SORT_CACHE[sig] = fn
+    return fn(tuple(c.data for c in db.columns),
+              tuple(c.validity for c in db.columns),
+              db.row_mask(), rank_tables)
+
+
+def sort_batch(db: DeviceBatch, keys: Sequence[SortKey],
+               conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
+    """Fully sort one device batch by the given keys."""
+    perm = sort_permutation(db, keys)
+    cols = []
+    for c in db.columns:
+        d = jnp.take(c.data, perm, axis=0)
+        v = jnp.take(c.validity, perm, axis=0)
+        h = None if c.data_hi is None else jnp.take(c.data_hi, perm, axis=0)
+        cols.append(DeviceColumn(d, v, c.dtype, c.dictionary, h))
+    return DeviceBatch(cols, db.num_rows, list(db.names))

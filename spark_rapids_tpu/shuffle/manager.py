@@ -1,0 +1,115 @@
+"""In-process multithreaded shuffle (reference MULTITHREADED mode).
+
+Reference: RapidsShuffleThreadedWriterBase/ReaderBase
+(RapidsShuffleInternalManagerBase.scala:238,569) parallelize sort-shuffle
+file IO with thread pools; batches ride the JCudfSerialization host wire
+format.  Here the wire format is Arrow IPC (the TPU build's host columnar
+format IS Arrow, so serialization is zero-copy buffer framing), partitions
+live in an in-memory block store (spill-to-disk belongs to the runtime
+spill store), and a thread pool overlaps per-map-task serialization.
+
+The ICI path (parallel/exchange.py) replaces this entirely when the data
+is already device-resident across a mesh; this manager is the host path
+between independent processes/stages.
+"""
+from __future__ import annotations
+
+import io
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from ..columnar.host import HostBatch
+
+
+class ShuffleBlockStore:
+    """Partition-id -> list of serialized Arrow IPC payloads."""
+
+    def __init__(self):
+        self._blocks: Dict[Tuple[int, int], List[bytes]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, shuffle_id: int, part_id: int, payload: bytes) -> None:
+        with self._lock:
+            self._blocks.setdefault((shuffle_id, part_id), []).append(payload)
+
+    def get(self, shuffle_id: int, part_id: int) -> List[bytes]:
+        with self._lock:
+            return list(self._blocks.get((shuffle_id, part_id), []))
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            for k in [k for k in self._blocks if k[0] == shuffle_id]:
+                del self._blocks[k]
+
+    def bytes_stored(self) -> int:
+        with self._lock:
+            return sum(len(p) for ps in self._blocks.values() for p in ps)
+
+
+def serialize_batch(rb: pa.RecordBatch) -> bytes:
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    return sink.getvalue()
+
+
+def deserialize_batches(payloads: Iterable[bytes]) -> List[pa.RecordBatch]:
+    out: List[pa.RecordBatch] = []
+    for p in payloads:
+        with pa.ipc.open_stream(io.BytesIO(p)) as r:
+            out.extend(r)
+    return out
+
+
+class ShuffleManager:
+    """Process-wide shuffle service: map-side writes split host batches by
+    a precomputed partition-id lane; reduce-side reads concatenate."""
+
+    def __init__(self, num_threads: int = 6):
+        self.store = ShuffleBlockStore()
+        self.pool = ThreadPoolExecutor(max_workers=num_threads,
+                                       thread_name_prefix="shuffle")
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def new_shuffle(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    def write_batch(self, shuffle_id: int, hb: HostBatch,
+                    part_ids: np.ndarray, num_partitions: int) -> None:
+        """Split one host batch by partition id and store each slice
+        (serialization fans out on the thread pool)."""
+        rb = hb.rb
+        order = np.argsort(part_ids, kind="stable")
+        sorted_ids = part_ids[order]
+        bounds = np.searchsorted(sorted_ids, np.arange(num_partitions + 1))
+        idx_arr = pa.array(order)
+
+        def ser(p: int):
+            s, e = bounds[p], bounds[p + 1]
+            if s == e:
+                return
+            sl = rb.take(idx_arr.slice(s, e - s))
+            self.store.put(shuffle_id, p, serialize_batch(sl))
+
+        list(self.pool.map(ser, range(num_partitions)))
+
+    def read_partition(self, shuffle_id: int, part_id: int
+                       ) -> List[pa.RecordBatch]:
+        return deserialize_batches(self.store.get(shuffle_id, part_id))
+
+
+_MANAGER: Optional[ShuffleManager] = None
+
+
+def get_shuffle_manager() -> ShuffleManager:
+    global _MANAGER
+    if _MANAGER is None:
+        _MANAGER = ShuffleManager()
+    return _MANAGER

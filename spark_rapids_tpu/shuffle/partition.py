@@ -1,0 +1,146 @@
+"""Device-side partitioners (GpuPartitioning analogues).
+
+Reference: GpuHashPartitioningBase.scala:28 (Spark murmur3_32 then pmod),
+GpuRoundRobinPartitioning, GpuRangePartitioner.scala:173,
+GpuSinglePartitioning — all split device tables into per-partition slices.
+
+TPU-first: only the partition-id lane is computed on device (one fused
+program using the same murmur3 kernels the aggregation hash uses); the
+physical split happens wherever the rows are headed — host-side slicing
+for the host shuffle (the rows are being downloaded anyway), bucket
+compaction for the ICI all_to_all path (parallel/exchange.py).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, DeviceColumn
+from ..config import TpuConf, DEFAULT_CONF
+from ..ops.hashing import hash_column, dict_hash_array
+from ..plan import expressions as E
+
+
+class Partitioning:
+    num_partitions: int = 1
+
+    def partition_ids(self, db: DeviceBatch, conf: TpuConf) -> np.ndarray:
+        """Host int32 array (num_rows,) of target partitions."""
+        raise NotImplementedError
+
+
+class SinglePartitioning(Partitioning):
+    def __init__(self):
+        self.num_partitions = 1
+
+    def partition_ids(self, db, conf):
+        return np.zeros(int(db.num_rows), np.int32)
+
+
+class RoundRobinPartitioning(Partitioning):
+    """Spark round-robin: rows cycle through partitions, starting position
+    varies per task — we start at 0 (deterministic for tests)."""
+
+    def __init__(self, num_partitions: int):
+        self.num_partitions = num_partitions
+        self._next_start = 0
+
+    def partition_ids(self, db, conf):
+        n = int(db.num_rows)
+        ids = (np.arange(n, dtype=np.int64) + self._next_start) \
+            % self.num_partitions
+        self._next_start = int((self._next_start + n) % self.num_partitions)
+        return ids.astype(np.int32)
+
+
+_HASH_CACHE = {}
+
+
+class HashPartitioning(Partitioning):
+    """Spark HashPartitioning: pmod(murmur3_32(keys, seed=42), n)."""
+
+    def __init__(self, key_exprs: Sequence[E.Expression], num_partitions: int):
+        self.key_exprs = list(key_exprs)
+        self.num_partitions = num_partitions
+
+    def bind(self, schema: t.StructType) -> "HashPartitioning":
+        self.key_exprs = [e.bind(schema) for e in self.key_exprs]
+        return self
+
+    def _key_cols(self, db: DeviceBatch, conf) -> List[DeviceColumn]:
+        # plain column keys use the raw storage lanes (keeps DOUBLE as its
+        # bit-exact int64 lane, which Spark-compatible hashing requires)
+        cols = []
+        for e in self.key_exprs:
+            inner = e.children[0] if isinstance(e, E.Alias) else e
+            if isinstance(inner, E.ColumnRef):
+                cols.append(db.column_by_name(inner.name))
+            else:
+                from ..exec.evaluator import evaluate_projection
+                kb = evaluate_projection([e], ["_k"], db, conf)
+                cols.append(kb.columns[0])
+        for i, c in enumerate(cols):
+            if isinstance(c.dtype, t.StringType) and i > 0:
+                raise NotImplementedError(
+                    "string partition key after position 0: chained-seed "
+                    "string hashing needs the byte-level device kernel")
+        return cols
+
+    def partition_ids(self, db, conf):
+        kb_columns = self._key_cols(db, conf)
+        kb = DeviceBatch(kb_columns, db.num_rows,
+                         [f"_k{i}" for i in range(len(kb_columns))])
+        sig = ("hashpart", db.capacity, self.num_partitions,
+               tuple((c.dtype.simple_string, str(c.data.dtype))
+                     for c in kb.columns))
+        fn = _HASH_CACHE.get(sig)
+        if fn is None:
+            dtypes = [c.dtype for c in kb.columns]
+
+            def run(datas, valids, dhashes):
+                h = jnp.full((datas[0].shape[0],), 42, jnp.uint32)
+                for d, v, dt, i in zip(datas, valids, dtypes,
+                                       range(len(dtypes))):
+                    h = hash_column(d, v, dt, h, dhashes.get(i))
+                p = h.astype(jnp.int32) % jnp.int32(self.num_partitions)
+                return jnp.where(p < 0, p + self.num_partitions, p)
+            fn = jax.jit(run)
+            _HASH_CACHE[sig] = fn
+        dhashes = {}
+        for i, c in enumerate(kb.columns):
+            if isinstance(c.dtype, t.StringType):
+                dhashes[i] = jnp.asarray(dict_hash_array(c.dictionary, 42))
+        ids = fn(tuple(c.data for c in kb.columns),
+                 tuple(c.validity for c in kb.columns), dhashes)
+        return np.asarray(jax.device_get(ids))[:int(db.num_rows)]
+
+
+class RangePartitioning(Partitioning):
+    """Spark RangePartitioning: sampled boundaries, searchsorted placement.
+    Boundaries are computed once from the first batch (reference samples
+    the whole RDD; single-process build samples the stream head)."""
+
+    def __init__(self, sort_col: int, num_partitions: int,
+                 ascending: bool = True):
+        self.sort_col = sort_col
+        self.num_partitions = num_partitions
+        self.ascending = ascending
+        self._bounds: Optional[np.ndarray] = None
+
+    def partition_ids(self, db, conf):
+        col = db.columns[self.sort_col]
+        vals = np.asarray(jax.device_get(col.data))[:int(db.num_rows)]
+        valid = np.asarray(jax.device_get(col.validity))[:int(db.num_rows)]
+        if self._bounds is None:
+            live = vals[valid]
+            qs = np.linspace(0, 1, self.num_partitions + 1)[1:-1]
+            self._bounds = np.quantile(live, qs) if live.size \
+                else np.zeros(self.num_partitions - 1)
+        side = "right" if self.ascending else "left"
+        ids = np.searchsorted(self._bounds, vals, side=side).astype(np.int32)
+        ids[~valid] = 0          # nulls first -> partition 0
+        return ids

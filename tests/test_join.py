@@ -1,0 +1,153 @@
+"""Join correctness vs a pandas merge oracle (reference join_test role)."""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.exec.join import CrossJoinExec, HashJoinExec
+from spark_rapids_tpu.exec.plan import HostScanExec
+from spark_rapids_tpu.ops import join as J
+from spark_rapids_tpu.plan import expressions as E
+
+RNG = np.random.default_rng(31)
+
+
+def tables(n_left=300, n_right=200, nkeys=40, null_frac=0.1, seed=0):
+    rng = np.random.default_rng(seed)
+    lt = pa.table({
+        "lk": pa.array(rng.integers(0, nkeys, n_left), pa.int64(),
+                       mask=rng.random(n_left) < null_frac),
+        "lv": pa.array(np.arange(n_left), pa.int64()),
+    })
+    rt = pa.table({
+        "rk": pa.array(rng.integers(0, nkeys, n_right), pa.int64(),
+                       mask=rng.random(n_right) < null_frac),
+        "rv": pa.array(np.arange(n_right) * 10, pa.int64()),
+    })
+    return lt, rt
+
+
+def run_join(jt, lt, rt, lkeys=("lk",), rkeys=("rk",)):
+    plan = HashJoinExec(jt, [E.ColumnRef(k) for k in lkeys],
+                        [E.ColumnRef(k) for k in rkeys],
+                        HostScanExec.from_table(lt, max_rows=128),
+                        HostScanExec.from_table(rt, max_rows=128))
+    return plan.collect()
+
+
+def oracle(jt, lt, rt):
+    # pandas merge treats NaN keys as equal; Spark's null keys never match,
+    # so build from the non-null inner join + unmatched sides explicitly
+    ld, rd = lt.to_pandas(), rt.to_pandas()
+    ln, rn = ld[ld["lk"].notna()], rd[rd["rk"].notna()]
+    inner = ln.merge(rn, left_on="lk", right_on="rk", how="inner")
+    if jt == J.INNER:
+        return inner
+    lmatched, rmatched = set(inner["lv"]), set(inner["rv"])
+    left_un = ld[~ld["lv"].isin(lmatched)].assign(rk=np.nan, rv=np.nan)
+    right_un = rd[~rd["rv"].isin(rmatched)].assign(lk=np.nan, lv=np.nan)[
+        ["lk", "lv", "rk", "rv"]]
+    parts = [inner]
+    if jt in (J.LEFT_OUTER, J.FULL_OUTER):
+        parts.append(left_un)
+    if jt in (J.RIGHT_OUTER, J.FULL_OUTER):
+        parts.append(right_un)
+    return pd.concat(parts, ignore_index=True)
+
+
+def as_sorted_rows(df_like) -> list:
+    if isinstance(df_like, pa.Table):
+        df_like = df_like.to_pandas()
+    rows = [tuple(None if (x != x if isinstance(x, float) else pd.isna(x))
+                  else (int(x) if isinstance(x, (np.integer, float)) and
+                        x == int(x) else x)
+                  for x in r)
+            for r in df_like.itertuples(index=False)]
+    return sorted(rows, key=lambda r: tuple((v is None, v) for v in r))
+
+
+@pytest.mark.parametrize("jt", [J.INNER, J.LEFT_OUTER, J.RIGHT_OUTER,
+                                J.FULL_OUTER])
+def test_join_types_match_pandas(jt):
+    lt, rt = tables(seed=3)
+    got = run_join(jt, lt, rt)
+    want = oracle(jt, lt, rt)
+    assert as_sorted_rows(got) == as_sorted_rows(want)
+
+
+def test_semi_anti():
+    lt, rt = tables(seed=5)
+    ld, rd = lt.to_pandas(), rt.to_pandas()
+    rkeys = set(rd["rk"].dropna().astype(int))
+    got_semi = run_join(J.LEFT_SEMI, lt, rt).to_pandas()
+    want_semi = ld[ld["lk"].isin(rkeys)]
+    assert as_sorted_rows(got_semi) == as_sorted_rows(want_semi)
+    got_anti = run_join(J.LEFT_ANTI, lt, rt).to_pandas()
+    want_anti = ld[~ld["lk"].isin(rkeys)]   # null keys kept by anti
+    assert as_sorted_rows(got_anti) == as_sorted_rows(want_anti)
+
+
+def test_multi_key_join():
+    rng = np.random.default_rng(9)
+    n = 250
+    lt = pa.table({"a": pa.array(rng.integers(0, 6, n), pa.int32()),
+                   "b": pa.array(rng.integers(0, 6, n), pa.int64(),
+                                 mask=rng.random(n) < 0.1),
+                   "lv": pa.array(np.arange(n), pa.int64())})
+    rt = pa.table({"c": pa.array(rng.integers(0, 6, n), pa.int32()),
+                   "d": pa.array(rng.integers(0, 6, n), pa.int64(),
+                                 mask=rng.random(n) < 0.1),
+                   "rv": pa.array(np.arange(n), pa.int64())})
+    got = HashJoinExec(J.INNER, [E.ColumnRef("a"), E.ColumnRef("b")],
+                       [E.ColumnRef("c"), E.ColumnRef("d")],
+                       HostScanExec.from_table(lt, max_rows=64),
+                       HostScanExec.from_table(rt, max_rows=64)).collect()
+    ld = lt.to_pandas().dropna(subset=["a", "b"])
+    rd = rt.to_pandas().dropna(subset=["c", "d"])
+    want = ld.merge(rd, left_on=["a", "b"], right_on=["c", "d"], how="inner")
+    assert as_sorted_rows(got) == as_sorted_rows(want)
+
+
+def test_string_key_join():
+    lt = pa.table({"s": pa.array(["a", "b", None, "c", "b"]),
+                   "lv": pa.array([1, 2, 3, 4, 5], pa.int64())})
+    rt = pa.table({"s2": pa.array(["b", "c", "d", None]),
+                   "rv": pa.array([10, 20, 30, 40], pa.int64())})
+    got = run_join(J.INNER, lt, rt, ("s",), ("s2",)).to_pydict()
+    pairs = sorted(zip(got["lv"], got["rv"]))
+    assert pairs == [(2, 10), (4, 20), (5, 10)]
+
+
+def test_double_key_nan_and_negzero():
+    lt = pa.table({"k": pa.array([1.5, float("nan"), -0.0, 2.0]),
+                   "lv": pa.array([1, 2, 3, 4], pa.int64())})
+    rt = pa.table({"k2": pa.array([float("nan"), 0.0, 1.5]),
+                   "rv": pa.array([10, 20, 30], pa.int64())})
+    got = run_join(J.INNER, lt, rt, ("k",), ("k2",)).to_pydict()
+    pairs = sorted(zip(got["lv"], got["rv"]))
+    # Spark joins: NaN == NaN, -0.0 == 0.0
+    assert pairs == [(1, 30), (2, 10), (3, 20)]
+
+
+def test_cross_join():
+    lt = pa.table({"a": pa.array([1, 2, 3], pa.int64())})
+    rt = pa.table({"b": pa.array([10, 20], pa.int64())})
+    got = CrossJoinExec(HostScanExec.from_table(lt),
+                        HostScanExec.from_table(rt)).collect()
+    rows = sorted(zip(got["a"].to_pylist(), got["b"].to_pylist()))
+    assert rows == [(a, b) for a in (1, 2, 3) for b in (10, 20)]
+
+
+def test_empty_sides():
+    lt, rt = tables(seed=7)
+    empty_r = rt.slice(0, 0)
+    assert run_join(J.INNER, lt, empty_r).num_rows == 0
+    lo = run_join(J.LEFT_OUTER, lt, empty_r)
+    assert lo.num_rows == lt.num_rows
+    assert lo["rv"].null_count == lt.num_rows
+    anti = run_join(J.LEFT_ANTI, lt, empty_r)
+    assert anti.num_rows == lt.num_rows
+    empty_l = lt.slice(0, 0)
+    assert run_join(J.INNER, empty_l, rt).num_rows == 0
+    ro = run_join(J.RIGHT_OUTER, empty_l, rt)
+    assert ro.num_rows == rt.num_rows
