@@ -266,8 +266,14 @@ class HashAggregate:
         scalars, then the result expressions run via their CPU kernels on a
         1-row Arrow batch (cheaper than dispatching a device program for a
         single row)."""
-        from ..columnar.host import dtype_to_arrow
         fetched = jax.device_get([(d, v) for d, v in outs])
+        return self.finalize_fetched(fetched)
+
+    def finalize_fetched(self, fetched) -> pa.Table:
+        """Host-side tail of final_host, split out so pipelined callers
+        (bench, concurrent-task executor) can batch many queries' D2H
+        fetches into one transfer before finalizing each."""
+        from ..columnar.host import dtype_to_arrow
         arrays = []
         for (d, v), spec in zip(fetched, self.update_specs):
             val = d.item() if bool(v) else None

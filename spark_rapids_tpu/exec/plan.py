@@ -230,13 +230,16 @@ class HashAggregateExec(PlanNode):
         merged = agg.merge(partials) if len(partials) > 1 else partials[0]
         yield agg.final(merged)
 
-    def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
-        """Global (no-key) aggregations finish on host from raw buffer
-        scalars: N fused partial dispatches + at most one merge dispatch +
-        ONE D2H fetch — no 1-row device batches, no device final
-        projection."""
+    def collect_device(self, ctx: Optional[ExecContext] = None):
+        """Dispatch a global (no-key) aggregation fully async: returns
+        (outs, finalize) where `outs` is the list of (scalar, valid) device
+        buffers and `finalize(fetched)` turns their host values into the
+        result table.  No host sync happens inside this call — callers can
+        pipeline many queries and batch all fetches into one D2H round trip
+        (the concurrent-GpuSemaphore-tasks analogue for a chip behind a
+        high-latency link)."""
         if self.key_exprs:
-            return super().collect(ctx)
+            raise ValueError("collect_device is for global aggregations")
         ctx = ctx or ExecContext()
         agg = HashAggregate(self.key_exprs, self.key_names, self.aggs,
                             ctx.conf)
@@ -249,7 +252,18 @@ class HashAggregateExec(PlanNode):
         if not raw:
             empty = empty_device_batch(source.output_schema, ctx.conf)
             raw.append(agg.partial_fused(empty, conds, raw=True))
-        return agg.final_host(agg.merge_raw(raw))
+        return agg.merge_raw(raw), agg.finalize_fetched
+
+    def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
+        """Global (no-key) aggregations finish on host from raw buffer
+        scalars: N fused partial dispatches + at most one merge dispatch +
+        ONE D2H fetch — no 1-row device batches, no device final
+        projection."""
+        if self.key_exprs:
+            return super().collect(ctx)
+        import jax
+        outs, finalize = self.collect_device(ctx)
+        return finalize(jax.device_get(list(outs)))
 
     def describe(self):
         return (f"HashAggregateExec[keys={self.key_names}, "
