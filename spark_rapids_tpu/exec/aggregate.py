@@ -25,8 +25,8 @@ from .. import types as t
 from ..config import TpuConf
 from ..columnar.device import DeviceBatch, DeviceColumn
 from ..ops import groupby as G
-from ..ops.batch_ops import concat_batches, shrink_to_rows, unify_dictionaries, \
-    remap_string_column
+from ..ops.batch_ops import concat_batches, ensure_unique_dict, \
+    shrink_to_rows
 from ..plan import expressions as E
 from ..plan.aggregates import AggregateFunction
 from .evaluator import evaluate_projection
@@ -35,20 +35,9 @@ _GROUPBY_CACHE = {}
 _REDUCE_CACHE = {}
 
 
-def _ensure_unique_dict(col: DeviceColumn) -> DeviceColumn:
-    """Group keys compare by code, which requires a duplicate-free dict."""
-    d = col.dictionary
-    if d is None:
-        return col
-    unified, remaps = unify_dictionaries([d])
-    if len(unified) == len(d):
-        return col
-    return remap_string_column(col, remaps[0], unified)
-
-
 def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
                  specs: List[G.AggSpec], live, capacity: int):
-    key_cols = [_ensure_unique_dict(c) for c in key_cols]
+    key_cols = [ensure_unique_dict(c) for c in key_cols]
     info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
     sig = (info, tuple((s.kind, s.input_idx, s.dtype) for s in specs),
            capacity, tuple(str(c.data.dtype) for c in agg_cols))

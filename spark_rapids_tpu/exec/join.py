@@ -9,7 +9,7 @@ the same shape as the reference's build-then-stream iterator.
 """
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Sequence, Tuple
+from typing import Iterator, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -18,8 +18,8 @@ import pyarrow as pa
 from .. import types as t
 from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
 from ..ops import join as J
-from ..ops.batch_ops import concat_batches, unify_dictionaries, \
-    remap_string_column
+from ..ops.batch_ops import concat_batches, ensure_unique_dict, \
+    remap_codes_into
 from ..ops.filter import compact_batch, gather_batch
 from ..plan import expressions as E
 from .evaluator import evaluate_projection
@@ -35,16 +35,6 @@ def _null_columns(schema: t.StructType, capacity: int) -> List[DeviceColumn]:
         cols.append(DeviceColumn(jnp.zeros((capacity,), np_dt),
                                  jnp.zeros((capacity,), bool), dt))
     return cols
-
-
-def _unify_string_keys(a: DeviceColumn, b: DeviceColumn
-                       ) -> Tuple[DeviceColumn, DeviceColumn]:
-    """Remap both sides' codes into one union dictionary so code equality
-    == string equality."""
-    unified, (ra, rb) = None, (None, None)
-    unified, remaps = unify_dictionaries([a.dictionary, b.dictionary])
-    return (remap_string_column(a, remaps[0], unified),
-            remap_string_column(b, remaps[1], unified))
 
 
 class HashJoinExec(PlanNode):
@@ -84,10 +74,39 @@ class HashJoinExec(PlanNode):
 
     # -- helpers -----------------------------------------------------------
 
-    def _key_cols(self, db: DeviceBatch, exprs, ctx) -> List[DeviceColumn]:
-        kb = evaluate_projection(exprs, [f"_k{i}" for i in range(len(exprs))],
-                                 db, ctx.conf)
-        return list(kb.columns)
+    @staticmethod
+    def _plain_ref(e: E.Expression):
+        inner = e.children[0] if isinstance(e, E.Alias) else e
+        return inner if isinstance(inner, E.ColumnRef) else None
+
+    def _raw_key_positions(self) -> List[bool]:
+        """Key positions where BOTH sides are plain column references: those
+        keys stay on their raw storage lanes (for DOUBLE that is the
+        bit-exact int64 lane — projecting would force the lossy native-f64
+        compute representation, the round-1 ADVICE.md defect).  Both sides
+        must agree so build/probe lane encodings match."""
+        out = []
+        for le, re_ in zip(self.left_keys, self.right_keys):
+            out.append(self._plain_ref(le) is not None and
+                       self._plain_ref(re_) is not None)
+        return out
+
+    def _key_cols(self, db: DeviceBatch, exprs, raw_pos, ctx
+                  ) -> List[DeviceColumn]:
+        cols: List[Optional[DeviceColumn]] = [None] * len(exprs)
+        proj_exprs, proj_slots = [], []
+        for i, (e, raw) in enumerate(zip(exprs, raw_pos)):
+            if raw:
+                cols[i] = db.column_by_name(self._plain_ref(e).name)
+            else:
+                proj_exprs.append(e)
+                proj_slots.append(i)
+        if proj_exprs:
+            kb = evaluate_projection(
+                proj_exprs, [f"_k{i}" for i in proj_slots], db, ctx.conf)
+            for slot, c in zip(proj_slots, kb.columns):
+                cols[slot] = c
+        return cols
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         # ---- build (right side), fully materialized ----
@@ -102,23 +121,31 @@ class HashJoinExec(PlanNode):
             yield from self._empty_build_output(ctx)
             return
 
-        build_keys = self._key_cols(build_batch, self.right_keys, ctx)
+        raw_pos = self._raw_key_positions()
+        build_keys = self._key_cols(build_batch, self.right_keys, raw_pos,
+                                    ctx)
+        # String build keys: dedupe their dictionaries ONCE; probe batches
+        # remap into the build code space (-1 for strings the build side
+        # never saw), so the build sort below happens once per join, not
+        # once per probe batch.
+        has_str = [isinstance(c.dtype, t.StringType) for c in build_keys]
+        for i, s in enumerate(has_str):
+            if s:
+                build_keys[i] = ensure_unique_dict(build_keys[i])
+        build = J.BuildTable(build_batch, build_keys)
         out_names = list(self.output_schema.names)
-        emit_right = self.join_type not in (J.LEFT_SEMI, J.LEFT_ANTI)
 
         build_matched_acc = jnp.zeros((build_batch.capacity,), bool)
 
         for pb in self.left.execute(ctx):
             if int(pb.num_rows) == 0:
                 continue
-            probe_keys = self._key_cols(pb, self.left_keys, ctx)
-            # unify string dictionaries pairwise (per probe batch)
-            bk = list(build_keys)
-            for i, (b, p) in enumerate(zip(bk, probe_keys)):
-                if isinstance(b.dtype, t.StringType):
-                    bk[i], probe_keys[i] = _unify_string_keys(b, p)
-            build = J.BuildTable(build_batch, bk)
-            probe_lanes = [J.canonical_lane(c) for c in probe_keys]
+            probe_keys = self._key_cols(pb, self.left_keys, raw_pos, ctx)
+            for i, s in enumerate(has_str):
+                if s:
+                    probe_keys[i] = remap_codes_into(
+                        probe_keys[i], build_keys[i].dictionary)
+            probe_lanes = J.key_cols_lanes(probe_keys)
             probe_valid = pb.row_mask()
             for c in probe_keys:
                 probe_valid = probe_valid & c.validity
@@ -131,7 +158,8 @@ class HashJoinExec(PlanNode):
                 else:
                     out_cap = bucket_capacity(total, ctx.conf)
                     _, _, _, matched, _ = J.expand_pairs(
-                        build, probe_lanes, probe_valid, lo, cum, out_cap)
+                        build, probe_lanes, probe_valid, lo, cum, out_cap,
+                        total)
                 keep = matched if self.join_type == J.LEFT_SEMI \
                     else pb.row_mask() & ~matched
                 out = compact_batch(pb, keep, ctx.conf)
@@ -142,7 +170,7 @@ class HashJoinExec(PlanNode):
                 out_cap = bucket_capacity(total, ctx.conf)
                 probe_idx, build_idx, ok, probe_matched, build_matched = \
                     J.expand_pairs(build, probe_lanes, probe_valid, lo, cum,
-                                   out_cap)
+                                   out_cap, total)
                 build_matched_acc = build_matched_acc | build_matched
                 lg = gather_batch(pb, probe_idx, total)
                 rg = gather_batch(build_batch, build_idx, total)

@@ -43,6 +43,35 @@ def remap_string_column(col: DeviceColumn, remap: np.ndarray,
     return DeviceColumn(data, col.validity, col.dtype, unified)
 
 
+def ensure_unique_dict(col: DeviceColumn) -> DeviceColumn:
+    """Code-equality == string-equality requires a duplicate-free dict."""
+    d = col.dictionary
+    if d is None:
+        return col
+    unified, remaps = unify_dictionaries([d])
+    if len(unified) == len(d):
+        return col
+    return remap_string_column(col, remaps[0], unified)
+
+
+def remap_codes_into(col: DeviceColumn, target_dict: pa.Array) -> DeviceColumn:
+    """Remap a string column's codes into `target_dict`'s code space; codes
+    whose string is absent from the target map to -1 (equal to no valid
+    code).  Lets a join probe stream remap against a build-side dictionary
+    unified ONCE instead of re-unifying build+probe per batch."""
+    src = col.dictionary
+    if src is None:
+        raise ValueError("remap_codes_into needs a dictionary column")
+    idx = pc.index_in(src.cast(pa.string()), value_set=target_dict)
+    table = np.asarray(idx.fill_null(-1).to_numpy(zero_copy_only=False),
+                       dtype=np.int32)
+    if not len(table):
+        table = np.full(1, -1, np.int32)
+    dev = jnp.asarray(table)
+    data = dev[jnp.clip(col.data, 0, dev.shape[0] - 1)]
+    return DeviceColumn(data, col.validity, col.dtype, target_dict)
+
+
 def concat_batches(batches: List[DeviceBatch],
                    conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
     """Concatenate device batches (same schema) into one bucketed batch."""

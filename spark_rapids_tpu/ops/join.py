@@ -33,7 +33,7 @@ import numpy as np
 from .. import types as t
 from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
 from ..config import TpuConf, DEFAULT_CONF
-from .kernels import compute_view
+
 
 INNER = "inner"
 LEFT_OUTER = "left_outer"
@@ -53,44 +53,98 @@ def _mix64(x: jax.Array) -> jax.Array:
     return x ^ (x >> 31)
 
 
-def canonical_lane(col: DeviceColumn) -> jax.Array:
-    """int64 lane with Spark join-equality semantics (see module doc).
-    Strings must already carry a side-unified dictionary."""
+def _computed_f64_lanes(x: jax.Array) -> List[jax.Array]:
+    """Exact injective int64 lane(s) for a *computed* (native-repr) f64 lane.
+
+    The f64->i64 bitcast is unavailable on-TPU, so the encoding is built
+    from conversions that exist on each backend:
+
+      * TPU: the emulated f64 IS an (f32 hi, f32 lo) double-double pair, so
+        `x.astype(f32)` recovers hi exactly and `x - hi` IS lo — two f32
+        bitcasts packed into one int64 capture the full device value with
+        zero loss.
+      * CPU (real f64, used by the test mesh): the f32 pair keeps only ~48
+        of 53 mantissa bits and overflows f32's exponent range, so distinct
+        doubles would collide (the round-1 defect, ADVICE.md).  frexp gives
+        an exact (53-bit scaled mantissa, exponent) pair instead — two
+        int64 lanes, injective for every finite double.
+
+    NaN (any payload) and -0.0 are canonicalized first: Spark equates them.
+    """
+    x = jnp.where(x == 0.0, jnp.float64(0.0), x)
+    isnan = jnp.isnan(x)
+    if jax.default_backend() == "tpu":
+        hi = x.astype(jnp.float32)
+        lo = jnp.where(jnp.isfinite(hi),
+                       (x - hi.astype(jnp.float64)).astype(jnp.float32),
+                       jnp.float32(0.0))
+        hb = jax.lax.bitcast_convert_type(hi, jnp.int32)
+        hb = jnp.where(isnan, jnp.int32(0x7FC00000), hb)
+        lb = jax.lax.bitcast_convert_type(
+            jnp.where(lo == 0.0, jnp.float32(0.0), lo), jnp.int32)
+        lb = jnp.where(isnan, jnp.int32(0), lb)
+        return [(hb.astype(jnp.int64) << 32) |
+                (lb.astype(jnp.int64) & jnp.int64(0xFFFFFFFF))]
+    # XLA CPU flushes subnormals to zero in every op INCLUDING == (verified:
+    # jnp.float64(2**-1060) == 0.0 is True), so subnormal inputs are
+    # indistinguishable from 0 under the backend's own equality — encode
+    # them as 0 explicitly rather than trusting frexp's inconsistent
+    # subnormal handling.
+    sub = jnp.abs(x) < jnp.float64(2.0 ** -1022)
+    m, e = jnp.frexp(jnp.where(sub, 0.0, x))  # m in +-[0.5,1), exact
+    mi = (m * jnp.float64(2.0 ** 53)).astype(jnp.int64)
+    el = e.astype(jnp.int64)
+    isinf = jnp.isinf(x)
+    mi = jnp.where(isinf, jnp.where(x > 0, jnp.int64(1), jnp.int64(-1)), mi)
+    el = jnp.where(isinf, jnp.int64(1 << 30), el)
+    mi = jnp.where(isnan, jnp.int64(0x7FF8000000000000), mi)
+    el = jnp.where(isnan, jnp.int64(1 << 30), el)
+    return [mi, el]
+
+
+def canonical_lanes(col: DeviceColumn) -> List[jax.Array]:
+    """int64 lane(s) with Spark join-equality semantics (see module doc):
+    value equality on the column == elementwise equality of every lane.
+    Strings must already carry a side-unified dictionary.
+
+    Most types yield one lane; computed DOUBLE yields one or two depending
+    on backend (_computed_f64_lanes).  Build and probe sides must derive
+    their lanes through the same column representation (exec/join.py keeps
+    plain-column keys on the storage lane for both sides)."""
     dt = col.dtype
     data = col.data
     if isinstance(dt, t.StringType):
-        return data.astype(jnp.int64)
+        return [data.astype(jnp.int64)]
     if isinstance(dt, t.DoubleType):
-        cv = compute_view(data, dt)
-        if cv.dtype == jnp.float64:
-            # computed lane: no f64->bits on TPU; canonicalize by VALUE.
-            # Collisions across distinct doubles impossible; NaN/-0 fixed up
-            canon = jnp.where(jnp.isnan(cv), jnp.float64(np.nan), cv)
-            canon = jnp.where(canon == 0.0, jnp.float64(0.0), canon)
-            # order-preserving int mapping not needed (equality only):
-            # use the f32x2 split trick via two mixes of hi/lo halves
-            hi = canon.astype(jnp.float32).astype(jnp.float64)
-            lo = (canon - hi).astype(jnp.float32)
-            bits = (jax.lax.bitcast_convert_type(hi.astype(jnp.float32),
-                                                 jnp.int32).astype(jnp.int64)
-                    << 32) | jax.lax.bitcast_convert_type(
-                        lo, jnp.int32).astype(jnp.int64) & 0xFFFFFFFF
-            return bits
-        # storage bits: canonicalize NaN (any payload) and -0.0
-        f = jax.lax.bitcast_convert_type(data, jnp.float64)
-        isnan = jnp.isnan(f)
-        canon_nan = jnp.int64(0x7FF8000000000000)
-        bits = jnp.where(isnan, canon_nan, data)
+        if data.dtype != jnp.int64:
+            return _computed_f64_lanes(data)
+        # int64-bits storage lane (host pass-through): canonicalize NaN
+        # (any payload) and -0.0 on the BITS — exact for all 64 bits, no
+        # round trip through the (emulated) f64 representation
+        exp_mask = jnp.int64(0x7FF0000000000000)
+        mant_mask = jnp.int64(0x000FFFFFFFFFFFFF)
+        isnan = ((data & exp_mask) == exp_mask) & ((data & mant_mask) != 0)
+        bits = jnp.where(isnan, jnp.int64(0x7FF8000000000000), data)
         neg_zero = jnp.int64(np.int64(np.uint64(0x8000000000000000)))
-        return jnp.where(bits == neg_zero, jnp.int64(0), bits)
+        return [jnp.where(bits == neg_zero, jnp.int64(0), bits)]
     if isinstance(dt, t.FloatType):
         isnan = jnp.isnan(data)
         canon = jnp.where(isnan, jnp.float32(np.nan), data)
         canon = jnp.where(canon == 0.0, jnp.float32(0.0), canon)
-        return jax.lax.bitcast_convert_type(canon, jnp.int32).astype(jnp.int64)
+        b = jax.lax.bitcast_convert_type(canon, jnp.int32)
+        b = jnp.where(isnan, jnp.int32(0x7FC00000), b)
+        return [b.astype(jnp.int64)]
     if isinstance(dt, t.DecimalType) and dt.is_wide:
         raise NotImplementedError("wide decimal join keys")
-    return data.astype(jnp.int64)
+    return [data.astype(jnp.int64)]
+
+
+def key_cols_lanes(key_cols: Sequence[DeviceColumn]) -> List[jax.Array]:
+    """Flat canonical lane list for a key column set."""
+    lanes: List[jax.Array] = []
+    for c in key_cols:
+        lanes.extend(canonical_lanes(c))
+    return lanes
 
 
 def composite_hash(lanes: Sequence[jax.Array]) -> jax.Array:
@@ -112,7 +166,7 @@ class BuildTable:
 
     def __init__(self, batch: DeviceBatch, key_cols: Sequence[DeviceColumn]):
         self.batch = batch
-        lanes = [canonical_lane(c) for c in key_cols]
+        lanes = key_cols_lanes(key_cols)
         valid = batch.row_mask()
         for c in key_cols:
             valid = valid & c.validity      # null keys never match
@@ -162,7 +216,8 @@ def probe_counts(build: BuildTable, probe_lanes: List[jax.Array],
 
 
 def expand_pairs(build: BuildTable, probe_lanes: List[jax.Array],
-                 probe_valid: jax.Array, lo, cum, out_cap: int):
+                 probe_valid: jax.Array, lo, cum, out_cap: int,
+                 total: Optional[int] = None):
     """-> (probe_idx, build_idx, verified, probe_matched, build_matched)
 
     probe_idx/build_idx: (out_cap,) gather indices for candidate pairs;
@@ -202,6 +257,14 @@ def expand_pairs(build: BuildTable, probe_lanes: List[jax.Array],
             return probe_idx, build_idx, ok, probe_matched, build_matched
         fn = jax.jit(run, static_argnames=())
         _PROBE_CACHE[sig] = fn
-    total = jnp.int32(min(int(cum[-1]) if cum.shape[0] else 0, out_cap))
+    # callers pass probe_counts' total to avoid a second D2H sync
+    true_total = total if total is not None \
+        else (int(cum[-1]) if cum.shape[0] else 0)
+    if true_total > out_cap:
+        # callers size out_cap from probe_counts' total; a smaller cap would
+        # silently drop matching rows — fail loudly instead
+        raise ValueError(f"join candidate pairs {true_total} exceed output "
+                         f"capacity {out_cap}")
+    total = jnp.int32(true_total)
     return fn(build.perm, tuple(build.lanes), build.key_valid,
               tuple(probe_lanes), probe_valid, lo, cum, total)

@@ -17,6 +17,7 @@ from typing import List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pyarrow as pa
 
 from .. import types as t
 from ..columnar.device import DeviceBatch, DeviceColumn
@@ -131,16 +132,51 @@ class RangePartitioning(Partitioning):
         self.ascending = ascending
         self._bounds: Optional[np.ndarray] = None
 
+    def _string_ids(self, col, n: int, side: str) -> np.ndarray:
+        """Strings: bounds are VALUES (strings), not per-batch dictionary
+        ranks — rank positions are meaningless across batches with
+        different dictionaries.  Placement maps each (small) dictionary
+        entry to its bound interval once, then indexes by code."""
+        codes = np.asarray(jax.device_get(col.data))[:n]
+        dict_np = np.asarray(col.dictionary.cast(pa.string())
+                             .to_numpy(zero_copy_only=False)) \
+            if col.dictionary is not None and len(col.dictionary) \
+            else np.array([""], object)
+        codes = np.clip(codes, 0, len(dict_np) - 1)
+        if self._bounds is None:
+            valid = np.asarray(jax.device_get(col.validity))[:n]
+            live = np.sort(dict_np[codes[valid]].astype(str))
+            qs = np.linspace(0, 1, self.num_partitions + 1)[1:-1]
+            self._bounds = (live[(qs * (len(live) - 1)).astype(int)]
+                            if live.size else np.array([""] * max(
+                                self.num_partitions - 1, 1), object))
+        pos = np.searchsorted(np.asarray(self._bounds, dtype=str),
+                              dict_np.astype(str), side=side)
+        return pos.astype(np.int32)[codes]
+
     def partition_ids(self, db, conf):
         col = db.columns[self.sort_col]
-        vals = np.asarray(jax.device_get(col.data))[:int(db.num_rows)]
-        valid = np.asarray(jax.device_get(col.validity))[:int(db.num_rows)]
+        n = int(db.num_rows)
+        side = "right" if self.ascending else "left"
+        valid = np.asarray(jax.device_get(col.validity))[:n]
+        if isinstance(col.dtype, t.StringType):
+            ids = self._string_ids(col, n, side)
+            ids[~valid] = 0
+            return ids
+        vals = np.asarray(jax.device_get(col.data))[:n]
+        if isinstance(col.dtype, t.DoubleType) and vals.dtype == np.int64:
+            # int64 IEEE-bit storage lane: signed-int order reverses for
+            # negative doubles — compare as float64 values
+            vals = vals.view(np.float64)
+        isnan = np.isnan(vals) if np.issubdtype(vals.dtype, np.floating) \
+            else np.zeros(len(vals), bool)
         if self._bounds is None:
-            live = vals[valid]
+            live = vals[valid & ~isnan]
             qs = np.linspace(0, 1, self.num_partitions + 1)[1:-1]
             self._bounds = np.quantile(live, qs) if live.size \
                 else np.zeros(self.num_partitions - 1)
-        side = "right" if self.ascending else "left"
         ids = np.searchsorted(self._bounds, vals, side=side).astype(np.int32)
+        # Spark float order: NaN greatest -> last (asc) / first (desc)
+        ids[isnan] = self.num_partitions - 1 if self.ascending else 0
         ids[~valid] = 0          # nulls first -> partition 0
         return ids

@@ -1,0 +1,135 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.columnar.device import to_device
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.exec.join import HashJoinExec
+from spark_rapids_tpu.exec.plan import HostScanExec, ProjectExec
+from spark_rapids_tpu.ops import join as J
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.shuffle.partition import RangePartitioning
+
+
+def _scan(d, chunk=None):
+    return HostScanExec.from_table(pa.table(d), chunk)
+
+
+class TestDoubleJoinKeys:
+    """ops/join.py:67 — computed-f64 join lanes collided on nearby doubles."""
+
+    def test_adjacent_doubles_do_not_collide(self):
+        base = 12345.6789
+        nxt = float(np.nextafter(base, np.inf))
+        left = _scan({"k": pa.array([base], pa.float64()),
+                      "l": pa.array([1], pa.int64())})
+        right = _scan({"k": pa.array([base, nxt], pa.float64()),
+                       "r": pa.array([10, 20], pa.int64())})
+        # force the computed-f64 path through a projection (k * 1.0)
+        lp = ProjectExec([E.Multiply(E.ColumnRef("k"), E.Literal(1.0)),
+                          E.ColumnRef("l")], ["k", "l"], left)
+        rp = ProjectExec([E.Multiply(E.ColumnRef("k"), E.Literal(1.0)),
+                          E.ColumnRef("r")], ["k", "r"], right)
+        out = HashJoinExec("inner", [E.ColumnRef("k")], [E.ColumnRef("k")],
+                           lp, rp).collect()
+        assert out.num_rows == 1
+        assert out.column("r").to_pylist() == [10]
+
+    def test_plain_ref_double_keys_use_exact_storage_lane(self):
+        vals = [1.0, -0.0, 0.0, float(np.nextafter(1.0, 2.0)), float("nan")]
+        left = _scan({"k": pa.array(vals, pa.float64()),
+                      "l": pa.array(range(len(vals)), pa.int64())})
+        right = _scan({"k": pa.array([1.0, 0.0, float("nan")], pa.float64()),
+                       "r": pa.array([100, 200, 300], pa.int64())})
+        out = HashJoinExec("inner", [E.ColumnRef("k")], [E.ColumnRef("k")],
+                           left, right).collect().to_pydict()
+        got = sorted(zip(out["l"], out["r"]))
+        # -0.0 == 0.0 and NaN == NaN per Spark join equality;
+        # nextafter(1.0) must NOT match 1.0
+        assert got == [(0, 100), (1, 200), (2, 200), (4, 300)]
+
+    def test_computed_f64_lanes_injective_on_host(self):
+        import jax.numpy as jnp
+        vals = np.array([1.0, np.nextafter(1.0, 2.0), -1.0, 0.0, -0.0,
+                         1e300, 1e-300, np.inf, -np.inf, np.nan, 2.0**-1060])
+        lanes = J._computed_f64_lanes(jnp.asarray(vals))
+        enc = list(zip(*[np.asarray(l).tolist() for l in lanes]))
+        # all distinct except -0.0 == 0.0, and the subnormal which XLA CPU
+        # flushes to zero in == itself (so 0-encoding matches backend
+        # equality semantics)
+        assert len(set(enc)) == len(vals) - 2
+        assert enc[3] == enc[4] == enc[10]
+        assert len(set(enc[:10])) == 9
+
+
+class TestRangePartitionValueOrder:
+    """shuffle/partition.py:141 — boundaries must be computed in value
+    order, not storage-lane order."""
+
+    def test_mixed_sign_doubles(self):
+        vals = [-100.0, -1.0, -0.5, 0.0, 0.5, 1.0, 100.0, 1e9]
+        db = to_device(HostBatch.from_pydict(
+            {"x": pa.array(vals, pa.float64())}))
+        part = RangePartitioning(0, 4)
+        ids = part.partition_ids(db, None)
+        # partition ids must be monotone in VALUE order
+        assert list(ids) == sorted(ids)
+        assert ids[0] < ids[-1]
+
+    def test_string_ranges_use_dictionary_ranks(self):
+        vals = ["zebra", "apple", "mango", "banana", "pear", "kiwi",
+                "grape", "fig"]
+        db = to_device(HostBatch.from_pydict({"s": pa.array(vals)}))
+        part = RangePartitioning(0, 3)
+        ids = part.partition_ids(db, None)
+        order = np.argsort(vals)
+        assert list(ids[order]) == sorted(ids)
+
+    def test_nan_goes_last(self):
+        vals = [1.0, float("nan"), -5.0, 2.0]
+        db = to_device(HostBatch.from_pydict(
+            {"x": pa.array(vals, pa.float64())}))
+        ids = RangePartitioning(0, 3).partition_ids(db, None)
+        assert ids[1] == 2
+
+
+class TestExpandPairsOverflow:
+    """ops/join.py:206 — undersized out_cap must fail loudly."""
+
+    def test_raises_not_truncates(self):
+        left = _scan({"k": pa.array([1] * 8, pa.int64())})
+        right = _scan({"k": pa.array([1] * 8, pa.int64())})
+        lb = next(iter(left.execute.__self__.batches))
+        db_l = to_device(lb)
+        db_r = to_device(next(iter(right.batches)))
+        build = J.BuildTable(db_r, [db_r.columns[0]])
+        lanes = J.key_cols_lanes([db_l.columns[0]])
+        valid = db_l.row_mask()
+        lo, counts, cum, total = J.probe_counts(build, lanes, valid)
+        assert total == 64
+        with pytest.raises(ValueError, match="exceed"):
+            J.expand_pairs(build, lanes, valid, lo, cum, out_cap=32)
+
+
+class TestStringJoinBuildHoist:
+    """exec/join.py:120 — build table built once; probe dictionaries remap
+    into the build code space."""
+
+    def test_string_join_multi_probe_batches(self):
+        left = _scan({"k": pa.array(["a", "b", "c", "d", "e", "x"]),
+                      "l": pa.array(range(6), pa.int64())}, chunk=2)
+        right = _scan({"k": pa.array(["b", "d", "e", "zz"]),
+                       "r": pa.array([20, 40, 50, 99], pa.int64())})
+        out = HashJoinExec("inner", [E.ColumnRef("k")], [E.ColumnRef("k")],
+                           left, right).collect().to_pydict()
+        assert sorted(zip(out["l"], out["r"])) == [(1, 20), (3, 40), (4, 50)]
+
+    def test_string_left_anti_with_unseen_probe_strings(self):
+        left = _scan({"k": pa.array(["a", "b", "q"]),
+                      "l": pa.array([0, 1, 2], pa.int64())})
+        right = _scan({"k": pa.array(["b"])})
+        out = HashJoinExec("left_anti", [E.ColumnRef("k")],
+                           [E.ColumnRef("k")], left, right).collect()
+        assert sorted(out.column("l").to_pylist()) == [0, 2]
