@@ -29,3 +29,4 @@ _jax.config.update("jax_enable_x64", True)
 
 from . import types
 from .config import TpuConf, DEFAULT_CONF
+from .session import DataFrame, TpuSession, col, lit

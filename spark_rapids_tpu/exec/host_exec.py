@@ -1,0 +1,495 @@
+"""CPU physical operators (per-operator fallback path) + transitions.
+
+The reference keeps unreplaced Spark operators running on the CPU and
+bridges with GpuRowToColumnarExec / GpuColumnarToRowExec
+(GpuTransitionOverrides.scala:50).  Here the CPU engine is pyarrow: host
+operators stream pyarrow RecordBatches and evaluate expressions through
+their `eval_cpu` oracle path — the same code that serves as the test
+oracle, which is exactly the reference's "same query, two backends"
+correctness strategy (SURVEY §4).
+
+Transitions:
+  * HostToDeviceExec — device PlanNode over a HostNode child (the
+    HostColumnarToGpu role), slicing oversized host batches to the
+    configured row target before upload.
+  * DeviceToHostExec — HostNode over a device PlanNode child (the
+    GpuColumnarToRowExec / BringBackToHost role).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, to_device, to_host
+from ..columnar.host import HostBatch, dtype_to_arrow, struct_to_schema
+from ..plan import expressions as E
+from ..plan.aggregates import AggregateFunction
+from .plan import ExecContext, PlanNode
+
+
+class HostNode:
+    """Base CPU operator: streams pyarrow RecordBatches."""
+
+    def __init__(self, *children: "HostNode"):
+        self.children = list(children)
+
+    @property
+    def child(self) -> "HostNode":
+        return self.children[0]
+
+    @property
+    def output_schema(self) -> t.StructType:
+        raise NotImplementedError
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def describe(self) -> str:
+        return self.name()
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.describe()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
+        ctx = ctx or ExecContext()
+        rbs = [rb for rb in self.execute(ctx) if rb.num_rows > 0]
+        schema = struct_to_schema(self.output_schema)
+        if not rbs:
+            return pa.Table.from_batches([], schema)
+        return pa.Table.from_batches(rbs, rbs[0].schema)
+
+    def _table(self, ctx) -> pa.Table:
+        """Materialize the child stream as one table."""
+        rbs = [rb for rb in self.child.execute(ctx) if rb.num_rows > 0]
+        schema = struct_to_schema(self.child.output_schema)
+        if not rbs:
+            return pa.Table.from_batches([], schema)
+        return pa.Table.from_batches(rbs, rbs[0].schema)
+
+
+# ---------------------------------------------------------------------------
+# Transitions
+# ---------------------------------------------------------------------------
+
+class HostToDeviceExec(PlanNode):
+    """Upload a host stream to device (HostColumnarToGpu role)."""
+
+    def __init__(self, host_child: HostNode):
+        super().__init__()
+        self.host_child = host_child
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.host_child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        target = ctx.conf.batch_size_rows
+        for rb in self.host_child.execute(ctx):
+            for off in range(0, max(rb.num_rows, 1), target):
+                sl = rb.slice(off, min(target, rb.num_rows - off))
+                if rb.num_rows and sl.num_rows == 0:
+                    continue
+                ctx.bump("h2d_rows", sl.num_rows)
+                yield to_device(HostBatch(sl), ctx.conf)
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + "HostToDeviceExec"]
+        lines.append(self.host_child.tree_string(indent + 1))
+        return "\n".join(lines)
+
+
+class DeviceToHostExec(HostNode):
+    """Fetch a device stream to host (GpuColumnarToRowExec role)."""
+
+    def __init__(self, device_child: PlanNode):
+        super().__init__()
+        self.device_child = device_child
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.device_child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        for db in self.device_child.execute(ctx):
+            if int(db.num_rows) == 0:
+                continue
+            ctx.bump("d2h_rows", int(db.num_rows))
+            yield to_host(db).rb
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + "DeviceToHostExec"]
+        lines.append(self.device_child.tree_string(indent + 1))
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CPU operators
+# ---------------------------------------------------------------------------
+
+class HostSourceExec(HostNode):
+    """Leaf over an in-memory Arrow table."""
+
+    def __init__(self, table: pa.Table, batch_rows: Optional[int] = None):
+        super().__init__()
+        self.table = table
+        self.batch_rows = batch_rows
+
+    @property
+    def output_schema(self) -> t.StructType:
+        from ..columnar.host import schema_to_struct
+        return schema_to_struct(self.table.schema)
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        tbl = self.table.combine_chunks()
+        yield from tbl.to_batches(max_chunksize=self.batch_rows)
+
+    def describe(self):
+        return f"HostSourceExec[{self.table.num_rows} rows]"
+
+
+def _eval_named(exprs: Sequence[E.Expression], names: Sequence[str],
+                rb: pa.RecordBatch) -> pa.RecordBatch:
+    arrays, fields = [], []
+    for e, n in zip(exprs, names):
+        a = e.eval_cpu(rb)
+        if isinstance(a, pa.ChunkedArray):
+            a = a.combine_chunks()
+        if isinstance(a, pa.Scalar):
+            a = pa.array([a.as_py()] * rb.num_rows, type=a.type)
+        arrays.append(a)
+        fields.append(pa.field(n, a.type))
+    return pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
+
+
+class CpuProjectExec(HostNode):
+    def __init__(self, exprs: Sequence[E.Expression], names: Sequence[str],
+                 child: HostNode):
+        super().__init__(child)
+        self.exprs = [e.bind(child.output_schema) for e in exprs]
+        self.names = list(names)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return t.StructType([t.StructField(n, e.dtype, e.nullable)
+                             for n, e in zip(self.names, self.exprs)])
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        for rb in self.child.execute(ctx):
+            yield _eval_named(self.exprs, self.names, rb)
+
+    def describe(self):
+        return f"CpuProjectExec[{', '.join(self.names)}]"
+
+
+class CpuFilterExec(HostNode):
+    def __init__(self, condition: E.Expression, child: HostNode):
+        super().__init__(child)
+        self.condition = condition.bind(child.output_schema)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        for rb in self.child.execute(ctx):
+            mask = self.condition.eval_cpu(rb)
+            mask = pc.fill_null(mask, False)
+            tbl = pa.Table.from_batches([rb]).filter(mask)
+            for out in tbl.combine_chunks().to_batches():
+                yield out
+
+    def describe(self):
+        return f"CpuFilterExec[{self.condition!r}]"
+
+
+class CpuAggregateExec(HostNode):
+    """Hash aggregate on pyarrow TableGroupBy / compute reductions."""
+
+    def __init__(self, keys: Sequence[E.Expression], key_names: Sequence[str],
+                 aggs: Sequence[Tuple[AggregateFunction, str]],
+                 child: HostNode):
+        super().__init__(child)
+        schema = child.output_schema
+        self.keys = [k.bind(schema) for k in keys]
+        self.key_names = list(key_names)
+        self.aggs = [(fn.bind(schema), n) for fn, n in aggs]
+
+    @property
+    def output_schema(self) -> t.StructType:
+        fields = [t.StructField(n, k.dtype)
+                  for n, k in zip(self.key_names, self.keys)]
+        for fn, n in self.aggs:
+            fields.append(t.StructField(n, fn.dtype))
+        return t.StructType(fields)
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        tbl = self._table(ctx)
+        rb = HostBatch.from_table(tbl).rb
+        # project keys + agg children into a working table
+        arrays, names = [], []
+        for i, k in enumerate(self.keys):
+            arrays.append(self._arr(k.eval_cpu(rb), rb.num_rows))
+            names.append(f"_k{i}")
+        agg_specs = []
+        for j, (fn, _) in enumerate(self.aggs):
+            child = fn.child
+            col = f"_a{j}"
+            if child is None:
+                # count(*): count over an all-valid dummy column
+                arrays.append(pa.array([True] * rb.num_rows))
+            else:
+                arrays.append(self._arr(child.eval_cpu(rb), rb.num_rows))
+            names.append(col)
+            agg_specs.append((col, fn))
+        work = pa.table(dict(zip(names, arrays)))
+
+        if not self.keys:
+            out_arrays, out_fields = [], []
+            for (col, fn), (_, oname) in zip(agg_specs, self.aggs):
+                fname, opts = fn.cpu_agg()
+                val = self._global_agg(work[col], fname, opts)
+                want = dtype_to_arrow(fn.dtype)
+                arr = pa.array([val.as_py()], type=want) if val is not None \
+                    else pa.nulls(1, want)
+                out_arrays.append(arr)
+                out_fields.append(pa.field(oname, want))
+            yield pa.RecordBatch.from_arrays(out_arrays,
+                                             schema=pa.schema(out_fields))
+            return
+
+        gb_aggs = []
+        for col, fn in agg_specs:
+            fname, opts = fn.cpu_agg()
+            gb_aggs.append((col, fname, opts))
+        res = work.group_by([f"_k{i}" for i in range(len(self.keys))],
+                            use_threads=False).aggregate(gb_aggs)
+        # order output columns: keys then aggs, cast to declared types
+        out_arrays, out_fields = [], []
+        for i, (kname, k) in enumerate(zip(self.key_names, self.keys)):
+            a = res[f"_k{i}"].combine_chunks()
+            out_arrays.append(a)
+            out_fields.append(pa.field(kname, a.type))
+        for j, ((col, fn), (_, oname)) in enumerate(zip(agg_specs, self.aggs)):
+            fname, _ = fn.cpu_agg()
+            a = res[f"{col}_{fname}"].combine_chunks().cast(
+                dtype_to_arrow(fn.dtype))
+            out_arrays.append(a)
+            out_fields.append(pa.field(oname, a.type))
+        tbl = pa.Table.from_arrays(out_arrays, schema=pa.schema(out_fields))
+        yield HostBatch.from_table(tbl).rb
+
+    @staticmethod
+    def _arr(a, n):
+        if isinstance(a, pa.ChunkedArray):
+            a = a.combine_chunks()
+        if isinstance(a, pa.Scalar):
+            a = pa.array([a.as_py()] * n, type=a.type)
+        return a
+
+    @staticmethod
+    def _global_agg(col: pa.ChunkedArray, fname: str, opts):
+        fn = {"sum": pc.sum, "min": pc.min, "max": pc.max, "mean": pc.mean,
+              "count": pc.count, "first": lambda c, options=None:
+                  c[0] if len(c) else None,
+              "last": lambda c, options=None: c[-1] if len(c) else None,
+              }[fname]
+        if fname in ("first", "last"):
+            vals = col.drop_null() if opts is not None and \
+                getattr(opts, "skip_nulls", False) else col
+            return fn(vals)
+        return fn(col, options=opts) if opts is not None else fn(col)
+
+    def describe(self):
+        return (f"CpuAggregateExec[keys={self.key_names}, "
+                f"aggs={[n for _, n in self.aggs]}]")
+
+
+class CpuSortExec(HostNode):
+    def __init__(self, orders, child: HostNode):
+        """orders: (bound-or-unbound expr, ascending, nulls_first) tuples."""
+        super().__init__(child)
+        self.orders = [(e.bind(child.output_schema), asc, nf)
+                       for e, asc, nf in orders]
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        tbl = self._table(ctx)
+        rb = HostBatch.from_table(tbl).rb
+        sort_cols, keys = [], []
+        for i, (e, asc, nf) in enumerate(self.orders):
+            sort_cols.append(CpuAggregateExec._arr(e.eval_cpu(rb), rb.num_rows))
+            keys.append((f"_s{i}", "ascending" if asc else "descending",
+                         "at_start" if nf else "at_end"))
+        work = pa.table({f"_s{i}": c for i, c in enumerate(sort_cols)})
+        idx = pc.sort_indices(
+            work, sort_keys=[(n, d) for n, d, _ in keys],
+            null_placement=keys[0][2] if keys else "at_start")
+        out = pa.Table.from_batches([rb]).take(idx)
+        yield HostBatch.from_table(out).rb
+
+    def describe(self):
+        return f"CpuSortExec[{len(self.orders)} keys]"
+
+
+class CpuLimitExec(HostNode):
+    def __init__(self, limit: int, child: HostNode):
+        super().__init__(child)
+        self.limit = limit
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        remaining = self.limit
+        for rb in self.child.execute(ctx):
+            if remaining <= 0:
+                return
+            if rb.num_rows <= remaining:
+                remaining -= rb.num_rows
+                yield rb
+            else:
+                yield rb.slice(0, remaining)
+                return
+
+
+_PA_JOIN = {"inner": "inner", "left_outer": "left outer",
+            "right_outer": "right outer", "full_outer": "full outer",
+            "left_semi": "left semi", "left_anti": "left anti"}
+
+
+class CpuJoinExec(HostNode):
+    def __init__(self, join_type: str, left_keys, right_keys,
+                 left: HostNode, right: HostNode):
+        super().__init__(left, right)
+        self.join_type = join_type
+        self.left_keys = [k.bind(left.output_schema) for k in left_keys]
+        self.right_keys = [k.bind(right.output_schema) for k in right_keys]
+
+    @property
+    def output_schema(self) -> t.StructType:
+        lf = list(self.children[0].output_schema.fields)
+        if self.join_type in ("left_semi", "left_anti"):
+            return t.StructType(lf)
+        return t.StructType(lf + list(self.children[1].output_schema.fields))
+
+    def _side_table(self, ctx, side: int) -> pa.Table:
+        rbs = [rb for rb in self.children[side].execute(ctx) if rb.num_rows > 0]
+        schema = struct_to_schema(self.children[side].output_schema)
+        if not rbs:
+            return pa.Table.from_batches([], schema)
+        return pa.Table.from_batches(rbs, rbs[0].schema)
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        lt = self._side_table(ctx, 0)
+        rt = self._side_table(ctx, 1)
+        if self.join_type == "cross":
+            yield from self._cross(lt, rt)
+            return
+        lrb = HostBatch.from_table(lt).rb
+        rrb = HostBatch.from_table(rt).rb
+        lkeys = [f"_jk{i}" for i in range(len(self.left_keys))]
+        lt2 = lt
+        for name, e in zip(lkeys, self.left_keys):
+            lt2 = lt2.append_column(name,
+                                    CpuAggregateExec._arr(e.eval_cpu(lrb), lrb.num_rows))
+        rt2 = rt
+        for name, e in zip(lkeys, self.right_keys):
+            rt2 = rt2.append_column(name,
+                                    CpuAggregateExec._arr(e.eval_cpu(rrb), rrb.num_rows))
+        # avoid output name collisions: suffix right columns on conflict
+        out = lt2.join(rt2, keys=lkeys, join_type=_PA_JOIN[self.join_type],
+                       left_suffix="", right_suffix="_r",
+                       coalesce_keys=False)
+        drop = [c for c in out.column_names if c.startswith("_jk")]
+        out = out.drop_columns(drop)
+        want = struct_to_schema(self.output_schema)
+        out = out.rename_columns(want.names)
+        out = out.cast(want)
+        yield HostBatch.from_table(out).rb
+
+    def _cross(self, lt: pa.Table, rt: pa.Table):
+        import numpy as np
+        nl, nr = lt.num_rows, rt.num_rows
+        if nl == 0 or nr == 0:
+            return
+        li = np.repeat(np.arange(nl), nr)
+        ri = np.tile(np.arange(nr), nl)
+        lo = lt.take(li)
+        ro = rt.take(ri)
+        cols = list(lo.columns) + list(ro.columns)
+        names = list(self.output_schema.names)
+        yield HostBatch.from_table(
+            pa.table(dict(zip(names, cols)))).rb
+
+    def describe(self):
+        return f"CpuJoinExec[{self.join_type}]"
+
+
+class CpuUnionExec(HostNode):
+    def __init__(self, *children: HostNode):
+        super().__init__(*children)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.children[0].output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        names = struct_to_schema(self.output_schema).names
+        for c in self.children:
+            for rb in c.execute(ctx):
+                yield pa.RecordBatch.from_arrays(
+                    list(rb.columns), schema=rb.schema.with_metadata(None)
+                ).rename_columns(names)
+
+
+class CpuRangeExec(HostNode):
+    def __init__(self, start, end, step=1, name="id",
+                 batch_rows: Optional[int] = None):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.col_name = name
+        self.batch_rows = batch_rows
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return t.StructType([t.StructField(self.col_name, t.LongType(), False)])
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        import numpy as np
+        vals = np.arange(self.start, self.end, self.step, dtype=np.int64)
+        chunk = self.batch_rows or ctx.conf.batch_size_rows
+        for off in range(0, len(vals), chunk):
+            yield pa.RecordBatch.from_arrays(
+                [pa.array(vals[off:off + chunk])],
+                schema=pa.schema([pa.field(self.col_name, pa.int64(), False)]))
+
+
+class CpuExpandExec(HostNode):
+    def __init__(self, projections, names, child: HostNode):
+        super().__init__(child)
+        self.projections = [[e.bind(child.output_schema) for e in p]
+                            for p in projections]
+        self.names = list(names)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return t.StructType([t.StructField(n, e.dtype) for n, e in
+                             zip(self.names, self.projections[0])])
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        for rb in self.child.execute(ctx):
+            for proj in self.projections:
+                yield _eval_named(proj, self.names, rb)

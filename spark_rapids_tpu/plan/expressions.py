@@ -159,6 +159,70 @@ class Expression:
     def __repr__(self):
         return self.fingerprint()
 
+    # ---- Column-style operator sugar (pyspark Column analogue) ----
+    @staticmethod
+    def _lift(v) -> "Expression":
+        return v if isinstance(v, Expression) else Literal(v)
+
+    def alias(self, name: str) -> "Expression":
+        return Alias(self, name)
+
+    def cast(self, to: "t.DataType") -> "Expression":
+        return Cast(self, to)
+
+    def __add__(self, o):
+        return Add(self, self._lift(o))
+
+    def __sub__(self, o):
+        return Subtract(self, self._lift(o))
+
+    def __mul__(self, o):
+        return Multiply(self, self._lift(o))
+
+    def __truediv__(self, o):
+        return Divide(self, self._lift(o))
+
+    def __mod__(self, o):
+        return Remainder(self, self._lift(o))
+
+    def __neg__(self):
+        return UnaryMinus(self)
+
+    def __gt__(self, o):
+        return GreaterThan(self, self._lift(o))
+
+    def __ge__(self, o):
+        return GreaterThanOrEqual(self, self._lift(o))
+
+    def __lt__(self, o):
+        return LessThan(self, self._lift(o))
+
+    def __le__(self, o):
+        return LessThanOrEqual(self, self._lift(o))
+
+    def __eq__(self, o):
+        return EqualTo(self, self._lift(o))
+
+    def __ne__(self, o):
+        return NotEqual(self, self._lift(o))
+
+    __hash__ = object.__hash__
+
+    def __and__(self, o):
+        return And(self, self._lift(o))
+
+    def __or__(self, o):
+        return Or(self, self._lift(o))
+
+    def __invert__(self):
+        return Not(self)
+
+    def is_null(self):
+        return IsNull(self)
+
+    def is_not_null(self):
+        return IsNotNull(self)
+
 
 # ---------------------------------------------------------------------------
 # Leaves

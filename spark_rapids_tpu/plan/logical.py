@@ -1,0 +1,260 @@
+"""Logical plan nodes — the Catalyst-physical-plan analogue the overrides
+engine rewrites.
+
+In the reference, Spark hands the plugin a *physical* plan whose nodes are
+wrapped into `RapidsMeta` trees, tagged, and converted
+(GpuOverrides.scala:4364 wrapAndTagPlan, RapidsMeta.scala:83).  This engine
+owns its own planner, so the pre-rewrite representation is this small
+logical algebra: each node declares its schema (resolving expressions
+against children) and nothing else — placement (TPU vs CPU), transitions,
+and physical operator choice are decided entirely by plan/overrides.py.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from .. import types as t
+from . import expressions as E
+from .aggregates import AggregateFunction
+
+
+class LogicalPlan:
+    """Base logical operator. Schema resolves lazily, children first."""
+
+    def __init__(self, *children: "LogicalPlan"):
+        self.children = list(children)
+        self._schema: Optional[t.StructType] = None
+
+    @property
+    def child(self) -> "LogicalPlan":
+        return self.children[0]
+
+    @property
+    def schema(self) -> t.StructType:
+        if self._schema is None:
+            self._schema = self._resolve_schema()
+        return self._schema
+
+    def _resolve_schema(self) -> t.StructType:
+        raise NotImplementedError(type(self).__name__)
+
+    def name(self) -> str:
+        return type(self).__name__.removeprefix("Logical")
+
+    def describe(self) -> str:
+        return self.name()
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.describe()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+
+def _as_expr(e) -> E.Expression:
+    return E.ColumnRef(e) if isinstance(e, str) else e
+
+
+def _out_name(e: E.Expression, i: int) -> str:
+    if isinstance(e, E.Alias):
+        return e.name
+    if isinstance(e, E.ColumnRef):
+        return e.name
+    return f"col{i}"
+
+
+class LogicalScan(LogicalPlan):
+    """Leaf over an in-memory Arrow table (the InMemoryScan / LocalTableScan
+    analogue).  File scans are LogicalFileScan (io/)."""
+
+    def __init__(self, table: pa.Table):
+        super().__init__()
+        self.table = table
+
+    def _resolve_schema(self):
+        from ..columnar.host import schema_to_struct
+        return schema_to_struct(self.table.schema)
+
+    def describe(self):
+        return f"Scan[{self.table.num_rows} rows]"
+
+
+class LogicalProject(LogicalPlan):
+    def __init__(self, exprs: Sequence, child: LogicalPlan,
+                 names: Optional[Sequence[str]] = None):
+        super().__init__(child)
+        self.exprs = [_as_expr(e) for e in exprs]
+        self.names = list(names) if names is not None else \
+            [_out_name(e, i) for i, e in enumerate(self.exprs)]
+
+    def _resolve_schema(self):
+        bound = [e.bind(self.child.schema) for e in self.exprs]
+        return t.StructType([t.StructField(n, e.dtype, e.nullable)
+                             for n, e in zip(self.names, bound)])
+
+    def describe(self):
+        return f"Project[{', '.join(self.names)}]"
+
+
+class LogicalFilter(LogicalPlan):
+    def __init__(self, condition: E.Expression, child: LogicalPlan):
+        super().__init__(child)
+        self.condition = _as_expr(condition)
+
+    def _resolve_schema(self):
+        return self.child.schema
+
+    def describe(self):
+        return f"Filter[{self.condition!r}]"
+
+
+class LogicalAggregate(LogicalPlan):
+    """group-by keys + aggregate list.  keys may be arbitrary expressions;
+    aggs are (AggregateFunction, output name) pairs."""
+
+    def __init__(self, keys: Sequence, aggs: Sequence[Tuple[AggregateFunction, str]],
+                 child: LogicalPlan, key_names: Optional[Sequence[str]] = None):
+        super().__init__(child)
+        self.keys = [_as_expr(k) for k in keys]
+        self.key_names = list(key_names) if key_names is not None else \
+            [_out_name(k, i) for i, k in enumerate(self.keys)]
+        self.aggs = list(aggs)
+
+    def _resolve_schema(self):
+        schema = self.child.schema
+        fields = []
+        for n, k in zip(self.key_names, self.keys):
+            fields.append(t.StructField(n, k.bind(schema).dtype))
+        for fn, n in self.aggs:
+            fields.append(t.StructField(n, fn.bind(schema).dtype))
+        return t.StructType(fields)
+
+    def describe(self):
+        return (f"Aggregate[keys={self.key_names}, "
+                f"aggs={[n for _, n in self.aggs]}]")
+
+
+class LogicalSort(LogicalPlan):
+    """orders: sequence of (expr-or-name, ascending, nulls_first)."""
+
+    def __init__(self, orders: Sequence, child: LogicalPlan,
+                 global_sort: bool = True):
+        super().__init__(child)
+        norm = []
+        for o in orders:
+            if isinstance(o, (str, E.Expression)):
+                norm.append((_as_expr(o), True, True))
+            else:
+                e, *rest = o
+                asc = rest[0] if rest else True
+                nf = rest[1] if len(rest) > 1 else asc
+                norm.append((_as_expr(e), asc, nf))
+        self.orders = norm
+        self.global_sort = global_sort
+
+    def _resolve_schema(self):
+        return self.child.schema
+
+    def describe(self):
+        ks = [(e.name if isinstance(e, E.ColumnRef) else repr(e),
+               "asc" if a else "desc") for e, a, _ in self.orders]
+        return f"Sort[{ks}]"
+
+
+class LogicalLimit(LogicalPlan):
+    def __init__(self, limit: int, child: LogicalPlan):
+        super().__init__(child)
+        self.limit = limit
+
+    def _resolve_schema(self):
+        return self.child.schema
+
+    def describe(self):
+        return f"Limit[{self.limit}]"
+
+
+class LogicalJoin(LogicalPlan):
+    """Equi-join on key expression pairs.  join_type: inner, left_outer,
+    right_outer, full_outer, left_semi, left_anti, cross."""
+
+    def __init__(self, join_type: str, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: Sequence = (), right_keys: Sequence = ()):
+        super().__init__(left, right)
+        self.join_type = join_type
+        self.left_keys = [_as_expr(k) for k in left_keys]
+        self.right_keys = [_as_expr(k) for k in right_keys]
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def _resolve_schema(self):
+        lf = list(self.left.schema.fields)
+        if self.join_type in ("left_semi", "left_anti"):
+            return t.StructType(lf)
+        return t.StructType(lf + list(self.right.schema.fields))
+
+    def describe(self):
+        return f"Join[{self.join_type}, keys={len(self.left_keys)}]"
+
+
+class LogicalUnion(LogicalPlan):
+    def __init__(self, *children: LogicalPlan):
+        super().__init__(*children)
+
+    def _resolve_schema(self):
+        return self.children[0].schema
+
+
+class LogicalRange(LogicalPlan):
+    def __init__(self, start: int, end: int, step: int = 1, name: str = "id"):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.col_name = name
+
+    def _resolve_schema(self):
+        return t.StructType([t.StructField(self.col_name, t.LongType(), False)])
+
+    def describe(self):
+        return f"Range[{self.start},{self.end},{self.step}]"
+
+
+class LogicalExpand(LogicalPlan):
+    def __init__(self, projections: Sequence[Sequence], names: Sequence[str],
+                 child: LogicalPlan):
+        super().__init__(child)
+        self.projections = [[_as_expr(e) for e in p] for p in projections]
+        self.names = list(names)
+
+    def _resolve_schema(self):
+        bound = [e.bind(self.child.schema) for e in self.projections[0]]
+        return t.StructType([t.StructField(n, e.dtype)
+                             for n, e in zip(self.names, bound)])
+
+
+class LogicalWindow(LogicalPlan):
+    """Window functions over (partition keys, order keys).  window_exprs:
+    (WindowFunctionSpec, output name) pairs appended to the child schema.
+    See plan/window.py for specs."""
+
+    def __init__(self, window_exprs: Sequence, partition_keys: Sequence,
+                 order_keys: Sequence, child: LogicalPlan):
+        super().__init__(child)
+        self.window_exprs = list(window_exprs)
+        self.partition_keys = [_as_expr(k) for k in partition_keys]
+        self.order_keys = list(order_keys)
+
+    def _resolve_schema(self):
+        fields = list(self.child.schema.fields)
+        for spec, name in self.window_exprs:
+            fields.append(t.StructField(name, spec.result_type(self.child.schema)))
+        return t.StructType(fields)
+
+    def describe(self):
+        return f"Window[{[n for _, n in self.window_exprs]}]"

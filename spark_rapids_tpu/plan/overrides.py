@@ -1,0 +1,576 @@
+"""The plan-rewrite engine: wrap -> tag -> convert with per-node fallback.
+
+Reference: GpuOverrides.scala:904-4720 (rule registry + applyOverrides),
+RapidsMeta.scala:83-328 (meta tree, tagForGpu, willNotWorkOnGpu,
+canThisBeReplaced), GpuTransitionOverrides.scala:46 (transition insertion),
+ExplainPlan (spark.rapids.sql.explain logging).
+
+Lifecycle (same shape as the reference):
+  1. wrap   — the logical plan (plan/logical.py) is wrapped into a
+     PlanMeta tree; every expression into an ExprMeta tree.
+  2. tag    — children first, then self: master kill-switch, per-op conf
+     enable keys (`spark.rapids.tpu.sql.exec.<Name>` /
+     `...sql.expression.<Name>`), declarative TypeSig checks against the
+     rule registry, and op-specific `tag_self` checks.  Every failure is a
+     recorded *reason string*, never an exception.
+  3. convert — nodes where `can_replace` become device execs (exec/plan.py
+     et al); others become CPU execs (exec/host_exec.py).  Transitions
+     (HostToDeviceExec / DeviceToHostExec) are inserted exactly where the
+     placement flips — the GpuTransitionOverrides role.
+
+Explain: `PhysicalQuery.explain()` renders every placement decision with
+its reasons (`spark.rapids.tpu.sql.explain=ALL|NOT_ON_TPU`).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+import pyarrow as pa
+
+from .. import types as t
+from ..config import TpuConf, DEFAULT_CONF
+from ..exec import host_exec as H
+from ..exec.plan import (CoalesceBatchesExec, ExecContext, ExpandExec,
+                         FilterExec, GlobalLimitExec, HashAggregateExec,
+                         HostScanExec, PlanNode, ProjectExec, RangeExec,
+                         SortExec, UnionExec)
+from . import expressions as E
+from . import logical as L
+from .aggregates import (AggregateFunction, Average, BoolAnd, BoolOr, Count,
+                         First, Last, Max, Min, Sum)
+
+log = logging.getLogger("spark_rapids_tpu.overrides")
+
+
+# ---------------------------------------------------------------------------
+# Rule registry (GpuOverrides.commonExpressions / commonExecs analogue)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ExprRule:
+    cls: type
+    input_sig: t.TypeSig
+    output_sig: t.TypeSig
+    desc: str = ""
+
+
+@dataclasses.dataclass
+class ExecRule:
+    cls: type
+    output_sig: t.TypeSig
+    desc: str = ""
+
+
+_EXPR_RULES: Dict[type, ExprRule] = {}
+_EXEC_RULES: Dict[type, ExecRule] = {}
+_AGG_RULES: Dict[type, ExprRule] = {}
+
+
+def expr_rule(cls, input_sig, output_sig=None, desc=""):
+    _EXPR_RULES[cls] = ExprRule(cls, input_sig, output_sig or input_sig, desc)
+
+
+def agg_rule(cls, input_sig, output_sig=None, desc=""):
+    _AGG_RULES[cls] = ExprRule(cls, input_sig, output_sig or input_sig, desc)
+
+
+def exec_rule(cls, output_sig, desc=""):
+    _EXEC_RULES[cls] = ExecRule(cls, output_sig, desc)
+
+
+_NUM_BOOL = t.T.NUMERIC + t.T.BOOLEAN + t.T.NULL
+_COMMON = t.T.DEVICE_COMMON
+
+expr_rule(E.ColumnRef, _COMMON, desc="column reference")
+expr_rule(E.Literal, _COMMON + t.T.NULL, desc="literal value")
+expr_rule(E.Alias, _COMMON, desc="named expression")
+for _c in (E.Add, E.Subtract, E.Multiply, E.Divide, E.IntegralDivide,
+           E.Remainder, E.UnaryMinus, E.Abs):
+    expr_rule(_c, t.T.NUMERIC + t.T.NULL, desc="arithmetic")
+for _c in (E.EqualTo, E.NotEqual, E.LessThan, E.LessThanOrEqual,
+           E.GreaterThan, E.GreaterThanOrEqual, E.EqualNullSafe):
+    expr_rule(_c, t.T.COMPARABLE, t.T.BOOLEAN, desc="comparison")
+for _c in (E.And, E.Or, E.Not):
+    expr_rule(_c, t.T.BOOLEAN + t.T.NULL, t.T.BOOLEAN, desc="boolean logic")
+for _c in (E.IsNull, E.IsNotNull):
+    expr_rule(_c, t.T.ALL_SIMPLE, t.T.BOOLEAN, desc="null predicate")
+expr_rule(E.IsNaN, t.T.FP, t.T.BOOLEAN, desc="NaN predicate")
+expr_rule(E.Coalesce, _COMMON, desc="first non-null")
+expr_rule(E.If, _COMMON, desc="if/else")
+expr_rule(E.CaseWhen, _COMMON, desc="case/when")
+expr_rule(E.In, _COMMON, t.T.BOOLEAN, desc="IN list")
+for _c in (E.Sqrt, E.Exp, E.Log, E.Pow):
+    expr_rule(_c, t.T.NUMERIC, t.T.FP, desc="math fn")
+for _c in (E.Floor, E.Ceil):
+    expr_rule(_c, t.T.NUMERIC, t.T.INTEGRAL, desc="rounding")
+expr_rule(E.Cast, t.T.ALL_SIMPLE, desc="cast (pairs gated by Cast itself)")
+
+for _c in (Count, Sum, Min, Max, Average, First, Last, BoolAnd, BoolOr):
+    agg_rule(_c, _COMMON, desc="aggregate function")
+
+exec_rule(L.LogicalScan, t.T.ALL_SIMPLE, "in-memory scan + device upload")
+exec_rule(L.LogicalProject, _COMMON, "projection")
+exec_rule(L.LogicalFilter, t.T.ALL_SIMPLE, "filter")
+exec_rule(L.LogicalAggregate, _COMMON, "hash aggregate")
+exec_rule(L.LogicalSort, t.T.ORDERABLE, "sort")
+exec_rule(L.LogicalLimit, t.T.ALL_SIMPLE, "limit")
+exec_rule(L.LogicalJoin, _COMMON, "hash join")
+exec_rule(L.LogicalUnion, t.T.ALL_SIMPLE, "union")
+exec_rule(L.LogicalRange, t.T.ALL_SIMPLE, "range generator")
+exec_rule(L.LogicalExpand, _COMMON, "expand (grouping sets)")
+
+
+# ---------------------------------------------------------------------------
+# Meta hierarchy
+# ---------------------------------------------------------------------------
+
+class BaseMeta:
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+        self.reasons: List[str] = []
+
+    def will_not_work(self, reason: str):
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    @property
+    def can_replace(self) -> bool:
+        return not self.reasons
+
+
+class ExprMeta(BaseMeta):
+    """Wraps one bound expression.  Child reasons roll up: the reference
+    replaces expressions only as whole trees inside an operator."""
+
+    def __init__(self, expr: E.Expression, conf: TpuConf):
+        super().__init__(conf)
+        self.expr = expr
+        self.children = [ExprMeta(c, conf) for c in expr.children]
+
+    def tag(self):
+        for c in self.children:
+            c.tag()
+            for r in c.reasons:
+                self.will_not_work(r)
+        name = type(self.expr).__name__
+        if not self.conf.is_op_enabled("expression", name):
+            self.will_not_work(
+                f"expression {name} disabled by "
+                f"spark.rapids.tpu.sql.expression.{name}")
+            return
+        rule = _EXPR_RULES.get(type(self.expr))
+        if rule is None:
+            self.will_not_work(f"expression {name} has no TPU rule")
+            return
+        for c in self.expr.children:
+            if c.dtype is not None and not rule.input_sig.supports(c.dtype):
+                self.will_not_work(
+                    f"expression {name}: input type "
+                    f"{c.dtype.simple_string} not supported")
+        if self.expr.dtype is not None and \
+                not rule.output_sig.supports(self.expr.dtype):
+            self.will_not_work(
+                f"expression {name}: output type "
+                f"{self.expr.dtype.simple_string} not supported")
+        for r in self.expr.unsupported_reasons(self.conf):
+            self.will_not_work(f"expression {name}: {r}")
+
+
+class AggMeta(BaseMeta):
+    def __init__(self, fn: AggregateFunction, conf: TpuConf):
+        super().__init__(conf)
+        self.fn = fn
+
+    def tag(self):
+        name = type(self.fn).__name__
+        if _AGG_RULES.get(type(self.fn)) is None:
+            self.will_not_work(f"aggregate {name} has no TPU rule")
+            return
+        for r in self.fn.unsupported_reasons(self.conf):
+            self.will_not_work(f"aggregate {name}: {r}")
+
+
+class PlanMeta(BaseMeta):
+    """Wraps one logical node; subclasses add expression metas + convert."""
+
+    def __init__(self, node: L.LogicalPlan, conf: TpuConf,
+                 parent: Optional["PlanMeta"]):
+        super().__init__(conf)
+        self.node = node
+        self.parent = parent
+        self.children = [wrap_plan(c, conf, self) for c in node.children]
+        self.expr_metas: List[ExprMeta] = []
+        self.agg_metas: List[AggMeta] = []
+
+    # -- wrap helpers ------------------------------------------------------
+    def _wrap_exprs(self, exprs: Sequence[E.Expression],
+                    schema: t.StructType) -> List[E.Expression]:
+        bound = []
+        for e in exprs:
+            try:
+                b = e.bind(schema)
+            except (KeyError, TypeError) as exc:
+                self.will_not_work(f"cannot bind {e!r}: {exc}")
+                continue
+            self.expr_metas.append(ExprMeta(b, self.conf))
+            bound.append(b)
+        return bound
+
+    # -- tagging -----------------------------------------------------------
+    def tag(self):
+        for c in self.children:
+            c.tag()
+        if not self.conf.sql_enabled:
+            self.will_not_work("spark.rapids.tpu.sql.enabled is false")
+            return
+        name = self.node.name()
+        key_name = type(self.node).__name__.removeprefix("Logical") + "Exec"
+        if not self.conf.is_op_enabled("exec", key_name):
+            self.will_not_work(
+                f"exec {key_name} disabled by "
+                f"spark.rapids.tpu.sql.exec.{key_name}")
+        rule = _EXEC_RULES.get(type(self.node))
+        if rule is None:
+            self.will_not_work(f"operator {name} has no TPU rule")
+        else:
+            for f in self.node.schema.fields:
+                if not rule.output_sig.supports(f.data_type):
+                    self.will_not_work(
+                        f"output column {f.name}: type "
+                        f"{f.data_type.simple_string} not supported")
+        for em in self.expr_metas:
+            em.tag()
+            for r in em.reasons:
+                self.will_not_work(r)
+        for am in self.agg_metas:
+            am.tag()
+            for r in am.reasons:
+                self.will_not_work(r)
+        self.tag_self()
+
+    def tag_self(self):
+        pass
+
+    # -- conversion --------------------------------------------------------
+    def convert(self) -> Tuple[str, object]:
+        """Returns ("device", PlanNode) or ("host", HostNode)."""
+        if self.can_replace and not self.conf.explain_only:
+            return "device", self.to_device()
+        return "host", self.to_host()
+
+    def to_device(self) -> PlanNode:
+        raise NotImplementedError
+
+    def to_host(self) -> H.HostNode:
+        raise NotImplementedError
+
+    def _device_child(self, i: int = 0) -> PlanNode:
+        kind, node = self.children[i].convert()
+        if kind == "device":
+            return node
+        return H.HostToDeviceExec(node)
+
+    def _host_child(self, i: int = 0) -> H.HostNode:
+        kind, node = self.children[i].convert()
+        if kind == "host":
+            return node
+        return H.DeviceToHostExec(node)
+
+    # -- explain -----------------------------------------------------------
+    def explain_lines(self, depth: int = 0) -> List[str]:
+        mark = "*" if self.can_replace else "!"
+        line = f"{'  ' * depth}{mark}Exec <{self.node.name()}>"
+        if self.can_replace:
+            line += " will run on TPU"
+        else:
+            line += (" cannot run on TPU because "
+                     + "; ".join(self.reasons[:4]))
+            if len(self.reasons) > 4:
+                line += f" (+{len(self.reasons) - 4} more)"
+        out = [line]
+        for c in self.children:
+            out += c.explain_lines(depth + 1)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Per-node metas
+# ---------------------------------------------------------------------------
+
+class ScanMeta(PlanMeta):
+    def to_device(self):
+        return HostScanExec.from_table(self.node.table,
+                                       self.conf.batch_size_rows)
+
+    def to_host(self):
+        return H.HostSourceExec(self.node.table, self.conf.batch_size_rows)
+
+
+class ProjectMeta(PlanMeta):
+    def __init__(self, node, conf, parent):
+        super().__init__(node, conf, parent)
+        self.bound = self._wrap_exprs(node.exprs, node.child.schema)
+
+    def to_device(self):
+        return ProjectExec(self.node.exprs, self.node.names,
+                           self._device_child())
+
+    def to_host(self):
+        return H.CpuProjectExec(self.node.exprs, self.node.names,
+                                self._host_child())
+
+
+class FilterMeta(PlanMeta):
+    def __init__(self, node, conf, parent):
+        super().__init__(node, conf, parent)
+        self._wrap_exprs([node.condition], node.child.schema)
+
+    def to_device(self):
+        return FilterExec(self.node.condition, self._device_child())
+
+    def to_host(self):
+        return H.CpuFilterExec(self.node.condition, self._host_child())
+
+
+class AggregateMeta(PlanMeta):
+    def __init__(self, node, conf, parent):
+        super().__init__(node, conf, parent)
+        schema = node.child.schema
+        self._wrap_exprs(node.keys, schema)
+        for fn, _name in node.aggs:
+            try:
+                b = fn.bind(schema)
+            except (KeyError, TypeError) as exc:
+                self.will_not_work(f"cannot bind {fn!r}: {exc}")
+                continue
+            self.agg_metas.append(AggMeta(b, self.conf))
+            if b.child is not None:
+                self.expr_metas.append(ExprMeta(b.child, self.conf))
+
+    def to_device(self):
+        return HashAggregateExec(self.node.keys, self.node.key_names,
+                                 self.node.aggs, self._device_child())
+
+    def to_host(self):
+        return H.CpuAggregateExec(self.node.keys, self.node.key_names,
+                                  self.node.aggs, self._host_child())
+
+
+class SortMeta(PlanMeta):
+    def __init__(self, node, conf, parent):
+        super().__init__(node, conf, parent)
+        self._wrap_exprs([e for e, _, _ in node.orders], node.child.schema)
+
+    def tag_self(self):
+        schema = self.node.child.schema
+        for e, _asc, _nf in self.node.orders:
+            if not isinstance(e, E.ColumnRef):
+                self.will_not_work(
+                    f"sort key {e!r} is not a column reference "
+                    "(planner pre-projection not yet implemented)")
+                continue
+            dt = schema[e.name].data_type
+            if isinstance(dt, t.DecimalType) and dt.is_wide:
+                self.will_not_work("decimal128 sort key not yet on device")
+
+    def to_device(self):
+        from ..ops.sort import SortKey
+        schema = self.node.child.schema
+        keys = [SortKey(schema.field_index(e.name), asc, nf)
+                for e, asc, nf in self.node.orders]
+        return SortExec(keys, self._device_child(),
+                        global_sort=self.node.global_sort)
+
+    def to_host(self):
+        return H.CpuSortExec(self.node.orders, self._host_child())
+
+
+class LimitMeta(PlanMeta):
+    def to_device(self):
+        return GlobalLimitExec(self.node.limit, self._device_child())
+
+    def to_host(self):
+        return H.CpuLimitExec(self.node.limit, self._host_child())
+
+
+class JoinMeta(PlanMeta):
+    _DEVICE_TYPES = {"inner", "left_outer", "right_outer", "full_outer",
+                     "left_semi", "left_anti", "cross"}
+
+    def __init__(self, node, conf, parent):
+        super().__init__(node, conf, parent)
+        self._wrap_exprs(node.left_keys, node.left.schema)
+        self._wrap_exprs(node.right_keys, node.right.schema)
+
+    def tag_self(self):
+        if self.node.join_type not in self._DEVICE_TYPES:
+            self.will_not_work(
+                f"join type {self.node.join_type} not supported on TPU")
+
+    def to_device(self):
+        from ..exec.join import CrossJoinExec, HashJoinExec
+        left = self._device_child(0)
+        right = self._device_child(1)
+        if self.node.join_type == "cross":
+            return CrossJoinExec(left, right)
+        return HashJoinExec(self.node.join_type, self.node.left_keys,
+                            self.node.right_keys, left, right)
+
+    def to_host(self):
+        return H.CpuJoinExec(self.node.join_type, self.node.left_keys,
+                             self.node.right_keys,
+                             self._host_child(0), self._host_child(1))
+
+
+class UnionMeta(PlanMeta):
+    def convert(self):
+        kids = [c.convert() for c in self.children]
+        if self.can_replace and not self.conf.explain_only:
+            dev = [k if kind == "device" else H.HostToDeviceExec(k)
+                   for kind, k in kids]
+            return "device", UnionExec(*dev)
+        host = [k if kind == "host" else H.DeviceToHostExec(k)
+                for kind, k in kids]
+        return "host", H.CpuUnionExec(*host)
+
+
+class RangeMeta(PlanMeta):
+    def to_device(self):
+        n = self.node
+        return RangeExec(n.start, n.end, n.step, n.col_name)
+
+    def to_host(self):
+        n = self.node
+        return H.CpuRangeExec(n.start, n.end, n.step, n.col_name)
+
+
+class ExpandMeta(PlanMeta):
+    def __init__(self, node, conf, parent):
+        super().__init__(node, conf, parent)
+        for p in node.projections:
+            self._wrap_exprs(p, node.child.schema)
+
+    def to_device(self):
+        return ExpandExec(self.node.projections, self.node.names,
+                          self._device_child())
+
+    def to_host(self):
+        return H.CpuExpandExec(self.node.projections, self.node.names,
+                               self._host_child())
+
+
+_META_FOR: Dict[type, Type[PlanMeta]] = {
+    L.LogicalScan: ScanMeta,
+    L.LogicalProject: ProjectMeta,
+    L.LogicalFilter: FilterMeta,
+    L.LogicalAggregate: AggregateMeta,
+    L.LogicalSort: SortMeta,
+    L.LogicalLimit: LimitMeta,
+    L.LogicalJoin: JoinMeta,
+    L.LogicalUnion: UnionMeta,
+    L.LogicalRange: RangeMeta,
+    L.LogicalExpand: ExpandMeta,
+}
+
+
+class UnknownMeta(PlanMeta):
+    """Nodes with no meta: always CPU (and no CPU impl -> plan error)."""
+
+    def tag_self(self):
+        self.will_not_work(
+            f"operator {type(self.node).__name__} has no TPU rule")
+
+    def to_host(self):
+        raise NotImplementedError(
+            f"no CPU fallback implementation for {type(self.node).__name__}")
+
+
+def wrap_plan(node: L.LogicalPlan, conf: TpuConf,
+              parent: Optional[PlanMeta] = None) -> PlanMeta:
+    meta_cls = _META_FOR.get(type(node), UnknownMeta)
+    return meta_cls(node, conf, parent)
+
+
+# ---------------------------------------------------------------------------
+# Entry point (GpuOverrides.applyOverrides analogue)
+# ---------------------------------------------------------------------------
+
+class PhysicalQuery:
+    """Tagged + converted plan, ready to run."""
+
+    def __init__(self, meta: PlanMeta, kind: str, root, conf: TpuConf):
+        self.meta = meta
+        self.kind = kind           # "device" | "host" at the root
+        self.root = root
+        self.conf = conf
+
+    def explain(self) -> str:
+        return "\n".join(self.meta.explain_lines())
+
+    def physical_tree(self) -> str:
+        return self.root.tree_string()
+
+    def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
+        ctx = ctx or ExecContext(self.conf)
+        return self.root.collect(ctx)
+
+    def execute_host_batches(self, ctx: Optional[ExecContext] = None):
+        """Stream results as pyarrow RecordBatches."""
+        ctx = ctx or ExecContext(self.conf)
+        if self.kind == "device":
+            node = H.DeviceToHostExec(self.root)
+        else:
+            node = self.root
+        yield from node.execute(ctx)
+
+
+def apply_overrides(plan: L.LogicalPlan,
+                    conf: TpuConf = DEFAULT_CONF) -> PhysicalQuery:
+    """wrapAndTagPlan + doConvertPlan + explain logging."""
+    meta = wrap_plan(plan, conf)
+    meta.tag()
+    mode = conf.explain
+    if mode != "NONE":
+        for line in meta.explain_lines():
+            if mode == "ALL" or line.lstrip().startswith("!"):
+                log.info(line)
+    kind, root = meta.convert()
+    return PhysicalQuery(meta, kind, root, conf)
+
+
+# ---------------------------------------------------------------------------
+# supported_ops doc generation (reference TypeChecks -> docs/supported_ops.md)
+# ---------------------------------------------------------------------------
+
+def generate_supported_ops() -> str:
+    lines = ["# Supported expressions and operators", "",
+             "Generated from the overrides rule registry "
+             "(plan/overrides.py).", "",
+             "## Execs", "", "| operator | supported output types |",
+             "|---|---|"]
+    for cls, rule in sorted(_EXEC_RULES.items(), key=lambda kv: kv[0].__name__):
+        lines.append(f"| {cls.__name__.removeprefix('Logical')} | "
+                     f"{', '.join(sorted(rule.output_sig.tags))} |")
+    lines += ["", "## Expressions", "",
+              "| expression | input types | output types |", "|---|---|---|"]
+    for cls, rule in sorted(_EXPR_RULES.items(), key=lambda kv: kv[0].__name__):
+        lines.append(f"| {cls.__name__} | "
+                     f"{', '.join(sorted(rule.input_sig.tags))} | "
+                     f"{', '.join(sorted(rule.output_sig.tags))} |")
+    lines += ["", "## Aggregate functions", "",
+              "| function | input types |", "|---|---|"]
+    for cls, rule in sorted(_AGG_RULES.items(), key=lambda kv: kv[0].__name__):
+        lines.append(f"| {cls.__name__} | "
+                     f"{', '.join(sorted(rule.input_sig.tags))} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    import pathlib
+    out = pathlib.Path(__file__).resolve().parent.parent.parent / "docs"
+    out.mkdir(exist_ok=True)
+    (out / "supported_ops.md").write_text(generate_supported_ops())
+    print(f"wrote {out / 'supported_ops.md'}")

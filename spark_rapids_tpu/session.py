@@ -1,0 +1,165 @@
+"""User-facing session + DataFrame API over the overrides engine.
+
+The reference is driven through a SparkSession with the plugin injected
+(Plugin.scala:426 driver plugin, SQLExecPlugin.scala:27); queries are
+ordinary DataFrames and the plugin rewrites their physical plans.  This
+engine owns the whole stack, so the session plays both roles: it holds the
+TpuConf (re-read per query, GpuOverrides.scala:4571) and hands out
+DataFrames whose `collect()` runs wrap->tag->convert->execute.
+
+    s = TpuSession({"spark.rapids.tpu.sql.explain": "NOT_ON_TPU"})
+    df = s.from_arrow(table).filter(col("x") > lit(1)).group_by("k") \
+         .agg((Sum(col("x")), "sx"))
+    df.collect()      # pyarrow Table
+    df.explain()      # placement decisions with fallback reasons
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from . import types as t
+from .config import TpuConf
+from .exec.plan import ExecContext
+from .plan import expressions as E
+from .plan import logical as L
+from .plan.aggregates import AggregateFunction
+from .plan.overrides import PhysicalQuery, apply_overrides
+
+
+class TpuSession:
+    def __init__(self, conf: Optional[Dict] = None):
+        self.conf = conf if isinstance(conf, TpuConf) else TpuConf(conf)
+
+    def set_conf(self, key: str, value) -> None:
+        raw = dict(self.conf._raw)
+        raw[key] = value
+        self.conf = TpuConf(raw)
+
+    # -- sources -----------------------------------------------------------
+    def from_arrow(self, table: pa.Table) -> "DataFrame":
+        return DataFrame(L.LogicalScan(table), self)
+
+    def from_pydict(self, data: dict, schema=None) -> "DataFrame":
+        return self.from_arrow(pa.table(data, schema=schema))
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              name: str = "id") -> "DataFrame":
+        if end is None:
+            start, end = 0, start
+        return DataFrame(L.LogicalRange(start, end, step, name), self)
+
+    def read_parquet(self, *paths: str, columns=None) -> "DataFrame":
+        from .io.parquet import LogicalParquetScan
+        return DataFrame(LogicalParquetScan(list(paths), columns), self)
+
+    def read_csv(self, *paths: str, schema=None, **opts) -> "DataFrame":
+        from .io.text import LogicalCsvScan
+        return DataFrame(LogicalCsvScan(list(paths), schema, opts), self)
+
+    def read_json(self, *paths: str, schema=None, **opts) -> "DataFrame":
+        from .io.text import LogicalJsonScan
+        return DataFrame(LogicalJsonScan(list(paths), schema, opts), self)
+
+
+class GroupedData:
+    def __init__(self, df: "DataFrame", keys: Sequence):
+        self._df = df
+        self._keys = list(keys)
+
+    def agg(self, *aggs: Tuple[AggregateFunction, str]) -> "DataFrame":
+        return DataFrame(
+            L.LogicalAggregate(self._keys, list(aggs), self._df._plan),
+            self._df._session)
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan, session: TpuSession):
+        self._plan = plan
+        self._session = session
+
+    # -- transformations ---------------------------------------------------
+    def select(self, *exprs, names: Optional[Sequence[str]] = None
+               ) -> "DataFrame":
+        return self._wrap(L.LogicalProject(list(exprs), self._plan, names))
+
+    def with_column(self, name: str, expr: E.Expression) -> "DataFrame":
+        exprs = [E.ColumnRef(n) for n in self.schema.names
+                 if n != name] + [expr]
+        names = [n for n in self.schema.names if n != name] + [name]
+        return self._wrap(L.LogicalProject(exprs, self._plan, names))
+
+    def filter(self, condition) -> "DataFrame":
+        return self._wrap(L.LogicalFilter(condition, self._plan))
+
+    where = filter
+
+    def group_by(self, *keys) -> GroupedData:
+        return GroupedData(self, keys)
+
+    def agg(self, *aggs: Tuple[AggregateFunction, str]) -> "DataFrame":
+        return GroupedData(self, ()).agg(*aggs)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             left_on=None, right_on=None) -> "DataFrame":
+        if on is not None:
+            keys = [on] if isinstance(on, str) else list(on)
+            left_on = right_on = keys
+        return self._wrap(L.LogicalJoin(how, self._plan, other._plan,
+                                        left_on or [], right_on or []))
+
+    def sort(self, *orders, global_sort: bool = True) -> "DataFrame":
+        return self._wrap(L.LogicalSort(list(orders), self._plan,
+                                        global_sort))
+
+    order_by = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._wrap(L.LogicalLimit(n, self._plan))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return self._wrap(L.LogicalUnion(self._plan, other._plan))
+
+    # -- actions -----------------------------------------------------------
+    @property
+    def schema(self) -> t.StructType:
+        return self._plan.schema
+
+    def physical(self) -> PhysicalQuery:
+        return apply_overrides(self._plan, self._session.conf)
+
+    def collect(self) -> pa.Table:
+        return self.physical().collect()
+
+    def to_pydict(self) -> dict:
+        return self.collect().to_pydict()
+
+    def count(self) -> int:
+        from .plan.aggregates import Count
+        res = self.agg((Count(None), "count")).collect()
+        return res.column("count").to_pylist()[0]
+
+    def explain(self) -> str:
+        q = self.physical()
+        return q.explain() + "\n\nPhysical plan:\n" + q.physical_tree()
+
+    def logical_tree(self) -> str:
+        return self._plan.tree_string()
+
+    def write_parquet(self, path: str, **opts) -> None:
+        from .io.parquet import write_parquet
+        write_parquet(self, path, **opts)
+
+    def _wrap(self, plan: L.LogicalPlan) -> "DataFrame":
+        return DataFrame(plan, self._session)
+
+
+# -- convenience constructors (pyspark.sql.functions analogue) -------------
+
+def col(name: str) -> E.ColumnRef:
+    return E.ColumnRef(name)
+
+
+def lit(value, dtype: Optional[t.DataType] = None) -> E.Literal:
+    return E.Literal(value, dtype)
