@@ -1,0 +1,271 @@
+"""Parquet scan + write.
+
+Reference: GpuParquetScan.scala (2897 LoC, three reader strategies selected
+by spark.rapids.sql.format.parquet.reader.type), GpuMultiFileReader.scala:342
+(MULTITHREADED cloud reader: thread pool reads+filters footers and buffers
+files in parallel), GpuParquetFileFormat.scala + ColumnarOutputWriter.scala
+(device-encoded writes streamed to the filesystem).
+
+TPU realization: decode happens on host via pyarrow (Arrow C++ SIMD decode —
+the host-decode role the reference gives the GPU is deliberately NOT mapped
+to the TPU: XLA has no parquet decoder and byte-twiddling decode is a poor
+MXU/VPU fit; the win comes from overlapping decode with H2D upload and
+keeping all *compute* on device).  Strategies:
+
+  * PERFILE      — one file at a time, row-group granularity, in order.
+  * MULTITHREADED— a thread pool decodes (file, row-group) units ahead of
+                   the consumer (GpuMultiFileReader.scala:342 analogue);
+                   bounded lookahead caps host memory.
+  * COALESCING   — like MULTITHREADED but small row groups are concatenated
+                   up to the batch row target before upload (the
+                   MultiFileParquetPartitionReader stitching analogue).
+  * AUTO         — MULTITHREADED (the cloud-default heuristic).
+
+Row-group pruning: conjunctive `col <op> literal` predicates prune row
+groups via footer min/max statistics before any column data is read
+(GpuParquetFileFilterHandler analogue).
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, to_device
+from ..columnar.host import HostBatch, schema_to_struct, struct_to_schema
+from ..config import (PARQUET_MT_THREADS, PARQUET_READER_TYPE, TpuConf)
+from ..exec.host_exec import HostNode
+from ..exec.plan import ExecContext, PlanNode
+from ..plan import expressions as E
+from ..plan import logical as L
+
+
+# ---------------------------------------------------------------------------
+# Predicate pushdown: expression tree -> conjunctive (col, op, value) terms
+# ---------------------------------------------------------------------------
+
+_CMP = {E.EqualTo: "=", E.LessThan: "<", E.LessThanOrEqual: "<=",
+        E.GreaterThan: ">", E.GreaterThanOrEqual: ">="}
+
+
+def conjunctive_terms(expr: Optional[E.Expression]
+                      ) -> List[Tuple[str, str, object]]:
+    """Best-effort extraction of ANDed `col <op> literal` terms.  Terms that
+    don't fit the shape are skipped (pruning stays conservative)."""
+    if expr is None:
+        return []
+    if isinstance(expr, E.And):
+        return conjunctive_terms(expr.children[0]) + \
+            conjunctive_terms(expr.children[1])
+    op = _CMP.get(type(expr))
+    if op is None:
+        return []
+    l, r = expr.children
+    if isinstance(l, E.ColumnRef) and isinstance(r, E.Literal) \
+            and r.value is not None:
+        return [(l.name, op, r.value)]
+    if isinstance(r, E.ColumnRef) and isinstance(l, E.Literal) \
+            and l.value is not None:
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+        return [(r.name, flip[op], l.value)]
+    return []
+
+
+def _rg_maybe_matches(meta, name_to_idx, terms) -> bool:
+    """False only when stats PROVE no row in the group can match."""
+    for col, op, val in terms:
+        i = name_to_idx.get(col)
+        if i is None:
+            continue
+        st = meta.column(i).statistics
+        if st is None or not st.has_min_max:
+            continue
+        lo, hi = st.min, st.max
+        try:
+            if op == "=" and (val < lo or val > hi):
+                return False
+            if op in ("<", "<=") and not (lo < val or (op == "<=" and lo <= val)):
+                return False
+            if op in (">", ">=") and not (hi > val or (op == ">=" and hi >= val)):
+                return False
+        except TypeError:
+            continue      # incomparable stat types: keep the group
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Host-side batch production (shared by device scan and CPU fallback scan)
+# ---------------------------------------------------------------------------
+
+def _scan_units(paths: Sequence[str], terms) -> List[Tuple[str, int]]:
+    """(path, row_group) work units after row-group stat pruning."""
+    units = []
+    for p in paths:
+        pf = pq.ParquetFile(p)
+        schema = pf.schema_arrow
+        name_to_idx = {n: i for i, n in enumerate(schema.names)}
+        for rg in range(pf.metadata.num_row_groups):
+            if _rg_maybe_matches(pf.metadata.row_group(rg), name_to_idx,
+                                 terms):
+                units.append((p, rg))
+    return units
+
+
+def _read_unit(unit: Tuple[str, int], columns) -> pa.Table:
+    path, rg = unit
+    return pq.ParquetFile(path).read_row_group(rg, columns=columns)
+
+
+def host_batch_stream(paths: Sequence[str], columns, conf: TpuConf,
+                      filter_expr: Optional[E.Expression] = None,
+                      ) -> Iterator[pa.RecordBatch]:
+    """Ordered stream of decoded record batches per the reader strategy."""
+    strategy = str(conf.get(PARQUET_READER_TYPE)).upper()
+    if strategy == "AUTO":
+        strategy = "MULTITHREADED"
+    terms = conjunctive_terms(filter_expr)
+    units = _scan_units(paths, terms)
+    target = conf.batch_size_rows
+
+    def split(tbl: pa.Table) -> Iterator[pa.RecordBatch]:
+        yield from tbl.combine_chunks().to_batches(max_chunksize=target)
+
+    if strategy == "PERFILE" or not units:
+        for u in units:
+            yield from split(_read_unit(u, columns))
+        return
+
+    threads = conf.get(PARQUET_MT_THREADS)
+    lookahead = max(2, threads)
+    coalesce = strategy == "COALESCING"
+    pending: List[pa.Table] = []
+    pending_rows = 0
+    with cf.ThreadPoolExecutor(max_workers=threads) as pool:
+        futures = [pool.submit(_read_unit, u, columns) for u in
+                   units[:lookahead]]
+        nxt = lookahead
+        for i in range(len(units)):
+            tbl = futures[i].result()
+            if nxt < len(units):
+                futures.append(pool.submit(_read_unit, units[nxt], columns))
+                nxt += 1
+            if not coalesce:
+                yield from split(tbl)
+                continue
+            pending.append(tbl)
+            pending_rows += tbl.num_rows
+            if pending_rows >= target:
+                yield from split(pa.concat_tables(pending))
+                pending, pending_rows = [], 0
+        if pending:
+            yield from split(pa.concat_tables(pending))
+
+
+def parquet_schema(paths: Sequence[str], columns=None) -> t.StructType:
+    schema = pq.ParquetFile(paths[0]).schema_arrow
+    st = schema_to_struct(schema)
+    if columns:
+        return t.StructType([st[c] for c in columns])
+    return st
+
+
+# ---------------------------------------------------------------------------
+# Plan nodes
+# ---------------------------------------------------------------------------
+
+class LogicalParquetScan(L.LogicalPlan):
+    def __init__(self, paths: Sequence[str], columns=None):
+        super().__init__()
+        self.paths = list(paths)
+        self.columns = list(columns) if columns else None
+        self.pushed_filter: Optional[E.Expression] = None
+
+    def _resolve_schema(self):
+        return parquet_schema(self.paths, self.columns)
+
+    def describe(self):
+        extra = f", pushed={self.pushed_filter!r}" if self.pushed_filter else ""
+        return f"ParquetScan[{len(self.paths)} files{extra}]"
+
+
+class ParquetScanExec(PlanNode):
+    """Device scan: threaded host decode overlapped with H2D upload."""
+
+    def __init__(self, paths, columns, schema: t.StructType,
+                 filter_expr: Optional[E.Expression] = None):
+        super().__init__()
+        self.paths = list(paths)
+        self.columns = columns
+        self._schema = schema
+        self.filter_expr = filter_expr
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        for rb in host_batch_stream(self.paths, self.columns, ctx.conf,
+                                    self.filter_expr):
+            ctx.bump("scanned_rows", rb.num_rows)
+            yield to_device(HostBatch(rb), ctx.conf)
+
+    def describe(self):
+        return f"ParquetScanExec[{len(self.paths)} files]"
+
+
+class CpuParquetScanExec(HostNode):
+    def __init__(self, paths, columns, schema: t.StructType,
+                 filter_expr: Optional[E.Expression] = None):
+        super().__init__()
+        self.paths = list(paths)
+        self.columns = columns
+        self._schema = schema
+        self.filter_expr = filter_expr
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        yield from host_batch_stream(self.paths, self.columns, ctx.conf,
+                                     self.filter_expr)
+
+
+# ---------------------------------------------------------------------------
+# Writer (GpuParquetFileFormat / ColumnarOutputWriter analogue)
+# ---------------------------------------------------------------------------
+
+def write_parquet(df, path: str, partition_by: Optional[Sequence[str]] = None,
+                  compression: str = "zstd",
+                  row_group_rows: int = 1 << 20) -> None:
+    """Stream query results into parquet without materializing the whole
+    result (the reference streams device-encoded chunks through
+    HostBufferConsumer; here host batches stream into ParquetWriter)."""
+    q = df.physical()
+    schema = struct_to_schema(df.schema)
+    if partition_by:
+        import pyarrow.dataset as ds
+        tbl = q.collect()
+        ds.write_dataset(tbl, path, format="parquet",
+                         partitioning=ds.partitioning(
+                             pa.schema([schema.field(c) for c in partition_by]),
+                             flavor="hive"),
+                         existing_data_behavior="overwrite_or_ignore")
+        return
+    import pathlib
+    p = pathlib.Path(path)
+    if p.suffix != ".parquet":
+        p.mkdir(parents=True, exist_ok=True)
+        p = p / "part-00000.parquet"
+    writer = pq.ParquetWriter(str(p), schema, compression=compression)
+    try:
+        for rb in q.execute_host_batches():
+            if rb.num_rows == 0:
+                continue
+            writer.write_batch(rb.cast(schema) if rb.schema != schema else rb,
+                               row_group_size=row_group_rows)
+    finally:
+        writer.close()

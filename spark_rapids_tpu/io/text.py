@@ -1,0 +1,119 @@
+"""CSV / JSON scans.
+
+Reference: GpuCSVScan.scala:223 + GpuTextBasedPartitionReader (host line
+framing, device decode via Table.readCSV/readJSON), catalyst/json/rapids
+GpuJsonScan.  Here decode is pyarrow.csv / pyarrow.json on host threads
+(same reasoning as io/parquet.py: text parsing is not TPU work), producing
+the engine's standard host batch stream with threaded per-file lookahead.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+from typing import Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.json as pajson
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, to_device
+from ..columnar.host import HostBatch, schema_to_struct, struct_to_schema
+from ..exec.host_exec import HostNode
+from ..exec.plan import ExecContext, PlanNode
+from ..plan import logical as L
+
+
+def _read_csv(path: str, schema, opts) -> pa.Table:
+    convert = pacsv.ConvertOptions(
+        column_types=schema if schema is not None else None)
+    parse = pacsv.ParseOptions(delimiter=opts.get("sep", ","))
+    read = pacsv.ReadOptions(
+        column_names=opts.get("column_names"),
+        autogenerate_column_names=opts.get("header", True) is False
+        and opts.get("column_names") is None)
+    return pacsv.read_csv(path, read_options=read, parse_options=parse,
+                          convert_options=convert)
+
+
+def _read_json(path: str, schema, opts) -> pa.Table:
+    parse = pajson.ParseOptions(
+        explicit_schema=schema if schema is not None else None)
+    return pajson.read_json(path, parse_options=parse)
+
+
+def _stream(paths: Sequence[str], schema, opts, conf, reader
+            ) -> Iterator[pa.RecordBatch]:
+    target = conf.batch_size_rows
+    with cf.ThreadPoolExecutor(max_workers=min(8, max(1, len(paths)))) as pool:
+        futs = [pool.submit(reader, p, schema, opts) for p in paths]
+        for f in futs:
+            tbl = f.result()
+            yield from tbl.combine_chunks().to_batches(max_chunksize=target)
+
+
+class _TextLogicalScan(L.LogicalPlan):
+    reader = None
+    fmt = "text"
+
+    def __init__(self, paths: Sequence[str], schema=None, opts=None):
+        super().__init__()
+        self.paths = list(paths)
+        self.arrow_schema = schema
+        self.opts = dict(opts or {})
+
+    def _resolve_schema(self):
+        if self.arrow_schema is not None:
+            return schema_to_struct(self.arrow_schema)
+        tbl = type(self).reader(self.paths[0], None, self.opts)
+        return schema_to_struct(tbl.schema)
+
+    def describe(self):
+        return f"{type(self).__name__}[{len(self.paths)} files]"
+
+
+class LogicalCsvScan(_TextLogicalScan):
+    reader = staticmethod(_read_csv)
+    fmt = "csv"
+
+
+class LogicalJsonScan(_TextLogicalScan):
+    reader = staticmethod(_read_json)
+    fmt = "json"
+
+
+class TextScanExec(PlanNode):
+    def __init__(self, logical: _TextLogicalScan, schema: t.StructType):
+        super().__init__()
+        self.logical = logical
+        self._schema = schema
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        lg = self.logical
+        want = struct_to_schema(self._schema)
+        for rb in _stream(lg.paths, lg.arrow_schema, lg.opts, ctx.conf,
+                          type(lg).reader):
+            ctx.bump("scanned_rows", rb.num_rows)
+            if rb.schema != want:
+                rb = pa.Table.from_batches([rb]).cast(want) \
+                    .combine_chunks().to_batches()[0]
+            yield to_device(HostBatch(rb), ctx.conf)
+
+
+class CpuTextScanExec(HostNode):
+    def __init__(self, logical: _TextLogicalScan, schema: t.StructType):
+        super().__init__()
+        self.logical = logical
+        self._schema = schema
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        lg = self.logical
+        yield from _stream(lg.paths, lg.arrow_schema, lg.opts, ctx.conf,
+                           type(lg).reader)

@@ -30,8 +30,12 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple, Type
 import pyarrow as pa
 
 from .. import types as t
-from ..config import TpuConf, DEFAULT_CONF
+from ..config import ENABLED_FORMATS, TpuConf, DEFAULT_CONF
 from ..exec import host_exec as H
+from ..io.parquet import (CpuParquetScanExec, LogicalParquetScan,
+                          ParquetScanExec)
+from ..io.text import (CpuTextScanExec, LogicalCsvScan, LogicalJsonScan,
+                       TextScanExec)
 from ..exec.plan import (CoalesceBatchesExec, ExecContext, ExpandExec,
                          FilterExec, GlobalLimitExec, HashAggregateExec,
                          HostScanExec, PlanNode, ProjectExec, RangeExec,
@@ -120,6 +124,9 @@ exec_rule(L.LogicalJoin, _COMMON, "hash join")
 exec_rule(L.LogicalUnion, t.T.ALL_SIMPLE, "union")
 exec_rule(L.LogicalRange, t.T.ALL_SIMPLE, "range generator")
 exec_rule(L.LogicalExpand, _COMMON, "expand (grouping sets)")
+exec_rule(LogicalParquetScan, t.T.ALL_SIMPLE, "parquet scan")
+exec_rule(LogicalCsvScan, t.T.ALL_SIMPLE, "csv scan")
+exec_rule(LogicalJsonScan, t.T.ALL_SIMPLE, "json scan")
 
 
 # ---------------------------------------------------------------------------
@@ -461,6 +468,38 @@ class ExpandMeta(PlanMeta):
                                self._host_child())
 
 
+class ParquetScanMeta(PlanMeta):
+    def tag_self(self):
+        if not self.conf.get(ENABLED_FORMATS["parquet"]):
+            self.will_not_work(
+                "parquet scan disabled by "
+                "spark.rapids.tpu.sql.format.parquet.enabled")
+
+    def to_device(self):
+        n = self.node
+        return ParquetScanExec(n.paths, n.columns, n.schema, n.pushed_filter)
+
+    def to_host(self):
+        n = self.node
+        return CpuParquetScanExec(n.paths, n.columns, n.schema,
+                                  n.pushed_filter)
+
+
+class TextScanMeta(PlanMeta):
+    def tag_self(self):
+        fmt = type(self.node).fmt
+        if not self.conf.get(ENABLED_FORMATS[fmt]):
+            self.will_not_work(
+                f"{fmt} scan disabled by "
+                f"spark.rapids.tpu.sql.format.{fmt}.enabled")
+
+    def to_device(self):
+        return TextScanExec(self.node, self.node.schema)
+
+    def to_host(self):
+        return CpuTextScanExec(self.node, self.node.schema)
+
+
 _META_FOR: Dict[type, Type[PlanMeta]] = {
     L.LogicalScan: ScanMeta,
     L.LogicalProject: ProjectMeta,
@@ -472,6 +511,9 @@ _META_FOR: Dict[type, Type[PlanMeta]] = {
     L.LogicalUnion: UnionMeta,
     L.LogicalRange: RangeMeta,
     L.LogicalExpand: ExpandMeta,
+    LogicalParquetScan: ParquetScanMeta,
+    LogicalCsvScan: TextScanMeta,
+    LogicalJsonScan: TextScanMeta,
 }
 
 
@@ -526,9 +568,22 @@ class PhysicalQuery:
         yield from node.execute(ctx)
 
 
+def _push_down_filters(plan: L.LogicalPlan) -> None:
+    """Scan pushdown pre-pass: a Filter directly above a parquet scan hands
+    its condition to the scan for row-group stat pruning (the filter itself
+    stays — pruning is a bandwidth optimization, not an evaluation).
+    Reference: GpuParquetFileFilterHandler row-group filtering."""
+    if isinstance(plan, L.LogicalFilter) and \
+            isinstance(plan.child, LogicalParquetScan):
+        plan.child.pushed_filter = plan.condition
+    for c in plan.children:
+        _push_down_filters(c)
+
+
 def apply_overrides(plan: L.LogicalPlan,
                     conf: TpuConf = DEFAULT_CONF) -> PhysicalQuery:
     """wrapAndTagPlan + doConvertPlan + explain logging."""
+    _push_down_filters(plan)
     meta = wrap_plan(plan, conf)
     meta.tag()
     mode = conf.explain
