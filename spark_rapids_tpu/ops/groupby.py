@@ -186,10 +186,12 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity):
         group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
         outs = []
         for spec in agg_specs:
-            d = agg_data[spec.input_idx][perm] if spec.input_idx >= 0 else None
-            v = agg_valid[spec.input_idx]
-            v = (jnp.ones((capacity,), bool) if v is None else v)[perm] \
-                if spec.input_idx >= 0 else s_live
+            if spec.input_idx >= 0:
+                d = agg_data[spec.input_idx][perm]
+                v = agg_valid[spec.input_idx]
+                v = (jnp.ones((capacity,), bool) if v is None else v)[perm]
+            else:
+                d, v = None, s_live
             vl = (v & s_live) if d is not None else s_live
             dt = spec.dtype
             if spec.kind == COUNT_ALL:

@@ -1,0 +1,238 @@
+"""Byte-level string kernels over (offsets, bytes) tensors.
+
+Reference: cuDF strings columns are first-class device (offsets, chars)
+buffers and stringFunctions.scala composes ~4k LoC of kernels over them.
+TPU-first re-design: row strings stay dictionary-encoded (columnar/
+device.py), and byte-level kernels run over the *dictionary's* byte
+tensors — O(unique) device work instead of O(rows) — with per-row results
+materialized by a code gather.  This makes predicates (startswith /
+endswith / contains / LIKE) fully device-evaluated while transforms
+(upper/trim/substr/...) rewrite the dictionary host-side (plan/strings.py).
+
+Byte tensors are padded to the same geometric capacity buckets as row
+batches so the jit cache stays bounded; the evaluator's content-keyed aux
+cache means each distinct dictionary uploads once.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from ..columnar.device import bucket_capacity
+from ..config import TpuConf, DEFAULT_CONF
+
+
+def dict_byte_tensors(dictionary: Optional[pa.Array],
+                      conf: TpuConf = DEFAULT_CONF
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """(offsets int32[cap_n+1], bytes uint8[cap_b]) of a string dictionary.
+
+    offsets[i]..offsets[i+1] bound entry i's utf-8 bytes; offsets beyond the
+    dictionary repeat the total so padded entries read as empty strings.
+    """
+    if dictionary is None or len(dictionary) == 0:
+        return (np.zeros(2, np.int32), np.zeros(1, np.uint8))
+    arr = dictionary.cast(pa.string())
+    joined = "".join((v.as_py() or "") for v in arr)
+    raw = joined.encode("utf-8")
+    lens = np.array([len(((v.as_py()) or "").encode("utf-8")) for v in arr],
+                    np.int32)
+    offs = np.zeros(len(arr) + 1, np.int32)
+    np.cumsum(lens, out=offs[1:])
+    cap_n = bucket_capacity(len(arr) + 1, conf)
+    cap_b = bucket_capacity(max(len(raw), 1), conf)
+    offsets = np.full(cap_n + 1, offs[-1], np.int32)
+    offsets[:len(offs)] = offs
+    bytes_ = np.zeros(cap_b, np.uint8)
+    bytes_[:len(raw)] = np.frombuffer(raw, np.uint8)
+    return offsets, bytes_
+
+
+# ---------------------------------------------------------------------------
+# Device kernels (traced): per-dictionary-entry boolean / int results
+# ---------------------------------------------------------------------------
+
+def char_lengths(offsets: jax.Array, bytes_: jax.Array) -> jax.Array:
+    """Unicode character count per entry (Spark length()).  A char starts
+    at every byte that is not a UTF-8 continuation byte (0b10xxxxxx)."""
+    lead = ((bytes_ & 0xC0) != 0x80).astype(jnp.int32)
+    csum = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(lead)])
+    n = offsets.shape[0] - 1
+    lo = jnp.clip(offsets[:n], 0, bytes_.shape[0])
+    hi = jnp.clip(offsets[1:], 0, bytes_.shape[0])
+    return csum[hi] - csum[lo]
+
+
+def byte_lengths(offsets: jax.Array) -> jax.Array:
+    return offsets[1:] - offsets[:-1]
+
+
+def _entry_bounds(offsets: jax.Array):
+    n = offsets.shape[0] - 1
+    return offsets[:n], offsets[1:]
+
+
+def match_prefix(offsets: jax.Array, bytes_: jax.Array,
+                 pat: bytes) -> jax.Array:
+    """bool[n]: entry starts with `pat` (byte-wise; exact for UTF-8)."""
+    lo, hi = _entry_bounds(offsets)
+    p = len(pat)
+    ok = (hi - lo) >= p
+    cap = bytes_.shape[0]
+    for k, b in enumerate(pat):
+        idx = jnp.clip(lo + k, 0, cap - 1)
+        ok = ok & (bytes_[idx] == np.uint8(b))
+    return ok
+
+
+def match_suffix(offsets: jax.Array, bytes_: jax.Array,
+                 pat: bytes) -> jax.Array:
+    lo, hi = _entry_bounds(offsets)
+    p = len(pat)
+    ok = (hi - lo) >= p
+    cap = bytes_.shape[0]
+    for k, b in enumerate(pat):
+        idx = jnp.clip(hi - p + k, 0, cap - 1)
+        ok = ok & (bytes_[idx] == np.uint8(b))
+    return ok
+
+
+def match_contains(offsets: jax.Array, bytes_: jax.Array,
+                   pat: bytes) -> jax.Array:
+    """bool[n]: `pat` occurs in entry.  Sliding window match over the byte
+    lane, then a per-entry any() via prefix sums — one pass, no loops over
+    entries."""
+    lo, hi = _entry_bounds(offsets)
+    p = len(pat)
+    if p == 0:
+        return jnp.ones(lo.shape, bool)
+    cap = bytes_.shape[0]
+    window = jnp.ones((cap,), bool)
+    for k, b in enumerate(pat):
+        shifted = jnp.roll(bytes_, -k) if k else bytes_
+        window = window & (shifted == np.uint8(b))
+    # window[j] = bytes[j:j+p] == pat (rolled bytes wrap; guard via bounds)
+    wsum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                            jnp.cumsum(window.astype(jnp.int32))])
+    # valid starts for entry i: j in [lo, hi - p]
+    start_lo = jnp.clip(lo, 0, cap)
+    start_hi = jnp.clip(hi - p + 1, 0, cap)
+    start_hi = jnp.maximum(start_hi, start_lo)
+    return (wsum[start_hi] - wsum[start_lo]) > 0
+
+
+def match_equals(offsets: jax.Array, bytes_: jax.Array,
+                 pat: bytes) -> jax.Array:
+    lo, hi = _entry_bounds(offsets)
+    return match_prefix(offsets, bytes_, pat) & ((hi - lo) == len(pat))
+
+
+# ---------------------------------------------------------------------------
+# LIKE compilation (reference GpuLike via cudf regex; RegexParser.scala
+# rejects untranslatable patterns — same pattern here: simple shapes run as
+# device byte kernels, the general case evaluates host-side per dictionary)
+# ---------------------------------------------------------------------------
+
+class LikePlan:
+    """Compiled LIKE pattern: either a device kernel composition or None
+    (host fallback)."""
+
+    def __init__(self, kind: str, parts: List[bytes]):
+        self.kind = kind      # equals|prefix|suffix|contains|prefix_suffix
+        self.parts = parts
+
+    def eval_device(self, offsets, bytes_) -> jax.Array:
+        if self.kind == "equals":
+            return match_equals(offsets, bytes_, self.parts[0])
+        if self.kind == "prefix":
+            return match_prefix(offsets, bytes_, self.parts[0])
+        if self.kind == "suffix":
+            return match_suffix(offsets, bytes_, self.parts[0])
+        if self.kind == "contains":
+            return match_contains(offsets, bytes_, self.parts[0])
+        if self.kind == "prefix_suffix":
+            pre, suf = self.parts
+            lo, hi = _entry_bounds(offsets)
+            return (match_prefix(offsets, bytes_, pre) &
+                    match_suffix(offsets, bytes_, suf) &
+                    ((hi - lo) >= (len(pre) + len(suf))))
+        raise AssertionError(self.kind)
+
+
+def compile_like(pattern: str, escape: str = "\\") -> Optional[LikePlan]:
+    """Device plan for simple LIKE shapes; None -> host regex fallback."""
+    # tokenize honoring the escape character
+    literal: List[str] = []
+    tokens: List[object] = []      # str literal chunks | "%" | "_"
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == escape and i + 1 < len(pattern):
+            literal.append(pattern[i + 1])
+            i += 2
+            continue
+        if c in ("%", "_"):
+            if literal:
+                tokens.append("".join(literal))
+                literal = []
+            tokens.append("%" if c == "%" else "_")
+            i += 1
+            continue
+        literal.append(c)
+        i += 1
+    if literal:
+        tokens.append("".join(literal))
+    if any(tk == "_" for tk in tokens):
+        return None
+    # collapse runs of %
+    coll: List[object] = []
+    for tk in tokens:
+        if tk == "%" and coll and coll[-1] == "%":
+            continue
+        coll.append(tk)
+    lits = [tk for tk in coll if tk != "%"]
+    enc = [s.encode("utf-8") for s in lits]
+    if not coll:
+        return LikePlan("equals", [b""])
+    if len(lits) == 0:      # only %
+        return LikePlan("contains", [b""])
+    if len(lits) == 1:
+        s = enc[0]
+        starts = coll[0] == "%"
+        ends = coll[-1] == "%"
+        if not starts and not ends:
+            return LikePlan("equals", [s])
+        if not starts and ends:
+            return LikePlan("prefix", [s])
+        if starts and not ends:
+            return LikePlan("suffix", [s])
+        return LikePlan("contains", [s])
+    if len(lits) == 2 and coll[0] != "%" and coll[-1] != "%" \
+            and len(coll) == 3:
+        return LikePlan("prefix_suffix", enc)
+    return None
+
+
+def like_to_regex(pattern: str, escape: str = "\\") -> str:
+    """Full-match regex equivalent of a LIKE pattern (host fallback path)."""
+    import re as _re
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == escape and i + 1 < len(pattern):
+            out.append(_re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(_re.escape(c))
+        i += 1
+    return "".join(out)

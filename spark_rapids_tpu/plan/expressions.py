@@ -321,11 +321,11 @@ class Alias(Expression):
         self.dtype = self.children[0].dtype
         self.nullable = self.children[0].nullable
 
-    def _eval_dev(self, ctx, kids):
-        return self.children[0].eval_dev(ctx)
+    def _prepare(self, pctx, kids):
+        return kids[0]          # forward dictionary metadata transparently
 
-    def eval_dev(self, ctx):
-        return self.children[0].eval_dev(ctx)
+    def _eval_dev(self, ctx, kids):
+        return kids[0]
 
     def eval_cpu(self, rb):
         return self.children[0].eval_cpu(rb)

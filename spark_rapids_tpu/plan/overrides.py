@@ -111,6 +111,22 @@ for _c in (E.Floor, E.Ceil):
     expr_rule(_c, t.T.NUMERIC, t.T.INTEGRAL, desc="rounding")
 expr_rule(E.Cast, t.T.ALL_SIMPLE, desc="cast (pairs gated by Cast itself)")
 
+from . import strings as STR  # noqa: E402  (registry population)
+
+for _c in (STR.Upper, STR.Lower, STR.InitCap, STR.StringTrim,
+           STR.StringTrimLeft, STR.StringTrimRight, STR.Substring,
+           STR.Concat, STR.ConcatWs, STR.StringReplace, STR.Lpad, STR.Rpad,
+           STR.StringRepeat, STR.Reverse, STR.SplitPart):
+    expr_rule(_c, t.T.STRING + t.T.INTEGRAL + t.T.NULL, t.T.STRING,
+              desc="string transform (dictionary rewrite)")
+for _c in (STR.Length, STR.OctetLength, STR.BitLength, STR.StringLocate,
+           STR.Instr, STR.Ascii):
+    expr_rule(_c, t.T.STRING + t.T.INTEGRAL, t.T.INTEGRAL,
+              desc="string measure (device byte kernel / dict gather)")
+for _c in (STR.StartsWith, STR.EndsWith, STR.Contains, STR.Like, STR.RLike):
+    expr_rule(_c, t.T.STRING, t.T.BOOLEAN,
+              desc="string predicate (device byte kernel)")
+
 for _c in (Count, Sum, Min, Max, Average, First, Last, BoolAnd, BoolOr):
     agg_rule(_c, _COMMON, desc="aggregate function")
 

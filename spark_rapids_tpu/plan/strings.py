@@ -1,0 +1,739 @@
+"""String expressions (reference stringFunctions.scala, ~4k LoC).
+
+Two TPU evaluation shapes (see ops/strings.py module docs):
+
+  * **Dictionary transforms** — upper/trim/substring/concat/replace/...
+    rewrite the column's dictionary host-side during the prepare phase
+    (O(unique) python-exact Spark semantics); device work is zero — codes
+    and validity pass straight through, and downstream consumers (compare,
+    groupby, join, output) read the transformed dictionary from the
+    prepare-phase HostVal chain.
+  * **Device byte kernels** — startswith/endswith/contains/LIKE/length
+    evaluate over the dictionary's (offsets, bytes) tensors on device
+    (ops/strings.py) and gather per-row results through the code lane.
+
+CPU oracle (`eval_cpu`) implements the same Spark semantics row-wise —
+used for fallback and by every string test as the comparison oracle.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as t
+from ..ops import strings as S
+from ..ops.kernels import merge_validity, valid_or_true
+from .expressions import (DevVal, Expression, HostVal, Literal, PrepCtx)
+
+
+def _dict_or_empty(hv: HostVal) -> pa.Array:
+    if hv.dictionary is None:
+        return pa.array([], pa.string())
+    return hv.dictionary.cast(pa.string())
+
+
+def _is_string_literal(e: Expression) -> bool:
+    return isinstance(e, Literal) and isinstance(e.dtype, (t.StringType,
+                                                           t.NullType))
+
+
+def _literal_value(e: Expression):
+    return e.value if isinstance(e, Literal) else None
+
+
+class StringExpression(Expression):
+    """Shared tagging: children must be strings/ints per declared slots."""
+
+    def unsupported_reasons(self, conf):
+        return []
+
+
+# ---------------------------------------------------------------------------
+# Dictionary transforms
+# ---------------------------------------------------------------------------
+
+class DictTransform(StringExpression):
+    """Base: rewrites the single non-literal string child's dictionary.
+
+    Subclasses implement `_transform_value(s, args) -> str|None` with exact
+    Spark semantics; literal arguments are read at plan time.
+    """
+    #: indexes of children that must be literals (validated in reasons)
+    literal_slots: tuple = ()
+
+    def _resolve(self):
+        self.dtype = t.STRING
+        self.nullable = True
+
+    def _code_child_index(self) -> int:
+        for i, c in enumerate(self.children):
+            if not isinstance(c, Literal):
+                return i
+        return 0
+
+    def unsupported_reasons(self, conf):
+        out = []
+        non_lit = [i for i, c in enumerate(self.children)
+                   if not isinstance(c, Literal)
+                   and isinstance(c.dtype, (t.StringType, t.NullType))]
+        if len(non_lit) > 1:
+            out.append("more than one non-literal string operand "
+                       "(dictionary transform needs a single code lane)")
+        for i in self.literal_slots:
+            if i < len(self.children) and \
+                    not isinstance(self.children[i], Literal):
+                out.append(f"argument {i} must be a literal")
+        return out
+
+    def _args(self) -> List[object]:
+        return [_literal_value(c) if isinstance(c, Literal) else None
+                for c in self.children]
+
+    def _prepare(self, pctx: PrepCtx, kids: List[HostVal]) -> HostVal:
+        ci = self._code_child_index()
+        d = _dict_or_empty(kids[ci])
+        args = self._args()
+        vals = []
+        for v in d:
+            s = v.as_py()
+            vals.append(None if s is None else self._transform_value(s, args))
+        if not vals:
+            vals = [None]
+        return HostVal(pa.array(vals, pa.string()))
+
+    def _eval_dev(self, ctx, kids):
+        ci = self._code_child_index()
+        k = kids[ci]
+        valid = k.validity
+        for i, other in enumerate(kids):
+            if i != ci:
+                valid = merge_validity(valid, other.validity)
+        return DevVal(k.data, valid, t.STRING)
+
+    def _eval_cpu(self, rb, kids):
+        ci = self._code_child_index()
+        args = self._args()
+        base = kids[ci].cast(pa.string())
+        out = []
+        n = len(base)
+        valid_others = np.ones(n, bool)
+        for i, k in enumerate(kids):
+            if i != ci:
+                valid_others &= np.asarray(pc.is_valid(k))
+        for j, v in enumerate(base):
+            s = v.as_py()
+            if s is None or not valid_others[j]:
+                out.append(None)
+            else:
+                out.append(self._transform_value(s, args))
+        return pa.array(out, pa.string())
+
+    def _transform_value(self, s: str, args) -> Optional[str]:
+        raise NotImplementedError
+
+
+class Upper(DictTransform):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _transform_value(self, s, args):
+        return s.upper()
+
+
+class Lower(DictTransform):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _transform_value(self, s, args):
+        return s.lower()
+
+
+class InitCap(DictTransform):
+    """Spark initcap: first letter of each whitespace-separated word upper,
+    rest lower."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _transform_value(self, s, args):
+        out = []
+        cap = True
+        for ch in s.lower():
+            if cap and ch.isalpha():
+                out.append(ch.upper())
+                cap = False
+            else:
+                out.append(ch)
+            if ch == " ":
+                cap = True
+        return "".join(out)
+
+
+class StringTrim(DictTransform):
+    _strip = staticmethod(lambda s, chars: s.strip(chars))
+
+    def __init__(self, child, trim_chars: Optional[Expression] = None):
+        self.children = (child,) + ((trim_chars,) if trim_chars else ())
+        self.literal_slots = (1,) if trim_chars else ()
+
+    def _transform_value(self, s, args):
+        chars = args[1] if len(args) > 1 else None
+        return type(self)._strip(s, chars if chars is not None else None)
+
+
+class StringTrimLeft(StringTrim):
+    _strip = staticmethod(lambda s, chars: s.lstrip(chars))
+
+
+class StringTrimRight(StringTrim):
+    _strip = staticmethod(lambda s, chars: s.rstrip(chars))
+
+
+def _spark_substring(s: str, pos: int, length: Optional[int]) -> str:
+    n = len(s)
+    if length is not None and length <= 0:
+        return ""
+    if pos > 0:
+        start = pos - 1
+    elif pos == 0:
+        start = 0
+    else:
+        start = max(n + pos, 0)
+    end = n if length is None else min(start + length, n)
+    return s[start:end] if start < n else ""
+
+
+class Substring(DictTransform):
+    """substring(str, pos[, len]) — 1-based, Spark pos-0/negative rules."""
+    literal_slots = (1, 2)
+
+    def __init__(self, child, pos, length=None):
+        kids = (child, pos if isinstance(pos, Expression) else Literal(pos))
+        if length is not None:
+            kids += (length if isinstance(length, Expression)
+                     else Literal(length),)
+        self.children = kids
+
+    def _transform_value(self, s, args):
+        pos = args[1]
+        length = args[2] if len(args) > 2 else None
+        if pos is None:
+            return None
+        return _spark_substring(s, int(pos), None if length is None
+                                else int(length))
+
+
+class Concat(DictTransform):
+    """concat(...) over strings: null if any operand null."""
+
+    def __init__(self, *children):
+        self.children = tuple(children)
+
+    def _transform_value(self, s, args):
+        ci = self._code_child_index()
+        parts = []
+        for i, a in enumerate(args):
+            if i == ci:
+                parts.append(s)
+            elif a is None:
+                return None
+            else:
+                parts.append(str(a))
+        return "".join(parts)
+
+    def _eval_cpu(self, rb, kids):
+        # row-wise: supports ANY operand mix (this is the fallback engine
+        # for the >1 non-literal case the dictionary transform can't run)
+        cols = [k.cast(pa.string()).to_pylist() for k in kids]
+        out = []
+        for row in zip(*cols):
+            out.append(None if any(v is None for v in row)
+                       else "".join(row))
+        return pa.array(out, pa.string())
+
+
+class ConcatWs(DictTransform):
+    """concat_ws(sep, ...): skips null operands; null only if sep null."""
+    literal_slots = (0,)
+
+    def __init__(self, sep, *children):
+        sep = sep if isinstance(sep, Expression) else Literal(sep)
+        self.children = (sep,) + tuple(children)
+
+    def _code_child_index(self):
+        for i, c in enumerate(self.children[1:], start=1):
+            if not isinstance(c, Literal):
+                return i
+        return 1 if len(self.children) > 1 else 0
+
+    def _transform_value(self, s, args):
+        sep = args[0]
+        if sep is None:
+            return None
+        ci = self._code_child_index()
+        parts = []
+        for i, a in enumerate(args):
+            if i == 0:
+                continue
+            if i == ci:
+                parts.append(s)
+            elif a is not None:
+                parts.append(str(a))
+        return sep.join(parts)
+
+    def _null_fallback(self, args) -> Optional[str]:
+        """Result when the code child is null: nulls are SKIPPED by
+        concat_ws, so the remaining literal parts still join."""
+        sep = args[0]
+        if sep is None:
+            return None
+        ci = self._code_child_index()
+        return sep.join(str(a) for i, a in enumerate(args)
+                        if i != 0 and i != ci and a is not None)
+
+    def _prepare(self, pctx, kids):
+        ci = self._code_child_index()
+        d = _dict_or_empty(kids[ci])
+        args = self._args()
+        vals = []
+        for v in d:
+            s = v.as_py()
+            vals.append(None if s is None else self._transform_value(s, args))
+        fallback_code = len(vals)
+        vals.append(self._null_fallback(args))
+        pctx.add(self, np.asarray([fallback_code], np.int32))
+        return HostVal(pa.array(vals, pa.string()))
+
+    def _eval_dev(self, ctx, kids):
+        # null operands are SKIPPED (not propagated): null code-child rows
+        # remap to the literals-only fallback dictionary entry; only a null
+        # separator nulls the result.
+        (fallback,) = ctx.aux_of(self)
+        ci = self._code_child_index()
+        k = kids[ci]
+        kv = valid_or_true(k.validity, ctx.capacity)
+        data = jnp.where(kv, k.data, fallback[0])
+        sep_null = _literal_value(self.children[0]) is None and \
+            isinstance(self.children[0], Literal)
+        valid = jnp.zeros((ctx.capacity,), bool) if sep_null else None
+        return DevVal(data, valid, t.STRING)
+
+    def _eval_cpu(self, rb, kids):
+        args = self._args()
+        sep = args[0]
+        base = kids[self._code_child_index()].cast(pa.string())
+        out = []
+        for v in base:
+            s = v.as_py()
+            if sep is None:
+                out.append(None)
+            elif s is None:
+                # code child null: join remaining literal parts
+                parts = [str(a) for i, a in enumerate(args)
+                         if i != 0 and i != self._code_child_index()
+                         and a is not None]
+                out.append(sep.join(parts))
+            else:
+                out.append(self._transform_value(s, args))
+        return pa.array(out, pa.string())
+
+
+class StringReplace(DictTransform):
+    literal_slots = (1, 2)
+
+    def __init__(self, child, search, replace):
+        lift = lambda x: x if isinstance(x, Expression) else Literal(x)
+        self.children = (child, lift(search), lift(replace))
+
+    def _transform_value(self, s, args):
+        search, repl = args[1], args[2]
+        if search is None or search == "":
+            return s
+        return s.replace(search, repl if repl is not None else "")
+
+
+class StringPad(DictTransform):
+    literal_slots = (1, 2)
+    _left = True
+
+    def __init__(self, child, length, pad=" "):
+        lift = lambda x: x if isinstance(x, Expression) else Literal(x)
+        self.children = (child, lift(length), lift(pad))
+
+    def _transform_value(self, s, args):
+        length, pad = int(args[1]), args[2]
+        if length <= len(s):
+            return s[:length]
+        if not pad:
+            return s
+        fill = (pad * ((length - len(s)) // len(pad) + 1))[: length - len(s)]
+        return fill + s if self._left else s + fill
+
+
+class Lpad(StringPad):
+    _left = True
+
+
+class Rpad(StringPad):
+    _left = False
+
+
+class StringRepeat(DictTransform):
+    literal_slots = (1,)
+
+    def __init__(self, child, times):
+        lift = lambda x: x if isinstance(x, Expression) else Literal(x)
+        self.children = (child, lift(times))
+
+    def _transform_value(self, s, args):
+        return s * max(int(args[1]), 0)
+
+
+class Reverse(DictTransform):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _transform_value(self, s, args):
+        return s[::-1]
+
+
+class SplitPart(DictTransform):
+    """split_part(str, delim, part): 1-based; negative counts from end;
+    out of range -> empty string (Spark semantics)."""
+    literal_slots = (1, 2)
+
+    def __init__(self, child, delim, part):
+        lift = lambda x: x if isinstance(x, Expression) else Literal(x)
+        self.children = (child, lift(delim), lift(part))
+
+    def _transform_value(self, s, args):
+        delim, part = args[1], int(args[2])
+        if not delim:
+            return None
+        parts = s.split(delim)
+        idx = part - 1 if part > 0 else len(parts) + part
+        if part == 0 or idx < 0 or idx >= len(parts):
+            return ""
+        return parts[idx]
+
+
+# ---------------------------------------------------------------------------
+# Dictionary transforms with non-string results (int gather lanes)
+# ---------------------------------------------------------------------------
+
+class DictIntTransform(StringExpression):
+    """Host computes an int per dictionary entry; device gathers by code."""
+    result_type = t.INT
+
+    def _resolve(self):
+        self.dtype = type(self).result_type
+        self.nullable = True
+
+    def _per_entry(self, s: str, args) -> int:
+        raise NotImplementedError
+
+    def _args(self) -> List[object]:
+        return [_literal_value(c) if isinstance(c, Literal) else None
+                for c in self.children]
+
+    def _code_child_index(self) -> int:
+        for i, c in enumerate(self.children):
+            if not isinstance(c, Literal):
+                return i
+        return 0
+
+    def unsupported_reasons(self, conf):
+        out = []
+        for i, c in enumerate(self.children):
+            if i != self._code_child_index() and not isinstance(c, Literal):
+                out.append(f"argument {i} must be a literal")
+        return out
+
+    def _prepare(self, pctx, kids):
+        d = _dict_or_empty(kids[self._code_child_index()])
+        args = self._args()
+        vals = [0 if v.as_py() is None else self._per_entry(v.as_py(), args)
+                for v in d]
+        if not vals:
+            vals = [0]
+        pctx.add(self, np.asarray(vals, np.int32))
+        return HostVal()
+
+    def _eval_dev(self, ctx, kids):
+        (lane,) = ctx.aux_of(self)
+        k = kids[self._code_child_index()]
+        codes = jnp.clip(k.data, 0, lane.shape[0] - 1)
+        valid = k.validity
+        for i, other in enumerate(kids):
+            if i != self._code_child_index():
+                valid = merge_validity(valid, other.validity)
+        return DevVal(lane[codes], valid, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        args = self._args()
+        base = kids[self._code_child_index()].cast(pa.string())
+        out = [None if v.as_py() is None else self._per_entry(v.as_py(), args)
+               for v in base]
+        from ..columnar.host import dtype_to_arrow
+        return pa.array(out, dtype_to_arrow(self.dtype))
+
+
+class StringLocate(DictIntTransform):
+    """locate(substr, str[, start]): 1-based position, 0 if absent."""
+
+    def __init__(self, substr, string, start=1):
+        lift = lambda x: x if isinstance(x, Expression) else Literal(x)
+        self.children = (lift(substr), string, lift(start))
+
+    def _code_child_index(self):
+        return 1
+
+    def _per_entry(self, s, args):
+        sub, start = args[0], int(args[2])
+        if sub is None:
+            return 0
+        if start <= 0:
+            return 0
+        return s.find(sub, start - 1) + 1
+
+
+class Instr(DictIntTransform):
+    def __init__(self, string, substr):
+        lift = lambda x: x if isinstance(x, Expression) else Literal(x)
+        self.children = (string, lift(substr))
+
+    def _code_child_index(self):
+        return 0
+
+    def _per_entry(self, s, args):
+        sub = args[1]
+        return 0 if sub is None else s.find(sub) + 1
+
+
+class Ascii(DictIntTransform):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _per_entry(self, s, args):
+        return ord(s[0]) if s else 0
+
+
+# ---------------------------------------------------------------------------
+# Device byte-kernel expressions
+# ---------------------------------------------------------------------------
+
+class ByteKernelExpression(StringExpression):
+    """Base for expressions evaluating ops/strings.py kernels over the
+    dictionary byte tensors, gathered per row by code."""
+
+    def _string_child(self) -> Expression:
+        return self.children[0]
+
+    def _add_byte_tensors(self, pctx, hv: HostVal):
+        offsets, bytes_ = S.dict_byte_tensors(hv.dictionary, pctx.conf)
+        pctx.add(self, offsets)
+        pctx.add(self, bytes_)
+
+
+class Length(ByteKernelExpression):
+    """length(str): UTF-8 character count, computed on device from the
+    dictionary byte tensors (ops/strings.py char_lengths)."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.INT
+        self.nullable = self.children[0].nullable
+
+    def _prepare(self, pctx, kids):
+        self._add_byte_tensors(pctx, kids[0])
+        return HostVal()
+
+    def _eval_dev(self, ctx, kids):
+        offsets, bytes_ = ctx.aux_of(self)
+        lens = S.char_lengths(offsets, bytes_)
+        codes = jnp.clip(kids[0].data, 0, lens.shape[0] - 1)
+        return DevVal(lens[codes], kids[0].validity, t.INT)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.utf8_length(kids[0].cast(pa.string())).cast(pa.int32())
+
+
+class OctetLength(Length):
+    def _eval_dev(self, ctx, kids):
+        offsets, bytes_ = ctx.aux_of(self)
+        lens = S.byte_lengths(offsets)
+        codes = jnp.clip(kids[0].data, 0, lens.shape[0] - 1)
+        return DevVal(lens[codes], kids[0].validity, t.INT)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.binary_length(kids[0].cast(pa.string())).cast(pa.int32())
+
+
+class BitLength(Length):
+    def _eval_dev(self, ctx, kids):
+        offsets, bytes_ = ctx.aux_of(self)
+        lens = S.byte_lengths(offsets) * jnp.int32(8)
+        codes = jnp.clip(kids[0].data, 0, lens.shape[0] - 1)
+        return DevVal(lens[codes], kids[0].validity, t.INT)
+
+    def _eval_cpu(self, rb, kids):
+        return pc.multiply(
+            pc.binary_length(kids[0].cast(pa.string())).cast(pa.int32()),
+            pa.scalar(8, pa.int32()))
+
+
+class StringPredicate(ByteKernelExpression):
+    """base: predicate(str_expr, literal pattern) via device byte kernel."""
+    kernel = None
+    cpu_fn = None
+
+    def __init__(self, left, right):
+        lift = lambda x: x if isinstance(x, Expression) else Literal(x)
+        self.children = (left, lift(right))
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+        self.nullable = True
+
+    def unsupported_reasons(self, conf):
+        if not isinstance(self.children[1], Literal):
+            return ["search pattern must be a literal"]
+        return []
+
+    def _pattern(self) -> Optional[str]:
+        return _literal_value(self.children[1])
+
+    def _prepare(self, pctx, kids):
+        self._add_byte_tensors(pctx, kids[0])
+        return HostVal()
+
+    def _eval_dev(self, ctx, kids):
+        offsets, bytes_ = ctx.aux_of(self)
+        pat = self._pattern()
+        cap = ctx.capacity
+        if pat is None:
+            return DevVal(jnp.zeros((cap,), bool), jnp.zeros((cap,), bool),
+                          t.BOOLEAN)
+        mask = type(self).kernel(offsets, bytes_, pat.encode("utf-8"))
+        codes = jnp.clip(kids[0].data, 0, mask.shape[0] - 1)
+        return DevVal(mask[codes], kids[0].validity, t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        pat = self._pattern()
+        arr = kids[0].cast(pa.string())
+        if pat is None:
+            return pa.nulls(len(arr), pa.bool_())
+        return type(self).cpu_fn(arr, pat)
+
+
+class StartsWith(StringPredicate):
+    kernel = staticmethod(S.match_prefix)
+    cpu_fn = staticmethod(lambda a, p: pc.starts_with(a, pattern=p))
+
+
+class EndsWith(StringPredicate):
+    kernel = staticmethod(S.match_suffix)
+    cpu_fn = staticmethod(lambda a, p: pc.ends_with(a, pattern=p))
+
+
+class Contains(StringPredicate):
+    kernel = staticmethod(S.match_contains)
+    cpu_fn = staticmethod(lambda a, p: pc.match_substring(a, pattern=p))
+
+
+class Like(ByteKernelExpression):
+    """str LIKE pattern.  Simple shapes (prefix/suffix/contains/equals/
+    prefix%suffix) run as device byte kernels; general patterns evaluate
+    host-side per dictionary entry and gather (the reference's transpile-
+    or-reject pattern, RegexParser.scala:687)."""
+
+    def __init__(self, left, pattern: str, escape: str = "\\"):
+        self.children = (left,)
+        self.pattern = pattern
+        self.escape = escape
+        self._plan = S.compile_like(pattern, escape)
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+        self.nullable = self.children[0].nullable
+
+    def _prepare(self, pctx, kids):
+        if self._plan is not None:
+            self._add_byte_tensors(pctx, kids[0])
+        else:
+            import re
+            rx = re.compile(S.like_to_regex(self.pattern, self.escape),
+                            re.DOTALL)
+            d = _dict_or_empty(kids[0])
+            mask = np.array(
+                [bool(rx.fullmatch(v.as_py())) if v.as_py() is not None
+                 else False for v in d] or [False], bool)
+            pctx.add(self, mask)
+        return HostVal()
+
+    def _eval_dev(self, ctx, kids):
+        if self._plan is not None:
+            offsets, bytes_ = ctx.aux_of(self)
+            mask = self._plan.eval_device(offsets, bytes_)
+        else:
+            (mask,) = ctx.aux_of(self)
+        codes = jnp.clip(kids[0].data, 0, mask.shape[0] - 1)
+        return DevVal(mask[codes], kids[0].validity, t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        import re
+        rx = re.compile(S.like_to_regex(self.pattern, self.escape), re.DOTALL)
+        arr = kids[0].cast(pa.string())
+        return pa.array([None if v.as_py() is None
+                         else bool(rx.fullmatch(v.as_py())) for v in arr],
+                        pa.bool_())
+
+    def _fp_extra(self):
+        return f"{self.pattern!r}"
+
+
+class RLike(ByteKernelExpression):
+    """str RLIKE regex (unanchored find).  Evaluated host-side per
+    dictionary entry via Python `re` — a documented dialect deviation from
+    Java regex (the reference transpiles Java regex to the cuDF dialect and
+    rejects what doesn't map, RegexParser.scala; same contract here)."""
+
+    def __init__(self, left, pattern: str):
+        self.children = (left,)
+        self.pattern = pattern
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+        self.nullable = self.children[0].nullable
+
+    def _prepare(self, pctx, kids):
+        import re
+        rx = re.compile(self.pattern)
+        d = _dict_or_empty(kids[0])
+        mask = np.array([bool(rx.search(v.as_py()))
+                         if v.as_py() is not None else False for v in d]
+                        or [False], bool)
+        pctx.add(self, mask)
+        return HostVal()
+
+    def _eval_dev(self, ctx, kids):
+        (mask,) = ctx.aux_of(self)
+        codes = jnp.clip(kids[0].data, 0, mask.shape[0] - 1)
+        return DevVal(mask[codes], kids[0].validity, t.BOOLEAN)
+
+    def _eval_cpu(self, rb, kids):
+        import re
+        rx = re.compile(self.pattern)
+        arr = kids[0].cast(pa.string())
+        return pa.array([None if v.as_py() is None
+                         else bool(rx.search(v.as_py())) for v in arr],
+                        pa.bool_())
+
+    def _fp_extra(self):
+        return f"{self.pattern!r}"

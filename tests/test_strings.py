@@ -1,0 +1,211 @@
+"""String expression tests: device (dictionary/byte-kernel) path vs the
+CPU oracle path, over nulls / empties / unicode / dictionary reuse.
+
+Reference model: stringFunctions.scala rules + integration_tests
+string_test.py comparisons.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.ops import strings as S
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan import strings as STR
+from spark_rapids_tpu.session import DataFrame, TpuSession, col, lit
+
+VALUES = ["hello", "World", "", None, "héllo wörld", "  pad  ", "ab",
+          "hello", "xyzzy", "a%b_c", "ﬆﬁ", "LOW up", None, "tail hello"]
+
+
+@pytest.fixture(scope="module")
+def table():
+    return pa.table({
+        "s": pa.array(VALUES, pa.string()),
+        "i": pa.array(range(len(VALUES)), pa.int64()),
+    })
+
+
+def run_both(table, expr, name="r"):
+    """Evaluate expr through the device plan and through the CPU fallback
+    plan; return (device_list, cpu_list)."""
+    dev_s = TpuSession()
+    df = dev_s.from_arrow(table).select(col("i"), E.Alias(expr, name))
+    q = df.physical()
+    assert q.kind == "device", q.explain()
+    dev = q.collect().sort_by("i").column(name).to_pylist()
+    cpu_s = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    cpu = DataFrame(df._plan, cpu_s).collect().sort_by("i") \
+        .column(name).to_pylist()
+    return dev, cpu
+
+
+TRANSFORMS = [
+    ("upper", lambda: STR.Upper(col("s"))),
+    ("lower", lambda: STR.Lower(col("s"))),
+    ("initcap", lambda: STR.InitCap(col("s"))),
+    ("trim", lambda: STR.StringTrim(col("s"))),
+    ("ltrim", lambda: STR.StringTrimLeft(col("s"))),
+    ("rtrim", lambda: STR.StringTrimRight(col("s"))),
+    ("trim_chars", lambda: STR.StringTrim(col("s"), E.Literal("dl"))),
+    ("substr", lambda: STR.Substring(col("s"), 2, 3)),
+    ("substr_neg", lambda: STR.Substring(col("s"), -3)),
+    ("substr_zero", lambda: STR.Substring(col("s"), 0, 2)),
+    ("concat_lit", lambda: STR.Concat(col("s"), E.Literal("!"))),
+    ("concat_pre", lambda: STR.Concat(E.Literal(">>"), col("s"))),
+    ("concat_ws", lambda: STR.ConcatWs("-", col("s"), E.Literal("z"))),
+    ("replace", lambda: STR.StringReplace(col("s"), "l", "L")),
+    ("lpad", lambda: STR.Lpad(col("s"), 8, "*")),
+    ("rpad", lambda: STR.Rpad(col("s"), 8, "*")),
+    ("lpad_trunc", lambda: STR.Lpad(col("s"), 3)),
+    ("repeat", lambda: STR.StringRepeat(col("s"), 2)),
+    ("reverse", lambda: STR.Reverse(col("s"))),
+    ("split_part", lambda: STR.SplitPart(col("s"), "l", 2)),
+    ("split_part_neg", lambda: STR.SplitPart(col("s"), " ", -1)),
+]
+
+
+@pytest.mark.parametrize("name,make", TRANSFORMS, ids=[n for n, _ in TRANSFORMS])
+def test_transform_device_matches_cpu(table, name, make):
+    dev, cpu = run_both(table, make())
+    assert dev == cpu, name
+
+
+MEASURES = [
+    ("length", lambda: STR.Length(col("s"))),
+    ("octet_length", lambda: STR.OctetLength(col("s"))),
+    ("bit_length", lambda: STR.BitLength(col("s"))),
+    ("locate", lambda: STR.StringLocate("l", col("s"))),
+    ("locate_start", lambda: STR.StringLocate("l", col("s"), 4)),
+    ("instr", lambda: STR.Instr(col("s"), "o")),
+    ("ascii", lambda: STR.Ascii(col("s"))),
+]
+
+
+@pytest.mark.parametrize("name,make", MEASURES, ids=[n for n, _ in MEASURES])
+def test_measure_device_matches_cpu(table, name, make):
+    dev, cpu = run_both(table, make())
+    assert dev == cpu, name
+
+
+PREDICATES = [
+    ("startswith", lambda: STR.StartsWith(col("s"), "he")),
+    ("endswith", lambda: STR.EndsWith(col("s"), "lo")),
+    ("contains", lambda: STR.Contains(col("s"), "ll")),
+    ("contains_uni", lambda: STR.Contains(col("s"), "ö")),
+    ("startswith_empty", lambda: STR.StartsWith(col("s"), "")),
+    ("like_prefix", lambda: STR.Like(col("s"), "he%")),
+    ("like_suffix", lambda: STR.Like(col("s"), "%lo")),
+    ("like_contains", lambda: STR.Like(col("s"), "%ell%")),
+    ("like_exact", lambda: STR.Like(col("s"), "hello")),
+    ("like_both", lambda: STR.Like(col("s"), "h%o")),
+    ("like_underscore", lambda: STR.Like(col("s"), "h_llo")),
+    ("like_escape", lambda: STR.Like(col("s"), r"a\%b\_c")),
+    ("rlike", lambda: STR.RLike(col("s"), "l+o")),
+    ("rlike_anchor", lambda: STR.RLike(col("s"), "^[hW]")),
+]
+
+
+@pytest.mark.parametrize("name,make", PREDICATES, ids=[n for n, _ in PREDICATES])
+def test_predicate_device_matches_cpu(table, name, make):
+    dev, cpu = run_both(table, make())
+    assert dev == cpu, name
+
+
+def test_predicate_in_filter(table):
+    s = TpuSession()
+    out = s.from_arrow(table).filter(STR.Contains(col("s"), "hello")) \
+        .collect()
+    assert sorted(out.column("s").to_pylist()) == \
+        ["hello", "hello", "tail hello"]
+
+
+def test_nested_transform_chain(table):
+    # upper(trim(substr)) composes through the dictionary chain
+    expr = STR.Upper(STR.StringTrim(STR.Substring(col("s"), 1, 4)))
+    dev, cpu = run_both(table, expr)
+    assert dev == cpu
+
+
+def test_transform_feeds_comparison(table):
+    s = TpuSession()
+    out = s.from_arrow(table).filter(
+        E.EqualTo(STR.Upper(col("s")), E.Literal("HELLO"))).collect()
+    assert out.column("s").to_pylist() == ["hello", "hello"]
+
+
+def test_transform_feeds_groupby(table):
+    s = TpuSession()
+    from spark_rapids_tpu.plan.aggregates import Count
+    df = s.from_arrow(table).select(
+        E.Alias(STR.Lower(col("s")), "ls"), col("i")) \
+        .group_by("ls").agg((Count(None), "c"))
+    out = df.collect().sort_by("ls").to_pydict()
+    exp = {}
+    for v in VALUES:
+        key = v.lower() if v is not None else None
+        exp[key] = exp.get(key, 0) + 1
+    got = dict(zip(out["ls"], out["c"]))
+    assert got == exp
+
+
+def test_concat_two_columns_falls_back(table):
+    # two non-literal string lanes: dictionary transform impossible
+    tbl = table.append_column("s2", table.column("s"))
+    s = TpuSession()
+    df = s.from_arrow(tbl).select(
+        E.Alias(STR.Concat(col("s"), col("s2")), "c"), col("i"))
+    q = df.physical()
+    assert q.kind == "host"
+    assert "single code lane" in " ".join(q.meta.reasons)
+    out = q.collect().sort_by("i").column("c").to_pylist()
+    exp = [None if v is None else v + v for v in VALUES]
+    assert out == exp
+
+
+def test_null_pattern_predicate(table):
+    dev, cpu = run_both(table, STR.StartsWith(col("s"),
+                                              E.Literal(None, t.STRING)))
+    assert dev == cpu == [None] * len(VALUES)
+
+
+# ---------------------------------------------------------------------------
+# Kernel unit tests (ops/strings.py directly)
+# ---------------------------------------------------------------------------
+
+def test_byte_tensor_layout():
+    d = pa.array(["ab", "", "cdé"])
+    offsets, bytes_ = S.dict_byte_tensors(d)
+    assert offsets[0] == 0 and offsets[1] == 2 and offsets[2] == 2
+    assert offsets[3] == 2 + len("cdé".encode())
+    assert bytes(bytes_[:2].tobytes()) == b"ab"
+
+
+def test_compile_like_shapes():
+    assert S.compile_like("abc").kind == "equals"
+    assert S.compile_like("abc%").kind == "prefix"
+    assert S.compile_like("%abc").kind == "suffix"
+    assert S.compile_like("%abc%").kind == "contains"
+    assert S.compile_like("a%c").kind == "prefix_suffix"
+    assert S.compile_like("a_c") is None
+    assert S.compile_like("a%b%c") is None
+    assert S.compile_like(r"a\%b").kind == "equals"
+
+
+def test_match_kernels_direct():
+    import jax.numpy as jnp
+    d = pa.array(["hello", "hell", "he", "", "shell"])
+    offsets, bytes_ = S.dict_byte_tensors(d)
+    o, b = jnp.asarray(offsets), jnp.asarray(bytes_)
+    n = len(d)
+    assert list(np.asarray(S.match_prefix(o, b, b"hell"))[:n]) == \
+        [True, True, False, False, False]
+    assert list(np.asarray(S.match_suffix(o, b, b"ll"))[:n]) == \
+        [False, True, False, False, True]
+    assert list(np.asarray(S.match_contains(o, b, b"ell"))[:n]) == \
+        [True, True, False, False, True]
+    assert list(np.asarray(S.match_equals(o, b, b"he"))[:n]) == \
+        [False, False, True, False, False]
+    lens = np.asarray(S.char_lengths(o, b))[:n]
+    assert list(lens) == [5, 4, 2, 0, 5]
