@@ -264,7 +264,13 @@ def _device_column_to_arrow(col: DeviceColumn, num_rows: int,
     if isinstance(dt, t.DecimalType):
         if dt.is_wide:
             lo = data.astype(np.int64).view(np.uint64)
-            hi_lane = np.asarray(hi_np)[:num_rows].view(np.uint64)
+            if hi_np is None:
+                # device-computed wide result: single int64 lane, sign-extend
+                hi_np = np.where(data.astype(np.int64) < 0,
+                                 np.int64(-1), np.int64(0))
+                hi_lane = hi_np.view(np.uint64)
+            else:
+                hi_lane = np.asarray(hi_np)[:num_rows].view(np.uint64)
             lanes = np.empty((num_rows, 2), dtype=np.uint64)
             lanes[:, 0] = lo
             lanes[:, 1] = hi_lane

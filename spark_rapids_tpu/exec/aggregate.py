@@ -68,15 +68,17 @@ def _run_reduce(agg_cols: List[DeviceColumn], specs: List[G.AggSpec],
 
 
 def check_agg_buffers_supported(aggs) -> None:
-    """The two-lane (hi, lo) decimal buffer path isn't built; plan-time
-    tagging rejects these (aggregates.py unsupported_reasons) — fail fast
-    for direct API users too."""
+    """Decimal buffers ride the single int64 unscaled lane (sums whose
+    true value exceeds int64 null out — ops/decimal.py module docs).  Only
+    two-lane 128-bit HOST inputs are rejected; plan-time tagging does this
+    too (aggregates.py unsupported_reasons) — fail fast for direct API
+    users."""
     for fn, _name in aggs:
-        for _kind, bdt in fn.update_ops():
-            if isinstance(bdt, t.DecimalType):
-                raise NotImplementedError(
-                    f"decimal aggregation buffer ({fn.name}) not yet "
-                    "supported on device")
+        child = getattr(fn, "child", None)
+        if child is not None and E._consumes_wide_host(child):
+            raise NotImplementedError(
+                f"128-bit host decimal input to {fn.name} not supported "
+                "on device")
 
 
 def _storage_zeros(dt: t.DataType, capacity: int):
@@ -266,6 +268,9 @@ class HashAggregate:
         arrays = []
         for (d, v), spec in zip(fetched, self.update_specs):
             val = d.item() if bool(v) else None
+            if val is not None and isinstance(spec.dtype, t.DecimalType):
+                import decimal as pydec
+                val = pydec.Decimal(val).scaleb(-spec.dtype.scale)
             arrays.append(pa.array([val], dtype_to_arrow(spec.dtype)))
         names = self._buffer_names()
         rb = pa.RecordBatch.from_arrays(arrays, names)

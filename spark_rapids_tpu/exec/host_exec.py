@@ -256,14 +256,23 @@ class CpuAggregateExec(HostNode):
             out_arrays, out_fields = [], []
             for (col, fn), (_, oname) in zip(agg_specs, self.aggs):
                 fname, opts = fn.cpu_agg()
-                val = self._global_agg(work[col], fname, opts)
                 want = dtype_to_arrow(fn.dtype)
-                arr = pa.array([val.as_py()], type=want) if val is not None \
-                    else pa.nulls(1, want)
+                if fname == "_py":
+                    v = opts(work[col].to_pylist())
+                    arr = pa.array([v], type=want) if v is not None \
+                        else pa.nulls(1, want)
+                else:
+                    val = self._global_agg(work[col], fname, opts)
+                    arr = pa.array([val.as_py()], type=want) \
+                        if val is not None else pa.nulls(1, want)
                 out_arrays.append(arr)
                 out_fields.append(pa.field(oname, want))
             yield pa.RecordBatch.from_arrays(out_arrays,
                                              schema=pa.schema(out_fields))
+            return
+
+        if any(fn.cpu_agg()[0] == "_py" for _c, fn in agg_specs):
+            yield self._python_grouped(work, agg_specs)
             return
 
         gb_aggs = []
@@ -286,6 +295,51 @@ class CpuAggregateExec(HostNode):
             out_fields.append(pa.field(oname, a.type))
         tbl = pa.Table.from_arrays(out_arrays, schema=pa.schema(out_fields))
         yield HostBatch.from_table(tbl).rb
+
+    def _python_grouped(self, work: pa.Table, agg_specs) -> pa.RecordBatch:
+        """Pure-python grouped aggregation: the exact-semantics path for
+        aggregates pyarrow's TableGroupBy can't express (e.g. decimal avg
+        at Spark's result scale)."""
+        nk = len(self.keys)
+        key_cols = [work[f"_k{i}"].to_pylist() for i in range(nk)]
+        val_cols = [work[col].to_pylist() for col, _fn in agg_specs]
+        groups: dict = {}
+        order = []
+        for row in range(work.num_rows):
+            key = tuple(kc[row] for kc in key_cols)
+            g = groups.get(key)
+            if g is None:
+                g = groups[key] = [[] for _ in agg_specs]
+                order.append(key)
+            for j in range(len(agg_specs)):
+                g[j].append(val_cols[j][row])
+
+        def apply(fn, fname, opts, values):
+            nn = [v for v in values if v is not None]
+            if fname == "_py":
+                return opts(values)
+            if fname == "count":
+                mode = getattr(opts, "mode", "only_valid")
+                return len(values) if mode == "all" else len(nn)
+            if not nn:
+                return None
+            return {"sum": sum, "min": min, "max": max,
+                    "mean": lambda v: sum(v) / len(v),
+                    "first": lambda v: v[0], "last": lambda v: v[-1],
+                    }[fname](nn)
+
+        out_arrays, out_fields = [], []
+        for i, (kname, k) in enumerate(zip(self.key_names, self.keys)):
+            out_arrays.append(pa.array([key[i] for key in order],
+                                       dtype_to_arrow(k.dtype)))
+            out_fields.append(pa.field(kname, dtype_to_arrow(k.dtype)))
+        for j, ((_col, fn), (_, oname)) in enumerate(zip(agg_specs, self.aggs)):
+            fname, opts = fn.cpu_agg()
+            vals = [apply(fn, fname, opts, groups[key][j]) for key in order]
+            out_arrays.append(pa.array(vals, dtype_to_arrow(fn.dtype)))
+            out_fields.append(pa.field(oname, dtype_to_arrow(fn.dtype)))
+        return pa.RecordBatch.from_arrays(out_arrays,
+                                          schema=pa.schema(out_fields))
 
     @staticmethod
     def _arr(a, n):

@@ -185,7 +185,7 @@ class HashAggregateExec(PlanNode):
         for n, e in zip(self.key_names, self.key_exprs):
             fields.append(t.StructField(n, e.dtype))
         for fn, n in self.aggs:
-            fields.append(t.StructField(n, fn.result_type))
+            fields.append(t.StructField(n, fn.dtype))
         return t.StructType(fields)
 
     def _strip_filters(self, can_fuse: bool):

@@ -1,0 +1,138 @@
+"""Device calendar kernels over DATE (int32 days) / TIMESTAMP (int64 us UTC).
+
+Reference: datetimeExpressions.scala + GpuTimeZoneDB JNI (device timezone
+transition tables).  This engine keeps Spark's internal representations
+(days since epoch / micros since epoch UTC), so every calendar field is
+pure integer arithmetic — branchless civil-calendar conversion (the
+Gregorian era decomposition) vectorizes perfectly on the VPU; no lookup
+tables, no host trips.  Non-UTC session timezones are not yet supported
+(the reference gates non-UTC behind GpuTimeZoneDB the same way).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _floordiv(a, b):
+    return a // b     # jnp int division floors for int inputs (numpy rules)
+
+
+def civil_from_days(days: jax.Array):
+    """(year, month, day) from days since 1970-01-01 (proleptic Gregorian).
+
+    Branchless era decomposition; exact for the whole int32 day range.
+    """
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097                                   # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)          # [0, 365]
+    mp = (5 * doy + 2) // 153                                # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                        # [1, 31]
+    m = mp + jnp.where(mp < 10, 3, -9)                       # [1, 12]
+    y = y + (m <= 2)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def days_from_civil(y: jax.Array, m: jax.Array, d: jax.Array) -> jax.Array:
+    """days since epoch from (year, month, day); inverse of civil_from_days."""
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400                                      # [0, 399]
+    mp = (m.astype(jnp.int64) + 9) % 12                      # [0, 11]
+    doy = (153 * mp + 2) // 5 + d.astype(jnp.int64) - 1      # [0, 365]
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def is_leap(y: jax.Array) -> jax.Array:
+    return ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+
+
+def days_in_month(y: jax.Array, m: jax.Array) -> jax.Array:
+    base = jnp.asarray([0, 31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                       jnp.int32)
+    d = base[jnp.clip(m, 0, 12)]
+    return jnp.where((m == 2) & is_leap(y), 29, d)
+
+
+def day_of_year(days: jax.Array) -> jax.Array:
+    y, _, _ = civil_from_days(days)
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return (days.astype(jnp.int32) - jan1 + 1)
+
+
+def day_of_week_sunday1(days: jax.Array) -> jax.Array:
+    """Spark dayofweek(): 1 = Sunday ... 7 = Saturday.
+    1970-01-01 was a Thursday."""
+    dow0 = (days.astype(jnp.int64) + 4) % 7        # 0 = Sunday
+    dow0 = jnp.where(dow0 < 0, dow0 + 7, dow0)
+    return (dow0 + 1).astype(jnp.int32)
+
+
+def weekday_monday0(days: jax.Array) -> jax.Array:
+    """Spark weekday(): 0 = Monday ... 6 = Sunday."""
+    w = (days.astype(jnp.int64) + 3) % 7
+    w = jnp.where(w < 0, w + 7, w)
+    return w.astype(jnp.int32)
+
+
+def iso_week(days: jax.Array) -> jax.Array:
+    """ISO-8601 week number (Spark weekofyear)."""
+    wd = weekday_monday0(days)                     # 0=Mon..6=Sun
+    nearest_thursday = days.astype(jnp.int32) + (3 - wd)
+    y, _, _ = civil_from_days(nearest_thursday)
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return ((nearest_thursday - jan1) // 7 + 1).astype(jnp.int32)
+
+
+def add_months(days: jax.Array, months: jax.Array) -> jax.Array:
+    """Spark add_months: day clamped to the target month's last day."""
+    y, m, d = civil_from_days(days)
+    total = y.astype(jnp.int64) * 12 + (m.astype(jnp.int64) - 1) \
+        + months.astype(jnp.int64)
+    ny = jnp.where(total >= 0, total, total - 11) // 12
+    nm = (total - ny * 12 + 1).astype(jnp.int32)
+    ny = ny.astype(jnp.int32)
+    nd = jnp.minimum(d, days_in_month(ny, nm))
+    return days_from_civil(ny, nm, nd)
+
+
+def last_day(days: jax.Array) -> jax.Array:
+    y, m, _ = civil_from_days(days)
+    return days_from_civil(y, m, days_in_month(y, m))
+
+
+def trunc_date(days: jax.Array, unit: str) -> jax.Array:
+    y, m, d = civil_from_days(days)
+    one = jnp.ones_like(y)
+    if unit in ("year", "yyyy", "yy"):
+        return days_from_civil(y, one, one)
+    if unit in ("quarter",):
+        qm = ((m - 1) // 3) * 3 + 1
+        return days_from_civil(y, qm, one)
+    if unit in ("month", "mon", "mm"):
+        return days_from_civil(y, m, one)
+    if unit in ("week",):
+        return (days.astype(jnp.int32) - weekday_monday0(days))
+    raise ValueError(f"unsupported trunc unit {unit}")
+
+
+_US_PER_DAY = 86400_000_000
+
+
+def ts_to_days(us: jax.Array) -> jax.Array:
+    """micros since epoch -> days since epoch (floor, UTC)."""
+    us = us.astype(jnp.int64)
+    return jnp.where(us >= 0, us // _US_PER_DAY,
+                     -((-us + _US_PER_DAY - 1) // _US_PER_DAY)
+                     ).astype(jnp.int32)
+
+
+def ts_time_of_day_us(us: jax.Array) -> jax.Array:
+    us = us.astype(jnp.int64)
+    rem = us % _US_PER_DAY
+    return jnp.where(rem < 0, rem + _US_PER_DAY, rem)

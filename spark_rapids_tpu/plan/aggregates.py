@@ -66,8 +66,8 @@ class AggregateFunction:
             if isinstance(self.child.dtype, (t.ArrayType, t.StructType,
                                              t.MapType, t.BinaryType)):
                 out.append(f"{self.name} over {self.child.dtype.simple_string}")
-            if isinstance(self.child.dtype, t.DecimalType):
-                out.append("decimal aggregation not yet on device")
+            if E._consumes_wide_host(self.child):
+                out.append("128-bit host decimal lane not consumable on device")
         return out
 
     # CPU fallback: (pyarrow TableGroupBy aggregation name, options)
@@ -188,26 +188,56 @@ class Max(Min):
 class Average(AggregateFunction):
     name = "avg"
 
+    def _is_decimal(self):
+        return isinstance(self.child.dtype, t.DecimalType)
+
     def _resolve(self):
-        if isinstance(self.child.dtype, t.DecimalType):
-            raise TypeError("decimal avg handled via fallback")
+        if self._is_decimal():
+            # Spark: avg(decimal(p,s)) -> decimal(p+4, s+4)
+            d = self.child.dtype
+            self.dtype = t.DecimalType(min(38, d.precision + 4),
+                                       min(38, d.scale + 4))
+            self.nullable = True
+            return
         self.dtype = t.DOUBLE
         self.nullable = True
 
+    def _sum_type(self) -> t.DataType:
+        if self._is_decimal():
+            d = self.child.dtype
+            return t.DecimalType(min(38, d.precision + 10), d.scale)
+        return t.DOUBLE
+
     def inputs(self):
+        if self._is_decimal():
+            return [self.child, self.child]
         # sum in double space (Spark: avg sums as double for non-decimal)
         return [_resolved(E.Cast(self.child, t.DOUBLE)), self.child]
 
     def update_ops(self):
-        return [(G.SUM, t.DOUBLE), (G.COUNT, t.LONG)]
+        return [(G.SUM, self._sum_type()), (G.COUNT, t.LONG)]
 
     def merge_ops(self):
-        return [(G.SUM, t.DOUBLE), (G.SUM, t.LONG)]
+        return [(G.SUM, self._sum_type()), (G.SUM, t.LONG)]
 
     def evaluate(self, refs):
+        if self._is_decimal():
+            return _DecimalAvgEvaluate(refs[0], refs[1], self.dtype)
         return E.Divide(refs[0], refs[1])
 
     def cpu_agg(self):
+        if isinstance(self.child.dtype, t.DecimalType):
+            import decimal as pydec
+            out_t = self.dtype
+            quant = pydec.Decimal(1).scaleb(-out_t.scale)
+
+            def py_avg(values):
+                vals = [v for v in values if v is not None]
+                if not vals:
+                    return None
+                return (sum(vals) / len(vals)).quantize(
+                    quant, rounding=pydec.ROUND_HALF_UP)
+            return ("_py", py_avg)
         return ("mean", None)
 
 
@@ -291,3 +321,50 @@ def _resolved(e: E.Expression) -> E.Expression:
     """Resolve an expression wrapped around already-bound children."""
     e._resolve()
     return e
+
+
+class _DecimalAvgEvaluate(E.Expression):
+    """sum_buffer / count at Spark's avg scale (s+4), HALF_UP — exact
+    integer arithmetic on the unscaled lanes (no float round-trip)."""
+
+    def __init__(self, sum_e: E.Expression, count_e: E.Expression,
+                 out_t: t.DecimalType):
+        self.children = (sum_e, count_e)
+        self.out_t = out_t
+        self._resolve()
+
+    def _resolve(self):
+        self.dtype = self.out_t
+        self.nullable = True
+
+    def _eval_dev(self, ctx, kids):
+        import jax.numpy as jnp
+        from ..ops import decimal as D
+        from ..ops.kernels import merge_validity
+        s_in = self.children[0].dtype.scale
+        shift = self.out_t.scale - s_in
+        u = kids[0].data.astype(jnp.int64)
+        c = kids[1].data.astype(jnp.int64)
+        us, ok = D.upscale(u, shift)
+        safe_c = jnp.maximum(c, 1)
+        mag = (jnp.abs(us) + safe_c // 2) // safe_c
+        q = jnp.where(us < 0, -mag, mag)
+        valid = merge_validity(kids[0].validity, kids[1].validity,
+                               ok & (c > 0))
+        return E.DevVal(q, valid, self.out_t)
+
+    def _eval_cpu(self, rb, kids):
+        import decimal as pydec
+        quant = pydec.Decimal(1).scaleb(-self.out_t.scale)
+        out = []
+        for s, c in zip(kids[0].to_pylist(), kids[1].to_pylist()):
+            if s is None or c is None or c == 0:
+                out.append(None)
+            else:
+                out.append((pydec.Decimal(s) / c).quantize(
+                    quant, rounding=pydec.ROUND_HALF_UP))
+        return pa.array(out, pa.decimal128(self.out_t.precision,
+                                           self.out_t.scale))
+
+    def _fp_extra(self):
+        return self.out_t.simple_string

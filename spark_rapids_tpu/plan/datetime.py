@@ -1,0 +1,406 @@
+"""Datetime expressions (reference datetimeExpressions.scala, ~2.3k LoC).
+
+All field extraction / date arithmetic is branchless integer math on the
+DATE (int32 days) / TIMESTAMP (int64 us UTC) lanes — ops/datetime.py.
+Session timezone is UTC-only for now (non-UTC is what GpuTimeZoneDB exists
+for in the reference; same gating contract).
+
+CPU oracle uses pyarrow temporal kernels with explicit corrections where
+Spark semantics differ (dayofweek numbering, week-of-year = ISO week).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as t
+from ..ops import datetime as DK
+from ..ops.kernels import merge_validity
+from .expressions import DevVal, Expression, Literal
+
+
+def _days(kid: DevVal) -> "jnp.ndarray":
+    return kid.data.astype(jnp.int32)
+
+
+def _as_date_cpu(arr: pa.Array) -> pa.Array:
+    return arr if pa.types.is_date32(arr.type) else arr.cast(pa.date32())
+
+
+class DateField(Expression):
+    """Base: int field extracted from a DATE (or TIMESTAMP via day part)."""
+    result_type = t.INT
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = type(self).result_type
+        self.nullable = self.children[0].nullable
+
+    def unsupported_reasons(self, conf):
+        dt = self.children[0].dtype
+        if not isinstance(dt, (t.DateType, t.TimestampType, t.NullType)):
+            return [f"datetime field of {dt.simple_string}"]
+        return []
+
+    def _input_days(self, kid: DevVal):
+        if isinstance(self.children[0].dtype, t.TimestampType):
+            return DK.ts_to_days(kid.data)
+        return _days(kid)
+
+    def _eval_dev(self, ctx, kids):
+        return DevVal(self._field_dev(self._input_days(kids[0])),
+                      kids[0].validity, self.dtype)
+
+    def _cpu_input(self, arr: pa.Array) -> pa.Array:
+        if pa.types.is_timestamp(arr.type):
+            return arr.cast(pa.timestamp("us", tz="UTC"))
+        return _as_date_cpu(arr)
+
+    def _eval_cpu(self, rb, kids):
+        return self._field_cpu(self._cpu_input(kids[0])).cast(pa.int32())
+
+
+class Year(DateField):
+    def _field_dev(self, days):
+        y, _, _ = DK.civil_from_days(days)
+        return y
+
+    def _field_cpu(self, arr):
+        return pc.year(arr)
+
+
+class Month(DateField):
+    def _field_dev(self, days):
+        _, m, _ = DK.civil_from_days(days)
+        return m
+
+    def _field_cpu(self, arr):
+        return pc.month(arr)
+
+
+class DayOfMonth(DateField):
+    def _field_dev(self, days):
+        _, _, d = DK.civil_from_days(days)
+        return d
+
+    def _field_cpu(self, arr):
+        return pc.day(arr)
+
+
+class DayOfWeek(DateField):
+    """Spark: 1 = Sunday .. 7 = Saturday."""
+
+    def _field_dev(self, days):
+        return DK.day_of_week_sunday1(days)
+
+    def _field_cpu(self, arr):
+        # pyarrow day_of_week: 0=Monday..6=Sunday -> spark 1=Sunday..7=Sat
+        dow = pc.day_of_week(arr, count_from_zero=False, week_start=7)
+        return dow
+
+
+class WeekDay(DateField):
+    """Spark: 0 = Monday .. 6 = Sunday."""
+
+    def _field_dev(self, days):
+        return DK.weekday_monday0(days)
+
+    def _field_cpu(self, arr):
+        return pc.day_of_week(arr)
+
+
+class DayOfYear(DateField):
+    def _field_dev(self, days):
+        return DK.day_of_year(days)
+
+    def _field_cpu(self, arr):
+        return pc.day_of_year(arr)
+
+
+class Quarter(DateField):
+    def _field_dev(self, days):
+        _, m, _ = DK.civil_from_days(days)
+        return (m - 1) // 3 + 1
+
+    def _field_cpu(self, arr):
+        return pc.quarter(arr)
+
+
+class WeekOfYear(DateField):
+    def _field_dev(self, days):
+        return DK.iso_week(days)
+
+    def _field_cpu(self, arr):
+        return pc.iso_week(arr)
+
+
+class TimeField(Expression):
+    """Hour/minute/second from TIMESTAMP (UTC)."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.INT
+        self.nullable = self.children[0].nullable
+
+    def unsupported_reasons(self, conf):
+        dt = self.children[0].dtype
+        if not isinstance(dt, (t.TimestampType, t.NullType)):
+            return [f"time field of {dt.simple_string}"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        tod = DK.ts_time_of_day_us(kids[0].data)
+        return DevVal(self._from_tod(tod).astype(jnp.int32),
+                      kids[0].validity, t.INT)
+
+    def _eval_cpu(self, rb, kids):
+        arr = kids[0].cast(pa.timestamp("us", tz="UTC"))
+        return self._field_cpu(arr).cast(pa.int32())
+
+
+class Hour(TimeField):
+    def _from_tod(self, tod):
+        return tod // 3600_000_000
+
+    def _field_cpu(self, arr):
+        return pc.hour(arr)
+
+
+class Minute(TimeField):
+    def _from_tod(self, tod):
+        return (tod // 60_000_000) % 60
+
+    def _field_cpu(self, arr):
+        return pc.minute(arr)
+
+
+class Second(TimeField):
+    def _from_tod(self, tod):
+        return (tod // 1_000_000) % 60
+
+    def _field_cpu(self, arr):
+        return pc.second(arr)
+
+
+class DateAdd(Expression):
+    """date_add(date, n) -> DATE.  DateSub negates."""
+    _sign = 1
+
+    def __init__(self, date, n):
+        lift = lambda x: x if isinstance(x, Expression) else Literal(x)
+        self.children = (date, lift(n))
+
+    def _resolve(self):
+        self.dtype = t.DATE
+        self.nullable = True
+
+    def unsupported_reasons(self, conf):
+        if not isinstance(self.children[0].dtype, (t.DateType, t.NullType)):
+            return ["date_add of non-date"]
+        if not t.is_integral(self.children[1].dtype):
+            return ["date_add offset must be integral"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        d = _days(kids[0]) + jnp.int32(self._sign) * kids[1].data.astype(jnp.int32)
+        return DevVal(d, merge_validity(kids[0].validity, kids[1].validity),
+                      t.DATE)
+
+    def _eval_cpu(self, rb, kids):
+        d = _as_date_cpu(kids[0]).cast(pa.int32())
+        n = kids[1].cast(pa.int32())
+        out = pc.add(d, pc.multiply(n, pa.scalar(self._sign, pa.int32())))
+        return out.cast(pa.int32()).cast(pa.date32())
+
+
+class DateSub(DateAdd):
+    _sign = -1
+
+
+class DateDiff(Expression):
+    """datediff(end, start) -> INT days."""
+
+    def __init__(self, end, start):
+        self.children = (end, start)
+
+    def _resolve(self):
+        self.dtype = t.INT
+        self.nullable = True
+
+    def unsupported_reasons(self, conf):
+        for c in self.children:
+            if not isinstance(c.dtype, (t.DateType, t.NullType)):
+                return ["datediff of non-date"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        return DevVal(_days(kids[0]) - _days(kids[1]),
+                      merge_validity(kids[0].validity, kids[1].validity),
+                      t.INT)
+
+    def _eval_cpu(self, rb, kids):
+        e = _as_date_cpu(kids[0]).cast(pa.int32())
+        s = _as_date_cpu(kids[1]).cast(pa.int32())
+        return pc.subtract(e, s)
+
+
+class AddMonths(Expression):
+    def __init__(self, date, months):
+        lift = lambda x: x if isinstance(x, Expression) else Literal(x)
+        self.children = (date, lift(months))
+
+    def _resolve(self):
+        self.dtype = t.DATE
+        self.nullable = True
+
+    def unsupported_reasons(self, conf):
+        if not isinstance(self.children[0].dtype, (t.DateType, t.NullType)):
+            return ["add_months of non-date"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        d = DK.add_months(_days(kids[0]), kids[1].data)
+        return DevVal(d, merge_validity(kids[0].validity, kids[1].validity),
+                      t.DATE)
+
+    def _eval_cpu(self, rb, kids):
+        import datetime as pydt
+        days = _as_date_cpu(kids[0]).cast(pa.int32()).to_pylist()
+        months = kids[1].cast(pa.int32()).to_pylist()
+        out = []
+        for dv, mv in zip(days, months):
+            if dv is None or mv is None:
+                out.append(None)
+                continue
+            date = pydt.date(1970, 1, 1) + pydt.timedelta(days=dv)
+            total = date.year * 12 + date.month - 1 + mv
+            ny, nm = divmod(total, 12)
+            nm += 1
+            import calendar
+            nd = min(date.day, calendar.monthrange(ny, nm)[1])
+            out.append((pydt.date(ny, nm, nd) - pydt.date(1970, 1, 1)).days)
+        return pa.array(out, pa.int32()).cast(pa.date32())
+
+
+class LastDay(Expression):
+    def __init__(self, date):
+        self.children = (date,)
+
+    def _resolve(self):
+        self.dtype = t.DATE
+        self.nullable = self.children[0].nullable
+
+    def unsupported_reasons(self, conf):
+        if not isinstance(self.children[0].dtype, (t.DateType, t.NullType)):
+            return ["last_day of non-date"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        return DevVal(DK.last_day(_days(kids[0])), kids[0].validity, t.DATE)
+
+    def _eval_cpu(self, rb, kids):
+        import calendar
+        import datetime as pydt
+        days = _as_date_cpu(kids[0]).cast(pa.int32()).to_pylist()
+        out = []
+        for dv in days:
+            if dv is None:
+                out.append(None)
+                continue
+            date = pydt.date(1970, 1, 1) + pydt.timedelta(days=dv)
+            nd = calendar.monthrange(date.year, date.month)[1]
+            out.append((pydt.date(date.year, date.month, nd)
+                        - pydt.date(1970, 1, 1)).days)
+        return pa.array(out, pa.int32()).cast(pa.date32())
+
+
+class TruncDate(Expression):
+    """trunc(date, unit): year/quarter/month/week."""
+    _UNITS = ("year", "yyyy", "yy", "quarter", "month", "mon", "mm", "week")
+
+    def __init__(self, date, unit: str):
+        self.children = (date,)
+        self.unit = str(unit).lower()
+
+    def _resolve(self):
+        self.dtype = t.DATE
+        self.nullable = True
+
+    def unsupported_reasons(self, conf):
+        out = []
+        if not isinstance(self.children[0].dtype, (t.DateType, t.NullType)):
+            out.append("trunc of non-date")
+        if self.unit not in self._UNITS:
+            out.append(f"trunc unit {self.unit!r}")
+        return out
+
+    def _eval_dev(self, ctx, kids):
+        return DevVal(DK.trunc_date(_days(kids[0]), self.unit),
+                      kids[0].validity, t.DATE)
+
+    def _eval_cpu(self, rb, kids):
+        import datetime as pydt
+        days = _as_date_cpu(kids[0]).cast(pa.int32()).to_pylist()
+        out = []
+        for dv in days:
+            if dv is None:
+                out.append(None)
+                continue
+            date = pydt.date(1970, 1, 1) + pydt.timedelta(days=dv)
+            if self.unit in ("year", "yyyy", "yy"):
+                r = pydt.date(date.year, 1, 1)
+            elif self.unit == "quarter":
+                r = pydt.date(date.year, ((date.month - 1) // 3) * 3 + 1, 1)
+            elif self.unit in ("month", "mon", "mm"):
+                r = pydt.date(date.year, date.month, 1)
+            else:  # week: Monday
+                r = date - pydt.timedelta(days=date.weekday())
+            out.append((r - pydt.date(1970, 1, 1)).days)
+        return pa.array(out, pa.int32()).cast(pa.date32())
+
+    def _fp_extra(self):
+        return self.unit
+
+
+class ToUnixTimestamp(Expression):
+    """timestamp -> seconds since epoch (LONG)."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.LONG
+        self.nullable = self.children[0].nullable
+
+    def unsupported_reasons(self, conf):
+        dt = self.children[0].dtype
+        if not isinstance(dt, (t.TimestampType, t.DateType, t.NullType)):
+            return ["to_unix_timestamp of non-datetime"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        if isinstance(self.children[0].dtype, t.DateType):
+            secs = _days(kids[0]).astype(jnp.int64) * 86400
+        else:
+            us = kids[0].data.astype(jnp.int64)
+            secs = jnp.where(us >= 0, us // 1_000_000,
+                             -((-us + 999_999) // 1_000_000))
+        return DevVal(secs, kids[0].validity, t.LONG)
+
+    def _eval_cpu(self, rb, kids):
+        arr = kids[0]
+        if pa.types.is_date32(arr.type):
+            return pc.multiply(arr.cast(pa.int32()).cast(pa.int64()),
+                               pa.scalar(86400, pa.int64()))
+        us = arr.cast(pa.timestamp("us", tz="UTC")).cast(pa.int64())
+        vals = us.to_numpy(zero_copy_only=False)
+        out = np.floor_divide(vals, 1_000_000)
+        return pa.array(out, pa.int64(), mask=np.asarray(pc.is_null(arr)))

@@ -264,6 +264,8 @@ class Literal(Expression):
 
     @staticmethod
     def _infer(v) -> t.DataType:
+        import datetime as pydt
+        import decimal as pydec
         if v is None:
             return t.NULL
         if isinstance(v, bool):
@@ -274,7 +276,37 @@ class Literal(Expression):
             return t.DOUBLE
         if isinstance(v, str):
             return t.STRING
+        if isinstance(v, pydec.Decimal):
+            sign, digits, exp = v.as_tuple()
+            scale = max(0, -exp)
+            precision = max(len(digits), scale + 1)
+            return t.DecimalType(precision, scale)
+        if isinstance(v, pydt.datetime):
+            return t.TIMESTAMP
+        if isinstance(v, pydt.date):
+            return t.DATE
         raise TypeError(f"cannot infer literal type of {v!r}")
+
+    def _physical_value(self):
+        """Host value -> device lane value per the storage mapping."""
+        import datetime as pydt
+        import decimal as pydec
+        v, dt = self.value, self.dtype
+        if isinstance(dt, t.DecimalType):
+            d = v if isinstance(v, pydec.Decimal) else pydec.Decimal(str(v))
+            return int(d.scaleb(dt.scale).to_integral_value(
+                rounding=pydec.ROUND_HALF_UP))
+        if isinstance(dt, t.DateType):
+            if isinstance(v, pydt.date):
+                return (v - pydt.date(1970, 1, 1)).days
+            return int(v)
+        if isinstance(dt, t.TimestampType):
+            if isinstance(v, pydt.datetime):
+                epoch = pydt.datetime(1970, 1, 1,
+                                      tzinfo=v.tzinfo and pydt.timezone.utc)
+                return int((v - epoch).total_seconds() * 1e6)
+            return int(v)
+        return v
 
     def bind(self, schema):
         return self
@@ -297,7 +329,8 @@ class Literal(Expression):
             data = jnp.zeros((cap,), dtype=jnp.int32)  # code 0 of 1-entry dict
             return DevVal(data, None, self.dtype,
                           pa.array([self.value], pa.string()))
-        data = jnp.full((cap,), self.value, dtype=compute_dtype(self.dtype))
+        data = jnp.full((cap,), self._physical_value(),
+                        dtype=compute_dtype(self.dtype))
         return DevVal(data, None, self.dtype)
 
     def _eval_cpu(self, rb, kids):
@@ -306,7 +339,11 @@ class Literal(Expression):
         if self.value is None:
             return pa.nulls(n, dtype_to_arrow(self.dtype)
                             if not isinstance(self.dtype, t.NullType) else pa.null())
-        return pa.array([self.value] * n, dtype_to_arrow(self.dtype))
+        v = self.value
+        if isinstance(self.dtype, t.DecimalType):
+            import decimal as pydec
+            v = v if isinstance(v, pydec.Decimal) else pydec.Decimal(str(v))
+        return pa.array([v] * n, dtype_to_arrow(self.dtype))
 
     def _fp_extra(self):
         return f"{self.value!r}:{self.dtype.simple_string}"
@@ -349,6 +386,27 @@ def _promote_binary(a: Expression, b: Expression) -> t.DataType:
     return t.numeric_promote(da, db)
 
 
+def _is_decimal_op(da: t.DataType, db: t.DataType) -> bool:
+    return isinstance(da, t.DecimalType) or isinstance(db, t.DecimalType)
+
+
+def _as_decimal(dt: t.DataType) -> t.DecimalType:
+    from ..ops import decimal as D
+    if isinstance(dt, t.DecimalType):
+        return dt
+    return D.integral_as_decimal(dt)
+
+
+def _consumes_wide_host(e: Expression) -> bool:
+    """True when `e` reads a wide (p>18) decimal straight off a host column:
+    those carry a (lo, hi) two-lane representation the single-lane kernels
+    cannot consume.  Device-COMPUTED wide results are single-lane int64 and
+    are fine (ops/decimal.py module docs)."""
+    inner = e.children[0] if isinstance(e, Alias) else e
+    return isinstance(inner, ColumnRef) and \
+        isinstance(inner.dtype, t.DecimalType) and inner.dtype.is_wide
+
+
 def _cast_dev(v, src: t.DataType, dst: t.DataType):
     if src == dst:
         return v
@@ -365,24 +423,49 @@ def _cpu_promote(arr: pa.Array, dst: t.DataType) -> pa.Array:
 
 class BinaryArithmetic(Expression):
     symbol = "?"
+    #: ops/decimal.py result-type rule; None -> decimal unsupported here
+    decimal_rule = None
+    decimal_kernel = None
 
     def __init__(self, left: Expression, right: Expression):
         self.children = (left, right)
 
+    def _is_decimal(self):
+        return _is_decimal_op(self.children[0].dtype, self.children[1].dtype)
+
     def _resolve(self):
-        self.dtype = _promote_binary(*self.children)
+        if self._is_decimal():
+            from ..ops import decimal as D
+            rule = self.decimal_rule
+            if rule is None:
+                raise TypeError(
+                    f"{type(self).__name__} not defined for decimal")
+            self.dtype = rule(_as_decimal(self.children[0].dtype),
+                              _as_decimal(self.children[1].dtype))
+        else:
+            self.dtype = _promote_binary(*self.children)
         self.nullable = True
 
     def unsupported_reasons(self, conf):
         for c in self.children:
             if not t.is_numeric(c.dtype) and not isinstance(c.dtype, t.NullType):
                 return [f"non-numeric operand {c.dtype.simple_string}"]
-            if isinstance(c.dtype, t.DecimalType):
-                return ["decimal arithmetic not yet on device"]
+            if _consumes_wide_host(c):
+                return ["128-bit host decimal lane not consumable on device"]
+        if self._is_decimal() and self.decimal_kernel is None:
+            return [f"decimal {self.symbol} not yet on device"]
         return []
 
     def _eval_dev(self, ctx, kids):
         l, r = kids
+        if self._is_decimal():
+            kern = self.decimal_kernel
+            sa = _as_decimal(l.dtype).scale
+            sb = _as_decimal(r.dtype).scale
+            data, ok = kern(l.data.astype(jnp.int64), sa,
+                            r.data.astype(jnp.int64), sb, self.dtype)
+            return DevVal(data, merge_validity(l.validity, r.validity, ok),
+                          self.dtype)
         ld = _cast_dev(l.data, l.dtype, self.dtype)
         rd = _cast_dev(r.data, r.dtype, self.dtype)
         data, extra_valid = self._op_dev(ld, rd)
@@ -390,16 +473,58 @@ class BinaryArithmetic(Expression):
         return DevVal(data, valid, self.dtype)
 
     def _eval_cpu(self, rb, kids):
+        if self._is_decimal():
+            return self._decimal_cpu(kids)
         l = _cpu_promote(kids[0], self.dtype)
         r = _cpu_promote(kids[1], self.dtype)
         return self._op_cpu(l, r)
+
+    def _decimal_cpu(self, kids):
+        """Exact row-wise python-decimal oracle with Spark result typing."""
+        import decimal as pydec
+        out_t: t.DecimalType = self.dtype
+        quant = pydec.Decimal(1).scaleb(-out_t.scale)
+        limit = pydec.Decimal(10) ** (out_t.precision - out_t.scale)
+        lv = kids[0].to_pylist()
+        rv = kids[1].to_pylist()
+        out = []
+        with pydec.localcontext() as ctx:
+            ctx.prec = 76
+            for a, b in zip(lv, rv):
+                if a is None or b is None:
+                    out.append(None)
+                    continue
+                try:
+                    v = self._py_op(pydec.Decimal(a), pydec.Decimal(b))
+                except (pydec.DivisionByZero, pydec.InvalidOperation):
+                    out.append(None)
+                    continue
+                v = v.quantize(quant, rounding=pydec.ROUND_HALF_UP)
+                out.append(None if abs(v) >= limit else v)
+        return pa.array(out, pa.decimal128(out_t.precision, out_t.scale))
 
     def _fp_extra(self):
         return self.symbol
 
 
+def _decimal_rules():
+    from ..ops import decimal as D
+    return D
+
+
 class Add(BinaryArithmetic):
     symbol = "+"
+
+    @property
+    def decimal_rule(self):
+        return _decimal_rules().add_result
+
+    @property
+    def decimal_kernel(self):
+        return _decimal_rules().add_dev
+
+    def _py_op(self, a, b):
+        return a + b
 
     def _op_dev(self, l, r):
         return l + r, None
@@ -411,6 +536,17 @@ class Add(BinaryArithmetic):
 class Subtract(BinaryArithmetic):
     symbol = "-"
 
+    @property
+    def decimal_rule(self):
+        return _decimal_rules().add_result
+
+    @property
+    def decimal_kernel(self):
+        return _decimal_rules().sub_dev
+
+    def _py_op(self, a, b):
+        return a - b
+
     def _op_dev(self, l, r):
         return l - r, None
 
@@ -421,6 +557,17 @@ class Subtract(BinaryArithmetic):
 class Multiply(BinaryArithmetic):
     symbol = "*"
 
+    @property
+    def decimal_rule(self):
+        return _decimal_rules().mul_result
+
+    @property
+    def decimal_kernel(self):
+        return _decimal_rules().mul_dev
+
+    def _py_op(self, a, b):
+        return a * b
+
     def _op_dev(self, l, r):
         return l * r, None
 
@@ -429,14 +576,34 @@ class Multiply(BinaryArithmetic):
 
 
 class Divide(BinaryArithmetic):
-    """Spark Divide: result is DOUBLE (for non-decimal); x/0 -> NULL."""
+    """Spark Divide: DOUBLE result for non-decimal, decimal-rule result for
+    decimal (device: CPU fallback — int64 lanes can't hold the scaled
+    dividend); x/0 -> NULL."""
     symbol = "/"
+    decimal_kernel = None     # tagged off-device; exact python CPU path
+
+    @property
+    def decimal_rule(self):
+        return _decimal_rules().div_result
+
+    def _py_op(self, a, b):
+        return a / b
 
     def _resolve(self):
+        if self._is_decimal():
+            self.dtype = self.decimal_rule(
+                _as_decimal(self.children[0].dtype),
+                _as_decimal(self.children[1].dtype))
+            return
         for c in self.children:
             if not (t.is_numeric(c.dtype) or isinstance(c.dtype, t.NullType)):
                 raise TypeError(f"divide on {c.dtype}")
         self.dtype = t.DOUBLE
+
+    def _eval_cpu(self, rb, kids):
+        if self._is_decimal():
+            return self._decimal_cpu(kids)
+        return self._float_div_cpu(rb, kids)
 
     def _eval_dev(self, ctx, kids):
         l, r = kids
@@ -448,7 +615,7 @@ class Divide(BinaryArithmetic):
         return DevVal(data, merge_validity(l.validity, r.validity, extra),
                       t.DOUBLE)
 
-    def _eval_cpu(self, rb, kids):
+    def _float_div_cpu(self, rb, kids):
         l = kids[0].cast(pa.float64())
         r = kids[1].cast(pa.float64())
         nz = pc.not_equal(r, pa.scalar(0.0))
@@ -461,9 +628,24 @@ class Divide(BinaryArithmetic):
 class IntegralDivide(BinaryArithmetic):
     """Spark `div`: long division truncating toward zero; x div 0 -> NULL."""
     symbol = "div"
+    decimal_kernel = None
 
     def _resolve(self):
         self.dtype = t.LONG
+
+    def _eval_cpu(self, rb, kids):
+        if self._is_decimal():
+            import decimal as pydec
+            out = []
+            for a, b in zip(kids[0].to_pylist(), kids[1].to_pylist()):
+                if a is None or b is None or b == 0:
+                    out.append(None)
+                else:
+                    q = pydec.Decimal(a) / pydec.Decimal(b)
+                    out.append(int(q.to_integral_value(
+                        rounding=pydec.ROUND_DOWN)))
+            return pa.array(out, pa.int64())
+        return self._int_div_cpu(rb, kids)
 
     def unsupported_reasons(self, conf):
         base = super().unsupported_reasons(conf)
@@ -482,7 +664,7 @@ class IntegralDivide(BinaryArithmetic):
         return DevVal(q, merge_validity(l.validity, r.validity, rd != 0),
                       t.LONG)
 
-    def _eval_cpu(self, rb, kids):
+    def _int_div_cpu(self, rb, kids):
         l = kids[0].cast(pa.int64())
         r = kids[1].cast(pa.int64())
         nz = pc.not_equal(r, pa.scalar(0, pa.int64()))
@@ -494,6 +676,18 @@ class IntegralDivide(BinaryArithmetic):
 class Remainder(BinaryArithmetic):
     """Spark %: Java semantics (sign follows dividend); x % 0 -> NULL."""
     symbol = "%"
+    decimal_kernel = None
+
+    @property
+    def decimal_rule(self):
+        def rule(a: t.DecimalType, b: t.DecimalType) -> t.DecimalType:
+            s = max(a.scale, b.scale)
+            p = min(a.precision - a.scale, b.precision - b.scale) + s
+            return t.DecimalType(max(p, 1), s)
+        return rule
+
+    def _py_op(self, a, b):
+        return a % b        # python Decimal %: sign follows dividend (Java)
 
     def _eval_dev(self, ctx, kids):
         l, r = kids
@@ -580,17 +774,33 @@ class BinaryComparison(Expression):
                 return []
             return ["string ordering comparison not yet on device"]
         for c in self.children:
-            if isinstance(c.dtype, t.DecimalType) and c.dtype.is_wide:
-                return ["decimal128 comparison not yet on device"]
+            if _consumes_wide_host(c):
+                return ["128-bit host decimal lane not consumable on device"]
         return []
 
     def _common(self):
         l, r = self.children
         if isinstance(l.dtype, t.StringType):
             return t.STRING
+        if _is_decimal_op(l.dtype, r.dtype):
+            da, db = _as_decimal(l.dtype), _as_decimal(r.dtype)
+            s = max(da.scale, db.scale)
+            p = max(da.precision - da.scale, db.precision - db.scale) + s
+            return t.DecimalType(min(p, 38), s)
         if l.dtype == r.dtype:
             return l.dtype
         return _promote_binary(*self.children)
+
+    def _decimal_lanes(self, kids, common: t.DecimalType):
+        """Align both sides to the common scale; overflow -> null (rare:
+        only beyond int64's unscaled range, see ops/decimal.py)."""
+        from ..ops import decimal as D
+        l, r = kids
+        sa = _as_decimal(self.children[0].dtype).scale
+        sb = _as_decimal(self.children[1].dtype).scale
+        ld, ok_a = D.rescale(l.data.astype(jnp.int64), sa, common.scale)
+        rd, ok_b = D.rescale(r.data.astype(jnp.int64), sb, common.scale)
+        return ld, rd, ok_a & ok_b
 
     # -- string-vs-string equality via unified dictionary remap
     def _prepare(self, pctx, kids):
@@ -610,6 +820,7 @@ class BinaryComparison(Expression):
 
     def _eval_dev(self, ctx, kids):
         l, r = kids
+        extra = None
         if isinstance(l.dtype, t.StringType) or isinstance(r.dtype, t.StringType):
             map_l, map_r = ctx.aux_of(self)
             lc = map_l[jnp.clip(l.data, 0, map_l.shape[0] - 1)]
@@ -617,15 +828,32 @@ class BinaryComparison(Expression):
             data = self._op_dev(lc, rc)
         else:
             common = self._common()
-            ld = _cast_dev(l.data, l.dtype, common)
-            rd = _cast_dev(r.data, r.dtype, common)
+            if isinstance(common, t.DecimalType):
+                ld, rd, extra = self._decimal_lanes(kids, common)
+            else:
+                ld = _cast_dev(l.data, l.dtype, common)
+                rd = _cast_dev(r.data, r.dtype, common)
             data = self._op_dev(ld, rd)
-        return DevVal(data, merge_validity(l.validity, r.validity), t.BOOLEAN)
+        return DevVal(data, merge_validity(l.validity, r.validity, extra),
+                      t.BOOLEAN)
 
     def _eval_cpu(self, rb, kids):
         l, r = kids
+        common = None
         if not isinstance(self.children[0].dtype, t.StringType):
             common = self._common()
+        if isinstance(common, t.DecimalType):
+            # exact row-wise python-decimal comparison oracle
+            import decimal as pydec
+            import operator as op
+            fn = {"=": op.eq, "!=": op.ne, "<": op.lt, "<=": op.le,
+                  ">": op.gt, ">=": op.ge}[self.symbol]
+            out = []
+            for a, b in zip(l.to_pylist(), r.to_pylist()):
+                out.append(None if a is None or b is None
+                           else fn(pydec.Decimal(str(a)), pydec.Decimal(str(b))))
+            return pa.array(out, pa.bool_())
+        if common is not None:
             l, r = _cpu_promote(l, common), _cpu_promote(r, common)
         return self._op_cpu(l, r)
 
@@ -1192,27 +1420,130 @@ class Cast(Expression):
 
     def unsupported_reasons(self, conf):
         src, dst = self.children[0].dtype, self.to
+        if _consumes_wide_host(self.children[0]):
+            return ["128-bit host decimal lane not consumable on device"]
+        if isinstance(src, t.DecimalType):
+            if t.is_numeric(dst) or isinstance(dst, t.BooleanType):
+                return []
+            return [f"cast {src.simple_string}->{dst.simple_string} "
+                    "not yet on device"]
+        if isinstance(dst, t.DecimalType):
+            if t.is_numeric(src) or isinstance(src, t.StringType):
+                return []
+            return [f"cast {src.simple_string}->{dst.simple_string} "
+                    "not yet on device"]
         ok_num = (t.is_numeric(src) or isinstance(src, t.BooleanType)) and \
                  (t.is_numeric(dst) or isinstance(dst, t.BooleanType))
-        if isinstance(src, t.DecimalType) or isinstance(dst, t.DecimalType):
-            return [f"decimal cast {src.simple_string}->{dst.simple_string} "
-                    "not yet on device"]
         if ok_num:
             return []
         if src == dst:
             return []
+        if isinstance(src, t.StringType) and (
+                t.is_numeric(dst) or isinstance(dst, t.DateType)):
+            return []     # dictionary-parse path (_prepare)
         if isinstance(src, t.DateType) and isinstance(dst, t.TimestampType):
             return []
         if isinstance(src, t.TimestampType) and isinstance(dst, t.DateType):
             return []
         return [f"cast {src.simple_string}->{dst.simple_string} not yet on device"]
 
+    # -- string -> X: parse the dictionary host-side, gather by code -------
+    @staticmethod
+    def _parse_entry(s: Optional[str], dst: t.DataType):
+        """Spark non-ANSI string cast: trimmed; invalid -> null."""
+        import datetime as pydt
+        import decimal as pydec
+        if s is None:
+            return None
+        s = s.strip()
+        if not s:
+            return None
+        try:
+            if isinstance(dst, t.DateType):
+                parts = s.split("T")[0].split(" ")[0].split("-")
+                if len(parts) != 3:
+                    return None
+                y, m, d = (int(p) for p in parts)
+                return (pydt.date(y, m, d) - pydt.date(1970, 1, 1)).days
+            if isinstance(dst, t.DecimalType):
+                v = pydec.Decimal(s).scaleb(dst.scale).to_integral_value(
+                    rounding=pydec.ROUND_HALF_UP)
+                iv = int(v)
+                if abs(iv) > 10 ** min(dst.precision, 18) - 1:
+                    return None
+                return iv
+            if t.is_floating(dst):
+                return float(s)
+            if t.is_integral(dst):
+                d = pydec.Decimal(s)
+                iv = int(d.to_integral_value(rounding=pydec.ROUND_DOWN))
+                info = np.iinfo(t.physical_np_dtype(dst))
+                if iv < info.min or iv > info.max:
+                    return None
+                return iv
+            if isinstance(dst, t.BooleanType):
+                low = s.lower()
+                if low in ("t", "true", "y", "yes", "1"):
+                    return True
+                if low in ("f", "false", "n", "no", "0"):
+                    return False
+                return None
+        except (ValueError, ArithmeticError):
+            return None
+        return None
+
+    def _prepare(self, pctx, kids):
+        src, dst = self.children[0].dtype, self.to
+        if isinstance(src, t.StringType) and not isinstance(dst, t.StringType):
+            d = kids[0].dictionary
+            entries = [v.as_py() for v in d] if d is not None else []
+            parsed = [self._parse_entry(s, dst) for s in entries] or [None]
+            ok = np.array([p is not None for p in parsed], bool)
+            np_dt = t.physical_np_dtype(dst)
+            vals = np.array([p if p is not None else 0 for p in parsed],
+                            np_dt if not t.is_floating(dst) else np.float64)
+            if isinstance(dst, t.DoubleType):
+                vals = vals.astype(np.float64).view(np.int64)  # bit-exact lane
+            pctx.add(self, vals)
+            pctx.add(self, ok)
+        return HostVal()
+
     def _eval_dev(self, ctx, kids):
+        from ..ops import decimal as D
         src, dst = self.children[0].dtype, self.to
         x = kids[0].data
         valid = kids[0].validity
         if src == dst:
             return kids[0]
+        if isinstance(src, t.StringType):
+            vals, ok = ctx.aux_of(self)
+            codes = jnp.clip(x, 0, vals.shape[0] - 1)
+            data = vals[codes]
+            if isinstance(dst, t.DoubleType):
+                data = jax.lax.bitcast_convert_type(data, jnp.float64)
+            return DevVal(data, merge_validity(valid, ok[codes]), dst)
+        if isinstance(src, t.DecimalType):
+            u = x.astype(jnp.int64)
+            if isinstance(dst, t.DecimalType):
+                data, ok = D.rescale(u, src.scale, dst.scale)
+                ok = ok & D.fits_precision(data, dst.precision)
+                return DevVal(data, merge_validity(valid, ok), dst)
+            if t.is_floating(dst):
+                f = D.to_double(u, src.scale)
+                return DevVal(f.astype(compute_dtype(dst)), valid, dst)
+            if isinstance(dst, t.BooleanType):
+                return DevVal(u != 0, valid, dst)
+            ints = D.cast_to_integral(u, src.scale)
+            info = np.iinfo(t.physical_np_dtype(dst))
+            ok = (ints >= info.min) & (ints <= info.max)
+            return DevVal(ints.astype(compute_dtype(dst)),
+                          merge_validity(valid, ok), dst)
+        if isinstance(dst, t.DecimalType):
+            if t.is_floating(src):
+                data, ok = D.from_double(x.astype(jnp.float64), dst)
+            else:
+                data, ok = D.from_integral(x, dst)
+            return DevVal(data, merge_validity(valid, ok), dst)
         if isinstance(dst, t.BooleanType):
             data = x != 0
         elif t.is_floating(src) and t.is_integral(dst):
@@ -1242,9 +1573,48 @@ class Cast(Expression):
         return DevVal(data, valid, dst)
 
     def _eval_cpu(self, rb, kids):
+        import decimal as pydec
         from ..columnar.host import dtype_to_arrow
         src, dst = self.children[0].dtype, self.to
         arr = kids[0]
+        if isinstance(src, t.StringType) and not isinstance(dst, t.StringType):
+            parsed = [self._parse_entry(v.as_py(), dst)
+                      for v in arr.cast(pa.string())]
+            if isinstance(dst, t.DecimalType):
+                parsed = [None if p is None else
+                          pydec.Decimal(p).scaleb(-dst.scale) for p in parsed]
+            if isinstance(dst, t.DateType):
+                return pa.array([None if p is None else p for p in parsed],
+                                pa.int32()).cast(pa.date32())
+            return pa.array(parsed, dtype_to_arrow(dst))
+        if isinstance(src, t.DecimalType) or isinstance(dst, t.DecimalType):
+            out = []
+            limit = None
+            if isinstance(dst, t.DecimalType):
+                quant = pydec.Decimal(1).scaleb(-dst.scale)
+                limit = pydec.Decimal(10) ** (dst.precision - dst.scale)
+            for v in arr.to_pylist():
+                if v is None:
+                    out.append(None)
+                    continue
+                d = v if isinstance(v, pydec.Decimal) \
+                    else pydec.Decimal(str(v))
+                if isinstance(dst, t.DecimalType):
+                    try:
+                        q = d.quantize(quant, rounding=pydec.ROUND_HALF_UP)
+                    except pydec.InvalidOperation:
+                        out.append(None)
+                        continue
+                    out.append(None if abs(q) >= limit else q)
+                elif t.is_floating(dst):
+                    out.append(float(d))
+                elif isinstance(dst, t.BooleanType):
+                    out.append(d != 0)
+                else:
+                    iv = int(d.to_integral_value(rounding=pydec.ROUND_DOWN))
+                    info = np.iinfo(t.physical_np_dtype(dst))
+                    out.append(iv if info.min <= iv <= info.max else None)
+            return pa.array(out, dtype_to_arrow(dst))
         if t.is_floating(src) and t.is_integral(dst):
             x = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
             x = np.nan_to_num(x, nan=0.0, posinf=np.inf, neginf=-np.inf)

@@ -111,7 +111,19 @@ for _c in (E.Floor, E.Ceil):
     expr_rule(_c, t.T.NUMERIC, t.T.INTEGRAL, desc="rounding")
 expr_rule(E.Cast, t.T.ALL_SIMPLE, desc="cast (pairs gated by Cast itself)")
 
+from . import datetime as DT  # noqa: E402  (registry population)
 from . import strings as STR  # noqa: E402  (registry population)
+
+for _c in (DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek, DT.WeekDay,
+           DT.DayOfYear, DT.Quarter, DT.WeekOfYear):
+    expr_rule(_c, t.T.DATETIME, t.T.INTEGRAL, desc="date field extract")
+for _c in (DT.Hour, DT.Minute, DT.Second):
+    expr_rule(_c, t.T.TIMESTAMP, t.T.INTEGRAL, desc="time field extract")
+for _c in (DT.DateAdd, DT.DateSub, DT.AddMonths, DT.LastDay, DT.TruncDate):
+    expr_rule(_c, t.T.DATE + t.T.INTEGRAL, t.T.DATE, desc="date arithmetic")
+expr_rule(DT.DateDiff, t.T.DATE, t.T.INTEGRAL, desc="date difference")
+expr_rule(DT.ToUnixTimestamp, t.T.DATETIME, t.T.INTEGRAL,
+          desc="epoch seconds")
 
 for _c in (STR.Upper, STR.Lower, STR.InitCap, STR.StringTrim,
            STR.StringTrimLeft, STR.StringTrimRight, STR.Substring,

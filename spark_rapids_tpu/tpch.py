@@ -1,0 +1,225 @@
+"""TPC-H data generation + query builders over the DataFrame API.
+
+Role of the reference's integration_tests TPC-H/TPC-DS suites + datagen
+(SURVEY §2.13): deterministic scaled tables with the spec's column types
+(money = decimal(12,2), dates = date32) and the query shapes used by the
+test suite (tests/test_tpch.py asserts device results against a pyarrow/
+python oracle) and bench.py.
+
+Row counts scale linearly with `scale` (scale=1.0 -> SF1-ish counts); the
+value distributions follow the TPC-H spec shapes (uniform ranges, date
+windows) without the full dbgen text grammar.
+"""
+from __future__ import annotations
+
+import datetime as pydt
+from typing import Dict, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from .plan import datetime as DT
+from .plan import expressions as E
+from .plan.aggregates import Average, Count, Sum
+from .session import DataFrame, TpuSession, col, lit
+
+
+def money_from_cents(cents: np.ndarray, precision=12, scale=2) -> pa.Array:
+    """Exact decimal(p,s) from integer unscaled values (no float trip)."""
+    import decimal as pydec
+    vals = [pydec.Decimal(int(c)).scaleb(-scale)
+            for c in cents.astype(np.int64)]
+    return pa.array(vals, pa.decimal128(precision, scale))
+
+
+_DATE0 = pydt.date(1970, 1, 1)
+
+
+def _days(d: pydt.date) -> int:
+    return (d - _DATE0).days
+
+
+def gen_tables(scale: float = 0.01, seed: int = 20240706
+               ) -> Dict[str, pa.Table]:
+    rng = np.random.default_rng(seed)
+    n_li = max(int(6_001_215 * scale), 100)
+    n_ord = max(int(1_500_000 * scale), 40)
+    n_cust = max(int(150_000 * scale), 20)
+    n_supp = max(int(10_000 * scale), 5)
+    n_part = max(int(200_000 * scale), 20)
+
+    nations = ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT",
+               "ETHIOPIA", "FRANCE", "GERMANY", "INDIA", "INDONESIA",
+               "IRAN", "IRAQ", "JAPAN", "JORDAN", "KENYA", "MOROCCO",
+               "MOZAMBIQUE", "PERU", "CHINA", "ROMANIA", "SAUDI ARABIA",
+               "VIETNAM", "RUSSIA", "UNITED KINGDOM", "UNITED STATES"]
+    region_of = [0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4, 2, 4, 0, 0, 0, 1, 2,
+                 3, 4, 2, 3, 3, 1]
+    regions = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+
+    region = pa.table({
+        "r_regionkey": pa.array(range(5), pa.int64()),
+        "r_name": pa.array(regions),
+    })
+    nation = pa.table({
+        "n_nationkey": pa.array(range(25), pa.int64()),
+        "n_name": pa.array(nations),
+        "n_regionkey": pa.array(region_of, pa.int64()),
+    })
+    customer = pa.table({
+        "c_custkey": pa.array(range(n_cust), pa.int64()),
+        "c_nationkey": pa.array(rng.integers(0, 25, n_cust), pa.int64()),
+        "c_mktsegment": pa.array(rng.choice(
+            ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY",
+             "HOUSEHOLD"], n_cust)),
+        "c_acctbal": money_from_cents(
+            rng.integers(-99999, 999999, n_cust), 12, 2),
+    })
+    supplier = pa.table({
+        "s_suppkey": pa.array(range(n_supp), pa.int64()),
+        "s_nationkey": pa.array(rng.integers(0, 25, n_supp), pa.int64()),
+        "s_acctbal": money_from_cents(
+            rng.integers(-99999, 999999, n_supp), 12, 2),
+    })
+    part = pa.table({
+        "p_partkey": pa.array(range(n_part), pa.int64()),
+        "p_type": pa.array(rng.choice(
+            ["ECONOMY ANODIZED STEEL", "LARGE BRUSHED BRASS",
+             "STANDARD POLISHED TIN", "SMALL PLATED COPPER",
+             "PROMO BURNISHED NICKEL"], n_part)),
+        "p_size": pa.array(rng.integers(1, 51, n_part), pa.int32()),
+    })
+
+    o_date_lo = _days(pydt.date(1992, 1, 1))
+    o_date_hi = _days(pydt.date(1998, 8, 2))
+    orders = pa.table({
+        "o_orderkey": pa.array(range(n_ord), pa.int64()),
+        "o_custkey": pa.array(rng.integers(0, n_cust, n_ord), pa.int64()),
+        "o_orderdate": pa.array(
+            rng.integers(o_date_lo, o_date_hi, n_ord).astype(np.int32),
+            pa.int32()).cast(pa.date32()),
+        "o_shippriority": pa.array(np.zeros(n_ord, np.int32), pa.int32()),
+        "o_orderstatus": pa.array(rng.choice(["F", "O", "P"], n_ord)),
+        "o_totalprice": money_from_cents(
+            rng.integers(100_00, 500_000_00, n_ord), 12, 2),
+    })
+
+    l_ship = rng.integers(o_date_lo, o_date_hi + 122, n_li).astype(np.int32)
+    rf = rng.choice(["A", "N", "R"], n_li)
+    lineitem = pa.table({
+        "l_orderkey": pa.array(rng.integers(0, n_ord, n_li), pa.int64()),
+        "l_partkey": pa.array(rng.integers(0, n_part, n_li), pa.int64()),
+        "l_suppkey": pa.array(rng.integers(0, n_supp, n_li), pa.int64()),
+        "l_quantity": money_from_cents(
+            rng.integers(1, 51, n_li) * 100, 12, 2),
+        "l_extendedprice": money_from_cents(
+            rng.integers(900_00, 10_500_000, n_li), 12, 2),
+        "l_discount": money_from_cents(rng.integers(0, 11, n_li), 12, 2),
+        "l_tax": money_from_cents(rng.integers(0, 9, n_li), 12, 2),
+        "l_returnflag": pa.array(rf),
+        "l_linestatus": pa.array(np.where(
+            l_ship > _days(pydt.date(1995, 6, 17)), "O", "F")),
+        "l_shipdate": pa.array(l_ship, pa.int32()).cast(pa.date32()),
+    })
+    return {"lineitem": lineitem, "orders": orders, "customer": customer,
+            "supplier": supplier, "part": part, "nation": nation,
+            "region": region}
+
+
+# ---------------------------------------------------------------------------
+# Queries
+# ---------------------------------------------------------------------------
+
+def q1(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Pricing summary report."""
+    cutoff = _days(pydt.date(1998, 12, 1)) - 90
+    li = s.from_arrow(t["lineitem"])
+    disc_price = E.Multiply(col("l_extendedprice"),
+                            E.Subtract(E.Literal(1), col("l_discount")))
+    charge = E.Multiply(disc_price,
+                        E.Add(E.Literal(1), col("l_tax")))
+    return (li.filter(E.LessThanOrEqual(col("l_shipdate"),
+                                        E.Literal(cutoff, DTYPE_DATE)))
+            .group_by("l_returnflag", "l_linestatus")
+            .agg((Sum(col("l_quantity")), "sum_qty"),
+                 (Sum(col("l_extendedprice")), "sum_base_price"),
+                 (Sum(disc_price), "sum_disc_price"),
+                 (Sum(charge), "sum_charge"),
+                 (Average(col("l_quantity")), "avg_qty"),
+                 (Average(col("l_extendedprice")), "avg_price"),
+                 (Average(col("l_discount")), "avg_disc"),
+                 (Count(None), "count_order"))
+            .sort("l_returnflag", "l_linestatus"))
+
+
+def q3(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Shipping priority."""
+    date = _days(pydt.date(1995, 3, 15))
+    cust = s.from_arrow(t["customer"]).filter(
+        E.EqualTo(col("c_mktsegment"), E.Literal("BUILDING")))
+    orders = s.from_arrow(t["orders"]).filter(
+        E.LessThan(col("o_orderdate"), E.Literal(date, DTYPE_DATE)))
+    li = s.from_arrow(t["lineitem"]).filter(
+        E.GreaterThan(col("l_shipdate"), E.Literal(date, DTYPE_DATE)))
+    j = cust.join(orders, left_on=["c_custkey"], right_on=["o_custkey"]) \
+        .join(li, left_on=["o_orderkey"], right_on=["l_orderkey"])
+    revenue = E.Multiply(col("l_extendedprice"),
+                         E.Subtract(E.Literal(1), col("l_discount")))
+    return (j.group_by("o_orderkey", "o_orderdate", "o_shippriority")
+            .agg((Sum(revenue), "revenue"))
+            .sort(("revenue", False, False), ("o_orderdate", True, True))
+            .limit(10))
+
+
+def q5(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Local supplier volume: ASIA, 1994."""
+    d_lo = _days(pydt.date(1994, 1, 1))
+    d_hi = _days(pydt.date(1995, 1, 1))
+    region = s.from_arrow(t["region"]).filter(
+        E.EqualTo(col("r_name"), E.Literal("ASIA")))
+    nation = s.from_arrow(t["nation"])
+    cust = s.from_arrow(t["customer"])
+    supp = s.from_arrow(t["supplier"])
+    orders = s.from_arrow(t["orders"]).filter(
+        E.And(E.GreaterThanOrEqual(col("o_orderdate"),
+                                   E.Literal(d_lo, DTYPE_DATE)),
+              E.LessThan(col("o_orderdate"), E.Literal(d_hi, DTYPE_DATE))))
+    li = s.from_arrow(t["lineitem"])
+    j = (region.join(nation, left_on=["r_regionkey"],
+                     right_on=["n_regionkey"])
+         .join(cust, left_on=["n_nationkey"], right_on=["c_nationkey"])
+         .join(orders, left_on=["c_custkey"], right_on=["o_custkey"])
+         .join(li, left_on=["o_orderkey"], right_on=["l_orderkey"]))
+    # l_suppkey must match a supplier in the same nation:
+    j = j.join(supp, left_on=["l_suppkey"], right_on=["s_suppkey"]) \
+        .filter(E.EqualTo(col("s_nationkey"), col("n_nationkey")))
+    revenue = E.Multiply(col("l_extendedprice"),
+                         E.Subtract(E.Literal(1), col("l_discount")))
+    return (j.group_by("n_name").agg((Sum(revenue), "revenue"))
+            .sort(("revenue", False, False)))
+
+
+def q6(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Forecast revenue change."""
+    d_lo = _days(pydt.date(1994, 1, 1))
+    d_hi = _days(pydt.date(1995, 1, 1))
+    li = s.from_arrow(t["lineitem"])
+    import decimal as pydec
+    cond = E.And(
+        E.And(E.GreaterThanOrEqual(col("l_shipdate"),
+                                   E.Literal(d_lo, DTYPE_DATE)),
+              E.LessThan(col("l_shipdate"), E.Literal(d_hi, DTYPE_DATE))),
+        E.And(E.And(E.GreaterThanOrEqual(col("l_discount"),
+                                         E.Literal(pydec.Decimal("0.05"))),
+                    E.LessThanOrEqual(col("l_discount"),
+                                      E.Literal(pydec.Decimal("0.07")))),
+              E.LessThan(col("l_quantity"),
+                         E.Literal(pydec.Decimal("24")))))
+    revenue = E.Multiply(col("l_extendedprice"), col("l_discount"))
+    return li.filter(cond).agg((Sum(revenue), "revenue"))
+
+
+from . import types as _t           # noqa: E402
+DTYPE_DATE = _t.DATE
+
+QUERIES = {"q1": q1, "q3": q3, "q5": q5, "q6": q6}

@@ -229,8 +229,10 @@ def physical_np_dtype(dt: DataType):
     if isinstance(dt, DoubleType):
         return np.int64
     if isinstance(dt, DecimalType):
-        if dt.is_wide:
-            raise TypeError("wide decimal has a two-lane representation")
+        # narrow: int64 unscaled.  wide (p>18): the PRIMARY lane is still
+        # int64 — host columns carry a data_hi lane alongside; device-
+        # computed wide results are single-lane int64 with overflow-to-null
+        # (ops/decimal.py module docs).
         return np.int64
     try:
         return _NP_DTYPES[type(dt)]

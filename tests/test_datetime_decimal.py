@@ -1,0 +1,260 @@
+"""Datetime + decimal expression tests: device vs CPU-oracle vs直接 checks.
+
+Reference scope: datetimeExpressions.scala field extraction / date math,
+decimalExpressions.scala + GpuCast decimal paths (int64 unscaled lanes).
+"""
+import datetime as pydt
+import decimal as pydec
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.plan import datetime as DT
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.aggregates import Count, Max, Min, Sum, Average
+from spark_rapids_tpu.session import DataFrame, TpuSession, col, lit
+
+D = pydec.Decimal
+
+
+@pytest.fixture(scope="module")
+def date_table():
+    dates = [pydt.date(2024, 2, 29), pydt.date(1970, 1, 1),
+             pydt.date(1969, 12, 31), pydt.date(2000, 2, 28),
+             pydt.date(1999, 12, 31), None, pydt.date(2023, 1, 1),
+             pydt.date(1900, 3, 1), pydt.date(2100, 12, 31),
+             pydt.date(2024, 1, 8)]
+    ts = [pydt.datetime(2024, 2, 29, 13, 45, 59, 123456),
+          pydt.datetime(1970, 1, 1, 0, 0, 0),
+          pydt.datetime(1969, 12, 31, 23, 59, 59),
+          None,
+          pydt.datetime(2000, 6, 15, 6, 30, 15, 500000),
+          pydt.datetime(1955, 11, 5, 12, 0, 0),
+          pydt.datetime(2038, 1, 19, 3, 14, 7),
+          pydt.datetime(2024, 12, 31, 23, 0, 0),
+          pydt.datetime(2001, 9, 9, 1, 46, 40),
+          pydt.datetime(1977, 5, 25, 19, 0, 0)]
+    return pa.table({
+        "d": pa.array(dates, pa.date32()),
+        "ts": pa.array(ts, pa.timestamp("us", tz="UTC")),
+        "n": pa.array(range(10), pa.int32()),
+        "i": pa.array(range(10), pa.int64()),
+    })
+
+
+def run_both(table, expr, name="r"):
+    dev_s = TpuSession()
+    df = dev_s.from_arrow(table).select(col("i"), E.Alias(expr, name))
+    q = df.physical()
+    assert q.kind == "device", q.explain()
+    dev = q.collect().sort_by("i").column(name).to_pylist()
+    cpu_s = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    cpu = DataFrame(df._plan, cpu_s).collect().sort_by("i") \
+        .column(name).to_pylist()
+    return dev, cpu
+
+
+DATE_EXPRS = [
+    ("year", lambda: DT.Year(col("d"))),
+    ("month", lambda: DT.Month(col("d"))),
+    ("day", lambda: DT.DayOfMonth(col("d"))),
+    ("dayofweek", lambda: DT.DayOfWeek(col("d"))),
+    ("weekday", lambda: DT.WeekDay(col("d"))),
+    ("dayofyear", lambda: DT.DayOfYear(col("d"))),
+    ("quarter", lambda: DT.Quarter(col("d"))),
+    ("weekofyear", lambda: DT.WeekOfYear(col("d"))),
+    ("year_of_ts", lambda: DT.Year(col("ts"))),
+    ("month_of_ts", lambda: DT.Month(col("ts"))),
+    ("hour", lambda: DT.Hour(col("ts"))),
+    ("minute", lambda: DT.Minute(col("ts"))),
+    ("second", lambda: DT.Second(col("ts"))),
+    ("date_add", lambda: DT.DateAdd(col("d"), col("n"))),
+    ("date_add_lit", lambda: DT.DateAdd(col("d"), 45)),
+    ("date_sub", lambda: DT.DateSub(col("d"), 400)),
+    ("datediff", lambda: DT.DateDiff(col("d"), DT.DateAdd(col("d"), 37))),
+    ("add_months", lambda: DT.AddMonths(col("d"), col("n"))),
+    ("add_months_neg", lambda: DT.AddMonths(col("d"), -13)),
+    ("last_day", lambda: DT.LastDay(col("d"))),
+    ("trunc_year", lambda: DT.TruncDate(col("d"), "year")),
+    ("trunc_month", lambda: DT.TruncDate(col("d"), "month")),
+    ("trunc_quarter", lambda: DT.TruncDate(col("d"), "quarter")),
+    ("trunc_week", lambda: DT.TruncDate(col("d"), "week")),
+    ("to_unix_ts", lambda: DT.ToUnixTimestamp(col("ts"))),
+    ("to_unix_date", lambda: DT.ToUnixTimestamp(col("d"))),
+    ("cast_d_ts", lambda: E.Cast(col("d"), t.TIMESTAMP)),
+    ("cast_ts_d", lambda: E.Cast(col("ts"), t.DATE)),
+]
+
+
+@pytest.mark.parametrize("name,make", DATE_EXPRS, ids=[n for n, _ in DATE_EXPRS])
+def test_datetime_device_matches_cpu(date_table, name, make):
+    dev, cpu = run_both(date_table, make())
+    assert dev == cpu, name
+
+
+def test_datetime_python_oracle(date_table):
+    # belt-and-braces: device vs direct python datetime for field extracts
+    dev, _ = run_both(date_table, DT.DayOfWeek(col("d")))
+    dates = date_table.column("d").to_pylist()
+    exp = [None if d is None else (d.isoweekday() % 7) + 1 for d in dates]
+    assert dev == exp
+    dev, _ = run_both(date_table, DT.WeekOfYear(col("d")))
+    exp = [None if d is None else d.isocalendar()[1] for d in dates]
+    assert dev == exp
+
+
+# ---------------------------------------------------------------------------
+# Decimal
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dec_table():
+    a = [D("123.45"), D("-0.01"), D("9999999999.99"), None, D("0.00"),
+         D("555.55"), D("-9999999999.99"), D("10.00")]
+    b = [D("2.5"), D("1000.0"), D("-1.1"), D("3.3"), None, D("0.1"),
+         D("7.0"), D("-10.0")]
+    return pa.table({
+        "a": pa.array(a, pa.decimal128(12, 2)),
+        "b": pa.array(b, pa.decimal128(8, 1)),
+        "i": pa.array(range(8), pa.int64()),
+        "f": pa.array([1.5, -2.25, 3.75, 0.0, 1e6, -0.125, 2.0, 99.99]),
+    })
+
+
+DEC_EXPRS = [
+    ("add", lambda: E.Add(col("a"), col("b"))),
+    ("sub", lambda: E.Subtract(col("a"), col("b"))),
+    ("mul", lambda: E.Multiply(col("a"), col("b"))),
+    ("add_int", lambda: E.Add(col("a"), E.Literal(7))),
+    ("mul_lit", lambda: E.Multiply(col("a"), E.Literal(D("0.80")))),
+    ("neg", lambda: E.UnaryMinus(col("a"))),
+    ("abs", lambda: E.Abs(col("a"))),
+    ("cast_rescale_up", lambda: E.Cast(col("a"), t.DecimalType(15, 4))),
+    ("cast_rescale_down", lambda: E.Cast(col("a"), t.DecimalType(12, 1))),
+    ("cast_to_long", lambda: E.Cast(col("a"), t.LONG)),
+    ("cast_to_int", lambda: E.Cast(col("a"), t.INT)),
+    ("cast_to_double", lambda: E.Cast(col("a"), t.DOUBLE)),
+    ("cast_from_int", lambda: E.Cast(col("i"), t.DecimalType(10, 2))),
+    ("cast_from_double", lambda: E.Cast(col("f"), t.DecimalType(12, 3))),
+    ("cmp_lt", lambda: E.LessThan(col("a"), col("b"))),
+    ("cmp_eq", lambda: E.EqualTo(col("a"), E.Literal(D("10.00")))),
+    ("cmp_mixed_scale", lambda: E.GreaterThanOrEqual(col("b"), col("a"))),
+    ("cmp_int", lambda: E.GreaterThan(col("a"), E.Literal(100))),
+]
+
+
+@pytest.mark.parametrize("name,make", DEC_EXPRS, ids=[n for n, _ in DEC_EXPRS])
+def test_decimal_device_matches_cpu(dec_table, name, make):
+    dev, cpu = run_both(dec_table, make())
+    if name in ("cast_to_double",):
+        # decimal->double divides on the emulated-f64 unit: last-ulp
+        # deviations are the documented float-compat contract
+        assert dev == pytest.approx(cpu, rel=1e-12), name
+    else:
+        assert dev == cpu, name
+
+
+def test_decimal_result_types(dec_table):
+    s = TpuSession()
+    df = s.from_arrow(dec_table).select(
+        E.Alias(E.Add(col("a"), col("b")), "add"),
+        E.Alias(E.Multiply(col("a"), col("b")), "mul"))
+    sch = df.schema
+    # add: max(12-2, 8-1)+max(2,1)+1 = 13, s=2 ; mul: 12+8+1=21, s=3
+    assert sch["add"].data_type == t.DecimalType(13, 2)
+    assert sch["mul"].data_type == t.DecimalType(21, 3)
+
+
+def test_decimal_divide_falls_back_exact(dec_table):
+    s = TpuSession()
+    df = s.from_arrow(dec_table).select(
+        col("i"), E.Alias(E.Divide(col("a"), col("b")), "q"))
+    q = df.physical()
+    assert q.kind == "host"
+    out = q.collect().sort_by("i").column("q").to_pylist()
+    a = dec_table.column("a").to_pylist()
+    b = dec_table.column("b").to_pylist()
+    # spot-check: 123.45 / 2.5 = 49.38
+    assert out[0] == D("123.45") / D("2.5")
+    assert out[3] is None and out[4] is None
+
+
+def test_decimal_filter_and_groupby(dec_table):
+    s = TpuSession()
+    out = s.from_arrow(dec_table).filter(
+        E.GreaterThan(col("a"), E.Literal(D("0.00")))).collect()
+    assert out.num_rows == 4
+    df = s.from_arrow(dec_table).agg(
+        (Sum(col("a")), "sa"), (Min(col("a")), "mn"), (Max(col("a")), "mx"),
+        (Count(col("a")), "c"))
+    got = df.collect()
+    vals = [v for v in dec_table.column("a").to_pylist() if v is not None]
+    assert got.column("sa").to_pylist()[0] == sum(vals)
+    assert got.column("mn").to_pylist()[0] == min(vals)
+    assert got.column("mx").to_pylist()[0] == max(vals)
+    assert got.column("c").to_pylist()[0] == len(vals)
+
+
+def test_decimal_avg_device_exact(dec_table):
+    s = TpuSession()
+    df = s.from_arrow(dec_table).agg((Average(col("a")), "av"))
+    q = df.physical()
+    assert q.kind == "device", q.explain()
+    out = q.collect().column("av").to_pylist()[0]
+    vals = [v for v in dec_table.column("a").to_pylist() if v is not None]
+    exp = (sum(vals) / len(vals)).quantize(D("0.000001"),
+                                           rounding=pydec.ROUND_HALF_UP)
+    assert out == exp
+    # and the CPU fallback engine agrees
+    cpu_s = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    cpu = DataFrame(df._plan, cpu_s).collect().column("av").to_pylist()[0]
+    assert cpu == exp
+
+
+def test_decimal_overflow_nulls():
+    # mul result exceeding int64 unscaled nulls out (documented deviation)
+    tbl = pa.table({"a": pa.array([D("99999999999999.99")],
+                                  pa.decimal128(16, 2)),
+                    "i": pa.array([0], pa.int64())})
+    dev, _ = run_both(tbl, E.Multiply(col("a"), col("a")))
+    assert dev == [None]
+
+
+def test_string_cast_device(dec_table):
+    tbl = pa.table({
+        "s": pa.array(["12", " 34 ", "x", "", None, "-7", "3.9", "1e3"]),
+        "i": pa.array(range(8), pa.int64()),
+    })
+    for dst, exp in [
+        (t.INT, [12, 34, None, None, None, -7, 3, 1000]),
+        (t.LONG, [12, 34, None, None, None, -7, 3, 1000]),
+        (t.DOUBLE, [12.0, 34.0, None, None, None, -7.0, 3.9, 1000.0]),
+        (t.DecimalType(6, 1),
+         [D("12.0"), D("34.0"), None, None, None, D("-7.0"), D("3.9"),
+          D("1000.0")]),
+    ]:
+        dev, cpu = run_both(tbl, E.Cast(col("s"), dst))
+        assert dev == cpu == exp, dst
+
+
+def test_string_to_date_cast():
+    tbl = pa.table({
+        "s": pa.array(["2024-02-29", " 1970-01-01", "bad", None,
+                       "1999-12-31", "2024-13-01"]),
+        "i": pa.array(range(6), pa.int64()),
+    })
+    dev, cpu = run_both(tbl, E.Cast(col("s"), t.DATE))
+    exp = [pydt.date(2024, 2, 29), pydt.date(1970, 1, 1), None, None,
+           pydt.date(1999, 12, 31), None]
+    assert dev == cpu == exp
+
+
+def test_date_sort_and_join_keys(date_table):
+    s = TpuSession()
+    out = s.from_arrow(date_table).sort(("d", True, True)).collect()
+    got = out.column("d").to_pylist()
+    exp = sorted([d for d in date_table.column("d").to_pylist()
+                  if d is not None])
+    assert got == [None] + exp

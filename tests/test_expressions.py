@@ -166,8 +166,8 @@ def test_filter_string_predicate():
 
 def test_unsupported_tagging():
     from spark_rapids_tpu.config import DEFAULT_CONF
-    schema = t.StructType([t.StructField("s", t.STRING)])
-    e = E.Cast(col("s"), t.INT).bind(schema)
+    schema = t.StructType([t.StructField("i", t.INT)])
+    e = E.Cast(col("i"), t.STRING).bind(schema)   # int->string: no dict
     reasons = e.tree_unsupported(DEFAULT_CONF)
     assert reasons and "cast" in reasons[0].lower()
 
