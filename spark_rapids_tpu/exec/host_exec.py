@@ -387,9 +387,7 @@ class CpuSortExec(HostNode):
             keys.append((f"_s{i}", "ascending" if asc else "descending",
                          "at_start" if nf else "at_end"))
         work = pa.table({f"_s{i}": c for i, c in enumerate(sort_cols)})
-        idx = pc.sort_indices(
-            work, sort_keys=[(n, d) for n, d, _ in keys],
-            null_placement=keys[0][2] if keys else "at_start")
+        idx = pc.sort_indices(work, sort_keys=keys)
         out = pa.Table.from_batches([rb]).take(idx)
         yield HostBatch.from_table(out).rb
 
@@ -547,3 +545,445 @@ class CpuExpandExec(HostNode):
         for rb in self.child.execute(ctx):
             for proj in self.projections:
                 yield _eval_named(proj, self.names, rb)
+
+
+class CpuWindowExec(HostNode):
+    """CPU window fallback: numpy over the partition-sorted table.
+
+    Independent of the device kernel (ops/window.py) — row-at-a-time /
+    numpy formulations of Spark's window semantics, usable as both the
+    per-operator fallback and the correctness cross-check (SURVEY §4
+    "same query, two backends").  Decimal inputs compute through float64
+    (documented fallback-precision deviation)."""
+
+    def __init__(self, window_exprs, partition_keys, order_keys,
+                 child: HostNode):
+        from ..plan.window import check_window_analysis
+        super().__init__(child)
+        check_window_analysis(window_exprs, order_keys)
+        schema = child.output_schema
+        self.window_exprs = [(spec.bind(schema), name)
+                             for spec, name in window_exprs]
+        self.partition_keys = [e.bind(schema) for e in partition_keys]
+        self.order_keys = [(e.bind(schema), asc, nf)
+                           for e, asc, nf in order_keys]
+
+    @property
+    def output_schema(self) -> t.StructType:
+        fields = list(self.child.output_schema.fields)
+        for spec, name in self.window_exprs:
+            fields.append(t.StructField(name, spec.dtype))
+        return t.StructType(fields)
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        import numpy as np
+        import pandas as pd
+        from ..plan.window import default_frame
+
+        tbl = self._table(ctx)
+        rb = HostBatch.from_table(tbl).rb
+        n = rb.num_rows
+        arr = CpuAggregateExec._arr
+
+        key_cols, key_specs = [], []
+        for i, e in enumerate(self.partition_keys):
+            key_cols.append((f"_p{i}", arr(e.eval_cpu(rb), n), True, True))
+        for i, (e, asc, nf) in enumerate(self.order_keys):
+            key_cols.append((f"_o{i}", arr(e.eval_cpu(rb), n), asc, nf))
+        if key_cols and n:
+            work = pa.table({nm: c for nm, c, _, _ in key_cols})
+            idx = pc.sort_indices(
+                work,
+                sort_keys=[(nm, "ascending" if asc else "descending",
+                            "at_start" if nf else "at_end")
+                           for nm, _, asc, nf in key_cols]
+            ).to_numpy(zero_copy_only=False)
+        else:
+            idx = np.arange(n)
+        srb = pa.Table.from_batches([rb]).take(idx)
+        srb = HostBatch.from_table(srb).rb
+
+        # boundary structure via per-column factorized codes (nulls equal)
+        def codes_of(a):
+            return pd.factorize(a.take(pa.array(idx)).to_pandas(),
+                                use_na_sentinel=False)[0]
+
+        np_idx = np.arange(n, dtype=np.int64)
+        part_b = np.zeros(n, bool)
+        peer_b = np.zeros(n, bool)
+        if n:
+            part_b[0] = peer_b[0] = True
+        for nm, a, _asc, _nf in key_cols:
+            c = codes_of(a)
+            diff = np.zeros(n, bool)
+            diff[1:] = c[1:] != c[:-1]
+            if nm.startswith("_p"):
+                part_b |= diff
+            peer_b |= diff
+        seg = np.cumsum(part_b) - 1 if n else np.zeros(0, np.int64)
+        pg = np.cumsum(peer_b) - 1 if n else np.zeros(0, np.int64)
+
+        def seg_edges(ids):
+            starts = np.zeros(n, np.int64)
+            ends = np.zeros(n, np.int64)
+            if not n:
+                return starts, ends
+            first = np.zeros(ids.max() + 1, np.int64)
+            last = np.zeros(ids.max() + 1, np.int64)
+            b = np.ones(n, bool)
+            b[1:] = ids[1:] != ids[:-1]
+            first[ids[b]] = np_idx[b]
+            e_mask = np.ones(n, bool)
+            e_mask[:-1] = ids[1:] != ids[:-1]
+            last[ids[e_mask]] = np_idx[e_mask]
+            return first[ids], last[ids]
+
+        part_start, part_end = seg_edges(seg)
+        peer_start, peer_end = seg_edges(pg)
+        part_rows = part_end - part_start + 1
+        rn0 = np_idx - part_start
+
+        # value-offset RANGE frames: per-partition searchsorted over the
+        # single numeric order key (Spark's analyzer requirement)
+        def range_bounds(frame):
+            from ..plan.window import WindowAnalysisError
+            if len(self.order_keys) != 1:
+                raise WindowAnalysisError(
+                    "a value-offset RANGE frame requires exactly one "
+                    "window ORDER BY expression")
+            oe, oasc, _onf = self.order_keys[0]
+            odt = oe.dtype
+            if not (t.is_numeric(odt) or isinstance(
+                    odt, (t.DateType, t.TimestampType, t.DecimalType))):
+                raise WindowAnalysisError(
+                    f"value-offset RANGE frame over "
+                    f"{odt.simple_string} order key")
+            oa = key_cols[len(self.partition_keys)][1].take(pa.array(idx))
+            ovalid = pc.is_valid(oa).to_numpy(zero_copy_only=False)
+            ov = oa.cast(pa.float64()).fill_null(0.0) \
+                .to_numpy(zero_copy_only=False)
+            vvv = ov if oasc else -ov     # ascending comparison lane
+            lo = np.empty(n, np.int64)
+            hi = np.empty(n, np.int64)
+            starts = np.nonzero(part_b)[0]
+            for s, e in zip(starts, np.append(starts[1:], n)):
+                vidx = np.nonzero(ovalid[s:e])[0]
+                if not len(vidx):
+                    lo[s:e] = s
+                    hi[s:e] = e - 1
+                    continue
+                vs, ve = int(vidx[0]), int(vidx[-1])
+                sub = vvv[s + vs:s + ve + 1]
+                l_ = np.zeros(len(sub), np.int64) if frame.lower is None \
+                    else np.searchsorted(sub, sub + frame.lower, "left")
+                h_ = np.full(len(sub), len(sub) - 1, np.int64) \
+                    if frame.upper is None \
+                    else np.searchsorted(sub, sub + frame.upper,
+                                         "right") - 1
+                lo[s + vs:s + ve + 1] = s + vs + l_
+                hi[s + vs:s + ve + 1] = s + vs + h_
+                # null order rows form their own peer frame
+                if vs > 0:
+                    lo[s:s + vs] = s
+                    hi[s:s + vs] = s + vs - 1
+                if s + ve + 1 < e:
+                    lo[s + ve + 1:e] = s + ve + 1
+                    hi[s + ve + 1:e] = e - 1
+            return lo, hi
+
+        out_arrays = []
+        for spec, _name in self.window_exprs:
+            frame = spec.frame
+            if frame is None:
+                if spec.kind in ("row_number", "rank", "dense_rank",
+                                 "percent_rank", "cume_dist", "ntile",
+                                 "lead", "lag"):
+                    frame = None
+                else:
+                    frame = default_frame(bool(self.order_keys))
+            gather_source = None
+            order_lane = None
+            rank_order = None
+            default_slot = None
+            if spec.child is not None:
+                va = arr(spec.child.eval_cpu(srb), n)
+                valid = pc.is_valid(va).to_numpy(zero_copy_only=False)
+                dt = spec.child.dtype
+                if isinstance(dt, (t.StringType, t.BinaryType)):
+                    # value-carrying functions gather from the source array;
+                    # their numeric lane carries row indices (min/max order
+                    # rows by value rank).  Structural functions over string
+                    # inputs (count) never touch the value lane.
+                    if spec.kind in ("lead", "lag", "first_value",
+                                     "last_value", "agg_min", "agg_max"):
+                        gather_source = va
+                    vals = np.arange(n, dtype=np.int64)
+                    if spec.kind in ("lead", "lag") and \
+                            spec.default is not None:
+                        # default rides as an extra slot at index n
+                        gather_source = pa.concat_arrays(
+                            [va.combine_chunks()
+                             if isinstance(va, pa.ChunkedArray) else va,
+                             pa.array([spec.default], va.type)])
+                        default_slot = n
+                    if spec.kind in ("agg_min", "agg_max"):
+                        rank_order = pc.sort_indices(
+                            va, null_placement="at_end"
+                        ).to_numpy(zero_copy_only=False).astype(np.int64)
+                        order_lane = np.empty(n, np.int64)
+                        order_lane[rank_order] = np.arange(n)
+                elif isinstance(dt, (t.FloatType, t.DoubleType)):
+                    vals = va.cast(pa.float64()).fill_null(0.0) \
+                        .to_numpy(zero_copy_only=False)
+                elif isinstance(dt, t.DecimalType):
+                    # decimal through float64: documented fallback deviation
+                    vals = va.cast(pa.float64()).fill_null(0.0) \
+                        .to_numpy(zero_copy_only=False)
+                else:
+                    # exact int64 lane for integral/bool/date/timestamp —
+                    # no float64 round trip (lossy beyond 2^53)
+                    vals = va.cast(pa.int64()).fill_null(0) \
+                        .to_numpy(zero_copy_only=False)
+            else:
+                va, valid, vals, dt = None, np.ones(n, bool), None, None
+
+            data, ok = self._one(spec, frame, n, np_idx, part_start,
+                                 part_end, part_rows, peer_start, peer_end,
+                                 rn0, part_b, peer_b, vals, valid,
+                                 gather_source is not None, order_lane,
+                                 default_slot, range_bounds, seg,
+                                 rank_order)
+            out_arrays.append(self._to_arrow(spec, data, ok, gather_source))
+
+        cols = list(srb.columns) + out_arrays
+        names = list(srb.schema.names) + [nm for _, nm in self.window_exprs]
+        yield pa.RecordBatch.from_arrays(cols, names=names)
+
+    @staticmethod
+    def _one(spec, frame, n, np_idx, part_start, part_end, part_rows,
+             peer_start, peer_end, rn0, part_b, peer_b, vals, valid,
+             as_index, order_lane, default_slot=None, range_bounds=None,
+             seg_of=None, rank_order=None):
+        """Returns (ndarray, validity ndarray).  With `as_index` (string/
+        binary inputs) the value lane carries row indices and min/max order
+        by `order_lane` (value ranks); the caller gathers real values."""
+        import numpy as np
+        k = spec.kind
+        live = np.ones(n, bool)
+        if k == "row_number":
+            return rn0 + 1, live
+        if k == "rank":
+            return peer_start - part_start + 1, live
+        if k == "dense_rank":
+            dr = np.zeros(n, np.int64)
+            cur = 0
+            for i in range(n):
+                cur = 1 if part_b[i] else (cur + (1 if peer_b[i] else 0))
+                dr[i] = cur
+            return dr, live
+        if k == "percent_rank":
+            denom = np.maximum(part_rows - 1, 1)
+            out = (peer_start - part_start) / denom
+            return np.where(part_rows == 1, 0.0, out), live
+        if k == "cume_dist":
+            return (peer_end - part_start + 1) / part_rows, live
+        if k == "ntile":
+            nt = spec.n
+            kk = part_rows // nt
+            rem = part_rows % nt
+            cut = rem * (kk + 1)
+            bucket = np.where(rn0 < cut, rn0 // np.maximum(kk + 1, 1),
+                              rem + (rn0 - cut) // np.maximum(kk, 1))
+            bucket = np.where(part_rows < nt, rn0, bucket)
+            return bucket + 1, live
+        if k in ("lead", "lag"):
+            shift = spec.offset * (1 if k == "lead" else -1)
+            src = np_idx + shift
+            in_part = (src >= part_start) & (src <= part_end)
+            srcc = np.clip(src, 0, max(n - 1, 0))
+            sd = vals[srcc] if n else vals
+            sv = valid[srcc] if n else valid
+            if spec.default is not None:
+                dflt = vals.dtype.type(default_slot if as_index
+                                       else spec.default)
+                data = np.where(in_part, sd, dflt)
+                return data, np.where(in_part, sv, True)
+            return np.where(in_part, sd, vals.dtype.type(0)), in_part & sv
+
+        # framed aggregates / first_value / last_value
+        value_range = frame.kind == "range" and (
+            frame.lower not in (None, 0) or frame.upper not in (None, 0))
+        if value_range:
+            lo, hi = range_bounds(frame)     # searchsorted value offsets
+        elif frame.kind == "range":
+            lo = part_start if frame.lower is None else peer_start
+            hi = part_end if frame.upper is None else peer_end
+        else:
+            lo = part_start if frame.lower is None \
+                else np.maximum(part_start, np_idx + frame.lower)
+            hi = part_end if frame.upper is None \
+                else np.minimum(part_end, np_idx + frame.upper)
+        nonempty = hi >= lo
+        if k == "first_value" or k == "last_value":
+            pick = np.clip(lo if k == "first_value" else hi, 0,
+                           max(n - 1, 0))
+            return vals[pick], valid[pick] & nonempty
+        # prefix windows
+        vmask = valid
+        cnt_lane = (vmask if spec.child is not None
+                    else np.ones(n, bool)).astype(np.int64)
+        pc_cnt = np.cumsum(cnt_lane)
+        loc = np.clip(lo - 1, -1, n - 1)
+        base_c = np.where(lo > 0, pc_cnt[loc], 0)
+        hic = np.clip(hi, 0, max(n - 1, 0))
+        cnt = np.where(nonempty, pc_cnt[hic] - base_c, 0)
+        if k == "agg_count":
+            return cnt, live
+        if k in ("agg_sum", "agg_avg"):
+            zero = vals.dtype.type(0)
+            ps = np.cumsum(np.where(vmask, vals, zero))
+            base = np.where(lo > 0, ps[loc], zero)
+            s = np.where(nonempty, ps[hic] - base, zero)
+            if k == "agg_sum":
+                return s, cnt > 0
+            return s / np.maximum(cnt, 1), cnt > 0
+        return CpuWindowExec._minmax(
+            spec, frame, n, part_b, seg_of, lo, hi, nonempty, cnt, vals,
+            valid, order_lane, rank_order)
+
+    @staticmethod
+    def _minmax(spec, frame, n, part_b, seg_of, lo, hi, nonempty, cnt,
+                vals, valid, order_lane, rank_order):
+        """Window min/max.  Selection happens in an *order lane* (value
+        ranks for strings, NaN-mapped-to-+inf floats, exact ints); the
+        result row's true value is emitted, so NaN inputs and null-fill
+        slots are never confused (nulls are excluded from selection
+        entirely).  O(n) paths cover the always-on-CPU shapes (running /
+        unbounded frames — string min/max never runs on device); bounded
+        and value-range frames use a per-row selection loop."""
+        import numpy as np
+        k = spec.kind
+        is_min = k == "agg_min"
+        olane = order_lane if order_lane is not None else vals
+        is_float = np.issubdtype(np.asarray(olane).dtype, np.floating)
+        if is_float:
+            nan_mask = np.isnan(olane) & valid
+            olane = np.where(np.isnan(olane), np.inf, olane)
+            ident = np.inf if is_min else -np.inf
+        else:
+            nan_mask = None
+            info = np.iinfo(olane.dtype)
+            ident = olane.dtype.type(info.max if is_min else info.min)
+        masked = np.where(valid, olane, ident)
+        op = np.minimum if is_min else np.maximum
+        starts = np.nonzero(part_b)[0]
+
+        def decode(red_olane, frame_cnt, frame_nan_cnt):
+            """Order-lane result -> (value lane, validity)."""
+            okv = (frame_cnt > 0) & nonempty
+            if rank_order is not None:
+                # string rank -> winning row index (vals carries indices)
+                r = np.clip(red_olane, 0, max(n - 1, 0)).astype(np.int64)
+                return rank_order[r], okv
+            if is_float and frame_nan_cnt is not None:
+                non_nan = frame_cnt - frame_nan_cnt
+                if is_min:     # NaN only when every valid value is NaN
+                    red = np.where((frame_cnt > 0) & (non_nan == 0),
+                                   np.nan, red_olane)
+                else:          # NaN greatest: any NaN wins the max
+                    red = np.where(frame_nan_cnt > 0, np.nan, red_olane)
+                return red, okv
+            return red_olane, okv
+
+        running = frame.kind == "rows" and frame.lower is None and \
+            frame.upper == 0
+        range_running = frame.kind == "range" and frame.lower is None and \
+            frame.upper == 0
+        unbounded = frame.lower is None and frame.upper is None
+        if running or range_running or unbounded:
+            nan_cnt_pref = None
+            if nan_mask is not None:
+                nan_cnt_pref = np.cumsum(nan_mask.astype(np.int64))
+
+            def frame_nan(lo_, hi_):
+                if nan_cnt_pref is None:
+                    return None
+                base = np.where(lo_ > 0,
+                                nan_cnt_pref[np.clip(lo_ - 1, 0, n - 1)], 0)
+                return nan_cnt_pref[np.clip(hi_, 0, max(n - 1, 0))] - base
+            if unbounded:
+                red = op.reduceat(masked, starts)[seg_of] if n \
+                    else masked
+                return decode(red, cnt, frame_nan(lo, hi))
+            acc = np.empty_like(masked)
+            for s, e in zip(starts, np.append(starts[1:], n)):
+                acc[s:e] = op.accumulate(masked[s:e])
+            if range_running:   # include current row's peers
+                acc = acc[np.clip(hi, 0, max(n - 1, 0))]
+            return decode(acc, cnt, frame_nan(lo, hi))
+
+        # bounded / value-range frames: per-row selection among VALID rows
+        out = np.zeros(n, vals.dtype)
+        ok = np.zeros(n, bool)
+        for i in range(n):
+            if not nonempty[i]:
+                continue
+            w = np.arange(lo[i], hi[i] + 1)
+            wvalid = w[valid[w]]
+            if not len(wvalid):
+                continue
+            cand = olane[wvalid]
+            nans = nan_mask[wvalid] if nan_mask is not None else None
+            j = int(np.argmin(cand) if is_min else np.argmax(cand))
+            sel = wvalid[j]
+            if nans is not None:
+                if is_min and nans.all():
+                    out[i] = np.nan
+                    ok[i] = True
+                    continue
+                if not is_min and nans.any():
+                    out[i] = np.nan
+                    ok[i] = True
+                    continue
+            out[i] = vals[sel]
+            ok[i] = True
+        return out, ok
+
+    @staticmethod
+    def _to_arrow(spec, data, ok, gather_source):
+        import numpy as np
+        dt = spec.dtype
+        atype = dtype_to_arrow(dt)
+        mask = ~np.asarray(ok, bool)
+        if gather_source is not None:
+            # pass-through over strings/binary: data carries row indices
+            idx = np.clip(np.asarray(data, np.int64), 0,
+                          max(len(gather_source) - 1, 0))
+            taken = gather_source.take(pa.array(idx))
+            return pc.if_else(pa.array(~mask), taken,
+                              pa.nulls(len(mask), atype))
+        if isinstance(dt, t.DecimalType):
+            import decimal as _d
+            q = _d.Decimal(1).scaleb(-dt.scale)
+            pyvals = [None if m else _d.Decimal(repr(float(v))).quantize(
+                q, rounding=_d.ROUND_HALF_UP)
+                for v, m in zip(np.asarray(data, np.float64), mask)]
+            return pa.array(pyvals, type=atype)
+        # logical (arrow) representation, NOT the device storage lane —
+        # DOUBLE's physical lane is int64 bit patterns and must not be
+        # used to round-trip host-computed floats
+        data = np.asarray(data)
+        if isinstance(dt, (t.FloatType, t.DoubleType)):
+            return pa.array(data.astype(np.float64), pa.float64(),
+                            mask=mask).cast(atype)
+        if isinstance(dt, t.BooleanType):
+            return pa.array(data.astype(bool), atype, mask=mask)
+        ints = np.rint(data).astype(np.int64) \
+            if not np.issubdtype(data.dtype, np.integer) else data
+        if isinstance(dt, (t.DateType, t.TimestampType)):
+            w = pa.int32() if isinstance(dt, t.DateType) else pa.int64()
+            return pa.array(ints, pa.int64(), mask=mask).cast(w).cast(atype)
+        return pa.array(ints, pa.int64(), mask=mask).cast(atype)
+
+    def describe(self):
+        return f"CpuWindowExec[{[n for _, n in self.window_exprs]}]"

@@ -1,0 +1,381 @@
+"""Window kernels: segmented scans/reductions over partition-sorted rows.
+
+Role of cuDF RollingAggregation / scan-based running windows in the
+reference (window/GpuWindowExec.scala:146, GpuRunningWindowExec.scala:220,
+GpuBatchedBoundedWindowExec.scala:220) — re-designed for XLA:
+
+  * the input batch arrives sorted by (partition keys, order keys)
+    (ops/sort.py lexsort); partition and peer boundaries are equality
+    flags on adjacent rows (same trick as the sort-segment groupby);
+  * running frames  = segmented inclusive scans via `lax.associative_scan`
+    with a boundary-reset combiner (one log-depth pass, no scatter);
+  * unbounded frames = segment reductions broadcast back through seg ids;
+  * bounded ROWS sums/counts = global prefix-sum differences with the
+    window clamped to the partition span (exact: clamping keeps both
+    gathers inside the current partition);
+  * bounded ROWS min/max = static shift-stack reduction when both bounds
+    are finite, forward/backward segmented scans gathered at the moving
+    bound when one side is unbounded;
+  * RANGE frames (UNBOUNDED/CURRENT shapes) = the running result gathered
+    at each row's peer-group end / start.
+
+Everything for one operator runs as ONE jit program per
+(specs, bucket, layout) key.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as t
+from ..plan.window import WindowFrame
+from .groupby import (_bits_from_order, _bits_total_order,
+                      _null_first_key_lanes, _ORDER_MAX, _ORDER_MIN)
+from .kernels import compute_view
+
+
+def _seg_scan(vals: jax.Array, boundary: jax.Array, op) -> jax.Array:
+    """Segmented inclusive scan: resets at rows where boundary is True.
+
+    The combiner on (value, start-flag) pairs is the standard segmented-scan
+    monoid (associative, so log-depth associative_scan applies)."""
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, op(av, bv)), af | bf
+    out, _ = jax.lax.associative_scan(combine, (vals, boundary))
+    return out
+
+
+def _seg_scan_rev(vals: jax.Array, boundary: jax.Array, op) -> jax.Array:
+    """Segmented inclusive scan running from each segment's END backwards.
+    `boundary` marks segment STARTS; reversed, segment ends become starts."""
+    end_b = jnp.concatenate([boundary[1:], jnp.ones((1,), bool)])
+    out = _seg_scan(vals[::-1], end_b[::-1], op)
+    return out[::-1]
+
+
+def _boundary_from_lanes(lanes: List[jax.Array], capacity: int) -> jax.Array:
+    """True where any lane differs from the previous row (row 0 True)."""
+    b = jnp.zeros((capacity,), bool).at[0].set(True)
+    for lane in lanes:
+        if lane is None:
+            continue
+        b = b | jnp.concatenate([jnp.ones((1,), bool),
+                                 lane[1:] != lane[:-1]])
+    return b
+
+
+def _key_eq_lanes(cols_info, datas, valids) -> List[jax.Array]:
+    lanes: List[jax.Array] = []
+    for (dt,), d, v in zip(cols_info, datas, valids):
+        lanes.extend(l for l in _null_first_key_lanes(compute_view(d, dt), v, dt)
+                     if l is not None)
+    return lanes
+
+
+def _gather(vals: jax.Array, idx: jax.Array, capacity: int) -> jax.Array:
+    return vals[jnp.clip(idx, 0, capacity - 1)]
+
+
+def _minmax_ident(dtype, is_min: bool):
+    if dtype in (jnp.float64, jnp.float32):
+        return np.inf if is_min else -np.inf
+    if np.dtype(dtype) == np.bool_:
+        return is_min          # True is min-identity, False is max-identity
+    info = np.iinfo(np.dtype(dtype))
+    return info.max if is_min else info.min
+
+
+def _minmax_lanes(cd, vl, dt, raw_data, is_min):
+    """(order lane with invalid rows at identity, identity scalar, decoder).
+
+    DOUBLE int64-bits columns compare in Java total-order bit space (exact,
+    NaN greatest); computed float lanes order by value with NaN mapped to
+    +inf (NaN-greatest ordering; NaN payload collapse is a documented
+    deviation on computed lanes, cf. docs/compatibility.md float notes)."""
+    if isinstance(dt, t.DoubleType) and raw_data is not None \
+            and raw_data.dtype == jnp.int64:
+        ident = jnp.int64(_ORDER_MAX if is_min else _ORDER_MIN)
+        o = jnp.where(vl, _bits_total_order(raw_data), ident)
+        return o, ident, _bits_from_order
+    if t.is_floating(dt):
+        f = cd.astype(jnp.float64)
+        o = jnp.where(jnp.isnan(f), jnp.float64(np.inf), f)
+        ident = jnp.float64(np.inf if is_min else -np.inf)
+        o = jnp.where(vl, o, ident)
+        return o, ident, (lambda x: x)
+    if isinstance(dt, t.BooleanType):
+        ident = jnp.int8(1 if is_min else 0)
+        o = jnp.where(vl, cd.astype(jnp.int8), ident)
+        return o, ident, (lambda x: x > 0)
+    ident = jnp.asarray(_minmax_ident(cd.dtype, is_min), cd.dtype)
+    o = jnp.where(vl, cd, ident)
+    return o, ident, (lambda x: x)
+
+
+def _round_half_up_div(num: jax.Array, den: jax.Array) -> jax.Array:
+    """Spark decimal division rounding: HALF_UP (away from zero), den > 0."""
+    mag = jnp.abs(num)
+    q = (mag + den // 2) // den
+    return jnp.where(num < 0, -q, q)
+
+
+def window_trace(part_info, order_info, val_info, specs_frames,
+                 capacity: int):
+    """Build the traced window program.
+
+    part_info/order_info/val_info: tuples of (dtype,) per column (static).
+    specs_frames: list of (spec, resolved WindowFrame, input_idx); input_idx
+    indexes the value columns, -1 for input-less functions.
+
+    Returns fn(part_data, part_valid, order_data, order_valid,
+               val_data, val_valid, live) -> [(data, valid)] per spec,
+    where all lanes belong to the partition-sorted batch.
+    """
+    def run(part_data, part_valid, order_data, order_valid,
+            val_data, val_valid, live):
+        idx = jnp.arange(capacity, dtype=jnp.int32)
+
+        # --- partition / peer structure ---
+        part_lanes = _key_eq_lanes(part_info, part_data, part_valid)
+        live_lane = (~live).astype(jnp.int8)
+        part_b = _boundary_from_lanes(part_lanes + [live_lane], capacity)
+        seg = jnp.cumsum(part_b.astype(jnp.int32)) - 1
+
+        order_lanes = _key_eq_lanes(order_info, order_data, order_valid)
+        peer_b = (part_b | _boundary_from_lanes(order_lanes, capacity)) \
+            if order_lanes else part_b
+
+        part_start = _seg_scan(idx, part_b, jnp.minimum)
+        part_end = _gather(jax.ops.segment_max(idx, seg,
+                                               num_segments=capacity),
+                           seg, capacity)
+        part_rows = (part_end - part_start + 1).astype(jnp.int64)
+
+        pg = jnp.cumsum(peer_b.astype(jnp.int32)) - 1
+        peer_start = _seg_scan(idx, peer_b, jnp.minimum)
+        peer_end = _gather(jax.ops.segment_max(idx, pg,
+                                               num_segments=capacity),
+                           pg, capacity)
+
+        rn0 = idx - part_start                     # 0-based row number
+
+        def frame_bounds(frame: WindowFrame):
+            """Per-row inclusive [lo, hi] row-index bounds."""
+            if frame.kind == "range":
+                lo = part_start if frame.lower is None else peer_start
+                hi = part_end if frame.upper is None else peer_end
+                return lo, hi
+            lo = part_start if frame.lower is None \
+                else jnp.maximum(part_start, idx + frame.lower)
+            hi = part_end if frame.upper is None \
+                else jnp.minimum(part_end, idx + frame.upper)
+            return lo, hi
+
+        outs: List[Tuple[jax.Array, jax.Array]] = []
+        for spec, frame, input_idx in specs_frames:
+            kind = spec.kind
+            if input_idx >= 0:
+                d = val_data[input_idx]
+                v = val_valid[input_idx]
+                v = jnp.ones((capacity,), bool) if v is None else v
+                dt = spec.child.dtype
+                cd = compute_view(d, dt)
+                vl = v & live
+            else:
+                d = cd = dt = None
+                vl = live
+
+            if kind == "row_number":
+                outs.append(((rn0 + 1).astype(jnp.int32), live))
+            elif kind == "rank":
+                outs.append(((peer_start - part_start + 1).astype(jnp.int32),
+                             live))
+            elif kind == "dense_rank":
+                dr = _seg_scan(peer_b.astype(jnp.int32), part_b, jnp.add)
+                outs.append((dr, live))
+            elif kind == "percent_rank":
+                rank0 = (peer_start - part_start).astype(jnp.float64)
+                denom = (part_rows - 1).astype(jnp.float64)
+                pr = jnp.where(denom > 0, rank0 / jnp.maximum(denom, 1.0),
+                               0.0)
+                outs.append((pr, live))
+            elif kind == "cume_dist":
+                cume = (peer_end - part_start + 1).astype(jnp.float64) \
+                    / part_rows.astype(jnp.float64)
+                outs.append((cume, live))
+            elif kind == "ntile":
+                n = jnp.int64(spec.n)
+                k = part_rows // n
+                rem = part_rows % n
+                i0 = rn0.astype(jnp.int64)
+                cut = rem * (k + 1)
+                bucket = jnp.where(
+                    i0 < cut, i0 // jnp.maximum(k + 1, 1),
+                    rem + (i0 - cut) // jnp.maximum(k, 1))
+                bucket = jnp.where(part_rows < n, i0, bucket)
+                outs.append(((bucket + 1).astype(jnp.int32), live))
+            elif kind in ("lead", "lag"):
+                shift = spec.offset * (1 if kind == "lead" else -1)
+                src = idx + shift
+                in_part = (src >= part_start) & (src <= part_end) & live
+                sd = _gather(cd, src, capacity)
+                sv = _gather(vl, src, capacity)
+                if spec.default is not None:
+                    # Spark: default only when the offset row does not
+                    # exist; an existing null value stays null
+                    dflt = jnp.asarray(spec.default, sd.dtype)
+                    data = jnp.where(in_part, sd, dflt)
+                    valid = jnp.where(in_part, sv, True) & live
+                else:
+                    data = jnp.where(in_part, sd, jnp.zeros((), sd.dtype))
+                    valid = in_part & sv
+                outs.append((data, valid))
+            elif kind in ("first_value", "last_value"):
+                lo, hi = frame_bounds(frame)
+                pick = lo if kind == "first_value" else hi
+                nonempty = hi >= lo
+                data = _gather(cd, pick, capacity)
+                valid = _gather(vl, pick, capacity) & nonempty & live
+                outs.append((data, valid))
+            elif kind in ("agg_sum", "agg_count", "agg_avg",
+                          "agg_min", "agg_max"):
+                outs.append(_framed_agg(
+                    kind, spec, frame, cd, vl, dt, d, idx, part_b,
+                    frame_bounds, seg, pg, peer_end, peer_start, live,
+                    capacity))
+            else:
+                raise ValueError(f"unknown window kind {kind}")
+        return outs
+
+    return run
+
+
+def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
+                frame_bounds, seg, pg, peer_end, peer_start, live, capacity):
+    """sum/count/min/max/avg over a frame; returns (data, valid)."""
+    is_min = kind == "agg_min"
+    count_all = kind == "agg_count" and spec.child is None
+    cnt_lane = (live if count_all else vl).astype(jnp.int64)
+
+    if kind in ("agg_sum", "agg_avg"):
+        decimal = isinstance(dt, t.DecimalType)
+        if decimal:
+            acc = jnp.where(vl, cd.astype(jnp.int64), 0)
+        elif kind == "agg_avg" or t.is_floating(dt):
+            acc = jnp.where(vl, cd.astype(jnp.float64), 0.0)
+        else:
+            acc = jnp.where(vl, cd.astype(jnp.int64), 0)
+
+    def finish(s, c):
+        if kind == "agg_count":
+            return c, live
+        if kind == "agg_sum":
+            return s, (c > 0) & live
+        if isinstance(dt, t.DecimalType):
+            # avg(decimal(p,s)) -> decimal(p+4, s+4): unscaled*10^4/count
+            q = _round_half_up_div(s * jnp.int64(10 ** 4), jnp.maximum(c, 1))
+            return q, (c > 0) & live
+        return (s / jnp.maximum(c, 1).astype(jnp.float64), (c > 0) & live)
+
+    # --- whole-partition / whole-peer-group frames: reduce + broadcast ---
+    peers_only = frame.kind == "range" and frame.lower == 0 \
+        and frame.upper == 0
+    if frame.is_unbounded_both or peers_only:
+        ids = pg if peers_only else seg
+
+        def bcast(x):
+            return _gather(x, ids, capacity)
+        c = bcast(jax.ops.segment_sum(cnt_lane, ids, num_segments=capacity))
+        if kind == "agg_count":
+            return c, live
+        if kind in ("agg_sum", "agg_avg"):
+            s = bcast(jax.ops.segment_sum(acc, ids, num_segments=capacity))
+            return finish(s, c)
+        o, _ident, back = _minmax_lanes(cd, vl, dt, raw_data, is_min)
+        red = bcast((jax.ops.segment_min if is_min else jax.ops.segment_max)(
+            o, ids, num_segments=capacity))
+        return back(red), (c > 0) & live
+
+    # --- running frames (incl. RANGE ..CURRENT ROW via peer-end gather) ---
+    running_rows = frame.kind == "rows" and frame.is_running
+    running_range = frame.kind == "range" and frame.lower is None \
+        and frame.upper == 0
+    if running_rows or running_range:
+        def at_peers(x):
+            return _gather(x, peer_end, capacity) if running_range else x
+        c = at_peers(_seg_scan(cnt_lane, part_b, jnp.add))
+        if kind == "agg_count":
+            return c, live
+        if kind in ("agg_sum", "agg_avg"):
+            s = at_peers(_seg_scan(acc, part_b, jnp.add))
+            return finish(s, c)
+        o, _ident, back = _minmax_lanes(cd, vl, dt, raw_data, is_min)
+        red = at_peers(_seg_scan(
+            o, part_b, jnp.minimum if is_min else jnp.maximum))
+        return back(red), (c > 0) & live
+
+    # --- RANGE CURRENT ROW .. UNBOUNDED FOLLOWING: reverse running ---
+    if frame.kind == "range":
+        def at_peer_start(x):
+            return _gather(x, peer_start, capacity)
+        c = at_peer_start(_seg_scan_rev(cnt_lane, part_b, jnp.add))
+        if kind == "agg_count":
+            return c, live
+        if kind in ("agg_sum", "agg_avg"):
+            s = at_peer_start(_seg_scan_rev(acc, part_b, jnp.add))
+            return finish(s, c)
+        o, _ident, back = _minmax_lanes(cd, vl, dt, raw_data, is_min)
+        red = at_peer_start(_seg_scan_rev(
+            o, part_b, jnp.minimum if is_min else jnp.maximum))
+        return back(red), (c > 0) & live
+
+    # --- bounded ROWS frames ---
+    lo, hi = frame_bounds(frame)
+    nonempty = (hi >= lo) & live
+
+    if kind in ("agg_sum", "agg_count", "agg_avg"):
+        def pref_window(lane):
+            p = jnp.cumsum(lane)
+            hi_v = _gather(p, hi, capacity)
+            lo_v = jnp.where(lo > 0, _gather(p, lo - 1, capacity),
+                             jnp.zeros((), p.dtype))
+            return jnp.where(nonempty, hi_v - lo_v, jnp.zeros((), p.dtype))
+        c = pref_window(cnt_lane)
+        if kind == "agg_count":
+            return c, live
+        return finish(pref_window(acc), c)
+
+    # bounded min/max
+    o, ident, back = _minmax_lanes(cd, vl, dt, raw_data, is_min)
+    op = jnp.minimum if is_min else jnp.maximum
+    c_cnt = None
+    if frame.lower is None:
+        # UNBOUNDED PRECEDING .. k FOLLOWING: forward scan gathered at hi
+        fwd = _seg_scan(o, part_b, op)
+        red = jnp.where(nonempty, _gather(fwd, hi, capacity), ident)
+    elif frame.upper is None:
+        # k PRECEDING .. UNBOUNDED FOLLOWING: backward scan gathered at lo
+        bwd = _seg_scan_rev(o, part_b, op)
+        red = jnp.where(nonempty, _gather(bwd, lo, capacity), ident)
+    else:
+        best = jnp.full((capacity,), 0, o.dtype) + ident
+        c_cnt = jnp.zeros((capacity,), jnp.int64)
+        for off in range(frame.lower, frame.upper + 1):
+            src = idx + off
+            ok = (src >= lo) & (src <= hi)
+            cand_v = ok & _gather(vl, src, capacity)
+            cand = jnp.where(cand_v, _gather(o, src, capacity), ident)
+            best = op(best, cand)
+            c_cnt = c_cnt + cand_v.astype(jnp.int64)
+        red = best
+    if c_cnt is None:
+        p = jnp.cumsum(vl.astype(jnp.int64))
+        hi_v = _gather(p, hi, capacity)
+        lo_v = jnp.where(lo > 0, _gather(p, lo - 1, capacity), jnp.int64(0))
+        c_cnt = jnp.where(nonempty, hi_v - lo_v, jnp.int64(0))
+    return back(red), (c_cnt > 0) & live

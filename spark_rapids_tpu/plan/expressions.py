@@ -279,8 +279,12 @@ class Literal(Expression):
         if isinstance(v, pydec.Decimal):
             sign, digits, exp = v.as_tuple()
             scale = max(0, -exp)
-            precision = max(len(digits), scale + 1)
-            return t.DecimalType(precision, scale)
+            # positive exponents widen the integral part: 1E+2 is 100 ->
+            # 3 integral digits, decimal(3, 0)
+            integral = len(digits) + max(exp, 0)
+            precision = max(integral + scale if exp >= 0 else len(digits),
+                            scale + 1)
+            return t.DecimalType(min(precision, 38), scale)
         if isinstance(v, pydt.datetime):
             return t.TIMESTAMP
         if isinstance(v, pydt.date):

@@ -245,15 +245,27 @@ class LogicalWindow(LogicalPlan):
 
     def __init__(self, window_exprs: Sequence, partition_keys: Sequence,
                  order_keys: Sequence, child: LogicalPlan):
+        from .window import check_window_analysis
         super().__init__(child)
+        check_window_analysis(window_exprs, order_keys)
         self.window_exprs = list(window_exprs)
         self.partition_keys = [_as_expr(k) for k in partition_keys]
-        self.order_keys = list(order_keys)
+        norm = []
+        for o in order_keys:
+            if isinstance(o, (str, E.Expression)):
+                norm.append((_as_expr(o), True, True))
+            else:
+                e, *rest = o
+                asc = rest[0] if rest else True
+                nf = rest[1] if len(rest) > 1 else asc
+                norm.append((_as_expr(e), asc, nf))
+        self.order_keys = norm
 
     def _resolve_schema(self):
         fields = list(self.child.schema.fields)
         for spec, name in self.window_exprs:
-            fields.append(t.StructField(name, spec.result_type(self.child.schema)))
+            bound = spec.bind(self.child.schema)
+            fields.append(t.StructField(name, bound.dtype))
         return t.StructType(fields)
 
     def describe(self):

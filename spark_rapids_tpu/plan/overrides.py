@@ -152,6 +152,8 @@ exec_rule(L.LogicalJoin, _COMMON, "hash join")
 exec_rule(L.LogicalUnion, t.T.ALL_SIMPLE, "union")
 exec_rule(L.LogicalRange, t.T.ALL_SIMPLE, "range generator")
 exec_rule(L.LogicalExpand, _COMMON, "expand (grouping sets)")
+exec_rule(L.LogicalWindow, _COMMON,
+          "window functions (partition-sorted segmented scans)")
 exec_rule(LogicalParquetScan, t.T.ALL_SIMPLE, "parquet scan")
 exec_rule(LogicalCsvScan, t.T.ALL_SIMPLE, "csv scan")
 exec_rule(LogicalJsonScan, t.T.ALL_SIMPLE, "json scan")
@@ -528,6 +530,56 @@ class TextScanMeta(PlanMeta):
         return CpuTextScanExec(self.node, self.node.schema)
 
 
+class WindowMeta(PlanMeta):
+    """LogicalWindow -> WindowExec (window/GpuWindowExec.scala:146 role).
+    Window specs carry their own support checks (plan/window.py); ranking
+    functions additionally require order keys, as Spark's analyzer does."""
+
+    def __init__(self, node, conf, parent):
+        super().__init__(node, conf, parent)
+        schema = node.child.schema
+        self._wrap_exprs(node.partition_keys, schema)
+        self._wrap_exprs([e for e, _, _ in node.order_keys], schema)
+        self.spec_metas = []
+        for spec, _name in node.window_exprs:
+            # bind failures (e.g. sum over string) are analysis errors, as
+            # in Spark — the CPU path cannot run them either, so they raise
+            # here rather than half-recording an unusable fallback
+            b = spec.bind(schema)
+            self.spec_metas.append(b)
+            if b.child is not None:
+                self.expr_metas.append(ExprMeta(b.child, self.conf))
+
+    def tag_self(self):
+        for b in self.spec_metas:
+            name = type(b).__name__
+            if not self.conf.is_op_enabled("expression", name):
+                self.will_not_work(
+                    f"window function {name} disabled by "
+                    f"spark.rapids.tpu.sql.expression.{name}")
+            for r in b.unsupported_reasons(self.conf):
+                self.will_not_work(f"window function {b.name}: {r}")
+        schema = self.node.child.schema
+        for e, _a, _nf in self.node.order_keys:
+            try:
+                dt = e.bind(schema).dtype
+            except (KeyError, TypeError):
+                continue     # bind failure already recorded by _wrap_exprs
+            if isinstance(dt, t.DecimalType) and dt.is_wide:
+                self.will_not_work("decimal128 window order key "
+                                   "not yet on device")
+
+    def to_device(self):
+        from ..exec.window import WindowExec
+        return WindowExec(self.node.window_exprs, self.node.partition_keys,
+                          self.node.order_keys, self._device_child())
+
+    def to_host(self):
+        return H.CpuWindowExec(self.node.window_exprs,
+                               self.node.partition_keys,
+                               self.node.order_keys, self._host_child())
+
+
 _META_FOR: Dict[type, Type[PlanMeta]] = {
     L.LogicalScan: ScanMeta,
     L.LogicalProject: ProjectMeta,
@@ -539,6 +591,7 @@ _META_FOR: Dict[type, Type[PlanMeta]] = {
     L.LogicalUnion: UnionMeta,
     L.LogicalRange: RangeMeta,
     L.LogicalExpand: ExpandMeta,
+    L.LogicalWindow: WindowMeta,
     LogicalParquetScan: ParquetScanMeta,
     LogicalCsvScan: TextScanMeta,
     LogicalJsonScan: TextScanMeta,
