@@ -144,6 +144,14 @@ HASH_SUBPARTITION_FALLBACK = conf(
     "Re-hash-partition oversized join build sides into sub-joins "
     "(reference GpuSubPartitionHashJoin).")
 
+AGG_FALLBACK_PARTITIONS = conf(
+    "spark.rapids.tpu.sql.agg.fallbackPartitions", 8,
+    "Bucket count for the high-cardinality aggregation fallback: when "
+    "merged partial results exceed one target batch, partials are "
+    "re-hash-partitioned into this many independently-merged buckets "
+    "(reference GpuAggregateExec repartition-based fallback).",
+    checker=_positive, internal=True)
+
 RETRY_ENABLED = conf(
     "spark.rapids.tpu.sql.retry.enabled", True,
     "Retry device work with halved batches on HBM RESOURCE_EXHAUSTED "
@@ -183,6 +191,13 @@ HOST_SPILL_LIMIT_BYTES = conf(
     "spark.rapids.tpu.memory.host.spillStorageSize", 8 << 30,
     "Host spill store byte limit before batches overflow to disk "
     "(reference RapidsHostMemoryStore limit).", checker=_positive)
+
+HBM_BUDGET_BYTES = conf(
+    "spark.rapids.tpu.memory.tpu.budgetBytes", 0,
+    "Absolute HBM byte budget for operator-held batches; 0 derives it from "
+    "allocFraction x discovered device memory (unlimited when memory stats "
+    "are unavailable).  Exceeding the budget spills LRU batches to host.",
+    checker=lambda v: None if v >= 0 else "must be >= 0")
 
 HBM_BUDGET_FRACTION = conf(
     "spark.rapids.tpu.memory.tpu.allocFraction", 0.85,

@@ -1,0 +1,160 @@
+"""Out-of-core sort: spillable sorted runs + capstone k-way merge.
+
+Reference: GpuOutOfCoreSortIterator (GpuSortExec.scala:281) — sort each
+batch, split into chunks, keep a spillable pending set, N-way merge.
+
+TPU-first shape: TPU sort is ONE fused lexsort, so "merging" loaded chunks
+is a concat + resort (cheaper than data-dependent k-way merge control
+flow).  What makes it out-of-core is the *emission rule*: after resorting
+the loaded window, only rows ≤ the smallest **capstone** (the last — i.e.
+largest — row of each run's currently-loaded chunk) can be emitted,
+because every unloaded row of run i is ≥ run i's capstone.  The capstone
+position is found by tracking its concat index through the sort
+permutation — no device key comparisons, one scalar D2H per capstone.
+
+Memory: held state (runs, pending set) lives in budget-registered
+Spillables (runtime/memory.py) that demote to host/disk under pressure;
+the merge window is R+1 transient batches.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterator, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..columnar.device import DeviceBatch
+from ..config import TpuConf
+from ..ops.batch_ops import concat_batches
+from ..ops.sort import (SortKey, permute_batch, sort_batch,
+                        sort_permutation)
+from ..runtime.memory import MemoryBudget, Spillable
+from ..runtime.retry import slice_batch, with_split_retry
+from .plan import ExecContext
+
+
+def _row_bytes(db: DeviceBatch) -> int:
+    """Approximate bytes per logical row at full occupancy."""
+    return max(1, db.nbytes() // max(db.capacity, 1))
+
+
+class OutOfCoreSorter:
+    """Accumulates input batches into sorted runs, then streams the merged
+    order in bounded chunks."""
+
+    def __init__(self, keys: Sequence[SortKey], ctx: ExecContext):
+        self.keys = list(keys)
+        self.ctx = ctx
+        self.conf: TpuConf = ctx.conf
+        self.budget: MemoryBudget = ctx.budget
+        self._pending: List[DeviceBatch] = []
+        self._pending_rows = 0
+        self._runs: List[deque] = []      # deques of Spillable chunks
+        self._window_rows: Optional[int] = None
+
+    # -- phase 1: build sorted runs ---------------------------------------
+    def _resolve_window(self, db: DeviceBatch) -> int:
+        if self._window_rows is None:
+            if self.budget.limit:
+                self._window_rows = max(
+                    self.conf.batch_size_rows // 8,
+                    (self.budget.limit // 2) // _row_bytes(db))
+            else:
+                self._window_rows = 1 << 62      # unlimited: single run
+        return self._window_rows
+
+    def add(self, db: DeviceBatch):
+        n = int(db.num_rows)
+        if n == 0:
+            return
+        window = self._resolve_window(db)
+        self._pending.append(db)
+        self._pending_rows += n
+        if self._pending_rows >= window:
+            self._close_run()
+
+    def _close_run(self):
+        if not self._pending:
+            return
+        batches, self._pending = self._pending, []
+        self._pending_rows = 0
+        merged = concat_batches(batches, self.conf) if len(batches) > 1 \
+            else batches[0]
+        chunk_rows = self.conf.batch_size_rows
+        # Each with_split_retry output is sorted INDEPENDENTLY (OOM halves
+        # are not ordered relative to each other), so each one must open
+        # its own run — the capstone merge relies on within-run order.
+        for s in with_split_retry(
+                self.budget, self.conf, merged,
+                lambda b: sort_batch(b, self.keys, self.conf)):
+            run = deque()
+            rows = int(s.num_rows)
+            for off in range(0, rows, chunk_rows):
+                hi = min(off + chunk_rows, rows)
+                chunk = slice_batch(s, off, hi, self.conf) \
+                    if (off, hi) != (0, rows) else s
+                run.append(Spillable(chunk, self.budget))
+            if run:
+                self._runs.append(run)
+                self.ctx.bump("sort_runs")
+
+    # -- phase 2: merge ----------------------------------------------------
+    def results(self) -> Iterator[DeviceBatch]:
+        self._close_run()
+        if not self._runs:
+            return
+        if len(self._runs) == 1:
+            for sp in self._runs[0]:
+                yield sp.get()
+                sp.close()
+            self._runs = []
+            return
+        yield from self._merge()
+
+    def _merge(self) -> Iterator[DeviceBatch]:
+        runs = self._runs
+        pending: Optional[Spillable] = None
+        while True:
+            window: List[DeviceBatch] = []
+            if pending is not None:
+                window.append(pending.get())
+                pending.close()
+                pending = None
+            # load the next chunk of every non-empty run; remember each
+            # loaded chunk's last-row concat index (the capstone)
+            offset = sum(int(b.num_rows) for b in window)
+            capstones = []                     # (concat_idx, run_idx)
+            for ri, run in enumerate(runs):
+                if not run:
+                    continue
+                sp = run.popleft()
+                b = sp.get()
+                sp.close()
+                window.append(b)
+                rows = int(b.num_rows)
+                capstones.append((offset + rows - 1, ri))
+                offset += rows
+            if not window:
+                return
+            merged = concat_batches(window, self.conf) \
+                if len(window) > 1 else window[0]
+            total = int(merged.num_rows)
+            perm = sort_permutation(merged, self.keys)
+            inv = jnp.zeros((merged.capacity,), jnp.int32).at[perm].set(
+                jnp.arange(merged.capacity, dtype=jnp.int32))
+            # emit rows up to the smallest capstone of runs that still
+            # have unloaded chunks; runs now empty constrain nothing
+            active = [ci for ci, ri in capstones if runs[ri]]
+            if active:
+                cut = min(int(inv[ci]) for ci in active) + 1
+            else:
+                cut = total
+            s = permute_batch(merged, perm)
+            yield slice_batch(s, 0, cut, self.conf) if cut < total else \
+                DeviceBatch(s.columns, total, list(s.names))
+            self.ctx.bump("sort_merge_passes")
+            if cut < total:
+                pending = Spillable(
+                    slice_batch(s, cut, total, self.conf), self.budget)
+            elif not any(runs):
+                return

@@ -13,6 +13,7 @@ schema (plan/overrides.py does the tagging/conversion from a logical tree).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -30,11 +31,28 @@ from .aggregate import HashAggregate
 from .evaluator import evaluate_projection
 
 
+_BUDGET_INIT_LOCK = threading.Lock()
+
+
 @dataclasses.dataclass
 class ExecContext:
     """Per-query execution state threaded through the plan."""
     conf: TpuConf = DEFAULT_CONF
     metrics: dict = dataclasses.field(default_factory=dict)
+    _budget: object = None
+
+    @property
+    def budget(self):
+        """Lazy per-query HBM budget (runtime/memory.py) — the
+        RapidsBufferCatalog role for batches operators hold.  Guarded:
+        a racing first touch from shuffle/scan worker threads must not
+        create two disjoint budgets."""
+        if self._budget is None:
+            with _BUDGET_INIT_LOCK:
+                if self._budget is None:
+                    from ..runtime.memory import MemoryBudget
+                    self._budget = MemoryBudget(self.conf)
+        return self._budget
 
     def bump(self, name: str, n: int = 1):
         self.metrics[name] = self.metrics.get(name, 0) + n
@@ -201,6 +219,7 @@ class HashAggregateExec(PlanNode):
         return source, conds
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from ..config import AGG_FALLBACK_PARTITIONS
         agg = HashAggregate(self.key_exprs, self.key_names, self.aggs,
                             ctx.conf)
         # Fuse a chain of upstream filters into the map-side program: the
@@ -209,18 +228,42 @@ class HashAggregateExec(PlanNode):
         # (TPU row gathers cost far more than masked reduction lanes).
         source, conds = self._strip_filters(agg.can_fuse_filter())
         partials: List[DeviceBatch] = []
+        buckets = None          # repartition-fallback state
+        num_buckets = 0
         seen = False
         for db in source.execute(ctx):
             if isinstance(db.num_rows, int) and db.num_rows == 0:
                 continue
             seen = True
-            partials.append(agg.partial_fused(db, conds)
-                            if agg.can_fuse_filter() else agg.partial(db))
+            p = agg.partial_fused(db, conds) if agg.can_fuse_filter() \
+                else agg.partial(db)
+            if buckets is not None:
+                self._scatter(p, buckets, num_buckets, ctx)
+                continue
+            partials.append(p)
             # Bound the pending set: merge when the partials would overflow
             # one target batch (the reference's tryMergeAggregatedBatches).
             if len(partials) > 1 and \
                     sum(int(p.num_rows) for p in partials) > ctx.conf.batch_size_rows:
-                partials = [agg.merge(partials)]
+                merged = agg.merge(partials)
+                if self.key_exprs and \
+                        int(merged.num_rows) > ctx.conf.batch_size_rows:
+                    # High-cardinality fallback (GpuAggregateExec.scala:711
+                    # repartition-based path): merging no longer reduces, so
+                    # hash-split the merged partials into independently
+                    # mergeable buckets held as spillables.
+                    num_buckets = ctx.conf.get(AGG_FALLBACK_PARTITIONS)
+                    buckets = [[] for _ in range(num_buckets)]
+                    self._scatter(merged, buckets, num_buckets, ctx)
+                    partials = []
+                    ctx.bump("agg_repartition_fallbacks")
+                else:
+                    partials = [merged]
+        if buckets is not None:
+            for blist in buckets:
+                if blist:
+                    yield from self._finalize_bucket(agg, blist, ctx, 1)
+            return
         if not seen:
             if self.key_exprs:
                 return  # grouped agg over empty input -> no rows
@@ -229,6 +272,63 @@ class HashAggregateExec(PlanNode):
             partials = [agg.partial(empty)]
         merged = agg.merge(partials) if len(partials) > 1 else partials[0]
         yield agg.final(merged)
+
+    def _scatter(self, pb: DeviceBatch, buckets, num_buckets: int,
+                 ctx: ExecContext, salt: int = 0):
+        """Split a partial batch into hash buckets of its group keys
+        (value-stable across batches: string keys hash dictionary VALUES,
+        not per-batch codes)."""
+        from ..runtime.memory import Spillable
+        ids = _agg_partition_ids(pb, len(self.key_names), num_buckets, salt)
+        live = pb.row_mask()
+        for k in range(num_buckets):
+            part = compact_batch(pb, (ids == k) & live, ctx.conf)
+            part = shrink_to_rows(part, int(part.num_rows), ctx.conf)
+            if int(part.num_rows):
+                buckets[k].append(Spillable(part, ctx.budget))
+
+    _MAX_SCATTER_DEPTH = 3
+
+    def _finalize_bucket(self, agg, blist, ctx: ExecContext, depth: int):
+        """Merge + finalize one fallback bucket.  Oversized buckets
+        re-scatter with a different hash salt (the reference re-partitions
+        recursively); merges are rolling and retry-wrapped so the working
+        set stays at two batches."""
+        from ..config import AGG_FALLBACK_PARTITIONS
+        from ..runtime.retry import with_retry
+        conf = ctx.conf
+        total = sum(sp.num_rows for sp in blist)
+        if depth < self._MAX_SCATTER_DEPTH and len(blist) > 1 and \
+                total > 2 * conf.batch_size_rows:
+            k = conf.get(AGG_FALLBACK_PARTITIONS)
+            sub = [[] for _ in range(k)]
+            for sp in blist:
+                b = sp.get()
+                sp.close()
+                self._scatter(b, sub, k, ctx, salt=depth)
+            ctx.bump("agg_repartition_fallbacks")
+            for sl in sub:
+                if sl:
+                    yield from self._finalize_bucket(agg, sl, ctx,
+                                                     depth + 1)
+            return
+        from ..runtime.memory import Spillable
+        acc = blist[0]
+        for sp in blist[1:]:
+            # both inputs stay REGISTERED during the merge attempt so the
+            # retry's spill_all can actually demote them (the reference's
+            # "inputs must be spillable" contract); get() inside the
+            # attempt re-materializes after a spill
+            a, b = acc, sp
+            merged = with_retry(ctx.budget, conf,
+                                lambda: agg.merge([a.get(), b.get()]))
+            nxt = Spillable(merged, ctx.budget)
+            a.close()
+            b.close()
+            acc = nxt
+        out = acc.get()
+        acc.close()
+        yield agg.final(out)
 
     def collect_device(self, ctx: Optional[ExecContext] = None):
         """Dispatch a global (no-key) aggregation fully async: returns
@@ -268,6 +368,115 @@ class HashAggregateExec(PlanNode):
     def describe(self):
         return (f"HashAggregateExec[keys={self.key_names}, "
                 f"aggs={[n for _, n in self.aggs]}]")
+
+
+_AGG_PART_CACHE = {}
+
+
+def _agg_partition_ids(pb: DeviceBatch, nkeys: int, num_buckets: int,
+                       salt: int = 0):
+    """Deterministic bucket id per row from the leading `nkeys` columns.
+
+    Unlike shuffle HashPartitioning this need not be Spark-exact — it only
+    must map equal keys to equal buckets across batches: string columns
+    hash their dictionary VALUES through a host crc32 table (per-batch
+    codes are not stable), other lanes fold to uint32.  `salt` decorrelates
+    recursive re-scatters (same hash would map a bucket onto itself).
+    crc32 tables pad to power-of-two sizes so per-batch dictionary growth
+    does not churn the jit cache."""
+    import jax
+
+    tables = {}
+    for i, c in enumerate(pb.columns[:nkeys]):
+        if c.dictionary is not None:
+            tables[i] = _dict_crc_table(c.dictionary)
+    dtypes = tuple(c.dtype for c in pb.columns[:nkeys])
+    sig = ("aggpart", pb.capacity, num_buckets, nkeys, salt,
+           tuple(d.simple_string for d in dtypes),
+           tuple((str(c.data.dtype), c.data_hi is not None,
+                  i in tables and int(tables[i].shape[0]))
+                 for i, c in enumerate(pb.columns[:nkeys])))
+    fn = _AGG_PART_CACHE.get(sig)
+    if fn is None:
+        capacity = pb.capacity
+
+        salt_c = jnp.uint32((salt * 0x9E3779B9) & 0xFFFFFFFF)
+
+        def run(datas, valids, his, tabs):
+            h = jnp.full((capacity,), 17, jnp.uint32)
+            for i in range(nkeys):
+                d = datas[i]
+                if i in tabs:
+                    tab = tabs[i]
+                    lane = tab[jnp.clip(d, 0, tab.shape[0] - 1)]
+                elif isinstance(dtypes[i], (t.DoubleType, t.FloatType)):
+                    # DOUBLE has two storage lanes (int64 bit patterns /
+                    # native f64); hash a lane-independent value derivation
+                    # so spilled-and-reuploaded batches bucket identically
+                    from ..ops.kernels import compute_view
+                    f = compute_view(d, dtypes[i]).astype(jnp.float64)
+                    isnan = jnp.isnan(f)
+                    isinf = jnp.isinf(f)
+                    safe = jnp.where(isnan | isinf, 0.0, f)
+                    ip = jnp.floor(safe)
+                    fr = ((safe - ip) * jnp.float64(1 << 30)) \
+                        .astype(jnp.uint32)
+                    ii = jnp.clip(ip, -2.0**62, 2.0**62).astype(jnp.int64)
+                    lane = ((ii ^ (ii >> 32)).astype(jnp.uint32)
+                            * jnp.uint32(31)) ^ fr
+                    lane = jnp.where(isnan, jnp.uint32(0xA5A5A5A5), lane)
+                    lane = jnp.where(isinf & (f > 0),
+                                     jnp.uint32(0x77777777), lane)
+                    lane = jnp.where(isinf & (f < 0),
+                                     jnp.uint32(0x33333333), lane)
+                else:
+                    # equal values -> equal lanes is all bucketing needs
+                    x = d.astype(jnp.int64)
+                    lane = (x ^ (x >> 32)).astype(jnp.uint32)
+                lane = jnp.where(valids[i], lane, jnp.uint32(0x9E3779B9))
+                # XOR-salt each lane: an additive salt would only rotate
+                # bucket labels, leaving re-scatter groupings unchanged
+                h = h * jnp.uint32(2654435761) + (lane ^ salt_c)
+                if his[i] is not None:
+                    hx = his[i]
+                    h = h * jnp.uint32(31) + \
+                        ((hx ^ (hx >> 32)).astype(jnp.uint32))
+            # avalanche so the low bits (the modulo) see every input bit
+            h = h ^ (h >> 16)
+            h = h * jnp.uint32(0x7FEB352D)
+            h = h ^ (h >> 15)
+            return (h % jnp.uint32(num_buckets)).astype(jnp.int32)
+
+        fn = jax.jit(run)
+        _AGG_PART_CACHE[sig] = fn
+    return fn(tuple(c.data for c in pb.columns[:nkeys]),
+              tuple(c.validity for c in pb.columns[:nkeys]),
+              tuple(c.data_hi for c in pb.columns[:nkeys]), tables)
+
+
+_CRC_TABLE_CACHE = {}
+
+
+def _dict_crc_table(dictionary):
+    """crc32-of-value table for a string dictionary, padded to a power of
+    two (stable jit signatures) and cached by dictionary identity (the
+    same pa.Array flows through every batch sharing the dictionary)."""
+    import zlib
+    import numpy as np
+    key = id(dictionary)
+    hit = _CRC_TABLE_CACHE.get(key)
+    if hit is not None and hit[0] is dictionary:
+        return hit[1]
+    ent = [zlib.crc32(s.encode("utf-8")) if s is not None else 0
+           for s in dictionary.to_pylist()] or [0]
+    padded = 1 << (len(ent) - 1).bit_length()
+    ent += [0] * (padded - len(ent))
+    tab = jnp.asarray(np.asarray(ent, np.uint32))
+    if len(_CRC_TABLE_CACHE) > 512:
+        _CRC_TABLE_CACHE.clear()
+    # pin the dictionary so its id stays valid while cached
+    _CRC_TABLE_CACHE[key] = (dictionary, tab)
+    return tab
 
 
 class LocalLimitExec(PlanNode):
@@ -369,13 +578,12 @@ class CoalesceBatchesExec(PlanNode):
 class SortExec(PlanNode):
     """GpuSortExec (GpuSortExec.scala:86): sorts by SortOrder keys.
 
-    global_sort concatenates the input stream (the single-partition case or
-    post-range-exchange per-partition totals); local sort orders each batch
-    independently (enough for sort-merge structures and windows).  The
-    out-of-core merge path of the reference (GpuOutOfCoreSortIterator:281)
-    maps to sorting coalesced sub-runs and merging via concat+resort —
-    TPU sort is one fused lexsort, so resorting merged runs is cheaper than
-    an N-way merge with its data-dependent control flow."""
+    global_sort runs through the out-of-core sorter (exec/ooc_sort.py):
+    under an HBM budget the input accumulates as spillable sorted runs
+    merged by capstone-bounded concat+resort passes (the
+    GpuOutOfCoreSortIterator role); with no budget it degenerates to one
+    concat+lexsort.  Local sort orders each batch independently (enough
+    for sort-merge structures and windows)."""
 
     def __init__(self, keys, child: PlanNode, global_sort: bool = True):
         from ..ops.sort import SortKey
@@ -394,12 +602,11 @@ class SortExec(PlanNode):
             for db in self.child.execute(ctx):
                 yield sort_batch(db, self.keys, ctx.conf)
             return
-        batches = [db for db in self.child.execute(ctx)
-                   if int(db.num_rows) > 0]
-        if not batches:
-            return
-        merged = concat_batches(batches, ctx.conf)
-        yield sort_batch(merged, self.keys, ctx.conf)
+        from .ooc_sort import OutOfCoreSorter
+        sorter = OutOfCoreSorter(self.keys, ctx)
+        for db in self.child.execute(ctx):
+            sorter.add(db)
+        yield from sorter.results()
 
     def describe(self):
         scope = "global" if self.global_sort else "local"

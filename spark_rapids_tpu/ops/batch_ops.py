@@ -95,6 +95,14 @@ def concat_batches(batches: List[DeviceBatch],
             cols = [remap_string_column(c, r, unified)
                     for c, r in zip(cols, remaps)]
         data_parts = [c.data[:b.num_rows] for c, b in zip(cols, batches)]
+        if isinstance(dt, t.DoubleType) and \
+                len({str(p.dtype) for p in data_parts}) > 1:
+            # DOUBLE has two storage lanes (int64 bit patterns from host
+            # uploads, native f64 from device compute; see columnar/device):
+            # concatenating them raw would convert bit patterns NUMERICALLY.
+            # Unify on f64 via the bitcast view.
+            from .kernels import compute_view
+            data_parts = [compute_view(p, dt) for p in data_parts]
         valid_parts = [c.validity[:b.num_rows] for c, b in zip(cols, batches)]
         pad = cap - total
         if pad:

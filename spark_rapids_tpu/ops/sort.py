@@ -151,10 +151,8 @@ def sort_permutation(db: DeviceBatch, keys: Sequence[SortKey]) -> jax.Array:
               db.row_mask(), rank_tables)
 
 
-def sort_batch(db: DeviceBatch, keys: Sequence[SortKey],
-               conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
-    """Fully sort one device batch by the given keys."""
-    perm = sort_permutation(db, keys)
+def permute_batch(db: DeviceBatch, perm: jax.Array) -> DeviceBatch:
+    """Gather every lane of every column through a row permutation."""
     cols = []
     for c in db.columns:
         d = jnp.take(c.data, perm, axis=0)
@@ -162,3 +160,9 @@ def sort_batch(db: DeviceBatch, keys: Sequence[SortKey],
         h = None if c.data_hi is None else jnp.take(c.data_hi, perm, axis=0)
         cols.append(DeviceColumn(d, v, c.dtype, c.dictionary, h))
     return DeviceBatch(cols, db.num_rows, list(db.names))
+
+
+def sort_batch(db: DeviceBatch, keys: Sequence[SortKey],
+               conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
+    """Fully sort one device batch by the given keys."""
+    return permute_batch(db, sort_permutation(db, keys))

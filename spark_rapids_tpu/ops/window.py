@@ -91,30 +91,32 @@ def _minmax_ident(dtype, is_min: bool):
 
 
 def _minmax_lanes(cd, vl, dt, raw_data, is_min):
-    """(order lane with invalid rows at identity, identity scalar, decoder).
+    """(order lane with invalid rows at identity, identity scalar, decoder,
+    nan lane or None).
 
     DOUBLE int64-bits columns compare in Java total-order bit space (exact,
-    NaN greatest); computed float lanes order by value with NaN mapped to
-    +inf (NaN-greatest ordering; NaN payload collapse is a documented
-    deviation on computed lanes, cf. docs/compatibility.md float notes)."""
+    NaN greatest).  Computed float lanes order by value with NaN mapped to
+    +inf; callers restore NaN results from per-frame NaN counts (Spark:
+    max is NaN when any valid value is NaN, min only when ALL are)."""
     if isinstance(dt, t.DoubleType) and raw_data is not None \
             and raw_data.dtype == jnp.int64:
         ident = jnp.int64(_ORDER_MAX if is_min else _ORDER_MIN)
         o = jnp.where(vl, _bits_total_order(raw_data), ident)
-        return o, ident, _bits_from_order
+        return o, ident, _bits_from_order, None
     if t.is_floating(dt):
         f = cd.astype(jnp.float64)
+        nan_lane = (jnp.isnan(f) & vl).astype(jnp.int64)
         o = jnp.where(jnp.isnan(f), jnp.float64(np.inf), f)
         ident = jnp.float64(np.inf if is_min else -np.inf)
         o = jnp.where(vl, o, ident)
-        return o, ident, (lambda x: x)
+        return o, ident, (lambda x: x), nan_lane
     if isinstance(dt, t.BooleanType):
         ident = jnp.int8(1 if is_min else 0)
         o = jnp.where(vl, cd.astype(jnp.int8), ident)
-        return o, ident, (lambda x: x > 0)
+        return o, ident, (lambda x: x > 0), None
     ident = jnp.asarray(_minmax_ident(cd.dtype, is_min), cd.dtype)
     o = jnp.where(vl, cd, ident)
-    return o, ident, (lambda x: x)
+    return o, ident, (lambda x: x), None
 
 
 def _round_half_up_div(num: jax.Array, den: jax.Array) -> jax.Array:
@@ -122,6 +124,18 @@ def _round_half_up_div(num: jax.Array, den: jax.Array) -> jax.Array:
     mag = jnp.abs(num)
     q = (mag + den // 2) // den
     return jnp.where(num < 0, -q, q)
+
+
+def _nan_restore(red, frame_cnt, frame_nan, is_min):
+    """Spark float semantics over the NaN->+inf order lane: max is NaN when
+    any valid value in the frame is NaN; min only when ALL are."""
+    if frame_nan is None:
+        return red
+    non_nan = frame_cnt - frame_nan
+    nan = jnp.float64(np.nan)
+    if is_min:
+        return jnp.where((frame_cnt > 0) & (non_nan == 0), nan, red)
+    return jnp.where(frame_nan > 0, nan, red)
 
 
 def window_trace(part_info, order_info, val_info, specs_frames,
@@ -296,10 +310,13 @@ def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
         if kind in ("agg_sum", "agg_avg"):
             s = bcast(jax.ops.segment_sum(acc, ids, num_segments=capacity))
             return finish(s, c)
-        o, _ident, back = _minmax_lanes(cd, vl, dt, raw_data, is_min)
+        o, _ident, back, nan_lane = _minmax_lanes(cd, vl, dt, raw_data,
+                                                  is_min)
         red = bcast((jax.ops.segment_min if is_min else jax.ops.segment_max)(
             o, ids, num_segments=capacity))
-        return back(red), (c > 0) & live
+        fnan = None if nan_lane is None else bcast(
+            jax.ops.segment_sum(nan_lane, ids, num_segments=capacity))
+        return _nan_restore(back(red), c, fnan, is_min), (c > 0) & live
 
     # --- running frames (incl. RANGE ..CURRENT ROW via peer-end gather) ---
     running_rows = frame.kind == "rows" and frame.is_running
@@ -314,10 +331,13 @@ def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
         if kind in ("agg_sum", "agg_avg"):
             s = at_peers(_seg_scan(acc, part_b, jnp.add))
             return finish(s, c)
-        o, _ident, back = _minmax_lanes(cd, vl, dt, raw_data, is_min)
+        o, _ident, back, nan_lane = _minmax_lanes(cd, vl, dt, raw_data,
+                                                  is_min)
         red = at_peers(_seg_scan(
             o, part_b, jnp.minimum if is_min else jnp.maximum))
-        return back(red), (c > 0) & live
+        fnan = None if nan_lane is None else at_peers(
+            _seg_scan(nan_lane, part_b, jnp.add))
+        return _nan_restore(back(red), c, fnan, is_min), (c > 0) & live
 
     # --- RANGE CURRENT ROW .. UNBOUNDED FOLLOWING: reverse running ---
     if frame.kind == "range":
@@ -329,10 +349,13 @@ def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
         if kind in ("agg_sum", "agg_avg"):
             s = at_peer_start(_seg_scan_rev(acc, part_b, jnp.add))
             return finish(s, c)
-        o, _ident, back = _minmax_lanes(cd, vl, dt, raw_data, is_min)
+        o, _ident, back, nan_lane = _minmax_lanes(cd, vl, dt, raw_data,
+                                                  is_min)
         red = at_peer_start(_seg_scan_rev(
             o, part_b, jnp.minimum if is_min else jnp.maximum))
-        return back(red), (c > 0) & live
+        fnan = None if nan_lane is None else at_peer_start(
+            _seg_scan_rev(nan_lane, part_b, jnp.add))
+        return _nan_restore(back(red), c, fnan, is_min), (c > 0) & live
 
     # --- bounded ROWS frames ---
     lo, hi = frame_bounds(frame)
@@ -351,7 +374,7 @@ def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
         return finish(pref_window(acc), c)
 
     # bounded min/max
-    o, ident, back = _minmax_lanes(cd, vl, dt, raw_data, is_min)
+    o, ident, back, nan_lane = _minmax_lanes(cd, vl, dt, raw_data, is_min)
     op = jnp.minimum if is_min else jnp.maximum
     c_cnt = None
     if frame.lower is None:
@@ -373,9 +396,12 @@ def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
             best = op(best, cand)
             c_cnt = c_cnt + cand_v.astype(jnp.int64)
         red = best
-    if c_cnt is None:
-        p = jnp.cumsum(vl.astype(jnp.int64))
+    def pref_cnt(lane):
+        p = jnp.cumsum(lane)
         hi_v = _gather(p, hi, capacity)
         lo_v = jnp.where(lo > 0, _gather(p, lo - 1, capacity), jnp.int64(0))
-        c_cnt = jnp.where(nonempty, hi_v - lo_v, jnp.int64(0))
-    return back(red), (c_cnt > 0) & live
+        return jnp.where(nonempty, hi_v - lo_v, jnp.int64(0))
+    if c_cnt is None:
+        c_cnt = pref_cnt(vl.astype(jnp.int64))
+    fnan = None if nan_lane is None else pref_cnt(nan_lane)
+    return _nan_restore(back(red), c_cnt, fnan, is_min), (c_cnt > 0) & live

@@ -1,0 +1,271 @@
+"""HBM budget accounting + spill store — the RMM/spill-framework role.
+
+Reference: RapidsBufferCatalog.scala:62 (buffer catalog with device→host→
+disk tiers), DeviceMemoryEventHandler.scala:36 (synchronous spill on
+allocation failure), SpillableColumnarBatch.scala (operators hold handles,
+not pinned batches), GpuDeviceManager.scala:275 (pool sizing).
+
+TPU-first re-design (SURVEY §7 hard part b): XLA manages HBM itself and
+cannot call back on allocation failure, so the engine *pre-budgets*: every
+long-lived batch an operator holds across blocking points is registered
+here as a `Spillable`; admitting a new reservation spills least-recently-
+used device batches to host until the budget fits.  Reactive OOMs
+(XlaRuntimeError RESOURCE_EXHAUSTED leaking through the budget, e.g. from
+transient kernel scratch) are caught by runtime/retry.py, which spills
+everything and replays with split batches.
+
+The host tier holds Arrow batches; a byte limit overflows the oldest to a
+disk directory of Arrow IPC files (the RapidsDiskStore role).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import pyarrow as pa
+
+from ..columnar.device import DeviceBatch, to_device, to_host
+from ..columnar.host import HostBatch
+from ..config import (HBM_BUDGET_BYTES, HBM_BUDGET_FRACTION,
+                      HOST_SPILL_LIMIT_BYTES, TEST_INJECT_RETRY_OOM, TpuConf)
+
+
+class TpuRetryOOM(RuntimeError):
+    """Budget exhausted (or injected); the retry framework catches this and
+    replays the attempt — the GpuRetryOOM analogue."""
+
+
+class TpuSplitAndRetryOOM(TpuRetryOOM):
+    """Retry after splitting the input — the GpuSplitAndRetryOOM analogue."""
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """Budget OOMs plus XLA RESOURCE_EXHAUSTED leaking past the budget."""
+    if isinstance(exc, TpuRetryOOM):
+        return True
+    s = str(exc)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+
+
+def device_hbm_bytes() -> Optional[int]:
+    """Total bytes of the addressable device's memory, if discoverable."""
+    import jax
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return None
+
+
+class MemoryBudget:
+    """Per-query (ExecContext) budget over registered Spillables.
+
+    `limit == 0` means unlimited (accounting still runs, nothing spills).
+    Thread-safe: shuffle/scan worker threads register batches too."""
+
+    def __init__(self, conf: TpuConf):
+        limit = conf.get(HBM_BUDGET_BYTES)
+        if limit == 0:
+            hbm = device_hbm_bytes()
+            limit = int(hbm * conf.get(HBM_BUDGET_FRACTION)) if hbm else 0
+        self.limit = limit
+        self.host_limit = conf.get(HOST_SPILL_LIMIT_BYTES)
+        self.conf = conf
+        self.live = 0                 # bytes of registered device batches
+        self.host_live = 0
+        self._lock = threading.RLock()
+        self._spillables: "OrderedDict[int, Spillable]" = OrderedDict()
+        self._next_id = 0
+        self._disk_dir: Optional[str] = None
+        # OOM injection: fire TpuRetryOOM on the Nth reservation (1-based),
+        # once — the reference's spark.rapids.sql.test.injectRetryOOM
+        self._inject_at = conf.get(TEST_INJECT_RETRY_OOM)
+        self._reservations = 0
+        self.metrics = {"spilled_batches": 0, "spilled_bytes": 0,
+                        "disk_batches": 0, "oom_retries": 0}
+
+    # -- registration ------------------------------------------------------
+    def register(self, sp: "Spillable") -> int:
+        with self._lock:
+            self._next_id += 1
+            self._spillables[self._next_id] = sp
+            return self._next_id
+
+    def unregister(self, sid: int):
+        with self._lock:
+            self._spillables.pop(sid, None)
+
+    def touch(self, sid: int):
+        """LRU bump: most-recently-used spills last."""
+        with self._lock:
+            if sid in self._spillables:
+                self._spillables.move_to_end(sid)
+
+    # -- accounting --------------------------------------------------------
+    def reserve(self, nbytes: int):
+        """Admit `nbytes` of new device data, spilling LRU batches first.
+        Raises TpuRetryOOM when the budget cannot fit even after spilling
+        everything (the DeviceMemoryEventHandler contract)."""
+        with self._lock:
+            self._reservations += 1
+            if self._inject_at and self._reservations == self._inject_at:
+                self.metrics["oom_retries"] += 1
+                raise TpuRetryOOM("injected OOM "
+                                  f"(reservation #{self._reservations})")
+            if not self.limit:
+                self.live += nbytes
+                return
+            while self.live + nbytes > self.limit:
+                if not self._spill_one():
+                    raise TpuRetryOOM(
+                        f"HBM budget exhausted: live={self.live} "
+                        f"+ {nbytes} > limit={self.limit} with nothing "
+                        "left to spill")
+            self.live += nbytes
+
+    def release(self, nbytes: int):
+        with self._lock:
+            self.live -= nbytes
+
+    def _spill_one(self) -> bool:
+        for sp in self._spillables.values():
+            if sp.on_device:
+                sp.spill()
+                return True
+        return False
+
+    def spill_all(self):
+        """Reactive path (retry framework): push every held batch off
+        device before replaying the failed attempt."""
+        with self._lock:
+            for sp in list(self._spillables.values()):
+                if sp.on_device:
+                    sp.spill()
+
+    # -- host tier ---------------------------------------------------------
+    def host_reserve(self, nbytes: int):
+        with self._lock:
+            while self.host_limit and \
+                    self.host_live + nbytes > self.host_limit:
+                if not self._disk_one():
+                    break        # disk tier is unbounded; never refuse
+            self.host_live += nbytes
+
+    def host_release(self, nbytes: int):
+        with self._lock:
+            self.host_live -= nbytes
+
+    def _disk_one(self) -> bool:
+        for sp in self._spillables.values():
+            if sp.on_host:
+                sp.to_disk()
+                return True
+        return False
+
+    def disk_dir(self) -> str:
+        if self._disk_dir is None:
+            self._disk_dir = tempfile.mkdtemp(prefix="srtpu_spill_")
+        return self._disk_dir
+
+
+class Spillable:
+    """A batch an operator holds across blocking points, owned by the
+    budget: device ⇄ host Arrow ⇄ disk Arrow-IPC (SpillableColumnarBatch +
+    the three RapidsBufferStore tiers)."""
+
+    def __init__(self, db: DeviceBatch, budget: MemoryBudget):
+        self._db: Optional[DeviceBatch] = db
+        self._hb: Optional[HostBatch] = None
+        self._path: Optional[str] = None
+        self._budget = budget
+        self._nbytes = db.nbytes()
+        self.num_rows = int(db.num_rows)
+        budget.reserve(self._nbytes)
+        self._sid = budget.register(self)
+
+    @property
+    def on_device(self) -> bool:
+        return self._db is not None
+
+    @property
+    def on_host(self) -> bool:
+        return self._hb is not None
+
+    def spill(self):
+        """device -> host tier (holds the budget lock: spill can be driven
+        by another thread's reserve())."""
+        with self._budget._lock:
+            if self._db is None:
+                return
+            hb = to_host(self._db)
+            self._db = None
+            self._budget.release(self._nbytes)
+            self._budget.metrics["spilled_batches"] += 1
+            self._budget.metrics["spilled_bytes"] += self._nbytes
+            self._hb = hb
+            self._budget.host_reserve(hb.rb.nbytes)
+
+    def to_disk(self):
+        """host -> disk tier (Arrow IPC file)."""
+        if self._hb is None:
+            return
+        path = os.path.join(self._budget.disk_dir(),
+                            f"spill_{self._sid}.arrow")
+        with pa.OSFile(path, "wb") as f:
+            with pa.ipc.new_file(f, self._hb.rb.schema) as w:
+                w.write_batch(self._hb.rb)
+        self._budget.host_release(self._hb.rb.nbytes)
+        self._budget.metrics["disk_batches"] += 1
+        self._hb = None
+        self._path = path
+
+    def get(self) -> DeviceBatch:
+        """Materialize on device (re-uploading through the budget).  The
+        returned batch reference stays valid even if the spillable is
+        spilled again by a concurrent reserve()."""
+        with self._budget._lock:
+            if self._db is None:
+                hb = self._host_batch()
+                self._budget.reserve(self._nbytes)
+                self._db = to_device(hb, self._budget.conf)
+                if self._hb is not None:
+                    self._budget.host_release(self._hb.rb.nbytes)
+                self._hb = None
+            self._budget.touch(self._sid)
+            return self._db
+
+    def get_host(self) -> HostBatch:
+        """Materialize as a host batch without a device reservation."""
+        with self._budget._lock:
+            if self._db is not None:
+                return to_host(self._db)
+            return self._host_batch()
+
+    def _host_batch(self) -> HostBatch:
+        if self._hb is not None:
+            return self._hb
+        assert self._path is not None, "spillable lost all tiers"
+        with pa.OSFile(self._path, "rb") as f:
+            rb = pa.ipc.open_file(f).get_batch(0)
+        return HostBatch(rb)
+
+    def close(self):
+        with self._budget._lock:
+            self._budget.unregister(self._sid)
+            if self._db is not None:
+                self._budget.release(self._nbytes)
+                self._db = None
+            if self._hb is not None:
+                self._budget.host_release(self._hb.rb.nbytes)
+                self._hb = None
+            if self._path is not None:
+                try:
+                    os.unlink(self._path)
+                except OSError:
+                    pass
+                self._path = None

@@ -1,0 +1,105 @@
+"""OOM retry framework — the RmmRapidsRetryIterator role.
+
+Reference: RmmRapidsRetryIterator.scala:41-107 — operator inner loops run
+inside withRetry/withRetryNoSplit/withSplitAndRetry; on GpuRetryOOM the
+work replays after spilling, on GpuSplitAndRetryOOM the input batch is
+split in half first.  Inputs must be spillable and the attempt idempotent.
+
+TPU shape: the budget (runtime/memory.py) raises TpuRetryOOM proactively;
+XLA RESOURCE_EXHAUSTED errors from kernel scratch are caught reactively.
+Either way the recovery ladder is identical to the reference's:
+  1. spill everything registered with the budget, replay;
+  2. halve the input batch and process the halves independently
+     (up to conf retry.maxSplits times);
+  3. rethrow.
+Attempts must be idempotent: they are traced jit programs plus pure
+gathers, so replaying is safe by construction.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, TypeVar
+
+import jax.numpy as jnp
+
+from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
+from ..config import RETRY_ENABLED, RETRY_MAX_SPLITS, TpuConf
+from .memory import MemoryBudget, TpuRetryOOM, is_oom_error
+
+T = TypeVar("T")
+
+
+def split_batch(db: DeviceBatch, conf: TpuConf) -> List[DeviceBatch]:
+    """Halve a batch by row (the splitSpillableInHalfByRows policy)."""
+    n = int(db.num_rows)
+    if n <= 1:
+        raise TpuRetryOOM(f"cannot split a {n}-row batch further")
+    cut = n // 2
+    return [slice_batch(db, 0, cut, conf), slice_batch(db, cut, n, conf)]
+
+
+def slice_batch(db: DeviceBatch, start: int, stop: int,
+                conf: TpuConf) -> DeviceBatch:
+    """Rows [start, stop) as a new right-sized batch (device slice)."""
+    rows = stop - start
+    cap = bucket_capacity(max(rows, 1), conf)
+    idx = jnp.arange(cap, dtype=jnp.int32) + start
+    live = jnp.arange(cap, dtype=jnp.int32) < rows
+    cols = []
+    for c in db.columns:
+        sl = jnp.clip(idx, 0, db.capacity - 1)
+        d = c.data[sl]
+        v = c.validity[sl] & live
+        h = None if c.data_hi is None else c.data_hi[sl]
+        cols.append(DeviceColumn(d, v, c.dtype, c.dictionary, h))
+    return DeviceBatch(cols, rows, list(db.names))
+
+
+def with_retry(budget: MemoryBudget, conf: TpuConf,
+               attempt: Callable[[], T]) -> T:
+    """Replay `attempt` once after a spill-everything on OOM
+    (withRetryNoSplit)."""
+    if not conf.get(RETRY_ENABLED):
+        return attempt()
+    try:
+        return attempt()
+    except Exception as e:                       # noqa: BLE001
+        if not is_oom_error(e):
+            raise
+        budget.metrics["oom_retries"] += 1
+        budget.spill_all()
+        return attempt()
+
+
+def with_split_retry(budget: MemoryBudget, conf: TpuConf,
+                     batch: DeviceBatch,
+                     attempt: Callable[[DeviceBatch], T]
+                     ) -> Iterator[T]:
+    """Run `attempt(batch)`; on OOM spill + replay, then recursively halve
+    the batch (withSplitAndRetry).  Yields one result per final sub-batch
+    in row order."""
+    if not conf.get(RETRY_ENABLED):
+        yield attempt(batch)
+        return
+    max_splits = conf.get(RETRY_MAX_SPLITS)
+    pending: List[tuple] = [(batch, 0)]          # (batch, splits so far)
+    while pending:
+        b, depth = pending.pop(0)
+        try:
+            yield attempt(b)
+            continue
+        except Exception as e:                   # noqa: BLE001
+            if not is_oom_error(e):
+                raise
+        budget.metrics["oom_retries"] += 1
+        budget.spill_all()
+        try:
+            yield attempt(b)
+            continue
+        except Exception as e:                   # noqa: BLE001
+            if not is_oom_error(e):
+                raise
+            if depth >= max_splits:
+                raise TpuRetryOOM(
+                    f"OOM persists after {depth} splits") from e
+        halves = split_batch(b, conf)
+        pending[:0] = [(h, depth + 1) for h in halves]

@@ -1,0 +1,379 @@
+"""Memory discipline tests: budget, spill, retry, OOC sort, agg fallback.
+
+Mirrors the reference's retry-harness suites (RmmSparkRetrySuiteBase +
+*RetrySuite, SURVEY §4.2c): synthetic OOM injection via conf
+(spark.rapids.tpu.sql.test.injectRetryOOM) plus capped-budget runs with
+inputs ~10x the budget.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.device import to_device, to_host
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec.plan import (ExecContext, HashAggregateExec,
+                                        HostScanExec, SortExec)
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.aggregates import Count, Sum
+from spark_rapids_tpu.runtime.memory import (MemoryBudget, Spillable,
+                                             TpuRetryOOM)
+from spark_rapids_tpu.runtime.retry import (slice_batch, split_batch,
+                                            with_retry, with_split_retry)
+
+
+def small_conf(budget=1 << 20, **kw):
+    settings = {
+        "spark.rapids.tpu.memory.tpu.budgetBytes": budget,
+        "spark.rapids.tpu.sql.batchSizeRows": 1024,
+        "spark.rapids.tpu.sql.shape.minBucketRows": 256,
+    }
+    settings.update(kw)
+    return TpuConf(settings)
+
+
+def make_batch(n, conf, seed=0):
+    rng = np.random.default_rng(seed)
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, max(n, 1), n), pa.int64()),
+        "v": pa.array(rng.standard_normal(n)),
+    })
+    return to_device(HostBatch(tbl.to_batches()[0]), conf)
+
+
+# ---------------------------------------------------------------------------
+# budget + spillable
+# ---------------------------------------------------------------------------
+
+def test_spillable_roundtrip():
+    conf = small_conf()
+    budget = MemoryBudget(conf)
+    db = make_batch(500, conf)
+    before = to_host(db).rb
+    sp = Spillable(db, budget)
+    assert budget.live > 0
+    sp.spill()
+    assert sp.on_host and not sp.on_device
+    assert budget.live == 0
+    after = to_host(sp.get()).rb
+    assert before.equals(after)
+    sp.close()
+    assert budget.live == 0
+
+
+def test_budget_spills_lru():
+    conf = small_conf(budget=1 << 16)     # 64 KiB
+    budget = MemoryBudget(conf)
+    sps = [Spillable(make_batch(1000, conf, seed=i), budget)
+           for i in range(8)]             # ~17 KB each
+    # early batches must have been pushed to host
+    assert budget.metrics["spilled_batches"] > 0
+    assert budget.live <= budget.limit
+    # everything still readable
+    for i, sp in enumerate(sps):
+        assert int(sp.get().num_rows) == 1000
+        sp.spill()                         # make room for the next get
+    for sp in sps:
+        sp.close()
+
+
+def test_budget_oom_when_nothing_to_spill():
+    conf = small_conf(budget=1 << 10)
+    budget = MemoryBudget(conf)
+    with pytest.raises(TpuRetryOOM):
+        budget.reserve(1 << 20)
+
+
+def test_disk_tier():
+    conf = small_conf(budget=1 << 15,
+                      **{"spark.rapids.tpu.memory.host.spillStorageSize":
+                         1 << 14})
+    budget = MemoryBudget(conf)
+    sps = [Spillable(make_batch(1000, conf, seed=i), budget)
+           for i in range(6)]
+    assert budget.metrics["disk_batches"] > 0
+    for sp in sps:
+        assert int(sp.get().num_rows) == 1000
+        sp.spill()
+    for sp in sps:
+        sp.close()
+
+
+# ---------------------------------------------------------------------------
+# retry framework
+# ---------------------------------------------------------------------------
+
+def test_split_batch_halves():
+    conf = small_conf()
+    db = make_batch(1001, conf)
+    a, b = split_batch(db, conf)
+    assert int(a.num_rows) + int(b.num_rows) == 1001
+    ta, tb = to_host(a).rb, to_host(b).rb
+    whole = to_host(db).rb
+    assert ta.column("k").to_pylist() + tb.column("k").to_pylist() == \
+        whole.column("k").to_pylist()
+
+
+def test_slice_batch():
+    conf = small_conf()
+    db = make_batch(100, conf)
+    s = slice_batch(db, 10, 35, conf)
+    assert int(s.num_rows) == 25
+    assert to_host(s).rb.column("k").to_pylist() == \
+        to_host(db).rb.column("k").to_pylist()[10:35]
+
+
+def test_with_retry_injected_oom():
+    conf = small_conf(**{"spark.rapids.tpu.sql.test.injectRetryOOM": 1})
+    budget = MemoryBudget(conf)
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        budget.reserve(64)          # 1st reservation raises (injected)
+        budget.release(64)
+        return "ok"
+
+    assert with_retry(budget, conf, attempt) == "ok"
+    assert len(calls) == 2
+    assert budget.metrics["oom_retries"] >= 1
+
+
+def test_with_split_retry_splits():
+    conf = small_conf()
+    budget = MemoryBudget(conf)
+    db = make_batch(1000, conf)
+    failed = set()
+
+    def attempt(b):
+        n = int(b.num_rows)
+        if n > 300:                  # fake OOM for big batches
+            failed.add(n)
+            raise TpuRetryOOM(f"too big: {n}")
+        return n
+
+    outs = list(with_split_retry(budget, conf, db, attempt))
+    assert sum(outs) == 1000
+    assert all(n <= 300 for n in outs)
+    assert failed                    # the split path actually ran
+
+
+def test_with_split_retry_gives_up():
+    conf = small_conf(**{"spark.rapids.tpu.sql.retry.maxSplits": 2})
+    budget = MemoryBudget(conf)
+    db = make_batch(64, conf)
+
+    def attempt(b):
+        raise TpuRetryOOM("always")
+
+    with pytest.raises(TpuRetryOOM):
+        list(with_split_retry(budget, conf, db, attempt))
+
+
+# ---------------------------------------------------------------------------
+# OOC sort under a capped budget
+# ---------------------------------------------------------------------------
+
+def _sorted_values(exec_node, ctx):
+    out = exec_node.collect(ctx)
+    return out.column("v").to_pylist(), out.num_rows
+
+
+def test_ooc_sort_10x_budget():
+    n = 40_000
+    rng = np.random.default_rng(5)
+    tbl = pa.table({"v": pa.array(rng.standard_normal(n))})
+    # per-row ~9B device; 40k rows ~360KB; budget 64KB => ~6x over; chunk
+    # rows small so the merge window stays well under the budget
+    conf = small_conf(budget=1 << 16)
+    ctx = ExecContext(conf)
+    scan = HostScanExec.from_table(tbl, max_rows=1024)
+    s = SortExec([(0, True, True)], scan)
+    vals, rows = _sorted_values(s, ctx)
+    assert rows == n
+    assert vals == sorted(tbl.column("v").to_pylist())
+    assert ctx.metrics.get("sort_runs", 0) > 1
+    assert ctx.metrics.get("sort_merge_passes", 0) >= 1
+    assert ctx.budget.metrics["spilled_batches"] > 0
+
+
+def test_ooc_sort_desc_with_ties_and_nulls():
+    n = 5_000
+    rng = np.random.default_rng(6)
+    v = rng.integers(0, 50, n).astype("float64")
+    mask = rng.random(n) < 0.1
+    tbl = pa.table({"v": pa.array(np.where(mask, 0, v), mask=mask)})
+    conf = small_conf(budget=1 << 14)
+    ctx = ExecContext(conf)
+    scan = HostScanExec.from_table(tbl, max_rows=512)
+    s = SortExec([(0, False, False)], scan)   # desc, nulls last
+    out = s.collect(ctx).column("v").to_pylist()
+    nn = [x for x in out if x is not None]
+    assert nn == sorted(nn, reverse=True)
+    assert out[len(nn):] == [None] * (n - len(nn))
+    assert len(out) == n
+
+
+def test_sort_unlimited_budget_single_pass():
+    tbl = pa.table({"v": pa.array(np.random.default_rng(1)
+                                  .standard_normal(2000))})
+    conf = small_conf(budget=0)
+    conf_settings_noauto = conf    # budget 0 + no hbm stats -> unlimited
+    ctx = ExecContext(conf_settings_noauto)
+    scan = HostScanExec.from_table(tbl, max_rows=512)
+    s = SortExec([(0, True, True)], scan)
+    vals, rows = _sorted_values(s, ctx)
+    assert vals == sorted(tbl.column("v").to_pylist())
+
+
+# ---------------------------------------------------------------------------
+# aggregation repartition fallback
+# ---------------------------------------------------------------------------
+
+def test_agg_high_cardinality_fallback():
+    n = 30_000          # ~30k distinct groups >> 1024-row target batches
+    rng = np.random.default_rng(9)
+    keys = rng.permutation(n).astype(np.int64)
+    tbl = pa.table({"k": pa.array(keys), "v": pa.array(np.ones(n))})
+    conf = small_conf(budget=1 << 18)
+    ctx = ExecContext(conf)
+    scan = HostScanExec.from_table(tbl, max_rows=1024)
+    agg = HashAggregateExec([E.ColumnRef("k")], ["k"],
+                            [(Sum(E.ColumnRef("v")), "s"),
+                             (Count(E.ColumnRef("v")), "c")], scan)
+    out = agg.collect(ctx)
+    assert ctx.metrics.get("agg_repartition_fallbacks", 0) >= 1
+    assert out.num_rows == n
+    assert set(out.column("k").to_pylist()) == set(range(n))
+    assert all(s == 1.0 for s in out.column("s").to_pylist())
+    assert all(c == 1 for c in out.column("c").to_pylist())
+
+
+def test_agg_fallback_with_string_keys():
+    # same string value in different batches (different dictionaries) must
+    # land in the same bucket
+    n = 6_000
+    rng = np.random.default_rng(11)
+    ks = [f"key_{i}" for i in rng.integers(0, 3000, n)]
+    tbl = pa.table({"k": pa.array(ks), "v": pa.array(np.ones(n))})
+    conf = small_conf(budget=1 << 18,
+                      **{"spark.rapids.tpu.sql.batchSizeRows": 512})
+    ctx = ExecContext(conf)
+    scan = HostScanExec.from_table(tbl, max_rows=512)
+    agg = HashAggregateExec([E.ColumnRef("k")], ["k"],
+                            [(Count(None), "c")], scan)
+    out = agg.collect(ctx)
+    assert ctx.metrics.get("agg_repartition_fallbacks", 0) >= 1
+    # every key appears exactly once with the right total
+    import collections
+    exp = collections.Counter(ks)
+    got = dict(zip(out.column("k").to_pylist(), out.column("c").to_pylist()))
+    assert len(got) == len(exp)
+    assert got == dict(exp)
+
+
+def test_agg_low_cardinality_no_fallback():
+    n = 20_000
+    rng = np.random.default_rng(12)
+    tbl = pa.table({"k": pa.array(rng.integers(0, 10, n), pa.int64()),
+                    "v": pa.array(np.ones(n))})
+    conf = small_conf()
+    ctx = ExecContext(conf)
+    scan = HostScanExec.from_table(tbl, max_rows=1024)
+    agg = HashAggregateExec([E.ColumnRef("k")], ["k"],
+                            [(Count(None), "c")], scan)
+    out = agg.collect(ctx)
+    assert ctx.metrics.get("agg_repartition_fallbacks", 0) == 0
+    assert out.num_rows == 10
+    assert sum(out.column("c").to_pylist()) == n
+
+
+def test_ooc_sort_oom_split_keeps_order(monkeypatch):
+    """An OOM-split during run sorting must open one run per half —
+    independently sorted halves are unordered relative to each other."""
+    import spark_rapids_tpu.exec.ooc_sort as OS
+    real_sort = OS.sort_batch
+    def flaky_sort(db, keys, conf):
+        if int(db.num_rows) > 6000:
+            raise TpuRetryOOM("synthetic: batch too big")
+        return real_sort(db, keys, conf)
+    monkeypatch.setattr(OS, "sort_batch", flaky_sort)
+
+    n = 20_000
+    rng = np.random.default_rng(21)
+    tbl = pa.table({"v": pa.array(rng.standard_normal(n))})
+    conf = small_conf(budget=1 << 17)
+    ctx = ExecContext(conf)
+    scan = HostScanExec.from_table(tbl, max_rows=1024)
+    s = SortExec([(0, True, True)], scan)
+    out = s.collect(ctx).column("v").to_pylist()
+    assert len(out) == n
+    assert out == sorted(tbl.column("v").to_pylist())
+    assert ctx.budget.metrics["oom_retries"] > 0
+
+
+def test_agg_partition_ids_stable_across_double_lanes():
+    """A double group key must bucket identically whether its column is in
+    the int64-bit-pattern lane (host upload) or native f64 (computed)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.device import DeviceBatch, DeviceColumn
+    from spark_rapids_tpu import types as t
+    from spark_rapids_tpu.exec.plan import _agg_partition_ids
+
+    conf = small_conf()
+    vals = np.array([1.5, -2.25, 1e12 + 0.125, -0.0, 3.0, 1e-3], np.float64)
+    cap = 256
+    pad = np.zeros(cap - len(vals), np.float64)
+    f64 = np.concatenate([vals, pad])
+    valid = np.zeros(cap, bool)
+    valid[:len(vals)] = True
+
+    bits_col = DeviceColumn(jnp.asarray(f64.view(np.int64)),
+                            jnp.asarray(valid), t.DOUBLE)
+    f64_col = DeviceColumn(jnp.asarray(f64), jnp.asarray(valid), t.DOUBLE)
+    db_bits = DeviceBatch([bits_col], len(vals), ["k"])
+    db_f64 = DeviceBatch([f64_col], len(vals), ["k"])
+    for salt in (0, 1, 2):
+        a = np.asarray(_agg_partition_ids(db_bits, 1, 8, salt))[:len(vals)]
+        b = np.asarray(_agg_partition_ids(db_f64, 1, 8, salt))[:len(vals)]
+        assert np.array_equal(a, b), (salt, a, b)
+    # salts actually decorrelate (not just a label rotation)
+    s0 = np.asarray(_agg_partition_ids(db_f64, 1, 8, 0))[:len(vals)]
+    s1 = np.asarray(_agg_partition_ids(db_f64, 1, 8, 1))[:len(vals)]
+    assert not np.array_equal((s1 - s0) % 8, np.full(len(vals),
+                                                     (s1[0] - s0[0]) % 8))
+
+
+def test_window_minmax_nan_device():
+    """Device window max over a frame containing NaN is NaN (Spark), not
+    +inf; min over all-NaN is NaN."""
+    from spark_rapids_tpu.exec.plan import HostScanExec
+    from spark_rapids_tpu.exec.window import WindowExec
+    from spark_rapids_tpu.plan import expressions as E
+    from spark_rapids_tpu.plan.window import WindowFrame, WinMax, WinMin
+
+    nan = float("nan")
+    tbl = pa.table({"g": ["a", "a", "a", "b", "b"],
+                    "o": [1, 2, 3, 1, 2],
+                    # computed lane: force through a projection below
+                    "v": [1.0, nan, 5.0, nan, nan]})
+    scan = HostScanExec.from_table(tbl)
+    # Add 0.0 so the lane is a computed f64 (the NaN->inf order-lane path)
+    expr = E.Add(E.ColumnRef("v"), E.Literal(0.0))
+    w = WindowExec(
+        [(WinMax(expr, WindowFrame("rows", None, None)), "mx"),
+         (WinMin(expr, WindowFrame("rows", None, None)), "mn"),
+         (WinMax(expr, WindowFrame("rows", None, 0)), "rmx"),
+         (WinMax(expr, WindowFrame("rows", -1, 0)), "bmx")],
+        [E.ColumnRef("g")], [(E.ColumnRef("o"), True, True)], scan)
+    out = w.collect(ExecContext()).to_pandas().sort_values(["g", "o"])
+    mx = out["mx"].tolist()
+    assert all(x != x for x in mx[:3])          # partition a: has NaN -> NaN
+    assert all(x != x for x in mx[3:])          # partition b: all NaN
+    mn = out["mn"].tolist()
+    assert mn[0] == 1.0 and mn[1] == 1.0 and mn[2] == 1.0
+    assert all(x != x for x in mn[3:])          # min over all-NaN is NaN
+    rmx = out["rmx"].tolist()
+    assert rmx[0] == 1.0 and rmx[1] != rmx[1] and rmx[2] != rmx[2]
+    bmx = out["bmx"].tolist()                   # rows [-1, 0]
+    assert bmx[0] == 1.0 and bmx[1] != bmx[1] and bmx[2] != bmx[2]
