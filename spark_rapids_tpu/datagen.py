@@ -1,0 +1,246 @@
+"""Deterministic typed data generators — the data_gen.py DSL role.
+
+Reference: integration_tests/src/main/python/data_gen.py (StringGen /
+IntegerGen / DecimalGen / ... with seeds, special values, null fractions)
+and datagen/ (bigDataGen.scala seed-mapped scale generation,
+FlatDistribution/ExponentialDistribution, key-groups for join
+correlation).
+
+Generators are composable specs: `gen_table([("a", IntGen(nullable=0.1)),
+("b", StringGen())], rows=10_000, seed=7)` yields the same pyarrow table
+for the same seed on every run.  Special values (type extremes, NaN, ±0.0,
+epoch edges) are injected at a fixed ratio so kernels meet them in every
+suite run, mirroring the reference's _special_case machinery.
+"""
+from __future__ import annotations
+
+import datetime as pydt
+import decimal as pydec
+import string as _string
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+
+class Gen:
+    """Base generator: produce(rng, n) -> pyarrow array."""
+    nullable: float = 0.08          # default null fraction
+
+    def __init__(self, nullable: Optional[float] = None):
+        if nullable is not None:
+            self.nullable = nullable
+
+    def arrow_type(self) -> pa.DataType:
+        raise NotImplementedError
+
+    def _values(self, rng: np.random.Generator, n: int):
+        raise NotImplementedError
+
+    def specials(self) -> List:
+        return []
+
+    def produce(self, rng: np.random.Generator, n: int) -> pa.Array:
+        vals = list(self._values(rng, n))
+        sp = self.specials()
+        if sp and n >= 4:
+            # plant every special value at deterministic slots
+            slots = rng.choice(n, size=min(len(sp), n), replace=False)
+            for s, i in zip(sp, slots):
+                vals[int(i)] = s
+        if self.nullable:
+            mask = rng.random(n) < self.nullable
+            vals = [None if m else v for v, m in zip(vals, mask)]
+        return pa.array(vals, self.arrow_type())
+
+
+class BooleanGen(Gen):
+    def arrow_type(self):
+        return pa.bool_()
+
+    def _values(self, rng, n):
+        return rng.random(n) < 0.5
+
+
+class _IntegralGen(Gen):
+    lo: int
+    hi: int
+    pa_type: pa.DataType
+
+    def __init__(self, lo=None, hi=None, nullable=None):
+        super().__init__(nullable)
+        if lo is not None:
+            self.lo = lo
+        if hi is not None:
+            self.hi = hi
+
+    def arrow_type(self):
+        return self.pa_type
+
+    def _values(self, rng, n):
+        return [int(v) for v in rng.integers(self.lo, self.hi + 1, n)]
+
+    def specials(self):
+        return [self.lo, self.hi, 0]
+
+
+class ByteGen(_IntegralGen):
+    lo, hi, pa_type = -128, 127, pa.int8()
+
+
+class ShortGen(_IntegralGen):
+    lo, hi, pa_type = -(2 ** 15), 2 ** 15 - 1, pa.int16()
+
+
+class IntGen(_IntegralGen):
+    lo, hi, pa_type = -(2 ** 31), 2 ** 31 - 1, pa.int32()
+
+
+class LongGen(_IntegralGen):
+    lo, hi, pa_type = -(2 ** 63), 2 ** 63 - 1, pa.int64()
+
+
+class FloatGen(Gen):
+    pa_type = pa.float32()
+    _specials = [0.0, -0.0, 1.0, -1.0, float("inf"), float("-inf"),
+                 float("nan")]
+
+    def arrow_type(self):
+        return self.pa_type
+
+    def _values(self, rng, n):
+        mag = rng.integers(-30, 30, n).astype(np.float64)
+        return (rng.standard_normal(n) * np.power(10.0, mag)).astype(
+            np.dtype(self.pa_type.to_pandas_dtype())).tolist()
+
+    def specials(self):
+        return list(self._specials)
+
+
+class DoubleGen(FloatGen):
+    pa_type = pa.float64()
+
+
+class StringGen(Gen):
+    """Random strings from a charset with length range; pattern-free (the
+    reference's regex-pattern StringGen can layer on)."""
+
+    def __init__(self, min_len=0, max_len=12, charset=None, nullable=None):
+        super().__init__(nullable)
+        self.min_len = min_len
+        self.max_len = max_len
+        self.charset = charset or (_string.ascii_letters + _string.digits
+                                   + " _-")
+
+    def arrow_type(self):
+        return pa.string()
+
+    def _values(self, rng, n):
+        chars = np.array(list(self.charset))
+        lens = rng.integers(self.min_len, self.max_len + 1, n)
+        out = []
+        for ln in lens:
+            out.append("".join(chars[rng.integers(0, len(chars), ln)]))
+        return out
+
+    def specials(self):
+        return ["", " ", "\t", "√unicode✓", "UPPER lower"]
+
+
+class DecimalGen(Gen):
+    def __init__(self, precision=9, scale=2, nullable=None):
+        super().__init__(nullable)
+        self.precision = precision
+        self.scale = scale
+
+    def arrow_type(self):
+        return pa.decimal128(self.precision, self.scale)
+
+    def _values(self, rng, n):
+        hi = 10 ** min(self.precision, 18) - 1
+        unscaled = rng.integers(-hi, hi, n)
+        q = pydec.Decimal(1).scaleb(-self.scale)
+        return [pydec.Decimal(int(u)).scaleb(-self.scale).quantize(q)
+                for u in unscaled]
+
+    def specials(self):
+        q = pydec.Decimal(1).scaleb(-self.scale)
+        hi = pydec.Decimal(10 ** min(self.precision, 18) - 1).scaleb(
+            -self.scale)
+        return [pydec.Decimal(0).quantize(q), hi, -hi]
+
+
+class DateGen(Gen):
+    def __init__(self, lo=pydt.date(1800, 1, 1), hi=pydt.date(2200, 1, 1),
+                 nullable=None):
+        super().__init__(nullable)
+        self.lo = lo
+        self.hi = hi
+
+    def arrow_type(self):
+        return pa.date32()
+
+    def _values(self, rng, n):
+        epoch = pydt.date(1970, 1, 1)
+        lo = (self.lo - epoch).days
+        hi = (self.hi - epoch).days
+        return [epoch + pydt.timedelta(days=int(d))
+                for d in rng.integers(lo, hi, n)]
+
+    def specials(self):
+        return [pydt.date(1970, 1, 1), pydt.date(2000, 2, 29), self.lo]
+
+
+class TimestampGen(Gen):
+    def arrow_type(self):
+        return pa.timestamp("us", tz="UTC")
+
+    def _values(self, rng, n):
+        us = rng.integers(-10**15, 4 * 10**15, n)
+        return [int(v) for v in us]
+
+    def produce(self, rng, n):
+        vals = self._values(rng, n)
+        if self.nullable:
+            mask = rng.random(n) < self.nullable
+            vals = [None if m else v for v, m in zip(vals, mask)]
+        return pa.array(vals, pa.int64()).cast(self.arrow_type())
+
+
+class KeyGroupGen(Gen):
+    """Low-cardinality keys for join/groupby correlation (the datagen
+    key-groups role): values drawn from a fixed pool so two tables built
+    with the same pool parameters join."""
+
+    def __init__(self, num_keys=100, base: Gen = None, nullable=None):
+        super().__init__(nullable)
+        self.num_keys = num_keys
+        self.base = base or LongGen(0, 10 ** 9, nullable=0.0)
+
+    def arrow_type(self):
+        return self.base.arrow_type()
+
+    def _values(self, rng, n):
+        pool_rng = np.random.default_rng(12345 + self.num_keys)
+        pool = list(self.base._values(pool_rng, self.num_keys))
+        idx = rng.integers(0, self.num_keys, n)
+        return [pool[i] for i in idx]
+
+
+def gen_table(cols: Sequence[Tuple[str, Gen]], rows: int,
+              seed: int = 0) -> pa.Table:
+    """Deterministic table: one independent child seed per column, so
+    adding a column never perturbs the others (seed-mapped generation,
+    bigDataGen.scala)."""
+    ss = np.random.SeedSequence(seed)
+    child = ss.spawn(len(cols))
+    arrays, names = [], []
+    for (name, g), cs in zip(cols, child):
+        arrays.append(g.produce(np.random.default_rng(cs), rows))
+        names.append(name)
+    return pa.table(dict(zip(names, arrays)))
+
+
+ALL_SIMPLE_GENS = [BooleanGen(), ByteGen(), ShortGen(), IntGen(),
+                   LongGen(), FloatGen(), DoubleGen(), StringGen(),
+                   DecimalGen(9, 2), DateGen(), TimestampGen()]

@@ -281,8 +281,8 @@ class HashAggregate:
             refs = [E.ColumnRef(f"_buf{j}").bind(schema)
                     for j in range(start, end)]
             expr = fn.evaluate(refs)
-            from ..plan.aggregates import _resolved
-            expr = _resolved(expr) if expr.dtype is None else expr
+            from ..plan.aggregates import _deep_resolved
+            expr = _deep_resolved(expr)
             out_arrays.append(expr.eval_cpu(rb))
             out_names.append(name)
         return pa.Table.from_arrays(out_arrays, out_names)
@@ -314,8 +314,8 @@ class HashAggregate:
             refs = [E.ColumnRef(f"_buf{j}").bind(schema)
                     for j in range(start, end)]
             expr = fn.evaluate(refs)
-            from ..plan.aggregates import _resolved
-            out_exprs.append(_resolved(expr) if expr.dtype is None else expr)
+            from ..plan.aggregates import _deep_resolved
+            out_exprs.append(_deep_resolved(expr))
             out_names.append(name)
         return evaluate_projection(out_exprs, out_names, merged, self.conf)
 

@@ -246,6 +246,13 @@ class CpuAggregateExec(HostNode):
             if child is None:
                 # count(*): count over an all-valid dummy column
                 arrays.append(pa.array([True] * rb.num_rows))
+            elif getattr(fn, "child2", None) is not None:
+                # binary statistical aggregates ride a struct column whose
+                # pylist dicts the _py callable unpacks (corr/covar)
+                x = self._arr(child.eval_cpu(rb), rb.num_rows)
+                y = self._arr(fn.child2.eval_cpu(rb), rb.num_rows)
+                arrays.append(pa.StructArray.from_arrays([x, y],
+                                                         ["x", "y"]))
             else:
                 arrays.append(self._arr(child.eval_cpu(rb), rb.num_rows))
             names.append(col)

@@ -37,6 +37,16 @@ def _null_columns(schema: t.StructType, capacity: int) -> List[DeviceColumn]:
     return cols
 
 
+def _join_partition_ids(key_cols: List[DeviceColumn], db: DeviceBatch,
+                        num_buckets: int) -> jax.Array:
+    """Bucket ids from join-key columns; value-stable across sides and
+    batches (reuses the agg fallback's lane-normalized hash)."""
+    from .plan import _agg_partition_ids
+    kb = DeviceBatch(list(key_cols), db.num_rows,
+                     [f"_k{i}" for i in range(len(key_cols))])
+    return _agg_partition_ids(kb, len(key_cols), num_buckets)
+
+
 class HashJoinExec(PlanNode):
     """Equi-join: inner / left|right|full outer / left semi / left anti.
 
@@ -113,14 +123,92 @@ class HashJoinExec(PlanNode):
         right_batches = [db for db in self.right.execute(ctx)
                          if int(db.num_rows) > 0]
         if not right_batches:
-            build_batch = None
-        else:
-            build_batch = concat_batches(right_batches, ctx.conf)
-
-        if build_batch is None:
             yield from self._empty_build_output(ctx)
             return
 
+        from ..config import HASH_SUBPARTITION_FALLBACK
+        build_rows = sum(int(b.num_rows) for b in right_batches)
+        if ctx.conf.get(HASH_SUBPARTITION_FALLBACK) and \
+                build_rows > 2 * ctx.conf.batch_size_rows:
+            # Oversized build side: re-hash-partition BOTH sides into
+            # independent sub-joins (GpuSubPartitionHashJoin.scala:32) —
+            # equal keys hash to the same bucket on both sides, so the
+            # union of bucket joins is the join.
+            yield from self._sub_partition_join(right_batches, ctx)
+            return
+
+        build_batch = concat_batches(right_batches, ctx.conf)
+        yield from self._join_stream(build_batch, self.left.execute(ctx),
+                                     ctx)
+
+    def _sub_partition_join(self, right_batches, ctx: ExecContext
+                            ) -> Iterator[DeviceBatch]:
+        from ..runtime.memory import Spillable
+        conf = ctx.conf
+        build_rows = sum(int(b.num_rows) for b in right_batches)
+        k = 1 << max(1, (build_rows // conf.batch_size_rows)
+                     .bit_length() - 1)
+        k = min(k, 32)
+        ctx.bump("join_subpartition_fallbacks")
+
+        raw_pos = self._raw_key_positions()
+
+        def scatter(db, exprs, buckets):
+            keys = self._key_cols(db, exprs, raw_pos, ctx)
+            ids = _join_partition_ids(keys, db, k)
+            live = db.row_mask()
+            for p in range(k):
+                part = compact_batch(db, (ids == p) & live, ctx.conf)
+                from ..ops.batch_ops import shrink_to_rows
+                part = shrink_to_rows(part, int(part.num_rows), ctx.conf)
+                if int(part.num_rows):
+                    buckets[p].append(Spillable(part, ctx.budget))
+
+        build_parts = [[] for _ in range(k)]
+        probe_parts = [[] for _ in range(k)]
+        try:
+            for db in right_batches:
+                scatter(db, self.right_keys, build_parts)
+            for db in self.left.execute(ctx):
+                if int(db.num_rows) == 0:
+                    continue
+                scatter(db, self.left_keys, probe_parts)
+
+            for p in range(k):
+                bl, pl = build_parts[p], probe_parts[p]
+                if not bl and not pl:
+                    continue
+
+                def probes():
+                    for sp in pl:
+                        b = sp.get()
+                        sp.close()
+                        yield b
+                if not bl:
+                    if self.join_type in (J.INNER, J.LEFT_SEMI,
+                                          J.RIGHT_OUTER):
+                        # nothing to emit: release without re-uploading
+                        for sp in pl:
+                            sp.close()
+                        continue
+                    # empty build bucket: the empty-build rule decides
+                    yield from self._empty_build_stream(probes(), ctx)
+                    continue
+                bbs = [sp.get() for sp in bl]
+                build_batch = concat_batches(bbs, ctx.conf) \
+                    if len(bbs) > 1 else bbs[0]
+                for sp in bl:
+                    sp.close()
+                yield from self._join_stream(build_batch, probes(), ctx)
+        finally:
+            # early generator abandonment (e.g. LIMIT above the join) must
+            # not leak registered spillables / disk spill files
+            for part in build_parts + probe_parts:
+                for sp in part:
+                    sp.close()
+
+    def _join_stream(self, build_batch: DeviceBatch, probe_iter,
+                     ctx: ExecContext) -> Iterator[DeviceBatch]:
         raw_pos = self._raw_key_positions()
         build_keys = self._key_cols(build_batch, self.right_keys, raw_pos,
                                     ctx)
@@ -137,7 +225,7 @@ class HashJoinExec(PlanNode):
 
         build_matched_acc = jnp.zeros((build_batch.capacity,), bool)
 
-        for pb in self.left.execute(ctx):
+        for pb in probe_iter:
             if int(pb.num_rows) == 0:
                 continue
             probe_keys = self._key_cols(pb, self.left_keys, raw_pos, ctx)
@@ -198,12 +286,21 @@ class HashJoinExec(PlanNode):
             yield compact_batch(padded, unmatched, ctx.conf)
 
     def _empty_build_output(self, ctx) -> Iterator[DeviceBatch]:
+        # top level: inner/semi/right-outer need not execute the probe
+        # subtree at all (the pre-sub-partition short-circuit)
+        if self.join_type in (J.INNER, J.LEFT_SEMI, J.RIGHT_OUTER):
+            return
+        yield from self._empty_build_stream(self.left.execute(ctx), ctx)
+
+    def _empty_build_stream(self, probe_iter, ctx) -> Iterator[DeviceBatch]:
         """Empty build side: inner/semi/right produce nothing; left outer
         and anti pass probe rows through (right side null)."""
         if self.join_type in (J.INNER, J.LEFT_SEMI, J.RIGHT_OUTER):
+            for _ in probe_iter:     # drain (sub-partition spill cleanup)
+                pass
             return
         out_names = list(self.output_schema.names)
-        for pb in self.left.execute(ctx):
+        for pb in probe_iter:
             if int(pb.num_rows) == 0:
                 continue
             if self.join_type == J.LEFT_ANTI:

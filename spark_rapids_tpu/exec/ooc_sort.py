@@ -51,6 +51,7 @@ class OutOfCoreSorter:
         self._pending_rows = 0
         self._runs: List[deque] = []      # deques of Spillable chunks
         self._window_rows: Optional[int] = None
+        self._merge_pending: Optional[Spillable] = None
 
     # -- phase 1: build sorted runs ---------------------------------------
     def _resolve_window(self, db: DeviceBatch) -> int:
@@ -103,23 +104,32 @@ class OutOfCoreSorter:
         self._close_run()
         if not self._runs:
             return
-        if len(self._runs) == 1:
-            for sp in self._runs[0]:
-                yield sp.get()
-                sp.close()
+        try:
+            if len(self._runs) == 1:
+                for sp in self._runs[0]:
+                    yield sp.get()
+                    sp.close()
+                return
+            yield from self._merge()
+        finally:
+            # early abandonment (e.g. LIMIT above the sort) must release
+            # every still-registered chunk and the pending set
+            for run in self._runs:
+                for sp in run:
+                    sp.close()
             self._runs = []
-            return
-        yield from self._merge()
+            if self._merge_pending is not None:
+                self._merge_pending.close()
+                self._merge_pending = None
 
     def _merge(self) -> Iterator[DeviceBatch]:
         runs = self._runs
-        pending: Optional[Spillable] = None
         while True:
             window: List[DeviceBatch] = []
-            if pending is not None:
-                window.append(pending.get())
-                pending.close()
-                pending = None
+            if self._merge_pending is not None:
+                window.append(self._merge_pending.get())
+                self._merge_pending.close()
+                self._merge_pending = None
             # load the next chunk of every non-empty run; remember each
             # loaded chunk's last-row concat index (the capstone)
             offset = sum(int(b.num_rows) for b in window)
@@ -154,7 +164,7 @@ class OutOfCoreSorter:
                 DeviceBatch(s.columns, total, list(s.names))
             self.ctx.bump("sort_merge_passes")
             if cut < total:
-                pending = Spillable(
+                self._merge_pending = Spillable(
                     slice_batch(s, cut, total, self.conf), self.budget)
             elif not any(runs):
                 return

@@ -260,9 +260,16 @@ class HashAggregateExec(PlanNode):
                 else:
                     partials = [merged]
         if buckets is not None:
-            for blist in buckets:
-                if blist:
-                    yield from self._finalize_bucket(agg, blist, ctx, 1)
+            try:
+                for blist in buckets:
+                    if blist:
+                        yield from self._finalize_bucket(agg, blist, ctx, 1)
+            finally:
+                # early abandonment / errors must release every registered
+                # spillable (close is idempotent)
+                for blist in buckets:
+                    for sp in blist:
+                        sp.close()
             return
         if not seen:
             if self.key_exprs:
@@ -295,40 +302,53 @@ class HashAggregateExec(PlanNode):
         recursively); merges are rolling and retry-wrapped so the working
         set stays at two batches."""
         from ..config import AGG_FALLBACK_PARTITIONS
+        from ..runtime.memory import Spillable
         from ..runtime.retry import with_retry
         conf = ctx.conf
         total = sum(sp.num_rows for sp in blist)
-        if depth < self._MAX_SCATTER_DEPTH and len(blist) > 1 and \
-                total > 2 * conf.batch_size_rows:
-            k = conf.get(AGG_FALLBACK_PARTITIONS)
-            sub = [[] for _ in range(k)]
+        sub = []
+        acc = None
+        try:
+            if depth < self._MAX_SCATTER_DEPTH and len(blist) > 1 and \
+                    total > 2 * conf.batch_size_rows:
+                k = conf.get(AGG_FALLBACK_PARTITIONS)
+                sub = [[] for _ in range(k)]
+                for sp in blist:
+                    b = sp.get()
+                    sp.close()
+                    self._scatter(b, sub, k, ctx, salt=depth)
+                ctx.bump("agg_repartition_fallbacks")
+                for sl in sub:
+                    if sl:
+                        yield from self._finalize_bucket(agg, sl, ctx,
+                                                         depth + 1)
+                return
+            acc = blist[0]
+            for sp in blist[1:]:
+                # both inputs stay REGISTERED during the merge attempt so
+                # the retry's spill_all can actually demote them (the
+                # reference's "inputs must be spillable" contract); get()
+                # inside the attempt re-materializes after a spill
+                a, b = acc, sp
+                merged = with_retry(ctx.budget, conf,
+                                    lambda: agg.merge([a.get(), b.get()]))
+                nxt = Spillable(merged, ctx.budget)
+                a.close()
+                b.close()
+                acc = nxt
+            out = acc.get()
+            acc.close()
+            yield agg.final(out)
+        finally:
+            # early abandonment / mid-merge failure: release everything
+            # still registered (close is idempotent)
             for sp in blist:
-                b = sp.get()
                 sp.close()
-                self._scatter(b, sub, k, ctx, salt=depth)
-            ctx.bump("agg_repartition_fallbacks")
             for sl in sub:
-                if sl:
-                    yield from self._finalize_bucket(agg, sl, ctx,
-                                                     depth + 1)
-            return
-        from ..runtime.memory import Spillable
-        acc = blist[0]
-        for sp in blist[1:]:
-            # both inputs stay REGISTERED during the merge attempt so the
-            # retry's spill_all can actually demote them (the reference's
-            # "inputs must be spillable" contract); get() inside the
-            # attempt re-materializes after a spill
-            a, b = acc, sp
-            merged = with_retry(ctx.budget, conf,
-                                lambda: agg.merge([a.get(), b.get()]))
-            nxt = Spillable(merged, ctx.budget)
-            a.close()
-            b.close()
-            acc = nxt
-        out = acc.get()
-        acc.close()
-        yield agg.final(out)
+                for sp in sl:
+                    sp.close()
+            if acc is not None:
+                acc.close()
 
     def collect_device(self, ctx: Optional[ExecContext] = None):
         """Dispatch a global (no-key) aggregation fully async: returns
@@ -448,6 +468,8 @@ def _agg_partition_ids(pb: DeviceBatch, nkeys: int, num_buckets: int,
             return (h % jnp.uint32(num_buckets)).astype(jnp.int32)
 
         fn = jax.jit(run)
+        if len(_AGG_PART_CACHE) > 512:
+            _AGG_PART_CACHE.clear()
         _AGG_PART_CACHE[sig] = fn
     return fn(tuple(c.data for c in pb.columns[:nkeys]),
               tuple(c.validity for c in pb.columns[:nkeys]),

@@ -1,0 +1,537 @@
+"""Java-regex → byte-DFA transpiler + device prefix-automaton runner.
+
+The reference's CudfRegexTranspiler (RegexParser.scala:687, 2162 LoC)
+parses Java regex and re-emits it in the cuDF dialect, REJECTING patterns
+whose semantics don't map — the pattern for any engine whose regex dialect
+differs from Java's.  The TPU has no regex engine at all, so the transpile
+target here is further down: a byte-level DFA executed as a *prefix
+automaton* —
+
+  * parse a Java-regex subset (literals, escapes, char classes, '.',
+    top-level anchors, groups, alternation, greedy quantifiers) to an AST,
+    rejecting constructs whose semantics can't compile to a DFA
+    (backreferences, lookaround, lazy/possessive quantifiers, interior
+    anchors, word boundaries) with a RegexUnsupported the caller turns
+    into a fallback — the same reject contract as the reference;
+  * Thompson-construct an NFA over the BYTE alphabet (non-ASCII literals
+    expand to their UTF-8 byte sequences; '.' and negated classes accept
+    well-formed multi-byte sequences, so character semantics survive the
+    byte-level compilation);
+  * subset-construct a DFA with a state cap (blowup ⇒ reject);
+  * run it on device: each byte of the dictionary's flat byte tensor
+    becomes a state-mapping vector, composed by a segmented
+    `associative_scan` (function composition is associative — the classic
+    parallel DFA evaluation), with resets at string starts.  One log-depth
+    pass matches EVERY dictionary entry simultaneously; per-row verdicts
+    gather by dictionary code.
+
+Search (RLIKE) semantics come from automaton shape, not scanning: an
+unanchored head becomes a start-state self-loop, an unanchored tail makes
+accepting states absorbing.
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+MAX_NFA_STATES = 256
+MAX_DFA_STATES = 96
+MAX_REPEAT = 64
+
+
+class RegexUnsupported(Exception):
+    """Pattern uses a construct outside the DFA-compilable subset."""
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+class _Node:
+    pass
+
+
+class _Bytes(_Node):              # literal byte sequence (one char)
+    def __init__(self, bs: bytes):
+        self.bs = bs
+
+
+class _Class(_Node):              # set of single BYTES (ASCII subset)
+    def __init__(self, bytes_set: FrozenSet[int], with_multibyte: bool):
+        self.bytes_set = bytes_set
+        self.with_multibyte = with_multibyte   # also match any non-ASCII char
+
+
+class _Concat(_Node):
+    def __init__(self, parts: List[_Node]):
+        self.parts = parts
+
+
+class _Alt(_Node):
+    def __init__(self, opts: List[_Node]):
+        self.opts = opts
+
+
+class _Repeat(_Node):
+    def __init__(self, node: _Node, lo: int, hi: Optional[int]):
+        self.node = node
+        self.lo = lo
+        self.hi = hi             # None = unbounded
+
+
+_ASCII = frozenset(range(0x00, 0x80))
+_DIGITS = frozenset(range(ord("0"), ord("9") + 1))
+_WORD = frozenset(ord(c) for c in
+                  "abcdefghijklmnopqrstuvwxyz"
+                  "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_")
+_SPACE = frozenset(b" \t\n\x0b\f\r")
+
+
+class _Parser:
+    """Recursive-descent Java-regex parser for the DFA subset."""
+
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def take(self) -> str:
+        c = self.p[self.i]
+        self.i += 1
+        return c
+
+    def parse(self) -> Tuple[_Node, bool, bool]:
+        """Returns (ast, start_anchored, end_anchored)."""
+        start = False
+        if self.peek() == "^":
+            self.take()
+            start = True
+        node = self._alternation(top=True)
+        end = getattr(self, "_end_anchor", False)
+        if self.i < len(self.p):
+            raise RegexUnsupported(f"unbalanced pattern at {self.i}")
+        return node, start, end
+
+    def _alternation(self, top=False) -> _Node:
+        opts = [self._concat(top)]
+        while self.peek() == "|":
+            self.take()
+            opts.append(self._concat(top))
+        if len(opts) > 1 and top and getattr(self, "_end_anchor", False):
+            # '$' consumed inside one branch of a top-level alternation
+            raise RegexUnsupported(
+                "'$' inside an alternation branch (interior anchor)")
+        return opts[0] if len(opts) == 1 else _Alt(opts)
+
+    def _concat(self, top=False) -> _Node:
+        parts: List[_Node] = []
+        while True:
+            c = self.peek()
+            if c is None or c in "|)":
+                break
+            if c == "$":
+                self.take()
+                if top and self.peek() is None:
+                    self._end_anchor = True
+                    break
+                raise RegexUnsupported("interior '$' anchor")
+            if c == "^":
+                raise RegexUnsupported("interior '^' anchor")
+            atom = self._atom()
+            atom = self._quantified(atom)
+            parts.append(atom)
+        return _Concat(parts)
+
+    def _quantified(self, atom: _Node) -> _Node:
+        c = self.peek()
+        if c not in ("*", "+", "?", "{"):
+            return atom
+        if c == "{":
+            save = self.i
+            self.take()
+            lo, hi = self._brace()
+            if lo is None:                   # not a quantifier: literal '{'
+                self.i = save
+                return atom
+        else:
+            self.take()
+            lo, hi = {"*": (0, None), "+": (1, None), "?": (0, 1)}[c]
+        nxt = self.peek()
+        if nxt == "?":
+            raise RegexUnsupported("lazy quantifier (no leftmost spans "
+                                   "in a DFA)")
+        if nxt == "+":
+            raise RegexUnsupported("possessive quantifier")
+        return _Repeat(atom, lo, hi)
+
+    def _brace(self):
+        digits = ""
+        while self.peek() is not None and self.peek().isdigit():
+            digits += self.take()
+        if not digits:
+            return None, None
+        lo = int(digits)
+        hi = lo
+        if self.peek() == ",":
+            self.take()
+            d2 = ""
+            while self.peek() is not None and self.peek().isdigit():
+                d2 += self.take()
+            hi = int(d2) if d2 else None
+        if self.peek() != "}":
+            return None, None
+        self.take()
+        if hi is not None and (hi < lo or hi > MAX_REPEAT) or lo > MAX_REPEAT:
+            raise RegexUnsupported(f"repeat bound beyond {MAX_REPEAT}")
+        return lo, hi
+
+    def _atom(self) -> _Node:
+        c = self.take()
+        if c == "(":
+            if self.peek() == "?":
+                self.take()
+                n = self.peek()
+                if n == ":":
+                    self.take()
+                else:
+                    raise RegexUnsupported(
+                        "lookaround / named group / inline flags")
+            inner = self._alternation()
+            if self.peek() != ")":
+                raise RegexUnsupported("unbalanced group")
+            self.take()
+            return inner           # capturing == non-capturing for matching
+        if c == "[":
+            return self._char_class()
+        if c == ".":
+            # Java default: any char except line terminators
+            return _Class(_ASCII - {0x0A, 0x0D}, with_multibyte=True)
+        if c == "\\":
+            return self._escape()
+        if c in "*+?":
+            raise RegexUnsupported(f"dangling quantifier '{c}'")
+        return _Bytes(c.encode("utf-8"))
+
+    def _escape(self) -> _Node:
+        if self.peek() is None:
+            raise RegexUnsupported("trailing backslash")
+        c = self.take()
+        simple = {"n": b"\n", "t": b"\t", "r": b"\r", "f": b"\f",
+                  "a": b"\x07", "e": b"\x1b", "0": b"\x00"}
+        if c in simple:
+            return _Bytes(simple[c])
+        if c == "d":
+            return _Class(_DIGITS, False)
+        if c == "D":
+            return _Class(_ASCII - _DIGITS, True)
+        if c == "w":
+            return _Class(_WORD, False)
+        if c == "W":
+            return _Class(_ASCII - _WORD, True)
+        if c == "s":
+            return _Class(_SPACE, False)
+        if c == "S":
+            return _Class(_ASCII - _SPACE, True)
+        if c == "x":
+            h = ""
+            for _ in range(2):
+                if self.peek() is None:
+                    raise RegexUnsupported("bad \\x escape")
+                h += self.take()
+            return _Bytes(bytes([int(h, 16)]))
+        if c in "123456789":
+            raise RegexUnsupported("backreference")
+        if c in ("b", "B"):
+            raise RegexUnsupported("word boundary")
+        if c in ("A",):
+            raise RegexUnsupported("\\A anchor (use leading ^)")
+        if c in ("z", "Z", "G"):
+            raise RegexUnsupported(f"\\{c} anchor")
+        if c in ("p", "P", "u", "N", "k", "Q"):
+            raise RegexUnsupported(f"\\{c} construct")
+        # escaped metacharacter or punctuation: literal
+        return _Bytes(c.encode("utf-8"))
+
+    def _char_class(self) -> _Node:
+        negated = False
+        if self.peek() == "^":
+            self.take()
+            negated = True
+        items: set = set()
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                raise RegexUnsupported("unterminated character class")
+            if c == "]" and not first:
+                self.take()
+                break
+            first = False
+            c = self.take()
+            if c == "[" and self.peek() == ":":
+                raise RegexUnsupported("POSIX class")
+            if c == "\\":
+                e = self.take()
+                cls = {"d": _DIGITS, "w": _WORD, "s": _SPACE}.get(e)
+                if cls is not None:
+                    items |= cls
+                    continue
+                if e in ("D", "W", "S"):
+                    raise RegexUnsupported(
+                        "negated predefined class inside a class")
+                simple = {"n": 0x0A, "t": 0x09, "r": 0x0D, "f": 0x0C}
+                lo_b = simple.get(e, ord(e) if ord(e) < 128 else None)
+                if lo_b is None:
+                    raise RegexUnsupported("non-ASCII escape in class")
+            else:
+                if ord(c) > 127:
+                    raise RegexUnsupported("non-ASCII char in class")
+                lo_b = ord(c)
+            if self.peek() == "-" and self.i + 1 < len(self.p) \
+                    and self.p[self.i + 1] != "]":
+                self.take()
+                hi_c = self.take()
+                if hi_c == "\\":
+                    hi_c = self.take()
+                if ord(hi_c) > 127:
+                    raise RegexUnsupported("non-ASCII range in class")
+                items |= set(range(lo_b, ord(hi_c) + 1))
+            else:
+                items.add(lo_b)
+        if negated:
+            return _Class(_ASCII - items, with_multibyte=True)
+        return _Class(frozenset(items), with_multibyte=False)
+
+
+# ---------------------------------------------------------------------------
+# NFA (Thompson) over the byte alphabet
+# ---------------------------------------------------------------------------
+
+_MB_LEAD2 = frozenset(range(0xC2, 0xE0))
+_MB_LEAD3 = frozenset(range(0xE0, 0xF0))
+_MB_LEAD4 = frozenset(range(0xF0, 0xF5))
+_MB_CONT = frozenset(range(0x80, 0xC0))
+
+
+class _NFA:
+    def __init__(self):
+        self.eps: List[List[int]] = []
+        self.trans: List[List[Tuple[FrozenSet[int], int]]] = []
+
+    def new_state(self) -> int:
+        if len(self.eps) >= MAX_NFA_STATES:
+            raise RegexUnsupported("pattern too large (NFA cap)")
+        self.eps.append([])
+        self.trans.append([])
+        return len(self.eps) - 1
+
+    def add_eps(self, a, b):
+        self.eps[a].append(b)
+
+    def add(self, a, byteset: FrozenSet[int], b):
+        self.trans[a].append((byteset, b))
+
+    def _multibyte(self, a, b):
+        """Accept one well-formed non-ASCII UTF-8 char from a to b."""
+        m2 = self.new_state()
+        self.add(a, _MB_LEAD2, m2)
+        self.add(m2, _MB_CONT, b)
+        m3a, m3b = self.new_state(), self.new_state()
+        self.add(a, _MB_LEAD3, m3a)
+        self.add(m3a, _MB_CONT, m3b)
+        self.add(m3b, _MB_CONT, b)
+        m4a, m4b, m4c = (self.new_state(), self.new_state(),
+                         self.new_state())
+        self.add(a, _MB_LEAD4, m4a)
+        self.add(m4a, _MB_CONT, m4b)
+        self.add(m4b, _MB_CONT, m4c)
+        self.add(m4c, _MB_CONT, b)
+
+    def build(self, node: _Node, a: int, b: int):
+        """Wire `node` to accept between states a..b."""
+        if isinstance(node, _Bytes):
+            cur = a
+            for i, byte in enumerate(node.bs):
+                nxt = b if i == len(node.bs) - 1 else self.new_state()
+                self.add(cur, frozenset([byte]), nxt)
+                cur = nxt
+        elif isinstance(node, _Class):
+            if node.bytes_set:
+                self.add(a, node.bytes_set, b)
+            if node.with_multibyte:
+                self._multibyte(a, b)
+        elif isinstance(node, _Concat):
+            cur = a
+            for i, part in enumerate(node.parts):
+                nxt = b if i == len(node.parts) - 1 else self.new_state()
+                self.build(part, cur, nxt)
+                cur = nxt
+            if not node.parts:
+                self.add_eps(a, b)
+        elif isinstance(node, _Alt):
+            for opt in node.opts:
+                s, e = self.new_state(), self.new_state()
+                self.add_eps(a, s)
+                self.build(opt, s, e)
+                self.add_eps(e, b)
+        elif isinstance(node, _Repeat):
+            lo, hi = node.lo, node.hi
+            cur = a
+            for _ in range(lo):
+                nxt = self.new_state()
+                self.build(node.node, cur, nxt)
+                cur = nxt
+            if hi is None:
+                # loop: cur -> cur, then out
+                s, e = self.new_state(), self.new_state()
+                self.add_eps(cur, s)
+                self.build(node.node, s, e)
+                self.add_eps(e, s)
+                self.add_eps(cur, b)
+                self.add_eps(e, b)
+            else:
+                self.add_eps(cur, b)
+                for _ in range(hi - lo):
+                    nxt = self.new_state()
+                    self.build(node.node, cur, nxt)
+                    self.add_eps(nxt, b)
+                    cur = nxt
+        else:
+            raise RegexUnsupported(f"unknown node {node!r}")
+
+
+# ---------------------------------------------------------------------------
+# DFA via subset construction
+# ---------------------------------------------------------------------------
+
+class Dfa:
+    """table: (S, 256) int16 next-state; accepting: (S,) bool; start: 0."""
+
+    def __init__(self, table: np.ndarray, accepting: np.ndarray):
+        self.table = table
+        self.accepting = accepting
+
+    @property
+    def num_states(self):
+        return self.table.shape[0]
+
+
+def _eps_closure(nfa: _NFA, states: FrozenSet[int]) -> FrozenSet[int]:
+    stack, seen = list(states), set(states)
+    while stack:
+        s = stack.pop()
+        for n in nfa.eps[s]:
+            if n not in seen:
+                seen.add(n)
+                stack.append(n)
+    return frozenset(seen)
+
+
+def compile_dfa(pattern: str, search: bool = True) -> Dfa:
+    """Compile a Java regex to a byte DFA.
+
+    search=True gives RLIKE find-anywhere semantics via automaton shape:
+    unanchored head = start loops on every byte; unanchored tail =
+    accepting states absorb.  Raises RegexUnsupported outside the subset.
+    """
+    ast, anchored_start, anchored_end = _Parser(pattern).parse()
+    nfa = _NFA()
+    start = nfa.new_state()
+    accept = nfa.new_state()
+    nfa.build(ast, start, accept)
+
+    all_bytes = frozenset(range(256))
+    if search and not anchored_start:
+        nfa.add(start, all_bytes, start)
+    if search and not anchored_end:
+        nfa.add(accept, all_bytes, accept)
+
+    d0 = _eps_closure(nfa, frozenset([start]))
+    states: Dict[FrozenSet[int], int] = {d0: 0}
+    order = [d0]
+    rows: List[np.ndarray] = []
+    i = 0
+    while i < len(order):
+        cur = order[i]
+        row = np.zeros(256, np.int16)
+        # group target sets per byte
+        for b in range(256):
+            tgt = set()
+            for s in cur:
+                for byteset, to in nfa.trans[s]:
+                    if b in byteset:
+                        tgt.add(to)
+            if tgt:
+                closed = _eps_closure(nfa, frozenset(tgt))
+            else:
+                closed = frozenset()
+            idx = states.get(closed)
+            if idx is None:
+                if len(states) >= MAX_DFA_STATES:
+                    raise RegexUnsupported("DFA state blowup")
+                idx = len(states)
+                states[closed] = idx
+                order.append(closed)
+            row[b] = idx
+        rows.append(row)
+        i += 1
+    table = np.stack(rows)
+    accepting = np.array([accept in st for st in order], bool)
+    return Dfa(table, accepting)
+
+
+# ---------------------------------------------------------------------------
+# Device runner: segmented prefix-automaton
+# ---------------------------------------------------------------------------
+
+def dfa_matches(dfa: Dfa, offsets, bytes_):
+    """Convenience wrapper over dfa_matches_lanes (uploads the tables)."""
+    import jax.numpy as jnp
+    return dfa_matches_lanes(jnp.asarray(dfa.table.T.astype(np.int16)),
+                             jnp.asarray(dfa.accepting), offsets, bytes_)
+
+
+def dfa_matches_lanes(table_t, accepting, offsets, bytes_):
+    """Run the DFA over every dictionary entry at once.
+
+    table_t: (256, S) int16 transposed transition table; accepting: (S,)
+    bool; offsets: (n_entries+1,) int32; bytes_: (n_bytes,) uint8 — all
+    device arrays.  Returns (n_entries,) bool device — entry matches.
+
+    Each byte maps to its column of the transition table (a state-mapping
+    vector); a segmented associative scan composes the mappings with
+    resets at entry starts, and the verdict gathers the end-of-entry
+    state.  O(n_bytes * S) work at log depth — every entry in parallel.
+    """
+    import jax.numpy as jnp
+    import jax
+
+    S = table_t.shape[1]
+    n = bytes_.shape[0]
+    n_entries = offsets.shape[0] - 1
+
+    if n == 0:
+        # all entries empty: start state decides
+        return jnp.broadcast_to(accepting[0], (n_entries,))
+
+    fmap = table_t[bytes_.astype(jnp.int32)]              # (n, S)
+    # empty entries have start == next start (or == n, dropped): clipping
+    # would alias them onto the PREVIOUS entry's last byte
+    starts = jnp.zeros((n,), bool).at[offsets[:-1]].set(True, mode="drop")
+
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        composed = jnp.take_along_axis(bv, av.astype(jnp.int32), axis=-1)
+        return jnp.where(bf[..., None], bv, composed), af | bf
+
+    pref, _ = jax.lax.associative_scan(combine, (fmap, starts))
+    # state at entry end = pref[last_byte][start=0]; empty entry -> state 0
+    last = jnp.clip(offsets[1:] - 1, 0, n - 1)
+    end_state = pref[last, 0]
+    empty = offsets[1:] == offsets[:-1]
+    end_state = jnp.where(empty, 0, end_state)
+    return accepting[jnp.clip(end_state, 0, S - 1)]

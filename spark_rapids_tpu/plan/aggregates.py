@@ -168,12 +168,30 @@ class Min(AggregateFunction):
             out.append("string min/max not yet on device")
         return out
 
+    _is_min = True
+
     def cpu_agg(self):
+        # pyarrow's min/max SKIP NaN; Spark orders NaN greatest (and
+        # -0.0 < 0.0) — float inputs need the Java-ordering python path
+        if t.is_floating(self.child.dtype):
+            import math
+            is_min = self._is_min
+
+            def key(v):
+                return (v != v, v, not math.copysign(1.0, v) < 0)
+
+            def py(vs):
+                nn = [v for v in vs if v is not None]
+                if not nn:
+                    return None
+                return min(nn, key=key) if is_min else max(nn, key=key)
+            return ("_py", py)
         return ("min", None)
 
 
 class Max(Min):
     name = "max"
+    _is_min = False
 
     def update_ops(self):
         return [(G.MAX, self.dtype)]
@@ -182,6 +200,8 @@ class Max(Min):
         return [(G.MAX, self.dtype)]
 
     def cpu_agg(self):
+        if t.is_floating(self.child.dtype):
+            return super().cpu_agg()
         return ("max", None)
 
 
@@ -323,6 +343,17 @@ def _resolved(e: E.Expression) -> E.Expression:
     return e
 
 
+def _deep_resolved(e: E.Expression) -> E.Expression:
+    """Recursively resolve an evaluate() tree whose leaves (buffer refs,
+    literals) are already bound but whose inner nodes are not."""
+    for c in e.children:
+        if getattr(c, "dtype", None) is None:
+            _deep_resolved(c)
+    if getattr(e, "dtype", None) is None:
+        e._resolve()
+    return e
+
+
 class _DecimalAvgEvaluate(E.Expression):
     """sum_buffer / count at Spark's avg scale (s+4), HALF_UP — exact
     integer arithmetic on the unscaled lanes (no float round-trip)."""
@@ -368,3 +399,360 @@ class _DecimalAvgEvaluate(E.Expression):
 
     def _fp_extra(self):
         return self.out_t.simple_string
+
+
+# ---------------------------------------------------------------------------
+# Statistical aggregates (reference org/.../rapids/aggregate/ stddev/
+# variance/covariance families) — device path composes existing SUM kernels
+# over projected moment inputs; no new kernel code.
+# ---------------------------------------------------------------------------
+
+def _null_double():
+    return E.Literal(None, t.DOUBLE)
+
+
+def _clamp_nonneg(e: E.Expression) -> E.Expression:
+    """max(e, 0): the moment formula m2 = ss - s^2/n can round to a tiny
+    negative for constant columns; Spark's variance is never negative and
+    its sqrt must not produce NaN from rounding.  The condition tests
+    `e < 0` so a NaN moment (NaN input values) passes through as NaN —
+    Spark's variance over NaN is NaN, not 0."""
+    zero = E.Literal(0.0, t.DOUBLE)
+    return E.If(E.LessThan(e, zero), zero, e)
+
+
+def _masked_pair(x: E.Expression, other: E.Expression) -> E.Expression:
+    """x where the partner column is non-null (Spark drops half-null pairs
+    from the binary statistical aggregates)."""
+    return _resolved(E.If(E.IsNotNull(other), x, _null_double()))
+
+
+class VariancePop(AggregateFunction):
+    """var_pop: buffers (n, sum x, sum x^2) merged by summation.
+
+    Moment-based formulation instead of the reference's Welford M2 merge —
+    sums are exact merges under the sort-segment kernel; the final
+    (ss - s^2/n)/n runs in f64.  Precision note: catastrophic cancellation
+    for huge means is possible (documented deviation; the reference's
+    central-moment merge is more stable)."""
+    name = "var_pop"
+    ddof = 0
+
+    def _resolve(self):
+        self.dtype = t.DOUBLE
+        self.nullable = True
+        self._xd = _resolved(E.Cast(self.child, t.DOUBLE))
+
+    def inputs(self):
+        xx = _resolved(E.Multiply(self._xd, self._xd))
+        return [self.child, self._xd, xx]
+
+    def update_ops(self):
+        return [(G.COUNT, t.LONG), (G.SUM, t.DOUBLE), (G.SUM, t.DOUBLE)]
+
+    def merge_ops(self):
+        return [(G.SUM, t.LONG), (G.SUM, t.DOUBLE), (G.SUM, t.DOUBLE)]
+
+    def evaluate(self, refs):
+        n = E.Cast(refs[0], t.DOUBLE)
+        s, ss = refs[1], refs[2]
+        m2 = _clamp_nonneg(
+            E.Subtract(ss, E.Divide(E.Multiply(s, s), n)))
+        denom = E.Literal(float(self.ddof), t.DOUBLE)
+        var = E.Divide(m2, E.Subtract(n, denom))
+        guard = E.GreaterThan(refs[0], E.Literal(self.ddof, t.LONG))
+        return E.If(guard, var, _null_double())
+
+    def cpu_agg(self):
+        exp = self
+
+        def py(values):
+            nn = [float(v) for v in values if v is not None]
+            n = len(nn)
+            if n <= exp.ddof:
+                return None
+            mean = sum(nn) / n
+            m2 = sum((v - mean) ** 2 for v in nn)
+            return m2 / (n - exp.ddof)
+        return ("_py", py)
+
+
+class VarianceSamp(VariancePop):
+    name = "var_samp"
+    ddof = 1
+
+
+class StddevPop(VariancePop):
+    name = "stddev_pop"
+
+    def evaluate(self, refs):
+        return E.Sqrt(super().evaluate(refs))
+
+    def cpu_agg(self):
+        _f, py = super().cpu_agg()
+
+        def sq(values):
+            v = py(values)
+            return None if v is None else v ** 0.5
+        return ("_py", sq)
+
+
+class StddevSamp(StddevPop):
+    name = "stddev_samp"
+    ddof = 1
+
+
+class _BinaryStatAgg(AggregateFunction):
+    """Base for corr/covar: two children, pairwise-complete rows only."""
+    def __init__(self, x: E.Expression, y: E.Expression):
+        super().__init__(x)
+        self.child2 = y
+
+    def bind(self, schema):
+        import copy
+        b = copy.copy(self)
+        b.child = self.child.bind(schema)
+        b.child2 = self.child2.bind(schema)
+        b._resolve()
+        return b
+
+    def unsupported_reasons(self, conf):
+        out = AggregateFunction.unsupported_reasons(self, conf)
+        out += self.child2.tree_unsupported(conf)
+        return out
+
+    def _resolve(self):
+        self.dtype = t.DOUBLE
+        self.nullable = True
+        xd = _resolved(E.Cast(self.child, t.DOUBLE))
+        yd = _resolved(E.Cast(self.child2, t.DOUBLE))
+        self._x = _masked_pair(xd, self.child2)
+        self._y = _masked_pair(yd, self.child)
+
+    def _pair_count_input(self):
+        # null unless BOTH sides valid -> COUNT counts complete pairs
+        return _resolved(E.Multiply(self._x, self._y))
+
+    def cpu_agg(self):
+        pair = self.cpu_pair_agg()
+        return ("_py", lambda vs: pair([(d["x"], d["y"]) for d in vs]))
+
+    def __repr__(self):
+        return f"{self.name}({self.child!r}, {self.child2!r})"
+
+
+class CovarPop(_BinaryStatAgg):
+    name = "covar_pop"
+    ddof = 0
+
+    def inputs(self):
+        xy = self._pair_count_input()
+        return [xy, self._x, self._y, xy]
+
+    def update_ops(self):
+        return [(G.COUNT, t.LONG), (G.SUM, t.DOUBLE), (G.SUM, t.DOUBLE),
+                (G.SUM, t.DOUBLE)]
+
+    def merge_ops(self):
+        return [(G.SUM, t.LONG)] + [(G.SUM, t.DOUBLE)] * 3
+
+    def evaluate(self, refs):
+        n = E.Cast(refs[0], t.DOUBLE)
+        sx, sy, sxy = refs[1], refs[2], refs[3]
+        num = E.Subtract(sxy, E.Divide(E.Multiply(sx, sy), n))
+        denom = E.Subtract(n, E.Literal(float(self.ddof), t.DOUBLE))
+        cov = E.Divide(num, denom)
+        guard = E.GreaterThan(refs[0], E.Literal(self.ddof, t.LONG))
+        return E.If(guard, cov, _null_double())
+
+    def cpu_pair_agg(self):
+        exp = self
+
+        def py(pairs):
+            nn = [(float(a), float(b)) for a, b in pairs
+                  if a is not None and b is not None]
+            n = len(nn)
+            if n <= exp.ddof:
+                return None
+            mx = sum(a for a, _ in nn) / n
+            my = sum(b for _, b in nn) / n
+            sxy = sum((a - mx) * (b - my) for a, b in nn)
+            return sxy / (n - exp.ddof)
+        return py
+
+
+class CovarSamp(CovarPop):
+    name = "covar_samp"
+    ddof = 1
+
+
+class Corr(_BinaryStatAgg):
+    name = "corr"
+
+    def inputs(self):
+        xy = self._pair_count_input()
+        xx = _resolved(E.Multiply(self._x, self._x))
+        yy = _resolved(E.Multiply(self._y, self._y))
+        return [xy, self._x, self._y, xy, xx, yy]
+
+    def update_ops(self):
+        return [(G.COUNT, t.LONG)] + [(G.SUM, t.DOUBLE)] * 5
+
+    def merge_ops(self):
+        return [(G.SUM, t.LONG)] + [(G.SUM, t.DOUBLE)] * 5
+
+    def evaluate(self, refs):
+        n = E.Cast(refs[0], t.DOUBLE)
+        sx, sy, sxy, sxx, syy = refs[1:6]
+        cov = E.Subtract(sxy, E.Divide(E.Multiply(sx, sy), n))
+        vx = _clamp_nonneg(E.Subtract(sxx, E.Divide(E.Multiply(sx, sx), n)))
+        vy = _clamp_nonneg(E.Subtract(syy, E.Divide(E.Multiply(sy, sy), n)))
+        denom = E.Sqrt(E.Multiply(vx, vy))
+        # zero variance (constant column / single pair): Spark returns NaN,
+        # but Divide maps x/0 to NULL — substitute NaN explicitly
+        corr = E.If(E.EqualTo(denom, E.Literal(0.0, t.DOUBLE)),
+                    E.Literal(float("nan"), t.DOUBLE),
+                    E.Divide(cov, denom))
+        guard = E.GreaterThan(refs[0], E.Literal(0, t.LONG))
+        return E.If(guard, corr, _null_double())
+
+    def cpu_pair_agg(self):
+        def py(pairs):
+            nn = [(float(a), float(b)) for a, b in pairs
+                  if a is not None and b is not None]
+            n = len(nn)
+            if n == 0:
+                return None
+            mx = sum(a for a, _ in nn) / n
+            my = sum(b for _, b in nn) / n
+            sxy = sum((a - mx) * (b - my) for a, b in nn)
+            sxx = sum((a - mx) ** 2 for a, _ in nn)
+            syy = sum((b - my) ** 2 for _, b in nn)
+            d = (sxx * syy) ** 0.5
+            return sxy / d if d else float("nan")
+        return py
+
+
+# ---------------------------------------------------------------------------
+# Collection / distinct / percentile aggregates (CPU fallback first;
+# reference GpuCollectList/Set, count-distinct dedupe, GpuPercentile)
+# ---------------------------------------------------------------------------
+
+class CollectList(AggregateFunction):
+    """collect_list: ArrayType output keeps it on the CPU path for now
+    (device lanes have no ragged representation; reference uses cuDF
+    lists)."""
+    name = "collect_list"
+
+    def _resolve(self):
+        self.dtype = t.ArrayType(self.child.dtype)
+        self.nullable = False
+
+    def inputs(self):
+        return [self.child]
+
+    def unsupported_reasons(self, conf):
+        out = [] if conf.is_op_enabled("expression", type(self).__name__) \
+            else [f"{type(self).__name__} disabled by conf"]
+        out.append("collect aggregates produce ARRAY output "
+                   "(device lanes are flat; CPU path handles this)")
+        return out
+
+    def cpu_agg(self):
+        return ("_py", lambda vs: [v for v in vs if v is not None])
+
+
+class CollectSet(CollectList):
+    name = "collect_set"
+
+    def cpu_agg(self):
+        def py(vs):
+            seen, out = set(), []
+            for v in vs:
+                if v is not None and v not in seen:
+                    seen.add(v)
+                    out.append(v)
+            return out
+        return ("_py", py)
+
+
+class CountDistinct(AggregateFunction):
+    """count(DISTINCT x).  The reference plans this via per-key dedupe;
+    here the CPU path dedupes exactly; a device rewrite (group by
+    (keys, x) then count) can layer on later."""
+    name = "count_distinct"
+
+    def _resolve(self):
+        self.dtype = t.LONG
+        self.nullable = False
+
+    def inputs(self):
+        return [self.child]
+
+    def unsupported_reasons(self, conf):
+        out = AggregateFunction.unsupported_reasons(self, conf)
+        out.append("count(DISTINCT) device rewrite not yet implemented")
+        return out
+
+    def cpu_agg(self):
+        return ("_py", lambda vs: len({v for v in vs if v is not None}))
+
+
+def _percentile_exact(values, p: float):
+    """Spark exact percentile: linear interpolation at (n-1)*p."""
+    nn = sorted(float(v) for v in values if v is not None)
+    if not nn:
+        return None
+    if len(nn) == 1:
+        return nn[0]
+    pos = (len(nn) - 1) * p
+    lo = int(pos)
+    frac = pos - lo
+    hi = min(lo + 1, len(nn) - 1)
+    return nn[lo] * (1 - frac) + nn[hi] * frac
+
+
+class Percentile(AggregateFunction):
+    """percentile(col, p) — exact, CPU path (reference GpuPercentile uses
+    a JNI histogram; device-side sort-based percentile can layer on the
+    sort-segment machinery later)."""
+    name = "percentile"
+
+    def __init__(self, child: E.Expression, percentage: float):
+        super().__init__(child)
+        assert 0.0 <= percentage <= 1.0
+        self.percentage = percentage
+
+    def _resolve(self):
+        self.dtype = t.DOUBLE
+        self.nullable = True
+
+    def inputs(self):
+        return [self.child]
+
+    def unsupported_reasons(self, conf):
+        out = AggregateFunction.unsupported_reasons(self, conf)
+        out.append("percentile runs on the CPU path "
+                   "(device histogram kernel pending)")
+        return out
+
+    def cpu_agg(self):
+        p = self.percentage
+        return ("_py", lambda vs: _percentile_exact(vs, p))
+
+    def __repr__(self):
+        return f"percentile({self.child!r}, {self.percentage})"
+
+
+class ApproximatePercentile(Percentile):
+    """approx_percentile — the exact CPU percentile satisfies the contract
+    (reference uses a t-digest; any value within the rank error is valid,
+    and exact has zero error)."""
+    name = "approx_percentile"
+
+
+class Median(Percentile):
+    name = "median"
+
+    def __init__(self, child: E.Expression):
+        super().__init__(child, 0.5)

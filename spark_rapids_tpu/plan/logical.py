@@ -179,12 +179,27 @@ class LogicalJoin(LogicalPlan):
     """Equi-join on key expression pairs.  join_type: inner, left_outer,
     right_outer, full_outer, left_semi, left_anti, cross."""
 
+    _MIRROR = {"inner": "inner", "left_outer": "right_outer",
+               "right_outer": "left_outer", "full_outer": "full_outer",
+               "cross": "cross"}
+
     def __init__(self, join_type: str, left: LogicalPlan, right: LogicalPlan,
-                 left_keys: Sequence = (), right_keys: Sequence = ()):
+                 left_keys: Sequence = (), right_keys: Sequence = (),
+                 broadcast: Optional[str] = None):
+        """broadcast: None | "left" | "right" — the BROADCAST hint side.
+        A "left" broadcast mirrors the join so the broadcast side becomes
+        the build (right) side; non-mirrorable types (semi/anti) keep the
+        hint only when it already points right."""
+        if broadcast == "left" and join_type in self._MIRROR:
+            left, right = right, left
+            left_keys, right_keys = right_keys, left_keys
+            join_type = self._MIRROR[join_type]
+            broadcast = "right"
         super().__init__(left, right)
         self.join_type = join_type
         self.left_keys = [_as_expr(k) for k in left_keys]
         self.right_keys = [_as_expr(k) for k in right_keys]
+        self.broadcast = broadcast if broadcast == "right" else None
 
     @property
     def left(self):

@@ -138,9 +138,20 @@ for _c in (STR.Length, STR.OctetLength, STR.BitLength, STR.StringLocate,
 for _c in (STR.StartsWith, STR.EndsWith, STR.Contains, STR.Like, STR.RLike):
     expr_rule(_c, t.T.STRING, t.T.BOOLEAN,
               desc="string predicate (device byte kernel)")
+for _c in (STR.RegexpExtract, STR.RegexpReplace):
+    expr_rule(_c, t.T.STRING,
+              desc="regex extract/replace (dictionary transform)")
 
 for _c in (Count, Sum, Min, Max, Average, First, Last, BoolAnd, BoolOr):
     agg_rule(_c, _COMMON, desc="aggregate function")
+
+from .aggregates import (Corr, CovarPop, CovarSamp, StddevPop,  # noqa: E402
+                         StddevSamp, VariancePop, VarianceSamp)
+
+for _c in (VariancePop, VarianceSamp, StddevPop, StddevSamp,
+           Corr, CovarPop, CovarSamp):
+    agg_rule(_c, t.T.NUMERIC, t.T.FP,
+             desc="statistical aggregate (moment sums on device)")
 
 exec_rule(L.LogicalScan, t.T.ALL_SIMPLE, "in-memory scan + device upload")
 exec_rule(L.LogicalProject, _COMMON, "projection")
@@ -447,9 +458,14 @@ class JoinMeta(PlanMeta):
                 f"join type {self.node.join_type} not supported on TPU")
 
     def to_device(self):
+        from ..exec.exchange import BroadcastExchangeExec
         from ..exec.join import CrossJoinExec, HashJoinExec
         left = self._device_child(0)
         right = self._device_child(1)
+        if getattr(self.node, "broadcast", None) == "right":
+            # GpuBroadcastHashJoinExec shape: the build side materializes
+            # once and replays to every consumer / replica
+            right = BroadcastExchangeExec(right)
         if self.node.join_type == "cross":
             return CrossJoinExec(left, right)
         return HashJoinExec(self.node.join_type, self.node.left_keys,

@@ -699,20 +699,36 @@ class Like(ByteKernelExpression):
 
 
 class RLike(ByteKernelExpression):
-    """str RLIKE regex (unanchored find).  Evaluated host-side per
-    dictionary entry via Python `re` — a documented dialect deviation from
-    Java regex (the reference transpiles Java regex to the cuDF dialect and
-    rejects what doesn't map, RegexParser.scala; same contract here)."""
+    """str RLIKE regex (unanchored find).
+
+    Patterns inside the Java-regex DFA subset compile through the
+    transpiler (ops/regex.py — the reference's CudfRegexTranspiler role,
+    RegexParser.scala:687) and run fully on device as a prefix automaton
+    over the dictionary byte tensors.  Rejected patterns fall back to
+    host-side per-dictionary-entry Python `re` (a documented dialect
+    deviation, same transpile-or-fallback contract as the reference)."""
 
     def __init__(self, left, pattern: str):
+        from ..ops.regex import RegexUnsupported, compile_dfa
         self.children = (left,)
         self.pattern = pattern
+        try:
+            self._dfa = compile_dfa(pattern)
+            self._reject = None
+        except RegexUnsupported as e:
+            self._dfa = None
+            self._reject = str(e)
 
     def _resolve(self):
         self.dtype = t.BOOLEAN
         self.nullable = self.children[0].nullable
 
     def _prepare(self, pctx, kids):
+        if self._dfa is not None:
+            self._add_byte_tensors(pctx, kids[0])
+            pctx.add(self, self._dfa.table.T.astype(np.int16))
+            pctx.add(self, self._dfa.accepting)
+            return HostVal()
         import re
         rx = re.compile(self.pattern)
         d = _dict_or_empty(kids[0])
@@ -723,7 +739,12 @@ class RLike(ByteKernelExpression):
         return HostVal()
 
     def _eval_dev(self, ctx, kids):
-        (mask,) = ctx.aux_of(self)
+        if self._dfa is not None:
+            from ..ops.regex import dfa_matches_lanes
+            offsets, bytes_, table_t, accepting = ctx.aux_of(self)
+            mask = dfa_matches_lanes(table_t, accepting, offsets, bytes_)
+        else:
+            (mask,) = ctx.aux_of(self)
         codes = jnp.clip(kids[0].data, 0, mask.shape[0] - 1)
         return DevVal(mask[codes], kids[0].validity, t.BOOLEAN)
 
@@ -737,3 +758,115 @@ class RLike(ByteKernelExpression):
 
     def _fp_extra(self):
         return f"{self.pattern!r}"
+
+
+def _validated_regex(pattern: str):
+    """(compiled python re, subset-reject reason or None).
+
+    The transpiler's subset check (ops/regex.py) decides whether the
+    pattern's semantics agree between Java and Python `re` well enough to
+    run on the device path; rejected patterns are tagged so the operator
+    falls back visibly (dictionary transforms run host-side either way —
+    the tag is about DOCUMENTED dialect, not performance).  A pattern
+    Python cannot compile at all is an analysis error (Spark raises too)."""
+    import re
+    from ..ops.regex import RegexUnsupported, compile_dfa
+    try:
+        rx = re.compile(pattern)
+    except re.error as e:
+        raise ValueError(f"invalid regexp pattern {pattern!r}: {e}") from e
+    try:
+        compile_dfa(pattern)
+        return rx, None
+    except RegexUnsupported as e:
+        return rx, str(e)
+
+
+class RegexpExtract(DictTransform):
+    """regexp_extract(str, pattern, idx): the idx-th group of the first
+    match, "" when no match (Spark semantics).
+
+    Dictionary transform: each distinct value extracts once on host via
+    Python `re` after the Java pattern passes the transpiler's subset
+    check extended with capture groups — group spans themselves cannot
+    come out of the DFA, but validating the pattern against the same
+    subset keeps the dialect contract (documented deviation: evaluation
+    dialect is Python `re` for the accepted subset, where the two agree)."""
+
+    def __init__(self, subject, pattern: str, idx: int = 1):
+        self.children = (subject,)
+        self.pattern = pattern
+        self.idx = idx
+        self._rx, self._subset_reject = _validated_regex(pattern)
+
+    def unsupported_reasons(self, conf):
+        out = super().unsupported_reasons(conf)
+        if self._subset_reject is not None:
+            out.append(f"pattern outside the Java-regex subset "
+                       f"({self._subset_reject}); CPU fallback evaluates "
+                       "in the Python re dialect")
+        if self.idx < 0 or self.idx > self._rx.groups:
+            out.append(f"group index {self.idx} out of range "
+                       f"(pattern has {self._rx.groups})")
+        return out
+
+    def _transform_value(self, s, args):
+        m = self._rx.search(s)
+        if m is None:
+            return ""
+        g = m.group(self.idx)
+        return "" if g is None else g
+
+    def _fp_extra(self):
+        return f"{self.pattern!r};{self.idx}"
+
+
+def _java_replacement_to_python(rep: str) -> str:
+    """Translate a Java replacement string ($N group refs, backslash
+    escapes) to Python re template syntax, where only backslash is
+    special: `\\X` in Java means literal X, so `\\\\` becomes an escaped
+    backslash and every other escaped char is emitted bare."""
+    out = []
+    i = 0
+    while i < len(rep):
+        c = rep[i]
+        if c == "$" and i + 1 < len(rep) and rep[i + 1].isdigit():
+            out.append("\\" + rep[i + 1])
+            i += 2
+        elif c == "\\" and i + 1 < len(rep):
+            nxt = rep[i + 1]
+            out.append("\\\\" if nxt == "\\" else nxt)
+            i += 2
+        elif c == "\\":
+            out.append("\\\\")          # trailing backslash: literal
+            i += 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+class RegexpReplace(DictTransform):
+    """regexp_replace(str, pattern, replacement): replace EVERY match
+    (Spark semantics); Java $N group references in the replacement."""
+
+    def __init__(self, subject, pattern: str, replacement: str):
+        self.children = (subject,)
+        self.pattern = pattern
+        self.replacement = replacement
+        self._rx, self._subset_reject = _validated_regex(pattern)
+        self._py_rep = _java_replacement_to_python(replacement)
+
+    def unsupported_reasons(self, conf):
+        out = super().unsupported_reasons(conf)
+        if self._subset_reject is not None:
+            out.append(f"pattern outside the Java-regex subset "
+                       f"({self._subset_reject}); CPU fallback evaluates "
+                       "in the Python re dialect")
+        return out
+
+    def _transform_value(self, s, args):
+        return self._rx.sub(self._py_rep, s)
+
+    def _fp_extra(self):
+        return f"{self.pattern!r};{self.replacement!r}"

@@ -100,11 +100,16 @@ def gen_tables(scale: float = 0.01, seed: int = 20240706
             pa.int32()).cast(pa.date32()),
         "o_shippriority": pa.array(np.zeros(n_ord, np.int32), pa.int32()),
         "o_orderstatus": pa.array(rng.choice(["F", "O", "P"], n_ord)),
+        "o_orderpriority": pa.array(rng.choice(
+            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED",
+             "5-LOW"], n_ord)),
         "o_totalprice": money_from_cents(
             rng.integers(100_00, 500_000_00, n_ord), 12, 2),
     })
 
     l_ship = rng.integers(o_date_lo, o_date_hi + 122, n_li).astype(np.int32)
+    l_commit = l_ship + rng.integers(-30, 61, n_li).astype(np.int32)
+    l_receipt = l_ship + rng.integers(1, 31, n_li).astype(np.int32)
     rf = rng.choice(["A", "N", "R"], n_li)
     lineitem = pa.table({
         "l_orderkey": pa.array(rng.integers(0, n_ord, n_li), pa.int64()),
@@ -120,6 +125,11 @@ def gen_tables(scale: float = 0.01, seed: int = 20240706
         "l_linestatus": pa.array(np.where(
             l_ship > _days(pydt.date(1995, 6, 17)), "O", "F")),
         "l_shipdate": pa.array(l_ship, pa.int32()).cast(pa.date32()),
+        "l_commitdate": pa.array(l_commit, pa.int32()).cast(pa.date32()),
+        "l_receiptdate": pa.array(l_receipt, pa.int32()).cast(pa.date32()),
+        "l_shipmode": pa.array(rng.choice(
+            ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP",
+             "TRUCK"], n_li)),
     })
     return {"lineitem": lineitem, "orders": orders, "customer": customer,
             "supplier": supplier, "part": part, "nation": nation,
@@ -219,7 +229,141 @@ def q6(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
     return li.filter(cond).agg((Sum(revenue), "revenue"))
 
 
+def q4(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Order priority checking: EXISTS ≡ left-semi join."""
+    d_lo = _days(pydt.date(1993, 7, 1))
+    d_hi = _days(pydt.date(1993, 10, 1))
+    orders = s.from_arrow(t["orders"]).filter(
+        E.And(E.GreaterThanOrEqual(col("o_orderdate"),
+                                   E.Literal(d_lo, DTYPE_DATE)),
+              E.LessThan(col("o_orderdate"), E.Literal(d_hi, DTYPE_DATE))))
+    late = s.from_arrow(t["lineitem"]).filter(
+        E.LessThan(col("l_commitdate"), col("l_receiptdate")))
+    j = orders.join(late, how="left_semi",
+                    left_on=["o_orderkey"], right_on=["l_orderkey"])
+    return (j.group_by("o_orderpriority")
+            .agg((Count(None), "order_count"))
+            .sort("o_orderpriority"))
+
+
+def q10(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Returned item reporting (top 20 customers by lost revenue)."""
+    d_lo = _days(pydt.date(1993, 10, 1))
+    d_hi = _days(pydt.date(1994, 1, 1))
+    cust = s.from_arrow(t["customer"])
+    orders = s.from_arrow(t["orders"]).filter(
+        E.And(E.GreaterThanOrEqual(col("o_orderdate"),
+                                   E.Literal(d_lo, DTYPE_DATE)),
+              E.LessThan(col("o_orderdate"), E.Literal(d_hi, DTYPE_DATE))))
+    li = s.from_arrow(t["lineitem"]).filter(
+        E.EqualTo(col("l_returnflag"), E.Literal("R")))
+    nation = s.from_arrow(t["nation"])
+    j = (cust.join(orders, left_on=["c_custkey"], right_on=["o_custkey"])
+         .join(li, left_on=["o_orderkey"], right_on=["l_orderkey"])
+         .join(nation, left_on=["c_nationkey"], right_on=["n_nationkey"]))
+    revenue = E.Multiply(col("l_extendedprice"),
+                         E.Subtract(E.Literal(1), col("l_discount")))
+    return (j.group_by("c_custkey", "n_name")
+            .agg((Sum(revenue), "revenue"))
+            .sort(("revenue", False, False), ("c_custkey", True, True))
+            .limit(20))
+
+
+def q12(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Shipping modes and order priority (CASE WHEN sums + IN)."""
+    d_lo = _days(pydt.date(1994, 1, 1))
+    d_hi = _days(pydt.date(1995, 1, 1))
+    li = s.from_arrow(t["lineitem"]).filter(E.And(
+        E.And(E.In(col("l_shipmode"), ["MAIL", "SHIP"]),
+              E.And(E.LessThan(col("l_commitdate"), col("l_receiptdate")),
+                    E.LessThan(col("l_shipdate"), col("l_commitdate")))),
+        E.And(E.GreaterThanOrEqual(col("l_receiptdate"),
+                                   E.Literal(d_lo, DTYPE_DATE)),
+              E.LessThan(col("l_receiptdate"),
+                         E.Literal(d_hi, DTYPE_DATE)))))
+    orders = s.from_arrow(t["orders"])
+    j = orders.join(li, left_on=["o_orderkey"], right_on=["l_orderkey"])
+    high = E.CaseWhen(
+        [(E.In(col("o_orderpriority"), ["1-URGENT", "2-HIGH"]),
+          E.Literal(1))], E.Literal(0))
+    low = E.CaseWhen(
+        [(E.In(col("o_orderpriority"), ["1-URGENT", "2-HIGH"]),
+          E.Literal(0))], E.Literal(1))
+    return (j.group_by("l_shipmode")
+            .agg((Sum(high), "high_line_count"),
+                 (Sum(low), "low_line_count"))
+            .sort("l_shipmode"))
+
+
+def q14(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Promotion effect: 100 * promo revenue / total revenue."""
+    from .plan.strings import StartsWith
+    d_lo = _days(pydt.date(1995, 9, 1))
+    d_hi = _days(pydt.date(1995, 10, 1))
+    li = s.from_arrow(t["lineitem"]).filter(
+        E.And(E.GreaterThanOrEqual(col("l_shipdate"),
+                                   E.Literal(d_lo, DTYPE_DATE)),
+              E.LessThan(col("l_shipdate"), E.Literal(d_hi, DTYPE_DATE))))
+    part = s.from_arrow(t["part"])
+    j = li.join(part, left_on=["l_partkey"], right_on=["p_partkey"])
+    revenue = E.Multiply(col("l_extendedprice"),
+                         E.Subtract(E.Literal(1), col("l_discount")))
+    promo = E.CaseWhen([(StartsWith(col("p_type"), "PROMO"), revenue)],
+                       E.Literal(pydec_zero()))
+    agg = j.agg((Sum(promo), "promo"), (Sum(revenue), "total"))
+    ratio = E.Divide(E.Multiply(E.Literal(100.0),
+                                E.Cast(col("promo"), _t.DOUBLE)),
+                     E.Cast(col("total"), _t.DOUBLE))
+    return agg.select(ratio, names=["promo_revenue"])
+
+
+def pydec_zero():
+    import decimal as pydec
+    return pydec.Decimal("0.00")
+
+
+def q17(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Small-quantity-order revenue: correlated avg subquery as a join."""
+    part = s.from_arrow(t["part"]).filter(
+        E.EqualTo(col("p_type"), E.Literal("PROMO BURNISHED NICKEL")))
+    li = s.from_arrow(t["lineitem"])
+    per_part = (li.group_by("l_partkey")
+                .agg((Average(col("l_quantity")), "avg_qty")))
+    per_part = per_part.select(
+        col("l_partkey"), E.Multiply(E.Literal(0.2),
+                                     E.Cast(col("avg_qty"), _t.DOUBLE)),
+        names=["ap_partkey", "qty_limit"])
+    j = (li.join(part, left_on=["l_partkey"], right_on=["p_partkey"])
+         .join(per_part, left_on=["l_partkey"], right_on=["ap_partkey"])
+         .filter(E.LessThan(E.Cast(col("l_quantity"), _t.DOUBLE),
+                            col("qty_limit"))))
+    total = j.agg((Sum(col("l_extendedprice")), "s"))
+    return total.select(
+        E.Divide(E.Cast(col("s"), _t.DOUBLE), E.Literal(7.0)),
+        names=["avg_yearly"])
+
+
+def q18(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Large-volume customers (HAVING sum(qty) > threshold via join)."""
+    li = s.from_arrow(t["lineitem"])
+    big = (li.group_by("l_orderkey")
+           .agg((Sum(col("l_quantity")), "total_qty"))
+           .filter(E.GreaterThan(E.Cast(col("total_qty"), _t.DOUBLE),
+                                 E.Literal(7200.0))))
+    big = big.select(col("l_orderkey"), col("total_qty"),
+                     names=["big_orderkey", "total_qty"])
+    orders = s.from_arrow(t["orders"])
+    cust = s.from_arrow(t["customer"])
+    j = (orders.join(big, left_on=["o_orderkey"], right_on=["big_orderkey"])
+         .join(cust, left_on=["o_custkey"], right_on=["c_custkey"]))
+    return (j.select(col("c_custkey"), col("o_orderkey"), col("o_orderdate"),
+                     col("o_totalprice"), col("total_qty"))
+            .sort(("o_totalprice", False, False), ("o_orderdate", True, True))
+            .limit(100))
+
+
 from . import types as _t           # noqa: E402
 DTYPE_DATE = _t.DATE
 
-QUERIES = {"q1": q1, "q3": q3, "q5": q5, "q6": q6}
+QUERIES = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q10": q10,
+           "q12": q12, "q14": q14, "q17": q17, "q18": q18}

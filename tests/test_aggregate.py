@@ -169,3 +169,160 @@ def test_first_last_bool():
     rows = {r["k"]: (r["fv"], r["ba"], r["bo"])
             for r in got.to_table().to_pylist()}
     assert rows == {1: (3, False, True), 2: (4, True, True)}
+
+
+# ---------------------------------------------------------------------------
+# round-2 aggregate breadth: statistical + collection + percentile
+# ---------------------------------------------------------------------------
+
+def _stat_table(n=1000, seed=5):
+    rng = np.random.default_rng(seed)
+    g = rng.integers(0, 5, n)
+    x = rng.standard_normal(n) * 10 + 3
+    y = x * 0.5 + rng.standard_normal(n)
+    xm = rng.random(n) < 0.1
+    return pa.table({"g": pa.array(g, pa.int32()),
+                     "x": pa.array(np.where(xm, 0, x), mask=xm),
+                     "y": pa.array(y)})
+
+
+def test_statistical_aggregates_device():
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.aggregates import (
+        Corr, CovarPop, CovarSamp, StddevPop, StddevSamp, VariancePop,
+        VarianceSamp)
+    tbl = _stat_table()
+    plan = L.LogicalAggregate(["g"], [
+        (VariancePop(E.ColumnRef("x")), "vp"),
+        (VarianceSamp(E.ColumnRef("x")), "vs"),
+        (StddevPop(E.ColumnRef("x")), "sp"),
+        (StddevSamp(E.ColumnRef("x")), "ss"),
+        (Corr(E.ColumnRef("x"), E.ColumnRef("y")), "cr"),
+        (CovarPop(E.ColumnRef("x"), E.ColumnRef("y")), "cvp"),
+        (CovarSamp(E.ColumnRef("x"), E.ColumnRef("y")), "cvs"),
+    ], L.LogicalScan(tbl))
+    q = apply_overrides(plan)
+    assert q.kind == "device", q.explain()
+    out = q.collect().to_pandas().sort_values("g")
+    df = tbl.to_pandas()
+    for _, row in out.iterrows():
+        sub = df[df["g"] == row["g"]]
+        xs = sub["x"].dropna()
+        pair = sub.dropna(subset=["x", "y"])
+        assert np.isclose(row["vp"], xs.var(ddof=0))
+        assert np.isclose(row["vs"], xs.var(ddof=1))
+        assert np.isclose(row["sp"], xs.std(ddof=0))
+        assert np.isclose(row["ss"], xs.std(ddof=1))
+        assert np.isclose(row["cr"], pair["x"].corr(pair["y"]), rtol=1e-6)
+        assert np.isclose(row["cvp"], pair["x"].cov(pair["y"], ddof=0))
+        assert np.isclose(row["cvs"], pair["x"].cov(pair["y"], ddof=1))
+
+
+def test_stat_aggregates_tiny_groups():
+    # null guards: var_samp/covar_samp null on 1-row groups, corr null/nan
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.aggregates import (Corr, CovarSamp,
+                                                  VarianceSamp)
+    tbl = pa.table({"g": pa.array([1, 2, 2], pa.int32()),
+                    "x": pa.array([5.0, 1.0, 3.0]),
+                    "y": pa.array([2.0, 1.0, 2.0])})
+    plan = L.LogicalAggregate(["g"], [
+        (VarianceSamp(E.ColumnRef("x")), "vs"),
+        (CovarSamp(E.ColumnRef("x"), E.ColumnRef("y")), "cv"),
+        (Corr(E.ColumnRef("x"), E.ColumnRef("y")), "cr"),
+    ], L.LogicalScan(tbl))
+    import pandas as pd
+    out = apply_overrides(plan).collect().to_pandas().sort_values("g")
+    r1 = out[out["g"] == 1].iloc[0]
+    assert pd.isna(r1["vs"]) and pd.isna(r1["cv"])
+    r2 = out[out["g"] == 2].iloc[0]
+    assert np.isclose(r2["vs"], 2.0)     # var([1,3], ddof=1) = 2
+    assert np.isclose(r2["cv"], 1.0)     # cov([1,3],[1,2], ddof=1) = 1
+
+
+def test_collect_countdistinct_percentile_cpu_fallback():
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.aggregates import (CollectList, CollectSet,
+                                                  CountDistinct, Median,
+                                                  Percentile)
+    tbl = _stat_table(400, seed=9)
+    plan = L.LogicalAggregate(["g"], [
+        (CollectList(E.ColumnRef("x")), "cl"),
+        (CollectSet(E.ColumnRef("g")), "cs"),
+        (CountDistinct(E.ColumnRef("x")), "cd"),
+        (Percentile(E.ColumnRef("x"), 0.25), "p25"),
+        (Median(E.ColumnRef("x")), "med"),
+    ], L.LogicalScan(tbl))
+    q = apply_overrides(plan)
+    assert q.kind == "host"        # ARRAY output + CPU-only aggs
+    out = q.collect().to_pandas().sort_values("g")
+    df = tbl.to_pandas()
+    for _, row in out.iterrows():
+        xs = df[df["g"] == row["g"]]["x"].dropna().tolist()
+        assert len(row["cl"]) == len(xs)
+        assert list(row["cs"]) == [row["g"]]
+        assert row["cd"] == len(set(xs))
+        assert np.isclose(row["p25"], np.percentile(xs, 25))
+        assert np.isclose(row["med"], np.percentile(xs, 50))
+
+
+def test_global_stat_aggregates():
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.aggregates import StddevSamp, VariancePop
+    tbl = _stat_table(300, seed=11)
+    plan = L.LogicalAggregate([], [
+        (VariancePop(E.ColumnRef("x")), "vp"),
+        (StddevSamp(E.ColumnRef("x")), "ss"),
+    ], L.LogicalScan(tbl))
+    q = apply_overrides(plan)
+    assert q.kind == "device", q.explain()
+    out = q.collect().to_pandas()
+    xs = tbl.to_pandas()["x"].dropna()
+    assert np.isclose(out["vp"][0], xs.var(ddof=0))
+    assert np.isclose(out["ss"][0], xs.std(ddof=1))
+
+
+def test_stddev_constant_column_zero_not_nan():
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.aggregates import (Corr, StddevPop,
+                                                  VariancePop)
+    tbl = pa.table({"g": pa.array([1] * 100 + [2] * 50, pa.int32()),
+                    "x": pa.array([0.1] * 150),
+                    "y": pa.array(np.arange(150.0))})
+    plan = L.LogicalAggregate(["g"], [
+        (VariancePop(E.ColumnRef("x")), "vp"),
+        (StddevPop(E.ColumnRef("x")), "sp"),
+        (Corr(E.ColumnRef("x"), E.ColumnRef("y")), "cr"),
+    ], L.LogicalScan(tbl))
+    q = apply_overrides(plan)
+    assert q.kind == "device"
+    out = q.collect().to_pandas()
+    assert (out["vp"] >= 0).all()
+    # never NaN/negative: m2 clamped (tiny positive rounding residue ok)
+    assert (out["sp"] >= 0).all() and (out["sp"] < 1e-6).all()
+    assert not out["sp"].isna().any()
+
+
+def test_corr_single_pair_is_nan_not_null():
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.aggregates import Corr
+    tbl = pa.table({"g": pa.array([1, 2, 2], pa.int32()),
+                    "x": pa.array([5.0, 1.0, 3.0]),
+                    "y": pa.array([2.0, 1.0, 2.0])})
+    plan = L.LogicalAggregate(["g"], [(Corr(E.ColumnRef("x"),
+                                            E.ColumnRef("y")), "cr")],
+                              L.LogicalScan(tbl))
+    q = apply_overrides(plan)
+    assert q.kind == "device"
+    out = q.collect()
+    rows = dict(zip(out.column("g").to_pylist(),
+                    out.column("cr").to_pylist()))
+    # single pair: zero variance -> Spark corr = NaN, NOT NULL
+    assert rows[1] is not None and rows[1] != rows[1]
+    assert rows[2] == pytest.approx(1.0)

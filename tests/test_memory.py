@@ -377,3 +377,52 @@ def test_window_minmax_nan_device():
     assert rmx[0] == 1.0 and rmx[1] != rmx[1] and rmx[2] != rmx[2]
     bmx = out["bmx"].tolist()                   # rows [-1, 0]
     assert bmx[0] == 1.0 and bmx[1] != bmx[1] and bmx[2] != bmx[2]
+
+
+def test_ooc_sort_limit_no_spill_leak():
+    """Abandoning a global sort early (LIMIT) must release every
+    registered spillable (review-finding regression)."""
+    n = 20_000
+    rng = np.random.default_rng(23)
+    tbl = pa.table({"v": pa.array(rng.standard_normal(n))})
+    conf = small_conf(budget=1 << 16)
+    ctx = ExecContext(conf)
+    scan = HostScanExec.from_table(tbl, max_rows=1024)
+    s = SortExec([(0, True, True)], scan)
+    it = s.execute(ctx)
+    next(it)
+    it.close()
+    assert ctx.budget.live == 0, "leaked device budget bytes"
+    assert len(ctx.budget._spillables) == 0
+
+
+def test_agg_fallback_limit_no_spill_leak():
+    n = 30_000
+    rng = np.random.default_rng(24)
+    tbl = pa.table({"k": pa.array(rng.permutation(n).astype(np.int64)),
+                    "v": pa.array(np.ones(n))})
+    conf = small_conf(budget=1 << 18)
+    ctx = ExecContext(conf)
+    scan = HostScanExec.from_table(tbl, max_rows=1024)
+    agg = HashAggregateExec([E.ColumnRef("k")], ["k"],
+                            [(Count(None), "c")], scan)
+    it = agg.execute(ctx)
+    next(it)
+    it.close()
+    assert ctx.metrics.get("agg_repartition_fallbacks", 0) >= 1
+    assert ctx.budget.live == 0, "leaked device budget bytes"
+    assert len(ctx.budget._spillables) == 0
+
+
+def test_variance_nan_propagates():
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.aggregates import VariancePop
+    tbl = pa.table({"g": pa.array([1, 1, 1], pa.int32()),
+                    "x": pa.array([1.0, float("nan"), 3.0])})
+    plan = L.LogicalAggregate(["g"], [(VariancePop(E.ColumnRef("x")), "v")],
+                              L.LogicalScan(tbl))
+    q = apply_overrides(plan)
+    assert q.kind == "device"
+    v = q.collect().column("v").to_pylist()[0]
+    assert v is not None and v != v      # NaN, not clamped to 0

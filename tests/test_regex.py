@@ -1,0 +1,159 @@
+"""Regex transpiler + device DFA tests (reference
+RegularExpressionTranspilerSuite role: fuzz the transpiler against the
+host regex engine, assert rejects are clean)."""
+import re
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.ops.regex import (RegexUnsupported, compile_dfa,
+                                        dfa_matches)
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.strings import RegexpExtract, RegexpReplace, RLike
+from spark_rapids_tpu.testing import assert_device_cpu_equal
+
+
+def run_dfa(pattern, strings):
+    import jax.numpy as jnp
+    dfa = compile_dfa(pattern)
+    data = b"".join(s.encode("utf-8") for s in strings)
+    offs = np.zeros(len(strings) + 1, np.int32)
+    for i, s in enumerate(strings):
+        offs[i + 1] = offs[i] + len(s.encode("utf-8"))
+    arr = np.frombuffer(data, np.uint8) if data else np.zeros(0, np.uint8)
+    return np.asarray(dfa_matches(dfa, jnp.asarray(offs),
+                                  jnp.asarray(arr))).tolist()
+
+
+CASES = [
+    (r"abc", ["abc", "xxabcxx", "ab", "ABC", ""]),
+    (r"^abc$", ["abc", "xabc", "abcx", ""]),
+    (r"a+b*c?", ["a", "aab", "bc", "aaabbbc", ""]),
+    (r"[a-f0-9]+", ["deadbeef", "xyz", "a1", ""]),
+    (r"[^0-9]+", ["abc", "123", "a1", "日本"]),
+    (r"(foo|bar)+baz", ["foobaz", "barfoobaz", "baz", "fooba"]),
+    (r"\d{3}-\d{4}", ["555-1234", "5551234", "x555-1234y"]),
+    (r"a.c", ["abc", "a日c", "ac", "a\nc"]),
+    (r"\w+@\w+\.(com|org)", ["x@y.com", "a_b@c.org", "x@y.net", "@.com"]),
+    (r"колбаса", ["колбаса", "не колбаса нет", "kolbasa"]),
+    (r"^$", ["", "x"]),
+    (r"a{2,3}", ["a", "aa", "aaa", "aaaa", "baab"]),
+    (r"^(ab|cd)*$", ["", "ab", "abcd", "abc", "cdab"]),
+    (r"\s+", [" ", "ab", "a b"]),
+    (r"x\.y", ["x.y", "xzy"]),
+]
+
+
+@pytest.mark.parametrize("pattern,strings", CASES)
+def test_dfa_vs_python_re(pattern, strings):
+    got = run_dfa(pattern, strings)
+    exp = [bool(re.search(pattern, s)) for s in strings]
+    assert got == exp, (pattern, got, exp)
+
+
+@pytest.mark.parametrize("pattern", [
+    r"(?=x)a", r"(?!x)a", r"(?<=x)a", r"a*?", r"a+?", r"a??",
+    r"\bword\b", r"(a)\1", r"a(?i)b", r"x{1000}", r"a$b", r"a^b",
+    r"\p{Alpha}", r"[[:digit:]]",
+])
+def test_rejections(pattern):
+    with pytest.raises(RegexUnsupported):
+        compile_dfa(pattern)
+
+
+def test_fuzz_dfa_against_re():
+    """Generated strings over a tiny alphabet vs python re — the
+    RegularExpressionTranspilerSuite fuzz strategy."""
+    rng = np.random.default_rng(17)
+    alphabet = "ab01. "
+    strings = ["".join(rng.choice(list(alphabet), rng.integers(0, 12)))
+               for _ in range(200)]
+    for pattern in [r"a+", r"(a|b)+", r"a.b", r"[ab]+[01]+", r"^a", r"b$",
+                    r"a{2}", r"(a0|b1)*$", r"\d+", r"\s"]:
+        got = run_dfa(pattern, strings)
+        exp = [bool(re.search(pattern, s)) for s in strings]
+        assert got == exp, pattern
+
+
+def test_rlike_device_uses_dfa():
+    r = RLike(E.ColumnRef("s"), r"^ab+c$")
+    assert r._dfa is not None
+    r2 = RLike(E.ColumnRef("s"), r"a*?")     # lazy -> host fallback
+    assert r2._dfa is None and "lazy" in r2._reject
+
+
+def test_rlike_device_vs_cpu():
+    data = {"s": pa.array(["abc", "abbbc", "ab", None, "xabcx", ""])}
+    assert_device_cpu_equal(
+        [RLike(E.ColumnRef("s"), r"^ab+c$"),
+         RLike(E.ColumnRef("s"), r"b+"),
+         RLike(E.ColumnRef("s"), r"a*?")],     # fallback path
+        data)
+
+
+def test_regexp_extract():
+    data = {"s": pa.array(["a123b", "xy", None, "c7d88"])}
+    assert_device_cpu_equal(
+        [RegexpExtract(E.ColumnRef("s"), r"(\d+)", 1),
+         RegexpExtract(E.ColumnRef("s"), r"([a-z])(\d+)", 2),
+         RegexpExtract(E.ColumnRef("s"), r"z(\d+)", 1)],   # no match -> ""
+        data)
+    from spark_rapids_tpu.columnar import HostBatch, to_device
+    from spark_rapids_tpu.exec.evaluator import evaluate_projection
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    from spark_rapids_tpu.columnar.device import to_host
+    db = to_device(HostBatch.from_pydict(data))
+    out = to_host(evaluate_projection(
+        [RegexpExtract(E.ColumnRef("s"), r"(\d+)", 1).bind(db.schema)],
+        ["e"], db, DEFAULT_CONF))
+    assert out.rb.column("e").to_pylist() == ["123", "", None, "7"]
+
+
+def test_regexp_replace():
+    data = {"s": pa.array(["a1b2", "none here", None])}
+    assert_device_cpu_equal(
+        [RegexpReplace(E.ColumnRef("s"), r"\d", "#"),
+         RegexpReplace(E.ColumnRef("s"), r"(\d)", "<$1>")],
+        data)
+    from spark_rapids_tpu.columnar import HostBatch, to_device
+    from spark_rapids_tpu.exec.evaluator import evaluate_projection
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    from spark_rapids_tpu.columnar.device import to_host
+    db = to_device(HostBatch.from_pydict(data))
+    out = to_host(evaluate_projection(
+        [RegexpReplace(E.ColumnRef("s"), r"(\d)", "<$1>").bind(db.schema)],
+        ["r"], db, DEFAULT_CONF))
+    assert out.rb.column("r").to_pylist() == ["a<1>b<2>", "none here", None]
+
+
+def test_java_replacement_backslash():
+    from spark_rapids_tpu.plan.strings import _java_replacement_to_python
+    assert _java_replacement_to_python("\\\\") == "\\\\"      # literal \
+    assert _java_replacement_to_python("$1x") == "\\1x"
+    assert _java_replacement_to_python("\\$") == "$"
+    assert _java_replacement_to_python("a\\nb") == "anb"     # Java: literal n
+    # end-to-end: replace digits with a literal backslash
+    data = {"s": pa.array(["a1b"])}
+    from spark_rapids_tpu.columnar import HostBatch, to_device
+    from spark_rapids_tpu.columnar.device import to_host
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    from spark_rapids_tpu.exec.evaluator import evaluate_projection
+    db = to_device(HostBatch.from_pydict(data))
+    out = to_host(evaluate_projection(
+        [RegexpReplace(E.ColumnRef("s"), r"\d", "\\\\").bind(db.schema)],
+        ["r"], db, DEFAULT_CONF))
+    assert out.rb.column("r").to_pylist() == ["a\\b"]
+
+
+def test_regexp_invalid_pattern_raises():
+    with pytest.raises(ValueError):
+        RegexpExtract(E.ColumnRef("s"), r"(unclosed", 1)
+
+
+def test_regexp_out_of_subset_tagged():
+    # lazy quantifier: valid Python re, outside the Java-subset check
+    r = RegexpReplace(E.ColumnRef("s"), r"a*?", "x")
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    reasons = r.unsupported_reasons(DEFAULT_CONF)
+    assert any("subset" in x for x in reasons)

@@ -106,3 +106,81 @@ def test_q6_device_vs_cpu(tables, session):
             total += price * disc
     got = dev.column("revenue").to_pylist()[0]
     assert got == total.quantize(D("0.0001"))
+
+
+# ---------------------------------------------------------------------------
+# round-2 query breadth: q4, q10, q12, q14, q17, q18
+# ---------------------------------------------------------------------------
+
+def _norm(tbl: pa.Table):
+    cols = tbl.schema.names
+    rows = list(zip(*[tbl.column(c).to_pylist() for c in cols]))
+    return [tuple(float(x) if isinstance(x, pydec.Decimal) else x
+                  for x in r) for r in rows]
+
+
+@pytest.mark.parametrize("qname", ["q4", "q10", "q12", "q14", "q17", "q18"])
+def test_query_device_vs_cpu(qname, tables, session):
+    df = tpch.QUERIES[qname](session, tables)
+    dev = df.collect()
+    cpu = cpu_oracle(tpch.QUERIES[qname](session, tables))
+    got, exp = _norm(dev), _norm(cpu)
+    if qname in ("q14", "q17"):
+        assert len(got) == len(exp) == 1
+        for g, e in zip(got[0], exp[0]):
+            if g is None or e is None:
+                assert g == e
+            else:
+                assert abs(g - e) <= 1e-9 * max(1.0, abs(e))
+    else:
+        assert got == exp, (qname, got[:3], exp[:3])
+
+
+def test_q4_independent_oracle(tables, session):
+    import datetime as _dt
+    dev = tpch.q4(session, tables).collect()
+    orders, li = tables["orders"], tables["lineitem"]
+    d_lo, d_hi = _dt.date(1993, 7, 1), _dt.date(1993, 10, 1)
+    late_orders = {ok for ok, c, r in zip(li["l_orderkey"].to_pylist(),
+                                          li["l_commitdate"].to_pylist(),
+                                          li["l_receiptdate"].to_pylist())
+                   if c < r}
+    import collections
+    cnt = collections.Counter()
+    for ok, od, pri in zip(orders["o_orderkey"].to_pylist(),
+                           orders["o_orderdate"].to_pylist(),
+                           orders["o_orderpriority"].to_pylist()):
+        if d_lo <= od < d_hi and ok in late_orders:
+            cnt[pri] += 1
+    got = dict(zip(dev.column("o_orderpriority").to_pylist(),
+                   dev.column("order_count").to_pylist()))
+    assert got == dict(cnt)
+
+
+def test_q12_independent_oracle(tables, session):
+    import datetime as _dt
+    dev = tpch.q12(session, tables).collect()
+    li, orders = tables["lineitem"], tables["orders"]
+    pri = dict(zip(orders["o_orderkey"].to_pylist(),
+                   orders["o_orderpriority"].to_pylist()))
+    d_lo, d_hi = _dt.date(1994, 1, 1), _dt.date(1995, 1, 1)
+    import collections
+    hi_c, lo_c = collections.Counter(), collections.Counter()
+    for ok, sm, sd, cd, rd in zip(li["l_orderkey"].to_pylist(),
+                                  li["l_shipmode"].to_pylist(),
+                                  li["l_shipdate"].to_pylist(),
+                                  li["l_commitdate"].to_pylist(),
+                                  li["l_receiptdate"].to_pylist()):
+        if sm in ("MAIL", "SHIP") and cd < rd and sd < cd \
+                and d_lo <= rd < d_hi:
+            if pri[ok] in ("1-URGENT", "2-HIGH"):
+                hi_c[sm] += 1
+            else:
+                lo_c[sm] += 1
+    got_hi = dict(zip(dev.column("l_shipmode").to_pylist(),
+                      dev.column("high_line_count").to_pylist()))
+    got_lo = dict(zip(dev.column("l_shipmode").to_pylist(),
+                      dev.column("low_line_count").to_pylist()))
+    for sm in got_hi:
+        assert got_hi[sm] == hi_c.get(sm, 0)
+        assert got_lo[sm] == lo_c.get(sm, 0)
