@@ -231,6 +231,12 @@ METRICS_LEVEL = conf(
     "ESSENTIAL, MODERATE, or DEBUG metric collection per operator.",
     checker=_enum_checker("ESSENTIAL", "MODERATE", "DEBUG"))
 
+PROFILE_PATH = conf(
+    "spark.rapids.tpu.profile.path", "",
+    "When set, wrap query execution in a jax-profiler trace written to "
+    "this directory (the NVTX/CUPTI Profiler analogue; open in "
+    "XProf/perfetto).")
+
 
 class TpuConf:
     """An immutable-ish view over a dict of raw settings with typed access.

@@ -994,3 +994,61 @@ class CpuWindowExec(HostNode):
 
     def describe(self):
         return f"CpuWindowExec[{[n for _, n in self.window_exprs]}]"
+
+
+class CpuGenerateExec(HostNode):
+    """explode / posexplode (+outer): replicate parent rows per array
+    element, appending pos/col columns (GpuGenerateExec semantics:
+    non-outer drops rows whose array is null/empty; outer keeps them with
+    null generated columns)."""
+
+    def __init__(self, generator, output_names, child: HostNode):
+        super().__init__(child)
+        self.generator = generator.bind(child.output_schema)
+        gen_fields = self.generator.output_fields()
+        self.output_names = list(output_names) or \
+            [f.name for f in gen_fields]
+        self._gen_fields = gen_fields
+
+    @property
+    def output_schema(self) -> t.StructType:
+        fields = list(self.child.output_schema.fields)
+        for f, n in zip(self._gen_fields, self.output_names):
+            fields.append(t.StructField(n, f.data_type, f.nullable))
+        return t.StructType(fields)
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        from ..columnar.host import dtype_to_arrow
+        gen = self.generator
+        for rb in self.child.execute(ctx):
+            arrays = CpuAggregateExec._arr(gen.child.eval_cpu(rb),
+                                           rb.num_rows).to_pylist()
+            take_idx: List[int] = []
+            poss: List[Optional[int]] = []
+            vals: List = []
+            for i, arr in enumerate(arrays):
+                if arr is None or len(arr) == 0:
+                    if gen.outer:
+                        take_idx.append(i)
+                        poss.append(None)
+                        vals.append(None)
+                    continue
+                for p, v in enumerate(arr):
+                    take_idx.append(i)
+                    poss.append(p)
+                    vals.append(v)
+            base = rb.take(pa.array(take_idx, pa.int64()))
+            cols = list(base.columns)
+            names = list(base.schema.names)
+            fi = 0
+            if gen.pos:
+                cols.append(pa.array(poss, pa.int32()))
+                names.append(self.output_names[fi])
+                fi += 1
+            et = dtype_to_arrow(gen.child.dtype.element_type)
+            cols.append(pa.array(vals, et))
+            names.append(self.output_names[fi])
+            yield pa.RecordBatch.from_arrays(cols, names=names)
+
+    def describe(self):
+        return f"CpuGenerateExec[{self.generator!r}]"

@@ -1,0 +1,86 @@
+"""Per-operator metrics instrumentation — the GpuMetric / GpuTaskMetrics
+role.
+
+Reference: GpuExec declares metric sets surfaced in the Spark UI
+(GpuExec.scala:49-160: opTime, numOutputRows, ...), GpuTaskMetrics adds
+semaphore-wait / spill / retry accumulators, and NVTX ranges mark
+operator spans for nsys (NvtxWithMetrics.scala).
+
+TPU shape: `instrument(root, ctx)` wraps every PlanNode/HostNode execute
+stream with wall-time + row counters keyed `<ExecName>.op_time_ms` /
+`.output_rows` in ctx.metrics (enabled at metrics level >= OPERATOR), and
+`profile_trace(conf)` wraps a query in a jax-profiler trace (the
+NVTX/CUPTI analogue — open the trace in XProf/perfetto) when
+`spark.rapids.tpu.profile.path` is set."""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager, nullcontext
+
+from ..config import METRICS_LEVEL, PROFILE_PATH, TpuConf
+
+
+def instrument(node, ctx) -> None:
+    """Wrap the execute() of every node in the tree (device and host)
+    with op-time and output-row metrics.  Idempotent per node object."""
+    if getattr(node, "_metered", False):
+        return
+    node._metered = True
+    name = type(node).__name__
+    inner = node.execute
+
+    def metered(c):
+        t0 = time.perf_counter()
+        rows = 0
+        try:
+            it = inner(c)
+            while True:
+                t1 = time.perf_counter()
+                try:
+                    out = next(it)
+                except StopIteration:
+                    return
+                finally:
+                    c.metrics[f"{name}.op_time_ms"] = c.metrics.get(
+                        f"{name}.op_time_ms", 0.0) + \
+                        (time.perf_counter() - t1) * 1000.0
+                n = getattr(out, "num_rows", None)
+                if n is not None:
+                    try:
+                        rows += int(n)
+                    except Exception:       # lazy device count: skip sync
+                        pass
+                yield out
+        finally:
+            c.metrics[f"{name}.total_time_ms"] = c.metrics.get(
+                f"{name}.total_time_ms", 0.0) + \
+                (time.perf_counter() - t0) * 1000.0
+            c.metrics[f"{name}.output_rows"] = c.metrics.get(
+                f"{name}.output_rows", 0) + rows
+
+    node.execute = metered
+    for attr in ("children",):
+        for c in getattr(node, attr, []):
+            instrument(c, ctx)
+    for attr in ("host_child", "device_child"):
+        c = getattr(node, attr, None)
+        if c is not None:
+            instrument(c, ctx)
+
+
+def should_instrument(conf: TpuConf) -> bool:
+    return conf.get(METRICS_LEVEL) in ("MODERATE", "DEBUG")
+
+
+@contextmanager
+def profile_trace(conf: TpuConf):
+    """jax profiler trace around a query when profile.path is set —
+    the NVTX/nsys + built-in Profiler analogue (SURVEY §5 tracing)."""
+    path = conf.get(PROFILE_PATH)
+    if not path:
+        with nullcontext():
+            yield
+        return
+    import jax
+    with jax.profiler.trace(path):
+        yield

@@ -1,0 +1,53 @@
+"""ORC scan + writer.
+
+Reference: GpuOrcScan.scala:76 (same three reader strategies as parquet:
+stripe stitching, protobuf footer rewrite, device decode) and
+GpuOrcFileFormat.  Host decode is pyarrow.orc (stripe-parallel via the
+shared threaded stream), producing the engine's standard host batch
+stream uploaded to device — the same reasoning as io/parquet.py: columnar
+file decode is host work feeding the chip, overlapped with H2D."""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.orc as paorc
+
+from .. import types as t
+from ..columnar.host import schema_to_struct
+from .text import (CpuTextScanExec, TextScanExec, _TextLogicalScan)
+
+
+def _read_orc(path: str, schema, opts) -> pa.Table:
+    f = paorc.ORCFile(path)
+    cols = opts.get("columns")
+    return f.read(columns=cols)
+
+
+class LogicalOrcScan(_TextLogicalScan):
+    reader = staticmethod(_read_orc)
+    fmt = "orc"
+
+    def _resolve_schema(self):
+        if self.arrow_schema is not None:
+            return schema_to_struct(self.arrow_schema)
+        f = paorc.ORCFile(self.paths[0])
+        sch = f.schema
+        cols = self.opts.get("columns")
+        if cols:
+            sch = pa.schema([sch.field(c) for c in cols])
+        return schema_to_struct(sch)
+
+
+class OrcScanExec(TextScanExec):
+    pass
+
+
+class CpuOrcScanExec(CpuTextScanExec):
+    pass
+
+
+def write_orc(table: pa.Table, path: str,
+              compression: str = "zstd") -> None:
+    """Write one ORC file (GpuOrcFileFormat role; host encode)."""
+    paorc.write_table(table, path, compression=compression.upper())

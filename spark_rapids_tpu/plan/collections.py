@@ -1,0 +1,365 @@
+"""Array/collection expressions (reference collectionOperations.scala,
+complexTypeCreator/Extractors).
+
+TPU-first placement decision: device lanes are FLAT (data + validity per
+column; no ragged tensors — SURVEY §7 hard part (c)), so array-typed
+values live only on the CPU side of the plan.  Every expression here
+evaluates through `eval_cpu` over pyarrow and tags itself off-device; the
+overrides engine splices the enclosing operator onto the CPU path with
+transitions, and downstream scalar results return to the device.  This is
+the same per-operator-fallback contract the reference applies to its own
+unsupported type/op combinations (GpuOverrides tagging), applied to a
+whole type family.
+
+Explode/posexplode (the GpuGenerateExec role) live in exec/host_exec.py
+CpuGenerateExec over the LogicalGenerate node.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as t
+from .expressions import Expression, Literal
+
+_OFF_DEVICE = ("ARRAY values live on the CPU path (device lanes are flat)")
+
+
+class ArrayExpression(Expression):
+    """Base: CPU-evaluated; never placed on device."""
+
+    def unsupported_reasons(self, conf):
+        return [_OFF_DEVICE]
+
+    def eval_dev(self, ctx):          # pragma: no cover - tag prevents this
+        raise NotImplementedError(_OFF_DEVICE)
+
+
+class CreateArray(ArrayExpression):
+    """array(e1, e2, ...) — Spark CreateArray."""
+
+    def __init__(self, *items: Expression):
+        self.children = tuple(items)
+
+    def _resolve(self):
+        et = self.children[0].dtype if self.children else t.NULL
+        self.dtype = t.ArrayType(et)
+        self.nullable = False
+
+    def _eval_cpu(self, rb, kids):
+        n = rb.num_rows
+        cols = [k.to_pylist() for k in kids]
+        return pa.array([[c[i] for c in cols] for i in range(n)],
+                        pa.list_(_arrow_elem(self.dtype)))
+
+
+def _arrow_elem(dt: t.ArrayType):
+    from ..columnar.host import dtype_to_arrow
+    return dtype_to_arrow(dt.element_type)
+
+
+class Size(ArrayExpression):
+    """size(array) — Spark: null input -> -1 with legacy conf, null
+    otherwise; modern default (spark.sql.legacy.sizeOfNull=false) -> null."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.INT
+        self.nullable = True
+
+    def _eval_cpu(self, rb, kids):
+        return pc.list_value_length(kids[0]).cast(pa.int32())
+
+
+class GetArrayItem(ArrayExpression):
+    """array[idx] (0-based, Spark GetArrayItem): out-of-range -> null."""
+
+    def __init__(self, child: Expression, index: int):
+        self.children = (child,)
+        self.index = index
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype.element_type
+        self.nullable = True
+
+    def _fp_extra(self):
+        return str(self.index)
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None or self.index < 0 or self.index >= len(v):
+                out.append(None)
+            else:
+                out.append(v[self.index])
+        from ..columnar.host import dtype_to_arrow
+        return pa.array(out, dtype_to_arrow(self.dtype))
+
+
+class ArrayContains(ArrayExpression):
+    """array_contains(arr, value): Spark null semantics — null array ->
+    null; no match with nulls present -> null; else false."""
+
+    def __init__(self, child: Expression, value):
+        self.children = (child,)
+        self.value = value
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+        self.nullable = True
+
+    def _fp_extra(self):
+        return repr(self.value)
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+            elif self.value in [x for x in v if x is not None]:
+                out.append(True)
+            elif any(x is None for x in v):
+                out.append(None)
+            else:
+                out.append(False)
+        return pa.array(out, pa.bool_())
+
+
+class SortArray(ArrayExpression):
+    """sort_array(arr, asc): nulls first when ascending, last when
+    descending (Spark)."""
+
+    def __init__(self, child: Expression, ascending: bool = True):
+        self.children = (child,)
+        self.ascending = ascending
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _fp_extra(self):
+        return str(self.ascending)
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            nn = sorted(x for x in v if x is not None)
+            nulls = [None] * (len(v) - len(nn))
+            if self.ascending:
+                out.append(nulls + nn)
+            else:
+                out.append(list(reversed(nn)) + nulls)
+        return pa.array(out, pa.list_(_arrow_elem(self.dtype)))
+
+
+class ArrayMin(ArrayExpression):
+    name = "array_min"
+    _pick = staticmethod(min)
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype.element_type
+        self.nullable = True
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v in kids[0].to_pylist():
+            nn = [] if v is None else [x for x in v if x is not None]
+            out.append(self._pick(nn) if nn else None)
+        from ..columnar.host import dtype_to_arrow
+        return pa.array(out, dtype_to_arrow(self.dtype))
+
+
+class ArrayMax(ArrayMin):
+    name = "array_max"
+    _pick = staticmethod(max)
+
+
+class ExplodeGen:
+    """Generator spec for LogicalGenerate: explode(col) / posexplode(col).
+    (reference GpuGenerateExec generators, GpuGenerateExec.scala:829)."""
+
+    def __init__(self, child: Expression, pos: bool = False,
+                 outer: bool = False):
+        self.child = child
+        self.pos = pos
+        self.outer = outer
+
+    def bind(self, schema):
+        import copy
+        b = copy.copy(self)
+        b.child = self.child.bind(schema)
+        if not isinstance(b.child.dtype, t.ArrayType):
+            raise TypeError(
+                f"explode requires an array input, got "
+                f"{b.child.dtype.simple_string}")
+        return b
+
+    def output_fields(self) -> List[t.StructField]:
+        et = self.child.dtype.element_type
+        fields = []
+        if self.pos:
+            # outer rows with null/empty arrays carry a NULL pos
+            fields.append(t.StructField("pos", t.INT, self.outer))
+        fields.append(t.StructField("col", et, True))
+        return fields
+
+    def __repr__(self):
+        name = "posexplode" if self.pos else "explode"
+        return f"{name}{'_outer' if self.outer else ''}({self.child!r})"
+
+
+# ---------------------------------------------------------------------------
+# Higher-order functions (reference higherOrderFunctions.scala:
+# transform/filter/exists with bound-lambda batching)
+# ---------------------------------------------------------------------------
+
+class LambdaVar(Expression):
+    """The lambda-bound element variable inside a higher-order body —
+    resolves against the synthetic one-column schema the parent builds."""
+
+    def __init__(self, name: str = "x"):
+        self.children = ()
+        self.name = name
+
+    def bind(self, schema):
+        import copy
+        b = copy.copy(self)
+        f = schema[self.name]
+        b.dtype = f.data_type
+        b.nullable = f.nullable
+        return b
+
+    def _fp_extra(self):
+        return self.name
+
+    def _eval_cpu(self, rb, kids):
+        return rb.column(rb.schema.names.index(self.name))
+
+
+class _HigherOrder(ArrayExpression):
+    """Base: flatten every row's elements into ONE batch, evaluate the
+    lambda body over it vectorized (the reference's bound-lambda batching,
+    higherOrderFunctions.scala), then reassemble per-row results.  Outer
+    column references inside the body are not supported (tagged)."""
+
+    def __init__(self, arr: Expression, body: Expression, var: str = "x"):
+        self.children = (arr,)
+        self.body = body
+        self.var = var
+
+    def bind(self, schema):
+        import copy
+        b = copy.copy(self)
+        b.children = tuple(c.bind(schema) for c in self.children)
+        elem = b.children[0].dtype.element_type
+        lam_schema = t.StructType([t.StructField(b.var, elem, True)])
+        b.body = b.body.bind(lam_schema)
+        b._resolve()
+        return b
+
+    def _fp_extra(self):
+        return f"{self.var};{self.body.fingerprint()}"
+
+    def unsupported_reasons(self, conf):
+        return [_OFF_DEVICE]
+
+    def _flat_eval(self, kids):
+        """(lists, flat body results) for the single array child."""
+        lists = kids[0].to_pylist()
+        flat = [v for row in lists if row is not None for v in row]
+        from ..columnar.host import dtype_to_arrow
+        elem_t = _arrow_elem(self.children[0].dtype)
+        rb = pa.RecordBatch.from_arrays([pa.array(flat, elem_t)],
+                                        names=[self.var])
+        out = self.body.eval_cpu(rb)
+        if isinstance(out, pa.ChunkedArray):
+            out = out.combine_chunks()
+        if isinstance(out, pa.Scalar):
+            out = pa.array([out.as_py()] * rb.num_rows, out.type)
+        return lists, out.to_pylist()
+
+
+class ArrayTransform(_HigherOrder):
+    """transform(arr, x -> body)."""
+
+    def _resolve(self):
+        self.dtype = t.ArrayType(self.body.dtype)
+        self.nullable = self.children[0].nullable
+
+    def _eval_cpu(self, rb, kids):
+        lists, flat = self._flat_eval(kids)
+        from ..columnar.host import dtype_to_arrow
+        out, i = [], 0
+        for row in lists:
+            if row is None:
+                out.append(None)
+            else:
+                out.append(flat[i:i + len(row)])
+                i += len(row)
+        return pa.array(out, pa.list_(dtype_to_arrow(self.body.dtype)))
+
+
+class ArrayFilter(_HigherOrder):
+    """filter(arr, x -> predicate)."""
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _eval_cpu(self, rb, kids):
+        lists, flat = self._flat_eval(kids)
+        out, i = [], 0
+        for row in lists:
+            if row is None:
+                out.append(None)
+            else:
+                keep = flat[i:i + len(row)]
+                i += len(row)
+                out.append([v for v, k in zip(row, keep) if k is True])
+        return pa.array(out, pa.list_(_arrow_elem(self.dtype)))
+
+
+class ArrayExists(_HigherOrder):
+    """exists(arr, x -> predicate): Spark three-valued semantics — true if
+    any true; else null if any null; else false."""
+    _default = False
+    _hit = True
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+        self.nullable = True
+
+    def _eval_cpu(self, rb, kids):
+        lists, flat = self._flat_eval(kids)
+        out, i = [], 0
+        for row in lists:
+            if row is None:
+                out.append(None)
+                continue
+            vals = flat[i:i + len(row)]
+            i += len(row)
+            if self._hit in [bool(v) if v is not None else None
+                             for v in vals]:
+                out.append(self._hit)
+            elif any(v is None for v in vals):
+                out.append(None)
+            else:
+                out.append(self._default)
+        return pa.array(out, pa.bool_())
+
+
+class ArrayForAll(ArrayExists):
+    """forall(arr, x -> predicate): false if any false; else null if any
+    null; else true — the _hit/_default inversion of exists."""
+    _default = True
+    _hit = False

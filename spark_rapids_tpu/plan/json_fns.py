@@ -1,0 +1,106 @@
+"""JSON expressions (reference GpuGetJsonObject / JNI JSONUtils role).
+
+get_json_object evaluates as a dictionary transform (plan/strings.py
+DictTransform): each distinct string parses once on host; device work is
+code/validity pass-through.  The JSONPath subset is `$`, `.field`,
+`['field']`, `[index]` — wildcards and recursive descent are tagged
+unsupported (the transpile-or-reject contract, like the regex engine)."""
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple, Union
+
+from .. import types as t
+from .strings import DictTransform
+
+
+def parse_json_path(path: str) -> Optional[List[Union[str, int]]]:
+    """JSONPath -> list of field/index steps; None when outside the
+    subset."""
+    if not path.startswith("$"):
+        return None
+    steps: List[Union[str, int]] = []
+    i = 1
+    n = len(path)
+    while i < n:
+        c = path[i]
+        if c == ".":
+            i += 1
+            if i < n and path[i] == ".":
+                return None               # recursive descent
+            j = i
+            while j < n and path[j] not in ".[":
+                j += 1
+            name = path[i:j]
+            if not name or name == "*":
+                return None
+            steps.append(name)
+            i = j
+        elif c == "[":
+            j = path.find("]", i)
+            if j < 0:
+                return None
+            inner = path[i + 1:j].strip()
+            if inner.startswith("'") and inner.endswith("'"):
+                steps.append(inner[1:-1])
+            elif inner == "*":
+                return None
+            else:
+                try:
+                    steps.append(int(inner))
+                except ValueError:
+                    return None
+            i = j + 1
+        else:
+            return None
+    return steps
+
+
+def _render(v) -> Optional[str]:
+    """Spark's get_json_object rendering: scalars bare, structures as
+    compact JSON, null -> SQL NULL."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return json.dumps(v)
+    return json.dumps(v, separators=(",", ":"))
+
+
+class GetJsonObject(DictTransform):
+    def __init__(self, child, path: str):
+        self.children = (child,)
+        self.path = path
+        self._steps = parse_json_path(path)
+
+    def unsupported_reasons(self, conf):
+        out = super().unsupported_reasons(conf)
+        if self._steps is None:
+            out.append(f"JSONPath {self.path!r} outside the supported "
+                       "subset ($, .field, ['field'], [index])")
+        return out
+
+    def _fp_extra(self):
+        return repr(self.path)
+
+    def _transform_value(self, s, args):
+        if self._steps is None:
+            return None
+        try:
+            obj = json.loads(s)
+        except (ValueError, TypeError):
+            return None
+        for step in self._steps:
+            if isinstance(step, str):
+                if not isinstance(obj, dict) or step not in obj:
+                    return None
+                obj = obj[step]
+            else:
+                if not isinstance(obj, list) or step >= len(obj) \
+                        or step < -len(obj):
+                    return None
+                obj = obj[step]
+        return _render(obj)

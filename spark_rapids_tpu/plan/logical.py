@@ -285,3 +285,27 @@ class LogicalWindow(LogicalPlan):
 
     def describe(self):
         return f"Window[{[n for _, n in self.window_exprs]}]"
+
+
+class LogicalGenerate(LogicalPlan):
+    """Generator (explode/posexplode) appending generated columns to the
+    child's rows — reference GpuGenerateExec (GpuGenerateExec.scala:829).
+    Runs on the CPU path by placement (array inputs; plan/collections.py)."""
+
+    def __init__(self, generator, child: LogicalPlan,
+                 output_names: Sequence[str] = ()):
+        super().__init__(child)
+        self.generator = generator
+        self.output_names = list(output_names)
+
+    def _resolve_schema(self):
+        bound = self.generator.bind(self.child.schema)
+        fields = list(self.child.schema.fields)
+        gen_fields = bound.output_fields()
+        names = self.output_names or [f.name for f in gen_fields]
+        for f, n in zip(gen_fields, names):
+            fields.append(t.StructField(n, f.data_type, f.nullable))
+        return t.StructType(fields)
+
+    def describe(self):
+        return f"Generate[{self.generator!r}]"

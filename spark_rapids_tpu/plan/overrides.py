@@ -34,6 +34,7 @@ from ..config import ENABLED_FORMATS, TpuConf, DEFAULT_CONF
 from ..exec import host_exec as H
 from ..io.parquet import (CpuParquetScanExec, LogicalParquetScan,
                           ParquetScanExec)
+from ..io.orc import CpuOrcScanExec, LogicalOrcScan, OrcScanExec
 from ..io.text import (CpuTextScanExec, LogicalCsvScan, LogicalJsonScan,
                        TextScanExec)
 from ..exec.plan import (CoalesceBatchesExec, ExecContext, ExpandExec,
@@ -142,6 +143,11 @@ for _c in (STR.RegexpExtract, STR.RegexpReplace):
     expr_rule(_c, t.T.STRING,
               desc="regex extract/replace (dictionary transform)")
 
+from . import json_fns as JSON  # noqa: E402  (registry population)
+
+expr_rule(JSON.GetJsonObject, t.T.STRING,
+          desc="get_json_object (dictionary transform)")
+
 for _c in (Count, Sum, Min, Max, Average, First, Last, BoolAnd, BoolOr):
     agg_rule(_c, _COMMON, desc="aggregate function")
 
@@ -168,6 +174,7 @@ exec_rule(L.LogicalWindow, _COMMON,
 exec_rule(LogicalParquetScan, t.T.ALL_SIMPLE, "parquet scan")
 exec_rule(LogicalCsvScan, t.T.ALL_SIMPLE, "csv scan")
 exec_rule(LogicalJsonScan, t.T.ALL_SIMPLE, "json scan")
+exec_rule(LogicalOrcScan, t.T.ALL_SIMPLE, "orc scan")
 
 
 # ---------------------------------------------------------------------------
@@ -318,6 +325,25 @@ class PlanMeta(BaseMeta):
         kind, node = self.children[i].convert()
         if kind == "device":
             return node
+        # transition pruning: columns whose types device lanes cannot carry
+        # (arrays/maps/structs/binary) are dropped at the upload boundary —
+        # a DEVICE parent can never reference them (TypeSig tagging would
+        # have kept it on the CPU), so only pass-through ballast is cut
+        schema = node.output_schema
+        unrepresentable = (t.ArrayType, t.MapType, t.StructType,
+                           t.BinaryType)
+        keep = [f.name for f in schema.fields
+                if not isinstance(f.data_type, unrepresentable)]
+        if len(keep) != len(schema.fields):
+            exprs = [E.ColumnRef(n) for n in keep]
+            names = list(keep)
+            if not exprs:
+                # a zero-column projection would collapse num_rows to 0;
+                # carry the row count through a synthetic constant column
+                # (device parents resolve columns by name and ignore it)
+                exprs = [E.Literal(0, t.INT)]
+                names = ["__rows__"]
+            node = H.CpuProjectExec(exprs, names, node)
         return H.HostToDeviceExec(node)
 
     def _host_child(self, i: int = 0) -> H.HostNode:
@@ -596,6 +622,22 @@ class WindowMeta(PlanMeta):
                                self.node.order_keys, self._host_child())
 
 
+class GenerateMeta(PlanMeta):
+    """LogicalGenerate: array generators live on the CPU path by placement
+    (plan/collections.py module docs); the meta tags the reason and always
+    converts to CpuGenerateExec with transitions around it."""
+
+    def tag_self(self):
+        self.will_not_work(
+            "explode/posexplode consume ARRAY values "
+            "(device lanes are flat; CPU path with transitions)")
+
+    def to_host(self):
+        return H.CpuGenerateExec(self.node.generator,
+                                 self.node.output_names,
+                                 self._host_child())
+
+
 _META_FOR: Dict[type, Type[PlanMeta]] = {
     L.LogicalScan: ScanMeta,
     L.LogicalProject: ProjectMeta,
@@ -608,9 +650,11 @@ _META_FOR: Dict[type, Type[PlanMeta]] = {
     L.LogicalRange: RangeMeta,
     L.LogicalExpand: ExpandMeta,
     L.LogicalWindow: WindowMeta,
+    L.LogicalGenerate: GenerateMeta,
     LogicalParquetScan: ParquetScanMeta,
     LogicalCsvScan: TextScanMeta,
     LogicalJsonScan: TextScanMeta,
+    LogicalOrcScan: TextScanMeta,
 }
 
 
@@ -651,18 +695,41 @@ class PhysicalQuery:
     def physical_tree(self) -> str:
         return self.root.tree_string()
 
+    def _instrumented(self, ctx: ExecContext):
+        """Shared observability wiring: per-op metrics, profiler trace,
+        concurrency permit, budget counters (GpuTaskMetrics role)."""
+        from contextlib import contextmanager
+        from ..exec.metrics import (instrument, profile_trace,
+                                    should_instrument)
+        from ..runtime.semaphore import device_permit
+
+        @contextmanager
+        def scope():
+            if should_instrument(self.conf):
+                instrument(self.root, ctx)
+            with profile_trace(self.conf), \
+                    device_permit(self.conf, ctx.metrics):
+                yield
+            if ctx._budget is not None:
+                for k, v in ctx.budget.metrics.items():
+                    ctx.metrics[f"memory.{k}"] = v
+        return scope()
+
     def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
         ctx = ctx or ExecContext(self.conf)
-        return self.root.collect(ctx)
+        with self._instrumented(ctx):
+            return self.root.collect(ctx)
 
     def execute_host_batches(self, ctx: Optional[ExecContext] = None):
-        """Stream results as pyarrow RecordBatches."""
+        """Stream results as pyarrow RecordBatches (same permit/metrics
+        scope as collect — the permit is held while the stream drains)."""
         ctx = ctx or ExecContext(self.conf)
         if self.kind == "device":
             node = H.DeviceToHostExec(self.root)
         else:
             node = self.root
-        yield from node.execute(ctx)
+        with self._instrumented(ctx):
+            yield from node.execute(ctx)
 
 
 def _push_down_filters(plan: L.LogicalPlan) -> None:

@@ -1,0 +1,53 @@
+"""Concurrency throttle — the GpuSemaphore role.
+
+Reference: GpuSemaphore.scala:51 — `spark.rapids.sql.concurrentGpuTasks`
+(default 2) tasks hold permits before touching the device, so concurrent
+tasks cannot collectively exceed device memory; permits release around
+host-only phases.
+
+TPU shape: one process-wide semaphore sized by
+`spark.rapids.tpu.sql.concurrentTpuTasks`; each running query (and each
+shuffle/scan worker doing device uploads) holds a permit for the duration
+of its device work.  The memory budget (runtime/memory.py) bounds bytes;
+the semaphore bounds concurrent *holders*, which is what keeps worst-case
+transient allocations (K concurrent programs' scratch) in check."""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+from ..config import CONCURRENT_TPU_TASKS, TpuConf
+
+_LOCK = threading.Lock()
+_SEM: Optional[threading.BoundedSemaphore] = None
+_SIZE: Optional[int] = None
+
+
+def _semaphore(conf: TpuConf) -> threading.BoundedSemaphore:
+    global _SEM, _SIZE
+    n = conf.get(CONCURRENT_TPU_TASKS)
+    with _LOCK:
+        if _SEM is None or _SIZE != n:
+            _SEM = threading.BoundedSemaphore(n)
+            _SIZE = n
+        return _SEM
+
+
+@contextmanager
+def device_permit(conf: TpuConf, metrics: Optional[dict] = None):
+    """Hold one device permit; blocks when concurrentTpuTasks are active.
+    Time spent blocked is surfaced as the semaphore-wait metric
+    (GpuTaskMetrics semaphore-wait analogue)."""
+    import time
+    sem = _semaphore(conf)
+    t0 = time.perf_counter()
+    sem.acquire()
+    waited = time.perf_counter() - t0
+    if metrics is not None:
+        metrics["semaphore_wait_ms"] = metrics.get(
+            "semaphore_wait_ms", 0.0) + waited * 1000.0
+    try:
+        yield
+    finally:
+        sem.release()

@@ -1,0 +1,161 @@
+"""Array expressions + explode/posexplode (reference
+collectionOperations.scala + GpuGenerateExec role).
+
+Array values live on the CPU path by placement; these tests assert both
+the CPU semantics and that the overrides engine splices generators/array
+expressions onto the CPU path with working transitions back to device."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.collections import (ArrayContains, ArrayMax,
+                                               ArrayMin, CreateArray,
+                                               ExplodeGen, GetArrayItem,
+                                               Size, SortArray)
+from spark_rapids_tpu.plan.overrides import apply_overrides
+from spark_rapids_tpu.plan.aggregates import Count, Sum
+
+
+def arr_table():
+    return pa.table({
+        "a": pa.array([[1, 2, 3], [], None, [5, None], [7]],
+                      pa.list_(pa.int64())),
+        "k": pa.array([1, 2, 3, 4, 5], pa.int64()),
+    })
+
+
+def test_explode():
+    plan = L.LogicalGenerate(ExplodeGen(E.ColumnRef("a")),
+                             L.LogicalScan(arr_table()), ["v"])
+    q = apply_overrides(plan)
+    assert q.kind == "host"
+    out = q.collect()
+    assert out.column("k").to_pylist() == [1, 1, 1, 4, 4, 5]
+    assert out.column("v").to_pylist() == [1, 2, 3, 5, None, 7]
+
+
+def test_explode_outer():
+    plan = L.LogicalGenerate(ExplodeGen(E.ColumnRef("a"), outer=True),
+                             L.LogicalScan(arr_table()), ["v"])
+    out = apply_overrides(plan).collect()
+    assert out.column("k").to_pylist() == [1, 1, 1, 2, 3, 4, 4, 5]
+    assert out.column("v").to_pylist() == [1, 2, 3, None, None, 5, None, 7]
+
+
+def test_posexplode():
+    plan = L.LogicalGenerate(ExplodeGen(E.ColumnRef("a"), pos=True),
+                             L.LogicalScan(arr_table()), ["p", "v"])
+    out = apply_overrides(plan).collect()
+    assert out.column("p").to_pylist() == [0, 1, 2, 0, 1, 0]
+    assert out.column("v").to_pylist() == [1, 2, 3, 5, None, 7]
+
+
+def test_explode_then_device_aggregate():
+    """Post-explode scalar rows return to the device path."""
+    plan = L.LogicalAggregate(
+        ["k"], [(Sum(E.ColumnRef("v")), "s"), (Count(None), "c")],
+        L.LogicalGenerate(ExplodeGen(E.ColumnRef("a")),
+                          L.LogicalScan(arr_table()), ["v"]))
+    q = apply_overrides(plan)
+    tree = q.root.tree_string()
+    assert "HashAggregateExec" in tree          # device agg
+    assert "HostToDeviceExec" in tree           # transition inserted
+    out = q.collect()
+    rows = {k: (s, c) for k, s, c in zip(out.column("k").to_pylist(),
+                                         out.column("s").to_pylist(),
+                                         out.column("c").to_pylist())}
+    assert rows == {1: (6, 3), 4: (5, 2), 5: (7, 1)}
+
+
+def test_array_expressions():
+    tbl = arr_table()
+    plan = L.LogicalProject(
+        [Size(E.ColumnRef("a")),
+         GetArrayItem(E.ColumnRef("a"), 1),
+         ArrayContains(E.ColumnRef("a"), 2),
+         ArrayMin(E.ColumnRef("a")),
+         ArrayMax(E.ColumnRef("a")),
+         SortArray(E.ColumnRef("a"), False)],
+        L.LogicalScan(tbl),
+        names=["sz", "it", "ct", "mn", "mx", "sa"])
+    q = apply_overrides(plan)
+    assert q.kind == "host"
+    out = q.collect()
+    assert out.column("sz").to_pylist() == [3, 0, None, 2, 1]
+    assert out.column("it").to_pylist() == [2, None, None, None, None]
+    # contains: [1,2,3] has 2 -> True; [] -> False; None -> None;
+    # [5,None]: no 2 but null present -> None; [7] -> False
+    assert out.column("ct").to_pylist() == [True, False, None, None, False]
+    assert out.column("mn").to_pylist() == [1, None, None, 5, 7]
+    assert out.column("mx").to_pylist() == [3, None, None, 5, 7]
+    assert out.column("sa").to_pylist() == \
+        [[3, 2, 1], [], None, [5, None], [7]]
+
+
+def test_create_array_roundtrip():
+    tbl = pa.table({"x": pa.array([1, 2], pa.int64()),
+                    "y": pa.array([10, None], pa.int64())})
+    plan = L.LogicalProject(
+        [CreateArray(E.ColumnRef("x"), E.ColumnRef("y"))],
+        L.LogicalScan(tbl), names=["arr"])
+    out = apply_overrides(plan).collect()
+    assert out.column("arr").to_pylist() == [[1, 10], [2, None]]
+
+
+def test_explode_non_array_raises():
+    tbl = pa.table({"x": pa.array([1], pa.int64())})
+    plan = L.LogicalGenerate(ExplodeGen(E.ColumnRef("x")),
+                             L.LogicalScan(tbl), ["v"])
+    with pytest.raises(TypeError):
+        plan.schema
+
+
+def test_device_count_over_array_only_child():
+    """Transition pruning must not collapse row counts when every child
+    column is unrepresentable (review-finding regression)."""
+    tbl = pa.table({"a": pa.array([[1], [2, 3], None],
+                                  pa.list_(pa.int64()))})
+    plan = L.LogicalAggregate([], [(Count(None), "c")],
+                              L.LogicalScan(tbl))
+    q = apply_overrides(plan)
+    out = q.collect()
+    assert out.column("c").to_pylist() == [3]
+
+
+def test_posexplode_outer_pos_nullable():
+    plan = L.LogicalGenerate(
+        ExplodeGen(E.ColumnRef("a"), pos=True, outer=True),
+        L.LogicalScan(arr_table()), ["p", "v"])
+    assert plan.schema["p"].nullable
+    out = apply_overrides(plan).collect()
+    assert out.column("p").to_pylist() == [0, 1, 2, None, None, 0, 1, 0]
+
+
+def test_higher_order_transform_filter():
+    from spark_rapids_tpu.plan.collections import (ArrayExists, ArrayFilter,
+                                                   ArrayForAll,
+                                                   ArrayTransform, LambdaVar)
+    tbl = pa.table({"a": pa.array([[1, 2, 3], [], None, [4, None]],
+                                  pa.list_(pa.int64()))})
+    x = LambdaVar("x")
+    plan = L.LogicalProject(
+        [ArrayTransform(E.ColumnRef("a"),
+                        E.Multiply(x, E.Literal(10, None))),
+         ArrayFilter(E.ColumnRef("a"),
+                     E.GreaterThan(x, E.Literal(1, None))),
+         ArrayExists(E.ColumnRef("a"),
+                     E.GreaterThan(x, E.Literal(2, None))),
+         ArrayForAll(E.ColumnRef("a"),
+                     E.GreaterThan(x, E.Literal(0, None)))],
+        L.LogicalScan(tbl), names=["tr", "fl", "ex", "fa"])
+    q = apply_overrides(plan)
+    assert q.kind == "host"
+    out = q.collect()
+    assert out.column("tr").to_pylist() == \
+        [[10, 20, 30], [], None, [40, None]]
+    assert out.column("fl").to_pylist() == [[2, 3], [], None, [4]]
+    assert out.column("ex").to_pylist() == [True, False, None, True]
+    # forall over [4, None]: no false, a null -> null
+    assert out.column("fa").to_pylist() == [True, True, None, None]

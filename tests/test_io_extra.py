@@ -1,0 +1,106 @@
+"""ORC scan/write + JSON expression tests (round-2 format growth)."""
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.json_fns import GetJsonObject, parse_json_path
+from spark_rapids_tpu.plan.overrides import apply_overrides
+
+
+@pytest.fixture()
+def orc_file(tmp_path):
+    from spark_rapids_tpu.io.orc import write_orc
+    rng = np.random.default_rng(7)
+    tbl = pa.table({
+        "a": pa.array(rng.integers(0, 100, 500), pa.int64()),
+        "b": pa.array(rng.standard_normal(500)),
+        "s": pa.array([f"v{i % 7}" for i in range(500)]),
+    })
+    path = str(tmp_path / "t.orc")
+    write_orc(tbl, path)
+    return path, tbl
+
+
+def test_orc_scan_device(orc_file):
+    from spark_rapids_tpu.io.orc import LogicalOrcScan
+    from spark_rapids_tpu.plan.aggregates import Count, Sum
+    path, tbl = orc_file
+    plan = L.LogicalAggregate(
+        ["s"], [(Sum(E.ColumnRef("a")), "sa"), (Count(None), "c")],
+        LogicalOrcScan([path]))
+    q = apply_overrides(plan)
+    assert q.kind == "device", q.explain()
+    out = q.collect()
+    df = tbl.to_pandas()
+    exp = df.groupby("s")["a"].sum().to_dict()
+    got = dict(zip(out.column("s").to_pylist(),
+                   out.column("sa").to_pylist()))
+    assert got == exp
+
+
+def test_orc_scan_cpu_fallback_conf(orc_file):
+    from spark_rapids_tpu.io.orc import LogicalOrcScan
+    from spark_rapids_tpu.config import TpuConf
+    path, tbl = orc_file
+    conf = TpuConf({"spark.rapids.tpu.sql.format.orc.enabled": False})
+    plan = L.LogicalFilter(E.GreaterThan(E.ColumnRef("a"), E.Literal(50)),
+                           LogicalOrcScan([path]))
+    q = apply_overrides(plan, conf)
+    assert "orc scan disabled" in " ".join(q.meta.children[0].reasons)
+    out = q.collect()
+    assert out.num_rows == (tbl.to_pandas()["a"] > 50).sum()
+
+
+def test_orc_column_projection(orc_file, tmp_path):
+    from spark_rapids_tpu.io.orc import LogicalOrcScan
+    path, tbl = orc_file
+    plan = LogicalOrcScan([path], opts={"columns": ["a"]})
+    assert plan.schema.names == ["a"]
+
+
+def test_json_path_parser():
+    assert parse_json_path("$.a.b") == ["a", "b"]
+    assert parse_json_path("$[2]") == [2]
+    assert parse_json_path("$.a[0].b") == ["a", 0, "b"]
+    assert parse_json_path("$['k y']") == ["k y"]
+    assert parse_json_path("$..a") is None
+    assert parse_json_path("$.a[*]") is None
+    assert parse_json_path("a.b") is None
+
+
+def test_get_json_object():
+    rows = [json.dumps({"a": {"b": 1.5}, "l": [10, {"x": "s"}],
+                        "t": True, "s": "plain", "n": None}),
+            "not json", None, json.dumps({"a": {}})]
+    tbl = pa.table({"j": pa.array(rows, pa.string())})
+    plan = L.LogicalProject(
+        [GetJsonObject(E.ColumnRef("j"), "$.a.b"),
+         GetJsonObject(E.ColumnRef("j"), "$.l[1].x"),
+         GetJsonObject(E.ColumnRef("j"), "$.t"),
+         GetJsonObject(E.ColumnRef("j"), "$.s"),
+         GetJsonObject(E.ColumnRef("j"), "$.a"),
+         GetJsonObject(E.ColumnRef("j"), "$.missing")],
+        L.LogicalScan(tbl),
+        names=["b", "lx", "t", "s", "a", "m"])
+    q = apply_overrides(plan)
+    assert q.kind == "device", q.explain()
+    out = q.collect()
+    assert out.column("b").to_pylist() == ["1.5", None, None, None]
+    assert out.column("lx").to_pylist() == ["s", None, None, None]
+    assert out.column("t").to_pylist() == ["true", None, None, None]
+    assert out.column("s").to_pylist() == ["plain", None, None, None]
+    assert out.column("a").to_pylist() == ['{"b":1.5}', None, None, "{}"]
+    assert out.column("m").to_pylist() == [None, None, None, None]
+
+
+def test_get_json_object_wildcard_tagged():
+    tbl = pa.table({"j": pa.array(['{"a":[1]}'])})
+    plan = L.LogicalProject([GetJsonObject(E.ColumnRef("j"), "$.a[*]")],
+                            L.LogicalScan(tbl), names=["x"])
+    q = apply_overrides(plan)
+    assert q.kind == "host"
+    assert any("subset" in r for r in q.meta.reasons)
