@@ -21,7 +21,12 @@ from .text import (CpuTextScanExec, TextScanExec, _TextLogicalScan)
 def _read_orc(path: str, schema, opts) -> pa.Table:
     f = paorc.ORCFile(path)
     cols = opts.get("columns")
-    return f.read(columns=cols)
+    if cols is None and schema is not None:
+        cols = list(schema.names)
+    tbl = f.read(columns=cols)
+    if schema is not None:
+        tbl = tbl.select(schema.names).cast(schema)
+    return tbl
 
 
 class LogicalOrcScan(_TextLogicalScan):

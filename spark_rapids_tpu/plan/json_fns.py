@@ -14,11 +14,16 @@ from .. import types as t
 from .strings import DictTransform
 
 
-def parse_json_path(path: str) -> Optional[List[Union[str, int]]]:
+INVALID_PATH = "INVALID"    # Spark rejects it -> always-NULL, no fallback
+
+
+def parse_json_path(path: str) -> Union[None, str,
+                                        List[Union[str, int]]]:
     """JSONPath -> list of field/index steps; None when outside the
-    subset."""
+    subset (tagged for fallback); INVALID_PATH when Spark itself rejects
+    the path (always-NULL results, no fallback tag)."""
     if not path.startswith("$"):
-        return None
+        return INVALID_PATH
     steps: List[Union[str, int]] = []
     i = 1
     n = len(path)
@@ -47,9 +52,14 @@ def parse_json_path(path: str) -> Optional[List[Union[str, int]]]:
                 return None
             else:
                 try:
-                    steps.append(int(inner))
+                    idx = int(inner)
                 except ValueError:
                     return None
+                if idx < 0:
+                    # Spark's path grammar rejects negative subscripts
+                    # (get_json_object returns NULL for them)
+                    return INVALID_PATH
+                steps.append(idx)
             i = j + 1
         else:
             return None
@@ -87,7 +97,7 @@ class GetJsonObject(DictTransform):
         return repr(self.path)
 
     def _transform_value(self, s, args):
-        if self._steps is None:
+        if self._steps is None or self._steps == INVALID_PATH:
             return None
         try:
             obj = json.loads(s)

@@ -87,6 +87,8 @@ def exec_rule(cls, output_sig, desc=""):
 
 _NUM_BOOL = t.T.NUMERIC + t.T.BOOLEAN + t.T.NULL
 _COMMON = t.T.DEVICE_COMMON
+# every device-representable simple type — NO BINARY (no device lane for it)
+_DEVICE_SIMPLE = t.T.NUMERIC + t.T.STRING + t.T.BOOLEAN + t.T.DATETIME + t.T.NULL
 
 expr_rule(E.ColumnRef, _COMMON, desc="column reference")
 expr_rule(E.Literal, _COMMON + t.T.NULL, desc="literal value")
@@ -148,6 +150,14 @@ from . import json_fns as JSON  # noqa: E402  (registry population)
 expr_rule(JSON.GetJsonObject, t.T.STRING,
           desc="get_json_object (dictionary transform)")
 
+from . import udf as UDF  # noqa: E402  (registry population)
+
+expr_rule(UDF.TpuUDF, t.T.NUMERIC + t.T.BOOLEAN + t.T.DATETIME,
+          desc="jax-traceable columnar UDF (fuses into the operator "
+               "program)")
+expr_rule(UDF.PythonUDF, t.T.ALL_SIMPLE,
+          desc="row-at-a-time python UDF (always CPU path)")
+
 for _c in (Count, Sum, Min, Max, Average, First, Last, BoolAnd, BoolOr):
     agg_rule(_c, _COMMON, desc="aggregate function")
 
@@ -159,27 +169,51 @@ for _c in (VariancePop, VarianceSamp, StddevPop, StddevSamp,
     agg_rule(_c, t.T.NUMERIC, t.T.FP,
              desc="statistical aggregate (moment sums on device)")
 
-exec_rule(L.LogicalScan, t.T.ALL_SIMPLE, "in-memory scan + device upload")
+exec_rule(L.LogicalScan, _DEVICE_SIMPLE, "in-memory scan + device upload")
 exec_rule(L.LogicalProject, _COMMON, "projection")
-exec_rule(L.LogicalFilter, t.T.ALL_SIMPLE, "filter")
+exec_rule(L.LogicalFilter, _DEVICE_SIMPLE, "filter")
 exec_rule(L.LogicalAggregate, _COMMON, "hash aggregate")
 exec_rule(L.LogicalSort, t.T.ORDERABLE, "sort")
-exec_rule(L.LogicalLimit, t.T.ALL_SIMPLE, "limit")
+exec_rule(L.LogicalLimit, _DEVICE_SIMPLE, "limit")
 exec_rule(L.LogicalJoin, _COMMON, "hash join")
-exec_rule(L.LogicalUnion, t.T.ALL_SIMPLE, "union")
-exec_rule(L.LogicalRange, t.T.ALL_SIMPLE, "range generator")
+exec_rule(L.LogicalUnion, _DEVICE_SIMPLE, "union")
+exec_rule(L.LogicalRange, _DEVICE_SIMPLE, "range generator")
 exec_rule(L.LogicalExpand, _COMMON, "expand (grouping sets)")
 exec_rule(L.LogicalWindow, _COMMON,
           "window functions (partition-sorted segmented scans)")
-exec_rule(LogicalParquetScan, t.T.ALL_SIMPLE, "parquet scan")
-exec_rule(LogicalCsvScan, t.T.ALL_SIMPLE, "csv scan")
-exec_rule(LogicalJsonScan, t.T.ALL_SIMPLE, "json scan")
-exec_rule(LogicalOrcScan, t.T.ALL_SIMPLE, "orc scan")
+exec_rule(LogicalParquetScan, _DEVICE_SIMPLE, "parquet scan")
+exec_rule(LogicalCsvScan, _DEVICE_SIMPLE, "csv scan")
+exec_rule(LogicalJsonScan, _DEVICE_SIMPLE, "json scan")
+exec_rule(LogicalOrcScan, _DEVICE_SIMPLE, "orc scan")
 
 
 # ---------------------------------------------------------------------------
 # Meta hierarchy
 # ---------------------------------------------------------------------------
+
+def _host_to_device(node: "H.HostNode") -> PlanNode:
+    """Wrap a CPU node for a device parent, pruning columns whose types
+    device lanes cannot carry (arrays/maps/structs/binary).  Safe because
+    no DEVICE exec's output signature admits those types (the device exec
+    rules use _DEVICE_SIMPLE / _COMMON), so a device parent that needed
+    such a column was itself tagged onto the CPU — only pass-through
+    ballast is cut here."""
+    schema = node.output_schema
+    unrepresentable = (t.ArrayType, t.MapType, t.StructType, t.BinaryType)
+    keep = [f.name for f in schema.fields
+            if not isinstance(f.data_type, unrepresentable)]
+    if len(keep) != len(schema.fields):
+        exprs = [E.ColumnRef(n) for n in keep]
+        names = list(keep)
+        if not exprs:
+            # a zero-column projection would collapse num_rows to 0;
+            # carry the row count through a synthetic constant column
+            # (device parents resolve columns by name and ignore it)
+            exprs = [E.Literal(0, t.INT)]
+            names = ["__rows__"]
+        node = H.CpuProjectExec(exprs, names, node)
+    return H.HostToDeviceExec(node)
+
 
 class BaseMeta:
     def __init__(self, conf: TpuConf):
@@ -325,26 +359,7 @@ class PlanMeta(BaseMeta):
         kind, node = self.children[i].convert()
         if kind == "device":
             return node
-        # transition pruning: columns whose types device lanes cannot carry
-        # (arrays/maps/structs/binary) are dropped at the upload boundary —
-        # a DEVICE parent can never reference them (TypeSig tagging would
-        # have kept it on the CPU), so only pass-through ballast is cut
-        schema = node.output_schema
-        unrepresentable = (t.ArrayType, t.MapType, t.StructType,
-                           t.BinaryType)
-        keep = [f.name for f in schema.fields
-                if not isinstance(f.data_type, unrepresentable)]
-        if len(keep) != len(schema.fields):
-            exprs = [E.ColumnRef(n) for n in keep]
-            names = list(keep)
-            if not exprs:
-                # a zero-column projection would collapse num_rows to 0;
-                # carry the row count through a synthetic constant column
-                # (device parents resolve columns by name and ignore it)
-                exprs = [E.Literal(0, t.INT)]
-                names = ["__rows__"]
-            node = H.CpuProjectExec(exprs, names, node)
-        return H.HostToDeviceExec(node)
+        return _host_to_device(node)
 
     def _host_child(self, i: int = 0) -> H.HostNode:
         kind, node = self.children[i].convert()
@@ -507,7 +522,7 @@ class UnionMeta(PlanMeta):
     def convert(self):
         kids = [c.convert() for c in self.children]
         if self.can_replace and not self.conf.explain_only:
-            dev = [k if kind == "device" else H.HostToDeviceExec(k)
+            dev = [k if kind == "device" else _host_to_device(k)
                    for kind, k in kids]
             return "device", UnionExec(*dev)
         host = [k if kind == "host" else H.DeviceToHostExec(k)

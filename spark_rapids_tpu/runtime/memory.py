@@ -211,14 +211,18 @@ class Spillable:
             self._budget.host_reserve(hb.rb.nbytes)
 
     def to_disk(self):
-        """host -> disk tier (Arrow IPC file)."""
+        """host -> disk tier: Arrow IPC payload inside a checksummed
+        native block (native/spillio.cpp — the RapidsDiskStore writes;
+        the C write path releases the GIL under spill worker threads)."""
         if self._hb is None:
             return
+        from .. import native
         path = os.path.join(self._budget.disk_dir(),
-                            f"spill_{self._sid}.arrow")
-        with pa.OSFile(path, "wb") as f:
-            with pa.ipc.new_file(f, self._hb.rb.schema) as w:
-                w.write_batch(self._hb.rb)
+                            f"spill_{self._sid}.blk")
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, self._hb.rb.schema) as w:
+            w.write_batch(self._hb.rb)
+        native.spill_write(path, sink.getvalue())   # zero-copy pa.Buffer
         self._budget.host_release(self._hb.rb.nbytes)
         self._budget.metrics["disk_batches"] += 1
         self._hb = None
@@ -250,8 +254,10 @@ class Spillable:
         if self._hb is not None:
             return self._hb
         assert self._path is not None, "spillable lost all tiers"
-        with pa.OSFile(self._path, "rb") as f:
-            rb = pa.ipc.open_file(f).get_batch(0)
+        from .. import native
+        payload = native.spill_read(self._path)     # checksum-verified
+        reader = pa.ipc.open_stream(pa.BufferReader(payload))
+        rb = reader.read_next_batch()
         return HostBatch(rb)
 
     def close(self):

@@ -20,18 +20,18 @@ from typing import Optional
 from ..config import CONCURRENT_TPU_TASKS, TpuConf
 
 _LOCK = threading.Lock()
-_SEM: Optional[threading.BoundedSemaphore] = None
-_SIZE: Optional[int] = None
+_SEMS: dict = {}        # size -> semaphore: stable per configured size
 
 
 def _semaphore(conf: TpuConf) -> threading.BoundedSemaphore:
-    global _SEM, _SIZE
+    """One stable semaphore per configured size — rebuilding on a size
+    change would hand fresh unblocked permits to in-flight holders."""
     n = conf.get(CONCURRENT_TPU_TASKS)
     with _LOCK:
-        if _SEM is None or _SIZE != n:
-            _SEM = threading.BoundedSemaphore(n)
-            _SIZE = n
-        return _SEM
+        sem = _SEMS.get(n)
+        if sem is None:
+            sem = _SEMS[n] = threading.BoundedSemaphore(n)
+        return sem
 
 
 @contextmanager

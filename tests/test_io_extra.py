@@ -67,9 +67,11 @@ def test_json_path_parser():
     assert parse_json_path("$[2]") == [2]
     assert parse_json_path("$.a[0].b") == ["a", 0, "b"]
     assert parse_json_path("$['k y']") == ["k y"]
-    assert parse_json_path("$..a") is None
-    assert parse_json_path("$.a[*]") is None
-    assert parse_json_path("a.b") is None
+    assert parse_json_path("$..a") is None            # subset-tagged
+    assert parse_json_path("$.a[*]") is None          # subset-tagged
+    from spark_rapids_tpu.plan.json_fns import INVALID_PATH
+    assert parse_json_path("a.b") == INVALID_PATH     # Spark rejects
+    assert parse_json_path("$[-1]") == INVALID_PATH   # negative subscript
 
 
 def test_get_json_object():
@@ -104,3 +106,36 @@ def test_get_json_object_wildcard_tagged():
     q = apply_overrides(plan)
     assert q.kind == "host"
     assert any("subset" in r for r in q.meta.reasons)
+
+
+def test_get_json_object_negative_index_null():
+    tbl = pa.table({"j": pa.array(['[1,2,3]'])})
+    plan = L.LogicalProject([GetJsonObject(E.ColumnRef("j"), "$[-1]")],
+                            L.LogicalScan(tbl), names=["x"])
+    q = apply_overrides(plan)
+    # invalid-in-Spark path: stays wherever placement puts it, returns NULL
+    assert q.collect().column("x").to_pylist() == [None]
+
+
+def test_orc_user_schema_honored(orc_file):
+    from spark_rapids_tpu.io.orc import LogicalOrcScan
+    path, tbl = orc_file
+    want = pa.schema([("a", pa.int64())])
+    plan = LogicalOrcScan([path], schema=want)
+    q = apply_overrides(L.LogicalLimit(5, plan))
+    out = q.collect()
+    assert out.schema.names == ["a"]
+    assert out.num_rows == 5
+
+
+def test_binary_column_not_silently_dropped(tmp_path):
+    """BINARY has no device lane: operators over it must fall back whole,
+    never lose the column at a transition (review-finding regression)."""
+    tbl = pa.table({"a": pa.array([1, 2, 3], pa.int64()),
+                    "bin": pa.array([b"x", b"yy", None], pa.binary())})
+    plan = L.LogicalLimit(2, L.LogicalScan(tbl))
+    q = apply_overrides(plan)
+    out = q.collect()
+    assert out.schema.names == ["a", "bin"]
+    assert out.num_rows == 2
+    assert out.column("bin").to_pylist() == [b"x", b"yy"]

@@ -1,0 +1,123 @@
+"""UDF acceleration tests (reference rapids-udfs role, SURVEY 2.8)."""
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.overrides import apply_overrides
+from spark_rapids_tpu.plan.udf import PythonUDF, TpuUDF
+
+
+def _tbl(n=1000):
+    rng = np.random.default_rng(5)
+    return pa.table({
+        "x": pa.array(rng.integers(0, 100, n), pa.int64(),
+                      mask=rng.random(n) < 0.1),
+        "y": pa.array(rng.standard_normal(n)),
+    })
+
+
+def test_tpu_udf_device_fused():
+    def my_fn(x, y):
+        return jnp.sqrt(jnp.abs(x.astype(jnp.float64)) + y * y)
+
+    tbl = _tbl()
+    plan = L.LogicalProject(
+        [TpuUDF(my_fn, t.DOUBLE, E.ColumnRef("x"), E.ColumnRef("y"))],
+        L.LogicalScan(tbl), names=["r"])
+    q = apply_overrides(plan)
+    assert q.kind == "device", q.explain()
+    out = q.collect().to_pandas()
+    df = tbl.to_pandas()
+    exp = np.sqrt(np.abs(df["x"]) + df["y"] ** 2)
+    got = out["r"]
+    mask = df["x"].notna()
+    assert np.allclose(got[mask], exp[mask], rtol=1e-9)
+    assert got[~mask].isna().all()       # null inputs -> null output
+
+
+def test_tpu_udf_in_filter_and_agg():
+    """The UDF fuses into the single filter+aggregate program."""
+    from spark_rapids_tpu.plan.aggregates import Count, Sum
+
+    def double_it(x):
+        return x * 2
+
+    tbl = _tbl()
+    udf = TpuUDF(double_it, t.LONG, E.ColumnRef("x"))
+    plan = L.LogicalAggregate(
+        [], [(Sum(udf), "s"), (Count(None), "c")],
+        L.LogicalFilter(E.GreaterThan(udf, E.Literal(50)),
+                        L.LogicalScan(tbl)))
+    q = apply_overrides(plan)
+    assert q.kind == "device"
+    out = q.collect()
+    df = tbl.to_pandas()
+    d = df["x"] * 2
+    keep = d > 50
+    assert out.column("s").to_pylist() == [int(d[keep & df["x"].notna()].sum())]
+
+
+def test_tpu_udf_custom_validity():
+    def clamped(pair):
+        data, valid = pair
+        # custom nulls: result invalid where data negative
+        return data, valid & (data >= 0)
+
+    tbl = pa.table({"x": pa.array([-5, 3, None, 7], pa.int64())})
+    plan = L.LogicalProject(
+        [TpuUDF(clamped, t.LONG, E.ColumnRef("x"), needs_validity=True)],
+        L.LogicalScan(tbl), names=["r"])
+    out = apply_overrides(plan).collect()
+    assert out.column("r").to_pylist() == [None, 3, None, 7]
+
+
+def test_tpu_udf_string_input_tagged():
+    tbl = pa.table({"s": pa.array(["a", "b"])})
+    plan = L.LogicalProject(
+        [TpuUDF(lambda s: s, t.LONG, E.ColumnRef("s"))],
+        L.LogicalScan(tbl), names=["r"])
+    q = apply_overrides(plan)
+    assert q.kind == "host"
+    assert any("jax lanes" in r for r in q.meta.reasons)
+
+
+def test_python_udf_cpu_path():
+    def slow_fn(x, y):
+        return int(x) + round(float(y))
+
+    tbl = _tbl(100)
+    plan = L.LogicalProject(
+        [PythonUDF(slow_fn, t.LONG, E.ColumnRef("x"), E.ColumnRef("y"))],
+        L.LogicalScan(tbl), names=["r"])
+    q = apply_overrides(plan)
+    assert q.kind == "host"
+    assert any("row-at-a-time" in r for r in q.meta.reasons)
+    out = q.collect()
+    df = tbl.to_pandas()
+    for got, x, y in zip(out.column("r").to_pylist(), df["x"], df["y"]):
+        if x != x:       # null
+            assert got is None
+        else:
+            assert got == int(x) + round(float(y))
+
+
+def test_python_udf_feeds_device_parent():
+    """CPU UDF project -> device aggregate via transitions."""
+    from spark_rapids_tpu.plan.aggregates import Sum
+    tbl = _tbl(200)
+    plan = L.LogicalAggregate(
+        [], [(Sum(E.ColumnRef("r")), "s")],
+        L.LogicalProject(
+            [PythonUDF(lambda x: int(x) % 7, t.LONG, E.ColumnRef("x"))],
+            L.LogicalScan(tbl), names=["r"]))
+    q = apply_overrides(plan)
+    tree = q.root.tree_string()
+    assert "HashAggregateExec" in tree and "HostToDeviceExec" in tree
+    out = q.collect()
+    df = tbl.to_pandas()
+    exp = int((df["x"].dropna().astype(int) % 7).sum())
+    assert out.column("s").to_pylist() == [exp]
