@@ -1,0 +1,1 @@
+from .table import DeltaTable, DeltaConcurrentModification   # noqa: F401

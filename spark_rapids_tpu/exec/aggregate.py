@@ -182,9 +182,9 @@ class HashAggregate:
             meta = _batch_meta(db)
 
             def run(col_data, col_valid, num_rows, aux_arrs):
-                inputs = _build_inputs(meta, col_data, col_valid)
+                inputs, raw = _build_inputs(meta, col_data, col_valid)
                 ctx = E.EvalCtx(capacity, num_rows, inputs, aux_arrs,
-                                node_slots, conf)
+                                node_slots, conf, raw)
                 live = live_mask(capacity, num_rows)
                 for c in conds_t:
                     dv = c.eval_dev(ctx)

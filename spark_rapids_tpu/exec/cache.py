@@ -1,0 +1,105 @@
+"""Cached-plan materialization — the ParquetCachedBatchSerializer role.
+
+Reference: df.cache() stores batches as compressed Parquet BYTES
+(ParquetCachedBatchSerializer.scala:264, GpuInMemoryTableScanExec): the
+columnar encode compresses on the accelerator side and cached data
+re-decodes on demand, trading CPU-side decode for a fraction of the
+memory of raw batches.
+
+Here: the first materialization streams the child's host batches into an
+in-memory zstd parquet buffer (one shot); replays decode from the buffer
+through the standard host->device upload.  The logical node pins the
+buffer on the LOGICAL plan object so every physical re-plan of the same
+DataFrame reuses it (Spark's cache is also logical-plan-keyed)."""
+from __future__ import annotations
+
+import io as _io
+from typing import Iterator, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from .. import types as t
+from ..columnar.host import schema_to_struct, struct_to_schema
+from ..plan import logical as L
+
+
+class LogicalCache(L.LogicalPlan):
+    """Caches the child's result on first materialization."""
+
+    def __init__(self, child: L.LogicalPlan):
+        super().__init__(child)
+        self._buffer: Optional[bytes] = None
+        self._cached_schema: Optional[pa.Schema] = None
+
+    def _resolve_schema(self):
+        return self.child.schema
+
+    def materialized(self) -> bool:
+        return self._buffer is not None
+
+    def cached_bytes(self) -> int:
+        return len(self._buffer) if self._buffer is not None else 0
+
+    def materialize(self, conf) -> None:
+        if self._buffer is not None:
+            return
+        from ..plan.overrides import apply_overrides
+        q = apply_overrides(self.child, conf)
+        schema = struct_to_schema(self.schema)
+        sink = _io.BytesIO()
+        writer = pq.ParquetWriter(sink, schema, compression="zstd")
+        try:
+            for rb in q.execute_host_batches():
+                if rb.num_rows:
+                    writer.write_batch(rb.cast(schema)
+                                       if rb.schema != schema else rb)
+        finally:
+            writer.close()
+        self._buffer = sink.getvalue()
+        self._cached_schema = schema
+
+    def read_batches(self, batch_rows: int) -> Iterator[pa.RecordBatch]:
+        assert self._buffer is not None, "cache not materialized"
+        f = pq.ParquetFile(_io.BytesIO(self._buffer))
+        for rb in f.iter_batches(batch_size=batch_rows):
+            yield rb
+
+    def describe(self):
+        state = f"{self.cached_bytes()}B" if self.materialized() \
+            else "cold"
+        return f"Cache[{state}]"
+
+
+class CachedHostScan:
+    """Host exec over a LogicalCache: materializes lazily at EXECUTE time
+    (never during plan conversion — explain stays side-effect free) and
+    STREAMS batches from the compressed buffer (peak memory = one decoded
+    batch, which is the cache's whole point)."""
+
+    def __init__(self, lc: LogicalCache, conf):
+        from .host_exec import HostNode
+        self.children = []
+        self._lc = lc
+        self._conf = conf
+
+    @property
+    def output_schema(self):
+        return self._lc.schema
+
+    def execute(self, ctx) -> Iterator[pa.RecordBatch]:
+        self._lc.materialize(ctx.conf)
+        yield from self._lc.read_batches(ctx.conf.batch_size_rows)
+
+    def describe(self):
+        return f"CachedHostScan[{self._lc.describe()}]"
+
+    def tree_string(self, indent: int = 0) -> str:
+        return "  " * indent + self.describe()
+
+    def name(self):
+        return type(self).__name__
+
+    def collect(self, ctx=None):
+        from .host_exec import HostNode
+        return HostNode.collect(self, ctx)

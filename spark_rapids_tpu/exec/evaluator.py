@@ -84,9 +84,11 @@ def _batch_meta(db: DeviceBatch):
 
 def _build_inputs(meta, col_data, col_valid):
     inputs = {}
+    raw = {}
     for (name, dtype, dictionary), d, v in zip(meta, col_data, col_valid):
         inputs[name] = DevVal(compute_view(d, dtype), v, dtype, dictionary)
-    return inputs
+        raw[name] = d          # storage lane (f64-bits stay int64)
+    return inputs, raw
 
 
 def _expr_fp(e) -> str:
@@ -124,8 +126,9 @@ def evaluate_projection(exprs: Sequence[Expression], names: Sequence[str],
         meta = _batch_meta(db)
 
         def run(col_data, col_valid, num_rows, aux_arrs):
-            inputs = _build_inputs(meta, col_data, col_valid)
-            ctx = EvalCtx(capacity, num_rows, inputs, aux_arrs, node_slots, conf)
+            inputs, raw = _build_inputs(meta, col_data, col_valid)
+            ctx = EvalCtx(capacity, num_rows, inputs, aux_arrs, node_slots,
+                          conf, raw)
             live = live_mask(capacity, num_rows)
             outs = []
             for e in exprs_t:
@@ -160,8 +163,9 @@ def compute_predicate(cond: Expression, db: DeviceBatch,
         meta = _batch_meta(db)
 
         def run(col_data, col_valid, num_rows, aux_arrs):
-            inputs = _build_inputs(meta, col_data, col_valid)
-            ctx = EvalCtx(capacity, num_rows, inputs, aux_arrs, node_slots, conf)
+            inputs, raw = _build_inputs(meta, col_data, col_valid)
+            ctx = EvalCtx(capacity, num_rows, inputs, aux_arrs, node_slots,
+                          conf, raw)
             dv = cond.eval_dev(ctx)
             keep = dv.data
             if dv.validity is not None:

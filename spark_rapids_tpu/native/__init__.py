@@ -195,10 +195,19 @@ class ShuffleBlockWriter:
 def read_shuffle_block(path: str, offset: int) -> bytes:
     lib = _load()
     if lib is not None:
+        size = os.path.getsize(path)
         with open(path, "rb") as f:
             f.seek(offset)
             hdr = f.read(24)
-        _magic, n, _h = struct.unpack("<QQQ", hdr)
+        if len(hdr) < 24:
+            raise IOError(f"truncated shuffle block header in {path} "
+                          f"at {offset}")
+        magic, n, _h = struct.unpack("<QQQ", hdr)
+        if magic != _MAGIC:
+            raise IOError(f"bad shuffle block magic in {path} at {offset}")
+        if n > size - offset - 24:
+            raise IOError(f"shuffle block length {n} exceeds file size "
+                          f"({path} at {offset})")
         buf = ctypes.create_string_buffer(max(int(n), 1))
         r = lib.shuffle_read_block(path.encode(), offset, buf, n)
         if r < 0:

@@ -65,13 +65,17 @@ class HostVal:
 class EvalCtx:
     """Device-phase context available while tracing eval_dev."""
 
-    def __init__(self, capacity: int, num_rows, inputs, aux, node_slots, conf):
+    def __init__(self, capacity: int, num_rows, inputs, aux, node_slots,
+                 conf, raw=None):
         self.capacity = capacity
         self.num_rows = num_rows
         self.inputs = inputs          # name -> DevVal
         self.aux = aux                # tuple of jnp arrays (positional)
         self.node_slots = node_slots
         self.conf = conf
+        # name -> STORAGE lane (DOUBLE keeps its int64 f64-bits form when
+        # host-scanned) — consumers needing bit-exact lanes (hash) read it
+        self.raw = raw or {}
 
     def aux_of(self, node: "Expression") -> List[jax.Array]:
         return [self.aux[i] for i in self.node_slots.get(id(node), [])]
@@ -1631,3 +1635,500 @@ class Cast(Expression):
 
     def _fp_extra(self):
         return self.to.simple_string
+
+
+# ---------------------------------------------------------------------------
+# Math breadth (reference mathExpressions.scala)
+# ---------------------------------------------------------------------------
+
+class Sin(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.sin)
+    fn_np = staticmethod(np.sin)
+
+
+class Cos(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.cos)
+    fn_np = staticmethod(np.cos)
+
+
+class Tan(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.tan)
+    fn_np = staticmethod(np.tan)
+
+
+class Asin(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.arcsin)
+    fn_np = staticmethod(np.arcsin)
+
+
+class Acos(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.arccos)
+    fn_np = staticmethod(np.arccos)
+
+
+class Atan(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.arctan)
+    fn_np = staticmethod(np.arctan)
+
+
+class Sinh(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.sinh)
+    fn_np = staticmethod(np.sinh)
+
+
+class Cosh(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.cosh)
+    fn_np = staticmethod(np.cosh)
+
+
+class Tanh(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.tanh)
+    fn_np = staticmethod(np.tanh)
+
+
+class Log10(UnaryMathExpression):
+    """Spark log10: null for input <= 0 (shares Log's domain rule)."""
+
+    def _eval_dev(self, ctx, kids):
+        x = kids[0].data.astype(jnp.float64)
+        ok = x > 0
+        data = jnp.log10(jnp.where(ok, x, 1.0))
+        data = jnp.where(jnp.isposinf(x), jnp.float64(np.inf), data)
+        return DevVal(data, merge_validity(kids[0].validity, ok), t.DOUBLE)
+
+    def _eval_cpu(self, rb, kids):
+        arr = kids[0].cast(pa.float64())
+        x = arr.to_numpy(zero_copy_only=False)
+        with np.errstate(all="ignore"):
+            out = np.log10(x)
+        mask = np.asarray(pc.is_null(arr)) | ~(x > 0)
+        return pa.array(out, pa.float64(), mask=mask)
+
+
+class Log2(Log10):
+    def _eval_dev(self, ctx, kids):
+        x = kids[0].data.astype(jnp.float64)
+        ok = x > 0
+        data = jnp.log2(jnp.where(ok, x, 1.0))
+        data = jnp.where(jnp.isposinf(x), jnp.float64(np.inf), data)
+        return DevVal(data, merge_validity(kids[0].validity, ok), t.DOUBLE)
+
+    def _eval_cpu(self, rb, kids):
+        arr = kids[0].cast(pa.float64())
+        x = arr.to_numpy(zero_copy_only=False)
+        with np.errstate(all="ignore"):
+            out = np.log2(x)
+        mask = np.asarray(pc.is_null(arr)) | ~(x > 0)
+        return pa.array(out, pa.float64(), mask=mask)
+
+
+class Cbrt(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.cbrt)
+    fn_np = staticmethod(np.cbrt)
+
+
+class Signum(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.sign)
+    fn_np = staticmethod(np.sign)
+
+
+class Atan2(Expression):
+    def __init__(self, y, x):
+        self.children = (y, x)
+
+    def _resolve(self):
+        self.dtype = t.DOUBLE
+
+    def _eval_dev(self, ctx, kids):
+        data = jnp.arctan2(kids[0].data.astype(jnp.float64),
+                           kids[1].data.astype(jnp.float64))
+        return DevVal(data, merge_validity(kids[0].validity,
+                                           kids[1].validity), t.DOUBLE)
+
+    def _eval_cpu(self, rb, kids):
+        a = kids[0].cast(pa.float64()).to_numpy(zero_copy_only=False)
+        b = kids[1].cast(pa.float64()).to_numpy(zero_copy_only=False)
+        mask = np.asarray(pc.is_null(kids[0])) | np.asarray(
+            pc.is_null(kids[1]))
+        with np.errstate(all="ignore"):
+            return pa.array(np.arctan2(a, b), pa.float64(), mask=mask)
+
+
+class Greatest(Expression):
+    """greatest(...): Spark skips nulls, null only when ALL inputs null;
+    NaN is greatest (Java ordering)."""
+    _is_greatest = True
+
+    def __init__(self, *items):
+        assert len(items) >= 2
+        self.children = tuple(items)
+
+    def _resolve(self):
+        # first non-NULL-typed child decides the result type (Coalesce
+        # pattern): greatest(NULL, x) is x-typed, not NULL-typed
+        self.dtype = next((c.dtype for c in self.children
+                           if not isinstance(c.dtype, t.NullType)), t.NULL)
+        self.nullable = all(c.nullable for c in self.children)
+
+    def unsupported_reasons(self, conf):
+        out = []
+        for c in self.children:
+            if _consumes_wide_host(c):
+                out.append("128-bit host decimal lane not consumable "
+                           "on device")
+        return out
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.kernels import valid_or_true
+        is_fp = t.is_floating(self.dtype)
+        acc_d = kids[0].data
+        acc_v = valid_or_true(kids[0].validity, ctx.capacity)
+        for k in kids[1:]:
+            d, v = k.data, valid_or_true(k.validity, ctx.capacity)
+            if is_fp:
+                da = acc_d.astype(jnp.float64)
+                db = d.astype(jnp.float64)
+                # NaN greatest (Java order) with an explicit nan lane so a
+                # genuine +inf never ties with NaN
+                na, nb = jnp.isnan(da), jnp.isnan(db)
+                # Java ordering tiebreak: -0.0 < +0.0 (IEEE == can't see it)
+                sa, sb = jnp.signbit(da), jnp.signbit(db)
+                zero_tie = (~na & ~nb & (db == da))
+                if self._is_greatest:
+                    take_b = (nb & ~na) | (~na & ~nb & (db > da)) | \
+                        (zero_tie & sa & ~sb)
+                else:
+                    take_b = (na & ~nb) | (~na & ~nb & (db < da)) | \
+                        (zero_tie & ~sa & sb)
+            else:
+                take_b = d > acc_d if self._is_greatest else d < acc_d
+            pick_b = v & (~acc_v | take_b)
+            acc_d = jnp.where(pick_b, d, acc_d)
+            acc_v = acc_v | v
+        return DevVal(acc_d, acc_v, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        import math
+        cols = [k.to_pylist() for k in kids]
+        gt = self._is_greatest
+
+        def key(v):
+            return ((v != v, v, not math.copysign(1.0, v) < 0)
+                    if isinstance(v, float) else (False, v, True))
+        out = []
+        for row in zip(*cols):
+            nn = [v for v in row if v is not None]
+            out.append((max(nn, key=key) if gt else min(nn, key=key))
+                      if nn else None)
+        from ..columnar.host import dtype_to_arrow
+        return pa.array(out, dtype_to_arrow(self.dtype))
+
+
+class Least(Greatest):
+    _is_greatest = False
+
+
+class Round(Expression):
+    """round(x, scale) HALF_UP (Spark default).  Decimals round on the
+    unscaled int64 lane exactly.  DOUBLE rounds in binary (x*10^s):
+    Spark rounds the double's SHORTEST DECIMAL representation through
+    BigDecimal, so values sitting on a decimal half-way point that binary
+    cannot represent (e.g. 2.675) can differ in the last unit — a
+    documented deviation (cf. the reference's float notes in
+    docs/compatibility.md); both engine paths here agree with each
+    other."""
+    _half_even = False
+
+    def __init__(self, child, scale: int = 0):
+        self.children = (child,)
+        self.scale = scale
+
+    def _fp_extra(self):
+        return str(self.scale)
+
+    def _resolve(self):
+        dt = self.children[0].dtype
+        if isinstance(dt, t.DecimalType):
+            # Spark: round(decimal(p,s), d) -> decimal(p-s+max(d,0)+1,
+            # max(d,0)); the +1 absorbs the round-up carry (999.99 -> 1000)
+            if self.scale >= dt.scale:
+                self.dtype = dt
+            else:
+                self.dtype = t.DecimalType(
+                    min(38, dt.precision - dt.scale + max(self.scale, 0)
+                        + 1),
+                    max(self.scale, 0))
+        elif t.is_integral(dt):
+            self.dtype = dt
+        else:
+            self.dtype = t.DOUBLE
+        self.nullable = self.children[0].nullable
+
+    def unsupported_reasons(self, conf):
+        out = []
+        if _consumes_wide_host(self.children[0]):
+            out.append("128-bit host decimal lane not consumable on device")
+        return out
+
+    def _int_round(self, d, drop: int):
+        """Exact integer rounding: divide by 10^drop with HALF_UP or
+        HALF_EVEN on the magnitude."""
+        p = jnp.int64(10 ** drop)
+        mag = jnp.abs(d)
+        q = (mag + p // 2) // p
+        if self._half_even:
+            r = mag - (mag // p) * p
+            half = (r * 2 == p)
+            qf = mag // p
+            q = jnp.where(half, qf + (qf % 2), q)
+        return jnp.where(d < 0, -q, q)
+
+    def _eval_dev(self, ctx, kids):
+        dt = self.children[0].dtype
+        if isinstance(dt, t.DecimalType):
+            # drop digits down to the requested scale; a negative scale
+            # keeps the decimal's scale at 0 but zeroes integral digits
+            drop = dt.scale - self.scale
+            d = kids[0].data.astype(jnp.int64)
+            if drop <= 0:
+                return DevVal(d, kids[0].validity, self.dtype)
+            q = self._int_round(d, drop)
+            if self.scale < 0:
+                q = q * jnp.int64(10 ** (-self.scale))
+            return DevVal(q, kids[0].validity, self.dtype)
+        if t.is_integral(dt):
+            d = kids[0].data.astype(jnp.int64)
+            if self.scale >= 0:
+                out = d
+            else:
+                out = self._int_round(d, -self.scale) * \
+                    jnp.int64(10 ** (-self.scale))
+            return DevVal(out.astype(kids[0].data.dtype),
+                          kids[0].validity, self.dtype)
+        x = kids[0].data.astype(jnp.float64)
+        p = jnp.float64(10.0 ** self.scale)
+        if self._half_even:
+            out = jnp.round(x * p) / p
+        else:
+            out = jnp.trunc(x * p + jnp.where(x >= 0, 0.5, -0.5)) / p
+        return DevVal(out, kids[0].validity, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        import decimal as pydec
+        dt = self.children[0].dtype
+        from ..columnar.host import dtype_to_arrow
+        mode = pydec.ROUND_HALF_EVEN if self._half_even \
+            else pydec.ROUND_HALF_UP
+        if isinstance(dt, t.DecimalType):
+            out_q = pydec.Decimal(1).scaleb(-self.dtype.scale)
+            rq = pydec.Decimal(1).scaleb(-self.scale)
+            out = [None if v is None else
+                   v.quantize(rq, rounding=mode).quantize(out_q)
+                   for v in kids[0].to_pylist()]
+            return pa.array(out, dtype_to_arrow(self.dtype))
+        if t.is_integral(dt):
+            if self.scale >= 0:
+                return kids[0]
+            rq = pydec.Decimal(1).scaleb(-self.scale)
+            out = [None if v is None else
+                   int(pydec.Decimal(v).quantize(rq, rounding=mode))
+                   for v in kids[0].to_pylist()]
+            return pa.array(out, dtype_to_arrow(self.dtype))
+        xs = kids[0].cast(pa.float64()).to_pylist()
+        p = 10.0 ** self.scale
+        if self._half_even:
+            out = [None if v is None else
+                   float(np.round(v * p) / p) for v in xs]
+        else:
+            out = [None if v is None else
+                   math_trunc_half_up(v, p) for v in xs]
+        return pa.array(out, pa.float64())
+
+
+def math_trunc_half_up(v: float, p: float) -> float:
+    import math
+    x = v * p
+    return math.floor(x + 0.5) / p if x >= 0 else math.ceil(x - 0.5) / p
+
+
+class BRound(Round):
+    """bround: HALF_EVEN (banker's rounding)."""
+    _half_even = True
+
+
+class RaiseError(Expression):
+    """raise_error(msg): CPU-path only — jit programs cannot raise, so the
+    expression tags off-device and the CPU operator throws on the first
+    evaluated row (reference GpuRaiseError, misc.scala)."""
+
+    def __init__(self, message: str):
+        self.children = ()
+        self.message = message
+
+    def _resolve(self):
+        self.dtype = t.NULL
+        self.nullable = True
+
+    def _fp_extra(self):
+        return repr(self.message)
+
+    def unsupported_reasons(self, conf):
+        return ["raise_error must run on the CPU path (device programs "
+                "cannot throw)"]
+
+    def _eval_cpu(self, rb, kids):
+        if rb.num_rows > 0:
+            raise RuntimeError(self.message)
+        return pa.nulls(0)
+
+
+class Murmur3Hash(Expression):
+    """hash(...): Spark's murmur3-based hash with seed 42 folded across
+    columns — device kernels from ops/hashing (the HashFunctions.scala
+    murmur3 role; bit-exact with Spark for the supported lane types)."""
+
+    def __init__(self, *items):
+        assert items
+        self.children = tuple(items)
+
+    def _resolve(self):
+        self.dtype = t.INT
+        self.nullable = False
+
+    def _prepare(self, pctx, kids):
+        from ..ops.hashing import dict_hash_array
+        for k, c in zip(kids, self.children):
+            if isinstance(c.dtype, t.StringType):
+                d = k.dictionary
+                # per-seed string hashes cannot precompute (seed chains);
+                # only position-0 style single-column usage precomputes
+                pctx.add(self, dict_hash_array(
+                    d.cast(pa.string()) if d is not None
+                    else pa.array([], pa.string()), 42))
+        return HostVal()
+
+    def unsupported_reasons(self, conf):
+        out = []
+        strings = [c for c in self.children
+                   if isinstance(c.dtype, t.StringType)]
+        if strings and (len(self.children) > 1 or
+                        self.children[0] is not strings[0]):
+            out.append("string input to hash() only as the single/first "
+                       "column (chained-seed string hashing needs the "
+                       "byte-level kernel)")
+        for c in self.children:
+            if isinstance(c.dtype, (t.ArrayType, t.MapType, t.StructType,
+                                    t.BinaryType)):
+                out.append(f"hash over {c.dtype.simple_string}")
+            if isinstance(c.dtype, t.DoubleType) and \
+                    not isinstance(c, ColumnRef):
+                out.append("hash over a COMPUTED double (bit-exact f64 "
+                           "lanes exist only for scanned columns)")
+            if isinstance(c.dtype, t.DecimalType) and c.dtype.is_wide:
+                out.append("hash over decimal(>18)")
+        return out
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.hashing import hash_column
+        from ..ops.kernels import valid_or_true
+        aux_iter = iter(ctx.aux_of(self))
+        h = jnp.full((ctx.capacity,), 42, jnp.uint32)
+        for k, c in zip(kids, self.children):
+            if isinstance(c.dtype, t.StringType):
+                # single-string-column form only (tagged otherwise): the
+                # dict table was hashed against the constant seed 42
+                table = next(aux_iter)
+                codes = jnp.clip(k.data, 0, table.shape[0] - 1)
+                lane = table[codes].astype(jnp.uint32)
+                valid = valid_or_true(k.validity, ctx.capacity)
+                h = jnp.where(valid, lane, h)   # null: seed passes through
+                continue
+            data = k.data
+            if isinstance(c.dtype, t.DoubleType) and \
+                    isinstance(c, ColumnRef):
+                # Spark hashes the f64 BIT PATTERN: use the storage lane
+                # (int64 bits for scanned columns), not the compute view
+                data = ctx.raw.get(c.name, data)
+                if data.dtype != jnp.int64:
+                    raise TypeError(
+                        "hash() over a DOUBLE column whose batch was "
+                        "device-computed upstream: the f64 bit pattern "
+                        "is unavailable on TPU (no f64->i64 bitcast). "
+                        "Disable spark.rapids.tpu.sql.expression."
+                        "Murmur3Hash to hash on the CPU path.")
+            h = hash_column(data, k.validity, c.dtype, h)
+        return DevVal(h.astype(jnp.int32), None, t.INT)
+
+    @staticmethod
+    def _cpu_lane(arr: pa.Array, dt: t.DataType):
+        """(values list, width) normalized to the exact integers the
+        device kernels hash — bit patterns for floats (-0 -> +0, NaN
+        canonical), epoch micros/days via arrow casts (no host-timezone
+        round trips), unscaled longs for narrow decimals."""
+        import struct as _st
+        if isinstance(dt, t.BooleanType):
+            return [None if v is None else (1 if v else 0)
+                    for v in arr.to_pylist()], 32
+        if isinstance(dt, (t.ByteType, t.ShortType, t.IntegerType)):
+            return arr.cast(pa.int32()).to_pylist(), 32
+        if isinstance(dt, t.DateType):
+            return arr.cast(pa.int32()).to_pylist(), 32
+        if isinstance(dt, t.LongType):
+            return arr.to_pylist(), 64
+        if isinstance(dt, t.TimestampType):
+            return arr.cast(pa.int64()).to_pylist(), 64
+        if isinstance(dt, t.FloatType):
+            out = []
+            for v in arr.to_pylist():
+                if v is None:
+                    out.append(None)
+                    continue
+                if v != v:
+                    out.append(0x7FC00000)          # canonical NaN bits
+                    continue
+                if v == 0.0:
+                    v = 0.0                          # -0.0 -> +0.0
+                out.append(_st.unpack("<i", _st.pack("<f", v))[0])
+            return out, 32
+        if isinstance(dt, t.DoubleType):
+            out = []
+            for v in arr.to_pylist():
+                if v is None:
+                    out.append(None)
+                    continue
+                if v != v:
+                    out.append(0x7FF8000000000000)   # canonical NaN bits
+                    continue
+                if v == 0.0:
+                    v = 0.0                          # -0.0 -> +0.0
+                out.append(_st.unpack("<q", _st.pack("<d", v))[0])
+            return out, 64
+        if isinstance(dt, t.DecimalType):
+            return [None if v is None else
+                    int(v.scaleb(dt.scale)) for v in arr.to_pylist()], 64
+        raise TypeError(f"hash over {dt.simple_string}")
+
+    def _eval_cpu(self, rb, kids):
+        from ..ops.hashing import (murmur3_int32_host, murmur3_int64_host,
+                                   murmur3_utf8)
+        lanes = []
+        for k, c in zip(kids, self.children):
+            if isinstance(c.dtype, t.StringType):
+                lanes.append((k.to_pylist(), "s"))
+            else:
+                lanes.append(self._cpu_lane(k, c.dtype))
+        out = []
+        for i in range(rb.num_rows):
+            h = 42
+            for vals, width in lanes:
+                v = vals[i]
+                if v is None:
+                    continue
+                if width == "s":
+                    h = murmur3_utf8(v, h)
+                elif width == 64:
+                    h = murmur3_int64_host(int(v), h)
+                else:
+                    h = murmur3_int32_host(int(v), h)
+            out.append(h - 2**32 if h >= 2**31 else h)
+        return pa.array(out, pa.int32())

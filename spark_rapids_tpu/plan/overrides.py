@@ -108,10 +108,21 @@ expr_rule(E.Coalesce, _COMMON, desc="first non-null")
 expr_rule(E.If, _COMMON, desc="if/else")
 expr_rule(E.CaseWhen, _COMMON, desc="case/when")
 expr_rule(E.In, _COMMON, t.T.BOOLEAN, desc="IN list")
-for _c in (E.Sqrt, E.Exp, E.Log, E.Pow):
+for _c in (E.Sqrt, E.Exp, E.Log, E.Pow, E.Sin, E.Cos, E.Tan, E.Asin,
+           E.Acos, E.Atan, E.Sinh, E.Cosh, E.Tanh, E.Log10, E.Log2,
+           E.Cbrt, E.Signum, E.Atan2):
     expr_rule(_c, t.T.NUMERIC, t.T.FP, desc="math fn")
 for _c in (E.Floor, E.Ceil):
     expr_rule(_c, t.T.NUMERIC, t.T.INTEGRAL, desc="rounding")
+for _c in (E.Round, E.BRound):
+    expr_rule(_c, t.T.NUMERIC, desc="round/bround (HALF_UP / HALF_EVEN)")
+for _c in (E.Greatest, E.Least):
+    expr_rule(_c, t.T.NUMERIC + t.T.DATETIME + t.T.BOOLEAN + t.T.NULL,
+              desc="n-ary extremum (null-skipping, NaN greatest)")
+expr_rule(E.Murmur3Hash, _COMMON, t.T.INTEGRAL,
+          desc="Spark hash() — bit-exact murmur3 device kernels")
+expr_rule(E.RaiseError, t.T.ALL_SIMPLE + t.T.NULL,
+          desc="raise_error (CPU path: device programs cannot throw)")
 expr_rule(E.Cast, t.T.ALL_SIMPLE, desc="cast (pairs gated by Cast itself)")
 
 from . import datetime as DT  # noqa: E402  (registry population)
@@ -181,6 +192,12 @@ exec_rule(L.LogicalRange, _DEVICE_SIMPLE, "range generator")
 exec_rule(L.LogicalExpand, _COMMON, "expand (grouping sets)")
 exec_rule(L.LogicalWindow, _COMMON,
           "window functions (partition-sorted segmented scans)")
+
+from ..exec.cache import LogicalCache  # noqa: E402
+
+exec_rule(LogicalCache, _DEVICE_SIMPLE,
+          "cached scan (zstd parquet bytes, "
+          "ParquetCachedBatchSerializer role)")
 exec_rule(LogicalParquetScan, _DEVICE_SIMPLE, "parquet scan")
 exec_rule(LogicalCsvScan, _DEVICE_SIMPLE, "csv scan")
 exec_rule(LogicalJsonScan, _DEVICE_SIMPLE, "json scan")
@@ -637,6 +654,21 @@ class WindowMeta(PlanMeta):
                                self.node.order_keys, self._host_child())
 
 
+class CacheMeta(PlanMeta):
+    """LogicalCache -> cached scan (ParquetCachedBatchSerializer role).
+    Materialization happens lazily at EXECUTE time (CachedHostScan), so
+    plan conversion / explain never runs the child, and batches stream
+    from the compressed buffer rather than decoding wholesale."""
+
+    def to_device(self):
+        from ..exec.cache import CachedHostScan
+        return H.HostToDeviceExec(CachedHostScan(self.node, self.conf))
+
+    def to_host(self):
+        from ..exec.cache import CachedHostScan
+        return CachedHostScan(self.node, self.conf)
+
+
 class GenerateMeta(PlanMeta):
     """LogicalGenerate: array generators live on the CPU path by placement
     (plan/collections.py module docs); the meta tags the reason and always
@@ -666,6 +698,7 @@ _META_FOR: Dict[type, Type[PlanMeta]] = {
     L.LogicalExpand: ExpandMeta,
     L.LogicalWindow: WindowMeta,
     L.LogicalGenerate: GenerateMeta,
+    LogicalCache: CacheMeta,
     LogicalParquetScan: ParquetScanMeta,
     LogicalCsvScan: TextScanMeta,
     LogicalJsonScan: TextScanMeta,

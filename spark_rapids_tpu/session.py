@@ -121,6 +121,14 @@ class DataFrame:
     def union(self, other: "DataFrame") -> "DataFrame":
         return self._wrap(L.LogicalUnion(self._plan, other._plan))
 
+    def cache(self) -> "DataFrame":
+        """Materialize once as compressed parquet bytes; downstream plans
+        re-decode from the cache (ParquetCachedBatchSerializer role)."""
+        from .exec.cache import LogicalCache
+        if isinstance(self._plan, LogicalCache):
+            return self
+        return self._wrap(LogicalCache(self._plan))
+
     # -- actions -----------------------------------------------------------
     @property
     def schema(self) -> t.StructType:

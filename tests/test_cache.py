@@ -1,0 +1,72 @@
+"""Cached-plan tests (ParquetCachedBatchSerializer role)."""
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.session import TpuSession, col
+
+
+def test_cache_materializes_once_and_reuses():
+    rng = np.random.default_rng(4)
+    tbl = pa.table({"k": pa.array(rng.integers(0, 10, 5000), pa.int64()),
+                    "v": pa.array(rng.standard_normal(5000))})
+    s = TpuSession()
+    base = s.from_arrow(tbl).filter(
+        E.GreaterThan(col("v"), E.Literal(0.0))).cache()
+    lc = base._plan
+    assert not lc.materialized()
+    r1 = base.collect()
+    assert lc.materialized()
+    size1 = lc.cached_bytes()
+    assert size1 > 0
+    # downstream plans reuse the same buffer (no rematerialization)
+    from spark_rapids_tpu.plan.aggregates import Count, Sum
+    agg = base.group_by("k").agg((Sum(col("v")), "sv"), (Count(None), "c"))
+    out = agg.collect()
+    assert lc.cached_bytes() == size1
+    exp = tbl.to_pandas()
+    exp = exp[exp["v"] > 0]
+    assert out.num_rows == exp["k"].nunique()
+    assert sorted(out.column("c").to_pylist()) == \
+        sorted(exp.groupby("k").size().tolist())
+    assert r1.num_rows == len(exp)
+
+
+def test_cache_device_placement():
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    tbl = pa.table({"x": pa.array(range(100), pa.int64())})
+    s = TpuSession()
+    df = s.from_arrow(tbl).cache()
+    q = apply_overrides(df._plan)
+    assert q.kind == "device"
+    assert q.collect().num_rows == 100
+
+
+def test_cache_idempotent():
+    tbl = pa.table({"x": pa.array([1], pa.int64())})
+    s = TpuSession()
+    df = s.from_arrow(tbl).cache()
+    assert df.cache()._plan is df._plan
+
+
+def test_cache_explain_only_no_materialization():
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    tbl = pa.table({"x": pa.array(range(10), pa.int64())})
+    s = TpuSession()
+    df = s.from_arrow(tbl).cache()
+    conf = TpuConf({"spark.rapids.tpu.sql.mode": "explainOnly"})
+    q = apply_overrides(df._plan, conf)
+    q.explain()
+    assert not df._plan.materialized()   # explain ran nothing
+
+
+def test_cache_lazy_until_execute():
+    tbl = pa.table({"x": pa.array(range(10), pa.int64())})
+    s = TpuSession()
+    df = s.from_arrow(tbl).cache()
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    q = apply_overrides(df._plan)
+    assert not df._plan.materialized()   # conversion is side-effect free
+    q.collect()
+    assert df._plan.materialized()

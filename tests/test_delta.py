@@ -1,0 +1,161 @@
+"""Delta Lake subset tests (SURVEY 2.11: log protocol, GPU-written files
+with stats, DELETE/UPDATE/MERGE via touched-file rewrite)."""
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.delta import DeltaConcurrentModification, DeltaTable
+from spark_rapids_tpu.plan import expressions as E
+
+
+def make(tmp_path, n=100, seed=0):
+    dt = DeltaTable(str(tmp_path / "tbl"))
+    rng = np.random.default_rng(seed)
+    t1 = pa.table({"k": pa.array(range(n), pa.int64()),
+                   "v": pa.array(rng.integers(0, 50, n), pa.int64()),
+                   "s": pa.array([f"name{i % 5}" for i in range(n)])})
+    dt.write(t1, mode="append")
+    return dt, t1
+
+
+def test_create_and_read(tmp_path):
+    dt, t1 = make(tmp_path)
+    assert dt.version() == 0
+    got = dt.read().sort_by("k")
+    assert got.equals(t1.select(got.schema.names).cast(got.schema))
+    # log structure
+    log = os.listdir(dt.log_dir)
+    assert log == ["00000000000000000000.json"]
+    acts = dt._read_actions()
+    kinds = [next(iter(a)) for a in acts]
+    assert "protocol" in kinds and "metaData" in kinds and "add" in kinds
+
+
+def test_append_and_overwrite_and_time_travel(tmp_path):
+    dt, t1 = make(tmp_path, 50)
+    t2 = pa.table({"k": pa.array(range(100, 120), pa.int64()),
+                   "v": pa.array([1] * 20, pa.int64()),
+                   "s": pa.array(["x"] * 20)})
+    v1 = dt.write(t2, mode="append")
+    assert v1 == 1
+    assert dt.read().num_rows == 70
+    v2 = dt.write(t2, mode="overwrite")
+    assert dt.read().num_rows == 20
+    # time travel
+    assert dt.read(version=0).num_rows == 50
+    assert dt.read(version=1).num_rows == 70
+
+
+def test_add_actions_carry_stats(tmp_path):
+    dt, t1 = make(tmp_path, 30)
+    adds = [a["add"] for a in dt._read_actions() if "add" in a]
+    stats = json.loads(adds[0]["stats"])
+    assert stats["numRecords"] == 30
+    assert stats["minValues"]["k"] == 0
+    assert stats["maxValues"]["k"] == 29
+    assert stats["nullCount"]["k"] == 0
+
+
+def test_delete(tmp_path):
+    dt, t1 = make(tmp_path, 100)
+    v = dt.delete(E.LessThan(E.ColumnRef("k"), E.Literal(30)))
+    assert v == 1
+    got = dt.read()
+    assert got.num_rows == 70
+    assert min(got.column("k").to_pylist()) == 30
+    # no-match delete commits nothing
+    v2 = dt.delete(E.GreaterThan(E.ColumnRef("k"), E.Literal(10**9)))
+    assert v2 == 1
+
+
+def test_update(tmp_path):
+    dt, t1 = make(tmp_path, 50)
+    v = dt.update(E.EqualTo(E.ColumnRef("s"), E.Literal("name0")),
+                  {"v": E.Literal(999, None)})
+    assert v == 1
+    got = dt.read()
+    for k, vv, s in zip(got.column("k").to_pylist(),
+                        got.column("v").to_pylist(),
+                        got.column("s").to_pylist()):
+        if s == "name0":
+            assert vv == 999
+        else:
+            assert vv != 999 or t1.column("v")[k].as_py() == 999
+
+
+def test_merge(tmp_path):
+    dt, t1 = make(tmp_path, 20)
+    source = pa.table({
+        "sk": pa.array([5, 10, 100, 101], pa.int64()),
+        "sv": pa.array([50, 100, 1000, 1010], pa.int64()),
+    })
+    v = dt.merge(source, on=("k", "sk"),
+                 when_matched_update={"v": E.ColumnRef("sv")},
+                 when_not_matched_insert=False)
+    got = dt.read().sort_by("k")
+    ks = got.column("k").to_pylist()
+    vs = got.column("v").to_pylist()
+    m = dict(zip(ks, vs))
+    assert got.num_rows == 20
+    assert m[5] == 50 and m[10] == 100
+    orig = dict(zip(t1.column("k").to_pylist(), t1.column("v").to_pylist()))
+    for k in ks:
+        if k not in (5, 10):
+            assert m[k] == orig[k]
+
+
+def test_merge_with_insert(tmp_path):
+    dt, t1 = make(tmp_path, 10)
+    source = pa.table({"k": pa.array([3, 50], pa.int64()),
+                       "v": pa.array([333, 555], pa.int64()),
+                       "s": pa.array(["upd", "new"])})
+    dt.merge(source, on=("k", "k"),
+             when_matched_update={"v": E.ColumnRef("v"),
+                                  "s": E.ColumnRef("s")},
+             when_not_matched_insert=True)
+    got = dt.read().sort_by("k")
+    assert got.num_rows == 11
+    m = {k: (v, s) for k, v, s in zip(got.column("k").to_pylist(),
+                                      got.column("v").to_pylist(),
+                                      got.column("s").to_pylist())}
+    assert m[50] == (555, "new")
+
+
+def test_merge_delete(tmp_path):
+    dt, t1 = make(tmp_path, 10)
+    source = pa.table({"sk": pa.array([2, 4], pa.int64())})
+    dt.merge(source, on=("k", "sk"), when_matched_delete=True,
+             when_not_matched_insert=False)
+    got = dt.read()
+    assert got.num_rows == 8
+    assert 2 not in got.column("k").to_pylist()
+
+
+def test_concurrent_commit_conflict(tmp_path):
+    dt, t1 = make(tmp_path, 10)
+    # simulate another writer grabbing version 1
+    other = DeltaTable(dt.path)
+    other._commit(1, [other._commit_info("WRITE", {})])
+    with pytest.raises(DeltaConcurrentModification):
+        dt._commit(1, [dt._commit_info("WRITE", {})])
+
+
+def test_schema_roundtrip(tmp_path):
+    import decimal
+    dt = DeltaTable(str(tmp_path / "t2"))
+    tbl = pa.table({
+        "i": pa.array([1], pa.int32()),
+        "d": pa.array([decimal.Decimal("1.50")], pa.decimal128(10, 2)),
+        "ts": pa.array([1000000], pa.int64()).cast(
+            pa.timestamp("us", tz="UTC")),
+        "dt": pa.array([1], pa.int32()).cast(pa.date32()),
+    })
+    dt.write(tbl)
+    sch = dt.schema()
+    assert sch.field("i").type == pa.int32()
+    assert sch.field("d").type == pa.decimal128(10, 2)
+    assert pa.types.is_timestamp(sch.field("ts").type)
+    assert sch.field("dt").type == pa.date32()

@@ -1,0 +1,276 @@
+"""Math breadth + greatest/least + round + hash() + raise_error tests."""
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.overrides import apply_overrides
+from spark_rapids_tpu.testing import assert_device_cpu_equal
+
+
+def test_trig_family_device_vs_cpu():
+    rng = np.random.default_rng(2)
+    data = {"x": pa.array(rng.uniform(-0.99, 0.99, 300),
+                          mask=rng.random(300) < 0.1)}
+    assert_device_cpu_equal(
+        [E.Sin(E.ColumnRef("x")), E.Cos(E.ColumnRef("x")),
+         E.Tan(E.ColumnRef("x")), E.Asin(E.ColumnRef("x")),
+         E.Acos(E.ColumnRef("x")), E.Atan(E.ColumnRef("x")),
+         E.Sinh(E.ColumnRef("x")), E.Cosh(E.ColumnRef("x")),
+         E.Tanh(E.ColumnRef("x")), E.Cbrt(E.ColumnRef("x")),
+         E.Signum(E.ColumnRef("x"))],
+        data, approx_float=True)
+
+
+def test_log_family_domain():
+    data = {"x": pa.array([10.0, 0.0, -3.0, None, 1000.0])}
+    assert_device_cpu_equal(
+        [E.Log10(E.ColumnRef("x")), E.Log2(E.ColumnRef("x"))],
+        data, approx_float=True)
+
+
+def test_atan2():
+    rng = np.random.default_rng(3)
+    data = {"y": pa.array(rng.standard_normal(100)),
+            "x": pa.array(rng.standard_normal(100))}
+    assert_device_cpu_equal(
+        [E.Atan2(E.ColumnRef("y"), E.ColumnRef("x"))], data,
+        approx_float=True)
+
+
+def test_greatest_least():
+    data = {"a": pa.array([1.0, None, 5.0, float("nan"), None]),
+            "b": pa.array([2.0, 3.0, None, 1.0, None]),
+            "c": pa.array([0.0, None, 4.0, 2.0, None])}
+    assert_device_cpu_equal(
+        [E.Greatest(E.ColumnRef("a"), E.ColumnRef("b"), E.ColumnRef("c")),
+         E.Least(E.ColumnRef("a"), E.ColumnRef("b"), E.ColumnRef("c"))],
+        data)
+    # oracle checks: nulls skipped, NaN greatest
+    from spark_rapids_tpu.columnar import HostBatch, to_device
+    from spark_rapids_tpu.columnar.device import to_host
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    from spark_rapids_tpu.exec.evaluator import evaluate_projection
+    db = to_device(HostBatch.from_pydict(data))
+    g = E.Greatest(E.ColumnRef("a"), E.ColumnRef("b"),
+                   E.ColumnRef("c")).bind(db.schema)
+    out = to_host(evaluate_projection([g], ["g"], db,
+                                      DEFAULT_CONF)).rb.column("g")
+    vals = out.to_pylist()
+    assert vals[0] == 2.0
+    assert vals[1] == 3.0              # nulls skipped
+    assert vals[2] == 5.0
+    assert vals[3] != vals[3]          # NaN greatest
+    assert vals[4] is None             # all null
+
+
+def test_greatest_ints():
+    data = {"a": pa.array([1, None, 7], pa.int64()),
+            "b": pa.array([5, 2, None], pa.int64())}
+    assert_device_cpu_equal(
+        [E.Greatest(E.ColumnRef("a"), E.ColumnRef("b")),
+         E.Least(E.ColumnRef("a"), E.ColumnRef("b"))], data)
+
+
+@pytest.mark.parametrize("scale", [0, 1, 2, -1])
+def test_round_double(scale):
+    data = {"x": pa.array([1.25, -1.25, 2.5, -2.5, 123.456, None, 0.05])}
+    assert_device_cpu_equal(
+        [E.Round(E.ColumnRef("x"), scale)], data, approx_float=True)
+
+
+def test_round_half_up_semantics():
+    from spark_rapids_tpu.columnar import HostBatch, to_device
+    from spark_rapids_tpu.columnar.device import to_host
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    from spark_rapids_tpu.exec.evaluator import evaluate_projection
+    data = {"x": pa.array([2.5, -2.5, 3.5])}
+    db = to_device(HostBatch.from_pydict(data))
+    r = E.Round(E.ColumnRef("x"), 0).bind(db.schema)
+    b = E.BRound(E.ColumnRef("x"), 0).bind(db.schema)
+    out = to_host(evaluate_projection([r, b], ["r", "b"], db, DEFAULT_CONF))
+    assert out.rb.column("r").to_pylist() == [3.0, -3.0, 4.0]   # HALF_UP
+    assert out.rb.column("b").to_pylist() == [2.0, -2.0, 4.0]   # HALF_EVEN
+
+
+def test_round_decimal():
+    import decimal
+    vals = [decimal.Decimal("1.25"), decimal.Decimal("-1.25"),
+            decimal.Decimal("9.99"), None]
+    data = {"d": pa.array(vals, pa.decimal128(9, 2))}
+    assert_device_cpu_equal([E.Round(E.ColumnRef("d"), 1)], data)
+    from spark_rapids_tpu.columnar import HostBatch, to_device
+    from spark_rapids_tpu.columnar.device import to_host
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    from spark_rapids_tpu.exec.evaluator import evaluate_projection
+    db = to_device(HostBatch.from_pydict(data))
+    r = E.Round(E.ColumnRef("d"), 1).bind(db.schema)
+    out = to_host(evaluate_projection([r], ["r"], db, DEFAULT_CONF))
+    assert [str(v) if v is not None else None
+            for v in out.rb.column("r").to_pylist()] == \
+        ["1.3", "-1.3", "10.0", None]       # HALF_UP away from zero
+
+
+def test_hash_matches_cpu_oracle():
+    rng = np.random.default_rng(9)
+    data = {
+        "i": pa.array(rng.integers(-1000, 1000, 200), pa.int32(),
+                      mask=rng.random(200) < 0.1),
+        "l": pa.array(rng.integers(-10**12, 10**12, 200), pa.int64()),
+        "d": pa.array(rng.standard_normal(200)),
+        "b": pa.array(rng.random(200) < 0.5),
+    }
+    assert_device_cpu_equal(
+        [E.Murmur3Hash(E.ColumnRef("i"), E.ColumnRef("l"),
+                       E.ColumnRef("d"), E.ColumnRef("b"))], data)
+
+
+def test_hash_single_string():
+    data = {"s": pa.array(["alpha", "beta", None, "alpha", ""])}
+    assert_device_cpu_equal([E.Murmur3Hash(E.ColumnRef("s"))], data)
+
+
+def test_hash_string_in_chain_tagged():
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    h = E.Murmur3Hash(E.ColumnRef("i"), E.ColumnRef("s"))
+    schema = t.StructType([t.StructField("i", t.INT),
+                           t.StructField("s", t.STRING)])
+    reasons = h.bind(schema).unsupported_reasons(DEFAULT_CONF)
+    assert any("chained-seed" in r for r in reasons)
+
+
+def test_raise_error():
+    tbl = pa.table({"x": pa.array([1, 2], pa.int64())})
+    plan = L.LogicalProject([E.RaiseError("boom")],
+                            L.LogicalScan(tbl), names=["e"])
+    q = apply_overrides(plan)
+    assert q.kind == "host"
+    with pytest.raises(RuntimeError, match="boom"):
+        q.collect()
+
+
+def test_hash_float_decimal_ts_date_cpu_matches_device():
+    import decimal
+    rng = np.random.default_rng(11)
+    n = 100
+    data = {
+        "f": pa.array(np.concatenate([
+            rng.standard_normal(n - 3).astype(np.float32),
+            np.array([0.0, -0.0, np.nan], np.float32)]), pa.float32()),
+        "dec": pa.array([decimal.Decimal(f"{v}.{v % 100:02d}")
+                         for v in range(n)], pa.decimal128(9, 2)),
+        "ts": pa.array(rng.integers(0, 2**45, n), pa.int64()).cast(
+            pa.timestamp("us", tz="UTC")),
+        "dt": pa.array(rng.integers(0, 20000, n).astype(np.int32),
+                       pa.int32()).cast(pa.date32()),
+    }
+    assert_device_cpu_equal(
+        [E.Murmur3Hash(E.ColumnRef("f")),
+         E.Murmur3Hash(E.ColumnRef("dec")),
+         E.Murmur3Hash(E.ColumnRef("ts")),
+         E.Murmur3Hash(E.ColumnRef("dt")),
+         E.Murmur3Hash(E.ColumnRef("f"), E.ColumnRef("dec"),
+                       E.ColumnRef("ts"), E.ColumnRef("dt"))], data)
+
+
+def test_hash_double_negzero_equals_poszero():
+    data = {"d": pa.array([0.0, -0.0])}
+    assert_device_cpu_equal([E.Murmur3Hash(E.ColumnRef("d"))], data)
+    from spark_rapids_tpu.columnar import HostBatch, to_device
+    from spark_rapids_tpu.columnar.device import to_host
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    from spark_rapids_tpu.exec.evaluator import evaluate_projection
+    db = to_device(HostBatch.from_pydict(data))
+    h = E.Murmur3Hash(E.ColumnRef("d")).bind(db.schema)
+    out = to_host(evaluate_projection([h], ["h"], db, DEFAULT_CONF))
+    a, b = out.rb.column("h").to_pylist()
+    assert a == b
+
+
+def test_greatest_nan_vs_inf():
+    data = {"a": pa.array([float("inf"), float("nan")]),
+            "b": pa.array([float("nan"), float("inf")])}
+    from spark_rapids_tpu.columnar import HostBatch, to_device
+    from spark_rapids_tpu.columnar.device import to_host
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    from spark_rapids_tpu.exec.evaluator import evaluate_projection
+    db = to_device(HostBatch.from_pydict(data))
+    g = E.Greatest(E.ColumnRef("a"), E.ColumnRef("b")).bind(db.schema)
+    l = E.Least(E.ColumnRef("a"), E.ColumnRef("b")).bind(db.schema)
+    out = to_host(evaluate_projection([g, l], ["g", "l"], db, DEFAULT_CONF))
+    gs = out.rb.column("g").to_pylist()
+    ls = out.rb.column("l").to_pylist()
+    assert all(x != x for x in gs)               # NaN greatest beats +inf
+    assert ls == [float("inf"), float("inf")]
+
+
+def test_round_negative_scale():
+    import decimal
+    from spark_rapids_tpu.columnar import HostBatch, to_device
+    from spark_rapids_tpu.columnar.device import to_host
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    from spark_rapids_tpu.exec.evaluator import evaluate_projection
+    data = {"d": pa.array([decimal.Decimal("123.45"),
+                           decimal.Decimal("-126.00"),
+                           decimal.Decimal("0.01")],
+                          pa.decimal128(5, 2)),
+            "i": pa.array([115, -125, 2**60 + 7], pa.int64())}
+    db = to_device(HostBatch.from_pydict(data))
+    rd = E.Round(E.ColumnRef("d"), -1).bind(db.schema)
+    ri = E.Round(E.ColumnRef("i"), -1).bind(db.schema)
+    out = to_host(evaluate_projection([rd, ri], ["rd", "ri"], db,
+                                      DEFAULT_CONF))
+    assert [str(v) for v in out.rb.column("rd").to_pylist()] == \
+        ["120", "-130", "0"]
+    # 2**60+7 = ...846983 -> HALF_UP at tens -> ...846980 (exact int64)
+    assert out.rb.column("ri").to_pylist() == \
+        [120, -130, (2 ** 60 + 7) // 10 * 10]
+    assert_device_cpu_equal([E.Round(E.ColumnRef("i"), -1)],
+                            {"i": data["i"]})
+
+
+def test_round_decimal_carry_precision():
+    import decimal
+    data = {"d": pa.array([decimal.Decimal("999.99")], pa.decimal128(5, 2))}
+    r = E.Round(E.ColumnRef("d"), -1)
+    schema = t.StructType([t.StructField("d", t.DecimalType(5, 2))])
+    b = r.bind(schema)
+    assert b.dtype.precision >= 4       # 1000 fits
+    assert_device_cpu_equal([E.Round(E.ColumnRef("d"), -1)], data)
+
+
+def test_greatest_null_first_child_types():
+    data = {"x": pa.array([1.5, 2.5])}
+    g = E.Greatest(E.Literal(None, t.NULL), E.ColumnRef("x"))
+    schema = t.StructType([t.StructField("x", t.DOUBLE)])
+    assert isinstance(g.bind(schema).dtype, t.DoubleType)
+
+
+def test_greatest_signed_zero():
+    data = {"a": pa.array([-0.0, 0.0]), "b": pa.array([0.0, -0.0])}
+    from spark_rapids_tpu.columnar import HostBatch, to_device
+    from spark_rapids_tpu.columnar.device import to_host
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    from spark_rapids_tpu.exec.evaluator import evaluate_projection
+    db = to_device(HostBatch.from_pydict(data))
+    g = E.Greatest(E.ColumnRef("a"), E.ColumnRef("b")).bind(db.schema)
+    l = E.Least(E.ColumnRef("a"), E.ColumnRef("b")).bind(db.schema)
+    out = to_host(evaluate_projection([g, l], ["g", "l"], db, DEFAULT_CONF))
+    import math
+    assert all(math.copysign(1.0, v) > 0
+               for v in out.rb.column("g").to_pylist())
+    assert all(math.copysign(1.0, v) < 0
+               for v in out.rb.column("l").to_pylist())
+
+
+def test_round_wide_decimal_tagged():
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    schema = t.StructType([t.StructField("w", t.DecimalType(30, 2))])
+    r = E.Round(E.ColumnRef("w"), 1).bind(schema)
+    assert any("128-bit" in x for x in r.unsupported_reasons(DEFAULT_CONF))
+    g = E.Greatest(E.ColumnRef("w"), E.ColumnRef("w")).bind(schema)
+    assert any("128-bit" in x for x in g.unsupported_reasons(DEFAULT_CONF))

@@ -90,3 +90,14 @@ def test_disk_tier_uses_native(tmp_path):
     hb = sp.get_host()
     assert hb.rb.to_pydict() == before
     sp.close()
+
+
+def test_shuffle_block_bad_offset_clean_error(tmp_path):
+    path = str(tmp_path / "x.dat")
+    w = native.ShuffleBlockWriter(path)
+    off = w.append(b"payload" * 10)
+    w.close()
+    with pytest.raises(IOError):
+        native.read_shuffle_block(path, off + 8)   # misaligned offset
+    with pytest.raises(IOError):
+        native.read_shuffle_block(path, 10**6)     # beyond EOF
