@@ -152,6 +152,12 @@ AGG_FALLBACK_PARTITIONS = conf(
     "(reference GpuAggregateExec repartition-based fallback).",
     checker=_positive, internal=True)
 
+CBO_ENABLED = conf(
+    "spark.rapids.tpu.sql.optimizer.enabled", False,
+    "Cost-based placement pass: un-tag isolated cheap device operators "
+    "whose two host<->device transitions outweigh the device win "
+    "(reference CostBasedOptimizer, also off by default).")
+
 RETRY_ENABLED = conf(
     "spark.rapids.tpu.sql.retry.enabled", True,
     "Retry device work with halved batches on HBM RESOURCE_EXHAUSTED "

@@ -1,0 +1,52 @@
+"""Cost-based placement optimizer — the CostBasedOptimizer role.
+
+Reference: CostBasedOptimizer.scala:54 (optional, OFF by default) walks
+the tagged meta tree and *rejects* GPU placement where transition costs
+outweigh the speedup, using static per-operator default costs
+(GpuCostModel:334) rather than real statistics.
+
+Same shape here: after tagging, a device-placed operator that forms an
+ISLAND — every child and the parent stay on the CPU — pays two
+host<->device transitions (upload + download of full batches) to run one
+operator.  For cheap row-parallel operators (project/filter/limit/union)
+the transition cost dominates, so the pass un-tags them with a recorded
+cost reason (visible in explain, like every other fallback).  Expensive
+operators (joins, aggregates, sorts, windows) stay on device even as
+islands — the compute win covers the transfers.
+
+Enabled by `spark.rapids.tpu.sql.optimizer.enabled` (default false, as in
+the reference).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from . import logical as L
+
+#: operator classes whose device win is too small to buy two transitions
+_CHEAP = (L.LogicalProject, L.LogicalFilter, L.LogicalLimit,
+          L.LogicalUnion, L.LogicalExpand)
+
+
+def apply_cbo(meta) -> int:
+    """Post-tag pass over a PlanMeta tree; returns how many nodes were
+    un-tagged for cost."""
+    return _walk(meta, parent_replaceable=False)
+
+
+def _walk(meta, parent_replaceable: bool) -> int:
+    changed = 0
+    for c in meta.children:
+        changed += _walk(c, parent_replaceable=meta.can_replace)
+    if not meta.can_replace:
+        return changed
+    if not isinstance(meta.node, _CHEAP):
+        return changed
+    children_on_device = any(c.can_replace for c in meta.children)
+    if parent_replaceable or children_on_device:
+        return changed
+    meta.will_not_work(
+        "cost-based optimizer: isolated cheap operator — two "
+        "host<->device transitions outweigh the device win "
+        "(spark.rapids.tpu.sql.optimizer.enabled)")
+    return changed + 1

@@ -798,6 +798,10 @@ def apply_overrides(plan: L.LogicalPlan,
     _push_down_filters(plan)
     meta = wrap_plan(plan, conf)
     meta.tag()
+    from ..config import CBO_ENABLED
+    if conf.get(CBO_ENABLED):
+        from .cbo import apply_cbo
+        apply_cbo(meta)
     mode = conf.explain
     if mode != "NONE":
         for line in meta.explain_lines():

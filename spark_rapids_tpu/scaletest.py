@@ -1,0 +1,132 @@
+"""Scale/stress harness — the integration_tests ScaleTest role.
+
+Reference: integration_tests/src/main/scala/.../scaletest/ — QuerySpecs
+(~30 join/agg/window queries over generated a-f tables), per-query
+timeout, TestReport with timings.  Data comes from the datagen DSL
+(datagen/bigDataGen.scala) with key-groups for join correlation.
+
+Usage:
+    python -m spark_rapids_tpu.scaletest --rows 100000 --timeout 120
+or programmatically: `run_scale_test(rows=...)` -> report dict.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from .datagen import (BooleanGen, DateGen, DecimalGen, DoubleGen, IntGen,
+                      KeyGroupGen, LongGen, StringGen, gen_table)
+from .plan import expressions as E
+from .plan.aggregates import Average, Count, Max, Min, Sum
+from .plan.window import Rank, RowNumber, WindowFrame, WinSum
+from .session import DataFrame, TpuSession, col
+
+
+def build_tables(rows: int, seed: int = 0) -> Dict[str, pa.Table]:
+    """Tables a/b/c with correlated keys (key-groups) and mixed types."""
+    kg = KeyGroupGen(num_keys=max(rows // 20, 10), nullable=0.05)
+    small_kg = KeyGroupGen(num_keys=50, nullable=0.02)
+    a = gen_table([("key", kg), ("grp", small_kg),
+                   ("i", IntGen()), ("l", LongGen(-10**9, 10**9)),
+                   ("d", DoubleGen()), ("s", StringGen()),
+                   ("dec", DecimalGen(12, 2)), ("dt", DateGen()),
+                   ("b", BooleanGen())], rows, seed=seed)
+    b = gen_table([("key", kg), ("v", LongGen(-10**6, 10**6)),
+                   ("w", DoubleGen())], max(rows // 2, 10), seed=seed + 1)
+    c = gen_table([("grp", small_kg), ("name", StringGen(1, 8))],
+                  60, seed=seed + 2)
+    return {"a": a, "b": b, "c": c}
+
+
+def query_specs(s: TpuSession, t: Dict[str, pa.Table]) -> Dict[str, Callable]:
+    a = lambda: s.from_arrow(t["a"])          # noqa: E731
+    b = lambda: s.from_arrow(t["b"])          # noqa: E731
+    c = lambda: s.from_arrow(t["c"])          # noqa: E731
+    return {
+        "full_agg": lambda: a().agg(
+            (Sum(col("l")), "sl"), (Average(col("d")), "ad"),
+            (Min(col("i")), "mi"), (Max(col("i")), "ma"),
+            (Count(None), "n")),
+        "group_agg": lambda: a().group_by("grp").agg(
+            (Sum(col("dec")), "sd"), (Count(col("s")), "cs")),
+        "high_card_agg": lambda: a().group_by("key").agg(
+            (Count(None), "n"), (Sum(col("l")), "sl")),
+        "filter_project": lambda: a().filter(
+            E.GreaterThan(col("d"), E.Literal(0.0))).select(
+            E.Multiply(col("l"), E.Literal(2)), col("s"),
+            names=["l2", "s"]),
+        "inner_join": lambda: a().join(
+            b(), left_on=["key"], right_on=["key"]),
+        "outer_join_agg": lambda: a().join(
+            b(), how="left_outer", left_on=["key"], right_on=["key"])
+            .group_by("grp").agg((Count(col("v")), "cv")),
+        "broadcastish_join": lambda: a().join(
+            c(), left_on=["grp"], right_on=["grp"]),
+        "sort": lambda: a().sort(("l", False, False), ("i", True, True)),
+        "topn": lambda: a().sort(("d", False, False)).limit(100),
+        "window": lambda: a().window(
+            [(RowNumber(), "rn"), (Rank(), "rk"),
+             (WinSum(col("l"), WindowFrame("rows", None, 0)), "rs")],
+            partition_by=["grp"], order_by=[("l", True, True)]),
+        "distinctish": lambda: a().group_by("grp", "b").agg(
+            (Count(None), "n")),
+    }
+
+
+def run_scale_test(rows: int = 50_000, seed: int = 0,
+                   timeout_s: float = 300.0,
+                   queries: Optional[List[str]] = None) -> dict:
+    """Run every query spec with a per-query wall clock; returns the
+    TestReport-shaped dict (name, status, rows, seconds)."""
+    tables = build_tables(rows, seed)
+    s = TpuSession()
+    specs = query_specs(s, tables)
+    if queries:
+        specs = {k: v for k, v in specs.items() if k in queries}
+    import concurrent.futures as cf
+    report = {"rows": rows, "seed": seed, "results": []}
+    pool = cf.ThreadPoolExecutor(max_workers=1)
+    for name, build in specs.items():
+        t0 = time.perf_counter()
+        entry = {"name": name}
+        fut = pool.submit(lambda b=build: b().collect())
+        try:
+            out = fut.result(timeout=timeout_s)
+            dt = time.perf_counter() - t0
+            entry.update(status="OK", out_rows=out.num_rows,
+                         seconds=round(dt, 3))
+        except cf.TimeoutError:
+            # true watchdog: stop waiting and move on (the worker thread
+            # keeps running to completion — python cannot kill it — so a
+            # fresh pool takes over for the remaining queries)
+            entry.update(status="TIMEOUT", seconds=round(timeout_s, 3))
+            pool = cf.ThreadPoolExecutor(max_workers=1)
+        except Exception as e:                   # noqa: BLE001
+            entry.update(status="FAIL", error=repr(e),
+                         seconds=round(time.perf_counter() - t0, 3))
+        report["results"].append(entry)
+    pool.shutdown(wait=False)
+    report["passed"] = sum(r["status"] == "OK" for r in report["results"])
+    report["total"] = len(report["results"])
+    return report
+
+
+def main():
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=50_000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--queries", nargs="*", default=None)
+    args = p.parse_args()
+    report = run_scale_test(args.rows, args.seed, args.timeout,
+                            args.queries)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,14 @@ class DataFrame:
     def union(self, other: "DataFrame") -> "DataFrame":
         return self._wrap(L.LogicalUnion(self._plan, other._plan))
 
+    def window(self, window_exprs, partition_by=(), order_by=()
+               ) -> "DataFrame":
+        """Append window function columns: window_exprs = (spec, name)
+        pairs (plan/window.py specs)."""
+        return self._wrap(L.LogicalWindow(list(window_exprs),
+                                          list(partition_by),
+                                          list(order_by), self._plan))
+
     def cache(self) -> "DataFrame":
         """Materialize once as compressed parquet bytes; downstream plans
         re-decode from the cache (ParquetCachedBatchSerializer role)."""

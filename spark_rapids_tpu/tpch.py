@@ -81,13 +81,32 @@ def gen_tables(scale: float = 0.01, seed: int = 20240706
         "s_acctbal": money_from_cents(
             rng.integers(-99999, 999999, n_supp), 12, 2),
     })
+    colors = ["green", "blue", "red", "ivory", "khaki"]
     part = pa.table({
         "p_partkey": pa.array(range(n_part), pa.int64()),
+        "p_name": pa.array([f"{c} polished item{i}" for i, c in
+                            enumerate(rng.choice(colors, n_part))]),
         "p_type": pa.array(rng.choice(
             ["ECONOMY ANODIZED STEEL", "LARGE BRUSHED BRASS",
              "STANDARD POLISHED TIN", "SMALL PLATED COPPER",
              "PROMO BURNISHED NICKEL"], n_part)),
+        "p_brand": pa.array([f"Brand#{b}" for b in
+                             rng.integers(11, 56, n_part)]),
+        "p_container": pa.array(rng.choice(
+            ["SM CASE", "SM BOX", "MED BAG", "MED BOX", "LG CASE",
+             "LG BOX", "JUMBO PKG"], n_part)),
         "p_size": pa.array(rng.integers(1, 51, n_part), pa.int32()),
+    })
+
+    n_ps = n_part * 2
+    partsupp = pa.table({
+        "ps_partkey": pa.array(
+            np.concatenate([np.arange(n_part), np.arange(n_part)]),
+            pa.int64()),
+        "ps_suppkey": pa.array(rng.integers(0, n_supp, n_ps), pa.int64()),
+        "ps_availqty": pa.array(rng.integers(1, 10000, n_ps), pa.int32()),
+        "ps_supplycost": money_from_cents(
+            rng.integers(1_00, 1000_00, n_ps), 12, 2),
     })
 
     o_date_lo = _days(pydt.date(1992, 1, 1))
@@ -103,6 +122,10 @@ def gen_tables(scale: float = 0.01, seed: int = 20240706
         "o_orderpriority": pa.array(rng.choice(
             ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED",
              "5-LOW"], n_ord)),
+        "o_comment": pa.array(rng.choice(
+            ["fast delivery", "special requests pending",
+             "nothing unusual", "pending special requests now",
+             "routine order"], n_ord)),
         "o_totalprice": money_from_cents(
             rng.integers(100_00, 500_000_00, n_ord), 12, 2),
     })
@@ -132,8 +155,8 @@ def gen_tables(scale: float = 0.01, seed: int = 20240706
              "TRUCK"], n_li)),
     })
     return {"lineitem": lineitem, "orders": orders, "customer": customer,
-            "supplier": supplier, "part": part, "nation": nation,
-            "region": region}
+            "supplier": supplier, "part": part, "partsupp": partsupp,
+            "nation": nation, "region": region}
 
 
 # ---------------------------------------------------------------------------
@@ -362,8 +385,127 @@ def q18(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
             .limit(100))
 
 
+def q7(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Volume shipping between FRANCE and GERMANY (nation joined twice
+    under renames)."""
+    d_lo = _days(pydt.date(1995, 1, 1))
+    d_hi = _days(pydt.date(1996, 12, 31))
+    supp_nation = s.from_arrow(t["nation"]).select(
+        col("n_nationkey"), col("n_name"),
+        names=["sn_key", "supp_nation"]).filter(
+        E.In(col("supp_nation"), ["FRANCE", "GERMANY"]))
+    cust_nation = s.from_arrow(t["nation"]).select(
+        col("n_nationkey"), col("n_name"),
+        names=["cn_key", "cust_nation"]).filter(
+        E.In(col("cust_nation"), ["FRANCE", "GERMANY"]))
+    li = s.from_arrow(t["lineitem"]).filter(
+        E.And(E.GreaterThanOrEqual(col("l_shipdate"),
+                                   E.Literal(d_lo, DTYPE_DATE)),
+              E.LessThanOrEqual(col("l_shipdate"),
+                                E.Literal(d_hi, DTYPE_DATE))))
+    j = (li.join(s.from_arrow(t["supplier"]),
+                 left_on=["l_suppkey"], right_on=["s_suppkey"])
+         .join(supp_nation, left_on=["s_nationkey"], right_on=["sn_key"])
+         .join(s.from_arrow(t["orders"]),
+               left_on=["l_orderkey"], right_on=["o_orderkey"])
+         .join(s.from_arrow(t["customer"]),
+               left_on=["o_custkey"], right_on=["c_custkey"])
+         .join(cust_nation, left_on=["c_nationkey"], right_on=["cn_key"])
+         .filter(E.Not(E.EqualTo(col("supp_nation"),
+                                 col("cust_nation")))))
+    volume = E.Multiply(col("l_extendedprice"),
+                        E.Subtract(E.Literal(1), col("l_discount")))
+    year = DT.Year(col("l_shipdate"))
+    return (j.group_by(col("supp_nation"), col("cust_nation"),
+                       E.Alias(year, "l_year"))
+            .agg((Sum(volume), "revenue"))
+            .sort("supp_nation", "cust_nation", "l_year"))
+
+
+def q9(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Product type profit measure: the spec's 6-table join with
+    ps_supplycost (profit = price*(1-disc) - supplycost*qty)."""
+    from .plan.strings import Contains
+    part = s.from_arrow(t["part"]).filter(
+        Contains(col("p_name"), "green"))
+    li = s.from_arrow(t["lineitem"])
+    ps = s.from_arrow(t["partsupp"])
+    j = (li.join(part, left_on=["l_partkey"], right_on=["p_partkey"])
+         .join(s.from_arrow(t["supplier"]),
+               left_on=["l_suppkey"], right_on=["s_suppkey"])
+         .join(ps, left_on=["l_partkey", "l_suppkey"],
+               right_on=["ps_partkey", "ps_suppkey"])
+         .join(s.from_arrow(t["orders"]),
+               left_on=["l_orderkey"], right_on=["o_orderkey"])
+         .join(s.from_arrow(t["nation"]),
+               left_on=["s_nationkey"], right_on=["n_nationkey"]))
+    amount = E.Subtract(
+        E.Multiply(col("l_extendedprice"),
+                   E.Subtract(E.Literal(1), col("l_discount"))),
+        E.Multiply(col("ps_supplycost"), col("l_quantity")))
+    year = DT.Year(col("o_orderdate"))
+    return (j.group_by(col("n_name"), E.Alias(year, "o_year"))
+            .agg((Sum(amount), "sum_profit"))
+            .sort(("n_name", True, True), ("o_year", False, False)))
+
+
+def q13(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Customer distribution: two-level aggregation over a left outer
+    join with a NOT-LIKE filtered order side."""
+    from .plan.strings import Contains
+    orders = s.from_arrow(t["orders"]).filter(
+        E.Not(E.And(Contains(col("o_comment"), "special"),
+                    Contains(col("o_comment"), "requests"))))
+    cust = s.from_arrow(t["customer"])
+    j = cust.join(orders, how="left_outer",
+                  left_on=["c_custkey"], right_on=["o_custkey"])
+    per_cust = (j.group_by("c_custkey")
+                .agg((Count(col("o_orderkey")), "c_count")))
+    return (per_cust.group_by("c_count")
+            .agg((Count(None), "custdist"))
+            .sort(("custdist", False, False), ("c_count", False, False)))
+
+
+def q19(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Discounted revenue: disjunction of brand/container/quantity
+    conjuncts (the OR-of-ANDs predicate shape)."""
+    import decimal as pydec
+    li = s.from_arrow(t["lineitem"]).filter(
+        E.And(E.In(col("l_shipmode"), ["AIR", "REG AIR"]),
+              E.EqualTo(col("l_returnflag"), E.Literal("N"))))
+    part = s.from_arrow(t["part"])
+    j = li.join(part, left_on=["l_partkey"], right_on=["p_partkey"])
+
+    def qty_between(lo, hi):
+        return E.And(
+            E.GreaterThanOrEqual(col("l_quantity"),
+                                 E.Literal(pydec.Decimal(lo))),
+            E.LessThanOrEqual(col("l_quantity"),
+                              E.Literal(pydec.Decimal(hi))))
+    branch1 = E.And(E.And(E.EqualTo(col("p_brand"), E.Literal("Brand#12")),
+                          E.In(col("p_container"),
+                               ["SM CASE", "SM BOX"])),
+                    E.And(qty_between("1", "11"),
+                          E.LessThanOrEqual(col("p_size"), E.Literal(5))))
+    branch2 = E.And(E.And(E.EqualTo(col("p_brand"), E.Literal("Brand#23")),
+                          E.In(col("p_container"),
+                               ["MED BAG", "MED BOX"])),
+                    E.And(qty_between("10", "20"),
+                          E.LessThanOrEqual(col("p_size"), E.Literal(10))))
+    branch3 = E.And(E.And(E.EqualTo(col("p_brand"), E.Literal("Brand#34")),
+                          E.In(col("p_container"),
+                               ["LG CASE", "LG BOX", "JUMBO PKG"])),
+                    E.And(qty_between("20", "30"),
+                          E.LessThanOrEqual(col("p_size"), E.Literal(15))))
+    revenue = E.Multiply(col("l_extendedprice"),
+                         E.Subtract(E.Literal(1), col("l_discount")))
+    return (j.filter(E.Or(E.Or(branch1, branch2), branch3))
+            .agg((Sum(revenue), "revenue")))
+
+
 from . import types as _t           # noqa: E402
 DTYPE_DATE = _t.DATE
 
-QUERIES = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q10": q10,
-           "q12": q12, "q14": q14, "q17": q17, "q18": q18}
+QUERIES = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q7": q7,
+           "q9": q9, "q10": q10, "q12": q12, "q13": q13, "q14": q14,
+           "q17": q17, "q18": q18, "q19": q19}

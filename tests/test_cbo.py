@@ -1,0 +1,63 @@
+"""Cost-based optimizer tests (CostBasedOptimizer role, off by default)."""
+import pyarrow as pa
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.overrides import apply_overrides
+from spark_rapids_tpu.plan.udf import PythonUDF
+
+
+def _island_plan(tbl):
+    """CPU(project pyudf) -> device-capable Filter -> CPU(project pyudf):
+    the middle Filter is a device island costing two transitions."""
+    inner = L.LogicalProject(
+        [PythonUDF(lambda x: int(x) + 1, t.LONG, E.ColumnRef("x")),
+         E.ColumnRef("x")],
+        L.LogicalScan(tbl), names=["y", "x"])
+    filt = L.LogicalFilter(E.GreaterThan(E.ColumnRef("y"), E.Literal(5)),
+                           inner)
+    return L.LogicalProject(
+        [PythonUDF(lambda y: int(y) * 2, t.LONG, E.ColumnRef("y"))],
+        filt, names=["z"])
+
+
+def test_cbo_off_by_default_keeps_island():
+    tbl = pa.table({"x": pa.array(range(20), pa.int64())})
+    q = apply_overrides(_island_plan(tbl))
+    tree = q.root.tree_string()
+    assert "FilterExec" in tree            # island stays on device
+    assert "HostToDeviceExec" in tree
+
+
+def test_cbo_untags_cheap_island():
+    tbl = pa.table({"x": pa.array(range(20), pa.int64())})
+    conf = TpuConf({"spark.rapids.tpu.sql.optimizer.enabled": True})
+    q = apply_overrides(_island_plan(tbl), conf)
+    tree = q.root.tree_string()
+    assert "CpuFilterExec" in tree         # island pushed to CPU
+    assert "HostToDeviceExec" not in tree
+    # same results either way
+    out = q.collect()
+    exp = [(x + 1) * 2 for x in range(20) if x + 1 > 5]
+    assert sorted(out.column("z").to_pylist()) == sorted(exp)
+    # reason visible in explain
+    assert "cost-based" in q.explain()
+
+
+def test_cbo_keeps_expensive_island():
+    from spark_rapids_tpu.plan.aggregates import Sum
+    tbl = pa.table({"k": pa.array([1, 1, 2], pa.int64()),
+                    "x": pa.array([1, 2, 3], pa.int64())})
+    inner = L.LogicalProject(
+        [PythonUDF(lambda x: int(x), t.LONG, E.ColumnRef("x")),
+         E.ColumnRef("k")],
+        L.LogicalScan(tbl), names=["v", "k"])
+    agg = L.LogicalAggregate(["k"], [(Sum(E.ColumnRef("v")), "s")], inner)
+    outer = L.LogicalProject(
+        [PythonUDF(lambda s: int(s), t.LONG, E.ColumnRef("s"))],
+        agg, names=["o"])
+    conf = TpuConf({"spark.rapids.tpu.sql.optimizer.enabled": True})
+    q = apply_overrides(outer, conf)
+    assert "HashAggregateExec" in q.root.tree_string()   # agg stays device

@@ -1,0 +1,16 @@
+"""ScaleTest harness smoke (integration_tests ScaleTest role)."""
+from spark_rapids_tpu.scaletest import build_tables, run_scale_test
+
+
+def test_scale_harness_all_green():
+    report = run_scale_test(rows=4000, seed=3, timeout_s=600)
+    failures = [r for r in report["results"] if r["status"] != "OK"]
+    assert not failures, failures
+    assert report["passed"] == report["total"] >= 11
+
+
+def test_tables_key_correlation():
+    t = build_tables(2000)
+    a_keys = set(t["a"].column("key").drop_null().to_pylist())
+    b_keys = set(t["b"].column("key").drop_null().to_pylist())
+    assert len(a_keys & b_keys) > 10

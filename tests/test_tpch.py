@@ -184,3 +184,37 @@ def test_q12_independent_oracle(tables, session):
     for sm in got_hi:
         assert got_hi[sm] == hi_c.get(sm, 0)
         assert got_lo[sm] == lo_c.get(sm, 0)
+
+
+@pytest.mark.parametrize("qname", ["q7", "q9", "q13", "q19"])
+def test_query_breadth2_device_vs_cpu(qname, tables, session):
+    df = tpch.QUERIES[qname](session, tables)
+    dev = df.collect()
+    cpu = cpu_oracle(tpch.QUERIES[qname](session, tables))
+    got, exp = _norm(dev), _norm(cpu)
+    assert len(got) == len(exp), (qname, len(got), len(exp))
+    if qname == "q19":
+        for g, e in zip(got[0], exp[0]):
+            if g is None or e is None:
+                assert g == e
+            else:
+                assert abs(g - e) <= 1e-9 * max(1.0, abs(e))
+    else:
+        assert got == exp, (qname, got[:3], exp[:3])
+
+
+def test_q13_independent_oracle(tables, session):
+    dev = tpch.q13(session, tables).collect()
+    import collections
+    orders, cust = tables["orders"], tables["customer"]
+    ok_orders = collections.Counter()
+    for ck, cm in zip(orders["o_custkey"].to_pylist(),
+                      orders["o_comment"].to_pylist()):
+        if not ("special" in cm and "requests" in cm):
+            ok_orders[ck] += 1
+    dist = collections.Counter()
+    for ck in cust["c_custkey"].to_pylist():
+        dist[ok_orders.get(ck, 0)] += 1
+    got = dict(zip(dev.column("c_count").to_pylist(),
+                   dev.column("custdist").to_pylist()))
+    assert got == dict(dist)
