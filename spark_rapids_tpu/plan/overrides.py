@@ -35,6 +35,7 @@ from ..exec import host_exec as H
 from ..io.parquet import (CpuParquetScanExec, LogicalParquetScan,
                           ParquetScanExec)
 from ..io.orc import CpuOrcScanExec, LogicalOrcScan, OrcScanExec
+from ..io.avro import LogicalAvroScan
 from ..io.text import (CpuTextScanExec, LogicalCsvScan, LogicalJsonScan,
                        TextScanExec)
 from ..exec.plan import (CoalesceBatchesExec, ExecContext, ExpandExec,
@@ -202,6 +203,7 @@ exec_rule(LogicalParquetScan, _DEVICE_SIMPLE, "parquet scan")
 exec_rule(LogicalCsvScan, _DEVICE_SIMPLE, "csv scan")
 exec_rule(LogicalJsonScan, _DEVICE_SIMPLE, "json scan")
 exec_rule(LogicalOrcScan, _DEVICE_SIMPLE, "orc scan")
+exec_rule(LogicalAvroScan, _DEVICE_SIMPLE, "avro scan")
 
 
 # ---------------------------------------------------------------------------
@@ -703,6 +705,7 @@ _META_FOR: Dict[type, Type[PlanMeta]] = {
     LogicalCsvScan: TextScanMeta,
     LogicalJsonScan: TextScanMeta,
     LogicalOrcScan: TextScanMeta,
+    LogicalAvroScan: TextScanMeta,
 }
 
 

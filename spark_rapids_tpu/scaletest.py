@@ -23,7 +23,7 @@ from .datagen import (BooleanGen, DateGen, DecimalGen, DoubleGen, IntGen,
 from .plan import expressions as E
 from .plan.aggregates import Average, Count, Max, Min, Sum
 from .plan.window import Rank, RowNumber, WindowFrame, WinSum
-from .session import DataFrame, TpuSession, col
+from .session import TpuSession, col
 
 
 def build_tables(rows: int, seed: int = 0) -> Dict[str, pa.Table]:
@@ -87,29 +87,35 @@ def run_scale_test(rows: int = 50_000, seed: int = 0,
     specs = query_specs(s, tables)
     if queries:
         specs = {k: v for k, v in specs.items() if k in queries}
-    import concurrent.futures as cf
+    import threading
     report = {"rows": rows, "seed": seed, "results": []}
-    pool = cf.ThreadPoolExecutor(max_workers=1)
     for name, build in specs.items():
         t0 = time.perf_counter()
         entry = {"name": name}
-        fut = pool.submit(lambda b=build: b().collect())
-        try:
-            out = fut.result(timeout=timeout_s)
-            dt = time.perf_counter() - t0
-            entry.update(status="OK", out_rows=out.num_rows,
-                         seconds=round(dt, 3))
-        except cf.TimeoutError:
-            # true watchdog: stop waiting and move on (the worker thread
-            # keeps running to completion — python cannot kill it — so a
-            # fresh pool takes over for the remaining queries)
+        res: dict = {}
+
+        def work(b=build, res=res):
+            try:
+                res["out"] = b().collect()
+            except Exception as e:               # noqa: BLE001
+                res["err"] = e
+
+        # daemon thread: python cannot kill a hung query, but a daemon is
+        # not joined at interpreter exit, so a TIMEOUT never wedges the
+        # process and abandoned workers need no pool bookkeeping
+        th = threading.Thread(target=work, daemon=True,
+                              name=f"scaletest-{name}")
+        th.start()
+        th.join(timeout_s)
+        if th.is_alive():
             entry.update(status="TIMEOUT", seconds=round(timeout_s, 3))
-            pool = cf.ThreadPoolExecutor(max_workers=1)
-        except Exception as e:                   # noqa: BLE001
-            entry.update(status="FAIL", error=repr(e),
+        elif "err" in res:
+            entry.update(status="FAIL", error=repr(res["err"]),
+                         seconds=round(time.perf_counter() - t0, 3))
+        else:
+            entry.update(status="OK", out_rows=res["out"].num_rows,
                          seconds=round(time.perf_counter() - t0, 3))
         report["results"].append(entry)
-    pool.shutdown(wait=False)
     report["passed"] = sum(r["status"] == "OK" for r in report["results"])
     report["total"] = len(report["results"])
     return report

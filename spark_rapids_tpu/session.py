@@ -62,6 +62,14 @@ class TpuSession:
         from .io.text import LogicalJsonScan
         return DataFrame(LogicalJsonScan(list(paths), schema, opts), self)
 
+    def read_orc(self, *paths: str, schema=None, **opts) -> "DataFrame":
+        from .io.orc import LogicalOrcScan
+        return DataFrame(LogicalOrcScan(list(paths), schema, opts), self)
+
+    def read_avro(self, *paths: str, schema=None, **opts) -> "DataFrame":
+        from .io.avro import LogicalAvroScan
+        return DataFrame(LogicalAvroScan(list(paths), schema, opts), self)
+
 
 class GroupedData:
     def __init__(self, df: "DataFrame", keys: Sequence):

@@ -66,9 +66,17 @@ def gen_tables(scale: float = 0.01, seed: int = 20240706
         "n_name": pa.array(nations),
         "n_regionkey": pa.array(region_of, pa.int64()),
     })
+    c_nation = rng.integers(0, 25, n_cust)
     customer = pa.table({
         "c_custkey": pa.array(range(n_cust), pa.int64()),
-        "c_nationkey": pa.array(rng.integers(0, 25, n_cust), pa.int64()),
+        "c_name": pa.array([f"Customer#{i:09d}" for i in range(n_cust)]),
+        "c_nationkey": pa.array(c_nation, pa.int64()),
+        # spec: phone country code = nationkey + 10 (TPC-H 4.2.2.9)
+        "c_phone": pa.array([
+            f"{k + 10}-{a}-{b}-{c}" for k, a, b, c in zip(
+                c_nation, rng.integers(100, 1000, n_cust),
+                rng.integers(100, 1000, n_cust),
+                rng.integers(1000, 10000, n_cust))]),
         "c_mktsegment": pa.array(rng.choice(
             ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY",
              "HOUSEHOLD"], n_cust)),
@@ -77,13 +85,23 @@ def gen_tables(scale: float = 0.01, seed: int = 20240706
     })
     supplier = pa.table({
         "s_suppkey": pa.array(range(n_supp), pa.int64()),
+        "s_name": pa.array([f"Supplier#{i:09d}" for i in range(n_supp)]),
+        "s_address": pa.array([f"addr {i} lane" for i in range(n_supp)]),
+        "s_phone": pa.array([f"{11 + i % 25}-{i % 900 + 100}-55"
+                             for i in range(n_supp)]),
         "s_nationkey": pa.array(rng.integers(0, 25, n_supp), pa.int64()),
         "s_acctbal": money_from_cents(
             rng.integers(-99999, 999999, n_supp), 12, 2),
+        "s_comment": pa.array(rng.choice(
+            ["reliable and fast", "slow Customer Complaints recorded",
+             "usually on time", "pending Customer Complaints review",
+             "excellent record"], n_supp)),
     })
     colors = ["green", "blue", "red", "ivory", "khaki"]
     part = pa.table({
         "p_partkey": pa.array(range(n_part), pa.int64()),
+        "p_mfgr": pa.array([f"Manufacturer#{m}" for m in
+                            rng.integers(1, 6, n_part)]),
         "p_name": pa.array([f"{c} polished item{i}" for i, c in
                             enumerate(rng.choice(colors, n_part))]),
         "p_type": pa.array(rng.choice(
@@ -113,7 +131,13 @@ def gen_tables(scale: float = 0.01, seed: int = 20240706
     o_date_hi = _days(pydt.date(1998, 8, 2))
     orders = pa.table({
         "o_orderkey": pa.array(range(n_ord), pa.int64()),
-        "o_custkey": pa.array(rng.integers(0, n_cust, n_ord), pa.int64()),
+        # spec 4.2.3: orders reference only custkeys that are not a
+        # multiple of 3 (a third of customers have no orders -> q13/q22
+        # anti-join paths see real misses)
+        "o_custkey": pa.array(
+            np.array([k for k in range(n_cust) if k % 3 != 0], np.int64)[
+                rng.integers(0, n_cust - (n_cust + 2) // 3, n_ord)],
+            pa.int64()),
         "o_orderdate": pa.array(
             rng.integers(o_date_lo, o_date_hi, n_ord).astype(np.int32),
             pa.int32()).cast(pa.date32()),
@@ -503,9 +527,247 @@ def q19(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
             .agg((Sum(revenue), "revenue")))
 
 
+def q2(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Minimum-cost supplier: correlated MIN subquery as a self-join on
+    (partkey, min cost)."""
+    from .plan.strings import EndsWith
+    part = s.from_arrow(t["part"]).filter(
+        E.And(E.EqualTo(col("p_size"), E.Literal(15)),
+              EndsWith(col("p_type"), "BRASS")))
+    europe = (s.from_arrow(t["region"])
+              .filter(E.EqualTo(col("r_name"), E.Literal("EUROPE")))
+              .join(s.from_arrow(t["nation"]),
+                    left_on=["r_regionkey"], right_on=["n_regionkey"]))
+    esupp = europe.join(s.from_arrow(t["supplier"]),
+                        left_on=["n_nationkey"], right_on=["s_nationkey"])
+    ps = s.from_arrow(t["partsupp"])
+    eps = ps.join(esupp, left_on=["ps_suppkey"], right_on=["s_suppkey"]) \
+        .join(part, left_on=["ps_partkey"], right_on=["p_partkey"])
+    from .plan.aggregates import Min
+    mins = (eps.group_by("ps_partkey")
+            .agg((Min(col("ps_supplycost")), "min_cost"))
+            .select(col("ps_partkey"), col("min_cost"),
+                    names=["mc_partkey", "min_cost"]))
+    j = eps.join(mins, left_on=["ps_partkey", "ps_supplycost"],
+                 right_on=["mc_partkey", "min_cost"])
+    return (j.select(col("s_acctbal"), col("s_name"), col("n_name"),
+                     col("p_partkey"), col("p_mfgr"), col("s_address"),
+                     col("s_phone"))
+            .sort(("s_acctbal", False, False), ("n_name", True, True),
+                  ("s_name", True, True), ("p_partkey", True, True))
+            .limit(100))
+
+
+def q8(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """National market share: BRAZIL's share of AMERICA's ECONOMY
+    ANODIZED STEEL volume per year."""
+    d_lo = _days(pydt.date(1995, 1, 1))
+    d_hi = _days(pydt.date(1996, 12, 31))
+    part = s.from_arrow(t["part"]).filter(
+        E.EqualTo(col("p_type"), E.Literal("ECONOMY ANODIZED STEEL")))
+    orders = s.from_arrow(t["orders"]).filter(
+        E.And(E.GreaterThanOrEqual(col("o_orderdate"),
+                                   E.Literal(d_lo, DTYPE_DATE)),
+              E.LessThanOrEqual(col("o_orderdate"),
+                                E.Literal(d_hi, DTYPE_DATE))))
+    n1 = (s.from_arrow(t["region"])
+          .filter(E.EqualTo(col("r_name"), E.Literal("AMERICA")))
+          .join(s.from_arrow(t["nation"]),
+                left_on=["r_regionkey"], right_on=["n_regionkey"])
+          .select(col("n_nationkey"), names=["cn_key"]))
+    n2 = s.from_arrow(t["nation"]).select(
+        col("n_nationkey"), col("n_name"), names=["sn_key", "supp_nation"])
+    j = (s.from_arrow(t["lineitem"])
+         .join(part, left_on=["l_partkey"], right_on=["p_partkey"])
+         .join(s.from_arrow(t["supplier"]),
+               left_on=["l_suppkey"], right_on=["s_suppkey"])
+         .join(orders, left_on=["l_orderkey"], right_on=["o_orderkey"])
+         .join(s.from_arrow(t["customer"]),
+               left_on=["o_custkey"], right_on=["c_custkey"])
+         .join(n1, left_on=["c_nationkey"], right_on=["cn_key"])
+         .join(n2, left_on=["s_nationkey"], right_on=["sn_key"]))
+    volume = E.Multiply(
+        E.Cast(col("l_extendedprice"), _t.DOUBLE),
+        E.Subtract(E.Literal(1.0), E.Cast(col("l_discount"), _t.DOUBLE)))
+    brazil = E.CaseWhen(
+        [(E.EqualTo(col("supp_nation"), E.Literal("BRAZIL")), volume)],
+        E.Literal(0.0))
+    year = DT.Year(col("o_orderdate"))
+    g = (j.group_by(E.Alias(year, "o_year"))
+         .agg((Sum(brazil), "brazil_vol"), (Sum(volume), "total_vol")))
+    share = E.Divide(col("brazil_vol"), col("total_vol"))
+    return (g.select(col("o_year"), share, names=["o_year", "mkt_share"])
+            .sort("o_year"))
+
+
+def q11(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Important stock identification: HAVING against a scalar subquery
+    (total value fraction) via a 1-row cross join."""
+    germany = (s.from_arrow(t["partsupp"])
+               .join(s.from_arrow(t["supplier"]),
+                     left_on=["ps_suppkey"], right_on=["s_suppkey"])
+               .join(s.from_arrow(t["nation"]).filter(
+                   E.EqualTo(col("n_name"), E.Literal("GERMANY"))),
+                   left_on=["s_nationkey"], right_on=["n_nationkey"]))
+    value = E.Multiply(E.Cast(col("ps_supplycost"), _t.DOUBLE),
+                       E.Cast(col("ps_availqty"), _t.DOUBLE))
+    per_part = (germany.group_by("ps_partkey")
+                .agg((Sum(value), "value")))
+    total = (germany.agg((Sum(value), "tv"))
+             .select(E.Multiply(col("tv"), E.Literal(0.0001)),
+                     names=["threshold"]))
+    j = per_part.join(total, how="cross")
+    return (j.filter(E.GreaterThan(col("value"), col("threshold")))
+            .select(col("ps_partkey"), col("value"))
+            .sort(("value", False, False), ("ps_partkey", True, True)))
+
+
+def q15(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Top supplier: revenue view + MAX scalar subquery."""
+    from .plan.aggregates import Max
+    d_lo = _days(pydt.date(1996, 1, 1))
+    d_hi = _days(pydt.date(1996, 4, 1))
+    li = s.from_arrow(t["lineitem"]).filter(
+        E.And(E.GreaterThanOrEqual(col("l_shipdate"),
+                                   E.Literal(d_lo, DTYPE_DATE)),
+              E.LessThan(col("l_shipdate"), E.Literal(d_hi, DTYPE_DATE))))
+    revenue = E.Multiply(
+        E.Cast(col("l_extendedprice"), _t.DOUBLE),
+        E.Subtract(E.Literal(1.0), E.Cast(col("l_discount"), _t.DOUBLE)))
+    rev = (li.group_by("l_suppkey")
+           .agg((Sum(revenue), "total_revenue")))
+    top = rev.agg((Max(col("total_revenue")), "max_revenue"))
+    j = (rev.join(top, how="cross")
+         .filter(E.EqualTo(col("total_revenue"), col("max_revenue")))
+         .join(s.from_arrow(t["supplier"]),
+               left_on=["l_suppkey"], right_on=["s_suppkey"]))
+    return (j.select(col("s_suppkey"), col("s_name"), col("s_address"),
+                     col("s_phone"), col("total_revenue"))
+            .sort("s_suppkey"))
+
+
+def q16(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Parts/supplier relationship: NOT IN subquery as anti join +
+    count(distinct)."""
+    from .plan.aggregates import CountDistinct
+    from .plan.strings import Contains, StartsWith
+    bad_supp = s.from_arrow(t["supplier"]).filter(
+        E.And(Contains(col("s_comment"), "Customer"),
+              Contains(col("s_comment"), "Complaints")))
+    part = s.from_arrow(t["part"]).filter(
+        E.And(E.Not(E.EqualTo(col("p_brand"), E.Literal("Brand#45"))),
+              E.And(E.Not(StartsWith(col("p_type"), "MEDIUM POLISHED")),
+                    E.In(E.Cast(col("p_size"), _t.INT),
+                         [49, 14, 23, 45, 19, 3, 36, 9]))))
+    ps = (s.from_arrow(t["partsupp"])
+          .join(bad_supp, how="left_anti",
+                left_on=["ps_suppkey"], right_on=["s_suppkey"])
+          .join(part, left_on=["ps_partkey"], right_on=["p_partkey"]))
+    return (ps.group_by("p_brand", "p_type", "p_size")
+            .agg((CountDistinct(col("ps_suppkey")), "supplier_cnt"))
+            .sort(("supplier_cnt", False, False), ("p_brand", True, True),
+                  ("p_type", True, True), ("p_size", True, True)))
+
+
+def q20(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Potential part promotion: nested IN subqueries as semi joins over
+    a half-of-shipped-quantity threshold."""
+    from .plan.strings import StartsWith
+    d_lo = _days(pydt.date(1994, 1, 1))
+    d_hi = _days(pydt.date(1995, 1, 1))
+    green = s.from_arrow(t["part"]).filter(
+        StartsWith(col("p_name"), "green"))
+    shipped = (s.from_arrow(t["lineitem"])
+               .filter(E.And(
+                   E.GreaterThanOrEqual(col("l_shipdate"),
+                                        E.Literal(d_lo, DTYPE_DATE)),
+                   E.LessThan(col("l_shipdate"),
+                              E.Literal(d_hi, DTYPE_DATE))))
+               .group_by("l_partkey", "l_suppkey")
+               .agg((Sum(col("l_quantity")), "sum_qty")))
+    shipped = shipped.select(
+        col("l_partkey"), col("l_suppkey"),
+        E.Multiply(E.Literal(0.5), E.Cast(col("sum_qty"), _t.DOUBLE)),
+        names=["sh_partkey", "sh_suppkey", "half_qty"])
+    ps = (s.from_arrow(t["partsupp"])
+          .join(green, how="left_semi",
+                left_on=["ps_partkey"], right_on=["p_partkey"])
+          .join(shipped, left_on=["ps_partkey", "ps_suppkey"],
+                right_on=["sh_partkey", "sh_suppkey"])
+          .filter(E.GreaterThan(E.Cast(col("ps_availqty"), _t.DOUBLE),
+                                col("half_qty"))))
+    supp = (s.from_arrow(t["supplier"])
+            .join(s.from_arrow(t["nation"]).filter(
+                E.EqualTo(col("n_name"), E.Literal("CANADA"))),
+                left_on=["s_nationkey"], right_on=["n_nationkey"])
+            .join(ps, how="left_semi",
+                  left_on=["s_suppkey"], right_on=["ps_suppkey"]))
+    return supp.select(col("s_name"), col("s_address")).sort("s_name")
+
+
+def q21(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Suppliers who kept orders waiting: EXISTS/NOT-EXISTS pair rewritten
+    as per-order distinct-supplier counts (total > 1, late == 1)."""
+    from .plan.aggregates import CountDistinct
+    li = s.from_arrow(t["lineitem"])
+    late = li.filter(E.GreaterThan(col("l_receiptdate"),
+                                   col("l_commitdate")))
+    total_supp = (li.group_by("l_orderkey")
+                  .agg((CountDistinct(col("l_suppkey")), "n_supp"))
+                  .select(col("l_orderkey"), col("n_supp"),
+                          names=["ts_orderkey", "n_supp"]))
+    late_supp = (late.group_by("l_orderkey")
+                 .agg((CountDistinct(col("l_suppkey")), "n_late"))
+                 .select(col("l_orderkey"), col("n_late"),
+                         names=["ls_orderkey", "n_late"]))
+    fails = s.from_arrow(t["orders"]).filter(
+        E.EqualTo(col("o_orderstatus"), E.Literal("F")))
+    saudi = (s.from_arrow(t["supplier"])
+             .join(s.from_arrow(t["nation"]).filter(
+                 E.EqualTo(col("n_name"), E.Literal("SAUDI ARABIA"))),
+                 left_on=["s_nationkey"], right_on=["n_nationkey"]))
+    j = (late.join(saudi, left_on=["l_suppkey"], right_on=["s_suppkey"])
+         .join(fails, left_on=["l_orderkey"], right_on=["o_orderkey"])
+         .join(total_supp, left_on=["l_orderkey"], right_on=["ts_orderkey"])
+         .join(late_supp, left_on=["l_orderkey"], right_on=["ls_orderkey"])
+         .filter(E.And(E.GreaterThan(col("n_supp"), E.Literal(1)),
+                       E.EqualTo(col("n_late"), E.Literal(1)))))
+    return (j.group_by("s_name")
+            .agg((Count(None), "numwait"))
+            .sort(("numwait", False, False), ("s_name", True, True))
+            .limit(100))
+
+
+def q22(s: TpuSession, t: Dict[str, pa.Table]) -> DataFrame:
+    """Global sales opportunity: phone-prefix IN + scalar AVG subquery +
+    NOT EXISTS anti join."""
+    from .plan.strings import Substring
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    cust = s.from_arrow(t["customer"]).select(
+        col("c_custkey"), col("c_acctbal"),
+        Substring(col("c_phone"), 1, 2),
+        names=["c_custkey", "c_acctbal", "cntrycode"])
+    cust = cust.filter(E.In(col("cntrycode"), codes))
+    pos = cust.filter(E.GreaterThan(
+        E.Cast(col("c_acctbal"), _t.DOUBLE), E.Literal(0.0)))
+    avg_bal = pos.agg(
+        (Average(E.Cast(col("c_acctbal"), _t.DOUBLE)), "avg_bal"))
+    cand = (cust.join(avg_bal, how="cross")
+            .filter(E.GreaterThan(E.Cast(col("c_acctbal"), _t.DOUBLE),
+                                  col("avg_bal")))
+            .join(s.from_arrow(t["orders"]), how="left_anti",
+                  left_on=["c_custkey"], right_on=["o_custkey"]))
+    return (cand.group_by("cntrycode")
+            .agg((Count(None), "numcust"),
+                 (Sum(E.Cast(col("c_acctbal"), _t.DOUBLE)), "totacctbal"))
+            .sort("cntrycode"))
+
+
 from . import types as _t           # noqa: E402
 DTYPE_DATE = _t.DATE
 
-QUERIES = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q7": q7,
-           "q9": q9, "q10": q10, "q12": q12, "q13": q13, "q14": q14,
-           "q17": q17, "q18": q18, "q19": q19}
+QUERIES = {"q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
+           "q7": q7, "q8": q8, "q9": q9, "q10": q10, "q11": q11,
+           "q12": q12, "q13": q13, "q14": q14, "q15": q15, "q16": q16,
+           "q17": q17, "q18": q18, "q19": q19, "q20": q20, "q21": q21,
+           "q22": q22}

@@ -218,3 +218,101 @@ def test_q13_independent_oracle(tables, session):
     got = dict(zip(dev.column("c_count").to_pylist(),
                    dev.column("custdist").to_pylist()))
     assert got == dict(dist)
+
+
+# ---------------------------------------------------------------------------
+# full-suite completion: q2, q8, q11, q15, q16, q20, q21, q22
+# ---------------------------------------------------------------------------
+
+FLOAT_QUERIES = {"q8", "q11", "q15", "q20", "q22"}
+
+
+def _rows_close(got, exp, qname):
+    assert len(got) == len(exp), (qname, len(got), len(exp))
+    for gr, er in zip(got, exp):
+        assert len(gr) == len(er)
+        for g, e in zip(gr, er):
+            if g is None or e is None:
+                assert g == e, (qname, gr, er)
+            elif isinstance(g, float) and isinstance(e, float):
+                assert abs(g - e) <= 1e-9 * max(1.0, abs(e)), (qname, gr, er)
+            else:
+                assert g == e, (qname, gr, er)
+
+
+@pytest.mark.parametrize(
+    "qname", ["q2", "q8", "q11", "q15", "q16", "q20", "q21", "q22"])
+def test_query_completion_device_vs_cpu(qname, tables, session):
+    df = tpch.QUERIES[qname](session, tables)
+    dev = df.collect()
+    cpu = cpu_oracle(tpch.QUERIES[qname](session, tables))
+    got, exp = _norm(dev), _norm(cpu)
+    if qname in FLOAT_QUERIES:
+        _rows_close(got, exp, qname)
+    else:
+        assert got == exp, (qname, got[:3], exp[:3])
+
+
+def test_q22_independent_oracle(tables, session):
+    dev = tpch.q22(session, tables).collect()
+    cust, orders = tables["customer"], tables["orders"]
+    codes = {"13", "31", "23", "29", "30", "18", "17"}
+    has_order = set(orders["o_custkey"].to_pylist())
+    sel = [(str(ph)[:2], float(ab))
+           for ph, ab in zip(cust["c_phone"].to_pylist(),
+                             cust["c_acctbal"].to_pylist())
+           if str(ph)[:2] in codes]
+    pos = [ab for _, ab in sel if ab > 0]
+    avg = sum(pos) / len(pos)
+    import collections
+    n_cnt, n_sum = collections.Counter(), collections.defaultdict(float)
+    for (code, ab), ck in zip(
+            [(str(ph)[:2], float(ab))
+             for ph, ab in zip(cust["c_phone"].to_pylist(),
+                               cust["c_acctbal"].to_pylist())],
+            cust["c_custkey"].to_pylist()):
+        if code in codes and ab > avg and ck not in has_order:
+            n_cnt[code] += 1
+            n_sum[code] += ab
+    got = list(zip(dev.column("cntrycode").to_pylist(),
+                   dev.column("numcust").to_pylist(),
+                   dev.column("totacctbal").to_pylist()))
+    assert [c for c, _, _ in got] == sorted(n_cnt)
+    for code, n, tot in got:
+        assert n == n_cnt[code]
+        assert abs(tot - n_sum[code]) <= 1e-6 * max(1.0, abs(n_sum[code]))
+
+
+def test_q21_independent_oracle(tables, session):
+    dev = tpch.q21(session, tables).collect()
+    li, orders = tables["lineitem"], tables["orders"]
+    supp, nation = tables["supplier"], tables["nation"]
+    saudi = {k for k, nk in zip(supp["s_suppkey"].to_pylist(),
+                                supp["s_nationkey"].to_pylist())
+             if nation["n_name"].to_pylist()[nk] == "SAUDI ARABIA"}
+    sname = dict(zip(supp["s_suppkey"].to_pylist(),
+                     supp["s_name"].to_pylist()))
+    fstat = {ok for ok, st in zip(orders["o_orderkey"].to_pylist(),
+                                  orders["o_orderstatus"].to_pylist())
+             if st == "F"}
+    import collections
+    all_supp = collections.defaultdict(set)
+    late_supp = collections.defaultdict(set)
+    for ok, sk, cd, rd in zip(li["l_orderkey"].to_pylist(),
+                              li["l_suppkey"].to_pylist(),
+                              li["l_commitdate"].to_pylist(),
+                              li["l_receiptdate"].to_pylist()):
+        all_supp[ok].add(sk)
+        if rd > cd:
+            late_supp[ok].add(sk)
+    numwait = collections.Counter()
+    for ok, sk, cd, rd in zip(li["l_orderkey"].to_pylist(),
+                              li["l_suppkey"].to_pylist(),
+                              li["l_commitdate"].to_pylist(),
+                              li["l_receiptdate"].to_pylist()):
+        if (rd > cd and sk in saudi and ok in fstat
+                and len(all_supp[ok]) > 1 and late_supp[ok] == {sk}):
+            numwait[sname[sk]] += 1
+    got = dict(zip(dev.column("s_name").to_pylist(),
+                   dev.column("numwait").to_pylist()))
+    assert got == dict(numwait)
