@@ -224,7 +224,7 @@ ENABLED_FORMATS = {
     fmt: conf(
         f"spark.rapids.tpu.sql.format.{fmt}.enabled", True,
         f"Enable accelerated {fmt} scan.")
-    for fmt in ("parquet", "csv", "json", "orc", "avro")
+    for fmt in ("parquet", "csv", "json", "orc", "avro", "iceberg")
 }
 
 CPU_ORACLE_VALIDATE = conf(

@@ -316,8 +316,49 @@ def _encode(sc, v, out: bytearray) -> None:
     elif ty == "record":
         for f in sc["fields"]:
             _encode(f["type"], v[f["name"]], out)
+    elif ty == "map":
+        items = list(v.items()) if isinstance(v, dict) else list(v or ())
+        if items:
+            out += _zigzag(len(items))
+            for k, mv in items:
+                kb = k.encode("utf-8")
+                out += _zigzag(len(kb)) + kb
+                _encode(sc["values"], mv, out)
+        out += _zigzag(0)
     else:
         _encode(ty, v, out)
+
+
+def write_avro_records(avsc: dict, rows: Sequence[dict], path: str,
+                       codec: str = "deflate") -> None:
+    """Write dict rows under an explicit Avro record schema (nested
+    records/arrays/maps supported) — used by Iceberg manifest writing in
+    tests and by any caller that needs non-tabular Avro."""
+    sync = os.urandom(16)
+    out = io.BytesIO()
+    out.write(MAGIC)
+    meta = {"avro.schema": json.dumps(avsc).encode(),
+            "avro.codec": codec.encode()}
+    out.write(_zigzag(len(meta)))
+    for k, v in meta.items():
+        kb = k.encode()
+        out.write(_zigzag(len(kb)) + kb + _zigzag(len(v)) + v)
+    out.write(_zigzag(0))
+    out.write(sync)
+    block = bytearray()
+    for row in rows:
+        _encode(avsc, row, block)
+    payload = bytes(block)
+    if codec == "deflate":
+        payload = zlib.compress(payload)[2:-4]
+    elif codec != "null":
+        raise NotImplementedError(f"avro codec {codec}")
+    if rows:
+        out.write(_zigzag(len(rows)))
+        out.write(_zigzag(len(payload)) + payload)
+        out.write(sync)
+    with open(path, "wb") as f:
+        f.write(out.getvalue())
 
 
 def write_avro(table: pa.Table, path: str, codec: str = "deflate") -> None:

@@ -1,0 +1,188 @@
+"""Iceberg read path: metadata.json -> manifest lists -> manifests ->
+parquet data files, with v2 delete-file filtering.
+
+Role of the reference's iceberg support (SURVEY §2.6: sql-plugin
+com/nvidia/spark/rapids/iceberg ~6k LoC Java — scan with GPU parquet
+decode including deletes filtering; IcebergProviderImpl.scala loaded
+reflectively).  The reference ports Iceberg's own reader glue; here the
+table format is small enough to read directly: the metadata chain is
+JSON + Avro (io/avro.py), data files are parquet reused from the
+standard scan path, and delete files are applied on host before upload
+(position deletes by row index, equality deletes as an anti-join on the
+equality-id columns) — the same semantics Iceberg's DeleteFilter
+applies, expressed over arrow tables.
+
+Supported: format-version 1 and 2, snapshot selection (time travel by
+snapshot-id), position deletes, equality deletes, ADDED/EXISTING vs
+DELETED manifest entry status.  Writes are out of scope (read path
+only, like the reference).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..columnar.host import schema_to_struct
+from .avro import read_avro_rows
+from .text import _TextLogicalScan, CpuTextScanExec, TextScanExec
+
+
+def _local(path: str) -> str:
+    """Iceberg metadata stores absolute URIs; strip file:// for local."""
+    if path.startswith("file://"):
+        return path[len("file://"):]
+    return path
+
+
+class IcebergSnapshot:
+    """Resolved file sets of one snapshot."""
+
+    def __init__(self, data_files: List[str],
+                 pos_delete_files: List[str],
+                 eq_deletes: List[Tuple[str, List[int]]],
+                 schema: Optional[dict], snapshot_id: Optional[int]):
+        self.data_files = data_files
+        self.pos_delete_files = pos_delete_files
+        self.eq_deletes = eq_deletes        # (path, equality_field_ids)
+        self.schema = schema
+        self.snapshot_id = snapshot_id
+
+
+def load_table_metadata(table_path: str) -> dict:
+    """Latest metadata json via version-hint.text or highest vN."""
+    meta_dir = os.path.join(table_path, "metadata")
+    hint = os.path.join(meta_dir, "version-hint.text")
+    if os.path.exists(hint):
+        with open(hint) as f:
+            v = f.read().strip()
+        cand = os.path.join(meta_dir, f"v{v}.metadata.json")
+    else:
+        versions = sorted(
+            (f for f in os.listdir(meta_dir)
+             if f.endswith(".metadata.json")),
+            key=lambda n: int(n.split(".")[0].lstrip("v"))
+            if n.split(".")[0].lstrip("v").isdigit() else -1)
+        if not versions:
+            raise FileNotFoundError(f"no metadata.json under {meta_dir}")
+        cand = os.path.join(meta_dir, versions[-1])
+    with open(cand) as f:
+        return json.load(f)
+
+
+def resolve_snapshot(table_path: str,
+                     snapshot_id: Optional[int] = None) -> IcebergSnapshot:
+    meta = load_table_metadata(table_path)
+    snaps = meta.get("snapshots", [])
+    sid = snapshot_id if snapshot_id is not None \
+        else meta.get("current-snapshot-id")
+    snap = next((s for s in snaps if s["snapshot-id"] == sid), None)
+    if snap is None:
+        if snapshot_id is not None:
+            raise ValueError(f"snapshot {snapshot_id} not found")
+        return IcebergSnapshot([], [], [], _current_schema(meta), None)
+
+    data, pos_del, eq_del = [], [], []
+    _, manifests = read_avro_rows(_local(snap["manifest-list"]))
+    for m in manifests:
+        mpath = _local(m["manifest_path"])
+        # content: 0=data manifest, 1=delete manifest (v1 files omit it)
+        _, entries = read_avro_rows(mpath)
+        for e in entries:
+            if e.get("status") == 2:               # DELETED entry
+                continue
+            df = e["data_file"]
+            fpath = _local(df["file_path"])
+            content = df.get("content", 0)
+            if content == 0:
+                data.append(fpath)
+            elif content == 1:
+                pos_del.append(fpath)
+            elif content == 2:
+                eq_ids = df.get("equality_ids") or []
+                eq_del.append((fpath, list(eq_ids)))
+    return IcebergSnapshot(data, pos_del, eq_del,
+                           _current_schema(meta), sid)
+
+
+def _current_schema(meta: dict) -> Optional[dict]:
+    sid = meta.get("current-schema-id")
+    for sc in meta.get("schemas", []):
+        if sc.get("schema-id") == sid:
+            return sc
+    return meta.get("schema")
+
+
+def _field_names_by_id(schema: Optional[dict]) -> Dict[int, str]:
+    if not schema:
+        return {}
+    return {f["id"]: f["name"] for f in schema.get("fields", [])}
+
+
+def read_iceberg(table_path: str,
+                 snapshot_id: Optional[int] = None) -> pa.Table:
+    """Materialize a snapshot as one arrow table, deletes applied."""
+    snap = resolve_snapshot(table_path, snapshot_id)
+    if not snap.data_files:
+        return pa.table({})
+
+    # position deletes: {data file path -> sorted positions}
+    pos_by_file: Dict[str, set] = {}
+    for pf in snap.pos_delete_files:
+        t = pq.read_table(pf)
+        for fp, pos in zip(t.column("file_path").to_pylist(),
+                           t.column("pos").to_pylist()):
+            pos_by_file.setdefault(_local(fp), set()).add(pos)
+
+    names = _field_names_by_id(snap.schema)
+    eq_tables = [(pq.read_table(p),
+                  [names.get(i) for i in ids] if ids else None)
+                 for p, ids in snap.eq_deletes]
+
+    parts = []
+    for fpath in snap.data_files:
+        t = pq.read_table(fpath)
+        dead = pos_by_file.get(fpath)
+        if dead:
+            keep = [i for i in range(t.num_rows) if i not in dead]
+            t = t.take(keep)
+        for dt, cols in eq_tables:
+            key_cols = cols or dt.schema.names
+            key_cols = [c for c in key_cols if c in t.schema.names]
+            if not key_cols:
+                continue
+            dead_keys = set(zip(*[dt.column(c).to_pylist()
+                                  for c in key_cols]))
+            mask = [tuple(vals) not in dead_keys for vals in zip(
+                *[t.column(c).to_pylist() for c in key_cols])]
+            t = t.filter(pa.array(mask, pa.bool_()))
+        parts.append(t)
+    return pa.concat_tables(parts) if parts else pa.table({})
+
+
+# ---------------------------------------------------------------------------
+# scan plumbing
+# ---------------------------------------------------------------------------
+
+def _read_iceberg_scan(path: str, schema, opts) -> pa.Table:
+    tbl = read_iceberg(path, (opts or {}).get("snapshot_id"))
+    if schema is not None:
+        keep = [f.name for f in schema if f.name in tbl.schema.names]
+        tbl = tbl.select(keep)
+    return tbl
+
+
+class LogicalIcebergScan(_TextLogicalScan):
+    """Iceberg snapshot scan (IcebergProviderImpl role). paths = one
+    table root; opts: snapshot_id for time travel."""
+    reader = staticmethod(_read_iceberg_scan)
+    fmt = "iceberg"
+
+    def _resolve_schema(self):
+        if self.arrow_schema is not None:
+            return schema_to_struct(self.arrow_schema)
+        tbl = read_iceberg(self.paths[0], self.opts.get("snapshot_id"))
+        return schema_to_struct(tbl.schema)

@@ -36,6 +36,7 @@ from ..io.parquet import (CpuParquetScanExec, LogicalParquetScan,
                           ParquetScanExec)
 from ..io.orc import CpuOrcScanExec, LogicalOrcScan, OrcScanExec
 from ..io.avro import LogicalAvroScan
+from ..io.iceberg import LogicalIcebergScan
 from ..io.text import (CpuTextScanExec, LogicalCsvScan, LogicalJsonScan,
                        TextScanExec)
 from ..exec.plan import (CoalesceBatchesExec, ExecContext, ExpandExec,
@@ -204,6 +205,7 @@ exec_rule(LogicalCsvScan, _DEVICE_SIMPLE, "csv scan")
 exec_rule(LogicalJsonScan, _DEVICE_SIMPLE, "json scan")
 exec_rule(LogicalOrcScan, _DEVICE_SIMPLE, "orc scan")
 exec_rule(LogicalAvroScan, _DEVICE_SIMPLE, "avro scan")
+exec_rule(LogicalIcebergScan, _DEVICE_SIMPLE, "iceberg scan")
 
 
 # ---------------------------------------------------------------------------
@@ -706,6 +708,7 @@ _META_FOR: Dict[type, Type[PlanMeta]] = {
     LogicalJsonScan: TextScanMeta,
     LogicalOrcScan: TextScanMeta,
     LogicalAvroScan: TextScanMeta,
+    LogicalIcebergScan: TextScanMeta,
 }
 
 

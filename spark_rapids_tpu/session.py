@@ -70,6 +70,12 @@ class TpuSession:
         from .io.avro import LogicalAvroScan
         return DataFrame(LogicalAvroScan(list(paths), schema, opts), self)
 
+    def read_iceberg(self, table_path: str, snapshot_id=None,
+                     schema=None) -> "DataFrame":
+        from .io.iceberg import LogicalIcebergScan
+        return DataFrame(LogicalIcebergScan(
+            [table_path], schema, {"snapshot_id": snapshot_id}), self)
+
 
 class GroupedData:
     def __init__(self, df: "DataFrame", keys: Sequence):
