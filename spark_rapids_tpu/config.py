@@ -144,6 +144,20 @@ HASH_SUBPARTITION_FALLBACK = conf(
     "Re-hash-partition oversized join build sides into sub-joins "
     "(reference GpuSubPartitionHashJoin).")
 
+ADAPTIVE_ENABLED = conf(
+    "spark.rapids.tpu.sql.adaptive.enabled", True,
+    "Runtime-statistics re-planning (the AQE analogue, reference "
+    "GpuOverrides.scala:496-564): joins measure both materialized inputs "
+    "and build on the smaller side; shuffle reads coalesce partitions to "
+    "the advisory size from real map-output stats.")
+
+ADAPTIVE_ADVISORY_PARTITION_BYTES = conf(
+    "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeInBytes",
+    64 * 1024 * 1024,
+    "Target bytes per coalesced shuffle-read group "
+    "(spark.sql.adaptive.advisoryPartitionSizeInBytes role).",
+    checker=_positive)
+
 AGG_FALLBACK_PARTITIONS = conf(
     "spark.rapids.tpu.sql.agg.fallbackPartitions", 8,
     "Bucket count for the high-cardinality aggregation fallback: when "
@@ -227,6 +241,11 @@ ENABLED_FORMATS = {
     for fmt in ("parquet", "csv", "json", "orc", "avro", "iceberg")
 }
 
+SPARK_VERSION = conf(
+    "spark.rapids.tpu.spark.version", "3.5.0",
+    "Spark line whose semantics the engine emulates; selects the shim "
+    "(shims.py, the ShimLoader/SparkShimServiceProvider role).")
+
 CPU_ORACLE_VALIDATE = conf(
     "spark.rapids.tpu.sql.test.validateWithCpu", False,
     "Test-only: run every device operator's CPU fallback too and compare.",
@@ -306,7 +325,18 @@ class TpuConf:
 
     @property
     def ansi(self):
-        return self.get(ANSI_ENABLED)
+        # explicit session setting wins; otherwise the pinned Spark
+        # version's default (false through 3.x, true in 4.0 — shims.py)
+        if ANSI_ENABLED.key in self._raw:
+            return self.get(ANSI_ENABLED)
+        return self.shims.ansi_default
+
+    @property
+    def shims(self):
+        """Version shims for `spark.rapids.tpu.spark.version`
+        (ShimLoader role, shims.py)."""
+        from .shims import get_shims
+        return get_shims(str(self.get(SPARK_VERSION)))
 
     @property
     def bucket_min_rows(self):
@@ -340,6 +370,7 @@ def all_entries() -> List[ConfEntry]:
 
 if __name__ == "__main__":
     import pathlib
+    from .runtime import failure as _failure   # registers its conf entries
     out = pathlib.Path(__file__).resolve().parent.parent / "docs"
     out.mkdir(exist_ok=True)
     (out / "configs.md").write_text(generate_docs())

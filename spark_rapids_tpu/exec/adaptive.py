@@ -1,0 +1,154 @@
+"""Adaptive (runtime-statistics) execution: the engine's AQE analogue.
+
+Reference: the plugin's AQE integration re-applies overrides per query
+stage with real sizes in hand (GpuOverrides.scala:496-564,
+GpuCustomShuffleReaderExec.scala:37), and
+GpuShuffledSymmetricHashJoinExec.scala:354 probes both join inputs'
+sizes at runtime to pick the build side.  Spark can do this because a
+shuffle stage fully materializes before the next stage is planned.
+
+This engine's plans are single-process pipelines, so the same two
+runtime decisions attach directly to the operators that need them:
+
+- `AdaptiveShuffledJoinExec` materializes BOTH join inputs as spillable
+  stages (exactly what completed map stages are), measures real bytes,
+  and builds the hash table on the smaller side — mirroring the join
+  type when that swaps the inputs and restoring the original column
+  order on output.
+- `plan_coalesced_reads` groups a materialized exchange's partitions to
+  an advisory byte target using the shuffle manager's real per-partition
+  sizes (the GpuAQEShuffleRead / coalesced CustomShuffleReader role).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+from .. import types as t
+from ..columnar.device import DeviceBatch
+from ..plan import expressions as E
+from ..runtime.memory import Spillable
+from .join import HashJoinExec
+from .plan import ExecContext, PlanNode
+
+_MIRROR = {"inner": "inner", "left_outer": "right_outer",
+           "right_outer": "left_outer", "full_outer": "full_outer"}
+
+
+class _ReplayStage(PlanNode):
+    """A completed, spillable 'stage' the re-planned join replays."""
+
+    def __init__(self, batches: List[Spillable], schema: t.StructType):
+        super().__init__()
+        self.batches = batches
+        self._schema = schema
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        for sp in self.batches:
+            yield sp.get()
+
+    def describe(self):
+        return f"ReplayStage[{len(self.batches)} batches]"
+
+
+class AdaptiveShuffledJoinExec(PlanNode):
+    """Equi-join whose build side is chosen from measured input sizes.
+
+    Output schema and semantics are identical to
+    HashJoinExec(join_type, ...) — the mirror swap is invisible outside
+    (columns are restored to left-then-right order)."""
+
+    def __init__(self, join_type: str, left_keys: Sequence[E.Expression],
+                 right_keys: Sequence[E.Expression],
+                 left: PlanNode, right: PlanNode):
+        super().__init__(left, right)
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+
+    @property
+    def left(self) -> PlanNode:
+        return self.children[0]
+
+    @property
+    def right(self) -> PlanNode:
+        return self.children[1]
+
+    @property
+    def output_schema(self) -> t.StructType:
+        lf = list(self.left.output_schema.fields)
+        if self.join_type in ("left_semi", "left_anti"):
+            return t.StructType(lf)
+        return t.StructType(lf + list(self.right.output_schema.fields))
+
+    def _materialize(self, node: PlanNode, ctx: ExecContext
+                     ) -> List[Spillable]:
+        return [Spillable(db, ctx.budget) for db in node.execute(ctx)
+                if int(db.num_rows) > 0]
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        left_stage: List[Spillable] = []
+        right_stage: List[Spillable] = []
+        try:
+            left_stage = self._materialize(self.left, ctx)
+            right_stage = self._materialize(self.right, ctx)
+            lbytes = sum(sp._nbytes for sp in left_stage)
+            rbytes = sum(sp._nbytes for sp in right_stage)
+            ctx.metrics["adaptive_left_bytes"] = lbytes
+            ctx.metrics["adaptive_right_bytes"] = rbytes
+            swap = (self.join_type in _MIRROR) and lbytes < rbytes
+            if swap:
+                ctx.bump("adaptive_join_mirrored")
+                jt = _MIRROR[self.join_type]
+                join = HashJoinExec(
+                    jt, self.right_keys, self.left_keys,
+                    _ReplayStage(right_stage,
+                                 self.right.output_schema),
+                    _ReplayStage(left_stage, self.left.output_schema))
+                n_r = len(self.right.output_schema.fields)
+                n_l = len(self.left.output_schema.fields)
+                # mirrored output is right-cols ++ left-cols; restore
+                perm = list(range(n_r, n_r + n_l)) + list(range(n_r))
+                for db in join.execute(ctx):
+                    yield db.select(perm)
+            else:
+                join = HashJoinExec(
+                    self.join_type, self.left_keys, self.right_keys,
+                    _ReplayStage(left_stage, self.left.output_schema),
+                    _ReplayStage(right_stage,
+                                 self.right.output_schema))
+                yield from join.execute(ctx)
+        finally:
+            for sp in left_stage + right_stage:
+                sp.close()
+
+    def describe(self):
+        return f"AdaptiveShuffledJoinExec[{self.join_type}]"
+
+
+def plan_coalesced_reads(exchange, ctx: ExecContext,
+                         advisory_bytes: int) -> List[List[int]]:
+    """Group a materialized exchange's partitions so each reduce group is
+    ~advisory_bytes, from REAL map-output sizes.  Returns partition-id
+    groups (order preserved: range partitions stay contiguous)."""
+    from ..shuffle.manager import get_shuffle_manager
+    sid = exchange.materialize(ctx)
+    sizes = get_shuffle_manager().partition_sizes(sid)
+    n = exchange.partitioning.num_partitions
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for p in range(n):
+        b = sizes.get(p, 0)
+        if cur and cur_bytes + b > advisory_bytes:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(p)
+        cur_bytes += b
+    if cur:
+        groups.append(cur)
+    ctx.metrics["adaptive_coalesced_groups"] = len(groups)
+    return groups

@@ -63,10 +63,23 @@ class ShuffleExchangeExec(PlanNode):
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         sid = self.materialize(ctx)
-        reader = ShuffleReadExec(self, list(range(
-            self.partitioning.num_partitions)))
-        reader.shuffle_id = sid
-        yield from reader.execute(ctx)
+        from ..config import (ADAPTIVE_ADVISORY_PARTITION_BYTES,
+                              ADAPTIVE_ENABLED)
+        if ctx.conf.get(ADAPTIVE_ENABLED):
+            # AQE analogue: one reduce group per ~advisory bytes from
+            # REAL map-output sizes (GpuAQEShuffleRead role) instead of
+            # one group per partition
+            from .adaptive import plan_coalesced_reads
+            groups = plan_coalesced_reads(
+                self, ctx,
+                int(ctx.conf.get(ADAPTIVE_ADVISORY_PARTITION_BYTES)))
+        else:
+            groups = [[p] for p in
+                      range(self.partitioning.num_partitions)]
+        for group in groups:
+            reader = ShuffleReadExec(self, group)
+            reader.shuffle_id = sid
+            yield from reader.execute(ctx)
 
     def describe(self):
         return (f"ShuffleExchangeExec[{type(self.partitioning).__name__}"

@@ -265,6 +265,11 @@ class ExprMeta(BaseMeta):
             for r in c.reasons:
                 self.will_not_work(r)
         name = type(self.expr).__name__
+        if name in self.conf.shims.unavailable_expressions:
+            self.will_not_work(
+                f"expression {name} does not exist in Spark "
+                f"{self.conf.shims.version_prefix} (shim gate)")
+            return
         if not self.conf.is_op_enabled("expression", name):
             self.will_not_work(
                 f"expression {name} disabled by "
@@ -295,6 +300,11 @@ class AggMeta(BaseMeta):
 
     def tag(self):
         name = type(self.fn).__name__
+        if name in self.conf.shims.unavailable_expressions:
+            self.will_not_work(
+                f"aggregate {name} does not exist in Spark "
+                f"{self.conf.shims.version_prefix} (shim gate)")
+            return
         if _AGG_RULES.get(type(self.fn)) is None:
             self.will_not_work(f"aggregate {name} has no TPU rule")
             return
@@ -450,6 +460,11 @@ class AggregateMeta(PlanMeta):
         schema = node.child.schema
         self._wrap_exprs(node.keys, schema)
         for fn, _name in node.aggs:
+            # version-dependent agg semantics route through the shim seam
+            # (shims.py) — both the device evaluate() and the CPU
+            # cpu_agg() consult it, so the two paths stay oracles of
+            # each other for any pinned Spark version
+            fn._shims = conf.shims
             try:
                 b = fn.bind(schema)
             except (KeyError, TypeError) as exc:
@@ -520,6 +535,8 @@ class JoinMeta(PlanMeta):
                 f"join type {self.node.join_type} not supported on TPU")
 
     def to_device(self):
+        from ..config import ADAPTIVE_ENABLED
+        from ..exec.adaptive import AdaptiveShuffledJoinExec, _MIRROR
         from ..exec.exchange import BroadcastExchangeExec
         from ..exec.join import CrossJoinExec, HashJoinExec
         left = self._device_child(0)
@@ -530,6 +547,15 @@ class JoinMeta(PlanMeta):
             right = BroadcastExchangeExec(right)
         if self.node.join_type == "cross":
             return CrossJoinExec(left, right)
+        if (self.conf.get(ADAPTIVE_ENABLED)
+                and self.node.broadcast is None
+                and self.node.join_type in _MIRROR):
+            # AQE analogue: defer the build-side choice to runtime sizes
+            # (GpuShuffledSymmetricHashJoinExec.scala:354 role); an
+            # explicit broadcast hint is a planner decision and wins
+            return AdaptiveShuffledJoinExec(
+                self.node.join_type, self.node.left_keys,
+                self.node.right_keys, left, right)
         return HashJoinExec(self.node.join_type, self.node.left_keys,
                             self.node.right_keys, left, right)
 
@@ -771,7 +797,9 @@ class PhysicalQuery:
 
     def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
         ctx = ctx or ExecContext(self.conf)
-        with self._instrumented(ctx):
+        from ..runtime.failure import crash_capture, install_fault_injection
+        install_fault_injection(self.root, self.conf)
+        with self._instrumented(ctx), crash_capture(self.conf, ctx):
             return self.root.collect(ctx)
 
     def execute_host_batches(self, ctx: Optional[ExecContext] = None):

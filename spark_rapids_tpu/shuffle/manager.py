@@ -49,6 +49,15 @@ class ShuffleBlockStore:
         with self._lock:
             return sum(len(p) for ps in self._blocks.values() for p in ps)
 
+    def partition_sizes(self, shuffle_id: int) -> Dict[int, int]:
+        """part_id -> stored bytes (the MapStatus sizes AQE plans with)."""
+        with self._lock:
+            out: Dict[int, int] = {}
+            for (sid, pid), ps in self._blocks.items():
+                if sid == shuffle_id:
+                    out[pid] = sum(len(p) for p in ps)
+            return out
+
 
 def serialize_batch(rb: pa.RecordBatch) -> bytes:
     sink = io.BytesIO()
@@ -103,6 +112,9 @@ class ShuffleManager:
     def read_partition(self, shuffle_id: int, part_id: int
                        ) -> List[pa.RecordBatch]:
         return deserialize_batches(self.store.get(shuffle_id, part_id))
+
+    def partition_sizes(self, shuffle_id: int) -> Dict[int, int]:
+        return self.store.partition_sizes(shuffle_id)
 
 
 _MANAGER: Optional[ShuffleManager] = None

@@ -1,0 +1,164 @@
+"""AQE analogue: runtime build-side selection + stats-driven coalesced
+shuffle reads (reference GpuShuffledSymmetricHashJoinExec.scala:354,
+GpuCustomShuffleReaderExec.scala:37)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec.plan import ExecContext, HostScanExec
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.overrides import apply_overrides
+from spark_rapids_tpu.session import TpuSession, col
+
+
+def _tables(n_small=20, n_big=5000):
+    rng = np.random.default_rng(5)
+    small = pa.table({
+        "sk": pa.array(range(n_small), pa.int64()),
+        "sv": pa.array(rng.standard_normal(n_small)),
+    })
+    big = pa.table({
+        "bk": pa.array(rng.integers(0, n_small, n_big), pa.int64()),
+        "bv": pa.array(rng.integers(0, 1000, n_big), pa.int64()),
+    })
+    return small, big
+
+
+def _expected_inner(small, big):
+    sv = dict(zip(small["sk"].to_pylist(), small["sv"].to_pylist()))
+    return sorted((bk, bv, bk, sv[bk])
+                  for bk, bv in zip(big["bk"].to_pylist(),
+                                    big["bv"].to_pylist()) if bk in sv)
+
+
+def test_adaptive_join_builds_on_smaller_side():
+    """Big LEFT joined to small RIGHT: natural build (right) is already
+    smaller -> no mirror; small LEFT to big RIGHT -> mirrored."""
+    small, big = _tables()
+    s = TpuSession()
+
+    # case 1: build side already small — no mirror
+    df = s.from_arrow(big).join(s.from_arrow(small),
+                                left_on=["bk"], right_on=["sk"])
+    q = df.physical()
+    assert "AdaptiveShuffledJoinExec" in q.physical_tree()
+    ctx = ExecContext(s.conf)
+    out = q.collect(ctx)
+    got = sorted(zip(out.column("bk").to_pylist(),
+                     out.column("bv").to_pylist(),
+                     out.column("sk").to_pylist(),
+                     out.column("sv").to_pylist()))
+    assert got == _expected_inner(small, big)
+    assert ctx.metrics.get("adaptive_join_mirrored", 0) == 0
+    assert ctx.metrics["adaptive_right_bytes"] <= \
+        ctx.metrics["adaptive_left_bytes"]
+
+
+def test_adaptive_join_mirrors_when_left_smaller():
+    small, big = _tables()
+    s = TpuSession()
+    df = s.from_arrow(small).join(s.from_arrow(big),
+                                  left_on=["sk"], right_on=["bk"])
+    q = df.physical()
+    ctx = ExecContext(s.conf)
+    out = q.collect(ctx)
+    # output column order must be left-then-right despite the mirror
+    assert out.schema.names == ["sk", "sv", "bk", "bv"]
+    got = sorted(zip(out.column("bk").to_pylist(),
+                     out.column("bv").to_pylist(),
+                     out.column("sk").to_pylist(),
+                     out.column("sv").to_pylist()))
+    assert got == _expected_inner(small, big)
+    assert ctx.metrics["adaptive_join_mirrored"] == 1
+
+
+@pytest.mark.parametrize("how,mirrored", [
+    ("left_outer", "right_outer"), ("full_outer", "full_outer")])
+def test_adaptive_outer_join_mirror_semantics(how, mirrored):
+    """Outer joins mirror to their dual; results equal the CPU oracle."""
+    small, big = _tables(10, 400)
+    dev = TpuSession()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    from spark_rapids_tpu.session import DataFrame
+    df = dev.from_arrow(small).join(dev.from_arrow(big), how=how,
+                                    left_on=["sk"], right_on=["bk"])
+    out = df.collect()
+    exp = DataFrame(df._plan, cpu).collect()
+
+    def norm(t):
+        return sorted(map(tuple, zip(*[t.column(c).to_pylist()
+                                       for c in t.schema.names])))
+    assert out.schema.names == exp.schema.names
+    assert norm(out) == norm(exp)
+
+
+def test_adaptive_disabled_uses_static_join():
+    small, big = _tables()
+    s = TpuSession({"spark.rapids.tpu.sql.adaptive.enabled": "false"})
+    df = s.from_arrow(small).join(s.from_arrow(big),
+                                  left_on=["sk"], right_on=["bk"])
+    tree = df.physical().physical_tree()
+    assert "AdaptiveShuffledJoinExec" not in tree
+    assert "HashJoinExec" in tree
+
+
+def test_semi_anti_not_mirrored():
+    small, big = _tables()
+    s = TpuSession()
+    df = s.from_arrow(small).join(s.from_arrow(big), how="left_semi",
+                                  left_on=["sk"], right_on=["bk"])
+    tree = df.physical().physical_tree()
+    # semi joins have no mirror: stays on the static path
+    assert "AdaptiveShuffledJoinExec" not in tree
+
+
+def test_broadcast_hint_wins_over_adaptive():
+    small, big = _tables()
+    s = TpuSession()
+    plan = L.LogicalJoin("inner", L.LogicalScan(big), L.LogicalScan(small),
+                         ["bk"], ["sk"], broadcast="right")
+    q = apply_overrides(plan, s.conf)
+    tree = q.physical_tree()
+    assert "BroadcastExchangeExec" in tree
+    assert "AdaptiveShuffledJoinExec" not in tree
+
+
+def test_plan_coalesced_reads_groups_by_real_sizes():
+    from spark_rapids_tpu.exec.adaptive import plan_coalesced_reads
+    from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partition import HashPartitioning
+    # skewed: one huge partition, many tiny ones
+    rng = np.random.default_rng(9)
+    keys = np.where(rng.random(20000) < 0.7, 0,
+                    rng.integers(0, 64, 20000))
+    tbl = pa.table({"k": pa.array(keys, pa.int64()),
+                    "v": pa.array(rng.standard_normal(20000))})
+    scan = HostScanExec.from_table(tbl, 4096)
+    ex = ShuffleExchangeExec(
+        HashPartitioning([E.ColumnRef("k")], 16), scan)
+    ctx = ExecContext(TpuConf())
+    groups = plan_coalesced_reads(ex, ctx, advisory_bytes=16 * 1024)
+    # every partition appears exactly once, in order
+    flat = [p for g in groups for p in g]
+    assert flat == list(range(16))
+    assert 1 < len(groups) < 16        # real coalescing happened
+    # big-skew partition sits alone in its group
+    from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+    sizes = get_shuffle_manager().partition_sizes(ex.shuffle_id)
+    big_pid = max(sizes, key=sizes.get)
+    assert [big_pid] in [g for g in groups if len(g) == 1]
+
+
+def test_tpch_q3_unchanged_under_adaptive(tmp_path):
+    """End-to-end sanity: a multi-join query matches the CPU oracle with
+    adaptive joins active (they are on by default)."""
+    from spark_rapids_tpu import tpch
+    from spark_rapids_tpu.session import DataFrame
+    tables = tpch.gen_tables(scale=0.001)
+    dev = TpuSession()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    df = tpch.q3(dev, tables)
+    assert df.collect().to_pydict() == \
+        DataFrame(df._plan, cpu).collect().to_pydict()
