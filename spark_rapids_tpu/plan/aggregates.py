@@ -453,6 +453,14 @@ class VariancePop(AggregateFunction):
     def merge_ops(self):
         return [(G.SUM, t.LONG), (G.SUM, t.DOUBLE), (G.SUM, t.DOUBLE)]
 
+    def _legacy_nan(self) -> bool:
+        """Spark < 3.1 (SPARK-33726): sample variance of one row is NaN,
+        not null — routed through the shim seam (shims.py); `_shims` is
+        injected at plan conversion (plan/overrides.py AggregateMeta)."""
+        shims = getattr(self, "_shims", None)
+        return (self.ddof == 1 and shims is not None
+                and shims.legacy_statistical_aggregate)
+
     def evaluate(self, refs):
         n = E.Cast(refs[0], t.DOUBLE)
         s, ss = refs[1], refs[2]
@@ -461,7 +469,11 @@ class VariancePop(AggregateFunction):
         denom = E.Literal(float(self.ddof), t.DOUBLE)
         var = E.Divide(m2, E.Subtract(n, denom))
         guard = E.GreaterThan(refs[0], E.Literal(self.ddof, t.LONG))
-        return E.If(guard, var, _null_double())
+        empty = _null_double()
+        if self._legacy_nan():
+            empty = E.If(E.EqualTo(refs[0], E.Literal(1, t.LONG)),
+                         E.Literal(float("nan"), t.DOUBLE), empty)
+        return E.If(guard, var, empty)
 
     def cpu_agg(self):
         exp = self
@@ -470,6 +482,8 @@ class VariancePop(AggregateFunction):
             nn = [float(v) for v in values if v is not None]
             n = len(nn)
             if n <= exp.ddof:
+                if n == 1 and exp._legacy_nan():
+                    return float("nan")
                 return None
             mean = sum(nn) / n
             m2 = sum((v - mean) ** 2 for v in nn)

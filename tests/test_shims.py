@@ -1,0 +1,98 @@
+"""Shim seam (ShimLoader / per-version semantics role): version
+selection, legacy statistical aggregate, ANSI default, expression
+availability gates — device AND CPU paths agree per pinned version."""
+import math
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.aggregates import StddevSamp, VarianceSamp
+from spark_rapids_tpu.session import DataFrame, TpuSession, col
+from spark_rapids_tpu.shims import SparkShims, get_shims
+
+
+def test_version_prefix_selection():
+    assert get_shims("3.0.1").version_prefix == "3.0"
+    assert get_shims("3.3.4").version_prefix == "3.3"
+    assert get_shims("3.5.0").version_prefix == "3.5"
+    assert get_shims("4.0.0-preview1").version_prefix == "4.0"
+    with pytest.raises(ValueError, match="unsupported Spark version"):
+        get_shims("2.4.8")
+
+
+def test_conf_shims_and_ansi_default():
+    assert TpuConf().shims.version_prefix == "3.5"
+    assert TpuConf().ansi is False
+    c40 = TpuConf({"spark.rapids.tpu.spark.version": "4.0.0"})
+    assert c40.ansi is True                     # 4.0 defaults ANSI on
+    # explicit session setting beats the version default
+    c40_off = TpuConf({"spark.rapids.tpu.spark.version": "4.0.0",
+                       "spark.rapids.tpu.sql.ansi.enabled": "false"})
+    assert c40_off.ansi is False
+
+
+def _var_single_row(session):
+    tbl = pa.table({"g": pa.array([1, 1, 2], pa.int64()),
+                    "x": pa.array([10.0, 14.0, 5.0])})
+    df = (session.from_arrow(tbl).group_by("g")
+          .agg((VarianceSamp(col("x")), "v"),
+               (StddevSamp(col("x")), "s"))
+          .sort("g"))
+    out = df.collect()
+    return (out.column("v").to_pylist(), out.column("s").to_pylist())
+
+
+def test_legacy_statistical_aggregate_spark30():
+    """Spark < 3.1: var_samp of ONE row is NaN; 3.1+: null (SPARK-33726).
+    Both engine paths follow the pinned version."""
+    legacy = TpuSession({"spark.rapids.tpu.spark.version": "3.0.1"})
+    modern = TpuSession()
+    for s, expect_nan in ((legacy, True), (modern, False)):
+        v, sd = _var_single_row(s)
+        assert v[0] == pytest.approx(8.0)       # 2-row group: normal
+        if expect_nan:
+            assert math.isnan(v[1]) and math.isnan(sd[1])
+        else:
+            assert v[1] is None and sd[1] is None
+        # CPU fallback path agrees
+        cpu = TpuSession({**{k: v2 for k, v2 in s.conf._raw.items()},
+                          "spark.rapids.tpu.sql.enabled": "false"})
+        v_c, sd_c = _var_single_row(cpu)
+        if expect_nan:
+            assert math.isnan(v_c[1]) and math.isnan(sd_c[1])
+        else:
+            assert v_c[1] is None and sd_c[1] is None
+
+
+def test_expression_availability_gate():
+    from spark_rapids_tpu.plan.strings import SplitPart
+    tbl = pa.table({"s": pa.array(["a-b-c", "x-y"])})
+    old = TpuSession({"spark.rapids.tpu.spark.version": "3.3.0"})
+    df = old.from_arrow(tbl).select(
+        SplitPart(col("s"), "-", 2), names=["p"])
+    text = df.physical().explain()
+    assert "does not exist in Spark 3.3" in text
+    # modern default: runs on device
+    new = TpuSession()
+    df2 = new.from_arrow(tbl).select(
+        SplitPart(col("s"), "-", 2), names=["p"])
+    assert "does not exist" not in df2.physical().explain()
+    assert df2.collect().column("p").to_pylist() == ["b", "y"]
+
+
+def test_aggregate_availability_gate():
+    from spark_rapids_tpu.plan.aggregates import Median
+    tbl = pa.table({"x": pa.array([1.0, 2.0, 9.0])})
+    old = TpuSession({"spark.rapids.tpu.spark.version": "3.0.1"})
+    df = old.from_arrow(tbl).agg((Median(col("x")), "m"))
+    assert "Median does not exist in Spark 3.0" in df.physical().explain()
+    new = TpuSession()
+    assert tpu_median(new, tbl) == 2.0
+
+
+def tpu_median(session, tbl):
+    from spark_rapids_tpu.plan.aggregates import Median
+    df = session.from_arrow(tbl).agg((Median(col("x")), "m"))
+    return df.collect().column("m").to_pylist()[0]
