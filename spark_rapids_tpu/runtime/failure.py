@@ -1,0 +1,181 @@
+"""Failure detection + device crash capture — the GpuCoreDumpHandler /
+executor-self-termination role.
+
+Reference (SURVEY §5): the executor plugin classifies CUDA errors and
+self-terminates on fatal ones so Spark replaces the executor
+(Plugin.scala:566-575, logGpuDebugInfoAndExit); GpuCoreDumpHandler
+(GpuCoreDumpHandler.scala:38) streams GPU core dumps to a distributed FS
+and notifies the driver; `CudaFatalException` gets distinct retry
+handling (RmmRapidsRetryIterator).
+
+TPU translation:
+- `classify(exc)`: RETRYABLE (RESOURCE_EXHAUSTED / budget OOM — the
+  retry ladder owns these), FATAL_DEVICE (XLA internal errors, device
+  halt, data loss — the chip or its runtime is wedged; the hosting
+  process must exit so the cluster manager replaces it), QUERY (plain
+  python/user errors — fail the query, keep the executor).
+- `crash_capture(conf, ctx)`: context manager that, on FATAL_DEVICE,
+  writes a crash-dump JSON (exception, device info, memory budget
+  counters, query metrics, backend platform/version) to
+  `spark.rapids.tpu.coredump.path` before re-raising wrapped in
+  FatalDeviceError — the analogue of streaming the core dump out before
+  the executor dies.  PhysicalQuery.collect installs it when the conf
+  is set.
+- fault injection: `spark.rapids.tpu.test.injectFatalError` (internal)
+  raises a synthetic fatal error after N device batches, testing the
+  capture path the way injectRetryOOM tests the retry path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from contextlib import contextmanager
+from typing import Optional
+
+from ..config import TpuConf, conf as _conf, _positive
+from .memory import is_oom_error
+
+COREDUMP_PATH = _conf(
+    "spark.rapids.tpu.coredump.path", "",
+    "Directory for device crash dumps (GpuCoreDumpHandler role). Empty "
+    "disables capture.")
+
+INJECT_FATAL = _conf(
+    "spark.rapids.tpu.test.injectFatalError", 0,
+    "Test-only: raise a synthetic fatal device error after this many "
+    "device batches (0 = off).", internal=True,
+    checker=lambda v: None if v >= 0 else "must be >= 0")
+
+RETRYABLE = "retryable"
+FATAL_DEVICE = "fatal_device"
+QUERY = "query"
+
+_FATAL_MARKERS = (
+    "INTERNAL:", "DATA_LOSS", "device halted", "Device halted",
+    "FAILED_PRECONDITION: The program continuator has halted",
+    "XLA:TPU compile permanent error", "tpu driver",
+)
+
+
+class FatalDeviceError(RuntimeError):
+    """The device/runtime is wedged; the hosting process should exit
+    (the CudaFatalException analogue)."""
+
+    def __init__(self, msg: str, dump_path: Optional[str] = None):
+        super().__init__(msg)
+        self.dump_path = dump_path
+
+
+class InjectedFatalError(Exception):
+    """Synthetic fatal error from the fault-injection conf."""
+
+
+def classify(exc: BaseException) -> str:
+    if isinstance(exc, (FatalDeviceError, InjectedFatalError)):
+        return FATAL_DEVICE
+    if is_oom_error(exc):
+        return RETRYABLE
+    s = str(exc)
+    mod = type(exc).__module__ or ""
+    from_device_runtime = ("jax" in mod
+                           or "XlaRuntimeError" in type(exc).__name__)
+    if from_device_runtime and any(m in s for m in _FATAL_MARKERS):
+        return FATAL_DEVICE
+    return QUERY
+
+
+def write_crash_dump(conf: TpuConf, exc: BaseException,
+                     ctx=None) -> Optional[str]:
+    """Serialize diagnostic state next to the dying executor (the
+    core-dump stream-out). Returns the dump path."""
+    dump_dir = conf.get(COREDUMP_PATH)
+    if not dump_dir:
+        return None
+    os.makedirs(dump_dir, exist_ok=True)
+    info = {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "exception": repr(exc),
+        "traceback": traceback.format_exception(
+            type(exc), exc, exc.__traceback__),
+        "classification": classify(exc),
+    }
+    try:
+        import jax
+        d = jax.devices()[0]
+        info["device"] = {"kind": d.device_kind,
+                          "platform": d.platform,
+                          "id": d.id}
+        info["jax_version"] = jax.__version__
+        stats = d.memory_stats() or {}
+        info["memory_stats"] = {k: v for k, v in stats.items()
+                                if isinstance(v, (int, float))}
+    except Exception as e:                       # noqa: BLE001
+        info["device"] = f"unavailable: {e!r}"
+    if ctx is not None:
+        info["query_metrics"] = dict(getattr(ctx, "metrics", {}))
+        budget = getattr(ctx, "_budget", None)
+        if budget is not None:
+            info["memory_budget"] = dict(getattr(budget, "metrics", {}))
+    path = os.path.join(dump_dir,
+                        f"tpu-coredump-{os.getpid()}-{int(time.time())}"
+                        f".json")
+    with open(path, "w") as f:
+        json.dump(info, f, indent=2, default=str)
+    return path
+
+
+@contextmanager
+def crash_capture(conf: TpuConf, ctx=None):
+    """On a fatal device error: capture the dump, re-raise as
+    FatalDeviceError so the hosting process can self-terminate (the
+    Plugin.scala:569-575 contract: Spark replaces the executor)."""
+    try:
+        yield
+    except BaseException as exc:                 # noqa: BLE001
+        if classify(exc) == FATAL_DEVICE and \
+                not isinstance(exc, FatalDeviceError):
+            path = write_crash_dump(conf, exc, ctx)
+            raise FatalDeviceError(
+                f"fatal device error: {exc!r}"
+                + (f" (crash dump: {path})" if path else ""),
+                dump_path=path) from exc
+        raise
+
+
+def install_fault_injection(root, conf: TpuConf) -> None:
+    """Wrap a physical root's execute stream with the batch-count fatal
+    injector when the test conf asks for it (injectRetryOOM's sibling)."""
+    thr = int(conf.get(INJECT_FATAL))
+    if not thr or getattr(root, "_fatal_injected", False):
+        return
+    inj = FatalInjector(conf)
+    orig = root.execute
+
+    def wrapped(ctx):
+        for b in orig(ctx):
+            inj.tick()
+            yield b
+
+    root.execute = wrapped
+    root._fatal_injected = True
+
+
+class FatalInjector:
+    """Counts device batches; raises at the configured threshold."""
+
+    def __init__(self, conf: TpuConf):
+        self.threshold = int(conf.get(INJECT_FATAL))
+        self.count = 0
+
+    def tick(self):
+        if not self.threshold:
+            return
+        self.count += 1
+        if self.count >= self.threshold:
+            self.threshold = 0      # fire once
+            raise InjectedFatalError(
+                "injected fatal device error "
+                "(spark.rapids.tpu.test.injectFatalError)")

@@ -1,0 +1,79 @@
+"""Failure detection + crash capture (GpuCoreDumpHandler /
+executor-self-termination role): classification, dump contents, fault
+injection through a real query."""
+import json
+import os
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.runtime.failure import (FATAL_DEVICE, QUERY,
+                                              RETRYABLE, FatalDeviceError,
+                                              InjectedFatalError, classify,
+                                              crash_capture,
+                                              write_crash_dump)
+from spark_rapids_tpu.runtime.memory import TpuRetryOOM
+from spark_rapids_tpu.session import TpuSession, col
+from spark_rapids_tpu.plan import expressions as E
+
+
+def test_classify_retryable():
+    assert classify(TpuRetryOOM("x")) == RETRYABLE
+    assert classify(RuntimeError("RESOURCE_EXHAUSTED: hbm")) == RETRYABLE
+
+
+def test_classify_fatal_and_query():
+    assert classify(InjectedFatalError("boom")) == FATAL_DEVICE
+    assert classify(FatalDeviceError("wedged")) == FATAL_DEVICE
+    assert classify(ValueError("user bug")) == QUERY
+    # a plain python error mentioning INTERNAL: is NOT device-fatal
+    assert classify(ValueError("INTERNAL: not from xla")) == QUERY
+
+
+def test_crash_capture_writes_dump(tmp_path):
+    conf = TpuConf({"spark.rapids.tpu.coredump.path": str(tmp_path)})
+    with pytest.raises(FatalDeviceError) as ei:
+        with crash_capture(conf):
+            raise InjectedFatalError("synthetic halt")
+    path = ei.value.dump_path
+    assert path and os.path.exists(path)
+    dump = json.load(open(path))
+    assert dump["classification"] == FATAL_DEVICE
+    assert "synthetic halt" in dump["exception"]
+    assert any("InjectedFatalError" in line
+               for line in dump["traceback"])
+
+
+def test_crash_capture_passes_query_errors_through(tmp_path):
+    conf = TpuConf({"spark.rapids.tpu.coredump.path": str(tmp_path)})
+    with pytest.raises(ValueError):
+        with crash_capture(conf):
+            raise ValueError("plain bug")
+    assert not os.listdir(tmp_path)      # no dump for non-fatal
+
+
+def test_dump_without_conf_is_none():
+    conf = TpuConf()
+    assert write_crash_dump(conf, RuntimeError("x")) is None
+
+
+def test_fault_injection_through_real_query(tmp_path):
+    s = TpuSession({
+        "spark.rapids.tpu.coredump.path": str(tmp_path),
+        "spark.rapids.tpu.test.injectFatalError": "1",
+    })
+    tbl = pa.table({"x": pa.array(range(100), pa.int64())})
+    df = s.from_arrow(tbl).filter(E.GreaterThan(col("x"), E.Literal(10)))
+    with pytest.raises(FatalDeviceError) as ei:
+        df.collect()
+    assert ei.value.dump_path and os.path.exists(ei.value.dump_path)
+    dump = json.load(open(ei.value.dump_path))
+    assert "device" in dump
+
+
+def test_no_injection_query_unaffected(tmp_path):
+    s = TpuSession({"spark.rapids.tpu.coredump.path": str(tmp_path)})
+    tbl = pa.table({"x": pa.array(range(10), pa.int64())})
+    assert s.from_arrow(tbl).count() == 10
+    assert not os.listdir(tmp_path)
