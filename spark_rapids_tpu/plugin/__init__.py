@@ -1,0 +1,27 @@
+"""Plugin boundary: the JVM <-> TPU-worker contract.
+
+SURVEY §7 names the JVM⇄device process boundary "THE critical design
+decision": the reference runs in-process over JNI
+(sql-plugin/.../Plugin.scala:426,496); a TPU plugin cannot (no JAX JVM
+binding), so the executor hosts a long-lived TPU worker process and
+ships physical plans + columnar data across a local socket.
+
+This package is the worker side of that contract plus a reference
+client:
+
+- `protocol.py` — the versioned JSON plan/expression wire schema and its
+  decoder into the engine's LogicalPlan (what the Scala plugin's
+  convertToGpu emits instead of constructing exec objects), with Arrow
+  IPC as the data plane.
+- `worker.py` — the long-lived worker process: length-prefixed frames
+  over a local socket, one engine session per connection, explain /
+  execute / metrics requests.
+- `client.py` — a python client used by the tests; the JVM plugin
+  implements the same framing from Scala.
+"""
+from .protocol import plan_from_json, plan_to_json, PROTOCOL_VERSION
+from .worker import PlanWorker
+from .client import WorkerClient
+
+__all__ = ["plan_from_json", "plan_to_json", "PROTOCOL_VERSION",
+           "PlanWorker", "WorkerClient"]

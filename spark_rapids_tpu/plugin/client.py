@@ -1,0 +1,68 @@
+"""Reference client for the worker protocol (what the JVM plugin
+implements in Scala: JSON frame + ArrowStreamWriter frames out, JSON
+frame + ArrowStreamReader frame back)."""
+from __future__ import annotations
+
+import json
+import socket
+from typing import Dict, Optional, Tuple
+
+import pyarrow as pa
+
+from .worker import (ipc_to_table, recv_frame, send_frame, table_to_ipc)
+
+
+class WorkerError(RuntimeError):
+    def __init__(self, error_class: str, message: str):
+        super().__init__(f"{error_class}: {message}")
+        self.error_class = error_class
+
+
+class WorkerClient:
+    def __init__(self, address: Tuple[str, int]):
+        self._sock = socket.create_connection(address)
+
+    def ping(self) -> dict:
+        send_frame(self._sock, json.dumps({"type": "ping"}).encode())
+        return self._json_reply()
+
+    def execute(self, plan: dict, tables: Dict[str, pa.Table],
+                conf: Optional[dict] = None) -> Tuple[pa.Table, dict]:
+        self._send_request("execute", plan, tables, conf)
+        head = self._json_reply()
+        data = recv_frame(self._sock)
+        return ipc_to_table(data), head.get("metrics", {})
+
+    def explain(self, plan: dict, tables: Dict[str, pa.Table],
+                conf: Optional[dict] = None) -> dict:
+        self._send_request("explain", plan, tables, conf)
+        return self._json_reply()
+
+    def _send_request(self, kind: str, plan: dict,
+                      tables: Dict[str, pa.Table],
+                      conf: Optional[dict]):
+        names = sorted(tables)
+        send_frame(self._sock, json.dumps({
+            "type": kind, "plan": plan, "tables": names,
+            "conf": conf or {}}).encode())
+        for name in names:
+            send_frame(self._sock, table_to_ipc(tables[name]))
+
+    def _json_reply(self) -> dict:
+        frame = recv_frame(self._sock)
+        if frame is None:
+            raise WorkerError("ConnectionError", "worker closed")
+        head = json.loads(frame)
+        if head.get("type") == "error":
+            raise WorkerError(head.get("error_class", "?"),
+                              head.get("message", ""))
+        return head
+
+    def close(self):
+        self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

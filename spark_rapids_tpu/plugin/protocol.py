@@ -1,0 +1,318 @@
+"""Versioned JSON wire schema for plans/expressions + Arrow IPC data.
+
+The JVM side of the plugin (the GpuOverrides analogue running inside
+Spark's driver/executor) serializes each *physical* plan subtree it
+decided to accelerate into this schema; the worker decodes it into the
+engine's LogicalPlan and runs it through the normal overrides engine
+(wrap -> tag -> convert), so per-operator fallback and explain work
+identically for shipped plans and native DataFrame plans.
+
+Expressions serialize as {"e": <class name>, "children": [...]} plus
+class-specific fields; plans as {"op": <name>, ...}.  Input tables
+travel as Arrow IPC streams referenced by name ("t0", "t1", ...).
+Unknown ops/expressions raise ProtocolError with the offending name so
+the JVM side can tag that subtree CPU-only — the same contract
+GpuOverrides' rule registry provides in-process.
+"""
+from __future__ import annotations
+
+import datetime as pydt
+import decimal as pydec
+from typing import Any, Dict
+
+import pyarrow as pa
+
+from .. import types as t
+from ..plan import aggregates as A
+from ..plan import datetime as DT
+from ..plan import expressions as E
+from ..plan import logical as L
+from ..plan import strings as S
+
+PROTOCOL_VERSION = 1
+
+
+class ProtocolError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# types
+# ---------------------------------------------------------------------------
+
+_SIMPLE_TYPES = {
+    "boolean": t.BOOLEAN, "tinyint": t.BYTE, "smallint": t.SHORT,
+    "int": t.INT, "bigint": t.LONG, "float": t.FLOAT, "double": t.DOUBLE,
+    "string": t.STRING, "date": t.DATE,
+}
+
+
+def type_from_string(s: str) -> t.DataType:
+    if s in _SIMPLE_TYPES:
+        return _SIMPLE_TYPES[s]
+    if s.startswith("decimal(") and s.endswith(")"):
+        p, sc = s[len("decimal("):-1].split(",")
+        return t.DecimalType(int(p), int(sc))
+    if s.startswith("timestamp"):
+        return t.TIMESTAMP
+    raise ProtocolError(f"unknown type string {s!r}")
+
+
+# ---------------------------------------------------------------------------
+# expressions
+# ---------------------------------------------------------------------------
+
+# children-only constructors: cls(*children)
+_CHILD_ONLY = {}
+for _cls in (E.Add, E.Subtract, E.Multiply, E.Divide, E.IntegralDivide,
+             E.Remainder, E.UnaryMinus, E.Abs, E.EqualTo, E.NotEqual,
+             E.LessThan, E.LessThanOrEqual, E.GreaterThan,
+             E.GreaterThanOrEqual, E.EqualNullSafe, E.And, E.Or, E.Not,
+             E.IsNull, E.IsNotNull, E.IsNaN, E.Coalesce, E.If, E.Sqrt,
+             E.Exp, E.Log, E.Log10, E.Log2, E.Cbrt, E.Signum, E.Floor,
+             E.Ceil, E.Pow, E.Atan2, E.Greatest, E.Least, E.Sin, E.Cos,
+             E.Tan, E.Asin, E.Acos, E.Atan, E.Sinh, E.Cosh, E.Tanh,
+             S.Upper, S.Lower, S.InitCap, S.Length, S.Reverse,
+             S.Concat, DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek,
+             DT.DayOfYear, DT.Quarter, DT.Hour, DT.Minute, DT.Second,
+             DT.DateAdd, DT.DateSub, DT.DateDiff):
+    _CHILD_ONLY[_cls.__name__] = _cls
+
+
+def expr_to_json(e: E.Expression) -> Dict[str, Any]:
+    name = type(e).__name__
+    if isinstance(e, E.ColumnRef):
+        return {"e": "ColumnRef", "name": e.name}
+    if isinstance(e, E.Literal):
+        v = e.value
+        if isinstance(v, pydec.Decimal):
+            v = {"decimal": str(v)}
+        elif isinstance(v, pydt.date):
+            v = {"date": v.isoformat()}
+        return {"e": "Literal", "value": v,
+                "dtype": e.dtype.simple_string if e.dtype else None}
+    if isinstance(e, E.Alias):
+        return {"e": "Alias", "name": e.name,
+                "child": expr_to_json(e.children[0])}
+    if isinstance(e, E.Cast):
+        return {"e": "Cast", "dtype": e.to.simple_string,
+                "child": expr_to_json(e.children[0])}
+    if isinstance(e, E.In):
+        return {"e": "In", "child": expr_to_json(e.children[0]),
+                "items": list(e.items)}
+    if isinstance(e, E.CaseWhen):
+        n = len(e.children)
+        has_else = n % 2 == 1
+        pairs = (n - 1) // 2 if has_else else n // 2
+        return {"e": "CaseWhen",
+                "branches": [[expr_to_json(e.children[2 * i]),
+                              expr_to_json(e.children[2 * i + 1])]
+                             for i in range(pairs)],
+                "else": expr_to_json(e.children[-1]) if has_else else None}
+    if name in _CHILD_ONLY:
+        return {"e": name,
+                "children": [expr_to_json(c) for c in e.children]}
+    if isinstance(e, (S.StartsWith, S.EndsWith, S.Contains)):
+        return {"e": name, "child": expr_to_json(e.children[0]),
+                "needle": e.children[1].value}
+    if isinstance(e, S.Substring):
+        out = {"e": "Substring", "child": expr_to_json(e.children[0]),
+               "pos": e.children[1].value}
+        if len(e.children) > 2:
+            out["length"] = e.children[2].value
+        return out
+    if isinstance(e, S.Like):
+        return {"e": "Like", "child": expr_to_json(e.children[0]),
+                "pattern": e.pattern, "escape": e.escape}
+    raise ProtocolError(f"expression {name} has no wire encoding")
+
+
+def expr_from_json(d: Dict[str, Any]) -> E.Expression:
+    kind = d["e"]
+    if kind == "ColumnRef":
+        return E.ColumnRef(d["name"])
+    if kind == "Literal":
+        v = d["value"]
+        if isinstance(v, dict) and "decimal" in v:
+            v = pydec.Decimal(v["decimal"])
+        elif isinstance(v, dict) and "date" in v:
+            v = pydt.date.fromisoformat(v["date"])
+        dt = type_from_string(d["dtype"]) if d.get("dtype") else None
+        if d.get("dtype") == "date" and isinstance(v, int):
+            dt = t.DATE
+        return E.Literal(v, dt)
+    if kind == "Alias":
+        return E.Alias(expr_from_json(d["child"]), d["name"])
+    if kind == "Cast":
+        return E.Cast(expr_from_json(d["child"]),
+                      type_from_string(d["dtype"]))
+    if kind == "In":
+        return E.In(expr_from_json(d["child"]), d["items"])
+    if kind == "CaseWhen":
+        branches = [(expr_from_json(c), expr_from_json(v))
+                    for c, v in d["branches"]]
+        els = expr_from_json(d["else"]) if d.get("else") else None
+        return E.CaseWhen(branches, els)
+    if kind in _CHILD_ONLY:
+        return _CHILD_ONLY[kind](*[expr_from_json(c)
+                                   for c in d.get("children", [])])
+    if kind in ("StartsWith", "EndsWith", "Contains"):
+        cls = {"StartsWith": S.StartsWith, "EndsWith": S.EndsWith,
+               "Contains": S.Contains}[kind]
+        return cls(expr_from_json(d["child"]), d["needle"])
+    if kind == "Substring":
+        args = [expr_from_json(d["child"]), d["pos"]]
+        if "length" in d:
+            args.append(d["length"])
+        return S.Substring(*args)
+    if kind == "Like":
+        return S.Like(expr_from_json(d["child"]), d["pattern"],
+                      d.get("escape", "\\"))
+    raise ProtocolError(f"unknown expression {kind!r} "
+                        f"(protocol v{PROTOCOL_VERSION})")
+
+
+# ---------------------------------------------------------------------------
+# aggregate functions
+# ---------------------------------------------------------------------------
+
+_AGG_CLASSES = {c.__name__: c for c in (
+    A.Sum, A.Count, A.Min, A.Max, A.Average, A.First, A.Last, A.BoolAnd,
+    A.BoolOr, A.VariancePop, A.VarianceSamp, A.StddevPop, A.StddevSamp,
+    A.CollectList, A.CollectSet, A.CountDistinct, A.Percentile, A.Median,
+    A.ApproximatePercentile)}
+
+
+def agg_to_json(fn: A.AggregateFunction, name: str) -> Dict[str, Any]:
+    cls = type(fn).__name__
+    if cls not in _AGG_CLASSES:
+        raise ProtocolError(f"aggregate {cls} has no wire encoding")
+    out = {"fn": cls, "name": name,
+           "child": expr_to_json(fn.child) if fn.child is not None
+           else None}
+    if isinstance(fn, A.Percentile) and not isinstance(fn, A.Median):
+        out["q"] = fn.percentage
+    if isinstance(fn, A.First):          # covers Last (subclass)
+        out["ignore_nulls"] = fn.ignore_nulls
+    return out
+
+
+def agg_from_json(d: Dict[str, Any]):
+    cls = _AGG_CLASSES.get(d["fn"])
+    if cls is None:
+        raise ProtocolError(f"unknown aggregate {d['fn']!r}")
+    child = expr_from_json(d["child"]) if d.get("child") else None
+    if issubclass(cls, A.Percentile) and not issubclass(cls, A.Median):
+        return (cls(child, d["q"]), d["name"])
+    if issubclass(cls, A.First):
+        return (cls(child, d.get("ignore_nulls", False)), d["name"])
+    return (cls(child), d["name"])
+
+
+# ---------------------------------------------------------------------------
+# plans
+# ---------------------------------------------------------------------------
+
+def plan_to_json(plan: L.LogicalPlan,
+                 tables: Dict[str, pa.Table] = None) -> Dict[str, Any]:
+    """Serialize a plan; every LogicalScan's table is assigned a name
+    ("t0", "t1", ...) and collected into `tables` for the caller to ship
+    as Arrow IPC frames (same table object -> same name)."""
+    if tables is None:
+        tables = {}
+    if isinstance(plan, L.LogicalScan):
+        for name, tbl in tables.items():
+            if tbl is plan.table:
+                return {"op": "Scan", "table": name}
+        name = f"t{len(tables)}"
+        tables[name] = plan.table
+        return {"op": "Scan", "table": name}
+    if isinstance(plan, L.LogicalProject):
+        return {"op": "Project",
+                "exprs": [expr_to_json(e) for e in plan.exprs],
+                "names": list(plan.names),
+                "child": plan_to_json(plan.child, tables)}
+    if isinstance(plan, L.LogicalFilter):
+        return {"op": "Filter", "condition": expr_to_json(plan.condition),
+                "child": plan_to_json(plan.child, tables)}
+    if isinstance(plan, L.LogicalAggregate):
+        return {"op": "Aggregate",
+                "keys": [expr_to_json(k) for k in plan.keys],
+                "key_names": list(plan.key_names),
+                "aggs": [agg_to_json(fn, n) for fn, n in plan.aggs],
+                "child": plan_to_json(plan.child, tables)}
+    if isinstance(plan, L.LogicalJoin):
+        return {"op": "Join", "how": plan.join_type,
+                "left_keys": [expr_to_json(k) for k in plan.left_keys],
+                "right_keys": [expr_to_json(k) for k in plan.right_keys],
+                "broadcast": plan.broadcast,
+                "left": plan_to_json(plan.left, tables),
+                "right": plan_to_json(plan.right, tables)}
+    if isinstance(plan, L.LogicalSort):
+        return {"op": "Sort",
+                "orders": [[expr_to_json(e if isinstance(e, E.Expression)
+                                         else E.ColumnRef(e)), asc, nf]
+                           for e, asc, nf in plan.orders],
+                "global": plan.global_sort,
+                "child": plan_to_json(plan.child, tables)}
+    if isinstance(plan, L.LogicalLimit):
+        return {"op": "Limit", "n": plan.limit,
+                "child": plan_to_json(plan.child, tables)}
+    if isinstance(plan, L.LogicalUnion):
+        return {"op": "Union",
+                "children": [plan_to_json(c, tables)
+                             for c in plan.children]}
+    if isinstance(plan, L.LogicalRange):
+        return {"op": "Range", "start": plan.start, "end": plan.end,
+                "step": plan.step, "name": plan.col_name}
+    raise ProtocolError(
+        f"plan {type(plan).__name__} has no wire encoding")
+
+
+def plan_from_json(d: Dict[str, Any],
+                   tables: Dict[str, pa.Table]) -> L.LogicalPlan:
+    op = d["op"]
+    if op == "Scan":
+        name = d["table"]
+        if name not in tables:
+            raise ProtocolError(f"scan references unshipped table "
+                                f"{name!r}; have {sorted(tables)}")
+        return L.LogicalScan(tables[name])
+    if op == "Project":
+        return L.LogicalProject(
+            [expr_from_json(e) for e in d["exprs"]],
+            plan_from_json(d["child"], tables), d.get("names"))
+    if op == "Filter":
+        return L.LogicalFilter(expr_from_json(d["condition"]),
+                               plan_from_json(d["child"], tables))
+    if op == "Aggregate":
+        keys = [expr_from_json(k) for k in d["keys"]]
+        return L.LogicalAggregate(
+            keys, [agg_from_json(a) for a in d["aggs"]],
+            plan_from_json(d["child"], tables),
+            key_names=d.get("key_names"))
+    if op == "Join":
+        return L.LogicalJoin(
+            d["how"], plan_from_json(d["left"], tables),
+            plan_from_json(d["right"], tables),
+            [expr_from_json(k) for k in d["left_keys"]],
+            [expr_from_json(k) for k in d["right_keys"]],
+            broadcast=d.get("broadcast"))
+    if op == "Sort":
+        return L.LogicalSort(
+            [(expr_from_json(e), asc, nf) for e, asc, nf in d["orders"]],
+            plan_from_json(d["child"], tables),
+            d.get("global", True))
+    if op == "Limit":
+        return L.LogicalLimit(d["n"], plan_from_json(d["child"], tables))
+    if op == "Union":
+        kids = [plan_from_json(c, tables) for c in d["children"]]
+        out = L.LogicalUnion(kids[0], kids[1])
+        for k in kids[2:]:
+            out = L.LogicalUnion(out, k)
+        return out
+    if op == "Range":
+        return L.LogicalRange(d["start"], d["end"], d.get("step", 1),
+                              d.get("name", "id"))
+    raise ProtocolError(f"unknown plan op {op!r} "
+                        f"(protocol v{PROTOCOL_VERSION})")
