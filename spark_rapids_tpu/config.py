@@ -158,6 +158,18 @@ ADAPTIVE_ADVISORY_PARTITION_BYTES = conf(
     "(spark.sql.adaptive.advisoryPartitionSizeInBytes role).",
     checker=_positive)
 
+RUNTIME_FILTER_ENABLED = conf(
+    "spark.rapids.tpu.sql.join.runtimeFilter.enabled", True,
+    "Bloom-filter the probe side of large adaptive joins with the "
+    "materialized build side's keys before probing (the reference's "
+    "BloomFilter JNI / bloom_filter_might_contain role).")
+
+RUNTIME_FILTER_RATIO = conf(
+    "spark.rapids.tpu.sql.join.runtimeFilter.sizeRatio", 4.0,
+    "Apply the runtime filter only when probe bytes exceed build bytes "
+    "by this factor (below it the filter pass costs more than it saves).",
+    checker=_positive, internal=True)
+
 AGG_FALLBACK_PARTITIONS = conf(
     "spark.rapids.tpu.sql.agg.fallbackPartitions", 8,
     "Bucket count for the high-cardinality aggregation fallback: when "

@@ -54,6 +54,36 @@ class _ReplayStage(PlanNode):
         return f"ReplayStage[{len(self.batches)} batches]"
 
 
+class _BloomFilterStage(PlanNode):
+    """Probe-side runtime filter: drop rows whose join key is DEFINITELY
+    absent from the build side (ops/bloom.py).  Only wrapped around
+    joins where unmatched probe rows never reach the output."""
+
+    def __init__(self, child: PlanNode, bits, key_cols_fn, k: int):
+        super().__init__(child)
+        self.bits = bits
+        self.key_cols_fn = key_cols_fn
+        self.k = k
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from ..ops.bloom import bloom_might_contain
+        from ..ops.filter import compact_batch
+        for db in self.child.execute(ctx):
+            mask = bloom_might_contain(self.bits, self.key_cols_fn(db),
+                                       db, self.k) & db.row_mask()
+            out = compact_batch(db, mask, ctx.conf)
+            ctx.bump("bloom_filtered_rows",
+                     int(db.num_rows) - int(out.num_rows))
+            yield out
+
+    def describe(self):
+        return f"BloomFilterStage[k={self.k}]"
+
+
 class AdaptiveShuffledJoinExec(PlanNode):
     """Equi-join whose build side is chosen from measured input sizes.
 
@@ -108,6 +138,8 @@ class AdaptiveShuffledJoinExec(PlanNode):
                     _ReplayStage(right_stage,
                                  self.right.output_schema),
                     _ReplayStage(left_stage, self.left.output_schema))
+                self._maybe_bloom(join, jt, left_stage,
+                                  max(rbytes, 1), lbytes, ctx)
                 n_r = len(self.right.output_schema.fields)
                 n_l = len(self.left.output_schema.fields)
                 # mirrored output is right-cols ++ left-cols; restore
@@ -120,10 +152,50 @@ class AdaptiveShuffledJoinExec(PlanNode):
                     _ReplayStage(left_stage, self.left.output_schema),
                     _ReplayStage(right_stage,
                                  self.right.output_schema))
+                self._maybe_bloom(join, self.join_type, right_stage,
+                                  max(lbytes, 1), rbytes, ctx)
                 yield from join.execute(ctx)
         finally:
             for sp in left_stage + right_stage:
                 sp.close()
+
+    def _maybe_bloom(self, join: HashJoinExec, effective_jt: str,
+                     build_stage: List[Spillable], probe_bytes: int,
+                     build_bytes: int, ctx: ExecContext) -> None:
+        """Install a probe-side bloom runtime filter when profitable.
+
+        Safe only where unmatched PROBE rows never reach the output
+        (inner: dropped anyway; right_outer: output = matched probe +
+        all build rows).  left/full outer must keep unmatched probe rows
+        null-extended, anti must OUTPUT them — never filtered."""
+        from ..config import RUNTIME_FILTER_ENABLED, RUNTIME_FILTER_RATIO
+        if effective_jt not in ("inner", "right_outer"):
+            return
+        if not ctx.conf.get(RUNTIME_FILTER_ENABLED):
+            return
+        if probe_bytes < build_bytes * ctx.conf.get(RUNTIME_FILTER_RATIO):
+            return
+        from ..ops.bloom import (bloom_build, optimal_hashes,
+                                 optimal_slots)
+        build_rows = sum(sp.num_rows for sp in build_stage)
+        m = optimal_slots(build_rows)
+        k = optimal_hashes(build_rows, m)
+        raw_pos = join._raw_key_positions()
+        bits = None
+        for sp in build_stage:
+            bb = sp.get()
+            bits = bloom_build(
+                join._key_cols(bb, join.right_keys, raw_pos, ctx),
+                bb, m, k, bits)
+
+        def probe_keys(db):
+            return join._key_cols(db, join.left_keys, raw_pos, ctx)
+
+        # the probe child was just constructed by execute(); wrapping it
+        # here keeps key binding (done in HashJoinExec.__init__) intact
+        join.children[0] = _BloomFilterStage(
+            join.children[0], bits, probe_keys, k)
+        ctx.metrics["bloom_filter_slots"] = m
 
     def describe(self):
         return f"AdaptiveShuffledJoinExec[{self.join_type}]"

@@ -53,6 +53,15 @@ def init_distributed(coordinator: Optional[str] = None,
     if process_id is None and "PROCESS_ID" in os.environ:
         process_id = int(os.environ["PROCESS_ID"])
     if not coordinator or num_processes <= 1:
+        # standard Cloud TPU pod tooling sets no COORDINATOR_ADDRESS —
+        # an argless initialize() auto-detects the slice via TPU
+        # metadata; TPU_SKIP_DISTRIBUTED_INIT opts out for single-host
+        # runs that must not touch the coordination service
+        if os.environ.get("TPU_WORKER_HOSTNAMES") and \
+                not os.environ.get("TPU_SKIP_DISTRIBUTED_INIT"):
+            jax.distributed.initialize()
+            _INITIALIZED = True
+            return jax.process_count() > 1
         _INITIALIZED = True
         return False
     # process_id=None lets jax's cluster auto-detection assign ids
@@ -116,7 +125,7 @@ def two_level_all_to_all(mesh: Mesh, lanes, live, dest):
     n_hosts, ici = mesh.devices.shape
 
     def stage(axis: str, n_groups: int, group_of, chip_lanes, chip_live,
-              chip_dest):
+              chip_dest, forward_dest: bool = True):
         # bucket rows by destination group along `axis`, pad to quota,
         # then all_to_all delivers each group its bucket
         quota = chip_lanes[0].shape[0]
@@ -134,7 +143,10 @@ def two_level_all_to_all(mesh: Mesh, lanes, live, dest):
         src = jnp.where(valid, order[
             jnp.clip(starts[g] + k, 0, quota - 1)], 0)
         outs = []
-        for lane in chip_lanes + [chip_dest]:
+        # the dest lane only travels when a later stage still routes on
+        # it — the final stage skips that whole collective
+        send = chip_lanes + ([chip_dest] if forward_dest else [])
+        for lane in send:
             staged = lane[src].reshape(n_groups, quota)
             outs.append(jax.lax.all_to_all(
                 staged, axis, 0, 0, tiled=False))
@@ -142,7 +154,9 @@ def two_level_all_to_all(mesh: Mesh, lanes, live, dest):
         live_out = jax.lax.all_to_all(staged_live, axis, 0, 0,
                                       tiled=False)
         flat = [o.reshape(-1) for o in outs]
-        return flat[:-1], live_out.reshape(-1), flat[-1]
+        if forward_dest:
+            return flat[:-1], live_out.reshape(-1), flat[-1]
+        return flat, live_out.reshape(-1), None
 
     def prog(*args):
         n = len(lanes)
@@ -155,7 +169,7 @@ def two_level_all_to_all(mesh: Mesh, lanes, live, dest):
                                  chip_lanes, chip_live, chip_dest)
         # stage 2: to owning chip over ICI
         l2, live2, _ = stage(ICI_AXIS, ici, lambda d: d % ici,
-                             l1, live1, dest1)
+                             l1, live1, dest1, forward_dest=False)
         return tuple(o[None, :] for o in l2) + (live2[None, :],)
 
     shard = cluster_row_sharding(mesh)

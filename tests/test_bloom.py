@@ -1,0 +1,135 @@
+"""Bloom runtime join filter (BloomFilter JNI / InjectRuntimeFilter
+role): no false negatives, real filtering, adaptive-join integration."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.device import to_device
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec.plan import ExecContext
+from spark_rapids_tpu.ops.bloom import (bloom_build, bloom_might_contain,
+                                        optimal_hashes, optimal_slots)
+from spark_rapids_tpu.session import DataFrame, TpuSession, col
+
+
+def _dev(table):
+    return to_device(HostBatch.from_table(pa.table(table)))
+
+
+def test_sizing():
+    m = optimal_slots(10_000)
+    assert m & (m - 1) == 0 and 1 << 10 <= m <= 1 << 22
+    assert 1 <= optimal_hashes(10_000, m) <= 6
+    assert optimal_slots(10**9) == 1 << 22       # clamped
+
+
+def test_no_false_negatives_and_some_filtering():
+    rng = np.random.default_rng(6)
+    build_keys = rng.choice(100_000, size=2000, replace=False)
+    bd = _dev({"k": pa.array(build_keys, pa.int64())})
+    m = optimal_slots(2000)
+    k = optimal_hashes(2000, m)
+    bits = bloom_build([bd.column_by_name("k")], bd, m, k)
+
+    probe_keys = rng.integers(0, 100_000, 20_000)
+    pd_ = _dev({"k": pa.array(probe_keys, pa.int64())})
+    mask = np.asarray(bloom_might_contain(
+        bits, [pd_.column_by_name("k")], pd_, k))
+    live = np.asarray(pd_.row_mask())
+    in_build = np.isin(probe_keys, build_keys)
+    got = mask[live][:len(probe_keys)]
+    # every true member passes (no false negatives)
+    assert got[in_build].all()
+    # and a useful share of non-members is rejected
+    reject_rate = 1 - got[~in_build].mean()
+    assert reject_rate > 0.8, reject_rate
+
+
+def test_accumulate_over_batches():
+    b1 = _dev({"k": pa.array(range(0, 500), pa.int64())})
+    b2 = _dev({"k": pa.array(range(500, 1000), pa.int64())})
+    m, k = optimal_slots(1000), optimal_hashes(1000, optimal_slots(1000))
+    bits = bloom_build([b1.column_by_name("k")], b1, m, k)
+    bits = bloom_build([b2.column_by_name("k")], b2, m, k, bits)
+    probe = _dev({"k": pa.array(range(0, 1000), pa.int64())})
+    mask = np.asarray(bloom_might_contain(
+        bits, [probe.column_by_name("k")], probe, k))
+    assert mask[:1000].all()
+
+
+def _join_tables(n_small=200, n_big=50_000):
+    rng = np.random.default_rng(8)
+    small = pa.table({
+        "sk": pa.array(rng.choice(1_000_000, n_small, replace=False),
+                       pa.int64()),
+        "sv": pa.array(rng.standard_normal(n_small)),
+    })
+    big = pa.table({
+        "bk": pa.array(rng.integers(0, 1_000_000, n_big), pa.int64()),
+        "bv": pa.array(rng.integers(0, 99, n_big), pa.int64()),
+    })
+    return small, big
+
+
+def test_adaptive_join_applies_bloom_and_matches_oracle():
+    small, big = _join_tables()
+    dev = TpuSession()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    # big probe x small build: inner join, bloom should engage
+    df = dev.from_arrow(big).join(dev.from_arrow(small),
+                                  left_on=["bk"], right_on=["sk"])
+    ctx = ExecContext(dev.conf)
+    out = df.physical().collect(ctx)
+    assert ctx.metrics.get("bloom_filter_slots", 0) > 0
+    assert ctx.metrics.get("bloom_filtered_rows", 0) > 0
+    exp = DataFrame(df._plan, cpu).collect()
+
+    def norm(t):
+        return sorted(zip(t.column("bk").to_pylist(),
+                          t.column("bv").to_pylist(),
+                          t.column("sv").to_pylist()))
+    assert norm(out) == norm(exp)
+
+
+def test_bloom_disabled_by_conf():
+    small, big = _join_tables(100, 20_000)
+    s = TpuSession({"spark.rapids.tpu.sql.join.runtimeFilter.enabled":
+                    "false"})
+    df = s.from_arrow(big).join(s.from_arrow(small),
+                                left_on=["bk"], right_on=["sk"])
+    ctx = ExecContext(s.conf)
+    df.physical().collect(ctx)
+    assert "bloom_filter_slots" not in ctx.metrics
+
+
+def test_left_outer_never_bloom_filtered():
+    """Unmatched probe rows must survive in left outer output, so the
+    filter must not engage (effective jt after mirror = right_outer with
+    probe = the BIG side only happens for inner/right_outer paths)."""
+    small, big = _join_tables(100, 20_000)
+    dev = TpuSession()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    df = dev.from_arrow(big).join(dev.from_arrow(small), how="left_outer",
+                                  left_on=["bk"], right_on=["sk"])
+    ctx = ExecContext(dev.conf)
+    out = df.physical().collect(ctx)
+    exp = DataFrame(df._plan, cpu).collect()
+    assert out.num_rows == exp.num_rows == 20_000
+
+
+def test_string_keys_bloom():
+    rng = np.random.default_rng(10)
+    small = pa.table({"sk": pa.array([f"id{i}" for i in range(150)])})
+    big = pa.table({"bk": pa.array(
+        [f"id{i}" for i in rng.integers(0, 5000, 30_000)])})
+    dev = TpuSession()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    df = dev.from_arrow(big).join(dev.from_arrow(small),
+                                  left_on=["bk"], right_on=["sk"])
+    ctx = ExecContext(dev.conf)
+    out = df.physical().collect(ctx)
+    exp = DataFrame(df._plan, cpu).collect()
+    assert sorted(out.column("bk").to_pylist()) == \
+        sorted(exp.column("bk").to_pylist())
+    assert ctx.metrics.get("bloom_filtered_rows", 0) > 0

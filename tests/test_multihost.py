@@ -16,7 +16,18 @@ from spark_rapids_tpu.parallel.multihost import (DCN_AXIS, ICI_AXIS,
 
 def test_init_distributed_single_process(monkeypatch):
     monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
     assert init_distributed() is False        # no coordinator -> local
+
+
+def test_init_distributed_skip_flag(monkeypatch):
+    # pod metadata present but opted out -> stays single-process
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setenv("TPU_SKIP_DISTRIBUTED_INIT", "1")
+    import spark_rapids_tpu.parallel.multihost as mh
+    monkeypatch.setattr(mh, "_INITIALIZED", False)
+    assert init_distributed() is False
 
 
 def test_make_cluster_mesh_shapes():
