@@ -26,6 +26,7 @@ import time
 import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
@@ -180,6 +181,44 @@ class DeltaTable:
                     "dataChange": True}})
         if table.num_rows:
             _name, add = self._write_file(table)
+            actions.append(add)
+        self._commit(version, actions)
+        return version
+
+    def optimize(self, zorder_by: Optional[List[str]] = None,
+                 target_rows: int = 1 << 20) -> int:
+        """OPTIMIZE [ZORDER BY]: compact the snapshot into ~target_rows
+        files; with zorder_by, rows are first reordered along the Morton
+        curve over those columns (ops/zorder.py, the reference's
+        GpuOptimizeExecutor + ZOrder JNI role — delta-lake/
+        GpuOptimisticTransaction.scala + zorder/ dir).  Rewrites carry
+        dataChange=false so streaming readers skip them, and the add
+        actions keep per-file min/max stats so z-ordered files prune.
+        Returns the committed version."""
+        files = self.snapshot_files()
+        if not files:
+            return self.version()
+        tbl = pa.concat_tables([pq.read_table(p) for p in files])
+        if zorder_by:
+            from ..ops.zorder import zorder_sort_indices
+            cols = [_zorder_lane(tbl.column(name), name)
+                    for name in zorder_by]
+            tbl = tbl.take(pa.array(zorder_sort_indices(cols)))
+        version = self.version() + 1
+        op = "OPTIMIZE"
+        params = {"targetRows": target_rows}
+        if zorder_by:
+            params["zOrderBy"] = json.dumps(list(zorder_by))
+        actions = [self._commit_info(op, params)]
+        for p in files:
+            actions.append({"remove": {
+                "path": os.path.relpath(p, self.path),
+                "deletionTimestamp": int(time.time() * 1000),
+                "dataChange": False}})
+        for start in range(0, tbl.num_rows, target_rows):
+            chunk = tbl.slice(start, target_rows)
+            _name, add = self._write_file(chunk)
+            add["add"]["dataChange"] = False
             actions.append(add)
         self._commit(version, actions)
         return version
@@ -354,6 +393,32 @@ class DeltaTable:
 
         self._commit(version, actions)
         return version
+
+
+def _zorder_lane(arr: pa.ChunkedArray, name: str) -> np.ndarray:
+    """Any clusterable column -> float64 lane for the Morton key:
+    numerics/decimals cast directly, date/timestamp via their integer
+    representation, strings by value rank.  Nulls cluster first."""
+    dt = arr.type
+    if pa.types.is_string(dt) or pa.types.is_large_string(dt):
+        vals = arr.to_pylist()
+        uniq = sorted({v for v in vals if v is not None})
+        rank = {v: i for i, v in enumerate(uniq)}
+        return np.array([-1.0 if v is None else float(rank[v])
+                         for v in vals], np.float64)
+    if pa.types.is_timestamp(dt) or pa.types.is_date(dt):
+        arr = arr.cast(pa.int64() if pa.types.is_timestamp(dt)
+                       else pa.int32())
+    try:
+        f = arr.cast(pa.float64())
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError) as e:
+        raise TypeError(f"ZORDER BY {name}: type {dt} is not "
+                        f"clusterable") from e
+    if f.null_count:
+        import pyarrow.compute as pc
+        lo = pc.min(f).as_py()
+        f = f.fill_null((lo if lo is not None else 0.0) - 1.0)
+    return np.asarray(f.combine_chunks())
 
 
 def _null_safe(condition):

@@ -165,11 +165,12 @@ class AdaptiveShuffledJoinExec(PlanNode):
         """Install a probe-side bloom runtime filter when profitable.
 
         Safe only where unmatched PROBE rows never reach the output
-        (inner: dropped anyway; right_outer: output = matched probe +
-        all build rows).  left/full outer must keep unmatched probe rows
-        null-extended, anti must OUTPUT them — never filtered."""
+        (inner/left_semi: dropped anyway; right_outer: output = matched
+        probe + all build rows).  left/full outer must keep unmatched
+        probe rows null-extended, anti must OUTPUT them — never
+        filtered."""
         from ..config import RUNTIME_FILTER_ENABLED, RUNTIME_FILTER_RATIO
-        if effective_jt not in ("inner", "right_outer"):
+        if effective_jt not in ("inner", "right_outer", "left_semi"):
             return
         if not ctx.conf.get(RUNTIME_FILTER_ENABLED):
             return

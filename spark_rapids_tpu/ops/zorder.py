@@ -1,0 +1,93 @@
+"""Z-order (Morton) clustering keys on device.
+
+Role of the reference's ZOrder JNI kernel (SURVEY §2.9: interleave bits,
+used by Delta OPTIMIZE ZORDER and Databricks interleave_bits; zorder/
+dir ~323 LoC).  TPU formulation: per column, min-max scale values to
+uint32 in ONE fused program (the scan for min/max and the scale both
+vectorize), then interleave the top `63 // n_cols` bits of every column
+into a single int64 sort key — bit i of the key cycles through the
+columns, so sorting by the key gives the space-filling-curve order that
+keeps per-file min/max ranges tight on every z-ordered column.
+
+On TPUs with emulated f64 (double-double) the min-max scaling can land
+one ulp away from a host float64 computation, so device keys match a
+numpy oracle only to ±1 in each column's scaled value — identical
+clustering, not identical bits (the engine's general computed-f64
+deviation policy; exact-bit tests belong on the CPU backend).
+
+The 63-bit key truncates each column to 63/n bits of resolution (vs the
+reference's full byte-array keys): for file clustering this is ample —
+resolution only needs to exceed the file count by a few bits — and it
+keeps the key a single sortable lane instead of a variable-width byte
+string XLA cannot sort natively.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_unit_u32(x: jax.Array, valid: jax.Array, bits: int) -> jax.Array:
+    """Min-max scale a numeric lane to [0, 2^bits) uint32; nulls map to
+    0 (clustered first, like NULLS FIRST)."""
+    f = x.astype(jnp.float64)
+    big = jnp.float64(1e300)
+    lo = jnp.min(jnp.where(valid, f, big))
+    hi = jnp.max(jnp.where(valid, f, -big))
+    span = jnp.maximum(hi - lo, 1e-300)
+    top = jnp.float64((1 << bits) - 1)
+    u = jnp.clip((f - lo) / span * top, 0.0, top)
+    return jnp.where(valid, u.astype(jnp.uint32), jnp.uint32(0))
+
+
+def zorder_key(lanes: Sequence[jax.Array],
+               valids: Sequence[jax.Array]) -> jax.Array:
+    """Interleaved int64 sort key from N numeric lanes (N <= 8).
+    Column 0 owns the most significant bit of each round."""
+    n = len(lanes)
+    if not 1 <= n <= 8:
+        raise ValueError(f"zorder over {n} columns (1..8 supported)")
+    bits = min(32, 63 // n)      # 63 total: keys stay positive as int64
+    us = [_to_unit_u32(x, v, bits) for x, v in zip(lanes, valids)]
+    key = jnp.zeros(lanes[0].shape, jnp.uint64)
+    for b in range(bits - 1, -1, -1):
+        for u in us:
+            key = (key << jnp.uint64(1)) | \
+                ((u >> jnp.uint32(b)) & jnp.uint32(1)).astype(jnp.uint64)
+    return key.astype(jnp.int64)   # <= 63 bits used: always positive
+
+
+def zorder_key_np(cols: Sequence[np.ndarray]) -> np.ndarray:
+    """Numpy reference implementation (tests oracle)."""
+    n = len(cols)
+    bits = min(32, 63 // n)
+    us = []
+    for c in cols:
+        f = c.astype(np.float64)
+        lo, hi = f.min(), f.max()
+        span = max(hi - lo, 1e-300)
+        top = float((1 << bits) - 1)
+        us.append(np.clip((f - lo) / span * top, 0, top).astype(np.uint64))
+    key = np.zeros(len(cols[0]), np.uint64)
+    for b in range(bits - 1, -1, -1):
+        for u in us:
+            key = (key << np.uint64(1)) | ((u >> np.uint64(b))
+                                           & np.uint64(1))
+    return key.astype(np.int64)
+
+
+def zorder_sort_indices(table_cols: List[np.ndarray],
+                        use_device: bool = True) -> np.ndarray:
+    """Row order that clusters by z-value; device path when available."""
+    if use_device:
+        try:
+            lanes = [jnp.asarray(c.astype(np.float64)) for c in table_cols]
+            valids = [jnp.ones(len(table_cols[0]), bool)] * len(table_cols)
+            key = np.asarray(zorder_key(lanes, valids))
+            return np.argsort(key, kind="stable")
+        except Exception:                        # noqa: BLE001
+            pass
+    return np.argsort(zorder_key_np(table_cols), kind="stable")

@@ -47,6 +47,8 @@ def init_distributed(coordinator: Optional[str] = None,
     global _INITIALIZED
     if _INITIALIZED:
         return jax.process_count() > 1
+    explicit = (coordinator is not None or num_processes is not None
+                or process_id is not None)
     coordinator = coordinator or os.environ.get("COORDINATOR_ADDRESS")
     num_processes = num_processes if num_processes is not None else \
         int(os.environ.get("NUM_PROCESSES", "0") or 0)
@@ -55,10 +57,13 @@ def init_distributed(coordinator: Optional[str] = None,
     if not coordinator or num_processes <= 1:
         # standard Cloud TPU pod tooling sets no COORDINATOR_ADDRESS —
         # an argless initialize() auto-detects the slice via TPU
-        # metadata; TPU_SKIP_DISTRIBUTED_INIT opts out for single-host
-        # runs that must not touch the coordination service
-        if os.environ.get("TPU_WORKER_HOSTNAMES") and \
-                not os.environ.get("TPU_SKIP_DISTRIBUTED_INIT"):
+        # metadata.  Only when the caller passed NOTHING explicit
+        # (explicit args always win, incl. num_processes=1 meaning
+        # "stay single-process"); TPU_SKIP_DISTRIBUTED_INIT=1 opts out.
+        skip = os.environ.get("TPU_SKIP_DISTRIBUTED_INIT", "").lower() \
+            in ("1", "true", "yes")
+        if not explicit and not skip and \
+                os.environ.get("TPU_WORKER_HOSTNAMES"):
             jax.distributed.initialize()
             _INITIALIZED = True
             return jax.process_count() > 1

@@ -549,10 +549,13 @@ class JoinMeta(PlanMeta):
             return CrossJoinExec(left, right)
         if (self.conf.get(ADAPTIVE_ENABLED)
                 and self.node.broadcast is None
-                and self.node.join_type in _MIRROR):
+                and (self.node.join_type in _MIRROR
+                     or self.node.join_type == "left_semi")):
             # AQE analogue: defer the build-side choice to runtime sizes
             # (GpuShuffledSymmetricHashJoinExec.scala:354 role); an
-            # explicit broadcast hint is a planner decision and wins
+            # explicit broadcast hint is a planner decision and wins.
+            # left_semi never mirrors but qualifies for the bloom
+            # runtime filter (unmatched probe rows are dropped anyway)
             return AdaptiveShuffledJoinExec(
                 self.node.join_type, self.node.left_keys,
                 self.node.right_keys, left, right)

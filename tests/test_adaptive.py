@@ -104,14 +104,26 @@ def test_adaptive_disabled_uses_static_join():
     assert "HashJoinExec" in tree
 
 
-def test_semi_anti_not_mirrored():
+def test_semi_adaptive_but_never_mirrored():
     small, big = _tables()
     s = TpuSession()
     df = s.from_arrow(small).join(s.from_arrow(big), how="left_semi",
                                   left_on=["sk"], right_on=["bk"])
-    tree = df.physical().physical_tree()
-    # semi joins have no mirror: stays on the static path
-    assert "AdaptiveShuffledJoinExec" not in tree
+    q = df.physical()
+    # semi joins qualify for the bloom runtime filter (adaptive) but
+    # have no mirror: left stays the probe side even though bigger
+    assert "AdaptiveShuffledJoinExec" in q.physical_tree()
+    ctx = ExecContext(s.conf)
+    out = q.collect(ctx)
+    assert ctx.metrics.get("adaptive_join_mirrored", 0) == 0
+    sk_in_big = set(big["bk"].to_pylist())
+    exp = sorted(k for k in small["sk"].to_pylist() if k in sk_in_big)
+    assert sorted(out.column("sk").to_pylist()) == exp
+
+    # anti joins stay on the static path (filtering would be wrong)
+    df2 = s.from_arrow(small).join(s.from_arrow(big), how="left_anti",
+                                   left_on=["sk"], right_on=["bk"])
+    assert "AdaptiveShuffledJoinExec" not in df2.physical().physical_tree()
 
 
 def test_broadcast_hint_wins_over_adaptive():

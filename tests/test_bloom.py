@@ -133,3 +133,40 @@ def test_string_keys_bloom():
     assert sorted(out.column("bk").to_pylist()) == \
         sorted(exp.column("bk").to_pylist())
     assert ctx.metrics.get("bloom_filtered_rows", 0) > 0
+
+
+def test_semi_join_bloom_filters_probe():
+    small, big = _join_tables(150, 40_000)
+    dev = TpuSession()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    df = dev.from_arrow(big).join(dev.from_arrow(small), how="left_semi",
+                                  left_on=["bk"], right_on=["sk"])
+    ctx = ExecContext(dev.conf)
+    out = df.physical().collect(ctx)
+    assert ctx.metrics.get("bloom_filtered_rows", 0) > 0
+    exp = DataFrame(df._plan, cpu).collect()
+    assert sorted(zip(out.column("bk").to_pylist(),
+                      out.column("bv").to_pylist())) == \
+        sorted(zip(exp.column("bk").to_pylist(),
+                   exp.column("bv").to_pylist()))
+
+
+def test_zorder_string_and_timestamp_columns(tmp_path):
+    import datetime as pydt
+    from spark_rapids_tpu.delta.table import DeltaTable
+    rng = np.random.default_rng(17)
+    n = 2000
+    dt_ = DeltaTable(str(tmp_path / "t"))
+    dt_.write(pa.table({
+        "name": pa.array([None if i % 17 == 0 else f"cat{i % 40}"
+                          for i in range(n)]),
+        "ts": pa.array(rng.integers(0, 10**15, n), pa.int64()).cast(
+            pa.timestamp("us")),
+        "v": pa.array(rng.standard_normal(n)),
+    }))
+    dt_.optimize(zorder_by=["name", "ts"], target_rows=500)
+    assert dt_.read().num_rows == n
+    with pytest.raises(TypeError, match="not clusterable"):
+        dt_2 = DeltaTable(str(tmp_path / "t2"))
+        dt_2.write(pa.table({"b": pa.array([[1]], pa.list_(pa.int64()))}))
+        dt_2.optimize(zorder_by=["b"])
