@@ -250,7 +250,8 @@ ENABLED_FORMATS = {
     fmt: conf(
         f"spark.rapids.tpu.sql.format.{fmt}.enabled", True,
         f"Enable accelerated {fmt} scan.")
-    for fmt in ("parquet", "csv", "json", "orc", "avro", "iceberg")
+    for fmt in ("parquet", "csv", "json", "orc", "avro", "iceberg",
+                "hivetext")
 }
 
 SPARK_VERSION = conf(

@@ -81,6 +81,60 @@ class LogicalJsonScan(_TextLogicalScan):
     fmt = "json"
 
 
+def _read_hive_text(path: str, schema, opts) -> pa.Table:
+    """Hive default text serde: ctrl-A field delimiter, \\N nulls, no
+    header (GpuHiveTextFileFormat.scala role)."""
+    opts = dict(opts or {})
+    names = opts.get("column_names")
+    if names is None and schema is not None:
+        names = [f.name for f in schema]
+    convert = pacsv.ConvertOptions(
+        column_types=schema if schema is not None else None,
+        null_values=["\\N"], strings_can_be_null=True,
+        quoted_strings_can_be_null=False)
+    parse = pacsv.ParseOptions(delimiter=opts.get("sep", "\x01"),
+                               quote_char=False, escape_char="\\",
+                               newlines_in_values=True)
+    read = pacsv.ReadOptions(column_names=names,
+                             autogenerate_column_names=names is None)
+    return pacsv.read_csv(path, read_options=read, parse_options=parse,
+                          convert_options=convert)
+
+
+class LogicalHiveTextScan(_TextLogicalScan):
+    reader = staticmethod(_read_hive_text)
+    fmt = "hivetext"
+
+
+def write_hive_text(table: pa.Table, path: str, sep: str = "\x01") -> None:
+    """Writer half of the hive text serde: \\N for null, backslash-
+    escaped delimiter/newline/CR/backslash (LazySimpleSerDe escaping;
+    the reader's escape_char reverses it).  Known deviation: a field
+    whose VALUE is exactly the 2-char string '\\N' reads back as null —
+    arrow matches null markers after unescaping, so Hive's \\N-vs-\\\\N
+    distinction is not representable without a custom parser.  Binary
+    columns are rejected (text serde; use parquet/orc/avro)."""
+    for field in table.schema:
+        if pa.types.is_binary(field.type) or \
+                pa.types.is_large_binary(field.type):
+            raise TypeError(f"hive text cannot carry binary column "
+                            f"{field.name}; use parquet/orc/avro")
+
+    def esc(v) -> str:
+        s = v if isinstance(v, str) else str(v)
+        return (s.replace("\\", "\\\\").replace(sep, "\\" + sep)
+                .replace("\n", "\\\n").replace("\r", "\\\r"))
+
+    # the reader unescapes before null matching, so the on-disk marker
+    # is the ESCAPED form backslash-backslash-N (unescapes to \N)
+    null_marker = "\\\\N"
+    with open(path, "w", encoding="utf-8") as f:
+        cols = [table.column(n).to_pylist() for n in table.schema.names]
+        for row in zip(*cols):
+            f.write(sep.join(null_marker if v is None else esc(v)
+                             for v in row) + "\n")
+
+
 class TextScanExec(PlanNode):
     def __init__(self, logical: _TextLogicalScan, schema: t.StructType):
         super().__init__()

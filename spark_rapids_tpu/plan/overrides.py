@@ -37,8 +37,8 @@ from ..io.parquet import (CpuParquetScanExec, LogicalParquetScan,
 from ..io.orc import CpuOrcScanExec, LogicalOrcScan, OrcScanExec
 from ..io.avro import LogicalAvroScan
 from ..io.iceberg import LogicalIcebergScan
-from ..io.text import (CpuTextScanExec, LogicalCsvScan, LogicalJsonScan,
-                       TextScanExec)
+from ..io.text import (CpuTextScanExec, LogicalCsvScan,
+                       LogicalHiveTextScan, LogicalJsonScan, TextScanExec)
 from ..exec.plan import (CoalesceBatchesExec, ExecContext, ExpandExec,
                          FilterExec, GlobalLimitExec, HashAggregateExec,
                          HostScanExec, PlanNode, ProjectExec, RangeExec,
@@ -206,6 +206,7 @@ exec_rule(LogicalJsonScan, _DEVICE_SIMPLE, "json scan")
 exec_rule(LogicalOrcScan, _DEVICE_SIMPLE, "orc scan")
 exec_rule(LogicalAvroScan, _DEVICE_SIMPLE, "avro scan")
 exec_rule(LogicalIcebergScan, _DEVICE_SIMPLE, "iceberg scan")
+exec_rule(LogicalHiveTextScan, _DEVICE_SIMPLE, "hive text scan")
 
 
 # ---------------------------------------------------------------------------
@@ -738,6 +739,7 @@ _META_FOR: Dict[type, Type[PlanMeta]] = {
     LogicalOrcScan: TextScanMeta,
     LogicalAvroScan: TextScanMeta,
     LogicalIcebergScan: TextScanMeta,
+    LogicalHiveTextScan: TextScanMeta,
 }
 
 
@@ -813,6 +815,22 @@ class PhysicalQuery:
             node = H.DeviceToHostExec(self.root)
         else:
             node = self.root
+        with self._instrumented(ctx):
+            yield from node.execute(ctx)
+
+    def execute_device_batches(self, ctx: Optional[ExecContext] = None):
+        """Stream results as DeviceBatches WITHOUT bringing them to host
+        — the ColumnarRdd escape hatch (ColumnarRdd.scala:42 /
+        InternalColumnarRddConverter role) for ML pipelines that feed
+        query output straight into jax models.  Host-kind plans upload
+        at the boundary (HostColumnarToGpu role)."""
+        ctx = ctx or ExecContext(self.conf)
+        if self.kind == "device":
+            node = self.root
+        else:
+            # _host_to_device prunes device-unrepresentable columns
+            # (arrays/maps/structs/binary) before the upload boundary
+            node = _host_to_device(self.root)
         with self._instrumented(ctx):
             yield from node.execute(ctx)
 

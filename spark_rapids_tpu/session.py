@@ -70,6 +70,12 @@ class TpuSession:
         from .io.avro import LogicalAvroScan
         return DataFrame(LogicalAvroScan(list(paths), schema, opts), self)
 
+    def read_hive_text(self, *paths: str, schema=None, **opts
+                       ) -> "DataFrame":
+        from .io.text import LogicalHiveTextScan
+        return DataFrame(LogicalHiveTextScan(list(paths), schema, opts),
+                         self)
+
     def read_iceberg(self, table_path: str, snapshot_id=None,
                      schema=None) -> "DataFrame":
         from .io.iceberg import LogicalIcebergScan
@@ -180,6 +186,62 @@ class DataFrame:
     def write_parquet(self, path: str, **opts) -> None:
         from .io.parquet import write_parquet
         write_parquet(self, path, **opts)
+
+    def device_batches(self, ctx: Optional[ExecContext] = None):
+        """Zero-copy DeviceBatch stream — the ColumnarRdd escape hatch
+        (ColumnarRdd.scala:42) for feeding query results into jax/ML
+        code without a host round trip."""
+        return self.physical().execute_device_batches(ctx)
+
+    def to_jax(self, ctx: Optional[ExecContext] = None) -> dict:
+        """Materialize results as jax arrays on device: numeric columns
+        -> (data, validity); string columns -> (codes, validity,
+        dictionary) with per-batch codes remapped into ONE unified
+        dictionary (equal strings share a code across all batches).
+        Rows from all batches are concatenated, padding removed.
+        decimal(>18) has no single-lane device representation — use
+        collect() for those."""
+        import jax.numpy as jnp
+        import numpy as np
+        from . import types as _t
+        per_col: dict = {}
+        dicts: dict = {}      # name -> {value: global code}
+        for db in self.device_batches(ctx):
+            n = int(db.num_rows)
+            if n == 0:
+                continue
+            for name, c in zip(db.names, db.columns):
+                from .ops.kernels import compute_view
+                if isinstance(c.dtype, _t.DecimalType) and \
+                        c.dtype.is_wide:
+                    raise TypeError(
+                        f"to_jax: column {name} is {c.dtype.simple_string}"
+                        f" — wide decimals exceed one int64 lane; use "
+                        f"collect()")
+                if c.dictionary is not None:
+                    gd = dicts.setdefault(name, {})
+                    remap = np.empty(max(len(c.dictionary), 1), np.int32)
+                    for i, v in enumerate(c.dictionary):
+                        val = v.as_py()
+                        if val not in gd:
+                            gd[val] = len(gd)
+                        remap[i] = gd[val]
+                    codes = jnp.clip(c.data, 0, len(remap) - 1)
+                    data = jnp.asarray(remap)[codes][:n]
+                else:
+                    data = compute_view(c.data, c.dtype)[:n]
+                d, v = per_col.get(name, ([], []))
+                d.append(data)
+                v.append(c.validity[:n])
+                per_col[name] = (d, v)
+        out = {}
+        for name, (d, v) in per_col.items():
+            if name in dicts:
+                out[name] = (jnp.concatenate(d), jnp.concatenate(v),
+                             list(dicts[name]))
+            else:
+                out[name] = (jnp.concatenate(d), jnp.concatenate(v))
+        return out
 
     def _wrap(self, plan: L.LogicalPlan) -> "DataFrame":
         return DataFrame(plan, self._session)

@@ -1,0 +1,114 @@
+"""ColumnarRdd escape hatch (ColumnarRdd.scala:42 role): device batch
+stream + jax materialization feeding ML code without host round trips."""
+import numpy as np
+import pyarrow as pa
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.device import DeviceBatch
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.aggregates import Sum
+from spark_rapids_tpu.session import TpuSession, col, lit
+
+
+def _df(n=5000):
+    rng = np.random.default_rng(19)
+    s = TpuSession()
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 50, n), pa.int64()),
+        "x": pa.array(rng.standard_normal(n)),
+    })
+    return s, tbl
+
+
+def test_device_batches_stream():
+    s, tbl = _df()
+    df = s.from_arrow(tbl).filter(E.GreaterThan(col("x"), lit(0.0)))
+    total = 0
+    for db in df.device_batches():
+        assert isinstance(db, DeviceBatch)
+        total += int(db.num_rows)
+    exp = sum(1 for v in tbl["x"].to_pylist() if v > 0)
+    assert total == exp
+
+
+def test_to_jax_numeric_pipeline():
+    s, tbl = _df()
+    df = (s.from_arrow(tbl)
+          .group_by("k").agg((Sum(col("x")), "sx")))
+    out = df.to_jax()
+    data, valid = out["sx"]
+    assert data.dtype == jnp.float64
+    assert bool(valid.all())
+    # feed straight into jax compute: same result as host collect
+    dev_total = float(jnp.sum(jnp.where(valid, data, 0.0)))
+    host = df.collect()
+    host_total = sum(host.column("sx").to_pylist())
+    assert abs(dev_total - host_total) <= 1e-9 * max(1.0, abs(host_total))
+    k_data, k_valid = out["k"]
+    assert sorted(np.asarray(k_data).tolist()) == \
+        sorted(host.column("k").to_pylist())
+
+
+def test_to_jax_host_plan_uploads():
+    """CPU-fallback plans hit the HostColumnarToGpu boundary."""
+    s, tbl = _df(500)
+    s2 = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    df = s2.from_arrow(tbl).filter(E.GreaterThan(col("x"), lit(0.0)))
+    out = df.to_jax()
+    n = sum(1 for v in tbl["x"].to_pylist() if v > 0)
+    assert out["x"][0].shape[0] == n
+
+
+def test_to_jax_nulls_carried_in_validity():
+    s = TpuSession()
+    tbl = pa.table({"v": pa.array([1.0, None, 3.0, None])})
+    out = TpuSession().from_arrow(tbl).to_jax()
+    data, valid = out["v"]
+    assert np.asarray(valid).tolist() == [True, False, True, False]
+
+
+def test_to_jax_strings_unified_dictionary():
+    s = TpuSession()
+    t1 = pa.table({"s": pa.array(["apple", "banana"]),
+                   "i": pa.array([1, 2], pa.int64())})
+    t2 = pa.table({"s": pa.array(["banana", "cherry"]),
+                   "i": pa.array([3, 4], pa.int64())})
+    df = s.from_arrow(t1).union(s.from_arrow(t2))
+    out = df.to_jax()
+    codes, valid, dictionary = out["s"]
+    decoded = [dictionary[int(c)] for c in np.asarray(codes)]
+    assert sorted(decoded) == ["apple", "banana", "banana", "cherry"]
+    # equal strings share a code ACROSS batches
+    assert decoded.count("banana") == 2
+    bcodes = [int(c) for c, d in zip(np.asarray(codes), decoded)
+              if d == "banana"]
+    assert bcodes[0] == bcodes[1]
+
+
+def test_to_jax_wide_decimal_rejected():
+    import decimal as pydec
+    import pytest
+    s = TpuSession()
+    tbl = pa.table({"d": pa.array([pydec.Decimal(2) ** 70],
+                                  pa.decimal128(38, 0))})
+    with pytest.raises(TypeError, match="wide decimals"):
+        s.from_arrow(tbl).to_jax()
+
+
+def test_hive_text_escaping_roundtrip(tmp_path):
+    from spark_rapids_tpu.io.text import write_hive_text, _read_hive_text
+    tbl = pa.table({
+        "s": pa.array(["plain", "de\x01lim", "new\nline", "back\\slash",
+                       None, "cr\rhere"]),
+        "k": pa.array([1, 2, 3, 4, 5, 6], pa.int64()),
+    })
+    p = str(tmp_path / "esc.hive")
+    write_hive_text(tbl, p)
+    got = _read_hive_text(p, pa.schema([("s", pa.string()),
+                                        ("k", pa.int64())]), {})
+    assert got.to_pydict() == tbl.to_pydict()
+    import pytest
+    with pytest.raises(TypeError, match="binary"):
+        write_hive_text(pa.table({"b": pa.array([b"x"], pa.binary())}),
+                        str(tmp_path / "b.hive"))

@@ -139,3 +139,31 @@ def test_binary_column_not_silently_dropped(tmp_path):
     assert out.schema.names == ["a", "bin"]
     assert out.num_rows == 2
     assert out.column("bin").to_pylist() == [b"x", b"yy"]
+
+
+def test_hive_text_roundtrip_and_scan(tmp_path):
+    from spark_rapids_tpu.io.text import write_hive_text
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.plan import expressions as _E
+    tbl = pa.table({
+        "a": pa.array([1, None, 3], pa.int64()),
+        "s": pa.array(["x,y", None, "z\x02w"]),
+        "f": pa.array([1.5, 2.5, None]),
+    })
+    path = str(tmp_path / "t.hive")
+    write_hive_text(tbl, path)
+    raw = open(path, encoding="utf-8").read()
+    assert "\\N" in raw and "\x01" in raw
+    s = TpuSession()
+    schema = pa.schema([("a", pa.int64()), ("s", pa.string()),
+                        ("f", pa.float64())])
+    got = s.read_hive_text(path, schema=schema).collect()
+    assert got.to_pydict() == tbl.to_pydict()
+    # device placement + conf gate
+    df = s.read_hive_text(path, schema=schema).filter(
+        _E.IsNotNull(_E.ColumnRef("a")))
+    assert df.physical().kind == "device"
+    off = TpuSession({"spark.rapids.tpu.sql.format.hivetext.enabled":
+                      "false"})
+    assert "hivetext scan disabled" in \
+        off.read_hive_text(path, schema=schema).physical().explain()
