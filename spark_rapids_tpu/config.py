@@ -216,7 +216,9 @@ SHUFFLE_READER_THREADS = conf(
 
 SHUFFLE_COMPRESSION = conf(
     "spark.rapids.tpu.shuffle.compression.codec", "zstd",
-    "Codec for shuffle/spill Arrow IPC buffers: zstd, lz4, or none.",
+    "Codec for shuffle Arrow IPC buffers: zstd, lz4, or none — applied "
+    "inside the IPC layer (the nvcomp codec role, "
+    "TableCompressionCodec.scala:42), so readers are codec-agnostic.",
     checker=_enum_checker("ZSTD", "LZ4", "NONE"))
 
 HOST_SPILL_LIMIT_BYTES = conf(

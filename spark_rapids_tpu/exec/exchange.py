@@ -48,15 +48,17 @@ class ShuffleExchangeExec(PlanNode):
         """Run the map stage; returns the shuffle id."""
         if self.shuffle_id is not None:
             return self.shuffle_id
+        from ..config import SHUFFLE_COMPRESSION
         mgr = get_shuffle_manager()
         sid = mgr.new_shuffle()
         n = self.partitioning.num_partitions
+        codec = str(ctx.conf.get(SHUFFLE_COMPRESSION)).lower()
         for db in self.child.execute(ctx):
             if int(db.num_rows) == 0:
                 continue
             ids = self.partitioning.partition_ids(db, ctx.conf)
             hb = to_host(db)
-            mgr.write_batch(sid, hb, ids, n)
+            mgr.write_batch(sid, hb, ids, n, codec)
             ctx.bump("shuffle_rows_written", int(db.num_rows))
         self.shuffle_id = sid
         return sid

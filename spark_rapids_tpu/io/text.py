@@ -83,22 +83,83 @@ class LogicalJsonScan(_TextLogicalScan):
 
 def _read_hive_text(path: str, schema, opts) -> pa.Table:
     """Hive default text serde: ctrl-A field delimiter, \\N nulls, no
-    header (GpuHiveTextFileFormat.scala role)."""
+    header (GpuHiveTextFileFormat.scala role).
+
+    Hive's LazySimpleSerDe matches the \\N null marker BEFORE
+    unescaping (so \\N is null while \\\\N is the literal 2-char string
+    \\N).  Arrow's csv reader unescapes first, which cannot reproduce
+    that, so files containing any backslash go through a token-level
+    parser with Hive's exact semantics; backslash-free files (the
+    common case) take the vectorized arrow path."""
     opts = dict(opts or {})
+    sep = opts.get("sep", "\x01")
     names = opts.get("column_names")
     if names is None and schema is not None:
         names = [f.name for f in schema]
+    # newline="" disables universal-newline translation: escaped \r
+    # payload bytes must survive verbatim
+    with open(path, encoding="utf-8", newline="") as f:
+        data = f.read()
+    if "\\" in data:
+        return _parse_hive_escaped(data, sep, names, schema)
     convert = pacsv.ConvertOptions(
         column_types=schema if schema is not None else None,
-        null_values=["\\N"], strings_can_be_null=True,
-        quoted_strings_can_be_null=False)
-    parse = pacsv.ParseOptions(delimiter=opts.get("sep", "\x01"),
-                               quote_char=False, escape_char="\\",
-                               newlines_in_values=True)
+        strings_can_be_null=True, quoted_strings_can_be_null=False)
+    parse = pacsv.ParseOptions(delimiter=sep, quote_char=False,
+                               escape_char=False)
     read = pacsv.ReadOptions(column_names=names,
                              autogenerate_column_names=names is None)
     return pacsv.read_csv(path, read_options=read, parse_options=parse,
                           convert_options=convert)
+
+
+def _parse_hive_escaped(data: str, sep: str, names, schema) -> pa.Table:
+    """Token-level hive parse: split rows/fields on UNESCAPED newline/
+    delimiter, null-match raw tokens against \\N, then unescape."""
+    import re
+    rows: List[List] = []
+    fields: List = []
+    tok: List[str] = []
+    esc = False
+
+    def end_field():
+        raw_tok = "".join(tok)
+        if raw_tok == "\\N":
+            fields.append(None)
+        else:
+            fields.append(re.sub(r"\\(.)", r"\1", raw_tok,
+                                 flags=re.DOTALL))
+        tok.clear()
+
+    for ch in data:
+        if esc:
+            tok.append(ch)
+            esc = False
+        elif ch == "\\":
+            tok.append("\\")
+            esc = True
+        elif ch == sep:
+            end_field()
+        elif ch == "\n":
+            end_field()
+            rows.append(list(fields))
+            fields.clear()
+        else:
+            tok.append(ch)
+    if tok or fields:
+        end_field()
+        rows.append(list(fields))
+    ncols = max((len(r) for r in rows), default=0)
+    if names is None:
+        names = [f"f{i}" for i in range(ncols)]
+    cols = []
+    for i, name in enumerate(names):
+        vals = [r[i] if i < len(r) else None for r in rows]
+        arr = pa.array(vals, pa.string())
+        if schema is not None:
+            arr = arr.cast(schema.field(name).type)
+        cols.append(arr)
+    return pa.table(dict(zip(names, cols)))
 
 
 class LogicalHiveTextScan(_TextLogicalScan):
@@ -107,13 +168,11 @@ class LogicalHiveTextScan(_TextLogicalScan):
 
 
 def write_hive_text(table: pa.Table, path: str, sep: str = "\x01") -> None:
-    """Writer half of the hive text serde: \\N for null, backslash-
-    escaped delimiter/newline/CR/backslash (LazySimpleSerDe escaping;
-    the reader's escape_char reverses it).  Known deviation: a field
-    whose VALUE is exactly the 2-char string '\\N' reads back as null —
-    arrow matches null markers after unescaping, so Hive's \\N-vs-\\\\N
-    distinction is not representable without a custom parser.  Binary
-    columns are rejected (text serde; use parquet/orc/avro)."""
+    """Writer half of the hive text serde: the on-disk \\N null marker,
+    backslash-escaped delimiter/newline/CR/backslash (LazySimpleSerDe
+    escaping; a literal \\N VALUE round-trips as \\\\N exactly like
+    Hive).  Binary columns are rejected (text serde; use parquet/orc/
+    avro)."""
     for field in table.schema:
         if pa.types.is_binary(field.type) or \
                 pa.types.is_large_binary(field.type):
@@ -125,10 +184,9 @@ def write_hive_text(table: pa.Table, path: str, sep: str = "\x01") -> None:
         return (s.replace("\\", "\\\\").replace(sep, "\\" + sep)
                 .replace("\n", "\\\n").replace("\r", "\\\r"))
 
-    # the reader unescapes before null matching, so the on-disk marker
-    # is the ESCAPED form backslash-backslash-N (unescapes to \N)
-    null_marker = "\\\\N"
-    with open(path, "w", encoding="utf-8") as f:
+    # hive's marker: the 2 bytes backslash-N, matched BEFORE unescaping
+    null_marker = "\\N"
+    with open(path, "w", encoding="utf-8", newline="") as f:
         cols = [table.column(n).to_pylist() for n in table.schema.names]
         for row in zip(*cols):
             f.write(sep.join(null_marker if v is None else esc(v)

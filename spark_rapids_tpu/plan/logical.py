@@ -210,6 +210,20 @@ class LogicalJoin(LogicalPlan):
         return self.children[1]
 
     def _resolve_schema(self):
+        # analysis-time key type check: Spark coerces mismatched key
+        # types in the analyzer; this engine (like the physical layer
+        # the reference plugs into) requires equal types — callers cast
+        # explicitly.  Both engine paths must fail identically, so the
+        # error is raised here, not at execution.
+        for lk, rk in zip(self.left_keys, self.right_keys):
+            lt_ = lk.bind(self.left.schema).dtype
+            rt_ = rk.bind(self.right.schema).dtype
+            # field-wise inequality: decimal(10,2) vs decimal(10,4) must
+            # also fail — join kernels compare raw unscaled lanes
+            if lt_ != rt_:
+                raise TypeError(
+                    f"join key type mismatch: {lt_.simple_string} vs "
+                    f"{rt_.simple_string} — add an explicit Cast")
         lf = list(self.left.schema.fields)
         if self.join_type in ("left_semi", "left_anti"):
             return t.StructType(lf)

@@ -828,9 +828,19 @@ class PhysicalQuery:
         if self.kind == "device":
             node = self.root
         else:
-            # _host_to_device prunes device-unrepresentable columns
-            # (arrays/maps/structs/binary) before the upload boundary
-            node = _host_to_device(self.root)
+            # user-facing boundary: unlike the internal _host_to_device
+            # transition (which prunes pass-through ballast), silently
+            # dropping user-visible columns would be data loss — reject
+            bad = [f.name for f in self.root.output_schema.fields
+                   if isinstance(f.data_type,
+                                 (t.ArrayType, t.MapType, t.StructType,
+                                  t.BinaryType))]
+            if bad:
+                raise TypeError(
+                    f"device_batches/to_jax: columns {bad} have no "
+                    f"device lane representation; use collect() or "
+                    f"execute_host_batches()")
+            node = H.HostToDeviceExec(self.root)
         with self._instrumented(ctx):
             yield from node.execute(ctx)
 

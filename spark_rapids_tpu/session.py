@@ -220,7 +220,11 @@ class DataFrame:
                         f"collect()")
                 if c.dictionary is not None:
                     gd = dicts.setdefault(name, {})
-                    remap = np.empty(max(len(c.dictionary), 1), np.int32)
+                    # zeros, not empty: an all-null batch has a 0-length
+                    # dictionary and its (invalid) codes must not read
+                    # uninitialized memory — code values are only
+                    # meaningful where validity is True
+                    remap = np.zeros(max(len(c.dictionary), 1), np.int32)
                     for i, v in enumerate(c.dictionary):
                         val = v.as_py()
                         if val not in gd:
@@ -234,6 +238,29 @@ class DataFrame:
                 d.append(data)
                 v.append(c.validity[:n])
                 per_col[name] = (d, v)
+        if not per_col:
+            # zero-row result: shape stays schema-driven, not
+            # data-dependent — every column present with 0 rows
+            from .types import physical_np_dtype, StringType
+            out = {}
+            for f in self.schema.fields:
+                if isinstance(f.data_type, _t.DecimalType) and \
+                        f.data_type.is_wide:
+                    raise TypeError(
+                        f"to_jax: column {f.name} is "
+                        f"{f.data_type.simple_string} — wide decimals "
+                        f"exceed one int64 lane; use collect()")
+                empty_valid = jnp.zeros(0, bool)
+                if isinstance(f.data_type, StringType):
+                    out[f.name] = (jnp.zeros(0, jnp.int32), empty_valid,
+                                   [])
+                elif isinstance(f.data_type, _t.DoubleType):
+                    # compute_view turns the int64 storage lane into f64
+                    out[f.name] = (jnp.zeros(0, jnp.float64), empty_valid)
+                else:
+                    out[f.name] = (jnp.zeros(
+                        0, physical_np_dtype(f.data_type)), empty_valid)
+            return out
         out = {}
         for name, (d, v) in per_col.items():
             if name in dicts:

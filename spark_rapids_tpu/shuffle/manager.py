@@ -59,9 +59,15 @@ class ShuffleBlockStore:
             return out
 
 
-def serialize_batch(rb: pa.RecordBatch) -> bytes:
+def serialize_batch(rb: pa.RecordBatch, codec: str = "none") -> bytes:
+    """Arrow IPC wire format, optionally buffer-compressed (the nvcomp
+    LZ4/ZSTD codec role, TableCompressionCodec.scala:42 — compression
+    happens in the IPC layer so readers are codec-agnostic)."""
     sink = io.BytesIO()
-    with pa.ipc.new_stream(sink, rb.schema) as w:
+    options = None
+    if codec not in ("none", None, ""):
+        options = pa.ipc.IpcWriteOptions(compression=codec)
+    with pa.ipc.new_stream(sink, rb.schema, options=options) as w:
         w.write_batch(rb)
     return sink.getvalue()
 
@@ -91,9 +97,10 @@ class ShuffleManager:
             return self._next_id
 
     def write_batch(self, shuffle_id: int, hb: HostBatch,
-                    part_ids: np.ndarray, num_partitions: int) -> None:
+                    part_ids: np.ndarray, num_partitions: int,
+                    codec: str = "none") -> None:
         """Split one host batch by partition id and store each slice
-        (serialization fans out on the thread pool)."""
+        (serialization + compression fan out on the thread pool)."""
         rb = hb.rb
         order = np.argsort(part_ids, kind="stable")
         sorted_ids = part_ids[order]
@@ -105,7 +112,7 @@ class ShuffleManager:
             if s == e:
                 return
             sl = rb.take(idx_arr.slice(s, e - s))
-            self.store.put(shuffle_id, p, serialize_batch(sl))
+            self.store.put(shuffle_id, p, serialize_batch(sl, codec))
 
         list(self.pool.map(ser, range(num_partitions)))
 

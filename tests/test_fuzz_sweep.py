@@ -1,0 +1,164 @@
+"""Randomized query sweep: generator-driven device-vs-CPU comparison
+over random query shapes (the FuzzerUtils / qa_nightly_select_test
+role, SURVEY §4: 'random-input comparisons' + the 757-SELECT sweep).
+
+Each seed builds a random table (mixed types, nulls, special values)
+and a random pipeline of filter/project/aggregate/join/sort/limit
+stages; the same logical plan runs on the device engine and on the CPU
+fallback engine and must agree.  Failures print the seed + logical tree
+for deterministic replay.
+"""
+import decimal as pydec
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.datagen import (BooleanGen, DateGen, DecimalGen,
+                                      DoubleGen, IntGen, KeyGroupGen,
+                                      LongGen, StringGen, gen_table)
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.aggregates import (Average, Count, Max, Min,
+                                              Sum)
+from spark_rapids_tpu.session import DataFrame, TpuSession, col
+
+N_SEEDS = 12
+ROWS = 800
+
+
+def _table(seed: int) -> pa.Table:
+    return gen_table([
+        ("i", IntGen()),
+        ("l", LongGen()),
+        ("d", DoubleGen()),
+        ("dec", DecimalGen(9, 2)),
+        ("s", StringGen()),
+        ("b", BooleanGen()),
+        ("dt", DateGen()),
+        ("g", KeyGroupGen(10)),
+    ], ROWS, seed=seed)
+
+
+def _rand_predicate(rng) -> E.Expression:
+    choices = [
+        lambda: E.GreaterThan(col("i"), E.Literal(int(rng.integers(-50, 50)))),
+        lambda: E.LessThanOrEqual(col("l"), E.Literal(int(rng.integers(-10**9, 10**9)))),
+        lambda: E.IsNotNull(col("d")),
+        lambda: E.EqualTo(col("b"), E.Literal(bool(rng.integers(0, 2)))),
+        lambda: E.IsNull(col("s")),
+        lambda: E.GreaterThanOrEqual(col("dec"),
+                                     E.Literal(pydec.Decimal("0.00"))),
+        lambda: E.Not(E.IsNull(col("g"))),
+    ]
+    p = choices[rng.integers(0, len(choices))]()
+    if rng.random() < 0.4:
+        q = choices[rng.integers(0, len(choices))]()
+        p = E.And(p, q) if rng.random() < 0.5 else E.Or(p, q)
+    return p
+
+
+def _rand_aggs(rng):
+    pool = [
+        (Sum(col("l")), "sl"),
+        (Count(None), "n"),
+        (Count(col("d")), "nd"),
+        (Min(col("i")), "mi"),
+        (Max(col("dt")), "mx"),
+        (Average(E.Cast(col("i"), t.DOUBLE)), "av"),
+        (Sum(col("dec")), "sdec"),
+    ]
+    k = rng.integers(2, len(pool) + 1)
+    idx = rng.choice(len(pool), size=k, replace=False)
+    return [pool[i] for i in sorted(idx)]
+
+
+def _build_query(s: TpuSession, tbl: pa.Table, rng) -> DataFrame:
+    df = s.from_arrow(tbl)
+    if rng.random() < 0.8:
+        df = df.filter(_rand_predicate(rng))
+    if rng.random() < 0.4:
+        df = df.select(col("i"), col("l"), col("d"), col("dec"),
+                       col("s"), col("b"), col("dt"), col("g"),
+                       E.Multiply(E.Cast(col("i"), t.LONG), col("l")),
+                       names=["i", "l", "d", "dec", "s", "b", "dt", "g",
+                              "il"])
+    if rng.random() < 0.5:
+        # join against a small dimension keyed on the key-group column
+        # (same pool => real matches; same TYPE or the analyzer rejects)
+        pool = sorted({v for v in tbl.column("g").to_pylist()
+                       if v is not None})
+        dim = pa.table({
+            "gk": pa.array(pool, pa.int64()),
+            "w": pa.array(np.arange(len(pool), dtype=np.float64)),
+        })
+        how = ["inner", "left_outer", "left_semi"][rng.integers(0, 3)]
+        df = df.join(s.from_arrow(dim), how=how,
+                     left_on=["g"], right_on=["gk"])
+    shape = rng.random()
+    if shape < 0.5:
+        df = (df.group_by("g").agg(*_rand_aggs(rng))
+              .sort("g"))
+    elif shape < 0.75:
+        df = df.agg(*_rand_aggs(rng))
+    else:
+        df = df.sort(("l", bool(rng.integers(0, 2)), True),
+                     ("i", True, True)).limit(int(rng.integers(5, 60)))
+    return df
+
+
+def _norm_cell(x):
+    if isinstance(x, pydec.Decimal):
+        return float(x)
+    return x
+
+
+def _norm(tbl: pa.Table):
+    cols = tbl.schema.names
+    return [tuple(_norm_cell(x) for x in row)
+            for row in zip(*[tbl.column(c).to_pylist() for c in cols])]
+
+
+def _close(a, b):
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) or math.isnan(b):
+            return math.isnan(a) and math.isnan(b)
+        if a == b:                       # covers equal infinities
+            return True
+        return abs(a - b) <= 1e-9 * max(1.0, abs(a), abs(b))
+    return a == b
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_random_query_device_vs_cpu(seed):
+    rng = np.random.default_rng(1000 + seed)
+    tbl = _table(seed)
+    dev = TpuSession()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    df = _build_query(dev, tbl, rng)
+    ctx_msg = f"seed={seed}\n{df.logical_tree()}"
+    got = _norm(df.collect())
+    exp = _norm(DataFrame(df._plan, cpu).collect())
+    # sort-insensitive compare unless the plan ends in a sort+limit
+    from spark_rapids_tpu.plan import logical as L
+    ordered = isinstance(df._plan, L.LogicalLimit)
+    if not ordered:
+        got, exp = sorted(got, key=repr), sorted(exp, key=repr)
+    assert len(got) == len(exp), ctx_msg
+    for gr, er in zip(got, exp):
+        assert len(gr) == len(er), ctx_msg
+        for g, e in zip(gr, er):
+            assert _close(g, e), f"{ctx_msg}\nrow {gr} vs {er}"
+
+
+def test_mismatched_join_key_types_rejected():
+    """The sweep's first catch: mixed-type join keys must fail at
+    analysis on BOTH engines, not crash inside a kernel."""
+    s = TpuSession()
+    a = s.from_arrow(pa.table({"k": pa.array([1, 2], pa.int64())}))
+    b = s.from_arrow(pa.table({"k2": pa.array(["1", "2"])}))
+    with pytest.raises(TypeError, match="join key type mismatch"):
+        a.join(b, left_on=["k"], right_on=["k2"]).schema

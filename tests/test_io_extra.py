@@ -167,3 +167,24 @@ def test_hive_text_roundtrip_and_scan(tmp_path):
                       "false"})
     assert "hivetext scan disabled" in \
         off.read_hive_text(path, schema=schema).physical().explain()
+
+
+def test_hive_null_marker_matches_hive_semantics(tmp_path):
+    """Genuine Hive files: \\N (2 bytes) is null, \\\\N is the literal
+    string \\N — matched BEFORE unescaping, as LazySimpleSerDe does."""
+    from spark_rapids_tpu.io.text import _read_hive_text, write_hive_text
+    p = str(tmp_path / "hive_made.txt")
+    with open(p, "w") as f:
+        f.write("\\N\x011\n")         # null, 1
+        f.write("\\\\N\x012\n")       # literal \N, 2
+        f.write("plain\x01\\N\n")     # plain, null int
+    schema = pa.schema([("s", pa.string()), ("k", pa.int64())])
+    got = _read_hive_text(p, schema, {})
+    assert got.column("s").to_pylist() == [None, "\\N", "plain"]
+    assert got.column("k").to_pylist() == [1, 2, None]
+    # engine writer round-trips the literal \N value like Hive
+    tbl = pa.table({"s": pa.array(["\\N", None, "x"]),
+                    "k": pa.array([1, 2, 3], pa.int64())})
+    p2 = str(tmp_path / "rt.txt")
+    write_hive_text(tbl, p2)
+    assert _read_hive_text(p2, schema, {}).to_pydict() == tbl.to_pydict()
