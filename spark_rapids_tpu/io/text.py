@@ -96,21 +96,26 @@ def _read_hive_text(path: str, schema, opts) -> pa.Table:
     names = opts.get("column_names")
     if names is None and schema is not None:
         names = [f.name for f in schema]
-    # newline="" disables universal-newline translation: escaped \r
-    # payload bytes must survive verbatim
-    with open(path, encoding="utf-8", newline="") as f:
-        data = f.read()
-    if "\\" in data:
-        return _parse_hive_escaped(data, sep, names, schema)
+    # read once as bytes (escaped \r payloads survive; the arrow fast
+    # path consumes the same buffer, no second disk pass)
+    with open(path, "rb") as f:
+        raw = f.read()
+    if b"\\" in raw:
+        return _parse_hive_escaped(raw.decode("utf-8"), sep, names,
+                                   schema)
+    # no backslashes -> no \N markers and no escapes.  Only the empty
+    # field is null (and only for non-string types, as in Hive);
+    # arrow's default marker list ('NULL', 'NA', ...) must NOT apply —
+    # those are legitimate string values.
     convert = pacsv.ConvertOptions(
         column_types=schema if schema is not None else None,
-        strings_can_be_null=True, quoted_strings_can_be_null=False)
+        null_values=[""], strings_can_be_null=False)
     parse = pacsv.ParseOptions(delimiter=sep, quote_char=False,
                                escape_char=False)
     read = pacsv.ReadOptions(column_names=names,
                              autogenerate_column_names=names is None)
-    return pacsv.read_csv(path, read_options=read, parse_options=parse,
-                          convert_options=convert)
+    return pacsv.read_csv(pa.BufferReader(raw), read_options=read,
+                          parse_options=parse, convert_options=convert)
 
 
 def _parse_hive_escaped(data: str, sep: str, names, schema) -> pa.Table:
@@ -155,11 +160,32 @@ def _parse_hive_escaped(data: str, sep: str, names, schema) -> pa.Table:
     cols = []
     for i, name in enumerate(names):
         vals = [r[i] if i < len(r) else None for r in rows]
-        arr = pa.array(vals, pa.string())
-        if schema is not None:
-            arr = arr.cast(schema.field(name).type)
-        cols.append(arr)
+        ty = schema.field(name).type if schema is not None \
+            else pa.string()
+        cols.append(_cast_or_null(vals, ty))
     return pa.table(dict(zip(names, cols)))
+
+
+def _cast_or_null(vals, ty: pa.DataType) -> pa.Array:
+    """Hive primitive conversion: unparseable or empty fields become
+    null, never errors (LazySimpleSerDe contract)."""
+    if pa.types.is_string(ty) or pa.types.is_large_string(ty):
+        return pa.array(vals, ty)
+    vals = [None if v == "" else v for v in vals]
+    try:
+        return pa.array(vals, pa.string()).cast(ty)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+                continue
+            try:
+                out.append(pa.array([v], pa.string()).cast(ty)[0].as_py())
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError,
+                    ValueError):
+                out.append(None)
+        return pa.array(out, ty)
 
 
 class LogicalHiveTextScan(_TextLogicalScan):

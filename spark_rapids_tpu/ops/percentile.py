@@ -1,0 +1,110 @@
+"""Sort-based percentile kernels on the sort-segment machinery.
+
+Role of the reference's GpuPercentile / Histogram JNI kernel
+(GpuPercentile.scala, SURVEY §2.5 aggregate set) and of
+GpuApproximatePercentile's t-digest: this engine computes EXACT
+percentiles on device — the values sort as an extra minor lexsort lane
+under the group keys, so every group's values are contiguous ascending
+runs and each requested percentile is two gathers + a lerp.  Exact
+results trivially satisfy approx_percentile's rank-error contract.
+
+Ordering follows Spark's double sort: values ascending with NaN
+greatest; null values sort after everything inside their group and are
+excluded from the count.  A group with zero non-null values yields
+null.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as t
+from .groupby import _eq_prev, _null_first_key_lanes
+from .kernels import compute_view
+
+
+def percentile_trace(key_lanes_info, qs: Sequence[float],
+                     num_segments: int, capacity: int):
+    """Traced fn: (keys, keys_valid, val_f64, val_valid, live) ->
+    (out_keys [(data, valid)...], [(vals, valid) per q], num_groups).
+    With zero keys this is the global single-group reduction."""
+    qs = [float(q) for q in qs]
+
+    def run(keys, keys_valid, val, val_valid, live):
+        vlive = live & val_valid
+        isnan = jnp.isnan(val)
+        # neutralize NaN for the comparator; a separate flag lane orders
+        # them greatest-within-group (Spark double ordering)
+        clean = jnp.where(isnan, 0.0, val)
+        lanes = []
+        for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, keys,
+                                          keys_valid):
+            sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
+            lanes.extend([l for l in sub if l is not None])
+        # lexsort: LAST key is primary.  Major -> minor: dead rows last,
+        # group keys, value-nulls last in group, NaN after numbers,
+        # values ascending.
+        sort_keys = [clean, isnan.astype(jnp.int8),
+                     (~vlive).astype(jnp.int8)] + \
+            list(reversed(lanes)) + [(~live).astype(jnp.int8)]
+        perm = jnp.lexsort(sort_keys)
+        s_live = live[perm]
+        s_vlive = vlive[perm]
+        s_val = val[perm]
+        s_keys = [k[perm] for k in keys]
+        s_keys_valid = [None if v is None else v[perm]
+                        for v in keys_valid]
+
+        boundary = jnp.zeros((capacity,), bool).at[0].set(True)
+        for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, s_keys,
+                                          s_keys_valid):
+            sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
+            for lane in sub:
+                if lane is not None:
+                    boundary = boundary | _eq_prev(lane)
+        pad_start = jnp.concatenate([jnp.ones((1,), bool),
+                                     s_live[1:] != s_live[:-1]])
+        boundary = boundary | pad_start
+        seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+        count = jnp.sum(live, dtype=jnp.int32)
+        num_groups = jnp.where(count > 0,
+                               seg_ids[jnp.maximum(count - 1, 0)] + 1, 0)
+        group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+
+        start_idx = jax.ops.segment_min(
+            jnp.arange(capacity, dtype=jnp.int32), seg_ids,
+            num_segments=num_segments)
+        start_idx = jnp.clip(start_idx, 0, capacity - 1)
+        out_keys = []
+        for kd, kv in zip(s_keys, s_keys_valid):
+            okd = kd[start_idx]
+            okv = (jnp.ones((capacity,), bool) if kv is None
+                   else kv[start_idx])
+            out_keys.append((okd, okv & group_live))
+
+        # non-null values per group sit at [start, start + cnt)
+        cnt = jax.ops.segment_sum(s_vlive.astype(jnp.int32), seg_ids,
+                                  num_segments=num_segments)
+        out = []
+        for q in qs:
+            pos = (cnt - 1).astype(jnp.float64) * jnp.float64(q)
+            lo = jnp.floor(pos).astype(jnp.int32)
+            hi = jnp.ceil(pos).astype(jnp.int32)
+            frac = pos - lo.astype(jnp.float64)
+            gi_lo = jnp.clip(start_idx + jnp.maximum(lo, 0),
+                             0, capacity - 1)
+            gi_hi = jnp.clip(start_idx + jnp.maximum(hi, 0),
+                             0, capacity - 1)
+            v_lo = s_val[gi_lo]
+            v_hi = s_val[gi_hi]
+            # integral rank returns v_lo exactly: a NaN at the unused
+            # hi endpoint must not contaminate (NaN * 0 is NaN)
+            res = jnp.where(frac == 0.0, v_lo,
+                            v_lo + (v_hi - v_lo) * frac)
+            out.append((res, (cnt > 0) & group_live))
+        return out_keys, out, num_groups
+
+    return run

@@ -713,8 +713,12 @@ class CountDistinct(AggregateFunction):
 
 
 def _percentile_exact(values, p: float):
-    """Spark exact percentile: linear interpolation at (n-1)*p."""
-    nn = sorted(float(v) for v in values if v is not None)
+    """Spark exact percentile: linear interpolation at (n-1)*p.
+    NaN sorts greatest (Java double ordering) — a plain sorted() leaves
+    NaN placement undefined in python."""
+    import math
+    nn = sorted((float(v) for v in values if v is not None),
+                key=lambda v: (math.isnan(v), v))
     if not nn:
         return None
     if len(nn) == 1:
@@ -722,7 +726,9 @@ def _percentile_exact(values, p: float):
     pos = (len(nn) - 1) * p
     lo = int(pos)
     frac = pos - lo
-    hi = min(lo + 1, len(nn) - 1)
+    if frac == 0.0:
+        return nn[lo]        # integral rank: the other endpoint (which
+    hi = min(lo + 1, len(nn) - 1)   # may be NaN) must not contaminate
     return nn[lo] * (1 - frac) + nn[hi] * frac
 
 
@@ -746,8 +752,10 @@ class Percentile(AggregateFunction):
 
     def unsupported_reasons(self, conf):
         out = AggregateFunction.unsupported_reasons(self, conf)
-        out.append("percentile runs on the CPU path "
-                   "(device histogram kernel pending)")
+        if self.child is not None and self.child.dtype is not None and \
+                not t.is_numeric(self.child.dtype):
+            out.append(f"percentile over "
+                       f"{self.child.dtype.simple_string} (numeric only)")
         return out
 
     def cpu_agg(self):

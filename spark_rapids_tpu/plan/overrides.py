@@ -182,6 +182,14 @@ for _c in (VariancePop, VarianceSamp, StddevPop, StddevSamp,
     agg_rule(_c, t.T.NUMERIC, t.T.FP,
              desc="statistical aggregate (moment sums on device)")
 
+from .aggregates import (ApproximatePercentile, Median,  # noqa: E402
+                         Percentile)
+
+for _c in (Percentile, ApproximatePercentile, Median):
+    agg_rule(_c, t.T.NUMERIC, t.T.FP,
+             desc="sort-based device percentile (exact; satisfies the "
+                  "approx rank-error contract trivially)")
+
 exec_rule(L.LogicalScan, _DEVICE_SIMPLE, "in-memory scan + device upload")
 exec_rule(L.LogicalProject, _COMMON, "projection")
 exec_rule(L.LogicalFilter, _DEVICE_SIMPLE, "filter")
@@ -475,7 +483,25 @@ class AggregateMeta(PlanMeta):
             if b.child is not None:
                 self.expr_metas.append(ExprMeta(b.child, self.conf))
 
+    def tag_self(self):
+        from .aggregates import Percentile
+        kinds = [isinstance(fn, Percentile) for fn, _n in self.node.aggs]
+        if any(kinds) and not all(kinds):
+            # percentile is holistic (sort-based exec); mixing it with
+            # streaming aggregates would need two passes + a join — the
+            # reference routes such plans through separate aggregations
+            self.will_not_work(
+                "percentile mixed with non-percentile aggregates "
+                "(device path requires an all-percentile aggregation)")
+
     def to_device(self):
+        from .aggregates import Percentile
+        if self.node.aggs and all(isinstance(fn, Percentile)
+                                  for fn, _n in self.node.aggs):
+            from ..exec.percentile import PercentileAggregateExec
+            return PercentileAggregateExec(
+                self.node.keys, self.node.key_names, self.node.aggs,
+                self._device_child())
         return HashAggregateExec(self.node.keys, self.node.key_names,
                                  self.node.aggs, self._device_child())
 

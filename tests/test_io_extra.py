@@ -188,3 +188,35 @@ def test_hive_null_marker_matches_hive_semantics(tmp_path):
     p2 = str(tmp_path / "rt.txt")
     write_hive_text(tbl, p2)
     assert _read_hive_text(p2, schema, {}).to_pydict() == tbl.to_pydict()
+
+
+def test_hive_literal_null_strings_and_empty_preserved(tmp_path):
+    """'' and 'NULL' are real string values in Hive (only \\N is null);
+    empty numeric fields are null; malformed numerics are null not
+    errors — on BOTH parser paths."""
+    from spark_rapids_tpu.io.text import _read_hive_text, write_hive_text
+    schema = pa.schema([("s", pa.string()), ("k", pa.int64())])
+    # fast path (no backslashes anywhere)
+    p1 = str(tmp_path / "fast.txt")
+    with open(p1, "w") as f:
+        f.write("\x011\n")            # empty string, 1
+        f.write("NULL\x012\n")        # literal 'NULL', 2
+        f.write("x\x01\n")            # x, empty int -> null
+    got = _read_hive_text(p1, schema, {})
+    assert got.column("s").to_pylist() == ["", "NULL", "x"]
+    assert got.column("k").to_pylist() == [1, 2, None]
+    # escaped path (backslash present): same semantics + malformed int
+    p2 = str(tmp_path / "esc.txt")
+    with open(p2, "w") as f:
+        f.write("a\\\x01b\x011\n")    # escaped delimiter, 1
+        f.write("NULL\x01\n")         # literal 'NULL', empty int
+        f.write("y\x01oops\n")        # y, malformed int -> null
+    got2 = _read_hive_text(p2, schema, {})
+    assert got2.column("s").to_pylist() == ["a\x01b", "NULL", "y"]
+    assert got2.column("k").to_pylist() == [1, None, None]
+    # round trip with empty strings via our writer stays lossless
+    tbl = pa.table({"s": pa.array(["", "NULL", None]),
+                    "k": pa.array([7, 8, 9], pa.int64())})
+    p3 = str(tmp_path / "rt.txt")
+    write_hive_text(tbl, p3)
+    assert _read_hive_text(p3, schema, {}).to_pydict() == tbl.to_pydict()

@@ -1,0 +1,127 @@
+"""Device percentile aggregation (GpuPercentile / approx t-digest role):
+sort-based kernel vs the CPU oracle, grouped and global, NaN/null/edge
+semantics, plan placement."""
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.aggregates import (ApproximatePercentile,
+                                              Count, Median, Percentile)
+from spark_rapids_tpu.session import DataFrame, TpuSession, col
+
+
+def _oracle(vals, q):
+    nn = sorted(v for v in vals if v is not None and not (
+        isinstance(v, float) and math.isnan(v)))
+    nan = [v for v in vals if isinstance(v, float) and math.isnan(v)]
+    allv = nn + nan                      # NaN greatest (Spark ordering)
+    if not allv:
+        return None
+    pos = (len(allv) - 1) * q
+    lo, hi = int(math.floor(pos)), int(math.ceil(pos))
+    frac = pos - lo
+    return allv[lo] + (allv[hi] - allv[lo]) * frac
+
+
+def test_grouped_percentile_device_vs_oracle():
+    rng = np.random.default_rng(23)
+    n = 4000
+    g = rng.integers(0, 12, n)
+    x = rng.standard_normal(n) * 100
+    x[rng.random(n) < 0.07] = np.nan
+    vals = [None if rng.random() < 0.05 else float(v) for v in x]
+    tbl = pa.table({"g": pa.array(g, pa.int64()),
+                    "x": pa.array(vals, pa.float64())})
+    s = TpuSession()
+    df = (s.from_arrow(tbl).group_by("g")
+          .agg((Percentile(col("x"), 0.25), "p25"),
+               (Median(col("x")), "med"),
+               (Percentile(col("x"), 0.9), "p90"))
+          .sort("g"))
+    q = df.physical()
+    assert "PercentileAggregateExec" in q.physical_tree(), q.explain()
+    out = q.collect()
+    by_g = {}
+    for gg, v in zip(g, vals):
+        by_g.setdefault(int(gg), []).append(v)
+    for gg, p25, med, p90 in zip(out.column("g").to_pylist(),
+                                 out.column("p25").to_pylist(),
+                                 out.column("med").to_pylist(),
+                                 out.column("p90").to_pylist()):
+        for got, qq in ((p25, 0.25), (med, 0.5), (p90, 0.9)):
+            exp = _oracle(by_g[gg], qq)
+            if exp is None or (isinstance(exp, float) and math.isnan(exp)):
+                assert got is None or math.isnan(got)
+            else:
+                assert abs(got - exp) <= 1e-9 * max(1.0, abs(exp)), \
+                    (gg, qq, got, exp)
+
+
+def test_global_percentile_and_int_input():
+    tbl = pa.table({"v": pa.array([5, 1, 9, 3, None, 7], pa.int64())})
+    s = TpuSession()
+    df = s.from_arrow(tbl).agg((Median(col("v")), "med"),
+                               (Percentile(col("v"), 0.0), "mn"),
+                               (Percentile(col("v"), 1.0), "mx"))
+    q = df.physical()
+    assert "PercentileAggregateExec" in q.physical_tree()
+    out = q.collect()
+    assert out.column("med").to_pylist() == [5.0]
+    assert out.column("mn").to_pylist() == [1.0]
+    assert out.column("mx").to_pylist() == [9.0]
+
+
+def test_all_null_group_yields_null():
+    tbl = pa.table({"g": pa.array([1, 1, 2], pa.int64()),
+                    "x": pa.array([None, None, 4.0], pa.float64())})
+    s = TpuSession()
+    out = (s.from_arrow(tbl).group_by("g")
+           .agg((Median(col("x")), "m")).sort("g").collect())
+    assert out.column("m").to_pylist() == [None, 4.0]
+
+
+def test_string_keys_and_multibatch():
+    rng = np.random.default_rng(24)
+    n = 3000
+    keys = rng.choice(["a", "b", "c", "d"], n)
+    x = rng.uniform(0, 100, n)
+    tbl = pa.table({"k": pa.array(keys), "x": pa.array(x)})
+    s = TpuSession({"spark.rapids.tpu.sql.batchSizeRows": "1024"})
+    dev = (s.from_arrow(tbl).group_by("k")
+           .agg((Percentile(col("x"), 0.75), "p")).sort("k"))
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    got = dev.collect()
+    exp = DataFrame(dev._plan, cpu).collect()
+    assert got.column("k").to_pylist() == exp.column("k").to_pylist()
+    for gv, ev in zip(got.column("p").to_pylist(),
+                      exp.column("p").to_pylist()):
+        assert abs(gv - ev) <= 1e-9 * max(1.0, abs(ev))
+
+
+def test_approx_percentile_on_device_and_mixed_falls_back():
+    tbl = pa.table({"x": pa.array([1.0, 2.0, 3.0, 4.0])})
+    s = TpuSession()
+    df = s.from_arrow(tbl).agg((ApproximatePercentile(col("x"), 0.5), "a"))
+    assert "PercentileAggregateExec" in df.physical().physical_tree()
+    assert df.collect().column("a").to_pylist() == [2.5]
+    # mixed with streaming aggregate -> tagged off, CPU path, correct
+    mixed = s.from_arrow(tbl).agg((Median(col("x")), "m"),
+                                  (Count(None), "n"))
+    text = mixed.physical().explain()
+    assert "percentile mixed with non-percentile" in text
+    out = mixed.collect()
+    assert out.column("m").to_pylist() == [2.5]
+    assert out.column("n").to_pylist() == [4]
+
+
+def test_percentile_string_input_rejected_to_cpu():
+    tbl = pa.table({"s": pa.array(["a", "b"])})
+    s = TpuSession()
+    df = s.from_arrow(tbl).agg((Percentile(E.Cast(col("s"), t.DOUBLE),
+                                           0.5), "p"))
+    # cast makes it numeric: runs on device
+    assert "PercentileAggregateExec" in df.physical().physical_tree()
