@@ -107,15 +107,34 @@ def _read_hive_text(path: str, schema, opts) -> pa.Table:
     # field is null (and only for non-string types, as in Hive);
     # arrow's default marker list ('NULL', 'NA', ...) must NOT apply —
     # those are legitimate string values.
-    convert = pacsv.ConvertOptions(
-        column_types=schema if schema is not None else None,
-        null_values=[""], strings_can_be_null=False)
     parse = pacsv.ParseOptions(delimiter=sep, quote_char=False,
                                escape_char=False)
     read = pacsv.ReadOptions(column_names=names,
                              autogenerate_column_names=names is None)
-    return pacsv.read_csv(pa.BufferReader(raw), read_options=read,
-                          parse_options=parse, convert_options=convert)
+    try:
+        convert = pacsv.ConvertOptions(
+            column_types=schema if schema is not None else None,
+            null_values=[""], strings_can_be_null=False)
+        return pacsv.read_csv(pa.BufferReader(raw), read_options=read,
+                              parse_options=parse,
+                              convert_options=convert)
+    except pa.ArrowInvalid:
+        # unparseable primitive tokens: Hive yields null, never errors —
+        # re-read untyped and convert per column with the null-on-error
+        # contract (_cast_or_null)
+        tbl = pacsv.read_csv(
+            pa.BufferReader(raw), read_options=read,
+            parse_options=parse,
+            convert_options=pacsv.ConvertOptions(
+                column_types={n: pa.string() for n in (names or [])}
+                if names else None,
+                null_values=[""], strings_can_be_null=False))
+        if schema is None:
+            return tbl
+        cols = [_cast_or_null(
+            tbl.column(n).combine_chunks().to_pylist(),
+            schema.field(n).type) for n in tbl.schema.names]
+        return pa.table(dict(zip(tbl.schema.names, cols)))
 
 
 def _parse_hive_escaped(data: str, sep: str, names, schema) -> pa.Table:

@@ -1,0 +1,76 @@
+"""Device count(DISTINCT) via the sort-segment machinery.
+
+Role of the reference's count-distinct planning (SURVEY §2.5: 'per-key
+dedupe' — Spark rewrites to a two-level aggregation; the reference runs
+the dedupe as a cuDF drop_duplicates).  TPU formulation: sort rows by
+(group keys, value) and count value-change boundaries among valid rows
+per segment — no materialized dedupe table, one fused program.
+
+Value equality uses the storage lanes (int64 f64-bit-patterns for
+DOUBLE are bit-exact; string codes must be dictionary-unified by the
+caller).  Nulls are excluded (Spark count(DISTINCT) semantics); NaN
+counts as one distinct value (all NaN bit patterns canonicalize).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as t
+from .groupby import _CANON_NAN, _EXP_MASK, _MANT_MASK, _eq_prev
+from .kernels import compute_view
+
+
+_NEG_ZERO_BITS = jnp.int64(-2 ** 63)        # 0x8000000000000000
+
+
+def _value_eq_lanes(data: jax.Array, dt: t.DataType):
+    """Lanes whose rowwise equality == value equality (NaN canonical,
+    -0.0 == 0.0 per Spark's distinct/grouping normalization)."""
+    if isinstance(dt, t.DoubleType) and data.dtype == jnp.int64:
+        is_nan = ((data & _EXP_MASK) == _EXP_MASK) & \
+            ((data & _MANT_MASK) != 0)
+        d = jnp.where(is_nan, jnp.int64(_CANON_NAN), data)
+        return [jnp.where(d == _NEG_ZERO_BITS, jnp.int64(0), d)]
+    v = compute_view(data, dt)
+    if t.is_floating(dt):
+        isnan = jnp.isnan(v)
+        return [jnp.where(isnan, 0, v), isnan.astype(jnp.int8)]
+    return [v]
+
+
+def distinct_count_trace(key_lanes_info, num_segments: int,
+                         capacity: int):
+    """Traced fn: (keys, keys_valid, val_data, val_valid, live,
+    val_dtype static via closure list) -> (out_keys, (count, valid),
+    num_groups)."""
+
+    from .percentile import sorted_segments
+
+    def build(val_dtype: t.DataType):
+        def run(keys, keys_valid, val, val_valid, live):
+            vlive = live & val_valid
+            vlanes = _value_eq_lanes(val, val_dtype)
+            # minor order within group: values grouped (asc), nulls last
+            minor = list(vlanes) + [(~vlive).astype(jnp.int8)]
+            (perm, _s_live, _sk, _skv, seg_ids, _start, out_keys,
+             num_groups, group_live) = sorted_segments(
+                key_lanes_info, keys, keys_valid, live, minor, capacity,
+                num_segments)
+            s_vlive = vlive[perm]
+            s_vlanes = [l[perm] for l in vlanes]
+
+            # first occurrence of each distinct valid value in a group:
+            # segment start OR any value lane changed from prev row
+            changed = jnp.zeros((capacity,), bool).at[0].set(True)
+            changed = changed | _eq_prev(seg_ids)
+            for lane in s_vlanes:
+                changed = changed | _eq_prev(lane)
+            first = s_vlive & changed
+            cnt = jax.ops.segment_sum(first.astype(jnp.int64), seg_ids,
+                                      num_segments=num_segments)
+            return out_keys, (cnt, group_live), num_groups
+
+        return run
+
+    return build

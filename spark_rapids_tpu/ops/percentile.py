@@ -26,6 +26,59 @@ from .groupby import _eq_prev, _null_first_key_lanes
 from .kernels import compute_view
 
 
+def sorted_segments(key_lanes_info, keys, keys_valid, live,
+                    minor_lanes, capacity: int, num_segments: int):
+    """Shared sort-segment core for holistic aggregates (percentile,
+    count-distinct): lexsort rows by (dead-last, group keys,
+    minor_lanes most-minor-first), find group boundaries, return
+
+      (perm, s_live, s_keys, s_keys_valid, seg_ids, start_idx,
+       out_keys, num_groups, group_live)
+
+    `minor_lanes` order rows WITHIN a group (value lanes, null flags);
+    they do not contribute to boundaries."""
+    lanes = []
+    for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, keys, keys_valid):
+        sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
+        lanes.extend([l for l in sub if l is not None])
+    # lexsort: LAST key is primary
+    sort_keys = list(minor_lanes) + list(reversed(lanes)) + \
+        [(~live).astype(jnp.int8)]
+    perm = jnp.lexsort(sort_keys)
+    s_live = live[perm]
+    s_keys = [k[perm] for k in keys]
+    s_keys_valid = [None if v is None else v[perm] for v in keys_valid]
+
+    boundary = jnp.zeros((capacity,), bool).at[0].set(True)
+    for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, s_keys,
+                                      s_keys_valid):
+        sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
+        for lane in sub:
+            if lane is not None:
+                boundary = boundary | _eq_prev(lane)
+    pad_start = jnp.concatenate([jnp.ones((1,), bool),
+                                 s_live[1:] != s_live[:-1]])
+    boundary = boundary | pad_start
+    seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    count = jnp.sum(live, dtype=jnp.int32)
+    num_groups = jnp.where(count > 0,
+                           seg_ids[jnp.maximum(count - 1, 0)] + 1, 0)
+    group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+
+    start_idx = jax.ops.segment_min(
+        jnp.arange(capacity, dtype=jnp.int32), seg_ids,
+        num_segments=num_segments)
+    start_idx = jnp.clip(start_idx, 0, capacity - 1)
+    out_keys = []
+    for kd, kv in zip(s_keys, s_keys_valid):
+        okd = kd[start_idx]
+        okv = (jnp.ones((capacity,), bool) if kv is None
+               else kv[start_idx])
+        out_keys.append((okd, okv & group_live))
+    return (perm, s_live, s_keys, s_keys_valid, seg_ids, start_idx,
+            out_keys, num_groups, group_live)
+
+
 def percentile_trace(key_lanes_info, qs: Sequence[float],
                      num_segments: int, capacity: int):
     """Traced fn: (keys, keys_valid, val_f64, val_valid, live) ->
@@ -39,51 +92,15 @@ def percentile_trace(key_lanes_info, qs: Sequence[float],
         # neutralize NaN for the comparator; a separate flag lane orders
         # them greatest-within-group (Spark double ordering)
         clean = jnp.where(isnan, 0.0, val)
-        lanes = []
-        for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, keys,
-                                          keys_valid):
-            sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
-            lanes.extend([l for l in sub if l is not None])
-        # lexsort: LAST key is primary.  Major -> minor: dead rows last,
-        # group keys, value-nulls last in group, NaN after numbers,
-        # values ascending.
-        sort_keys = [clean, isnan.astype(jnp.int8),
-                     (~vlive).astype(jnp.int8)] + \
-            list(reversed(lanes)) + [(~live).astype(jnp.int8)]
-        perm = jnp.lexsort(sort_keys)
-        s_live = live[perm]
+        # minor order within group: values asc, NaN after, nulls last
+        minor = [clean, isnan.astype(jnp.int8),
+                 (~vlive).astype(jnp.int8)]
+        (perm, s_live, _sk, _skv, seg_ids, start_idx, out_keys,
+         num_groups, group_live) = sorted_segments(
+            key_lanes_info, keys, keys_valid, live, minor, capacity,
+            num_segments)
         s_vlive = vlive[perm]
         s_val = val[perm]
-        s_keys = [k[perm] for k in keys]
-        s_keys_valid = [None if v is None else v[perm]
-                        for v in keys_valid]
-
-        boundary = jnp.zeros((capacity,), bool).at[0].set(True)
-        for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, s_keys,
-                                          s_keys_valid):
-            sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
-            for lane in sub:
-                if lane is not None:
-                    boundary = boundary | _eq_prev(lane)
-        pad_start = jnp.concatenate([jnp.ones((1,), bool),
-                                     s_live[1:] != s_live[:-1]])
-        boundary = boundary | pad_start
-        seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-        count = jnp.sum(live, dtype=jnp.int32)
-        num_groups = jnp.where(count > 0,
-                               seg_ids[jnp.maximum(count - 1, 0)] + 1, 0)
-        group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
-
-        start_idx = jax.ops.segment_min(
-            jnp.arange(capacity, dtype=jnp.int32), seg_ids,
-            num_segments=num_segments)
-        start_idx = jnp.clip(start_idx, 0, capacity - 1)
-        out_keys = []
-        for kd, kv in zip(s_keys, s_keys_valid):
-            okd = kd[start_idx]
-            okv = (jnp.ones((capacity,), bool) if kv is None
-                   else kv[start_idx])
-            out_keys.append((okd, okv & group_live))
 
         # non-null values per group sit at [start, start + cnt)
         cnt = jax.ops.segment_sum(s_vlive.astype(jnp.int32), seg_ids,

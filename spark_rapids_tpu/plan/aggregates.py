@@ -705,7 +705,10 @@ class CountDistinct(AggregateFunction):
 
     def unsupported_reasons(self, conf):
         out = AggregateFunction.unsupported_reasons(self, conf)
-        out.append("count(DISTINCT) device rewrite not yet implemented")
+        dt = None if self.child is None else self.child.dtype
+        if dt is not None and isinstance(dt, t.DecimalType) and dt.is_wide:
+            out.append("count(DISTINCT) over decimal128 "
+                       "(no single device lane)")
         return out
 
     def cpu_agg(self):

@@ -190,6 +190,11 @@ for _c in (Percentile, ApproximatePercentile, Median):
              desc="sort-based device percentile (exact; satisfies the "
                   "approx rank-error contract trivially)")
 
+from .aggregates import CountDistinct  # noqa: E402
+
+agg_rule(CountDistinct, _COMMON, t.T.INTEGRAL,
+         desc="count(DISTINCT) as a sorted value-change count")
+
 exec_rule(L.LogicalScan, _DEVICE_SIMPLE, "in-memory scan + device upload")
 exec_rule(L.LogicalProject, _COMMON, "projection")
 exec_rule(L.LogicalFilter, _DEVICE_SIMPLE, "filter")
@@ -484,22 +489,37 @@ class AggregateMeta(PlanMeta):
                 self.expr_metas.append(ExprMeta(b.child, self.conf))
 
     def tag_self(self):
-        from .aggregates import Percentile
-        kinds = [isinstance(fn, Percentile) for fn, _n in self.node.aggs]
-        if any(kinds) and not all(kinds):
-            # percentile is holistic (sort-based exec); mixing it with
-            # streaming aggregates would need two passes + a join — the
-            # reference routes such plans through separate aggregations
-            self.will_not_work(
-                "percentile mixed with non-percentile aggregates "
-                "(device path requires an all-percentile aggregation)")
+        # holistic aggregates (sort-based device execs) cannot mix with
+        # streaming ones in one device aggregation — the reference
+        # routes such plans through separate aggregations
+        for family, label in self._holistic_split():
+            if any(family) and not all(family):
+                self.will_not_work(
+                    f"{label} mixed with other aggregates (device path "
+                    f"requires a uniform aggregation)")
+
+    def _holistic_split(self):
+        from .aggregates import CountDistinct, Percentile
+        aggs = self.node.aggs
+        return (
+            ([isinstance(fn, Percentile) for fn, _n in aggs],
+             "percentile"),
+            ([isinstance(fn, CountDistinct) for fn, _n in aggs],
+             "count(DISTINCT)"),
+        )
 
     def to_device(self):
-        from .aggregates import Percentile
+        from .aggregates import CountDistinct, Percentile
         if self.node.aggs and all(isinstance(fn, Percentile)
                                   for fn, _n in self.node.aggs):
             from ..exec.percentile import PercentileAggregateExec
             return PercentileAggregateExec(
+                self.node.keys, self.node.key_names, self.node.aggs,
+                self._device_child())
+        if self.node.aggs and all(isinstance(fn, CountDistinct)
+                                  for fn, _n in self.node.aggs):
+            from ..exec.distinct import DistinctAggregateExec
+            return DistinctAggregateExec(
                 self.node.keys, self.node.key_names, self.node.aggs,
                 self._device_child())
         return HashAggregateExec(self.node.keys, self.node.key_names,

@@ -220,3 +220,16 @@ def test_hive_literal_null_strings_and_empty_preserved(tmp_path):
     p3 = str(tmp_path / "rt.txt")
     write_hive_text(tbl, p3)
     assert _read_hive_text(p3, schema, {}).to_pydict() == tbl.to_pydict()
+
+
+def test_hive_fast_path_malformed_numeric_nulls(tmp_path):
+    from spark_rapids_tpu.io.text import _read_hive_text
+    p = str(tmp_path / "bad.txt")
+    with open(p, "w") as f:
+        f.write("a\x011\n")
+        f.write("b\x01oops\n")      # malformed int, no backslash in file
+        f.write("NULL\x013\n")      # literal 'NULL' string value
+    schema = pa.schema([("s", pa.string()), ("k", pa.int64())])
+    got = _read_hive_text(p, schema, {})
+    assert got.column("s").to_pylist() == ["a", "b", "NULL"]
+    assert got.column("k").to_pylist() == [1, None, 3]

@@ -112,16 +112,22 @@ def test_approx_percentile_on_device_and_mixed_falls_back():
     mixed = s.from_arrow(tbl).agg((Median(col("x")), "m"),
                                   (Count(None), "n"))
     text = mixed.physical().explain()
-    assert "percentile mixed with non-percentile" in text
+    assert "percentile mixed with other aggregates" in text
     out = mixed.collect()
     assert out.column("m").to_pylist() == [2.5]
     assert out.column("n").to_pylist() == [4]
 
 
 def test_percentile_string_input_rejected_to_cpu():
-    tbl = pa.table({"s": pa.array(["a", "b"])})
+    tbl = pa.table({"s": pa.array(["3", "1"])})
     s = TpuSession()
+    # raw string input: tagged off the device kernel with a reason
+    raw = s.from_arrow(tbl).agg((Percentile(col("s"), 0.5), "p"))
+    text = raw.physical().explain()
+    assert "percentile over string" in text.lower()
+    assert "PercentileAggregateExec" not in raw.physical().physical_tree()
+    # explicit cast makes it numeric: runs on device
     df = s.from_arrow(tbl).agg((Percentile(E.Cast(col("s"), t.DOUBLE),
                                            0.5), "p"))
-    # cast makes it numeric: runs on device
     assert "PercentileAggregateExec" in df.physical().physical_tree()
+    assert df.collect().column("p").to_pylist() == [2.0]
