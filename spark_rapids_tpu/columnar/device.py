@@ -108,6 +108,9 @@ class DeviceBatch:
     columns: List[DeviceColumn]
     num_rows: object   # int | jax.Array 0-d
     names: List[str]
+    # scan provenance for input_file_name (GpuInputFileBlock role):
+    # "" = unknown / non-file source / mixed files
+    origin_file: str = ""
 
     @property
     def capacity(self) -> int:
@@ -130,7 +133,8 @@ class DeviceBatch:
 
     def select(self, indices: Sequence[int]) -> "DeviceBatch":
         return DeviceBatch([self.columns[i] for i in indices], self.num_rows,
-                           [self.names[i] for i in indices])
+                           [self.names[i] for i in indices],
+                           self.origin_file)
 
     def nbytes(self) -> int:
         return sum(c.nbytes() for c in self.columns)

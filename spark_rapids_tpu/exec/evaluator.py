@@ -35,6 +35,7 @@ def _input_sig(db: DeviceBatch):
 
 
 _AUX_DEVICE_CACHE = {}
+_SMALL_AUX_CACHE = {}
 _SCALAR_CACHE = {}
 
 
@@ -44,14 +45,17 @@ def _upload_aux(a: np.ndarray) -> jax.Array:
     Aux arrays (literal values, dictionary rank tables) repeat identically
     across batches and re-planned queries; uploading them per call costs a
     host->device transfer each — material when the chip sits behind a
-    high-latency link."""
+    high-latency link.  Tiny scalars (e.g. monotonically_increasing_id's
+    per-batch base) churn a DIFFERENT value every batch — they get their
+    own small cache so they cannot evict the big shared uploads."""
     key = (a.dtype.str, a.shape, a.tobytes())
-    buf = _AUX_DEVICE_CACHE.get(key)
+    cache = _SMALL_AUX_CACHE if a.nbytes <= 16 else _AUX_DEVICE_CACHE
+    buf = cache.get(key)
     if buf is None:
-        if len(_AUX_DEVICE_CACHE) > 4096:
-            _AUX_DEVICE_CACHE.clear()
+        if len(cache) > 4096:
+            cache.clear()
         buf = jnp.asarray(a)
-        _AUX_DEVICE_CACHE[key] = buf
+        cache[key] = buf
     return buf
 
 
@@ -69,7 +73,7 @@ def _num_rows_scalar(num_rows) -> jax.Array:
 
 def _prepare(exprs: Sequence[Expression], db: DeviceBatch, conf: TpuConf):
     dicts = {n: c.dictionary for n, c in zip(db.names, db.columns)}
-    pctx = PrepCtx(conf, dicts)
+    pctx = PrepCtx(conf, dicts, batch=db)
     hostvals = [e.prepare(pctx) for e in exprs]
     aux = tuple(_upload_aux(np.asarray(a)) for a in pctx.aux)
     return pctx, hostvals, aux
@@ -148,7 +152,7 @@ def evaluate_projection(exprs: Sequence[Expression], names: Sequence[str],
     cols = []
     for (data, valid), e, hv in zip(outs, exprs, hostvals):
         cols.append(DeviceColumn(data, valid, e.dtype, hv.dictionary))
-    return DeviceBatch(cols, db.num_rows, list(names))
+    return DeviceBatch(cols, db.num_rows, list(names), db.origin_file)
 
 
 def compute_predicate(cond: Expression, db: DeviceBatch,

@@ -540,7 +540,7 @@ def _truncate(db: DeviceBatch, rows: int) -> DeviceBatch:
     live = jnp.arange(db.capacity, dtype=jnp.int32) < jnp.int32(rows)
     cols = [DeviceColumn(c.data, c.validity & live, c.dtype, c.dictionary,
                          c.data_hi) for c in db.columns]
-    return DeviceBatch(cols, rows, db.names)
+    return DeviceBatch(cols, rows, db.names, db.origin_file)
 
 
 class UnionExec(PlanNode):

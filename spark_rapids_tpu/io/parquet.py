@@ -41,6 +41,7 @@ from ..exec.host_exec import HostNode
 from ..exec.plan import ExecContext, PlanNode
 from ..plan import expressions as E
 from ..plan import logical as L
+from ..plan.misc import set_current_input_file
 
 
 # ---------------------------------------------------------------------------
@@ -123,6 +124,18 @@ def host_batch_stream(paths: Sequence[str], columns, conf: TpuConf,
                       filter_expr: Optional[E.Expression] = None,
                       ) -> Iterator[pa.RecordBatch]:
     """Ordered stream of decoded record batches per the reader strategy."""
+    for rb, _origin in host_batch_stream_with_origin(
+            paths, columns, conf, filter_expr):
+        yield rb
+
+
+def host_batch_stream_with_origin(
+        paths: Sequence[str], columns, conf: TpuConf,
+        filter_expr: Optional[E.Expression] = None,
+        ) -> Iterator[Tuple[pa.RecordBatch, str]]:
+    """(batch, source file) pairs — scan provenance for
+    input_file_name (GpuInputFileName role).  COALESCING batches that
+    stitched multiple files report "" (mixed provenance)."""
     strategy = str(conf.get(PARQUET_READER_TYPE)).upper()
     if strategy == "AUTO":
         strategy = "MULTITHREADED"
@@ -130,18 +143,20 @@ def host_batch_stream(paths: Sequence[str], columns, conf: TpuConf,
     units = _scan_units(paths, terms)
     target = conf.batch_size_rows
 
-    def split(tbl: pa.Table) -> Iterator[pa.RecordBatch]:
-        yield from tbl.combine_chunks().to_batches(max_chunksize=target)
+    def split(tbl: pa.Table, origin: str):
+        for rb in tbl.combine_chunks().to_batches(max_chunksize=target):
+            yield rb, origin
 
     if strategy == "PERFILE" or not units:
         for u in units:
-            yield from split(_read_unit(u, columns))
+            yield from split(_read_unit(u, columns), u[0])
         return
 
     threads = conf.get(PARQUET_MT_THREADS)
     lookahead = max(2, threads)
     coalesce = strategy == "COALESCING"
     pending: List[pa.Table] = []
+    pending_files: set = set()
     pending_rows = 0
     with cf.ThreadPoolExecutor(max_workers=threads) as pool:
         futures = [pool.submit(_read_unit, u, columns) for u in
@@ -153,15 +168,20 @@ def host_batch_stream(paths: Sequence[str], columns, conf: TpuConf,
                 futures.append(pool.submit(_read_unit, units[nxt], columns))
                 nxt += 1
             if not coalesce:
-                yield from split(tbl)
+                yield from split(tbl, units[i][0])
                 continue
             pending.append(tbl)
+            pending_files.add(units[i][0])
             pending_rows += tbl.num_rows
             if pending_rows >= target:
-                yield from split(pa.concat_tables(pending))
+                origin = pending_files.pop() if len(pending_files) == 1 \
+                    else ""
+                yield from split(pa.concat_tables(pending), origin)
                 pending, pending_rows = [], 0
+                pending_files = set()
         if pending:
-            yield from split(pa.concat_tables(pending))
+            origin = pending_files.pop() if len(pending_files) == 1 else ""
+            yield from split(pa.concat_tables(pending), origin)
 
 
 def parquet_schema(paths: Sequence[str], columns=None) -> t.StructType:
@@ -207,10 +227,13 @@ class ParquetScanExec(PlanNode):
         return self._schema
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        for rb in host_batch_stream(self.paths, self.columns, ctx.conf,
-                                    self.filter_expr):
+        for rb, origin in host_batch_stream_with_origin(
+                self.paths, self.columns, ctx.conf, self.filter_expr):
             ctx.bump("scanned_rows", rb.num_rows)
-            yield to_device(HostBatch(rb), ctx.conf)
+            db = to_device(HostBatch(rb), ctx.conf)
+            db.origin_file = origin      # input_file_name provenance
+            set_current_input_file(origin)
+            yield db
 
     def describe(self):
         return f"ParquetScanExec[{len(self.paths)} files]"
@@ -230,8 +253,10 @@ class CpuParquetScanExec(HostNode):
         return self._schema
 
     def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
-        yield from host_batch_stream(self.paths, self.columns, ctx.conf,
-                                     self.filter_expr)
+        for rb, origin in host_batch_stream_with_origin(
+                self.paths, self.columns, ctx.conf, self.filter_expr):
+            set_current_input_file(origin)
+            yield rb
 
 
 # ---------------------------------------------------------------------------

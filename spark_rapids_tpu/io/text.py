@@ -9,7 +9,7 @@ the engine's standard host batch stream with threaded per-file lookahead.
 from __future__ import annotations
 
 import concurrent.futures as cf
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import pyarrow as pa
 import pyarrow.csv as pacsv
@@ -42,13 +42,15 @@ def _read_json(path: str, schema, opts) -> pa.Table:
 
 
 def _stream(paths: Sequence[str], schema, opts, conf, reader
-            ) -> Iterator[pa.RecordBatch]:
+            ) -> Iterator[Tuple[pa.RecordBatch, str]]:
+    """(batch, source path) pairs — provenance for input_file_name."""
     target = conf.batch_size_rows
     with cf.ThreadPoolExecutor(max_workers=min(8, max(1, len(paths)))) as pool:
         futs = [pool.submit(reader, p, schema, opts) for p in paths]
-        for f in futs:
+        for f, path in zip(futs, paths):
             tbl = f.result()
-            yield from tbl.combine_chunks().to_batches(max_chunksize=target)
+            for rb in tbl.combine_chunks().to_batches(max_chunksize=target):
+                yield rb, path
 
 
 class _TextLogicalScan(L.LogicalPlan):
@@ -249,15 +251,19 @@ class TextScanExec(PlanNode):
         return self._schema
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from ..plan.misc import set_current_input_file
         lg = self.logical
         want = struct_to_schema(self._schema)
-        for rb in _stream(lg.paths, lg.arrow_schema, lg.opts, ctx.conf,
-                          type(lg).reader):
+        for rb, origin in _stream(lg.paths, lg.arrow_schema, lg.opts,
+                                  ctx.conf, type(lg).reader):
             ctx.bump("scanned_rows", rb.num_rows)
             if rb.schema != want:
                 rb = pa.Table.from_batches([rb]).cast(want) \
                     .combine_chunks().to_batches()[0]
-            yield to_device(HostBatch(rb), ctx.conf)
+            db = to_device(HostBatch(rb), ctx.conf)
+            db.origin_file = origin
+            set_current_input_file(origin)
+            yield db
 
 
 class CpuTextScanExec(HostNode):
@@ -271,6 +277,9 @@ class CpuTextScanExec(HostNode):
         return self._schema
 
     def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        from ..plan.misc import set_current_input_file
         lg = self.logical
-        yield from _stream(lg.paths, lg.arrow_schema, lg.opts, ctx.conf,
-                           type(lg).reader)
+        for rb, origin in _stream(lg.paths, lg.arrow_schema, lg.opts,
+                                  ctx.conf, type(lg).reader):
+            set_current_input_file(origin)
+            yield rb

@@ -79,8 +79,8 @@ def concat_batches(batches: List[DeviceBatch],
     if len(batches) == 1:
         return batches[0]
     # concat makes host-side layout decisions, so lazy counts sync here
-    batches = [DeviceBatch(b.columns, int(b.num_rows), b.names)
-               for b in batches]
+    batches = [DeviceBatch(b.columns, int(b.num_rows), b.names,
+                           b.origin_file) for b in batches]
     total = sum(b.num_rows for b in batches)
     cap = bucket_capacity(max(total, 1), conf)
     names = list(batches[0].names)
@@ -117,7 +117,9 @@ def concat_batches(batches: List[DeviceBatch],
         out_cols.append(DeviceColumn(jnp.concatenate(data_parts),
                                      jnp.concatenate(valid_parts),
                                      dt, unified, hi))
-    return DeviceBatch(out_cols, total, names)
+    origins = {b.origin_file for b in batches}
+    origin = origins.pop() if len(origins) == 1 else ""
+    return DeviceBatch(out_cols, total, names, origin)
 
 
 def shrink_to_rows(db: DeviceBatch, num_rows: int,
@@ -126,9 +128,9 @@ def shrink_to_rows(db: DeviceBatch, num_rows: int,
     (used after groupby/filter when occupancy dropped a bucket or more)."""
     cap = bucket_capacity(max(num_rows, 1), conf)
     if cap >= db.capacity:
-        return DeviceBatch(db.columns, num_rows, db.names)
+        return DeviceBatch(db.columns, num_rows, db.names, db.origin_file)
     cols = [DeviceColumn(c.data[:cap], c.validity[:cap], c.dtype,
                          c.dictionary,
                          None if c.data_hi is None else c.data_hi[:cap])
             for c in db.columns]
-    return DeviceBatch(cols, num_rows, db.names)
+    return DeviceBatch(cols, num_rows, db.names, db.origin_file)

@@ -81,9 +81,10 @@ def compact_batch(db: DeviceBatch, keep: jax.Array,
     cols = [DeviceColumn(d, v, c.dtype, c.dictionary, h)
             for (d, v, h), c in zip(outs, db.columns)]
     if not sync:
-        return DeviceBatch(cols, count, db.names)
-    return shrink_to_rows(DeviceBatch(cols, int(count), db.names),
-                          int(count), conf)
+        return DeviceBatch(cols, count, db.names, db.origin_file)
+    return shrink_to_rows(
+        DeviceBatch(cols, int(count), db.names, db.origin_file),
+        int(count), conf)
 
 
 def gather_batch(db: DeviceBatch, indices: jax.Array, out_rows: int,

@@ -159,7 +159,7 @@ def permute_batch(db: DeviceBatch, perm: jax.Array) -> DeviceBatch:
         v = jnp.take(c.validity, perm, axis=0)
         h = None if c.data_hi is None else jnp.take(c.data_hi, perm, axis=0)
         cols.append(DeviceColumn(d, v, c.dtype, c.dictionary, h))
-    return DeviceBatch(cols, db.num_rows, list(db.names))
+    return DeviceBatch(cols, db.num_rows, list(db.names), db.origin_file)
 
 
 def sort_batch(db: DeviceBatch, keys: Sequence[SortKey],

@@ -44,9 +44,11 @@ from ..ops.kernels import compute_dtype, merge_validity
 class PrepCtx:
     """Host-phase context: collects device aux arrays in deterministic order."""
 
-    def __init__(self, conf: TpuConf, dicts: Dict[str, Optional[pa.Array]]):
+    def __init__(self, conf: TpuConf, dicts: Dict[str, Optional[pa.Array]],
+                 batch=None):
         self.conf = conf
         self.dicts = dicts            # input column name -> dictionary or None
+        self.batch = batch            # the DeviceBatch under evaluation
         self.aux: List[np.ndarray] = []
         self.node_slots: Dict[int, List[int]] = {}
 
@@ -1961,9 +1963,14 @@ class RaiseError(Expression):
     expression tags off-device and the CPU operator throws on the first
     evaluated row (reference GpuRaiseError, misc.scala)."""
 
-    def __init__(self, message: str):
-        self.children = ()
-        self.message = message
+    def __init__(self, message):
+        # accept a plain string or an expression evaluating to one
+        if isinstance(message, Expression):
+            self.children = (message,)
+            self.message = None
+        else:
+            self.children = ()
+            self.message = str(message)
 
     def _resolve(self):
         self.dtype = t.NULL
@@ -1978,7 +1985,11 @@ class RaiseError(Expression):
 
     def _eval_cpu(self, rb, kids):
         if rb.num_rows > 0:
-            raise RuntimeError(self.message)
+            msg = self.message
+            if msg is None:
+                vals = kids[0].to_pylist()
+                msg = str(next((v for v in vals if v is not None), ""))
+            raise RuntimeError(msg)
         return pa.nulls(0)
 
 

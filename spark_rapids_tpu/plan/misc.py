@@ -1,0 +1,134 @@
+"""Misc nondeterministic / provenance expressions.
+
+Role of the reference's GpuMonotonicallyIncreasingID, GpuSparkPartitionID
+and GpuInputFileName/Block (GpuInputFileBlock.scala, InputFileBlockRule)
+— SURVEY §2.5 misc set (GpuRaiseError lives in plan/expressions.py).
+
+This engine's unit of work is the batch where Spark's is the partition,
+so the partition-indexed expressions use the batch ordinal: ids are
+`(batch_ordinal << 33) | row_index` — unique and increasing, same shape
+as Spark's `(partitionId << 33) | rowInPartition`, and exactly as
+nondeterministic as Spark documents the originals to be.
+
+input_file_name reads the batch's scan provenance (`origin_file`,
+attached by the parquet scan and propagated through projection/filter
+batches — the InputFileBlockRule concern); batches with no file
+provenance yield "" like Spark's non-file sources.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+import jax.numpy as jnp
+
+from .. import types as t
+from .expressions import DevVal, Expression, HostVal
+
+# CPU-path provenance: pyarrow RecordBatches cannot carry attributes, so
+# scan execs record the current file here; within-task pipelines are
+# sequential generators, so set-before-yield ordering is preserved
+import threading
+
+_TL = threading.local()
+
+
+def set_current_input_file(path: str) -> None:
+    _TL.current = path or ""
+
+
+def current_input_file() -> str:
+    return getattr(_TL, "current", "")
+
+
+class MonotonicallyIncreasingID(Expression):
+    """Nondeterministic unique int64 per row."""
+
+    def __init__(self):
+        self.children = ()
+        self._batch_no = -1
+
+    def _resolve(self):
+        self.dtype = t.LONG
+        self.nullable = False
+
+    def _prepare(self, pctx, kids):
+        self._batch_no += 1
+        pctx.add(self, np.int64(self._batch_no << 33))
+        return HostVal()
+
+    def _eval_dev(self, ctx, kids):
+        (base,) = ctx.aux_of(self)
+        data = base + jnp.arange(ctx.capacity, dtype=jnp.int64)
+        return DevVal(data, None, t.LONG)
+
+    def _eval_cpu(self, rb, kids):
+        self._batch_no += 1
+        base = self._batch_no << 33
+        return pa.array(np.arange(rb.num_rows, dtype=np.int64) + base,
+                        pa.int64())
+
+    def _fp_extra(self):
+        return "mid"
+
+
+class SparkPartitionID(Expression):
+    """The batch ordinal (the engine's partition analogue)."""
+
+    def __init__(self):
+        self.children = ()
+        self._batch_no = -1
+
+    def _resolve(self):
+        self.dtype = t.INT
+        self.nullable = False
+
+    def _prepare(self, pctx, kids):
+        self._batch_no += 1
+        pctx.add(self, np.int32(self._batch_no))
+        return HostVal()
+
+    def _eval_dev(self, ctx, kids):
+        (pid,) = ctx.aux_of(self)
+        data = jnp.full((ctx.capacity,), 0, jnp.int32) + pid
+        return DevVal(data, None, t.INT)
+
+    def _eval_cpu(self, rb, kids):
+        self._batch_no += 1
+        return pa.array([self._batch_no] * rb.num_rows, pa.int32())
+
+    def _fp_extra(self):
+        return "pid"
+
+
+class InputFileName(Expression):
+    """Scan provenance of the current batch; "" when unknown."""
+
+    def __init__(self):
+        self.children = ()
+        self._current_file = ""
+
+    def _resolve(self):
+        self.dtype = t.STRING
+        self.nullable = False
+
+    def _prepare(self, pctx, kids):
+        # the per-batch file travels OUTSIDE the trace, as the output
+        # column dictionary (HostVal) — codes are always 0
+        f = str(getattr(pctx.batch, "origin_file", "") or "")
+        return HostVal(pa.array([f], pa.string()))
+
+    def _eval_dev(self, ctx, kids):
+        # placeholder dictionary: evaluate_projection overrides the
+        # output dictionary with the per-batch HostVal one, so nothing
+        # file-specific is baked into the compiled program.  Nested use
+        # (e.g. upper(input_file_name())) is tagged off the device path
+        # by ExprMeta because inner consumers would read THIS dictionary.
+        codes = jnp.zeros((ctx.capacity,), jnp.int32)
+        return DevVal(codes, None, t.STRING, pa.array([""], pa.string()))
+
+    def _eval_cpu(self, rb, kids):
+        return pa.array([current_input_file()] * rb.num_rows, pa.string())
+
+    def _fp_extra(self):
+        return "ifn"

@@ -171,6 +171,15 @@ expr_rule(UDF.TpuUDF, t.T.NUMERIC + t.T.BOOLEAN + t.T.DATETIME,
 expr_rule(UDF.PythonUDF, t.T.ALL_SIMPLE,
           desc="row-at-a-time python UDF (always CPU path)")
 
+from . import misc as MISC  # noqa: E402
+
+expr_rule(MISC.MonotonicallyIncreasingID, _COMMON,
+          desc="nondeterministic unique int64 per row (batch-indexed)")
+expr_rule(MISC.SparkPartitionID, _COMMON,
+          desc="batch ordinal (the engine's partition analogue)")
+expr_rule(MISC.InputFileName, _COMMON,
+          desc="scan provenance of the current batch; '' when unknown")
+
 for _c in (Count, Sum, Min, Max, Average, First, Last, BoolAnd, BoolOr):
     agg_rule(_c, _COMMON, desc="aggregate function")
 
@@ -278,6 +287,14 @@ class ExprMeta(BaseMeta):
             c.tag()
             for r in c.reasons:
                 self.will_not_work(r)
+        from .misc import InputFileName
+        if any(isinstance(c, InputFileName) for c in self.expr.children):
+            # nested use would read the placeholder dictionary baked
+            # into the traced program (plan/misc.py); only top-level
+            # projection outputs carry the per-batch file dictionary
+            self.will_not_work(
+                "input_file_name nested inside another expression "
+                "(device path supports it as a top-level output only)")
         name = type(self.expr).__name__
         if name in self.conf.shims.unavailable_expressions:
             self.will_not_work(
@@ -903,10 +920,39 @@ def _push_down_filters(plan: L.LogicalPlan) -> None:
         _push_down_filters(c)
 
 
+def _plan_uses_input_file_name(plan: L.LogicalPlan) -> bool:
+    from .misc import InputFileName
+
+    def expr_has(e) -> bool:
+        return isinstance(e, InputFileName) or \
+            any(expr_has(c) for c in getattr(e, "children", ()))
+
+    for node in _walk(plan):
+        for attr in ("exprs", "keys", "left_keys", "right_keys"):
+            if any(expr_has(e) for e in getattr(node, attr, ())):
+                return True
+        cond = getattr(node, "condition", None)
+        if cond is not None and expr_has(cond):
+            return True
+    return False
+
+
+def _walk(plan: L.LogicalPlan):
+    yield plan
+    for c in plan.children:
+        yield from _walk(c)
+
+
 def apply_overrides(plan: L.LogicalPlan,
                     conf: TpuConf = DEFAULT_CONF) -> PhysicalQuery:
     """wrapAndTagPlan + doConvertPlan + explain logging."""
     _push_down_filters(plan)
+    if _plan_uses_input_file_name(plan):
+        # the InputFileBlockRule role: COALESCING stitches row groups of
+        # many files into one batch (mixed provenance -> ""), so
+        # input_file_name forces the per-file reader
+        from ..config import PARQUET_READER_TYPE
+        conf = TpuConf({**conf._raw, PARQUET_READER_TYPE.key: "PERFILE"})
     meta = wrap_plan(plan, conf)
     meta.tag()
     from ..config import CBO_ENABLED

@@ -51,7 +51,7 @@ def slice_batch(db: DeviceBatch, start: int, stop: int,
         v = c.validity[sl] & live
         h = None if c.data_hi is None else c.data_hi[sl]
         cols.append(DeviceColumn(d, v, c.dtype, c.dictionary, h))
-    return DeviceBatch(cols, rows, list(db.names))
+    return DeviceBatch(cols, rows, list(db.names), db.origin_file)
 
 
 def with_retry(budget: MemoryBudget, conf: TpuConf,

@@ -1,0 +1,144 @@
+"""Misc expressions: monotonically_increasing_id, spark_partition_id,
+input_file_name (GpuInputFileBlock role), raise_error."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.misc import (InputFileName,
+                                        MonotonicallyIncreasingID,
+                                        SparkPartitionID)
+from spark_rapids_tpu.session import TpuSession, col
+
+
+def test_monotonically_increasing_id_unique_increasing():
+    n = 5000
+    tbl = pa.table({"x": pa.array(np.arange(n), pa.int64())})
+    s = TpuSession({"spark.rapids.tpu.sql.batchSizeRows": "1024"})
+    df = s.from_arrow(tbl).select(
+        col("x"), MonotonicallyIncreasingID(), names=["x", "id"])
+    assert df.physical().kind == "device"
+    ids = df.collect().column("id").to_pylist()
+    assert len(set(ids)) == n                # unique
+    assert ids == sorted(ids)                # increasing in batch order
+    # batch structure: high bits step by batch ordinal
+    assert ids[0] >> 33 == 0 and ids[-1] >> 33 >= 1
+
+
+def test_spark_partition_id_steps_per_batch():
+    n = 3000
+    tbl = pa.table({"x": pa.array(np.arange(n), pa.int64())})
+    s = TpuSession({"spark.rapids.tpu.sql.batchSizeRows": "1024"})
+    out = s.from_arrow(tbl).select(
+        SparkPartitionID(), names=["p"]).collect()
+    pids = out.column("p").to_pylist()
+    assert sorted(set(pids)) == list(range(max(pids) + 1))
+    assert max(pids) >= 1                    # multiple batches seen
+
+
+def test_input_file_name_from_parquet(tmp_path):
+    p1 = str(tmp_path / "a.parquet")
+    p2 = str(tmp_path / "b.parquet")
+    pq.write_table(pa.table({"v": pa.array(range(100), pa.int64())}), p1)
+    pq.write_table(pa.table({"v": pa.array(range(100, 150), pa.int64())}),
+                   p2)
+    s = TpuSession()
+    df = s.read_parquet(p1, p2).select(
+        col("v"), InputFileName(), names=["v", "f"])
+    out = df.collect()
+    by_file = {}
+    for v, f in zip(out.column("v").to_pylist(),
+                    out.column("f").to_pylist()):
+        by_file.setdefault(f, []).append(v)
+    assert sorted(by_file) == [p1, p2]
+    assert sorted(by_file[p1]) == list(range(100))
+    assert sorted(by_file[p2]) == list(range(100, 150))
+
+
+def test_input_file_name_survives_filter(tmp_path):
+    p1 = str(tmp_path / "a.parquet")
+    pq.write_table(pa.table({"v": pa.array(range(50), pa.int64())}), p1)
+    s = TpuSession()
+    df = (s.read_parquet(p1)
+          .filter(E.GreaterThan(col("v"), E.Literal(40)))
+          .select(InputFileName(), names=["f"]))
+    files = set(df.collect().column("f").to_pylist())
+    assert files == {p1}
+
+
+def test_input_file_name_empty_for_memory_source():
+    s = TpuSession()
+    tbl = pa.table({"x": pa.array([1, 2], pa.int64())})
+    out = s.from_arrow(tbl).select(InputFileName(), names=["f"]).collect()
+    assert out.column("f").to_pylist() == ["", ""]
+
+
+def test_raise_error_runs_on_cpu_and_raises():
+    s = TpuSession()
+    tbl = pa.table({"x": pa.array([1], pa.int64())})
+    df = s.from_arrow(tbl).select(
+        E.RaiseError(E.Literal("boom")), names=["e"])
+    text = df.physical().explain()
+    assert "raise_error" in text.lower() or "CPU" in text
+    with pytest.raises(RuntimeError, match="boom"):
+        df.collect()
+
+
+def test_input_file_name_after_limit(tmp_path):
+    p1 = str(tmp_path / "a.parquet")
+    pq.write_table(pa.table({"v": pa.array(range(50), pa.int64())}), p1)
+    s = TpuSession()
+    out = (s.read_parquet(p1).limit(10)
+           .select(InputFileName(), names=["f"]).collect())
+    assert set(out.column("f").to_pylist()) == {p1}
+
+
+def test_input_file_name_cpu_fallback_path(tmp_path):
+    """Forced CPU execution still sees provenance (thread-local set by
+    the CPU scan execs)."""
+    p1 = str(tmp_path / "a.parquet")
+    pq.write_table(pa.table({"v": pa.array(range(20), pa.int64())}), p1)
+    s = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    out = s.read_parquet(p1).select(
+        col("v"), InputFileName(), names=["v", "f"]).collect()
+    assert set(out.column("f").to_pylist()) == {p1}
+
+
+def test_input_file_name_hive_text_scan(tmp_path):
+    from spark_rapids_tpu.io.text import write_hive_text
+    p1 = str(tmp_path / "t.hive")
+    write_hive_text(pa.table({"v": pa.array(range(9), pa.int64())}), p1)
+    s = TpuSession()
+    schema = pa.schema([("v", pa.int64())])
+    out = s.read_hive_text(p1, schema=schema).select(
+        InputFileName(), names=["f"]).collect()
+    assert set(out.column("f").to_pylist()) == {p1}
+
+
+def test_input_file_name_nested_goes_cpu(tmp_path):
+    from spark_rapids_tpu.plan.strings import Upper
+    p1 = str(tmp_path / "a.parquet")
+    pq.write_table(pa.table({"v": pa.array(range(5), pa.int64())}), p1)
+    s = TpuSession()
+    df = s.read_parquet(p1).select(
+        Upper(InputFileName()), names=["f"])
+    text = df.physical().explain()
+    assert "input_file_name nested" in text
+    # correctness preserved on the CPU path
+    assert set(df.collect().column("f").to_pylist()) == {p1.upper()}
+
+
+def test_input_file_name_forces_perfile_reader(tmp_path):
+    """COALESCING would stitch files into mixed batches (provenance "");
+    input_file_name in the plan forces PERFILE (InputFileBlockRule)."""
+    p1, p2 = str(tmp_path / "a.parquet"), str(tmp_path / "b.parquet")
+    pq.write_table(pa.table({"v": pa.array(range(30), pa.int64())}), p1)
+    pq.write_table(pa.table({"v": pa.array(range(30, 60), pa.int64())}), p2)
+    s = TpuSession({
+        "spark.rapids.tpu.sql.format.parquet.reader.type": "COALESCING"})
+    out = s.read_parquet(p1, p2).select(
+        col("v"), InputFileName(), names=["v", "f"]).collect()
+    assert set(out.column("f").to_pylist()) == {p1, p2}
