@@ -48,6 +48,13 @@ from ..config import TpuConf, DEFAULT_CONF
 from .host import HostBatch, dtype_to_arrow
 
 
+def merge_origin(origins) -> str:
+    """Provenance of data merged from several batches/files: the single
+    shared file, or "" for mixed/unknown (input_file_name contract)."""
+    s = {o or "" for o in origins}
+    return s.pop() if len(s) == 1 else ""
+
+
 def bucket_capacity(n: int, conf: TpuConf = DEFAULT_CONF) -> int:
     """Smallest static-shape bucket >= n.
 

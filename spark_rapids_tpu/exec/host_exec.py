@@ -211,6 +211,15 @@ class CpuFilterExec(HostNode):
         return f"CpuFilterExec[{self.condition!r}]"
 
 
+def _clear_scan_provenance():
+    """Materializing operators (sort/agg/join/window) drain their whole
+    input before emitting, so per-batch scan provenance no longer
+    corresponds to output rows — input_file_name above them is ""
+    (Spark's behavior past a materialization point within the task)."""
+    from ..plan.misc import set_current_input_file
+    set_current_input_file("")
+
+
 class CpuAggregateExec(HostNode):
     """Hash aggregate on pyarrow TableGroupBy / compute reductions."""
 
@@ -237,6 +246,7 @@ class CpuAggregateExec(HostNode):
         # project keys + agg children into a working table
         arrays, names = [], []
         for i, k in enumerate(self.keys):
+            _clear_scan_provenance()
             arrays.append(self._arr(k.eval_cpu(rb), rb.num_rows))
             names.append(f"_k{i}")
         agg_specs = []
@@ -321,6 +331,11 @@ class CpuAggregateExec(HostNode):
             for j in range(len(agg_specs)):
                 g[j].append(val_cols[j][row])
 
+        def wrap64(v):
+            # Spark/device integral sums wrap to int64 two's complement
+            # (non-ANSI); unbounded python ints must match
+            return (int(v) + 2 ** 63) % 2 ** 64 - 2 ** 63
+
         def apply(fn, fname, opts, values):
             nn = [v for v in values if v is not None]
             if fname == "_py":
@@ -330,10 +345,13 @@ class CpuAggregateExec(HostNode):
                 return len(values) if mode == "all" else len(nn)
             if not nn:
                 return None
-            return {"sum": sum, "min": min, "max": max,
-                    "mean": lambda v: sum(v) / len(v),
-                    "first": lambda v: v[0], "last": lambda v: v[-1],
-                    }[fname](nn)
+            out = {"sum": sum, "min": min, "max": max,
+                   "mean": lambda v: sum(v) / len(v),
+                   "first": lambda v: v[0], "last": lambda v: v[-1],
+                   }[fname](nn)
+            if fname == "sum" and t.is_integral(fn.dtype):
+                out = wrap64(out)
+            return out
 
         out_arrays, out_fields = [], []
         for i, (kname, k) in enumerate(zip(self.key_names, self.keys)):
@@ -390,6 +408,7 @@ class CpuSortExec(HostNode):
         rb = HostBatch.from_table(tbl).rb
         sort_cols, keys = [], []
         for i, (e, asc, nf) in enumerate(self.orders):
+            _clear_scan_provenance()
             sort_cols.append(CpuAggregateExec._arr(e.eval_cpu(rb), rb.num_rows))
             keys.append((f"_s{i}", "ascending" if asc else "descending",
                          "at_start" if nf else "at_end"))
@@ -462,6 +481,7 @@ class CpuJoinExec(HostNode):
         lkeys = [f"_jk{i}" for i in range(len(self.left_keys))]
         lt2 = lt
         for name, e in zip(lkeys, self.left_keys):
+            _clear_scan_provenance()
             lt2 = lt2.append_column(name,
                                     CpuAggregateExec._arr(e.eval_cpu(lrb), lrb.num_rows))
         rt2 = rt
@@ -594,6 +614,7 @@ class CpuWindowExec(HostNode):
 
         key_cols, key_specs = [], []
         for i, e in enumerate(self.partition_keys):
+            _clear_scan_provenance()
             key_cols.append((f"_p{i}", arr(e.eval_cpu(rb), n), True, True))
         for i, (e, asc, nf) in enumerate(self.order_keys):
             key_cols.append((f"_o{i}", arr(e.eval_cpu(rb), n), asc, nf))

@@ -34,7 +34,8 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from .. import types as t
-from ..columnar.device import DeviceBatch, to_device
+from ..columnar.device import (DeviceBatch, merge_origin,
+                               to_device)
 from ..columnar.host import HostBatch, schema_to_struct, struct_to_schema
 from ..config import (PARQUET_MT_THREADS, PARQUET_READER_TYPE, TpuConf)
 from ..exec.host_exec import HostNode
@@ -174,14 +175,13 @@ def host_batch_stream_with_origin(
             pending_files.add(units[i][0])
             pending_rows += tbl.num_rows
             if pending_rows >= target:
-                origin = pending_files.pop() if len(pending_files) == 1 \
-                    else ""
-                yield from split(pa.concat_tables(pending), origin)
+                yield from split(pa.concat_tables(pending),
+                                 merge_origin(pending_files))
                 pending, pending_rows = [], 0
                 pending_files = set()
         if pending:
-            origin = pending_files.pop() if len(pending_files) == 1 else ""
-            yield from split(pa.concat_tables(pending), origin)
+            yield from split(pa.concat_tables(pending),
+                             merge_origin(pending_files))
 
 
 def parquet_schema(paths: Sequence[str], columns=None) -> t.StructType:

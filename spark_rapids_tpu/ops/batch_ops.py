@@ -117,9 +117,9 @@ def concat_batches(batches: List[DeviceBatch],
         out_cols.append(DeviceColumn(jnp.concatenate(data_parts),
                                      jnp.concatenate(valid_parts),
                                      dt, unified, hi))
-    origins = {b.origin_file for b in batches}
-    origin = origins.pop() if len(origins) == 1 else ""
-    return DeviceBatch(out_cols, total, names, origin)
+    from ..columnar.device import merge_origin
+    return DeviceBatch(out_cols, total, names,
+                       merge_origin(b.origin_file for b in batches))
 
 
 def shrink_to_rows(db: DeviceBatch, num_rows: int,

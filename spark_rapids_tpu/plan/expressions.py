@@ -1987,8 +1987,10 @@ class RaiseError(Expression):
         if rb.num_rows > 0:
             msg = self.message
             if msg is None:
-                vals = kids[0].to_pylist()
-                msg = str(next((v for v in vals if v is not None), ""))
+                # the FIRST evaluated row's message, like Spark — not
+                # the first non-null one
+                v0 = kids[0].to_pylist()[0]
+                msg = "" if v0 is None else str(v0)
             raise RuntimeError(msg)
         return pa.nulls(0)
 

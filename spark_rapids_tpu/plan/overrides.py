@@ -865,6 +865,8 @@ class PhysicalQuery:
 
     def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
         ctx = ctx or ExecContext(self.conf)
+        from ..plan.misc import set_current_input_file
+        set_current_input_file("")   # provenance never leaks across queries
         from ..runtime.failure import crash_capture, install_fault_injection
         install_fault_injection(self.root, self.conf)
         with self._instrumented(ctx), crash_capture(self.conf, ctx):
@@ -927,9 +929,29 @@ def _plan_uses_input_file_name(plan: L.LogicalPlan) -> bool:
         return isinstance(e, InputFileName) or \
             any(expr_has(c) for c in getattr(e, "children", ()))
 
+    def any_expr(items) -> bool:
+        for item in items:
+            if isinstance(item, E.Expression):
+                if expr_has(item):
+                    return True
+            elif isinstance(item, (tuple, list)) and item:
+                # (expr, asc, nf) orders, (fn, name) aggs,
+                # (spec, name) window exprs, Expand projection rows
+                head = item[0]
+                if isinstance(head, E.Expression) and expr_has(head):
+                    return True
+                child = getattr(head, "child", None)
+                if isinstance(child, E.Expression) and expr_has(child):
+                    return True
+                if isinstance(head, (tuple, list)) and any_expr(item):
+                    return True
+        return False
+
     for node in _walk(plan):
-        for attr in ("exprs", "keys", "left_keys", "right_keys"):
-            if any(expr_has(e) for e in getattr(node, attr, ())):
+        for attr in ("exprs", "keys", "left_keys", "right_keys",
+                     "partition_keys", "aggs", "orders", "order_keys",
+                     "window_exprs", "projections"):
+            if any_expr(getattr(node, attr, ())):
                 return True
         cond = getattr(node, "condition", None)
         if cond is not None and expr_has(cond):

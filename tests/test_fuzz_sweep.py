@@ -20,11 +20,12 @@ from spark_rapids_tpu.datagen import (BooleanGen, DateGen, DecimalGen,
                                       LongGen, StringGen, gen_table)
 from spark_rapids_tpu import types as t
 from spark_rapids_tpu.plan import expressions as E
-from spark_rapids_tpu.plan.aggregates import (Average, Count, Max, Min,
-                                              Sum)
+from spark_rapids_tpu.plan.aggregates import (Average, Count,
+                                              CountDistinct, Max, Median,
+                                              Min, Sum)
 from spark_rapids_tpu.session import DataFrame, TpuSession, col
 
-N_SEEDS = 12
+N_SEEDS = 16
 ROWS = 800
 
 
@@ -68,6 +69,8 @@ def _rand_aggs(rng):
         (Max(col("dt")), "mx"),
         (Average(E.Cast(col("i"), t.DOUBLE)), "av"),
         (Sum(col("dec")), "sdec"),
+        (Median(col("d")), "md"),
+        (CountDistinct(col("i")), "cdi"),
     ]
     k = rng.integers(2, len(pool) + 1)
     idx = rng.choice(len(pool), size=k, replace=False)
@@ -97,11 +100,20 @@ def _build_query(s: TpuSession, tbl: pa.Table, rng) -> DataFrame:
         df = df.join(s.from_arrow(dim), how=how,
                      left_on=["g"], right_on=["gk"])
     shape = rng.random()
-    if shape < 0.5:
+    if shape < 0.45:
         df = (df.group_by("g").agg(*_rand_aggs(rng))
               .sort("g"))
-    elif shape < 0.75:
+    elif shape < 0.65:
         df = df.agg(*_rand_aggs(rng))
+    elif shape < 0.85:
+        from spark_rapids_tpu.plan.window import (Rank, RowNumber,
+                                                  WindowFrame, WinSum)
+        df = (df.window(
+            [(RowNumber(), "rn"), (Rank(), "rk"),
+             (WinSum(col("l"), WindowFrame("rows", None, 0)), "run")],
+            partition_by=["g"], order_by=[("l", True, True)])
+            .filter(E.LessThanOrEqual(col("rn"),
+                                      E.Literal(int(rng.integers(2, 9))))))
     else:
         df = df.sort(("l", bool(rng.integers(0, 2)), True),
                      ("i", True, True)).limit(int(rng.integers(5, 60)))

@@ -142,3 +142,43 @@ def test_input_file_name_forces_perfile_reader(tmp_path):
     out = s.read_parquet(p1, p2).select(
         col("v"), InputFileName(), names=["v", "f"]).collect()
     assert set(out.column("f").to_pylist()) == {p1, p2}
+
+
+def test_provenance_reset_between_queries_and_after_materialization(
+        tmp_path):
+    from spark_rapids_tpu.plan.strings import Upper
+    p1 = str(tmp_path / "a.parquet")
+    pq.write_table(pa.table({"v": pa.array(range(10), pa.int64())}), p1)
+    s = TpuSession()
+    # query 1 scans a file (sets the thread-local)
+    s.read_parquet(p1).select(InputFileName(), names=["f"]).collect()
+    # query 2: CPU-path nested input_file_name over a MEMORY source must
+    # be "", not the stale file from query 1
+    tbl = pa.table({"x": pa.array([1, 2], pa.int64())})
+    out = s.from_arrow(tbl).select(Upper(InputFileName()),
+                                   names=["f"]).collect()
+    assert out.column("f").to_pylist() == ["", ""]
+    # CPU sort drains the whole scan first: per-row provenance is gone,
+    # so input_file_name above it is "" (never the wrong file)
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    p2 = str(tmp_path / "b.parquet")
+    pq.write_table(pa.table({"v": pa.array(range(10, 20), pa.int64())}),
+                   p2)
+    out2 = (cpu.read_parquet(p1, p2).sort("v")
+            .select(InputFileName(), names=["f"]).collect())
+    assert set(out2.column("f").to_pylist()) == {""}
+
+
+def test_perfile_forced_for_agg_and_window_usage(tmp_path):
+    from spark_rapids_tpu.plan.aggregates import First
+    p1, p2 = str(tmp_path / "a.parquet"), str(tmp_path / "b.parquet")
+    pq.write_table(pa.table({"v": pa.array(range(30), pa.int64())}), p1)
+    pq.write_table(pa.table({"v": pa.array(range(30, 60), pa.int64())}),
+                   p2)
+    s = TpuSession({
+        "spark.rapids.tpu.sql.format.parquet.reader.type": "COALESCING"})
+    # input_file_name inside an aggregate must also force PERFILE
+    out = (s.read_parquet(p1, p2)
+           .group_by(InputFileName())
+           .agg((First(col("v")), "fv")).collect())
+    assert sorted(out.columns[0].to_pylist()) == [p1, p2]
