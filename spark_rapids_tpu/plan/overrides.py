@@ -157,6 +157,8 @@ for _c in (STR.StartsWith, STR.EndsWith, STR.Contains, STR.Like, STR.RLike):
 for _c in (STR.RegexpExtract, STR.RegexpReplace):
     expr_rule(_c, t.T.STRING,
               desc="regex extract/replace (dictionary transform)")
+expr_rule(STR.ParseUrl, t.T.STRING,
+          desc="parse_url (JNI ParseURI role; dictionary transform)")
 
 from . import json_fns as JSON  # noqa: E402  (registry population)
 

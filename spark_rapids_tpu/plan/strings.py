@@ -870,3 +870,64 @@ class RegexpReplace(DictTransform):
 
     def _fp_extra(self):
         return f"{self.pattern!r};{self.replacement!r}"
+
+
+class ParseUrl(DictTransform):
+    """parse_url(url, part[, key]) — the JNI ParseURI role
+    (GpuParseUrl, SURVEY §2.5 misc: com.nvidia.spark.rapids.jni.ParseURI).
+    Spark parts: PROTOCOL, HOST, PATH, QUERY, REF, FILE, AUTHORITY,
+    USERINFO; with part=QUERY a third literal extracts one query
+    parameter.  Invalid URLs and absent parts yield null, as Spark does.
+    Runs as a dictionary transform: each distinct URL parses once per
+    batch dictionary, codes gather the result."""
+    literal_slots = (1, 2)
+
+    _PARTS = ("PROTOCOL", "HOST", "PATH", "QUERY", "REF", "FILE",
+              "AUTHORITY", "USERINFO")
+
+    def __init__(self, child, part, key=None):
+        kids = (child,
+                part if isinstance(part, Expression) else Literal(part))
+        if key is not None:
+            kids += (key if isinstance(key, Expression) else Literal(key),)
+        self.children = kids
+
+    def unsupported_reasons(self, conf):
+        out = super().unsupported_reasons(conf)
+        part = _literal_value(self.children[1]) \
+            if isinstance(self.children[1], Literal) else None
+        if part is not None and str(part).upper() not in self._PARTS:
+            out.append(f"parse_url part {part!r} is not a Spark part")
+        return out
+
+    def _transform_value(self, s, args):
+        from urllib.parse import parse_qs, urlparse
+        part = args[1]
+        key = args[2] if len(args) > 2 else None
+        if part is None:
+            return None
+        try:
+            u = urlparse(s)
+            # Spark rejects URLs without a scheme/netloc structure
+            if not u.scheme:
+                return None
+        except ValueError:
+            return None
+        part = str(part).upper()
+        if part == "QUERY" and key is not None:
+            vals = parse_qs(u.query, keep_blank_values=False).get(key)
+            return vals[0] if vals else None
+        out = {
+            "PROTOCOL": u.scheme or None,
+            "HOST": u.hostname,
+            "PATH": u.path if (u.path or u.netloc) else None,
+            "QUERY": u.query or None,
+            "REF": u.fragment or None,
+            "FILE": (u.path + ("?" + u.query if u.query else ""))
+            if (u.path or u.query or u.netloc) else None,
+            "AUTHORITY": u.netloc or None,
+            "USERINFO": (u.username or "") + (":" + u.password
+                                              if u.password else "")
+            if (u.username or u.password) else None,
+        }.get(part)
+        return out
