@@ -308,10 +308,12 @@ def _device_column_to_arrow(col: DeviceColumn, num_rows: int,
 
 
 def to_host(db: DeviceBatch) -> HostBatch:
-    n = int(db.num_rows)
-    # one D2H round trip for every lane of every column
-    fetched = jax.device_get([(c.data, c.validity, c.data_hi)
-                              for c in db.columns])
+    # ONE D2H round trip for the row count and every lane of every column
+    # (a separate int(num_rows) fetch would double the tunnel RTTs)
+    n_f, fetched = jax.device_get(
+        (db.num_rows, [(c.data, c.validity, c.data_hi)
+                       for c in db.columns]))
+    n = int(n_f)
     arrays = [_device_column_to_arrow(c, n, f)
               for c, f in zip(db.columns, fetched)]
     schema = pa.schema([pa.field(n, a.type) for n, a in zip(db.names, arrays)])

@@ -37,14 +37,20 @@ _MIRROR = {"inner": "inner", "left_outer": "right_outer",
 class _ReplayStage(PlanNode):
     """A completed, spillable 'stage' the re-planned join replays."""
 
-    def __init__(self, batches: List[Spillable], schema: t.StructType):
+    def __init__(self, batches: List[Spillable], schema: t.StructType,
+                 source: PlanNode = None):
         super().__init__()
         self.batches = batches
         self._schema = schema
+        self._source = source      # statistics delegate (keys_unique)
 
     @property
     def output_schema(self) -> t.StructType:
         return self._schema
+
+    def keys_unique(self, names):
+        # replay preserves exactly the source's rows
+        return self._source is not None and self._source.keys_unique(names)
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for sp in self.batches:
@@ -76,8 +82,11 @@ class _BloomFilterStage(PlanNode):
             mask = bloom_might_contain(self.bits, self.key_cols_fn(db),
                                        db, self.k) & db.row_mask()
             out = compact_batch(db, mask, ctx.conf)
+            # lazy metric: accumulate on device, coerced ONCE at query end
+            # (PhysicalQuery._instrumented) instead of a sync per batch
+            import jax.numpy as jnp
             ctx.bump("bloom_filtered_rows",
-                     int(db.num_rows) - int(out.num_rows))
+                     jnp.int64(db.num_rows) - jnp.int64(out.num_rows))
             yield out
 
     def describe(self):
@@ -114,10 +123,31 @@ class AdaptiveShuffledJoinExec(PlanNode):
             return t.StructType(lf)
         return t.StructType(lf + list(self.right.output_schema.fields))
 
+    def keys_unique(self, names):
+        from .join import key_ref_names
+
+        def side_unique(keys, side):
+            kn = key_ref_names(keys)
+            return kn is not None and side.keys_unique(kn)
+
+        left_names = set(self.left.output_schema.names)
+        if self.join_type in ("left_semi", "left_anti"):
+            return self.left.keys_unique(names)
+        if all(n in left_names for n in names):
+            return self.left.keys_unique(names) and \
+                side_unique(self.right_keys, self.right)
+        right_names = set(self.right.output_schema.names)
+        if all(n in right_names for n in names):
+            return self.right.keys_unique(names) and \
+                side_unique(self.left_keys, self.left)
+        return False
+
     def _materialize(self, node: PlanNode, ctx: ExecContext
                      ) -> List[Spillable]:
+        # no per-batch row-count sync: empty batches are padding-only and
+        # byte sizing below uses capacity-based nbytes (host-known)
         return [Spillable(db, ctx.budget) for db in node.execute(ctx)
-                if int(db.num_rows) > 0]
+                if not (isinstance(db.num_rows, int) and db.num_rows == 0)]
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         left_stage: List[Spillable] = []
@@ -136,8 +166,9 @@ class AdaptiveShuffledJoinExec(PlanNode):
                 join = HashJoinExec(
                     jt, self.right_keys, self.left_keys,
                     _ReplayStage(right_stage,
-                                 self.right.output_schema),
-                    _ReplayStage(left_stage, self.left.output_schema))
+                                 self.right.output_schema, self.right),
+                    _ReplayStage(left_stage, self.left.output_schema,
+                                 self.left))
                 self._maybe_bloom(join, jt, left_stage,
                                   max(rbytes, 1), lbytes, ctx)
                 n_r = len(self.right.output_schema.fields)
@@ -149,9 +180,10 @@ class AdaptiveShuffledJoinExec(PlanNode):
             else:
                 join = HashJoinExec(
                     self.join_type, self.left_keys, self.right_keys,
-                    _ReplayStage(left_stage, self.left.output_schema),
+                    _ReplayStage(left_stage, self.left.output_schema,
+                                 self.left),
                     _ReplayStage(right_stage,
-                                 self.right.output_schema))
+                                 self.right.output_schema, self.right))
                 self._maybe_bloom(join, self.join_type, right_stage,
                                   max(lbytes, 1), rbytes, ctx)
                 yield from join.execute(ctx)

@@ -37,6 +37,20 @@ def _null_columns(schema: t.StructType, capacity: int) -> List[DeviceColumn]:
     return cols
 
 
+def key_ref_names(exprs) -> Optional[List[str]]:
+    """Column names when every key expression is a plain (possibly
+    aliased) column reference, else None.  Shared by HashJoinExec and
+    AdaptiveShuffledJoinExec so the aligned-path legality rule cannot
+    drift between them."""
+    names = []
+    for e in exprs:
+        inner = e.children[0] if isinstance(e, E.Alias) else e
+        if not isinstance(inner, E.ColumnRef):
+            return None
+        names.append(inner.name)
+    return names
+
+
 def _join_partition_ids(key_cols: List[DeviceColumn], db: DeviceBatch,
                         num_buckets: int) -> jax.Array:
     """Bucket ids from join-key columns; value-stable across sides and
@@ -118,24 +132,55 @@ class HashJoinExec(PlanNode):
                 cols[slot] = c
         return cols
 
+    def keys_unique(self, names: Sequence[str]) -> bool:
+        left_names = set(self.left.output_schema.names)
+        if self.join_type in (J.LEFT_SEMI, J.LEFT_ANTI):
+            return self.left.keys_unique(names)      # subset of left rows
+        if all(n in left_names for n in names):
+            # each probe row appears at most once iff the build side is
+            # unique in its join keys
+            return self.left.keys_unique(names) and self._build_unique()
+        right_names = set(self.right.output_schema.names)
+        if all(n in right_names for n in names):
+            return self.right.keys_unique(names) and self._probe_unique()
+        return False
+
+    def _build_unique(self) -> bool:
+        names = key_ref_names(self.right_keys)
+        return names is not None and self.right.keys_unique(names)
+
+    def _probe_unique(self) -> bool:
+        names = key_ref_names(self.left_keys)
+        return names is not None and self.left.keys_unique(names)
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         # ---- build (right side), fully materialized ----
+        # No per-batch row-count sync: empty batches are harmless (padding
+        # only) and the sub-partition gate sizes by capacity, which bounds
+        # rows from above without a D2H round trip.
         right_batches = [db for db in self.right.execute(ctx)
-                         if int(db.num_rows) > 0]
+                         if db.capacity > 0 and not
+                         (isinstance(db.num_rows, int) and db.num_rows == 0)]
         if not right_batches:
             yield from self._empty_build_output(ctx)
             return
 
         from ..config import HASH_SUBPARTITION_FALLBACK
-        build_rows = sum(int(b.num_rows) for b in right_batches)
+        build_rows_bound = sum(b.capacity for b in right_batches)
         if ctx.conf.get(HASH_SUBPARTITION_FALLBACK) and \
-                build_rows > 2 * ctx.conf.batch_size_rows:
+                build_rows_bound > 2 * ctx.conf.batch_size_rows:
             # Oversized build side: re-hash-partition BOTH sides into
             # independent sub-joins (GpuSubPartitionHashJoin.scala:32) —
             # equal keys hash to the same bucket on both sides, so the
             # union of bucket joins is the join.
-            yield from self._sub_partition_join(right_batches, ctx)
-            return
+            build_rows = sum(int(b.num_rows) for b in right_batches)
+            if build_rows > 2 * ctx.conf.batch_size_rows:
+                yield from self._sub_partition_join(right_batches, ctx)
+                return
+            right_batches = [b for b in right_batches if int(b.num_rows)]
+            if not right_batches:
+                yield from self._empty_build_output(ctx)
+                return
 
         build_batch = concat_batches(right_batches, ctx.conf)
         yield from self._join_stream(build_batch, self.left.execute(ctx),
@@ -222,11 +267,21 @@ class HashJoinExec(PlanNode):
                 build_keys[i] = ensure_unique_dict(build_keys[i])
         build = J.BuildTable(build_batch, build_keys)
         out_names = list(self.output_schema.names)
+        # Sync-free probe-aligned path: a build side whose keys are unique
+        # (exact plan statistics — dimension scans, group-by outputs) makes
+        # every probe row match at most once, so join output rides the
+        # probe's own static capacity and NO host round trip sizes it.
+        # Single-lane only: the sorted lane is exact there (no composite-
+        # hash collisions), so the one verified slot IS the unique match.
+        aligned = all(raw_pos) and len(build.lanes) == 1 \
+            and self._build_unique()
+        if aligned:
+            ctx.bump("join_aligned_fastpath")
 
         build_matched_acc = jnp.zeros((build_batch.capacity,), bool)
 
         for pb in probe_iter:
-            if int(pb.num_rows) == 0:
+            if isinstance(pb.num_rows, int) and pb.num_rows == 0:
                 continue
             probe_keys = self._key_cols(pb, self.left_keys, raw_pos, ctx)
             for i, s in enumerate(has_str):
@@ -238,22 +293,61 @@ class HashJoinExec(PlanNode):
             for c in probe_keys:
                 probe_valid = probe_valid & c.validity
 
-            lo, counts, cum, total = J.probe_counts(build, probe_lanes,
-                                                    probe_valid)
             if self.join_type in (J.LEFT_SEMI, J.LEFT_ANTI):
-                if total == 0:
-                    matched = jnp.zeros((pb.capacity,), bool)
+                # matched flag only — no pair expansion; single-lane keys
+                # (exact ranges) need no host sync and no uniqueness
+                if len(probe_lanes) == 1 and len(build.lanes) == 1:
+                    matched = J.probe_matched_lazy(build, probe_lanes,
+                                                   probe_valid)
                 else:
-                    out_cap = bucket_capacity(total, ctx.conf)
-                    _, _, _, matched, _ = J.expand_pairs(
-                        build, probe_lanes, probe_valid, lo, cum, out_cap,
-                        total)
+                    lo, counts, cum, total = J.probe_counts(
+                        build, probe_lanes, probe_valid)
+                    if total == 0:
+                        matched = jnp.zeros((pb.capacity,), bool)
+                    else:
+                        out_cap = bucket_capacity(total, ctx.conf)
+                        _, _, _, matched, _ = J.expand_pairs(
+                            build, probe_lanes, probe_valid, lo, cum,
+                            out_cap, total)
                 keep = matched if self.join_type == J.LEFT_SEMI \
                     else pb.row_mask() & ~matched
                 out = compact_batch(pb, keep, ctx.conf)
                 yield DeviceBatch(out.columns, out.num_rows, out_names)
                 continue
 
+            if aligned:
+                build_idx, ok = J.probe_aligned(build, probe_lanes,
+                                                probe_valid)
+                rg = gather_batch(build_batch,
+                                  jnp.where(ok, build_idx, -1),
+                                  pb.num_rows, null_out_of_bounds=True)
+                if self.join_type in (J.RIGHT_OUTER, J.FULL_OUTER):
+                    hits = jnp.zeros((build_batch.capacity,), jnp.int32) \
+                        .at[jnp.where(ok, build_idx, 0)] \
+                        .max(ok.astype(jnp.int32))
+                    build_matched_acc = build_matched_acc | (hits > 0)
+                if self.join_type == J.LEFT_OUTER:
+                    # all probe rows survive; unmatched rows carry null
+                    # right columns (already null via the -1 gather)
+                    yield DeviceBatch(list(pb.columns) + rg.columns,
+                                      pb.num_rows, out_names)
+                else:   # inner / right_outer / full_outer matched part
+                    pairs = DeviceBatch(list(pb.columns) + rg.columns,
+                                        pb.num_rows, out_names)
+                    yield compact_batch(pairs, ok & pb.row_mask(),
+                                        ctx.conf)
+                    if self.join_type == J.FULL_OUTER:
+                        unmatched = pb.row_mask() & ~ok
+                        right_nulls = _null_columns(
+                            self.right.output_schema, pb.capacity)
+                        padded = DeviceBatch(
+                            list(pb.columns) + right_nulls, pb.num_rows,
+                            out_names)
+                        yield compact_batch(padded, unmatched, ctx.conf)
+                continue
+
+            lo, counts, cum, total = J.probe_counts(build, probe_lanes,
+                                                    probe_valid)
             if total > 0:
                 out_cap = bucket_capacity(total, ctx.conf)
                 probe_idx, build_idx, ok, probe_matched, build_matched = \
@@ -328,13 +422,26 @@ class CrossJoinExec(PlanNode):
                             list(self.children[1].output_schema.fields))
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        out_names = list(self.output_schema.names)
+        if self.children[1].static_row_count() == 1:
+            # scalar-subquery cross join (the HAVING-against-total shape):
+            # exactly one build row broadcasts onto every probe row with
+            # zero host syncs
+            build = None
+            for db in self.children[1].execute(ctx):
+                build = db if build is None else build
+            for pb in self.children[0].execute(ctx):
+                idx0 = jnp.zeros((pb.capacity,), jnp.int32)
+                rg = gather_batch(build, idx0, pb.num_rows)
+                yield DeviceBatch(list(pb.columns) + rg.columns,
+                                  pb.num_rows, out_names)
+            return
         right_batches = [db for db in self.children[1].execute(ctx)
                          if int(db.num_rows) > 0]
         if not right_batches:
             return
         build = concat_batches(right_batches, ctx.conf)
         nb = int(build.num_rows)
-        out_names = list(self.output_schema.names)
         for pb in self.children[0].execute(ctx):
             npr = int(pb.num_rows)
             if npr == 0:

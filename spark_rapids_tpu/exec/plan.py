@@ -78,6 +78,24 @@ class PlanNode:
     def name(self) -> str:
         return type(self).__name__
 
+    # -- static statistics (the CBO/AQE-statistics analogue) ---------------
+    def keys_unique(self, names: Sequence[str]) -> bool:
+        """True if no two live rows can carry equal NON-NULL values in the
+        named column tuple.  Drives the sync-free probe-aligned join path
+        (ops/join.py probe_aligned): a unique build side makes join output
+        size a static fact.  Conservative default: unknown -> False.
+        Sources of truth: exact scan statistics (HostScanExec), group-by
+        structure, and uniqueness-preserving operators (filter/sort/limit
+        keep a subset of rows; joins with unique build sides repeat each
+        probe row at most once)."""
+        return False
+
+    def static_row_count(self) -> Optional[int]:
+        """Exact output row count when statically known (global aggregates
+        emit exactly one row), else None.  Lets cross joins against scalar
+        subqueries run without a host sync."""
+        return None
+
     def tree_string(self, indent: int = 0) -> str:
         lines = ["  " * indent + self.describe()]
         for c in self.children:
@@ -89,15 +107,30 @@ class PlanNode:
 
     # -- helpers -----------------------------------------------------------
     def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
-        """Run the plan and bring results back to host (GpuBringBackToHost)."""
+        """Run the plan and bring results back to host (GpuBringBackToHost).
+
+        Transfer policy per batch: small batches fetch count + lanes in
+        ONE round trip (to_host); large batches with a lazy count fetch
+        the scalar count first so an all-padding batch never ships
+        full-capacity lanes over the link."""
         ctx = ctx or ExecContext()
-        hbs = [to_host(db) for db in self.execute(ctx)
-               if int(db.num_rows) > 0]
+        hbs = []
+        for db in self.execute(ctx):
+            if isinstance(db.num_rows, int):
+                if db.num_rows == 0:
+                    continue
+            elif db.nbytes() > (1 << 20):
+                n = int(db.num_rows)        # cheap scalar vs huge lanes
+                if n == 0:
+                    continue
+                db = DeviceBatch(db.columns, n, db.names, db.origin_file)
+            hbs.append(to_host(db))
         schema = None
         batches = []
         for hb in hbs:
-            schema = schema or hb.rb.schema
-            batches.append(hb.rb)
+            if hb.num_rows > 0:
+                schema = schema or hb.rb.schema
+                batches.append(hb.rb)
         if not batches:
             from ..columnar.host import struct_to_schema
             return pa.Table.from_batches([], struct_to_schema(self.output_schema))
@@ -108,11 +141,13 @@ class HostScanExec(PlanNode):
     """Leaf: uploads host Arrow batches to device (HostColumnarToGpu role)."""
 
     def __init__(self, batches: Sequence[HostBatch],
-                 schema: Optional[t.StructType] = None):
+                 schema: Optional[t.StructType] = None,
+                 source_table: Optional[pa.Table] = None):
         super().__init__()
         self.batches = list(batches)
         self._schema = schema or (self.batches[0].schema if self.batches
                                   else t.StructType([]))
+        self._source_table = source_table
 
     @classmethod
     def from_table(cls, table: pa.Table, max_rows: Optional[int] = None
@@ -120,7 +155,17 @@ class HostScanExec(PlanNode):
         rbs = table.to_batches(max_chunksize=max_rows) if max_rows \
             else table.combine_chunks().to_batches()
         return cls([HostBatch(rb) for rb in rbs],
-                   schema_to_struct(table.schema))
+                   schema_to_struct(table.schema), source_table=table)
+
+    def keys_unique(self, names: Sequence[str]) -> bool:
+        """Exact scan-time distinctness statistics (the role Delta/Iceberg
+        table stats play for the reference's planner), cached per source
+        table so repeated queries over the same data pay once."""
+        tbl = self._source_table
+        if tbl is None or not names or \
+                any(n not in tbl.schema.names for n in names):
+            return False
+        return _table_keys_unique(tbl, tuple(names))
 
     @property
     def output_schema(self) -> t.StructType:
@@ -135,6 +180,41 @@ class HostScanExec(PlanNode):
         return f"HostScanExec[{len(self.batches)} batches]"
 
 
+_UNIQUE_STAT_CACHE: dict = {}
+
+
+def _table_keys_unique(tbl: pa.Table, names: tuple) -> bool:
+    """No two rows share equal fully-non-null values in `names` (rows with
+    any null key are excluded — null join keys never match).
+
+    Cached per (table identity, key tuple) via weakref: stats die with
+    the table instead of pinning gigabytes of dropped inputs, and id()
+    reuse after GC cannot alias a stale entry (the finalizer removes it)."""
+    import weakref
+    key = (id(tbl), names)
+    hit = _UNIQUE_STAT_CACHE.get(key)
+    if hit is not None and hit[0]() is tbl:
+        return hit[1]
+    import pyarrow.compute as pc
+    sub = tbl.select(list(names)).drop_null()
+    if sub.num_rows == 0:
+        uniq = True
+    elif len(names) == 1:
+        uniq = pc.count_distinct(sub.column(0)).as_py() == sub.num_rows
+    else:
+        uniq = sub.group_by(list(names)).aggregate([]).num_rows \
+            == sub.num_rows
+    try:
+        ref = weakref.ref(tbl, lambda _r, k=key:
+                          _UNIQUE_STAT_CACHE.pop(k, None))
+    except TypeError:        # weakref-unsupported object: don't cache
+        return uniq
+    if len(_UNIQUE_STAT_CACHE) > 1024:
+        _UNIQUE_STAT_CACHE.clear()
+    _UNIQUE_STAT_CACHE[key] = (ref, uniq)
+    return uniq
+
+
 class ProjectExec(PlanNode):
     """GpuProjectExec: one fused XLA program per row bucket
     (reference basicPhysicalOperators.scala:350)."""
@@ -144,6 +224,24 @@ class ProjectExec(PlanNode):
         super().__init__(child)
         self.exprs = [e.bind(child.output_schema) for e in exprs]
         self.names = list(names)
+
+    def keys_unique(self, names: Sequence[str]) -> bool:
+        # renames/pass-throughs delegate to the child's columns; the
+        # plain-reference rule is the shared join helper so the aligned-
+        # path legality cannot drift between project and join
+        from .join import key_ref_names
+        mapped = []
+        for n in names:
+            if n not in self.names:
+                return False
+            ref = key_ref_names([self.exprs[self.names.index(n)]])
+            if ref is None:
+                return False
+            mapped.extend(ref)
+        return self.child.keys_unique(mapped)
+
+    def static_row_count(self):
+        return self.child.static_row_count()   # projection keeps rows
 
     @property
     def output_schema(self) -> t.StructType:
@@ -169,6 +267,9 @@ class FilterExec(PlanNode):
     @property
     def output_schema(self) -> t.StructType:
         return self.child.output_schema
+
+    def keys_unique(self, names):
+        return self.child.keys_unique(names)   # subset of rows
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from .evaluator import compute_predicate
@@ -205,6 +306,16 @@ class HashAggregateExec(PlanNode):
         for fn, n in self.aggs:
             fields.append(t.StructField(n, fn.dtype))
         return t.StructType(fields)
+
+    def keys_unique(self, names: Sequence[str]) -> bool:
+        # the group-key tuple is unique by construction; any superset of a
+        # unique tuple is unique.  A global aggregate has exactly one row.
+        if not self.key_exprs:
+            return True
+        return set(self.key_names) <= set(names)
+
+    def static_row_count(self) -> Optional[int]:
+        return 1 if not self.key_exprs else None
 
     def _strip_filters(self, can_fuse: bool):
         """Peel the chain of FilterExec children this aggregate can fuse;
@@ -512,18 +623,29 @@ class LocalLimitExec(PlanNode):
     def output_schema(self) -> t.StructType:
         return self.child.output_schema
 
+    def keys_unique(self, names):
+        return self.child.keys_unique(names)   # prefix of rows
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        # Never peek ahead: pulling a second batch before emitting would
+        # compute an entire extra upstream batch even when the first one
+        # already satisfies the limit.  A lazy count costs one scalar
+        # sync; the payoff is the capacity slice (shrink_to_capacity), so
+        # a tiny LIMIT never ships a full-capacity batch to host.
+        from ..ops.batch_ops import shrink_to_capacity
         remaining = self.limit
         for db in self.child.execute(ctx):
             if remaining <= 0:
                 return
             n = int(db.num_rows)
-            if n <= remaining:
+            if n == 0:
+                continue
+            if n < remaining:
                 remaining -= n
                 yield db
             else:
-                yield shrink_to_rows(_truncate(db, remaining), remaining,
-                                     ctx.conf)
+                yield shrink_to_capacity(_truncate(db, remaining),
+                                         remaining, ctx.conf)
                 return
 
     def describe(self):
@@ -575,6 +697,12 @@ class CoalesceBatchesExec(PlanNode):
     def output_schema(self) -> t.StructType:
         return self.child.output_schema
 
+    def keys_unique(self, names):
+        return self.child.keys_unique(names)   # same rows, repacked
+
+    def static_row_count(self):
+        return self.child.static_row_count()
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         target = self.target_rows or ctx.conf.batch_size_rows
         pending: List[DeviceBatch] = []
@@ -618,15 +746,34 @@ class SortExec(PlanNode):
     def output_schema(self) -> t.StructType:
         return self.child.output_schema
 
+    def keys_unique(self, names):
+        return self.child.keys_unique(names)   # permutation of rows
+
+    def static_row_count(self):
+        return self.child.static_row_count()
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from ..ops.sort import sort_batch
         if not self.global_sort:
             for db in self.child.execute(ctx):
                 yield sort_batch(db, self.keys, ctx.conf)
             return
+        # Single-batch input sorts directly with zero host syncs (the
+        # dominant case once upstream operators keep lazy row counts);
+        # the out-of-core path engages from the second batch on.
+        it = self.child.execute(ctx)
+        first = next(it, None)
+        if first is None:
+            return
+        second = next(it, None)
+        if second is None:
+            yield sort_batch(first, self.keys, ctx.conf)
+            return
         from .ooc_sort import OutOfCoreSorter
         sorter = OutOfCoreSorter(self.keys, ctx)
-        for db in self.child.execute(ctx):
+        sorter.add(first)
+        sorter.add(second)
+        for db in it:
             sorter.add(db)
         yield from sorter.results()
 
@@ -651,17 +798,24 @@ class TopNExec(PlanNode):
     def output_schema(self) -> t.StructType:
         return self.child.output_schema
 
+    def keys_unique(self, names):
+        return self.child.keys_unique(names)   # prefix of a permutation
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from ..ops.sort import sort_batch
         pending: Optional[DeviceBatch] = None
+        from ..ops.batch_ops import shrink_to_capacity
         for db in self.child.execute(ctx):
-            if int(db.num_rows) == 0:
+            if isinstance(db.num_rows, int) and db.num_rows == 0:
                 continue
             batch = db if pending is None \
                 else concat_batches([pending, db], ctx.conf)
             s = sort_batch(batch, self.keys, ctx.conf)
-            n = min(self.limit, int(s.num_rows))
-            pending = shrink_to_rows(_truncate(s, n), n, ctx.conf)
+            # lazy cut + static capacity shrink: live rows <= limit by
+            # construction, so the bucket slice needs no row-count sync
+            nl = jnp.minimum(jnp.int32(self.limit), jnp.int32(s.num_rows))
+            pending = shrink_to_capacity(_truncate(s, nl), self.limit,
+                                         ctx.conf)
         if pending is not None:
             yield pending
 
@@ -684,6 +838,9 @@ class RangeExec(PlanNode):
     @property
     def output_schema(self) -> t.StructType:
         return t.StructType([t.StructField(self.col_name, t.LongType())])
+
+    def keys_unique(self, names):
+        return list(names) == [self.col_name]   # iota never repeats
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from ..columnar.device import DeviceColumn, bucket_capacity

@@ -39,17 +39,25 @@ def _local(path: str) -> str:
 
 
 class IcebergSnapshot:
-    """Resolved file sets of one snapshot."""
+    """Resolved file sets of one snapshot.
+
+    ``seq_of`` maps every file path to its *data sequence number* (Iceberg
+    v2 spec): inherited from the manifest-list entry when the manifest
+    entry's own sequence_number is null and status is ADDED.  ``None``
+    means the table carries no sequence metadata (v1 / legacy layouts).
+    """
 
     def __init__(self, data_files: List[str],
                  pos_delete_files: List[str],
                  eq_deletes: List[Tuple[str, List[int]]],
-                 schema: Optional[dict], snapshot_id: Optional[int]):
+                 schema: Optional[dict], snapshot_id: Optional[int],
+                 seq_of: Optional[Dict[str, Optional[int]]] = None):
         self.data_files = data_files
         self.pos_delete_files = pos_delete_files
         self.eq_deletes = eq_deletes        # (path, equality_field_ids)
         self.schema = schema
         self.snapshot_id = snapshot_id
+        self.seq_of = seq_of or {}
 
 
 def load_table_metadata(table_path: str) -> dict:
@@ -86,9 +94,11 @@ def resolve_snapshot(table_path: str,
         return IcebergSnapshot([], [], [], _current_schema(meta), None)
 
     data, pos_del, eq_del = [], [], []
+    seq_of: Dict[str, Optional[int]] = {}
     _, manifests = read_avro_rows(_local(snap["manifest-list"]))
     for m in manifests:
         mpath = _local(m["manifest_path"])
+        mseq = m.get("sequence_number")      # manifest's data sequence num
         # content: 0=data manifest, 1=delete manifest (v1 files omit it)
         _, entries = read_avro_rows(mpath)
         for e in entries:
@@ -96,6 +106,12 @@ def resolve_snapshot(table_path: str,
                 continue
             df = e["data_file"]
             fpath = _local(df["file_path"])
+            # v2 spec: null entry sequence_number on an ADDED entry
+            # inherits the manifest's sequence number.
+            eseq = e.get("sequence_number")
+            if eseq is None and e.get("status") == 1:
+                eseq = mseq
+            seq_of[fpath] = eseq
             content = df.get("content", 0)
             if content == 0:
                 data.append(fpath)
@@ -105,7 +121,7 @@ def resolve_snapshot(table_path: str,
                 eq_ids = df.get("equality_ids") or []
                 eq_del.append((fpath, list(eq_ids)))
     return IcebergSnapshot(data, pos_del, eq_del,
-                           _current_schema(meta), sid)
+                           _current_schema(meta), sid, seq_of)
 
 
 def _current_schema(meta: dict) -> Optional[dict]:
@@ -122,6 +138,17 @@ def _field_names_by_id(schema: Optional[dict]) -> Dict[int, str]:
     return {f["id"]: f["name"] for f in schema.get("fields", [])}
 
 
+def _delete_applies(data_seq: Optional[int], del_seq: Optional[int],
+                    strict: bool) -> bool:
+    """Iceberg v2 sequence-number scoping: an equality delete applies only
+    to data files with *strictly lower* data sequence number; a position
+    delete applies to files with lower-or-equal sequence number.  Tables
+    without sequence metadata (v1/legacy) apply deletes everywhere."""
+    if data_seq is None or del_seq is None:
+        return True
+    return data_seq < del_seq if strict else data_seq <= del_seq
+
+
 def read_iceberg(table_path: str,
                  snapshot_id: Optional[int] = None) -> pa.Table:
     """Materialize a snapshot as one arrow table, deletes applied."""
@@ -129,27 +156,33 @@ def read_iceberg(table_path: str,
     if not snap.data_files:
         return pa.table({})
 
-    # position deletes: {data file path -> sorted positions}
-    pos_by_file: Dict[str, set] = {}
+    # position deletes: {data file path -> [(position, delete_seq)]}
+    pos_by_file: Dict[str, list] = {}
     for pf in snap.pos_delete_files:
         t = pq.read_table(pf)
+        dseq = snap.seq_of.get(pf)
         for fp, pos in zip(t.column("file_path").to_pylist(),
                            t.column("pos").to_pylist()):
-            pos_by_file.setdefault(_local(fp), set()).add(pos)
+            pos_by_file.setdefault(_local(fp), []).append((pos, dseq))
 
     names = _field_names_by_id(snap.schema)
     eq_tables = [(pq.read_table(p),
-                  [names.get(i) for i in ids] if ids else None)
+                  [names.get(i) for i in ids] if ids else None,
+                  snap.seq_of.get(p))
                  for p, ids in snap.eq_deletes]
 
     parts = []
     for fpath in snap.data_files:
         t = pq.read_table(fpath)
-        dead = pos_by_file.get(fpath)
+        fseq = snap.seq_of.get(fpath)
+        dead = {pos for pos, dseq in pos_by_file.get(fpath, ())
+                if _delete_applies(fseq, dseq, strict=False)}
         if dead:
             keep = [i for i in range(t.num_rows) if i not in dead]
             t = t.take(keep)
-        for dt, cols in eq_tables:
+        for dt, cols, dseq in eq_tables:
+            if not _delete_applies(fseq, dseq, strict=True):
+                continue
             key_cols = cols or dt.schema.names
             key_cols = [c for c in key_cols if c in t.schema.names]
             if not key_cols:

@@ -122,6 +122,23 @@ def concat_batches(batches: List[DeviceBatch],
                        merge_origin(b.origin_file for b in batches))
 
 
+def shrink_to_capacity(db: DeviceBatch, row_bound: int,
+                       conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
+    """Slice lanes down to the bucket fitting `row_bound` WITHOUT reading
+    the (possibly lazy) num_rows.  Sound when the caller can statically
+    bound the live row count (e.g. LIMIT N): live rows are a prefix, so
+    rows past the bound are guaranteed padding.  Keeps collect()/to_host
+    from shipping a full-capacity batch over the link for a tiny limit."""
+    cap = bucket_capacity(max(row_bound, 1), conf)
+    if cap >= db.capacity:
+        return db
+    cols = [DeviceColumn(c.data[:cap], c.validity[:cap], c.dtype,
+                         c.dictionary,
+                         None if c.data_hi is None else c.data_hi[:cap])
+            for c in db.columns]
+    return DeviceBatch(cols, db.num_rows, db.names, db.origin_file)
+
+
 def shrink_to_rows(db: DeviceBatch, num_rows: int,
                    conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
     """Re-bucket a padded batch down to the bucket fitting `num_rows`

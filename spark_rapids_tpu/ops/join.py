@@ -190,6 +190,76 @@ class BuildTable:
 _PROBE_CACHE = {}
 
 
+def probe_aligned(build: BuildTable, probe_lanes: List[jax.Array],
+                  probe_valid: jax.Array):
+    """Probe a build side whose keys are UNIQUE: each probe row has at
+    most one match, so the output is probe-aligned — (build_idx, ok) with
+    shape (probe_capacity,) and NO host sync (output capacity is the
+    probe's own capacity, known statically).
+
+    SINGLE-LANE ONLY: with one canonical lane the sorted "hash" IS the
+    lane (exact, zero collisions), so the slot at searchsorted-left is
+    the unique candidate.  With multiple lanes the composite hash can
+    collide between distinct build keys and the single verified slot
+    could miss a real match that sits one slot over — multi-lane joins
+    must use probe_counts/expand_pairs, which scan the full candidate
+    range.
+
+    This is the TPU-native fast path for the dominant join shape
+    (fact⋈dimension, join-against-group-by): the reference syncs to size
+    its gather maps (GpuHashJoin.scala:104); a unique build side makes
+    the size a static fact instead."""
+    assert len(probe_lanes) == 1 and len(build.lanes) == 1, \
+        "probe_aligned requires exact single-lane keys"
+    sig = ("aligned", build.capacity, probe_valid.shape[0],
+           len(probe_lanes))
+    fn = _PROBE_CACHE.get(sig)
+    if fn is None:
+        bcap = build.capacity
+
+        def run(perm, sorted_hash, valid_count, b_lanes, b_key_valid,
+                p_lanes, p_valid):
+            h = composite_hash(p_lanes)
+            lo = jnp.searchsorted(sorted_hash, h, side="left")
+            in_range = lo < valid_count
+            pos = jnp.clip(lo, 0, bcap - 1)
+            build_idx = jnp.take(perm, pos).astype(jnp.int32)
+            ok = p_valid & in_range & \
+                (jnp.take(sorted_hash, pos) == h)
+            for bl, pl in zip(b_lanes, p_lanes):
+                ok = ok & (jnp.take(bl, build_idx) == pl)
+            ok = ok & jnp.take(b_key_valid, build_idx)
+            return build_idx, ok
+        fn = jax.jit(run)
+        _PROBE_CACHE[sig] = fn
+    return fn(build.perm, build.sorted_hash, build.valid_count,
+              tuple(build.lanes), build.key_valid,
+              tuple(probe_lanes), probe_valid)
+
+
+def probe_matched_lazy(build: BuildTable, probe_lanes: List[jax.Array],
+                       probe_valid: jax.Array) -> jax.Array:
+    """Per-probe-row matched flag with NO host sync — sound only for a
+    SINGLE canonical lane, where the "hash" is the lane itself and a
+    non-empty candidate range proves a true match (semi/anti joins need
+    only this flag, never the pairs)."""
+    assert len(probe_lanes) == 1, "exact ranges require a single lane"
+    sig = ("matched_lazy", build.capacity, probe_valid.shape[0])
+    fn = _PROBE_CACHE.get(sig)
+    if fn is None:
+        def run(sorted_hash, valid_count, lanes, pvalid):
+            h = composite_hash(lanes)
+            lo = jnp.searchsorted(sorted_hash, h, side="left")
+            hi = jnp.searchsorted(sorted_hash, h, side="right")
+            lo = jnp.minimum(lo, valid_count)
+            hi = jnp.minimum(hi, valid_count)
+            return pvalid & (hi > lo)
+        fn = jax.jit(run)
+        _PROBE_CACHE[sig] = fn
+    return fn(build.sorted_hash, build.valid_count, tuple(probe_lanes),
+              probe_valid)
+
+
 def probe_counts(build: BuildTable, probe_lanes: List[jax.Array],
                  probe_valid: jax.Array):
     """-> (lo, hi, counts, total) ; total is a host int (one sync)."""

@@ -88,6 +88,13 @@ def zorder_sort_indices(table_cols: List[np.ndarray],
             valids = [jnp.ones(len(table_cols[0]), bool)] * len(table_cols)
             key = np.asarray(zorder_key(lanes, valids))
             return np.argsort(key, kind="stable")
-        except Exception:                        # noqa: BLE001
-            pass
+        except Exception as e:                   # noqa: BLE001
+            # A real kernel regression must not masquerade as a quiet CPU
+            # fallback: surface it (jax backend errors are RuntimeError;
+            # anything else is a bug in zorder_key itself).
+            import logging
+            logging.getLogger(__name__).warning(
+                "zorder device path failed, using numpy oracle: %s", e)
+            if not isinstance(e, (RuntimeError, jax.errors.JaxRuntimeError)):
+                raise
     return np.argsort(zorder_key_np(table_cols), kind="stable")

@@ -860,6 +860,14 @@ class PhysicalQuery:
             with profile_trace(self.conf), \
                     device_permit(self.conf, ctx.metrics):
                 yield
+            # metrics accumulated as device scalars (lazy counts) coerce
+            # in ONE batched fetch at query end
+            import jax
+            lazy = {k: v for k, v in ctx.metrics.items()
+                    if isinstance(v, jax.Array)}
+            if lazy:
+                for k, v in zip(lazy, jax.device_get(list(lazy.values()))):
+                    ctx.metrics[k] = v.item()
             if ctx._budget is not None:
                 for k, v in ctx.budget.metrics.items():
                     ctx.metrics[f"memory.{k}"] = v

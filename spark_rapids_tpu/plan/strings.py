@@ -917,9 +917,17 @@ class ParseUrl(DictTransform):
         if part == "QUERY" and key is not None:
             vals = parse_qs(u.query, keep_blank_values=False).get(key)
             return vals[0] if vals else None
+        # java.net.URI preserves host case; urllib's .hostname lowercases.
+        # Extract the raw host from netloc (strip userinfo, port).
+        raw_host = u.netloc.rsplit("@", 1)[-1]
+        if raw_host.startswith("["):             # IPv6 literal
+            end = raw_host.find("]")
+            raw_host = raw_host[:end + 1] if end >= 0 else None
+        else:
+            raw_host = raw_host.split(":", 1)[0] or None
         out = {
             "PROTOCOL": u.scheme or None,
-            "HOST": u.hostname,
+            "HOST": raw_host,
             "PATH": u.path if (u.path or u.netloc) else None,
             "QUERY": u.query or None,
             "REF": u.fragment or None,

@@ -19,8 +19,11 @@ class WorkerError(RuntimeError):
 
 
 class WorkerClient:
-    def __init__(self, address: Tuple[str, int]):
+    def __init__(self, address: Tuple[str, int],
+                 token: Optional[str] = None):
         self._sock = socket.create_connection(address)
+        if token is not None:
+            send_frame(self._sock, token.encode())
 
     def ping(self) -> dict:
         send_frame(self._sock, json.dumps({"type": "ping"}).encode())

@@ -79,11 +79,22 @@ def ipc_to_table(data: bytes) -> pa.Table:
 
 class PlanWorker:
     """Accepts connections on a local TCP port; one thread per
-    connection (the executor's task threads multiplex over it)."""
+    connection (the executor's task threads multiplex over it).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    Auth: the worker mints a random token at startup; the first frame of
+    every connection must be that token (the legitimate client learns it
+    out-of-band — the JVM side reads it from the worker's launch
+    handshake).  Anything else is dropped before a single plan or Arrow
+    byte is parsed, so another local user can't execute plans or read
+    shipped tables."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None):
+        import secrets
         self._srv = socket.create_server((host, port))
         self.address: Tuple[str, int] = self._srv.getsockname()
+        self.token: str = token if token is not None \
+            else secrets.token_hex(16)
         self._threads = []
         self._accept_thread: Optional[threading.Thread] = None
         self._closed = False
@@ -103,10 +114,16 @@ class PlanWorker:
             th = threading.Thread(target=self._serve_conn, args=(conn,),
                                   daemon=True, name="tpu-worker-conn")
             th.start()
+            self._threads = [t for t in self._threads if t.is_alive()]
             self._threads.append(th)
 
     def _serve_conn(self, conn: socket.socket):
         with conn:
+            import hmac
+            hello = recv_frame(conn)
+            if hello is None or not hmac.compare_digest(
+                    hello, self.token.encode()):
+                return                              # unauthenticated peer
             while True:
                 frame = recv_frame(conn)
                 if frame is None:

@@ -184,9 +184,17 @@ class Spillable:
         self._path: Optional[str] = None
         self._budget = budget
         self._nbytes = db.nbytes()
-        self.num_rows = int(db.num_rows)
+        # lazily coerced: a device-resident row count stays on device
+        # until someone actually needs the host value (spill does anyway)
+        self._num_rows = db.num_rows
         budget.reserve(self._nbytes)
         self._sid = budget.register(self)
+
+    @property
+    def num_rows(self) -> int:
+        if not isinstance(self._num_rows, int):
+            self._num_rows = int(self._num_rows)
+        return self._num_rows
 
     @property
     def on_device(self) -> bool:

@@ -25,11 +25,19 @@ from .session import DataFrame, TpuSession, col, lit
 
 
 def money_from_cents(cents: np.ndarray, precision=12, scale=2) -> pa.Array:
-    """Exact decimal(p,s) from integer unscaled values (no float trip)."""
-    import decimal as pydec
-    vals = [pydec.Decimal(int(c)).scaleb(-scale)
-            for c in cents.astype(np.int64)]
-    return pa.array(vals, pa.decimal128(precision, scale))
+    """Exact decimal(p,s) from integer unscaled values (no float trip).
+
+    Vectorized: the unscaled int64 cents ARE the decimal128 low lane;
+    build the array straight from buffers (a Python-Decimal loop takes
+    minutes at SF1's 6M rows)."""
+    unscaled = cents.astype(np.int64)
+    lanes = np.empty((len(unscaled), 2), dtype=np.uint64)
+    lanes[:, 0] = unscaled.view(np.uint64)
+    lanes[:, 1] = np.where(unscaled < 0,
+                           np.uint64(0xFFFFFFFFFFFFFFFF), np.uint64(0))
+    return pa.Array.from_buffers(
+        pa.decimal128(precision, scale), len(unscaled),
+        [None, pa.py_buffer(lanes.tobytes())])
 
 
 _DATE0 = pydt.date(1970, 1, 1)

@@ -9,12 +9,25 @@ late — use jax.config, which applies because no backend is initialized yet.
 import os
 
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+# Persistent-cache AOT loads warn about XLA pseudo machine features
+# (+prefer-no-gather etc.) that host detection never reports; the spam
+# drowns test output. ERROR-level C++ logs are noise here — real failures
+# surface as Python exceptions.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: jit compiles dominate suite wall time; with a
+# warm cache the full suite finishes headless well under the 10-minute budget.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                          ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import pytest  # noqa: E402
 

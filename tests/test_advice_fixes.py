@@ -133,3 +133,19 @@ class TestStringJoinBuildHoist:
         out = HashJoinExec("left_anti", [E.ColumnRef("k")],
                            [E.ColumnRef("k")], left, right).collect()
         assert sorted(out.column("l").to_pylist()) == [0, 2]
+
+
+class TestParseUrlHostCase:
+    """Round-2 advisor: parse_url(url,'HOST') must preserve host case
+    (java.net.URI does; urllib's .hostname lowercases)."""
+
+    def test_mixed_case_host_preserved(self):
+        from spark_rapids_tpu.plan.strings import ParseUrl
+        pu = ParseUrl.__new__(ParseUrl)
+        assert pu._transform_value(
+            "https://ExAmple.COM/path", [None, "HOST"]) == "ExAmple.COM"
+        assert pu._transform_value(
+            "https://user:pw@MixedCase.Org:8080/p?q=1",
+            [None, "HOST"]) == "MixedCase.Org"
+        assert pu._transform_value(
+            "http://[2001:DB8::1]:443/x", [None, "HOST"]) == "[2001:DB8::1]"

@@ -169,6 +169,79 @@ def test_session_read_iceberg_time_travel(iceberg_table):
     assert s.read_iceberg(root).count() == 45
 
 
+SEQ_ENTRY_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"]},
+        {"name": "sequence_number", "type": ["null", "long"]},
+        {"name": "data_file", "type": DATA_FILE_SCHEMA},
+    ]}
+
+SEQ_LIST_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "partition_spec_id", "type": "int"},
+        {"name": "content", "type": "int"},
+        {"name": "sequence_number", "type": "long"},
+        {"name": "added_snapshot_id", "type": "long"},
+    ]}
+
+
+def test_equality_delete_sequence_scoping(tmp_path):
+    """Delete-then-reinsert: an equality delete (seq 2) must not drop rows
+    from a data file added later (seq 3) — v2 spec strict-lower rule."""
+    root = str(tmp_path / "tbl")
+    data_dir = os.path.join(root, "data")
+    meta_dir = os.path.join(root, "metadata")
+    os.makedirs(data_dir)
+    os.makedirs(meta_dir)
+
+    f_old = os.path.join(data_dir, "old.parquet")
+    pq.write_table(pa.table({"id": pa.array([1, 2], pa.int64()),
+                             "v": pa.array([1.0, 2.0]),
+                             "cat": pa.array(["c", "d"])}), f_old)
+    ed = os.path.join(data_dir, "eq-del.parquet")
+    pq.write_table(pa.table({"cat": pa.array(["c"])}), ed)
+    f_new = os.path.join(data_dir, "new.parquet")  # re-insert of 'c'
+    pq.write_table(pa.table({"id": pa.array([3], pa.int64()),
+                             "v": pa.array([3.0]),
+                             "cat": pa.array(["c"])}), f_new)
+
+    def entry(path, content, seq, eq_ids=None):
+        return {"status": 1, "snapshot_id": seq, "sequence_number": seq,
+                "data_file": {
+                    "content": content, "file_path": path,
+                    "file_format": "PARQUET", "record_count": 1,
+                    "file_size_in_bytes": os.path.getsize(path),
+                    "equality_ids": eq_ids}}
+
+    entries = [entry(f_old, 0, 1),
+               entry(ed, 2, 2, eq_ids=[3]),
+               entry(f_new, 0, 3)]
+    mpath = os.path.join(meta_dir, "manifest-1.avro")
+    write_avro_records(SEQ_ENTRY_SCHEMA, entries, mpath)
+    lpath = os.path.join(meta_dir, "snap-1.avro")
+    write_avro_records(SEQ_LIST_SCHEMA, [{
+        "manifest_path": mpath, "manifest_length": os.path.getsize(mpath),
+        "partition_spec_id": 0, "content": 0, "sequence_number": 3,
+        "added_snapshot_id": 3}], lpath)
+    meta = {"format-version": 2, "table-uuid": "0001", "location": root,
+            "current-snapshot-id": 3,
+            "schemas": [ICEBERG_SCHEMA], "current-schema-id": 0,
+            "snapshots": [{"snapshot-id": 3, "manifest-list": lpath,
+                           "timestamp-ms": 1700000000003}]}
+    with open(os.path.join(meta_dir, "v1.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(meta_dir, "version-hint.text"), "w") as f:
+        f.write("1")
+
+    t = read_iceberg(root)
+    # id=1 (cat 'c', seq 1 < delete seq 2) dropped; id=2 kept;
+    # id=3 (re-inserted at seq 3, NOT < 2) must survive.
+    assert sorted(t.column("id").to_pylist()) == [2, 3]
+
+
 def test_iceberg_disabled_conf_falls_back(iceberg_table):
     from spark_rapids_tpu.session import TpuSession
     root, _, _ = iceberg_table

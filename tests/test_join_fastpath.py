@@ -1,0 +1,260 @@
+"""Sync-free join fast paths (round-3 perf work).
+
+- probe-aligned joins when the build side's keys are unique (exact scan
+  statistics / group-by structure): ops/join.py probe_aligned
+- single-lane semi/anti matched flags without pair expansion
+- scalar-subquery cross joins (static_row_count == 1)
+- static uniqueness inference (PlanNode.keys_unique)
+
+Every path is validated against the same queries on the slow/sized path
+(uniqueness knowledge stripped) and against a pyarrow oracle.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.exec.join import CrossJoinExec, HashJoinExec
+from spark_rapids_tpu.exec.plan import (ExecContext, FilterExec,
+                                        HashAggregateExec, HostScanExec,
+                                        ProjectExec, SortExec)
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.aggregates import Count, Sum
+
+
+def _scan(d, chunk=None):
+    return HostScanExec.from_table(pa.table(d), chunk)
+
+
+def _dim():
+    return _scan({"k": pa.array([1, 2, 3, 5, 8], pa.int64()),
+                  "name": pa.array(["a", "b", "c", "d", "e"])})
+
+
+def _fact():
+    return _scan({"fk": pa.array([1, 1, 2, 5, 9, 8, 8, 8], pa.int64()),
+                  "v": pa.array([10., 11., 20., 50., 90., 80., 81., 82.])})
+
+
+class TestKeysUnique:
+    def test_scan_statistics(self):
+        dim = _dim()
+        assert dim.keys_unique(["k"])
+        assert not _fact().keys_unique(["fk"])
+        assert not dim.keys_unique(["missing"])
+        assert not dim.keys_unique([])
+
+    def test_scan_multi_key(self):
+        s = _scan({"a": pa.array([1, 1, 2]), "b": pa.array([1, 2, 1])})
+        assert s.keys_unique(["a", "b"])
+        assert not s.keys_unique(["a"])
+
+    def test_nulls_do_not_break_uniqueness(self):
+        # null keys never match — several nulls still count as unique
+        s = _scan({"k": pa.array([1, None, None, 2], pa.int64())})
+        assert s.keys_unique(["k"])
+
+    def test_filter_sort_project_preserve(self):
+        dim = _dim()
+        f = FilterExec(E.GreaterThan(E.ColumnRef("k"), E.Literal(1)), dim)
+        assert f.keys_unique(["k"])
+        s = SortExec([(0, True, True)], f)
+        assert s.keys_unique(["k"])
+        p = ProjectExec([E.ColumnRef("k"), E.ColumnRef("name")],
+                        ["kk", "nm"], s)
+        assert p.keys_unique(["kk"])
+        # computed expressions don't map to a source column
+        p2 = ProjectExec([E.Add(E.ColumnRef("k"), E.Literal(1))], ["k1"], s)
+        assert not p2.keys_unique(["k1"])
+        # a genuinely non-unique column stays non-unique through project
+        dup = _scan({"d": pa.array([1, 1, 2], pa.int64())})
+        pd = ProjectExec([E.ColumnRef("d")], ["dd"], dup)
+        assert not pd.keys_unique(["dd"])
+
+    def test_groupby_keys_unique(self):
+        agg = HashAggregateExec([E.ColumnRef("fk")], ["fk"],
+                                [(Sum(E.ColumnRef("v")), "sv")], _fact())
+        assert agg.keys_unique(["fk"])
+        assert agg.keys_unique(["fk", "sv"])
+        assert not agg.keys_unique(["sv"])
+
+    def test_global_agg_static_row_count(self):
+        agg = HashAggregateExec([], [], [(Sum(E.ColumnRef("v")), "sv")],
+                                _fact())
+        assert agg.static_row_count() == 1
+        p = ProjectExec([E.ColumnRef("sv")], ["total"], agg)
+        assert p.static_row_count() == 1
+
+    def test_join_propagates_uniqueness(self):
+        j = HashJoinExec("inner", [E.ColumnRef("fk")], [E.ColumnRef("k")],
+                         _fact(), _dim())
+        # fact keys stay non-unique; a unique left input would stay unique
+        assert not j.keys_unique(["fk"])
+        j2 = HashJoinExec("inner", [E.ColumnRef("k")], [E.ColumnRef("k2")],
+                          _dim(),
+                          _scan({"k2": pa.array([1, 2, 3], pa.int64())}))
+        assert j2.keys_unique(["k"])
+
+
+def _join_oracle(jt):
+    """pyarrow oracle for fact-join-dim on fk == k."""
+    fact = pa.table({"fk": [1, 1, 2, 5, 9, 8, 8, 8],
+                     "v": [10., 11., 20., 50., 90., 80., 81., 82.]})
+    dim = pa.table({"k": [1, 2, 3, 5, 8],
+                    "name": ["a", "b", "c", "d", "e"]})
+    return fact.join(dim, keys=["fk"], right_keys=["k"],
+                     join_type=jt, right_suffix="_r")
+
+
+@pytest.mark.parametrize("jt", ["inner", "left_outer", "left_semi",
+                                "left_anti", "full_outer", "right_outer"])
+def test_aligned_matches_sized_path(jt):
+    """The unique-build aligned path and the generic sized path agree."""
+    ctx = ExecContext()
+    fast = HashJoinExec(jt, [E.ColumnRef("fk")], [E.ColumnRef("k")],
+                        _fact(), _dim())
+    assert fast._build_unique()
+    out_fast = fast.collect(ctx)
+    assert ctx.metrics.get("join_aligned_fastpath", 0) >= 1 or \
+        jt in ("left_semi", "left_anti")
+
+    # strip the statistics -> generic path
+    dim_nostat = HostScanExec(_dim().batches, _dim().output_schema)
+    slow = HashJoinExec(jt, [E.ColumnRef("fk")], [E.ColumnRef("k")],
+                        _fact(), dim_nostat)
+    assert not slow._build_unique()
+    out_slow = slow.collect()
+
+    def rows(tbl):
+        cols = [tbl.column(n).to_pylist() for n in tbl.schema.names]
+        return sorted(zip(*cols), key=repr)
+    assert rows(out_fast) == rows(out_slow)
+
+
+def test_aligned_inner_against_pyarrow():
+    out = HashJoinExec("inner", [E.ColumnRef("fk")], [E.ColumnRef("k")],
+                       _fact(), _dim()).collect()
+    got = sorted(zip(out.column("fk").to_pylist(),
+                     out.column("v").to_pylist(),
+                     out.column("name").to_pylist()))
+    ora = _join_oracle("inner")
+    exp = sorted(zip(ora.column("fk").to_pylist(),
+                     ora.column("v").to_pylist(),
+                     ora.column("name").to_pylist()))
+    assert got == exp
+
+
+def test_aligned_with_filtered_probe_lazy_counts():
+    """Probe comes through a filter (lazy num_rows) — still correct and
+    still aligned."""
+    fact = FilterExec(E.GreaterThan(E.ColumnRef("v"), E.Literal(15.0)),
+                      _fact())
+    ctx = ExecContext()
+    out = HashJoinExec("inner", [E.ColumnRef("fk")], [E.ColumnRef("k")],
+                       fact, _dim()).collect(ctx)
+    assert ctx.metrics.get("join_aligned_fastpath") == 1
+    got = sorted(zip(out.column("fk").to_pylist(),
+                     out.column("name").to_pylist()))
+    assert got == [(2, "b"), (5, "d"), (8, "e"), (8, "e"), (8, "e")]
+
+
+def test_semi_anti_single_lane_no_expansion():
+    for jt, exp in [("left_semi", [1, 1, 2, 5, 8, 8, 8]),
+                    ("left_anti", [9])]:
+        out = HashJoinExec(jt, [E.ColumnRef("fk")], [E.ColumnRef("k")],
+                           _fact(),
+                           # non-unique build: the lazy matched flag must
+                           # not depend on uniqueness
+                           _scan({"k": pa.array([1, 2, 3, 5, 8, 8],
+                                                pa.int64())})).collect()
+        assert sorted(out.column("fk").to_pylist()) == exp
+
+
+def test_cross_join_scalar_subquery_fast_path():
+    """HAVING-against-total shape: cross join vs a global aggregate."""
+    fact = _fact()
+    total = HashAggregateExec([], [], [(Sum(E.ColumnRef("v")), "tv")],
+                              _fact())
+    cross = CrossJoinExec(fact, total)
+    out = cross.collect()
+    assert out.num_rows == 8
+    assert set(out.column("tv").to_pylist()) == {sum(
+        [10., 11., 20., 50., 90., 80., 81., 82.])}
+
+
+def test_aligned_join_string_build_keys():
+    """Dictionary (string) build keys still work on the aligned path."""
+    dim = _scan({"s": pa.array(["x", "y", "z"]),
+                 "m": pa.array([1, 2, 3], pa.int64())})
+    fact = _scan({"s": pa.array(["y", "x", "q", "y"]),
+                  "v": pa.array([1., 2., 3., 4.])})
+    ctx = ExecContext()
+    j = HashJoinExec("inner", [E.ColumnRef("s")], [E.ColumnRef("s")],
+                     fact, dim)
+    assert j._build_unique()
+    out = j.collect(ctx)
+    got = sorted(zip(out.column("v").to_pylist(),
+                     out.column("m").to_pylist()))
+    assert got == [(1.0, 2), (2.0, 1), (4.0, 2)]
+
+
+def test_aligned_null_keys_never_match():
+    dim = _scan({"k": pa.array([1, None, 2], pa.int64()),
+                 "m": pa.array([10, 99, 20], pa.int64())})
+    fact = _scan({"k": pa.array([1, None, 3], pa.int64()),
+                  "v": pa.array([1., 2., 3.])})
+    out = HashJoinExec("left_outer", [E.ColumnRef("k")],
+                       [E.ColumnRef("k")], fact, dim).collect()
+    rows = dict(zip(out.column("v").to_pylist(),
+                    out.column("m").to_pylist()))
+    assert rows == {1.0: 10, 2.0: None, 3.0: None}
+
+
+def test_multi_key_join_never_aligned():
+    """Composite (multi-lane) keys must use the range-scanning sized path:
+    the aligned single-slot probe could miss a match under a composite-
+    hash collision between distinct build tuples."""
+    dim = _scan({"a": pa.array([1, 1, 2], pa.int64()),
+                 "b": pa.array([1, 2, 1], pa.int64()),
+                 "m": pa.array([10, 11, 12], pa.int64())})
+    fact = _scan({"a": pa.array([1, 2, 1], pa.int64()),
+                  "b": pa.array([2, 1, 9], pa.int64()),
+                  "v": pa.array([1., 2., 3.])})
+    ctx = ExecContext()
+    j = HashJoinExec("inner",
+                     [E.ColumnRef("a"), E.ColumnRef("b")],
+                     [E.ColumnRef("a"), E.ColumnRef("b")], fact, dim)
+    assert j._build_unique()          # the pair IS unique...
+    out = j.collect(ctx)
+    # ...but the aligned fast path must NOT engage (multi-lane)
+    assert "join_aligned_fastpath" not in ctx.metrics
+    assert sorted(zip(out.column("v").to_pylist(),
+                      out.column("m").to_pylist())) == [(1.0, 11),
+                                                        (2.0, 12)]
+
+
+def test_limit_lazy_path_shrinks_capacity():
+    """LIMIT over one big batch must not ship the full input capacity to
+    host: the lazy path slices lanes down to the limit's bucket."""
+    from spark_rapids_tpu.exec.plan import LocalLimitExec
+    n = 200_000
+    scan = _scan({"x": pa.array(np.arange(n), pa.int64())})
+    lim = LocalLimitExec(7, scan)
+    ctx = ExecContext()
+    outs = list(lim.execute(ctx))
+    assert len(outs) == 1
+    assert outs[0].capacity < n        # sliced, not full input capacity
+    tbl = lim.collect()
+    assert tbl.column("x").to_pylist() == list(range(7))
+
+
+def test_topn_output_capacity_bounded():
+    from spark_rapids_tpu.exec.plan import TopNExec
+    n = 100_000
+    scan = HostScanExec.from_table(
+        pa.table({"x": pa.array(np.random.default_rng(0).permutation(n))}),
+        max_rows=30_000)   # multi-batch stream
+    top = TopNExec(5, [(0, True, True)], scan)
+    outs = list(top.execute(ExecContext()))
+    assert len(outs) == 1
+    assert outs[0].capacity <= 1024    # bucket_capacity(5) at defaults
+    assert top.collect().column("x").to_pylist() == [0, 1, 2, 3, 4]

@@ -149,7 +149,7 @@ def test_unknown_op_and_expr_raise_protocol_error():
 
 def test_worker_end_to_end():
     t0, t1 = _mini_tables()
-    with PlanWorker() as w, WorkerClient(w.address) as c:
+    with PlanWorker() as w, WorkerClient(w.address, w.token) as c:
         pong = c.ping()
         assert pong["version"] == 1
 
@@ -171,7 +171,7 @@ def test_worker_end_to_end():
 
 
 def test_worker_error_reply_keeps_connection_usable():
-    with PlanWorker() as w, WorkerClient(w.address) as c:
+    with PlanWorker() as w, WorkerClient(w.address, w.token) as c:
         from spark_rapids_tpu.plugin.client import WorkerError
         with pytest.raises(WorkerError, match="unknown plan op"):
             c.execute({"op": "Exotic"}, {})
@@ -180,7 +180,7 @@ def test_worker_error_reply_keeps_connection_usable():
 
 def test_worker_multiple_sequential_queries():
     t0, t1 = _mini_tables()
-    with PlanWorker() as w, WorkerClient(w.address) as c:
+    with PlanWorker() as w, WorkerClient(w.address, w.token) as c:
         for _ in range(3):
             out, _m = c.execute(
                 {"op": "Limit", "n": 5,
@@ -202,7 +202,7 @@ def test_dataframe_plan_ships_to_worker():
     wire = plan_to_json(df._plan, tables)
     assert sorted(tables) == ["t0", "t1"]
     local = df.collect()
-    with PlanWorker() as w, WorkerClient(w.address) as c:
+    with PlanWorker() as w, WorkerClient(w.address, w.token) as c:
         remote, _ = c.execute(wire, tables)
     assert remote.to_pydict() == local.to_pydict()
 
@@ -227,7 +227,7 @@ def test_error_mid_request_does_not_desync_connection():
     drain the Arrow frames before erroring, or the long-lived connection
     misparses them as the next JSON header."""
     t0, _ = _mini_tables()
-    with PlanWorker() as w, WorkerClient(w.address) as c:
+    with PlanWorker() as w, WorkerClient(w.address, w.token) as c:
         from spark_rapids_tpu.plugin.client import WorkerError
         with pytest.raises(WorkerError, match="unknown request type"):
             c._send_request("exotic", {"op": "Scan", "table": "t0"},
@@ -238,3 +238,19 @@ def test_error_mid_request_does_not_desync_connection():
             {"op": "Limit", "n": 3, "child": {"op": "Scan", "table": "t0"}},
             {"t0": t0})
         assert out.num_rows == 3
+
+
+def test_unauthenticated_connection_rejected():
+    """A peer that doesn't present the worker's token gets dropped
+    before any plan or Arrow frame is parsed."""
+    with PlanWorker() as w:
+        with pytest.raises(Exception):
+            with WorkerClient(w.address, "wrong-token") as c:
+                c.ping()
+        # no token at all
+        with pytest.raises(Exception):
+            with WorkerClient(w.address) as c:
+                c.ping()
+        # the right token still works
+        with WorkerClient(w.address, w.token) as c:
+            assert c.ping()["type"] == "pong"
