@@ -112,6 +112,16 @@ BATCH_SIZE_BYTES = conf(
     "Target maximum bytes per device batch when coalescing host batches.",
     checker=_positive)
 
+WHOLE_PLAN_COMPILE = conf(
+    "spark.rapids.tpu.sql.compile.wholePlan", "AUTO",
+    "Compile an entire device plan into ONE XLA program (tracing is the "
+    "whole-plan analogue of the reference's cudf AST compiled "
+    "expressions). AUTO enables it on the TPU backend only (CPU test "
+    "meshes keep the eager batch engine); ON/OFF force it. Plans that "
+    "need host-side decisions (sized join expansion, out-of-core sort) "
+    "automatically fall back to the eager engine.",
+    checker=_enum_checker("AUTO", "ON", "OFF"), commonly_used=True)
+
 CONCURRENT_TPU_TASKS = conf(
     "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
     "Number of concurrent tasks allowed to hold device memory at once "

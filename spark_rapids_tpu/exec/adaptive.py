@@ -52,6 +52,10 @@ class _ReplayStage(PlanNode):
         # replay preserves exactly the source's rows
         return self._source is not None and self._source.keys_unique(names)
 
+    def column_range(self, name):
+        return None if self._source is None \
+            else self._source.column_range(name)
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for sp in self.batches:
             yield sp.get()
@@ -123,24 +127,21 @@ class AdaptiveShuffledJoinExec(PlanNode):
             return t.StructType(lf)
         return t.StructType(lf + list(self.right.output_schema.fields))
 
-    def keys_unique(self, names):
+    @staticmethod
+    def _side_unique(keys, side) -> bool:
         from .join import key_ref_names
+        kn = key_ref_names(keys)
+        return kn is not None and side.keys_unique(kn)
 
-        def side_unique(keys, side):
-            kn = key_ref_names(keys)
-            return kn is not None and side.keys_unique(kn)
+    def keys_unique(self, names):
+        from .join import join_keys_unique
+        return join_keys_unique(self.join_type, self.left, self.right,
+                                self.left_keys, self.right_keys, names)
 
-        left_names = set(self.left.output_schema.names)
-        if self.join_type in ("left_semi", "left_anti"):
-            return self.left.keys_unique(names)
-        if all(n in left_names for n in names):
-            return self.left.keys_unique(names) and \
-                side_unique(self.right_keys, self.right)
-        right_names = set(self.right.output_schema.names)
-        if all(n in right_names for n in names):
-            return self.right.keys_unique(names) and \
-                side_unique(self.left_keys, self.left)
-        return False
+    def column_range(self, name):
+        from .join import join_column_range
+        return join_column_range(self.join_type, self.left, self.right,
+                                 name)
 
     def _materialize(self, node: PlanNode, ctx: ExecContext
                      ) -> List[Spillable]:
@@ -160,6 +161,17 @@ class AdaptiveShuffledJoinExec(PlanNode):
             ctx.metrics["adaptive_left_bytes"] = lbytes
             ctx.metrics["adaptive_right_bytes"] = rbytes
             swap = (self.join_type in _MIRROR) and lbytes < rbytes
+            if self.join_type in _MIRROR:
+                # A UNIQUE-keyed build side unlocks the sync-free aligned
+                # probe (exec/join.py) — worth more than raw size unless
+                # the unique side is dramatically bigger (8x guard).
+                run_u = self._side_unique(self.right_keys, self.right)
+                lun_u = self._side_unique(self.left_keys, self.left)
+                if run_u != lun_u:
+                    if lun_u and lbytes <= 8 * max(rbytes, 1):
+                        swap = True
+                    elif run_u and rbytes <= 8 * max(lbytes, 1):
+                        swap = False
             if swap:
                 ctx.bump("adaptive_join_mirrored")
                 jt = _MIRROR[self.join_type]

@@ -52,7 +52,12 @@ def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
         tuple(c.data for c in agg_cols),
         tuple(c.validity for c in agg_cols),
         live)
-    return key_cols, out_keys, outs, int(num_groups)
+    # concrete (eager) group counts coerce to host as before — shrinking
+    # to the real bucket keeps downstream sorts small; under whole-plan
+    # tracing the count is a Tracer and must stay on device
+    if not isinstance(num_groups, jax.core.Tracer):
+        num_groups = int(num_groups)
+    return key_cols, out_keys, outs, num_groups
 
 
 def _run_reduce(agg_cols: List[DeviceColumn], specs: List[G.AggSpec],
@@ -215,7 +220,8 @@ class HashAggregate:
             fn = jax.jit(run)
             _JIT_CACHE[key] = fn
 
-        out_keys, outs, ng = fn(tuple(c.data for c in db.columns),
+        from .evaluator import _col_lanes
+        out_keys, outs, ng = fn(_col_lanes(db),
                                 tuple(c.validity for c in db.columns),
                                 _num_rows_scalar(db.num_rows), aux)
         if not self.key_exprs:
@@ -227,7 +233,9 @@ class HashAggregate:
             key_cols.append(DeviceColumn(
                 jnp.zeros((0,)), jnp.zeros((0,), bool), e.dtype,
                 hv.dictionary))
-        return self._groupby_outs_to_batch(key_cols, out_keys, outs, int(ng))
+        if not isinstance(ng, jax.core.Tracer):
+            ng = int(ng)
+        return self._groupby_outs_to_batch(key_cols, out_keys, outs, ng)
 
     def merge_raw(self, partial_outs: List[List]) -> List:
         """Merge per-batch global-agg scalar outputs into final buffer
@@ -331,6 +339,23 @@ class HashAggregate:
     def _buffer_names(self):
         return [f"_buf{i}" for i in range(len(self.update_specs))]
 
+    def _static_group_bound(self, key_cols) -> "Optional[int]":
+        """Upper bound on group count from key-domain sizes (dictionary
+        lengths, bool), when every key has a bounded domain.  +1 per key
+        for the null group.  Lets the output shrink to a tiny bucket with
+        NO host sync — the group count itself can stay on device."""
+        bound = 1
+        for kc in key_cols:
+            if kc.dictionary is not None:
+                bound *= len(kc.dictionary) + 1
+            elif isinstance(kc.dtype, t.BooleanType):
+                bound *= 3
+            else:
+                return None
+            if bound > (1 << 22):
+                return None
+        return bound
+
     def _groupby_outs_to_batch(self, key_cols, out_keys, outs, n_groups):
         cols = []
         for (kd, kv), kc in zip(out_keys, key_cols):
@@ -341,7 +366,15 @@ class HashAggregate:
             cols.append(DeviceColumn(data.astype(_storage_zeros(
                 spec.dtype, 1).dtype), valid, spec.dtype))
         db = DeviceBatch(cols, n_groups, self.key_names + self._buffer_names())
-        return shrink_to_rows(db, n_groups, self.conf)
+        if isinstance(n_groups, int):
+            return shrink_to_rows(db, n_groups, self.conf)
+        # lazy group count: shrink by the static key-domain bound instead
+        # of syncing (whole-plan tracing / tunnel-latency paths)
+        bound = self._static_group_bound(key_cols)
+        if bound is not None:
+            from ..ops.batch_ops import shrink_to_capacity
+            return shrink_to_capacity(db, bound, self.conf)
+        return db
 
     def _reduce_outs_to_batch(self, outs) -> DeviceBatch:
         from ..columnar.device import bucket_capacity

@@ -1,0 +1,251 @@
+"""Whole-plan XLA compilation: one jit program per query.
+
+The reference dispatches one cuDF kernel launch per operator step; launch
+latency is ~free on a locally attached GPU.  On TPU the idiomatic shape
+is the opposite: **trace the entire physical plan once and hand XLA a
+single program** — operators fuse (filter masks into projections into
+segment-reductions), intermediate lanes never round-trip through HBM
+twice, and a warm query is ONE dispatch + ONE result fetch regardless of
+plan depth.  This is the "cudf AST compiled expressions" idea
+(GpuExpressions.scala convertToAst / ast.CompiledExpression) taken to its
+XLA-native conclusion: tracing IS the AST, for the whole plan rather than
+one expression.
+
+How it works:
+  * Leaf `HostScanExec`s upload their batches once (cached on the node —
+    the buffer-cache / spill-framework role for hot inputs).
+  * `jax.jit(run)` traces `root.execute(ctx)` — the ordinary operator
+    generators — over placeholder arrays standing in for every leaf lane.
+    All sync-free paths (probe-aligned joins, lazy filters/limits,
+    segment aggregations, single-batch sorts) trace cleanly because they
+    never coerce a device value on host.
+  * Output batch *structure* (schema, capacities, dictionaries) is
+    recorded at trace time; the compiled call returns flat lanes that are
+    re-wrapped as DeviceBatches / fetched in one `jax.device_get`.
+  * Anything that genuinely needs a host decision (sized join expansion,
+    out-of-core sort, retry machinery) raises a tracer-concretization
+    error — the caller falls back to the eager batch-at-a-time engine,
+    which remains the out-of-core/general path.
+
+Compile cost is paid once per (plan shape, input bucket) and is
+persisted by jax's compilation cache; warm latency is what the
+benchmark measures (BASELINE.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import pyarrow as pa
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, DeviceColumn, to_device
+from ..config import TpuConf
+from .plan import ExecContext, HostScanExec, PlanNode
+
+
+def _find_scans(root: PlanNode) -> List[HostScanExec]:
+    out = []
+    seen = set()
+
+    def walk(n: PlanNode):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        if isinstance(n, HostScanExec):
+            out.append(n)
+        for c in n.children:
+            walk(c)
+    walk(root)
+    return out
+
+
+def _flatten_batch(db: DeviceBatch):
+    """-> (arrays, spec) where spec rebuilds the batch from arrays."""
+    arrays = []
+    cols = []
+    for c in db.columns:
+        arrays.append(c.data)
+        arrays.append(c.validity)
+        if c.data_hi is not None:
+            arrays.append(c.data_hi)
+        cols.append((c.dtype, c.dictionary, c.data_hi is not None))
+    static_rows = db.num_rows if isinstance(db.num_rows, int) else None
+    if static_rows is None:
+        arrays.append(db.num_rows)
+    return arrays, (cols, list(db.names), static_rows, db.origin_file)
+
+
+def _rebuild_batch(arrays, spec, i: int) -> Tuple[DeviceBatch, int]:
+    cols_spec, names, static_rows, origin = spec
+    cols = []
+    for dtype, dictionary, has_hi in cols_spec:
+        data = arrays[i]
+        valid = arrays[i + 1]
+        i += 2
+        hi = None
+        if has_hi:
+            hi = arrays[i]
+            i += 1
+        cols.append(DeviceColumn(data, valid, dtype, dictionary, hi))
+    if static_rows is None:
+        num_rows = arrays[i]
+        i += 1
+    else:
+        num_rows = static_rows
+    return DeviceBatch(cols, num_rows, names, origin), i
+
+
+class CompiledPlan:
+    """A traced-and-jitted device plan bound to its leaf scans."""
+
+    def __init__(self, root: PlanNode, conf: TpuConf):
+        self.root = root
+        self.conf = conf
+        self._out_specs: Optional[list] = None
+        self._compiled = None
+        self._input_specs = None
+
+    # -- leaves ------------------------------------------------------------
+    def _leaf_batches(self, ctx: ExecContext
+                      ) -> List[Tuple[HostScanExec, List[DeviceBatch]]]:
+        pairs = []
+        for node in _find_scans(self.root):
+            cached = getattr(node, "_device_cache", None)
+            if cached is None:
+                cached = [to_device(hb, ctx.conf) for hb in node.batches]
+                node._device_cache = cached
+            pairs.append((node, cached))
+        return pairs
+
+    # -- compile + run -----------------------------------------------------
+    def execute(self, ctx: ExecContext) -> List[DeviceBatch]:
+        """Run the whole plan as one XLA program; returns device batches.
+
+        Raises jax tracer errors (ConcretizationTypeError & friends) when
+        the plan needs host decisions — callers fall back to eager."""
+        pairs = self._leaf_batches(ctx)
+        flat_in: List[jax.Array] = []
+        in_specs = []
+        for node, dbs in pairs:
+            node_specs = []
+            for db in dbs:
+                arrays, spec = _flatten_batch(db)
+                flat_in.extend(arrays)
+                node_specs.append(spec)
+            in_specs.append((node, node_specs))
+
+        if self._compiled is None:
+            self._input_specs = [(n, list(s)) for n, s in in_specs]
+            out_holder: Dict[str, list] = {}
+
+            def run(flat):
+                # rebuild leaf batches from traced arrays and install them
+                i = 0
+                for node, node_specs in in_specs:
+                    batches = []
+                    for spec in node_specs:
+                        db, i = _rebuild_batch(flat, spec, i)
+                        batches.append(db)
+                    node._trace_batches = batches
+                try:
+                    trace_ctx = _trace_context(ctx)
+                    outs = list(self.root.execute(trace_ctx))
+                finally:
+                    for node, _ in in_specs:
+                        node._trace_batches = None
+                    # copy ONLY host numbers back: a traced metric value
+                    # escaping the jit would be a leaked tracer
+                    for k, v in trace_ctx.metrics.items():
+                        if isinstance(v, (int, float)):
+                            ctx.metrics[k] = v
+                flat_out = []
+                specs = []
+                for db in outs:
+                    arrays, spec = _flatten_batch(db)
+                    flat_out.extend(arrays)
+                    specs.append(spec)
+                out_holder["specs"] = specs
+                return flat_out
+
+            compiled = jax.jit(run)
+            flat_res = compiled(flat_in)         # traces on first call
+            self._out_specs = out_holder["specs"]
+            self._compiled = compiled
+        else:
+            flat_res = self._compiled(flat_in)
+
+        outs = []
+        i = 0
+        for spec in self._out_specs:
+            db, i = _rebuild_batch(flat_res, spec, i)
+            outs.append(db)
+        return outs
+
+    def collect(self, ctx: ExecContext) -> pa.Table:
+        from ..columnar.device import to_host
+        from ..columnar.host import struct_to_schema
+        outs = self.execute(ctx)
+        hbs = [to_host(db) for db in outs]
+        batches = [hb.rb for hb in hbs if hb.num_rows > 0]
+        if not batches:
+            return pa.Table.from_batches(
+                [], struct_to_schema(self.root.output_schema))
+        return pa.Table.from_batches(batches, batches[0].schema)
+
+
+def _trace_context(ctx: ExecContext) -> ExecContext:
+    """Execution context for use UNDER tracing: unlimited budget (XLA owns
+    memory inside one program; spilling a tracer is meaningless), no
+    runtime bloom filters (their sizing needs host row counts), and a
+    PRIVATE metrics dict — device-scalar metrics recorded during tracing
+    are tracers and must never escape the jit (host numbers are copied
+    back by the caller)."""
+    from ..config import (HBM_BUDGET_BYTES, RUNTIME_FILTER_ENABLED,
+                          TEST_INJECT_RETRY_OOM)
+    raw = dict(ctx.conf._raw)
+    raw[HBM_BUDGET_BYTES.key] = 1 << 62
+    raw[RUNTIME_FILTER_ENABLED.key] = False
+    raw[TEST_INJECT_RETRY_OOM.key] = 0
+    return ExecContext(TpuConf(raw))
+
+
+# errors that mean "this plan needs host decisions" — not bugs
+_TRACE_FALLBACK_ERRORS = (
+    jax.errors.ConcretizationTypeError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.UnexpectedTracerError,
+)
+
+
+def collect_with_fallback(root: PlanNode, ctx: ExecContext,
+                          cache_on: Optional[object] = None
+                          ) -> Optional[pa.Table]:
+    """Try the whole-plan compiled path; None means 'use the eager engine'
+    (host-decision plan, or device OOM — the eager engine has the OOC
+    machinery)."""
+    holder = cache_on if cache_on is not None else root
+    plan = getattr(holder, "_compiled_plan", None)
+    if plan is False:                    # previously failed to trace
+        return None
+    if plan is None:
+        plan = CompiledPlan(root, ctx.conf)
+    try:
+        out = plan.collect(ctx)
+    except _TRACE_FALLBACK_ERRORS:
+        holder._compiled_plan = False
+        ctx.bump("whole_plan_fallbacks")
+        return None
+    except Exception as e:               # noqa: BLE001
+        from ..runtime.memory import is_oom_error
+        holder._compiled_plan = False
+        ctx.bump("whole_plan_fallbacks")
+        if is_oom_error(e):
+            return None                  # eager engine has spill/retry
+        raise
+    holder._compiled_plan = plan
+    ctx.bump("whole_plan_compiled_queries")
+    return out

@@ -45,10 +45,29 @@ class DistinctAggregateExec(PlanNode):
             fields.append(t.StructField(n, t.LONG, False))
         return t.StructType(fields)
 
+    def keys_unique(self, names) -> bool:
+        # one output row per group-key tuple
+        if not self.key_exprs:
+            return True
+        return set(self.key_names) <= set(names)
+
+    def static_row_count(self):
+        return 1 if not self.key_exprs else None
+
+    def column_range(self, name):
+        from .join import key_ref_names
+        if name not in self.key_names:
+            return None
+        ref = key_ref_names([self.key_exprs[self.key_names.index(name)]])
+        return None if ref is None else self.child.column_range(ref[0])
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         conf = ctx.conf
+        # no per-batch sync: statically-empty batches are dropped, lazy
+        # counts flow through (padding rows are masked by `live` below)
         batches = [db for db in self.child.execute(ctx)
-                   if int(db.num_rows) > 0]
+                   if not (isinstance(db.num_rows, int)
+                           and db.num_rows == 0)]
         if not batches:
             if not self.key_exprs:
                 yield self._zero_row(conf)
@@ -92,7 +111,8 @@ class DistinctAggregateExec(PlanNode):
                 tuple(c.validity for c in key_cols),
                 vcol.data, vcol.validity, live)
             if out_keys is None:
-                out_keys, n_groups = ok, int(ng)
+                out_keys = ok
+                n_groups = ng if isinstance(ng, jax.core.Tracer) else int(ng)
             for i, jj in enumerate(val_of):
                 if jj == j:
                     results[i] = (cnt, valid)
@@ -105,10 +125,14 @@ class DistinctAggregateExec(PlanNode):
             # count(DISTINCT) is never null: 0 for empty groups
             cols.append(DeviceColumn(
                 cnt, jnp.ones(cnt.shape, bool), t.LONG))
-        n_out = max(n_groups, 1) if not self.key_exprs else n_groups
-        db = DeviceBatch(cols, n_out,
-                         self.key_names + [n for _f, n in self.aggs])
-        yield shrink_to_rows(db, n_out, conf)
+        names = self.key_names + [n for _f, n in self.aggs]
+        if isinstance(n_groups, int):
+            n_out = max(n_groups, 1) if not self.key_exprs else n_groups
+            yield shrink_to_rows(DeviceBatch(cols, n_out, names), n_out,
+                                 conf)
+            return
+        n_out = jnp.maximum(n_groups, 1) if not self.key_exprs else n_groups
+        yield DeviceBatch(cols, n_out, names)
 
     def _zero_row(self, conf) -> DeviceBatch:
         from ..columnar.device import bucket_capacity

@@ -52,9 +52,13 @@ def _upload_aux(a: np.ndarray) -> jax.Array:
     cache = _SMALL_AUX_CACHE if a.nbytes <= 16 else _AUX_DEVICE_CACHE
     buf = cache.get(key)
     if buf is None:
+        buf = jnp.asarray(a)
+        if isinstance(buf, jax.core.Tracer):
+            # under whole-plan tracing the "upload" is a traced constant —
+            # caching it would leak the tracer into later eager calls
+            return buf
         if len(cache) > 4096:
             cache.clear()
-        buf = jnp.asarray(a)
         cache[key] = buf
     return buf
 
@@ -64,9 +68,11 @@ def _num_rows_scalar(num_rows) -> jax.Array:
         return num_rows.astype(jnp.int32)
     buf = _SCALAR_CACHE.get(num_rows)
     if buf is None:
+        buf = jnp.int32(num_rows)
+        if isinstance(buf, jax.core.Tracer):
+            return buf           # whole-plan tracing: never cache tracers
         if len(_SCALAR_CACHE) > 4096:
             _SCALAR_CACHE.clear()
-        buf = jnp.int32(num_rows)
         _SCALAR_CACHE[num_rows] = buf
     return buf
 
@@ -86,11 +92,22 @@ def _batch_meta(db: DeviceBatch):
     return [(n, c.dtype, c.dictionary) for n, c in zip(db.names, db.columns)]
 
 
+def _col_lanes(db: DeviceBatch):
+    """Per-column jit argument: the data lane, or (data, hi) for two-lane
+    wide-decimal host columns (pytree — jit handles the nesting)."""
+    return tuple(c.data if c.data_hi is None else (c.data, c.data_hi)
+                 for c in db.columns)
+
+
 def _build_inputs(meta, col_data, col_valid):
     inputs = {}
     raw = {}
     for (name, dtype, dictionary), d, v in zip(meta, col_data, col_valid):
-        inputs[name] = DevVal(compute_view(d, dtype), v, dtype, dictionary)
+        hi = None
+        if isinstance(d, tuple):
+            d, hi = d
+        inputs[name] = DevVal(compute_view(d, dtype), v, dtype, dictionary,
+                              hi)
         raw[name] = d          # storage lane (f64-bits stay int64)
     return inputs, raw
 
@@ -140,18 +157,20 @@ def evaluate_projection(exprs: Sequence[Expression], names: Sequence[str],
                 data = storage_view(dv.data, e.dtype)
                 valid = dv.validity if dv.validity is not None \
                     else jnp.ones((capacity,), bool)
-                outs.append((data, valid & live))
+                # two-lane wide decimals keep their hi lane through the
+                # projection (dropping it would corrupt |values| >= 2^63)
+                outs.append((data, valid & live, dv.hi))
             return outs
 
         fn = jax.jit(run)
         _JIT_CACHE[key] = fn
 
-    col_data = tuple(c.data for c in db.columns)
+    col_data = _col_lanes(db)
     col_valid = tuple(c.validity for c in db.columns)
     outs = fn(col_data, col_valid, _num_rows_scalar(db.num_rows), aux)
     cols = []
-    for (data, valid), e, hv in zip(outs, exprs, hostvals):
-        cols.append(DeviceColumn(data, valid, e.dtype, hv.dictionary))
+    for (data, valid, hi), e, hv in zip(outs, exprs, hostvals):
+        cols.append(DeviceColumn(data, valid, e.dtype, hv.dictionary, hi))
     return DeviceBatch(cols, db.num_rows, list(names), db.origin_file)
 
 
@@ -178,8 +197,7 @@ def compute_predicate(cond: Expression, db: DeviceBatch,
 
         fn = jax.jit(run)
         _JIT_CACHE[key] = fn
-    return fn(tuple(c.data for c in db.columns),
-              tuple(c.validity for c in db.columns),
+    return fn(_col_lanes(db), tuple(c.validity for c in db.columns),
               _num_rows_scalar(db.num_rows), aux)
 
 

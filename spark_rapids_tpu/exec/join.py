@@ -51,6 +51,39 @@ def key_ref_names(exprs) -> Optional[List[str]]:
     return names
 
 
+def join_keys_unique(join_type: str, left, right, left_keys, right_keys,
+                     names) -> bool:
+    """Shared statistics-propagation rule for equi-join operators
+    (HashJoinExec and the adaptive planner wrap the same semantics):
+    semi/anti keep a subset of left rows; otherwise a side's columns stay
+    unique iff that side was unique AND the other side's join keys are
+    unique (each row matched at most once)."""
+    def side_unique(keys, side):
+        kn = key_ref_names(keys)
+        return kn is not None and side.keys_unique(kn)
+
+    if join_type in (J.LEFT_SEMI, J.LEFT_ANTI):
+        return left.keys_unique(names)
+    left_names = set(left.output_schema.names)
+    if all(n in left_names for n in names):
+        return left.keys_unique(names) and side_unique(right_keys, right)
+    right_names = set(right.output_schema.names)
+    if all(n in right_names for n in names):
+        return right.keys_unique(names) and side_unique(left_keys, left)
+    return False
+
+
+def join_column_range(join_type: str, left, right, name):
+    """Shared value-range propagation: joins gather existing rows, so a
+    column's range only narrows (outer-join nulls are not values)."""
+    if name in left.output_schema.names:
+        return left.column_range(name)
+    if join_type not in (J.LEFT_SEMI, J.LEFT_ANTI) and \
+            name in right.output_schema.names:
+        return right.column_range(name)
+    return None
+
+
 def _join_partition_ids(key_cols: List[DeviceColumn], db: DeviceBatch,
                         num_buckets: int) -> jax.Array:
     """Bucket ids from join-key columns; value-stable across sides and
@@ -133,17 +166,8 @@ class HashJoinExec(PlanNode):
         return cols
 
     def keys_unique(self, names: Sequence[str]) -> bool:
-        left_names = set(self.left.output_schema.names)
-        if self.join_type in (J.LEFT_SEMI, J.LEFT_ANTI):
-            return self.left.keys_unique(names)      # subset of left rows
-        if all(n in left_names for n in names):
-            # each probe row appears at most once iff the build side is
-            # unique in its join keys
-            return self.left.keys_unique(names) and self._build_unique()
-        right_names = set(self.right.output_schema.names)
-        if all(n in right_names for n in names):
-            return self.right.keys_unique(names) and self._probe_unique()
-        return False
+        return join_keys_unique(self.join_type, self.left, self.right,
+                                self.left_keys, self.right_keys, names)
 
     def _build_unique(self) -> bool:
         names = key_ref_names(self.right_keys)
@@ -152,6 +176,52 @@ class HashJoinExec(PlanNode):
     def _probe_unique(self) -> bool:
         names = key_ref_names(self.left_keys)
         return names is not None and self.left.keys_unique(names)
+
+    def column_range(self, name: str):
+        return join_column_range(self.join_type, self.left, self.right,
+                                 name)
+
+    def _range_pack_spec(self):
+        """[(lo, stride)] per key column when the composite key can fold
+        into ONE injective int64 lane from exact column-range statistics
+        (min/max over BOTH sides), else None.  Gives multi-column joins
+        the exact single-lane probe paths (no composite-hash collisions,
+        no sizing sync)."""
+        ln = key_ref_names(self.left_keys)
+        rn = key_ref_names(self.right_keys)
+        if ln is None or rn is None or len(ln) < 2:
+            return None
+        spans = []
+        for l, r in zip(ln, rn):
+            lr = self.left.column_range(l)
+            rr = self.right.column_range(r)
+            if lr is None or rr is None:
+                return None
+            lo = min(lr[0], rr[0])
+            hi = max(lr[1], rr[1])
+            spans.append((lo, hi - lo + 1))
+        total = 1
+        for _lo, span in spans:
+            total *= span
+            if total >= (1 << 62):
+                return None
+        spec = []
+        stride = 1
+        for lo, span in reversed(spans):
+            spec.append((lo, stride))
+            stride *= span
+        spec.reverse()
+        return spec
+
+    @staticmethod
+    def _packed_lane(key_cols, spec) -> jax.Array:
+        """Fold per-column int64 canonical lanes into the packed lane."""
+        packed = None
+        for c, (lo, stride) in zip(key_cols, spec):
+            lane = c.data.astype(jnp.int64)
+            part = (lane - jnp.int64(lo)) * jnp.int64(stride)
+            packed = part if packed is None else packed + part
+        return packed
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         # ---- build (right side), fully materialized ----
@@ -265,7 +335,12 @@ class HashJoinExec(PlanNode):
         for i, s in enumerate(has_str):
             if s:
                 build_keys[i] = ensure_unique_dict(build_keys[i])
-        build = J.BuildTable(build_batch, build_keys)
+        # Composite keys with exact range statistics fold into one
+        # injective int64 lane — single-lane probe paths apply.
+        pack = self._range_pack_spec() if all(raw_pos) else None
+        build_lanes = None if pack is None \
+            else [self._packed_lane(build_keys, pack)]
+        build = J.BuildTable(build_batch, build_keys, build_lanes)
         out_names = list(self.output_schema.names)
         # Sync-free probe-aligned path: a build side whose keys are unique
         # (exact plan statistics — dimension scans, group-by outputs) makes
@@ -288,7 +363,8 @@ class HashJoinExec(PlanNode):
                 if s:
                     probe_keys[i] = remap_codes_into(
                         probe_keys[i], build_keys[i].dictionary)
-            probe_lanes = J.key_cols_lanes(probe_keys)
+            probe_lanes = [self._packed_lane(probe_keys, pack)] \
+                if pack is not None else J.key_cols_lanes(probe_keys)
             probe_valid = pb.row_mask()
             for c in probe_keys:
                 probe_valid = probe_valid & c.validity

@@ -96,6 +96,15 @@ class PlanNode:
         subqueries run without a host sync."""
         return None
 
+    def column_range(self, name: str) -> Optional[Tuple[int, int]]:
+        """Exact (min, max) of a column's integer-lane values when known
+        from scan statistics, else None.  Value-preserving operators
+        delegate; values only ever narrow (filter/limit keep subsets,
+        joins gather existing rows).  Lets multi-column join keys pack
+        into ONE injective int64 lane (exec/join.py), unlocking the
+        sync-free aligned/semi probe paths for composite keys."""
+        return None
+
     def tree_string(self, indent: int = 0) -> str:
         lines = ["  " * indent + self.describe()]
         for c in self.children:
@@ -148,6 +157,10 @@ class HostScanExec(PlanNode):
         self._schema = schema or (self.batches[0].schema if self.batches
                                   else t.StructType([]))
         self._source_table = source_table
+        # whole-plan compilation hooks (exec/compiled.py): uploaded-once
+        # device batches, and tracer stand-ins installed during jit trace
+        self._device_cache = None
+        self._trace_batches = None
 
     @classmethod
     def from_table(cls, table: pa.Table, max_rows: Optional[int] = None
@@ -167,11 +180,20 @@ class HostScanExec(PlanNode):
             return False
         return _table_keys_unique(tbl, tuple(names))
 
+    def column_range(self, name: str) -> Optional[Tuple[int, int]]:
+        tbl = self._source_table
+        if tbl is None or name not in tbl.schema.names:
+            return None
+        return _table_column_range(tbl, name)
+
     @property
     def output_schema(self) -> t.StructType:
         return self._schema
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        if self._trace_batches is not None:   # under whole-plan tracing
+            yield from self._trace_batches
+            return
         for hb in self.batches:
             ctx.bump("scanned_rows", hb.num_rows)
             yield to_device(hb, ctx.conf)
@@ -215,6 +237,55 @@ def _table_keys_unique(tbl: pa.Table, names: tuple) -> bool:
     return uniq
 
 
+_RANGE_STAT_CACHE: dict = {}
+
+
+def _table_column_range(tbl: pa.Table, name: str):
+    """Exact (min, max) of the column's canonical int64 lane (ints/dates
+    as-is, bool as 0/1, narrow decimals as unscaled), or None for types
+    without a single integer lane.  Weakref-cached like the uniqueness
+    stats."""
+    import weakref
+    key = (id(tbl), name)
+    hit = _RANGE_STAT_CACHE.get(key)
+    if hit is not None and hit[0]() is tbl:
+        return hit[1]
+    import pyarrow.compute as pc
+    col = tbl.column(name)
+    typ = col.type
+    rng = None
+    try:
+        if pa.types.is_integer(typ) or pa.types.is_date(typ) or \
+                pa.types.is_boolean(typ):
+            mm = pc.min_max(col)
+            lo, hi = mm["min"].as_py(), mm["max"].as_py()
+            if lo is not None:
+                if pa.types.is_boolean(typ):
+                    lo, hi = int(lo), int(hi)
+                elif pa.types.is_date(typ):
+                    import datetime as _dt
+                    epoch = _dt.date(1970, 1, 1)
+                    lo, hi = (lo - epoch).days, (hi - epoch).days
+                rng = (int(lo), int(hi))
+        elif pa.types.is_decimal(typ) and typ.precision <= 18:
+            mm = pc.min_max(col)
+            lo, hi = mm["min"].as_py(), mm["max"].as_py()
+            if lo is not None:
+                s = typ.scale
+                rng = (int(lo.scaleb(s)), int(hi.scaleb(s)))
+    except Exception:                            # noqa: BLE001
+        rng = None
+    try:
+        ref = weakref.ref(tbl, lambda _r, k=key:
+                          _RANGE_STAT_CACHE.pop(k, None))
+    except TypeError:
+        return rng
+    if len(_RANGE_STAT_CACHE) > 4096:
+        _RANGE_STAT_CACHE.clear()
+    _RANGE_STAT_CACHE[key] = (ref, rng)
+    return rng
+
+
 class ProjectExec(PlanNode):
     """GpuProjectExec: one fused XLA program per row bucket
     (reference basicPhysicalOperators.scala:350)."""
@@ -243,6 +314,13 @@ class ProjectExec(PlanNode):
     def static_row_count(self):
         return self.child.static_row_count()   # projection keeps rows
 
+    def column_range(self, name):
+        from .join import key_ref_names
+        if name not in self.names:
+            return None
+        ref = key_ref_names([self.exprs[self.names.index(name)]])
+        return None if ref is None else self.child.column_range(ref[0])
+
     @property
     def output_schema(self) -> t.StructType:
         return t.StructType([t.StructField(n, e.dtype)
@@ -270,6 +348,9 @@ class FilterExec(PlanNode):
 
     def keys_unique(self, names):
         return self.child.keys_unique(names)   # subset of rows
+
+    def column_range(self, name):
+        return self.child.column_range(name)   # subset of values
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from .evaluator import compute_predicate
@@ -314,6 +395,23 @@ class HashAggregateExec(PlanNode):
             return True
         return set(self.key_names) <= set(names)
 
+    def column_range(self, name):
+        from .join import key_ref_names
+        if name in self.key_names:
+            # group-key columns pass values through unchanged
+            e = self.key_exprs[self.key_names.index(name)]
+            ref = key_ref_names([e])
+            return None if ref is None else self.child.column_range(ref[0])
+        # Min/Max aggregate outputs select existing values -> the child
+        # column's range bounds them
+        from ..plan.aggregates import Max, Min
+        for fn, out_name in self.aggs:
+            if out_name == name and isinstance(fn, (Min, Max)):
+                ref = key_ref_names([fn.child])
+                if ref is not None:
+                    return self.child.column_range(ref[0])
+        return None
+
     def static_row_count(self) -> Optional[int]:
         return 1 if not self.key_exprs else None
 
@@ -354,11 +452,14 @@ class HashAggregateExec(PlanNode):
             partials.append(p)
             # Bound the pending set: merge when the partials would overflow
             # one target batch (the reference's tryMergeAggregatedBatches).
+            # Capacity is a host fact, so the gate never syncs; it bounds
+            # rows from above (merging slightly early is harmless).
             if len(partials) > 1 and \
-                    sum(int(p.num_rows) for p in partials) > ctx.conf.batch_size_rows:
+                    sum(p.capacity for p in partials) > ctx.conf.batch_size_rows:
                 merged = agg.merge(partials)
                 if self.key_exprs and \
-                        int(merged.num_rows) > ctx.conf.batch_size_rows:
+                        isinstance(merged.num_rows, int) and \
+                        merged.num_rows > ctx.conf.batch_size_rows:
                     # High-cardinality fallback (GpuAggregateExec.scala:711
                     # repartition-based path): merging no longer reduces, so
                     # hash-split the merged partials into independently
@@ -605,6 +706,9 @@ def _dict_crc_table(dictionary):
     padded = 1 << (len(ent) - 1).bit_length()
     ent += [0] * (padded - len(ent))
     tab = jnp.asarray(np.asarray(ent, np.uint32))
+    import jax
+    if isinstance(tab, jax.core.Tracer):
+        return tab               # whole-plan tracing: never cache tracers
     if len(_CRC_TABLE_CACHE) > 512:
         _CRC_TABLE_CACHE.clear()
     # pin the dictionary so its id stays valid while cached
@@ -625,6 +729,9 @@ class LocalLimitExec(PlanNode):
 
     def keys_unique(self, names):
         return self.child.keys_unique(names)   # prefix of rows
+
+    def column_range(self, name):
+        return self.child.column_range(name)
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         # Never peek ahead: pulling a second batch before emitting would
@@ -675,6 +782,12 @@ class UnionExec(PlanNode):
     def output_schema(self) -> t.StructType:
         return self.children[0].output_schema
 
+    def column_range(self, name):
+        rngs = [c.column_range(name) for c in self.children]
+        if any(r is None for r in rngs):
+            return None
+        return (min(r[0] for r in rngs), max(r[1] for r in rngs))
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         names = list(self.output_schema.names)
         for c in self.children:
@@ -702,6 +815,9 @@ class CoalesceBatchesExec(PlanNode):
 
     def static_row_count(self):
         return self.child.static_row_count()
+
+    def column_range(self, name):
+        return self.child.column_range(name)
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         target = self.target_rows or ctx.conf.batch_size_rows
@@ -752,6 +868,9 @@ class SortExec(PlanNode):
     def static_row_count(self):
         return self.child.static_row_count()
 
+    def column_range(self, name):
+        return self.child.column_range(name)
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from ..ops.sort import sort_batch
         if not self.global_sort:
@@ -800,6 +919,9 @@ class TopNExec(PlanNode):
 
     def keys_unique(self, names):
         return self.child.keys_unique(names)   # prefix of a permutation
+
+    def column_range(self, name):
+        return self.child.column_range(name)
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from ..ops.sort import sort_batch

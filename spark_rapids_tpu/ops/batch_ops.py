@@ -72,13 +72,33 @@ def remap_codes_into(col: DeviceColumn, target_dict: pa.Array) -> DeviceColumn:
     return DeviceColumn(data, col.validity, col.dtype, target_dict)
 
 
+
+
+def _hi_lane_of(col: DeviceColumn, upto=None) -> "jax.Array":
+    """The column's hi int64 lane, synthesizing the sign-extension for
+    single-lane (device-computed) wide values so mixed streams concat
+    correctly."""
+    if col.data_hi is not None:
+        return col.data_hi if upto is None else col.data_hi[:upto]
+    d = col.data if upto is None else col.data[:upto]
+    d = d.astype(jnp.int64)
+    return jnp.where(d < 0, jnp.int64(-1), jnp.int64(0))
+
+
 def concat_batches(batches: List[DeviceBatch],
                    conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
-    """Concatenate device batches (same schema) into one bucketed batch."""
+    """Concatenate device batches (same schema) into one bucketed batch.
+
+    Batches with host-known counts concatenate tightly (layout decisions
+    on host).  If ANY count is lazy (a device scalar / tracer), the lazy
+    path concatenates full-capacity lanes and compacts live rows to the
+    front on device — zero host syncs, at the cost of padding up to the
+    capacity sum."""
     assert batches, "concat of zero batches"
     if len(batches) == 1:
         return batches[0]
-    # concat makes host-side layout decisions, so lazy counts sync here
+    if any(not isinstance(b.num_rows, int) for b in batches):
+        return _concat_batches_lazy(batches, conf)
     batches = [DeviceBatch(b.columns, int(b.num_rows), b.names,
                            b.origin_file) for b in batches]
     total = sum(b.num_rows for b in batches)
@@ -109,8 +129,9 @@ def concat_batches(batches: List[DeviceBatch],
             data_parts.append(jnp.zeros((pad,), cols[0].data.dtype))
             valid_parts.append(jnp.zeros((pad,), bool))
         hi = None
-        if cols[0].data_hi is not None:
-            hi_parts = [c.data_hi[:b.num_rows] for c, b in zip(cols, batches)]
+        if any(c.data_hi is not None for c in cols):
+            hi_parts = [_hi_lane_of(c, b.num_rows)
+                        for c, b in zip(cols, batches)]
             if pad:
                 hi_parts.append(jnp.zeros((pad,), jnp.int64))
             hi = jnp.concatenate(hi_parts)
@@ -120,6 +141,56 @@ def concat_batches(batches: List[DeviceBatch],
     from ..columnar.device import merge_origin
     return DeviceBatch(out_cols, total, names,
                        merge_origin(b.origin_file for b in batches))
+
+
+def _concat_batches_lazy(batches: List[DeviceBatch],
+                         conf: TpuConf) -> DeviceBatch:
+    """Sync-free concat: stack full-capacity lanes, then compact live rows
+    to the front on device (ops/filter.py).  Capacities are host facts, so
+    the output shape is static; the row count stays a device scalar."""
+    from ..columnar.device import merge_origin
+    from .filter import compact_batch
+    cap_total = sum(b.capacity for b in batches)
+    cap = bucket_capacity(max(cap_total, 1), conf)
+    pad = cap - cap_total
+    names = list(batches[0].names)
+    live_parts = [b.row_mask() for b in batches]
+    if pad:
+        live_parts.append(jnp.zeros((pad,), bool))
+    keep = jnp.concatenate(live_parts)
+    out_cols = []
+    for ci in range(batches[0].num_columns):
+        cols = [b.column(ci) for b in batches]
+        dt = cols[0].dtype
+        unified = None
+        if isinstance(dt, t.StringType):
+            unified, remaps = unify_dictionaries(
+                [c.dictionary for c in cols])
+            cols = [remap_string_column(c, r, unified)
+                    for c, r in zip(cols, remaps)]
+        data_parts = [c.data for c in cols]
+        if isinstance(dt, t.DoubleType) and \
+                len({str(p.dtype) for p in data_parts}) > 1:
+            from .kernels import compute_view
+            data_parts = [compute_view(p, dt) for p in data_parts]
+        valid_parts = [c.validity for c in cols]
+        if pad:
+            data_parts = data_parts + [jnp.zeros((pad,),
+                                                 data_parts[0].dtype)]
+            valid_parts = valid_parts + [jnp.zeros((pad,), bool)]
+        hi = None
+        if any(c.data_hi is not None for c in cols):
+            hi_parts = [_hi_lane_of(c) for c in cols]
+            if pad:
+                hi_parts.append(jnp.zeros((pad,), jnp.int64))
+            hi = jnp.concatenate(hi_parts)
+        out_cols.append(DeviceColumn(jnp.concatenate(data_parts),
+                                     jnp.concatenate(valid_parts),
+                                     dt, unified, hi))
+    total = sum(jnp.int32(b.num_rows) for b in batches)
+    db = DeviceBatch(out_cols, total, names,
+                     merge_origin(b.origin_file for b in batches))
+    return compact_batch(db, keep, conf)
 
 
 def shrink_to_capacity(db: DeviceBatch, row_bound: int,

@@ -162,11 +162,17 @@ def composite_hash(lanes: Sequence[jax.Array]) -> jax.Array:
 
 
 class BuildTable:
-    """Sorted build side of a join (the hash-table analogue)."""
+    """Sorted build side of a join (the hash-table analogue).
 
-    def __init__(self, batch: DeviceBatch, key_cols: Sequence[DeviceColumn]):
+    `lanes_override` replaces the per-column canonical lanes (e.g. a
+    range-packed single lane for composite keys — exec/join.py
+    _range_pack_spec); key validity still derives from `key_cols`."""
+
+    def __init__(self, batch: DeviceBatch, key_cols: Sequence[DeviceColumn],
+                 lanes_override: Optional[List[jax.Array]] = None):
         self.batch = batch
-        lanes = key_cols_lanes(key_cols)
+        lanes = lanes_override if lanes_override is not None \
+            else key_cols_lanes(key_cols)
         valid = batch.row_mask()
         for c in key_cols:
             valid = valid & c.validity      # null keys never match

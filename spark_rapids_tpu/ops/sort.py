@@ -99,6 +99,15 @@ def order_lanes(col: DeviceColumn, asc: bool, nulls_first: bool,
         nan_lane = isnan.astype(jnp.uint8)
         lanes = [nan_lane, lane] if asc else [1 - nan_lane, -lane]
         return [_null_lane(col.validity, nulls_first)] + lanes
+    elif isinstance(dt, t.DecimalType) and dt.is_wide and \
+            col.data_hi is not None:
+        # two-lane host decimal128: int128 total order == lexicographic
+        # (signed hi, unsigned lo)
+        hi_lane = _to_unsigned_comparable(col.data_hi)
+        lo_lane = data.astype(jnp.int64).astype(jnp.uint64)
+        if not asc:
+            hi_lane, lo_lane = ~hi_lane, ~lo_lane
+        return [_null_lane(col.validity, nulls_first), hi_lane, lo_lane]
     else:
         lane = _to_unsigned_comparable(data)
     if not asc:
@@ -124,8 +133,8 @@ def sort_permutation(db: DeviceBatch, keys: Sequence[SortKey]) -> jax.Array:
             rank_tables[k.col_index] = jnp.asarray(
                 dictionary_ranks(col.dictionary))
     sig = ("sortperm", db.capacity, tuple(keys),
-           tuple((str(c.data.dtype), c.dtype.simple_string)
-                 for c in db.columns),
+           tuple((str(c.data.dtype), c.dtype.simple_string,
+                  c.data_hi is not None) for c in db.columns),
            tuple((i, rt.shape) for i, rt in rank_tables.items()))
     fn = _SORT_CACHE.get(sig)
     if fn is None:
@@ -135,9 +144,12 @@ def sort_permutation(db: DeviceBatch, keys: Sequence[SortKey]) -> jax.Array:
         def run(col_data, col_valid, live, ranks):
             lanes: List[jax.Array] = []
             for k in keys_t:
-                col = DeviceColumn(col_data[k.col_index],
-                                   col_valid[k.col_index],
-                                   dtypes[k.col_index])
+                d = col_data[k.col_index]
+                hi = None
+                if isinstance(d, tuple):
+                    d, hi = d
+                col = DeviceColumn(d, col_valid[k.col_index],
+                                   dtypes[k.col_index], None, hi)
                 lanes.extend(order_lanes(col, k.ascending, k.nulls_first,
                                          ranks.get(k.col_index)))
             # lexsort: last key is primary -> [minor..., major, liveness]
@@ -146,7 +158,8 @@ def sort_permutation(db: DeviceBatch, keys: Sequence[SortKey]) -> jax.Array:
 
         fn = jax.jit(run)
         _SORT_CACHE[sig] = fn
-    return fn(tuple(c.data for c in db.columns),
+    return fn(tuple(c.data if c.data_hi is None else (c.data, c.data_hi)
+                    for c in db.columns),
               tuple(c.validity for c in db.columns),
               db.row_mask(), rank_tables)
 

@@ -84,14 +84,18 @@ class EvalCtx:
 
 
 class DevVal:
-    """A traced column value: compute-representation lane + validity."""
+    """A traced column value: compute-representation lane + validity.
+
+    `hi` carries the high int64 lane of a HOST-scanned wide (p>18)
+    decimal; device-computed wide results are single-lane (hi None)."""
 
     def __init__(self, data, validity, dtype: t.DataType,
-                 dictionary: Optional[pa.Array] = None):
+                 dictionary: Optional[pa.Array] = None, hi=None):
         self.data = data
         self.validity = validity      # None = all rows valid
         self.dtype = dtype
         self.dictionary = dictionary
+        self.hi = hi
 
 
 class Expression:
@@ -1431,6 +1435,8 @@ class Cast(Expression):
     def unsupported_reasons(self, conf):
         src, dst = self.children[0].dtype, self.to
         if _consumes_wide_host(self.children[0]):
+            if t.is_floating(dst):
+                return []     # two-lane -> f64 kernel (_eval_dev)
             return ["128-bit host decimal lane not consumable on device"]
         if isinstance(src, t.DecimalType):
             if t.is_numeric(dst) or isinstance(dst, t.BooleanType):
@@ -1533,6 +1539,19 @@ class Cast(Expression):
                 data = jax.lax.bitcast_convert_type(data, jnp.float64)
             return DevVal(data, merge_validity(valid, ok[codes]), dst)
         if isinstance(src, t.DecimalType):
+            if kids[0].hi is not None and t.is_floating(dst):
+                # two-lane host decimal128: value = hi*2^64 + unsigned(lo),
+                # combined in f64 (32-bit halves — u64->f64 conversion is
+                # not portable across backends), then unscaled
+                lo = x.astype(jnp.int64)
+                hi_f = kids[0].hi.astype(jnp.float64)
+                lo_hi32 = ((lo >> 32) & jnp.int64(0xFFFFFFFF)) \
+                    .astype(jnp.float64)
+                lo_lo32 = (lo & jnp.int64(0xFFFFFFFF)).astype(jnp.float64)
+                f = (hi_f * jnp.float64(2.0 ** 64)
+                     + lo_hi32 * jnp.float64(2.0 ** 32) + lo_lo32)
+                f = f / jnp.float64(10.0 ** src.scale)
+                return DevVal(f.astype(compute_dtype(dst)), valid, dst)
             u = x.astype(jnp.int64)
             if isinstance(dst, t.DecimalType):
                 data, ok = D.rescale(u, src.scale, dst.scale)

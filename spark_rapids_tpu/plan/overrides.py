@@ -562,9 +562,9 @@ class SortMeta(PlanMeta):
                     f"sort key {e!r} is not a column reference "
                     "(planner pre-projection not yet implemented)")
                 continue
-            dt = schema[e.name].data_type
-            if isinstance(dt, t.DecimalType) and dt.is_wide:
-                self.will_not_work("decimal128 sort key not yet on device")
+            # wide decimal keys sort on device: two-lane (hi, lo) host
+            # columns lexicographically, single-lane computed results
+            # directly (ops/sort.py order_lanes)
 
     def to_device(self):
         from ..ops.sort import SortKey
@@ -580,6 +580,20 @@ class SortMeta(PlanMeta):
 
 class LimitMeta(PlanMeta):
     def to_device(self):
+        # Limit directly above a global Sort collapses into TopN
+        # (reference GpuTopN, limit.scala): per-batch sort+cut keeps the
+        # working set at the limit's bucket and, for single-batch
+        # streams, runs with zero host syncs (whole-plan traceable).
+        child_meta = self.children[0]
+        if isinstance(child_meta, SortMeta) and child_meta.can_replace \
+                and child_meta.node.global_sort:
+            from ..exec.plan import TopNExec
+            from ..ops.sort import SortKey
+            schema = child_meta.node.child.schema
+            keys = [SortKey(schema.field_index(e.name), asc, nf)
+                    for e, asc, nf in child_meta.node.orders]
+            return TopNExec(self.node.limit, keys,
+                            child_meta._device_child())
         return GlobalLimitExec(self.node.limit, self._device_child())
 
     def to_host(self):
@@ -873,6 +887,16 @@ class PhysicalQuery:
                     ctx.metrics[f"memory.{k}"] = v
         return scope()
 
+    def _whole_plan_enabled(self) -> bool:
+        from ..config import WHOLE_PLAN_COMPILE
+        mode = str(self.conf.get(WHOLE_PLAN_COMPILE)).upper()
+        if mode == "OFF":
+            return False
+        if mode == "ON":
+            return True
+        import jax
+        return jax.default_backend() == "tpu"
+
     def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
         ctx = ctx or ExecContext(self.conf)
         from ..plan.misc import set_current_input_file
@@ -880,6 +904,11 @@ class PhysicalQuery:
         from ..runtime.failure import crash_capture, install_fault_injection
         install_fault_injection(self.root, self.conf)
         with self._instrumented(ctx), crash_capture(self.conf, ctx):
+            if self.kind == "device" and self._whole_plan_enabled():
+                from ..exec.compiled import collect_with_fallback
+                out = collect_with_fallback(self.root, ctx, cache_on=self)
+                if out is not None:
+                    return out
             return self.root.collect(ctx)
 
     def execute_host_batches(self, ctx: Optional[ExecContext] = None):

@@ -125,11 +125,16 @@ def gen_tables(scale: float = 0.01, seed: int = 20240706
     })
 
     n_ps = n_part * 2
+    # spec: (ps_partkey, ps_suppkey) is the table's primary key — each
+    # part's supplier copies use distinct stride offsets (TPC-H 4.2.3's
+    # supplier-of-part formula shape)
+    ps_pk = np.concatenate([np.arange(n_part), np.arange(n_part)])
+    ps_sk = np.concatenate(
+        [np.arange(n_part) % n_supp,
+         (np.arange(n_part) + max(1, n_supp // 4 + 1)) % n_supp])
     partsupp = pa.table({
-        "ps_partkey": pa.array(
-            np.concatenate([np.arange(n_part), np.arange(n_part)]),
-            pa.int64()),
-        "ps_suppkey": pa.array(rng.integers(0, n_supp, n_ps), pa.int64()),
+        "ps_partkey": pa.array(ps_pk, pa.int64()),
+        "ps_suppkey": pa.array(ps_sk, pa.int64()),
         "ps_availqty": pa.array(rng.integers(1, 10000, n_ps), pa.int32()),
         "ps_supplycost": money_from_cents(
             rng.integers(1_00, 1000_00, n_ps), 12, 2),

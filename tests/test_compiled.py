@@ -1,0 +1,102 @@
+"""Whole-plan XLA compilation (exec/compiled.py).
+
+The conftest CPU mesh disables the AUTO mode, so these tests force ON and
+assert (a) every TPC-H query either compiles into one program or falls
+back cleanly, (b) compiled results match the eager engine and the CPU
+oracle, (c) the compiled plan is cached and reused across collects.
+"""
+import jax
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import tpch
+from spark_rapids_tpu.exec.plan import ExecContext
+from spark_rapids_tpu.session import DataFrame, TpuSession, col
+
+ON = {"spark.rapids.tpu.sql.compile.wholePlan": "ON"}
+CPU = {"spark.rapids.tpu.sql.enabled": "false"}
+
+
+def _approx_eq(a: pa.Table, b: pa.Table) -> bool:
+    da, db = a.to_pydict(), b.to_pydict()
+    if set(da) != set(db):
+        return False
+    for k in da:
+        if len(da[k]) != len(db[k]):
+            return False
+        for x, y in zip(da[k], db[k]):
+            if x == y:
+                continue
+            if isinstance(x, float) and isinstance(y, float) and \
+                    abs(x - y) <= 1e-9 * max(1.0, abs(x), abs(y)):
+                continue    # reduction-order float tail
+            return False
+    return True
+
+
+@pytest.fixture(scope="module")
+def tiny_tables():
+    return tpch.gen_tables(scale=0.002)
+
+
+@pytest.mark.parametrize("name", sorted(tpch.QUERIES,
+                                        key=lambda q: int(q[1:])))
+def test_tpch_whole_plan_compiles_and_matches(name, tiny_tables):
+    s = TpuSession(ON)
+    dfq = tpch.QUERIES[name](s, tiny_tables)
+    ctx = ExecContext(s.conf)
+    out = dfq.physical().collect(ctx)
+    oracle = DataFrame(dfq._plan, TpuSession(CPU)).collect()
+    assert _approx_eq(out, oracle), f"{name} result mismatch"
+    assert ctx.metrics.get("whole_plan_compiled_queries", 0) == 1, \
+        f"{name} did not compile whole-plan: {ctx.metrics}"
+
+
+def test_compiled_plan_cached_across_collects(tiny_tables):
+    s = TpuSession(ON)
+    q = tpch.QUERIES["q6"](s, tiny_tables).physical()
+    first = q.collect()
+    assert q._compiled_plan not in (None, False)
+    plan_obj = q._compiled_plan
+    second = q.collect()
+    assert q._compiled_plan is plan_obj          # reused, not re-traced
+    assert first.to_pydict() == second.to_pydict()
+
+
+def test_fallback_on_host_decision_plan():
+    """A plan needing host decisions (multi-batch out-of-core sort) falls
+    back to the eager engine and still returns correct results."""
+    import numpy as np
+    s = TpuSession({**ON, "spark.rapids.tpu.sql.batchSizeRows": 1000})
+    rng = np.random.default_rng(7)
+    t = pa.table({"x": rng.permutation(5000).astype("int64")})
+    df = s.from_arrow(t).sort(("x", True, True))
+    ctx = ExecContext(s.conf)
+    out = df.physical().collect(ctx)
+    assert out.column("x").to_pylist() == list(range(5000))
+    assert ctx.metrics.get("whole_plan_fallbacks", 0) >= 1 or \
+        ctx.metrics.get("whole_plan_compiled_queries", 0) == 1
+
+
+def test_auto_mode_off_on_cpu_backend(tiny_tables):
+    """AUTO leaves the eager engine in charge on non-TPU backends."""
+    assert jax.default_backend() != "tpu"
+    s = TpuSession()      # AUTO
+    q = tpch.QUERIES["q6"](s, tiny_tables).physical()
+    ctx = ExecContext(s.conf)
+    q.collect(ctx)
+    assert "whole_plan_compiled_queries" not in ctx.metrics
+
+
+def test_compiled_groupby_string_keys(tiny_tables):
+    s = TpuSession(ON)
+    li = s.from_arrow(tiny_tables["lineitem"])
+    from spark_rapids_tpu.plan.aggregates import Count, Sum
+    df = (li.group_by("l_returnflag")
+            .agg((Count(None), "n"))
+            .sort("l_returnflag"))
+    ctx = ExecContext(s.conf)
+    out = df.physical().collect(ctx)
+    assert ctx.metrics.get("whole_plan_compiled_queries") == 1
+    oracle = DataFrame(df._plan, TpuSession(CPU)).collect()
+    assert out.to_pydict() == oracle.to_pydict()

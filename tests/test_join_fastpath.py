@@ -209,27 +209,44 @@ def test_aligned_null_keys_never_match():
     assert rows == {1.0: 10, 2.0: None, 3.0: None}
 
 
-def test_multi_key_join_never_aligned():
-    """Composite (multi-lane) keys must use the range-scanning sized path:
-    the aligned single-slot probe could miss a match under a composite-
-    hash collision between distinct build tuples."""
-    dim = _scan({"a": pa.array([1, 1, 2], pa.int64()),
-                 "b": pa.array([1, 2, 1], pa.int64()),
-                 "m": pa.array([10, 11, 12], pa.int64())})
-    fact = _scan({"a": pa.array([1, 2, 1], pa.int64()),
-                  "b": pa.array([2, 1, 9], pa.int64()),
-                  "v": pa.array([1., 2., 3.])})
+def test_multi_key_join_range_packing():
+    """Composite keys WITH exact range statistics fold into one injective
+    int64 lane (range packing) — the aligned path engages and is exact.
+    Without statistics the composite hash could collide between distinct
+    build tuples, so the aligned path must NOT engage."""
+    dim_tbl = pa.table({"a": pa.array([1, 1, 2], pa.int64()),
+                        "b": pa.array([1, 2, 1], pa.int64()),
+                        "m": pa.array([10, 11, 12], pa.int64())})
+    fact_tbl = pa.table({"a": pa.array([1, 2, 1], pa.int64()),
+                         "b": pa.array([2, 1, 9], pa.int64()),
+                         "v": pa.array([1., 2., 3.])})
+    keys = [E.ColumnRef("a"), E.ColumnRef("b")]
+
+    # with stats: packed single lane -> aligned
     ctx = ExecContext()
-    j = HashJoinExec("inner",
-                     [E.ColumnRef("a"), E.ColumnRef("b")],
-                     [E.ColumnRef("a"), E.ColumnRef("b")], fact, dim)
-    assert j._build_unique()          # the pair IS unique...
+    j = HashJoinExec("inner", keys, keys,
+                     HostScanExec.from_table(fact_tbl),
+                     HostScanExec.from_table(dim_tbl))
+    assert j._build_unique()
+    assert j._range_pack_spec() is not None
     out = j.collect(ctx)
-    # ...but the aligned fast path must NOT engage (multi-lane)
-    assert "join_aligned_fastpath" not in ctx.metrics
+    assert ctx.metrics.get("join_aligned_fastpath") == 1
     assert sorted(zip(out.column("v").to_pylist(),
                       out.column("m").to_pylist())) == [(1.0, 11),
                                                         (2.0, 12)]
+
+    # stats stripped: no packing -> multi-lane -> sized path only
+    dim_ns = HostScanExec.from_table(dim_tbl)
+    dim_ns._source_table = None
+    j2 = HashJoinExec("inner", keys, keys,
+                      HostScanExec.from_table(fact_tbl), dim_ns)
+    assert j2._range_pack_spec() is None
+    ctx2 = ExecContext()
+    out2 = j2.collect(ctx2)
+    assert "join_aligned_fastpath" not in ctx2.metrics
+    assert sorted(zip(out2.column("v").to_pylist(),
+                      out2.column("m").to_pylist())) == [(1.0, 11),
+                                                         (2.0, 12)]
 
 
 def test_limit_lazy_path_shrinks_capacity():
