@@ -1,71 +1,81 @@
 #!/usr/bin/env python
-"""Headline benchmark: TPC-H q6 (SF1-sized lineitem) through the framework.
+"""Headline benchmark: the full 22-query TPC-H suite at SF>=1.
 
 Prints ONE json line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-vs_baseline = CPU time / TPU per-query time (>1 means the TPU path wins)
-against an in-process vectorized pyarrow baseline — a *stronger* stand-in
-for CPU Spark than Spark itself (columnar C++ kernels, no JVM/task
-overhead), so the reported speedup is conservative vs the BASELINE.md
-north-star.
+Headline metric: geometric-mean speedup of per-query WARM wall time
+(device engine / whole-plan XLA compilation) over the SAME queries on
+the engine's CPU fallback (vectorized pyarrow kernels — a stronger
+stand-in for CPU Spark than Spark itself: columnar C++ kernels, no
+JVM/task overhead, so the reported speedup is conservative vs the
+BASELINE.md north star).
 
-Methodology.  The TPU number is device-resident *throughput*: K independent
-query executions are dispatched back-to-back and every result is fetched in
-ONE batched D2H transfer; per-query time = wall / K.  This mirrors how both
-the reference and Spark itself actually run — many concurrent tasks per
-device (GpuSemaphore concurrentGpuTasks, RapidsConf.scala:544-551) with
-per-task result latency hidden by the pipeline.  It matters doubly here
-because this chip sits behind a tunnel with ~60 ms round-trip latency: a
-single-query sync measures the tunnel, not the engine (round-1's 66 ms
-"q6 time" was ~64 ms of RTT + ~2 ms of compute).  Single-shot latency and
-cold end-to-end (host upload included) times are reported on stderr for
-transparency.
+Methodology.
+  * Every query runs BOTH engines from the same in-memory tables and
+    results are cross-checked (float tails to 1e-9 relative — reduction
+    order differs, as the reference documents for GPU float aggs).
+  * Device timing is single-shot warm wall time: one whole-plan XLA
+    dispatch + one result fetch, measured after the one-time costs
+    (compile — persisted to the jax compilation cache; H2D upload —
+    tables are device-resident across queries, the buffer-cache role).
+    It INCLUDES the test harness tunnel's ~60ms round-trip per query;
+    the RTT is also reported separately so the engine-time floor is
+    visible.  CPU timing is the same warm single-shot discipline.
+  * Cold numbers (first-run compile, upload) are reported on stderr.
+
+Run: python bench.py [scale] [--queries q1,q6,...]
 """
 import json
 import sys
 import time
 
 import numpy as np
-import pyarrow as pa
-import pyarrow.compute as pc
 
-SF1_ROWS = 6_001_215
-DATE_LO = 8766    # 1994-01-01 in days since epoch
-DATE_HI = 9131    # 1995-01-01
-PIPELINE_DEPTH = 64
+import jax
 
-
-def gen_lineitem(n: int) -> pa.Table:
-    rng = np.random.default_rng(20240706)
-    return pa.table({
-        "l_quantity": pa.array(rng.integers(1, 51, n), pa.int64()),
-        "l_extendedprice": pa.array(rng.uniform(900.0, 105000.0, n).round(2)),
-        "l_discount": pa.array(rng.integers(0, 11, n) / 100.0),
-        "l_shipdate": pa.array(rng.integers(8035, 10592, n).astype(np.int32),
-                               pa.int32()),
-    })
+# persistent compile cache: cold compiles (minutes/query over the
+# tunnel) are paid once per (plan, shape); later runs trace + load
+jax.config.update("jax_compilation_cache_dir",
+                  __file__.rsplit("/", 1)[0] + "/.jax_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
-def build_plan(scan):
-    from spark_rapids_tpu.plan import expressions as E
-    from spark_rapids_tpu.plan.aggregates import Sum
-    from spark_rapids_tpu.exec.plan import FilterExec, HashAggregateExec
+def measure_rtt() -> float:
+    """Median device round-trip (a 4-byte fetch) — the per-sync tax this
+    harness adds; on a locally attached chip it is ~10us."""
+    import jax.numpy as jnp
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((1,), jnp.int32)
+    jax.device_get(f(x))
+    times = []
+    for _ in range(11):
+        t0 = time.perf_counter()
+        # a fresh device-computed value: the fetch must round-trip
+        jax.device_get(f(x))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
 
-    c = E.ColumnRef
-    cond = E.And(
-        E.And(E.GreaterThanOrEqual(c("l_shipdate"), E.Literal(DATE_LO)),
-              E.LessThan(c("l_shipdate"), E.Literal(DATE_HI))),
-        E.And(E.And(E.GreaterThanOrEqual(c("l_discount"), E.Literal(0.05)),
-                    E.LessThanOrEqual(c("l_discount"), E.Literal(0.07))),
-              E.LessThan(c("l_quantity"), E.Literal(24))))
-    revenue = E.Multiply(c("l_extendedprice"), c("l_discount"))
-    return HashAggregateExec([], [], [(Sum(revenue), "revenue")],
-                             FilterExec(cond, scan))
+
+def approx_equal(a, b) -> bool:
+    da, db = a.to_pydict(), b.to_pydict()
+    if set(da) != set(db):
+        return False
+    for k in da:
+        if len(da[k]) != len(db[k]):
+            return False
+        for x, y in zip(da[k], db[k]):
+            if x == y:
+                continue
+            if isinstance(x, float) and isinstance(y, float) and \
+                    abs(x - y) <= 1e-6 * max(1.0, abs(x), abs(y)):
+                continue
+            return False
+    return True
 
 
-def time_runs(fn, iters=5):
-    fn()  # warm (compile + caches)
+def time_warm(fn, iters=3):
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -74,167 +84,100 @@ def time_runs(fn, iters=5):
     return min(times)
 
 
-def make_device_scan(table: pa.Table, batch_rows: int):
-    """Upload once; return a PlanNode replaying device-resident batches
-    (buffer-cache analogue of a hot scan)."""
-    import jax
-    from spark_rapids_tpu.columnar.device import to_device
-    from spark_rapids_tpu.exec.plan import HostScanExec, PlanNode
-
-    src = HostScanExec.from_table(table, batch_rows)
-    cached = [to_device(hb) for hb in src.batches]
-    jax.block_until_ready([c.data for b in cached for c in b.columns])
-
-    class DeviceScan(PlanNode):
-        output_schema = src.output_schema
-
-        def execute(self, ctx):
-            return iter(cached)
-
-    return DeviceScan()
-
-
-def run_tpu_throughput(scan, depth: int):
-    """Pipelined device-resident execution: dispatch `depth` independent
-    query runs, one batched fetch at the end."""
-    import jax
-    plan = build_plan(scan)
-
-    def once():
-        runs = [plan.collect_device() for _ in range(depth)]
-        flat = [buf for outs, _fin in runs for pair in outs for buf in pair]
-        fetched = jax.device_get(flat)
-        results = []
-        it = iter(fetched)
-        for outs, fin in runs:
-            pairs = [(next(it), next(it)) for _ in outs]
-            results.append(fin(pairs).column("revenue").to_pylist()[0])
-        return results
-
-    results = once()
-    assert all(abs(r - results[0]) < 1e-9 for r in results)
-    return time_runs(once, iters=3) / depth, results[0]
-
-
-def run_tpu_single(scan):
-    plan = build_plan(scan)
-
-    def once():
-        return plan.collect().column("revenue").to_pylist()[0]
-
-    result = once()
-    return time_runs(once, iters=3), result
-
-
-def run_tpu_e2e(table: pa.Table, batch_rows: int):
-    from spark_rapids_tpu.exec.plan import HostScanExec
-
-    def once():
-        plan = build_plan(HostScanExec.from_table(table, batch_rows))
-        return plan.collect().column("revenue").to_pylist()[0]
-
-    result = once()
-    return time_runs(once, iters=2), result
-
-
-def run_cpu(table: pa.Table):
-    def once():
-        m = pc.and_(
-            pc.and_(pc.greater_equal(table["l_shipdate"], DATE_LO),
-                    pc.less(table["l_shipdate"], DATE_HI)),
-            pc.and_(pc.and_(pc.greater_equal(table["l_discount"], 0.05),
-                            pc.less_equal(table["l_discount"], 0.07)),
-                    pc.less(table["l_quantity"], 24)))
-        ft = table.filter(m)
-        return pc.sum(pc.multiply(ft["l_extendedprice"],
-                                  ft["l_discount"])).as_py()
-
-    result = once()
-    return time_runs(once), result
-
-
-# Scan->filter->aggregate shapes only: join-shaped queries make several
-# data-dependent shape decisions (join output capacity, coalesce sizing),
-# each a host sync that costs the full ~60ms tunnel RTT in THIS harness —
-# they measure the tunnel, not the engine (single_shot note).  On locally
-# attached chips those syncs are ~10us.
-SUITE_QUERIES = ("q1", "q6")
-
-
-def run_tpch_suite(scale: float = 0.005):
-    """Secondary breadth metric: the TPC-H query subset end-to-end
-    (scan->joins->aggs->sort, transitions included) on the device path vs
-    the SAME queries on the engine's CPU fallback engine (pyarrow
-    kernels).  Single-shot wall times — includes the ~60ms tunnel RTT per
-    device query, so these speedups UNDERSTATE the engine (see the
-    headline methodology note)."""
+def run_suite(scale: float, query_names):
     from spark_rapids_tpu import tpch
-    from spark_rapids_tpu.session import TpuSession, DataFrame
+    from spark_rapids_tpu.exec.plan import ExecContext
+    from spark_rapids_tpu.session import DataFrame, TpuSession
 
+    t0 = time.perf_counter()
     tables = tpch.gen_tables(scale=scale)
-    dev_s = TpuSession()
-    cpu_s = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    gen_s = time.perf_counter() - t0
+    print(f"# datagen SF{scale}: {gen_s:.1f}s "
+          f"lineitem={tables['lineitem'].num_rows}", file=sys.stderr)
+
+    dev = TpuSession()          # wholePlan AUTO -> on for the TPU backend
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+
     per_q = {}
-    for name in SUITE_QUERIES:
-        df = tpch.QUERIES[name](dev_s, tables)
+    compiled_ct = 0
+    for name in query_names:
+        dfq = tpch.QUERIES[name](dev, tables)
+        q = dfq.physical()
+        # cold: compile (or cache load) + device upload + first run
+        t0 = time.perf_counter()
+        out = q.collect(ExecContext(dev.conf))
+        cold_s = time.perf_counter() - t0
+        dt = time_warm(lambda: q.collect(ExecContext(dev.conf)))
+        ctx = ExecContext(dev.conf)
+        out = q.collect(ctx)
+        compiled = ctx.metrics.get("whole_plan_compiled_queries", 0)
+        compiled_ct += compiled
 
-        def dev_once(df=df):
-            return df.collect()
+        cq = DataFrame(dfq._plan, cpu).physical()
+        oracle = cq.collect()
+        ct = time_warm(lambda: cq.collect(), iters=2)
 
-        def cpu_once(df=df):
-            return DataFrame(df._plan, cpu_s).collect()
-
-        dt = time_runs(dev_once, iters=1)
-        ct = time_runs(cpu_once, iters=1)
+        match = approx_equal(out, oracle)
         per_q[name] = {"device_ms": round(dt * 1e3, 1),
                        "cpu_ms": round(ct * 1e3, 1),
-                       "speedup": round(ct / dt, 2)}
+                       "speedup": round(ct / dt, 2),
+                       "compiled": bool(compiled),
+                       "match": match}
+        print(f"# {name}: device={dt*1e3:.0f}ms cpu={ct*1e3:.0f}ms "
+              f"x{ct/dt:.2f} cold={cold_s:.1f}s "
+              f"compiled={bool(compiled)} match={match}", file=sys.stderr)
+        if not match:
+            print(f"# WARNING {name}: device != cpu oracle", file=sys.stderr)
     speedups = [v["speedup"] for v in per_q.values()]
-    geomean = float(np.exp(np.mean(np.log(speedups))))
-    return {"tpch_suite_scale": scale,
-            "tpch_suite_geomean_speedup": round(geomean, 2),
-            "tpch_suite_queries": per_q,
-            "tpch_suite_note": "single-shot wall times incl. one full "
-            "tunnel RTT per host sync; scan/agg shapes only (joins are "
-            "RTT-bound in this harness, not engine-bound)"}
+    geomean = float(np.exp(np.mean(np.log(speedups)))) if speedups else 0.0
+    return per_q, geomean, compiled_ct
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else SF1_ROWS
-    batch_rows = 1 << 23   # single fused batch: fewest dispatches wins
-    table = gen_lineitem(n)
+    scale = 1.0
+    names = None
+    args = list(sys.argv[1:])
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a.startswith("--queries"):
+            if "=" in a:
+                names = a.split("=", 1)[1].split(",")
+            else:
+                i += 1
+                names = args[i].split(",")
+        else:
+            scale = float(a)
+        i += 1
+    from spark_rapids_tpu import tpch
+    query_names = names or sorted(tpch.QUERIES, key=lambda q: int(q[1:]))
 
-    cpu_t, cpu_r = run_cpu(table)
-    scan = make_device_scan(table, batch_rows)
-    thr_t, thr_r = run_tpu_throughput(scan, PIPELINE_DEPTH)
-    lat_t, lat_r = run_tpu_single(scan)
-    e2e_t, e2e_r = run_tpu_e2e(table, batch_rows)
+    rtt = measure_rtt()
+    print(f"# backend={jax.default_backend()} tunnel RTT ~{rtt*1e3:.0f}ms "
+          f"per host sync", file=sys.stderr)
 
-    for r in (thr_r, lat_r, e2e_r):
-        assert abs(r - cpu_r) / abs(cpu_r) < 1e-6, (r, cpu_r)
+    per_q, geomean, compiled_ct = run_suite(scale, query_names)
 
-    print(f"# rows={n} cpu(pyarrow)={cpu_t*1e3:.1f}ms "
-          f"tpu_resident_per_query={thr_t*1e3:.3f}ms (depth={PIPELINE_DEPTH}) "
-          f"tpu_single_shot={lat_t*1e3:.1f}ms (tunnel RTT ~60ms) "
-          f"tpu_e2e_cold={e2e_t*1e3:.1f}ms (tunnel H2D ~50MB/s)",
-          file=sys.stderr)
+    q6 = per_q.get("q6", {})
     out = {
-        "metric": "tpch_q6_sf1_device_resident_per_query_ms",
-        "value": round(thr_t * 1e3, 3),
-        "unit": "ms",
-        "vs_baseline": round(cpu_t / thr_t, 3),
-        "pipeline_depth": PIPELINE_DEPTH,
-        "single_shot_ms": round(lat_t * 1e3, 3),
-        "e2e_cold_ms": round(e2e_t * 1e3, 3),
-        "cpu_baseline_ms": round(cpu_t * 1e3, 3),
-        "note": "per-query time with K executions batched into one D2H "
-                "fetch; single_shot is dominated by the ~60ms test-harness "
-                "tunnel RTT, not engine time",
+        "metric": f"tpch_sf{scale:g}_suite_geomean_speedup_vs_cpu",
+        "value": round(geomean, 3),
+        "unit": "x",
+        "vs_baseline": round(geomean, 3),
+        "tpch_suite_scale": scale,
+        "tpch_suite_queries": per_q,
+        "tpch_suite_geomean_speedup": round(geomean, 3),
+        "queries_measured": len(per_q),
+        "whole_plan_compiled": compiled_ct,
+        "tunnel_rtt_ms": round(rtt * 1e3, 1),
+        "q6_device_ms": q6.get("device_ms"),
+        "note": "warm single-shot wall per query (one whole-plan XLA "
+                "dispatch + one fetch, device-resident tables, compile "
+                "cached); INCLUDES one tunnel RTT per query — "
+                "tunnel_rtt_ms is the harness floor. CPU baseline = "
+                "same queries on the engine's vectorized pyarrow "
+                "fallback, warm.",
     }
-    try:
-        out.update(run_tpch_suite())
-    except Exception as e:                       # noqa: BLE001
-        print(f"# tpch suite sweep skipped: {e!r}", file=sys.stderr)
     print(json.dumps(out))
 
 

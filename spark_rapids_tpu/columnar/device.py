@@ -82,21 +82,44 @@ class DeviceColumn:
     dictionary: host pyarrow array of unique values for STRING columns
                 (codes index into it); None otherwise.
     data_hi   : high int64 lane for wide decimals; None otherwise.
+
+    RAGGED (ARRAY<primitive>) columns — the SURVEY §7c values+offsets
+    dual-tensor design (reference nested cuDF LIST columns,
+    GpuColumnVector.java type mapping):
+    offsets   : int32, shape (row_capacity + 1,); row i's elements are
+                data[offsets[i]:offsets[i+1]].  Null/padding rows carry
+                empty spans.  When set, `data` is the flat VALUES lane
+                (its own value-capacity bucket) and `validity` stays the
+                per-ROW null mask with shape (row_capacity,).
+    elem_valid: bool per VALUE (null elements); same shape as data.
     """
     data: jax.Array
     validity: jax.Array
     dtype: t.DataType
     dictionary: Optional[pa.Array] = None
     data_hi: Optional[jax.Array] = None
+    offsets: Optional[jax.Array] = None
+    elem_valid: Optional[jax.Array] = None
 
     @property
     def capacity(self) -> int:
+        if self.offsets is not None:
+            return self.offsets.shape[0] - 1
+        return self.data.shape[0]
+
+    @property
+    def value_capacity(self) -> int:
+        """Flat values-lane capacity of a ragged column."""
         return self.data.shape[0]
 
     def nbytes(self) -> int:
         n = self.data.size * self.data.dtype.itemsize + self.validity.size
         if self.data_hi is not None:
             n += self.data_hi.size * 8
+        if self.offsets is not None:
+            n += self.offsets.size * 4
+        if self.elem_valid is not None:
+            n += self.elem_valid.size
         return n
 
     def with_dtype(self, dtype: t.DataType) -> "DeviceColumn":
@@ -201,6 +224,9 @@ def _arrow_column_to_device(arr: pa.Array, dt: t.DataType, capacity: int,
     if n:
         validity_np[:n] = pc.is_valid(arr).to_numpy(zero_copy_only=False)
 
+    if isinstance(dt, t.ArrayType):
+        return _arrow_list_to_device(arr, dt, capacity, validity_np, device)
+
     dictionary = None
     hi = None
     if isinstance(dt, t.StringType):
@@ -244,6 +270,46 @@ def _arrow_column_to_device(arr: pa.Array, dt: t.DataType, capacity: int,
     return DeviceColumn(put(data_np), put(validity_np), dt, dictionary, hi)
 
 
+def _arrow_list_to_device(arr: pa.Array, dt: t.ArrayType, capacity: int,
+                          validity_np: np.ndarray, device=None
+                          ) -> DeviceColumn:
+    """ListArray -> ragged device column: int32 offsets (row capacity+1)
+    + flat values lane in its own bucket.  Null rows get empty spans so
+    kernels never need the row validity to bound a segment."""
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    n = len(arr)
+    if n:
+        arr = arr.cast(pa.list_(arr.type.value_type))
+        raw_off = np.asarray(arr.offsets.to_numpy(zero_copy_only=False),
+                             np.int64)
+        values = arr.values[raw_off[0]:raw_off[-1]]
+        raw_off = raw_off - raw_off[0]
+        # null rows -> empty spans (rebuild offsets monotonically)
+        lens = np.diff(raw_off)
+        lens[~validity_np[:n]] = 0
+        # rebuild a compacted values array when null rows carried values
+        if lens.sum() != len(values):
+            keep = np.zeros(len(values), bool)
+            for i in range(n):
+                if validity_np[i]:
+                    keep[raw_off[i]:raw_off[i + 1]] = True
+            values = values.filter(pa.array(keep))
+        off = np.zeros(capacity + 1, np.int32)
+        off[1:n + 1] = np.cumsum(lens).astype(np.int32)
+        off[n + 1:] = off[n]
+    else:
+        values = pa.array([], dtype_to_arrow(dt.element_type))
+        off = np.zeros(capacity + 1, np.int32)
+
+    vcap = bucket_capacity(max(len(values), 1))
+    vcol = _arrow_column_to_device(values, dt.element_type, vcap, device)
+    put = (lambda x: jax.device_put(x, device)) if device is not None \
+        else jnp.asarray
+    return DeviceColumn(vcol.data, put(validity_np), dt,
+                        vcol.dictionary, vcol.data_hi,
+                        offsets=put(off), elem_valid=vcol.validity)
+
+
 def to_device(hb: HostBatch, conf: TpuConf = DEFAULT_CONF,
               capacity: Optional[int] = None, device=None) -> DeviceBatch:
     cap = capacity or bucket_capacity(max(hb.num_rows, 1), conf)
@@ -260,13 +326,25 @@ def to_device(hb: HostBatch, conf: TpuConf = DEFAULT_CONF,
 def _device_column_to_arrow(col: DeviceColumn, num_rows: int,
                             fetched=None) -> pa.Array:
     if fetched is not None:
-        data_np, valid_np, hi_np = fetched
+        data_np, valid_np, hi_np, off_np, ev_np = fetched
     else:
-        data_np, valid_np, hi_np = jax.device_get(
-            (col.data, col.validity, col.data_hi))
+        data_np, valid_np, hi_np, off_np, ev_np = jax.device_get(
+            (col.data, col.validity, col.data_hi, col.offsets,
+             col.elem_valid))
+    dt = col.dtype
+    if isinstance(dt, t.ArrayType):
+        off = np.asarray(off_np)[:num_rows + 1].astype(np.int32)
+        nvals = int(off[-1]) if len(off) else 0
+        vcol = DeviceColumn(col.data, col.elem_valid, dt.element_type,
+                            col.dictionary)
+        values = _device_column_to_arrow(
+            vcol, nvals, (data_np, ev_np, None, None, None))
+        valid = np.asarray(valid_np)[:num_rows].astype(bool)
+        return pa.ListArray.from_arrays(
+            pa.array(off, pa.int32()), values,
+            mask=pa.array(~valid) if not valid.all() else None)
     data = np.asarray(data_np)[:num_rows]
     valid = np.asarray(valid_np)[:num_rows].astype(bool)
-    dt = col.dtype
     if isinstance(dt, t.StringType):
         codes = np.where(valid, data, -1).astype(np.int32)
         dict_arr = col.dictionary if col.dictionary is not None else pa.array([], pa.string())
@@ -311,8 +389,8 @@ def to_host(db: DeviceBatch) -> HostBatch:
     # ONE D2H round trip for the row count and every lane of every column
     # (a separate int(num_rows) fetch would double the tunnel RTTs)
     n_f, fetched = jax.device_get(
-        (db.num_rows, [(c.data, c.validity, c.data_hi)
-                       for c in db.columns]))
+        (db.num_rows, [(c.data, c.validity, c.data_hi, c.offsets,
+                        c.elem_valid) for c in db.columns]))
     n = int(n_f)
     arrays = [_device_column_to_arrow(c, n, f)
               for c, f in zip(db.columns, fetched)]

@@ -122,6 +122,28 @@ WHOLE_PLAN_COMPILE = conf(
     "automatically fall back to the eager engine.",
     checker=_enum_checker("AUTO", "ON", "OFF"), commonly_used=True)
 
+SESSION_TIMEZONE = conf(
+    "spark.sql.session.timeZone", "UTC",
+    "Session timezone for timestamp field extraction, truncation and "
+    "date<->timestamp casts. Non-UTC zones convert on device through a "
+    "precomputed IANA transition table (ops/timezone.py — the "
+    "GpuTimeZoneDB role).", commonly_used=True)
+
+MESH_ENABLED = conf(
+    "spark.rapids.tpu.sql.mesh.enabled", False,
+    "Execute device plans SPMD over ALL addressable chips: leaf scans "
+    "shard row-wise across a jax.sharding.Mesh and the whole-plan XLA "
+    "program is GSPMD-partitioned, with cross-chip exchanges (groupby, "
+    "sort, join) riding ICI collectives inserted by XLA. The "
+    "multi-chip execution fabric (reference RapidsShuffleManager/UCX "
+    "role). Requires >=2 addressable devices; single-device sessions "
+    "ignore it.", commonly_used=True)
+
+MESH_DEVICES = conf(
+    "spark.rapids.tpu.sql.mesh.devices", 0,
+    "Number of mesh devices for SPMD execution (0 = all addressable).",
+    checker=lambda v: None if v >= 0 else "must be >= 0")
+
 CONCURRENT_TPU_TASKS = conf(
     "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
     "Number of concurrent tasks allowed to hold device memory at once "

@@ -93,21 +93,33 @@ def _batch_meta(db: DeviceBatch):
 
 
 def _col_lanes(db: DeviceBatch):
-    """Per-column jit argument: the data lane, or (data, hi) for two-lane
-    wide-decimal host columns (pytree — jit handles the nesting)."""
-    return tuple(c.data if c.data_hi is None else (c.data, c.data_hi)
-                 for c in db.columns)
+    """Per-column jit argument: the data lane, (data, hi) for two-lane
+    wide-decimal host columns, or (data, offsets, elem_valid) for ragged
+    ARRAY columns (pytrees — jit handles the nesting)."""
+    out = []
+    for c in db.columns:
+        if c.offsets is not None:
+            out.append((c.data, c.offsets, c.elem_valid))
+        elif c.data_hi is not None:
+            out.append((c.data, c.data_hi))
+        else:
+            out.append(c.data)
+    return tuple(out)
 
 
 def _build_inputs(meta, col_data, col_valid):
     inputs = {}
     raw = {}
     for (name, dtype, dictionary), d, v in zip(meta, col_data, col_valid):
-        hi = None
+        hi = offsets = elem_valid = None
         if isinstance(d, tuple):
-            d, hi = d
-        inputs[name] = DevVal(compute_view(d, dtype), v, dtype, dictionary,
-                              hi)
+            if len(d) == 3:
+                d, offsets, elem_valid = d
+            else:
+                d, hi = d
+        view = d if offsets is not None else compute_view(d, dtype)
+        inputs[name] = DevVal(view, v, dtype, dictionary, hi,
+                              offsets=offsets, elem_valid=elem_valid)
         raw[name] = d          # storage lane (f64-bits stay int64)
     return inputs, raw
 
@@ -154,12 +166,15 @@ def evaluate_projection(exprs: Sequence[Expression], names: Sequence[str],
             outs = []
             for e in exprs_t:
                 dv = e.eval_dev(ctx)
-                data = storage_view(dv.data, e.dtype)
+                data = dv.data if dv.offsets is not None \
+                    else storage_view(dv.data, e.dtype)
                 valid = dv.validity if dv.validity is not None \
                     else jnp.ones((capacity,), bool)
                 # two-lane wide decimals keep their hi lane through the
-                # projection (dropping it would corrupt |values| >= 2^63)
-                outs.append((data, valid & live, dv.hi))
+                # projection (dropping it would corrupt |values| >= 2^63);
+                # ragged (ARRAY) results keep offsets + element validity
+                outs.append((data, valid & live, dv.hi, dv.offsets,
+                             dv.elem_valid))
             return outs
 
         fn = jax.jit(run)
@@ -169,8 +184,9 @@ def evaluate_projection(exprs: Sequence[Expression], names: Sequence[str],
     col_valid = tuple(c.validity for c in db.columns)
     outs = fn(col_data, col_valid, _num_rows_scalar(db.num_rows), aux)
     cols = []
-    for (data, valid, hi), e, hv in zip(outs, exprs, hostvals):
-        cols.append(DeviceColumn(data, valid, e.dtype, hv.dictionary, hi))
+    for (data, valid, hi, offsets, ev), e, hv in zip(outs, exprs, hostvals):
+        cols.append(DeviceColumn(data, valid, e.dtype, hv.dictionary,
+                                 hi, offsets=offsets, elem_valid=ev))
     return DeviceBatch(cols, db.num_rows, list(names), db.origin_file)
 
 

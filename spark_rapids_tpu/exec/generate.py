@@ -1,0 +1,100 @@
+"""Device explode/posexplode over ragged columns (GpuGenerateExec role).
+
+Reference: GpuGenerateExec.scala:829 runs explode on GPU via cuDF LIST
+explode.  TPU-native over the values+offsets layout (ops/ragged.py) the
+output is almost free: the exploded rows ARE the values lane — parent
+columns gather through the per-value row-id lane, `pos` is
+`arange - offsets[row]`, and the output's static capacity is the values
+lane's own bucket, so the whole operator is sync-free (whole-plan
+traceable).
+
+Like Spark's GenerateExec.requiredChildOutput, the exploded ARRAY input
+column is pruned from the output — the overrides meta places this exec
+only when the parent operator provably never reads it (re-expanding each
+row's array per element would be quadratic in values).
+
+`outer` explode additionally emits the rows whose array is null/empty
+with a null element (and null pos), as a second compacted batch.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as t
+from ..columnar.device import DeviceBatch, DeviceColumn
+from ..ops import ragged as R
+from ..ops.filter import compact_batch, gather_batch
+from .plan import ExecContext, PlanNode
+
+
+class GenerateExec(PlanNode):
+    """explode/posexplode(col): child columns (minus the array input)
+    ++ [pos,] col."""
+
+    def __init__(self, generator, output_names: List[str], child: PlanNode):
+        super().__init__(child)
+        self.generator = generator.bind(child.output_schema)
+        gen_fields = self.generator.output_fields()
+        self.output_names = list(output_names) or \
+            [f.name for f in gen_fields]
+        self._gen_fields = gen_fields
+        self._arr_name = self.generator.child.name
+
+    @property
+    def output_schema(self) -> t.StructType:
+        fields = [f for f in self.child.output_schema.fields
+                  if f.name != self._arr_name]
+        for f, n in zip(self._gen_fields, self.output_names):
+            fields.append(t.StructField(n, f.data_type, f.nullable))
+        return t.StructType(fields)
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        gen = self.generator
+        out_names = list(self.output_schema.names)
+        for db in self.child.execute(ctx):
+            col = db.column_by_name(self._arr_name)
+            keep_idx = [i for i, n in enumerate(db.names)
+                        if n != self._arr_name]
+            parent_src = db.select(keep_idx)
+
+            vcap = col.value_capacity
+            rid = R.row_ids(col.offsets, vcap)
+            live = R.value_live(col.offsets, vcap, db.num_rows)
+            n_out = col.offsets[jnp.int32(db.num_rows)]
+
+            safe_rid = jnp.clip(rid, 0, db.capacity - 1)
+            parent = gather_batch(parent_src,
+                                  jnp.where(live, safe_rid, -1),
+                                  n_out, null_out_of_bounds=True)
+            out_cols = list(parent.columns)
+            if gen.pos:
+                pos = jnp.arange(vcap, dtype=jnp.int32) - \
+                    jnp.take(col.offsets, safe_rid)
+                out_cols.append(DeviceColumn(pos, live, t.INT))
+            out_cols.append(DeviceColumn(col.data, col.elem_valid & live,
+                                         gen.child.dtype.element_type,
+                                         col.dictionary))
+            yield DeviceBatch(out_cols, n_out, out_names)
+
+            if gen.outer:
+                # rows with null/empty arrays emit once with null col/pos
+                lens = col.offsets[1:] - col.offsets[:-1]
+                empty = db.row_mask() & ((lens == 0) | ~col.validity)
+                base = compact_batch(parent_src, empty, ctx.conf)
+                extra = list(base.columns)
+                cap = base.capacity
+                if gen.pos:
+                    extra.append(DeviceColumn(
+                        jnp.zeros((cap,), jnp.int32),
+                        jnp.zeros((cap,), bool), t.INT))
+                extra.append(DeviceColumn(
+                    jnp.zeros((cap,), col.data.dtype),
+                    jnp.zeros((cap,), bool),
+                    gen.child.dtype.element_type, col.dictionary))
+                yield DeviceBatch(extra, base.num_rows, out_names)
+
+    def describe(self):
+        return f"GenerateExec[{self.generator!r}]"

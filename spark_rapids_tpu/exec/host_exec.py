@@ -288,14 +288,26 @@ class CpuAggregateExec(HostNode):
                                              schema=pa.schema(out_fields))
             return
 
-        if any(fn.cpu_agg()[0] == "_py" for _c, fn in agg_specs):
-            yield self._python_grouped(work, agg_specs)
-            return
+        # "_py" aggregates that decompose into arrow parts (decimal avg ->
+        # sum+count) keep the whole grouped path on C++ kernels; only
+        # undecomposable ones force the python loop
+        splits = {}
+        for col, fn in agg_specs:
+            if fn.cpu_agg()[0] == "_py":
+                sp = fn.cpu_agg_split()
+                if sp is None:
+                    yield self._python_grouped(work, agg_specs)
+                    return
+                splits[col] = sp
 
         gb_aggs = []
         for col, fn in agg_specs:
-            fname, opts = fn.cpu_agg()
-            gb_aggs.append((col, fname, opts))
+            if col in splits:
+                for fname, opts in splits[col][0]:
+                    gb_aggs.append((col, fname, opts))
+            else:
+                fname, opts = fn.cpu_agg()
+                gb_aggs.append((col, fname, opts))
         res = work.group_by([f"_k{i}" for i in range(len(self.keys))],
                             use_threads=False).aggregate(gb_aggs)
         # order output columns: keys then aggs, cast to declared types
@@ -305,9 +317,16 @@ class CpuAggregateExec(HostNode):
             out_arrays.append(a)
             out_fields.append(pa.field(kname, a.type))
         for j, ((col, fn), (_, oname)) in enumerate(zip(agg_specs, self.aggs)):
-            fname, _ = fn.cpu_agg()
-            a = res[f"{col}_{fname}"].combine_chunks().cast(
-                dtype_to_arrow(fn.dtype))
+            want = dtype_to_arrow(fn.dtype)
+            if col in splits:
+                parts, finish = splits[col]
+                lanes = [res[f"{col}_{fname}"].to_pylist()
+                         for fname, _o in parts]
+                vals = [finish(*row) for row in zip(*lanes)]
+                a = pa.array(vals, want)
+            else:
+                fname, _ = fn.cpu_agg()
+                a = res[f"{col}_{fname}"].combine_chunks().cast(want)
             out_arrays.append(a)
             out_fields.append(pa.field(oname, a.type))
         tbl = pa.Table.from_arrays(out_arrays, schema=pa.schema(out_fields))

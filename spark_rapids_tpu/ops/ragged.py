@@ -1,0 +1,155 @@
+"""Ragged (ARRAY<primitive>) device kernels over values+offsets lanes.
+
+Reference: cuDF LIST columns are first-class device types consumed by
+collectionOperations.scala, higherOrderFunctions.scala and
+GpuGenerateExec.scala:829.  XLA has no ragged tensors, so the TPU-native
+layout is the SURVEY §7c dual-tensor design: a flat VALUES lane (own
+static bucket) plus an int32 offsets lane per row; every kernel below is
+a composition of segment primitives (searchsorted row-ids, segment
+min/max/sum, masked compaction) that XLA fuses — no per-row loops, no
+host round trips.
+
+The segment workhorse: `row_ids(offsets, vcap)` maps each value-lane slot
+to its owning row via one vectorized searchsorted; everything else rides
+`jax.ops.segment_*` over that id lane.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as t
+from ..columnar.device import DeviceColumn
+
+
+def row_ids(offsets: jax.Array, vcap: int) -> jax.Array:
+    """Owning row index per values-lane slot; slots past the last live
+    value map to the (invalid) final row id."""
+    pos = jnp.arange(vcap, dtype=jnp.int32)
+    return (jnp.searchsorted(offsets, pos, side="right") - 1) \
+        .astype(jnp.int32)
+
+
+def value_live(offsets: jax.Array, vcap: int, num_rows) -> jax.Array:
+    """True for value slots belonging to live rows (< num_rows)."""
+    end = offsets[jnp.int32(num_rows)]
+    return jnp.arange(vcap, dtype=jnp.int32) < end
+
+
+def sizes(col: DeviceColumn) -> Tuple[jax.Array, jax.Array]:
+    """Per-row element count (int32) + row validity."""
+    off = col.offsets
+    n = off[1:] - off[:-1]
+    return n.astype(jnp.int32), col.validity
+
+
+def get_item(col: DeviceColumn, index: int) -> Tuple[jax.Array, jax.Array]:
+    """array[index] per row: (values gathered, validity)."""
+    off = col.offsets
+    lens = off[1:] - off[:-1]
+    idx = off[:-1] + jnp.int32(index)
+    ok = col.validity & (jnp.int32(index) >= 0) & (jnp.int32(index) < lens)
+    safe = jnp.clip(idx, 0, col.value_capacity - 1)
+    data = jnp.take(col.data, safe)
+    valid = ok & jnp.take(col.elem_valid, safe)
+    return data, valid
+
+
+def contains(col: DeviceColumn, needle, num_rows) -> Tuple[jax.Array,
+                                                           jax.Array]:
+    """array_contains(arr, v) — Spark: null array -> null; true if any
+    element equals v; else null if the array has null elements, false
+    otherwise."""
+    vcap = col.value_capacity
+    rid = row_ids(col.offsets, vcap)
+    live = value_live(col.offsets, vcap, num_rows)
+    cap = col.capacity
+    hit = (col.data == needle) & col.elem_valid & live
+    has_hit = jax.ops.segment_max(hit.astype(jnp.int32), rid,
+                                  num_segments=cap) > 0
+    has_null = jax.ops.segment_max(
+        ((~col.elem_valid) & live).astype(jnp.int32), rid,
+        num_segments=cap) > 0
+    data = has_hit
+    valid = col.validity & (has_hit | ~has_null)
+    return data, valid
+
+
+def _segment_minmax(col: DeviceColumn, num_rows, is_min: bool
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """array_min/array_max ignoring null elements; empty/all-null -> null."""
+    vcap = col.value_capacity
+    rid = row_ids(col.offsets, vcap)
+    live = value_live(col.offsets, vcap, num_rows) & col.elem_valid
+    cap = col.capacity
+    info_dtype = col.data.dtype
+    if jnp.issubdtype(info_dtype, jnp.floating):
+        ident = jnp.array(jnp.inf if is_min else -jnp.inf, info_dtype)
+    else:
+        ii = jnp.iinfo(info_dtype)
+        ident = jnp.array(ii.max if is_min else ii.min, info_dtype)
+    vals = jnp.where(live, col.data, ident)
+    seg = jax.ops.segment_min if is_min else jax.ops.segment_max
+    out = seg(vals, rid, num_segments=cap)
+    any_val = jax.ops.segment_max(live.astype(jnp.int32), rid,
+                                  num_segments=cap) > 0
+    return out, col.validity & any_val
+
+
+def array_min(col, num_rows):
+    return _segment_minmax(col, num_rows, True)
+
+
+def array_max(col, num_rows):
+    return _segment_minmax(col, num_rows, False)
+
+
+def sort_within(col: DeviceColumn, num_rows, asc: bool = True
+                ) -> DeviceColumn:
+    """sort_array: order elements within each row (nulls first for asc,
+    last for desc — Spark SortArray semantics)."""
+    vcap = col.value_capacity
+    rid = row_ids(col.offsets, vcap)
+    live = value_live(col.offsets, vcap, num_rows)
+    from .sort import _to_unsigned_comparable
+    lane = _to_unsigned_comparable(col.data)
+    if not asc:
+        lane = ~lane
+    null_lane = jnp.where(col.elem_valid, jnp.uint8(1), jnp.uint8(0)) \
+        if asc else jnp.where(col.elem_valid, jnp.uint8(0), jnp.uint8(1))
+    # segment-local stable sort: [value, nulls, row, liveness] minor->major
+    perm = jnp.lexsort([lane, null_lane, rid,
+                        (~live).astype(jnp.int8)])
+    return DeviceColumn(jnp.take(col.data, perm), col.validity, col.dtype,
+                        col.dictionary,
+                        None if col.data_hi is None
+                        else jnp.take(col.data_hi, perm),
+                        offsets=col.offsets,
+                        elem_valid=jnp.take(col.elem_valid, perm))
+
+
+def filter_values(col: DeviceColumn, keep_vals: jax.Array, num_rows
+                  ) -> DeviceColumn:
+    """Higher-order filter: keep values where the (values-lane) predicate
+    holds; offsets recompute from per-row surviving counts."""
+    vcap = col.value_capacity
+    rid = row_ids(col.offsets, vcap)
+    live = value_live(col.offsets, vcap, num_rows)
+    keep = keep_vals & live
+    # stable compaction ordered by (row, original position)
+    order = jnp.lexsort([jnp.arange(vcap, dtype=jnp.int32),
+                         (~keep).astype(jnp.int8)])
+    new_counts = jax.ops.segment_sum(keep.astype(jnp.int32), rid,
+                                     num_segments=col.capacity)
+    new_off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(new_counts).astype(jnp.int32)])
+    return DeviceColumn(jnp.take(col.data, order), col.validity,
+                        col.dtype, col.dictionary,
+                        None if col.data_hi is None
+                        else jnp.take(col.data_hi, order),
+                        offsets=new_off,
+                        elem_valid=jnp.take(col.elem_valid, order) & (
+                            jnp.arange(vcap, dtype=jnp.int32)
+                            < new_off[-1]))

@@ -1,0 +1,112 @@
+"""Session-timezone conversion via precomputed transition tables.
+
+Reference: GpuTimeZoneDB (spark-rapids-jni) loads the IANA tz database
+into a GPU-resident transition table so non-UTC timestamp operations run
+device-side (SURVEY §2.9; datetimeExpressions.scala + TimeZoneDB.scala).
+TPU-native equivalent: the table is two small int64 lanes
+(UTC transition instants, offsets) built once per zone from the OS tzdata
+(zoneinfo) and shipped to the device through the aux-upload cache; the
+conversion is one vectorized `searchsorted` + gather — branchless, fully
+traceable inside whole-plan programs.
+
+Wall->UTC (the DST-gap/overlap minefield) follows Spark/java.time
+semantics: ambiguous local times take the EARLIER offset; skipped local
+times shift forward by the gap.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_US = 1_000_000
+
+
+@functools.lru_cache(maxsize=64)
+def transition_table(tz_name: str) -> Tuple[np.ndarray, np.ndarray]:
+    """-> (utc_instants_us ascending, offsets_us) with a leading sentinel
+    so searchsorted-1 always lands on a valid row.
+
+    Built by probing zoneinfo at UTC year boundaries and bisecting down
+    to exact transition instants — exact for every zone the OS tzdata
+    knows, without reaching into private tzfile internals."""
+    from zoneinfo import ZoneInfo
+    tz = ZoneInfo(tz_name)
+
+    def off_us(utc_us: int) -> int:
+        ts = _dt.datetime.fromtimestamp(utc_us / _US, _dt.timezone.utc)
+        return int(ts.astimezone(tz).utcoffset().total_seconds() * _US)
+
+    lo_year, hi_year = 1900, 2100
+    instants = [int(_dt.datetime(lo_year, 1, 1,
+                                 tzinfo=_dt.timezone.utc).timestamp()) * _US]
+    offsets = [off_us(instants[0])]
+    probe = instants[0]
+    # 4-day probe window: fine enough that no real zone transitions twice
+    # inside one window (Morocco's paired Ramadan transitions are weeks
+    # apart; a 6-month window cancels them out entirely)
+    step = 4 * 86400 * _US
+    cur_off = offsets[0]
+    end = int(_dt.datetime(hi_year, 1, 1,
+                           tzinfo=_dt.timezone.utc).timestamp()) * _US
+    while probe < end:
+        nxt = probe + step
+        o = off_us(nxt)
+        if o != cur_off:
+            # bisect the exact transition instant in [probe, nxt]
+            lo, hi = probe, nxt
+            while hi - lo > _US:
+                mid = (lo + hi) // 2
+                if off_us(mid) == cur_off:
+                    lo = mid
+                else:
+                    hi = mid
+            instants.append(hi)
+            offsets.append(o)
+            cur_off = o
+        probe = nxt
+    return (np.asarray(instants, np.int64), np.asarray(offsets, np.int64))
+
+
+@functools.lru_cache(maxsize=64)
+def wall_table(tz_name: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Transition table keyed by LOCAL wall instants for wall->UTC:
+    (wall_points_us ascending, offsets_us).  Points are each transition's
+    pre-gap wall time; ambiguous ranges resolve to the EARLIER offset by
+    taking the last point <= wall (Spark/java.time's default)."""
+    utc_pts, offs = transition_table(tz_name)
+    wall_pts = [utc_pts[0] + offs[0]]
+    w_offs = [offs[0]]
+    for i in range(1, len(utc_pts)):
+        prev_off, new_off = int(offs[i - 1]), int(offs[i])
+        t_utc = int(utc_pts[i])
+        # Switch at wall = t + max(prev, new):
+        #  * spring-forward gap [t+prev, t+new): walls below t+new keep
+        #    the PREVIOUS offset, so a skipped wall shifts FORWARD by the
+        #    gap (java.time/Spark: 02:30 EST-gap -> 07:30 UTC);
+        #  * fall-back overlap [t+new, t+prev): the EARLIER offset wins
+        #    inside the overlap, switching only at the overlap end.
+        wall_pts.append(t_utc + max(prev_off, new_off))
+        w_offs.append(new_off)
+    return (np.asarray(wall_pts, np.int64), np.asarray(w_offs, np.int64))
+
+
+def utc_to_local(ts_us: jax.Array, points: jax.Array,
+                 offsets: jax.Array) -> jax.Array:
+    """Local wall-clock micros for UTC instants (vectorized)."""
+    idx = jnp.clip(jnp.searchsorted(points, ts_us, side="right") - 1,
+                   0, points.shape[0] - 1)
+    return ts_us + jnp.take(offsets, idx)
+
+
+def local_to_utc(wall_us: jax.Array, wall_points: jax.Array,
+                 offsets: jax.Array) -> jax.Array:
+    """UTC instants for local wall-clock micros (earlier-offset rule for
+    ambiguous walls; skipped walls shift forward by the gap)."""
+    idx = jnp.clip(jnp.searchsorted(wall_points, wall_us, side="right") - 1,
+                   0, wall_points.shape[0] - 1)
+    return wall_us - jnp.take(offsets, idx)

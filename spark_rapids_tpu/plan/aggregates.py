@@ -74,6 +74,12 @@ class AggregateFunction:
     def cpu_agg(self) -> Tuple[str, object]:
         raise NotImplementedError
 
+    def cpu_agg_split(self):
+        """Optional decomposition of a "_py" aggregate into arrow-grouped
+        parts + a per-group finisher: ([(fname, opts), ...], finish).
+        None = no decomposition (the python grouped path handles it)."""
+        return None
+
     def __repr__(self):
         return f"{self.name}({self.child!r})"
 
@@ -259,6 +265,24 @@ class Average(AggregateFunction):
                     quant, rounding=pydec.ROUND_HALF_UP)
             return ("_py", py_avg)
         return ("mean", None)
+
+    def cpu_agg_split(self):
+        """Grouped decimal avg decomposes into arrow sum+count with a
+        per-GROUP python finish (exact Spark scale), keeping the grouped
+        path on vectorized C++ kernels instead of a per-ROW python loop."""
+        if not isinstance(self.child.dtype, t.DecimalType):
+            return None
+        import decimal as pydec
+        out_t = self.dtype
+        quant = pydec.Decimal(1).scaleb(-out_t.scale)
+
+        def finish(s, c):
+            if s is None or not c:
+                return None
+            return (pydec.Decimal(s) / c).quantize(
+                quant, rounding=pydec.ROUND_HALF_UP)
+        return ([("sum", None),
+                 ("count", pc.CountOptions(mode="only_valid"))], finish)
 
 
 class First(AggregateFunction):

@@ -22,13 +22,49 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from .. import types as t
-from .expressions import Expression, Literal
+from .expressions import DevVal, Expression, Literal
 
 _OFF_DEVICE = ("ARRAY values live on the CPU path (device lanes are flat)")
 
 
+def _device_elem_ok(dt: t.DataType) -> bool:
+    """Element types the ragged device kernels handle (ops/ragged.py):
+    single integer-comparable lane.  DOUBLE (two storage lanes), wide
+    decimal and nested elements stay on the CPU path."""
+    return isinstance(dt, (t.ByteType, t.ShortType, t.IntegerType,
+                           t.LongType, t.FloatType, t.BooleanType,
+                           t.DateType))
+
+
+def _ragged_child_ok(e: Expression) -> bool:
+    """The array input has a ragged DEVICE representation: a column
+    reference (scan/project carries offsets lanes) or a device-eligible
+    higher-order result, with a device-supported element type."""
+    from .expressions import ColumnRef
+    if not isinstance(e.dtype, t.ArrayType) or \
+            not _device_elem_ok(e.dtype.element_type):
+        return False
+    if isinstance(e, ColumnRef):
+        return True
+    return isinstance(e, (ArrayFilter, ArrayTransform, SortArray)) and \
+        not e.unsupported_reasons(None)
+
+
+def _as_ragged_col(dv):
+    """Ragged DevVal -> the DeviceColumn shape ops/ragged.py consumes."""
+    import jax.numpy as jnp
+    from ..columnar.device import DeviceColumn
+    validity = dv.validity
+    if validity is None:
+        validity = jnp.ones((dv.offsets.shape[0] - 1,), bool)
+    return DeviceColumn(dv.data, validity, dv.dtype, dv.dictionary,
+                        None, offsets=dv.offsets,
+                        elem_valid=dv.elem_valid)
+
+
 class ArrayExpression(Expression):
-    """Base: CPU-evaluated; never placed on device."""
+    """Base: CPU-evaluated unless a subclass provides a ragged device
+    kernel (ops/ragged.py) and the input qualifies (_ragged_child_ok)."""
 
     def unsupported_reasons(self, conf):
         return [_OFF_DEVICE]
@@ -64,12 +100,24 @@ class Size(ArrayExpression):
     """size(array) — Spark: null input -> -1 with legacy conf, null
     otherwise; modern default (spark.sql.legacy.sizeOfNull=false) -> null."""
 
+    eval_dev = Expression.eval_dev
+
     def __init__(self, child: Expression):
         self.children = (child,)
 
     def _resolve(self):
         self.dtype = t.INT
         self.nullable = True
+
+    def unsupported_reasons(self, conf):
+        if _ragged_child_ok(self.children[0]):
+            return []
+        return [_OFF_DEVICE]
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops import ragged as R
+        data, valid = R.sizes(_as_ragged_col(kids[0]))
+        return DevVal(data, valid, t.INT)
 
     def _eval_cpu(self, rb, kids):
         return pc.list_value_length(kids[0]).cast(pa.int32())
@@ -88,6 +136,19 @@ class GetArrayItem(ArrayExpression):
 
     def _fp_extra(self):
         return str(self.index)
+
+    eval_dev = Expression.eval_dev
+
+    def unsupported_reasons(self, conf):
+        if _ragged_child_ok(self.children[0]):
+            return []
+        return [_OFF_DEVICE]
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops import ragged as R
+        data, valid = R.get_item(_as_ragged_col(kids[0]), self.index)
+        return DevVal(data, valid, self.dtype,
+                      kids[0].dictionary)
 
     def _eval_cpu(self, rb, kids):
         out = []
@@ -114,6 +175,21 @@ class ArrayContains(ArrayExpression):
 
     def _fp_extra(self):
         return repr(self.value)
+
+    eval_dev = Expression.eval_dev
+
+    def unsupported_reasons(self, conf):
+        if _ragged_child_ok(self.children[0]) and \
+                isinstance(self.value, (int, float, bool)):
+            return []
+        return [_OFF_DEVICE]
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops import ragged as R
+        col = _as_ragged_col(kids[0])
+        needle = col.data.dtype.type(self.value)
+        data, valid = R.contains(col, needle, ctx.num_rows)
+        return DevVal(data, valid, t.BOOLEAN)
 
     def _eval_cpu(self, rb, kids):
         out = []
@@ -144,6 +220,20 @@ class SortArray(ArrayExpression):
     def _fp_extra(self):
         return str(self.ascending)
 
+    eval_dev = Expression.eval_dev
+
+    def unsupported_reasons(self, conf):
+        if _ragged_child_ok(self.children[0]):
+            return []
+        return [_OFF_DEVICE]
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops import ragged as R
+        out = R.sort_within(_as_ragged_col(kids[0]), ctx.num_rows,
+                            self.ascending)
+        return DevVal(out.data, out.validity, self.dtype, out.dictionary,
+                      offsets=out.offsets, elem_valid=out.elem_valid)
+
     def _eval_cpu(self, rb, kids):
         out = []
         for v in kids[0].to_pylist():
@@ -162,6 +252,9 @@ class SortArray(ArrayExpression):
 class ArrayMin(ArrayExpression):
     name = "array_min"
     _pick = staticmethod(min)
+    _is_min = True
+
+    eval_dev = Expression.eval_dev
 
     def __init__(self, child: Expression):
         self.children = (child,)
@@ -169,6 +262,19 @@ class ArrayMin(ArrayExpression):
     def _resolve(self):
         self.dtype = self.children[0].dtype.element_type
         self.nullable = True
+
+    def unsupported_reasons(self, conf):
+        if _ragged_child_ok(self.children[0]) and not \
+                isinstance(self.dtype, t.BooleanType):
+            return []
+        return [_OFF_DEVICE]
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops import ragged as R
+        col = _as_ragged_col(kids[0])
+        fn = R.array_min if self._is_min else R.array_max
+        data, valid = fn(col, ctx.num_rows)
+        return DevVal(data, valid, self.dtype, kids[0].dictionary)
 
     def _eval_cpu(self, rb, kids):
         out = []
@@ -182,6 +288,7 @@ class ArrayMin(ArrayExpression):
 class ArrayMax(ArrayMin):
     name = "array_max"
     _pick = staticmethod(max)
+    _is_min = False
 
 
 class ExplodeGen:
@@ -242,6 +349,9 @@ class LambdaVar(Expression):
     def _fp_extra(self):
         return self.name
 
+    def _eval_dev(self, ctx, kids):
+        return ctx.inputs[self.name]
+
     def _eval_cpu(self, rb, kids):
         return rb.column(rb.schema.names.index(self.name))
 
@@ -270,8 +380,48 @@ class _HigherOrder(ArrayExpression):
     def _fp_extra(self):
         return f"{self.var};{self.body.fingerprint()}"
 
+    eval_dev = Expression.eval_dev
+
     def unsupported_reasons(self, conf):
+        if _ragged_child_ok(self.children[0]) and \
+                self._body_device_ok(conf):
+            return []
         return [_OFF_DEVICE]
+
+    def _body_device_ok(self, conf) -> bool:
+        """Elementwise body over the lambda variable only: every leaf is a
+        LambdaVar or Literal and every node has a device kernel (outer
+        column references would need a row-broadcast to the values lane —
+        not yet wired)."""
+        from .expressions import ColumnRef
+
+        def walk(e) -> bool:
+            if isinstance(e, ColumnRef):
+                return False
+            if e.unsupported_reasons(conf):
+                return False
+            return all(walk(c) for c in e.children)
+        return walk(self.body)
+
+    def _prepare(self, pctx, kids):
+        from .expressions import HostVal
+        self.body.prepare(pctx)       # register the body's aux slots
+        return HostVal()
+
+    def _lambda_eval(self, ctx, kids):
+        """Evaluate the body over the flat VALUES lane (the reference's
+        bound-lambda batching, vectorized end to end)."""
+        import jax.numpy as jnp
+        from .expressions import EvalCtx
+        col = _as_ragged_col(kids[0])
+        n_vals = col.offsets[jnp.int32(ctx.num_rows)]
+        elem_dv = DevVal(col.data, col.elem_valid,
+                         self.children[0].dtype.element_type,
+                         col.dictionary)
+        ectx = EvalCtx(col.value_capacity, n_vals,
+                       {self.var: elem_dv}, ctx.aux, ctx.node_slots,
+                       ctx.conf)
+        return col, self.body.eval_dev(ectx)
 
     def _flat_eval(self, kids):
         """(lists, flat body results) for the single array child."""
@@ -296,6 +446,16 @@ class ArrayTransform(_HigherOrder):
         self.dtype = t.ArrayType(self.body.dtype)
         self.nullable = self.children[0].nullable
 
+    def _eval_dev(self, ctx, kids):
+        import jax.numpy as jnp
+        from ..ops.kernels import storage_view
+        col, body = self._lambda_eval(ctx, kids)
+        ev = body.validity if body.validity is not None \
+            else jnp.ones((col.value_capacity,), bool)
+        return DevVal(storage_view(body.data, self.body.dtype),
+                      kids[0].validity, self.dtype, body.dictionary,
+                      offsets=col.offsets, elem_valid=ev)
+
     def _eval_cpu(self, rb, kids):
         lists, flat = self._flat_eval(kids)
         from ..columnar.host import dtype_to_arrow
@@ -315,6 +475,18 @@ class ArrayFilter(_HigherOrder):
     def _resolve(self):
         self.dtype = self.children[0].dtype
         self.nullable = self.children[0].nullable
+
+    def _eval_dev(self, ctx, kids):
+        import jax.numpy as jnp
+        from ..ops import ragged as R
+        col, body = self._lambda_eval(ctx, kids)
+        keep = body.data.astype(bool)
+        if body.validity is not None:
+            keep = keep & body.validity      # null predicate -> dropped
+        out = R.filter_values(col, keep, ctx.num_rows)
+        return DevVal(out.data, kids[0].validity, self.dtype,
+                      out.dictionary, offsets=out.offsets,
+                      elem_valid=out.elem_valid)
 
     def _eval_cpu(self, rb, kids):
         lists, flat = self._flat_eval(kids)
@@ -338,6 +510,28 @@ class ArrayExists(_HigherOrder):
     def _resolve(self):
         self.dtype = t.BOOLEAN
         self.nullable = True
+
+    def _eval_dev(self, ctx, kids):
+        import jax
+        import jax.numpy as jnp
+        from ..ops import ragged as R
+        col, body = self._lambda_eval(ctx, kids)
+        vcap = col.value_capacity
+        rid = R.row_ids(col.offsets, vcap)
+        live = R.value_live(col.offsets, vcap, ctx.num_rows)
+        pred = body.data.astype(bool)
+        pvalid = body.validity if body.validity is not None \
+            else jnp.ones((vcap,), bool)
+        cap = col.capacity
+        hit = jax.ops.segment_max(
+            ((pred == self._hit) & pvalid & live).astype(jnp.int32),
+            rid, num_segments=cap) > 0
+        any_null = jax.ops.segment_max(
+            ((~pvalid) & live).astype(jnp.int32), rid,
+            num_segments=cap) > 0
+        data = jnp.where(hit, self._hit, self._default)
+        valid = col.validity & (hit | ~any_null)
+        return DevVal(data, valid, t.BOOLEAN)
 
     def _eval_cpu(self, rb, kids):
         lists, flat = self._flat_eval(kids)

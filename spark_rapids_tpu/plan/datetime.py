@@ -22,6 +22,62 @@ from ..ops import datetime as DK
 from ..ops.kernels import merge_validity
 from .expressions import DevVal, Expression, Literal
 
+# ---------------------------------------------------------------------------
+# Session timezone (GpuTimeZoneDB role).  The device path ships transition
+# tables as aux lanes (prepared per expression); the CPU oracle reads the
+# session zone from this contextvar, set by PhysicalQuery around execution
+# (same pattern as plan/misc.set_current_input_file).
+# ---------------------------------------------------------------------------
+import contextvars as _cv
+
+_SESSION_TZ = _cv.ContextVar("srtpu_session_tz", default="UTC")
+
+
+def set_session_timezone(tz: str) -> None:
+    _SESSION_TZ.set(tz or "UTC")
+
+
+def session_timezone() -> str:
+    return _SESSION_TZ.get()
+
+
+def _conf_tz(conf) -> str:
+    from ..config import SESSION_TIMEZONE
+    try:
+        return str(conf.get(SESSION_TIMEZONE)) if conf is not None else "UTC"
+    except Exception:                        # noqa: BLE001
+        return "UTC"
+
+
+def _prepare_tz(expr, pctx):
+    """Register the zone's transition table as aux lanes when non-UTC."""
+    tz = _conf_tz(pctx.conf)
+    if tz.upper() == "UTC":
+        return
+    from ..ops.timezone import transition_table
+    pts, offs = transition_table(tz)
+    pctx.add(expr, pts)
+    pctx.add(expr, offs)
+
+
+def _dev_local_ts(expr, ctx, ts_us):
+    """UTC timestamp lane -> local wall micros (identity under UTC)."""
+    aux = ctx.aux_of(expr)
+    if not aux:
+        return ts_us
+    from ..ops.timezone import utc_to_local
+    return utc_to_local(ts_us, aux[0], aux[1])
+
+
+def _cpu_local(arr: pa.Array) -> pa.Array:
+    """UTC-instant arrow timestamps -> session-zone-aware timestamps (the
+    temporal kernels then extract LOCAL fields)."""
+    tz = session_timezone()
+    arr = arr.cast(pa.timestamp("us", tz="UTC"))
+    if tz.upper() != "UTC":
+        arr = arr.cast(pa.timestamp("us", tz=tz))
+    return arr
+
 
 def _days(kid: DevVal) -> "jnp.ndarray":
     return kid.data.astype(jnp.int32)
@@ -48,18 +104,24 @@ class DateField(Expression):
             return [f"datetime field of {dt.simple_string}"]
         return []
 
-    def _input_days(self, kid: DevVal):
+    def _prepare(self, pctx, kids):
+        from .expressions import HostVal
         if isinstance(self.children[0].dtype, t.TimestampType):
-            return DK.ts_to_days(kid.data)
+            _prepare_tz(self, pctx)
+        return HostVal()
+
+    def _input_days(self, ctx, kid: DevVal):
+        if isinstance(self.children[0].dtype, t.TimestampType):
+            return DK.ts_to_days(_dev_local_ts(self, ctx, kid.data))
         return _days(kid)
 
     def _eval_dev(self, ctx, kids):
-        return DevVal(self._field_dev(self._input_days(kids[0])),
+        return DevVal(self._field_dev(self._input_days(ctx, kids[0])),
                       kids[0].validity, self.dtype)
 
     def _cpu_input(self, arr: pa.Array) -> pa.Array:
         if pa.types.is_timestamp(arr.type):
-            return arr.cast(pa.timestamp("us", tz="UTC"))
+            return _cpu_local(arr)
         return _as_date_cpu(arr)
 
     def _eval_cpu(self, rb, kids):
@@ -156,14 +218,18 @@ class TimeField(Expression):
             return [f"time field of {dt.simple_string}"]
         return []
 
+    def _prepare(self, pctx, kids):
+        from .expressions import HostVal
+        _prepare_tz(self, pctx)
+        return HostVal()
+
     def _eval_dev(self, ctx, kids):
-        tod = DK.ts_time_of_day_us(kids[0].data)
+        tod = DK.ts_time_of_day_us(_dev_local_ts(self, ctx, kids[0].data))
         return DevVal(self._from_tod(tod).astype(jnp.int32),
                       kids[0].validity, t.INT)
 
     def _eval_cpu(self, rb, kids):
-        arr = kids[0].cast(pa.timestamp("us", tz="UTC"))
-        return self._field_cpu(arr).cast(pa.int32())
+        return self._field_cpu(_cpu_local(kids[0])).cast(pa.int32())
 
 
 class Hour(TimeField):
@@ -386,9 +452,26 @@ class ToUnixTimestamp(Expression):
             return ["to_unix_timestamp of non-datetime"]
         return []
 
+    def _prepare(self, pctx, kids):
+        from .expressions import HostVal
+        if isinstance(self.children[0].dtype, t.DateType):
+            tz = _conf_tz(pctx.conf)
+            if tz.upper() != "UTC":
+                # DATE -> epoch seconds is "local midnight" (Spark)
+                from ..ops.timezone import wall_table
+                pts, offs = wall_table(tz)
+                pctx.add(self, pts)
+                pctx.add(self, offs)
+        return HostVal()
+
     def _eval_dev(self, ctx, kids):
         if isinstance(self.children[0].dtype, t.DateType):
-            secs = _days(kids[0]).astype(jnp.int64) * 86400
+            wall_us = _days(kids[0]).astype(jnp.int64) * 86400_000_000
+            aux = ctx.aux_of(self)
+            if aux:
+                from ..ops.timezone import local_to_utc
+                wall_us = local_to_utc(wall_us, aux[0], aux[1])
+            secs = wall_us // 1_000_000
         else:
             us = kids[0].data.astype(jnp.int64)
             secs = jnp.where(us >= 0, us // 1_000_000,
@@ -398,8 +481,17 @@ class ToUnixTimestamp(Expression):
     def _eval_cpu(self, rb, kids):
         arr = kids[0]
         if pa.types.is_date32(arr.type):
-            return pc.multiply(arr.cast(pa.int32()).cast(pa.int64()),
-                               pa.scalar(86400, pa.int64()))
+            days = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
+            wall = days.astype(np.int64) * 86400_000_000
+            tz = session_timezone()
+            if tz.upper() != "UTC":
+                from ..ops.timezone import local_to_utc, wall_table
+                pts, offs = wall_table(tz)
+                wall = np.asarray(local_to_utc(jnp.asarray(wall),
+                                               jnp.asarray(pts),
+                                               jnp.asarray(offs)))
+            return pa.array(wall // 1_000_000, pa.int64(),
+                            mask=np.asarray(pc.is_null(arr)))
         us = arr.cast(pa.timestamp("us", tz="UTC")).cast(pa.int64())
         vals = us.to_numpy(zero_copy_only=False)
         out = np.floor_divide(vals, 1_000_000)

@@ -87,15 +87,20 @@ class DevVal:
     """A traced column value: compute-representation lane + validity.
 
     `hi` carries the high int64 lane of a HOST-scanned wide (p>18)
-    decimal; device-computed wide results are single-lane (hi None)."""
+    decimal; device-computed wide results are single-lane (hi None).
+    Ragged ARRAY values carry `offsets` (int32, rows+1) + `elem_valid`
+    (per flat value) with `data` as the flat values lane."""
 
     def __init__(self, data, validity, dtype: t.DataType,
-                 dictionary: Optional[pa.Array] = None, hi=None):
+                 dictionary: Optional[pa.Array] = None, hi=None,
+                 offsets=None, elem_valid=None):
         self.data = data
         self.validity = validity      # None = all rows valid
         self.dtype = dtype
         self.dictionary = dictionary
         self.hi = hi
+        self.offsets = offsets
+        self.elem_valid = elem_valid
 
 
 class Expression:
@@ -494,9 +499,42 @@ class BinaryArithmetic(Expression):
         return self._op_cpu(l, r)
 
     def _decimal_cpu(self, kids):
-        """Exact row-wise python-decimal oracle with Spark result typing."""
+        """Exact decimal arithmetic with Spark result typing.
+
+        Fast path: arrow's decimal128 kernels (vectorized C++, exact) for
+        +/-/* with a rescaling cast to the Spark result type; any arrow
+        refusal (precision overflow, unsupported pair) falls back to the
+        row-wise python-decimal oracle below."""
         import decimal as pydec
         out_t: t.DecimalType = self.dtype
+        if type(self).__name__ in ("Add", "Subtract", "Multiply"):
+            try:
+                def as_dec(a):
+                    if pa.types.is_decimal(a.type):
+                        return a
+                    return a.cast(pa.decimal128(20, 0))
+                l, r = as_dec(kids[0]), as_dec(kids[1])
+                if type(self).__name__ == "Multiply" and \
+                        l.type.precision + r.type.precision + 1 > 38:
+                    # arrow needs p1+p2+1 <= 38; shrink declared operand
+                    # precisions to the values' actual headroom (the cast
+                    # raises if any value doesn't fit -> python fallback)
+                    budget = 38 - 1
+                    p1 = min(l.type.precision, budget - r.type.precision)
+                    if p1 <= l.type.scale:
+                        raise pa.ArrowInvalid("no precision headroom")
+                    l = l.cast(pa.decimal128(p1, l.type.scale))
+                    p2 = min(r.type.precision, budget - p1)
+                    if p2 <= r.type.scale:
+                        raise pa.ArrowInvalid("no precision headroom")
+                    r = r.cast(pa.decimal128(p2, r.type.scale))
+                res = self._op_cpu(l, r)
+                if isinstance(res, pa.ChunkedArray):
+                    res = res.combine_chunks()
+                return res.cast(pa.decimal128(out_t.precision, out_t.scale))
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError,
+                    pa.ArrowTypeError):
+                pass
         quant = pydec.Decimal(1).scaleb(-out_t.scale)
         limit = pydec.Decimal(10) ** (out_t.precision - out_t.scale)
         lv = kids[0].to_pylist()
@@ -1510,6 +1548,21 @@ class Cast(Expression):
 
     def _prepare(self, pctx, kids):
         src, dst = self.children[0].dtype, self.to
+        ts_date_pair = (isinstance(src, t.DateType)
+                        and isinstance(dst, t.TimestampType)) or \
+                       (isinstance(src, t.TimestampType)
+                        and isinstance(dst, t.DateType))
+        if ts_date_pair:
+            from .datetime import _conf_tz
+            tz = _conf_tz(pctx.conf)
+            if tz.upper() != "UTC":
+                # date->ts uses local midnight (wall->utc table);
+                # ts->date uses the local day (utc->local table)
+                from ..ops.timezone import transition_table, wall_table
+                pts, offs = wall_table(tz) \
+                    if isinstance(src, t.DateType) else transition_table(tz)
+                pctx.add(self, pts)
+                pctx.add(self, offs)
         if isinstance(src, t.StringType) and not isinstance(dst, t.StringType):
             d = kids[0].dictionary
             entries = [v.as_py() for v in d] if d is not None else []
@@ -1591,9 +1644,18 @@ class Cast(Expression):
             i64 = jnp.clip(i64, np.int64(info.min), np.int64(info.max))
             data = i64.astype(compute_dtype(dst))
         elif isinstance(src, t.DateType) and isinstance(dst, t.TimestampType):
-            data = x.astype(jnp.int64) * jnp.int64(86400_000_000)
+            wall = x.astype(jnp.int64) * jnp.int64(86400_000_000)
+            aux = ctx.aux_of(self)
+            if aux:                       # session tz: local midnight
+                from ..ops.timezone import local_to_utc
+                wall = local_to_utc(wall, aux[0], aux[1])
+            data = wall
         elif isinstance(src, t.TimestampType) and isinstance(dst, t.DateType):
             us = x.astype(jnp.int64)
+            aux = ctx.aux_of(self)
+            if aux:                       # session tz: local day
+                from ..ops.timezone import utc_to_local
+                us = utc_to_local(us, aux[0], aux[1])
             days = jnp.where(us >= 0, us // 86400_000_000,
                              -((-us + 86400_000_000 - 1) // 86400_000_000))
             data = days.astype(jnp.int32)
@@ -1652,6 +1714,37 @@ class Cast(Expression):
             return pa.array(x.astype(t.physical_np_dtype(dst)),
                             dtype_to_arrow(dst),
                             mask=np.asarray(pc.is_null(arr)))
+        ts_date_pair = (isinstance(src, t.DateType)
+                        and isinstance(dst, t.TimestampType)) or \
+                       (isinstance(src, t.TimestampType)
+                        and isinstance(dst, t.DateType))
+        if ts_date_pair:
+            from .datetime import session_timezone
+            tz = session_timezone()
+            if tz.upper() != "UTC":
+                import jax.numpy as _jnp
+                mask = np.asarray(pc.is_null(arr))
+                if isinstance(src, t.DateType):
+                    from ..ops.timezone import local_to_utc, wall_table
+                    days = arr.cast(pa.int32()) \
+                        .to_numpy(zero_copy_only=False)
+                    wall = days.astype(np.int64) * 86400_000_000
+                    pts, offs = wall_table(tz)
+                    us = np.asarray(local_to_utc(
+                        _jnp.asarray(wall), _jnp.asarray(pts),
+                        _jnp.asarray(offs)))
+                    return pa.array(us, pa.int64(), mask=mask) \
+                        .cast(dtype_to_arrow(dst))
+                from ..ops.timezone import transition_table, utc_to_local
+                us = arr.cast(pa.timestamp("us", tz="UTC")) \
+                    .cast(pa.int64()).to_numpy(zero_copy_only=False)
+                pts, offs = transition_table(tz)
+                loc = np.asarray(utc_to_local(
+                    _jnp.asarray(us), _jnp.asarray(pts),
+                    _jnp.asarray(offs)))
+                days = np.floor_divide(loc, 86400_000_000)
+                return pa.array(days.astype(np.int32), pa.int32(),
+                                mask=mask).cast(pa.date32())
         return arr.cast(dtype_to_arrow(dst))
 
     def _fp_extra(self):

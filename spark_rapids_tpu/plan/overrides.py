@@ -92,7 +92,29 @@ _COMMON = t.T.DEVICE_COMMON
 # every device-representable simple type — NO BINARY (no device lane for it)
 _DEVICE_SIMPLE = t.T.NUMERIC + t.T.STRING + t.T.BOOLEAN + t.T.DATETIME + t.T.NULL
 
-expr_rule(E.ColumnRef, _COMMON, desc="column reference")
+expr_rule(E.ColumnRef, _COMMON + t.T.ARRAY, desc="column reference")
+
+# Ragged ARRAY expression family (plan/collections.py device kernels over
+# ops/ragged.py; per-expression tag_self narrows element types further)
+from .collections import (ArrayContains, ArrayExists,  # noqa: E402
+                          ArrayFilter, ArrayForAll, ArrayMax, ArrayMin,
+                          ArrayTransform, GetArrayItem, LambdaVar, Size,
+                          SortArray)
+
+_ARR_SIG = (_COMMON + t.T.ARRAY)
+for _cls, _desc in [
+        (Size, "size(array) from the offsets lane"),
+        (GetArrayItem, "array[i] gather"),
+        (ArrayContains, "segment any-equal"),
+        (ArrayMin, "segment min"),
+        (ArrayMax, "segment max"),
+        (SortArray, "segment-local lexsort"),
+        (ArrayTransform, "lambda over the flat values lane"),
+        (ArrayFilter, "values-lane compaction"),
+        (ArrayExists, "segment three-valued any"),
+        (ArrayForAll, "segment three-valued all"),
+        (LambdaVar, "lambda-bound element variable")]:
+    expr_rule(_cls, _ARR_SIG, desc=_desc)
 expr_rule(E.Literal, _COMMON + t.T.NULL, desc="literal value")
 expr_rule(E.Alias, _COMMON, desc="named expression")
 for _c in (E.Add, E.Subtract, E.Multiply, E.Divide, E.IntegralDivide,
@@ -206,8 +228,20 @@ from .aggregates import CountDistinct  # noqa: E402
 agg_rule(CountDistinct, _COMMON, t.T.INTEGRAL,
          desc="count(DISTINCT) as a sorted value-change count")
 
-exec_rule(L.LogicalScan, _DEVICE_SIMPLE, "in-memory scan + device upload")
-exec_rule(L.LogicalProject, _COMMON, "projection")
+# Ragged (ARRAY<primitive|string>) device support: values+offsets lanes
+# (SURVEY §7c; ops/ragged.py).  Scans upload them, projections carry and
+# compute over them, Generate explodes them; row-reordering execs
+# (filter/sort/join/agg) keep the CPU path for now.
+_RAGGED_ELEM = (t.T.INTEGRAL
+                + (t.T.FP - t.TypeSig(frozenset({"DOUBLE"})))
+                + t.T.BOOLEAN + t.T.DATE + t.T.STRING)
+_DEVICE_RAGGED = (_DEVICE_SIMPLE + t.T.ARRAY).with_nested(_RAGGED_ELEM)
+
+exec_rule(L.LogicalScan, _DEVICE_RAGGED, "in-memory scan + device upload")
+exec_rule(L.LogicalProject, (_COMMON + t.T.ARRAY).with_nested(_RAGGED_ELEM),
+          "projection")
+exec_rule(L.LogicalGenerate, _DEVICE_RAGGED,
+          "explode/posexplode over ragged values+offsets lanes")
 exec_rule(L.LogicalFilter, _DEVICE_SIMPLE, "filter")
 exec_rule(L.LogicalAggregate, _COMMON, "hash aggregate")
 exec_rule(L.LogicalSort, t.T.ORDERABLE, "sort")
@@ -245,9 +279,19 @@ def _host_to_device(node: "H.HostNode") -> PlanNode:
     such a column was itself tagged onto the CPU — only pass-through
     ballast is cut here."""
     schema = node.output_schema
-    unrepresentable = (t.ArrayType, t.MapType, t.StructType, t.BinaryType)
+
+    def representable(dt) -> bool:
+        if isinstance(dt, (t.MapType, t.StructType, t.BinaryType)):
+            return False
+        if isinstance(dt, t.ArrayType):
+            # ragged device lanes exist for primitive/string elements
+            from .collections import _device_elem_ok
+            return _device_elem_ok(dt.element_type) or \
+                isinstance(dt.element_type, t.StringType)
+        return True
+
     keep = [f.name for f in schema.fields
-            if not isinstance(f.data_type, unrepresentable)]
+            if representable(f.data_type)]
     if len(keep) != len(schema.fields):
         exprs = [E.ColumnRef(n) for n in keep]
         names = list(keep)
@@ -783,14 +827,71 @@ class CacheMeta(PlanMeta):
 
 
 class GenerateMeta(PlanMeta):
-    """LogicalGenerate: array generators live on the CPU path by placement
-    (plan/collections.py module docs); the meta tags the reason and always
-    converts to CpuGenerateExec with transitions around it."""
+    """LogicalGenerate: explode/posexplode runs ON DEVICE over ragged
+    values+offsets lanes (exec/generate.py — GpuGenerateExec.scala:829
+    role) when
+
+      * the generator input is a plain column reference with a
+        device-supported element type,
+      * no OTHER nested column rides along (row gathers would corrupt a
+        second ragged lane), and
+      * the PARENT operator provably never reads the exploded array
+        column (Spark's GenerateExec.requiredChildOutput pruning —
+        re-expanding each row's array per output element is quadratic).
+
+    Anything else falls to CpuGenerateExec with transitions."""
 
     def tag_self(self):
-        self.will_not_work(
-            "explode/posexplode consume ARRAY values "
-            "(device lanes are flat; CPU path with transitions)")
+        from .collections import _device_elem_ok
+        gen = self.node.generator
+        child_schema = self.node.child.schema
+        arr = getattr(gen, "child", None)
+        if not isinstance(arr, E.ColumnRef):
+            self.will_not_work("generator input is not a column reference")
+            return
+        adt = child_schema[arr.name].data_type
+        if not isinstance(adt, t.ArrayType) or not (
+                _device_elem_ok(adt.element_type)
+                or isinstance(adt.element_type, t.StringType)):
+            self.will_not_work(
+                f"array element type "
+                f"{adt.element_type.simple_string if isinstance(adt, t.ArrayType) else adt.simple_string}"
+                " has no ragged device lane")
+            return
+        for f in child_schema.fields:
+            if f.name != arr.name and isinstance(
+                    f.data_type, (t.ArrayType, t.MapType, t.StructType)):
+                self.will_not_work(
+                    f"second nested column {f.name} alongside the "
+                    "exploded input (row gathers are flat)")
+                return
+        if not self._parent_prunes_input(arr.name):
+            self.will_not_work(
+                f"parent operator may read the exploded array column "
+                f"{arr.name} (requiredChildOutput pruning not provable)")
+
+    def _parent_prunes_input(self, arr_name: str) -> bool:
+        p = self.parent
+        if not isinstance(p, ProjectMeta):
+            return False
+        refs = set()
+
+        def walk(e):
+            if isinstance(e, E.ColumnRef):
+                refs.add(e.name)
+            for c in e.children:
+                walk(c)
+            body = getattr(e, "body", None)
+            if body is not None:
+                walk(body)
+        for e in p.node.exprs:
+            walk(e)
+        return arr_name not in refs
+
+    def to_device(self):
+        from ..exec.generate import GenerateExec
+        return GenerateExec(self.node.generator, self.node.output_names,
+                            self._device_child())
 
     def to_host(self):
         return H.CpuGenerateExec(self.node.generator,
@@ -888,11 +989,13 @@ class PhysicalQuery:
         return scope()
 
     def _whole_plan_enabled(self) -> bool:
-        from ..config import WHOLE_PLAN_COMPILE
+        from ..config import MESH_ENABLED, WHOLE_PLAN_COMPILE
         mode = str(self.conf.get(WHOLE_PLAN_COMPILE)).upper()
         if mode == "OFF":
             return False
-        if mode == "ON":
+        if mode == "ON" or self.conf.get(MESH_ENABLED):
+            # SPMD mesh execution rides the whole-plan program (GSPMD
+            # partitions it across chips); mesh implies compile
             return True
         import jax
         return jax.default_backend() == "tpu"
@@ -901,6 +1004,9 @@ class PhysicalQuery:
         ctx = ctx or ExecContext(self.conf)
         from ..plan.misc import set_current_input_file
         set_current_input_file("")   # provenance never leaks across queries
+        from ..config import SESSION_TIMEZONE
+        from ..plan.datetime import set_session_timezone
+        set_session_timezone(str(self.conf.get(SESSION_TIMEZONE)))
         from ..runtime.failure import crash_capture, install_fault_injection
         install_fault_injection(self.root, self.conf)
         with self._instrumented(ctx), crash_capture(self.conf, ctx):
@@ -915,6 +1021,9 @@ class PhysicalQuery:
         """Stream results as pyarrow RecordBatches (same permit/metrics
         scope as collect — the permit is held while the stream drains)."""
         ctx = ctx or ExecContext(self.conf)
+        from ..config import SESSION_TIMEZONE
+        from ..plan.datetime import set_session_timezone
+        set_session_timezone(str(self.conf.get(SESSION_TIMEZONE)))
         if self.kind == "device":
             node = H.DeviceToHostExec(self.root)
         else:

@@ -81,7 +81,8 @@ def test_array_expressions():
         L.LogicalScan(tbl),
         names=["sz", "it", "ct", "mn", "mx", "sa"])
     q = apply_overrides(plan)
-    assert q.kind == "host"
+    # round 3: the whole family runs on DEVICE over ragged lanes
+    assert q.kind == "device", q.explain()
     out = q.collect()
     assert out.column("sz").to_pylist() == [3, 0, None, 2, 1]
     assert out.column("it").to_pylist() == [2, None, None, None, None]
@@ -151,7 +152,8 @@ def test_higher_order_transform_filter():
                      E.GreaterThan(x, E.Literal(0, None)))],
         L.LogicalScan(tbl), names=["tr", "fl", "ex", "fa"])
     q = apply_overrides(plan)
-    assert q.kind == "host"
+    # round 3: higher-order functions run on DEVICE over ragged lanes
+    assert q.kind == "device", q.explain()
     out = q.collect()
     assert out.column("tr").to_pylist() == \
         [[10, 20, 30], [], None, [40, None]]

@@ -1,0 +1,81 @@
+"""SPMD plan execution over the 8-device CPU mesh (VERDICT r2 #3).
+
+The session conf `spark.rapids.tpu.sql.mesh.enabled` routes device plans
+through the whole-plan compiler with leaf lanes row-sharded over a
+jax.sharding.Mesh; GSPMD partitions the program and inserts the
+cross-chip collectives.  These tests run real TPC-H queries through the
+session API on the mesh and assert (a) results match the single-device
+CPU oracle, (b) the inputs are genuinely sharded across devices."""
+import jax
+import pytest
+
+from spark_rapids_tpu import tpch
+from spark_rapids_tpu.exec.compiled import session_mesh
+from spark_rapids_tpu.exec.plan import ExecContext
+from spark_rapids_tpu.session import DataFrame, TpuSession
+
+MESH = {"spark.rapids.tpu.sql.mesh.enabled": True}
+CPU = {"spark.rapids.tpu.sql.enabled": "false"}
+
+
+def _approx_eq(a, b):
+    da, db = a.to_pydict(), b.to_pydict()
+    if set(da) != set(db):
+        return False
+    for k in da:
+        if len(da[k]) != len(db[k]):
+            return False
+        for x, y in zip(da[k], db[k]):
+            if x == y:
+                continue
+            if isinstance(x, float) and isinstance(y, float) and \
+                    abs(x - y) <= 1e-9 * max(1.0, abs(x), abs(y)):
+                continue
+            return False
+    return True
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return tpch.gen_tables(scale=0.005)
+
+
+def test_session_mesh_resolves(eight_devices):
+    s = TpuSession(MESH)
+    mesh = session_mesh(s.conf)
+    assert mesh is not None
+    assert mesh.devices.size == 8
+
+
+@pytest.mark.parametrize("name", ["q1", "q6", "q12", "q3", "q5", "q4"])
+def test_tpch_on_mesh_matches_oracle(name, tables, eight_devices):
+    s = TpuSession(MESH)
+    dfq = tpch.QUERIES[name](s, tables)
+    ctx = ExecContext(s.conf)
+    out = dfq.physical().collect(ctx)
+    assert ctx.metrics.get("whole_plan_compiled_queries", 0) == 1, \
+        f"{name} did not run the compiled SPMD path: {ctx.metrics}"
+    oracle = DataFrame(dfq._plan, TpuSession(CPU)).collect()
+    assert _approx_eq(out, oracle), f"{name} mesh result mismatch"
+
+
+def test_leaf_lanes_actually_sharded(tables, eight_devices):
+    """The scan lanes must be split across all 8 devices, not replicated
+    (the row-sharded data-parallel layout)."""
+    s = TpuSession(MESH)
+    q = tpch.QUERIES["q6"](s, tables).physical()
+    q.collect(ExecContext(s.conf))
+    plan = q._compiled_plan
+    assert plan is not None and plan is not False
+    node, dbs = plan._leaf_batches(ExecContext(s.conf))[0]
+    lane = dbs[0].columns[0].data
+    devs = {d for d in lane.sharding.device_set}
+    assert len(devs) == 8, f"lane on {len(devs)} devices"
+    # each shard holds 1/8 of the rows
+    shard_rows = {sh.data.shape[0] for sh in lane.addressable_shards}
+    assert shard_rows == {lane.shape[0] // 8}
+
+
+def test_mesh_off_on_single_device_conf(tables):
+    s = TpuSession({**MESH, "spark.rapids.tpu.sql.mesh.devices": 1})
+    assert session_mesh(s.conf) is None

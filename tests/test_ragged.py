@@ -1,0 +1,214 @@
+"""Ragged (ARRAY) device columns: values+offsets lanes (round-3 work,
+VERDICT r2 #4 / SURVEY §7c).
+
+Every case runs the SAME logical plan on the device path and on the CPU
+fallback engine and compares; placement asserts prove the device path
+actually engaged (q.kind == "device" / explain shows no fallback)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.collections import (ArrayContains, ArrayExists,
+                                               ArrayFilter, ArrayForAll,
+                                               ArrayMax, ArrayMin,
+                                               ArrayTransform, ExplodeGen,
+                                               GetArrayItem, LambdaVar,
+                                               Size, SortArray)
+from spark_rapids_tpu.session import DataFrame, TpuSession, col
+
+ARR = pa.table({
+    "id": pa.array([1, 2, 3, 4, 5], pa.int64()),
+    "a": pa.array([[1, 2, 3], [], None, [5, None, -2], [7]],
+                  pa.list_(pa.int64())),
+})
+
+
+def _both(df_dev):
+    dev = df_dev.collect()
+    cpu = DataFrame(df_dev._plan,
+                    TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+                    ).collect()
+    return dev, cpu
+
+
+def _dev_session():
+    return TpuSession()
+
+
+class TestRaggedUploadRoundTrip:
+    def test_scan_collect_round_trip(self):
+        s = _dev_session()
+        df = s.from_arrow(ARR)
+        q = df.physical()
+        assert q.kind == "device", q.explain()
+        out = q.collect()
+        assert out.column("a").to_pylist() == ARR.column("a").to_pylist()
+
+    def test_string_array_round_trip(self):
+        tbl = pa.table({"sa": pa.array([["x", "y"], None, ["z"]],
+                                       pa.list_(pa.string()))})
+        s = _dev_session()
+        q = s.from_arrow(tbl).physical()
+        assert q.kind == "device", q.explain()
+        assert q.collect().column("sa").to_pylist() == \
+            tbl.column("sa").to_pylist()
+
+
+class TestRaggedExpressions:
+    @pytest.mark.parametrize("make,name", [
+        (lambda: Size(col("a")), "size"),
+        (lambda: GetArrayItem(col("a"), 1), "item1"),
+        (lambda: GetArrayItem(col("a"), 9), "item9"),
+        (lambda: ArrayContains(col("a"), 2), "has2"),
+        (lambda: ArrayContains(col("a"), 99), "has99"),
+        (lambda: ArrayMin(col("a")), "amin"),
+        (lambda: ArrayMax(col("a")), "amax"),
+    ])
+    def test_scalar_results_match_cpu(self, make, name):
+        s = _dev_session()
+        df = s.from_arrow(ARR).select(col("id"), make(), names=["id", name])
+        q = df.physical()
+        assert q.kind == "device", q.explain()
+        dev, cpu = _both(df)
+        assert dev.to_pydict() == cpu.to_pydict()
+
+    def test_sort_array_matches_cpu(self):
+        for asc in (True, False):
+            s = _dev_session()
+            df = s.from_arrow(ARR).select(
+                col("id"), SortArray(col("a"), asc), names=["id", "sa"])
+            q = df.physical()
+            assert q.kind == "device", q.explain()
+            dev, cpu = _both(df)
+            assert dev.to_pydict() == cpu.to_pydict()
+
+    def test_transform_filter_exists_forall(self):
+        x = LambdaVar("x")
+        cases = [
+            ("t", ArrayTransform(col("a"),
+                                 E.Multiply(x, E.Literal(2)), "x")),
+            ("f", ArrayFilter(col("a"),
+                              E.GreaterThan(x, E.Literal(1)), "x")),
+            ("e", ArrayExists(col("a"),
+                              E.GreaterThan(x, E.Literal(4)), "x")),
+            ("fa", ArrayForAll(col("a"),
+                               E.GreaterThan(x, E.Literal(0)), "x")),
+        ]
+        for name, expr in cases:
+            s = _dev_session()
+            df = s.from_arrow(ARR).select(col("id"), expr,
+                                          names=["id", name])
+            q = df.physical()
+            assert q.kind == "device", (name, q.explain())
+            dev, cpu = _both(df)
+            assert dev.to_pydict() == cpu.to_pydict(), name
+
+    def test_transform_then_aggregate_chain(self):
+        """filter -> min over the filtered array, all on device."""
+        x = LambdaVar("x")
+        s = _dev_session()
+        df = s.from_arrow(ARR).select(
+            col("id"),
+            ArrayMin(ArrayFilter(col("a"),
+                                 E.GreaterThanOrEqual(x, E.Literal(0)),
+                                 "x")),
+            names=["id", "m"])
+        q = df.physical()
+        assert q.kind == "device", q.explain()
+        dev, cpu = _both(df)
+        assert dev.to_pydict() == cpu.to_pydict()
+
+
+class TestDeviceGenerate:
+    def _gen_df(self, s, pos=False, outer=False):
+        plan = L.LogicalGenerate(
+            ExplodeGen(E.ColumnRef("a"), pos=pos, outer=outer),
+            L.LogicalScan(ARR),
+            ["pos", "col"] if pos else ["col"])
+        # parent projection never reads `a` -> device Generate legal
+        names = (["id", "pos", "col"] if pos else ["id", "col"])
+        proj = L.LogicalProject([E.ColumnRef(n) for n in names], plan,
+                                names)
+        return DataFrame(proj, s)
+
+    @pytest.mark.parametrize("pos,outer", [(False, False), (True, False),
+                                           (False, True), (True, True)])
+    def test_explode_on_device_matches_cpu(self, pos, outer):
+        s = _dev_session()
+        df = self._gen_df(s, pos=pos, outer=outer)
+        q = df.physical()
+        assert q.kind == "device", q.explain()
+        assert "GenerateExec" in q.physical_tree()
+        dev, cpu = _both(df)
+        key = ["id"] + (["pos"] if pos else [])
+
+        def rows(tbl):
+            cols = [tbl.column(n).to_pylist() for n in tbl.schema.names]
+            return sorted(zip(*cols), key=repr)
+        assert rows(dev) == rows(cpu)
+
+    def test_generate_keeps_cpu_when_parent_reads_array(self):
+        s = _dev_session()
+        plan = L.LogicalGenerate(ExplodeGen(E.ColumnRef("a")),
+                                 L.LogicalScan(ARR), ["col"])
+        proj = L.LogicalProject(
+            [E.ColumnRef("col"), Size(E.ColumnRef("a"))], plan,
+            ["col", "n"])
+        df = DataFrame(proj, s)
+        q = df.physical()
+        assert "CpuGenerateExec" in q.physical_tree()
+        dev, cpu = _both(df)
+
+        def rows(tbl):
+            cols = [tbl.column(n).to_pylist() for n in tbl.schema.names]
+            return sorted(zip(*cols), key=repr)
+        assert rows(dev) == rows(cpu)
+
+    def test_explode_whole_plan_compiles(self):
+        """The sync-free device explode traces into one XLA program."""
+        from spark_rapids_tpu.exec.plan import ExecContext
+        s = TpuSession({"spark.rapids.tpu.sql.compile.wholePlan": "ON"})
+        df = self._gen_df(s, pos=True)
+        q = df.physical()
+        ctx = ExecContext(s.conf)
+        out = q.collect(ctx)
+        assert ctx.metrics.get("whole_plan_compiled_queries", 0) == 1, \
+            ctx.metrics
+        cpu = DataFrame(df._plan, TpuSession(
+            {"spark.rapids.tpu.sql.enabled": "false"})).collect()
+
+        def rows(tbl):
+            cols = [tbl.column(n).to_pylist() for n in tbl.schema.names]
+            return sorted(zip(*cols), key=repr)
+        assert rows(out) == rows(cpu)
+
+
+class TestRaggedLargeFuzz:
+    def test_fuzz_device_vs_cpu(self):
+        rng = np.random.default_rng(11)
+        n = 5000
+        lists = []
+        for _ in range(n):
+            r = rng.random()
+            if r < 0.05:
+                lists.append(None)
+            else:
+                ln = rng.integers(0, 9)
+                row = [None if rng.random() < 0.1 else
+                       int(rng.integers(-100, 100)) for _ in range(ln)]
+                lists.append(row)
+        tbl = pa.table({"id": pa.array(range(n), pa.int64()),
+                        "a": pa.array(lists, pa.list_(pa.int64()))})
+        s = _dev_session()
+        df = s.from_arrow(tbl).select(
+            col("id"), Size(col("a")), GetArrayItem(col("a"), 2),
+            ArrayContains(col("a"), 7), ArrayMin(col("a")),
+            ArrayMax(col("a")),
+            names=["id", "n", "i2", "c7", "mn", "mx"])
+        q = df.physical()
+        assert q.kind == "device", q.explain()
+        dev, cpu = _both(df)
+        assert dev.to_pydict() == cpu.to_pydict()

@@ -1,0 +1,193 @@
+"""Session-timezone support (VERDICT r2 #6 — the GpuTimeZoneDB role).
+
+Device results with `spark.sql.session.timeZone=America/Los_Angeles` are
+checked against an INDEPENDENT zoneinfo/datetime oracle (not this
+engine's CPU path), across DST spring-forward/fall-back boundaries."""
+import datetime as dt
+from zoneinfo import ZoneInfo
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.plan import datetime as DT
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.session import DataFrame, TpuSession, col
+
+LA = "America/Los_Angeles"
+TZCONF = {"spark.sql.session.timeZone": LA}
+UTC = dt.timezone.utc
+
+
+def _ts_table():
+    base = [
+        dt.datetime(2024, 3, 10, 9, 59, 0),    # just before spring-forward
+        dt.datetime(2024, 3, 10, 10, 1, 0),    # just after (PST->PDT)
+        dt.datetime(2024, 11, 3, 8, 30, 0),    # inside fall-back overlap
+        dt.datetime(2024, 7, 4, 0, 0, 0),      # plain summer
+        dt.datetime(2023, 12, 25, 23, 59, 59),  # plain winter
+        dt.datetime(1999, 1, 1, 12, 0, 0),
+    ]
+    return pa.table({"ts": pa.array([b.replace(tzinfo=UTC) for b in base],
+                                    pa.timestamp("us", tz="UTC"))}), base
+
+
+def _oracle_local(base):
+    return [b.replace(tzinfo=UTC).astimezone(ZoneInfo(LA)) for b in base]
+
+
+class TestTimezoneFields:
+    def test_hour_minute_la(self):
+        tbl, base = _ts_table()
+        s = TpuSession(TZCONF)
+        df = s.from_arrow(tbl).select(
+            DT.Hour(col("ts")), DT.Minute(col("ts")), names=["h", "m"])
+        q = df.physical()
+        assert q.kind == "device", q.explain()
+        out = q.collect()
+        loc = _oracle_local(base)
+        assert out.column("h").to_pylist() == [x.hour for x in loc]
+        assert out.column("m").to_pylist() == [x.minute for x in loc]
+
+    def test_date_fields_la(self):
+        tbl, base = _ts_table()
+        s = TpuSession(TZCONF)
+        df = s.from_arrow(tbl).select(
+            DT.Year(col("ts")), DT.Month(col("ts")),
+            DT.DayOfMonth(col("ts")), names=["y", "mo", "d"])
+        q = df.physical()
+        assert q.kind == "device", q.explain()
+        out = q.collect()
+        loc = _oracle_local(base)
+        assert out.column("y").to_pylist() == [x.year for x in loc]
+        assert out.column("mo").to_pylist() == [x.month for x in loc]
+        assert out.column("d").to_pylist() == [x.day for x in loc]
+
+    def test_cpu_engine_agrees(self):
+        tbl, _ = _ts_table()
+        dev = TpuSession(TZCONF)
+        cpu = TpuSession({**TZCONF, "spark.rapids.tpu.sql.enabled": "false"})
+        df = dev.from_arrow(tbl).select(
+            DT.Hour(col("ts")), DT.DayOfMonth(col("ts")), names=["h", "d"])
+        a = df.collect()
+        b = DataFrame(df._plan, cpu).collect()
+        assert a.to_pydict() == b.to_pydict()
+
+    def test_utc_default_unchanged(self):
+        tbl, base = _ts_table()
+        s = TpuSession()
+        out = s.from_arrow(tbl).select(DT.Hour(col("ts")),
+                                       names=["h"]).collect()
+        assert out.column("h").to_pylist() == [b.hour for b in base]
+
+
+class TestTimezoneCasts:
+    def test_ts_to_date_la(self):
+        tbl, base = _ts_table()
+        s = TpuSession(TZCONF)
+        df = s.from_arrow(tbl).select(E.Cast(col("ts"), t.DATE),
+                                      names=["d"])
+        q = df.physical()
+        assert q.kind == "device", q.explain()
+        out = q.collect()
+        loc = _oracle_local(base)
+        assert out.column("d").to_pylist() == [x.date() for x in loc]
+
+    def test_date_to_ts_is_local_midnight(self):
+        dates = [dt.date(2024, 3, 10), dt.date(2024, 11, 3),
+                 dt.date(2024, 7, 4), dt.date(1999, 1, 1)]
+        tbl = pa.table({"d": pa.array(dates, pa.date32())})
+        s = TpuSession(TZCONF)
+        df = s.from_arrow(tbl).select(E.Cast(col("d"), t.TIMESTAMP),
+                                      names=["ts"])
+        q = df.physical()
+        assert q.kind == "device", q.explain()
+        out = q.collect()
+        got = out.column("ts").to_pylist()
+        z = ZoneInfo(LA)
+        for g, d in zip(got, dates):
+            exp = dt.datetime(d.year, d.month, d.day, tzinfo=z)
+            assert g.replace(tzinfo=UTC) == exp.astimezone(UTC), (g, d)
+
+    def test_to_unix_timestamp_of_date_la(self):
+        dates = [dt.date(2024, 7, 4), dt.date(2023, 12, 25)]
+        tbl = pa.table({"d": pa.array(dates, pa.date32())})
+        s = TpuSession(TZCONF)
+        out = s.from_arrow(tbl).select(
+            DT.ToUnixTimestamp(col("d")), names=["u"]).collect()
+        z = ZoneInfo(LA)
+        exp = [int(dt.datetime(d.year, d.month, d.day,
+                               tzinfo=z).timestamp()) for d in dates]
+        assert out.column("u").to_pylist() == exp
+
+
+class TestTransitionTableFuzz:
+    def test_random_instants_vs_zoneinfo(self):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.ops.timezone import (transition_table,
+                                                   utc_to_local)
+        rng = np.random.default_rng(5)
+        lo = int(dt.datetime(1971, 1, 1, tzinfo=UTC).timestamp())
+        hi = int(dt.datetime(2037, 1, 1, tzinfo=UTC).timestamp())
+        secs = rng.integers(lo, hi, 3000)
+        us = secs * 1_000_000
+        for zone in (LA, "Europe/Berlin", "Asia/Kolkata",
+                     "Australia/Sydney"):
+            pts, offs = transition_table(zone)
+            loc = np.asarray(utc_to_local(jnp.asarray(us),
+                                          jnp.asarray(pts),
+                                          jnp.asarray(offs)))
+            z = ZoneInfo(zone)
+            for u, l in zip(us[:500].tolist(), loc[:500].tolist()):
+                d = dt.datetime.fromtimestamp(u / 1e6, UTC).astimezone(z)
+                exp = d.replace(tzinfo=UTC).timestamp() * 1e6
+                assert abs(exp - l) <= 1, (zone, u)
+
+
+class TestDstEdgeRules:
+    def test_skipped_wall_shifts_forward(self):
+        """java.time/Spark: a wall time inside the spring-forward gap
+        shifts FORWARD by the gap (02:30 EST-gap -> 07:30 UTC)."""
+        import jax.numpy as jnp
+        from spark_rapids_tpu.ops.timezone import local_to_utc, wall_table
+        wp, wo = wall_table("America/New_York")
+        wall_us = int((dt.datetime(2024, 3, 10, 2, 30)
+                       - dt.datetime(1970, 1, 1)).total_seconds()) * 10**6
+        got = int(np.asarray(local_to_utc(jnp.asarray([wall_us]),
+                                          jnp.asarray(wp),
+                                          jnp.asarray(wo)))[0])
+        assert dt.datetime.fromtimestamp(got / 1e6, UTC) == \
+            dt.datetime(2024, 3, 10, 7, 30, tzinfo=UTC)
+
+    def test_ambiguous_wall_takes_earlier_offset(self):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.ops.timezone import local_to_utc, wall_table
+        wp, wo = wall_table("America/New_York")
+        # 01:30 on fall-back day is ambiguous: earlier (EDT) wins -> 05:30
+        wall_us = int((dt.datetime(2024, 11, 3, 1, 30)
+                       - dt.datetime(1970, 1, 1)).total_seconds()) * 10**6
+        got = int(np.asarray(local_to_utc(jnp.asarray([wall_us]),
+                                          jnp.asarray(wp),
+                                          jnp.asarray(wo)))[0])
+        assert dt.datetime.fromtimestamp(got / 1e6, UTC) == \
+            dt.datetime(2024, 11, 3, 5, 30, tzinfo=UTC)
+
+    def test_paired_transitions_casablanca(self):
+        """Morocco suspends DST for Ramadan — paired transitions weeks
+        apart that a coarse probe window cancels out."""
+        import jax.numpy as jnp
+        from spark_rapids_tpu.ops.timezone import (transition_table,
+                                                   utc_to_local)
+        pts, offs = transition_table("Africa/Casablanca")
+        z = ZoneInfo("Africa/Casablanca")
+        for probe in (dt.datetime(2023, 4, 1, 12, tzinfo=UTC),
+                      dt.datetime(2023, 6, 1, 12, tzinfo=UTC),
+                      dt.datetime(2024, 3, 20, 12, tzinfo=UTC)):
+            us = int(probe.timestamp()) * 10**6
+            loc = int(np.asarray(utc_to_local(
+                jnp.asarray([us]), jnp.asarray(pts),
+                jnp.asarray(offs)))[0])
+            exp = probe.astimezone(z)
+            got = dt.datetime.fromtimestamp(loc / 1e6, UTC)
+            assert (got.hour, got.minute) == (exp.hour, exp.minute), probe
