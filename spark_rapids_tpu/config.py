@@ -122,6 +122,14 @@ WHOLE_PLAN_COMPILE = conf(
     "automatically fall back to the eager engine.",
     checker=_enum_checker("AUTO", "ON", "OFF"), commonly_used=True)
 
+STRING_TRANSFORM_DEVICE_MIN = conf(
+    "spark.rapids.tpu.sql.string.transformDeviceMinUnique", 8192,
+    "Dictionary size above which string transforms (upper/lower/trim/"
+    "substring) rewrite their byte tensors ON DEVICE (one packed-range "
+    "kernel + one fetch) instead of the per-entry host loop. Small "
+    "dictionaries stay host-side (kernel+fetch overhead dominates).",
+    checker=_positive)
+
 SESSION_TIMEZONE = conf(
     "spark.sql.session.timeZone", "UTC",
     "Session timezone for timestamp field extraction, truncation and "

@@ -35,16 +35,63 @@ _GROUPBY_CACHE = {}
 _REDUCE_CACHE = {}
 
 
+_DENSE_DOMAIN_MAX = 4096
+_DICT_UNIQUE_CACHE: dict = {}
+
+
+def _dict_unique(d: pa.Array) -> bool:
+    """Duplicate-free dictionary (code equality == value equality), cached
+    by identity."""
+    import pyarrow.compute as pc
+    key = id(d)
+    hit = _DICT_UNIQUE_CACHE.get(key)
+    if hit is not None and hit[0] is d:
+        return hit[1]
+    u = len(pc.unique(d.cast(pa.string()))) == len(d)
+    if len(_DICT_UNIQUE_CACHE) > 1024:
+        _DICT_UNIQUE_CACHE.clear()
+    _DICT_UNIQUE_CACHE[key] = (d, u)
+    return u
+
+
+def _dense_domains(key_cols) -> "Optional[List[int]]":
+    """Static per-key domain sizes when ALL keys are bounded (dictionary
+    codes / booleans) and the bucket product stays small — the dense
+    no-sort groupby's eligibility (ops/groupby.py dense_groupby_trace).
+
+    The size/budget check runs FIRST: a high-cardinality dictionary must
+    bail out before any O(unique) host work."""
+    sizes = []
+    total = 1
+    for c in key_cols:
+        if c.dictionary is not None:
+            sizes.append(max(len(c.dictionary), 1))
+        elif isinstance(c.dtype, t.BooleanType):
+            sizes.append(2)
+        else:
+            return None
+        total *= sizes[-1] + 1
+        if total > _DENSE_DOMAIN_MAX:
+            return None
+    return sizes
+
+
 def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
                  specs: List[G.AggSpec], live, capacity: int):
     key_cols = [ensure_unique_dict(c) for c in key_cols]
     info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
+    domains = _dense_domains(key_cols)
     sig = (info, tuple((s.kind, s.input_idx, s.dtype) for s in specs),
-           capacity, tuple(str(c.data.dtype) for c in agg_cols))
+           capacity, tuple(str(c.data.dtype) for c in agg_cols),
+           tuple(domains) if domains else None)
     fn = _GROUPBY_CACHE.get(sig)
     if fn is None:
-        fn = jax.jit(G.groupby_trace(list(info), list(specs), capacity,
-                                     capacity))
+        if domains is not None:
+            fn = jax.jit(G.dense_groupby_trace(list(domains), list(specs),
+                                               capacity))
+        else:
+            fn = jax.jit(G.groupby_trace(list(info), list(specs), capacity,
+                                         capacity))
         _GROUPBY_CACHE[sig] = fn
     out_keys, outs, num_groups = fn(
         tuple(c.data for c in key_cols),
@@ -151,10 +198,54 @@ class HashAggregate:
             key_batch.columns, agg_cols, self.update_specs, live, db.capacity)
         return self._groupby_outs_to_batch(key_cols, out_keys, outs, n_groups)
 
-    def can_fuse_filter(self) -> bool:
-        """String group keys need host-side dictionary unification, which
-        can't live inside one traced program — everything else fuses."""
-        return not any(isinstance(e.dtype, t.StringType) for e in self.key_exprs)
+    def can_fuse_filter(self, db: "Optional[DeviceBatch]" = None) -> bool:
+        """Whether the whole map side (filter mask + projections + update
+        groupby) can run as ONE traced program.
+
+        Non-string keys always fuse.  String keys fuse when the batch is
+        in hand and every string key is a plain column reference with a
+        duplicate-free dictionary whose domain is small: the DENSE
+        bounded-domain groupby (ops/groupby.py) then needs no host-side
+        dictionary work inside the trace."""
+        if not any(isinstance(e.dtype, t.StringType) for e in self.key_exprs):
+            return True
+        if db is None:
+            return False
+        return self._fused_dense_domains(db) is not None
+
+    def _fused_dense_domains(self, db: DeviceBatch):
+        """Static dense-groupby domain sizes for the fused path, or None.
+
+        Sizes/budget check first; the O(unique) duplicate check only ever
+        runs on dictionaries already under the (small) domain budget."""
+        sizes = []
+        dicts = []
+        total = 1
+        for e in self.key_exprs:
+            inner = e.children[0] if isinstance(e, E.Alias) else e
+            if isinstance(e.dtype, t.BooleanType):
+                sizes.append(2)
+                dicts.append(None)
+            elif isinstance(e.dtype, t.StringType):
+                if not isinstance(inner, E.ColumnRef):
+                    return None
+                try:
+                    c = db.column_by_name(inner.name)
+                except ValueError:
+                    return None
+                if c.dictionary is None:
+                    return None
+                sizes.append(max(len(c.dictionary), 1))
+                dicts.append(c.dictionary)
+            else:
+                return None
+            total *= sizes[-1] + 1
+            if total > _DENSE_DOMAIN_MAX:
+                return None
+        for d in dicts:
+            if d is not None and not _dict_unique(d):
+                return None
+        return sizes
 
     def partial_fused(self, db: DeviceBatch, conds: Sequence[E.Expression],
                       raw: bool = False):
@@ -173,8 +264,13 @@ class HashAggregate:
         pctx, hostvals, aux = _prepare(exprs_all, db, self.conf)
         spec_sig = tuple((s.kind, s.input_idx, str(s.dtype))
                          for s in self.update_specs)
+        dense_domains = self._fused_dense_domains(db) \
+            if any(isinstance(e.dtype, (t.StringType, t.BooleanType))
+                   for e in self.key_exprs) else None
         key = _jit_key(exprs_all, db, aux, self.conf,
-                       ("fpartial", spec_sig, len(conds), len(self.key_exprs)))
+                       ("fpartial", spec_sig, len(conds),
+                        len(self.key_exprs),
+                        tuple(dense_domains) if dense_domains else None))
         fn = _JIT_CACHE.get(key)
         if fn is None:
             capacity = db.capacity
@@ -213,7 +309,11 @@ class HashAggregate:
                     kds.append(dv.data)
                     kvs.append(valid_or_true(dv.validity, capacity))
                     kinfo.append((e.dtype, True, str(dv.data.dtype)))
-                gb = G.groupby_trace(kinfo, specs, capacity, capacity)
+                if dense_domains is not None:
+                    gb = G.dense_groupby_trace(list(dense_domains), specs,
+                                               capacity)
+                else:
+                    gb = G.groupby_trace(kinfo, specs, capacity, capacity)
                 return gb(tuple(kds), tuple(kvs), tuple(agg_data),
                           tuple(agg_valid), live)
 

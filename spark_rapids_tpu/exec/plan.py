@@ -431,11 +431,14 @@ class HashAggregateExec(PlanNode):
         from ..config import AGG_FALLBACK_PARTITIONS
         agg = HashAggregate(self.key_exprs, self.key_names, self.aggs,
                             ctx.conf)
-        # Fuse a chain of upstream filters into the map-side program: the
-        # predicates become the groupby's live-mask, so filter + projections
-        # + update aggregation run as ONE dispatch with no compaction
-        # (TPU row gathers cost far more than masked reduction lanes).
-        source, conds = self._strip_filters(agg.can_fuse_filter())
+        # Fuse upstream filters into the map side for EVERY aggregation:
+        # the predicates become the groupby's live-mask, so filter +
+        # projections + update aggregation run with no mask compaction
+        # (TPU row gathers — one argsort + per-column gathers — cost far
+        # more than masked reduction lanes; ~3s at an 8M bucket).  Keys
+        # the single-program fuse can't take (host dictionary work) still
+        # skip the compact: the mask evaluates as its own program.
+        source, conds = self._strip_filters(True)
         partials: List[DeviceBatch] = []
         buckets = None          # repartition-fallback state
         num_buckets = 0
@@ -444,8 +447,16 @@ class HashAggregateExec(PlanNode):
             if isinstance(db.num_rows, int) and db.num_rows == 0:
                 continue
             seen = True
-            p = agg.partial_fused(db, conds) if agg.can_fuse_filter() \
-                else agg.partial(db)
+            if agg.can_fuse_filter(db):
+                p = agg.partial_fused(db, conds)
+            else:
+                live = None
+                if conds:
+                    from .evaluator import compute_predicate
+                    live = db.row_mask()
+                    for c in conds:
+                        live = live & compute_predicate(c, db, ctx.conf)
+                p = agg.partial(db, live)
             if buckets is not None:
                 self._scatter(p, buckets, num_buckets, ctx)
                 continue
@@ -575,7 +586,7 @@ class HashAggregateExec(PlanNode):
         ctx = ctx or ExecContext()
         agg = HashAggregate(self.key_exprs, self.key_names, self.aggs,
                             ctx.conf)
-        source, conds = self._strip_filters(agg.can_fuse_filter())
+        source, conds = self._strip_filters(True)
         raw = []
         for db in source.execute(ctx):
             if isinstance(db.num_rows, int) and db.num_rows == 0:

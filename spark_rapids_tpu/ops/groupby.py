@@ -125,6 +125,62 @@ _ORDER_MAX = np.int64(2**63 - 1)   # unreachable after NaN canonicalization
 _ORDER_MIN = np.int64(-2**63)
 
 
+
+
+def _batched_sums(agg_specs, spec_vls, live_all, seg, num_segments,
+                  reindex):
+    """ONE wide (N, K) segment_sum for every sum-like lane (SUM buffers,
+    COUNT/COUNT_ALL, per-input valid counts) — TPU scatters pay a fixed
+    serialization cost per pass, so K-wide rows amortize it (measured
+    4.5x for 10 aggregates at 8M rows).  Shared by groupby_trace and
+    dense_groupby_trace so the lane/dtype rules cannot drift.
+
+    spec_vls: per-spec (data, valid&live) with any permutation already
+    applied; live_all: the COUNT(*) lane; reindex: maps the (S, K)
+    segment output onto the caller's group order.
+    Returns sum_of(key, is_float) -> (G,) lane."""
+    int_lanes, int_slots = [], {}
+    f64_lanes, f64_slots = [], {}
+
+    def queue(key, lane, is_float):
+        lanes_, slots = (f64_lanes, f64_slots) if is_float \
+            else (int_lanes, int_slots)
+        if key not in slots:
+            slots[key] = len(lanes_)
+            lanes_.append(lane)
+
+    for si, spec in enumerate(agg_specs):
+        d, vl = spec_vls[si]
+        dt = spec.dtype
+        if spec.kind == COUNT_ALL:
+            queue(("cnt", si), live_all.astype(jnp.int64), False)
+        elif spec.kind == COUNT:
+            queue(("cnt", si), vl.astype(jnp.int64), False)
+        elif spec.kind == SUM:
+            cd = compute_view(d, dt)
+            if t.is_floating(dt):
+                queue(("sum", si),
+                      jnp.where(vl, cd.astype(jnp.float64), 0.0), True)
+            else:
+                queue(("sum", si),
+                      jnp.where(vl, cd.astype(jnp.int64), 0), False)
+        if spec.kind not in (COUNT, COUNT_ALL):
+            queue(("vc", spec.input_idx), vl.astype(jnp.int64), False)
+
+    int_out = f64_out = None
+    if int_lanes:
+        int_out = reindex(jax.ops.segment_sum(
+            jnp.stack(int_lanes, axis=1), seg, num_segments=num_segments))
+    if f64_lanes:
+        f64_out = reindex(jax.ops.segment_sum(
+            jnp.stack(f64_lanes, axis=1), seg, num_segments=num_segments))
+
+    def sum_of(key, is_float):
+        return (f64_out[:, f64_slots[key]] if is_float
+                else int_out[:, int_slots[key]])
+    return sum_of
+
+
 def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity):
     """Build the traced groupby fn for jit.
 
@@ -184,7 +240,7 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity):
 
         # --- 4. aggregates ---
         group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
-        outs = []
+        spec_vls = []
         for spec in agg_specs:
             if spec.input_idx >= 0:
                 d = agg_data[spec.input_idx][perm]
@@ -193,25 +249,22 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity):
             else:
                 d, v = None, s_live
             vl = (v & s_live) if d is not None else s_live
+            spec_vls.append((d, vl))
+        sum_of = _batched_sums(agg_specs, spec_vls, s_live, seg_ids,
+                               num_segments, lambda a: a)
+
+        outs = []
+        for si, spec in enumerate(agg_specs):
+            d, vl = spec_vls[si]
             dt = spec.dtype
-            if spec.kind == COUNT_ALL:
-                data = jax.ops.segment_sum(s_live.astype(jnp.int64), seg_ids,
-                                           num_segments=num_segments)
-                outs.append((data, group_live))
+            if spec.kind in (COUNT, COUNT_ALL):
+                outs.append((sum_of(("cnt", si), False), group_live))
                 continue
-            if spec.kind == COUNT:
-                data = jax.ops.segment_sum(vl.astype(jnp.int64), seg_ids,
-                                           num_segments=num_segments)
-                outs.append((data, group_live))
-                continue
-            valid_count = jax.ops.segment_sum(vl.astype(jnp.int32), seg_ids,
-                                              num_segments=num_segments)
+            valid_count = sum_of(("vc", spec.input_idx), False)
             out_valid = (valid_count > 0) & group_live
             cd = compute_view(d, dt)
             if spec.kind == SUM:
-                acc = cd.astype(jnp.float64 if t.is_floating(dt) else jnp.int64)
-                data = jax.ops.segment_sum(jnp.where(vl, acc, 0), seg_ids,
-                                           num_segments=num_segments)
+                data = sum_of(("sum", si), t.is_floating(dt))
             elif spec.kind in (MIN, MAX):
                 is_min = spec.kind == MIN
                 if isinstance(dt, t.DoubleType) and d.dtype == jnp.int64:
@@ -336,5 +389,136 @@ def reduce_trace(agg_specs, capacity):
                     raise ValueError(spec.kind)
             outs.append((data, valid))
         return outs
+
+    return run
+
+
+def dense_groupby_trace(domain_sizes, agg_specs, capacity):
+    """Bounded-domain groupby: NO SORT, NO ROW GATHERS.
+
+    When every group key has a small static domain (dictionary codes,
+    booleans), rows map to a dense bucket id (base-mixed radix over the
+    key slots, one extra slot per key for null) and every aggregate is a
+    single segment reduction into D buckets.  For the classic low-
+    cardinality shapes (TPC-H q1's returnflag x linestatus) this replaces
+    an O(C log C) multi-lane lexsort + per-column gathers with one
+    masked pass — the difference between seconds and milliseconds at
+    8M-row capacities.
+
+    domain_sizes: static per-key domain size (codes in [0, size)).
+    Returns fn(keys, keys_valid, agg_data, agg_valid, live) with the same
+    contract as groupby_trace: occupied buckets compact to the front,
+    group keys decode from the bucket id.
+    """
+    strides = []
+    d_total = 1
+    for size in reversed(domain_sizes):
+        strides.append(d_total)
+        d_total *= size + 1                       # +1: the null slot
+    strides.reverse()
+    D = d_total
+
+    def run(keys, keys_valid, agg_data, agg_valid, live):
+        comb = jnp.zeros((capacity,), jnp.int32)
+        for size, stride, kd, kv in zip(domain_sizes, strides, keys,
+                                        keys_valid):
+            slot = jnp.clip(kd.astype(jnp.int32), 0, size - 1)
+            if kv is not None:
+                slot = jnp.where(kv, slot, jnp.int32(size))
+            comb = comb + slot * jnp.int32(stride)
+        seg = jnp.where(live, comb, jnp.int32(D))   # dead rows -> bucket D
+        ns = D + 1
+
+        occupied = jax.ops.segment_max(live.astype(jnp.int32), seg,
+                                       num_segments=ns)[:D] > 0
+        num_groups = jnp.sum(occupied, dtype=jnp.int32)
+        # compact occupied buckets to the front, stably (bucket order)
+        order = jnp.argsort(jnp.where(occupied, jnp.int32(0),
+                                      jnp.int32(1)), stable=True)
+        group_live = jnp.arange(D, dtype=jnp.int32) < num_groups
+
+        out_keys = []
+        for size, stride, kd in zip(domain_sizes, strides, keys):
+            slot = (order // jnp.int32(stride)) % jnp.int32(size + 1)
+            okd = slot.astype(kd.dtype)
+            okv = (slot < size) & group_live
+            out_keys.append((okd, okv))
+
+        spec_vls = []
+        for spec in agg_specs:
+            if spec.input_idx >= 0:
+                d = agg_data[spec.input_idx]
+                v = agg_valid[spec.input_idx]
+                v = jnp.ones((capacity,), bool) if v is None else v
+            else:
+                d, v = None, live
+            vl = (v & live) if d is not None else live
+            spec_vls.append((d, vl))
+        sum_of = _batched_sums(agg_specs, spec_vls, live, seg, ns,
+                               lambda a: a[:D][order])
+
+        outs = []
+        for si, spec in enumerate(agg_specs):
+            d, vl = spec_vls[si]
+            dt = spec.dtype
+            if spec.kind in (COUNT, COUNT_ALL):
+                outs.append((sum_of(("cnt", si), False), group_live))
+                continue
+            valid_count = sum_of(("vc", spec.input_idx), False)
+            out_valid = (valid_count > 0) & group_live
+            cd = compute_view(d, dt)
+            if spec.kind == SUM:
+                data = sum_of(("sum", si), t.is_floating(dt))
+            elif spec.kind in (MIN, MAX):
+                is_min = spec.kind == MIN
+                if isinstance(dt, t.DoubleType) and d.dtype == jnp.int64:
+                    o = _bits_total_order(d)
+                    ident = jnp.int64(_ORDER_MAX if is_min else _ORDER_MIN)
+                    o = jnp.where(vl, o, ident)
+                    red = (jax.ops.segment_min if is_min
+                           else jax.ops.segment_max)(
+                        o, seg, num_segments=ns)[:D][order]
+                    data = _bits_from_order(red)
+                elif t.is_floating(dt):
+                    data = _segment_minmax_float(cd, vl, seg, ns,
+                                                 is_min)[:D][order]
+                else:
+                    if isinstance(dt, t.BooleanType):
+                        ident = jnp.asarray(is_min)
+                        acc = cd
+                    else:
+                        info = np.iinfo(np.dtype(cd.dtype))
+                        ident = jnp.asarray(info.max if is_min
+                                            else info.min, cd.dtype)
+                        acc = cd
+                    acc = jnp.where(vl, acc, ident)
+                    data = (jax.ops.segment_min if is_min
+                            else jax.ops.segment_max)(
+                        acc, seg, num_segments=ns)[:D][order]
+            elif spec.kind in (FIRST, LAST, FIRST_NN, LAST_NN):
+                idx = jnp.arange(capacity, dtype=jnp.int32)
+                is_first = spec.kind in (FIRST, FIRST_NN)
+                sel = vl if spec.kind in (FIRST_NN, LAST_NN) else live
+                masked = jnp.where(sel, idx,
+                                   jnp.int32(capacity) if is_first
+                                   else jnp.int32(-1))
+                pick = (jax.ops.segment_min if is_first
+                        else jax.ops.segment_max)(
+                    masked, seg, num_segments=ns)[:D][order]
+                pick = jnp.clip(pick, 0, capacity - 1)
+                data = cd[pick]
+                out_valid = vl[pick] & group_live
+            elif spec.kind == ANY:
+                data = jax.ops.segment_max(
+                    jnp.where(vl, cd, False).astype(jnp.int8), seg,
+                    num_segments=ns)[:D][order] > 0
+            elif spec.kind == EVERY:
+                data = jax.ops.segment_min(
+                    jnp.where(vl, cd, True).astype(jnp.int8), seg,
+                    num_segments=ns)[:D][order] > 0
+            else:
+                raise ValueError(f"unknown agg kind {spec.kind}")
+            outs.append((data, out_valid))
+        return out_keys, outs, num_groups
 
     return run

@@ -36,19 +36,28 @@ def dict_byte_tensors(dictionary: Optional[pa.Array],
     """
     if dictionary is None or len(dictionary) == 0:
         return (np.zeros(2, np.int32), np.zeros(1, np.uint8))
+    # ZERO-COPY: a pyarrow string array IS (validity, int32 offsets, utf-8
+    # bytes) buffers — read them directly instead of a per-entry python
+    # join (the round-2 O(unique)-interpreted-python hot path).
     arr = dictionary.cast(pa.string())
-    joined = "".join((v.as_py() or "") for v in arr)
-    raw = joined.encode("utf-8")
-    lens = np.array([len(((v.as_py()) or "").encode("utf-8")) for v in arr],
-                    np.int32)
-    offs = np.zeros(len(arr) + 1, np.int32)
-    np.cumsum(lens, out=offs[1:])
-    cap_n = bucket_capacity(len(arr) + 1, conf)
-    cap_b = bucket_capacity(max(len(raw), 1), conf)
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if arr.null_count:
+        arr = arr.fill_null("")
+    n = len(arr)
+    bufs = arr.buffers()
+    raw_offs = np.frombuffer(bufs[1], np.int32)[arr.offset: arr.offset
+                                                + n + 1]
+    base = int(raw_offs[0])
+    offs = (raw_offs.astype(np.int64) - base).astype(np.int32)
+    nbytes = int(offs[-1])
+    data = np.frombuffer(bufs[2], np.uint8)[base: base + nbytes]
+    cap_n = bucket_capacity(n + 1, conf)
+    cap_b = bucket_capacity(max(nbytes, 1), conf)
     offsets = np.full(cap_n + 1, offs[-1], np.int32)
-    offsets[:len(offs)] = offs
+    offsets[:n + 1] = offs
     bytes_ = np.zeros(cap_b, np.uint8)
-    bytes_[:len(raw)] = np.frombuffer(raw, np.uint8)
+    bytes_[:nbytes] = data
     return offsets, bytes_
 
 
@@ -236,3 +245,208 @@ def like_to_regex(pattern: str, escape: str = "\\") -> str:
             out.append(_re.escape(c))
         i += 1
     return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Device byte TRANSFORMS (round 3): upper/lower/trim/substring rewrite the
+# byte tensors ON DEVICE, so high-cardinality columns (near-unique ids,
+# comments) no longer serialize through a per-entry python loop
+# (plan/strings.py DictTransform routes here above a size threshold).
+# Entries containing non-ASCII bytes are flagged and fixed host-side
+# (exact python semantics for the rare multilingual tail); substring is
+# char-aware and needs no fix-up.
+# ---------------------------------------------------------------------------
+
+_TRANSFORM_CACHE: dict = {}
+
+
+def _seg_ids(offsets: jax.Array, cap_b: int, n: int) -> jax.Array:
+    pos = jnp.arange(cap_b, dtype=jnp.int32)
+    return jnp.clip(jnp.searchsorted(offsets[:n + 1], pos, side="right")
+                    - 1, 0, n - 1).astype(jnp.int32)
+
+
+def _pack_ranges(bytes_: jax.Array, lo: jax.Array, hi: jax.Array,
+                 out_cap: int):
+    """Pack per-entry byte ranges [lo, hi) into dense (offsets, bytes)."""
+    lens = jnp.maximum(hi - lo, 0).astype(jnp.int32)
+    out_offs = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                jnp.cumsum(lens).astype(jnp.int32)])
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    ent = jnp.clip(jnp.searchsorted(out_offs, j, side="right") - 1,
+                   0, lens.shape[0] - 1)
+    src = jnp.take(lo, ent) + (j - jnp.take(out_offs, ent))
+    live = j < out_offs[-1]
+    out_bytes = jnp.where(
+        live, jnp.take(bytes_, jnp.clip(src, 0, bytes_.shape[0] - 1)),
+        jnp.uint8(0))
+    return out_offs, out_bytes
+
+
+def _case_trace(n: int, cap_b: int, upper: bool):
+    def run(offsets, bytes_):
+        b = bytes_
+        if upper:
+            out = jnp.where((b >= 97) & (b <= 122), b - 32, b)
+        else:
+            out = jnp.where((b >= 65) & (b <= 90), b + 32, b)
+        non_ascii = _entry_any(offsets, b >= 0x80, cap_b, n)
+        return offsets, out, non_ascii
+    return run
+
+
+def _entry_any(offsets, flag: jax.Array, cap_b: int, n: int) -> jax.Array:
+    seg = _seg_ids(offsets, cap_b, n)
+    live = jnp.arange(cap_b, dtype=jnp.int32) < offsets[n]
+    return jax.ops.segment_max((flag & live).astype(jnp.int32), seg,
+                               num_segments=n) > 0
+
+
+_ASCII_WS = (32, 9, 10, 13, 11, 12)
+
+
+def _trim_trace(n: int, cap_b: int, left: bool, right: bool):
+    def run(offsets, bytes_):
+        cap = cap_b
+        pos = jnp.arange(cap, dtype=jnp.int32)
+        seg = _seg_ids(offsets, cap, n)
+        ws = jnp.zeros((cap,), bool)
+        for c in _ASCII_WS:
+            ws = ws | (bytes_ == c)
+        live = pos < offsets[n]
+        lo0 = jnp.take(offsets[:n], jnp.arange(n))
+        hi0 = offsets[1:n + 1]
+        big = jnp.int32(cap + 1)
+        # first non-ws byte position per entry
+        first_nw = jax.ops.segment_min(
+            jnp.where(live & ~ws, pos, big), seg, num_segments=n)
+        last_nw = jax.ops.segment_max(
+            jnp.where(live & ~ws, pos, jnp.int32(-1)), seg, num_segments=n)
+        lo = jnp.where(jnp.asarray(left), jnp.minimum(first_nw, hi0), lo0)
+        hi = jnp.where(jnp.asarray(right), last_nw + 1, hi0)
+        hi = jnp.maximum(hi, lo)
+        out_offs, out_bytes = _pack_ranges(bytes_, lo, hi, cap)
+        non_ascii = _entry_any(offsets, bytes_ >= 0x80, cap, n)
+        return out_offs, out_bytes, non_ascii
+    return run
+
+
+def _substr_trace(n: int, cap_b: int, pos_arg: int, length):
+    def run(offsets, bytes_):
+        cap = cap_b
+        lead = ((bytes_ & 0xC0) != 0x80)
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        live = idx < offsets[n]
+        lead_live = lead & live
+        # chars before each entry + per-entry char count (char_lengths)
+        lead32 = lead_live.astype(jnp.int32)
+        csum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                jnp.cumsum(lead32)])
+        chars_before = csum[jnp.clip(offsets[:n], 0, cap)]
+        nchars = csum[jnp.clip(offsets[1:n + 1], 0, cap)] - chars_before
+        # byte position of the r-th char (global rank): stable compaction
+        char_pos = jnp.argsort(jnp.where(lead_live, idx, jnp.int32(cap)),
+                               stable=True).astype(jnp.int32)
+        total_chars = csum[-1]
+
+        if pos_arg > 0:
+            start = jnp.minimum(jnp.int32(pos_arg - 1), nchars)
+        elif pos_arg == 0:
+            start = jnp.zeros((n,), jnp.int32)
+        else:
+            start = jnp.maximum(nchars + jnp.int32(pos_arg), 0)
+        if length is None:
+            end = nchars
+        elif length <= 0:
+            end = start
+        else:
+            end = jnp.minimum(start + jnp.int32(length), nchars)
+        end = jnp.maximum(end, start)
+
+        def char_byte(rank):
+            # byte offset of global char rank; ranks at the end map to
+            # the bytes' end
+            r = jnp.clip(rank, 0, cap - 1)
+            p = jnp.take(char_pos, r)
+            return jnp.where(rank >= total_chars, offsets[n], p)
+
+        lo = char_byte(chars_before + start)
+        hi = char_byte(chars_before + end)
+        # chars of the NEXT entry start exactly at this entry's byte end,
+        # so an end-rank inside the next entry clamps to this entry's hi
+        hi = jnp.minimum(hi, offsets[1:n + 1])
+        lo = jnp.minimum(lo, offsets[1:n + 1])
+        out_offs, out_bytes = _pack_ranges(bytes_, lo, hi, cap)
+        return out_offs, out_bytes, jnp.zeros((n,), bool)
+    return run
+
+
+def transform_dict_device(dictionary: pa.Array, kind: str, args: tuple,
+                          conf: TpuConf = DEFAULT_CONF) -> pa.Array:
+    """Transform every dictionary entry on device; ONE fetch builds the
+    output pa.StringArray from the packed buffers.  `kind`:
+    upper|lower|trim|ltrim|rtrim|substr(pos, len)."""
+    offs_np, bytes_np = dict_byte_tensors(dictionary, conf)
+    n = len(dictionary)
+    cap_b = bytes_np.shape[0]
+    sig = (kind, args, offs_np.shape[0], cap_b, n)
+    fn = _TRANSFORM_CACHE.get(sig)
+    if fn is None:
+        if kind in ("upper", "lower"):
+            fn = jax.jit(_case_trace(n, cap_b, kind == "upper"))
+        elif kind in ("trim", "ltrim", "rtrim"):
+            fn = jax.jit(_trim_trace(n, cap_b, kind != "rtrim",
+                                     kind != "ltrim"))
+        elif kind == "substr":
+            fn = jax.jit(_substr_trace(n, cap_b, args[0], args[1]))
+        else:
+            raise ValueError(kind)
+        if len(_TRANSFORM_CACHE) > 512:
+            _TRANSFORM_CACHE.clear()
+        _TRANSFORM_CACHE[sig] = fn
+    out_offs, out_bytes, fixup = jax.device_get(
+        fn(jnp.asarray(offs_np), jnp.asarray(bytes_np)))
+    out_offs = np.asarray(out_offs)[:n + 1]
+    total = int(out_offs[-1])
+    data = np.asarray(out_bytes)[:total].tobytes()
+    arr = pa.Array.from_buffers(
+        pa.utf8(), n,
+        [None, pa.py_buffer(out_offs.astype(np.int32).tobytes()),
+         pa.py_buffer(data)])
+    fix = np.asarray(fixup)[:n]
+    if fix.any():
+        # exact python semantics for entries with non-ASCII bytes
+        vals = arr.to_pylist()
+        src = dictionary.cast(pa.string())
+        for i in np.nonzero(fix)[0].tolist():
+            s = src[i].as_py()
+            if s is None:
+                vals[i] = None
+                continue
+            if kind == "upper":
+                vals[i] = s.upper()
+            elif kind == "lower":
+                vals[i] = s.lower()
+            elif kind == "trim":
+                vals[i] = s.strip()
+            elif kind == "ltrim":
+                vals[i] = s.lstrip()
+            elif kind == "rtrim":
+                vals[i] = s.rstrip()
+        arr = pa.array(vals, pa.string())
+    # null entries: reuse the SOURCE validity bitmap directly (nulls were
+    # encoded as empty strings in the byte tensors) — no pylist loop
+    if dictionary.null_count:
+        src = dictionary.cast(pa.string())
+        if isinstance(src, pa.ChunkedArray):
+            src = src.combine_chunks()
+        if src.offset == 0:
+            bufs = arr.buffers()
+            arr = pa.Array.from_buffers(
+                pa.utf8(), n, [src.buffers()[0], bufs[1], bufs[2]],
+                null_count=src.null_count)
+        else:                 # sliced source: bit-shifted bitmap; rebuild
+            import pyarrow.compute as pc
+            arr = pc.if_else(pc.is_valid(src), arr,
+                             pa.scalar(None, pa.string()))
+    return arr
