@@ -1,0 +1,9 @@
+name := "spark-rapids-tpu-plugin"
+version := "0.3.0-SNAPSHOT"
+scalaVersion := "2.12.18"
+
+libraryDependencies ++= Seq(
+  "org.apache.spark" %% "spark-sql" % "3.5.1" % "provided",
+  "org.apache.arrow" % "arrow-vector" % "14.0.2",
+  "org.apache.arrow" % "arrow-memory-netty" % "14.0.2"
+)

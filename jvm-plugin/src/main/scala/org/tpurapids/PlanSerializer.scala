@@ -1,0 +1,278 @@
+/*
+ * Catalyst physical plan/expression -> the engine's JSON wire schema
+ * (spark_rapids_tpu/plugin/protocol.py).  The encodable surface mirrors
+ * the worker's expr_from_json/plan_from_json decoders; anything outside
+ * it returns Left(reason) so TpuOverrideRule leaves that operator on
+ * Spark with the reason logged (the RapidsMeta willNotWorkOnGpu
+ * contract).
+ */
+package org.tpurapids
+
+import scala.collection.mutable
+
+import org.apache.spark.sql.catalyst.expressions._
+import org.apache.spark.sql.catalyst.expressions.aggregate._
+import org.apache.spark.sql.execution._
+import org.apache.spark.sql.execution.aggregate.HashAggregateExec
+import org.apache.spark.sql.execution.joins.{BroadcastHashJoinExec, ShuffledHashJoinExec, SortMergeJoinExec}
+import org.apache.spark.sql.types._
+
+/** Serialized subtree: protocol JSON + the leaf scans to ship as Arrow. */
+case class SerializedPlan(json: String, inputs: Seq[SparkPlan])
+
+object PlanSerializer {
+
+  def trySerialize(plan: SparkPlan): Either[String, SerializedPlan] = {
+    try {
+      val inputs = mutable.ArrayBuffer[SparkPlan]()
+      val json = planJson(plan, inputs)
+      Right(SerializedPlan(Json.render(json), inputs.toSeq))
+    } catch {
+      case e: UnsupportedPlan => Left(e.getMessage)
+    }
+  }
+
+  private final class UnsupportedPlan(msg: String) extends Exception(msg)
+  private def bail(msg: String): Nothing = throw new UnsupportedPlan(msg)
+
+  // ---- plans ----------------------------------------------------------
+
+  private def planJson(p: SparkPlan,
+                       inputs: mutable.ArrayBuffer[SparkPlan]): Json.V =
+    p match {
+      case ProjectExec(exprs, child) =>
+        Json.obj(
+          "op" -> Json.s("Project"),
+          "exprs" -> Json.arr(exprs.map(e => exprJson(stripAlias(e))): _*),
+          "names" -> Json.arr(exprs.map(e => Json.s(e.name)): _*),
+          "child" -> planJson(child, inputs))
+      case FilterExec(cond, child) =>
+        Json.obj("op" -> Json.s("Filter"),
+          "condition" -> exprJson(cond),
+          "child" -> planJson(child, inputs))
+      case agg: HashAggregateExec =>
+        Json.obj("op" -> Json.s("Aggregate"),
+          "keys" -> Json.arr(agg.groupingExpressions.map(exprJson): _*),
+          "key_names" -> Json.arr(
+            agg.groupingExpressions.map(e => Json.s(e.name)): _*),
+          "aggs" -> Json.arr(agg.aggregateExpressions.map(aggJson): _*),
+          "child" -> planJson(agg.child, inputs))
+      case j: ShuffledHashJoinExec =>
+        joinJson(j.joinType.sql, j.leftKeys, j.rightKeys, j.left, j.right,
+                 broadcast = null, inputs)
+      case j: SortMergeJoinExec =>
+        // SMJ converts to the worker's hash join, as the reference's
+        // GpuSortMergeJoinMeta does
+        joinJson(j.joinType.sql, j.leftKeys, j.rightKeys, j.left, j.right,
+                 broadcast = null, inputs)
+      case j: BroadcastHashJoinExec =>
+        joinJson(j.joinType.sql, j.leftKeys, j.rightKeys, j.left, j.right,
+                 broadcast = "right", inputs)
+      case s: SortExec =>
+        Json.obj("op" -> Json.s("Sort"),
+          "orders" -> Json.arr(s.sortOrder.map { so =>
+            Json.arr(exprJson(so.child),
+                     Json.b(so.direction == Ascending),
+                     Json.b(so.nullOrdering == NullsFirst))
+          }: _*),
+          "global" -> Json.b(s.global),
+          "child" -> planJson(s.child, inputs))
+      case l: LocalLimitExec =>
+        Json.obj("op" -> Json.s("Limit"), "n" -> Json.i(l.limit),
+          "child" -> planJson(l.child, inputs))
+      case g: GlobalLimitExec =>
+        Json.obj("op" -> Json.s("Limit"), "n" -> Json.i(g.limit),
+          "child" -> planJson(g.child, inputs))
+      case u: UnionExec =>
+        Json.obj("op" -> Json.s("Union"),
+          "children" -> Json.arr(u.children.map(planJson(_, inputs)): _*))
+      case leaf: LeafExecNode =>
+        // any leaf (file scan, in-memory relation, reused exchange
+        // output) ships as an Arrow table: record it and reference by
+        // position (matches protocol.py's "t0", "t1", ... naming)
+        val idx = inputs.indexWhere(_ eq leaf) match {
+          case -1 => inputs += leaf; inputs.length - 1
+          case i => i
+        }
+        Json.obj("op" -> Json.s("Scan"), "table" -> Json.s(s"t$idx"))
+      case other =>
+        bail(s"operator ${other.nodeName} has no TPU wire encoding")
+    }
+
+  private def joinJson(how: String, lk: Seq[Expression], rk: Seq[Expression],
+                       left: SparkPlan, right: SparkPlan, broadcast: String,
+                       inputs: mutable.ArrayBuffer[SparkPlan]): Json.V = {
+    val howNorm = how.toLowerCase.replace(" ", "_") match {
+      case "inner" => "inner"
+      case "left_outer" | "leftouter" => "left_outer"
+      case "right_outer" | "rightouter" => "right_outer"
+      case "full_outer" | "fullouter" => "full_outer"
+      case "left_semi" | "leftsemi" => "left_semi"
+      case "left_anti" | "leftanti" => "left_anti"
+      case "cross" => "cross"
+      case o => bail(s"join type $o not supported")
+    }
+    Json.obj("op" -> Json.s("Join"), "how" -> Json.s(howNorm),
+      "left_keys" -> Json.arr(lk.map(exprJson): _*),
+      "right_keys" -> Json.arr(rk.map(exprJson): _*),
+      "broadcast" -> (if (broadcast == null) Json.nul else Json.s(broadcast)),
+      "left" -> planJson(left, inputs),
+      "right" -> planJson(right, inputs))
+  }
+
+  // ---- expressions ----------------------------------------------------
+
+  private def stripAlias(e: Expression): Expression = e match {
+    case Alias(child, _) => child
+    case other => other
+  }
+
+  /** Catalyst class name -> the worker's children-only class name. */
+  private val childOnly: Map[Class[_], String] = Map(
+    classOf[Add] -> "Add", classOf[Subtract] -> "Subtract",
+    classOf[Multiply] -> "Multiply", classOf[Divide] -> "Divide",
+    classOf[Remainder] -> "Remainder", classOf[UnaryMinus] -> "UnaryMinus",
+    classOf[Abs] -> "Abs", classOf[EqualTo] -> "EqualTo",
+    classOf[LessThan] -> "LessThan",
+    classOf[LessThanOrEqual] -> "LessThanOrEqual",
+    classOf[GreaterThan] -> "GreaterThan",
+    classOf[GreaterThanOrEqual] -> "GreaterThanOrEqual",
+    classOf[EqualNullSafe] -> "EqualNullSafe",
+    classOf[And] -> "And", classOf[Or] -> "Or", classOf[Not] -> "Not",
+    classOf[IsNull] -> "IsNull", classOf[IsNotNull] -> "IsNotNull",
+    classOf[IsNaN] -> "IsNaN", classOf[Coalesce] -> "Coalesce",
+    classOf[If] -> "If", classOf[Sqrt] -> "Sqrt", classOf[Exp] -> "Exp",
+    classOf[Log] -> "Log", classOf[Floor] -> "Floor",
+    classOf[Ceil] -> "Ceil", classOf[Pow] -> "Pow",
+    classOf[Greatest] -> "Greatest", classOf[Least] -> "Least",
+    classOf[Upper] -> "Upper", classOf[Lower] -> "Lower",
+    classOf[Length] -> "Length", classOf[Concat] -> "Concat",
+    classOf[Year] -> "Year", classOf[Month] -> "Month",
+    classOf[DayOfMonth] -> "DayOfMonth", classOf[Hour] -> "Hour",
+    classOf[Minute] -> "Minute", classOf[Second] -> "Second",
+    classOf[DateAdd] -> "DateAdd", classOf[DateSub] -> "DateSub",
+    classOf[DateDiff] -> "DateDiff")
+
+  def exprJson(e: Expression): Json.V = e match {
+    case a: AttributeReference =>
+      Json.obj("e" -> Json.s("ColumnRef"), "name" -> Json.s(a.name))
+    case Alias(child, _) => exprJson(child)
+    case lit: Literal => literalJson(lit)
+    case c: Cast =>
+      Json.obj("e" -> Json.s("Cast"),
+        "dtype" -> Json.s(typeString(c.dataType)),
+        "child" -> exprJson(c.child))
+    case in: In if in.list.forall(_.isInstanceOf[Literal]) =>
+      Json.obj("e" -> Json.s("In"), "child" -> exprJson(in.value),
+        "items" -> Json.arr(in.list.map(l =>
+          literalValue(l.asInstanceOf[Literal])): _*))
+    case cw: CaseWhen =>
+      Json.obj("e" -> Json.s("CaseWhen"),
+        "branches" -> Json.arr(cw.branches.map { case (c, v) =>
+          Json.arr(exprJson(c), exprJson(v)) }: _*),
+        "else" -> cw.elseValue.map(exprJson).getOrElse(Json.nul))
+    case ss: Substring =>
+      Json.obj("e" -> Json.s("Substring"), "child" -> exprJson(ss.str),
+        "pos" -> exprJson(ss.pos), "length" -> exprJson(ss.len))
+    case sw: StartsWith =>
+      needleJson("StartsWith", sw.left, sw.right)
+    case ew: EndsWith => needleJson("EndsWith", ew.left, ew.right)
+    case ct: Contains => needleJson("Contains", ct.left, ct.right)
+    case other =>
+      childOnly.get(other.getClass) match {
+        case Some(name) =>
+          Json.obj("e" -> Json.s(name),
+            "children" -> Json.arr(other.children.map(exprJson): _*))
+        case None =>
+          bail(s"expression ${other.prettyName} has no TPU wire encoding")
+      }
+  }
+
+  private def needleJson(name: String, subject: Expression,
+                         needle: Expression): Json.V = needle match {
+    case Literal(v, StringType) =>
+      Json.obj("e" -> Json.s(name), "child" -> exprJson(subject),
+        "needle" -> Json.s(v.toString))
+    case _ => bail(s"$name needle must be a literal")
+  }
+
+  private def aggJson(ae: AggregateExpression): Json.V = {
+    val (fn, child) = ae.aggregateFunction match {
+      case Sum(c, _) => ("Sum", Some(c))
+      case Count(Seq(Literal(1, _))) | Count(Nil) => ("Count", None)
+      case Count(Seq(c)) => ("Count", Some(c))
+      case Min(c) => ("Min", Some(c))
+      case Max(c) => ("Max", Some(c))
+      case Average(c, _) => ("Average", Some(c))
+      case First(c, ignoreNulls) => ("First", Some(c))
+      case Last(c, ignoreNulls) => ("Last", Some(c))
+      case other => bail(s"aggregate ${other.prettyName} not encodable")
+    }
+    Json.obj("fn" -> Json.s(fn),
+      "name" -> Json.s(ae.resultAttribute.name),
+      "child" -> child.map(exprJson).getOrElse(Json.nul))
+  }
+
+  private def literalJson(lit: Literal): Json.V =
+    Json.obj("e" -> Json.s("Literal"), "value" -> literalValue(lit),
+      "dtype" -> Json.s(typeString(lit.dataType)))
+
+  private def literalValue(lit: Literal): Json.V = lit.dataType match {
+    case _ if lit.value == null => Json.nul
+    case StringType => Json.s(lit.value.toString)
+    case BooleanType => Json.b(lit.value.asInstanceOf[Boolean])
+    case _: IntegralType => Json.i(lit.value.toString.toLong)
+    case _: FractionalType => Json.d(lit.value.toString.toDouble)
+    case DateType => Json.i(lit.value.toString.toLong)  // days since epoch
+    case dt => bail(s"literal of type $dt not encodable")
+  }
+
+  private def typeString(dt: DataType): String = dt match {
+    case BooleanType => "boolean"
+    case ByteType => "tinyint"
+    case ShortType => "smallint"
+    case IntegerType => "int"
+    case LongType => "bigint"
+    case FloatType => "float"
+    case DoubleType => "double"
+    case StringType => "string"
+    case DateType => "date"
+    case TimestampType => "timestamp"
+    case d: DecimalType => s"decimal(${d.precision},${d.scale})"
+    case other => bail(s"type $other not encodable")
+  }
+}
+
+/** Dependency-free minimal JSON rendering (the plugin shades nothing). */
+object Json {
+  sealed trait V { def render: String }
+  case class S(v: String) extends V {
+    def render: String = "\"" + v.flatMap {
+      case '"' => "\\\""
+      case '\\' => "\\\\"
+      case '\n' => "\\n"
+      case c if c < ' ' => f"\\u${c.toInt}%04x"
+      case c => c.toString
+    } + "\""
+  }
+  case class I(v: Long) extends V { def render: String = v.toString }
+  case class D(v: Double) extends V { def render: String = v.toString }
+  case class B(v: Boolean) extends V { def render: String = v.toString }
+  case object Null extends V { def render: String = "null" }
+  case class A(items: Seq[V]) extends V {
+    def render: String = items.map(_.render).mkString("[", ",", "]")
+  }
+  case class O(fields: Seq[(String, V)]) extends V {
+    def render: String =
+      fields.map { case (k, v) => S(k).render + ":" + v.render }
+        .mkString("{", ",", "}")
+  }
+  def s(v: String): V = S(v)
+  def i(v: Long): V = I(v)
+  def d(v: Double): V = D(v)
+  def b(v: Boolean): V = B(v)
+  def nul: V = Null
+  def arr(items: V*): V = A(items)
+  def obj(fields: (String, V)*): V = O(fields)
+  def render(v: V): String = v.render
+}

@@ -1,0 +1,51 @@
+/*
+ * The plan-walking override rule (reference GpuOverrides.scala:4563-4720
+ * applyWithContext/applyOverrides + RapidsMeta tagging).
+ *
+ * Strategy: find the LARGEST subtrees whose every operator and
+ * expression PlanSerializer can encode, replace each with a TpuExec leaf
+ * that ships the serialized subtree (plus its input tables as Arrow) to
+ * the worker, and leave everything else on Spark with a logged reason —
+ * per-operator fallback, never whole-query.
+ */
+package org.tpurapids
+
+import org.apache.spark.internal.Logging
+import org.apache.spark.sql.catalyst.rules.Rule
+import org.apache.spark.sql.execution._
+import org.apache.spark.sql.execution.exchange.Exchange
+
+class TpuOverrideRule extends Rule[SparkPlan] with Logging {
+
+  override def apply(plan: SparkPlan): SparkPlan = {
+    val conf = plan.conf
+    if (!conf.getConfString(TpuPluginConf.SqlEnabled, "true").toBoolean) {
+      return plan
+    }
+    val explain = conf.getConfString(TpuPluginConf.Explain, "NONE")
+    convert(plan, explain)
+  }
+
+  /** Bottom-up: children first, then try to claim this node.  A node is
+    * claimable when PlanSerializer encodes it AND all its children were
+    * claimed (contiguous device subtrees, the doConvertPlan rule). */
+  private def convert(plan: SparkPlan, explain: String): SparkPlan = {
+    plan match {
+      case _: Exchange =>
+        // exchanges stay on Spark: the shuffle boundary is where the
+        // worker's own distributed exchange takes over (SURVEY §2.7)
+        plan.withNewChildren(plan.children.map(convert(_, explain)))
+      case _ =>
+        PlanSerializer.trySerialize(plan) match {
+          case Right(payload) =>
+            TpuExec(plan, payload)
+          case Left(reason) =>
+            if (explain != "NONE") {
+              logWarning(s"!Exec <${plan.nodeName}> cannot run on TPU " +
+                s"because $reason")
+            }
+            plan.withNewChildren(plan.children.map(convert(_, explain)))
+        }
+    }
+  }
+}

@@ -1,0 +1,81 @@
+/*
+ * The SparkPlugin pair: driver + executor lifecycle for the TPU engine.
+ *
+ * Reference roles: RapidsDriverPlugin (sql-plugin Plugin.scala:426-491,
+ * config fixups + conf broadcast) and RapidsExecutorPlugin
+ * (Plugin.scala:496-576, device init / health checks / fatal-error
+ * executor self-termination).  The CUDA-era device bring-up maps to
+ * launching (or attaching to) the long-lived TPU worker process that
+ * owns the chip for this executor; the JNI boundary maps to the framed
+ * socket protocol in WorkerClient.scala.
+ */
+package org.tpurapids
+
+import java.util.{Map => JMap}
+import scala.collection.JavaConverters._
+
+import org.apache.spark.SparkContext
+import org.apache.spark.api.plugin.{DriverPlugin, ExecutorPlugin, PluginContext, SparkPlugin}
+import org.apache.spark.internal.Logging
+import org.apache.spark.sql.internal.StaticSQLConf
+
+class TpuPlugin extends SparkPlugin {
+  override def driverPlugin(): DriverPlugin = new TpuDriverPlugin
+  override def executorPlugin(): ExecutorPlugin = new TpuExecutorPlugin
+}
+
+object TpuPluginConf {
+  val WorkerAddress = "spark.tpurapids.worker.address"
+  val WorkerToken = "spark.tpurapids.worker.token"
+  val WorkerLaunch = "spark.tpurapids.worker.autoLaunch"
+  val SqlEnabled = "spark.tpurapids.sql.enabled"
+  val Explain = "spark.tpurapids.sql.explain"
+}
+
+class TpuDriverPlugin extends DriverPlugin with Logging {
+  override def init(sc: SparkContext, ctx: PluginContext): JMap[String, String] = {
+    // fixupConfigsOnDriver role (Plugin.scala:457): force the SQL
+    // extension in so the ColumnarRule is installed for every session.
+    val extKey = StaticSQLConf.SPARK_SESSION_EXTENSIONS.key
+    val ext = sc.conf.getOption(extKey)
+    val ours = classOf[TpuSQLExecPlugin].getName
+    ext match {
+      case Some(v) if v.contains(ours) => ()
+      case Some(v) => sc.conf.set(extKey, s"$v,$ours")
+      case None => sc.conf.set(extKey, ours)
+    }
+    logInfo(s"spark-rapids-tpu driver plugin initialized; extensions=$ours")
+    // broadcast the worker coordinates to executors (the conf-map hop
+    // RapidsDriverPlugin.init returns, Plugin.scala:480)
+    Map(
+      TpuPluginConf.WorkerAddress ->
+        sc.conf.get(TpuPluginConf.WorkerAddress, "127.0.0.1:9779"),
+      TpuPluginConf.WorkerToken ->
+        sc.conf.get(TpuPluginConf.WorkerToken, "")
+    ).asJava
+  }
+}
+
+class TpuExecutorPlugin extends ExecutorPlugin with Logging {
+  @volatile private var client: WorkerClient = _
+
+  override def init(ctx: PluginContext, extraConf: JMap[String, String]): Unit = {
+    val addr = extraConf.get(TpuPluginConf.WorkerAddress)
+    val token = extraConf.get(TpuPluginConf.WorkerToken)
+    val Array(host, port) = addr.split(":")
+    // Device bring-up (GpuDeviceManager.initializeGpuAndMemory role):
+    // attach to the executor's TPU worker and health-check it.  A worker
+    // that cannot be reached is the CudaException analogue — fail fast
+    // so Spark replaces the executor (Plugin.scala:566-575).
+    client = new WorkerClient(host, port.toInt, token)
+    val pong = client.ping()
+    require(pong.version == ProtocolVersion.Current,
+      s"worker protocol ${pong.version} != ${ProtocolVersion.Current}")
+    WorkerClient.shared = client
+    logInfo(s"attached to TPU worker at $addr (protocol v${pong.version})")
+  }
+
+  override def shutdown(): Unit = {
+    if (client != null) client.close()
+  }
+}

@@ -1,0 +1,28 @@
+/*
+ * SparkSessionExtensions injection (reference SQLExecPlugin.scala:27-43):
+ * install the columnar override rule so every physical plan (and every
+ * AQE query stage) passes through the TPU overrides.
+ */
+package org.tpurapids
+
+import org.apache.spark.internal.Logging
+import org.apache.spark.sql.SparkSessionExtensions
+import org.apache.spark.sql.catalyst.rules.Rule
+import org.apache.spark.sql.execution.{ColumnarRule, SparkPlan}
+
+class TpuSQLExecPlugin extends (SparkSessionExtensions => Unit) with Logging {
+  override def apply(ext: SparkSessionExtensions): Unit = {
+    ext.injectColumnar(_ => new TpuColumnarRule)
+    logInfo("spark-rapids-tpu columnar rule injected")
+  }
+}
+
+class TpuColumnarRule extends ColumnarRule {
+  // pre-columnar-transitions: the wrap/tag/convert pass
+  override def preColumnarTransitions: Rule[SparkPlan] = new TpuOverrideRule
+  // post-columnar-transitions: nothing extra — TpuExec produces rows
+  // directly (the worker returns Arrow; row conversion happens at the
+  // exec boundary), so Spark's own transitions suffice.
+  override def postColumnarTransitions: Rule[SparkPlan] =
+    new Rule[SparkPlan] { override def apply(p: SparkPlan): SparkPlan = p }
+}

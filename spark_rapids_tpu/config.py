@@ -122,6 +122,12 @@ WHOLE_PLAN_COMPILE = conf(
     "automatically fall back to the eager engine.",
     checker=_enum_checker("AUTO", "ON", "OFF"), commonly_used=True)
 
+PYTHON_WORKER_CONCURRENCY = conf(
+    "spark.rapids.tpu.python.concurrentPythonWorkers", 4,
+    "Concurrent pandas-UDF worker processes (the reference's "
+    "spark.rapids.python.concurrentPythonWorkers / "
+    "PythonWorkerSemaphore role).", checker=_positive)
+
 STRING_TRANSFORM_DEVICE_MIN = conf(
     "spark.rapids.tpu.sql.string.transformDeviceMinUnique", 8192,
     "Dictionary size above which string transforms (upper/lower/trim/"

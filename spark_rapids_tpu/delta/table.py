@@ -34,6 +34,67 @@ from .. import types as t
 from ..columnar.host import schema_to_struct, struct_to_schema
 
 
+def _split_partitions(table: pa.Table, parts: Sequence[str]):
+    """-> [(partition_values dict, sub-table)] by distinct partition
+    tuple (vectorized arrow group discovery, then filtered takes)."""
+    import pyarrow.compute as pc
+    keys = table.select(list(parts))
+    distinct = keys.group_by(list(parts)).aggregate([])
+    out = []
+    for row in distinct.to_pylist():
+        mask = None
+        for k, v in row.items():
+            m = pc.is_null(table.column(k)) if v is None \
+                else pc.equal(table.column(k), pa.scalar(v))
+            m = pc.fill_null(m, False)
+            mask = m if mask is None else pc.and_(mask, m)
+        out.append((row, table.filter(mask)))
+    return out
+
+
+def _checkpoint_schema() -> pa.Schema:
+    """The standard Delta checkpoint parquet layout (one action per row,
+    one struct column per action type)."""
+    add_t = pa.struct([
+        ("path", pa.string()),
+        ("partitionValues", pa.map_(pa.string(), pa.string())),
+        ("size", pa.int64()),
+        ("modificationTime", pa.int64()),
+        ("dataChange", pa.bool_()),
+        ("stats", pa.string()),
+    ])
+    remove_t = pa.struct([
+        ("path", pa.string()),
+        ("deletionTimestamp", pa.int64()),
+        ("dataChange", pa.bool_()),
+    ])
+    meta_t = pa.struct([
+        ("id", pa.string()),
+        ("name", pa.string()),
+        ("description", pa.string()),
+        ("format", pa.struct([("provider", pa.string()),
+                              ("options", pa.map_(pa.string(),
+                                                  pa.string()))])),
+        ("schemaString", pa.string()),
+        ("partitionColumns", pa.list_(pa.string())),
+        ("configuration", pa.map_(pa.string(), pa.string())),
+        ("createdTime", pa.int64()),
+    ])
+    protocol_t = pa.struct([
+        ("minReaderVersion", pa.int32()),
+        ("minWriterVersion", pa.int32()),
+    ])
+    txn_t = pa.struct([
+        ("appId", pa.string()),
+        ("version", pa.int64()),
+        ("lastUpdated", pa.int64()),
+    ])
+    return pa.schema([
+        pa.field("txn", txn_t), pa.field("add", add_t),
+        pa.field("remove", remove_t), pa.field("metaData", meta_t),
+        pa.field("protocol", protocol_t)])
+
+
 class DeltaConcurrentModification(RuntimeError):
     """Another writer committed this version first (optimistic conflict)."""
 
@@ -64,11 +125,68 @@ class DeltaTable:
 
     def version(self) -> int:
         vs = self._versions()
-        return vs[-1] if vs else -1
+        latest = vs[-1] if vs else -1
+        cp = self._last_checkpoint()
+        if cp is not None and cp > latest:
+            latest = cp            # JSON commits expired past a checkpoint
+        return latest
+
+    def _last_checkpoint(self, upto: Optional[int] = None) -> Optional[int]:
+        """Latest checkpoint version <= upto, preferring the
+        _last_checkpoint pointer (delta-lake/common checkpoint contract);
+        falls back to a directory listing for tables whose pointer is
+        stale or missing."""
+        cands = []
+        ptr = os.path.join(self.log_dir, "_last_checkpoint")
+        if os.path.exists(ptr):
+            try:
+                with open(ptr) as f:
+                    v = int(json.load(f)["version"])
+                if (upto is None or v <= upto) and os.path.exists(
+                        os.path.join(self.log_dir,
+                                     f"{v:020d}.checkpoint.parquet")):
+                    cands.append(v)
+            except (ValueError, KeyError, json.JSONDecodeError):
+                pass
+        if not cands and os.path.isdir(self.log_dir):
+            for f in os.listdir(self.log_dir):
+                if f.endswith(".checkpoint.parquet"):
+                    try:
+                        v = int(f.split(".")[0])
+                    except ValueError:
+                        continue
+                    if upto is None or v <= upto:
+                        cands.append(v)
+        return max(cands) if cands else None
+
+    @staticmethod
+    def _checkpoint_row_to_actions(row: dict) -> List[dict]:
+        out = []
+        for key in ("protocol", "metaData", "add", "remove", "txn"):
+            v = row.get(key)
+            if v is None:
+                continue
+            v = {k: x for k, x in v.items() if x is not None}
+            if key == "metaData" and isinstance(
+                    v.get("format"), dict):
+                v["format"] = {k: x for k, x in v["format"].items()
+                               if x is not None}
+            out.append({key: v})
+        return out
 
     def _read_actions(self, upto: Optional[int] = None) -> List[dict]:
         actions = []
+        start = 0
+        cp = self._last_checkpoint(upto)
+        if cp is not None:
+            cp_path = os.path.join(self.log_dir,
+                                   f"{cp:020d}.checkpoint.parquet")
+            for row in pq.read_table(cp_path).to_pylist():
+                actions.extend(self._checkpoint_row_to_actions(row))
+            start = cp + 1
         for v in self._versions():
+            if v < start:
+                continue
             if upto is not None and v > upto:
                 break
             with open(os.path.join(self.log_dir, _version_name(v))) as f:
@@ -77,6 +195,42 @@ class DeltaTable:
                     if line:
                         actions.append(json.loads(line))
         return actions
+
+    def checkpoint(self, version: Optional[int] = None) -> int:
+        """Write a parquet checkpoint of the log state at `version`
+        (default: latest) + the _last_checkpoint pointer — real Delta
+        readers (and this engine) then replay from the checkpoint instead
+        of the full JSON chain (delta-lake/common checkpoint role)."""
+        v = self.version() if version is None else version
+        if v < 0:
+            raise ValueError("cannot checkpoint an empty log")
+        active: Dict[str, dict] = {}
+        meta = protocol = None
+        for a in self._read_actions(v):
+            if "add" in a:
+                active[a["add"]["path"]] = a["add"]
+            elif "remove" in a:
+                active.pop(a["remove"]["path"], None)
+            elif "metaData" in a:
+                meta = a["metaData"]
+            elif "protocol" in a:
+                protocol = a["protocol"]
+        rows = []
+        if protocol is not None:
+            rows.append({"protocol": protocol})
+        if meta is not None:
+            rows.append({"metaData": meta})
+        for add in active.values():
+            rows.append({"add": add})
+        cp_schema = _checkpoint_schema()
+        full_rows = [{k: r.get(k) for k in cp_schema.names} for r in rows]
+        tbl = pa.Table.from_pylist(full_rows, cp_schema)
+        pq.write_table(tbl, os.path.join(
+            self.log_dir, f"{v:020d}.checkpoint.parquet"))
+        with open(os.path.join(self.log_dir, "_last_checkpoint"),
+                  "w") as f:
+            json.dump({"version": v, "size": len(rows)}, f)
+        return v
 
     def snapshot_files(self, version: Optional[int] = None) -> List[str]:
         """Active data files after log replay (add minus remove)."""
@@ -125,13 +279,23 @@ class DeltaTable:
     # ------------------------------------------------------------------
     # writes
     # ------------------------------------------------------------------
-    def _write_file(self, tbl: pa.Table) -> Tuple[str, dict]:
+    def _write_file(self, tbl: pa.Table,
+                    part_values: Optional[Dict[str, object]] = None
+                    ) -> Tuple[str, dict]:
         """One parquet data file + its stats-bearing add action
-        (GpuStatisticsCollection role: per-file min/max/nullCount)."""
+        (GpuStatisticsCollection role: per-file min/max/nullCount).
+        `part_values` places the file under hive-style col=val/ dirs and
+        records partitionValues (GpuFileFormatDataWriter dynamic-partition
+        role)."""
         import pyarrow.compute as pc
         name = f"part-{uuid.uuid4().hex}.parquet"
+        if part_values:
+            segs = []
+            for k, v in part_values.items():
+                segs.append(f"{k}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}")
+            name = "/".join(segs + [name])
         full = os.path.join(self.path, name)
-        os.makedirs(self.path, exist_ok=True)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
         pq.write_table(tbl, full, compression="zstd")
         mins, maxs, nulls = {}, {}, {}
         for c in tbl.schema.names:
@@ -145,13 +309,17 @@ class DeltaTable:
         stats = {"numRecords": tbl.num_rows, "minValues": mins,
                  "maxValues": maxs, "nullCount": nulls}
         add = {"add": {
-            "path": name, "partitionValues": {},
+            "path": name,
+            "partitionValues": {} if not part_values else
+            {k: (None if v is None else str(v))
+             for k, v in part_values.items()},
             "size": os.path.getsize(full),
             "modificationTime": int(time.time() * 1000),
             "dataChange": True, "stats": json.dumps(stats)}}
         return name, add
 
-    def _meta_action(self, schema: pa.Schema) -> dict:
+    def _meta_action(self, schema: pa.Schema,
+                     partition_by: Optional[Sequence[str]] = None) -> dict:
         fields = [{"name": n, "type": _arrow_type_to_delta(schema.field(n).type),
                    "nullable": schema.field(n).nullable, "metadata": {}}
                   for n in schema.names]
@@ -160,19 +328,38 @@ class DeltaTable:
             "format": {"provider": "parquet", "options": {}},
             "schemaString": json.dumps({"type": "struct",
                                         "fields": fields}),
-            "partitionColumns": [], "configuration": {},
+            "partitionColumns": list(partition_by or []),
+            "configuration": {},
             "createdTime": int(time.time() * 1000)}}
 
-    def write(self, table: pa.Table, mode: str = "append") -> int:
+    def partition_columns(self, version: Optional[int] = None) -> List[str]:
+        meta = None
+        for a in self._read_actions(version):
+            if "metaData" in a:
+                meta = a["metaData"]
+        return list((meta or {}).get("partitionColumns") or [])
+
+    def write(self, table: pa.Table, mode: str = "append",
+              partition_by: Optional[Sequence[str]] = None) -> int:
         """append | overwrite; creates the table if absent.  Returns the
-        committed version."""
+        committed version.  `partition_by` (create-time, or inherited
+        from the table's metadata) splits rows into hive-style
+        partition directories with per-partition stats-bearing files —
+        the reference's dynamic-partition writer
+        (GpuFileFormatDataWriter.scala)."""
         assert mode in ("append", "overwrite")
         version = self.version() + 1
+        existing_parts = self.partition_columns() if version > 0 else []
+        parts = list(partition_by) if partition_by is not None             else existing_parts
+        if version > 0 and partition_by is not None and                 list(partition_by) != existing_parts:
+            raise ValueError(
+                f"table is partitioned by {existing_parts}, "
+                f"got {list(partition_by)}")
         actions = [self._commit_info("WRITE", {"mode": mode})]
         if version == 0:
             actions.append({"protocol": {"minReaderVersion": 1,
                                          "minWriterVersion": 2}})
-            actions.append(self._meta_action(table.schema))
+            actions.append(self._meta_action(table.schema, parts))
         if mode == "overwrite":
             for p in self.snapshot_files():
                 actions.append({"remove": {
@@ -180,8 +367,14 @@ class DeltaTable:
                     "deletionTimestamp": int(time.time() * 1000),
                     "dataChange": True}})
         if table.num_rows:
-            _name, add = self._write_file(table)
-            actions.append(add)
+            if parts:
+                for pv, sub in _split_partitions(table, parts):
+                    _name, add = self._write_file(
+                        sub.drop_columns(list(parts)), pv)
+                    actions.append(add)
+            else:
+                _name, add = self._write_file(table)
+                actions.append(add)
         self._commit(version, actions)
         return version
 
@@ -226,15 +419,45 @@ class DeltaTable:
     # ------------------------------------------------------------------
     # reads
     # ------------------------------------------------------------------
+    def snapshot_adds(self, version: Optional[int] = None) -> List[dict]:
+        active: Dict[str, dict] = {}
+        for a in self._read_actions(version):
+            if "add" in a:
+                active[a["add"]["path"]] = a["add"]
+            elif "remove" in a:
+                active.pop(a["remove"]["path"], None)
+        return [active[p] for p in sorted(active)]
+
     def to_logical(self, version: Optional[int] = None):
-        """LogicalParquetScan over the snapshot (device-decoded)."""
+        """LogicalParquetScan over the snapshot (device-decoded).
+        Partitioned tables materialize partition columns from each add
+        action's partitionValues (the files don't store them)."""
         from ..io.parquet import LogicalParquetScan
-        files = self.snapshot_files(version)
-        if not files:
-            from ..plan import logical as L
-            sch = self.schema(version) or pa.schema([])
+        from ..plan import logical as L
+        parts = self.partition_columns(version)
+        sch = self.schema(version) or pa.schema([])
+        adds = self.snapshot_adds(version)
+        if not adds:
             return L.LogicalScan(pa.Table.from_batches([], sch))
-        return LogicalParquetScan(files)
+        if not parts:
+            return LogicalParquetScan(
+                [os.path.join(self.path, a["path"]) for a in adds])
+        import pyarrow.compute as pc
+        pieces = []
+        for a in adds:
+            tbl = pq.read_table(os.path.join(self.path, a["path"]))
+            pv = a.get("partitionValues") or {}
+            n = tbl.num_rows
+            for c in parts:
+                want = sch.field(c).type
+                raw = pv.get(c)
+                if raw is None or raw == "__HIVE_DEFAULT_PARTITION__":
+                    col = pa.nulls(n, want)
+                else:
+                    col = pa.array([raw] * n, pa.string()).cast(want)
+                tbl = tbl.append_column(pa.field(c, want), col)
+            pieces.append(tbl.select(sch.names))
+        return L.LogicalScan(pa.concat_tables(pieces))
 
     def read(self, version: Optional[int] = None) -> pa.Table:
         from ..plan.overrides import apply_overrides
@@ -257,7 +480,18 @@ class DeltaTable:
         out = apply_overrides(plan).collect()
         return out.column("c").to_pylist()[0] > 0
 
+    def _no_partition_dml(self, op: str):
+        if self.partition_columns():
+            raise NotImplementedError(
+                f"{op} on partitioned Delta tables is not yet supported "
+                "(per-file rewrites need partition-value columns "
+                "attached)")
+
     def delete(self, condition) -> int:
+        self._no_partition_dml("DELETE")
+        return self._delete_impl(condition)
+
+    def _delete_impl(self, condition) -> int:
         """DELETE WHERE condition: rewrite only the touched files."""
         from ..io.parquet import LogicalParquetScan
         from ..plan import expressions as E
@@ -286,6 +520,7 @@ class DeltaTable:
         return version
 
     def update(self, condition, assignments: Dict[str, object]) -> int:
+        self._no_partition_dml("UPDATE")
         """UPDATE SET col=expr WHERE condition (touched files only)."""
         from ..io.parquet import LogicalParquetScan
         from ..plan import expressions as E
@@ -320,7 +555,7 @@ class DeltaTable:
         self._commit(version, actions)
         return version
 
-    def merge(self, source: pa.Table, on: Tuple[str, str],
+    def merge(self, source: pa.Table, on: Tuple[str, str],  # noqa: C901
               when_matched_update: Optional[Dict[str, object]] = None,
               when_matched_delete: bool = False,
               when_not_matched_insert: bool = True) -> int:
@@ -332,6 +567,7 @@ class DeltaTable:
              updated (or dropped for delete);
           3. not-matched source rows appended as a new file.
         """
+        self._no_partition_dml("MERGE")
         from ..io.parquet import LogicalParquetScan
         from ..plan import expressions as E
         from ..plan import logical as L

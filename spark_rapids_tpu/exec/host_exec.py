@@ -1059,7 +1059,11 @@ class CpuGenerateExec(HostNode):
 
     def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
         from ..columnar.host import dtype_to_arrow
+        from ..plan.json_fns import JsonTupleGen
         gen = self.generator
+        if isinstance(gen, JsonTupleGen):
+            yield from self._execute_json_tuple(ctx)
+            return
         for rb in self.child.execute(ctx):
             arrays = CpuAggregateExec._arr(gen.child.eval_cpu(rb),
                                            rb.num_rows).to_pylist()
@@ -1088,6 +1092,36 @@ class CpuGenerateExec(HostNode):
             et = dtype_to_arrow(gen.child.dtype.element_type)
             cols.append(pa.array(vals, et))
             names.append(self.output_names[fi])
+            yield pa.RecordBatch.from_arrays(cols, names=names)
+
+    def _execute_json_tuple(self, ctx) -> Iterator[pa.RecordBatch]:
+        """json_tuple generator: one output row per input row, k string
+        field columns (GpuJsonTuple role)."""
+        import json as _json
+        gen = self.generator
+        for rb in self.child.execute(ctx):
+            vals = CpuAggregateExec._arr(gen.child.eval_cpu(rb),
+                                         rb.num_rows).cast(
+                pa.string()).to_pylist()
+            outs = [[] for _ in gen.fields]
+            from ..plan.json_fns import _render
+            for v in vals:
+                obj = None
+                if v is not None:
+                    try:
+                        obj = _json.loads(v)
+                    except (ValueError, TypeError):
+                        obj = None
+                for j, f in enumerate(gen.fields):
+                    if isinstance(obj, dict) and f in obj:
+                        outs[j].append(_render(obj[f]))
+                    else:
+                        outs[j].append(None)
+            cols = list(rb.columns)
+            names = list(rb.schema.names)
+            for j, name in enumerate(self.output_names):
+                cols.append(pa.array(outs[j], pa.string()))
+                names.append(name)
             yield pa.RecordBatch.from_arrays(cols, names=names)
 
     def describe(self):

@@ -1,0 +1,214 @@
+"""Pandas/Python exec family (reference org/.../execution/python/, 3073
+LoC: GpuArrowEvalPythonExec.scala:352, GpuMapInPandasExec et al.).
+
+The reference moves device batches GPU -> Arrow host stream -> a
+separate Python worker process (GpuArrowWriter/Reader), throttled by
+PythonWorkerSemaphore, then back.  This engine's host side is already
+Python+Arrow, so the worker boundary is a forked OS process fed Arrow
+IPC over a pipe — real process isolation (a crashing/leaking UDF cannot
+take the engine down), the same wire format (Arrow IPC), and a
+concurrency semaphore.  Fork start means user functions need not be
+picklable (closures/lambdas ride the copied address space), matching
+pyspark ergonomics.
+
+Execs:
+  * MapInPandasExec  — df.map_in_pandas(fn, schema): fn receives an
+    iterator of pandas.DataFrames, yields DataFrames (the
+    GpuMapInPandasExec contract).
+  * ArrowEvalPythonExec — scalar pandas UDF projection: each UDF maps
+    pandas.Series -> pandas.Series, appended to the child's columns
+    (the GpuArrowEvalPythonExec contract).
+
+Both are host-side operators (transitions move device batches to Arrow
+exactly as the reference's GPU->JVM->worker hops do); the overrides
+engine places them with per-operator fallback reasons like any other
+exec.
+"""
+from __future__ import annotations
+
+import io
+import multiprocessing as mp
+import os
+import struct
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from .. import types as t
+from ..columnar.host import struct_to_schema
+from .host_exec import HostNode
+from .plan import ExecContext
+
+_SEM_LOCK = threading.Lock()
+_WORKER_SEM: Optional[threading.Semaphore] = None
+
+
+def _worker_permit(conf):
+    """PythonWorkerSemaphore role: bound concurrent UDF workers."""
+    global _WORKER_SEM
+    from ..config import PYTHON_WORKER_CONCURRENCY
+    with _SEM_LOCK:
+        if _WORKER_SEM is None:
+            _WORKER_SEM = threading.Semaphore(
+                int(conf.get(PYTHON_WORKER_CONCURRENCY)))
+    return _WORKER_SEM
+
+
+def _send_ipc(conn, tbl: Optional[pa.RecordBatch], schema: pa.Schema):
+    if tbl is None:
+        conn.send_bytes(b"")
+        return
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, schema) as w:
+        w.write_batch(tbl)
+    conn.send_bytes(sink.getvalue())
+
+
+def _recv_ipc(conn) -> Optional[pa.Table]:
+    data = conn.recv_bytes()
+    if not data:
+        return None
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        return r.read_all()
+
+
+def _map_worker(conn, fn, out_schema_bytes):
+    """Child process: Arrow IPC in -> fn over pandas -> Arrow IPC out."""
+    try:
+        out_schema = pa.ipc.read_schema(pa.py_buffer(out_schema_bytes))
+
+        def batches():
+            while True:
+                tbl = _recv_ipc(conn)
+                if tbl is None:
+                    return
+                yield tbl.to_pandas()
+
+        for out_df in fn(batches()):
+            out = pa.RecordBatch.from_pandas(out_df,
+                                             schema=out_schema,
+                                             preserve_index=False)
+            _send_ipc(conn, out, out_schema)
+        conn.send_bytes(b"")                   # end of stream
+        err = None
+    except BaseException as e:                 # noqa: BLE001
+        try:
+            conn.send_bytes(b"ERR:" + repr(e).encode())
+        except Exception:                      # noqa: BLE001
+            pass
+        return
+    finally:
+        conn.close()
+
+
+class PythonWorkerError(RuntimeError):
+    pass
+
+
+class MapInPandasExec(HostNode):
+    """df.mapInPandas over a forked Arrow-IPC worker process."""
+
+    def __init__(self, fn: Callable, schema: t.StructType, child: HostNode):
+        super().__init__(child)
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        out_schema = struct_to_schema(self._schema)
+        schema_bytes = out_schema.serialize().to_pybytes()
+        mp_ctx = mp.get_context("fork")
+        parent, child_conn = mp_ctx.Pipe()
+        sem = _worker_permit(ctx.conf)
+        with sem:
+            proc = mp_ctx.Process(target=_map_worker,
+                                  args=(child_conn, self.fn,
+                                        schema_bytes), daemon=True)
+            proc.start()
+            child_conn.close()
+            ctx.bump("python_workers_started")
+
+            feeder_done = threading.Event()
+
+            def feed():
+                try:
+                    for rb in self.child.execute(ctx):
+                        if rb.num_rows == 0:
+                            continue
+                        _send_ipc(parent, rb, rb.schema)
+                    _send_ipc(parent, None, out_schema)
+                except (BrokenPipeError, OSError):
+                    pass
+                finally:
+                    feeder_done.set()
+
+            feeder = threading.Thread(target=feed, daemon=True)
+            feeder.start()
+            try:
+                while True:
+                    data = parent.recv_bytes()
+                    if data.startswith(b"ERR:"):
+                        raise PythonWorkerError(
+                            data[4:].decode(errors="replace"))
+                    if not data:
+                        break
+                    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+                        for rb in r.read_all().to_batches():
+                            yield rb
+            except EOFError:
+                raise PythonWorkerError(
+                    f"python worker died (exit={proc.exitcode})")
+            finally:
+                feeder_done.wait(timeout=5)
+                parent.close()
+                proc.join(timeout=10)
+                if proc.is_alive():
+                    proc.terminate()
+
+    def describe(self):
+        return f"MapInPandasExec[{getattr(self.fn, '__name__', 'fn')}]"
+
+
+class ArrowEvalPythonExec(HostNode):
+    """Scalar pandas UDFs appended as projection outputs.
+
+    udfs: [(fn, input column names, output name, output type)] — each fn
+    maps pandas.Series... -> pandas.Series of the output type (the
+    GpuArrowEvalPythonExec scalar-UDF contract)."""
+
+    def __init__(self, udfs: Sequence[Tuple[Callable, Sequence[str], str,
+                                            t.DataType]],
+                 child: HostNode):
+        super().__init__(child)
+        self.udfs = list(udfs)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        fields = list(self.child.output_schema.fields)
+        for _fn, _cols, name, dt in self.udfs:
+            fields.append(t.StructField(name, dt, True))
+        return t.StructType(fields)
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        child_names = list(self.child.output_schema.names)
+        out_schema = struct_to_schema(self.output_schema)
+
+        def apply(batches):
+            import pandas as pd
+            for df in batches:
+                cols = {n: df[n] for n in df.columns}
+                for fn, in_cols, name, _dt in self.udfs:
+                    cols[name] = pd.Series(
+                        fn(*[df[c] for c in in_cols]))
+                yield pd.DataFrame(cols)
+
+        inner = MapInPandasExec(apply, self.output_schema, self.child)
+        yield from inner.execute(ctx)
+
+    def describe(self):
+        names = [n for _f, _c, n, _t in self.udfs]
+        return f"ArrowEvalPythonExec[{', '.join(names)}]"

@@ -265,12 +265,46 @@ class CpuParquetScanExec(HostNode):
 
 def write_parquet(df, path: str, partition_by: Optional[Sequence[str]] = None,
                   compression: str = "zstd",
-                  row_group_rows: int = 1 << 20) -> None:
+                  row_group_rows: int = 1 << 20,
+                  bucket_by: Optional[Tuple[Sequence[str], int]] = None
+                  ) -> None:
     """Stream query results into parquet without materializing the whole
     result (the reference streams device-encoded chunks through
-    HostBufferConsumer; here host batches stream into ParquetWriter)."""
+    HostBufferConsumer; here host batches stream into ParquetWriter).
+
+    `bucket_by=(cols, n)` writes Spark-compatible bucketed output: rows
+    route to n files by the bit-exact Spark Murmur3 hash of the bucket
+    columns (pmod n), file names carrying the bucket id the way Spark's
+    FileFormatWriter does (reference GpuFileFormatDataWriter bucketing
+    with device Murmur3)."""
     q = df.physical()
     schema = struct_to_schema(df.schema)
+    if bucket_by:
+        import pathlib
+        from ..plan import expressions as E
+        cols, n_buckets = bucket_by
+        tbl = q.collect()
+        bound = E.Murmur3Hash(
+            *[E.ColumnRef(c) for c in cols]).bind(
+            schema_to_struct(tbl.schema))
+        rb = tbl.combine_chunks().to_batches()[0] if tbl.num_rows else None
+        root = pathlib.Path(path)
+        root.mkdir(parents=True, exist_ok=True)
+        if rb is None:
+            return
+        import numpy as np
+        import pyarrow.compute as pc
+        h = bound.eval_cpu(rb)
+        hv = np.asarray(h.to_numpy(zero_copy_only=False), np.int64)
+        b = ((hv % n_buckets) + n_buckets) % n_buckets   # Spark pmod
+        for bid in range(n_buckets):
+            sub = tbl.filter(pa.array(b == bid))
+            if sub.num_rows == 0:
+                continue
+            pq.write_table(sub, str(
+                root / f"part-00000-{bid:05d}.c000.parquet"),
+                compression=compression)
+        return
     if partition_by:
         import pyarrow.dataset as ds
         tbl = q.collect()

@@ -11,6 +11,7 @@ import json
 from typing import List, Optional, Tuple, Union
 
 from .. import types as t
+from .expressions import Expression
 from .strings import DictTransform
 
 
@@ -114,3 +115,159 @@ class GetJsonObject(DictTransform):
                     return None
                 obj = obj[step]
         return _render(obj)
+
+
+# ---------------------------------------------------------------------------
+# json_tuple / from_json / to_json (reference GpuJsonTuple,
+# GpuJsonToStructs, GpuStructsToJson — JNI JSONUtils/MapUtils role)
+# ---------------------------------------------------------------------------
+
+def json_tuple(child, *fields: str):
+    """json_tuple(json, f1, ..., fk) as k device-capable projections —
+    each field is a top-level GetJsonObject('$.f') dictionary transform,
+    so the whole tuple runs on the device path (the reference's
+    GpuJsonTuple evaluates all fields in one JNI pass; here each distinct
+    json string parses once per field on host, device work is code
+    gathers)."""
+    return [GetJsonObject(child, f"$.{f}") for f in fields]
+
+
+class JsonTupleGen:
+    """Generator spec (LogicalGenerate) for json_tuple in LATERAL VIEW
+    position: one output row per input row with k string columns."""
+
+    def __init__(self, child, fields: List[str]):
+        self.child = child
+        self.fields = list(fields)
+        self.pos = False
+        self.outer = False
+
+    def bind(self, schema):
+        import copy
+        b = copy.copy(self)
+        b.child = self.child.bind(schema)
+        if not isinstance(b.child.dtype, (t.StringType, t.NullType)):
+            raise TypeError("json_tuple requires a string input")
+        return b
+
+    def output_fields(self):
+        return [t.StructField(f"c{i}", t.STRING, True)
+                for i in range(len(self.fields))]
+
+    def __repr__(self):
+        return f"json_tuple({self.child!r}, {', '.join(self.fields)})"
+
+
+class FromJson(Expression):
+    """from_json(json, schema) -> STRUCT (Spark JsonToStructs,
+    PERMISSIVE mode: malformed rows yield a struct of nulls, null input
+    yields null).  Struct values have no device lane — CPU path by
+    per-expression tagging, the same contract the reference applies via
+    its TypeSig (GpuJsonToStructs allows-nested gating)."""
+
+    def __init__(self, child, schema: t.StructType):
+        self.children = (child,)
+        self.schema = schema
+
+    def _resolve(self):
+        self.dtype = self.schema
+        self.nullable = True
+
+    def _fp_extra(self):
+        return self.schema.simple_string
+
+    def unsupported_reasons(self, conf):
+        return ["STRUCT results have no device lane (CPU path)"]
+
+    def _coerce(self, v, dt):
+        import datetime as _dt
+        if v is None:
+            return None
+        try:
+            if isinstance(dt, t.StringType):
+                return v if isinstance(v, str) else json.dumps(v)
+            if isinstance(dt, t.BooleanType):
+                return v if isinstance(v, bool) else None
+            if t.is_integral(dt):
+                # JSON float tokens don't coerce to integral (Spark's
+                # Jackson parser rejects them)
+                if isinstance(v, bool) or not isinstance(v, int):
+                    return None
+                return int(v)
+            if t.is_floating(dt):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    return None
+                return float(v)
+            if isinstance(dt, t.ArrayType):
+                if not isinstance(v, list):
+                    return None
+                return [self._coerce(x, dt.element_type) for x in v]
+            if isinstance(dt, t.StructType):
+                if not isinstance(v, dict):
+                    return None
+                return {f.name: self._coerce(v.get(f.name), f.data_type)
+                        for f in dt.fields}
+        except (ValueError, TypeError):
+            return None
+        return None
+
+    def _eval_cpu(self, rb, kids):
+        import pyarrow as pa
+        from ..columnar.host import dtype_to_arrow
+        out = []
+        for v in kids[0].cast(pa.string()).to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            try:
+                obj = json.loads(v)
+            except (ValueError, TypeError):
+                obj = None
+            if not isinstance(obj, dict):
+                # PERMISSIVE: corrupt record -> struct of nulls
+                out.append({f.name: None for f in self.schema.fields})
+                continue
+            out.append({f.name: self._coerce(obj.get(f.name), f.data_type)
+                        for f in self.schema.fields})
+        return pa.array(out, dtype_to_arrow(self.schema))
+
+
+class ToJson(Expression):
+    """to_json(struct) -> json string (Spark StructsToJson): null struct
+    -> null; null fields are OMITTED (Spark default ignoreNullFields)."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.STRING
+        self.nullable = True
+
+    def unsupported_reasons(self, conf):
+        return ["STRUCT inputs have no device lane (CPU path)"]
+
+    @staticmethod
+    def _jsonable(v):
+        import datetime as _dt
+        import decimal as _dec
+        if isinstance(v, dict):
+            return {k: ToJson._jsonable(x) for k, x in v.items()
+                    if x is not None}
+        if isinstance(v, list):
+            return [ToJson._jsonable(x) for x in v]
+        if isinstance(v, _dec.Decimal):
+            return float(v)
+        if isinstance(v, (_dt.date, _dt.datetime)):
+            return v.isoformat()
+        return v
+
+    def _eval_cpu(self, rb, kids):
+        import pyarrow as pa
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+            else:
+                out.append(json.dumps(self._jsonable(v),
+                                      separators=(",", ":")))
+        return pa.array(out, pa.string())

@@ -301,6 +301,40 @@ class LogicalWindow(LogicalPlan):
         return f"Window[{[n for _, n in self.window_exprs]}]"
 
 
+class LogicalMapInPandas(LogicalPlan):
+    """mapInPandas: iterator-of-pandas-DataFrames transform through a
+    forked Arrow-IPC python worker (reference GpuMapInPandasExec)."""
+
+    def __init__(self, fn, schema, child: LogicalPlan):
+        super().__init__(child)
+        self.fn = fn
+        self.result_schema = schema
+
+    def _resolve_schema(self):
+        return self.result_schema
+
+    def describe(self):
+        return f"MapInPandas[{getattr(self.fn, '__name__', 'fn')}]"
+
+
+class LogicalArrowEvalPython(LogicalPlan):
+    """Scalar pandas-UDF projection outputs appended to the child
+    (reference GpuArrowEvalPythonExec)."""
+
+    def __init__(self, udfs, child: LogicalPlan):
+        super().__init__(child)
+        self.udfs = list(udfs)     # (fn, in_cols, name, dtype)
+
+    def _resolve_schema(self):
+        fields = list(self.child.schema.fields)
+        for _fn, _cols, name, dt in self.udfs:
+            fields.append(t.StructField(name, dt, True))
+        return t.StructType(fields)
+
+    def describe(self):
+        return f"ArrowEvalPython[{[n for _f, _c, n, _t in self.udfs]}]"
+
+
 class LogicalGenerate(LogicalPlan):
     """Generator (explode/posexplode) appending generated columns to the
     child's rows — reference GpuGenerateExec (GpuGenerateExec.scala:829).

@@ -149,6 +149,13 @@ expr_rule(E.RaiseError, t.T.ALL_SIMPLE + t.T.NULL,
           desc="raise_error (CPU path: device programs cannot throw)")
 expr_rule(E.Cast, t.T.ALL_SIMPLE, desc="cast (pairs gated by Cast itself)")
 
+from .json_fns import FromJson, ToJson  # noqa: E402
+
+expr_rule(FromJson, t.T.ALL, desc="from_json (STRUCT result: CPU path, "
+          "per-expression tagging — GpuJsonToStructs role)")
+expr_rule(ToJson, t.T.ALL, desc="to_json (STRUCT input: CPU path — "
+          "GpuStructsToJson role)")
+
 from . import datetime as DT  # noqa: E402  (registry population)
 from . import strings as STR  # noqa: E402  (registry population)
 
@@ -242,6 +249,10 @@ exec_rule(L.LogicalProject, (_COMMON + t.T.ARRAY).with_nested(_RAGGED_ELEM),
           "projection")
 exec_rule(L.LogicalGenerate, _DEVICE_RAGGED,
           "explode/posexplode over ragged values+offsets lanes")
+exec_rule(L.LogicalMapInPandas, t.T.ALL,
+          "mapInPandas via forked Arrow-IPC python workers")
+exec_rule(L.LogicalArrowEvalPython, t.T.ALL,
+          "scalar pandas UDFs via forked Arrow-IPC python workers")
 exec_rule(L.LogicalFilter, _DEVICE_SIMPLE, "filter")
 exec_rule(L.LogicalAggregate, _COMMON, "hash aggregate")
 exec_rule(L.LogicalSort, t.T.ORDERABLE, "sort")
@@ -826,6 +837,33 @@ class CacheMeta(PlanMeta):
         return CachedHostScan(self.node, self.conf)
 
 
+class MapInPandasMeta(PlanMeta):
+    """Pandas execs run on the host side of the plan by placement (the
+    worker boundary is host Arrow, as in the reference's GPU->JVM->python
+    hops); transitions bridge device children."""
+
+    def tag_self(self):
+        self.will_not_work(
+            "pandas UDFs execute in a python worker process "
+            "(host Arrow boundary; GpuMapInPandasExec role)")
+
+    def to_host(self):
+        from ..exec.python_exec import MapInPandasExec
+        return MapInPandasExec(self.node.fn, self.node.result_schema,
+                               self._host_child())
+
+
+class ArrowEvalPythonMeta(PlanMeta):
+    def tag_self(self):
+        self.will_not_work(
+            "pandas UDFs execute in a python worker process "
+            "(host Arrow boundary; GpuArrowEvalPythonExec role)")
+
+    def to_host(self):
+        from ..exec.python_exec import ArrowEvalPythonExec
+        return ArrowEvalPythonExec(self.node.udfs, self._host_child())
+
+
 class GenerateMeta(PlanMeta):
     """LogicalGenerate: explode/posexplode runs ON DEVICE over ragged
     values+offsets lanes (exec/generate.py — GpuGenerateExec.scala:829
@@ -912,6 +950,8 @@ _META_FOR: Dict[type, Type[PlanMeta]] = {
     L.LogicalExpand: ExpandMeta,
     L.LogicalWindow: WindowMeta,
     L.LogicalGenerate: GenerateMeta,
+    L.LogicalMapInPandas: MapInPandasMeta,
+    L.LogicalArrowEvalPython: ArrowEvalPythonMeta,
     LogicalCache: CacheMeta,
     LogicalParquetScan: ParquetScanMeta,
     LogicalCsvScan: TextScanMeta,

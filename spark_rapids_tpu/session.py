@@ -149,6 +149,24 @@ class DataFrame:
                                           list(partition_by),
                                           list(order_by), self._plan))
 
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """mapInPandas: fn(iterator of pandas.DataFrame) -> iterator of
+        pandas.DataFrame, executed in a forked Arrow-IPC python worker
+        (reference GpuMapInPandasExec).  `schema` is the result
+        StructType (or pyarrow schema)."""
+        from .columnar.host import schema_to_struct
+        import pyarrow as _pa
+        if isinstance(schema, _pa.Schema):
+            schema = schema_to_struct(schema)
+        return self._wrap(L.LogicalMapInPandas(fn, schema, self._plan))
+
+    def with_pandas_udf(self, name: str, fn, input_cols, return_type
+                        ) -> "DataFrame":
+        """Append a scalar pandas UDF column: fn(pandas.Series...) ->
+        pandas.Series (reference GpuArrowEvalPythonExec)."""
+        return self._wrap(L.LogicalArrowEvalPython(
+            [(fn, list(input_cols), name, return_type)], self._plan))
+
     def cache(self) -> "DataFrame":
         """Materialize once as compressed parquet bytes; downstream plans
         re-decode from the cache (ParquetCachedBatchSerializer role)."""

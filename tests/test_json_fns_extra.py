@@ -1,0 +1,89 @@
+"""from_json / to_json / json_tuple (VERDICT r2 #8 — GpuJsonToStructs /
+GpuStructsToJson / GpuJsonTuple roles)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.json_fns import (FromJson, JsonTupleGen, ToJson,
+                                            json_tuple)
+from spark_rapids_tpu.plan.overrides import apply_overrides
+from spark_rapids_tpu.session import DataFrame, TpuSession, col
+
+JS = pa.table({"j": pa.array([
+    '{"a": 1, "b": "x", "c": [1,2]}',
+    '{"a": 2.5, "b": null}',
+    'not json',
+    None,
+    '{"b": "y", "extra": 9}',
+])})
+
+
+class TestFromJson:
+    SCHEMA = t.StructType([
+        t.StructField("a", t.LONG), t.StructField("b", t.STRING)])
+
+    def test_from_json_permissive(self):
+        s = TpuSession()
+        df = s.from_arrow(JS).select(FromJson(col("j"), self.SCHEMA),
+                                     names=["s"])
+        out = df.collect()
+        assert out.column("s").to_pylist() == [
+            {"a": 1, "b": "x"},
+            {"a": None, "b": None},     # 2.5 is not integral -> null field
+            {"a": None, "b": None},     # corrupt -> struct of nulls
+            None,                        # null input -> null
+            {"a": None, "b": "y"},
+        ]
+
+    def test_from_json_tagged_cpu_with_reason(self):
+        s = TpuSession()
+        df = s.from_arrow(JS).select(FromJson(col("j"), self.SCHEMA),
+                                     names=["s"])
+        q = df.physical()
+        assert q.kind == "host"
+        assert "no device lane" in q.explain()
+
+    def test_from_json_nested_array(self):
+        sch = t.StructType([t.StructField(
+            "c", t.ArrayType(t.LONG))])
+        s = TpuSession()
+        out = s.from_arrow(JS).select(FromJson(col("j"), sch),
+                                      names=["s"]).collect()
+        assert out.column("s").to_pylist()[0] == {"c": [1, 2]}
+
+
+class TestToJson:
+    def test_round_trip(self):
+        s = TpuSession()
+        sch = t.StructType([t.StructField("a", t.LONG),
+                            t.StructField("b", t.STRING)])
+        df = s.from_arrow(JS).select(
+            ToJson(FromJson(col("j"), sch)), names=["out"])
+        out = df.collect()
+        assert out.column("out").to_pylist() == [
+            '{"a":1,"b":"x"}', "{}", "{}", None, '{"b":"y"}']
+
+
+class TestJsonTuple:
+    def test_projection_form_runs_on_device(self):
+        s = TpuSession()
+        exprs = json_tuple(col("j"), "a", "b")
+        df = s.from_arrow(JS).select(*exprs, names=["a", "b"])
+        q = df.physical()
+        assert q.kind == "device", q.explain()
+        out = q.collect()
+        assert out.column("a").to_pylist() == ["1", "2.5", None, None,
+                                               None]
+        assert out.column("b").to_pylist() == ["x", None, None, None, "y"]
+
+    def test_generator_form(self):
+        plan = L.LogicalGenerate(
+            JsonTupleGen(E.ColumnRef("j"), ["a", "b"]),
+            L.LogicalScan(JS), ["a", "b"])
+        out = apply_overrides(plan).collect()
+        assert out.column("a").to_pylist() == ["1", "2.5", None, None,
+                                               None]
+        assert out.column("b").to_pylist() == ["x", None, None, None, "y"]
+        assert out.column("j").to_pylist() == JS.column("j").to_pylist()

@@ -1,0 +1,96 @@
+"""Pandas/Python exec family (VERDICT r2 #9 — GpuArrowEvalPythonExec /
+GpuMapInPandasExec roles): forked Arrow-IPC worker processes with a
+concurrency semaphore."""
+import os
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.session import TpuSession, col
+
+
+TBL = pa.table({"x": pa.array(range(20), pa.int64()),
+                "g": pa.array(["a", "b"] * 10)})
+
+
+class TestMapInPandas:
+    def test_basic_transform(self):
+        s = TpuSession()
+        schema = t.StructType([t.StructField("y", t.LONG)])
+
+        def double(batches):
+            for df in batches:
+                yield df.assign(y=df.x * 2)[["y"]]
+
+        out = s.from_arrow(TBL).map_in_pandas(double, schema).collect()
+        assert out.column("y").to_pylist() == [i * 2 for i in range(20)]
+
+    def test_runs_in_separate_process(self):
+        s = TpuSession()
+        schema = t.StructType([t.StructField("pid", t.LONG)])
+        me = os.getpid()
+
+        def pids(batches):
+            import pandas as pd
+            for df in batches:
+                yield pd.DataFrame({"pid": [os.getpid()] * len(df)})
+
+        out = s.from_arrow(TBL).map_in_pandas(pids, schema).collect()
+        assert set(out.column("pid").to_pylist()) != {me}
+
+    def test_worker_error_propagates(self):
+        from spark_rapids_tpu.exec.python_exec import PythonWorkerError
+        s = TpuSession()
+        schema = t.StructType([t.StructField("y", t.LONG)])
+
+        def boom(batches):
+            for df in batches:
+                raise ValueError("kaboom")
+                yield df
+
+        with pytest.raises(PythonWorkerError, match="kaboom"):
+            s.from_arrow(TBL).map_in_pandas(boom, schema).collect()
+
+    def test_closure_capture_no_pickling_needed(self):
+        s = TpuSession()
+        schema = t.StructType([t.StructField("y", t.LONG)])
+        offset = 100
+        out = s.from_arrow(TBL).map_in_pandas(
+            lambda it: (df.assign(y=df.x + offset)[["y"]] for df in it),
+            schema).collect()
+        assert out.column("y").to_pylist() == [i + 100 for i in range(20)]
+
+    def test_after_device_ops_with_transitions(self):
+        """Device filter -> pandas map -> device agg round trip."""
+        from spark_rapids_tpu.plan import expressions as E
+        from spark_rapids_tpu.plan.aggregates import Sum
+        s = TpuSession()
+        schema = t.StructType([t.StructField("y", t.LONG)])
+        df = (s.from_arrow(TBL)
+              .filter(E.GreaterThanOrEqual(col("x"), E.Literal(10)))
+              .map_in_pandas(
+                  lambda it: (d.assign(y=d.x * 10)[["y"]] for d in it),
+                  schema)
+              .agg((Sum(col("y")), "s")))
+        q = df.physical()
+        assert "MapInPandasExec" in q.physical_tree()
+        out = q.collect()
+        assert out.column("s").to_pylist() == [sum(i * 10
+                                                   for i in range(10, 20))]
+
+
+class TestArrowEvalPython:
+    def test_scalar_pandas_udf(self):
+        s = TpuSession()
+        df = s.from_arrow(TBL).with_pandas_udf(
+            "sq", lambda x: x * x, ["x"], t.LONG)
+        out = df.collect()
+        assert out.column("sq").to_pylist() == [i * i for i in range(20)]
+        assert out.column("x").to_pylist() == list(range(20))
+
+    def test_explain_reason(self):
+        s = TpuSession()
+        df = s.from_arrow(TBL).with_pandas_udf(
+            "sq", lambda x: x * x, ["x"], t.LONG)
+        assert "python worker process" in df.physical().explain()
