@@ -1,8 +1,10 @@
 #!/usr/bin/env python
 """Headline benchmark: the full 22-query TPC-H suite at SF>=1.
 
-Prints ONE json line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+Prints a running JSON summary line after EVERY query (flushed), so a
+timeout kill at any point still leaves a complete, parseable result as
+the last stdout line — a perf harness that can fail to report is itself
+a defect (VERDICT r3).  The final line covers every query measured.
 
 Headline metric: geometric-mean speedup of per-query WARM wall time
 (device engine / whole-plan XLA compilation) over the SAME queries on
@@ -13,7 +15,7 @@ BASELINE.md north star).
 
 Methodology.
   * Every query runs BOTH engines from the same in-memory tables and
-    results are cross-checked (float tails to 1e-9 relative — reduction
+    results are cross-checked (float tails to 1e-6 relative — reduction
     order differs, as the reference documents for GPU float aggs).
   * Device timing is single-shot warm wall time: one whole-plan XLA
     dispatch + one result fetch, measured after the one-time costs
@@ -22,11 +24,16 @@ Methodology.
     It INCLUDES the test harness tunnel's ~60ms round-trip per query;
     the RTT is also reported separately so the engine-time floor is
     visible.  CPU timing is the same warm single-shot discipline.
-  * Cold numbers (first-run compile, upload) are reported on stderr.
+  * Cold numbers (first-run compile or cache load, upload) are reported
+    per query and as a median; a persistent-cache hit shows up as a
+    small cold time.
+  * Time budgets: BENCH_BUDGET_S (default 480) total; queries that
+    don't fit are listed in "skipped" rather than silently absent.
 
 Run: python bench.py [scale] [--queries q1,q6,...]
 """
 import json
+import os
 import sys
 import time
 
@@ -40,6 +47,13 @@ jax.config.update("jax_compilation_cache_dir",
                   __file__.rsplit("/", 1)[0] + "/.jax_cache")
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "480"))
+_T0 = time.perf_counter()
+
+
+def left() -> float:
+    return TOTAL_BUDGET_S - (time.perf_counter() - _T0)
 
 
 def measure_rtt() -> float:
@@ -84,10 +98,60 @@ def time_warm(fn, iters=3):
     return min(times)
 
 
+class Suite:
+    def __init__(self, scale: float, rtt: float):
+        self.scale = scale
+        self.rtt = rtt
+        self.per_q = {}
+        self.skipped = []
+        self.compiled_ct = 0
+
+    def emit(self, final: bool = False):
+        speedups = [v["speedup"] for v in self.per_q.values()
+                    if v["speedup"] is not None]
+        geomean = float(np.exp(np.mean(np.log(speedups)))) \
+            if speedups else 0.0
+        errors = sum(1 for v in self.per_q.values() if "error" in v)
+        colds = sorted(v["cold_s"] for v in self.per_q.values()
+                       if "error" not in v)
+        med_cold = colds[len(colds) // 2] if colds else None
+        scale = self.scale
+        out = {
+            "metric": f"tpch_sf{scale:g}_suite_geomean_speedup_vs_cpu",
+            "value": round(geomean, 3),
+            "unit": "x",
+            "vs_baseline": round(geomean, 3),
+            "tpch_suite_scale": scale,
+            "tpch_suite_queries": self.per_q,
+            "tpch_suite_geomean_speedup": round(geomean, 3),
+            "queries_measured": len(self.per_q),
+            "errors": errors,
+            "skipped": self.skipped,
+            "final": final,
+            "whole_plan_compiled": self.compiled_ct,
+            "median_cold_s": med_cold,
+            "tunnel_rtt_ms": round(self.rtt * 1e3, 1),
+            "elapsed_s": round(time.perf_counter() - _T0, 1),
+            "note": "warm single-shot wall per query (one whole-plan XLA "
+                    "dispatch + one fetch, device-resident tables, compile "
+                    "cached); INCLUDES one tunnel RTT per query — "
+                    "tunnel_rtt_ms is the harness floor. CPU baseline = "
+                    "same queries on the engine's vectorized pyarrow "
+                    "fallback, warm (arrow decimal128 kernels, no python "
+                    "row loops). Incremental line: last stdout line is "
+                    "always the complete current result.",
+        }
+        print(json.dumps(out), flush=True)
+
+
 def run_suite(scale: float, query_names):
     from spark_rapids_tpu import tpch
     from spark_rapids_tpu.exec.plan import ExecContext
     from spark_rapids_tpu.session import DataFrame, TpuSession
+
+    rtt = measure_rtt()
+    print(f"# backend={jax.default_backend()} tunnel RTT ~{rtt*1e3:.0f}ms "
+          f"per host sync", file=sys.stderr)
 
     t0 = time.perf_counter()
     tables = tpch.gen_tables(scale=scale)
@@ -98,39 +162,54 @@ def run_suite(scale: float, query_names):
     dev = TpuSession()          # wholePlan AUTO -> on for the TPU backend
     cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
 
-    per_q = {}
-    compiled_ct = 0
+    suite = Suite(scale, rtt)
     for name in query_names:
-        dfq = tpch.QUERIES[name](dev, tables)
-        q = dfq.physical()
-        # cold: compile (or cache load) + device upload + first run
-        t0 = time.perf_counter()
-        out = q.collect(ExecContext(dev.conf))
-        cold_s = time.perf_counter() - t0
-        dt = time_warm(lambda: q.collect(ExecContext(dev.conf)))
-        ctx = ExecContext(dev.conf)
-        out = q.collect(ctx)
-        compiled = ctx.metrics.get("whole_plan_compiled_queries", 0)
-        compiled_ct += compiled
+        if left() < 20:
+            suite.skipped.append(name)
+            continue
+        try:
+            dfq = tpch.QUERIES[name](dev, tables)
+            q = dfq.physical()
+            # cold: compile (or cache load) + device upload + first run
+            t0 = time.perf_counter()
+            out = q.collect(ExecContext(dev.conf))
+            cold_s = time.perf_counter() - t0
+            iters = 3 if left() > 120 else 1
+            dt = time_warm(lambda: q.collect(ExecContext(dev.conf)),
+                           iters=iters)
+            ctx = ExecContext(dev.conf)
+            out = q.collect(ctx)
+            compiled = ctx.metrics.get("whole_plan_compiled_queries", 0)
+            suite.compiled_ct += compiled
 
-        cq = DataFrame(dfq._plan, cpu).physical()
-        oracle = cq.collect()
-        ct = time_warm(lambda: cq.collect(), iters=2)
+            cq = DataFrame(dfq._plan, cpu).physical()
+            oracle = cq.collect()
+            ct = time_warm(lambda: cq.collect(), iters=2)
 
-        match = approx_equal(out, oracle)
-        per_q[name] = {"device_ms": round(dt * 1e3, 1),
-                       "cpu_ms": round(ct * 1e3, 1),
-                       "speedup": round(ct / dt, 2),
-                       "compiled": bool(compiled),
-                       "match": match}
-        print(f"# {name}: device={dt*1e3:.0f}ms cpu={ct*1e3:.0f}ms "
-              f"x{ct/dt:.2f} cold={cold_s:.1f}s "
-              f"compiled={bool(compiled)} match={match}", file=sys.stderr)
-        if not match:
-            print(f"# WARNING {name}: device != cpu oracle", file=sys.stderr)
-    speedups = [v["speedup"] for v in per_q.values()]
-    geomean = float(np.exp(np.mean(np.log(speedups)))) if speedups else 0.0
-    return per_q, geomean, compiled_ct
+            match = approx_equal(out, oracle)
+            suite.per_q[name] = {"device_ms": round(dt * 1e3, 1),
+                                 "cpu_ms": round(ct * 1e3, 1),
+                                 "speedup": round(ct / dt, 2),
+                                 "cold_s": round(cold_s, 1),
+                                 "compiled": bool(compiled),
+                                 "match": match}
+            print(f"# {name}: device={dt*1e3:.0f}ms cpu={ct*1e3:.0f}ms "
+                  f"x{ct/dt:.2f} cold={cold_s:.1f}s "
+                  f"compiled={bool(compiled)} match={match}",
+                  file=sys.stderr)
+            if not match:
+                print(f"# WARNING {name}: device != cpu oracle",
+                      file=sys.stderr)
+        except Exception as e:               # noqa: BLE001
+            # a broken query must not take the whole suite's report down
+            print(f"# ERROR {name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            suite.per_q[name] = {"device_ms": None, "cpu_ms": None,
+                                 "speedup": None, "cold_s": 0.0,
+                                 "compiled": False, "match": False,
+                                 "error": f"{type(e).__name__}: {e}"[:200]}
+        suite.emit()
+    return suite
 
 
 def main():
@@ -152,33 +231,8 @@ def main():
     from spark_rapids_tpu import tpch
     query_names = names or sorted(tpch.QUERIES, key=lambda q: int(q[1:]))
 
-    rtt = measure_rtt()
-    print(f"# backend={jax.default_backend()} tunnel RTT ~{rtt*1e3:.0f}ms "
-          f"per host sync", file=sys.stderr)
-
-    per_q, geomean, compiled_ct = run_suite(scale, query_names)
-
-    q6 = per_q.get("q6", {})
-    out = {
-        "metric": f"tpch_sf{scale:g}_suite_geomean_speedup_vs_cpu",
-        "value": round(geomean, 3),
-        "unit": "x",
-        "vs_baseline": round(geomean, 3),
-        "tpch_suite_scale": scale,
-        "tpch_suite_queries": per_q,
-        "tpch_suite_geomean_speedup": round(geomean, 3),
-        "queries_measured": len(per_q),
-        "whole_plan_compiled": compiled_ct,
-        "tunnel_rtt_ms": round(rtt * 1e3, 1),
-        "q6_device_ms": q6.get("device_ms"),
-        "note": "warm single-shot wall per query (one whole-plan XLA "
-                "dispatch + one fetch, device-resident tables, compile "
-                "cached); INCLUDES one tunnel RTT per query — "
-                "tunnel_rtt_ms is the harness floor. CPU baseline = "
-                "same queries on the engine's vectorized pyarrow "
-                "fallback, warm.",
-    }
-    print(json.dumps(out))
+    suite = run_suite(scale, query_names)
+    suite.emit(final=True)
 
 
 if __name__ == "__main__":

@@ -267,6 +267,9 @@ object Json {
       fields.map { case (k, v) => S(k).render + ":" + v.render }
         .mkString("{", ",", "}")
   }
+  /** Pre-rendered JSON spliced through verbatim (e.g. a SerializedPlan's
+    * payload embedded in a request header). */
+  case class Raw(v: String) extends V { def render: String = v }
   def s(v: String): V = S(v)
   def i(v: Long): V = I(v)
   def d(v: Double): V = D(v)
@@ -275,4 +278,114 @@ object Json {
   def arr(items: V*): V = A(items)
   def obj(fields: (String, V)*): V = O(fields)
   def render(v: V): String = v.render
+
+  /** Minimal recursive-descent parser for worker replies (flat JSON of
+    * strings/numbers/bools/objects — no dependency, mirrors render). */
+  def parse(text: String): V = {
+    val p = new Parser(text)
+    val v = p.value()
+    p.skipWs()
+    require(p.eof, s"trailing JSON content at ${p.pos}")
+    v
+  }
+
+  private final class Parser(s: String) {
+    var pos = 0
+    def eof: Boolean = pos >= s.length
+    def skipWs(): Unit = {
+      while (!eof && Character.isWhitespace(s.charAt(pos))) pos += 1
+    }
+    private def expect(c: Char): Unit = {
+      skipWs()
+      require(!eof && s.charAt(pos) == c,
+        s"expected '$c' at $pos in ${s.take(80)}")
+      pos += 1
+    }
+    def value(): V = {
+      skipWs()
+      require(!eof, "unexpected end of JSON")
+      s.charAt(pos) match {
+        case '{' => obj()
+        case '[' => arr()
+        case '"' => S(string())
+        case 't' => lit("true", B(true))
+        case 'f' => lit("false", B(false))
+        case 'n' => lit("null", Null)
+        case _ => number()
+      }
+    }
+    private def lit(word: String, v: V): V = {
+      require(s.regionMatches(pos, word, 0, word.length),
+        s"bad literal at $pos")
+      pos += word.length
+      v
+    }
+    private def obj(): V = {
+      expect('{')
+      val fields = scala.collection.mutable.ArrayBuffer[(String, V)]()
+      skipWs()
+      if (!eof && s.charAt(pos) == '}') { pos += 1; return O(fields.toSeq) }
+      while (true) {
+        skipWs()
+        val k = string()
+        expect(':')
+        fields += (k -> value())
+        skipWs()
+        if (!eof && s.charAt(pos) == ',') pos += 1
+        else { expect('}'); return O(fields.toSeq) }
+      }
+      O(fields.toSeq)
+    }
+    private def arr(): V = {
+      expect('[')
+      val items = scala.collection.mutable.ArrayBuffer[V]()
+      skipWs()
+      if (!eof && s.charAt(pos) == ']') { pos += 1; return A(items.toSeq) }
+      while (true) {
+        items += value()
+        skipWs()
+        if (!eof && s.charAt(pos) == ',') pos += 1
+        else { expect(']'); return A(items.toSeq) }
+      }
+      A(items.toSeq)
+    }
+    private def string(): String = {
+      expect('"')
+      val sb = new StringBuilder
+      while (true) {
+        require(!eof, "unterminated string")
+        val c = s.charAt(pos)
+        pos += 1
+        c match {
+          case '"' => return sb.toString
+          case '\\' =>
+            val e = s.charAt(pos); pos += 1
+            e match {
+              case '"' => sb += '"'
+              case '\\' => sb += '\\'
+              case '/' => sb += '/'
+              case 'n' => sb += '\n'
+              case 't' => sb += '\t'
+              case 'r' => sb += '\r'
+              case 'b' => sb += '\b'
+              case 'f' => sb += '\f'
+              case 'u' =>
+                sb += Integer.parseInt(s.substring(pos, pos + 4), 16).toChar
+                pos += 4
+              case other => sb += other
+            }
+          case other => sb += other
+        }
+      }
+      sb.toString
+    }
+    private def number(): V = {
+      val start = pos
+      while (!eof && "+-0123456789.eE".indexOf(s.charAt(pos)) >= 0) pos += 1
+      val text = s.substring(start, pos)
+      require(text.nonEmpty, s"bad JSON value at $start")
+      if (text.exists(c => c == '.' || c == 'e' || c == 'E')) D(text.toDouble)
+      else I(text.toLong)
+    }
+  }
 }

@@ -1,0 +1,80 @@
+/*
+ * The executed TPU operator: a SparkPlan node standing in for a claimed
+ * subtree (reference role: GpuExec + the transition execs,
+ * GpuTransitionOverrides) — its children are the claimed subtree's
+ * leaves (which Spark executes normally), its runtime ships the
+ * serialized plan + the children's output as Arrow to the executor's
+ * TPU worker and decodes the result stream back into rows.
+ *
+ * Execution shape (v1 data plane): the shipped subtree runs on ONE
+ * worker, so the input partitions gather onto a single partition first
+ * (coalesce(1)) — the Spark-side scale-out story is the worker's own
+ * distributed mesh (SURVEY §2.7: the engine shards one plan over the
+ * chip mesh), not many workers per query.  Output partitioning is
+ * therefore SinglePartition.
+ */
+package org.tpurapids
+
+import org.apache.spark.rdd.RDD
+import org.apache.spark.sql.catalyst.InternalRow
+import org.apache.spark.sql.catalyst.expressions.Attribute
+import org.apache.spark.sql.catalyst.plans.physical.{Partitioning, SinglePartition}
+import org.apache.spark.sql.execution.SparkPlan
+import org.apache.spark.sql.types.StructType
+
+case class TpuExec(original: SparkPlan, payload: SerializedPlan)
+    extends SparkPlan {
+
+  override def output: Seq[Attribute] = original.output
+
+  override def children: Seq[SparkPlan] = payload.inputs
+
+  override def outputPartitioning: Partitioning = SinglePartition
+
+  override def nodeName: String = "TpuExec"
+
+  override def simpleString(maxFields: Int): String =
+    s"TpuExec [${original.nodeName}] (${payload.inputs.length} inputs)"
+
+  override protected def withNewChildrenInternal(
+      newChildren: IndexedSeq[SparkPlan]): SparkPlan =
+    copy(payload = payload.copy(inputs = newChildren))
+
+  override protected def doExecute(): RDD[InternalRow] = {
+    val planJson = payload.json
+    val schemas: Seq[StructType] = children.map(_.schema)
+    val confMap: Map[String, String] = {
+      val c = conf
+      Seq(TpuPluginConf.SqlEnabled, TpuPluginConf.Explain)
+        .flatMap(k => c.getAllConfs.get(k).map(k -> _)).toMap
+    }
+
+    // each input partition encodes itself to one (inputIdx, ipcBytes)
+    val frames: Seq[RDD[(Int, Array[Byte])]] =
+      children.zipWithIndex.map { case (child, idx) =>
+        val schema = schemas(idx)
+        child.execute().mapPartitions { rows =>
+          Iterator((idx, ArrowCodec.toIpc(rows, schema)))
+        }
+      }
+
+    sparkContext.union(frames).coalesce(1).mapPartitions { it =>
+      val byInput = scala.collection.mutable.Map[
+        Int, scala.collection.mutable.ArrayBuffer[Array[Byte]]]()
+      it.foreach { case (i, b) =>
+        byInput.getOrElseUpdate(
+          i, scala.collection.mutable.ArrayBuffer[Array[Byte]]()) += b
+      }
+      val tables = schemas.indices.map { i =>
+        val parts = byInput.get(i).map(_.toSeq).getOrElse(Seq.empty)
+        (s"t$i", ArrowCodec.concatIpc(parts, schemas(i)))
+      }
+      val client = WorkerClient.shared
+      require(client != null,
+        "TPU worker client not initialized on this executor " +
+          "(TpuExecutorPlugin.init did not run?)")
+      val (resultIpc, _) = client.execute(planJson, tables, confMap)
+      ArrowCodec.fromIpc(resultIpc)
+    }
+  }
+}

@@ -1,0 +1,152 @@
+/*
+ * The JVM side of the JVM⇄TPU-worker boundary (SURVEY §7): a framed
+ * socket client speaking the protocol spark_rapids_tpu/plugin/worker.py
+ * serves — [4-byte big-endian length][payload] frames, a token
+ * handshake as the first frame, one JSON request frame followed by one
+ * Arrow IPC frame per shipped table, then a JSON reply (+ one Arrow
+ * frame for execute results).
+ *
+ * Reference role: the JNI boundary of the CUDA plugin (device calls
+ * into libcudf); here the "device" is a long-lived worker process that
+ * owns the chip, so the boundary is a local socket instead of JNI.
+ * The executable contract is tests/test_plugin.py (Python worker +
+ * client) plus the golden fixtures under jvm-plugin/fixtures/ that pin
+ * this client's and PlanSerializer's wire bytes.
+ */
+package org.tpurapids
+
+import java.io.{BufferedInputStream, BufferedOutputStream, DataInputStream, DataOutputStream}
+import java.net.Socket
+import java.nio.charset.StandardCharsets
+
+object ProtocolVersion {
+  val Current: Long = 1L
+}
+
+case class Pong(version: Long)
+
+case class WorkerException(errorClass: String, message: String)
+  extends RuntimeException(s"$errorClass: $message")
+
+object WorkerClient {
+  /** The executor-wide shared client (set by TpuExecutorPlugin.init). */
+  @volatile var shared: WorkerClient = _
+}
+
+class WorkerClient(host: String, port: Int, token: String) {
+  private val sock = new Socket(host, port)
+  sock.setTcpNoDelay(true)
+  private val in = new DataInputStream(
+    new BufferedInputStream(sock.getInputStream))
+  private val out = new DataOutputStream(
+    new BufferedOutputStream(sock.getOutputStream))
+  // the worker unconditionally reads the first frame as the auth token
+  // (plugin/worker.py _serve_conn) — a missing token must fail HERE with
+  // a clear message, not as a silent desync on the first request
+  require(token != null && token.nonEmpty,
+    s"${TpuPluginConf.WorkerToken} is not set — the worker prints its " +
+      "token at startup; pass it via spark conf")
+  sendFrame(token.getBytes(StandardCharsets.UTF_8))
+
+  // -- framing ------------------------------------------------------------
+
+  private def sendFrame(payload: Array[Byte]): Unit = synchronized {
+    out.writeInt(payload.length)
+    out.write(payload)
+    out.flush()
+  }
+
+  private def recvFrame(): Array[Byte] = {
+    val n = in.readInt()
+    val buf = new Array[Byte](n)
+    in.readFully(buf)
+    buf
+  }
+
+  private def jsonReply(): Json.V = {
+    val head = Json.parse(new String(recvFrame(), StandardCharsets.UTF_8))
+    head match {
+      case Json.O(fields) if fields.toMap.get("type").contains(Json.S("error")) =>
+        val m = fields.toMap
+        throw WorkerException(
+          m.get("error_class").collect { case Json.S(s) => s }.getOrElse("?"),
+          m.get("message").collect { case Json.S(s) => s }.getOrElse(""))
+      case v => v
+    }
+  }
+
+  // -- requests -----------------------------------------------------------
+
+  def ping(): Pong = synchronized {
+    sendFrame("""{"type":"ping"}""".getBytes(StandardCharsets.UTF_8))
+    jsonReply() match {
+      case Json.O(fields) =>
+        fields.toMap.get("version") match {
+          case Some(Json.I(v)) => Pong(v)
+          case _ => throw WorkerException("ProtocolError", "pong without version")
+        }
+      case _ => throw WorkerException("ProtocolError", "malformed pong")
+    }
+  }
+
+  /** Execute a serialized plan against named Arrow IPC table payloads.
+    * Returns (result IPC stream bytes, metrics).  The request's
+    * "tables" list orders the Arrow frames that follow the header —
+    * sorted by name, matching the Python reference client. */
+  def execute(planJson: String, tables: Seq[(String, Array[Byte])],
+              conf: Map[String, String] = Map.empty)
+      : (Array[Byte], Map[String, Double]) = synchronized {
+    sendRequest("execute", planJson, tables, conf)
+    val head = jsonReply()
+    val result = recvFrame()
+    val metrics = head match {
+      case Json.O(fields) =>
+        fields.toMap.get("metrics") match {
+          case Some(Json.O(ms)) => ms.collect {
+            case (k, Json.I(v)) => k -> v.toDouble
+            case (k, Json.D(v)) => k -> v
+          }.toMap
+          case _ => Map.empty[String, Double]
+        }
+      case _ => Map.empty[String, Double]
+    }
+    (result, metrics)
+  }
+
+  /** Ask the worker to run the overrides pipeline without executing:
+    * returns (explain text, whole plan lands on device?). */
+  def explain(planJson: String, tables: Seq[(String, Array[Byte])],
+              conf: Map[String, String] = Map.empty)
+      : (String, Boolean) = synchronized {
+    sendRequest("explain", planJson, tables, conf)
+    jsonReply() match {
+      case Json.O(fields) =>
+        val m = fields.toMap
+        val text = m.get("text").collect { case Json.S(s) => s }.getOrElse("")
+        val device = m.get("device").collect { case Json.B(b) => b }
+          .getOrElse(false)
+        (text, device)
+      case _ => throw WorkerException("ProtocolError", "malformed explained")
+    }
+  }
+
+  private def sendRequest(kind: String, planJson: String,
+                          tables: Seq[(String, Array[Byte])],
+                          conf: Map[String, String]): Unit = {
+    val sorted = tables.sortBy(_._1)
+    val header = Json.obj(
+      "type" -> Json.s(kind),
+      // the plan is already rendered JSON: splice it through verbatim
+      "plan" -> Json.Raw(planJson),
+      "tables" -> Json.arr(sorted.map(t => Json.s(t._1)): _*),
+      "conf" -> Json.O(conf.toSeq.sortBy(_._1)
+        .map { case (k, v) => k -> Json.s(v) })
+    ).render
+    sendFrame(header.getBytes(StandardCharsets.UTF_8))
+    sorted.foreach { case (_, ipc) => sendFrame(ipc) }
+  }
+
+  def close(): Unit = {
+    try sock.close() catch { case _: java.io.IOException => () }
+  }
+}

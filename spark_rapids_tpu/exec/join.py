@@ -182,11 +182,12 @@ class HashJoinExec(PlanNode):
                                  name)
 
     def _range_pack_spec(self):
-        """[(lo, stride)] per key column when the composite key can fold
-        into ONE injective int64 lane from exact column-range statistics
-        (min/max over BOTH sides), else None.  Gives multi-column joins
-        the exact single-lane probe paths (no composite-hash collisions,
-        no sizing sync)."""
+        """([(lo, stride)] per key column, total span) when the composite
+        key can fold into ONE injective int64 lane from exact
+        column-range statistics (min/max over BOTH sides), else None.
+        Packed lane values lie in [0, total) — a ready-made dense domain.
+        Gives multi-column joins the exact single-lane probe paths (no
+        composite-hash collisions, no sizing sync)."""
         ln = key_ref_names(self.left_keys)
         rn = key_ref_names(self.right_keys)
         if ln is None or rn is None or len(ln) < 2:
@@ -211,7 +212,40 @@ class HashJoinExec(PlanNode):
             spec.append((lo, stride))
             stride *= span
         spec.reverse()
-        return spec
+        return spec, total
+
+    @staticmethod
+    def _span_fits(span: int, build_capacity: int) -> bool:
+        """Direct-address-table sizing policy, shared by the single-key
+        and packed-composite-key dense gates."""
+        return span <= max(16 * build_capacity, 1 << 20) and \
+            span <= (1 << 26)
+
+    def _dense_domain(self, build_keys, build_capacity: int):
+        """(lo, hi) covering every valid BUILD key, for single-key joins
+        whose span is bounded enough for a direct-address table:
+        dictionary size for strings (codes are dense by construction),
+        exact scan statistics for integer-lane types.  None otherwise."""
+        if len(self.right_keys) != 1:
+            return None
+        c = build_keys[0]
+        if isinstance(c.dtype, t.StringType):
+            if c.dictionary is None:
+                return None
+            span = max(len(c.dictionary), 1)
+            lo, hi = 0, span - 1
+        else:
+            rn = key_ref_names(self.right_keys)
+            if rn is None or key_ref_names(self.left_keys) is None:
+                return None
+            rng = self.right.column_range(rn[0])
+            if rng is None:
+                return None
+            lo, hi = int(rng[0]), int(rng[1])
+            span = hi - lo + 1
+        if not self._span_fits(span, build_capacity):
+            return None
+        return lo, hi
 
     @staticmethod
     def _packed_lane(key_cols, spec) -> jax.Array:
@@ -337,10 +371,24 @@ class HashJoinExec(PlanNode):
                 build_keys[i] = ensure_unique_dict(build_keys[i])
         # Composite keys with exact range statistics fold into one
         # injective int64 lane — single-lane probe paths apply.
-        pack = self._range_pack_spec() if all(raw_pos) else None
+        pack_and_span = self._range_pack_spec() if all(raw_pos) else None
+        pack, pack_span = pack_and_span if pack_and_span is not None \
+            else (None, None)
         build_lanes = None if pack is None \
             else [self._packed_lane(build_keys, pack)]
-        build = J.BuildTable(build_batch, build_keys, build_lanes)
+        # Dense key domain (packed-lane span / dictionary size / scan
+        # stats): probes become direct-address gathers — no search, and
+        # a unique build side needs no sort either (ops/join.py).
+        if pack is not None:
+            domain = (0, pack_span - 1) if self._span_fits(
+                pack_span, build_batch.capacity) else None
+        else:
+            domain = self._dense_domain(build_keys, build_batch.capacity)
+        unique = domain is not None and self._build_unique()
+        if domain is not None:
+            ctx.bump("join_dense_domain")
+        build = J.BuildTable(build_batch, build_keys, build_lanes,
+                             domain=domain, unique=unique)
         out_names = list(self.output_schema.names)
         # Sync-free probe-aligned path: a build side whose keys are unique
         # (exact plan statistics — dimension scans, group-by outputs) makes
@@ -383,8 +431,8 @@ class HashJoinExec(PlanNode):
                     else:
                         out_cap = bucket_capacity(total, ctx.conf)
                         _, _, _, matched, _ = J.expand_pairs(
-                            build, probe_lanes, probe_valid, lo, cum,
-                            out_cap, total)
+                            build, probe_lanes, probe_valid, lo, counts,
+                            cum, out_cap, total)
                 keep = matched if self.join_type == J.LEFT_SEMI \
                     else pb.row_mask() & ~matched
                 out = compact_batch(pb, keep, ctx.conf)
@@ -427,8 +475,8 @@ class HashJoinExec(PlanNode):
             if total > 0:
                 out_cap = bucket_capacity(total, ctx.conf)
                 probe_idx, build_idx, ok, probe_matched, build_matched = \
-                    J.expand_pairs(build, probe_lanes, probe_valid, lo, cum,
-                                   out_cap, total)
+                    J.expand_pairs(build, probe_lanes, probe_valid, lo,
+                                   counts, cum, out_cap, total)
                 build_matched_acc = build_matched_acc | build_matched
                 lg = gather_batch(pb, probe_idx, total)
                 rg = gather_batch(build_batch, build_idx, total)

@@ -35,16 +35,54 @@ def compaction_order(keep: jax.Array) -> jax.Array:
                        stable=True)
 
 
+def grouped_take(lanes, idx: jax.Array):
+    """Gather many (capacity,) lanes at the same indices, same-dtype
+    lanes stacked into one (capacity, k) matrix per dtype.
+
+    TPU gathers pay per gathered ROW (descriptor-driven DMA), so k lanes
+    gathered as one matrix cost ~1 lane's descriptors instead of k —
+    measured 3x for 8 int64 lanes at 8M on v5e.  (A variadic payload
+    sort would be faster still at runtime but TPU sort COMPILE time
+    scales ~linearly with operand count — 7.5 min for 17 operands at 8M
+    — so gathers win end to end.)  Returns gathered lanes in order."""
+    groups: dict = {}
+    for slot, arr in enumerate(lanes):
+        groups.setdefault(str(arr.dtype), []).append((slot, arr))
+    out: dict = {}
+    for _dt, members in groups.items():
+        if len(members) == 1:
+            slot, arr = members[0]
+            out[slot] = jnp.take(arr, idx, axis=0)
+        else:
+            mat = jnp.stack([arr for _s, arr in members], axis=1)
+            g = jnp.take(mat, idx, axis=0)
+            for k, (slot, _arr) in enumerate(members):
+                out[slot] = g[:, k]
+    return [out[i] for i in range(len(lanes))]
+
+
 def _compact_trace(ncols: int, has_hi: Tuple[bool, ...]):
     def run(datas, valids, his, keep):
         order = compaction_order(keep)
         count = jnp.sum(keep, dtype=jnp.int32)
-        out = []
+        lanes = []
         for i in range(ncols):
-            d = jnp.take(datas[i], order, axis=0)
-            v = jnp.take(valids[i], order, axis=0) & (
-                jnp.arange(d.shape[0], dtype=jnp.int32) < count)
-            h = jnp.take(his[i], order, axis=0) if has_hi[i] else None
+            lanes.append(datas[i])
+            lanes.append(valids[i])
+            if has_hi[i]:
+                lanes.append(his[i])
+        moved = grouped_take(lanes, order)
+        live = jnp.arange(keep.shape[0], dtype=jnp.int32) < count
+        out = []
+        j = 0
+        for i in range(ncols):
+            d = moved[j]
+            v = moved[j + 1] & live
+            j += 2
+            h = None
+            if has_hi[i]:
+                h = moved[j]
+                j += 1
             out.append((d, v, h))
         return out, count
     return run
@@ -100,12 +138,24 @@ def gather_batch(db: DeviceBatch, indices: jax.Array, out_rows: int,
     in_bounds = (indices >= 0) & (indices < jnp.int32(db.num_rows))
     safe = jnp.clip(indices, 0, max(db.capacity - 1, 0)).astype(jnp.int32)
     live = live_mask(cap_out, jnp.int32(out_rows))
+    vmask = live & in_bounds if null_out_of_bounds else live
+
+    lanes = []
+    slots = []          # (col index, lane kind) per lane
+    for ci, c in enumerate(db.columns):
+        lanes.append(c.data)
+        slots.append((ci, "d"))
+        lanes.append(c.validity)
+        slots.append((ci, "v"))
+        if c.data_hi is not None:
+            lanes.append(c.data_hi)
+            slots.append((ci, "h"))
+    moved = grouped_take(lanes, safe)
+    gathered = {slot: arr for slot, arr in zip(slots, moved)}
     cols = []
-    for c in db.columns:
-        d = jnp.take(c.data, safe, axis=0)
-        v = jnp.take(c.validity, safe, axis=0) & live
-        if null_out_of_bounds:
-            v = v & in_bounds
-        h = None if c.data_hi is None else jnp.take(c.data_hi, safe, axis=0)
+    for ci, c in enumerate(db.columns):
+        d = gathered[(ci, "d")]
+        v = gathered[(ci, "v")] & vmask
+        h = gathered.get((ci, "h"))
         cols.append(DeviceColumn(d, v, c.dtype, c.dictionary, h))
     return DeviceBatch(cols, out_rows, names or list(db.names))

@@ -1,26 +1,36 @@
-"""Equi-join kernels: sorted-hash probe on canonical key lanes.
+"""Equi-join kernels: dense-domain direct addressing with a sorted-lane
+fallback.
 
 Reference: GpuShuffledHashJoinExec / GpuHashJoin (GpuHashJoin.scala:104)
 builds a cuDF hash table and gathers via GatherMaps.  Hash tables are a
-poor fit for the MXU/VPU (serial probing, dynamic shapes), so the
-TPU-native join is sort-based with static shapes end to end:
+poor fit for the MXU/VPU (serial probing, dynamic shapes); binary search
+is equally hostile (log2(n) dependent gathers — profiled at >50% of
+TPC-H join time on v5e).  The TPU-native design is therefore a
+*direct-address table over the key domain* whenever exact range
+statistics bound it (scan min/max propagated through the plan;
+dictionary size for strings; packed-lane span for composite keys):
 
   1. every key column maps to a *canonical int64 lane* where Spark join
      equality == integer equality (NaN canonicalized to one bit pattern,
      -0.0 -> +0.0, strings -> codes in a dictionary unified across both
      sides, narrow ints sign-extended);
-  2. multi-key rows fold their lanes into a 64-bit mixed hash; the build
-     side is sorted by it once (single key: the lane itself, exact);
-  3. probes binary-search the sorted lane (`searchsorted`) for candidate
-     ranges — O(log n) vectorized, no data-dependent loops;
-  4. candidate pairs expand into a static output bucket and are *verified*
+  2. with a known domain [lo, hi] of bounded span, the build side
+     scatters row ids (unique keys) or per-key counts+offsets (duplicate
+     keys) into a span-sized table — probes are then pure gathers, no
+     search, no sort for the unique case, O(1) per probe row;
+  3. without a domain, multi-key rows fold their lanes into a 64-bit
+     mixed hash, the build side is sorted by it once, and probes binary-
+     search the sorted lane (`searchsorted`) for candidate ranges;
+  4. candidate pairs expand into a static output bucket (pair ownership
+     recovered by scatter + cummax, not search) and are *verified*
      lane-by-lane, so hash collisions cannot produce wrong results, they
      only cost a masked-out row;
   5. outer/semi/anti variants derive from verified-match flags via
      segment/scatter max — never from the (overcounted) candidate ranges.
 
 One host sync per probe batch fetches the candidate-pair count (the
-reference syncs identically to size its gather maps).
+reference syncs identically to size its gather maps); unique-build and
+semi/anti probes are sync-free.
 """
 from __future__ import annotations
 
@@ -33,6 +43,7 @@ import numpy as np
 from .. import types as t
 from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
 from ..config import TpuConf, DEFAULT_CONF
+from .search import searchsorted
 
 
 INNER = "inner"
@@ -162,31 +173,124 @@ def composite_hash(lanes: Sequence[jax.Array]) -> jax.Array:
 
 
 class BuildTable:
-    """Sorted build side of a join (the hash-table analogue).
+    """Build side of a join (the hash-table analogue): a dense
+    direct-address table over the key domain when `domain` is given,
+    else a sorted canonical lane.
 
     `lanes_override` replaces the per-column canonical lanes (e.g. a
     range-packed single lane for composite keys — exec/join.py
-    _range_pack_spec); key validity still derives from `key_cols`."""
+    _range_pack_spec); key validity still derives from `key_cols`.
+
+    `domain=(lo, hi)` asserts every VALID build key lies in [lo, hi]
+    (exact plan statistics); requires a single lane.  `unique` asserts
+    build keys are distinct (plan uniqueness statistics) — with a domain
+    this removes the build sort entirely (one scatter builds the table).
+
+    Sort-dependent members (`perm`, `sorted_hash`) and the dense tables
+    (`slot`, `offs`) are built lazily so eager-mode probes only pay for
+    the structures their join type touches (XLA DCE does the same for
+    traced whole-plan programs)."""
 
     def __init__(self, batch: DeviceBatch, key_cols: Sequence[DeviceColumn],
-                 lanes_override: Optional[List[jax.Array]] = None):
+                 lanes_override: Optional[List[jax.Array]] = None,
+                 domain: Optional[Tuple[int, int]] = None,
+                 unique: bool = False):
         self.batch = batch
         lanes = lanes_override if lanes_override is not None \
             else key_cols_lanes(key_cols)
         valid = batch.row_mask()
         for c in key_cols:
             valid = valid & c.validity      # null keys never match
-        h = composite_hash(lanes)
+        self.lanes = lanes
+        self.key_valid = valid
+        self.unique = unique
+        if domain is not None and len(lanes) == 1:
+            self.domain = (int(domain[0]), int(domain[1]))
+        else:
+            self.domain = None
+        self._perm = None
+        self._sorted_hash = None
+        self._valid_count = None
+        self._slot = None
+        self._offs = None
+
+    @property
+    def span(self) -> int:
+        lo, hi = self.domain
+        return hi - lo + 1
+
+    def _dense_pos(self):
+        """(pos, in_bounds): clipped domain position + validity per build
+        row."""
+        lo, hi = self.domain
+        lane = self.lanes[0].astype(jnp.int64)
+        inb = self.key_valid & (lane >= lo) & (lane <= hi)
+        pos = jnp.clip(lane - lo, 0, self.span - 1).astype(jnp.int32)
+        return jnp.where(inb, pos, self.span), inb
+
+    @property
+    def slot(self) -> Optional[jax.Array]:
+        """Dense-unique direct table: slot[k-lo] = build row of key k,
+        -1 for absent keys.  None unless (domain and unique)."""
+        if self.domain is None or not self.unique:
+            return None
+        if self._slot is None:
+            tgt, _inb = self._dense_pos()
+            self._slot = jnp.full((self.span,), -1, jnp.int32).at[tgt].set(
+                jnp.arange(self.capacity, dtype=jnp.int32), mode="drop")
+        return self._slot
+
+    @property
+    def offs(self) -> Optional[jax.Array]:
+        """Dense per-key start offsets into the key-sorted order
+        (span+1,); key k's build rows are perm[offs[k-lo]:offs[k-lo+1]].
+        None without a domain."""
+        if self.domain is None:
+            return None
+        if self._offs is None:
+            tgt, _inb = self._dense_pos()
+            counts = jnp.zeros((self.span,), jnp.int32).at[tgt].add(
+                jnp.int32(1), mode="drop")
+            self._offs = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.cumsum(counts, dtype=jnp.int32)])
+        return self._offs
+
+    @property
+    def perm(self) -> jax.Array:
+        if self._perm is None:
+            self._sort()
+        return self._perm
+
+    @property
+    def sorted_hash(self) -> jax.Array:
+        if self._sorted_hash is None:
+            self._sort()
+        return self._sorted_hash
+
+    @property
+    def valid_count(self) -> jax.Array:
+        if self._valid_count is None:
+            self._valid_count = jnp.sum(self.key_valid, dtype=jnp.int32)
+        return self._valid_count
+
+    def _sort(self):
+        if self.domain is not None:
+            # sort on the domain POSITION (int order), consistent with
+            # the offs histogram — the uint64 hash order would disagree
+            # for negative lanes
+            tgt, _inb = self._dense_pos()
+            self._perm = jnp.argsort(tgt, stable=True)
+            self._sorted_hash = None    # dense probes never search
+            return
+        h = composite_hash(self.lanes)
         # dead/null-key rows get MAX and liveness-primary lexsort, so the
         # array is globally non-decreasing (searchsorted-safe) and the
         # searchable region is exactly [0, valid_count)
-        sort_h = jnp.where(valid, h, jnp.uint64(2**64 - 1))
-        perm = jnp.lexsort([sort_h, (~valid).astype(jnp.int8)])
-        self.perm = perm
-        self.sorted_hash = jnp.take(sort_h, perm)
-        self.valid_count = jnp.sum(valid, dtype=jnp.int32)
-        self.lanes = lanes
-        self.key_valid = valid
+        sort_h = jnp.where(self.key_valid, h, jnp.uint64(2**64 - 1))
+        perm = jnp.lexsort([sort_h, (~self.key_valid).astype(jnp.int8)])
+        self._perm = perm
+        self._sorted_hash = jnp.take(sort_h, perm)
 
     @property
     def capacity(self) -> int:
@@ -196,6 +300,15 @@ class BuildTable:
 _PROBE_CACHE = {}
 
 
+def _dense_probe_pos(lane: jax.Array, probe_valid: jax.Array,
+                     lo: int, hi: int):
+    """(pos, in_bounds) of probe keys in a build domain."""
+    lane = lane.astype(jnp.int64)
+    inb = probe_valid & (lane >= lo) & (lane <= hi)
+    pos = jnp.clip(lane - lo, 0, hi - lo).astype(jnp.int32)
+    return pos, inb
+
+
 def probe_aligned(build: BuildTable, probe_lanes: List[jax.Array],
                   probe_valid: jax.Array):
     """Probe a build side whose keys are UNIQUE: each probe row has at
@@ -203,13 +316,15 @@ def probe_aligned(build: BuildTable, probe_lanes: List[jax.Array],
     shape (probe_capacity,) and NO host sync (output capacity is the
     probe's own capacity, known statically).
 
-    SINGLE-LANE ONLY: with one canonical lane the sorted "hash" IS the
-    lane (exact, zero collisions), so the slot at searchsorted-left is
-    the unique candidate.  With multiple lanes the composite hash can
-    collide between distinct build keys and the single verified slot
-    could miss a real match that sits one slot over — multi-lane joins
-    must use probe_counts/expand_pairs, which scan the full candidate
-    range.
+    With a dense domain this is ONE gather from the direct-address
+    table — no search, and the build needed no sort.  Otherwise the slot
+    at searchsorted-left is the unique candidate.
+
+    SINGLE-LANE ONLY: with one canonical lane the lane is exact (zero
+    collisions).  With multiple lanes the composite hash can collide
+    between distinct build keys and the single verified slot could miss
+    a real match that sits one slot over — multi-lane joins must use
+    probe_counts/expand_pairs, which scan the full candidate range.
 
     This is the TPU-native fast path for the dominant join shape
     (fact⋈dimension, join-against-group-by): the reference syncs to size
@@ -217,6 +332,19 @@ def probe_aligned(build: BuildTable, probe_lanes: List[jax.Array],
     the size a static fact instead."""
     assert len(probe_lanes) == 1 and len(build.lanes) == 1, \
         "probe_aligned requires exact single-lane keys"
+    if build.slot is not None:
+        lo, hi = build.domain
+        sig = ("aligned_dense", build.span, probe_valid.shape[0], lo, hi)
+        fn = _PROBE_CACHE.get(sig)
+        if fn is None:
+            def run(slot, p_lane, p_valid):
+                pos, inb = _dense_probe_pos(p_lane, p_valid, lo, hi)
+                build_idx = jnp.take(slot, pos)
+                ok = inb & (build_idx >= 0)
+                return jnp.where(ok, build_idx, 0), ok
+            fn = jax.jit(run)
+            _PROBE_CACHE[sig] = fn
+        return fn(build.slot, probe_lanes[0], probe_valid)
     sig = ("aligned", build.capacity, probe_valid.shape[0],
            len(probe_lanes))
     fn = _PROBE_CACHE.get(sig)
@@ -226,7 +354,7 @@ def probe_aligned(build: BuildTable, probe_lanes: List[jax.Array],
         def run(perm, sorted_hash, valid_count, b_lanes, b_key_valid,
                 p_lanes, p_valid):
             h = composite_hash(p_lanes)
-            lo = jnp.searchsorted(sorted_hash, h, side="left")
+            lo = searchsorted(sorted_hash, h, side="left")
             in_range = lo < valid_count
             pos = jnp.clip(lo, 0, bcap - 1)
             build_idx = jnp.take(perm, pos).astype(jnp.int32)
@@ -248,15 +376,28 @@ def probe_matched_lazy(build: BuildTable, probe_lanes: List[jax.Array],
     """Per-probe-row matched flag with NO host sync — sound only for a
     SINGLE canonical lane, where the "hash" is the lane itself and a
     non-empty candidate range proves a true match (semi/anti joins need
-    only this flag, never the pairs)."""
+    only this flag, never the pairs).  Dense domains answer from the
+    per-key counts (two gathers), no search and no build sort."""
     assert len(probe_lanes) == 1, "exact ranges require a single lane"
+    if build.domain is not None:
+        lo, hi = build.domain
+        sig = ("matched_dense", build.span, probe_valid.shape[0], lo, hi)
+        fn = _PROBE_CACHE.get(sig)
+        if fn is None:
+            def run(offs, p_lane, p_valid):
+                pos, inb = _dense_probe_pos(p_lane, p_valid, lo, hi)
+                return inb & (jnp.take(offs, pos + 1) >
+                              jnp.take(offs, pos))
+            fn = jax.jit(run)
+            _PROBE_CACHE[sig] = fn
+        return fn(build.offs, probe_lanes[0], probe_valid)
     sig = ("matched_lazy", build.capacity, probe_valid.shape[0])
     fn = _PROBE_CACHE.get(sig)
     if fn is None:
         def run(sorted_hash, valid_count, lanes, pvalid):
             h = composite_hash(lanes)
-            lo = jnp.searchsorted(sorted_hash, h, side="left")
-            hi = jnp.searchsorted(sorted_hash, h, side="right")
+            lo = searchsorted(sorted_hash, h, side="left")
+            hi = searchsorted(sorted_hash, h, side="right")
             lo = jnp.minimum(lo, valid_count)
             hi = jnp.minimum(hi, valid_count)
             return pvalid & (hi > lo)
@@ -268,7 +409,24 @@ def probe_matched_lazy(build: BuildTable, probe_lanes: List[jax.Array],
 
 def probe_counts(build: BuildTable, probe_lanes: List[jax.Array],
                  probe_valid: jax.Array):
-    """-> (lo, hi, counts, total) ; total is a host int (one sync)."""
+    """-> (lo, counts, cum, total) ; total is a host int (one sync).
+    `lo` values are candidate-range starts in build.perm order."""
+    if build.domain is not None and len(probe_lanes) == 1:
+        dlo, dhi = build.domain
+        sig = ("counts_dense", build.span, probe_valid.shape[0], dlo, dhi)
+        fn = _PROBE_CACHE.get(sig)
+        if fn is None:
+            def run(offs, p_lane, p_valid):
+                pos, inb = _dense_probe_pos(p_lane, p_valid, dlo, dhi)
+                lo = jnp.take(offs, pos)
+                hi = jnp.take(offs, pos + 1)
+                counts = jnp.where(inb, hi - lo, 0).astype(jnp.int32)
+                return lo, counts, jnp.cumsum(counts)
+            fn = jax.jit(run)
+            _PROBE_CACHE[sig] = fn
+        lo, counts, cum = fn(build.offs, probe_lanes[0], probe_valid)
+        total = int(cum[-1]) if cum.shape[0] else 0
+        return lo, counts, cum, total
     sig = ("probe_counts", build.capacity, probe_valid.shape[0],
            len(probe_lanes))
     fn = _PROBE_CACHE.get(sig)
@@ -276,8 +434,8 @@ def probe_counts(build: BuildTable, probe_lanes: List[jax.Array],
         def run(sorted_hash, valid_count, lanes, pvalid):
             h = composite_hash(lanes)
             # restrict the search to the valid prefix
-            lo = jnp.searchsorted(sorted_hash, h, side="left")
-            hi = jnp.searchsorted(sorted_hash, h, side="right")
+            lo = searchsorted(sorted_hash, h, side="left")
+            hi = searchsorted(sorted_hash, h, side="right")
             lo = jnp.minimum(lo, valid_count)
             hi = jnp.minimum(hi, valid_count)
             counts = jnp.where(pvalid, hi - lo, 0).astype(jnp.int32)
@@ -292,42 +450,58 @@ def probe_counts(build: BuildTable, probe_lanes: List[jax.Array],
 
 
 def expand_pairs(build: BuildTable, probe_lanes: List[jax.Array],
-                 probe_valid: jax.Array, lo, cum, out_cap: int,
+                 probe_valid: jax.Array, lo, counts, cum, out_cap: int,
                  total: Optional[int] = None):
     """-> (probe_idx, build_idx, verified, probe_matched, build_matched)
 
     probe_idx/build_idx: (out_cap,) gather indices for candidate pairs;
     verified: lane-equality check per pair; probe_matched: per probe row;
-    build_matched: per build row (for right/full outer)."""
+    build_matched: per build row (for right/full outer).
+
+    Pair ownership (which probe row owns output slot i) is recovered by
+    scattering each live probe row's index at its range start and
+    cummax-ing forward — O(n) scatter+scan instead of a binary search
+    per output slot (the log2(n) dependent gathers of searchsorted are
+    the slowest access pattern on TPU)."""
+    # exact candidate ranges (single lane or dense domain) need no
+    # per-pair verification against collisions, and probe_matched is just
+    # counts>0 — skip one of the two segment reductions
+    exact = len(build.lanes) == 1
     sig = ("expand", build.capacity, probe_valid.shape[0], out_cap,
-           len(probe_lanes))
+           len(probe_lanes), exact)
     fn = _PROBE_CACHE.get(sig)
     if fn is None:
         pcap = probe_valid.shape[0]
         bcap = build.capacity
 
-        def run(perm, b_lanes, b_key_valid, p_lanes, p_valid, lo_, cum_,
-                total):
+        def run(perm, b_lanes, b_key_valid, p_lanes, p_valid, lo_,
+                counts_, cum_, total):
             i = jnp.arange(out_cap, dtype=jnp.int32)
             pair_live = i < total
-            probe_idx = jnp.searchsorted(cum_, i, side="right"
-                                         ).astype(jnp.int32)
-            probe_idx = jnp.minimum(probe_idx, pcap - 1)
-            base = jnp.where(probe_idx > 0,
-                             jnp.take(cum_, jnp.maximum(probe_idx - 1, 0)), 0)
-            off = i - base.astype(jnp.int32)
+            starts = (cum_ - counts_).astype(jnp.int32)
+            tgt = jnp.where(counts_ > 0, starts, out_cap)
+            rowmark = jnp.full((out_cap,), -1, jnp.int32).at[tgt].max(
+                jnp.arange(pcap, dtype=jnp.int32), mode="drop")
+            probe_idx = jnp.maximum(
+                jax.lax.cummax(rowmark), 0).astype(jnp.int32)
+            off = i - jnp.take(starts, probe_idx)
             pos = jnp.take(lo_, probe_idx) + off
             pos = jnp.clip(pos, 0, bcap - 1)
             build_idx = jnp.take(perm, pos)
-            # verify true key equality (kills hash collisions)
             ok = pair_live
-            for bl, pl in zip(b_lanes, p_lanes):
-                ok = ok & (jnp.take(bl, build_idx) ==
-                           jnp.take(pl, probe_idx))
-            ok = ok & jnp.take(p_valid, probe_idx) & \
-                jnp.take(b_key_valid, build_idx)
-            probe_matched = jax.ops.segment_max(
-                ok.astype(jnp.int32), probe_idx, num_segments=pcap) > 0
+            if exact:
+                ok = ok & jnp.take(p_valid, probe_idx)
+                probe_matched = p_valid & (counts_ > 0)
+            else:
+                # verify true key equality (kills hash collisions)
+                for bl, pl in zip(b_lanes, p_lanes):
+                    ok = ok & (jnp.take(bl, build_idx) ==
+                               jnp.take(pl, probe_idx))
+                ok = ok & jnp.take(p_valid, probe_idx) & \
+                    jnp.take(b_key_valid, build_idx)
+                probe_matched = jax.ops.segment_max(
+                    ok.astype(jnp.int32), probe_idx,
+                    num_segments=pcap, indices_are_sorted=True) > 0
             build_matched = jax.ops.segment_max(
                 ok.astype(jnp.int32), build_idx, num_segments=bcap) > 0
             return probe_idx, build_idx, ok, probe_matched, build_matched
@@ -343,4 +517,4 @@ def expand_pairs(build: BuildTable, probe_lanes: List[jax.Array],
                          f"capacity {out_cap}")
     total = jnp.int32(true_total)
     return fn(build.perm, tuple(build.lanes), build.key_valid,
-              tuple(probe_lanes), probe_valid, lo, cum, total)
+              tuple(probe_lanes), probe_valid, lo, counts, cum, total)

@@ -28,7 +28,8 @@ def row_ids(offsets: jax.Array, vcap: int) -> jax.Array:
     """Owning row index per values-lane slot; slots past the last live
     value map to the (invalid) final row id."""
     pos = jnp.arange(vcap, dtype=jnp.int32)
-    return (jnp.searchsorted(offsets, pos, side="right") - 1) \
+    from .search import searchsorted
+    return (searchsorted(offsets, pos, side="right") - 1) \
         .astype(jnp.int32)
 
 

@@ -262,7 +262,8 @@ _TRANSFORM_CACHE: dict = {}
 
 def _seg_ids(offsets: jax.Array, cap_b: int, n: int) -> jax.Array:
     pos = jnp.arange(cap_b, dtype=jnp.int32)
-    return jnp.clip(jnp.searchsorted(offsets[:n + 1], pos, side="right")
+    from .search import searchsorted
+    return jnp.clip(searchsorted(offsets[:n + 1], pos, side="right")
                     - 1, 0, n - 1).astype(jnp.int32)
 
 
@@ -273,7 +274,8 @@ def _pack_ranges(bytes_: jax.Array, lo: jax.Array, hi: jax.Array,
     out_offs = jnp.concatenate([jnp.zeros(1, jnp.int32),
                                 jnp.cumsum(lens).astype(jnp.int32)])
     j = jnp.arange(out_cap, dtype=jnp.int32)
-    ent = jnp.clip(jnp.searchsorted(out_offs, j, side="right") - 1,
+    from .search import searchsorted
+    ent = jnp.clip(searchsorted(out_offs, j, side="right") - 1,
                    0, lens.shape[0] - 1)
     src = jnp.take(lo, ent) + (j - jnp.take(out_offs, ent))
     live = j < out_offs[-1]

@@ -98,7 +98,8 @@ def wall_table(tz_name: str) -> Tuple[np.ndarray, np.ndarray]:
 def utc_to_local(ts_us: jax.Array, points: jax.Array,
                  offsets: jax.Array) -> jax.Array:
     """Local wall-clock micros for UTC instants (vectorized)."""
-    idx = jnp.clip(jnp.searchsorted(points, ts_us, side="right") - 1,
+    from .search import searchsorted
+    idx = jnp.clip(searchsorted(points, ts_us, side="right") - 1,
                    0, points.shape[0] - 1)
     return ts_us + jnp.take(offsets, idx)
 
@@ -107,6 +108,7 @@ def local_to_utc(wall_us: jax.Array, wall_points: jax.Array,
                  offsets: jax.Array) -> jax.Array:
     """UTC instants for local wall-clock micros (earlier-offset rule for
     ambiguous walls; skipped walls shift forward by the gap)."""
-    idx = jnp.clip(jnp.searchsorted(wall_points, wall_us, side="right") - 1,
+    from .search import searchsorted
+    idx = jnp.clip(searchsorted(wall_points, wall_us, side="right") - 1,
                    0, wall_points.shape[0] - 1)
     return wall_us - jnp.take(offsets, idx)

@@ -297,7 +297,8 @@ def distributed_sort(mesh: Mesh, keys, vals, live, boundaries):
     b = jnp.asarray(np.asarray(boundaries, np.int64))
 
     def dest_fn(k, lv):
-        d = jnp.searchsorted(b, k, side="right").astype(jnp.int32)
+        from ..ops.search import searchsorted
+        d = searchsorted(b, k, side="right").astype(jnp.int32)
         return jnp.where(lv, d, 0)
     dest = jax.jit(dest_fn)(keys, live)
 
@@ -346,8 +347,9 @@ def co_partitioned_join_count(mesh: Mesh, lk, llive, rk, rlive):
         # is live by construction)
         rs = jnp.sort(jnp.where(rlv, rks, big))
         nlive = jnp.sum(rlv, dtype=jnp.int64)
-        lo = jnp.minimum(jnp.searchsorted(rs, lks, side="left"), nlive)
-        hi = jnp.minimum(jnp.searchsorted(rs, lks, side="right"), nlive)
+        from ..ops.search import searchsorted
+        lo = jnp.minimum(searchsorted(rs, lks, side="left"), nlive)
+        hi = jnp.minimum(searchsorted(rs, lks, side="right"), nlive)
         return jnp.sum(jnp.where(llv, hi - lo, 0),
                        dtype=jnp.int64)[None]
 

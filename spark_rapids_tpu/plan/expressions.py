@@ -895,7 +895,15 @@ class BinaryComparison(Expression):
         if not isinstance(self.children[0].dtype, t.StringType):
             common = self._common()
         if isinstance(common, t.DecimalType):
-            # exact row-wise python-decimal comparison oracle
+            # arrow compares decimal128 natively once both sides share a
+            # scale; rescaling to (38, common.scale) is exact unless a
+            # value's integer digits + common scale exceed 38 — only then
+            # fall back to the exact row-wise python-decimal oracle
+            try:
+                want = pa.decimal128(38, common.scale)
+                return self._op_cpu(l.cast(want), r.cast(want))
+            except pa.ArrowInvalid:
+                pass
             import decimal as pydec
             import operator as op
             fn = {"=": op.eq, "!=": op.ne, "<": op.lt, "<=": op.le,
@@ -903,7 +911,8 @@ class BinaryComparison(Expression):
             out = []
             for a, b in zip(l.to_pylist(), r.to_pylist()):
                 out.append(None if a is None or b is None
-                           else fn(pydec.Decimal(str(a)), pydec.Decimal(str(b))))
+                           else fn(pydec.Decimal(str(a)),
+                                   pydec.Decimal(str(b))))
             return pa.array(out, pa.bool_())
         if common is not None:
             l, r = _cpu_promote(l, common), _cpu_promote(r, common)

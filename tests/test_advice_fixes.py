@@ -110,7 +110,8 @@ class TestExpandPairsOverflow:
         lo, counts, cum, total = J.probe_counts(build, lanes, valid)
         assert total == 64
         with pytest.raises(ValueError, match="exceed"):
-            J.expand_pairs(build, lanes, valid, lo, cum, out_cap=32)
+            J.expand_pairs(build, lanes, valid, lo, counts, cum,
+                           out_cap=32)
 
 
 class TestStringJoinBuildHoist:
