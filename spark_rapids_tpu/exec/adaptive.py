@@ -153,9 +153,17 @@ class AdaptiveShuffledJoinExec(PlanNode):
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         left_stage: List[Spillable] = []
         right_stage: List[Spillable] = []
+        # Fuse upstream filters (HashJoinExec._peel_filters): stages hold
+        # the RAW child batches and the predicates ride into the join as
+        # probe/build masks — no mask compaction on either input.  Byte
+        # sizes below are therefore PRE-filter sizes; the build-side
+        # choice only shifts when filters are both selective and skewed
+        # between sides, and correctness never depends on it.
+        left_src, left_conds = HashJoinExec._peel_filters(self.left)
+        right_src, right_conds = HashJoinExec._peel_filters(self.right)
         try:
-            left_stage = self._materialize(self.left, ctx)
-            right_stage = self._materialize(self.right, ctx)
+            left_stage = self._materialize(left_src, ctx)
+            right_stage = self._materialize(right_src, ctx)
             lbytes = sum(sp._nbytes for sp in left_stage)
             rbytes = sum(sp._nbytes for sp in right_stage)
             ctx.metrics["adaptive_left_bytes"] = lbytes
@@ -180,7 +188,8 @@ class AdaptiveShuffledJoinExec(PlanNode):
                     _ReplayStage(right_stage,
                                  self.right.output_schema, self.right),
                     _ReplayStage(left_stage, self.left.output_schema,
-                                 self.left))
+                                 self.left),
+                    probe_conds=right_conds, build_conds=left_conds)
                 self._maybe_bloom(join, jt, left_stage,
                                   max(rbytes, 1), lbytes, ctx)
                 n_r = len(self.right.output_schema.fields)
@@ -195,7 +204,8 @@ class AdaptiveShuffledJoinExec(PlanNode):
                     _ReplayStage(left_stage, self.left.output_schema,
                                  self.left),
                     _ReplayStage(right_stage,
-                                 self.right.output_schema, self.right))
+                                 self.right.output_schema, self.right),
+                    probe_conds=left_conds, build_conds=right_conds)
                 self._maybe_bloom(join, self.join_type, right_stage,
                                   max(lbytes, 1), rbytes, ctx)
                 yield from join.execute(ctx)
@@ -220,6 +230,18 @@ class AdaptiveShuffledJoinExec(PlanNode):
             return
         if probe_bytes < build_bytes * ctx.conf.get(RUNTIME_FILTER_RATIO):
             return
+        from .join import key_ref_names
+        rn = key_ref_names(join.right_keys)
+        if rn is not None and len(rn) == 1 and \
+                key_ref_names(join.left_keys) is not None:
+            rng = join.right.column_range(rn[0])
+            build_rows = sum(sp.num_rows for sp in build_stage)
+            if rng is not None and HashJoinExec._span_fits(
+                    int(rng[1]) - int(rng[0]) + 1, max(build_rows, 1)):
+                # the join will probe a dense direct-address table (two
+                # gathers per batch) — a bloom pass costs a full probe
+                # compaction, more than it can save there
+                return
         from ..ops.bloom import (bloom_build, optimal_hashes,
                                  optimal_slots)
         build_rows = sum(sp.num_rows for sp in build_stage)
@@ -229,9 +251,15 @@ class AdaptiveShuffledJoinExec(PlanNode):
         bits = None
         for sp in build_stage:
             bb = sp.get()
+            # fused build filters must mask insertion, else the bloom
+            # keeps the keys the filter was meant to remove
+            live = None
+            if join.build_conds:
+                live = join._conds_mask(join.build_conds, bb,
+                                        bb.row_mask(), ctx)
             bits = bloom_build(
                 join._key_cols(bb, join.right_keys, raw_pos, ctx),
-                bb, m, k, bits)
+                bb, m, k, bits, live=live)
 
         def probe_keys(db):
             return join._key_cols(db, join.left_keys, raw_pos, ctx)

@@ -40,12 +40,15 @@ import jax
 import pyarrow as pa
 
 from .. import types as t
-from ..columnar.device import DeviceBatch, DeviceColumn, to_device
+from ..columnar.device import (DeviceBatch, DeviceColumn, bucket_capacity,
+                               to_device)
 from ..config import TpuConf
 from .plan import ExecContext, HostScanExec, PlanNode
 
 
-def _find_scans(root: PlanNode) -> List[HostScanExec]:
+def _find_scans(root: PlanNode) -> List[PlanNode]:
+    """Leaves whose batches become jit inputs: host scans (uploaded) and
+    device-resident split seams (already on device)."""
     out = []
     seen = set()
 
@@ -53,7 +56,7 @@ def _find_scans(root: PlanNode) -> List[HostScanExec]:
         if id(n) in seen:
             return
         seen.add(id(n))
-        if isinstance(n, HostScanExec):
+        if isinstance(n, (HostScanExec, DeviceResidentScanExec)):
             out.append(n)
         for c in n.children:
             walk(c)
@@ -190,6 +193,9 @@ class CompiledPlan:
                       ) -> List[Tuple[HostScanExec, List[DeviceBatch]]]:
         pairs = []
         for node in _find_scans(self.root):
+            if isinstance(node, DeviceResidentScanExec):
+                pairs.append((node, node.batches))   # already on device
+                continue
             cached = getattr(node, "_device_cache", None)
             if cached is None:
                 cached = _shared_scan_upload(node, ctx.conf)
@@ -301,6 +307,144 @@ _TRACE_FALLBACK_ERRORS = (
 )
 
 
+class DeviceResidentScanExec(PlanNode):
+    """Leaf standing in for an already-computed subplan's device output
+    (the split-plan seam).  Delegates plan statistics to the node it
+    replaces, so downstream fast paths (unique-build joins, dense
+    domains) survive the split."""
+
+    def __init__(self, source: PlanNode):
+        super().__init__()
+        self._source = source
+        self.batches: List[DeviceBatch] = []
+
+    @property
+    def output_schema(self):
+        return self._source.output_schema
+
+    def keys_unique(self, names):
+        return self._source.keys_unique(names)
+
+    def column_range(self, name):
+        return self._source.column_range(name)
+
+    def static_row_count(self):
+        if len(self.batches) == 1 and \
+                isinstance(self.batches[0].num_rows, int):
+            return self.batches[0].num_rows
+        return self._source.static_row_count()
+
+    def execute(self, ctx: ExecContext):
+        trace = getattr(self, "_trace_batches", None)
+        yield from (trace if trace is not None else self.batches)
+
+    def describe(self):
+        return f"DeviceResidentScan[{self._source.describe()}]"
+
+
+def _find_split_agg(root: PlanNode) -> Optional[PlanNode]:
+    """Topmost (pre-order-first) aggregate below the root, or None.
+
+    The aggregate is where a plan's live row count collapses (millions of
+    input rows, thousands of groups) while the static bucket capacity
+    does NOT — everything above it would run padded at the input scale.
+    Splitting there costs ONE host sync and re-buckets the tail to the
+    actual group count."""
+    from .plan import HashAggregateExec
+    if isinstance(root, HashAggregateExec):
+        return None                     # nothing above it to speed up
+
+    def walk(n: PlanNode):
+        for c in n.children:
+            if isinstance(c, HashAggregateExec):
+                return c
+            found = walk(c)
+            if found is not None:
+                return found
+        return None
+    return walk(root)
+
+
+def _slice_batch(db: DeviceBatch, cap: int, n: int) -> DeviceBatch:
+    """Narrow a live-prefix batch to a smaller capacity bucket."""
+    cols = []
+    for c in db.columns:
+        cols.append(DeviceColumn(
+            c.data[:cap], c.validity[:cap], c.dtype, c.dictionary,
+            None if c.data_hi is None else c.data_hi[:cap]))
+    return DeviceBatch(cols, n, db.names, db.origin_file)
+
+
+def _swap_child(root: PlanNode, old: PlanNode, new: PlanNode):
+    """(parent, index) of `old` under `root`; caller mutates + restores."""
+    for n in [root] + [d for d in _walk_nodes(root)]:
+        for i, c in enumerate(n.children):
+            if c is old:
+                return n, i
+    raise ValueError("split node not found under root")
+
+
+def _walk_nodes(n: PlanNode):
+    for c in n.children:
+        yield c
+        yield from _walk_nodes(c)
+
+
+class SplitCompiledPlan:
+    """Two-program whole-plan execution: head = everything up to the
+    topmost aggregate, tail = the rest re-bucketed to the aggregate's
+    ACTUAL output count (one host sync for the count, no data transfer —
+    the slice is a device op).
+
+    The reference never needs this: its kernels size outputs dynamically
+    per launch.  Static-shape XLA programs otherwise carry the input-
+    scale padding through every operator above the aggregate (a TPC-H
+    q3 tail — sort+limit over ~11k groups — was running at the 4M-row
+    lineitem bucket)."""
+
+    def __init__(self, root: PlanNode, agg: PlanNode, conf: TpuConf):
+        self.root = root
+        self.agg = agg
+        self.conf = conf
+        self.head = CompiledPlan(agg, conf)
+        self.leaf = DeviceResidentScanExec(agg)
+        self._parent_idx = _swap_child(root, agg, self.leaf)
+        self._tails: Dict[tuple, CompiledPlan] = {}
+
+    def collect(self, ctx: ExecContext) -> pa.Table:
+        outs = self.head.execute(ctx)
+        sliced = []
+        for db in outs:
+            if any(c.offsets is not None for c in db.columns):
+                raise _SplitUnsupported()   # ragged agg output
+            n = db.num_rows if isinstance(db.num_rows, int) \
+                else int(db.num_rows)       # ONE host sync per batch
+            cap = bucket_capacity(max(n, 1), ctx.conf)
+            if cap < db.capacity:
+                sliced.append(_slice_batch(db, cap, n))
+            else:   # still pin the now-known host count
+                sliced.append(DeviceBatch(db.columns, n, db.names,
+                                          db.origin_file))
+        key = tuple((db.capacity, db.num_rows) for db in sliced)
+        tail = self._tails.get(key)
+        if tail is None:
+            tail = CompiledPlan(self.root, ctx.conf)
+            self._tails[key] = tail
+        self.leaf.batches = sliced
+        parent, i = self._parent_idx
+        parent.children[i] = self.leaf
+        try:
+            out = tail.collect(ctx)
+        finally:
+            parent.children[i] = self.agg
+        ctx.bump("whole_plan_split_queries")
+        return out
+
+
+class _SplitUnsupported(Exception):
+    pass
+
+
 def session_mesh(conf: TpuConf):
     """The SPMD execution mesh for this conf, or None (disabled /
     single device)."""
@@ -325,9 +469,32 @@ def collect_with_fallback(root: PlanNode, ctx: ExecContext,
     if plan is False:                    # previously failed to trace
         return None
     if plan is None:
-        plan = CompiledPlan(root, ctx.conf, mesh=session_mesh(ctx.conf))
+        mesh = session_mesh(ctx.conf)
+        agg = None if mesh is not None else _find_split_agg(root)
+        plan = SplitCompiledPlan(root, agg, ctx.conf) if agg is not None \
+            else CompiledPlan(root, ctx.conf, mesh=mesh)
     try:
         out = plan.collect(ctx)
+    except _SplitUnsupported:
+        # e.g. ragged aggregate output: retry as one program, with the
+        # same fallback ladder (trace errors AND device OOM -> eager)
+        plan = CompiledPlan(root, ctx.conf)
+        try:
+            out = plan.collect(ctx)
+        except _TRACE_FALLBACK_ERRORS:
+            holder._compiled_plan = False
+            ctx.bump("whole_plan_fallbacks")
+            return None
+        except Exception as e:           # noqa: BLE001
+            from ..runtime.memory import is_oom_error
+            holder._compiled_plan = False
+            ctx.bump("whole_plan_fallbacks")
+            if is_oom_error(e):
+                return None              # eager engine has spill/retry
+            raise
+        holder._compiled_plan = plan
+        ctx.bump("whole_plan_compiled_queries")
+        return out
     except _TRACE_FALLBACK_ERRORS:
         holder._compiled_plan = False
         ctx.bump("whole_plan_fallbacks")

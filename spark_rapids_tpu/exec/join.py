@@ -102,12 +102,18 @@ class HashJoinExec(PlanNode):
 
     def __init__(self, join_type: str, left_keys: Sequence[E.Expression],
                  right_keys: Sequence[E.Expression],
-                 left: PlanNode, right: PlanNode):
+                 left: PlanNode, right: PlanNode,
+                 probe_conds: Optional[List[E.Expression]] = None,
+                 build_conds: Optional[List[E.Expression]] = None):
         super().__init__(left, right)
         self.join_type = join_type
         self.left_keys = [e.bind(left.output_schema) for e in left_keys]
         self.right_keys = [e.bind(right.output_schema) for e in right_keys]
         assert len(self.left_keys) == len(self.right_keys)
+        # pre-fused filter predicates (see _peel_filters): evaluated as
+        # masks on raw input batches instead of upstream compactions
+        self.probe_conds = list(probe_conds or [])
+        self.build_conds = list(build_conds or [])
         if join_type not in (INNER_TYPES := {J.INNER, J.LEFT_OUTER,
                                              J.RIGHT_OUTER, J.FULL_OUTER,
                                              J.LEFT_SEMI, J.LEFT_ANTI}):
@@ -257,16 +263,44 @@ class HashJoinExec(PlanNode):
             packed = part if packed is None else packed + part
         return packed
 
+    @staticmethod
+    def _peel_filters(node: PlanNode):
+        """Peel the chain of FilterExec children a join can fuse; returns
+        (batch source node, conditions outermost-last).  Mirrors
+        HashAggregateExec._strip_filters: the predicates become probe /
+        build liveness masks instead of upstream mask compactions (a TPU
+        compaction is an argsort + row gathers — far costlier than a
+        fused mask lane)."""
+        from .plan import FilterExec
+        conds: List[E.Expression] = []
+        while isinstance(node, FilterExec):
+            conds.append(node.condition)
+            node = node.child
+        conds.reverse()
+        return node, conds
+
+    @staticmethod
+    def _conds_mask(conds, db: DeviceBatch, base, ctx: ExecContext):
+        """AND the fused predicates into a row mask over `db`."""
+        from .evaluator import compute_predicate
+        for c in conds:
+            base = base & compute_predicate(c, db, ctx.conf)
+        return base
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        right_src, peeled = self._peel_filters(self.right)
+        build_conds = list(self.build_conds) + peeled
+        left_src, peeled = self._peel_filters(self.left)
+        probe_conds = list(self.probe_conds) + peeled
         # ---- build (right side), fully materialized ----
         # No per-batch row-count sync: empty batches are harmless (padding
         # only) and the sub-partition gate sizes by capacity, which bounds
         # rows from above without a D2H round trip.
-        right_batches = [db for db in self.right.execute(ctx)
+        right_batches = [db for db in right_src.execute(ctx)
                          if db.capacity > 0 and not
                          (isinstance(db.num_rows, int) and db.num_rows == 0)]
         if not right_batches:
-            yield from self._empty_build_output(ctx)
+            yield from self._empty_build_output(left_src, probe_conds, ctx)
             return
 
         from ..config import HASH_SUBPARTITION_FALLBACK
@@ -279,18 +313,21 @@ class HashJoinExec(PlanNode):
             # union of bucket joins is the join.
             build_rows = sum(int(b.num_rows) for b in right_batches)
             if build_rows > 2 * ctx.conf.batch_size_rows:
-                yield from self._sub_partition_join(right_batches, ctx)
+                yield from self._sub_partition_join(
+                    right_batches, left_src, build_conds, probe_conds, ctx)
                 return
             right_batches = [b for b in right_batches if int(b.num_rows)]
             if not right_batches:
-                yield from self._empty_build_output(ctx)
+                yield from self._empty_build_output(left_src, probe_conds,
+                                                    ctx)
                 return
 
         build_batch = concat_batches(right_batches, ctx.conf)
-        yield from self._join_stream(build_batch, self.left.execute(ctx),
-                                     ctx)
+        yield from self._join_stream(build_batch, left_src.execute(ctx),
+                                     ctx, build_conds, probe_conds)
 
-    def _sub_partition_join(self, right_batches, ctx: ExecContext
+    def _sub_partition_join(self, right_batches, left_src, build_conds,
+                            probe_conds, ctx: ExecContext
                             ) -> Iterator[DeviceBatch]:
         from ..runtime.memory import Spillable
         conf = ctx.conf
@@ -302,10 +339,12 @@ class HashJoinExec(PlanNode):
 
         raw_pos = self._raw_key_positions()
 
-        def scatter(db, exprs, buckets):
+        def scatter(db, exprs, conds, buckets):
             keys = self._key_cols(db, exprs, raw_pos, ctx)
             ids = _join_partition_ids(keys, db, k)
-            live = db.row_mask()
+            # fused filters apply here — bucket batches are post-filter,
+            # so the bucket joins run with no conds
+            live = self._conds_mask(conds, db, db.row_mask(), ctx)
             for p in range(k):
                 part = compact_batch(db, (ids == p) & live, ctx.conf)
                 from ..ops.batch_ops import shrink_to_rows
@@ -317,11 +356,11 @@ class HashJoinExec(PlanNode):
         probe_parts = [[] for _ in range(k)]
         try:
             for db in right_batches:
-                scatter(db, self.right_keys, build_parts)
-            for db in self.left.execute(ctx):
+                scatter(db, self.right_keys, build_conds, build_parts)
+            for db in left_src.execute(ctx):
                 if int(db.num_rows) == 0:
                     continue
-                scatter(db, self.left_keys, probe_parts)
+                scatter(db, self.left_keys, probe_conds, probe_parts)
 
             for p in range(k):
                 bl, pl = build_parts[p], probe_parts[p]
@@ -357,10 +396,15 @@ class HashJoinExec(PlanNode):
                     sp.close()
 
     def _join_stream(self, build_batch: DeviceBatch, probe_iter,
-                     ctx: ExecContext) -> Iterator[DeviceBatch]:
+                     ctx: ExecContext, build_conds=(), probe_conds=()
+                     ) -> Iterator[DeviceBatch]:
         raw_pos = self._raw_key_positions()
         build_keys = self._key_cols(build_batch, self.right_keys, raw_pos,
                                     ctx)
+        # fused build-side filters: rows failing them never match and
+        # never surface as outer-unmatched
+        build_pre = self._conds_mask(build_conds, build_batch,
+                                     build_batch.row_mask(), ctx)
         # String build keys: dedupe their dictionaries ONCE; probe batches
         # remap into the build code space (-1 for strings the build side
         # never saw), so the build sort below happens once per join, not
@@ -388,7 +432,8 @@ class HashJoinExec(PlanNode):
         if domain is not None:
             ctx.bump("join_dense_domain")
         build = J.BuildTable(build_batch, build_keys, build_lanes,
-                             domain=domain, unique=unique)
+                             domain=domain, unique=unique,
+                             extra_valid=build_pre if build_conds else None)
         out_names = list(self.output_schema.names)
         # Sync-free probe-aligned path: a build side whose keys are unique
         # (exact plan statistics — dimension scans, group-by outputs) makes
@@ -413,7 +458,11 @@ class HashJoinExec(PlanNode):
                         probe_keys[i], build_keys[i].dictionary)
             probe_lanes = [self._packed_lane(probe_keys, pack)] \
                 if pack is not None else J.key_cols_lanes(probe_keys)
-            probe_valid = pb.row_mask()
+            # fused probe-side filters: failing rows are dead for every
+            # join type (they don't match, and don't surface as outer
+            # unmatched rows either)
+            pre = self._conds_mask(probe_conds, pb, pb.row_mask(), ctx)
+            probe_valid = pre
             for c in probe_keys:
                 probe_valid = probe_valid & c.validity
 
@@ -434,7 +483,7 @@ class HashJoinExec(PlanNode):
                             build, probe_lanes, probe_valid, lo, counts,
                             cum, out_cap, total)
                 keep = matched if self.join_type == J.LEFT_SEMI \
-                    else pb.row_mask() & ~matched
+                    else pre & ~matched
                 out = compact_batch(pb, keep, ctx.conf)
                 yield DeviceBatch(out.columns, out.num_rows, out_names)
                 continue
@@ -451,17 +500,18 @@ class HashJoinExec(PlanNode):
                         .max(ok.astype(jnp.int32))
                     build_matched_acc = build_matched_acc | (hits > 0)
                 if self.join_type == J.LEFT_OUTER:
-                    # all probe rows survive; unmatched rows carry null
-                    # right columns (already null via the -1 gather)
-                    yield DeviceBatch(list(pb.columns) + rg.columns,
+                    # all (filter-surviving) probe rows survive; unmatched
+                    # rows carry null right columns (the -1 gather)
+                    out = DeviceBatch(list(pb.columns) + rg.columns,
                                       pb.num_rows, out_names)
+                    yield compact_batch(out, pre, ctx.conf) \
+                        if probe_conds else out
                 else:   # inner / right_outer / full_outer matched part
                     pairs = DeviceBatch(list(pb.columns) + rg.columns,
                                         pb.num_rows, out_names)
-                    yield compact_batch(pairs, ok & pb.row_mask(),
-                                        ctx.conf)
+                    yield compact_batch(pairs, ok & pre, ctx.conf)
                     if self.join_type == J.FULL_OUTER:
-                        unmatched = pb.row_mask() & ~ok
+                        unmatched = pre & ~ok
                         right_nulls = _null_columns(
                             self.right.output_schema, pb.capacity)
                         padded = DeviceBatch(
@@ -487,7 +537,7 @@ class HashJoinExec(PlanNode):
                 probe_matched = jnp.zeros((pb.capacity,), bool)
 
             if self.join_type in (J.LEFT_OUTER, J.FULL_OUTER):
-                unmatched = pb.row_mask() & ~probe_matched
+                unmatched = pre & ~probe_matched
                 left_cols = list(pb.columns)
                 right_nulls = _null_columns(self.right.output_schema,
                                             pb.capacity)
@@ -496,21 +546,24 @@ class HashJoinExec(PlanNode):
                 yield compact_batch(padded, unmatched, ctx.conf)
 
         if self.join_type in (J.RIGHT_OUTER, J.FULL_OUTER):
-            unmatched = build_batch.row_mask() & ~build_matched_acc
+            unmatched = build_pre & ~build_matched_acc
             left_nulls = _null_columns(self.left.output_schema,
                                        build_batch.capacity)
             padded = DeviceBatch(left_nulls + list(build_batch.columns),
                                  build_batch.num_rows, out_names)
             yield compact_batch(padded, unmatched, ctx.conf)
 
-    def _empty_build_output(self, ctx) -> Iterator[DeviceBatch]:
+    def _empty_build_output(self, left_src, probe_conds, ctx
+                            ) -> Iterator[DeviceBatch]:
         # top level: inner/semi/right-outer need not execute the probe
         # subtree at all (the pre-sub-partition short-circuit)
         if self.join_type in (J.INNER, J.LEFT_SEMI, J.RIGHT_OUTER):
             return
-        yield from self._empty_build_stream(self.left.execute(ctx), ctx)
+        yield from self._empty_build_stream(left_src.execute(ctx), ctx,
+                                            probe_conds)
 
-    def _empty_build_stream(self, probe_iter, ctx) -> Iterator[DeviceBatch]:
+    def _empty_build_stream(self, probe_iter, ctx, probe_conds=()
+                            ) -> Iterator[DeviceBatch]:
         """Empty build side: inner/semi/right produce nothing; left outer
         and anti pass probe rows through (right side null)."""
         if self.join_type in (J.INNER, J.LEFT_SEMI, J.RIGHT_OUTER):
@@ -521,6 +574,10 @@ class HashJoinExec(PlanNode):
         for pb in probe_iter:
             if int(pb.num_rows) == 0:
                 continue
+            if probe_conds:
+                pb = compact_batch(
+                    pb, self._conds_mask(probe_conds, pb, pb.row_mask(),
+                                         ctx), ctx.conf)
             if self.join_type == J.LEFT_ANTI:
                 yield DeviceBatch(pb.columns, pb.num_rows, out_names)
             else:   # left/full outer

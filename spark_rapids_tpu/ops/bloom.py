@@ -62,15 +62,21 @@ def _double_hashes(key_cols: Sequence[DeviceColumn], db: DeviceBatch,
 
 def bloom_build(key_cols: Sequence[DeviceColumn], db: DeviceBatch,
                 m_slots: int, k: int,
-                bits: jax.Array = None) -> jax.Array:
+                bits: jax.Array = None,
+                live: jax.Array = None) -> jax.Array:
     """Set the k slots of every row's key; pass `bits` to accumulate
-    over multiple build batches."""
+    over multiple build batches.  `live` masks rows out of insertion
+    (fused build-side filters): without it their keys would only widen
+    the filter (false positives stay sound), but the bloom loses exactly
+    the selectivity the build filter was supposed to give it."""
     if bits is None:
         bits = jnp.zeros((m_slots,), bool)
     h1, h2 = _double_hashes(key_cols, db, m_slots)
     for i in range(k):
         idx = (h1 + i * h2) % m_slots
-        bits = bits.at[idx].set(True)
+        if live is not None:
+            idx = jnp.where(live, idx, m_slots)
+        bits = bits.at[idx].set(True, mode="drop")
     return bits
 
 

@@ -194,11 +194,12 @@ class BuildTable:
     def __init__(self, batch: DeviceBatch, key_cols: Sequence[DeviceColumn],
                  lanes_override: Optional[List[jax.Array]] = None,
                  domain: Optional[Tuple[int, int]] = None,
-                 unique: bool = False):
+                 unique: bool = False,
+                 extra_valid: Optional[jax.Array] = None):
         self.batch = batch
         lanes = lanes_override if lanes_override is not None \
             else key_cols_lanes(key_cols)
-        valid = batch.row_mask()
+        valid = batch.row_mask() if extra_valid is None else extra_valid
         for c in key_cols:
             valid = valid & c.validity      # null keys never match
         self.lanes = lanes

@@ -58,15 +58,17 @@ def test_accumulate_over_batches():
     assert mask[:1000].all()
 
 
-def _join_tables(n_small=200, n_big=50_000):
+def _join_tables(n_small=200, n_big=50_000, key_span=1 << 40):
+    # key span too wide for a dense direct-address table, so the bloom
+    # runtime filter stays worthwhile (dense-eligible joins skip it)
     rng = np.random.default_rng(8)
     small = pa.table({
-        "sk": pa.array(rng.choice(1_000_000, n_small, replace=False),
+        "sk": pa.array(rng.choice(key_span, n_small, replace=False),
                        pa.int64()),
         "sv": pa.array(rng.standard_normal(n_small)),
     })
     big = pa.table({
-        "bk": pa.array(rng.integers(0, 1_000_000, n_big), pa.int64()),
+        "bk": pa.array(rng.integers(0, key_span, n_big), pa.int64()),
         "bv": pa.array(rng.integers(0, 99, n_big), pa.int64()),
     })
     return small, big
@@ -170,3 +172,23 @@ def test_zorder_string_and_timestamp_columns(tmp_path):
         dt_2 = DeltaTable(str(tmp_path / "t2"))
         dt_2.write(pa.table({"b": pa.array([[1]], pa.list_(pa.int64()))}))
         dt_2.optimize(zorder_by=["b"])
+
+
+def test_bloom_skipped_for_dense_domain_join():
+    """A join that will probe a dense direct-address table gets no bloom
+    stage: the bloom pass costs a full probe compaction, more than the
+    two-gather dense probe it would save (exec/adaptive.py)."""
+    small, big = _join_tables(key_span=1_000_000)   # dense-eligible
+    dev = TpuSession()
+    df = dev.from_arrow(big).join(dev.from_arrow(small),
+                                  left_on=["bk"], right_on=["sk"])
+    ctx = ExecContext(dev.conf)
+    out = df.physical().collect(ctx)
+    assert "bloom_filter_slots" not in ctx.metrics
+    assert ctx.metrics.get("join_dense_domain", 0) >= 1
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = DataFrame(df._plan, cpu).collect()
+    assert sorted(zip(out.column("bk").to_pylist(),
+                      out.column("sv").to_pylist())) == \
+        sorted(zip(exp.column("bk").to_pylist(),
+                   exp.column("sv").to_pylist()))

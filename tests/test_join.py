@@ -315,3 +315,59 @@ def test_empty_build_inner_join_skips_probe():
     assert out == []
     # probe subtree never executed (HostScanExec bumps scanned_rows)
     assert ctx.metrics.get("scanned_rows", 0) == 0
+
+
+@pytest.mark.parametrize("jt", [J.INNER, J.LEFT_OUTER, J.RIGHT_OUTER,
+                                J.FULL_OUTER, J.LEFT_SEMI, J.LEFT_ANTI])
+def test_fused_filter_children_match_unfused(jt):
+    """FilterExec children are peeled into probe/build masks
+    (exec/join.py _peel_filters) — results must be identical to running
+    the filters as standalone compactions."""
+    from spark_rapids_tpu.exec.plan import FilterExec
+    lt, rt = tables(seed=11)
+    lcond = E.GreaterThan(E.ColumnRef("lv"), E.Literal(40))
+    rcond = E.LessThan(E.ColumnRef("rv"), E.Literal(1500))
+
+    def build(fused: bool):
+        left = HostScanExec.from_table(lt, max_rows=128)
+        right = HostScanExec.from_table(rt, max_rows=128)
+        lf = FilterExec(lcond, left)
+        rf = FilterExec(rcond, right)
+        if fused:
+            return HashJoinExec(jt, [E.ColumnRef("lk")],
+                                [E.ColumnRef("rk")], lf, rf)
+        # reference: filter via compaction by collecting pre-filtered
+        # tables, then joining plain scans
+        import pyarrow.compute as pc
+        lt2 = lt.filter(pc.greater(lt["lv"], 40))
+        rt2 = rt.filter(pc.less(rt["rv"], 1500))
+        return HashJoinExec(jt, [E.ColumnRef("lk")], [E.ColumnRef("rk")],
+                            HostScanExec.from_table(lt2, max_rows=128),
+                            HostScanExec.from_table(rt2, max_rows=128))
+
+    got = build(True).collect()
+    want = build(False).collect()
+    assert as_sorted_rows(got) == as_sorted_rows(want)
+
+
+def test_fused_filter_sub_partition_path():
+    """Fused filters must also apply in the sub-partition fallback."""
+    from spark_rapids_tpu.exec.plan import FilterExec
+    from spark_rapids_tpu.config import TpuConf, BATCH_SIZE_ROWS
+    from spark_rapids_tpu.exec.plan import ExecContext
+    lt, rt = tables(n_left=3000, n_right=3000, nkeys=50, seed=13)
+    cond = E.GreaterThan(E.ColumnRef("rv"), E.Literal(5000))
+    plan = HashJoinExec(
+        "inner", [E.ColumnRef("lk")], [E.ColumnRef("rk")],
+        HostScanExec.from_table(lt, max_rows=256),
+        FilterExec(cond, HostScanExec.from_table(rt, max_rows=256)))
+    ctx = ExecContext(TpuConf({BATCH_SIZE_ROWS.key: "512"}))
+    got = plan.collect(ctx)
+    assert ctx.metrics.get("join_subpartition_fallbacks", 0) >= 1
+    import pyarrow.compute as pc
+    rt2 = rt.filter(pc.greater(rt["rv"], 5000))
+    want = HashJoinExec(
+        "inner", [E.ColumnRef("lk")], [E.ColumnRef("rk")],
+        HostScanExec.from_table(lt, max_rows=256),
+        HostScanExec.from_table(rt2, max_rows=256)).collect()
+    assert as_sorted_rows(got) == as_sorted_rows(want)
