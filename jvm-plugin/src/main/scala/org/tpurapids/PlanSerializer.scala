@@ -172,8 +172,16 @@ object PlanSerializer {
           Json.arr(exprJson(c), exprJson(v)) }: _*),
         "else" -> cw.elseValue.map(exprJson).getOrElse(Json.nul))
     case ss: Substring =>
-      Json.obj("e" -> Json.s("Substring"), "child" -> exprJson(ss.str),
-        "pos" -> exprJson(ss.pos), "length" -> exprJson(ss.len))
+      // the worker decodes pos/length as plain JSON numbers
+      // (protocol.py expr_from_json "Substring"), not expression objects
+      (ss.pos, ss.len) match {
+        case (Literal(p, _: IntegralType), Literal(l, _: IntegralType))
+            if p != null && l != null =>
+          Json.obj("e" -> Json.s("Substring"), "child" -> exprJson(ss.str),
+            "pos" -> Json.i(p.toString.toLong),
+            "length" -> Json.i(l.toString.toLong))
+        case _ => bail("Substring pos/length must be integer literals")
+      }
     case sw: StartsWith =>
       needleJson("StartsWith", sw.left, sw.right)
     case ew: EndsWith => needleJson("EndsWith", ew.left, ew.right)
@@ -221,6 +229,10 @@ object PlanSerializer {
     case _ if lit.value == null => Json.nul
     case StringType => Json.s(lit.value.toString)
     case BooleanType => Json.b(lit.value.asInstanceOf[Boolean])
+    case _: DecimalType =>
+      // exact decimal transport (protocol.py: {"decimal": "<str>"});
+      // a double here would silently round 38-digit values
+      Json.obj("decimal" -> Json.s(lit.value.toString))
     case _: IntegralType => Json.i(lit.value.toString.toLong)
     case _: FractionalType => Json.d(lit.value.toString.toDouble)
     case DateType => Json.i(lit.value.toString.toLong)  // days since epoch

@@ -231,11 +231,14 @@ class AdaptiveShuffledJoinExec(PlanNode):
         if probe_bytes < build_bytes * ctx.conf.get(RUNTIME_FILTER_RATIO):
             return
         from .join import key_ref_names
+        build_rows = sum(sp.num_rows for sp in build_stage)
         rn = key_ref_names(join.right_keys)
         if rn is not None and len(rn) == 1 and \
-                key_ref_names(join.left_keys) is not None:
+                key_ref_names(join.left_keys) is not None and \
+                build_rows <= 2 * ctx.conf.batch_size_rows:
+            # (sub-partitioned builds never make one dense table, so the
+            # skip only applies on the single-batch path)
             rng = join.right.column_range(rn[0])
-            build_rows = sum(sp.num_rows for sp in build_stage)
             if rng is not None and HashJoinExec._span_fits(
                     int(rng[1]) - int(rng[0]) + 1, max(build_rows, 1)):
                 # the join will probe a dense direct-address table (two
@@ -244,7 +247,6 @@ class AdaptiveShuffledJoinExec(PlanNode):
                 return
         from ..ops.bloom import (bloom_build, optimal_hashes,
                                  optimal_slots)
-        build_rows = sum(sp.num_rows for sp in build_stage)
         m = optimal_slots(build_rows)
         k = optimal_hashes(build_rows, m)
         raw_pos = join._raw_key_positions()

@@ -37,6 +37,7 @@ import dataclasses
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import pyarrow as pa
 
 from .. import types as t
@@ -420,12 +421,13 @@ class SplitCompiledPlan:
             n = db.num_rows if isinstance(db.num_rows, int) \
                 else int(db.num_rows)       # ONE host sync per batch
             cap = bucket_capacity(max(n, 1), ctx.conf)
-            if cap < db.capacity:
-                sliced.append(_slice_batch(db, cap, n))
-            else:   # still pin the now-known host count
-                sliced.append(DeviceBatch(db.columns, n, db.names,
-                                          db.origin_file))
-        key = tuple((db.capacity, db.num_rows) for db in sliced)
+            cap = min(cap, db.capacity)
+            # num_rows stays a device scalar so the tail trace is keyed
+            # on the CAPACITY BUCKET only — a drifting group count
+            # (growing table, streaming appends) reuses the compiled
+            # tail instead of recompiling per exact count
+            sliced.append(_slice_batch(db, cap, jnp.int32(n)))
+        key = tuple(db.capacity for db in sliced)
         tail = self._tails.get(key)
         if tail is None:
             tail = CompiledPlan(self.root, ctx.conf)

@@ -60,15 +60,20 @@ def test_accumulate_over_batches():
 
 def _join_tables(n_small=200, n_big=50_000, key_span=1 << 40):
     # key span too wide for a dense direct-address table, so the bloom
-    # runtime filter stays worthwhile (dense-eligible joins skip it)
+    # runtime filter stays worthwhile (dense-eligible joins skip it).
+    # ~10% of big rows reuse small-side keys so the matched-row path
+    # through the bloom stage is genuinely exercised, not vacuous.
     rng = np.random.default_rng(8)
+    sk = rng.choice(key_span, n_small, replace=False)
+    bk = rng.integers(0, key_span, n_big)
+    hits = rng.random(n_big) < 0.1
+    bk[hits] = rng.choice(sk, hits.sum())
     small = pa.table({
-        "sk": pa.array(rng.choice(key_span, n_small, replace=False),
-                       pa.int64()),
+        "sk": pa.array(sk, pa.int64()),
         "sv": pa.array(rng.standard_normal(n_small)),
     })
     big = pa.table({
-        "bk": pa.array(rng.integers(0, key_span, n_big), pa.int64()),
+        "bk": pa.array(bk, pa.int64()),
         "bv": pa.array(rng.integers(0, 99, n_big), pa.int64()),
     })
     return small, big
