@@ -343,27 +343,41 @@ class DeviceResidentScanExec(PlanNode):
         return f"DeviceResidentScan[{self._source.describe()}]"
 
 
-def _find_split_agg(root: PlanNode) -> Optional[PlanNode]:
-    """Topmost (pre-order-first) aggregate below the root, or None.
+def _find_split_seams(root: PlanNode) -> List[PlanNode]:
+    """Innermost-first seam nodes where live row counts collapse but
+    static bucket capacities do not:
 
-    The aggregate is where a plan's live row count collapses (millions of
-    input rows, thousands of groups) while the static bucket capacity
-    does NOT — everything above it would run padded at the input scale.
-    Splitting there costs ONE host sync and re-buckets the tail to the
-    actual group count."""
-    from .plan import HashAggregateExec
-    if isinstance(root, HashAggregateExec):
-        return None                     # nothing above it to speed up
+      1. the input of the topmost aggregate (after its fused-filter
+         chain) when it is real work (a join subtree, not a bare scan) —
+         selective joins + fused filters typically leave a small
+         fraction of the input bucket live;
+      2. the topmost aggregate itself — millions of rows in, thousands
+         of groups out.
 
-    def walk(n: PlanNode):
+    Each seam costs one host count sync and re-buckets everything above
+    it to actual sizes."""
+    from .plan import FilterExec, HashAggregateExec, HostScanExec
+
+    def find_agg(n: PlanNode):
         for c in n.children:
             if isinstance(c, HashAggregateExec):
                 return c
-            found = walk(c)
+            found = find_agg(c)
             if found is not None:
                 return found
         return None
-    return walk(root)
+
+    agg = None if isinstance(root, HashAggregateExec) else find_agg(root)
+    if agg is None:
+        return []
+    seams: List[PlanNode] = []
+    source = agg.child
+    while isinstance(source, FilterExec):
+        source = source.child
+    if not isinstance(source, (HostScanExec, DeviceResidentScanExec)):
+        seams.append(source)
+    seams.append(agg)
+    return seams
 
 
 def _slice_batch(db: DeviceBatch, cap: int, n: int) -> DeviceBatch:
@@ -392,53 +406,76 @@ def _walk_nodes(n: PlanNode):
 
 
 class SplitCompiledPlan:
-    """Two-program whole-plan execution: head = everything up to the
-    topmost aggregate, tail = the rest re-bucketed to the aggregate's
-    ACTUAL output count (one host sync for the count, no data transfer —
-    the slice is a device op).
+    """Segmented whole-plan execution: the plan splits at seam nodes
+    where the live row count collapses (join subtrees under aggregates,
+    the aggregates themselves — _find_split_seams).  Each segment runs
+    as one XLA program; at every seam ONE host sync reads the actual
+    row count and the seam output re-buckets down (a device slice, no
+    data transfer) before the next segment compiles over the smaller
+    shapes.
 
     The reference never needs this: its kernels size outputs dynamically
     per launch.  Static-shape XLA programs otherwise carry the input-
-    scale padding through every operator above the aggregate (a TPC-H
-    q3 tail — sort+limit over ~11k groups — was running at the 4M-row
-    lineitem bucket)."""
+    scale padding through every downstream operator (a TPC-H q3 tail —
+    sort+limit over ~11k groups — was running at the 4M-row lineitem
+    bucket, and its group-by over ~540k join survivors likewise)."""
 
-    def __init__(self, root: PlanNode, agg: PlanNode, conf: TpuConf):
+    def __init__(self, root: PlanNode, seams: List[PlanNode],
+                 conf: TpuConf):
         self.root = root
-        self.agg = agg
         self.conf = conf
-        self.head = CompiledPlan(agg, conf)
-        self.leaf = DeviceResidentScanExec(agg)
-        self._parent_idx = _swap_child(root, agg, self.leaf)
-        self._tails: Dict[tuple, CompiledPlan] = {}
+        self.seams = list(seams)            # innermost-first
+        self.leaves = [DeviceResidentScanExec(s) for s in self.seams]
+        self._parent_idx = []
+        scope = list(self.seams[1:]) + [root]
+        for seam, leaf, upper in zip(self.seams, self.leaves, scope):
+            self._parent_idx.append(_swap_child(upper, seam, leaf))
+        # compiled programs per (segment, input-capacity key)
+        self._programs: List[Dict[tuple, CompiledPlan]] = \
+            [{} for _ in range(len(self.seams) + 1)]
 
-    def collect(self, ctx: ExecContext) -> pa.Table:
-        outs = self.head.execute(ctx)
+    def _segment(self, i: int, key: tuple, ctx) -> CompiledPlan:
+        progs = self._programs[i]
+        plan = progs.get(key)
+        if plan is None:
+            seg_root = self.seams[i] if i < len(self.seams) else self.root
+            plan = CompiledPlan(seg_root, ctx.conf)
+            progs[key] = plan
+        return plan
+
+    @staticmethod
+    def _shrink(outs: List[DeviceBatch], ctx) -> List[DeviceBatch]:
         sliced = []
         for db in outs:
             if any(c.offsets is not None for c in db.columns):
-                raise _SplitUnsupported()   # ragged agg output
+                raise _SplitUnsupported()   # ragged seam output
             n = db.num_rows if isinstance(db.num_rows, int) \
                 else int(db.num_rows)       # ONE host sync per batch
-            cap = bucket_capacity(max(n, 1), ctx.conf)
-            cap = min(cap, db.capacity)
-            # num_rows stays a device scalar so the tail trace is keyed
-            # on the CAPACITY BUCKET only — a drifting group count
-            # (growing table, streaming appends) reuses the compiled
-            # tail instead of recompiling per exact count
+            cap = min(bucket_capacity(max(n, 1), ctx.conf), db.capacity)
+            # num_rows stays a device scalar so segment traces are keyed
+            # on the CAPACITY BUCKET only — a drifting row count
+            # (growing table, streaming appends) reuses compiled
+            # programs instead of recompiling per exact count
             sliced.append(_slice_batch(db, cap, jnp.int32(n)))
-        key = tuple(db.capacity for db in sliced)
-        tail = self._tails.get(key)
-        if tail is None:
-            tail = CompiledPlan(self.root, ctx.conf)
-            self._tails[key] = tail
-        self.leaf.batches = sliced
-        parent, i = self._parent_idx
-        parent.children[i] = self.leaf
+        return sliced
+
+    def collect(self, ctx: ExecContext) -> pa.Table:
+        mutated = []
         try:
-            out = tail.collect(ctx)
+            key: tuple = ()
+            for i, (leaf, (parent, ci)) in enumerate(
+                    zip(self.leaves, self._parent_idx)):
+                seg = self._segment(i, key, ctx)
+                outs = seg.execute(ctx)
+                sliced = self._shrink(outs, ctx)
+                leaf.batches = sliced
+                parent.children[ci] = leaf
+                mutated.append((parent, ci, self.seams[i]))
+                key = tuple(db.capacity for db in sliced)
+            out = self._segment(len(self.seams), key, ctx).collect(ctx)
         finally:
-            parent.children[i] = self.agg
+            for parent, ci, orig in mutated:
+                parent.children[ci] = orig
         ctx.bump("whole_plan_split_queries")
         return out
 
@@ -472,8 +509,8 @@ def collect_with_fallback(root: PlanNode, ctx: ExecContext,
         return None
     if plan is None:
         mesh = session_mesh(ctx.conf)
-        agg = None if mesh is not None else _find_split_agg(root)
-        plan = SplitCompiledPlan(root, agg, ctx.conf) if agg is not None \
+        seams = [] if mesh is not None else _find_split_seams(root)
+        plan = SplitCompiledPlan(root, seams, ctx.conf) if seams \
             else CompiledPlan(root, ctx.conf, mesh=mesh)
     try:
         out = plan.collect(ctx)
