@@ -76,14 +76,77 @@ def _dense_domains(key_cols) -> "Optional[List[int]]":
     return sizes
 
 
+_PACK_BUDGET = 1 << 62
+
+
+def _key_pack_spec(key_cols: List[DeviceColumn],
+                   key_ranges) -> "Optional[tuple]":
+    """Per-key (lo, span) for keys with exact static bounds — plan range
+    statistics (exec layer) or deduped dictionary domains — greedily
+    until the span product budget; None unless >=2 keys pack (one packed
+    lane must actually replace lanes to pay for itself)."""
+    spec: List[Optional[Tuple[int, int]]] = []
+    total = 1
+    packed = 0
+    for i, c in enumerate(key_cols):
+        rng = key_ranges[i] if key_ranges is not None else None
+        entry = None
+        if isinstance(c.dtype, t.StringType):
+            if c.dictionary is not None:
+                # pow2-quantized span: the jit signature must not churn
+                # with every per-batch dictionary size (a span only
+                # needs to be >= the real domain)
+                span = max(len(c.dictionary), 1) + 1
+                entry = (0, 1 << (span - 1).bit_length())
+        elif isinstance(c.dtype, t.DoubleType) or \
+                isinstance(c.dtype, t.FloatType):
+            entry = None
+        elif rng is not None:
+            lo, hi = int(rng[0]), int(rng[1])
+            entry = (lo, hi - lo + 2)
+        elif isinstance(c.dtype, t.BooleanType):
+            entry = (0, 3)
+        if entry is not None and total * entry[1] <= _PACK_BUDGET:
+            total *= entry[1]
+            packed += 1
+            spec.append(entry)
+        else:
+            spec.append(None)
+    return tuple(spec) if packed >= 2 else None
+
+
+def _fused_pack_spec(key_exprs, key_ranges) -> "Optional[tuple]":
+    """Pack spec for the fused map-side path: plan ranges only (string
+    dictionaries are per-batch host values there)."""
+    spec: List[Optional[Tuple[int, int]]] = []
+    total = 1
+    packed = 0
+    for e, rng in zip(key_exprs, key_ranges or []):
+        entry = None
+        if rng is not None and not isinstance(
+                e.dtype, (t.DoubleType, t.FloatType, t.StringType)):
+            lo, hi = int(rng[0]), int(rng[1])
+            entry = (lo, hi - lo + 2)
+        if entry is not None and total * entry[1] <= _PACK_BUDGET:
+            total *= entry[1]
+            packed += 1
+            spec.append(entry)
+        else:
+            spec.append(None)
+    return tuple(spec) if packed >= 2 else None
+
+
 def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
-                 specs: List[G.AggSpec], live, capacity: int):
+                 specs: List[G.AggSpec], live, capacity: int,
+                 key_ranges=None):
     key_cols = [ensure_unique_dict(c) for c in key_cols]
     info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
     domains = _dense_domains(key_cols)
+    pack = None if domains is not None \
+        else _key_pack_spec(key_cols, key_ranges)
     sig = (info, tuple((s.kind, s.input_idx, s.dtype) for s in specs),
            capacity, tuple(str(c.data.dtype) for c in agg_cols),
-           tuple(domains) if domains else None)
+           tuple(domains) if domains else None, pack)
     fn = _GROUPBY_CACHE.get(sig)
     if fn is None:
         if domains is not None:
@@ -91,7 +154,7 @@ def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
                                                capacity))
         else:
             fn = jax.jit(G.groupby_trace(list(info), list(specs), capacity,
-                                         capacity))
+                                         capacity, pack_spec=pack))
         _GROUPBY_CACHE[sig] = fn
     out_keys, outs, num_groups = fn(
         tuple(c.data for c in key_cols),
@@ -145,11 +208,15 @@ class HashAggregate:
     def __init__(self, key_exprs: Sequence[E.Expression],
                  key_names: Sequence[str],
                  aggs: Sequence[Tuple[AggregateFunction, str]],
-                 conf: TpuConf):
+                 conf: TpuConf, key_ranges=None):
         self.key_exprs = list(key_exprs)
         self.key_names = list(key_names)
         self.aggs = list(aggs)
         self.conf = conf
+        # exact (lo, hi) per key from plan statistics (or None) — lets
+        # the group-by pack bounded keys into one sort lane
+        self.key_ranges = list(key_ranges) if key_ranges is not None \
+            else [None] * len(self.key_exprs)
         check_agg_buffers_supported(self.aggs)
         # flatten buffers
         self.update_specs: List[G.AggSpec] = []
@@ -195,7 +262,8 @@ class HashAggregate:
             outs = _run_reduce(agg_cols, self.update_specs, live, db.capacity)
             return self._reduce_outs_to_batch(outs)
         key_cols, out_keys, outs, n_groups = _run_groupby(
-            key_batch.columns, agg_cols, self.update_specs, live, db.capacity)
+            key_batch.columns, agg_cols, self.update_specs, live,
+            db.capacity, key_ranges=self.key_ranges)
         return self._groupby_outs_to_batch(key_cols, out_keys, outs, n_groups)
 
     def can_fuse_filter(self, db: "Optional[DeviceBatch]" = None) -> bool:
@@ -267,10 +335,14 @@ class HashAggregate:
         dense_domains = self._fused_dense_domains(db) \
             if any(isinstance(e.dtype, (t.StringType, t.BooleanType))
                    for e in self.key_exprs) else None
+        pack = None
+        if dense_domains is None:
+            pack = _fused_pack_spec(self.key_exprs, self.key_ranges)
         key = _jit_key(exprs_all, db, aux, self.conf,
                        ("fpartial", spec_sig, len(conds),
                         len(self.key_exprs),
-                        tuple(dense_domains) if dense_domains else None))
+                        tuple(dense_domains) if dense_domains else None,
+                        pack))
         fn = _JIT_CACHE.get(key)
         if fn is None:
             capacity = db.capacity
@@ -313,7 +385,8 @@ class HashAggregate:
                     gb = G.dense_groupby_trace(list(dense_domains), specs,
                                                capacity)
                 else:
-                    gb = G.groupby_trace(kinfo, specs, capacity, capacity)
+                    gb = G.groupby_trace(kinfo, specs, capacity, capacity,
+                                         pack_spec=pack)
                 return gb(tuple(kds), tuple(kvs), tuple(agg_data),
                           tuple(agg_valid), live)
 
@@ -406,7 +479,7 @@ class HashAggregate:
             return self._reduce_outs_to_batch(outs)
         key_cols, out_keys, outs, n_groups = _run_groupby(
             key_cols, buf_cols, self.merge_specs, merged.row_mask(),
-            merged.capacity)
+            merged.capacity, key_ranges=self.key_ranges)
         return self._groupby_outs_to_batch(key_cols, out_keys, outs, n_groups)
 
     def final(self, merged: DeviceBatch) -> DeviceBatch:

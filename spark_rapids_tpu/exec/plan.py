@@ -427,10 +427,21 @@ class HashAggregateExec(PlanNode):
             conds.reverse()
         return source, conds
 
+    def _key_ranges(self):
+        """Exact (lo, hi) per group key from plan statistics (plain
+        column refs only) — unlocks packed-lane group-by sorts."""
+        from .join import key_ref_names
+        out = []
+        for e in self.key_exprs:
+            ref = key_ref_names([e])
+            out.append(None if ref is None
+                       else self.child.column_range(ref[0]))
+        return out
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from ..config import AGG_FALLBACK_PARTITIONS
         agg = HashAggregate(self.key_exprs, self.key_names, self.aggs,
-                            ctx.conf)
+                            ctx.conf, key_ranges=self._key_ranges())
         # Fuse upstream filters into the map side for EVERY aggregation:
         # the predicates become the groupby's live-mask, so filter +
         # projections + update aggregation run with no mask compaction

@@ -154,3 +154,23 @@ def filter_values(col: DeviceColumn, keep_vals: jax.Array, num_rows
                         elem_valid=jnp.take(col.elem_valid, order) & (
                             jnp.arange(vcap, dtype=jnp.int32)
                             < new_off[-1]))
+
+def map_element_at(keys: DeviceColumn, values: DeviceColumn, needle,
+                  num_rows) -> Tuple[jax.Array, jax.Array]:
+    """element_at(map, key) over a shattered map (two ragged lanes with
+    identical offsets — plan/structs.py): per row, the value whose key
+    slot equals `needle`, null when absent or the map is null.  Spark
+    map construction keeps the LAST duplicate key, so ties resolve to
+    the highest matching slot (segment_max over slot index)."""
+    vcap = keys.value_capacity
+    rid = row_ids(keys.offsets, vcap)
+    live = value_live(keys.offsets, vcap, num_rows)
+    hit = (keys.data == needle) & keys.elem_valid & live
+    slot = jnp.where(hit, jnp.arange(vcap, dtype=jnp.int32),
+                     jnp.int32(-1))
+    best = jax.ops.segment_max(slot, rid, num_segments=keys.capacity)
+    found = best >= 0
+    safe = jnp.clip(best, 0, values.value_capacity - 1)
+    data = jnp.take(values.data, safe)
+    valid = keys.validity & found & jnp.take(values.elem_valid, safe)
+    return data, valid

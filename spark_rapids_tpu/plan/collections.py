@@ -557,3 +557,218 @@ class ArrayForAll(ArrayExists):
     null; else true — the _hit/_default inversion of exists."""
     _default = True
     _hit = False
+
+
+# ---------------------------------------------------------------------------
+# STRUCT / MAP expressions (reference complexTypeExtractors.scala,
+# complexTypeCreator.scala, collectionOperations.scala map family).
+#
+# TPU-first placement: structs and maps have no direct device lanes;
+# plan/structs.py SHATTERS eligible columns at the scan into flat
+# per-field lanes (struct) / two shared-offset ragged lanes (map) and
+# rewrites these expressions away, so the device program only ever sees
+# flat and ragged columns.  Instances that survive to placement (an
+# unshatterable input) evaluate on the CPU path like the array family.
+# ---------------------------------------------------------------------------
+
+
+class GetStructField(ArrayExpression):
+    """s.field — Spark GetStructField: null struct -> null field."""
+
+    def __init__(self, child: Expression, field: str):
+        self.children = (child,)
+        self.field = field
+
+    def _resolve(self):
+        st = self.children[0].dtype
+        if not isinstance(st, t.StructType):
+            raise TypeError(f"getField over {st.simple_string}")
+        self.dtype = st.fields[st.field_index(self.field)].data_type
+        self.nullable = True
+
+    def _fp_extra(self):
+        return self.field
+
+    def _eval_cpu(self, rb, kids):
+        arr = kids[0]
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        return pc.struct_field(arr, self.field)
+
+
+class CreateNamedStruct(ArrayExpression):
+    """named_struct(...) / struct(...) — also the re-nest expression the
+    shatter pass emits at the plan top: `valid` (when given) is a bool
+    expression carrying struct-level nullability."""
+
+    def __init__(self, names, exprs, valid: Optional[Expression] = None):
+        self.names = list(names)
+        self.children = tuple(exprs) + ((valid,) if valid is not None
+                                        else ())
+        self.has_valid = valid is not None
+
+    def _resolve(self):
+        n = len(self.names)
+        fields = [t.StructField(nm, e.dtype, True)
+                  for nm, e in zip(self.names, self.children[:n])]
+        self.dtype = t.StructType(fields)
+        self.nullable = self.has_valid
+
+    def _fp_extra(self):
+        return ",".join(self.names) + f"|{self.has_valid}"
+
+    def _eval_cpu(self, rb, kids):
+        n = len(self.names)
+        from ..columnar.host import dtype_to_arrow
+        arrs = [k if isinstance(k, pa.Array) else k.combine_chunks()
+                for k in kids[:n]]
+        mask = None
+        if self.has_valid:
+            v = kids[n]
+            import numpy as np
+            mask = pa.array(~np.asarray(
+                v.fill_null(False).to_numpy(zero_copy_only=False),
+                dtype=bool))
+        return pa.StructArray.from_arrays(
+            arrs, self.names, mask=mask)
+
+
+class MapKeys(ArrayExpression):
+    """map_keys(m) -> array<K> in entry order."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def _resolve(self):
+        mt = self.children[0].dtype
+        self.dtype = t.ArrayType(mt.key_type)
+        self.nullable = True
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        out = [None if v is None else [k for k, _ in v]
+               for v in kids[0].to_pylist()]
+        return pa.array(out, pa.list_(dtype_to_arrow(
+            self.children[0].dtype.key_type)))
+
+
+class MapValues(ArrayExpression):
+    """map_values(m) -> array<V> in entry order."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def _resolve(self):
+        mt = self.children[0].dtype
+        self.dtype = t.ArrayType(mt.value_type)
+        self.nullable = True
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        out = [None if v is None else [val for _, val in v]
+               for v in kids[0].to_pylist()]
+        return pa.array(out, pa.list_(dtype_to_arrow(
+            self.children[0].dtype.value_type)))
+
+
+class MapElementAt(ArrayExpression):
+    """element_at(map, key) — Spark: missing key -> null (non-ANSI)."""
+
+    def __init__(self, child: Expression, key):
+        self.children = (child,)
+        self.key = key
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype.value_type
+        self.nullable = True
+
+    def _fp_extra(self):
+        return repr(self.key)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+            else:
+                out.append(dict(v).get(self.key))
+        return pa.array(out, dtype_to_arrow(self.dtype))
+
+
+class ShatteredMapElementAt(Expression):
+    """element_at over a SHATTERED map: children are the two ragged
+    lanes (keys array, values array) plan/structs.py maintains with
+    identical offsets.  Runs on device (ops/ragged.py map_element_at)."""
+
+    def __init__(self, keys_col: Expression, vals_col: Expression, key,
+                 value_type: t.DataType):
+        self.children = (keys_col, vals_col)
+        self.key = key
+        self.value_type = value_type
+
+    def _resolve(self):
+        self.dtype = self.value_type
+        self.nullable = True
+
+    def _fp_extra(self):
+        return repr(self.key)
+
+    def unsupported_reasons(self, conf):
+        if _ragged_child_ok(self.children[0]) and \
+                _ragged_child_ok(self.children[1]) and \
+                isinstance(self.key, (int, bool)):
+            return []
+        return [_OFF_DEVICE]
+
+    eval_dev = Expression.eval_dev
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops import ragged as R
+        kc = _as_ragged_col(kids[0])
+        vc = _as_ragged_col(kids[1])
+        needle = kc.data.dtype.type(self.key)
+        data, valid = R.map_element_at(kc, vc, needle, ctx.num_rows)
+        return DevVal(data, valid, self.dtype, kids[1].dictionary)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        out = []
+        for ks, vs in zip(kids[0].to_pylist(), kids[1].to_pylist()):
+            if ks is None or vs is None:
+                out.append(None)
+            else:
+                m = {k: v for k, v in zip(ks, vs)}
+                out.append(m.get(self.key))
+        return pa.array(out, dtype_to_arrow(self.dtype))
+
+
+class RenestMap(ArrayExpression):
+    """Rebuild a MAP column from its two shattered array lanes plus the
+    map-level validity lane (the collect-side inverse of the shatter)."""
+
+    def __init__(self, keys_col: Expression, vals_col: Expression,
+                 valid: Expression, map_type: t.MapType):
+        self.children = (keys_col, vals_col, valid)
+        self.map_type = map_type
+
+    def _resolve(self):
+        self.dtype = self.map_type
+        self.nullable = True
+
+    def _fp_extra(self):
+        return self.map_type.simple_string
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        valid = kids[2].to_pylist()
+        out = []
+        for ks, vs, ok in zip(kids[0].to_pylist(), kids[1].to_pylist(),
+                              valid):
+            if not ok or ks is None:
+                out.append(None)
+            else:
+                out.append(list(zip(ks, vs)))
+        return pa.array(out, pa.map_(
+            dtype_to_arrow(self.map_type.key_type),
+            dtype_to_arrow(self.map_type.value_type)))

@@ -1249,6 +1249,11 @@ def _walk(plan: L.LogicalPlan):
 def apply_overrides(plan: L.LogicalPlan,
                     conf: TpuConf = DEFAULT_CONF) -> PhysicalQuery:
     """wrapAndTagPlan + doConvertPlan + explain logging."""
+    if conf.sql_enabled:
+        # nested-type shatter only matters for device placement; the
+        # pure-CPU engine (oracle) keeps the original nested plan
+        from .structs import shatter_nested
+        plan = shatter_nested(plan)
     plan = prune_columns(plan)
     _push_down_filters(plan)
     if _plan_uses_input_file_name(plan):

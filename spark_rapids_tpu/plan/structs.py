@@ -1,0 +1,347 @@
+"""Nested-type shattering: STRUCT and MAP columns become flat device
+lanes at the scan, re-nesting at the plan top.
+
+Reference: the CUDA plugin carries nested cuDF DTypes end to end
+(GpuColumnVector.java nested type mapping; complexTypeExtractors.scala
+evaluates GetStructField on device columns).  XLA device lanes are flat,
+so the TPU-native equivalent is the classic columnar shatter:
+
+  struct s {a, b}  ->  "s#__v" (bool struct-validity), "s#a", "s#b"
+  map    m<K, V>   ->  "m#__v", "m#keys" ARRAY<K>, "m#vals" ARRAY<V>
+                       (two ragged lanes with identical offsets)
+
+and a rewrite of every struct/map expression into flat-lane form:
+GetStructField -> the field lane ref, map_keys/map_values -> the ragged
+lane refs, element_at -> the shattered-map device kernel, IsNull on the
+container -> the validity lane, whole-container projection / group-by
+keys -> lane expansion.  A final projection re-nests the surviving
+containers (CreateNamedStruct / RenestMap — CPU-side by placement, like
+every host boundary).
+
+Columns with uses the rewrite cannot express (join keys, aggregate
+inputs, nested containers) simply stay nested and follow the CPU path,
+per-operator, exactly as before — the pass is strictly opt-in per
+column (fixpoint exclusion loop).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import pyarrow as pa
+
+from .. import types as t
+from . import expressions as E
+from . import logical as L
+from .collections import (CreateNamedStruct, GetStructField, MapElementAt,
+                          MapKeys, MapValues, RenestMap,
+                          ShatteredMapElementAt, Size, _device_elem_ok)
+
+_KNOWN_NODES = (L.LogicalScan, L.LogicalProject, L.LogicalFilter,
+                L.LogicalAggregate, L.LogicalSort, L.LogicalLimit,
+                L.LogicalJoin)
+
+
+def _flat_ok(dt: t.DataType) -> bool:
+    return not isinstance(dt, (t.ArrayType, t.MapType, t.StructType,
+                               t.BinaryType))
+
+
+def _shatterable(dt: t.DataType) -> bool:
+    if isinstance(dt, t.StructType):
+        return len(dt.fields) > 0 and \
+            all(_flat_ok(f.data_type) for f in dt.fields)
+    if isinstance(dt, t.MapType):
+        return _device_elem_ok(dt.key_type) and \
+            _device_elem_ok(dt.value_type)
+    return False
+
+
+class _Abort(Exception):
+    """A use of `name` the rewrite cannot express in flat lanes."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+def _lane_names(name: str, dt: t.DataType) -> List[str]:
+    if isinstance(dt, t.StructType):
+        return [f"{name}#__v"] + [f"{name}#{f.name}" for f in dt.fields]
+    return [f"{name}#__v", f"{name}#keys", f"{name}#vals"]
+
+
+def _flatten_table(tbl: pa.Table, names: Set[str]) -> pa.Table:
+    import pyarrow.compute as pc
+    cols: List[pa.Array] = []
+    fields: List[pa.Field] = []
+    for f in tbl.schema:
+        col = tbl.column(f.name)
+        if f.name not in names:
+            cols.append(col)
+            fields.append(f)
+            continue
+        arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) \
+            else col
+        cols.append(pc.is_valid(arr))
+        fields.append(pa.field(f"{f.name}#__v", pa.bool_(), False))
+        if pa.types.is_struct(f.type):
+            for sub in f.type:
+                cols.append(pc.struct_field(arr, sub.name))
+                fields.append(pa.field(f"{f.name}#{sub.name}", sub.type))
+        else:                                    # map
+            off = arr.offsets
+            # carry the map's own null mask onto both ragged lanes, so
+            # null maps stay null arrays (and never leak phantom spans)
+            null_mask = pc.is_null(arr)
+            keys = pa.ListArray.from_arrays(off, arr.keys,
+                                            mask=null_mask)
+            vals = pa.ListArray.from_arrays(off, arr.items,
+                                            mask=null_mask)
+            cols.append(keys)
+            fields.append(pa.field(f"{f.name}#keys",
+                                   pa.list_(arr.type.key_type)))
+            cols.append(vals)
+            fields.append(pa.field(f"{f.name}#vals",
+                                   pa.list_(arr.type.item_type)))
+    return pa.table(cols, schema=pa.schema(fields))
+
+
+class _Shatterer:
+    """One rewrite attempt over a fixed set of excluded column names;
+    raises _Abort naming a column when a use cannot be expressed."""
+
+    def __init__(self, excluded: Set[str], scan_cols: Set[str]):
+        self.excluded = excluded
+        # only SCAN columns gain lanes; computed containers (e.g. a
+        # with_column CreateNamedStruct) must never rewrite to phantom
+        # lane refs — they stay nested and follow the CPU path
+        self.scan_cols = scan_cols
+
+    # -- expressions -------------------------------------------------------
+
+    def _nested_cols(self, schema: t.StructType) -> Dict[str, t.DataType]:
+        return {f.name: f.data_type for f in schema.fields
+                if _shatterable(f.data_type) and
+                f.name in self.scan_cols and
+                f.name not in self.excluded}
+
+    def expr(self, e: E.Expression, nested: Dict[str, t.DataType],
+             expand_ok: bool = False):
+        """Rewrite one expression; returns an expression OR (when
+        `expand_ok`, for projection lists) a list of (expr, name)."""
+        if isinstance(e, E.Alias):
+            inner = self.expr(e.children[0], nested, expand_ok)
+            if isinstance(inner, list):
+                raise _Abort(_ref_name(e.children[0]))
+            return E.Alias(inner, e.name)
+        if isinstance(e, E.ColumnRef):
+            if e.name in nested:
+                if not expand_ok:
+                    raise _Abort(e.name)
+                return [(E.ColumnRef(ln), ln)
+                        for ln in _lane_names(e.name, nested[e.name])]
+            return e
+        if isinstance(e, GetStructField):
+            child = e.children[0]
+            if isinstance(child, E.ColumnRef) and child.name in nested:
+                return E.ColumnRef(f"{child.name}#{e.field}")
+        if isinstance(e, (E.IsNull, E.IsNotNull)):
+            child = e.children[0]
+            if isinstance(child, E.ColumnRef) and child.name in nested:
+                v = E.ColumnRef(f"{child.name}#__v")
+                return E.Not(v) if isinstance(e, E.IsNull) else v
+        if isinstance(e, MapKeys):
+            child = e.children[0]
+            if isinstance(child, E.ColumnRef) and child.name in nested:
+                return E.ColumnRef(f"{child.name}#keys")
+        if isinstance(e, MapValues):
+            child = e.children[0]
+            if isinstance(child, E.ColumnRef) and child.name in nested:
+                return E.ColumnRef(f"{child.name}#vals")
+        if isinstance(e, MapElementAt):
+            child = e.children[0]
+            if isinstance(child, E.ColumnRef) and child.name in nested:
+                return ShatteredMapElementAt(
+                    E.ColumnRef(f"{child.name}#keys"),
+                    E.ColumnRef(f"{child.name}#vals"),
+                    e.key, nested[child.name].value_type)
+        if isinstance(e, Size):
+            child = e.children[0]
+            if isinstance(child, E.ColumnRef) and child.name in nested \
+                    and isinstance(nested[child.name], t.MapType):
+                return Size(E.ColumnRef(f"{child.name}#keys"))
+        # generic: rewrite children; any surviving whole-container ref
+        # below raises _Abort via the ColumnRef branch
+        kids = [self.expr(c, nested) for c in e.children]
+        if all(k is c for k, c in zip(kids, e.children)):
+            return e
+        return _with_children(e, kids)
+
+    # -- plans -------------------------------------------------------------
+
+    def plan(self, p: L.LogicalPlan) -> L.LogicalPlan:
+        nested = self._nested_cols(p.child.schema) if p.children else {}
+        if isinstance(p, L.LogicalScan):
+            names = set(self._nested_cols(p.schema))
+            if not names:
+                return p
+            return L.LogicalScan(_flatten_table(p.table, names))
+        if isinstance(p, L.LogicalProject):
+            child = self.plan(p.child)
+            exprs: List[E.Expression] = []
+            names: List[str] = []
+            for e, n in zip(p.exprs, p.names):
+                r = self.expr(e, nested, expand_ok=True)
+                if isinstance(r, list):
+                    for le, ln in r:
+                        exprs.append(le)
+                        names.append(ln)
+                else:
+                    exprs.append(r)
+                    names.append(n)
+            return L.LogicalProject(exprs, child, names)
+        if isinstance(p, L.LogicalFilter):
+            cond = self.expr(p.condition, nested)
+            return L.LogicalFilter(cond, self.plan(p.child))
+        if isinstance(p, L.LogicalAggregate):
+            keys: List[E.Expression] = []
+            key_names: List[str] = []
+            for k, kn in zip(p.keys, p.key_names):
+                r = self.expr(k, nested, expand_ok=True)
+                if isinstance(r, list):
+                    for le, ln in r:
+                        keys.append(le)
+                        key_names.append(ln)
+                else:
+                    keys.append(r)
+                    key_names.append(kn)
+            aggs = []
+            for fn, n in p.aggs:
+                import copy
+                if fn.child is not None:
+                    new_child = self.expr(fn.child, nested)
+                    if new_child is not fn.child:
+                        fn = copy.copy(fn)
+                        fn.child = new_child
+                c2 = getattr(fn, "child2", None)
+                if c2 is not None:
+                    new_c2 = self.expr(c2, nested)
+                    if new_c2 is not c2:
+                        fn = copy.copy(fn)
+                        fn.child2 = new_c2
+                aggs.append((fn, n))
+            return L.LogicalAggregate(keys, aggs, self.plan(p.child),
+                                      key_names=key_names)
+        if isinstance(p, L.LogicalSort):
+            orders = []
+            for e, asc, nf in p.orders:
+                r = self.expr(e, nested, expand_ok=True)
+                if isinstance(r, list):
+                    # struct sort = lexicographic by (validity, fields):
+                    # null struct sorts per nf; field nulls follow
+                    # Spark's interpreted struct ordering (null first
+                    # for asc)
+                    v, *lanes = [le for le, _ln in r]
+                    # validity ascending (False first) == nulls first
+                    orders.append((v, nf, True))
+                    for le in lanes:
+                        orders.append((le, asc, asc))
+                else:
+                    orders.append((r, asc, nf))
+            return L.LogicalSort(orders, self.plan(p.child),
+                                 p.global_sort)
+        if isinstance(p, L.LogicalLimit):
+            return L.LogicalLimit(p.limit, self.plan(p.child))
+        if isinstance(p, L.LogicalJoin):
+            lnested = self._nested_cols(p.left.schema)
+            rnested = self._nested_cols(p.right.schema)
+            # rewrites apply (a GetStructField key becomes its lane ref);
+            # a whole-container key raises _Abort via the ColumnRef branch
+            lk = [self.expr(k, lnested) for k in p.left_keys]
+            rk = [self.expr(k, rnested) for k in p.right_keys]
+            return L.LogicalJoin(p.join_type, self.plan(p.left),
+                                 self.plan(p.right), lk, rk,
+                                 broadcast=p.broadcast)
+        raise _Abort("")                    # unknown node (pre-checked)
+
+
+def _ref_name(e: E.Expression) -> str:
+    while isinstance(e, E.Alias):
+        e = e.children[0]
+    return e.name if isinstance(e, E.ColumnRef) else ""
+
+
+def _with_children(e: E.Expression, kids: List[E.Expression]):
+    import copy
+    out = copy.copy(e)
+    out.children = tuple(kids)
+    # drop resolution caches so dtype re-derives over new children
+    for attr in ("dtype", "nullable"):
+        if hasattr(out, attr):
+            try:
+                delattr(out, attr)
+            except AttributeError:
+                pass
+    return out
+
+
+def shatter_nested(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Entry point: returns the rewritten plan (original returned
+    untouched when nothing shatters)."""
+    def walk_ok(p) -> bool:
+        return isinstance(p, _KNOWN_NODES) and \
+            all(walk_ok(c) for c in p.children)
+
+    def scan_candidates(p, out):
+        if isinstance(p, L.LogicalScan):
+            for f in p.schema.fields:
+                if _shatterable(f.data_type):
+                    out.add(f.name)
+        for c in p.children:
+            scan_candidates(c, out)
+
+    candidates: Set[str] = set()
+    scan_candidates(plan, candidates)
+    if not candidates or not walk_ok(plan):
+        return plan
+
+    orig_schema = plan.schema
+    excluded: Set[str] = set()
+    while True:
+        sh = _Shatterer(excluded, candidates)
+        try:
+            new_plan = sh.plan(plan)
+            break
+        except _Abort as a:
+            if not a.name or a.name in excluded:
+                return plan               # cannot localize: bail out
+            excluded.add(a.name)
+            if excluded >= candidates:
+                return plan
+
+    # re-nest surviving containers at the top so the user-visible schema
+    # is unchanged
+    new_names = set(new_plan.schema.names)
+    exprs: List[E.Expression] = []
+    names: List[str] = []
+    changed = False
+    for f in orig_schema.fields:
+        dt = f.data_type
+        lanes = _lane_names(f.name, dt) if _shatterable(dt) else []
+        if lanes and all(ln in new_names for ln in lanes):
+            changed = True
+            if isinstance(dt, t.StructType):
+                exprs.append(CreateNamedStruct(
+                    [sf.name for sf in dt.fields],
+                    [E.ColumnRef(ln) for ln in lanes[1:]],
+                    valid=E.ColumnRef(lanes[0])))
+            else:
+                exprs.append(RenestMap(E.ColumnRef(lanes[1]),
+                                       E.ColumnRef(lanes[2]),
+                                       E.ColumnRef(lanes[0]), dt))
+            names.append(f.name)
+        else:
+            exprs.append(E.ColumnRef(f.name))
+            names.append(f.name)
+    if not changed:
+        return new_plan          # rewritten; nothing to re-nest
+    return L.LogicalProject(exprs, new_plan, names)

@@ -1,0 +1,268 @@
+"""STRUCT/MAP device support via nested-type shattering
+(plan/structs.py): struct project/filter/group-by-key, getField, map
+lanes and element_at all run device-side as flat/ragged lanes; results
+re-nest at collect and match the CPU engine running the ORIGINAL nested
+plan (oracle independence: the CPU session never shatters)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec.plan import ExecContext
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.collections import (GetStructField, MapElementAt,
+                                               MapKeys, MapValues, Size)
+from spark_rapids_tpu.plan.overrides import apply_overrides
+from spark_rapids_tpu.session import DataFrame, TpuSession
+
+RNG = np.random.default_rng(21)
+
+
+def _struct_table(n=400):
+    return pa.table({
+        "id": pa.array(np.arange(n), pa.int64()),
+        "s": pa.array([None if i % 11 == 0 else
+                       {"a": int(i % 7), "b": None if i % 5 == 0
+                        else float(i) / 2, "c": f"v{i % 3}"}
+                       for i in range(n)],
+                      pa.struct([("a", pa.int64()), ("b", pa.float64()),
+                                 ("c", pa.string())])),
+    })
+
+
+def _map_table(n=300):
+    def mk(i):
+        if i % 13 == 0:
+            return None
+        return [(int(k), int(i * 10 + k)) for k in range(i % 4)]
+    return pa.table({
+        "id": pa.array(np.arange(n), pa.int64()),
+        "m": pa.array([mk(i) for i in range(n)],
+                      pa.map_(pa.int64(), pa.int64())),
+    })
+
+
+def _run_both(df):
+    dev = df.collect()
+    cpu = DataFrame(df._plan, TpuSession(
+        {"spark.rapids.tpu.sql.enabled": "false"})).collect()
+    return dev, cpu
+
+
+def _device_kind(df):
+    q = apply_overrides(df._plan, df._session.conf)
+    return q.kind
+
+
+def test_struct_getfield_project_filter_device():
+    s = TpuSession()
+    tbl = _struct_table()
+    df = (s.from_arrow(tbl)
+          .with_column("a", GetStructField(E.ColumnRef("s"), "a"))
+          .filter(E.GreaterThan(GetStructField(E.ColumnRef("s"), "a"),
+                                E.Literal(2)))
+          .select("id", "a"))
+    assert _device_kind(df) == "device"
+    dev, cpu = _run_both(df)
+    assert dev.to_pydict() == cpu.to_pydict()
+    # independent oracle
+    exp = [(i, v["a"]) for i, v in zip(tbl.column("id").to_pylist(),
+                                      tbl.column("s").to_pylist())
+           if v is not None and v["a"] is not None and v["a"] > 2]
+    assert list(zip(dev.column("id").to_pylist(),
+                    dev.column("a").to_pylist())) == exp
+
+
+def test_struct_passthrough_renests():
+    s = TpuSession()
+    tbl = _struct_table()
+    df = s.from_arrow(tbl).filter(
+        E.LessThan(E.ColumnRef("id"), E.Literal(50)))
+    dev, cpu = _run_both(df)
+    assert dev.column("s").to_pylist() == cpu.column("s").to_pylist()
+    assert dev.column("s").to_pylist() == \
+        tbl.column("s").to_pylist()[:50]
+    assert dev.schema.field("s").type == tbl.schema.field("s").type
+
+
+def test_struct_isnull_and_groupby_key():
+    from spark_rapids_tpu.plan.aggregates import Count, Sum
+    s = TpuSession()
+    tbl = _struct_table()
+    df = (s.from_arrow(tbl)
+          .filter(E.IsNotNull(E.ColumnRef("s")))
+          .group_by(GetStructField(E.ColumnRef("s"), "a"))
+          .agg((Count(None), "n"))
+          .sort("col0"))
+    dev, cpu = _run_both(df)
+    assert dev.to_pydict() == cpu.to_pydict()
+
+
+def test_groupby_whole_struct_key():
+    from spark_rapids_tpu.plan.aggregates import Count
+    s = TpuSession()
+    n = 300
+    tbl = pa.table({
+        "id": pa.array(np.arange(n), pa.int64()),
+        "s": pa.array([None if i % 10 == 0 else
+                       {"a": int(i % 3), "b": int(i % 2)}
+                       for i in range(n)],
+                      pa.struct([("a", pa.int64()), ("b", pa.int64())])),
+    })
+    df = s.from_arrow(tbl).group_by("s").agg((Count(None), "n"))
+    # the pure-CPU engine cannot group by struct keys at all (pyarrow
+    # limitation) — shattering makes the DEVICE path strictly more
+    # capable; oracle is computed in python
+    dev = df.collect()
+    want = {}
+    for v in tbl.column("s").to_pylist():
+        k = None if v is None else (v["a"], v["b"])
+        want[k] = want.get(k, 0) + 1
+    got = {None if v is None else (v["a"], v["b"]): c
+           for v, c in zip(dev.column("s").to_pylist(),
+                           dev.column("n").to_pylist())}
+    assert got == want
+
+
+def test_sort_by_struct():
+    s = TpuSession()
+    n = 120
+    tbl = pa.table({
+        "id": pa.array(np.arange(n), pa.int64()),
+        "s": pa.array([None if i % 9 == 0 else
+                       {"a": int(RNG.integers(0, 5)),
+                        "b": int(RNG.integers(0, 5))}
+                       for i in range(n)],
+                      pa.struct([("a", pa.int64()), ("b", pa.int64())])),
+    })
+    df = s.from_arrow(tbl).sort("s", "id")
+    dev, _cpu = _run_both(df)
+    got = dev.column("s").to_pylist()
+    def key(v):
+        return (v is not None, (v["a"], v["b"]) if v else ())
+    assert got == sorted(tbl.column("s").to_pylist(), key=key)
+
+
+def test_struct_through_join():
+    s = TpuSession()
+    tbl = _struct_table(200)
+    dim = pa.table({"id": pa.array(np.arange(0, 200, 2), pa.int64()),
+                    "w": pa.array(np.arange(100), pa.int64())})
+    df = s.from_arrow(tbl).join(s.from_arrow(dim),
+                                left_on=["id"], right_on=["id"]) \
+        .select("id", "s", "w").sort("id")
+    # pyarrow acero cannot carry struct payloads through joins, so the
+    # pure-CPU engine has no answer here — python oracle
+    dev = df.collect()
+    svals = {i: v for i, v in zip(tbl.column("id").to_pylist(),
+                                  tbl.column("s").to_pylist())}
+    ids = sorted(set(svals) & set(dim.column("id").to_pylist()))
+    assert dev.column("id").to_pylist() == ids
+    assert dev.column("s").to_pylist() == [svals[i] for i in ids]
+
+
+def test_map_lanes_device():
+    s = TpuSession()
+    tbl = _map_table()
+    df = (s.from_arrow(tbl)
+          .with_column("ks", MapKeys(E.ColumnRef("m")))
+          .with_column("vs", MapValues(E.ColumnRef("m")))
+          .with_column("n", Size(MapKeys(E.ColumnRef("m"))))
+          .with_column("at1", MapElementAt(E.ColumnRef("m"), 1))
+          .select("id", "ks", "vs", "n", "at1"))
+    dev, cpu = _run_both(df)
+    assert dev.to_pydict() == cpu.to_pydict()
+    # independent oracle for element_at
+    exp = []
+    for v in tbl.column("m").to_pylist():
+        exp.append(None if v is None else dict(v).get(1))
+    assert dev.column("at1").to_pylist() == exp
+
+
+def test_map_element_at_runs_on_device():
+    s = TpuSession()
+    tbl = _map_table()
+    df = (s.from_arrow(tbl)
+          .with_column("at1", MapElementAt(E.ColumnRef("m"), 1))
+          .select("id", "at1")
+          .filter(E.IsNotNull(E.ColumnRef("at1"))))
+    assert _device_kind(df) == "device"
+    dev, cpu = _run_both(df)
+    assert dev.to_pydict() == cpu.to_pydict()
+
+
+def test_map_passthrough_renests():
+    s = TpuSession()
+    tbl = _map_table()
+    df = s.from_arrow(tbl).filter(
+        E.LessThan(E.ColumnRef("id"), E.Literal(40)))
+    dev, cpu = _run_both(df)
+    assert dev.column("m").to_pylist() == cpu.column("m").to_pylist()
+    assert dev.column("m").to_pylist() == \
+        tbl.column("m").to_pylist()[:40]
+
+
+def test_unshatterable_struct_still_works_on_cpu_path():
+    # struct with an ARRAY field: not shatterable — rides the CPU path
+    s = TpuSession()
+    n = 60
+    tbl = pa.table({
+        "id": pa.array(np.arange(n), pa.int64()),
+        "s": pa.array([{"a": int(i), "xs": list(range(i % 3))}
+                       for i in range(n)],
+                      pa.struct([("a", pa.int64()),
+                                 ("xs", pa.list_(pa.int64()))])),
+    })
+    df = s.from_arrow(tbl).filter(
+        E.LessThan(E.ColumnRef("id"), E.Literal(10)))
+    dev, cpu = _run_both(df)
+    assert dev.column("s").to_pylist() == cpu.column("s").to_pylist()
+
+
+def test_computed_struct_not_rewritten():
+    """A with_column CreateNamedStruct is NOT lane-backed — field access
+    over it must fall back (CPU path), never rewrite to phantom lanes."""
+    from spark_rapids_tpu.plan.collections import CreateNamedStruct
+    s = TpuSession()
+    tbl = pa.table({"id": pa.array(np.arange(20), pa.int64())})
+    df = (s.from_arrow(tbl)
+          .with_column("t", CreateNamedStruct(["x"], [E.ColumnRef("id")]))
+          .with_column("y", GetStructField(E.ColumnRef("t"), "x"))
+          .select("id", "y"))
+    out = df.collect()
+    assert out.column("y").to_pylist() == list(range(20))
+
+
+def test_struct_field_join_key():
+    s = TpuSession()
+    tbl = _struct_table(100)
+    dim = pa.table({"a": pa.array(np.arange(7), pa.int64()),
+                    "label": pa.array([f"L{i}" for i in range(7)])})
+    df = s.from_arrow(tbl).join(
+        s.from_arrow(dim),
+        left_on=[GetStructField(E.ColumnRef("s"), "a")],
+        right_on=["a"]).select("id", "label").sort("id")
+    dev = df.collect()
+    exp = [(i, f"L{v['a']}")
+           for i, v in zip(tbl.column("id").to_pylist(),
+                           tbl.column("s").to_pylist()) if v is not None]
+    assert list(zip(dev.column("id").to_pylist(),
+                    dev.column("label").to_pylist())) == exp
+
+
+def test_struct_field_in_binary_stat_agg():
+    from spark_rapids_tpu.plan.aggregates import Corr
+    s = TpuSession()
+    n = 200
+    tbl = pa.table({
+        "g": pa.array(np.zeros(n, np.int64)),
+        "s": pa.array([{"a": int(i), "b": float(i) * 2 + 1}
+                       for i in range(n)],
+                      pa.struct([("a", pa.int64()), ("b", pa.float64())])),
+    })
+    df = s.from_arrow(tbl).group_by("g").agg(
+        (Corr(GetStructField(E.ColumnRef("s"), "a"),
+              GetStructField(E.ColumnRef("s"), "b")), "c"))
+    out = df.collect()
+    assert abs(out.column("c").to_pylist()[0] - 1.0) < 1e-9
