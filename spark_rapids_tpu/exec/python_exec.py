@@ -212,3 +212,194 @@ class ArrowEvalPythonExec(HostNode):
     def describe(self):
         names = [n for _f, _c, n, _t in self.udfs]
         return f"ArrowEvalPythonExec[{', '.join(names)}]"
+
+
+def _group_frames(table: pa.Table, key_names: Sequence[str]):
+    """pandas.DataFrame per group, null keys grouped together (pyspark
+    applyInPandas contract).  Host-side segmentation: this exec IS the
+    host boundary (the worker speaks pandas), so the reference's
+    device-side segmentation hop has nothing to win here."""
+    df = table.to_pandas()
+    if not key_names:
+        yield df
+        return
+    for _key_vals, g in df.groupby(list(key_names), dropna=False,
+                                   sort=True):
+        yield g
+
+
+class _GroupedPandasExec(HostNode):
+    """Shared scaffold for the grouped pandas exec family: materialize
+    the child, segment by keys, run `apply` over per-group frames in the
+    worker."""
+
+    _group_names: Sequence[str] = ()
+
+    def _run_grouped(self, ctx: ExecContext, apply
+                     ) -> Iterator[pa.RecordBatch]:
+        batches = [rb for rb in self.child.execute(ctx) if rb.num_rows]
+        if not batches:
+            return
+        table = pa.Table.from_batches(batches)
+        source = _FrameSource(_group_frames(table, self._group_names),
+                              self.child.output_schema)
+        inner = MapInPandasExec(apply, self.output_schema, source)
+        yield from inner.execute(ctx)
+
+
+class FlatMapGroupsInPandasExec(_GroupedPandasExec):
+    """groupBy(keys).applyInPandas(fn, schema) — fn maps each group's
+    pandas.DataFrame to a result DataFrame (reference
+    GpuFlatMapGroupsInPandasExec)."""
+
+    def __init__(self, key_names: Sequence[str], fn: Callable,
+                 schema: t.StructType, child: HostNode):
+        super().__init__(child)
+        self.key_names = list(key_names)
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def _group_names(self):
+        return self.key_names
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        user_fn = self.fn
+
+        def apply(frames):
+            for df in frames:
+                yield user_fn(df.reset_index(drop=True))
+
+        yield from self._run_grouped(ctx, apply)
+
+    def describe(self):
+        return (f"FlatMapGroupsInPandasExec[{self.key_names}, "
+                f"{getattr(self.fn, '__name__', 'fn')}]")
+
+
+class AggregateInPandasExec(_GroupedPandasExec):
+    """groupBy(keys).agg(pandas UDAF): each agg fn maps the group's
+    input Series to ONE scalar; output = key columns + one column per
+    agg, one row per group (reference GpuAggregateInPandasExec).
+
+    aggs: [(fn, input column names, output name, output type)]."""
+
+    def __init__(self, key_names: Sequence[str],
+                 aggs: Sequence[Tuple[Callable, Sequence[str], str,
+                                      t.DataType]],
+                 child: HostNode):
+        super().__init__(child)
+        self.key_names = list(key_names)
+        self.aggs = list(aggs)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        schema = self.child.output_schema
+        fields = [schema.fields[schema.field_index(n)]
+                  for n in self.key_names]
+        for _fn, _cols, name, dt in self.aggs:
+            fields.append(t.StructField(name, dt, True))
+        return t.StructType(fields)
+
+    @property
+    def _group_names(self):
+        return self.key_names
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        key_names = list(self.key_names)
+        aggs = list(self.aggs)
+
+        def apply(frames):
+            import pandas as pd
+            for df in frames:
+                row = {n: [df[n].iloc[0]] for n in key_names}
+                for fn, in_cols, name, _dt in aggs:
+                    row[name] = [fn(*[df[c] for c in in_cols])]
+                yield pd.DataFrame(row)
+
+        yield from self._run_grouped(ctx, apply)
+
+    def describe(self):
+        return (f"AggregateInPandasExec[{self.key_names}, "
+                f"{[n for _f, _c, n, _t in self.aggs]}]")
+
+
+class WindowInPandasExec(_GroupedPandasExec):
+    """Pandas window UDFs over unbounded partition frames: each fn maps
+    the partition's input Series to either a Series of the partition's
+    length or one scalar (broadcast) — the two shapes the reference's
+    GpuWindowInPandasExec supports for UNBOUNDED PRECEDING/FOLLOWING.
+
+    windows: [(fn, input column names, output name, output type)];
+    output = child columns + one per window fn, rows ordered by
+    (partition keys, order keys)."""
+
+    def __init__(self, partition_names: Sequence[str],
+                 order_names: Sequence[str],
+                 windows: Sequence[Tuple[Callable, Sequence[str], str,
+                                         t.DataType]],
+                 child: HostNode):
+        super().__init__(child)
+        self.partition_names = list(partition_names)
+        self.order_names = list(order_names)
+        self.windows = list(windows)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        fields = list(self.child.output_schema.fields)
+        for _fn, _cols, name, dt in self.windows:
+            fields.append(t.StructField(name, dt, True))
+        return t.StructType(fields)
+
+    @property
+    def _group_names(self):
+        return self.partition_names
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        order = list(self.order_names)
+        windows = list(self.windows)
+
+        def apply(frames):
+            import pandas as pd
+            for df in frames:
+                if order:
+                    df = df.sort_values(order, kind="stable")
+                df = df.reset_index(drop=True)
+                cols = {n: df[n] for n in df.columns}
+                for fn, in_cols, name, _dt in windows:
+                    out = fn(*[df[c] for c in in_cols])
+                    if not isinstance(out, pd.Series):
+                        out = pd.Series([out] * len(df))
+                    cols[name] = out.reset_index(drop=True)
+                yield pd.DataFrame(cols)
+
+        yield from self._run_grouped(ctx, apply)
+
+    def describe(self):
+        return (f"WindowInPandasExec[{self.partition_names}, "
+                f"{[n for _f, _c, n, _t in self.windows]}]")
+
+
+class _FrameSource(HostNode):
+    """Adapter: a python iterator of pandas group frames as a HostNode
+    child for MapInPandasExec (each frame = one worker batch = one
+    group)."""
+
+    def __init__(self, frames, schema: t.StructType):
+        super().__init__()
+        self._frames = frames
+        self._schema = schema
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        arrow_schema = struct_to_schema(self._schema)
+        for df in self._frames:
+            yield pa.RecordBatch.from_pandas(df, schema=arrow_schema,
+                                             preserve_index=False)

@@ -619,7 +619,6 @@ class CreateNamedStruct(ArrayExpression):
 
     def _eval_cpu(self, rb, kids):
         n = len(self.names)
-        from ..columnar.host import dtype_to_arrow
         arrs = [k if isinstance(k, pa.Array) else k.combine_chunks()
                 for k in kids[:n]]
         mask = None

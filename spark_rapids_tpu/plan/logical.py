@@ -357,3 +357,63 @@ class LogicalGenerate(LogicalPlan):
 
     def describe(self):
         return f"Generate[{self.generator!r}]"
+
+
+class LogicalFlatMapGroupsInPandas(LogicalPlan):
+    """groupBy(keys).applyInPandas(fn, schema) — reference
+    GpuFlatMapGroupsInPandasExec."""
+
+    def __init__(self, key_names, fn, schema, child: LogicalPlan):
+        super().__init__(child)
+        self.key_names = list(key_names)
+        self.fn = fn
+        self.result_schema = schema
+
+    def _resolve_schema(self):
+        return self.result_schema
+
+    def describe(self):
+        return (f"FlatMapGroupsInPandas[{self.key_names}, "
+                f"{getattr(self.fn, '__name__', 'fn')}]")
+
+
+class LogicalAggregateInPandas(LogicalPlan):
+    """groupBy(keys).agg(pandas UDAFs) — reference
+    GpuAggregateInPandasExec.  aggs: (fn, in_cols, name, dtype)."""
+
+    def __init__(self, key_names, aggs, child: LogicalPlan):
+        super().__init__(child)
+        self.key_names = list(key_names)
+        self.aggs = list(aggs)
+
+    def _resolve_schema(self):
+        schema = self.child.schema
+        fields = [schema.fields[schema.field_index(n)]
+                  for n in self.key_names]
+        for _fn, _cols, name, dt in self.aggs:
+            fields.append(t.StructField(name, dt, True))
+        return t.StructType(fields)
+
+    def describe(self):
+        return f"AggregateInPandas[{[n for _f, _c, n, _t in self.aggs]}]"
+
+
+class LogicalWindowInPandas(LogicalPlan):
+    """Pandas window UDFs over unbounded partition frames — reference
+    GpuWindowInPandasExec.  windows: (fn, in_cols, name, dtype)."""
+
+    def __init__(self, partition_names, order_names, windows,
+                 child: LogicalPlan):
+        super().__init__(child)
+        self.partition_names = list(partition_names)
+        self.order_names = list(order_names)
+        self.windows = list(windows)
+
+    def _resolve_schema(self):
+        fields = list(self.child.schema.fields)
+        for _fn, _cols, name, dt in self.windows:
+            fields.append(t.StructField(name, dt, True))
+        return t.StructType(fields)
+
+    def describe(self):
+        return f"WindowInPandas[{[n for _f, _c, n, _t in self.windows]}]"

@@ -253,6 +253,12 @@ exec_rule(L.LogicalMapInPandas, t.T.ALL,
           "mapInPandas via forked Arrow-IPC python workers")
 exec_rule(L.LogicalArrowEvalPython, t.T.ALL,
           "scalar pandas UDFs via forked Arrow-IPC python workers")
+exec_rule(L.LogicalFlatMapGroupsInPandas, t.T.ALL,
+          "applyInPandas via group-segmented python workers")
+exec_rule(L.LogicalAggregateInPandas, t.T.ALL,
+          "grouped pandas UDAFs via group-segmented python workers")
+exec_rule(L.LogicalWindowInPandas, t.T.ALL,
+          "pandas window UDFs via partition-segmented python workers")
 exec_rule(L.LogicalFilter, _DEVICE_SIMPLE, "filter")
 exec_rule(L.LogicalAggregate, _COMMON, "hash aggregate")
 exec_rule(L.LogicalSort, t.T.ORDERABLE, "sort")
@@ -864,6 +870,44 @@ class ArrowEvalPythonMeta(PlanMeta):
         return ArrowEvalPythonExec(self.node.udfs, self._host_child())
 
 
+class FlatMapGroupsInPandasMeta(PlanMeta):
+    def tag_self(self):
+        self.will_not_work(
+            "pandas UDFs execute in a python worker process "
+            "(host Arrow boundary; GpuFlatMapGroupsInPandasExec role)")
+
+    def to_host(self):
+        from ..exec.python_exec import FlatMapGroupsInPandasExec
+        return FlatMapGroupsInPandasExec(
+            self.node.key_names, self.node.fn, self.node.result_schema,
+            self._host_child())
+
+
+class AggregateInPandasMeta(PlanMeta):
+    def tag_self(self):
+        self.will_not_work(
+            "pandas UDFs execute in a python worker process "
+            "(host Arrow boundary; GpuAggregateInPandasExec role)")
+
+    def to_host(self):
+        from ..exec.python_exec import AggregateInPandasExec
+        return AggregateInPandasExec(self.node.key_names, self.node.aggs,
+                                     self._host_child())
+
+
+class WindowInPandasMeta(PlanMeta):
+    def tag_self(self):
+        self.will_not_work(
+            "pandas UDFs execute in a python worker process "
+            "(host Arrow boundary; GpuWindowInPandasExec role)")
+
+    def to_host(self):
+        from ..exec.python_exec import WindowInPandasExec
+        return WindowInPandasExec(self.node.partition_names,
+                                  self.node.order_names,
+                                  self.node.windows, self._host_child())
+
+
 class GenerateMeta(PlanMeta):
     """LogicalGenerate: explode/posexplode runs ON DEVICE over ragged
     values+offsets lanes (exec/generate.py — GpuGenerateExec.scala:829
@@ -952,6 +996,9 @@ _META_FOR: Dict[type, Type[PlanMeta]] = {
     L.LogicalGenerate: GenerateMeta,
     L.LogicalMapInPandas: MapInPandasMeta,
     L.LogicalArrowEvalPython: ArrowEvalPythonMeta,
+    L.LogicalFlatMapGroupsInPandas: FlatMapGroupsInPandasMeta,
+    L.LogicalAggregateInPandas: AggregateInPandasMeta,
+    L.LogicalWindowInPandas: WindowInPandasMeta,
     LogicalCache: CacheMeta,
     LogicalParquetScan: ParquetScanMeta,
     LogicalCsvScan: TextScanMeta,

@@ -93,6 +93,43 @@ class GroupedData:
             L.LogicalAggregate(self._keys, list(aggs), self._df._plan),
             self._df._session)
 
+    def _key_names(self) -> list:
+        names = []
+        for k in self._keys:
+            if isinstance(k, str):
+                names.append(k)
+            elif isinstance(k, E.Alias):
+                names.append(k.name)
+            elif isinstance(k, E.ColumnRef):
+                names.append(k.name)
+            else:
+                raise TypeError(
+                    "pandas group operations need plain column keys")
+        return names
+
+    def apply_in_pandas(self, fn, schema) -> "DataFrame":
+        """groupBy(keys).applyInPandas(fn, schema): fn maps each group's
+        pandas.DataFrame to a result DataFrame (reference
+        GpuFlatMapGroupsInPandasExec)."""
+        from .columnar.host import schema_to_struct
+        import pyarrow as _pa
+        if isinstance(schema, _pa.Schema):
+            schema = schema_to_struct(schema)
+        return DataFrame(
+            L.LogicalFlatMapGroupsInPandas(self._key_names(), fn, schema,
+                                           self._df._plan),
+            self._df._session)
+
+    def agg_in_pandas(self, *aggs) -> "DataFrame":
+        """Grouped pandas UDAFs: aggs = (fn, input column names, output
+        name, output type); each fn maps the group's Series to one
+        scalar (reference GpuAggregateInPandasExec)."""
+        norm = [(fn, list(cols), name, dt) for fn, cols, name, dt in aggs]
+        return DataFrame(
+            L.LogicalAggregateInPandas(self._key_names(), norm,
+                                       self._df._plan),
+            self._df._session)
+
 
 class DataFrame:
     def __init__(self, plan: L.LogicalPlan, session: TpuSession):
@@ -165,6 +202,17 @@ class DataFrame:
         """Append a scalar pandas UDF column: fn(pandas.Series...) ->
         pandas.Series (reference GpuArrowEvalPythonExec)."""
         return self._wrap(L.LogicalArrowEvalPython(
+            [(fn, list(input_cols), name, return_type)], self._plan))
+
+    def with_window_pandas_udf(self, name: str, fn, input_cols,
+                               return_type, partition_by=(),
+                               order_by=()) -> "DataFrame":
+        """Append a pandas window-UDF column over unbounded partition
+        frames: fn(partition Series...) -> Series of the partition's
+        length, or one scalar to broadcast (reference
+        GpuWindowInPandasExec)."""
+        return self._wrap(L.LogicalWindowInPandas(
+            list(partition_by), list(order_by),
             [(fn, list(input_cols), name, return_type)], self._plan))
 
     def cache(self) -> "DataFrame":

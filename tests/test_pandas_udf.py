@@ -3,6 +3,8 @@ GpuMapInPandasExec roles): forked Arrow-IPC worker processes with a
 concurrency semaphore."""
 import os
 
+import numpy as np
+
 import pyarrow as pa
 import pytest
 
@@ -94,3 +96,93 @@ class TestArrowEvalPython:
         df = s.from_arrow(TBL).with_pandas_udf(
             "sq", lambda x: x * x, ["x"], t.LONG)
         assert "python worker process" in df.physical().explain()
+
+
+# ---------------------------------------------------------------------------
+# Grouped pandas exec family (reference GpuFlatMapGroupsInPandasExec /
+# GpuAggregateInPandasExec / GpuWindowInPandasExec)
+# ---------------------------------------------------------------------------
+
+def _grouped_table(n=200):
+    rng = np.random.default_rng(5)
+    return pa.table({
+        "g": pa.array(rng.integers(0, 6, n), pa.int64()),
+        "x": pa.array(rng.standard_normal(n)),
+        "y": pa.array(rng.integers(0, 100, n), pa.int64()),
+    })
+
+
+def test_apply_in_pandas_matches_pandas_oracle():
+    import pandas as pd
+    from spark_rapids_tpu import types as t
+    tbl = _grouped_table()
+    s = TpuSession()
+
+    def center(df):
+        out = df.copy()
+        out["x"] = df["x"] - df["x"].mean()
+        return out
+
+    schema = t.StructType([t.StructField("g", t.LONG),
+                           t.StructField("x", t.DOUBLE),
+                           t.StructField("y", t.LONG)])
+    got = s.from_arrow(tbl).group_by("g").apply_in_pandas(center, schema) \
+        .collect().to_pandas().sort_values(["g", "y", "x"])
+    want = tbl.to_pandas().groupby("g", group_keys=False)[["g", "x", "y"]] \
+        .apply(center).sort_values(["g", "y", "x"])
+    assert np.allclose(got["x"].to_numpy(), want["x"].to_numpy())
+    assert got["y"].tolist() == want["y"].tolist()
+
+
+def test_agg_in_pandas_udaf():
+    from spark_rapids_tpu import types as t
+    tbl = _grouped_table()
+    s = TpuSession()
+
+    def wmean(x, y):
+        import numpy as _np
+        return float(_np.average(x, weights=y + 1))
+
+    got = s.from_arrow(tbl).group_by("g").agg_in_pandas(
+        (wmean, ["x", "y"], "wm", t.DOUBLE)).collect().to_pandas() \
+        .sort_values("g").reset_index(drop=True)
+    df = tbl.to_pandas()
+    want = df.groupby("g").apply(
+        lambda sub: float(np.average(sub["x"], weights=sub["y"] + 1)),
+        include_groups=False).sort_index()
+    assert got["g"].tolist() == want.index.tolist()
+    assert np.allclose(got["wm"].to_numpy(), want.to_numpy())
+
+
+def test_window_in_pandas_rank_and_scalar():
+    from spark_rapids_tpu import types as t
+    tbl = _grouped_table()
+    s = TpuSession()
+
+    def frac_of_max(x):
+        return x / x.max()
+
+    got = s.from_arrow(tbl).with_window_pandas_udf(
+        "fr", frac_of_max, ["x"], t.DOUBLE,
+        partition_by=["g"], order_by=["y"]).collect().to_pandas()
+    df = tbl.to_pandas()
+    want = df.sort_values(["g", "y"], kind="stable").reset_index(drop=True)
+    want["fr"] = want.groupby("g")["x"].transform(lambda x: x / x.max())
+    got = got.sort_values(["g", "y"], kind="stable").reset_index(drop=True)
+    assert np.allclose(got["fr"].to_numpy(), want["fr"].to_numpy())
+    assert got["x"].tolist() == want["x"].tolist()
+
+
+def test_agg_in_pandas_null_keys_grouped():
+    from spark_rapids_tpu import types as t
+    tbl = pa.table({
+        "g": pa.array([1, None, 1, None, 2], pa.int64()),
+        "x": pa.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+    })
+    s = TpuSession()
+    got = s.from_arrow(tbl).group_by("g").agg_in_pandas(
+        (lambda x: float(x.sum()), ["x"], "sx", t.DOUBLE)) \
+        .collect().to_pandas()
+    m = {None if g is None or g != g else int(g): v
+         for g, v in zip(got["g"], got["sx"])}
+    assert m == {1: 4.0, 2: 5.0, None: 6.0}
