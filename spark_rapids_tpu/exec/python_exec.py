@@ -237,10 +237,9 @@ class _GroupedPandasExec(HostNode):
 
     def _run_grouped(self, ctx: ExecContext, apply
                      ) -> Iterator[pa.RecordBatch]:
-        batches = [rb for rb in self.child.execute(ctx) if rb.num_rows]
-        if not batches:
+        table = self._table(ctx)
+        if table.num_rows == 0:
             return
-        table = pa.Table.from_batches(batches)
         source = _FrameSource(_group_frames(table, self._group_names),
                               self.child.output_schema)
         inner = MapInPandasExec(apply, self.output_schema, source)

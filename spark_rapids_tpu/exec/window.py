@@ -124,10 +124,19 @@ class WindowExec(PlanNode):
         order_cols = [s.columns[i] for i in o_idx]
         val_cols = [s.columns[i] for i in v_idx]
 
+        # sort directions only shape the traced program for value-offset
+        # RANGE frames — keep them out of the cache key otherwise
+        has_value_range = any(
+            f.kind == "range" and ((f.lower not in (None, 0)) or
+                                   (f.upper not in (None, 0)))
+            for _s, f, _i in specs_frames)
+        order_dirs = tuple((asc, nf) for _e, asc, nf in self.order_keys) \
+            if has_value_range else ()
         key = ("window", s.capacity,
                tuple(sp.fingerprint() for sp, _f, _i in specs_frames),
                tuple(f.fp() for _s, f, _i in specs_frames),
                tuple(i for _s, _f, i in specs_frames),
+               order_dirs,
                tuple((c.dtype.simple_string, str(c.data.dtype))
                      for c in part_cols + order_cols + val_cols))
         fn = _WINDOW_JIT_CACHE.get(key)
@@ -136,7 +145,7 @@ class WindowExec(PlanNode):
                 tuple((c.dtype,) for c in part_cols),
                 tuple((c.dtype,) for c in order_cols),
                 tuple((c.dtype,) for c in val_cols),
-                specs_frames, s.capacity)
+                specs_frames, s.capacity, order_dirs=order_dirs)
             fn = jax.jit(traced)
             _WINDOW_JIT_CACHE[key] = fn
 

@@ -301,6 +301,31 @@ class BuildTable:
 _PROBE_CACHE = {}
 
 
+def _merge_rank(sorted_vals: jax.Array, queries: jax.Array,
+                side: str) -> jax.Array:
+    """np.searchsorted(sorted_vals, queries, side) without binary search:
+    ONE variadic sort merges both lanes and ranks fall out of a cumsum
+    (log-step searchsorted gathers are the slowest access pattern on
+    TPU — ~2.1s at 2M/4M vs ~0.2s for the merge on v5e)."""
+    n = sorted_vals.shape[0]
+    m = queries.shape[0]
+    # tie order: 'left' counts keys strictly below (queries first on
+    # equal), 'right' counts keys at-or-below (keys first)
+    kt, qt = (1, 0) if side == "left" else (0, 1)
+    vals = jnp.concatenate([sorted_vals, queries])
+    tags = jnp.concatenate([jnp.full((n,), kt, jnp.int8),
+                            jnp.full((m,), qt, jnp.int8)])
+    pos = jnp.concatenate([jnp.zeros((n,), jnp.int32),
+                           jnp.arange(m, dtype=jnp.int32)])
+    _v, s_tags, s_pos = jax.lax.sort((vals, tags, pos), num_keys=2,
+                                     is_stable=True)
+    is_key = s_tags == jnp.int8(kt)
+    cum = jnp.cumsum(is_key.astype(jnp.int32))
+    tgt = jnp.where(is_key, m, s_pos)
+    return jnp.zeros((m,), jnp.int32).at[tgt].set(
+        jnp.where(is_key, 0, cum), mode="drop")
+
+
 def _dense_probe_pos(lane: jax.Array, probe_valid: jax.Array,
                      lo: int, hi: int):
     """(pos, in_bounds) of probe keys in a build domain."""
@@ -355,7 +380,7 @@ def probe_aligned(build: BuildTable, probe_lanes: List[jax.Array],
         def run(perm, sorted_hash, valid_count, b_lanes, b_key_valid,
                 p_lanes, p_valid):
             h = composite_hash(p_lanes)
-            lo = searchsorted(sorted_hash, h, side="left")
+            lo = _merge_rank(sorted_hash, h, side="left")
             in_range = lo < valid_count
             pos = jnp.clip(lo, 0, bcap - 1)
             build_idx = jnp.take(perm, pos).astype(jnp.int32)
@@ -397,8 +422,8 @@ def probe_matched_lazy(build: BuildTable, probe_lanes: List[jax.Array],
     if fn is None:
         def run(sorted_hash, valid_count, lanes, pvalid):
             h = composite_hash(lanes)
-            lo = searchsorted(sorted_hash, h, side="left")
-            hi = searchsorted(sorted_hash, h, side="right")
+            lo = _merge_rank(sorted_hash, h, side="left")
+            hi = _merge_rank(sorted_hash, h, side="right")
             lo = jnp.minimum(lo, valid_count)
             hi = jnp.minimum(hi, valid_count)
             return pvalid & (hi > lo)
@@ -435,8 +460,8 @@ def probe_counts(build: BuildTable, probe_lanes: List[jax.Array],
         def run(sorted_hash, valid_count, lanes, pvalid):
             h = composite_hash(lanes)
             # restrict the search to the valid prefix
-            lo = searchsorted(sorted_hash, h, side="left")
-            hi = searchsorted(sorted_hash, h, side="right")
+            lo = _merge_rank(sorted_hash, h, side="left")
+            hi = _merge_rank(sorted_hash, h, side="right")
             lo = jnp.minimum(lo, valid_count)
             hi = jnp.minimum(hi, valid_count)
             counts = jnp.where(pvalid, hi - lo, 0).astype(jnp.int32)

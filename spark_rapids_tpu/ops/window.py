@@ -138,8 +138,114 @@ def _nan_restore(red, frame_cnt, frame_nan, is_min):
     return jnp.where(frame_nan > 0, nan, red)
 
 
+def _merge_rank_counts(seg, u, query, query_first: bool, part_start,
+                       capacity: int):
+    """Per-row count of in-segment key values < query (query_first) or
+    <= query (not query_first), computed without binary search: one
+    variadic sort merges the key lane with the query lane per segment
+    (reference GpuBatchedBoundedWindowExec.scala:220 sizes value-offset
+    frames with per-row searches; log-step searchsorted is the slowest
+    access pattern on TPU, a merge sort rides the fast sort network)."""
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    kt, qt = (1, 0) if query_first else (0, 1)
+    segs = jnp.concatenate([seg, seg])
+    vals = jnp.concatenate([u, query])
+    tags = jnp.concatenate([jnp.full((capacity,), kt, jnp.int8),
+                            jnp.full((capacity,), qt, jnp.int8)])
+    pos = jnp.concatenate([idx, idx])
+    _sg, _vl, s_tags, s_pos = jax.lax.sort(
+        (segs, vals, tags, pos), num_keys=3, is_stable=True)
+    is_key = s_tags == jnp.int8(kt)
+    cum = jnp.cumsum(is_key.astype(jnp.int32))
+    # every batch row is a key, so keys in earlier segments == the
+    # segment's starting row index
+    tgt = jnp.where(is_key, 2 * capacity, s_pos)
+    counts = jnp.zeros((capacity,), jnp.int32).at[tgt].set(
+        cum, mode="drop")
+    return counts - part_start
+
+
+def _range_value_bounds(order_lane, order_valid, asc: bool,
+                        nulls_first: bool, frame, seg, part_start,
+                        part_end, peer_start, peer_end, capacity: int):
+    """Per-row inclusive [lo, hi] row bounds of a value-offset RANGE
+    frame over the single (int-lane) order key.  frame.lower/upper are
+    SIGNED value offsets (None = unbounded, 0 = current peer group).
+    Null order keys frame their null peer group (Spark)."""
+    u = order_lane.astype(jnp.int64)
+    if not asc:
+        u = -u                      # normalize to ascending value space
+    if order_valid is not None:
+        # null-key rows sit at the segment's head or tail (sort nf);
+        # pin their u to that extreme so non-null rows' merge counts
+        # step over them correctly (their own bounds are masked below)
+        null_u = jnp.int64(_ORDER_MIN if nulls_first else _ORDER_MAX)
+        u = jnp.where(order_valid, u, null_u)
+    if frame.lower is None:
+        lo = part_start
+    elif frame.lower == 0:
+        lo = peer_start
+    else:
+        # offsets are direction-free in the normalized (ascending-u)
+        # space: for DESC, "x preceding" = key+x = u-x = u+lower
+        cnt = _merge_rank_counts(seg, u, u + jnp.int64(frame.lower),
+                                 query_first=True,
+                                 part_start=part_start,
+                                 capacity=capacity)
+        lo = part_start + cnt
+    if frame.upper is None:
+        hi = part_end
+    elif frame.upper == 0:
+        hi = peer_end
+    else:
+        cnt = _merge_rank_counts(seg, u, u + jnp.int64(frame.upper),
+                                 query_first=False,
+                                 part_start=part_start,
+                                 capacity=capacity)
+        hi = part_start + cnt - 1
+    if order_valid is not None:
+        lo = jnp.where(order_valid, lo, peer_start)
+        hi = jnp.where(order_valid, hi, peer_end)
+    return lo, hi
+
+
+def _ilog2(length: jax.Array, capacity: int) -> jax.Array:
+    """floor(log2(length)) for 1 <= length <= capacity, exactly (no
+    float round-off)."""
+    k = jnp.zeros(length.shape, jnp.int32)
+    j = 2
+    while j <= capacity:
+        k = k + (length >= j).astype(jnp.int32)
+        j <<= 1
+    return k
+
+
+def _sparse_minmax(o, ident, lo, hi, op, capacity: int):
+    """min/max over arbitrary per-row [lo, hi] spans via a sparse table
+    (log2(cap) doubled-shift levels, two gathers per query) — the
+    variable-width analogue of the static shift-stack used for bounded
+    ROWS frames."""
+    levels = [o]
+    step = 1
+    while step < capacity:
+        prev = levels[-1]
+        shifted = jnp.concatenate(
+            [prev[step:], jnp.full((step,), ident, prev.dtype)])
+        levels.append(op(prev, shifted))
+        step <<= 1
+    table = jnp.stack(levels).reshape(-1)
+    length = jnp.maximum(hi - lo + 1, 1).astype(jnp.int32)
+    k = _ilog2(length, capacity)
+    pow2 = (jnp.int32(1) << k)
+    left = jnp.clip(lo, 0, capacity - 1)
+    right = jnp.clip(hi - pow2 + 1, 0, capacity - 1)
+    a = table[k * capacity + left]
+    b = table[k * capacity + right]
+    return op(a, b)
+
+
 def window_trace(part_info, order_info, val_info, specs_frames,
-                 capacity: int):
+                 capacity: int, order_dirs=()):
     """Build the traced window program.
 
     part_info/order_info/val_info: tuples of (dtype,) per column (static).
@@ -181,6 +287,19 @@ def window_trace(part_info, order_info, val_info, specs_frames,
         def frame_bounds(frame: WindowFrame):
             """Per-row inclusive [lo, hi] row-index bounds."""
             if frame.kind == "range":
+                if (frame.lower not in (None, 0)) or \
+                        (frame.upper not in (None, 0)):
+                    # value-offset RANGE: single int-lane order key
+                    # (placement guarantees this)
+                    asc, nf = order_dirs[0] if order_dirs else (True, True)
+                    ov = order_valid[0]
+                    ov = None if ov is None else (ov & live)
+                    lo, hi = _range_value_bounds(
+                        compute_view(order_data[0], order_info[0][0]),
+                        ov, asc, nf, frame, seg, part_start, part_end,
+                        peer_start, peer_end, capacity)
+                    return (jnp.clip(lo, part_start, part_end + 1),
+                            jnp.clip(hi, part_start - 1, part_end))
                 lo = part_start if frame.lower is None else peer_start
                 hi = part_end if frame.upper is None else peer_end
                 return lo, hi
@@ -340,7 +459,7 @@ def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
         return _nan_restore(back(red), c, fnan, is_min), (c > 0) & live
 
     # --- RANGE CURRENT ROW .. UNBOUNDED FOLLOWING: reverse running ---
-    if frame.kind == "range":
+    if frame.kind == "range" and frame.lower == 0 and frame.upper is None:
         def at_peer_start(x):
             return _gather(x, peer_start, capacity)
         c = at_peer_start(_seg_scan_rev(cnt_lane, part_b, jnp.add))
@@ -377,7 +496,11 @@ def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
     o, ident, back, nan_lane = _minmax_lanes(cd, vl, dt, raw_data, is_min)
     op = jnp.minimum if is_min else jnp.maximum
     c_cnt = None
-    if frame.lower is None:
+    if frame.kind == "range":
+        # value-offset RANGE: variable frame widths -> sparse table
+        red = jnp.where(nonempty, _sparse_minmax(o, ident, lo, hi, op,
+                                                 capacity), ident)
+    elif frame.lower is None:
         # UNBOUNDED PRECEDING .. k FOLLOWING: forward scan gathered at hi
         fwd = _seg_scan(o, part_b, op)
         red = jnp.where(nonempty, _gather(fwd, hi, capacity), ident)

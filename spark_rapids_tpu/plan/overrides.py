@@ -816,6 +816,25 @@ class WindowMeta(PlanMeta):
             if isinstance(dt, t.DecimalType) and dt.is_wide:
                 self.will_not_work("decimal128 window order key "
                                    "not yet on device")
+        # value-offset RANGE frames need ONE integer-lane order key
+        # (merge-rank bounds are value arithmetic on that lane)
+        if any(b.frame is not None and b.frame.kind == "range" and
+               ((b.frame.lower not in (None, 0)) or
+                (b.frame.upper not in (None, 0)))
+               for b in self.spec_metas):
+            ok = len(self.node.order_keys) == 1
+            if ok:
+                try:
+                    dt = self.node.order_keys[0][0].bind(schema).dtype
+                    ok = isinstance(dt, (t.ByteType, t.ShortType,
+                                         t.IntegerType, t.LongType,
+                                         t.DateType, t.TimestampType))
+                except (KeyError, TypeError):
+                    ok = False
+            if not ok:
+                self.will_not_work(
+                    "value-offset RANGE frame needs a single "
+                    "integer/date/timestamp order key on device")
 
     def to_device(self):
         from ..exec.window import WindowExec

@@ -16,9 +16,11 @@ dispatch (ops/window.py).
 Frames follow Spark semantics:
   * explicit ROWS BETWEEN a AND b — offsets relative to the current row
     (negative = preceding), None = unbounded in that direction;
-  * explicit RANGE supports the UNBOUNDED/CURRENT-ROW shapes (value-offset
-    RANGE frames are tagged unsupported, as the reference does for
-    non-literal bounds);
+  * explicit RANGE supports the UNBOUNDED/CURRENT-ROW shapes AND literal
+    value offsets (RANGE BETWEEN x PRECEDING AND y FOLLOWING) over a
+    single integer-lane order key — bounds found by a merge-rank sort
+    per side, min/max answered from a sparse table (the
+    GpuBatchedBoundedWindowExec.scala:220 role);
   * default frame: RANGE UNBOUNDED PRECEDING..CURRENT ROW when order keys
     exist (includes peer rows), else the whole partition.
 """
@@ -39,7 +41,8 @@ CURRENT = 0
 @dataclasses.dataclass(frozen=True)
 class WindowFrame:
     """kind: "rows" | "range"; lower/upper: int offset or None (unbounded).
-    RANGE frames only support the unbounded/current shapes."""
+    RANGE offsets are VALUE deltas on the single order key (0 = current
+    peer group); ROWS offsets are row counts."""
     kind: str = "range"
     lower: Optional[int] = UNBOUNDED
     upper: Optional[int] = CURRENT
@@ -112,12 +115,12 @@ class WindowFunctionSpec:
                            f"{self.child.dtype.simple_string}")
         if self.frame is not None:
             f = self.frame
-            if f.kind == "range" and not (
-                    f.lower in (None, 0) and f.upper in (None, 0)):
-                out.append("value-offset RANGE frame not supported "
-                           "(only UNBOUNDED/CURRENT ROW bounds)")
-            if f.kind == "rows" and f.lower is not None and \
-                    f.upper is not None and f.lower > f.upper:
+            # value-offset RANGE frames are supported on device (merge-
+            # rank bounds over the single int-lane order key); the
+            # order-key shape check lives in WindowMeta, which sees the
+            # order keys
+            if f.lower is not None and f.upper is not None and \
+                    f.lower > f.upper:
                 out.append("frame lower bound above upper bound")
         return out
 

@@ -98,13 +98,17 @@ class GroupedData:
         for k in self._keys:
             if isinstance(k, str):
                 names.append(k)
-            elif isinstance(k, E.Alias):
-                names.append(k.name)
             elif isinstance(k, E.ColumnRef):
                 names.append(k.name)
             else:
                 raise TypeError(
                     "pandas group operations need plain column keys")
+        schema_names = set(self._df.schema.names)
+        for n in names:
+            # fail at plan build, not inside the worker feeder thread
+            if n not in schema_names:
+                raise KeyError(f"group key {n!r} not in "
+                               f"{sorted(schema_names)}")
         return names
 
     def apply_in_pandas(self, fn, schema) -> "DataFrame":

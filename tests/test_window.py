@@ -452,7 +452,10 @@ def test_cpu_value_range_frame():
          (WinCount(E.ColumnRef("v"), WindowFrame("range", 0, 3)), "c")],
         ["g"], [("o", True, True)], L.LogicalScan(tbl))
     q = apply_overrides(plan)
-    assert q.kind == "host"     # value-offset RANGE is CPU-only
+    # value-offset RANGE over a single int order key runs on DEVICE now
+    # (merge-rank bounds, ops/window.py); the CPU path keeps its own
+    # implementation for ineligible shapes
+    assert q.kind == "device", q.explain()
     out = q.collect()
     assert out.column("s").to_pylist() == [1.0, 2.0, 1.0, 1.0]
     # o=1: window [1,4] -> {1,2}; o=2: [2,5] -> {2,5}; o=5: [5,8] -> {5};
@@ -502,3 +505,99 @@ def test_rank_without_order_raises():
     tbl = make_table(50)
     with pytest.raises(WindowAnalysisError):
         L.LogicalWindow([(Rank(), "r")], ["g"], [], L.LogicalScan(tbl))
+
+
+# ---------------------------------------------------------------------------
+# Device value-offset RANGE frames (merge-rank bounds + sparse min/max)
+# ---------------------------------------------------------------------------
+
+def _range_oracle(df, lower, upper, col, fn):
+    """Per-row python oracle: fn over values whose order key lies in
+    [o+lower, o+upper] within the partition.  Null keys sort FIRST
+    (asc, nulls_first) and compare below every value — Spark's range
+    bound ordering — so they model as -inf: a null-keyed current row
+    frames its peer (null) group, and non-null rows include the null
+    block exactly when the lower bound is unbounded."""
+    out = []
+    okey = df["o"].astype("float64").fillna(-np.inf)
+    for i, row in df.iterrows():
+        in_g = df["g"] == row["g"]
+        k = okey.loc[i]
+        if k == -np.inf:
+            sel = in_g & (okey == -np.inf)
+        else:
+            lo = k + lower if lower is not None else -np.inf
+            hi = k + upper if upper is not None else np.inf
+            sel = in_g & (okey >= lo) & (okey <= hi)
+        vals = df[sel][col].dropna()
+        out.append(fn(vals) if len(vals) else None)
+    return out
+
+
+@pytest.mark.parametrize("lower,upper", [(-3, 2), (-5, 0), (0, 4),
+                                         (None, 3), (-2, None), (-1, 1)])
+def test_device_value_range_frames_oracle(lower, upper):
+    rng = np.random.default_rng(33)
+    n = 400
+    df = pd.DataFrame({
+        "g": rng.integers(0, 5, n),
+        "o": [None if rng.random() < 0.05 else int(v)
+              for v in rng.integers(0, 40, n)],
+        "v": [None if rng.random() < 0.1 else float(v)
+              for v in rng.integers(0, 100, n)],
+    })
+    tbl = pa.table({"g": pa.array(df["g"], pa.int64()),
+                    "o": pa.array(df["o"], pa.int64()),
+                    "v": pa.array(df["v"], pa.float64())})
+    frame = WindowFrame("range", lower, upper)
+    plan = L.LogicalWindow(
+        [(WinSum(E.ColumnRef("v"), frame), "s"),
+         (WinCount(E.ColumnRef("v"), frame), "c"),
+         (WinMin(E.ColumnRef("v"), frame), "mn"),
+         (WinMax(E.ColumnRef("v"), frame), "mx")],
+        ["g"], [("o", True, True)], L.LogicalScan(tbl))
+    q = apply_overrides(plan)
+    assert q.kind == "device", q.explain()
+    out = q.collect().to_pandas()
+    # output order = (partition, order) sort; rebuild the oracle frame
+    # in the same order
+    odf = out[["g", "o", "v"]]
+    for name, fn in (("s", np.sum), ("mn", np.min), ("mx", np.max)):
+        want = _range_oracle(odf, lower, upper, "v", fn)
+        got = out[name].tolist()
+        assert all((w is None and (g is None or g != g)) or
+                   (w is not None and g == pytest.approx(w))
+                   for w, g in zip(want, got)), name
+    wantc = _range_oracle(odf, lower, upper, "v", len)
+    assert [c or 0 for c in wantc] == out["c"].tolist()
+
+
+def test_device_value_range_desc_and_date():
+    import datetime as pydt
+    rng = np.random.default_rng(7)
+    n = 120
+    days = [None if rng.random() < 0.08 else
+            pydt.date(2024, 1, 1) + pydt.timedelta(days=int(d))
+            for d in rng.integers(0, 30, n)]
+    tbl = pa.table({
+        "g": pa.array(rng.integers(0, 3, n), pa.int64()),
+        "o": pa.array(days, pa.date32()),
+        "v": pa.array(rng.integers(0, 50, n), pa.int64()),
+    })
+    frame = WindowFrame("range", -7, 0)     # 7 days preceding
+    plan = L.LogicalWindow(
+        [(WinSum(E.ColumnRef("v"), frame), "s")],
+        ["g"], [("o", False, False)], L.LogicalScan(tbl))
+    q = apply_overrides(plan)
+    assert q.kind == "device", q.explain()
+    out = q.collect().to_pandas()
+    # desc: 7 preceding = dates in [o, o+7]
+    for _, row in out.iterrows():
+        sub = out[out["g"] == row["g"]]
+        if pd.isna(row["o"]):
+            want = sub[sub["o"].isna()]["v"].sum()
+        else:
+            want = sub[(sub["o"] >= row["o"]) &
+                       (sub["o"] <= row["o"] + pd.Timedelta(days=7))][
+                "v"].sum()
+        assert row["s"] == want
