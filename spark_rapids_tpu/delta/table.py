@@ -14,8 +14,12 @@ the reference (log commits are CPU Delta-lib work there too).
 Subset implemented: create/append/overwrite, snapshot reads (with version
 time travel), stats-carrying add actions, DELETE, UPDATE, MERGE (matched
 update/delete + not-matched insert) via per-file touched-file discovery
-and rewrite.  Checkpoints/deletion vectors/column mapping are not
-implemented (log is JSON-only).
+and rewrite, parquet checkpoints + _last_checkpoint, deletion-vector
+READS (delta/dv.py: roaring-bitmap-array parser per the public
+PROTOCOL.md layout; u/p/i storage types, CRC + cardinality checks) and
+column-mapping (mode=name/id) reads via per-file physical->logical
+renames.  DML over DV-bearing or column-mapped snapshots is rejected
+explicitly (read path only).
 """
 from __future__ import annotations
 
@@ -428,24 +432,94 @@ class DeltaTable:
                 active.pop(a["remove"]["path"], None)
         return [active[p] for p in sorted(active)]
 
+    def _snapshot_state(self, version: Optional[int] = None):
+        """ONE log replay -> (metaData action or None, active adds) —
+        the log (and any parquet checkpoint behind it) is decoded once
+        per snapshot operation, not once per question asked of it."""
+        meta = None
+        active: Dict[str, dict] = {}
+        for a in self._read_actions(version):
+            if "metaData" in a:
+                meta = a["metaData"]
+            elif "add" in a:
+                active[a["add"]["path"]] = a["add"]
+            elif "remove" in a:
+                active.pop(a["remove"]["path"], None)
+        return meta, [active[p] for p in sorted(active)]
+
+    @staticmethod
+    def _mapping_mode_of(meta: Optional[dict]) -> str:
+        if meta is None:
+            return "none"
+        return (meta.get("configuration") or {}).get(
+            "delta.columnMapping.mode", "none")
+
+    @staticmethod
+    def _physical_names_of(meta: Optional[dict]) -> Dict[str, str]:
+        """logical -> physical column name (columnMapping mode=name/id:
+        files store physical names from each field's
+        delta.columnMapping.physicalName metadata)."""
+        out: Dict[str, str] = {}
+        if meta is None:
+            return out
+        for f in json.loads(meta["schemaString"])["fields"]:
+            phys = (f.get("metadata") or {}).get(
+                "delta.columnMapping.physicalName")
+            out[f["name"]] = phys or f["name"]
+        return out
+
+    def column_mapping_mode(self, version: Optional[int] = None) -> str:
+        return self._mapping_mode_of(self._snapshot_state(version)[0])
+
+    def _read_data_file(self, add: dict, sch: pa.Schema,
+                        phys: Optional[Dict[str, str]],
+                        part_cols=()) -> pa.Table:
+        """One add action -> its table slice: parquet decode, physical->
+        logical rename (column mapping), deletion-vector row mask,
+        null-fill for columns the file predates (schema evolution —
+        column mapping exists precisely to allow add/rename/drop)."""
+        tbl = pq.read_table(os.path.join(self.path, add["path"]))
+        if phys:
+            # physical -> logical for the columns present in the file
+            rename = {p: l for l, p in phys.items()}
+            tbl = tbl.rename_columns(
+                [rename.get(n, n) for n in tbl.schema.names])
+        dv = add.get("deletionVector")
+        if dv:
+            from .dv import read_deletion_vector
+            deleted = read_deletion_vector(dv, self.path)
+            mask = np.ones(tbl.num_rows, bool)
+            in_range = deleted[deleted < tbl.num_rows]
+            mask[in_range.astype(np.int64)] = False
+            tbl = tbl.filter(pa.array(mask))
+        for f in sch:
+            if f.name not in tbl.schema.names and f.name not in part_cols:
+                tbl = tbl.append_column(f, pa.nulls(tbl.num_rows, f.type))
+        return tbl
+
     def to_logical(self, version: Optional[int] = None):
         """LogicalParquetScan over the snapshot (device-decoded).
         Partitioned tables materialize partition columns from each add
-        action's partitionValues (the files don't store them)."""
+        action's partitionValues (the files don't store them);
+        DV-bearing or column-mapped files decode host-side first (row
+        masks / physical-name renames are per-file log facts the
+        streaming scan cannot know)."""
         from ..io.parquet import LogicalParquetScan
         from ..plan import logical as L
-        parts = self.partition_columns(version)
+        meta, adds = self._snapshot_state(version)
+        parts = (meta or {}).get("partitionColumns") or []
         sch = self.schema(version) or pa.schema([])
-        adds = self.snapshot_adds(version)
         if not adds:
             return L.LogicalScan(pa.Table.from_batches([], sch))
-        if not parts:
+        mapping = self._mapping_mode_of(meta)
+        phys = self._physical_names_of(meta) if mapping != "none" else None
+        has_dv = any(a.get("deletionVector") for a in adds)
+        if not parts and not has_dv and not phys:
             return LogicalParquetScan(
                 [os.path.join(self.path, a["path"]) for a in adds])
-        import pyarrow.compute as pc
         pieces = []
         for a in adds:
-            tbl = pq.read_table(os.path.join(self.path, a["path"]))
+            tbl = self._read_data_file(a, sch, phys, set(parts))
             pv = a.get("partitionValues") or {}
             n = tbl.num_rows
             for c in parts:
@@ -486,6 +560,12 @@ class DeltaTable:
                 f"{op} on partitioned Delta tables is not yet supported "
                 "(per-file rewrites need partition-value columns "
                 "attached)")
+        meta, adds = self._snapshot_state()
+        if any(a.get("deletionVector") for a in adds) or \
+                self._mapping_mode_of(meta) != "none":
+            raise NotImplementedError(
+                f"{op} on DV-bearing/column-mapped Delta tables is not "
+                "yet supported (read path only)")
 
     def delete(self, condition) -> int:
         self._no_partition_dml("DELETE")

@@ -126,10 +126,8 @@ class WindowExec(PlanNode):
 
         # sort directions only shape the traced program for value-offset
         # RANGE frames — keep them out of the cache key otherwise
-        has_value_range = any(
-            f.kind == "range" and ((f.lower not in (None, 0)) or
-                                   (f.upper not in (None, 0)))
-            for _s, f, _i in specs_frames)
+        has_value_range = any(f.is_value_offset
+                              for _s, f, _i in specs_frames)
         order_dirs = tuple((asc, nf) for _e, asc, nf in self.order_keys) \
             if has_value_range else ()
         key = ("window", s.capacity,

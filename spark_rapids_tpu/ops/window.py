@@ -287,8 +287,7 @@ def window_trace(part_info, order_info, val_info, specs_frames,
         def frame_bounds(frame: WindowFrame):
             """Per-row inclusive [lo, hi] row-index bounds."""
             if frame.kind == "range":
-                if (frame.lower not in (None, 0)) or \
-                        (frame.upper not in (None, 0)):
+                if frame.is_value_offset:
                     # value-offset RANGE: single int-lane order key
                     # (placement guarantees this)
                     asc, nf = order_dirs[0] if order_dirs else (True, True)

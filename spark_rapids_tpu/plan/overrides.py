@@ -818,9 +818,7 @@ class WindowMeta(PlanMeta):
                                    "not yet on device")
         # value-offset RANGE frames need ONE integer-lane order key
         # (merge-rank bounds are value arithmetic on that lane)
-        if any(b.frame is not None and b.frame.kind == "range" and
-               ((b.frame.lower not in (None, 0)) or
-                (b.frame.upper not in (None, 0)))
+        if any(b.frame is not None and b.frame.is_value_offset
                for b in self.spec_metas):
             ok = len(self.node.order_keys) == 1
             if ok:
@@ -1164,6 +1162,49 @@ class PhysicalQuery:
             yield from node.execute(ctx)
 
 
+def _plain_names(exprs):
+    """Column names when every expression is a plain (possibly aliased)
+    reference, else None."""
+    names = []
+    for e in exprs:
+        inner = e.children[0] if isinstance(e, E.Alias) else e
+        inner = E.ColumnRef(inner) if isinstance(inner, str) else inner
+        if not isinstance(inner, E.ColumnRef):
+            return None
+        names.append(inner.name)
+    return names
+
+
+def _logical_keys_unique(plan: L.LogicalPlan, names) -> bool:
+    """Logical-level distinctness: exact scan statistics propagated
+    through uniqueness-preserving operators (conservative False when
+    unknown) — the planner-side mirror of PlanNode.keys_unique."""
+    if not names:
+        return False
+    if type(plan) is L.LogicalScan:
+        from ..exec.plan import _table_keys_unique
+        tbl = plan.table
+        if any(n not in tbl.schema.names for n in names):
+            return False
+        return _table_keys_unique(tbl, tuple(names))
+    if type(plan) in (L.LogicalFilter, L.LogicalLimit, L.LogicalSort):
+        return _logical_keys_unique(plan.child, names)
+    if type(plan) is L.LogicalProject:
+        mapped = []
+        for n in names:
+            if n not in plan.names:
+                return False
+            ref = _plain_names([plan.exprs[plan.names.index(n)]])
+            if ref is None:
+                return False
+            mapped.append(ref[0])
+        return _logical_keys_unique(plan.child, mapped)
+    if type(plan) is L.LogicalAggregate:
+        return bool(plan.key_names) and \
+            set(plan.key_names) <= set(names)
+    return False
+
+
 def _expr_refs(e, out: set) -> None:
     if isinstance(e, E.ColumnRef):
         out.add(e.name)
@@ -1241,15 +1282,36 @@ def prune_columns(plan: L.LogicalPlan, required=None) -> L.LogicalPlan:
         rnames = set(plan.right.schema.names)
         lreq = {n for n in required if n in lnames}
         rreq = {n for n in required if n in rnames}
-        for k in plan.left_keys:
+        join_type = plan.join_type
+        left, right = plan.left, plan.right
+        lk, rk = plan.left_keys, plan.right_keys
+        broadcast = plan.broadcast
+        # An inner join where ONE side contributes no output column and
+        # has unique keys IS a semi join of the other side: each row
+        # matches at most once and only existence matters.  The device
+        # semi probe reads two offsets per row instead of gathering
+        # every build lane at probe capacity — on TPU (row gathers
+        # ~1.6 GB/s) this is the difference between a filter and a
+        # materialization (q9's part join, q3's customer join, q5's
+        # region join are pure filters of this shape).
+        if join_type == "inner" and not rreq and \
+                _logical_keys_unique(right, _plain_names(rk)):
+            join_type = "left_semi"
+        elif join_type == "inner" and not lreq and \
+                _logical_keys_unique(left, _plain_names(lk)):
+            join_type = "left_semi"
+            left, right = right, left
+            lk, rk = rk, lk
+            lreq, rreq = rreq, lreq
+            broadcast = None          # hint sides no longer apply
+        for k in lk:
             _expr_refs(k, lreq)
-        for k in plan.right_keys:
+        for k in rk:
             _expr_refs(k, rreq)
-        return L.LogicalJoin(plan.join_type,
-                             prune_columns(plan.left, lreq),
-                             prune_columns(plan.right, rreq),
-                             plan.left_keys, plan.right_keys,
-                             broadcast=plan.broadcast)
+        return L.LogicalJoin(join_type,
+                             prune_columns(left, lreq),
+                             prune_columns(right, rreq),
+                             lk, rk, broadcast=broadcast)
     # unknown operator: require everything it could read, keep pruning
     # below it (children rebuilt in place — node identity preserved)
     for i, c in enumerate(plan.children):

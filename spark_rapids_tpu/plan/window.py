@@ -58,6 +58,12 @@ class WindowFrame:
     def is_running(self) -> bool:
         return self.lower is None and self.upper == 0
 
+    @property
+    def is_value_offset(self) -> bool:
+        """RANGE frame with at least one literal value offset bound."""
+        return self.kind == "range" and (
+            self.lower not in (None, 0) or self.upper not in (None, 0))
+
 
 def default_frame(has_order: bool) -> WindowFrame:
     return WindowFrame("range", UNBOUNDED, CURRENT if has_order else UNBOUNDED)
