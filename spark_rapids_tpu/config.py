@@ -204,6 +204,14 @@ ADAPTIVE_ADVISORY_PARTITION_BYTES = conf(
     "(spark.sql.adaptive.advisoryPartitionSizeInBytes role).",
     checker=_positive)
 
+ADAPTIVE_SKEW_FACTOR = conf(
+    "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionFactor", 5.0,
+    "A shuffle partition whose stored bytes exceed this factor times the "
+    "median partition size (and the advisory size) splits into multiple "
+    "independent sub-reads (spark.sql.adaptive.skewJoin."
+    "skewedPartitionFactor / GpuCustomShuffleReaderExec skew-read role). "
+    "Set <= 0 to disable splitting.")
+
 RUNTIME_FILTER_ENABLED = conf(
     "spark.rapids.tpu.sql.join.runtimeFilter.enabled", True,
     "Bloom-filter the probe side of large adaptive joins with the "

@@ -277,19 +277,61 @@ class AdaptiveShuffledJoinExec(PlanNode):
 
 
 def plan_coalesced_reads(exchange, ctx: ExecContext,
-                         advisory_bytes: int) -> List[List[int]]:
+                         advisory_bytes: int) -> List[List]:
     """Group a materialized exchange's partitions so each reduce group is
-    ~advisory_bytes, from REAL map-output sizes.  Returns partition-id
-    groups (order preserved: range partitions stay contiguous)."""
+    ~advisory_bytes, from REAL map-output sizes (order preserved: range
+    partitions stay contiguous).
+
+    SKEWED partitions — stored bytes above skewedPartitionFactor x the
+    median AND the advisory size — split into multiple independent
+    sub-read units instead of coalescing (the reference's
+    GpuCustomShuffleReaderExec skew reads, which slice one hot
+    partition's map outputs across several reduce tasks; a join above
+    streams probe batches, so each sub-read joins against the full
+    build side exactly as Spark's skew-join sub-tasks do).
+
+    Read units are partition ids, or (partition, block_lo, block_hi)
+    map-block slices for split partitions."""
+    import statistics
+    from ..config import ADAPTIVE_SKEW_FACTOR
     from ..shuffle.manager import get_shuffle_manager
     sid = exchange.materialize(ctx)
-    sizes = get_shuffle_manager().partition_sizes(sid)
+    mgr = get_shuffle_manager()
+    sizes = mgr.partition_sizes(sid)
     n = exchange.partitioning.num_partitions
-    groups: List[List[int]] = []
-    cur: List[int] = []
+    factor = float(ctx.conf.get(ADAPTIVE_SKEW_FACTOR))
+    nonzero = sorted(b for b in sizes.values() if b) or [0]
+    median = statistics.median(nonzero)
+    skew_threshold = max(advisory_bytes, factor * median) \
+        if factor > 0 else float("inf")
+
+    groups: List[List] = []
+    cur: List = []
     cur_bytes = 0
+    skew_splits = 0
     for p in range(n):
         b = sizes.get(p, 0)
+        if b > skew_threshold:
+            blocks = mgr.block_sizes(sid, p)
+            if len(blocks) > 1:
+                if cur:
+                    groups.append(cur)
+                    cur, cur_bytes = [], 0
+                nsub = 0
+                lo = 0
+                acc = 0
+                for i, bb in enumerate(blocks):
+                    if acc and acc + bb > advisory_bytes:
+                        groups.append([(p, lo, i)])
+                        nsub += 1
+                        lo, acc = i, 0
+                    acc += bb
+                groups.append([(p, lo, len(blocks))])
+                nsub += 1
+                if nsub > 1:        # an actual split, not a solo group
+                    skew_splits += 1
+                continue
+            # single stored block: nothing to slice — solo group below
         if cur and cur_bytes + b > advisory_bytes:
             groups.append(cur)
             cur, cur_bytes = [], 0
@@ -298,4 +340,6 @@ def plan_coalesced_reads(exchange, ctx: ExecContext,
     if cur:
         groups.append(cur)
     ctx.metrics["adaptive_coalesced_groups"] = len(groups)
+    if skew_splits:
+        ctx.metrics["adaptive_skew_split_partitions"] = skew_splits
     return groups

@@ -14,7 +14,11 @@ performs at every exchange.
 """
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List, Optional, Sequence, Tuple, Union
+
+#: one reduce-read work unit: a whole partition id, or a (partition,
+#: block_lo, block_hi) slice of a skew-split partition's map blocks
+ReadUnit = Union[int, Tuple[int, int, int]]
 
 import numpy as np
 import pyarrow as pa
@@ -94,7 +98,7 @@ class ShuffleReadExec(PlanNode):
     coalesced group."""
 
     def __init__(self, exchange: ShuffleExchangeExec,
-                 partitions: Sequence[int]):
+                 partitions: Sequence[ReadUnit]):
         super().__init__(exchange)
         self.exchange = exchange
         self.partitions = list(partitions)
@@ -111,8 +115,15 @@ class ShuffleReadExec(PlanNode):
         target = ctx.conf.batch_size_rows
         pending: List[pa.RecordBatch] = []
         rows = 0
-        for p in self.partitions:
-            for rb in mgr.read_partition(sid, p):
+        for unit in self.partitions:
+            # a unit is a whole partition id or a (partition, block_lo,
+            # block_hi) skew sub-read (plan_coalesced_reads)
+            if isinstance(unit, tuple):
+                p, lo, hi = unit
+                rbs = mgr.read_partition(sid, p, block_range=(lo, hi))
+            else:
+                rbs = mgr.read_partition(sid, unit)
+            for rb in rbs:
                 if rb.num_rows == 0:
                     continue
                 if rows and rows + rb.num_rows > target:

@@ -58,6 +58,13 @@ class ShuffleBlockStore:
                     out[pid] = sum(len(p) for p in ps)
             return out
 
+    def block_sizes(self, shuffle_id: int, part_id: int) -> List[int]:
+        """Per stored map-block bytes of one partition — the split
+        points skewed-read planning slices on."""
+        with self._lock:
+            return [len(p) for p in
+                    self._blocks.get((shuffle_id, part_id), [])]
+
 
 def serialize_batch(rb: pa.RecordBatch, codec: str = "none") -> bytes:
     """Arrow IPC wire format, optionally buffer-compressed (the nvcomp
@@ -116,12 +123,21 @@ class ShuffleManager:
 
         list(self.pool.map(ser, range(num_partitions)))
 
-    def read_partition(self, shuffle_id: int, part_id: int
-                       ) -> List[pa.RecordBatch]:
-        return deserialize_batches(self.store.get(shuffle_id, part_id))
+    def read_partition(self, shuffle_id: int, part_id: int,
+                       block_range=None) -> List[pa.RecordBatch]:
+        """All of one partition, or a [lo, hi) slice of its stored
+        map-blocks (skewed-partition sub-reads)."""
+        payloads = self.store.get(shuffle_id, part_id)
+        if block_range is not None:
+            lo, hi = block_range
+            payloads = payloads[lo:hi]
+        return deserialize_batches(payloads)
 
     def partition_sizes(self, shuffle_id: int) -> Dict[int, int]:
         return self.store.partition_sizes(shuffle_id)
+
+    def block_sizes(self, shuffle_id: int, part_id: int) -> List[int]:
+        return self.store.block_sizes(shuffle_id, part_id)
 
 
 _MANAGER: Optional[ShuffleManager] = None

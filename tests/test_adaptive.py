@@ -152,15 +152,32 @@ def test_plan_coalesced_reads_groups_by_real_sizes():
         HashPartitioning([E.ColumnRef("k")], 16), scan)
     ctx = ExecContext(TpuConf())
     groups = plan_coalesced_reads(ex, ctx, advisory_bytes=16 * 1024)
-    # every partition appears exactly once, in order
-    flat = [p for g in groups for p in g]
-    assert flat == list(range(16))
-    assert 1 < len(groups) < 16        # real coalescing happened
-    # big-skew partition sits alone in its group
+    # every partition covered exactly once, in order; the skewed one may
+    # appear as several contiguous (p, lo, hi) map-block sub-reads
+    covered = []
+    for g in groups:
+        for unit in g:
+            if isinstance(unit, tuple):
+                p, lo, hi = unit
+                if covered and covered[-1][0] == p:
+                    assert covered[-1][1] == lo    # contiguous slices
+                    covered[-1] = (p, hi)
+                else:
+                    covered.append((p, hi if lo == 0 else None))
+            else:
+                covered.append((unit, "whole"))
+    assert [p for p, _ in covered] == list(range(16))
+    whole_groups = [g for g in groups
+                    if any(not isinstance(u, tuple) for u in g)]
+    assert 1 < len(whole_groups) < 16  # real coalescing happened
+    # big-skew partition split into multiple sub-reads, each its own group
     from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
     sizes = get_shuffle_manager().partition_sizes(ex.shuffle_id)
     big_pid = max(sizes, key=sizes.get)
-    assert [big_pid] in [g for g in groups if len(g) == 1]
+    sub_units = [u for g in groups for u in g
+                 if isinstance(u, tuple) and u[0] == big_pid]
+    assert len(sub_units) >= 2
+    assert ctx.metrics.get("adaptive_skew_split_partitions", 0) >= 1
 
 
 def test_tpch_q3_unchanged_under_adaptive(tmp_path):
@@ -174,3 +191,60 @@ def test_tpch_q3_unchanged_under_adaptive(tmp_path):
     df = tpch.q3(dev, tables)
     assert df.collect().to_pydict() == \
         DataFrame(df._plan, cpu).collect().to_pydict()
+
+
+def test_skew_split_reads_match_oracle():
+    """A hot shuffle partition splits into multiple independent sub-read
+    units (GpuCustomShuffleReaderExec skew-read role) and the join above
+    still matches the oracle — each sub-read joins against the full
+    build side like Spark's skew-join sub-tasks."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.exec.plan import ExecContext, HostScanExec
+    from spark_rapids_tpu.shuffle.partition import HashPartitioning
+    from spark_rapids_tpu.plan import expressions as E
+
+    rng = np.random.default_rng(9)
+    n = 40_000
+    # ~90% of rows share one hot key -> one partition dwarfs the rest
+    keys = np.where(rng.random(n) < 0.9, 7,
+                    rng.integers(0, 64, n)).astype(np.int64)
+    tbl = pa.table({"k": pa.array(keys),
+                    "v": pa.array(np.arange(n), pa.int64())})
+    scan = HostScanExec.from_table(tbl, max_rows=2048)  # many map blocks
+    ex = ShuffleExchangeExec(
+        HashPartitioning([E.ColumnRef("k")], 8), scan)
+    conf = TpuConf({
+        "spark.rapids.tpu.sql.adaptive."
+        "advisoryPartitionSizeInBytes": str(16 * 1024)})
+    ctx = ExecContext(conf)
+    rows = 0
+    for db in ex.execute(ctx):
+        rows += int(db.num_rows)
+    assert rows == n                       # nothing lost or duplicated
+    assert ctx.metrics.get("adaptive_skew_split_partitions", 0) >= 1
+    assert ctx.metrics["adaptive_coalesced_groups"] > 2
+
+    # a JOIN whose probe side streams from the skew-split exchange: the
+    # hot key's sub-reads each join against the FULL build side — the
+    # Spark skew-join sub-task shape — and the result matches a python
+    # oracle exactly (session plans do not route through shuffle
+    # exchanges, so this composes the execs directly)
+    from spark_rapids_tpu.exec.join import HashJoinExec
+    dim = pa.table({"k": pa.array(np.arange(64), pa.int64()),
+                    "w": pa.array(np.arange(64) * 10, pa.int64())})
+    ex2 = ShuffleExchangeExec(
+        HashPartitioning([E.ColumnRef("k")], 8),
+        HostScanExec.from_table(tbl, max_rows=2048))
+    join = HashJoinExec("inner", [E.ColumnRef("k")], [E.ColumnRef("k")],
+                        ex2, HostScanExec.from_table(dim))
+    jctx = ExecContext(conf)
+    out = join.collect(jctx)
+    assert jctx.metrics.get("adaptive_skew_split_partitions", 0) >= 1
+    # both sides carry a "k" column; address by position
+    got = sorted(zip(out.column(0).to_pylist(),
+                     out.column(out.num_columns - 1).to_pylist()))
+    want = sorted((int(k), int(k) * 10) for k in keys)
+    assert got == want
