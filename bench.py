@@ -48,7 +48,11 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
-TOTAL_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "480"))
+# With a primed compile cache (same disk), 22 queries need ~10-20 min
+# (cache loads + warm timing + the CPU oracle, which alone costs ~70s on
+# q21); the incremental JSON emit makes an external kill lossless, so a
+# generous default just maximizes what gets measured.
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1800"))
 _T0 = time.perf_counter()
 
 
