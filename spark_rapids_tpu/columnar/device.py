@@ -385,19 +385,107 @@ def _device_column_to_arrow(col: DeviceColumn, num_rows: int,
     return pa.array(data, arrow_type, mask=~valid)
 
 
-def to_host(db: DeviceBatch) -> HostBatch:
-    # ONE D2H round trip for the row count and every lane of every column
-    # (a separate int(num_rows) fetch would double the tunnel RTTs)
+def to_host(db: DeviceBatch, fetch_rows: Optional[int] = None) -> HostBatch:
+    """Bring a batch to host.
+
+    ONE D2H round trip for the row count and every lane of every column
+    (a separate int(num_rows) fetch would double the tunnel RTTs).
+
+    fetch_rows: upper bound on live rows KNOWN BY THE CALLER (a static
+    limit, an already-synced count).  Lanes are device-sliced to it before
+    the transfer, so the tunnel ships live rows instead of the padded
+    bucket capacity — on a high-latency link the padding bytes, not the
+    device compute, dominate a naive fetch (measured: a 1M-row bucket
+    carrying 1,760 live rows shipped 25 MB in 9.2 s; its live prefix is
+    42 KB).  Ragged value lanes are sliced via the (host-known) offsets
+    bound only when the whole column is fetched, because the value count
+    of a row prefix is itself device data."""
+    n, fetched = _fetch_lanes(db, fetch_rows)
+    if fetch_rows is not None:
+        n = min(n, fetch_rows)
+    return _build_host_batch(db, n, fetched)
+
+
+def _fetch_lanes(db: DeviceBatch, fetch_rows: Optional[int]):
+    """device_get count + lanes in one round trip; lanes prefix-sliced to
+    fetch_rows when given.  Returns (clamped live count, fetched lists)."""
+    cols = db.columns
+    if fetch_rows is not None and fetch_rows < db.capacity:
+        h = fetch_rows
+        sl = []
+        for c in cols:
+            if c.offsets is not None:
+                # offsets prefix is enough for rebuild; values lanes keep
+                # full length (their live length is offsets[h], on device)
+                sl.append(dataclasses.replace(
+                    c, offsets=c.offsets[:h + 1]))
+            else:
+                sl.append(dataclasses.replace(
+                    c, data=c.data[:h], validity=c.validity[:h],
+                    data_hi=None if c.data_hi is None else c.data_hi[:h]))
+        cols = sl
     n_f, fetched = jax.device_get(
         (db.num_rows, [(c.data, c.validity, c.data_hi, c.offsets,
-                        c.elem_valid) for c in db.columns]))
-    n = int(n_f)
+                        c.elem_valid) for c in cols]))
+    return int(n_f), fetched        # TRUE count (may exceed fetch_rows)
+
+
+def _build_host_batch(db: DeviceBatch, n: int, fetched) -> HostBatch:
     arrays = [_device_column_to_arrow(c, n, f)
               for c, f in zip(db.columns, fetched)]
     schema = pa.schema([pa.field(n, a.type) for n, a in zip(db.names, arrays)])
     if not arrays:
         return HostBatch(pa.RecordBatch.from_pydict({}))
     return HostBatch(pa.RecordBatch.from_arrays(arrays, schema=schema))
+
+
+# Result-fetch head size: one speculative round trip ships the count plus
+# this many rows; only a larger-than-head result pays a second trip.
+# 4096 rows x ~10 B/lane is ~40 KB/column — well under one RTT's worth of
+# bytes on the ~2 MB/s tunnel, while covering every TPC-H final result.
+RESULT_HEAD_ROWS = 4096
+
+
+def fetch_result_batch(db: DeviceBatch, bound: Optional[int] = None
+                       ) -> HostBatch:
+    """Bring a RESULT batch to host with minimum tunnel traffic.
+
+    The live rows of every operator output are a front prefix of the
+    padded bucket (filters compact, aggregates emit groups first, sorts
+    order dead rows last), so the fetch never needs the padding:
+
+      * static row count           -> one trip, exactly n rows
+      * static bound (limit/top-N) -> one trip, bound rows
+      * unknown count              -> ONE speculative trip fetching the
+        count + a RESULT_HEAD_ROWS prefix together; a second trip only
+        when the result is genuinely bigger than the head.
+
+    Measured on the axon tunnel (~125 ms RTT, ~2 MB/s D2H): a 1M-row
+    bucket with 1,760 live rows cost 9.2 s as a full-capacity fetch and
+    ~0.15 s via the head protocol."""
+    cap = db.capacity
+    if isinstance(db.num_rows, int):
+        return to_host(db, fetch_rows=min(db.num_rows, cap))
+    if any(c.offsets is not None for c in db.columns):
+        # ragged value lanes aren't prefix-sliceable by a row bound (the
+        # value count of a prefix is device data); fetch the cheap scalar
+        # count first so an all-padding bucket never ships its lanes
+        n = int(jax.device_get(db.num_rows))
+        return to_host(db, fetch_rows=max(n, 0) if n < cap else None)
+    # a small static bound buys an exact one-trip fetch; a loose bound
+    # (dense-domain group counts can reach 4M) must not defeat the head
+    # protocol, so past 4x the head size we speculate instead
+    if bound is not None and bound <= 4 * RESULT_HEAD_ROWS:
+        head = min(cap, bound)
+    else:
+        head = min(cap, RESULT_HEAD_ROWS)
+    if head >= cap:
+        return to_host(db)
+    n, fetched = _fetch_lanes(db, head)
+    if n <= head:
+        return _build_host_batch(db, n, fetched)
+    # result larger than the head: pay the second, exactly-sized trip
+    return to_host(db, fetch_rows=n)
 
 
 def empty_device_batch(schema: t.StructType, conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:

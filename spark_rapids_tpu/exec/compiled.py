@@ -271,10 +271,11 @@ class CompiledPlan:
         return outs
 
     def collect(self, ctx: ExecContext) -> pa.Table:
-        from ..columnar.device import to_host
+        from ..columnar.device import fetch_result_batch
         from ..columnar.host import struct_to_schema
         outs = self.execute(ctx)
-        hbs = [to_host(db) for db in outs]
+        bound = self.root.row_upper_bound()
+        hbs = [fetch_result_batch(db, bound) for db in outs]
         batches = [hb.rb for hb in hbs if hb.num_rows > 0]
         if not batches:
             return pa.Table.from_batches(

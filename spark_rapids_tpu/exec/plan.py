@@ -21,7 +21,7 @@ import pyarrow as pa
 
 from .. import types as t
 from ..config import TpuConf, DEFAULT_CONF
-from ..columnar.device import DeviceBatch, to_device, to_host, empty_device_batch
+from ..columnar.device import DeviceBatch, to_device, empty_device_batch
 from ..columnar.host import HostBatch, schema_to_struct
 from ..ops.batch_ops import concat_batches, shrink_to_rows
 from ..ops.filter import compact_batch
@@ -105,6 +105,14 @@ class PlanNode:
         sync-free aligned/semi probe paths for composite keys."""
         return None
 
+    def row_upper_bound(self) -> Optional[int]:
+        """Static UPPER bound on output rows (a limit/top-N cap, a
+        single-row global aggregate), else None.  Drives the result-fetch
+        head size: over a high-latency low-bandwidth link the collect
+        path ships `bound` rows instead of the padded bucket capacity
+        (columnar.device.to_host fetch_rows)."""
+        return self.static_row_count()
+
     def tree_string(self, indent: int = 0) -> str:
         lines = ["  " * indent + self.describe()]
         for c in self.children:
@@ -118,22 +126,18 @@ class PlanNode:
     def collect(self, ctx: Optional[ExecContext] = None) -> pa.Table:
         """Run the plan and bring results back to host (GpuBringBackToHost).
 
-        Transfer policy per batch: small batches fetch count + lanes in
-        ONE round trip (to_host); large batches with a lazy count fetch
-        the scalar count first so an all-padding batch never ships
-        full-capacity lanes over the link."""
+        Transfer policy per batch: fetch_result_batch ships the live-row
+        prefix, not the padded capacity — static counts/bounds in one
+        exactly-sized trip, unknown counts via a speculative
+        count+head-prefix trip (columnar.device.fetch_result_batch)."""
         ctx = ctx or ExecContext()
+        from ..columnar.device import fetch_result_batch
+        bound = self.row_upper_bound()
         hbs = []
         for db in self.execute(ctx):
-            if isinstance(db.num_rows, int):
-                if db.num_rows == 0:
-                    continue
-            elif db.nbytes() > (1 << 20):
-                n = int(db.num_rows)        # cheap scalar vs huge lanes
-                if n == 0:
-                    continue
-                db = DeviceBatch(db.columns, n, db.names, db.origin_file)
-            hbs.append(to_host(db))
+            if isinstance(db.num_rows, int) and db.num_rows == 0:
+                continue
+            hbs.append(fetch_result_batch(db, bound))
         schema = None
         batches = []
         for hb in hbs:
@@ -314,6 +318,9 @@ class ProjectExec(PlanNode):
     def static_row_count(self):
         return self.child.static_row_count()   # projection keeps rows
 
+    def row_upper_bound(self):
+        return self.child.row_upper_bound()
+
     def column_range(self, name):
         from .join import key_ref_names
         if name not in self.names:
@@ -351,6 +358,9 @@ class FilterExec(PlanNode):
 
     def column_range(self, name):
         return self.child.column_range(name)   # subset of values
+
+    def row_upper_bound(self):
+        return self.child.row_upper_bound()    # filter only shrinks
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from .evaluator import compute_predicate
@@ -414,6 +424,21 @@ class HashAggregateExec(PlanNode):
 
     def static_row_count(self) -> Optional[int]:
         return 1 if not self.key_exprs else None
+
+    def row_upper_bound(self):
+        if not self.key_exprs:
+            return 1
+        # bounded key domains bound the group count (dense-domain shapes:
+        # every key has exact range stats)
+        ranges = self._key_ranges()
+        if any(r is None for r in ranges):
+            return None
+        prod = 1
+        for lo, hi in ranges:
+            prod *= (hi - lo + 2)              # +1 span, +1 null slot
+            if prod > (1 << 22):
+                return None
+        return prod
 
     def _strip_filters(self, can_fuse: bool):
         """Peel the chain of FilterExec children this aggregate can fuse;
@@ -755,6 +780,10 @@ class LocalLimitExec(PlanNode):
     def column_range(self, name):
         return self.child.column_range(name)
 
+    def row_upper_bound(self):
+        child = self.child.row_upper_bound()
+        return self.limit if child is None else min(self.limit, child)
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         # Never peek ahead: pulling a second batch before emitting would
         # compute an entire extra upstream batch even when the first one
@@ -810,6 +839,12 @@ class UnionExec(PlanNode):
             return None
         return (min(r[0] for r in rngs), max(r[1] for r in rngs))
 
+    def row_upper_bound(self):
+        bounds = [c.row_upper_bound() for c in self.children]
+        if any(b is None for b in bounds):
+            return None
+        return sum(bounds)
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         names = list(self.output_schema.names)
         for c in self.children:
@@ -840,6 +875,9 @@ class CoalesceBatchesExec(PlanNode):
 
     def column_range(self, name):
         return self.child.column_range(name)
+
+    def row_upper_bound(self):
+        return self.child.row_upper_bound()
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         target = self.target_rows or ctx.conf.batch_size_rows
@@ -889,6 +927,9 @@ class SortExec(PlanNode):
 
     def static_row_count(self):
         return self.child.static_row_count()
+
+    def row_upper_bound(self):
+        return self.child.row_upper_bound()
 
     def column_range(self, name):
         return self.child.column_range(name)
@@ -944,6 +985,10 @@ class TopNExec(PlanNode):
 
     def column_range(self, name):
         return self.child.column_range(name)
+
+    def row_upper_bound(self):
+        child = self.child.row_upper_bound()
+        return self.limit if child is None else min(self.limit, child)
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from ..ops.sort import sort_batch
