@@ -27,8 +27,9 @@ Methodology.
   * Cold numbers (first-run compile or cache load, upload) are reported
     per query and as a median; a persistent-cache hit shows up as a
     small cold time.
-  * Time budgets: BENCH_BUDGET_S (default 480) total; queries that
-    don't fit are listed in "skipped" rather than silently absent.
+  * Time budgets: BENCH_BUDGET_S (default 1800, TOTAL_BUDGET_S below)
+    total; queries that don't fit are listed in "skipped" rather than
+    silently absent.
 
 Run: python bench.py [scale] [--queries q1,q6,...]
 """

@@ -371,6 +371,7 @@ object Json {
         c match {
           case '"' => return sb.toString
           case '\\' =>
+            require(!eof, "unterminated escape")
             val e = s.charAt(pos); pos += 1
             e match {
               case '"' => sb += '"'

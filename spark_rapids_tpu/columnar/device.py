@@ -468,8 +468,11 @@ def fetch_result_batch(db: DeviceBatch, bound: Optional[int] = None
         return to_host(db, fetch_rows=min(db.num_rows, cap))
     if any(c.offsets is not None for c in db.columns):
         # ragged value lanes aren't prefix-sliceable by a row bound (the
-        # value count of a prefix is device data); fetch the cheap scalar
-        # count first so an all-padding bucket never ships its lanes
+        # value count of a prefix is device data).  A small static bound
+        # fetches exactly-sized in one trip; otherwise the cheap scalar
+        # count goes first so an all-padding bucket never ships lanes
+        if bound is not None and bound < cap:
+            return to_host(db, fetch_rows=bound)
         n = int(jax.device_get(db.num_rows))
         return to_host(db, fetch_rows=max(n, 0) if n < cap else None)
     # a small static bound buys an exact one-trip fetch; a loose bound

@@ -524,7 +524,13 @@ class DeltaTable:
             n = tbl.num_rows
             for c in parts:
                 want = sch.field(c).type
-                raw = pv.get(c)
+                # under columnMapping the log keys partitionValues by
+                # PHYSICAL column name (Delta PROTOCOL.md writer
+                # requirement) — translate, falling back to the logical
+                # name for writers that used it
+                raw = pv.get(phys.get(c, c)) if phys else pv.get(c)
+                if raw is None and phys:
+                    raw = pv.get(c)
                 if raw is None or raw == "__HIVE_DEFAULT_PARTITION__":
                     col = pa.nulls(n, want)
                 else:

@@ -112,6 +112,11 @@ def _key_pack_spec(key_cols: List[DeviceColumn],
             spec.append(entry)
         else:
             spec.append(None)
+    # all keys covered -> the scatter-free single-sort-lane group-by
+    # (ops/groupby.py packed_groupby_trace); a partial pack must replace
+    # >=2 lanes to pay for itself
+    if packed == len(key_cols) and packed >= 1:
+        return tuple(spec)
     return tuple(spec) if packed >= 2 else None
 
 
@@ -133,6 +138,8 @@ def _fused_pack_spec(key_exprs, key_ranges) -> "Optional[tuple]":
             spec.append(entry)
         else:
             spec.append(None)
+    if packed == len(key_exprs) and packed >= 1:
+        return tuple(spec)
     return tuple(spec) if packed >= 2 else None
 
 

@@ -527,10 +527,13 @@ def collect_with_fallback(root: PlanNode, ctx: ExecContext,
             return None
         except Exception as e:           # noqa: BLE001
             from ..runtime.memory import is_oom_error
-            holder._compiled_plan = False
             ctx.bump("whole_plan_fallbacks")
             if is_oom_error(e):
-                return None              # eager engine has spill/retry
+                # transient device OOM: run eager THIS time, but keep the
+                # compiled path eligible — memory pressure passes, a
+                # trace error never does
+                return None
+            holder._compiled_plan = False
             raise
         holder._compiled_plan = plan
         ctx.bump("whole_plan_compiled_queries")
@@ -541,10 +544,11 @@ def collect_with_fallback(root: PlanNode, ctx: ExecContext,
         return None
     except Exception as e:               # noqa: BLE001
         from ..runtime.memory import is_oom_error
-        holder._compiled_plan = False
         ctx.bump("whole_plan_fallbacks")
         if is_oom_error(e):
-            return None                  # eager engine has spill/retry
+            return None                  # eager engine has spill/retry;
+                                         # compiled stays eligible
+        holder._compiled_plan = False
         raise
     holder._compiled_plan = plan
     ctx.bump("whole_plan_compiled_queries")

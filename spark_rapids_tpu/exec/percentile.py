@@ -61,6 +61,15 @@ class PercentileAggregateExec(PlanNode):
             if not self.key_exprs:
                 yield self._null_row(conf)
             return
+        from ..plan.aggregates import ApproximatePercentile
+        if len(batches) > 1 and all(isinstance(fn, ApproximatePercentile)
+                                    for fn, _ in self.aggs):
+            # PARTIAL/FINAL split: per-partition device sketches merged
+            # on host — the distributed shape (each batch = one
+            # partition's rows; multi-host shards arrive the same way).
+            # Ref: GpuApproximatePercentile.scala t-digest partial/merge.
+            yield self._sketched(batches, ctx)
+            return
         merged = concat_batches(batches, conf)
 
         # one value column per DISTINCT input expression; each carries
@@ -120,6 +129,102 @@ class PercentileAggregateExec(PlanNode):
         db = DeviceBatch(cols, n_out,
                          self.key_names + [n for _f, n in self.aggs])
         yield shrink_to_rows(db, n_out, conf)
+
+    def _sketched(self, batches, ctx: ExecContext) -> DeviceBatch:
+        """Device sketch build per input batch (the PARTIAL), host merge
+        per group across batches, interpolated FINAL."""
+        import numpy as np
+        import pyarrow as pa
+        from ..columnar.device import to_device
+        from ..columnar.host import HostBatch, dtype_to_arrow
+        from ..ops.kernels import compute_view
+        from ..ops.quantile_sketch import (DEFAULT_K, merge_sketches,
+                                           query_sketch)
+        conf = ctx.conf
+        nk = len(self.key_exprs)
+        val_exprs: List[E.Expression] = []
+        val_map: List[Tuple[int, float]] = []
+        fps = {}
+        for fn, _name in self.aggs:
+            fp = repr(fn.child)
+            if fp not in fps:
+                fps[fp] = len(val_exprs)
+                val_exprs.append(_resolved(E.Cast(fn.child, t.DOUBLE)))
+            val_map.append((fps[fp], fn.percentage))
+
+        # group key tuple -> per value-expr list of (count, points)
+        merged_sketches: dict = {}
+        key_dtypes = [e.dtype for e in self.key_exprs]
+        for db in batches:
+            proj = evaluate_projection(
+                self.key_exprs + val_exprs,
+                [f"_k{i}" for i in range(nk)] +
+                [f"_v{j}" for j in range(len(val_exprs))], db, conf)
+            key_cols = [ensure_unique_dict(c) for c in proj.columns[:nk]]
+            val_cols = proj.columns[nk:]
+            live = db.row_mask()
+            capacity = db.capacity
+            info = tuple((c.dtype, True, str(c.data.dtype))
+                         for c in key_cols)
+            for j, vcol in enumerate(val_cols):
+                sig = ("sketch", info, DEFAULT_K, capacity,
+                       str(vcol.data.dtype))
+                fn = _TRACE_CACHE.get(sig)
+                if fn is None:
+                    fn = jax.jit(P.sketch_trace(
+                        list(info), DEFAULT_K, capacity, capacity))
+                    _TRACE_CACHE[sig] = fn
+                vdata = compute_view(vcol.data, vcol.dtype)
+                ok, cnt, pts, ng = fn(
+                    tuple(c.data for c in key_cols),
+                    tuple(c.validity for c in key_cols),
+                    vdata.astype(jnp.float64), vcol.validity, live)
+                ng = int(ng)
+                fetched = jax.device_get(
+                    ([(kd[:ng], kv[:ng]) for kd, kv in ok],
+                     cnt[:ng], pts[:ng]))
+                oks, cnt_h, pts_h = fetched
+                for g in range(ng):
+                    kt = []
+                    for (kd, kv), kc in zip(oks, key_cols):
+                        if not kv[g]:
+                            kt.append(None)
+                        elif kc.dictionary is not None:
+                            kt.append(str(kc.dictionary[int(kd[g])]))
+                        elif isinstance(kc.dtype, t.DoubleType) and \
+                                np.asarray(kd).dtype == np.int64:
+                            # host-loaded doubles ride as f64 BIT
+                            # PATTERNS in the int64 storage lane
+                            kt.append(float(np.int64(kd[g]).view(
+                                np.float64)))
+                        else:
+                            kt.append(kd[g].item())
+                    slot = merged_sketches.setdefault(
+                        tuple(kt), [[] for _ in val_exprs])
+                    slot[j].append((int(cnt_h[g]), pts_h[g]))
+
+        if not merged_sketches and not self.key_exprs:
+            merged_sketches[()] = [[] for _ in val_exprs]
+        keys_out = sorted(merged_sketches.keys(),
+                          key=lambda kt: tuple(
+                              (v is None, v) for v in kt))
+        arrays = []
+        for i in range(nk):
+            vals = [kt[i] for kt in keys_out]
+            arrays.append(pa.array(vals, dtype_to_arrow(key_dtypes[i])))
+        # merge once per (group, value column); percentiles share it
+        final = {kt: [merge_sketches(slots[jj])
+                      for jj in range(len(val_exprs))]
+                 for kt, slots in merged_sketches.items()}
+        for i, (jj, q) in enumerate(val_map):
+            arrays.append(pa.array(
+                [query_sketch(*final[kt][jj], q) for kt in keys_out],
+                pa.float64()))
+        names = self.key_names + [n for _f, n in self.aggs]
+        rb = pa.RecordBatch.from_arrays(
+            arrays, schema=pa.schema(
+                [pa.field(n, a.type) for n, a in zip(names, arrays)]))
+        return to_device(HostBatch(rb), conf)
 
     def _null_row(self, conf) -> DeviceBatch:
         from ..columnar.device import bucket_capacity

@@ -61,6 +61,22 @@ def grouped_take(lanes, idx: jax.Array):
     return [out[i] for i in range(len(lanes))]
 
 
+def take_keys_valid(keys, keys_valid, extra, idx):
+    """grouped_take of key lanes + their (possibly None) validity lanes
+    + extra lanes at `idx`, preserving None validity slots.
+
+    Returns (keys_out, keys_valid_out, extra_out).  One stacked gather
+    pass per dtype class — the shared permute idiom of the sort-segment
+    kernels (groupby/percentile), kept in one place so the lane
+    bookkeeping cannot drift between copies."""
+    kv = [v for v in keys_valid if v is not None]
+    moved = grouped_take(list(keys) + kv + list(extra), idx)
+    nk = len(keys)
+    it = iter(moved[nk:nk + len(kv)])
+    out_kv = [None if v is None else next(it) for v in keys_valid]
+    return moved[:nk], out_kv, moved[nk + len(kv):]
+
+
 def _compact_trace(ncols: int, has_hi: Tuple[bool, ...]):
     def run(datas, valids, his, keep):
         order = compaction_order(keep)

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as t
-from .kernels import compute_view
+from .kernels import blocked_cummax, blocked_cumsum, compute_view
 
 
 # Aggregate kernel op kinds understood by the kernel.
@@ -127,18 +127,12 @@ _ORDER_MIN = np.int64(-2**63)
 
 
 
-def _batched_sums(agg_specs, spec_vls, live_all, seg, num_segments,
-                  reindex):
-    """ONE wide (N, K) segment_sum for every sum-like lane (SUM buffers,
-    COUNT/COUNT_ALL, per-input valid counts) — TPU scatters pay a fixed
-    serialization cost per pass, so K-wide rows amortize it (measured
-    4.5x for 10 aggregates at 8M rows).  Shared by groupby_trace and
-    dense_groupby_trace so the lane/dtype rules cannot drift.
+def _queue_sum_lanes(agg_specs, spec_vls, live_all):
+    """Collect every sum-like lane (SUM buffers, COUNT/COUNT_ALL,
+    per-input valid counts) into two dtype-class stacks.  Shared by all
+    group-by variants so the lane/dtype rules cannot drift.
 
-    spec_vls: per-spec (data, valid&live) with any permutation already
-    applied; live_all: the COUNT(*) lane; reindex: maps the (S, K)
-    segment output onto the caller's group order.
-    Returns sum_of(key, is_float) -> (G,) lane."""
+    Returns (int_lanes, int_slots, f64_lanes, f64_slots)."""
     int_lanes, int_slots = [], {}
     f64_lanes, f64_slots = [], {}
 
@@ -166,6 +160,21 @@ def _batched_sums(agg_specs, spec_vls, live_all, seg, num_segments,
                       jnp.where(vl, cd.astype(jnp.int64), 0), False)
         if spec.kind not in (COUNT, COUNT_ALL):
             queue(("vc", spec.input_idx), vl.astype(jnp.int64), False)
+    return int_lanes, int_slots, f64_lanes, f64_slots
+
+
+def _batched_sums(agg_specs, spec_vls, live_all, seg, num_segments,
+                  reindex):
+    """ONE wide (N, K) segment_sum for every sum-like lane — TPU scatters
+    pay a fixed serialization cost per pass, so K-wide rows amortize it
+    (measured 4.5x for 10 aggregates at 8M rows).
+
+    spec_vls: per-spec (data, valid&live) with any permutation already
+    applied; live_all: the COUNT(*) lane; reindex: maps the (S, K)
+    segment output onto the caller's group order.
+    Returns sum_of(key, is_float) -> (G,) lane."""
+    int_lanes, int_slots, f64_lanes, f64_slots = _queue_sum_lanes(
+        agg_specs, spec_vls, live_all)
 
     int_out = f64_out = None
     if int_lanes:
@@ -203,6 +212,202 @@ def _packed_key_lane(keys, keys_valid, pack_spec):
     return packed
 
 
+def packed_groupby_trace(pack_spec, key_lanes_info, agg_specs,
+                         num_segments, capacity):
+    """All-keys-packed group-by: ONE sort lane, NO scatters for the
+    sum/count family, group keys decoded arithmetically.
+
+    When every key has a static (lo, span) bound the whole key tuple —
+    including liveness — folds into one integer sort lane.  This changes
+    the cost shape on both axes that dominate this platform:
+
+      * compile: a 2-operand (key, iota) sort compiles in ~30s where a
+        k-key lexsort is minutes (TPU sort compile scales with operand
+        count — measured 164s for 3 int64 lanes at 1M vs 31s for
+        key+payload);
+      * run: per-lane permutation gathers collapse into grouped_take
+        stacks (~one gather pass per dtype class instead of per lane;
+        TPU gathers pay per-row descriptor latency, ~20ms per pass at
+        1M), sums/counts become ONE stacked cumsum + two small gathers
+        at segment boundaries instead of scatter passes (~70ms each at
+        1M, and scatter outputs land in slow S(1)-space buffers), and
+        segment starts come from a single-lane sort instead of a
+        segment_min scatter.
+
+    int64 cumsum-diff is exact for any group sum that fits int64
+    (two's-complement wraparound cancels in the subtraction), matching
+    segment_sum semantics.  MIN/MAX/ignore-null FIRST/LAST and ANY/EVERY
+    keep their segment (scatter) reductions — they are rare in hot
+    aggregations; the sum/count family is what TPC-H grinds on."""
+    spans = [s[1] for s in pack_spec]
+    los = [s[0] for s in pack_spec]
+    strides = []
+    tot = 1
+    for s in reversed(spans):
+        strides.append(tot)
+        tot *= s
+    strides.reverse()
+    total = tot
+    key_dt = jnp.int32 if total < (1 << 31) - 1 else jnp.int64
+
+    def run(keys, keys_valid, agg_data, agg_valid, live):
+        packed = _packed_key_lane(keys, keys_valid, pack_spec)
+        skey = jnp.where(live, packed, jnp.int64(total)).astype(key_dt)
+        iota = jnp.arange(capacity, dtype=jnp.int32)
+        skey_s, perm = jax.lax.sort((skey, iota), num_keys=1,
+                                    is_stable=True)
+        s_live = skey_s < jnp.asarray(total, key_dt)
+        count = jnp.sum(live, dtype=jnp.int32)
+        boundary = jnp.concatenate(
+            [jnp.ones((1,), bool), skey_s[1:] != skey_s[:-1]]) & s_live
+        num_groups = jnp.sum(boundary, dtype=jnp.int32)
+
+        # group start positions, compacted to the front by a SINGLE-lane
+        # sort (scatter-free segment_min)
+        starts = jnp.sort(jnp.where(boundary, iota, jnp.int32(capacity)))
+        starts = starts[:num_segments]
+        group_live = jnp.arange(num_segments, dtype=jnp.int32) < num_groups
+        starts_c = jnp.clip(starts, 0, capacity - 1)
+        nexts = jnp.concatenate(
+            [starts[1:], jnp.full((1,), capacity, jnp.int32)])
+        ends_c = jnp.clip(jnp.minimum(nexts - 1, count - 1), 0,
+                          capacity - 1)
+
+        # keys decode from the packed value — zero key gathers
+        pk = skey_s[starts_c].astype(jnp.int64)
+        out_keys = []
+        for (dt, _hv, lane_dt), lo, span, stride in zip(
+                key_lanes_info, los, spans, strides):
+            slot = (pk // jnp.int64(stride)) % jnp.int64(span)
+            data = (slot - 1 + jnp.int64(lo)).astype(jnp.dtype(lane_dt))
+            out_keys.append((data, (slot > 0) & group_live))
+
+        # permute agg inputs once, stacked by dtype class
+        from .filter import grouped_take
+        need = sorted({s.input_idx for s in agg_specs if s.input_idx >= 0})
+        lanes = []
+        for i in need:
+            v = agg_valid[i]
+            lanes.append(agg_data[i])
+            lanes.append(jnp.ones((capacity,), bool) if v is None else v)
+        moved = grouped_take(lanes, perm) if lanes else []
+        s_in = {}
+        for j, i in enumerate(need):
+            s_in[i] = (moved[2 * j], moved[2 * j + 1] & s_live)
+
+        spec_vls = []
+        for spec in agg_specs:
+            if spec.input_idx >= 0:
+                spec_vls.append(s_in[spec.input_idx])
+            else:
+                spec_vls.append((None, s_live))
+
+        # ---- sum/count family ----
+        # ints/counts: ONE stacked cumsum + two small boundary gathers
+        # (int64 wraparound cancels in the diff — exact whenever the
+        # group sum fits int64, segment_sum's own contract).  floats:
+        # cumsum-diff would let one group's sum be absorbed by preceding
+        # groups' magnitudes (running total ulp >> group sum), so f64
+        # keeps the per-segment scatter reduction.
+        int_lanes, int_slots, f64_lanes, f64_slots = _queue_sum_lanes(
+            agg_specs, spec_vls, s_live)
+
+        int_out = f64_out = None
+        if int_lanes:
+            cs = blocked_cumsum(jnp.stack(int_lanes, axis=1))
+            hi = cs[ends_c]
+            lo_ = jnp.where((starts_c > 0)[:, None],
+                            cs[jnp.maximum(starts_c - 1, 0)], 0)
+            int_out = hi - lo_
+        if f64_lanes:
+            f64_out = jax.ops.segment_sum(
+                jnp.stack(f64_lanes, axis=1),
+                blocked_cumsum(boundary.astype(jnp.int32)) - 1,
+                num_segments=num_segments)
+
+        def sum_of(key, is_float):
+            return (f64_out[:, f64_slots[key]] if is_float
+                    else int_out[:, int_slots[key]])
+
+        # ---- the rare holistic kinds keep segment (scatter) reductions
+        seg_ids = None
+
+        def seg():
+            nonlocal seg_ids
+            if seg_ids is None:
+                seg_ids = blocked_cumsum(boundary.astype(jnp.int32)) - 1
+                # dead rows continue the last segment; their vl is False
+                seg_ids = jnp.clip(seg_ids, 0, num_segments - 1)
+            return seg_ids
+
+        outs = []
+        for si, spec in enumerate(agg_specs):
+            d, vl = spec_vls[si]
+            dt = spec.dtype
+            if spec.kind in (COUNT, COUNT_ALL):
+                outs.append((sum_of(("cnt", si), False), group_live))
+                continue
+            valid_count = sum_of(("vc", spec.input_idx), False)
+            out_valid = (valid_count > 0) & group_live
+            cd = compute_view(d, dt)
+            if spec.kind == SUM:
+                data = sum_of(("sum", si), t.is_floating(dt))
+            elif spec.kind == FIRST:
+                data = cd[starts_c]
+                out_valid = vl[starts_c] & group_live
+            elif spec.kind == LAST:
+                data = cd[ends_c]
+                out_valid = vl[ends_c] & group_live
+            elif spec.kind in (MIN, MAX):
+                is_min = spec.kind == MIN
+                if isinstance(dt, t.DoubleType) and d.dtype == jnp.int64:
+                    o = _bits_total_order(d)
+                    ident = jnp.int64(_ORDER_MAX if is_min else _ORDER_MIN)
+                    o = jnp.where(vl, o, ident)
+                    red = (jax.ops.segment_min if is_min
+                           else jax.ops.segment_max)(
+                        o, seg(), num_segments=num_segments)
+                    data = _bits_from_order(red)
+                elif t.is_floating(dt):
+                    data = _segment_minmax_float(cd, vl, seg(),
+                                                 num_segments, is_min)
+                else:
+                    if isinstance(dt, t.BooleanType):
+                        ident = jnp.asarray(is_min)
+                    else:
+                        info = np.iinfo(np.dtype(cd.dtype))
+                        ident = jnp.asarray(info.max if is_min
+                                            else info.min, cd.dtype)
+                    acc = jnp.where(vl, cd, ident)
+                    data = (jax.ops.segment_min if is_min
+                            else jax.ops.segment_max)(
+                        acc, seg(), num_segments=num_segments)
+            elif spec.kind in (FIRST_NN, LAST_NN):
+                big = jnp.int32(capacity)
+                is_first = spec.kind == FIRST_NN
+                masked = jnp.where(vl, iota, big if is_first else -1)
+                pick = (jax.ops.segment_min if is_first
+                        else jax.ops.segment_max)(
+                    masked, seg(), num_segments=num_segments)
+                pick = jnp.clip(pick, 0, capacity - 1)
+                data = cd[pick]
+                out_valid = vl[pick] & group_live
+            elif spec.kind == ANY:
+                data = jax.ops.segment_max(
+                    jnp.where(vl, cd, False).astype(jnp.int8), seg(),
+                    num_segments=num_segments) > 0
+            elif spec.kind == EVERY:
+                data = jax.ops.segment_min(
+                    jnp.where(vl, cd, True).astype(jnp.int8), seg(),
+                    num_segments=num_segments) > 0
+            else:
+                raise ValueError(f"unknown agg kind {spec.kind}")
+            outs.append((data, out_valid))
+        return out_keys, outs, num_groups
+
+    return run
+
+
 def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity,
                   pack_spec=None):
     """Build the traced groupby fn for jit.
@@ -220,6 +425,13 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity,
     gathers are the expensive op on TPU, masked VPU work is nearly free.
     """
     packed_idx = {i for i, s in enumerate(pack_spec or []) if s is not None}
+    if pack_spec is not None and len(packed_idx) == len(key_lanes_info):
+        tot = 1
+        for _lo, span in pack_spec:
+            tot *= span
+        if tot <= (1 << 62):
+            return packed_groupby_trace(pack_spec, key_lanes_info,
+                                        agg_specs, num_segments, capacity)
 
     def key_sort_lanes(keys, keys_valid):
         """[(lanes...)] for sorting/boundaries: packed keys collapse into
@@ -236,14 +448,17 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity,
         return lanes
 
     def run(keys, keys_valid, agg_data, agg_valid, live):
+        from .filter import grouped_take, take_keys_valid
         # --- 1. sort ---
         lanes = key_sort_lanes(keys, keys_valid)
         # lexsort: LAST key is primary -> order [secondary..., primary]
         sort_keys = list(reversed(lanes)) + [(~live).astype(jnp.int8)]
         perm = jnp.lexsort(sort_keys)
-        s_live = live[perm]
-        s_keys = [k[perm] for k in keys]
-        s_keys_valid = [None if v is None else v[perm] for v in keys_valid]
+        # ONE stacked gather pass per dtype class for every permuted lane
+        # (keys, key validity, liveness) — TPU gathers pay per row, not
+        # per byte, so per-lane takes multiply a ~20ms/1M latency cost
+        s_keys, s_keys_valid, (s_live,) = take_keys_valid(
+            keys, keys_valid, [live], perm)
 
         # --- 2. boundaries ---
         boundary = jnp.zeros((capacity,), bool)
@@ -255,36 +470,43 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity,
                                      s_live[1:] != s_live[:-1]])
         boundary = boundary | pad_start
 
-        seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+        seg_ids = blocked_cumsum(boundary.astype(jnp.int32)) - 1
         count = jnp.sum(live, dtype=jnp.int32)
         num_groups = jnp.where(count > 0,
                                seg_ids[jnp.maximum(count - 1, 0)] + 1, 0)
 
         # --- 3. group keys: first row of each segment ---
+        # seg ids rise with position, so the g-th boundary (position
+        # order) IS segment g's start: ONE single-lane sort compacts the
+        # boundary positions — no segment_min scatter
         big = jnp.int32(capacity)
-        start_idx = jax.ops.segment_min(
-            jnp.arange(capacity, dtype=jnp.int32), seg_ids,
-            num_segments=num_segments)
+        iota = jnp.arange(capacity, dtype=jnp.int32)
+        start_idx = jnp.sort(jnp.where(boundary, iota, big))[:num_segments]
         start_idx = jnp.clip(start_idx, 0, capacity - 1)
+        group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+        okds, okvs, _ = take_keys_valid(s_keys, s_keys_valid, [],
+                                        start_idx)
         out_keys = []
-        for kd, kv in zip(s_keys, s_keys_valid):
-            okd = kd[start_idx]
-            okv = (jnp.ones((capacity,), bool) if kv is None else kv[start_idx])
-            group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+        for okd, okv in zip(okds, okvs):
+            okv = jnp.ones((capacity,), bool) if okv is None else okv
             out_keys.append((okd, okv & group_live))
 
         # --- 4. aggregates ---
-        group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+        need = sorted({s.input_idx for s in agg_specs if s.input_idx >= 0})
+        in_lanes = []
+        for i in need:
+            v = agg_valid[i]
+            in_lanes.append(agg_data[i])
+            in_lanes.append(jnp.ones((capacity,), bool) if v is None else v)
+        moved_in = grouped_take(in_lanes, perm) if in_lanes else []
+        s_in = {i: (moved_in[2 * j], moved_in[2 * j + 1] & s_live)
+                for j, i in enumerate(need)}
         spec_vls = []
         for spec in agg_specs:
             if spec.input_idx >= 0:
-                d = agg_data[spec.input_idx][perm]
-                v = agg_valid[spec.input_idx]
-                v = (jnp.ones((capacity,), bool) if v is None else v)[perm]
+                spec_vls.append(s_in[spec.input_idx])
             else:
-                d, v = None, s_live
-            vl = (v & s_live) if d is not None else s_live
-            spec_vls.append((d, vl))
+                spec_vls.append((None, s_live))
         sum_of = _batched_sums(agg_specs, spec_vls, s_live, seg_ids,
                                num_segments, lambda a: a)
 

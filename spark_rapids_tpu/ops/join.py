@@ -43,6 +43,7 @@ import numpy as np
 from .. import types as t
 from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
 from ..config import TpuConf, DEFAULT_CONF
+from .kernels import blocked_cummax, blocked_cumsum
 from .search import searchsorted
 
 
@@ -254,7 +255,7 @@ class BuildTable:
                 jnp.int32(1), mode="drop")
             self._offs = jnp.concatenate(
                 [jnp.zeros((1,), jnp.int32),
-                 jnp.cumsum(counts, dtype=jnp.int32)])
+                 blocked_cumsum(counts.astype(jnp.int32))])
         return self._offs
 
     @property
@@ -304,26 +305,33 @@ _PROBE_CACHE = {}
 def _merge_rank(sorted_vals: jax.Array, queries: jax.Array,
                 side: str) -> jax.Array:
     """np.searchsorted(sorted_vals, queries, side) without binary search:
-    ONE variadic sort merges both lanes and ranks fall out of a cumsum
+    a stable sort merges both lanes and ranks fall out of a cumsum
     (log-step searchsorted gathers are the slowest access pattern on
-    TPU — ~2.1s at 2M/4M vs ~0.2s for the merge on v5e)."""
+    TPU — ~2.1s at 2M/4M vs ~0.2s for the merge on v5e).
+
+    Tie order rides STABILITY, not a tag lane ('left' concatenates
+    queries first so equal keys land after them; 'right' the reverse),
+    and the rank inversion back to query order is a second stable sort
+    on the id payload — both are 2-operand (key, payload) sorts.  TPU
+    sort compile time scales with operand count (a 3-operand variadic
+    sort costs minutes at 1M) and scatter outputs land in slow S(1)
+    buffers, so two lean sorts beat one wide sort plus a scatter on
+    both axes."""
     n = sorted_vals.shape[0]
     m = queries.shape[0]
-    # tie order: 'left' counts keys strictly below (queries first on
-    # equal), 'right' counts keys at-or-below (keys first)
-    kt, qt = (1, 0) if side == "left" else (0, 1)
-    vals = jnp.concatenate([sorted_vals, queries])
-    tags = jnp.concatenate([jnp.full((n,), kt, jnp.int8),
-                            jnp.full((m,), qt, jnp.int8)])
-    pos = jnp.concatenate([jnp.zeros((n,), jnp.int32),
-                           jnp.arange(m, dtype=jnp.int32)])
-    _v, s_tags, s_pos = jax.lax.sort((vals, tags, pos), num_keys=2,
-                                     is_stable=True)
-    is_key = s_tags == jnp.int8(kt)
-    cum = jnp.cumsum(is_key.astype(jnp.int32))
-    tgt = jnp.where(is_key, m, s_pos)
-    return jnp.zeros((m,), jnp.int32).at[tgt].set(
-        jnp.where(is_key, 0, cum), mode="drop")
+    if side == "left":
+        vals = jnp.concatenate([queries, sorted_vals])
+        qlo = 0                         # query ids occupy [0, m)
+    else:
+        vals = jnp.concatenate([sorted_vals, queries])
+        qlo = n                         # query ids occupy [n, n+m)
+    ids = jnp.arange(n + m, dtype=jnp.int32)
+    _v, s_ids = jax.lax.sort((vals, ids), num_keys=1, is_stable=True)
+    is_key = (s_ids < qlo) | (s_ids >= qlo + m)
+    cum = blocked_cumsum(is_key.astype(jnp.int32))
+    # ranks back in query order: id-sort and slice the query span
+    _i, ranks = jax.lax.sort((s_ids, cum), num_keys=1, is_stable=True)
+    return ranks[qlo:qlo + m]
 
 
 def _dense_probe_pos(lane: jax.Array, probe_valid: jax.Array,
@@ -447,7 +455,7 @@ def probe_counts(build: BuildTable, probe_lanes: List[jax.Array],
                 lo = jnp.take(offs, pos)
                 hi = jnp.take(offs, pos + 1)
                 counts = jnp.where(inb, hi - lo, 0).astype(jnp.int32)
-                return lo, counts, jnp.cumsum(counts)
+                return lo, counts, blocked_cumsum(counts)
             fn = jax.jit(run)
             _PROBE_CACHE[sig] = fn
         lo, counts, cum = fn(build.offs, probe_lanes[0], probe_valid)
@@ -465,7 +473,7 @@ def probe_counts(build: BuildTable, probe_lanes: List[jax.Array],
             lo = jnp.minimum(lo, valid_count)
             hi = jnp.minimum(hi, valid_count)
             counts = jnp.where(pvalid, hi - lo, 0).astype(jnp.int32)
-            cum = jnp.cumsum(counts)
+            cum = blocked_cumsum(counts)
             return lo.astype(jnp.int32), counts, cum
         fn = jax.jit(run)
         _PROBE_CACHE[sig] = fn
@@ -505,11 +513,23 @@ def expand_pairs(build: BuildTable, probe_lanes: List[jax.Array],
             i = jnp.arange(out_cap, dtype=jnp.int32)
             pair_live = i < total
             starts = (cum_ - counts_).astype(jnp.int32)
+            # pair ownership by MERGE, not scatter: sort probe range
+            # starts together with the output slots (starts win ties so a
+            # start owns its own slot), cummax the owning probe row
+            # forward in merged order, then invert by the id payload —
+            # two 2-operand sorts; scatter outputs land in slow S(1)
+            # buffers and the variadic alternative is compile-hostile
             tgt = jnp.where(counts_ > 0, starts, out_cap)
-            rowmark = jnp.full((out_cap,), -1, jnp.int32).at[tgt].max(
-                jnp.arange(pcap, dtype=jnp.int32), mode="drop")
-            probe_idx = jnp.maximum(
-                jax.lax.cummax(rowmark), 0).astype(jnp.int32)
+            vals = jnp.concatenate([tgt, i])
+            ids = jnp.arange(pcap + out_cap, dtype=jnp.int32)
+            _v, s_ids = jax.lax.sort((vals, ids), num_keys=1,
+                                     is_stable=True)
+            is_start = s_ids < pcap
+            mark = jnp.where(is_start, s_ids, -1)
+            owner = blocked_cummax(mark)
+            _i, owner_by_id = jax.lax.sort((s_ids, owner), num_keys=1,
+                                           is_stable=True)
+            probe_idx = jnp.maximum(owner_by_id[pcap:], 0).astype(jnp.int32)
             off = i - jnp.take(starts, probe_idx)
             pos = jnp.take(lo_, probe_idx) + off
             pos = jnp.clip(pos, 0, bcap - 1)

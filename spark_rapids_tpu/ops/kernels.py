@@ -74,3 +74,47 @@ def live_mask(capacity: int, num_rows: jax.Array) -> jax.Array:
 
 def zeros_like_storage(dt: t.DataType, capacity: int) -> jax.Array:
     return jnp.zeros((capacity,), dtype=t.physical_np_dtype(dt))
+
+
+# ---------------------------------------------------------------------------
+# Blocked cumulative scans
+# ---------------------------------------------------------------------------
+# XLA:TPU lowers a long 1-D cumsum/cummax into a log-depth associative
+# scan whose COMPILE time is brutal on this platform (measured: 44-50s
+# for one 1M-row int64 cumsum; 2s for the blocked form).  Splitting into
+# fixed 512-row blocks keeps every scan window small (compiles in
+# seconds) and runs as three cheap elementwise/reduce passes.
+
+_SCAN_BLOCK = 512
+_SCAN_MIN = 4096            # below this the native scan compiles fine
+
+
+def blocked_cumsum(a: jax.Array, axis: int = 0) -> jax.Array:
+    """jnp.cumsum along axis 0 (1-D or 2-D input), TPU-compile-friendly."""
+    assert axis == 0
+    n = a.shape[0]
+    if n < _SCAN_MIN or n % _SCAN_BLOCK != 0:
+        return jnp.cumsum(a, axis=0)
+    nb = n // _SCAN_BLOCK
+    blocks = a.reshape((nb, _SCAN_BLOCK) + a.shape[1:])
+    within = jnp.cumsum(blocks, axis=1)
+    totals = within[:, -1]
+    offs = jnp.cumsum(totals, axis=0) - totals
+    return (within + offs[:, None]).reshape(a.shape)
+
+
+def blocked_cummax(a: jax.Array) -> jax.Array:
+    """lax.cummax along axis 0 (1-D input), TPU-compile-friendly."""
+    n = a.shape[0]
+    if n < _SCAN_MIN or n % _SCAN_BLOCK != 0:
+        return jax.lax.cummax(a, axis=0)
+    nb = n // _SCAN_BLOCK
+    blocks = a.reshape(nb, _SCAN_BLOCK)
+    within = jax.lax.cummax(blocks, axis=1)
+    totals = within[:, -1]
+    offs = jax.lax.cummax(totals, axis=0)
+    ident = (jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.inexact)
+             else jnp.iinfo(a.dtype).min)
+    shifted = jnp.concatenate(
+        [jnp.full((1,), ident, a.dtype), offs[:-1]])
+    return jnp.maximum(within, shifted[:, None]).reshape(n)

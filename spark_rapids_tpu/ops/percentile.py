@@ -23,7 +23,7 @@ import numpy as np
 
 from .. import types as t
 from .groupby import _eq_prev, _null_first_key_lanes
-from .kernels import compute_view
+from .kernels import blocked_cumsum, compute_view
 
 
 def sorted_segments(key_lanes_info, keys, keys_valid, live,
@@ -37,6 +37,7 @@ def sorted_segments(key_lanes_info, keys, keys_valid, live,
 
     `minor_lanes` order rows WITHIN a group (value lanes, null flags);
     they do not contribute to boundaries."""
+    from .filter import take_keys_valid
     lanes = []
     for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, keys, keys_valid):
         sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
@@ -45,9 +46,10 @@ def sorted_segments(key_lanes_info, keys, keys_valid, live,
     sort_keys = list(minor_lanes) + list(reversed(lanes)) + \
         [(~live).astype(jnp.int8)]
     perm = jnp.lexsort(sort_keys)
-    s_live = live[perm]
-    s_keys = [k[perm] for k in keys]
-    s_keys_valid = [None if v is None else v[perm] for v in keys_valid]
+    # one stacked gather pass per dtype class (TPU gathers pay per row,
+    # ~20ms per 1M-row pass — per-lane takes multiply that)
+    s_keys, s_keys_valid, (s_live,) = take_keys_valid(
+        keys, keys_valid, [live], perm)
 
     boundary = jnp.zeros((capacity,), bool).at[0].set(True)
     for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, s_keys,
@@ -59,24 +61,55 @@ def sorted_segments(key_lanes_info, keys, keys_valid, live,
     pad_start = jnp.concatenate([jnp.ones((1,), bool),
                                  s_live[1:] != s_live[:-1]])
     boundary = boundary | pad_start
-    seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg_ids = blocked_cumsum(boundary.astype(jnp.int32)) - 1
     count = jnp.sum(live, dtype=jnp.int32)
     num_groups = jnp.where(count > 0,
                            seg_ids[jnp.maximum(count - 1, 0)] + 1, 0)
     group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
 
-    start_idx = jax.ops.segment_min(
-        jnp.arange(capacity, dtype=jnp.int32), seg_ids,
-        num_segments=num_segments)
+    # seg ids rise with position, so the g-th boundary IS segment g's
+    # start: a single-lane sort compacts them (no segment_min scatter —
+    # scatter outputs land in slow S(1) buffers on this platform)
+    start_idx = jnp.sort(jnp.where(
+        boundary, jnp.arange(capacity, dtype=jnp.int32),
+        jnp.int32(capacity)))[:num_segments]
     start_idx = jnp.clip(start_idx, 0, capacity - 1)
+    okds, okvs, _ = take_keys_valid(s_keys, s_keys_valid, [], start_idx)
     out_keys = []
-    for kd, kv in zip(s_keys, s_keys_valid):
-        okd = kd[start_idx]
-        okv = (jnp.ones((capacity,), bool) if kv is None
-               else kv[start_idx])
+    for okd, okv in zip(okds, okvs):
+        okv = jnp.ones((capacity,), bool) if okv is None else okv
         out_keys.append((okd, okv & group_live))
     return (perm, s_live, s_keys, s_keys_valid, seg_ids, start_idx,
             out_keys, num_groups, group_live)
+
+
+def sketch_trace(key_lanes_info, k: int, num_segments: int,
+                 capacity: int):
+    """Traced PARTIAL of the mergeable approx_percentile: per group, the
+    non-null count and k equi-rank order statistics
+    (ops/quantile_sketch.py; reference GpuApproximatePercentile.scala
+    builds cuDF t-digests in partial mode).  Returns
+    (out_keys, cnt, points[num_segments, k], num_groups)."""
+    from .quantile_sketch import sketch_gather
+
+    def run(keys, keys_valid, val, val_valid, live):
+        vlive = live & val_valid
+        isnan = jnp.isnan(val)
+        clean = jnp.where(isnan, 0.0, val)
+        minor = [clean, isnan.astype(jnp.int8), (~vlive).astype(jnp.int8)]
+        (perm, _s_live, _sk, _skv, seg_ids, start_idx, out_keys,
+         num_groups, _group_live) = sorted_segments(
+            key_lanes_info, keys, keys_valid, live, minor, capacity,
+            num_segments)
+        s_vlive = vlive[perm]
+        s_val = val[perm]
+        cnt = jax.ops.segment_sum(s_vlive.astype(jnp.int32), seg_ids,
+                                  num_segments=num_segments)
+        pts = sketch_gather(s_val, start_idx, cnt, k, num_segments,
+                            capacity)
+        return out_keys, cnt, pts, num_groups
+
+    return run
 
 
 def percentile_trace(key_lanes_info, qs: Sequence[float],

@@ -1,0 +1,106 @@
+"""Mergeable quantile sketch: device build, host merge.
+
+Role of the reference's t-digest approx_percentile
+(/root/reference/sql-plugin/src/main/scala/org/apache/spark/sql/rapids/
+aggregate/GpuApproximatePercentile.scala — cuDF t-digest build/merge with
+fixed `delta` centroids): a FIXED-SIZE summary per group that partial
+aggregation can build on device and a final aggregation can merge across
+an exchange, so distributed approx_percentile has the same partial/final
+shape as every other aggregate instead of silently degrading to an
+exact-sort single-node algorithm.
+
+TPU-first formulation — an equi-rank summary rather than a centroid
+tree: the partial sorts its rows once (the sort-segment machinery the
+exact percentile already rides) and keeps, per group, the row count and
+K order statistics at evenly spaced ranks.  Merging summaries is a
+weighted-percentile resample (tiny: K points per input, numpy on host).
+Rank error is <= 1/(2(K-1)) per level and levels only add — two levels
+(partial -> final) stay well inside the reference t-digest's own
+delta=100 centroid resolution at the default K.
+
+NaN ordering follows Spark doubles (NaN greatest); nulls never enter a
+sketch (count excludes them, matching ApproximatePercentile semantics).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# number of stored order statistics per group summary — matches the
+# reference t-digest's default resolution class (delta=100 centroids)
+# with margin; 129 f64 lanes per group keeps the partial buffer small
+DEFAULT_K = 129
+
+
+def sketch_gather(s_val: jax.Array, start_idx: jax.Array,
+                  cnt: jax.Array, k: int, num_segments: int,
+                  capacity: int):
+    """Per-group equi-rank samples from value-sorted rows.
+
+    s_val: value lane sorted by (group, value) — the sorted_segments
+    layout; start_idx/cnt: per-group first row and non-null count.
+    Returns points (num_segments, k): for group g, point j sits at rank
+    round(j*(cnt-1)/(k-1)).  Empty groups produce zeros (masked by the
+    caller via cnt == 0)."""
+    j = jnp.arange(k, dtype=jnp.float64)
+    n1 = jnp.maximum(cnt.astype(jnp.float64) - 1.0, 0.0)
+    ranks = jnp.round(j[None, :] * (n1[:, None] / (k - 1))).astype(jnp.int32)
+    pos = jnp.clip(start_idx[:, None] + ranks, 0, capacity - 1)
+    return s_val[pos]
+
+
+def merge_sketches(parts: Sequence[Tuple[int, np.ndarray]],
+                   k: int = DEFAULT_K) -> Tuple[int, np.ndarray]:
+    """Merge (count, points[k]) summaries into one — host side, numpy.
+
+    Each input point represents count/k rows (endpoints half-weight, the
+    standard trapezoid weighting for equi-rank samples).  The merged
+    summary resamples the weighted union at k even ranks.  The operation
+    is associative up to the summary's own rank error (tested)."""
+    parts = [(int(n), np.asarray(p, np.float64)) for n, p in parts
+             if int(n) > 0]
+    if not parts:
+        return 0, np.zeros(k, np.float64)
+    if len(parts) == 1:
+        return parts[0]
+    vals = []
+    wts = []
+    for n, pts in parts:
+        m = len(pts)
+        w = np.full(m, n / max(m - 1, 1), np.float64)
+        w[0] *= 0.5
+        w[-1] *= 0.5
+        vals.append(pts)
+        wts.append(w)
+    v = np.concatenate(vals)
+    w = np.concatenate(wts)
+    # NaN sorts greatest (Spark double order)
+    order = np.argsort(np.where(np.isnan(v), np.inf, v), kind="stable")
+    nan_last = np.argsort(np.isnan(v[order]), kind="stable")
+    order = order[nan_last]
+    v = v[order]
+    cw = np.cumsum(w[order])
+    total = cw[-1]
+    n_out = sum(n for n, _ in parts)
+    target = np.linspace(0.0, total, k)
+    idx = np.searchsorted(cw, target, side="left")
+    idx = np.clip(idx, 0, len(v) - 1)
+    return n_out, v[idx]
+
+
+def query_sketch(n: int, pts: np.ndarray, q: float) -> float:
+    """Quantile estimate with linear interpolation between stored ranks
+    (Spark percentile interpolation applied to the summary)."""
+    if n <= 0:
+        return None
+    k = len(pts)
+    pos = q * (k - 1)
+    lo = int(np.floor(pos))
+    hi = min(lo + 1, k - 1)
+    frac = pos - lo
+    if frac == 0.0:
+        return float(pts[lo])
+    return float(pts[lo] * (1 - frac) + pts[hi] * frac)

@@ -34,7 +34,7 @@ from .. import types as t
 from ..plan.window import WindowFrame
 from .groupby import (_bits_from_order, _bits_total_order,
                       _null_first_key_lanes, _ORDER_MAX, _ORDER_MIN)
-from .kernels import compute_view
+from .kernels import blocked_cumsum, compute_view
 
 
 def _seg_scan(vals: jax.Array, boundary: jax.Array, op) -> jax.Array:
@@ -147,21 +147,27 @@ def _merge_rank_counts(seg, u, query, query_first: bool, part_start,
     frames with per-row searches; log-step searchsorted is the slowest
     access pattern on TPU, a merge sort rides the fast sort network)."""
     idx = jnp.arange(capacity, dtype=jnp.int32)
-    kt, qt = (1, 0) if query_first else (0, 1)
-    segs = jnp.concatenate([seg, seg])
-    vals = jnp.concatenate([u, query])
-    tags = jnp.concatenate([jnp.full((capacity,), kt, jnp.int8),
-                            jnp.full((capacity,), qt, jnp.int8)])
-    pos = jnp.concatenate([idx, idx])
-    _sg, _vl, s_tags, s_pos = jax.lax.sort(
-        (segs, vals, tags, pos), num_keys=3, is_stable=True)
-    is_key = s_tags == jnp.int8(kt)
-    cum = jnp.cumsum(is_key.astype(jnp.int32))
+    # tie order rides STABILITY (query-before-key = concat queries
+    # first), not a tag lane, and the inversion back to row order is a
+    # second 2-operand sort — TPU sort compile scales with operand
+    # count, and scatter outputs land in slow S(1) buffers
+    if query_first:
+        segs = jnp.concatenate([seg, seg])
+        vals = jnp.concatenate([query, u])
+        qlo = 0
+    else:
+        segs = jnp.concatenate([seg, seg])
+        vals = jnp.concatenate([u, query])
+        qlo = capacity
+    ids = jnp.arange(2 * capacity, dtype=jnp.int32)
+    _sg, _vl, s_ids = jax.lax.sort((segs, vals, ids), num_keys=2,
+                                   is_stable=True)
+    is_key = (s_ids < qlo) | (s_ids >= qlo + capacity)
+    cum = blocked_cumsum(is_key.astype(jnp.int32))
     # every batch row is a key, so keys in earlier segments == the
     # segment's starting row index
-    tgt = jnp.where(is_key, 2 * capacity, s_pos)
-    counts = jnp.zeros((capacity,), jnp.int32).at[tgt].set(
-        cum, mode="drop")
+    _i, counts = jax.lax.sort((s_ids, cum), num_keys=1, is_stable=True)
+    counts = counts[qlo:qlo + capacity]
     return counts - part_start
 
 
@@ -175,12 +181,26 @@ def _range_value_bounds(order_lane, order_valid, asc: bool,
     u = order_lane.astype(jnp.int64)
     if not asc:
         u = -u                      # normalize to ascending value space
+    # keep real values off the int64 extremes: the extremes are the null
+    # sentinels below, and u + offset must not wrap (saturating query)
+    u = jnp.clip(u, jnp.int64(int(_ORDER_MIN) + 1),
+                 jnp.int64(int(_ORDER_MAX) - 1))
     if order_valid is not None:
         # null-key rows sit at the segment's head or tail (sort nf);
         # pin their u to that extreme so non-null rows' merge counts
         # step over them correctly (their own bounds are masked below)
         null_u = jnp.int64(_ORDER_MIN if nulls_first else _ORDER_MAX)
         u = jnp.where(order_valid, u, null_u)
+
+    def query(offset: int):
+        # saturate u+offset inside the sentinel-free value band; the
+        # clip bounds are exact python ints, so no intermediate wrap
+        lo_b, hi_b = int(_ORDER_MIN) + 1, int(_ORDER_MAX) - 1
+        lo_c = max(lo_b, lo_b - offset)
+        hi_c = min(hi_b, hi_b - offset)
+        return jnp.clip(u, jnp.int64(lo_c), jnp.int64(hi_c)) + \
+            jnp.int64(offset)
+
     if frame.lower is None:
         lo = part_start
     elif frame.lower == 0:
@@ -188,7 +208,7 @@ def _range_value_bounds(order_lane, order_valid, asc: bool,
     else:
         # offsets are direction-free in the normalized (ascending-u)
         # space: for DESC, "x preceding" = key+x = u-x = u+lower
-        cnt = _merge_rank_counts(seg, u, u + jnp.int64(frame.lower),
+        cnt = _merge_rank_counts(seg, u, query(int(frame.lower)),
                                  query_first=True,
                                  part_start=part_start,
                                  capacity=capacity)
@@ -198,7 +218,7 @@ def _range_value_bounds(order_lane, order_valid, asc: bool,
     elif frame.upper == 0:
         hi = peer_end
     else:
-        cnt = _merge_rank_counts(seg, u, u + jnp.int64(frame.upper),
+        cnt = _merge_rank_counts(seg, u, query(int(frame.upper)),
                                  query_first=False,
                                  part_start=part_start,
                                  capacity=capacity)
@@ -264,7 +284,7 @@ def window_trace(part_info, order_info, val_info, specs_frames,
         part_lanes = _key_eq_lanes(part_info, part_data, part_valid)
         live_lane = (~live).astype(jnp.int8)
         part_b = _boundary_from_lanes(part_lanes + [live_lane], capacity)
-        seg = jnp.cumsum(part_b.astype(jnp.int32)) - 1
+        seg = blocked_cumsum(part_b.astype(jnp.int32)) - 1
 
         order_lanes = _key_eq_lanes(order_info, order_data, order_valid)
         peer_b = (part_b | _boundary_from_lanes(order_lanes, capacity)) \
@@ -276,7 +296,7 @@ def window_trace(part_info, order_info, val_info, specs_frames,
                            seg, capacity)
         part_rows = (part_end - part_start + 1).astype(jnp.int64)
 
-        pg = jnp.cumsum(peer_b.astype(jnp.int32)) - 1
+        pg = blocked_cumsum(peer_b.astype(jnp.int32)) - 1
         peer_start = _seg_scan(idx, peer_b, jnp.minimum)
         peer_end = _gather(jax.ops.segment_max(idx, pg,
                                                num_segments=capacity),
@@ -481,7 +501,7 @@ def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
 
     if kind in ("agg_sum", "agg_count", "agg_avg"):
         def pref_window(lane):
-            p = jnp.cumsum(lane)
+            p = blocked_cumsum(lane)
             hi_v = _gather(p, hi, capacity)
             lo_v = jnp.where(lo > 0, _gather(p, lo - 1, capacity),
                              jnp.zeros((), p.dtype))
@@ -519,7 +539,7 @@ def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
             c_cnt = c_cnt + cand_v.astype(jnp.int64)
         red = best
     def pref_cnt(lane):
-        p = jnp.cumsum(lane)
+        p = blocked_cumsum(lane)
         hi_v = _gather(p, hi, capacity)
         lo_v = jnp.where(lo > 0, _gather(p, lo - 1, capacity), jnp.int64(0))
         return jnp.where(nonempty, hi_v - lo_v, jnp.int64(0))

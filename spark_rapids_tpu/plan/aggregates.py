@@ -760,9 +760,10 @@ def _percentile_exact(values, p: float):
 
 
 class Percentile(AggregateFunction):
-    """percentile(col, p) — exact, CPU path (reference GpuPercentile uses
-    a JNI histogram; device-side sort-based percentile can layer on the
-    sort-segment machinery later)."""
+    """percentile(col, p) — exact.  AggregateMeta routes eligible shapes
+    to the DEVICE sort-segment path (exec/percentile.py PercentileExec
+    over ops/percentile.py); this class's cpu_agg is the oracle/fallback
+    (reference GpuPercentile.scala uses a JNI histogram)."""
     name = "percentile"
 
     def __init__(self, child: E.Expression, percentage: float):

@@ -200,3 +200,37 @@ def test_column_mapping_name_mode(tmp_path):
     assert out.column_names == ["id", "name"]
     assert out.column("id").to_pylist() == [1, 2, 3]
     assert out.column("name").to_pylist() == ["x", "y", "z"]
+
+
+def test_column_mapping_partitioned(tmp_path):
+    """Under columnMapping the log keys partitionValues by PHYSICAL name
+    (Delta PROTOCOL.md writer requirement): partition columns must read
+    back from pv[physical], not silently null out (ADVICE r4 medium)."""
+    path = str(tmp_path / "t")
+    os.makedirs(path, exist_ok=True)
+    pq.write_table(pa.table({
+        "col-abc123": pa.array([1, 2], pa.int64())}),
+        os.path.join(path, "part-0.parquet"))
+    schema_string = json.dumps({"type": "struct", "fields": [
+        {"name": "id", "type": "long", "nullable": True,
+         "metadata": {"delta.columnMapping.id": 1,
+                      "delta.columnMapping.physicalName": "col-abc123"}},
+        {"name": "region", "type": "string", "nullable": True,
+         "metadata": {"delta.columnMapping.id": 2,
+                      "delta.columnMapping.physicalName": "col-part9"}},
+    ]})
+    _commit_line(path, 0, [
+        {"protocol": {"minReaderVersion": 2, "minWriterVersion": 5}},
+        {"metaData": {"id": str(uuid.uuid4()), "format": {
+            "provider": "parquet", "options": {}},
+            "schemaString": schema_string,
+            "partitionColumns": ["region"],
+            "configuration": {"delta.columnMapping.mode": "name"},
+            "createdTime": 0}},
+        {"add": {"path": "part-0.parquet",
+                 "partitionValues": {"col-part9": "emea"},
+                 "size": 1, "modificationTime": 0, "dataChange": True}},
+    ])
+    out = DeltaTable(path).read()
+    assert out.column("region").to_pylist() == ["emea", "emea"]
+    assert out.column("id").to_pylist() == [1, 2]

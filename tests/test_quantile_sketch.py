@@ -1,0 +1,88 @@
+"""Mergeable approx_percentile sketch: device build, host merge
+(ops/quantile_sketch.py; reference GpuApproximatePercentile.scala
+t-digest partial/final).  Rank-error contract: |rank(est) - q*n| <=
+eps*n with eps ~ levels/(K-1)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.ops.quantile_sketch import (DEFAULT_K,
+                                                  merge_sketches,
+                                                  query_sketch)
+from spark_rapids_tpu.plan.aggregates import ApproximatePercentile
+from spark_rapids_tpu.session import TpuSession, col
+
+
+def _rank_err(data, est, q):
+    """|empirical rank of est - q| in [0,1]."""
+    s = np.sort(data)
+    r = np.searchsorted(s, est, side="left") / max(len(s) - 1, 1)
+    return abs(r - q)
+
+
+def _sketch_of(vals):
+    """Host-built summary of raw values (the device partial's contract:
+    count + K equi-rank order statistics)."""
+    s = np.sort(np.asarray(vals, np.float64))
+    n = len(s)
+    idx = np.round(np.linspace(0, n - 1, DEFAULT_K)).astype(int)
+    return n, s[idx]
+
+
+def test_merge_matches_exact_within_rank_error():
+    rng = np.random.default_rng(7)
+    a = rng.normal(0, 1, 5000)
+    b = rng.normal(3, 2, 3000)
+    merged = merge_sketches([_sketch_of(a), _sketch_of(b)])
+    allv = np.concatenate([a, b])
+    for q in (0.01, 0.25, 0.5, 0.9, 0.99):
+        est = query_sketch(*merged, q)
+        assert _rank_err(allv, est, q) <= 2.5 / (DEFAULT_K - 1)
+
+
+def test_merge_is_associative_within_rank_error():
+    rng = np.random.default_rng(11)
+    parts = [rng.exponential(s + 1, 2000 + 500 * s) for s in range(3)]
+    sks = [_sketch_of(p) for p in parts]
+    left = merge_sketches([merge_sketches(sks[:2]), sks[2]])
+    right = merge_sketches([sks[0], merge_sketches(sks[1:])])
+    allv = np.concatenate(parts)
+    assert left[0] == right[0] == len(allv)
+    for q in (0.1, 0.5, 0.9):
+        el = query_sketch(*left, q)
+        er = query_sketch(*right, q)
+        assert _rank_err(allv, el, q) <= 3.0 / (DEFAULT_K - 1)
+        assert _rank_err(allv, er, q) <= 3.0 / (DEFAULT_K - 1)
+
+
+def test_distributed_approx_percentile_partial_final():
+    """Grouped approx_percentile over MULTIPLE partitions runs the
+    device-sketch partial + host merge and stays within rank error of
+    exact — the across-an-exchange shape."""
+    rng = np.random.default_rng(3)
+    n = 40_000
+    keys = rng.integers(0, 4, n)
+    vals = rng.normal(keys * 10.0, 1.0 + keys, n)
+    tbl = pa.table({"k": pa.array(keys, pa.int64()),
+                    "x": pa.array(vals, pa.float64())})
+    s = TpuSession({"spark.rapids.tpu.sql.batchSizeRows": str(8192)})
+    out = (s.from_arrow(tbl).group_by("k")
+           .agg((ApproximatePercentile(col("x"), 0.5), "p50"),
+                (ApproximatePercentile(col("x"), 0.9), "p90"))
+           .sort("k").collect().to_pydict())
+    assert out["k"] == [0, 1, 2, 3]
+    for g in range(4):
+        data = vals[keys == g]
+        for q, name in ((0.5, "p50"), (0.9, "p90")):
+            assert _rank_err(data, out[name][g], q) <= \
+                3.0 / (DEFAULT_K - 1), (g, name)
+
+
+def test_single_partition_approx_stays_exact():
+    vals = list(range(101))
+    tbl = pa.table({"x": pa.array(vals, pa.int64())})
+    s = TpuSession()
+    out = (s.from_arrow(tbl)
+           .agg((ApproximatePercentile(col("x"), 0.25), "p"))
+           .collect().to_pydict())
+    assert out["p"] == [25.0]
