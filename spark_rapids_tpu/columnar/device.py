@@ -141,6 +141,15 @@ class DeviceBatch:
     # scan provenance for input_file_name (GpuInputFileBlock role):
     # "" = unknown / non-file source / mixed files
     origin_file: str = ""
+    # LAZY SELECTION VECTOR (the cuDF gather-map-deferred idea,
+    # JoinGatherer.scala role): when set, live rows are `sel`-True rows,
+    # NOT a front prefix, and num_rows is their (device) count.  Row
+    # gathers are the dominant device cost on TPU (~20ms per pass at
+    # 1M), so a join feeding a mask-aware consumer (aggregation live
+    # mask, another join's probe liveness) skips its output compaction
+    # entirely.  Prefix-assuming operators (fetch, concat, slicing)
+    # compact on entry via ops.batch_ops.ensure_prefix.
+    sel: object = None   # Optional[jax.Array]
 
     @property
     def capacity(self) -> int:
@@ -164,13 +173,16 @@ class DeviceBatch:
     def select(self, indices: Sequence[int]) -> "DeviceBatch":
         return DeviceBatch([self.columns[i] for i in indices], self.num_rows,
                            [self.names[i] for i in indices],
-                           self.origin_file)
+                           self.origin_file, sel=self.sel)
 
     def nbytes(self) -> int:
         return sum(c.nbytes() for c in self.columns)
 
     def row_mask(self) -> jax.Array:
-        """Bool mask of logically-live rows (True for row < num_rows)."""
+        """Bool mask of logically-live rows: the selection vector when
+        present, else True for row < num_rows (prefix liveness)."""
+        if self.sel is not None:
+            return self.sel
         return jnp.arange(self.capacity, dtype=jnp.int32) < jnp.int32(self.num_rows)
 
     def __repr__(self):
@@ -409,6 +421,9 @@ def to_host(db: DeviceBatch, fetch_rows: Optional[int] = None) -> HostBatch:
 def _fetch_lanes(db: DeviceBatch, fetch_rows: Optional[int]):
     """device_get count + lanes in one round trip; lanes prefix-sliced to
     fetch_rows when given.  Returns (clamped live count, fetched lists)."""
+    if db.sel is not None:
+        from ..ops.batch_ops import ensure_prefix
+        db = ensure_prefix(db)
     cols = db.columns
     if fetch_rows is not None and fetch_rows < db.capacity:
         h = fetch_rows

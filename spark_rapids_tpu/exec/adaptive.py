@@ -111,6 +111,7 @@ class AdaptiveShuffledJoinExec(PlanNode):
         self.join_type = join_type
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
+        self.lazy_sel = False      # forwarded to the inner HashJoinExec
 
     @property
     def left(self) -> PlanNode:
@@ -190,6 +191,7 @@ class AdaptiveShuffledJoinExec(PlanNode):
                     _ReplayStage(left_stage, self.left.output_schema,
                                  self.left),
                     probe_conds=right_conds, build_conds=left_conds)
+                join.lazy_sel = self.lazy_sel
                 self._maybe_bloom(join, jt, left_stage,
                                   max(rbytes, 1), lbytes, ctx)
                 n_r = len(self.right.output_schema.fields)
@@ -206,6 +208,7 @@ class AdaptiveShuffledJoinExec(PlanNode):
                     _ReplayStage(right_stage,
                                  self.right.output_schema, self.right),
                     probe_conds=left_conds, build_conds=right_conds)
+                join.lazy_sel = self.lazy_sel
                 self._maybe_bloom(join, self.join_type, right_stage,
                                   max(lbytes, 1), rbytes, ctx)
                 yield from join.execute(ctx)

@@ -335,6 +335,11 @@ class HashAggregate:
         from .evaluator import (_JIT_CACHE, _batch_meta, _build_inputs,
                                 _jit_key, _num_rows_scalar, _prepare)
         from ..ops.kernels import live_mask, valid_or_true
+        if db.sel is not None and any(c.offsets is not None
+                                      for c in db.columns):
+            # ragged kernels assume prefix liveness (see evaluator)
+            from ..ops.batch_ops import ensure_prefix
+            db = ensure_prefix(db, self.conf)
         exprs_all = list(conds) + self.key_exprs + self.input_exprs
         pctx, hostvals, aux = _prepare(exprs_all, db, self.conf)
         spec_sig = tuple((s.kind, s.input_idx, str(s.dtype))
@@ -345,11 +350,12 @@ class HashAggregate:
         pack = None
         if dense_domains is None:
             pack = _fused_pack_spec(self.key_exprs, self.key_ranges)
+        has_sel = db.sel is not None
         key = _jit_key(exprs_all, db, aux, self.conf,
                        ("fpartial", spec_sig, len(conds),
                         len(self.key_exprs),
                         tuple(dense_domains) if dense_domains else None,
-                        pack))
+                        pack, has_sel))
         fn = _JIT_CACHE.get(key)
         if fn is None:
             capacity = db.capacity
@@ -361,11 +367,13 @@ class HashAggregate:
             specs = list(self.update_specs)
             meta = _batch_meta(db)
 
-            def run(col_data, col_valid, num_rows, aux_arrs):
+            def run(col_data, col_valid, num_rows, aux_arrs, *sel_opt):
                 inputs, raw = _build_inputs(meta, col_data, col_valid)
                 ctx = E.EvalCtx(capacity, num_rows, inputs, aux_arrs,
                                 node_slots, conf, raw)
-                live = live_mask(capacity, num_rows)
+                # lazy join output: liveness is the selection vector
+                live = sel_opt[0] if sel_opt \
+                    else live_mask(capacity, num_rows)
                 for c in conds_t:
                     dv = c.eval_dev(ctx)
                     k = dv.data.astype(bool)
@@ -401,9 +409,10 @@ class HashAggregate:
             _JIT_CACHE[key] = fn
 
         from .evaluator import _col_lanes
+        extra = (db.sel,) if has_sel else ()
         out_keys, outs, ng = fn(_col_lanes(db),
                                 tuple(c.validity for c in db.columns),
-                                _num_rows_scalar(db.num_rows), aux)
+                                _num_rows_scalar(db.num_rows), aux, *extra)
         if not self.key_exprs:
             return outs if raw else self._reduce_outs_to_batch(outs)
         nconds = len(conds)

@@ -82,11 +82,18 @@ def _flatten_batch(db: DeviceBatch):
     static_rows = db.num_rows if isinstance(db.num_rows, int) else None
     if static_rows is None:
         arrays.append(db.num_rows)
-    return arrays, (cols, list(db.names), static_rows, db.origin_file)
+    # a lazy selection vector is part of the batch's liveness: dropping
+    # it across a program boundary would turn sel-liveness into (wrong)
+    # prefix-liveness
+    has_sel = db.sel is not None
+    if has_sel:
+        arrays.append(db.sel)
+    return arrays, (cols, list(db.names), static_rows, db.origin_file,
+                    has_sel)
 
 
 def _rebuild_batch(arrays, spec, i: int) -> Tuple[DeviceBatch, int]:
-    cols_spec, names, static_rows, origin = spec
+    cols_spec, names, static_rows, origin, has_sel = spec
     cols = []
     for dtype, dictionary, has_hi, has_off in cols_spec:
         data = arrays[i]
@@ -107,7 +114,11 @@ def _rebuild_batch(arrays, spec, i: int) -> Tuple[DeviceBatch, int]:
         i += 1
     else:
         num_rows = static_rows
-    return DeviceBatch(cols, num_rows, names, origin), i
+    sel = None
+    if has_sel:
+        sel = arrays[i]
+        i += 1
+    return DeviceBatch(cols, num_rows, names, origin, sel=sel), i
 
 
 def _shard_batch(db: DeviceBatch, mesh) -> DeviceBatch:
@@ -448,6 +459,11 @@ class SplitCompiledPlan:
     def _shrink(outs: List[DeviceBatch], ctx) -> List[DeviceBatch]:
         sliced = []
         for db in outs:
+            if db.sel is not None:
+                # lazy-join seam output: the seam re-buckets anyway, so
+                # materialize the selection vector here
+                from ..ops.batch_ops import ensure_prefix
+                db = ensure_prefix(db, ctx.conf)
             if any(c.offsets is not None for c in db.columns):
                 raise _SplitUnsupported()   # ragged seam output
             n = db.num_rows if isinstance(db.num_rows, int) \

@@ -149,8 +149,15 @@ def _jit_key(exprs, db, aux, conf, tag):
 def evaluate_projection(exprs: Sequence[Expression], names: Sequence[str],
                         db: DeviceBatch, conf: TpuConf) -> DeviceBatch:
     """Project `db` through bound expressions -> new DeviceBatch."""
+    if db.sel is not None and any(c.offsets is not None
+                                  for c in db.columns):
+        # ragged kernels bound live VALUES by offsets[num_rows] — a
+        # prefix assumption a selection vector violates; materialize
+        from ..ops.batch_ops import ensure_prefix
+        db = ensure_prefix(db, conf)
     pctx, hostvals, aux = _prepare(exprs, db, conf)
-    key = _jit_key(exprs, db, aux, conf, "project")
+    has_sel = db.sel is not None
+    key = _jit_key(exprs, db, aux, conf, ("project", has_sel))
     fn = _JIT_CACHE.get(key)
     if fn is None:
         capacity = db.capacity
@@ -158,11 +165,13 @@ def evaluate_projection(exprs: Sequence[Expression], names: Sequence[str],
         exprs_t = tuple(exprs)
         meta = _batch_meta(db)
 
-        def run(col_data, col_valid, num_rows, aux_arrs):
+        def run(col_data, col_valid, num_rows, aux_arrs, *sel_opt):
             inputs, raw = _build_inputs(meta, col_data, col_valid)
             ctx = EvalCtx(capacity, num_rows, inputs, aux_arrs, node_slots,
                           conf, raw)
-            live = live_mask(capacity, num_rows)
+            # a selection vector replaces prefix liveness (lazy join
+            # output: live rows are sel-True, not a front prefix)
+            live = sel_opt[0] if sel_opt else live_mask(capacity, num_rows)
             outs = []
             for e in exprs_t:
                 dv = e.eval_dev(ctx)
@@ -182,26 +191,34 @@ def evaluate_projection(exprs: Sequence[Expression], names: Sequence[str],
 
     col_data = _col_lanes(db)
     col_valid = tuple(c.validity for c in db.columns)
-    outs = fn(col_data, col_valid, _num_rows_scalar(db.num_rows), aux)
+    extra = (db.sel,) if has_sel else ()
+    outs = fn(col_data, col_valid, _num_rows_scalar(db.num_rows), aux,
+              *extra)
     cols = []
     for (data, valid, hi, offsets, ev), e, hv in zip(outs, exprs, hostvals):
         cols.append(DeviceColumn(data, valid, e.dtype, hv.dictionary,
                                  hi, offsets=offsets, elem_valid=ev))
-    return DeviceBatch(cols, db.num_rows, list(names), db.origin_file)
+    return DeviceBatch(cols, db.num_rows, list(names), db.origin_file,
+                       sel=db.sel)
 
 
 def compute_predicate(cond: Expression, db: DeviceBatch,
                       conf: TpuConf) -> jax.Array:
     """Evaluate a boolean expression -> keep-mask (False for null/padding)."""
+    if db.sel is not None and any(c.offsets is not None
+                                  for c in db.columns):
+        from ..ops.batch_ops import ensure_prefix
+        db = ensure_prefix(db, conf)
     pctx, _, aux = _prepare([cond], db, conf)
-    key = _jit_key([cond], db, aux, conf, "predicate")
+    has_sel = db.sel is not None
+    key = _jit_key([cond], db, aux, conf, ("predicate", has_sel))
     fn = _JIT_CACHE.get(key)
     if fn is None:
         capacity = db.capacity
         node_slots = dict(pctx.node_slots)
         meta = _batch_meta(db)
 
-        def run(col_data, col_valid, num_rows, aux_arrs):
+        def run(col_data, col_valid, num_rows, aux_arrs, *sel_opt):
             inputs, raw = _build_inputs(meta, col_data, col_valid)
             ctx = EvalCtx(capacity, num_rows, inputs, aux_arrs, node_slots,
                           conf, raw)
@@ -209,12 +226,14 @@ def compute_predicate(cond: Expression, db: DeviceBatch,
             keep = dv.data
             if dv.validity is not None:
                 keep = keep & dv.validity
-            return keep & live_mask(capacity, num_rows)
+            live = sel_opt[0] if sel_opt else live_mask(capacity, num_rows)
+            return keep & live
 
         fn = jax.jit(run)
         _JIT_CACHE[key] = fn
+    extra = (db.sel,) if has_sel else ()
     return fn(_col_lanes(db), tuple(c.validity for c in db.columns),
-              _num_rows_scalar(db.num_rows), aux)
+              _num_rows_scalar(db.num_rows), aux, *extra)
 
 
 def apply_filter(cond: Expression, db: DeviceBatch, conf: TpuConf) -> DeviceBatch:

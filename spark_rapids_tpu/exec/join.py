@@ -112,6 +112,10 @@ class HashJoinExec(PlanNode):
         assert len(self.left_keys) == len(self.right_keys)
         # pre-fused filter predicates (see _peel_filters): evaluated as
         # masks on raw input batches instead of upstream compactions
+        # lazy_sel: a mask-aware parent (negotiated by the overrides
+        # post-pass) lets this join emit a selection vector instead of
+        # compacting its output
+        self.lazy_sel = False
         self.probe_conds = list(probe_conds or [])
         self.build_conds = list(build_conds or [])
         if join_type not in (INNER_TYPES := {J.INNER, J.LEFT_OUTER,
@@ -484,6 +488,14 @@ class HashJoinExec(PlanNode):
                             cum, out_cap, total)
                 keep = matched if self.join_type == J.LEFT_SEMI \
                     else pre & ~matched
+                if self.lazy_sel:
+                    # mask-aware parent (aggregation live mask / another
+                    # join's probe liveness): skip the compaction — row
+                    # gathers are the dominant device cost
+                    yield DeviceBatch(list(pb.columns),
+                                      jnp.sum(keep, dtype=jnp.int32),
+                                      out_names, pb.origin_file, sel=keep)
+                    continue
                 out = compact_batch(pb, keep, ctx.conf)
                 yield DeviceBatch(out.columns, out.num_rows, out_names)
                 continue
@@ -491,9 +503,12 @@ class HashJoinExec(PlanNode):
             if aligned:
                 build_idx, ok = J.probe_aligned(build, probe_lanes,
                                                 probe_valid)
+                # a masked probe's live rows are NOT a prefix: gather with
+                # every position live; sel excludes dead rows downstream
+                out_rows = pb.capacity if pb.sel is not None else pb.num_rows
                 rg = gather_batch(build_batch,
                                   jnp.where(ok, build_idx, -1),
-                                  pb.num_rows, null_out_of_bounds=True)
+                                  out_rows, null_out_of_bounds=True)
                 if self.join_type in (J.RIGHT_OUTER, J.FULL_OUTER):
                     hits = jnp.zeros((build_batch.capacity,), jnp.int32) \
                         .at[jnp.where(ok, build_idx, 0)] \
@@ -504,12 +519,27 @@ class HashJoinExec(PlanNode):
                     # rows carry null right columns (the -1 gather)
                     out = DeviceBatch(list(pb.columns) + rg.columns,
                                       pb.num_rows, out_names)
-                    yield compact_batch(out, pre, ctx.conf) \
-                        if probe_conds else out
+                    if not probe_conds:
+                        # a masked probe's liveness must survive verbatim
+                        yield out if pb.sel is None else DeviceBatch(
+                            out.columns, pb.num_rows, out_names,
+                            sel=pb.sel)
+                    elif self.lazy_sel:
+                        yield DeviceBatch(out.columns,
+                                          jnp.sum(pre, dtype=jnp.int32),
+                                          out_names, sel=pre)
+                    else:
+                        yield compact_batch(out, pre, ctx.conf)
                 else:   # inner / right_outer / full_outer matched part
                     pairs = DeviceBatch(list(pb.columns) + rg.columns,
                                         pb.num_rows, out_names)
-                    yield compact_batch(pairs, ok & pre, ctx.conf)
+                    keep = ok & pre
+                    if self.lazy_sel and self.join_type == J.INNER:
+                        yield DeviceBatch(pairs.columns,
+                                          jnp.sum(keep, dtype=jnp.int32),
+                                          out_names, sel=keep)
+                    else:
+                        yield compact_batch(pairs, keep, ctx.conf)
                     if self.join_type == J.FULL_OUTER:
                         unmatched = pre & ~ok
                         right_nulls = _null_columns(
@@ -579,12 +609,13 @@ class HashJoinExec(PlanNode):
                     pb, self._conds_mask(probe_conds, pb, pb.row_mask(),
                                          ctx), ctx.conf)
             if self.join_type == J.LEFT_ANTI:
-                yield DeviceBatch(pb.columns, pb.num_rows, out_names)
+                yield DeviceBatch(pb.columns, pb.num_rows, out_names,
+                                  sel=pb.sel)
             else:   # left/full outer
                 right_nulls = _null_columns(self.right.output_schema,
                                             pb.capacity)
                 yield DeviceBatch(list(pb.columns) + right_nulls,
-                                  pb.num_rows, out_names)
+                                  pb.num_rows, out_names, sel=pb.sel)
 
     def describe(self):
         return (f"HashJoinExec[{self.join_type}, "

@@ -790,11 +790,12 @@ class LocalLimitExec(PlanNode):
         # already satisfies the limit.  A lazy count costs one scalar
         # sync; the payoff is the capacity slice (shrink_to_capacity), so
         # a tiny LIMIT never ships a full-capacity batch to host.
-        from ..ops.batch_ops import shrink_to_capacity
+        from ..ops.batch_ops import ensure_prefix, shrink_to_capacity
         remaining = self.limit
         for db in self.child.execute(ctx):
             if remaining <= 0:
                 return
+            db = ensure_prefix(db, ctx.conf)   # limit cuts a PREFIX
             n = int(db.num_rows)
             if n == 0:
                 continue

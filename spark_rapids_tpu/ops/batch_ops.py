@@ -85,6 +85,16 @@ def _hi_lane_of(col: DeviceColumn, upto=None) -> "jax.Array":
     return jnp.where(d < 0, jnp.int64(-1), jnp.int64(0))
 
 
+def ensure_prefix(db: DeviceBatch, conf: TpuConf = DEFAULT_CONF
+                  ) -> DeviceBatch:
+    """Materialize a lazy selection vector (DeviceBatch.sel) into the
+    front-prefix liveness every slicing/concat/fetch path assumes."""
+    if db.sel is None:
+        return db
+    from .filter import compact_batch
+    return compact_batch(db, db.sel, conf)
+
+
 def concat_batches(batches: List[DeviceBatch],
                    conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
     """Concatenate device batches (same schema) into one bucketed batch.
@@ -95,6 +105,7 @@ def concat_batches(batches: List[DeviceBatch],
     front on device — zero host syncs, at the cost of padding up to the
     capacity sum."""
     assert batches, "concat of zero batches"
+    batches = [ensure_prefix(b, conf) for b in batches]
     if len(batches) == 1:
         return batches[0]
     if any(not isinstance(b.num_rows, int) for b in batches):
@@ -200,6 +211,7 @@ def shrink_to_capacity(db: DeviceBatch, row_bound: int,
     bound the live row count (e.g. LIMIT N): live rows are a prefix, so
     rows past the bound are guaranteed padding.  Keeps collect()/to_host
     from shipping a full-capacity batch over the link for a tiny limit."""
+    db = ensure_prefix(db, conf)
     cap = bucket_capacity(max(row_bound, 1), conf)
     if cap >= db.capacity:
         return db
@@ -214,6 +226,7 @@ def shrink_to_rows(db: DeviceBatch, num_rows: int,
                    conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
     """Re-bucket a padded batch down to the bucket fitting `num_rows`
     (used after groupby/filter when occupancy dropped a bucket or more)."""
+    db = ensure_prefix(db, conf)
     cap = bucket_capacity(max(num_rows, 1), conf)
     if cap >= db.capacity:
         return DeviceBatch(db.columns, num_rows, db.names, db.origin_file)

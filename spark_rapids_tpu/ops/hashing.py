@@ -195,3 +195,146 @@ def murmur3_int64_host(x: int, seed: int) -> int:
     h1 = _np_mix_h1(_np_u32(seed), _np_mix_k1(_np_u32(low)))
     h1 = _np_mix_h1(h1, _np_mix_k1(_np_u32(high)))
     return int(_np_fmix(h1, 8))
+
+
+# ---------------------------------------------------------------------------
+# xxhash64 (reference spark-rapids-jni Hash.xxhash64 / Spark XXH64)
+# ---------------------------------------------------------------------------
+
+_XXP1 = 0x9E3779B185EBCA87
+_XXP2 = 0xC2B2AE3D27D4EB4F
+_XXP3 = 0x165667B19E3779F9
+_XXP4 = 0x85EBCA77C2B2AE63
+_XXP5 = 0x27D4EB2F165667C5
+_M64 = (1 << 64) - 1
+
+
+def _rotl64(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def _xx_fmix(h: int) -> int:
+    h ^= h >> 33
+    h = (h * _XXP2) & _M64
+    h ^= h >> 29
+    h = (h * _XXP3) & _M64
+    h ^= h >> 32
+    return h
+
+
+def xxhash64_long_host(l: int, seed: int) -> int:
+    """Spark XXH64.hashLong, exact (host ints)."""
+    h = (seed + _XXP5 + 8) & _M64
+    k1 = (_rotl64((l & _M64) * _XXP2 & _M64, 31) * _XXP1) & _M64
+    h ^= k1
+    h = (_rotl64(h, 27) * _XXP1 + _XXP4) & _M64
+    return _xx_fmix(h)
+
+
+def xxhash64_int_host(i: int, seed: int) -> int:
+    """Spark XXH64.hashInt, exact (host ints)."""
+    h = (seed + _XXP5 + 4) & _M64
+    h ^= ((i & 0xFFFFFFFF) * _XXP1) & _M64
+    h = (_rotl64(h, 23) * _XXP2 + _XXP3) & _M64
+    return _xx_fmix(h)
+
+
+def xxhash64_bytes_host(data: bytes, seed: int) -> int:
+    """Spark XXH64.hashUnsafeBytes (strings), exact."""
+    length = len(data)
+    if length >= 32:
+        v1 = (seed + _XXP1 + _XXP2) & _M64
+        v2 = (seed + _XXP2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - _XXP1) & _M64
+        off = 0
+        while off + 32 <= length:
+            for vi in range(4):
+                w = int.from_bytes(data[off + 8 * vi: off + 8 * vi + 8],
+                                   "little")
+                if vi == 0:
+                    v1 = (_rotl64((v1 + w * _XXP2) & _M64, 31) * _XXP1) \
+                        & _M64
+                elif vi == 1:
+                    v2 = (_rotl64((v2 + w * _XXP2) & _M64, 31) * _XXP1) \
+                        & _M64
+                elif vi == 2:
+                    v3 = (_rotl64((v3 + w * _XXP2) & _M64, 31) * _XXP1) \
+                        & _M64
+                else:
+                    v4 = (_rotl64((v4 + w * _XXP2) & _M64, 31) * _XXP1) \
+                        & _M64
+            off += 32
+        h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12) +
+             _rotl64(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            h ^= (_rotl64((v * _XXP2) & _M64, 31) * _XXP1) & _M64
+            h = (h * _XXP1 + _XXP4) & _M64
+    else:
+        off = 0
+        h = (seed + _XXP5) & _M64
+    h = (h + length) & _M64
+    while off + 8 <= length:
+        w = int.from_bytes(data[off:off + 8], "little")
+        h ^= (_rotl64((w * _XXP2) & _M64, 31) * _XXP1) & _M64
+        h = (_rotl64(h, 27) * _XXP1 + _XXP4) & _M64
+        off += 8
+    if off + 4 <= length:
+        w = int.from_bytes(data[off:off + 4], "little")
+        h ^= (w * _XXP1) & _M64
+        h = (_rotl64(h, 23) * _XXP2 + _XXP3) & _M64
+        off += 4
+    while off < length:
+        h ^= ((data[off] & 0xFF) * _XXP5) & _M64
+        h = (_rotl64(h, 11) * _XXP1) & _M64
+        off += 1
+    return _xx_fmix(h)
+
+
+def xxhash64_utf8(s, seed: int) -> int:
+    return xxhash64_bytes_host(s.encode("utf-8"), seed)
+
+
+def dict_xxhash_array(dictionary, seed: int) -> np.ndarray:
+    """uint64 xxhash64 of every dictionary entry (host)."""
+    out = np.empty(max(len(dictionary), 1), dtype=np.uint64)
+    out[:] = np.uint64(seed)
+    for i, v in enumerate(dictionary):
+        s = v.as_py() if hasattr(v, "as_py") else v
+        if s is not None:
+            out[i] = np.uint64(xxhash64_utf8(s, seed))
+    return out
+
+
+def _jx_rotl64(x, r: int):
+    return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
+
+
+def _jx_fmix(h):
+    import jax.numpy as jnp
+    h = h ^ (h >> np.uint64(33))
+    h = h * jnp.uint64(_XXP2)
+    h = h ^ (h >> np.uint64(29))
+    h = h * jnp.uint64(_XXP3)
+    return h ^ (h >> np.uint64(32))
+
+
+def xxhash64_long_lane(lane, seed):
+    """Device Spark XXH64.hashLong over a uint64 lane; `seed` is a
+    uint64 lane (per-row chaining across columns)."""
+    import jax.numpy as jnp
+    h = seed + jnp.uint64((_XXP5 + 8) & _M64)
+    k1 = _jx_rotl64(lane * jnp.uint64(_XXP2), 31) * jnp.uint64(_XXP1)
+    h = h ^ k1
+    h = _jx_rotl64(h, 27) * jnp.uint64(_XXP1) + jnp.uint64(_XXP4)
+    return _jx_fmix(h)
+
+
+def xxhash64_int_lane(lane, seed):
+    """Device Spark XXH64.hashInt over a uint64 lane holding the
+    zero-extended 32-bit value."""
+    import jax.numpy as jnp
+    h = seed + jnp.uint64((_XXP5 + 4) & _M64)
+    h = h ^ (lane * jnp.uint64(_XXP1))
+    h = _jx_rotl64(h, 23) * jnp.uint64(_XXP2) + jnp.uint64(_XXP3)
+    return _jx_fmix(h)

@@ -174,3 +174,75 @@ def map_element_at(keys: DeviceColumn, values: DeviceColumn, needle,
     data = jnp.take(values.data, safe)
     valid = keys.validity & found & jnp.take(values.elem_valid, safe)
     return data, valid
+
+
+def element_at(col: DeviceColumn, index: int) -> Tuple[jax.Array,
+                                                       jax.Array]:
+    """element_at(arr, i): 1-based; negative indexes from the end
+    (Spark ElementAt over arrays).  Out-of-range -> null."""
+    off = col.offsets
+    lens = off[1:] - off[:-1]
+    if index >= 0:
+        pos = jnp.int32(index - 1)
+        idx = off[:-1] + pos
+        ok = col.validity & (jnp.int32(index) >= 1) & (pos < lens)
+    else:
+        pos = lens + jnp.int32(index)
+        idx = off[:-1] + pos
+        ok = col.validity & (pos >= 0)
+    safe = jnp.clip(idx, 0, col.value_capacity - 1)
+    return jnp.take(col.data, safe), ok & jnp.take(col.elem_valid, safe)
+
+
+def position(col: DeviceColumn, needle, num_rows) -> Tuple[jax.Array,
+                                                           jax.Array]:
+    """array_position(arr, v): 1-based first match, 0 if absent, null
+    for null arrays (Spark)."""
+    vcap = col.value_capacity
+    rid = row_ids(col.offsets, vcap)
+    live = value_live(col.offsets, vcap, num_rows)
+    hit = (col.data == needle) & col.elem_valid & live
+    within = jnp.arange(vcap, dtype=jnp.int32) - jnp.take(col.offsets, rid)
+    big = jnp.int32(vcap)
+    first = jax.ops.segment_min(jnp.where(hit, within, big), rid,
+                                num_segments=col.capacity)
+    data = jnp.where(first < big, first + 1, 0).astype(jnp.int64)
+    return data, col.validity
+
+
+def slice_rows(col: DeviceColumn, start: int, length: int, num_rows
+               ) -> DeviceColumn:
+    """slice(arr, start, length): 1-based start; negative start counts
+    from the end (Spark Slice).  Keeps per-value order."""
+    vcap = col.value_capacity
+    rid = row_ids(col.offsets, vcap)
+    lens = col.offsets[1:] - col.offsets[:-1]
+    within = jnp.arange(vcap, dtype=jnp.int32) - jnp.take(col.offsets, rid)
+    if start >= 0:
+        lo = jnp.full(col.capacity, start - 1, jnp.int32)
+        oob = jnp.zeros(col.capacity, bool)
+    else:
+        raw_lo = lens + jnp.int32(start)
+        oob = raw_lo < 0          # Spark: start before the array -> empty
+        lo = jnp.maximum(raw_lo, 0)
+    lo_v = jnp.take(lo, rid)
+    keep = (within >= lo_v) & (within < lo_v + jnp.int32(length)) & \
+        ~jnp.take(oob, rid)
+    return filter_values(col, keep, num_rows)
+
+
+def reverse_rows(col: DeviceColumn, num_rows) -> DeviceColumn:
+    """reverse(arr): per-row element reversal — one gather, offsets
+    unchanged."""
+    vcap = col.value_capacity
+    rid = row_ids(col.offsets, vcap)
+    lens = col.offsets[1:] - col.offsets[:-1]
+    within = jnp.arange(vcap, dtype=jnp.int32) - jnp.take(col.offsets, rid)
+    src = jnp.take(col.offsets, rid) + jnp.take(lens, rid) - 1 - within
+    safe = jnp.clip(src, 0, vcap - 1)
+    return DeviceColumn(jnp.take(col.data, safe), col.validity,
+                        col.dtype, col.dictionary,
+                        None if col.data_hi is None
+                        else jnp.take(col.data_hi, safe),
+                        offsets=col.offsets,
+                        elem_valid=jnp.take(col.elem_valid, safe))

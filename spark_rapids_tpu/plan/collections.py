@@ -771,3 +771,619 @@ class RenestMap(ArrayExpression):
         return pa.array(out, pa.map_(
             dtype_to_arrow(self.map_type.key_type),
             dtype_to_arrow(self.map_type.value_type)))
+
+
+# ---------------------------------------------------------------------------
+# Collection breadth (reference collectionOperations.scala, mapUtils):
+# device ragged kernels where the layout permits (ops/ragged.py), exact
+# CPU fallbacks elsewhere.
+# ---------------------------------------------------------------------------
+
+class ElementAt(ArrayExpression):
+    """element_at(arr, i): 1-based, negative from the end; out-of-range
+    -> null (Spark ElementAt over arrays; map form is MapElementAt)."""
+
+    eval_dev = Expression.eval_dev
+
+    def __init__(self, child: Expression, index: int):
+        self.children = (child,)
+        self.index = int(index)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype.element_type
+        self.nullable = True
+
+    def _fp_extra(self):
+        return str(self.index)
+
+    def unsupported_reasons(self, conf):
+        if self.index == 0:
+            return ["element_at index 0 (Spark raises; 1-based)"]
+        if _ragged_child_ok(self.children[0]):
+            return []
+        return [_OFF_DEVICE]
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops import ragged as R
+        data, valid = R.element_at(_as_ragged_col(kids[0]), self.index)
+        return DevVal(data, valid, self.dtype, kids[0].dictionary)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+            elif self.index > 0:
+                out.append(v[self.index - 1]
+                           if self.index <= len(v) else None)
+            else:
+                out.append(v[self.index] if -self.index <= len(v)
+                           else None)
+        return pa.array(out, dtype_to_arrow(self.dtype))
+
+
+class ArrayPosition(ArrayExpression):
+    """array_position(arr, v): 1-based first match, 0 absent, null for
+    null arrays."""
+
+    eval_dev = Expression.eval_dev
+
+    def __init__(self, child: Expression, value):
+        self.children = (child,)
+        self.value = value
+
+    def _resolve(self):
+        self.dtype = t.LONG
+        self.nullable = True
+
+    def _fp_extra(self):
+        return repr(self.value)
+
+    def unsupported_reasons(self, conf):
+        if _ragged_child_ok(self.children[0]) and \
+                isinstance(self.value, (int, float, bool)):
+            return []
+        return [_OFF_DEVICE]
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops import ragged as R
+        col = _as_ragged_col(kids[0])
+        needle = col.data.dtype.type(self.value)
+        data, valid = R.position(col, needle, ctx.num_rows)
+        return DevVal(data, valid, t.LONG)
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            pos = 0
+            for i, x in enumerate(v):
+                if x == self.value:
+                    pos = i + 1
+                    break
+            out.append(pos)
+        return pa.array(out, pa.int64())
+
+
+class Slice(ArrayExpression):
+    """slice(arr, start, length): 1-based start, negative from the end."""
+
+    eval_dev = Expression.eval_dev
+
+    def __init__(self, child: Expression, start: int, length: int):
+        self.children = (child,)
+        self.start = int(start)
+        self.length = int(length)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _fp_extra(self):
+        return f"{self.start},{self.length}"
+
+    def unsupported_reasons(self, conf):
+        out = []
+        if self.start == 0:
+            out.append("slice start 0 (Spark raises; 1-based)")
+        if self.length < 0:
+            out.append("negative slice length (Spark raises)")
+        if out:
+            return out
+        if _ragged_child_ok(self.children[0]):
+            return []
+        return [_OFF_DEVICE]
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops import ragged as R
+        out = R.slice_rows(_as_ragged_col(kids[0]), self.start,
+                           self.length, ctx.num_rows)
+        return DevVal(out.data, out.validity, self.dtype, out.dictionary,
+                      offsets=out.offsets, elem_valid=out.elem_valid)
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            if self.start > 0:
+                lo = self.start - 1
+            else:
+                lo = len(v) + self.start
+                if lo < 0:        # start before the array -> empty (Spark)
+                    out.append([])
+                    continue
+            out.append(v[lo:lo + self.length])
+        return pa.array(out, pa.list_(_arrow_elem(self.dtype)))
+
+
+class ReverseArray(ArrayExpression):
+    """reverse(arr) — per-row element reversal."""
+
+    eval_dev = Expression.eval_dev
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def unsupported_reasons(self, conf):
+        if _ragged_child_ok(self.children[0]):
+            return []
+        return [_OFF_DEVICE]
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops import ragged as R
+        out = R.reverse_rows(_as_ragged_col(kids[0]), ctx.num_rows)
+        return DevVal(out.data, out.validity, self.dtype, out.dictionary,
+                      offsets=out.offsets, elem_valid=out.elem_valid)
+
+    def _eval_cpu(self, rb, kids):
+        out = [None if v is None else list(reversed(v))
+               for v in kids[0].to_pylist()]
+        return pa.array(out, pa.list_(_arrow_elem(self.dtype)))
+
+
+class _CpuArrayExpression(ArrayExpression):
+    """Base for CPU-only collection fns: tagged off-device with the
+    standard reason; subclasses implement _eval_cpu only."""
+
+    def unsupported_reasons(self, conf):
+        return [_OFF_DEVICE]
+
+
+class ArrayRepeat(_CpuArrayExpression):
+    """array_repeat(e, n)."""
+
+    def __init__(self, child: Expression, count: Expression):
+        self.children = (child, count)
+
+    def _resolve(self):
+        self.dtype = t.ArrayType(self.children[0].dtype)
+        self.nullable = self.children[1].nullable
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v, n in zip(kids[0].to_pylist(), kids[1].to_pylist()):
+            out.append(None if n is None else [v] * max(int(n), 0))
+        return pa.array(out, pa.list_(_arrow_elem(self.dtype)))
+
+
+class Flatten(_CpuArrayExpression):
+    """flatten(array<array<T>>) -> array<T>; null inner -> null result."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype.element_type
+        self.nullable = True
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None or any(x is None for x in v):
+                out.append(None)
+            else:
+                out.append([e for sub in v for e in sub])
+        return pa.array(out, pa.list_(_arrow_elem(self.dtype)))
+
+
+class ArrayDistinct(_CpuArrayExpression):
+    """array_distinct: first-occurrence order (Spark)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            seen, res = set(), []
+            has_null = False
+            for x in v:
+                if x is None:
+                    if not has_null:
+                        has_null = True
+                        res.append(None)
+                elif x not in seen:
+                    seen.add(x)
+                    res.append(x)
+            out.append(res)
+        return pa.array(out, pa.list_(_arrow_elem(self.dtype)))
+
+
+class ArraysOverlap(_CpuArrayExpression):
+    """arrays_overlap(a, b): true if a non-null common element exists;
+    null when none but either side has nulls (Spark 3-valued)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def _resolve(self):
+        self.dtype = t.BOOLEAN
+        self.nullable = True
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for a, b in zip(kids[0].to_pylist(), kids[1].to_pylist()):
+            if a is None or b is None:
+                out.append(None)
+                continue
+            sa = {x for x in a if x is not None}
+            sb = {x for x in b if x is not None}
+            if sa & sb:
+                out.append(True)
+            elif not a or not b:
+                # an empty side can never overlap: false even with nulls
+                out.append(False)
+            elif (len(sa) != len(a)) or (len(sb) != len(b)):
+                out.append(None)
+            else:
+                out.append(False)
+        return pa.array(out, pa.bool_())
+
+
+class _ArraySetOp(_CpuArrayExpression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = any(c.nullable for c in self.children)
+
+    @staticmethod
+    def _dedup(seq):
+        seen, out, has_null = set(), [], False
+        for x in seq:
+            if x is None:
+                if not has_null:
+                    has_null = True
+                    out.append(None)
+            elif x not in seen:
+                seen.add(x)
+                out.append(x)
+        return out
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for a, b in zip(kids[0].to_pylist(), kids[1].to_pylist()):
+            if a is None or b is None:
+                out.append(None)
+            else:
+                out.append(self._combine(a, b))
+        return pa.array(out, pa.list_(_arrow_elem(self.dtype)))
+
+
+class ArrayUnion(_ArraySetOp):
+    def _combine(self, a, b):
+        return self._dedup(list(a) + list(b))
+
+
+class ArrayIntersect(_ArraySetOp):
+    def _combine(self, a, b):
+        bs = set(x for x in b if x is not None)
+        bnull = any(x is None for x in b)
+        return self._dedup([x for x in a
+                            if (x is None and bnull) or x in bs])
+
+
+class ArrayExcept(_ArraySetOp):
+    def _combine(self, a, b):
+        bs = set(x for x in b if x is not None)
+        bnull = any(x is None for x in b)
+        return self._dedup([x for x in a
+                            if not ((x is None and bnull) or x in bs)])
+
+
+class ArrayRemove(_CpuArrayExpression):
+    """array_remove(arr, v): drop equal elements (nulls kept)."""
+
+    def __init__(self, child: Expression, value):
+        self.children = (child,)
+        self.value = value
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _fp_extra(self):
+        return repr(self.value)
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v in kids[0].to_pylist():
+            out.append(None if v is None
+                       else [x for x in v if x != self.value])
+        return pa.array(out, pa.list_(_arrow_elem(self.dtype)))
+
+
+class ArrayJoin(_CpuArrayExpression):
+    """array_join(arr, delim[, null_replacement])."""
+
+    def __init__(self, child: Expression, delimiter: str,
+                 null_replacement: "Optional[str]" = None):
+        self.children = (child,)
+        self.delimiter = delimiter
+        self.null_replacement = null_replacement
+
+    def _resolve(self):
+        self.dtype = t.STRING
+        self.nullable = True
+
+    def _fp_extra(self):
+        return f"{self.delimiter};{self.null_replacement}"
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            parts = []
+            for x in v:
+                if x is None:
+                    if self.null_replacement is not None:
+                        parts.append(self.null_replacement)
+                else:
+                    parts.append(str(x))
+            out.append(self.delimiter.join(parts))
+        return pa.array(out, pa.string())
+
+
+class Sequence(_CpuArrayExpression):
+    """sequence(start, stop[, step]) over integral inputs (Spark)."""
+
+    def __init__(self, start: Expression, stop: Expression,
+                 step: "Optional[Expression]" = None):
+        self.children = (start, stop) if step is None \
+            else (start, stop, step)
+
+    def _resolve(self):
+        self.dtype = t.ArrayType(self.children[0].dtype)
+        self.nullable = True
+
+    def unsupported_reasons(self, conf):
+        for c in self.children:
+            if not t.is_integral(c.dtype):
+                return [f"sequence over {c.dtype.simple_string}"]
+        return [_OFF_DEVICE]
+
+    def _eval_cpu(self, rb, kids):
+        starts = kids[0].to_pylist()
+        stops = kids[1].to_pylist()
+        steps = kids[2].to_pylist() if len(kids) > 2 \
+            else [None] * len(starts)
+        out = []
+        for a, b, st in zip(starts, stops, steps):
+            if a is None or b is None:
+                out.append(None)
+                continue
+            if st is None:
+                st = 1 if b >= a else -1
+            if st == 0:
+                out.append(None)
+                continue
+            seq = list(range(int(a), int(b) + (1 if st > 0 else -1),
+                             int(st)))
+            out.append(seq)
+        return pa.array(out, pa.list_(_arrow_elem(self.dtype)))
+
+
+# ---- map construction / transformation (CPU; maps have no flat device
+# lane beyond the shattered fast paths in plan/structs.py) ----
+
+class _CpuMapExpression(Expression):
+    def unsupported_reasons(self, conf):
+        return ["MAP values live on the CPU path"]
+
+    def _map_arrow(self):
+        from ..columnar.host import dtype_to_arrow
+        return pa.map_(dtype_to_arrow(self.dtype.key_type),
+                       dtype_to_arrow(self.dtype.value_type))
+
+
+class StrToMap(_CpuMapExpression):
+    """str_to_map(text, pairDelim, keyValueDelim) (Spark StringToMap;
+    reference mapUtils JNI)."""
+
+    def __init__(self, child: Expression, pair_delim: str = ",",
+                 kv_delim: str = ":"):
+        self.children = (child,)
+        self.pair_delim = pair_delim
+        self.kv_delim = kv_delim
+
+    def _resolve(self):
+        self.dtype = t.MapType(t.STRING, t.STRING)
+        self.nullable = self.children[0].nullable
+
+    def _fp_extra(self):
+        return f"{self.pair_delim};{self.kv_delim}"
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for s in kids[0].to_pylist():
+            if s is None:
+                out.append(None)
+                continue
+            m = []
+            seen = set()
+            for pair in s.split(self.pair_delim):
+                k, _, v = pair.partition(self.kv_delim)
+                vv = v if _ else None
+                if k in seen:
+                    raise ValueError(
+                        f"duplicate map key {k!r} in str_to_map "
+                        "(spark.sql.mapKeyDedupPolicy=EXCEPTION)")
+                seen.add(k)
+                m.append((k, vv))
+            out.append(m)
+        return pa.array(out, self._map_arrow())
+
+
+class MapFromArrays(_CpuMapExpression):
+    """map_from_arrays(keys, values)."""
+
+    def __init__(self, keys: Expression, values: Expression):
+        self.children = (keys, values)
+
+    def _resolve(self):
+        self.dtype = t.MapType(self.children[0].dtype.element_type,
+                               self.children[1].dtype.element_type)
+        self.nullable = True
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for ks, vs in zip(kids[0].to_pylist(), kids[1].to_pylist()):
+            if ks is None or vs is None:
+                out.append(None)
+            else:
+                out.append(list(zip(ks, vs)))
+        return pa.array(out, self._map_arrow())
+
+
+class MapConcat(_CpuMapExpression):
+    """map_concat(m1, m2, ...): duplicate keys RAISE, matching Spark's
+    default spark.sql.mapKeyDedupPolicy=EXCEPTION."""
+
+    def __init__(self, *maps: Expression):
+        assert maps
+        self.children = tuple(maps)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = any(c.nullable for c in self.children)
+
+    def _eval_cpu(self, rb, kids):
+        cols = [k.to_pylist() for k in kids]
+        out = []
+        for row in zip(*cols):
+            if any(m is None for m in row):
+                out.append(None)
+                continue
+            merged = {}
+            for m in row:
+                for k, v in m:
+                    if k in merged:
+                        raise ValueError(
+                            f"duplicate map key {k!r} in map_concat "
+                            "(spark.sql.mapKeyDedupPolicy=EXCEPTION)")
+                    merged[k] = v
+            out.append(list(merged.items()))
+        return pa.array(out, self._map_arrow())
+
+
+class MapEntries(_CpuMapExpression):
+    """map_entries(m) -> array<struct<key,value>>."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def _resolve(self):
+        mt = self.children[0].dtype
+        self.dtype = t.ArrayType(t.StructType([
+            t.StructField("key", mt.key_type),
+            t.StructField("value", mt.value_type)]))
+        self.nullable = self.children[0].nullable
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        st = self.dtype.element_type
+        out = []
+        for m in kids[0].to_pylist():
+            if m is None:
+                out.append(None)
+            else:
+                out.append([{"key": k, "value": v} for k, v in m])
+        return pa.array(out, pa.list_(pa.struct(
+            [pa.field("key", dtype_to_arrow(st.fields[0].data_type)),
+             pa.field("value", dtype_to_arrow(st.fields[1].data_type))])))
+
+
+class _MapLambda(_CpuMapExpression):
+    """Base for map higher-order fns with a (k, v) lambda body evaluated
+    per entry on host rows (reference higherOrderFunctions.scala map
+    forms)."""
+
+    def __init__(self, child: Expression, fn):
+        self.children = (child,)
+        self.fn = fn                  # python (k, v) -> value
+
+    def _fp_extra(self):
+        return repr(self.fn)
+
+
+class TransformValues(_MapLambda):
+    """transform_values(m, (k, v) -> body) with a python lambda body."""
+
+    def _resolve(self):
+        mt = self.children[0].dtype
+        self.dtype = t.MapType(mt.key_type, mt.value_type)
+        self.nullable = self.children[0].nullable
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for m in kids[0].to_pylist():
+            out.append(None if m is None
+                       else [(k, self.fn(k, v)) for k, v in m])
+        return pa.array(out, self._map_arrow())
+
+
+class TransformKeys(_MapLambda):
+    def _resolve(self):
+        mt = self.children[0].dtype
+        self.dtype = t.MapType(mt.key_type, mt.value_type)
+        self.nullable = self.children[0].nullable
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for m in kids[0].to_pylist():
+            out.append(None if m is None
+                       else [(self.fn(k, v), v) for k, v in m])
+        return pa.array(out, self._map_arrow())
+
+
+class MapFilter(_MapLambda):
+    def _resolve(self):
+        mt = self.children[0].dtype
+        self.dtype = t.MapType(mt.key_type, mt.value_type)
+        self.nullable = self.children[0].nullable
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for m in kids[0].to_pylist():
+            out.append(None if m is None
+                       else [(k, v) for k, v in m if self.fn(k, v)])
+        return pa.array(out, self._map_arrow())

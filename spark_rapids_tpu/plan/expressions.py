@@ -2266,3 +2266,471 @@ class Murmur3Hash(Expression):
                     h = murmur3_int32_host(int(v), h)
             out.append(h - 2**32 if h >= 2**31 else h)
         return pa.array(out, pa.int32())
+
+
+# ---------------------------------------------------------------------------
+# Bitwise family (reference bitwise.scala; device: one VPU op each)
+# ---------------------------------------------------------------------------
+
+class _BitwiseBinary(Expression):
+    _op = None        # (jnp a, jnp b) -> jnp
+    _pyop = None      # (int, int) -> int
+
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = any(c.nullable for c in self.children)
+
+    def unsupported_reasons(self, conf):
+        out = []
+        for c in self.children:
+            if not t.is_integral(c.dtype):
+                out.append(f"bitwise over {c.dtype.simple_string}")
+        return out
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.kernels import merge_validity
+        return DevVal(type(self)._op(kids[0].data, kids[1].data),
+                      merge_validity(kids[0].validity, kids[1].validity),
+                      self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        a, b = kids[0].to_pylist(), kids[1].to_pylist()
+        from ..columnar.host import dtype_to_arrow
+        bits = 8 * np.dtype(t.physical_np_dtype(self.dtype)).itemsize
+        mask = (1 << bits) - 1
+        sign = 1 << (bits - 1)
+        out = []
+        for x, y in zip(a, b):
+            if x is None or y is None:
+                out.append(None)
+                continue
+            v = type(self)._pyop(int(x), int(y)) & mask
+            out.append(v - (1 << bits) if v & sign else v)
+        return pa.array(out, dtype_to_arrow(self.dtype))
+
+
+class BitwiseAnd(_BitwiseBinary):
+    _op = staticmethod(lambda a, b: a & b)
+    _pyop = staticmethod(lambda a, b: a & b)
+
+
+class BitwiseOr(_BitwiseBinary):
+    _op = staticmethod(lambda a, b: a | b)
+    _pyop = staticmethod(lambda a, b: a | b)
+
+
+class BitwiseXor(_BitwiseBinary):
+    _op = staticmethod(lambda a, b: a ^ b)
+    _pyop = staticmethod(lambda a, b: a ^ b)
+
+
+class BitwiseNot(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def unsupported_reasons(self, conf):
+        if not t.is_integral(self.children[0].dtype):
+            return [f"bitwise over "
+                    f"{self.children[0].dtype.simple_string}"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        return DevVal(~kids[0].data, kids[0].validity, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        bits = 8 * np.dtype(t.physical_np_dtype(self.dtype)).itemsize
+        mask = (1 << bits) - 1
+        sign = 1 << (bits - 1)
+        out = []
+        for x in kids[0].to_pylist():
+            if x is None:
+                out.append(None)
+                continue
+            v = (~int(x)) & mask
+            out.append(v - (1 << bits) if v & sign else v)
+        return pa.array(out, dtype_to_arrow(self.dtype))
+
+
+class _Shift(Expression):
+    """Java shift semantics: the shift distance wraps modulo the value
+    width (Spark ShiftLeft/ShiftRight/ShiftRightUnsigned)."""
+    _kind = "left"
+
+    def __init__(self, child, amount):
+        self.children = (child, amount)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = any(c.nullable for c in self.children)
+
+    def unsupported_reasons(self, conf):
+        out = []
+        if not isinstance(self.children[0].dtype,
+                          (t.IntegerType, t.LongType)):
+            out.append("shift base must be INT or BIGINT")
+        if not t.is_integral(self.children[1].dtype):
+            out.append("shift amount must be integral")
+        return out
+
+    def _bits(self):
+        return 64 if isinstance(self.dtype, t.LongType) else 32
+
+    def _eval_dev(self, ctx, kids):
+        import jax.numpy as jnp
+        from ..ops.kernels import merge_validity
+        bits = self._bits()
+        sh = (kids[1].data.astype(jnp.int32) & (bits - 1))
+        v = kids[0].data
+        if self._kind == "left":
+            out = v << sh.astype(v.dtype)
+        elif self._kind == "right":
+            out = v >> sh.astype(v.dtype)
+        else:
+            u = v.astype(jnp.uint64 if bits == 64 else jnp.uint32)
+            out = (u >> sh.astype(u.dtype)).astype(v.dtype)
+        return DevVal(out, merge_validity(kids[0].validity,
+                                          kids[1].validity), self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        bits = self._bits()
+        mask = (1 << bits) - 1
+        sign = 1 << (bits - 1)
+        out = []
+        for x, s in zip(kids[0].to_pylist(), kids[1].to_pylist()):
+            if x is None or s is None:
+                out.append(None)
+                continue
+            s = int(s) & (bits - 1)
+            x = int(x)
+            if self._kind == "left":
+                v = (x << s) & mask
+            elif self._kind == "right":
+                v = (x >> s) & mask   # python >> is already arithmetic
+            else:
+                v = ((x & mask) >> s) & mask
+            out.append(v - (1 << bits) if v & sign else v)
+        return pa.array(out, dtype_to_arrow(self.dtype))
+
+
+class ShiftLeft(_Shift):
+    _kind = "left"
+
+
+class ShiftRight(_Shift):
+    _kind = "right"
+
+
+class ShiftRightUnsigned(_Shift):
+    _kind = "unsigned"
+
+
+class BitCount(Expression):
+    """bit_count(x): population count of the two's-complement form."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.INT
+        self.nullable = self.children[0].nullable
+
+    def unsupported_reasons(self, conf):
+        dt = self.children[0].dtype
+        if not (t.is_integral(dt) or isinstance(dt, t.BooleanType)):
+            return [f"bit_count over {dt.simple_string}"]
+        return []
+
+    def _eval_dev(self, ctx, kids):
+        import jax.numpy as jnp
+        from ..ops.kernels import compute_view
+        d = kids[0].data
+        if d.dtype == jnp.bool_:
+            cnt = d.astype(jnp.int32)
+        else:
+            # Spark counts bits of the SIGN-EXTENDED 64-bit value
+            u = d.astype(jnp.int64).astype(jnp.uint64)
+            cnt = jax.lax.population_count(u).astype(jnp.int32)
+        return DevVal(cnt, kids[0].validity, t.INT)
+
+    def _eval_cpu(self, rb, kids):
+        isbool = isinstance(self.children[0].dtype, t.BooleanType)
+        mask = (1 << 64) - 1         # sign-extend to 64 bits (Spark)
+        out = []
+        for x in kids[0].to_pylist():
+            if x is None:
+                out.append(None)
+            elif isbool:
+                out.append(1 if x else 0)
+            else:
+                out.append(bin(int(x) & mask).count("1"))
+        return pa.array(out, pa.int32())
+
+
+class WidthBucket(Expression):
+    """width_bucket(v, lo, hi, n) — Spark/ANSI histogram bucket index."""
+
+    def __init__(self, value, lo, hi, nbuckets):
+        self.children = (value, lo, hi, nbuckets)
+
+    def _resolve(self):
+        self.dtype = t.LONG
+        self.nullable = True
+
+    def unsupported_reasons(self, conf):
+        out = []
+        for c in self.children:
+            if not t.is_numeric(c.dtype):
+                out.append(f"width_bucket over {c.dtype.simple_string}")
+        return out
+
+    @staticmethod
+    def _bucket(v, lo, hi, n):
+        if n <= 0 or lo == hi or any(
+                x != x for x in (v, lo, hi)):      # NaN/degenerate
+            return None
+        if lo < hi:
+            if v < lo:
+                return 0
+            if v >= hi:
+                return n + 1
+            return int((v - lo) * n / (hi - lo)) + 1
+        if v > lo:
+            return 0
+        if v <= hi:
+            return n + 1
+        return int((lo - v) * n / (lo - hi)) + 1
+
+    def _eval_dev(self, ctx, kids):
+        import jax.numpy as jnp
+        from ..ops.kernels import compute_view, merge_validity
+        v = compute_view(kids[0].data, self.children[0].dtype) \
+            .astype(jnp.float64)
+        lo = compute_view(kids[1].data, self.children[1].dtype) \
+            .astype(jnp.float64)
+        hi = compute_view(kids[2].data, self.children[2].dtype) \
+            .astype(jnp.float64)
+        n = kids[3].data.astype(jnp.int64)
+        asc = lo < hi
+        below = jnp.where(asc, v < lo, v > lo)
+        above = jnp.where(asc, v >= hi, v <= hi)
+        frac = jnp.where(asc, (v - lo) / (hi - lo),
+                         (lo - v) / (lo - hi))
+        mid = (frac * n.astype(jnp.float64)).astype(jnp.int64) + 1
+        out = jnp.where(below, 0, jnp.where(above, n + 1, mid))
+        bad = (n <= 0) | (lo == hi) | jnp.isnan(v) | jnp.isnan(lo) | \
+            jnp.isnan(hi)
+        valid = merge_validity(kids[0].validity, kids[1].validity,
+                               kids[2].validity, kids[3].validity)
+        valid = (~bad) if valid is None else (valid & ~bad)
+        return DevVal(out, valid, t.LONG)
+
+    def _eval_cpu(self, rb, kids):
+        vals = [k.to_pylist() for k in kids]
+        out = []
+        for v, lo, hi, n in zip(*vals):
+            if None in (v, lo, hi, n):
+                out.append(None)
+            else:
+                out.append(self._bucket(float(v), float(lo), float(hi),
+                                        int(n)))
+        return pa.array(out, pa.int64())
+
+
+class XxHash64(Expression):
+    """xxhash64(...): Spark's 64-bit xxHash with seed 42 chained across
+    columns (reference spark-rapids-jni Hash.xxhash64 /
+    HashFunctions.scala).  Device kernels in ops/hashing.py; int lanes
+    hash via XXH64.hashInt, longs/dates/timestamps via hashLong, string
+    columns via a host-hashed dictionary table (single/first column
+    only, like Murmur3Hash — chained seeds need the byte kernel)."""
+
+    def __init__(self, *items):
+        assert items
+        self.children = tuple(items)
+
+    def _resolve(self):
+        self.dtype = t.LONG
+        self.nullable = False
+
+    def _prepare(self, pctx, kids):
+        from ..ops.hashing import dict_xxhash_array
+        for k, c in zip(kids, self.children):
+            if isinstance(c.dtype, t.StringType):
+                d = k.dictionary
+                pctx.add(self, dict_xxhash_array(
+                    d.cast(pa.string()) if d is not None
+                    else pa.array([], pa.string()), 42))
+        return HostVal()
+
+    def unsupported_reasons(self, conf):
+        out = []
+        strings = [c for c in self.children
+                   if isinstance(c.dtype, t.StringType)]
+        if strings and (len(self.children) > 1 or
+                        self.children[0] is not strings[0]):
+            out.append("string input to xxhash64() only as the "
+                       "single/first column (chained-seed string hashing "
+                       "needs the byte-level kernel)")
+        for c in self.children:
+            if isinstance(c.dtype, (t.ArrayType, t.MapType, t.StructType,
+                                    t.BinaryType, t.FloatType)):
+                out.append(f"xxhash64 over {c.dtype.simple_string}")
+            if isinstance(c.dtype, t.DoubleType):
+                out.append("xxhash64 over DOUBLE (bit-exact f64 lane "
+                           "widening not wired)")
+            if isinstance(c.dtype, t.DecimalType):
+                out.append("xxhash64 over decimal")
+        return out
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.hashing import xxhash64_int_lane, xxhash64_long_lane
+        from ..ops.kernels import valid_or_true
+        aux_iter = iter(ctx.aux_of(self))
+        h = jnp.full((ctx.capacity,), 42, jnp.uint64)
+        for k, c in zip(kids, self.children):
+            valid = valid_or_true(k.validity, ctx.capacity)
+            if isinstance(c.dtype, t.StringType):
+                table = next(aux_iter)
+                codes = jnp.clip(k.data, 0, table.shape[0] - 1)
+                lane = table[codes].astype(jnp.uint64)
+                h = jnp.where(valid, lane, h)
+                continue
+            dt = c.dtype
+            if isinstance(dt, (t.LongType, t.TimestampType)):
+                lane = k.data.astype(jnp.uint64)
+                nh = xxhash64_long_lane(lane, h)
+            elif isinstance(dt, t.BooleanType):
+                lane = k.data.astype(jnp.uint64) & jnp.uint64(0xFFFFFFFF)
+                nh = xxhash64_int_lane(lane, h)
+            else:   # byte/short/int/date hash as 32-bit
+                lane = k.data.astype(jnp.int32).astype(jnp.uint32) \
+                    .astype(jnp.uint64)
+                nh = xxhash64_int_lane(lane, h)
+            h = jnp.where(valid, nh, h)   # nulls: seed passes through
+        return DevVal(h.astype(jnp.int64), None, t.LONG)
+
+    def _eval_cpu(self, rb, kids):
+        from ..ops.hashing import (xxhash64_int_host, xxhash64_long_host,
+                                   xxhash64_utf8)
+        out = []
+        cols = [k.to_pylist() for k in kids]
+        for i in range(rb.num_rows):
+            h = 42
+            for vals, c in zip(cols, self.children):
+                v = vals[i]
+                if v is None:
+                    continue
+                dt = c.dtype
+                if isinstance(dt, t.StringType):
+                    h = xxhash64_utf8(v, h)
+                elif isinstance(dt, (t.LongType, t.TimestampType)):
+                    h = xxhash64_long_host(int(v), h)
+                elif isinstance(dt, t.BooleanType):
+                    h = xxhash64_int_host(1 if v else 0, h)
+                elif isinstance(dt, t.DateType):
+                    import datetime as _dt
+                    days = (v - _dt.date(1970, 1, 1)).days \
+                        if isinstance(v, _dt.date) else int(v)
+                    h = xxhash64_int_host(days, h)
+                else:
+                    h = xxhash64_int_host(int(v), h)
+            out.append(h - (1 << 64) if h >= (1 << 63) else h)
+        return pa.array(out, pa.int64())
+
+
+class ToDegrees(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.degrees)
+    fn_np = staticmethod(np.degrees)
+
+
+class ToRadians(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.radians)
+    fn_np = staticmethod(np.radians)
+
+
+class Expm1(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.expm1)
+    fn_np = staticmethod(np.expm1)
+
+
+class Log1p(UnaryMathExpression):
+    """log1p: Spark returns null for x <= -1 (ln of non-positive)."""
+    fn_dev = staticmethod(jnp.log1p)
+    fn_np = staticmethod(np.log1p)
+
+    def _resolve(self):
+        self.dtype = t.DOUBLE
+        self.nullable = True
+
+    def _eval_dev(self, ctx, kids):
+        import jax.numpy as _j
+        x = kids[0].data.astype(_j.float64)
+        data = _j.log1p(x)
+        valid = kids[0].validity
+        ok = x > -1.0
+        valid = ok if valid is None else (valid & ok)
+        return DevVal(data, valid, t.DOUBLE)
+
+    def _eval_cpu(self, rb, kids):
+        arr = kids[0].cast(pa.float64())
+        x = arr.to_numpy(zero_copy_only=False)
+        with np.errstate(all="ignore"):
+            out = np.log1p(x)
+        mask = np.asarray(pc.is_null(arr)) | ~(x > -1.0)
+        return pa.array(out, pa.float64(), mask=mask)
+
+
+class Rint(UnaryMathExpression):
+    fn_dev = staticmethod(jnp.round)
+    fn_np = staticmethod(np.rint)
+
+
+class Cot(UnaryMathExpression):
+    fn_dev = staticmethod(lambda x: 1.0 / jnp.tan(x))
+    fn_np = staticmethod(lambda x: 1.0 / np.tan(x))
+
+
+class Sec(UnaryMathExpression):
+    fn_dev = staticmethod(lambda x: 1.0 / jnp.cos(x))
+    fn_np = staticmethod(lambda x: 1.0 / np.cos(x))
+
+
+class Csc(UnaryMathExpression):
+    fn_dev = staticmethod(lambda x: 1.0 / jnp.sin(x))
+    fn_np = staticmethod(lambda x: 1.0 / np.sin(x))
+
+
+class Hypot(Expression):
+    """hypot(a, b)."""
+
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def _resolve(self):
+        self.dtype = t.DOUBLE
+        self.nullable = any(c.nullable for c in self.children)
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.kernels import merge_validity
+        data = jnp.hypot(kids[0].data.astype(jnp.float64),
+                         kids[1].data.astype(jnp.float64))
+        return DevVal(data, merge_validity(kids[0].validity,
+                                           kids[1].validity), t.DOUBLE)
+
+    def _eval_cpu(self, rb, kids):
+        a = kids[0].cast(pa.float64()).to_numpy(zero_copy_only=False)
+        b = kids[1].cast(pa.float64()).to_numpy(zero_copy_only=False)
+        with np.errstate(all="ignore"):
+            out = np.hypot(a, b)
+        mask = np.asarray(pc.is_null(kids[0])) | \
+            np.asarray(pc.is_null(kids[1]))
+        return pa.array(out, pa.float64(), mask=mask)

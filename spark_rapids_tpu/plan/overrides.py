@@ -115,6 +115,43 @@ for _cls, _desc in [
         (ArrayForAll, "segment three-valued all"),
         (LambdaVar, "lambda-bound element variable")]:
     expr_rule(_cls, _ARR_SIG, desc=_desc)
+
+from .collections import (ArrayDistinct, ArrayExcept,  # noqa: E402
+                          ArrayIntersect, ArrayJoin, ArrayPosition,
+                          ArrayRemove, ArrayRepeat, ArraysOverlap,
+                          ArrayUnion, ElementAt, Flatten, MapConcat,
+                          MapEntries, MapFilter, MapFromArrays, ReverseArray,
+                          Sequence, Slice, StrToMap, TransformKeys,
+                          TransformValues)
+
+for _cls, _desc in [
+        (ElementAt, "1-based element gather (negative from end)"),
+        (ArrayPosition, "segment first-match position"),
+        (Slice, "values-lane range compaction"),
+        (ReverseArray, "per-row reversal gather")]:
+    expr_rule(_cls, _ARR_SIG, desc=_desc)
+for _cls, _desc in [
+        (ArrayRepeat, "array_repeat (CPU)"),
+        (Flatten, "flatten array<array> (CPU)"),
+        (ArrayDistinct, "first-occurrence dedupe (CPU)"),
+        (ArraysOverlap, "3-valued set overlap (CPU)"),
+        (ArrayUnion, "set union (CPU)"),
+        (ArrayIntersect, "set intersect (CPU)"),
+        (ArrayExcept, "set except (CPU)"),
+        (ArrayRemove, "drop equal elements (CPU)"),
+        (ArrayJoin, "string join (CPU)"),
+        (Sequence, "integral range generation (CPU)")]:
+    expr_rule(_cls, _ARR_SIG, desc=_desc)
+_MAP_SIG = _COMMON + t.T.MAP + t.T.ARRAY + t.T.STRUCT
+for _cls, _desc in [
+        (StrToMap, "str_to_map (CPU)"),
+        (MapFromArrays, "map_from_arrays (CPU)"),
+        (MapConcat, "map_concat LAST_WIN (CPU)"),
+        (MapEntries, "map_entries (CPU)"),
+        (TransformValues, "map value lambda (CPU)"),
+        (TransformKeys, "map key lambda (CPU)"),
+        (MapFilter, "map entry filter (CPU)")]:
+    expr_rule(_cls, _MAP_SIG, desc=_desc)
 expr_rule(E.Literal, _COMMON + t.T.NULL, desc="literal value")
 expr_rule(E.Alias, _COMMON, desc="named expression")
 for _c in (E.Add, E.Subtract, E.Multiply, E.Divide, E.IntegralDivide,
@@ -134,7 +171,8 @@ expr_rule(E.CaseWhen, _COMMON, desc="case/when")
 expr_rule(E.In, _COMMON, t.T.BOOLEAN, desc="IN list")
 for _c in (E.Sqrt, E.Exp, E.Log, E.Pow, E.Sin, E.Cos, E.Tan, E.Asin,
            E.Acos, E.Atan, E.Sinh, E.Cosh, E.Tanh, E.Log10, E.Log2,
-           E.Cbrt, E.Signum, E.Atan2):
+           E.Cbrt, E.Signum, E.Atan2, E.ToDegrees, E.ToRadians, E.Expm1,
+           E.Log1p, E.Rint, E.Cot, E.Sec, E.Csc, E.Hypot):
     expr_rule(_c, t.T.NUMERIC, t.T.FP, desc="math fn")
 for _c in (E.Floor, E.Ceil):
     expr_rule(_c, t.T.NUMERIC, t.T.INTEGRAL, desc="rounding")
@@ -145,6 +183,17 @@ for _c in (E.Greatest, E.Least):
               desc="n-ary extremum (null-skipping, NaN greatest)")
 expr_rule(E.Murmur3Hash, _COMMON, t.T.INTEGRAL,
           desc="Spark hash() — bit-exact murmur3 device kernels")
+expr_rule(E.XxHash64, _COMMON, t.T.INTEGRAL,
+          desc="Spark xxhash64() — bit-exact XXH64 device kernels")
+for _c in (E.BitwiseAnd, E.BitwiseOr, E.BitwiseXor, E.BitwiseNot):
+    expr_rule(_c, t.T.INTEGRAL + t.T.NULL, desc="bitwise op")
+for _c in (E.ShiftLeft, E.ShiftRight, E.ShiftRightUnsigned):
+    expr_rule(_c, t.T.INTEGRAL + t.T.NULL,
+              desc="Java shift (distance mod width)")
+expr_rule(E.BitCount, t.T.INTEGRAL + t.T.BOOLEAN, t.T.INTEGRAL,
+          desc="population count")
+expr_rule(E.WidthBucket, t.T.NUMERIC, t.T.INTEGRAL,
+          desc="ANSI histogram bucket")
 expr_rule(E.RaiseError, t.T.ALL_SIMPLE + t.T.NULL,
           desc="raise_error (CPU path: device programs cannot throw)")
 expr_rule(E.Cast, t.T.ALL_SIMPLE, desc="cast (pairs gated by Cast itself)")
@@ -188,6 +237,20 @@ for _c in (STR.RegexpExtract, STR.RegexpReplace):
               desc="regex extract/replace (dictionary transform)")
 expr_rule(STR.ParseUrl, t.T.STRING,
           desc="parse_url (JNI ParseURI role; dictionary transform)")
+expr_rule(STR.Conv, t.T.STRING + t.T.INTEGRAL, t.T.STRING,
+          desc="base conversion (dictionary transform)")
+expr_rule(STR.Hex, t.T.STRING, t.T.STRING,
+          desc="hex of UTF-8 bytes (dictionary transform)")
+expr_rule(STR.FormatNumber, t.T.NUMERIC, t.T.STRING,
+          desc="format_number (CPU path)")
+expr_rule(STR.Bin, t.T.INTEGRAL, t.T.STRING, desc="bin (CPU path)")
+for _c in (STR.Translate, STR.SubstringIndex, STR.Left, STR.Right,
+           STR.Base64E, STR.UnBase64, STR.SoundEx):
+    expr_rule(_c, t.T.STRING + t.T.INTEGRAL + t.T.NULL, t.T.STRING,
+              desc="string transform (dictionary rewrite)")
+for _c in (STR.Levenshtein, STR.FindInSet):
+    expr_rule(_c, t.T.STRING, t.T.INTEGRAL,
+              desc="string measure (dictionary int transform)")
 
 from . import json_fns as JSON  # noqa: E402  (registry population)
 
@@ -1402,7 +1465,49 @@ def apply_overrides(plan: L.LogicalPlan,
             if mode == "ALL" or line.lstrip().startswith("!"):
                 log.info(line)
     kind, root = meta.convert()
+    if kind == "device":
+        _negotiate_lazy_sel(root)
     return PhysicalQuery(meta, kind, root, conf)
+
+
+def _negotiate_lazy_sel(root) -> None:
+    """Mark joins whose parent consumes liveness as a MASK so they skip
+    output compaction (DeviceBatch.sel, the JoinGatherer-deferred-gather
+    role): aggregations fold the mask into their live lane, a parent
+    join folds it into probe liveness, projections pass it through.  Row
+    gathers dominate device time on TPU, so every skipped compaction is
+    a full stacked gather pass saved."""
+    from ..exec.adaptive import AdaptiveShuffledJoinExec
+    from ..exec.join import HashJoinExec
+    from ..exec.plan import FilterExec, HashAggregateExec, ProjectExec
+
+    def producer(node):
+        # look through the mask-transparent chain (filters fold the mask
+        # into their predicate; projections propagate sel)
+        while isinstance(node, (FilterExec, ProjectExec)):
+            node = node.child
+        if isinstance(node, (HashJoinExec, AdaptiveShuffledJoinExec)):
+            return node
+        return None
+
+    seen = set()
+
+    def walk(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        if isinstance(node, HashAggregateExec):
+            p = producer(node.child)
+            if p is not None:
+                p.lazy_sel = True
+        elif isinstance(node, (HashJoinExec, AdaptiveShuffledJoinExec)):
+            p = producer(node.left)      # probe side only
+            if p is not None:
+                p.lazy_sel = True
+        for c in node.children:
+            walk(c)
+
+    walk(root)
 
 
 # ---------------------------------------------------------------------------

@@ -990,3 +990,286 @@ class ParseUrl(DictTransform):
             if (u.username or u.password) else None,
         }.get(part)
         return out
+
+
+class Conv(DictTransform):
+    """conv(numStr, fromBase, toBase) — Spark base conversion over the
+    dictionary (reference stringFunctions.scala Conv).  Bases 2..36;
+    invalid digits truncate at the first bad char; negative toBase
+    renders signed."""
+    literal_slots = (1, 2)
+
+    def __init__(self, child, from_base, to_base):
+        fb = from_base if isinstance(from_base, Expression) \
+            else Literal(from_base)
+        tb = to_base if isinstance(to_base, Expression) \
+            else Literal(to_base)
+        self.children = (child, fb, tb)
+
+    def _transform_value(self, s, args):
+        fb, tb = int(args[1]), int(args[2])
+        if not (2 <= abs(fb) <= 36 and 2 <= abs(tb) <= 36):
+            return None
+        digits = "0123456789abcdefghijklmnopqrstuvwxyz"
+        s2 = s.strip()
+        neg = s2.startswith("-")
+        if neg:
+            s2 = s2[1:]
+        val = 0
+        seen = False
+        for ch in s2.lower():
+            d = digits.find(ch)
+            if d < 0 or d >= abs(fb):
+                break
+            val = val * abs(fb) + d
+            seen = True
+        if not seen:
+            return None
+        # Java semantics: unsigned 64-bit wrap for positive toBase
+        if neg:
+            val = -val
+        if tb > 0:
+            val &= (1 << 64) - 1
+            sign = ""
+        else:
+            sign = "-" if val < 0 else ""
+            val = abs(val)
+            tb = -tb
+        if val == 0:
+            return "0"
+        out = []
+        while val:
+            out.append(digits[val % tb])
+            val //= tb
+        return sign + "".join(reversed(out)).upper()
+
+
+class Hex(DictTransform):
+    """hex(str): hex of the UTF-8 bytes (Spark Hex over strings)."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _transform_value(self, s, args):
+        return s.encode("utf-8").hex().upper()
+
+
+class FormatNumber(Expression):
+    """format_number(x, d): thousands separators + d decimal places
+    (HALF_EVEN, matching java.text.DecimalFormat)."""
+
+    def __init__(self, child, d: int):
+        self.children = (child,)
+        self.d = int(d)
+
+    def _resolve(self):
+        self.dtype = t.STRING
+        self.nullable = True
+
+    def _fp_extra(self):
+        return str(self.d)
+
+    def unsupported_reasons(self, conf):
+        if self.d < 0:
+            return ["negative decimal places"]
+        if not t.is_numeric(self.children[0].dtype):
+            return [f"format_number over "
+                    f"{self.children[0].dtype.simple_string}"]
+        return ["per-row string building (CPU path)"]
+
+    def _eval_cpu(self, rb, kids):
+        import decimal as pydec
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            q = pydec.Decimal(str(v)).quantize(
+                pydec.Decimal(1).scaleb(-self.d),
+                rounding=pydec.ROUND_HALF_EVEN)
+            out.append(f"{q:,.{self.d}f}")
+        return pa.array(out, pa.string())
+
+
+class Bin(Expression):
+    """bin(long): binary string of the two's-complement value."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _resolve(self):
+        self.dtype = t.STRING
+        self.nullable = self.children[0].nullable
+
+    def unsupported_reasons(self, conf):
+        if not t.is_integral(self.children[0].dtype):
+            return [f"bin over {self.children[0].dtype.simple_string}"]
+        return ["per-row string building (CPU path)"]
+
+    def _eval_cpu(self, rb, kids):
+        out = []
+        for v in kids[0].to_pylist():
+            if v is None:
+                out.append(None)
+            else:
+                u = int(v) & ((1 << 64) - 1)
+                out.append(format(u, "b"))
+        return pa.array(out, pa.string())
+
+
+class Translate(DictTransform):
+    """translate(str, from, to) — per-char mapping (Spark Translate)."""
+    literal_slots = (1, 2)
+
+    def __init__(self, child, matching: str, replace: str):
+        self.children = (child, Literal(matching), Literal(replace))
+
+    def _transform_value(self, s, args):
+        m, r = args[1], args[2]
+        table = {}
+        for i, ch in enumerate(m):
+            if ch not in table:
+                table[ch] = r[i] if i < len(r) else None
+        out = []
+        for ch in s:
+            t_ = table.get(ch, ch)
+            if t_ is not None:
+                out.append(t_)
+        return "".join(out)
+
+
+class SubstringIndex(DictTransform):
+    """substring_index(str, delim, count) (Spark)."""
+    literal_slots = (1, 2)
+
+    def __init__(self, child, delim: str, count: int):
+        self.children = (child, Literal(delim), Literal(count))
+
+    def _transform_value(self, s, args):
+        delim, count = args[1], int(args[2])
+        if delim == "" or count == 0:
+            return ""
+        parts = s.split(delim)
+        if count > 0:
+            return delim.join(parts[:count])
+        return delim.join(parts[count:])
+
+
+class Left(DictTransform):
+    """left(str, n)."""
+    literal_slots = (1,)
+
+    def __init__(self, child, n: int):
+        self.children = (child, Literal(n))
+
+    def _transform_value(self, s, args):
+        n = int(args[1])
+        return "" if n <= 0 else s[:n]
+
+
+class Right(DictTransform):
+    """right(str, n)."""
+    literal_slots = (1,)
+
+    def __init__(self, child, n: int):
+        self.children = (child, Literal(n))
+
+    def _transform_value(self, s, args):
+        n = int(args[1])
+        return "" if n <= 0 else s[-n:]
+
+
+class Base64E(DictTransform):
+    """base64(str): base64 of the UTF-8 bytes."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _transform_value(self, s, args):
+        import base64
+        return base64.b64encode(s.encode("utf-8")).decode("ascii")
+
+
+class UnBase64(DictTransform):
+    """unbase64(str) decoded back to a UTF-8 string (binary-safe inputs
+    only; invalid base64 -> null)."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _transform_value(self, s, args):
+        import base64
+        try:
+            return base64.b64decode(s, validate=True).decode("utf-8")
+        except Exception:       # noqa: BLE001 - invalid input -> null
+            return None
+
+
+class SoundEx(DictTransform):
+    """soundex(str) — the classic 4-char code (Spark SoundEx)."""
+
+    _CODES = {**{c: d for cs, d in [
+        ("BFPV", "1"), ("CGJKQSXZ", "2"), ("DT", "3"), ("L", "4"),
+        ("MN", "5"), ("R", "6")] for c in cs}}
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def _transform_value(self, s, args):
+        if not s:
+            return s
+        first = s[0].upper()
+        if not first.isalpha() or not first.isascii():
+            return s            # Spark: non-letter head returns input
+        out = [first]
+        prev = self._CODES.get(first, "")
+        for ch in s[1:].upper():
+            code = self._CODES.get(ch, "")
+            if code and code != prev:
+                out.append(code)
+                if len(out) == 4:
+                    break
+            if ch not in "HW":
+                prev = code
+        return "".join(out).ljust(4, "0")
+
+
+class Levenshtein(DictIntTransform):
+    """levenshtein(str, literal) via the dictionary (Spark)."""
+    literal_slots = (1,)
+
+    def __init__(self, child, other: str):
+        self.children = (child, Literal(other))
+
+    def _per_entry(self, s, args):
+        b = args[1]
+        if s is None or b is None:
+            return None
+        if len(s) < len(b):
+            s, b = b, s
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(s, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return prev[-1]
+
+
+class FindInSet(DictIntTransform):
+    """find_in_set(literal, strListCol): 1-based index in the
+    comma-separated list column (Spark FindInSet, needle literal)."""
+    literal_slots = (0,)
+
+    def __init__(self, needle: str, child):
+        self.children = (Literal(needle), child)
+
+    def _per_entry(self, s, args):
+        needle = args[0]
+        if s is None or needle is None:
+            return None
+        if "," in needle:
+            return 0
+        parts = s.split(",")
+        return parts.index(needle) + 1 if needle in parts else 0
