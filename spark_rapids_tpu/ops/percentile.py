@@ -158,3 +158,65 @@ def percentile_trace(key_lanes_info, qs: Sequence[float],
         return out_keys, out, num_groups
 
     return run
+
+
+def collect_trace(key_lanes_info, num_segments: int, capacity: int,
+                  distinct: bool, val_dtype):
+    """Traced collect_list / collect_set as a group-by emitting a RAGGED
+    column (reference GpuAggregateExec.scala collect ops over cuDF
+    lists).  Sort-by-(key[, value], position) makes every group's kept
+    values a contiguous run; a single-lane sort compacts the keep-mask
+    into gather indices — no scatters.
+
+    collect_list keeps non-null values in input order (stable sort on
+    the position payload); collect_set additionally keeps only the first
+    of each distinct value within a group (order unspecified by Spark —
+    here value-sorted).  Returns (out_keys, values, elem_offsets,
+    num_groups); values lane capacity == row capacity."""
+    from .distinct import _value_eq_lanes
+
+    def run(keys, keys_valid, val, val_valid, live):
+        vlive = live & val_valid
+        idx = jnp.arange(capacity, dtype=jnp.int32)
+        if distinct:
+            vlanes = _value_eq_lanes(val, val_dtype)
+            minor = [idx] + list(vlanes) + [(~vlive).astype(jnp.int8)]
+        else:
+            minor = [idx, (~vlive).astype(jnp.int8)]
+        (perm, _s_live, _sk, _skv, seg_ids, _start, out_keys,
+         num_groups, group_live) = sorted_segments(
+            key_lanes_info, keys, keys_valid, live, minor, capacity,
+            num_segments)
+        s_vlive = vlive[perm]
+        s_val = val[perm]
+        keep = s_vlive
+        if distinct:
+            changed = _eq_prev(seg_ids)
+            for lane in _value_eq_lanes(s_val, val_dtype):
+                changed = changed | _eq_prev(lane)
+            keep = keep & changed
+        # kept positions compact to the front, in (group, order) order
+        kept = jnp.sort(jnp.where(keep, idx, jnp.int32(capacity)))
+        kept_c = jnp.clip(kept, 0, capacity - 1)
+        n_kept = jnp.sum(keep, dtype=jnp.int32)
+        values = s_val[kept_c]
+        # per-group counts -> element offsets (scatter-free: counts are
+        # ends-starts in the kept ordering).  kept slots are grouped by
+        # seg id, so each group's count = (# kept with seg < g+1) -
+        # (# kept with seg < g): one cumulative histogram via merge rank
+        kept_seg = seg_ids[kept_c]
+        kept_seg = jnp.where(jnp.arange(capacity) < n_kept, kept_seg,
+                             jnp.int32(num_segments))
+        # rank of each group boundary in the kept_seg (sorted) lane:
+        # offsets[g] = count of kept with seg < g — merge-rank (two lean
+        # 2-operand sorts), not binary search (log-step dependent
+        # gathers are the slowest access pattern on this chip)
+        from .join import _merge_rank
+        offs = _merge_rank(
+            kept_seg.astype(jnp.uint64),
+            jnp.arange(num_segments + 1, dtype=jnp.uint64),
+            side="left").astype(jnp.int32)
+        elem_valid = jnp.arange(capacity, dtype=jnp.int32) < n_kept
+        return out_keys, values, offs, elem_valid, num_groups, group_live
+
+    return run

@@ -677,9 +677,11 @@ class Corr(_BinaryStatAgg):
 # ---------------------------------------------------------------------------
 
 class CollectList(AggregateFunction):
-    """collect_list: ArrayType output keeps it on the CPU path for now
-    (device lanes have no ragged representation; reference uses cuDF
-    lists)."""
+    """collect_list as a DEVICE group-by emitting a ragged column
+    (exec/collect.py CollectAggregateExec over ops/percentile.py
+    collect_trace; reference GpuAggregateExec.scala collect ops over
+    cuDF lists).  Flat element types only — the values ride the
+    values+offsets dual-lane layout."""
     name = "collect_list"
 
     def _resolve(self):
@@ -690,10 +692,21 @@ class CollectList(AggregateFunction):
         return [self.child]
 
     def unsupported_reasons(self, conf):
-        out = [] if conf.is_op_enabled("expression", type(self).__name__) \
+        out = [] if conf is None or \
+            conf.is_op_enabled("expression", type(self).__name__) \
             else [f"{type(self).__name__} disabled by conf"]
-        out.append("collect aggregates produce ARRAY output "
-                   "(device lanes are flat; CPU path handles this)")
+        if self.child is not None and conf is not None:
+            out += self.child.tree_unsupported(conf)
+        if self.child is not None and E._consumes_wide_host(self.child):
+            out.append("128-bit host decimal lane not consumable on "
+                       "device")
+        dt = None if self.child is None else self.child.dtype
+        if isinstance(dt, (t.ArrayType, t.MapType, t.StructType,
+                           t.BinaryType)):
+            out.append(f"collect over {dt.simple_string} "
+                       "(nested elements have no flat values lane)")
+        if isinstance(dt, t.DecimalType) and dt.is_wide:
+            out.append("collect over decimal(>18)")
         return out
 
     def cpu_agg(self):

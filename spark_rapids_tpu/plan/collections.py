@@ -1049,7 +1049,7 @@ class ArraysOverlap(_CpuArrayExpression):
             elif not a or not b:
                 # an empty side can never overlap: false even with nulls
                 out.append(False)
-            elif (len(sa) != len(a)) or (len(sb) != len(b)):
+            elif any(x is None for x in a) or any(x is None for x in b):
                 out.append(None)
             else:
                 out.append(False)
@@ -1387,3 +1387,48 @@ class MapFilter(_MapLambda):
             out.append(None if m is None
                        else [(k, v) for k, v in m if self.fn(k, v)])
         return pa.array(out, self._map_arrow())
+
+
+class RenestArrayStruct(Expression):
+    """Rebuild an ARRAY<STRUCT<...>> column from its shattered parallel
+    ragged lanes (shared offsets) plus the array validity and
+    element-struct validity lanes — the collect-side inverse of the
+    array<struct> shatter (plan/structs.py)."""
+
+    def __init__(self, valid: Expression, elem_valid: Expression,
+                 field_lanes: "List[Expression]", array_type: t.ArrayType):
+        self.children = tuple([valid, elem_valid] + list(field_lanes))
+        self.array_type = array_type
+
+    def _resolve(self):
+        self.dtype = self.array_type
+        self.nullable = True
+
+    def _fp_extra(self):
+        return self.array_type.simple_string
+
+    def unsupported_reasons(self, conf):
+        return ["re-nesting array<struct> (host boundary projection)"]
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        st = self.array_type.element_type
+        valid = kids[0].to_pylist()
+        evs = kids[1].to_pylist()
+        lanes = [k.to_pylist() for k in kids[2:]]
+        fnames = [f.name for f in st.fields]
+        out = []
+        for i, ok in enumerate(valid):
+            if not ok:
+                out.append(None)
+                continue
+            ev = evs[i] or []
+            row = []
+            for j, e_ok in enumerate(ev):
+                if not e_ok:
+                    row.append(None)
+                else:
+                    row.append({fn: lanes[k][i][j]
+                                for k, fn in enumerate(fnames)})
+            out.append(row)
+        return pa.array(out, dtype_to_arrow(self.array_type))

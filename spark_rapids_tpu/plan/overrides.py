@@ -298,6 +298,12 @@ from .aggregates import CountDistinct  # noqa: E402
 agg_rule(CountDistinct, _COMMON, t.T.INTEGRAL,
          desc="count(DISTINCT) as a sorted value-change count")
 
+from .aggregates import CollectList, CollectSet  # noqa: E402
+
+for _c in (CollectList, CollectSet):
+    agg_rule(_c, _COMMON, _COMMON + t.T.ARRAY,
+             desc="collect as a sorted group-by emitting ragged lanes")
+
 # Ragged (ARRAY<primitive|string>) device support: values+offsets lanes
 # (SURVEY §7c; ops/ragged.py).  Scans upload them, projections carry and
 # compute over them, Generate explodes them; row-reordering execs
@@ -323,7 +329,7 @@ exec_rule(L.LogicalAggregateInPandas, t.T.ALL,
 exec_rule(L.LogicalWindowInPandas, t.T.ALL,
           "pandas window UDFs via partition-segmented python workers")
 exec_rule(L.LogicalFilter, _DEVICE_SIMPLE, "filter")
-exec_rule(L.LogicalAggregate, _COMMON, "hash aggregate")
+exec_rule(L.LogicalAggregate, _COMMON + t.T.ARRAY, "hash aggregate")
 exec_rule(L.LogicalSort, t.T.ORDERABLE, "sort")
 exec_rule(L.LogicalLimit, _DEVICE_SIMPLE, "limit")
 exec_rule(L.LogicalJoin, _COMMON, "hash join")
@@ -632,6 +638,19 @@ class AggregateMeta(PlanMeta):
                 self.expr_metas.append(ExprMeta(b.child, self.conf))
 
     def tag_self(self):
+        # group keys must be single flat device lanes: ragged/nested
+        # keys have no boundary comparison, and wide (p>18) decimals
+        # carry a hi lane the groupby boundary/sort machinery ignores
+        for k, kn in zip(self.node.keys, self.node.key_names):
+            if isinstance(k.dtype, (t.ArrayType, t.MapType,
+                                    t.StructType, t.BinaryType)):
+                self.will_not_work(
+                    f"group key {kn}: {k.dtype.simple_string} keys have "
+                    "no flat device lane")
+            if isinstance(k.dtype, t.DecimalType) and k.dtype.is_wide:
+                self.will_not_work(
+                    f"group key {kn}: decimal({k.dtype.precision}) keys "
+                    "carry a second lane the group-by cannot compare")
         # holistic aggregates (sort-based device execs) cannot mix with
         # streaming ones in one device aggregation — the reference
         # routes such plans through separate aggregations
@@ -642,17 +661,25 @@ class AggregateMeta(PlanMeta):
                     f"requires a uniform aggregation)")
 
     def _holistic_split(self):
-        from .aggregates import CountDistinct, Percentile
+        from .aggregates import CollectList, CountDistinct, Percentile
         aggs = self.node.aggs
         return (
             ([isinstance(fn, Percentile) for fn, _n in aggs],
              "percentile"),
             ([isinstance(fn, CountDistinct) for fn, _n in aggs],
              "count(DISTINCT)"),
+            ([isinstance(fn, CollectList) for fn, _n in aggs],
+             "collect_list/collect_set"),
         )
 
     def to_device(self):
-        from .aggregates import CountDistinct, Percentile
+        from .aggregates import CollectList, CountDistinct, Percentile
+        if self.node.aggs and all(isinstance(fn, CollectList)
+                                  for fn, _n in self.node.aggs):
+            from ..exec.collect import CollectAggregateExec
+            return CollectAggregateExec(
+                self.node.keys, self.node.key_names, self.node.aggs,
+                self._device_child())
         if self.node.aggs and all(isinstance(fn, Percentile)
                                   for fn, _n in self.node.aggs):
             from ..exec.percentile import PercentileAggregateExec

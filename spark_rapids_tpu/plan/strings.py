@@ -1008,7 +1008,8 @@ class Conv(DictTransform):
 
     def _transform_value(self, s, args):
         fb, tb = int(args[1]), int(args[2])
-        if not (2 <= abs(fb) <= 36 and 2 <= abs(tb) <= 36):
+        # Spark NumberConverter: fromBase in [2,36]; |toBase| in [2,36]
+        if not (2 <= fb <= 36 and 2 <= abs(tb) <= 36):
             return None
         digits = "0123456789abcdefghijklmnopqrstuvwxyz"
         s2 = s.strip()
@@ -1025,7 +1026,10 @@ class Conv(DictTransform):
             seen = True
         if not seen:
             return None
-        # Java semantics: unsigned 64-bit wrap for positive toBase
+        # Spark NumberConverter: overflow SATURATES to unsigned max
+        if val >= (1 << 64):
+            val = (1 << 64) - 1
+            neg = False
         if neg:
             val = -val
         if tb > 0:

@@ -46,10 +46,27 @@ def _flat_ok(dt: t.DataType) -> bool:
                                t.BinaryType))
 
 
-def _shatterable(dt: t.DataType) -> bool:
+def _flat_struct(dt: t.DataType) -> bool:
+    return isinstance(dt, t.StructType) and len(dt.fields) > 0 and \
+        all(_flat_ok(f.data_type) for f in dt.fields)
+
+
+def _shatterable(dt: t.DataType, depth: int = 0) -> bool:
+    """One nesting level deeper than flat (reference GpuColumnVector.java
+    carries arbitrary nesting; this pass recurses once): struct fields
+    may themselves be FLAT structs (struct-of-struct), and
+    array<struct-of-flat> shatters into parallel ragged lanes sharing
+    offsets."""
     if isinstance(dt, t.StructType):
-        return len(dt.fields) > 0 and \
-            all(_flat_ok(f.data_type) for f in dt.fields)
+        if len(dt.fields) == 0:
+            return False
+        return all(_flat_ok(f.data_type) or
+                   (depth == 0 and _flat_struct(f.data_type))
+                   for f in dt.fields)
+    if isinstance(dt, t.ArrayType) and depth == 0:
+        return _flat_struct(dt.element_type) and all(
+            _device_elem_ok(f.data_type)
+            for f in dt.element_type.fields)
     if isinstance(dt, t.MapType):
         return _device_elem_ok(dt.key_type) and \
             _device_elem_ok(dt.value_type)
@@ -65,7 +82,19 @@ class _Abort(Exception):
 
 def _lane_names(name: str, dt: t.DataType) -> List[str]:
     if isinstance(dt, t.StructType):
-        return [f"{name}#__v"] + [f"{name}#{f.name}" for f in dt.fields]
+        out = [f"{name}#__v"]
+        for f in dt.fields:
+            if _flat_struct(f.data_type):
+                out.extend(_lane_names(f"{name}#{f.name}", f.data_type))
+            else:
+                out.append(f"{name}#{f.name}")
+        return out
+    if isinstance(dt, t.ArrayType):
+        # array<struct>: element-struct validity lane + one ragged lane
+        # per field, all sharing the array's offsets
+        st = dt.element_type
+        return ([f"{name}#__v", f"{name}#__ev"] +
+                [f"{name}#{f.name}" for f in st.fields])
     return [f"{name}#__v", f"{name}#keys", f"{name}#vals"]
 
 
@@ -85,8 +114,36 @@ def _flatten_table(tbl: pa.Table, names: Set[str]) -> pa.Table:
         fields.append(pa.field(f"{f.name}#__v", pa.bool_(), False))
         if pa.types.is_struct(f.type):
             for sub in f.type:
-                cols.append(pc.struct_field(arr, sub.name))
-                fields.append(pa.field(f"{f.name}#{sub.name}", sub.type))
+                sub_arr = pc.struct_field(arr, sub.name)
+                if pa.types.is_struct(sub.type):
+                    # struct-of-struct: recurse one level
+                    cols.append(pc.is_valid(sub_arr))
+                    fields.append(pa.field(
+                        f"{f.name}#{sub.name}#__v", pa.bool_(), False))
+                    for ss in sub.type:
+                        cols.append(pc.struct_field(sub_arr, ss.name))
+                        fields.append(pa.field(
+                            f"{f.name}#{sub.name}#{ss.name}", ss.type))
+                else:
+                    cols.append(sub_arr)
+                    fields.append(pa.field(f"{f.name}#{sub.name}",
+                                           sub.type))
+        elif pa.types.is_list(f.type):           # array<struct>
+            off = arr.offsets
+            null_mask = pc.is_null(arr)
+            elems = arr.values
+            ev = pa.ListArray.from_arrays(off, pc.is_valid(elems),
+                                          mask=null_mask)
+            cols.append(ev)
+            fields.append(pa.field(f"{f.name}#__ev",
+                                   pa.list_(pa.bool_())))
+            for sub in f.type.value_type:
+                lane = pa.ListArray.from_arrays(
+                    off, pc.struct_field(elems, sub.name),
+                    mask=null_mask)
+                cols.append(lane)
+                fields.append(pa.field(f"{f.name}#{sub.name}",
+                                       pa.list_(sub.type)))
         else:                                    # map
             off = arr.offsets
             # carry the map's own null mask onto both ragged lanes, so
@@ -141,14 +198,36 @@ class _Shatterer:
                         for ln in _lane_names(e.name, nested[e.name])]
             return e
         if isinstance(e, GetStructField):
-            child = e.children[0]
-            if isinstance(child, E.ColumnRef) and child.name in nested:
-                return E.ColumnRef(f"{child.name}#{e.field}")
+            name, path = _field_path(e)
+            if name is not None and name in nested:
+                sub_dt = _path_dtype(nested[name], path)
+                if sub_dt is None:
+                    raise _Abort(name)
+                lane = "#".join([name] + path)
+                if _flat_ok(sub_dt):
+                    return E.ColumnRef(lane)
+                if isinstance(sub_dt, t.StructType):
+                    # whole sub-struct reference: re-nest inline from
+                    # its lanes (flat fields by construction)
+                    return CreateNamedStruct(
+                        [sf.name for sf in sub_dt.fields],
+                        [E.ColumnRef(f"{lane}#{sf.name}")
+                         for sf in sub_dt.fields],
+                        valid=E.ColumnRef(f"{lane}#__v"))
+                raise _Abort(name)
         if isinstance(e, (E.IsNull, E.IsNotNull)):
             child = e.children[0]
             if isinstance(child, E.ColumnRef) and child.name in nested:
                 v = E.ColumnRef(f"{child.name}#__v")
                 return E.Not(v) if isinstance(e, E.IsNull) else v
+            if isinstance(child, GetStructField):
+                name, path = _field_path(child)
+                if name is not None and name in nested:
+                    sub_dt = _path_dtype(nested[name], path)
+                    lane = "#".join([name] + path)
+                    if isinstance(sub_dt, t.StructType):
+                        v = E.ColumnRef(f"{lane}#__v")
+                        return E.Not(v) if isinstance(e, E.IsNull) else v
         if isinstance(e, MapKeys):
             child = e.children[0]
             if isinstance(child, E.ColumnRef) and child.name in nested:
@@ -169,6 +248,9 @@ class _Shatterer:
             if isinstance(child, E.ColumnRef) and child.name in nested \
                     and isinstance(nested[child.name], t.MapType):
                 return Size(E.ColumnRef(f"{child.name}#keys"))
+            if isinstance(child, E.ColumnRef) and child.name in nested \
+                    and isinstance(nested[child.name], t.ArrayType):
+                return Size(E.ColumnRef(f"{child.name}#__ev"))
         # generic: rewrite children; any surviving whole-container ref
         # below raises _Abort via the ColumnRef branch
         kids = [self.expr(c, nested) for c in e.children]
@@ -270,6 +352,56 @@ def _ref_name(e: E.Expression) -> str:
     return e.name if isinstance(e, E.ColumnRef) else ""
 
 
+def _field_path(e: E.Expression):
+    """(column name, [field, subfield, ...]) of a GetStructField chain
+    rooted at a ColumnRef, else (None, None)."""
+    path: List[str] = []
+    cur = e
+    while isinstance(cur, GetStructField):
+        path.append(cur.field)
+        cur = cur.children[0]
+    if isinstance(cur, E.ColumnRef):
+        return cur.name, list(reversed(path))
+    return None, None
+
+
+def _path_dtype(dt: t.DataType, path: List[str]):
+    """dtype at the end of a struct field path, None if invalid."""
+    for f in path:
+        if not isinstance(dt, t.StructType):
+            return None
+        match = [sf.data_type for sf in dt.fields if sf.name == f]
+        if not match:
+            return None
+        dt = match[0]
+    return dt
+
+
+def _renest_expr(name: str, dt: t.DataType) -> E.Expression:
+    """Re-nesting expression rebuilding `name` from its lanes
+    (recursive for struct-of-struct; array<struct> zips ragged lanes)."""
+    if isinstance(dt, t.StructType):
+        field_exprs = []
+        for sf in dt.fields:
+            if _flat_struct(sf.data_type):
+                field_exprs.append(
+                    _renest_expr(f"{name}#{sf.name}", sf.data_type))
+            else:
+                field_exprs.append(E.ColumnRef(f"{name}#{sf.name}"))
+        return CreateNamedStruct([sf.name for sf in dt.fields],
+                                 field_exprs,
+                                 valid=E.ColumnRef(f"{name}#__v"))
+    if isinstance(dt, t.ArrayType):
+        from .collections import RenestArrayStruct
+        st = dt.element_type
+        return RenestArrayStruct(
+            E.ColumnRef(f"{name}#__v"), E.ColumnRef(f"{name}#__ev"),
+            [E.ColumnRef(f"{name}#{sf.name}") for sf in st.fields], dt)
+    return RenestMap(E.ColumnRef(f"{name}#keys"),
+                     E.ColumnRef(f"{name}#vals"),
+                     E.ColumnRef(f"{name}#__v"), dt)
+
+
 def _with_children(e: E.Expression, kids: List[E.Expression]):
     import copy
     out = copy.copy(e)
@@ -329,15 +461,7 @@ def shatter_nested(plan: L.LogicalPlan) -> L.LogicalPlan:
         lanes = _lane_names(f.name, dt) if _shatterable(dt) else []
         if lanes and all(ln in new_names for ln in lanes):
             changed = True
-            if isinstance(dt, t.StructType):
-                exprs.append(CreateNamedStruct(
-                    [sf.name for sf in dt.fields],
-                    [E.ColumnRef(ln) for ln in lanes[1:]],
-                    valid=E.ColumnRef(lanes[0])))
-            else:
-                exprs.append(RenestMap(E.ColumnRef(lanes[1]),
-                                       E.ColumnRef(lanes[2]),
-                                       E.ColumnRef(lanes[0]), dt))
+            exprs.append(_renest_expr(f.name, dt))
             names.append(f.name)
         else:
             exprs.append(E.ColumnRef(f.name))

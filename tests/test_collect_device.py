@@ -1,0 +1,105 @@
+"""Device collect_list/collect_set (exec/collect.py over
+ops/percentile.py collect_trace; reference GpuAggregateExec.scala
+collect ops).  Oracles: the engine's own CPU path; collect_set order is
+unspecified (Spark), so sets compare sorted."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.plan.aggregates import CollectList, CollectSet
+from spark_rapids_tpu.session import DataFrame, TpuSession, col
+
+CPU = {"spark.rapids.tpu.sql.enabled": "false"}
+
+
+def _placed_on_device(df):
+    return "CollectAggregateExec" in df.physical().root.tree_string()
+
+
+def _run(df):
+    out = df.collect().to_pydict()
+    cpu = DataFrame(df._plan, TpuSession(CPU)).collect().to_pydict()
+    return out, cpu
+
+
+def test_collect_list_nulls_dups_order():
+    s = TpuSession()
+    tbl = pa.table({"k": pa.array([1, 2, 1, 2, 1, 3, 1], pa.int64()),
+                    "v": pa.array([5, None, 3, 7, 3, None, 5],
+                                  pa.int64())})
+    df = (s.from_arrow(tbl).group_by("k")
+          .agg((CollectList(col("v")), "lst")).sort("k"))
+    assert _placed_on_device(df)
+    out, cpu = _run(df)
+    # nulls dropped, duplicates kept, INPUT ORDER preserved
+    assert out == cpu
+    assert out["lst"] == [[5, 3, 3, 5], [7], []]
+
+
+def test_collect_set_dedupes():
+    s = TpuSession()
+    tbl = pa.table({"k": pa.array([1, 1, 1, 2, 2], pa.int64()),
+                    "v": pa.array([4, 4, 2, None, 9], pa.int64())})
+    df = (s.from_arrow(tbl).group_by("k")
+          .agg((CollectSet(col("v")), "st")).sort("k"))
+    assert _placed_on_device(df)
+    out, cpu = _run(df)
+    assert [sorted(x) for x in out["st"]] == \
+        [sorted(x) for x in cpu["st"]] == [[2, 4], [9]]
+
+
+def test_collect_strings_and_doubles():
+    s = TpuSession()
+    tbl = pa.table({"k": pa.array([1, 1, 2, 2], pa.int64()),
+                    "s": pa.array(["b", "a", None, "b"]),
+                    "x": pa.array([1.5, np.nan, 2.5, 2.5])})
+    df = (s.from_arrow(tbl).group_by("k")
+          .agg((CollectList(col("s")), "ls"),
+               (CollectSet(col("x")), "sx")).sort("k"))
+    assert _placed_on_device(df)
+    out, cpu = _run(df)
+    assert out["ls"] == cpu["ls"] == [["b", "a"], ["b"]]
+
+    def norm(v):
+        return sorted((x != x, 0.0 if x != x else x) for x in v)
+    assert [norm(x) for x in out["sx"]] == [norm(x) for x in cpu["sx"]]
+
+
+def test_collect_multi_batch_partial_final():
+    """Groups spanning multiple input partitions merge correctly (the
+    partial/final shape: each batch contributes a slice of each list)."""
+    rng = np.random.default_rng(5)
+    n = 30_000
+    k = rng.integers(0, 50, n)
+    v = rng.integers(0, 20, n).astype(np.int64)
+    tbl = pa.table({"k": pa.array(k, pa.int64()),
+                    "v": pa.array(v, pa.int64())})
+    s = TpuSession({"spark.rapids.tpu.sql.batchSizeRows": str(8192)})
+    df = (s.from_arrow(tbl).group_by("k")
+          .agg((CollectSet(col("v")), "st")).sort("k"))
+    assert _placed_on_device(df)
+    out, cpu = _run(df)
+    assert out["k"] == cpu["k"]
+    assert [sorted(x) for x in out["st"]] == [sorted(x) for x in cpu["st"]]
+
+
+def test_collect_global_no_keys():
+    s = TpuSession()
+    tbl = pa.table({"v": pa.array([3, 1, None, 3], pa.int64())})
+    df = s.from_arrow(tbl).agg((CollectList(col("v")), "lst"))
+    out, cpu = _run(df)
+    assert out == cpu
+    assert out["lst"] == [[3, 1, 3]]
+
+
+def test_mixed_collect_and_sum_falls_back():
+    from spark_rapids_tpu.plan.aggregates import Sum
+    s = TpuSession()
+    tbl = pa.table({"k": pa.array([1, 1], pa.int64()),
+                    "v": pa.array([2, 3], pa.int64())})
+    df = (s.from_arrow(tbl).group_by("k")
+          .agg((CollectList(col("v")), "lst"), (Sum(col("v")), "sv")))
+    tree = df.physical().root.tree_string()
+    assert "CpuAggregateExec" in tree
+    out, cpu = _run(df)
+    assert out == cpu
