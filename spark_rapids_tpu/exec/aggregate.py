@@ -215,7 +215,7 @@ class HashAggregate:
     def __init__(self, key_exprs: Sequence[E.Expression],
                  key_names: Sequence[str],
                  aggs: Sequence[Tuple[AggregateFunction, str]],
-                 conf: TpuConf, key_ranges=None):
+                 conf: TpuConf, key_ranges=None, input_ranges=None):
         self.key_exprs = list(key_exprs)
         self.key_names = list(key_names)
         self.aggs = list(aggs)
@@ -224,6 +224,12 @@ class HashAggregate:
         # the group-by pack bounded keys into one sort lane
         self.key_ranges = list(key_ranges) if key_ranges is not None \
             else [None] * len(self.key_exprs)
+        # exact (lo, hi) per INPUT expression (plain column refs with
+        # scan stats): an int64 lane whose range fits int32 gathers as
+        # ONE u32 lane instead of a pair — the permutation gather is the
+        # dominant group-by cost at big buckets (~390ms for one 8M int64
+        # lane), so halving its width is material
+        self._input_ranges_by_expr = input_ranges or {}
         check_agg_buffers_supported(self.aggs)
         # flatten buffers
         self.update_specs: List[G.AggSpec] = []
@@ -250,6 +256,23 @@ class HashAggregate:
 
     # ---- phases ----
 
+    _I32_LO, _I32_HI = -(1 << 31), (1 << 31) - 1
+
+    def _narrow_cols(self, agg_cols):
+        """Cast int64 agg-input lanes with an int32-fitting known range
+        down to int32 (exact; sums re-widen inside the kernel)."""
+        out = []
+        for c, e in zip(agg_cols, self.input_exprs):
+            rng = self._input_ranges_by_expr.get(id(e))
+            if rng is not None and c.data.dtype == jnp.int64 and \
+                    self._I32_LO <= rng[0] and rng[1] <= self._I32_HI:
+                out.append(DeviceColumn(c.data.astype(jnp.int32),
+                                        c.validity, c.dtype,
+                                        c.dictionary))
+            else:
+                out.append(c)
+        return out
+
     def partial(self, db: DeviceBatch, live=None) -> DeviceBatch:
         """One input batch -> (keys + buffer columns) partial result.
 
@@ -262,7 +285,8 @@ class HashAggregate:
             [e for e in self.input_exprs],
             [f"_in{i}" for i in range(len(self.input_exprs))], db, self.conf) \
             if self.input_exprs else None
-        agg_cols = agg_in.columns if agg_in is not None else []
+        agg_cols = self._narrow_cols(agg_in.columns) \
+            if agg_in is not None else []
         if live is None:
             live = db.row_mask()
         if not self.key_exprs:
@@ -351,11 +375,15 @@ class HashAggregate:
         if dense_domains is None:
             pack = _fused_pack_spec(self.key_exprs, self.key_ranges)
         has_sel = db.sel is not None
+        narrow = tuple(
+            (rng := self._input_ranges_by_expr.get(id(e))) is not None
+            and self._I32_LO <= rng[0] and rng[1] <= self._I32_HI
+            for e in self.input_exprs)
         key = _jit_key(exprs_all, db, aux, self.conf,
                        ("fpartial", spec_sig, len(conds),
                         len(self.key_exprs),
                         tuple(dense_domains) if dense_domains else None,
-                        pack, has_sel))
+                        pack, has_sel, narrow))
         fn = _JIT_CACHE.get(key)
         if fn is None:
             capacity = db.capacity
@@ -381,9 +409,14 @@ class HashAggregate:
                         k = k & dv.validity
                     live = live & k
                 agg_data, agg_valid = [], []
-                for e in ins_t:
+                for i, e in enumerate(ins_t):
                     dv = e.eval_dev(ctx)
-                    agg_data.append(dv.data)
+                    d = dv.data
+                    if narrow[i] and d.dtype == jnp.int64:
+                        # range-proven int32 fit: halve the permutation
+                        # gather width (sums re-widen in the kernel)
+                        d = d.astype(jnp.int32)
+                    agg_data.append(d)
                     agg_valid.append(valid_or_true(dv.validity, capacity))
                 if not keys_t:
                     red = G.reduce_trace(specs, capacity)

@@ -355,7 +355,7 @@ class DeviceResidentScanExec(PlanNode):
         return f"DeviceResidentScan[{self._source.describe()}]"
 
 
-def _find_split_seams(root: PlanNode) -> List[PlanNode]:
+def _find_split_seams(root: PlanNode, conf=None) -> List[PlanNode]:
     """Innermost-first seam nodes where live row counts collapse but
     static bucket capacities do not:
 
@@ -382,6 +382,13 @@ def _find_split_seams(root: PlanNode) -> List[PlanNode]:
     agg = None if isinstance(root, HashAggregateExec) else find_agg(root)
     if agg is None:
         return []
+    # every seam costs one host count sync (a full tunnel RTT) and one
+    # extra program dispatch; with sub-capacity inputs the padding the
+    # seam would trim is worth less than the round trips (q11: 75 ms of
+    # device work behind ~450 ms of seam/dispatch latency), so only
+    # split when the subtree actually carries big buckets
+    if _max_leaf_capacity(agg, conf) < (2 << 20):
+        return []
     seams: List[PlanNode] = []
     source = agg.child
     while isinstance(source, FilterExec):
@@ -390,6 +397,22 @@ def _find_split_seams(root: PlanNode) -> List[PlanNode]:
         seams.append(source)
     seams.append(agg)
     return seams
+
+
+def _max_leaf_capacity(root: PlanNode, conf=None) -> int:
+    """Largest leaf-scan bucket under `root` (host batch row counts
+    rounded to their buckets under the SESSION conf; device-resident
+    seam leaves report their batch capacities)."""
+    from ..config import DEFAULT_CONF
+    conf = conf or DEFAULT_CONF
+    best = 0
+    for node in _find_scans(root):
+        if isinstance(node, DeviceResidentScanExec):
+            best = max(best, *(db.capacity for db in node.batches), 0)
+            continue
+        for hb in node.batches:
+            best = max(best, bucket_capacity(max(hb.num_rows, 1), conf))
+    return best
 
 
 def _slice_batch(db: DeviceBatch, cap: int, n: int) -> DeviceBatch:
@@ -526,7 +549,8 @@ def collect_with_fallback(root: PlanNode, ctx: ExecContext,
         return None
     if plan is None:
         mesh = session_mesh(ctx.conf)
-        seams = [] if mesh is not None else _find_split_seams(root)
+        seams = [] if mesh is not None \
+            else _find_split_seams(root, ctx.conf)
         plan = SplitCompiledPlan(root, seams, ctx.conf) if seams \
             else CompiledPlan(root, ctx.conf, mesh=mesh)
     try:

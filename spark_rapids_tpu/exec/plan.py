@@ -463,10 +463,24 @@ class HashAggregateExec(PlanNode):
                        else self.child.column_range(ref[0]))
         return out
 
+    def _input_ranges(self, agg) -> dict:
+        """id(input expr) -> exact (lo, hi) for plain column refs with
+        scan statistics — feeds the int32 gather narrowing."""
+        from .join import key_ref_names
+        out = {}
+        for e in agg.input_exprs:
+            ref = key_ref_names([e])
+            if ref is not None:
+                rng = self.child.column_range(ref[0])
+                if rng is not None:
+                    out[id(e)] = rng
+        return out
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from ..config import AGG_FALLBACK_PARTITIONS
         agg = HashAggregate(self.key_exprs, self.key_names, self.aggs,
                             ctx.conf, key_ranges=self._key_ranges())
+        agg._input_ranges_by_expr = self._input_ranges(agg)
         # Fuse upstream filters into the map side for EVERY aggregation:
         # the predicates become the groupby's live-mask, so filter +
         # projections + update aggregation run with no mask compaction

@@ -402,3 +402,138 @@ class _FrameSource(HostNode):
         for df in self._frames:
             yield pa.RecordBatch.from_pandas(df, schema=arrow_schema,
                                              preserve_index=False)
+
+
+def _cogroup_worker(conn, fn, out_schema_bytes):
+    """Child process for cogrouped pandas: PAIRS of Arrow IPC tables in
+    (left then right per group; an empty-bytes frame ends the stream),
+    fn(left_df, right_df) -> DataFrame, Arrow IPC out."""
+    try:
+        out_schema = pa.ipc.read_schema(pa.py_buffer(out_schema_bytes))
+        while True:
+            l_tbl = _recv_ipc(conn)
+            if l_tbl is None:
+                break
+            r_tbl = _recv_ipc(conn)
+            out_df = fn(l_tbl.to_pandas(), r_tbl.to_pandas())
+            out = pa.RecordBatch.from_pandas(out_df, schema=out_schema,
+                                             preserve_index=False)
+            _send_ipc(conn, out, out_schema)
+        conn.send_bytes(b"")                   # end of stream
+    except BaseException as e:                 # noqa: BLE001
+        try:
+            conn.send_bytes(b"ERR:" + repr(e).encode())
+        except Exception:                      # noqa: BLE001
+            pass
+        return
+    finally:
+        conn.close()
+
+
+class FlatMapCoGroupsInPandasExec(HostNode):
+    """cogroup(left, right).applyInPandas(fn, schema) — the reference's
+    GpuFlatMapCoGroupsInPandasExec over the fork-worker: both sides
+    materialize, group frames pair by SORTED key tuple (full outer over
+    the key sets — a key on one side only pairs with an empty frame),
+    and each pair round-trips the worker as two Arrow IPC messages."""
+
+    def __init__(self, left_keys: Sequence[str],
+                 right_keys: Sequence[str], fn: Callable,
+                 schema: t.StructType, left: HostNode, right: HostNode):
+        super().__init__(left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self._schema
+
+    def _side_table(self, node, ctx) -> pa.Table:
+        batches = list(node.execute(ctx))
+        schema = struct_to_schema(node.output_schema)
+        return pa.Table.from_batches(batches, schema) if batches \
+            else pa.Table.from_batches([], schema)
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        import multiprocessing as mp
+        left_t = self._side_table(self.children[0], ctx)
+        right_t = self._side_table(self.children[1], ctx)
+        l_schema = struct_to_schema(self.children[0].output_schema)
+        r_schema = struct_to_schema(self.children[1].output_schema)
+        l_groups = {k: df for k, df in _keyed_frames(left_t,
+                                                     self.left_keys)}
+        r_groups = {k: df for k, df in _keyed_frames(right_t,
+                                                     self.right_keys)}
+        keys = sorted(set(l_groups) | set(r_groups),
+                      key=lambda kt: tuple((v is None, v) for v in kt))
+        if not keys:
+            return
+        l_empty = left_t.slice(0, 0).to_pandas()
+        r_empty = right_t.slice(0, 0).to_pandas()
+        out_schema = struct_to_schema(self.output_schema)
+
+        ctxmp = mp.get_context("fork")
+        parent, child = ctxmp.Pipe()
+        proc = ctxmp.Process(
+            target=_cogroup_worker,
+            args=(child, self.fn, out_schema.serialize().to_pybytes()),
+            daemon=True)
+        with _worker_permit(ctx.conf):
+            proc.start()
+            child.close()
+            try:
+                for kt in keys:
+                    ldf = l_groups.get(kt)
+                    rdf = r_groups.get(kt)
+                    _send_ipc(parent, pa.RecordBatch.from_pandas(
+                        ldf if ldf is not None else l_empty,
+                        schema=l_schema, preserve_index=False), l_schema)
+                    _send_ipc(parent, pa.RecordBatch.from_pandas(
+                        rdf if rdf is not None else r_empty,
+                        schema=r_schema, preserve_index=False), r_schema)
+                    out = _recv_worker_batch(parent)
+                    if out is not None and out.num_rows:
+                        yield out
+                parent.send_bytes(b"")          # end of stream
+            finally:
+                parent.close()
+                proc.join(timeout=30)
+                if proc.is_alive():
+                    proc.terminate()
+
+    def describe(self):
+        return (f"FlatMapCoGroupsInPandasExec[{self.left_keys}|"
+                f"{self.right_keys}, "
+                f"{getattr(self.fn, '__name__', 'fn')}]")
+
+
+def _keyed_frames(table: pa.Table, key_names: Sequence[str]):
+    """(key tuple, pandas frame) per group, null keys grouped (pyspark
+    cogroup contract)."""
+    df = table.to_pandas()
+    if not key_names:
+        yield (), df
+        return
+    import pandas as pd
+    for key_vals, g in df.groupby(list(key_names), dropna=False,
+                                  sort=True):
+        if not isinstance(key_vals, tuple):
+            key_vals = (key_vals,)
+        norm = tuple(None if (v is None or v != v) else v
+                     for v in key_vals)
+        yield norm, g
+
+
+def _recv_worker_batch(parent) -> Optional[pa.RecordBatch]:
+    """One result frame from the worker (None = empty result); raises
+    PythonWorkerError on an ERR frame."""
+    buf = parent.recv_bytes()
+    if buf.startswith(b"ERR:"):
+        raise PythonWorkerError(buf[4:].decode())
+    if not buf:
+        return None
+    tbl = pa.ipc.open_stream(pa.py_buffer(buf)).read_all()
+    rbs = tbl.to_batches()
+    return rbs[0] if rbs else None

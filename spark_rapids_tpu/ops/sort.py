@@ -165,13 +165,26 @@ def sort_permutation(db: DeviceBatch, keys: Sequence[SortKey]) -> jax.Array:
 
 
 def permute_batch(db: DeviceBatch, perm: jax.Array) -> DeviceBatch:
-    """Gather every lane of every column through a row permutation."""
+    """Gather every lane of every column through a row permutation —
+    ONE stacked pass per dtype class (TPU gathers pay per-row descriptor
+    latency, ~80ms per 4M-row pass; per-lane takes multiply it)."""
+    from .filter import grouped_take
+    lanes = []
+    slots = []
+    for ci, c in enumerate(db.columns):
+        lanes.append(c.data)
+        slots.append((ci, "d"))
+        lanes.append(c.validity)
+        slots.append((ci, "v"))
+        if c.data_hi is not None:
+            lanes.append(c.data_hi)
+            slots.append((ci, "h"))
+    moved = dict(zip(slots, grouped_take(lanes, perm)))
     cols = []
-    for c in db.columns:
-        d = jnp.take(c.data, perm, axis=0)
-        v = jnp.take(c.validity, perm, axis=0)
-        h = None if c.data_hi is None else jnp.take(c.data_hi, perm, axis=0)
-        cols.append(DeviceColumn(d, v, c.dtype, c.dictionary, h))
+    for ci, c in enumerate(db.columns):
+        cols.append(DeviceColumn(moved[(ci, "d")], moved[(ci, "v")],
+                                 c.dtype, c.dictionary,
+                                 moved.get((ci, "h"))))
     return DeviceBatch(cols, db.num_rows, list(db.names), db.origin_file)
 
 

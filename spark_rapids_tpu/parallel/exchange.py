@@ -436,3 +436,53 @@ def distributed_groupby_ragged(mesh: Mesh, key_dtype: t.DataType,
 
     shard = NamedSharding(mesh, spec)
     return run, shard
+
+
+def distributed_window_rank(mesh: Mesh, part_keys, order_keys, live):
+    """Window rank() over the mesh: hash-exchange rows so every window
+    PARTITION lands wholly on one chip (the reference's pre-window
+    hash exchange), then one local sort + segment rank per shard —
+    the mesh-path analogue of exec/window.py's partition machinery.
+
+    part_keys/order_keys/live: (n_devices*cap,) sharded int64/int64/bool.
+    Returns (part_keys, order_keys, rank, live) in the exchange layout:
+    rank is Spark rank() (ties share, gaps after)."""
+    nparts = mesh.devices.size
+    axis = mesh.axis_names[0]
+    cap = part_keys.shape[0] // nparts
+
+    def dest_fn(k, lv):
+        from ..ops.hashing import hash_int64
+        h = hash_int64(k.astype(jnp.int64), jnp.uint32(42))
+        return jnp.where(lv, (h % jnp.uint32(nparts)).astype(jnp.int32),
+                         0)
+    dest = jax.jit(dest_fn)(part_keys, live)
+
+    ex = RaggedExchange(mesh, nlanes=2, cap=cap)
+    (pk, ok), rlive, _ = ex([part_keys, order_keys], live, dest)
+
+    spec = P(axis)
+
+    def local_rank(pk, ok, lv):
+        n = pk.shape[0]
+        order = jnp.lexsort((ok, pk, (~lv).astype(jnp.int8)))
+        s_pk, s_ok, s_lv = pk[order], ok[order], lv[order]
+        first = jnp.concatenate([jnp.ones((1,), bool),
+                                 s_pk[1:] != s_pk[:-1]])
+        peer = first | jnp.concatenate([jnp.ones((1,), bool),
+                                        s_ok[1:] != s_ok[:-1]])
+        idx = jnp.arange(n, dtype=jnp.int64)
+        from ..ops.kernels import blocked_cummax
+        part_start = blocked_cummax(
+            jnp.where(first, idx, jnp.int64(-1)).astype(jnp.int64))
+        peer_start = blocked_cummax(
+            jnp.where(peer, idx, jnp.int64(-1)).astype(jnp.int64))
+        s_rank = peer_start - part_start + 1
+        # invert the sort: rank back in exchange-layout row order
+        inv = jnp.argsort(order)
+        return pk, ok, s_rank[inv], lv
+
+    fn = jax.jit(jax.shard_map(local_rank, mesh=mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=(spec, spec, spec, spec)))
+    return fn(pk, ok, rlive)

@@ -718,11 +718,24 @@ class CollectSet(CollectList):
 
     def cpu_agg(self):
         def py(vs):
+            # Spark set equality boxes doubles: NaN == NaN and
+            # -0.0 == 0.0 (matching the device path's canonicalization)
+            def canon(v):
+                if isinstance(v, float):
+                    if v != v:
+                        return "__nan__"
+                    if v == 0.0:
+                        return 0.0
+                return v
             seen, out = set(), []
             for v in vs:
-                if v is not None and v not in seen:
-                    seen.add(v)
-                    out.append(v)
+                if v is None:
+                    continue
+                c = canon(v)
+                if c not in seen:
+                    seen.add(c)
+                    out.append(0.0 if c == 0.0 and isinstance(v, float)
+                               else v)
             return out
         return ("_py", py)
 

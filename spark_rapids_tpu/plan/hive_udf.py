@@ -1,0 +1,147 @@
+"""Hive UDF surface inside the columnar pipeline.
+
+Reference: hive UDFs run in the columnar plan two ways —
+  * `com.nvidia.spark.RapidsUDF` hive variants evaluate COLUMNAR on
+    device (hiveUDFs.scala GpuHiveSimpleUDF/GpuHiveGenericUDF when the
+    UDF implements RapidsUDF);
+  * plain hive UDFs run ROW-BASED ON HOST inside the columnar pipeline
+    (rowBasedHiveUDFs.scala GpuRowBasedHiveSimpleUDF/GenericUDF) — the
+    batch converts to rows, the UDF evaluates per row, results convert
+    back.
+
+TPU analogue: a hive-style UDF is any object with an `evaluate(*args)`
+method (the org.apache.hadoop.hive.ql.exec.UDF contract); if it ALSO
+implements `evaluate_columnar(*jax_arrays)` (the RapidsUDF analogue,
+here `TpuHiveUDF`), it places on device via the TpuUDF machinery.
+Otherwise it evaluates row-based on the CPU path — same placement
+policy as the reference, with the reason logged by the overrides.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import pyarrow as pa
+
+from .. import types as t
+from .expressions import Expression, HostVal
+
+
+class TpuHiveUDF:
+    """User base class: the `com.nvidia.spark.RapidsUDF` analogue for
+    hive-style UDFs.  Subclasses implement BOTH
+
+      evaluate(*row_values) -> value          (hive row contract)
+      evaluate_columnar(*jax_arrays) -> array (device lanes)
+
+    and the planner places the columnar form on device, keeping the row
+    form as the CPU oracle/fallback."""
+
+    def evaluate(self, *args):
+        raise NotImplementedError
+
+    def evaluate_columnar(self, *arrays):
+        raise NotImplementedError
+
+
+class HiveSimpleUDF(Expression):
+    """hive `UDF`-contract expression: `udf.evaluate(*row_values)` per
+    row.  Runs row-based on host inside the columnar pipeline
+    (rowBasedHiveUDFs.scala role); a TpuHiveUDF with a columnar form
+    places on device instead (hiveUDFs.scala RapidsUDF role)."""
+
+    def __init__(self, udf, return_type: t.DataType, *args: Expression,
+                 name: Optional[str] = None):
+        self.children = tuple(args)
+        self.udf = udf
+        self.return_type = return_type
+        self.udf_name = name or type(udf).__name__
+
+    def _resolve(self):
+        self.dtype = self.return_type
+        self.nullable = True
+
+    def _fp_extra(self):
+        return f"{self.udf_name}@{id(self.udf)}"
+
+    def _columnar(self) -> bool:
+        return callable(getattr(self.udf, "evaluate_columnar", None)) \
+            and not isinstance(
+                getattr(type(self.udf), "evaluate_columnar", None),
+                property) and \
+            type(self.udf).evaluate_columnar is not \
+            TpuHiveUDF.evaluate_columnar
+
+    def unsupported_reasons(self, conf):
+        if self._columnar():
+            out = []
+            for c in self.children:
+                if isinstance(c.dtype, (t.StringType, t.BinaryType,
+                                        t.ArrayType, t.MapType,
+                                        t.StructType)):
+                    out.append(
+                        f"hive RapidsUDF over {c.dtype.simple_string} "
+                        "input (jax lanes are numeric)")
+            if isinstance(self.return_type,
+                          (t.StringType, t.ArrayType, t.MapType,
+                           t.StructType)):
+                out.append("hive RapidsUDF returning "
+                           f"{self.return_type.simple_string}")
+            return out
+        return [f"hive UDF {self.udf_name} is row-based — evaluates on "
+                "host inside the columnar pipeline "
+                "(rowBasedHiveUDFs.scala role)"]
+
+    def _prepare(self, pctx, kids):
+        return HostVal()
+
+    def _eval_dev(self, ctx, kids):
+        from ..ops.kernels import merge_validity
+        from .expressions import DevVal
+        data = self.udf.evaluate_columnar(*[k.data for k in kids])
+        valid = merge_validity(*[k.validity for k in kids])
+        return DevVal(data, valid, self.dtype)
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        cols = [k.to_pylist() for k in kids]
+        out = []
+        for row in zip(*cols) if cols else [() for _ in
+                                            range(rb.num_rows)]:
+            try:
+                out.append(self.udf.evaluate(*row))
+            except Exception as e:          # noqa: BLE001
+                raise RuntimeError(
+                    f"hive UDF {self.udf_name} failed: {e!r}") from e
+        return pa.array(out, dtype_to_arrow(self.dtype))
+
+    def __repr__(self):
+        return f"{self.udf_name}({', '.join(map(repr, self.children))})"
+
+
+class HiveGenericUDF(HiveSimpleUDF):
+    """hive GenericUDF contract: `evaluate(deferred_objects)` where each
+    deferred object's .get() yields the argument (lazy evaluation —
+    rowBasedHiveUDFs.scala GpuRowBasedHiveGenericUDF)."""
+
+    class _Deferred:
+        __slots__ = ("_v",)
+
+        def __init__(self, v):
+            self._v = v
+
+        def get(self):
+            return self._v
+
+    def _eval_cpu(self, rb, kids):
+        from ..columnar.host import dtype_to_arrow
+        cols = [k.to_pylist() for k in kids]
+        out = []
+        for row in zip(*cols) if cols else [() for _ in
+                                            range(rb.num_rows)]:
+            try:
+                out.append(self.udf.evaluate(
+                    [self._Deferred(v) for v in row]))
+            except Exception as e:          # noqa: BLE001
+                raise RuntimeError(
+                    f"hive UDF {self.udf_name} failed: {e!r}") from e
+        return pa.array(out, dtype_to_arrow(self.dtype))

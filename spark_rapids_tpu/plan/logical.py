@@ -417,3 +417,32 @@ class LogicalWindowInPandas(LogicalPlan):
 
     def describe(self):
         return f"WindowInPandas[{[n for _f, _c, n, _t in self.windows]}]"
+
+
+class LogicalFlatMapCoGroupsInPandas(LogicalPlan):
+    """cogroup(l.groupBy(keys), r.groupBy(keys)).applyInPandas(fn, schema)
+    — fn maps each key's (left DataFrame, right DataFrame) pair to a
+    result DataFrame (reference GpuFlatMapCoGroupsInPandasExec)."""
+
+    def __init__(self, left_keys, right_keys, fn, schema,
+                 left: LogicalPlan, right: LogicalPlan):
+        super().__init__(left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self.result_schema = schema
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def _resolve_schema(self):
+        return self.result_schema
+
+    def describe(self):
+        return (f"FlatMapCoGroupsInPandas[{self.left_keys}, "
+                f"{getattr(self.fn, '__name__', 'fn')}]")

@@ -194,6 +194,13 @@ expr_rule(E.BitCount, t.T.INTEGRAL + t.T.BOOLEAN, t.T.INTEGRAL,
           desc="population count")
 expr_rule(E.WidthBucket, t.T.NUMERIC, t.T.INTEGRAL,
           desc="ANSI histogram bucket")
+
+from .hive_udf import HiveGenericUDF, HiveSimpleUDF  # noqa: E402
+
+for _c in (HiveSimpleUDF, HiveGenericUDF):
+    expr_rule(_c, t.T.ALL_SIMPLE + t.T.NULL,
+              desc="hive UDF: device when TpuHiveUDF (RapidsUDF role), "
+                   "row-based host otherwise (rowBasedHiveUDFs role)")
 expr_rule(E.RaiseError, t.T.ALL_SIMPLE + t.T.NULL,
           desc="raise_error (CPU path: device programs cannot throw)")
 expr_rule(E.Cast, t.T.ALL_SIMPLE, desc="cast (pairs gated by Cast itself)")
@@ -324,6 +331,8 @@ exec_rule(L.LogicalArrowEvalPython, t.T.ALL,
           "scalar pandas UDFs via forked Arrow-IPC python workers")
 exec_rule(L.LogicalFlatMapGroupsInPandas, t.T.ALL,
           "applyInPandas via group-segmented python workers")
+exec_rule(L.LogicalFlatMapCoGroupsInPandas, t.T.ALL,
+          "cogrouped applyInPandas via paired python-worker frames")
 exec_rule(L.LogicalAggregateInPandas, t.T.ALL,
           "grouped pandas UDAFs via group-segmented python workers")
 exec_rule(L.LogicalWindowInPandas, t.T.ALL,
@@ -640,16 +649,22 @@ class AggregateMeta(PlanMeta):
     def tag_self(self):
         # group keys must be single flat device lanes: ragged/nested
         # keys have no boundary comparison, and wide (p>18) decimals
-        # carry a hi lane the groupby boundary/sort machinery ignores
+        # carry a hi lane the groupby boundary/sort machinery ignores.
+        # Keys here are UNBOUND (dtype None) — resolve via the child
+        # schema before checking.
         for k, kn in zip(self.node.keys, self.node.key_names):
-            if isinstance(k.dtype, (t.ArrayType, t.MapType,
-                                    t.StructType, t.BinaryType)):
+            try:
+                kdt = k.bind(self.node.child.schema).dtype
+            except Exception:                    # noqa: BLE001
+                continue                         # binding tags elsewhere
+            if isinstance(kdt, (t.ArrayType, t.MapType,
+                                t.StructType, t.BinaryType)):
                 self.will_not_work(
-                    f"group key {kn}: {k.dtype.simple_string} keys have "
+                    f"group key {kn}: {kdt.simple_string} keys have "
                     "no flat device lane")
-            if isinstance(k.dtype, t.DecimalType) and k.dtype.is_wide:
+            if isinstance(kdt, t.DecimalType) and kdt.is_wide:
                 self.will_not_work(
-                    f"group key {kn}: decimal({k.dtype.precision}) keys "
+                    f"group key {kn}: decimal({kdt.precision}) keys "
                     "carry a second lane the group-by cannot compare")
         # holistic aggregates (sort-based device execs) cannot mix with
         # streaming ones in one device aggregation — the reference
@@ -990,6 +1005,20 @@ class FlatMapGroupsInPandasMeta(PlanMeta):
             self._host_child())
 
 
+class FlatMapCoGroupsInPandasMeta(PlanMeta):
+    def tag_self(self):
+        self.will_not_work(
+            "pandas UDFs execute in a python worker process "
+            "(host Arrow boundary; GpuFlatMapCoGroupsInPandasExec role)")
+
+    def to_host(self):
+        from ..exec.python_exec import FlatMapCoGroupsInPandasExec
+        return FlatMapCoGroupsInPandasExec(
+            self.node.left_keys, self.node.right_keys, self.node.fn,
+            self.node.result_schema, self._host_child(0),
+            self._host_child(1))
+
+
 class AggregateInPandasMeta(PlanMeta):
     def tag_self(self):
         self.will_not_work(
@@ -1104,6 +1133,7 @@ _META_FOR: Dict[type, Type[PlanMeta]] = {
     L.LogicalMapInPandas: MapInPandasMeta,
     L.LogicalArrowEvalPython: ArrowEvalPythonMeta,
     L.LogicalFlatMapGroupsInPandas: FlatMapGroupsInPandasMeta,
+    L.LogicalFlatMapCoGroupsInPandas: FlatMapCoGroupsInPandasMeta,
     L.LogicalAggregateInPandas: AggregateInPandasMeta,
     L.LogicalWindowInPandas: WindowInPandasMeta,
     LogicalCache: CacheMeta,

@@ -124,6 +124,12 @@ class GroupedData:
                                            self._df._plan),
             self._df._session)
 
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """cogroup(l.group_by(k), r.group_by(k)) -> .apply_in_pandas(fn,
+        schema) with fn(left_df, right_df) (reference
+        GpuFlatMapCoGroupsInPandasExec)."""
+        return CoGroupedData(self, other)
+
     def agg_in_pandas(self, *aggs) -> "DataFrame":
         """Grouped pandas UDAFs: aggs = (fn, input column names, output
         name, output type); each fn maps the group's Series to one
@@ -133,6 +139,23 @@ class GroupedData:
             L.LogicalAggregateInPandas(self._key_names(), norm,
                                        self._df._plan),
             self._df._session)
+
+
+class CoGroupedData:
+    def __init__(self, left: "GroupedData", right: "GroupedData"):
+        self._left = left
+        self._right = right
+
+    def apply_in_pandas(self, fn, schema) -> "DataFrame":
+        from .columnar.host import schema_to_struct
+        import pyarrow as _pa
+        if isinstance(schema, _pa.Schema):
+            schema = schema_to_struct(schema)
+        return DataFrame(
+            L.LogicalFlatMapCoGroupsInPandas(
+                self._left._key_names(), self._right._key_names(), fn,
+                schema, self._left._df._plan, self._right._df._plan),
+            self._left._df._session)
 
 
 class DataFrame:

@@ -1,0 +1,122 @@
+"""Hive UDF surface (plan/hive_udf.py): row-based host evaluation inside
+the columnar pipeline (reference rowBasedHiveUDFs.scala) and device
+placement for TpuHiveUDF columnar implementations (hiveUDFs.scala
+RapidsUDF role)."""
+import pyarrow as pa
+
+from spark_rapids_tpu.plan.hive_udf import (HiveGenericUDF, HiveSimpleUDF,
+                                            TpuHiveUDF)
+from spark_rapids_tpu.session import DataFrame, TpuSession, col
+
+CPU = {"spark.rapids.tpu.sql.enabled": "false"}
+
+
+class _PlusTax:
+    """Plain hive UDF: row-based, no columnar form."""
+
+    def evaluate(self, price, rate):
+        if price is None or rate is None:
+            return None
+        return price + price * rate
+
+
+class _Scale(TpuHiveUDF):
+    """RapidsUDF analogue: columnar device form + row oracle."""
+
+    def evaluate(self, x):
+        return None if x is None else x * 3
+
+    def evaluate_columnar(self, x):
+        return x * 3
+
+
+def test_row_based_hive_udf_host_path():
+    s = TpuSession()
+    tbl = pa.table({"p": pa.array([10.0, None, 2.0]),
+                    "r": pa.array([0.1, 0.2, None])})
+    df = s.from_arrow(tbl).select(
+        HiveSimpleUDF(_PlusTax(), __import__(
+            "spark_rapids_tpu.types", fromlist=["DOUBLE"]).DOUBLE,
+            col("p"), col("r")), names=["t"])
+    tree = df.physical().root.tree_string()
+    assert "Cpu" in tree            # row-based -> host placement
+    out = df.collect().to_pydict()
+    cpu = DataFrame(df._plan, TpuSession(CPU)).collect().to_pydict()
+    assert out == cpu
+    assert out["t"] == [11.0, None, None]
+
+
+def test_tpu_hive_udf_device_path():
+    from spark_rapids_tpu import types as t
+    s = TpuSession()
+    tbl = pa.table({"x": pa.array([1, None, 4], pa.int64())})
+    df = s.from_arrow(tbl).select(
+        HiveSimpleUDF(_Scale(), t.LONG, col("x")), names=["y"])
+    tree = df.physical().root.tree_string()
+    assert tree.startswith("ProjectExec")   # device placement
+    out = df.collect().to_pydict()
+    cpu = DataFrame(df._plan, TpuSession(CPU)).collect().to_pydict()
+    assert out == cpu
+    assert out["y"] == [3, None, 12]
+
+
+def test_hive_generic_udf_deferred():
+    from spark_rapids_tpu import types as t
+
+    class Concatish:
+        def evaluate(self, deferred):
+            a, b = (d.get() for d in deferred)
+            if a is None or b is None:
+                return None
+            return int(a) * 100 + int(b)
+
+    s = TpuSession()
+    tbl = pa.table({"a": pa.array([1, 2, None], pa.int64()),
+                    "b": pa.array([7, None, 9], pa.int64())})
+    df = s.from_arrow(tbl).select(
+        HiveGenericUDF(Concatish(), t.LONG, col("a"), col("b")),
+        names=["c"])
+    out = df.collect().to_pydict()
+    cpu = DataFrame(df._plan, TpuSession(CPU)).collect().to_pydict()
+    assert out == cpu
+    assert out["c"] == [107, None, None]
+
+
+def test_cogroup_apply_in_pandas():
+    import pandas as pd
+    s = TpuSession()
+    l = s.from_arrow(pa.table({"k": pa.array([1, 1, 2, 3], pa.int64()),
+                               "v": pa.array([10, 11, 20, 30],
+                                             pa.int64())}))
+    r = s.from_arrow(pa.table({"k2": pa.array([1, 2, 2, 4], pa.int64()),
+                               "w": pa.array([5, 6, 7, 8], pa.int64())}))
+
+    def merge(ldf, rdf):
+        k = ldf["k"].iloc[0] if len(ldf) else rdf["k2"].iloc[0]
+        return pd.DataFrame({"k": [int(k)],
+                             "lsum": [int(ldf["v"].sum())],
+                             "rsum": [int(rdf["w"].sum())]})
+
+    out = (l.group_by("k").cogroup(r.group_by("k2"))
+           .apply_in_pandas(merge, pa.schema(
+               [("k", pa.int64()), ("lsum", pa.int64()),
+                ("rsum", pa.int64())]))
+           .collect().to_pydict())
+    assert out == {"k": [1, 2, 3, 4], "lsum": [21, 20, 30, 0],
+                   "rsum": [5, 13, 0, 8]}
+
+
+def test_cogroup_worker_error_propagates():
+    import pytest
+    from spark_rapids_tpu.exec.python_exec import PythonWorkerError
+    s = TpuSession()
+    l = s.from_arrow(pa.table({"k": pa.array([1], pa.int64())}))
+    r = s.from_arrow(pa.table({"k2": pa.array([1], pa.int64())}))
+
+    def boom(ldf, rdf):
+        raise ValueError("kaput")
+
+    df = (l.group_by("k").cogroup(r.group_by("k2"))
+          .apply_in_pandas(boom, pa.schema([("k", pa.int64())])))
+    with pytest.raises(Exception, match="kaput"):
+        df.collect()
