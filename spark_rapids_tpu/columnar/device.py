@@ -454,15 +454,15 @@ def _build_host_batch(db: DeviceBatch, n: int, fetched) -> HostBatch:
     return HostBatch(pa.RecordBatch.from_arrays(arrays, schema=schema))
 
 
-# Result-fetch head size: one speculative round trip ships the count plus
-# this many rows; only a larger-than-head result pays a second trip.
-# 4096 rows x ~10 B/lane is ~40 KB/column — well under one RTT's worth of
-# bytes on the ~2 MB/s tunnel, while covering every TPC-H final result.
-RESULT_HEAD_ROWS = 4096
+# Result-fetch head default: one speculative round trip ships the count
+# plus this many rows (~40 KB/column at 4096 — under one RTT's worth of
+# bytes on the ~2 MB/s tunnel, covering every TPC-H final result).  The
+# SOURCE OF TRUTH is the config entry; conf=None callers read it from
+# DEFAULT_CONF so tuning the default cannot fork the two.
 
 
-def fetch_result_batch(db: DeviceBatch, bound: Optional[int] = None
-                       ) -> HostBatch:
+def fetch_result_batch(db: DeviceBatch, bound: Optional[int] = None,
+                       conf: Optional[TpuConf] = None) -> HostBatch:
     """Bring a RESULT batch to host with minimum tunnel traffic.
 
     The live rows of every operator output are a front prefix of the
@@ -478,6 +478,11 @@ def fetch_result_batch(db: DeviceBatch, bound: Optional[int] = None
     Measured on the axon tunnel (~125 ms RTT, ~2 MB/s D2H): a 1M-row
     bucket with 1,760 live rows cost 9.2 s as a full-capacity fetch and
     ~0.15 s via the head protocol."""
+    from ..config import (DEFAULT_CONF, RESULT_BOUND_FETCH_FACTOR,
+                          RESULT_HEAD_ROWS)
+    conf = conf or DEFAULT_CONF
+    head_rows = conf.get(RESULT_HEAD_ROWS)
+    bound_factor = conf.get(RESULT_BOUND_FETCH_FACTOR)
     cap = db.capacity
     if isinstance(db.num_rows, int):
         return to_host(db, fetch_rows=min(db.num_rows, cap))
@@ -492,11 +497,11 @@ def fetch_result_batch(db: DeviceBatch, bound: Optional[int] = None
         return to_host(db, fetch_rows=max(n, 0) if n < cap else None)
     # a small static bound buys an exact one-trip fetch; a loose bound
     # (dense-domain group counts can reach 4M) must not defeat the head
-    # protocol, so past 4x the head size we speculate instead
-    if bound is not None and bound <= 4 * RESULT_HEAD_ROWS:
+    # protocol, so past boundFactor x the head size we speculate instead
+    if bound is not None and bound <= bound_factor * head_rows:
         head = min(cap, bound)
     else:
-        head = min(cap, RESULT_HEAD_ROWS)
+        head = min(cap, head_rows)
     if head >= cap:
         return to_host(db)
     n, fetched = _fetch_lanes(db, head)

@@ -76,6 +76,10 @@ def _enum_checker(*allowed):
     return check
 
 
+def _non_negative(v):
+    return None if v >= 0 else "must be >= 0"
+
+
 def _positive(v):
     return None if v > 0 else "must be positive"
 
@@ -330,6 +334,86 @@ PROFILE_PATH = conf(
     "When set, wrap query execution in a jax-profiler trace written to "
     "this directory (the NVTX/CUPTI Profiler analogue; open in "
     "XProf/perfetto).")
+
+RESULT_HEAD_ROWS = conf(
+    "spark.rapids.tpu.sql.fetch.headRows", 4096,
+    "Result-fetch head size: one speculative round trip ships the row "
+    "count plus this many rows; only a larger result pays a second "
+    "exactly-sized trip. Size for your link: ~RTT*bandwidth worth of "
+    "rows (the tunnel harness measures ~125ms RTT at ~2MB/s).",
+    checker=_positive)
+
+RESULT_BOUND_FETCH_FACTOR = conf(
+    "spark.rapids.tpu.sql.fetch.boundFactor", 4,
+    "A static row bound up to boundFactor*headRows fetches exactly-sized "
+    "in one trip; looser bounds fall back to the speculative head so a "
+    "4M-row dense-domain bound cannot defeat the protocol.",
+    checker=_positive)
+
+SEAM_SPLIT_MIN_ROWS = conf(
+    "spark.rapids.tpu.sql.compile.seamSplitMinRows", 2 << 20,
+    "Minimum leaf-scan bucket size before a whole-plan program splits at "
+    "row-collapse seams (join subtrees under aggregates). Each seam "
+    "costs one host count sync (a full link RTT) plus an extra program "
+    "dispatch; below this scale the trimmed padding is worth less than "
+    "the round trips.", checker=_positive)
+
+DENSE_AGG_DOMAIN_MAX = conf(
+    "spark.rapids.tpu.sql.agg.denseDomainMax", 4096,
+    "Largest combined key-domain product the no-sort dense group-by "
+    "(direct bucket addressing over dictionary/boolean domains) will "
+    "use; beyond it the sort-segment group-by runs instead.",
+    checker=_positive)
+
+AGG_INPUT_NARROWING = conf(
+    "spark.rapids.tpu.sql.agg.inputNarrowing", True,
+    "Gather int64 aggregate-input lanes as int32 when exact plan range "
+    "statistics prove the values fit (row gathers are latency-bound per "
+    "pass; half-width lanes halve the dominant group-by cost). Sums "
+    "re-widen exactly.")
+
+JOIN_LAZY_SELECTION = conf(
+    "spark.rapids.tpu.sql.join.lazySelection", True,
+    "Let a join whose parent consumes liveness as a mask (aggregation "
+    "live lanes, a parent join's probe validity) emit a selection "
+    "vector instead of compacting its output — skips a full "
+    "argsort+gather pass per join output.")
+
+APPROX_PERCENTILE_SKETCH_K = conf(
+    "spark.rapids.tpu.sql.agg.approxPercentile.sketchSize", 129,
+    "Order statistics kept per group by the mergeable approx_percentile "
+    "summary (the t-digest delta analogue): rank error <= 1/(2(K-1)) "
+    "per merge level.", checker=_positive)
+
+REGEX_MAX_DFA_STATES = conf(
+    "spark.rapids.tpu.sql.regexp.maxStates", 96,
+    "DFA state budget for device regular expressions; patterns whose "
+    "determinized automaton exceeds it fall back to CPU (the reference "
+    "gates by RegexComplexityEstimator memory instead).",
+    checker=_positive)
+
+OOC_SORT_WINDOW_ROWS = conf(
+    "spark.rapids.tpu.sql.sort.outOfCore.windowRows", 0,
+    "Row budget per resident window of the out-of-core sorter; 0 sizes "
+    "from the HBM budget (the GpuOutOfCoreSortIterator splitUntilSmaller "
+    "role).", checker=_non_negative)
+
+DELTA_OPTIMIZE_TARGET_ROWS = conf(
+    "spark.rapids.tpu.delta.optimize.targetFileRows", 1 << 20,
+    "Row target per output file for Delta OPTIMIZE / ZORDER compaction "
+    "(the reference's optimize.maxFileSize analogue, rows not bytes "
+    "because device buckets are row-shaped).", checker=_positive)
+
+COLLECT_DEVICE_ENABLED = conf(
+    "spark.rapids.tpu.sql.agg.collect.enabled", True,
+    "Run collect_list/collect_set as the device sorted group-by "
+    "emitting ragged columns; off forces the CPU aggregation path.")
+
+RUNTIME_FILTER_FPP = conf(
+    "spark.rapids.tpu.sql.runtimeFilter.fpp", 0.01,
+    "Target false-positive probability sizing the join runtime bloom "
+    "filter (the reference's BloomFilter JNI sizing role); lower = "
+    "bigger filter, fewer wasted probe rows.", conf_type=float)
 
 
 class TpuConf:

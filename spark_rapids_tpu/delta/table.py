@@ -108,8 +108,11 @@ def _version_name(v: int) -> str:
 
 
 class DeltaTable:
-    def __init__(self, path: str):
+    def __init__(self, path: str, conf=None):
+        from ..config import DEFAULT_CONF, TpuConf
         self.path = path
+        self.conf = conf if isinstance(conf, TpuConf) else (
+            TpuConf(conf) if conf else DEFAULT_CONF)
         self.log_dir = os.path.join(path, "_delta_log")
 
     # ------------------------------------------------------------------
@@ -383,7 +386,7 @@ class DeltaTable:
         return version
 
     def optimize(self, zorder_by: Optional[List[str]] = None,
-                 target_rows: int = 1 << 20) -> int:
+                 target_rows: Optional[int] = None) -> int:
         """OPTIMIZE [ZORDER BY]: compact the snapshot into ~target_rows
         files; with zorder_by, rows are first reordered along the Morton
         curve over those columns (ops/zorder.py, the reference's
@@ -392,6 +395,9 @@ class DeltaTable:
         dataChange=false so streaming readers skip them, and the add
         actions keep per-file min/max stats so z-ordered files prune.
         Returns the committed version."""
+        if target_rows is None:
+            from ..config import DELTA_OPTIMIZE_TARGET_ROWS
+            target_rows = self.conf.get(DELTA_OPTIMIZE_TARGET_ROWS)
         files = self.snapshot_files()
         if not files:
             return self.version()

@@ -248,9 +248,10 @@ class AdaptiveShuffledJoinExec(PlanNode):
                 # gathers per batch) — a bloom pass costs a full probe
                 # compaction, more than it can save there
                 return
+        from ..config import RUNTIME_FILTER_FPP
         from ..ops.bloom import (bloom_build, optimal_hashes,
                                  optimal_slots)
-        m = optimal_slots(build_rows)
+        m = optimal_slots(build_rows, fpp=ctx.conf.get(RUNTIME_FILTER_FPP))
         k = optimal_hashes(build_rows, m)
         raw_pos = join._raw_key_positions()
         bits = None

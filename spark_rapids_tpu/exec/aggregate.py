@@ -54,13 +54,16 @@ def _dict_unique(d: pa.Array) -> bool:
     return u
 
 
-def _dense_domains(key_cols) -> "Optional[List[int]]":
+def _dense_domains(key_cols, conf=None) -> "Optional[List[int]]":
     """Static per-key domain sizes when ALL keys are bounded (dictionary
     codes / booleans) and the bucket product stays small — the dense
     no-sort groupby's eligibility (ops/groupby.py dense_groupby_trace).
 
     The size/budget check runs FIRST: a high-cardinality dictionary must
     bail out before any O(unique) host work."""
+    from ..config import DENSE_AGG_DOMAIN_MAX
+    limit = conf.get(DENSE_AGG_DOMAIN_MAX) if conf is not None \
+        else _DENSE_DOMAIN_MAX
     sizes = []
     total = 1
     for c in key_cols:
@@ -71,7 +74,7 @@ def _dense_domains(key_cols) -> "Optional[List[int]]":
         else:
             return None
         total *= sizes[-1] + 1
-        if total > _DENSE_DOMAIN_MAX:
+        if total > limit:
             return None
     return sizes
 
@@ -143,12 +146,26 @@ def _fused_pack_spec(key_exprs, key_ranges) -> "Optional[tuple]":
     return tuple(spec) if packed >= 2 else None
 
 
+def holistic_pack_spec(key_cols, key_exprs, child):
+    """Pack spec for the holistic (sorted_segments) aggregation execs:
+    plan range stats via plain column refs + dictionary/bool domains —
+    folds every key into ONE sort lane when all are bounded
+    (ops/percentile.py sorted_segments packed path)."""
+    from .join import key_ref_names
+    ranges = []
+    for e in key_exprs:
+        ref = key_ref_names([e])
+        ranges.append(None if ref is None
+                      else child.column_range(ref[0]))
+    return _key_pack_spec(key_cols, ranges)
+
+
 def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
                  specs: List[G.AggSpec], live, capacity: int,
-                 key_ranges=None):
+                 key_ranges=None, conf=None):
     key_cols = [ensure_unique_dict(c) for c in key_cols]
     info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
-    domains = _dense_domains(key_cols)
+    domains = _dense_domains(key_cols, conf)
     pack = None if domains is not None \
         else _key_pack_spec(key_cols, key_ranges)
     sig = (info, tuple((s.kind, s.input_idx, s.dtype) for s in specs),
@@ -260,7 +277,11 @@ class HashAggregate:
 
     def _narrow_cols(self, agg_cols):
         """Cast int64 agg-input lanes with an int32-fitting known range
-        down to int32 (exact; sums re-widen inside the kernel)."""
+        down to int32 (exact; sums re-widen inside the kernel);
+        spark.rapids.tpu.sql.agg.inputNarrowing gates it."""
+        from ..config import AGG_INPUT_NARROWING
+        if not self.conf.get(AGG_INPUT_NARROWING):
+            return list(agg_cols)
         out = []
         for c, e in zip(agg_cols, self.input_exprs):
             rng = self._input_ranges_by_expr.get(id(e))
@@ -294,7 +315,7 @@ class HashAggregate:
             return self._reduce_outs_to_batch(outs)
         key_cols, out_keys, outs, n_groups = _run_groupby(
             key_batch.columns, agg_cols, self.update_specs, live,
-            db.capacity, key_ranges=self.key_ranges)
+            db.capacity, key_ranges=self.key_ranges, conf=self.conf)
         return self._groupby_outs_to_batch(key_cols, out_keys, outs, n_groups)
 
     def can_fuse_filter(self, db: "Optional[DeviceBatch]" = None) -> bool:
@@ -317,6 +338,8 @@ class HashAggregate:
 
         Sizes/budget check first; the O(unique) duplicate check only ever
         runs on dictionaries already under the (small) domain budget."""
+        from ..config import DENSE_AGG_DOMAIN_MAX
+        limit = self.conf.get(DENSE_AGG_DOMAIN_MAX)
         sizes = []
         dicts = []
         total = 1
@@ -339,7 +362,7 @@ class HashAggregate:
             else:
                 return None
             total *= sizes[-1] + 1
-            if total > _DENSE_DOMAIN_MAX:
+            if total > limit:
                 return None
         for d in dicts:
             if d is not None and not _dict_unique(d):
@@ -375,8 +398,11 @@ class HashAggregate:
         if dense_domains is None:
             pack = _fused_pack_spec(self.key_exprs, self.key_ranges)
         has_sel = db.sel is not None
+        from ..config import AGG_INPUT_NARROWING
+        _narrow_on = self.conf.get(AGG_INPUT_NARROWING)
         narrow = tuple(
-            (rng := self._input_ranges_by_expr.get(id(e))) is not None
+            _narrow_on
+            and (rng := self._input_ranges_by_expr.get(id(e))) is not None
             and self._I32_LO <= rng[0] and rng[1] <= self._I32_HI
             for e in self.input_exprs)
         key = _jit_key(exprs_all, db, aux, self.conf,
@@ -528,7 +554,7 @@ class HashAggregate:
             return self._reduce_outs_to_batch(outs)
         key_cols, out_keys, outs, n_groups = _run_groupby(
             key_cols, buf_cols, self.merge_specs, merged.row_mask(),
-            merged.capacity, key_ranges=self.key_ranges)
+            merged.capacity, key_ranges=self.key_ranges, conf=self.conf)
         return self._groupby_outs_to_batch(key_cols, out_keys, outs, n_groups)
 
     def final(self, merged: DeviceBatch) -> DeviceBatch:

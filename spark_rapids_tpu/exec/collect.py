@@ -89,6 +89,8 @@ class CollectAggregateExec(PlanNode):
         live = merged.row_mask()
         capacity = merged.capacity
         info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
+        from .aggregate import holistic_pack_spec
+        pack = holistic_pack_spec(key_cols, self.key_exprs, self.child)
 
         results = [None] * len(self.aggs)
         out_keys = n_groups = None
@@ -97,12 +99,12 @@ class CollectAggregateExec(PlanNode):
         for j, vcol in enumerate(val_cols):
             distinct = flavors[j][1]
             sig = ("collect", info, capacity, distinct,
-                   str(vcol.data.dtype))
+                   str(vcol.data.dtype), pack)
             fn = _TRACE_CACHE.get(sig)
             if fn is None:
                 fn = jax.jit(P.collect_trace(
                     list(info), capacity, capacity, distinct,
-                    vcol.dtype), static_argnums=())
+                    vcol.dtype, pack_spec=pack), static_argnums=())
                 _TRACE_CACHE[sig] = fn
             ok, values, offs, ev, ng, _gl = fn(
                 tuple(c.data for c in key_cols),

@@ -286,7 +286,7 @@ class CompiledPlan:
         from ..columnar.host import struct_to_schema
         outs = self.execute(ctx)
         bound = self.root.row_upper_bound()
-        hbs = [fetch_result_batch(db, bound) for db in outs]
+        hbs = [fetch_result_batch(db, bound, ctx.conf) for db in outs]
         batches = [hb.rb for hb in hbs if hb.num_rows > 0]
         if not batches:
             return pa.Table.from_batches(
@@ -387,7 +387,9 @@ def _find_split_seams(root: PlanNode, conf=None) -> List[PlanNode]:
     # seam would trim is worth less than the round trips (q11: 75 ms of
     # device work behind ~450 ms of seam/dispatch latency), so only
     # split when the subtree actually carries big buckets
-    if _max_leaf_capacity(agg, conf) < (2 << 20):
+    from ..config import DEFAULT_CONF, SEAM_SPLIT_MIN_ROWS
+    min_rows = (conf or DEFAULT_CONF).get(SEAM_SPLIT_MIN_ROWS)
+    if _max_leaf_capacity(agg, conf) < min_rows:
         return []
     seams: List[PlanNode] = []
     source = agg.child

@@ -96,15 +96,18 @@ class DistinctAggregateExec(PlanNode):
         capacity = merged.capacity
 
         info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
+        from .aggregate import holistic_pack_spec
+        pack = holistic_pack_spec(key_cols, self.key_exprs, self.child)
         results: List = [None] * len(self.aggs)
         out_keys = n_groups = None
         for j, vcol in enumerate(val_cols):
             sig = (info, capacity, vcol.dtype.simple_string,
-                   str(vcol.data.dtype))
+                   str(vcol.data.dtype), pack)
             fn = _TRACE_CACHE.get(sig)
             if fn is None:
                 fn = jax.jit(distinct_count_trace(
-                    list(info), capacity, capacity)(vcol.dtype))
+                    list(info), capacity, capacity,
+                    pack_spec=pack)(vcol.dtype))
                 _TRACE_CACHE[sig] = fn
             ok, (cnt, valid), ng = fn(
                 tuple(c.data for c in key_cols),

@@ -56,7 +56,11 @@ class OutOfCoreSorter:
     # -- phase 1: build sorted runs ---------------------------------------
     def _resolve_window(self, db: DeviceBatch) -> int:
         if self._window_rows is None:
-            if self.budget.limit:
+            from ..config import OOC_SORT_WINDOW_ROWS
+            forced = self.conf.get(OOC_SORT_WINDOW_ROWS)
+            if forced:
+                self._window_rows = forced
+            elif self.budget.limit:
                 self._window_rows = max(
                     self.conf.batch_size_rows // 8,
                     (self.budget.limit // 2) // _row_bytes(db))

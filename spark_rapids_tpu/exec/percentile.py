@@ -95,16 +95,18 @@ class PercentileAggregateExec(PlanNode):
         capacity = merged.capacity
 
         info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
+        from .aggregate import holistic_pack_spec
+        pack = holistic_pack_spec(key_cols, self.key_exprs, self.child)
         results: List[Tuple] = [None] * len(self.aggs)
         out_keys = n_groups = None
         for j, vcol in enumerate(val_cols):
             qs = sorted({q for (jj, q) in val_map if jj == j})
             sig = (info, tuple(qs), capacity,
-                   str(vcol.data.dtype))
+                   str(vcol.data.dtype), pack)
             fn = _TRACE_CACHE.get(sig)
             if fn is None:
                 fn = jax.jit(P.percentile_trace(
-                    list(info), qs, capacity, capacity))
+                    list(info), qs, capacity, capacity, pack_spec=pack))
                 _TRACE_CACHE[sig] = fn
             from ..ops.kernels import compute_view
             vdata = compute_view(vcol.data, vcol.dtype)
@@ -138,9 +140,10 @@ class PercentileAggregateExec(PlanNode):
         from ..columnar.device import to_device
         from ..columnar.host import HostBatch, dtype_to_arrow
         from ..ops.kernels import compute_view
-        from ..ops.quantile_sketch import (DEFAULT_K, merge_sketches,
-                                           query_sketch)
+        from ..config import APPROX_PERCENTILE_SKETCH_K
+        from ..ops.quantile_sketch import merge_sketches, query_sketch
         conf = ctx.conf
+        DEFAULT_K = conf.get(APPROX_PERCENTILE_SKETCH_K)
         nk = len(self.key_exprs)
         val_exprs: List[E.Expression] = []
         val_map: List[Tuple[int, float]] = []
@@ -166,13 +169,17 @@ class PercentileAggregateExec(PlanNode):
             capacity = db.capacity
             info = tuple((c.dtype, True, str(c.data.dtype))
                          for c in key_cols)
+            from .aggregate import holistic_pack_spec
+            pack = holistic_pack_spec(key_cols, self.key_exprs,
+                                      self.child)
             for j, vcol in enumerate(val_cols):
                 sig = ("sketch", info, DEFAULT_K, capacity,
-                       str(vcol.data.dtype))
+                       str(vcol.data.dtype), pack)
                 fn = _TRACE_CACHE.get(sig)
                 if fn is None:
                     fn = jax.jit(P.sketch_trace(
-                        list(info), DEFAULT_K, capacity, capacity))
+                        list(info), DEFAULT_K, capacity, capacity,
+                        pack_spec=pack))
                     _TRACE_CACHE[sig] = fn
                 vdata = compute_view(vcol.data, vcol.dtype)
                 ok, cnt, pts, ng = fn(
@@ -213,7 +220,7 @@ class PercentileAggregateExec(PlanNode):
             vals = [kt[i] for kt in keys_out]
             arrays.append(pa.array(vals, dtype_to_arrow(key_dtypes[i])))
         # merge once per (group, value column); percentiles share it
-        final = {kt: [merge_sketches(slots[jj])
+        final = {kt: [merge_sketches(slots[jj], k=DEFAULT_K)
                       for jj in range(len(val_exprs))]
                  for kt, slots in merged_sketches.items()}
         for i, (jj, q) in enumerate(val_map):

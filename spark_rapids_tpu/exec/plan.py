@@ -137,7 +137,7 @@ class PlanNode:
         for db in self.execute(ctx):
             if isinstance(db.num_rows, int) and db.num_rows == 0:
                 continue
-            hbs.append(fetch_result_batch(db, bound))
+            hbs.append(fetch_result_batch(db, bound, ctx.conf))
         schema = None
         batches = []
         for hb in hbs:

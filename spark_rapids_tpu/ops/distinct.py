@@ -40,7 +40,7 @@ def _value_eq_lanes(data: jax.Array, dt: t.DataType):
 
 
 def distinct_count_trace(key_lanes_info, num_segments: int,
-                         capacity: int):
+                         capacity: int, pack_spec=None):
     """Traced fn: (keys, keys_valid, val_data, val_valid, live,
     val_dtype static via closure list) -> (out_keys, (count, valid),
     num_groups)."""
@@ -56,7 +56,7 @@ def distinct_count_trace(key_lanes_info, num_segments: int,
             (perm, _s_live, _sk, _skv, seg_ids, _start, out_keys,
              num_groups, group_live) = sorted_segments(
                 key_lanes_info, keys, keys_valid, live, minor, capacity,
-                num_segments)
+                num_segments, pack_spec=pack_spec)
             s_vlive = vlive[perm]
             s_vlanes = [l[perm] for l in vlanes]
 

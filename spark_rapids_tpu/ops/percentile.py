@@ -27,17 +27,68 @@ from .kernels import blocked_cumsum, compute_view
 
 
 def sorted_segments(key_lanes_info, keys, keys_valid, live,
-                    minor_lanes, capacity: int, num_segments: int):
+                    minor_lanes, capacity: int, num_segments: int,
+                    pack_spec=None):
     """Shared sort-segment core for holistic aggregates (percentile,
-    count-distinct): lexsort rows by (dead-last, group keys,
+    count-distinct, collect): lexsort rows by (dead-last, group keys,
     minor_lanes most-minor-first), find group boundaries, return
 
       (perm, s_live, s_keys, s_keys_valid, seg_ids, start_idx,
        out_keys, num_groups, group_live)
 
     `minor_lanes` order rows WITHIN a group (value lanes, null flags);
-    they do not contribute to boundaries."""
+    they do not contribute to boundaries.
+
+    pack_spec: per-key (lo, span) covering EVERY key (exec layer: plan
+    range stats, dictionary sizes, bools) folds the whole key tuple plus
+    liveness into ONE sort lane — TPU sort compile time scales with
+    operand count (a 9-operand lexsort at 1M is minutes; the packed form
+    is seconds), group keys decode arithmetically (zero key gathers),
+    and the boundary compare touches one lane."""
     from .filter import take_keys_valid
+    packed_all = pack_spec is not None and len(pack_spec) == \
+        len(key_lanes_info) and all(s is not None for s in pack_spec)
+    if packed_all:
+        from .groupby import _packed_key_lane
+        spans = [s[1] for s in pack_spec]
+        total = 1
+        for sp in spans:
+            total *= sp
+        packed = _packed_key_lane(keys, keys_valid, pack_spec)
+        key_lane = jnp.where(live, packed, jnp.int64(total))
+        if total < (1 << 31) - 1:
+            key_lane = key_lane.astype(jnp.int32)
+        sort_keys = list(minor_lanes) + [key_lane]
+        perm = jnp.lexsort(sort_keys)
+        s_key = key_lane[perm]
+        s_live = s_key < jnp.asarray(total, s_key.dtype)
+        boundary = _eq_prev(s_key)
+        seg_ids = blocked_cumsum(boundary.astype(jnp.int32)) - 1
+        count = jnp.sum(live, dtype=jnp.int32)
+        num_groups = jnp.where(count > 0,
+                               seg_ids[jnp.maximum(count - 1, 0)] + 1, 0)
+        group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+        start_idx = jnp.sort(jnp.where(
+            boundary & s_live, jnp.arange(capacity, dtype=jnp.int32),
+            jnp.int32(capacity)))[:num_segments]
+        start_idx = jnp.clip(start_idx, 0, capacity - 1)
+        # keys decode from the packed value at segment starts
+        strides = []
+        tot = 1
+        for sp in reversed(spans):
+            strides.append(tot)
+            tot *= sp
+        strides.reverse()
+        pk = s_key[start_idx].astype(jnp.int64)
+        out_keys = []
+        for (dt, _hv, lane_dt), (lo, span), stride in zip(
+                key_lanes_info, pack_spec, strides):
+            slot = (pk // jnp.int64(stride)) % jnp.int64(span)
+            okd = (slot - 1 + jnp.int64(lo)).astype(jnp.dtype(lane_dt))
+            out_keys.append((okd, (slot > 0) & group_live))
+        return (perm, s_live, None, None, seg_ids, start_idx,
+                out_keys, num_groups, group_live)
+
     lanes = []
     for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, keys, keys_valid):
         sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
@@ -84,7 +135,7 @@ def sorted_segments(key_lanes_info, keys, keys_valid, live,
 
 
 def sketch_trace(key_lanes_info, k: int, num_segments: int,
-                 capacity: int):
+                 capacity: int, pack_spec=None):
     """Traced PARTIAL of the mergeable approx_percentile: per group, the
     non-null count and k equi-rank order statistics
     (ops/quantile_sketch.py; reference GpuApproximatePercentile.scala
@@ -100,7 +151,7 @@ def sketch_trace(key_lanes_info, k: int, num_segments: int,
         (perm, _s_live, _sk, _skv, seg_ids, start_idx, out_keys,
          num_groups, _group_live) = sorted_segments(
             key_lanes_info, keys, keys_valid, live, minor, capacity,
-            num_segments)
+            num_segments, pack_spec=pack_spec)
         s_vlive = vlive[perm]
         s_val = val[perm]
         cnt = jax.ops.segment_sum(s_vlive.astype(jnp.int32), seg_ids,
@@ -113,7 +164,7 @@ def sketch_trace(key_lanes_info, k: int, num_segments: int,
 
 
 def percentile_trace(key_lanes_info, qs: Sequence[float],
-                     num_segments: int, capacity: int):
+                     num_segments: int, capacity: int, pack_spec=None):
     """Traced fn: (keys, keys_valid, val_f64, val_valid, live) ->
     (out_keys [(data, valid)...], [(vals, valid) per q], num_groups).
     With zero keys this is the global single-group reduction."""
@@ -131,7 +182,7 @@ def percentile_trace(key_lanes_info, qs: Sequence[float],
         (perm, s_live, _sk, _skv, seg_ids, start_idx, out_keys,
          num_groups, group_live) = sorted_segments(
             key_lanes_info, keys, keys_valid, live, minor, capacity,
-            num_segments)
+            num_segments, pack_spec=pack_spec)
         s_vlive = vlive[perm]
         s_val = val[perm]
 
@@ -161,7 +212,7 @@ def percentile_trace(key_lanes_info, qs: Sequence[float],
 
 
 def collect_trace(key_lanes_info, num_segments: int, capacity: int,
-                  distinct: bool, val_dtype):
+                  distinct: bool, val_dtype, pack_spec=None):
     """Traced collect_list / collect_set as a group-by emitting a RAGGED
     column (reference GpuAggregateExec.scala collect ops over cuDF
     lists).  Sort-by-(key[, value], position) makes every group's kept
@@ -186,7 +237,7 @@ def collect_trace(key_lanes_info, num_segments: int, capacity: int,
         (perm, _s_live, _sk, _skv, seg_ids, _start, out_keys,
          num_groups, group_live) = sorted_segments(
             key_lanes_info, keys, keys_valid, live, minor, capacity,
-            num_segments)
+            num_segments, pack_spec=pack_spec)
         s_vlive = vlive[perm]
         s_val = val[perm]
         keep = s_vlive

@@ -430,7 +430,8 @@ def _eps_closure(nfa: _NFA, states: FrozenSet[int]) -> FrozenSet[int]:
     return frozenset(seen)
 
 
-def compile_dfa(pattern: str, search: bool = True) -> Dfa:
+def compile_dfa(pattern: str, search: bool = True,
+                max_states: int = MAX_DFA_STATES) -> Dfa:
     """Compile a Java regex to a byte DFA.
 
     search=True gives RLIKE find-anywhere semantics via automaton shape:
@@ -470,7 +471,7 @@ def compile_dfa(pattern: str, search: bool = True) -> Dfa:
                 closed = frozenset()
             idx = states.get(closed)
             if idx is None:
-                if len(states) >= MAX_DFA_STATES:
+                if len(states) >= max_states:
                     raise RegexUnsupported("DFA state blowup")
                 idx = len(states)
                 states[closed] = idx

@@ -689,8 +689,10 @@ class AggregateMeta(PlanMeta):
 
     def to_device(self):
         from .aggregates import CollectList, CountDistinct, Percentile
+        from ..config import COLLECT_DEVICE_ENABLED
         if self.node.aggs and all(isinstance(fn, CollectList)
-                                  for fn, _n in self.node.aggs):
+                                  for fn, _n in self.node.aggs) and \
+                self.conf.get(COLLECT_DEVICE_ENABLED):
             from ..exec.collect import CollectAggregateExec
             return CollectAggregateExec(
                 self.node.keys, self.node.key_names, self.node.aggs,
@@ -1523,7 +1525,9 @@ def apply_overrides(plan: L.LogicalPlan,
                 log.info(line)
     kind, root = meta.convert()
     if kind == "device":
-        _negotiate_lazy_sel(root)
+        from ..config import JOIN_LAZY_SELECTION
+        if conf.get(JOIN_LAZY_SELECTION):
+            _negotiate_lazy_sel(root)
     return PhysicalQuery(meta, kind, root, conf)
 
 

@@ -770,6 +770,27 @@ class RLike(ByteKernelExpression):
             self._dfa = None
             self._reject = str(e)
 
+    def unsupported_reasons(self, conf):
+        out = super().unsupported_reasons(conf) \
+            if hasattr(super(), "unsupported_reasons") else []
+        # a raised session DFA budget (spark.rapids.tpu.sql.regexp.
+        # maxStates) can admit patterns the default budget rejected —
+        # retry HERE, where the session conf is in hand (plan tag time)
+        if self._dfa is None and conf is not None and \
+                "state blowup" in (self._reject or ""):
+            from ..config import REGEX_MAX_DFA_STATES
+            from ..ops.regex import RegexUnsupported, compile_dfa
+            budget = conf.get(REGEX_MAX_DFA_STATES)
+            from ..ops.regex import MAX_DFA_STATES
+            if budget != MAX_DFA_STATES:
+                try:
+                    self._dfa = compile_dfa(self.pattern,
+                                            max_states=budget)
+                    self._reject = None
+                except RegexUnsupported as e:
+                    self._reject = str(e)
+        return out
+
     def _resolve(self):
         self.dtype = t.BOOLEAN
         self.nullable = self.children[0].nullable
