@@ -134,6 +134,12 @@ class Suite:
             "skipped": self.skipped,
             "final": final,
             "whole_plan_compiled": self.compiled_ct,
+            "sort_operand_max": max(
+                (v.get("sort_operand_max") or 0
+                 for v in self.per_q.values()), default=0),
+            "scatter_op_total": sum(
+                v.get("scatter_op_count") or 0
+                for v in self.per_q.values()),
             "median_cold_s": med_cold,
             "tunnel_rtt_ms": round(self.rtt * 1e3, 1),
             "elapsed_s": round(time.perf_counter() - _T0, 1),
@@ -191,13 +197,23 @@ def run_suite(scale: float, query_names):
             oracle = cq.collect()
             ct = time_warm(lambda: cq.collect(), iters=2)
 
+            # regression-surface metrics from the emitted program: the
+            # widest sort (compile-time cliff) and the scatter count
+            # (runtime cliff) — docs/PERF.md §1.  Tracked per query so
+            # the perf trajectory sees the cause, not just wall time.
+            try:
+                from spark_rapids_tpu.testing import plan_program_stats
+                pstats = plan_program_stats(q, ExecContext(dev.conf))
+            except Exception:                # noqa: BLE001
+                pstats = {"sort_operand_max": None,
+                          "scatter_op_count": None}
             match = approx_equal(out, oracle)
             suite.per_q[name] = {"device_ms": round(dt * 1e3, 1),
                                  "cpu_ms": round(ct * 1e3, 1),
                                  "speedup": round(ct / dt, 2),
                                  "cold_s": round(cold_s, 1),
                                  "compiled": bool(compiled),
-                                 "match": match}
+                                 "match": match, **pstats}
             print(f"# {name}: device={dt*1e3:.0f}ms cpu={ct*1e3:.0f}ms "
                   f"x{ct/dt:.2f} cold={cold_s:.1f}s "
                   f"compiled={bool(compiled)} match={match}",

@@ -415,6 +415,49 @@ RUNTIME_FILTER_FPP = conf(
     "filter (the reference's BloomFilter JNI sizing role); lower = "
     "bigger filter, fewer wasted probe rows.", conf_type=float)
 
+SEG_SCATTER_FREE = conf(
+    "spark.rapids.tpu.sql.segments.scatterFree.enabled", True,
+    "Run segmented reductions over sorted runs (group-by MIN/MAX, "
+    "ignore-null FIRST/LAST, ANY/EVERY, f64 sums, count-distinct and "
+    "percentile counts, window frames) as blocked segmented scans plus "
+    "boundary gathers instead of jax.ops.segment_* scatters — scatters "
+    "cost ~70ms per 1M rows on this platform and land in slow S(1) "
+    "buffers (ops/segments.py). Off restores the scatter reductions "
+    "for A/B comparison.")
+
+MAX_SORT_OPERANDS = conf(
+    "spark.rapids.tpu.sql.sort.maxSortOperands", 2,
+    "Widest sort (key lanes + payload) any device kernel may emit; "
+    "wider orderings chain stable sorts through a running permutation "
+    "(ops/segments.py lexsort_capped). TPU sort COMPILE time scales "
+    "brutally with operand count (2-op 31s, 3xi64 164s, 10-op ~10min "
+    "at 1M), so 2 is the platform sweet spot; raise it only on "
+    "backends whose sort compile is cheap.",
+    checker=lambda v: None if v >= 2 else "must be >= 2")
+
+DENSE_AGG_VIA_SORT = conf(
+    "spark.rapids.tpu.sql.agg.denseDomainViaSort", False,
+    "Route bounded-domain group-bys (dictionary/boolean keys) through "
+    "the packed single-sort-lane kernel instead of the no-sort dense "
+    "bucket scatters — trades one cheap 2-operand sort (~5ms/1M) for "
+    "the direct segment scatters (~70ms/1M). Off keeps the dense "
+    "no-sort path; each is flip-testable against the other.")
+
+JOIN_DENSE_BUILD_VIA_SORT = conf(
+    "spark.rapids.tpu.sql.join.denseBuildViaSort", True,
+    "Build dense join direct-address tables (per-key offsets, "
+    "unique-key slots) from a sorted key lane + merge-rank instead of "
+    "scatters: scatter-built tables land in S(1)-space buffers whose "
+    "probe-side gathers run ~200MB/s, while sort outputs stay in fast "
+    "memory. Off restores the scatter builders.")
+
+JOIN_MATCHED_VIA_MERGE = conf(
+    "spark.rapids.tpu.sql.join.matchedViaMerge", True,
+    "Derive per-build/per-probe matched flags for outer and expanded "
+    "joins from a sorted index lane + merge-rank difference instead of "
+    "segment_max scatters (ops/segments.py matched_flags). Off "
+    "restores the scatter reductions.")
+
 
 class TpuConf:
     """An immutable-ish view over a dict of raw settings with typed access.

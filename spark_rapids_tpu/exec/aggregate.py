@@ -160,17 +160,41 @@ def holistic_pack_spec(key_cols, key_exprs, child):
     return _key_pack_spec(key_cols, ranges)
 
 
+def _seg_knobs(conf):
+    """(scatter_free, max_sort_operands, dense_via_sort) statics for the
+    group-by trace builders — part of every jit cache key they shape."""
+    from ..config import (DENSE_AGG_VIA_SORT, MAX_SORT_OPERANDS,
+                          SEG_SCATTER_FREE)
+    if conf is None:
+        return True, 2, False
+    return (conf.get(SEG_SCATTER_FREE), conf.get(MAX_SORT_OPERANDS),
+            conf.get(DENSE_AGG_VIA_SORT))
+
+
+def _domains_as_pack(domains):
+    """Dense key domains (codes in [0, size)) as a packed-lane spec:
+    slot 0 stays the null slot, codes shift up by one."""
+    return tuple((0, size + 1) for size in domains)
+
+
 def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
                  specs: List[G.AggSpec], live, capacity: int,
                  key_ranges=None, conf=None):
     key_cols = [ensure_unique_dict(c) for c in key_cols]
     info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
+    scatter_free, max_ops, dense_sort = _seg_knobs(conf)
     domains = _dense_domains(key_cols, conf)
-    pack = None if domains is not None \
-        else _key_pack_spec(key_cols, key_ranges)
+    if domains is not None and dense_sort:
+        # flip knob: run the bounded domain through the packed
+        # single-sort-lane kernel instead of the no-sort bucket scatters
+        pack, domains = _domains_as_pack(domains), None
+    else:
+        pack = None if domains is not None \
+            else _key_pack_spec(key_cols, key_ranges)
     sig = (info, tuple((s.kind, s.input_idx, s.dtype) for s in specs),
            capacity, tuple(str(c.data.dtype) for c in agg_cols),
-           tuple(domains) if domains else None, pack)
+           tuple(domains) if domains else None, pack, scatter_free,
+           max_ops)
     fn = _GROUPBY_CACHE.get(sig)
     if fn is None:
         if domains is not None:
@@ -178,7 +202,9 @@ def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
                                                capacity))
         else:
             fn = jax.jit(G.groupby_trace(list(info), list(specs), capacity,
-                                         capacity, pack_spec=pack))
+                                         capacity, pack_spec=pack,
+                                         scatter_free=scatter_free,
+                                         max_sort_operands=max_ops))
         _GROUPBY_CACHE[sig] = fn
     out_keys, outs, num_groups = fn(
         tuple(c.data for c in key_cols),
@@ -391,11 +417,14 @@ class HashAggregate:
         pctx, hostvals, aux = _prepare(exprs_all, db, self.conf)
         spec_sig = tuple((s.kind, s.input_idx, str(s.dtype))
                          for s in self.update_specs)
+        scatter_free, max_ops, dense_sort = _seg_knobs(self.conf)
         dense_domains = self._fused_dense_domains(db) \
             if any(isinstance(e.dtype, (t.StringType, t.BooleanType))
                    for e in self.key_exprs) else None
         pack = None
-        if dense_domains is None:
+        if dense_domains is not None and dense_sort:
+            pack, dense_domains = _domains_as_pack(dense_domains), None
+        elif dense_domains is None:
             pack = _fused_pack_spec(self.key_exprs, self.key_ranges)
         has_sel = db.sel is not None
         from ..config import AGG_INPUT_NARROWING
@@ -409,7 +438,7 @@ class HashAggregate:
                        ("fpartial", spec_sig, len(conds),
                         len(self.key_exprs),
                         tuple(dense_domains) if dense_domains else None,
-                        pack, has_sel, narrow))
+                        pack, has_sel, narrow, scatter_free, max_ops))
         fn = _JIT_CACHE.get(key)
         if fn is None:
             capacity = db.capacity
@@ -460,7 +489,9 @@ class HashAggregate:
                                                capacity)
                 else:
                     gb = G.groupby_trace(kinfo, specs, capacity, capacity,
-                                         pack_spec=pack)
+                                         pack_spec=pack,
+                                         scatter_free=scatter_free,
+                                         max_sort_operands=max_ops)
                 return gb(tuple(kds), tuple(kvs), tuple(agg_data),
                           tuple(agg_valid), live)
 
@@ -629,9 +660,12 @@ class HashAggregate:
         cap = bucket_capacity(1, self.conf)
         cols = []
         for (data, valid), spec in zip(outs, self.update_specs):
-            d = jnp.zeros((cap,), _storage_zeros(spec.dtype, 1).dtype
-                          ).at[0].set(data.astype(_storage_zeros(
-                              spec.dtype, 1).dtype))
-            v = jnp.zeros((cap,), bool).at[0].set(valid)
+            # row 0 by concatenation, not `.at[0].set` — the 1-element
+            # scatter that lowers to would be the only scatter left in a
+            # global-aggregation program
+            sdt = _storage_zeros(spec.dtype, 1).dtype
+            d = jnp.concatenate([data.astype(sdt)[None],
+                                 jnp.zeros((cap - 1,), sdt)])
+            v = jnp.concatenate([valid[None], jnp.zeros((cap - 1,), bool)])
             cols.append(DeviceColumn(d, v, spec.dtype))
         return DeviceBatch(cols, 1, self._buffer_names())

@@ -89,8 +89,9 @@ class CollectAggregateExec(PlanNode):
         live = merged.row_mask()
         capacity = merged.capacity
         info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
-        from .aggregate import holistic_pack_spec
+        from .aggregate import _seg_knobs, holistic_pack_spec
         pack = holistic_pack_spec(key_cols, self.key_exprs, self.child)
+        _sf, max_ops, _ds = _seg_knobs(ctx.conf)
 
         results = [None] * len(self.aggs)
         out_keys = n_groups = None
@@ -99,12 +100,13 @@ class CollectAggregateExec(PlanNode):
         for j, vcol in enumerate(val_cols):
             distinct = flavors[j][1]
             sig = ("collect", info, capacity, distinct,
-                   str(vcol.data.dtype), pack)
+                   str(vcol.data.dtype), pack, max_ops)
             fn = _TRACE_CACHE.get(sig)
             if fn is None:
                 fn = jax.jit(P.collect_trace(
                     list(info), capacity, capacity, distinct,
-                    vcol.dtype, pack_spec=pack), static_argnums=())
+                    vcol.dtype, pack_spec=pack,
+                    max_sort_operands=max_ops), static_argnums=())
                 _TRACE_CACHE[sig] = fn
             ok, values, offs, ev, ng, _gl = fn(
                 tuple(c.data for c in key_cols),

@@ -217,13 +217,7 @@ class CompiledPlan:
             pairs.append((node, cached))
         return pairs
 
-    # -- compile + run -----------------------------------------------------
-    def execute(self, ctx: ExecContext) -> List[DeviceBatch]:
-        """Run the whole plan as one XLA program; returns device batches.
-
-        Raises jax tracer errors (ConcretizationTypeError & friends) when
-        the plan needs host decisions — callers fall back to eager."""
-        pairs = self._leaf_batches(ctx)
+    def _flatten_inputs(self, pairs):
         flat_in: List[jax.Array] = []
         in_specs = []
         for node, dbs in pairs:
@@ -233,41 +227,67 @@ class CompiledPlan:
                 flat_in.extend(arrays)
                 node_specs.append(spec)
             in_specs.append((node, node_specs))
+        return flat_in, in_specs
+
+    def _make_runner(self, in_specs, ctx: ExecContext,
+                     out_holder: Dict[str, list]):
+        """The traced whole-plan function over flattened leaf lanes."""
+        def run(flat):
+            # rebuild leaf batches from traced arrays and install them
+            i = 0
+            for node, node_specs in in_specs:
+                batches = []
+                for spec in node_specs:
+                    db, i = _rebuild_batch(flat, spec, i)
+                    batches.append(db)
+                node._trace_batches = batches
+            try:
+                trace_ctx = _trace_context(ctx)
+                outs = list(self.root.execute(trace_ctx))
+            finally:
+                for node, _ in in_specs:
+                    node._trace_batches = None
+                # copy ONLY host numbers back: a traced metric value
+                # escaping the jit would be a leaked tracer
+                for k, v in trace_ctx.metrics.items():
+                    if isinstance(v, (int, float)):
+                        ctx.metrics[k] = v
+            flat_out = []
+            specs = []
+            for db in outs:
+                arrays, spec = _flatten_batch(db)
+                flat_out.extend(arrays)
+                specs.append(spec)
+            out_holder["specs"] = specs
+            return flat_out
+        return run
+
+    def make_jaxpr(self, ctx: ExecContext):
+        """Abstract-trace the whole-plan program and return its
+        ClosedJaxpr — no compile, no execution.  Powers the suite-wide
+        sort-operand lint (testing.py) and bench.py's per-query
+        `sort_operand_max` / `scatter_op_count` metrics.  Raises the
+        same tracer errors as execute() for host-decision plans."""
+        pairs = self._leaf_batches(ctx)
+        flat_in, in_specs = self._flatten_inputs(pairs)
+        holder: Dict[str, list] = {}
+        return jax.make_jaxpr(self._make_runner(in_specs, ctx, holder))(
+            flat_in)
+
+    # -- compile + run -----------------------------------------------------
+    def execute(self, ctx: ExecContext) -> List[DeviceBatch]:
+        """Run the whole plan as one XLA program; returns device batches.
+
+        Raises jax tracer errors (ConcretizationTypeError & friends) when
+        the plan needs host decisions — callers fall back to eager."""
+        pairs = self._leaf_batches(ctx)
+        flat_in, in_specs = self._flatten_inputs(pairs)
 
         if self._compiled is None:
             self._input_specs = [(n, list(s)) for n, s in in_specs]
             out_holder: Dict[str, list] = {}
-
-            def run(flat):
-                # rebuild leaf batches from traced arrays and install them
-                i = 0
-                for node, node_specs in in_specs:
-                    batches = []
-                    for spec in node_specs:
-                        db, i = _rebuild_batch(flat, spec, i)
-                        batches.append(db)
-                    node._trace_batches = batches
-                try:
-                    trace_ctx = _trace_context(ctx)
-                    outs = list(self.root.execute(trace_ctx))
-                finally:
-                    for node, _ in in_specs:
-                        node._trace_batches = None
-                    # copy ONLY host numbers back: a traced metric value
-                    # escaping the jit would be a leaked tracer
-                    for k, v in trace_ctx.metrics.items():
-                        if isinstance(v, (int, float)):
-                            ctx.metrics[k] = v
-                flat_out = []
-                specs = []
-                for db in outs:
-                    arrays, spec = _flatten_batch(db)
-                    flat_out.extend(arrays)
-                    specs.append(spec)
-                out_holder["specs"] = specs
-                return flat_out
-
-            compiled = jax.jit(run)
+            compiled = jax.jit(self._make_runner(in_specs, ctx,
+                                                 out_holder))
             flat_res = compiled(flat_in)         # traces on first call
             self._out_specs = out_holder["specs"]
             self._compiled = compiled

@@ -96,18 +96,36 @@ class DistinctAggregateExec(PlanNode):
         capacity = merged.capacity
 
         info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
-        from .aggregate import holistic_pack_spec
+        from .aggregate import _seg_knobs, holistic_pack_spec
+        from .join import key_ref_names
         pack = holistic_pack_spec(key_cols, self.key_exprs, self.child)
+        scatter_free, max_ops, _ds = _seg_knobs(conf)
         results: List = [None] * len(self.aggs)
         out_keys = n_groups = None
         for j, vcol in enumerate(val_cols):
+            # exact value bounds (dictionary size / scan range stats)
+            # let the value lane ride the packed key sort — the whole
+            # count-distinct order becomes ONE 2-operand sort
+            if vcol.dictionary is not None:
+                val_range = (0, max(len(vcol.dictionary) - 1, 0))
+            else:
+                ref = key_ref_names([val_exprs[j]])
+                val_range = None if ref is None \
+                    else self.child.column_range(ref[0])
+                if val_range is not None and not isinstance(
+                        vcol.dtype, (t.DoubleType, t.FloatType)):
+                    val_range = (int(val_range[0]), int(val_range[1]))
+                else:
+                    val_range = None
             sig = (info, capacity, vcol.dtype.simple_string,
-                   str(vcol.data.dtype), pack)
+                   str(vcol.data.dtype), pack, val_range, scatter_free,
+                   max_ops)
             fn = _TRACE_CACHE.get(sig)
             if fn is None:
                 fn = jax.jit(distinct_count_trace(
-                    list(info), capacity, capacity,
-                    pack_spec=pack)(vcol.dtype))
+                    list(info), capacity, capacity, pack_spec=pack,
+                    val_range=val_range, scatter_free=scatter_free,
+                    max_sort_operands=max_ops)(vcol.dtype))
                 _TRACE_CACHE[sig] = fn
             ok, (cnt, valid), ng = fn(
                 tuple(c.data for c in key_cols),

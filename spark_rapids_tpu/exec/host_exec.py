@@ -30,6 +30,29 @@ from ..plan.aggregates import AggregateFunction
 from .plan import ExecContext, PlanNode
 
 
+def sort_indices_per_key(keys) -> pa.Array:
+    """pc.sort_indices with PER-KEY null ordering.
+
+    pyarrow's SortOptions carries one GLOBAL null_placement (its sort
+    keys are strictly (name, order) pairs), but Spark's SortOrder sets
+    nulls-first/last per key.  Each key whose column can hold nulls gets
+    an explicit is-null rank column ahead of its value column, so the
+    per-key placement is exact and the value columns' global placement
+    becomes irrelevant.
+
+    keys: [(array_or_chunked, ascending, nulls_first)].
+    """
+    work, sk = {}, []
+    for i, (arr, asc, nf) in enumerate(keys):
+        a = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        if a.null_count:
+            work[f"_n{i}"] = pc.cast(pc.is_null(a), pa.int8())
+            sk.append((f"_n{i}", "descending" if nf else "ascending"))
+        work[f"_k{i}"] = a
+        sk.append((f"_k{i}", "ascending" if asc else "descending"))
+    return pc.sort_indices(pa.table(work), sort_keys=sk)
+
+
 class HostNode:
     """Base CPU operator: streams pyarrow RecordBatches."""
 
@@ -425,14 +448,12 @@ class CpuSortExec(HostNode):
     def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
         tbl = self._table(ctx)
         rb = HostBatch.from_table(tbl).rb
-        sort_cols, keys = [], []
-        for i, (e, asc, nf) in enumerate(self.orders):
+        keys = []
+        for e, asc, nf in self.orders:
             _clear_scan_provenance()
-            sort_cols.append(CpuAggregateExec._arr(e.eval_cpu(rb), rb.num_rows))
-            keys.append((f"_s{i}", "ascending" if asc else "descending",
-                         "at_start" if nf else "at_end"))
-        work = pa.table({f"_s{i}": c for i, c in enumerate(sort_cols)})
-        idx = pc.sort_indices(work, sort_keys=keys)
+            keys.append((CpuAggregateExec._arr(e.eval_cpu(rb), rb.num_rows),
+                         asc, nf))
+        idx = sort_indices_per_key(keys)
         out = pa.Table.from_batches([rb]).take(idx)
         yield HostBatch.from_table(out).rb
 
@@ -638,12 +659,8 @@ class CpuWindowExec(HostNode):
         for i, (e, asc, nf) in enumerate(self.order_keys):
             key_cols.append((f"_o{i}", arr(e.eval_cpu(rb), n), asc, nf))
         if key_cols and n:
-            work = pa.table({nm: c for nm, c, _, _ in key_cols})
-            idx = pc.sort_indices(
-                work,
-                sort_keys=[(nm, "ascending" if asc else "descending",
-                            "at_start" if nf else "at_end")
-                           for nm, _, asc, nf in key_cols]
+            idx = sort_indices_per_key(
+                [(c, asc, nf) for _nm, c, asc, nf in key_cols]
             ).to_numpy(zero_copy_only=False)
         else:
             idx = np.arange(n)

@@ -435,9 +435,15 @@ class HashJoinExec(PlanNode):
         unique = domain is not None and self._build_unique()
         if domain is not None:
             ctx.bump("join_dense_domain")
+        from ..config import (JOIN_DENSE_BUILD_VIA_SORT,
+                              JOIN_MATCHED_VIA_MERGE)
         build = J.BuildTable(build_batch, build_keys, build_lanes,
                              domain=domain, unique=unique,
-                             extra_valid=build_pre if build_conds else None)
+                             extra_valid=build_pre if build_conds else None,
+                             dense_via_sort=ctx.conf.get(
+                                 JOIN_DENSE_BUILD_VIA_SORT),
+                             matched_via_merge=ctx.conf.get(
+                                 JOIN_MATCHED_VIA_MERGE))
         out_names = list(self.output_schema.names)
         # Sync-free probe-aligned path: a build side whose keys are unique
         # (exact plan statistics — dimension scans, group-by outputs) makes
@@ -510,10 +516,16 @@ class HashJoinExec(PlanNode):
                                   jnp.where(ok, build_idx, -1),
                                   out_rows, null_out_of_bounds=True)
                 if self.join_type in (J.RIGHT_OUTER, J.FULL_OUTER):
-                    hits = jnp.zeros((build_batch.capacity,), jnp.int32) \
-                        .at[jnp.where(ok, build_idx, 0)] \
-                        .max(ok.astype(jnp.int32))
-                    build_matched_acc = build_matched_acc | (hits > 0)
+                    if build.matched_via_merge:
+                        from ..ops.segments import matched_flags
+                        hit = matched_flags(build_idx, ok,
+                                            build_batch.capacity)
+                    else:
+                        hit = jnp.zeros(
+                            (build_batch.capacity,), jnp.int32) \
+                            .at[jnp.where(ok, build_idx, 0)] \
+                            .max(ok.astype(jnp.int32)) > 0
+                    build_matched_acc = build_matched_acc | hit
                 if self.join_type == J.LEFT_OUTER:
                     # all (filter-surviving) probe rows survive; unmatched
                     # rows carry null right columns (the -1 gather)

@@ -153,7 +153,7 @@ class OutOfCoreSorter:
             merged = concat_batches(window, self.conf) \
                 if len(window) > 1 else window[0]
             total = int(merged.num_rows)
-            perm = sort_permutation(merged, self.keys)
+            perm = sort_permutation(merged, self.keys, self.conf)
             inv = jnp.zeros((merged.capacity,), jnp.int32).at[perm].set(
                 jnp.arange(merged.capacity, dtype=jnp.int32))
             # emit rows up to the smallest capstone of runs that still

@@ -95,18 +95,21 @@ class PercentileAggregateExec(PlanNode):
         capacity = merged.capacity
 
         info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
-        from .aggregate import holistic_pack_spec
+        from .aggregate import _seg_knobs, holistic_pack_spec
         pack = holistic_pack_spec(key_cols, self.key_exprs, self.child)
+        scatter_free, max_ops, _ds = _seg_knobs(conf)
         results: List[Tuple] = [None] * len(self.aggs)
         out_keys = n_groups = None
         for j, vcol in enumerate(val_cols):
             qs = sorted({q for (jj, q) in val_map if jj == j})
             sig = (info, tuple(qs), capacity,
-                   str(vcol.data.dtype), pack)
+                   str(vcol.data.dtype), pack, scatter_free, max_ops)
             fn = _TRACE_CACHE.get(sig)
             if fn is None:
                 fn = jax.jit(P.percentile_trace(
-                    list(info), qs, capacity, capacity, pack_spec=pack))
+                    list(info), qs, capacity, capacity, pack_spec=pack,
+                    scatter_free=scatter_free,
+                    max_sort_operands=max_ops))
                 _TRACE_CACHE[sig] = fn
             from ..ops.kernels import compute_view
             vdata = compute_view(vcol.data, vcol.dtype)
@@ -169,17 +172,20 @@ class PercentileAggregateExec(PlanNode):
             capacity = db.capacity
             info = tuple((c.dtype, True, str(c.data.dtype))
                          for c in key_cols)
-            from .aggregate import holistic_pack_spec
+            from .aggregate import _seg_knobs, holistic_pack_spec
             pack = holistic_pack_spec(key_cols, self.key_exprs,
                                       self.child)
+            scatter_free, max_ops, _ds = _seg_knobs(conf)
             for j, vcol in enumerate(val_cols):
                 sig = ("sketch", info, DEFAULT_K, capacity,
-                       str(vcol.data.dtype), pack)
+                       str(vcol.data.dtype), pack, scatter_free,
+                       max_ops)
                 fn = _TRACE_CACHE.get(sig)
                 if fn is None:
                     fn = jax.jit(P.sketch_trace(
                         list(info), DEFAULT_K, capacity, capacity,
-                        pack_spec=pack))
+                        pack_spec=pack, scatter_free=scatter_free,
+                        max_sort_operands=max_ops))
                     _TRACE_CACHE[sig] = fn
                 vdata = compute_view(vcol.data, vcol.dtype)
                 ok, cnt, pts, ng = fn(

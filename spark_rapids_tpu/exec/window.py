@@ -130,11 +130,13 @@ class WindowExec(PlanNode):
                               for _s, f, _i in specs_frames)
         order_dirs = tuple((asc, nf) for _e, asc, nf in self.order_keys) \
             if has_value_range else ()
+        from .aggregate import _seg_knobs
+        scatter_free, max_ops, _ds = _seg_knobs(ctx.conf)
         key = ("window", s.capacity,
                tuple(sp.fingerprint() for sp, _f, _i in specs_frames),
                tuple(f.fp() for _s, f, _i in specs_frames),
                tuple(i for _s, _f, i in specs_frames),
-               order_dirs,
+               order_dirs, scatter_free, max_ops,
                tuple((c.dtype.simple_string, str(c.data.dtype))
                      for c in part_cols + order_cols + val_cols))
         fn = _WINDOW_JIT_CACHE.get(key)
@@ -143,7 +145,8 @@ class WindowExec(PlanNode):
                 tuple((c.dtype,) for c in part_cols),
                 tuple((c.dtype,) for c in order_cols),
                 tuple((c.dtype,) for c in val_cols),
-                specs_frames, s.capacity, order_dirs=order_dirs)
+                specs_frames, s.capacity, order_dirs=order_dirs,
+                scatter_free=scatter_free, max_sort_operands=max_ops)
             fn = jax.jit(traced)
             _WINDOW_JIT_CACHE[key] = fn
 

@@ -10,6 +10,13 @@ Value equality uses the storage lanes (int64 f64-bit-patterns for
 DOUBLE are bit-exact; string codes must be dictionary-unified by the
 caller).  Nulls are excluded (Spark count(DISTINCT) semantics); NaN
 counts as one distinct value (all NaN bit patterns canonicalize).
+
+When the VALUE lane carries exact static bounds (`val_range`: scan
+statistics for int lanes, dictionary size for string codes) it packs
+into the same single sort lane as the group keys (ops/segments.py
+sorted_segments minor_spec), so the whole count-distinct order is ONE
+2-operand sort — the q16-class multi-operand lexsort whose XLA compile
+ran minutes disappears.
 """
 from __future__ import annotations
 
@@ -19,6 +26,7 @@ import jax.numpy as jnp
 from .. import types as t
 from .groupby import _CANON_NAN, _EXP_MASK, _MANT_MASK, _eq_prev
 from .kernels import compute_view
+from .segments import seg_sums_sorted, sorted_segments
 
 
 _NEG_ZERO_BITS = jnp.int64(-2 ** 63)        # 0x8000000000000000
@@ -40,12 +48,15 @@ def _value_eq_lanes(data: jax.Array, dt: t.DataType):
 
 
 def distinct_count_trace(key_lanes_info, num_segments: int,
-                         capacity: int, pack_spec=None):
+                         capacity: int, pack_spec=None, val_range=None,
+                         scatter_free=True, max_sort_operands=2):
     """Traced fn: (keys, keys_valid, val_data, val_valid, live,
     val_dtype static via closure list) -> (out_keys, (count, valid),
-    num_groups)."""
+    num_groups).
 
-    from .percentile import sorted_segments
+    val_range: exact (lo, hi) bound on the value's int lane (scan stats
+    / dictionary size) — lets the value ride the packed key sort lane.
+    """
 
     def build(val_dtype: t.DataType):
         def run(keys, keys_valid, val, val_valid, live):
@@ -53,23 +64,36 @@ def distinct_count_trace(key_lanes_info, num_segments: int,
             vlanes = _value_eq_lanes(val, val_dtype)
             # minor order within group: values grouped (asc), nulls last
             minor = list(vlanes) + [(~vlive).astype(jnp.int8)]
-            (perm, _s_live, _sk, _skv, seg_ids, _start, out_keys,
-             num_groups, group_live) = sorted_segments(
+            minor_spec = None
+            if val_range is not None and len(vlanes) == 1:
+                lo, hi = int(val_range[0]), int(val_range[1])
+                minor_spec = [(lo, hi - lo + 1), (0, 2)]
+            runs = sorted_segments(
                 key_lanes_info, keys, keys_valid, live, minor, capacity,
-                num_segments, pack_spec=pack_spec)
+                num_segments, pack_spec=pack_spec,
+                minor_spec=minor_spec,
+                max_sort_operands=max_sort_operands)
+            perm, seg_ids = runs.perm, runs.seg_ids
             s_vlive = vlive[perm]
             s_vlanes = [l[perm] for l in vlanes]
 
             # first occurrence of each distinct valid value in a group:
             # segment start OR any value lane changed from prev row
-            changed = jnp.zeros((capacity,), bool).at[0].set(True)
-            changed = changed | _eq_prev(seg_ids)
+            changed = _eq_prev(seg_ids)
             for lane in s_vlanes:
                 changed = changed | _eq_prev(lane)
             first = s_vlive & changed
-            cnt = jax.ops.segment_sum(first.astype(jnp.int64), seg_ids,
-                                      num_segments=num_segments)
-            return out_keys, (cnt, group_live), num_groups
+            if scatter_free:
+                # per-segment boundary counts = stacked-cumsum diff at
+                # the run ends — no segment_sum scatter
+                cnt = seg_sums_sorted([first.astype(jnp.int64)],
+                                      runs.start_idx,
+                                      runs.end_idx)[:, 0]
+            else:
+                cnt = jax.ops.segment_sum(first.astype(jnp.int64),
+                                          seg_ids,
+                                          num_segments=num_segments)
+            return runs.out_keys, (cnt, runs.group_live), runs.num_groups
 
         return run
 

@@ -27,7 +27,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as t
-from .kernels import blocked_cummax, blocked_cumsum, compute_view
+from .kernels import blocked_cumsum, compute_view
+from .segments import (blocked_seg_scan, lexsort_capped, row0_true,
+                       seg_reduce_sorted, seg_sums_sorted, segment_ends)
 
 
 # Aggregate kernel op kinds understood by the kernel.
@@ -190,6 +192,154 @@ def _batched_sums(agg_specs, spec_vls, live_all, seg, num_segments,
     return sum_of
 
 
+def _segment_minmax_float_sorted(vals, valid_live, boundary, ends_c,
+                                 is_min):
+    """Java-ordering float min/max over SORTED runs, scatter-free: the
+    NaN flag, the clean reduction and the non-NaN count all ride
+    segmented scans gathered at run ends (ops/segments.py) instead of
+    three segment_* scatters."""
+    isnan = jnp.isnan(vals) & valid_live
+    has_nan = seg_reduce_sorted(isnan.astype(jnp.int8), boundary, ends_c,
+                                jnp.maximum) > 0
+    all_nan_ident = jnp.float64(np.inf) if is_min else jnp.float64(-np.inf)
+    clean = jnp.where(valid_live & ~isnan, vals, all_nan_ident)
+    red = seg_reduce_sorted(clean, boundary, ends_c,
+                            jnp.minimum if is_min else jnp.maximum)
+    if is_min:
+        non_nan = seg_reduce_sorted(
+            (valid_live & ~isnan).astype(jnp.int32), boundary, ends_c,
+            jnp.add)
+        # min is NaN only when every valid value is NaN
+        return jnp.where(has_nan & (non_nan == 0), jnp.float64(np.nan),
+                         red)
+    return jnp.where(has_nan, jnp.float64(np.nan), red)
+
+
+def sorted_agg_outputs(agg_specs, spec_vls, s_live, boundary, starts_c,
+                       ends_c, group_live, num_segments: int,
+                       capacity: int, scatter_free: bool):
+    """Aggregate outputs over SORTED runs — the one implementation both
+    the packed and the generic sort-segment group-bys share.
+
+    spec_vls: per-spec (data, valid&live) lanes already in sorted order;
+    boundary: live-run starts; starts_c/ends_c: per segment-slot
+    first/last row (clipped).  With `scatter_free` every reduction is a
+    blocked segmented scan + boundary gather / stacked-cumsum diff
+    (ops/segments.py) — zero jax.ops.segment_* scatters in the emitted
+    program; without it the legacy segment (scatter) reductions run, so
+    the two modes are flip-comparable under one knob."""
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    big = jnp.int32(capacity)
+    seg_ids = None
+
+    def seg():
+        nonlocal seg_ids
+        if seg_ids is None:
+            # dead rows continue the last segment; their vl is False
+            seg_ids = jnp.clip(
+                blocked_cumsum(boundary.astype(jnp.int32)) - 1,
+                0, num_segments - 1)
+        return seg_ids
+
+    def reduce_lane(lane, is_min):
+        if scatter_free:
+            return seg_reduce_sorted(
+                lane, boundary, ends_c,
+                jnp.minimum if is_min else jnp.maximum)
+        return (jax.ops.segment_min if is_min else jax.ops.segment_max)(
+            lane, seg(), num_segments=num_segments)
+
+    # ---- the sum/count family: ONE stacked pass each dtype class ----
+    int_lanes, int_slots, f64_lanes, f64_slots = _queue_sum_lanes(
+        agg_specs, spec_vls, s_live)
+    int_out = f64_out = None
+    if int_lanes:
+        if scatter_free:
+            # stacked cumsum + two boundary gathers; int64 wraparound
+            # cancels in the diff (exact whenever the group sum fits
+            # int64 — segment_sum's own contract)
+            int_out = seg_sums_sorted(int_lanes, starts_c, ends_c)
+        else:
+            int_out = jax.ops.segment_sum(
+                jnp.stack(int_lanes, axis=1), seg(),
+                num_segments=num_segments)
+    if f64_lanes:
+        if scatter_free:
+            # SEGMENTED scan, not cumsum-diff: the per-run reset keeps
+            # each group's accumulation independent, so one group's sum
+            # is never absorbed by preceding groups' magnitudes
+            f64_out = blocked_seg_scan(
+                jnp.stack(f64_lanes, axis=1), boundary, jnp.add)[ends_c]
+        else:
+            f64_out = jax.ops.segment_sum(
+                jnp.stack(f64_lanes, axis=1), seg(),
+                num_segments=num_segments)
+
+    def sum_of(key, is_float):
+        return (f64_out[:, f64_slots[key]] if is_float
+                else int_out[:, int_slots[key]])
+
+    outs = []
+    for si, spec in enumerate(agg_specs):
+        d, vl = spec_vls[si]
+        dt = spec.dtype
+        if spec.kind in (COUNT, COUNT_ALL):
+            outs.append((sum_of(("cnt", si), False), group_live))
+            continue
+        valid_count = sum_of(("vc", spec.input_idx), False)
+        out_valid = (valid_count > 0) & group_live
+        cd = compute_view(d, dt)
+        if spec.kind == SUM:
+            data = sum_of(("sum", si), t.is_floating(dt))
+        elif spec.kind == FIRST:
+            # runs hold only live rows (liveness is the primary sort
+            # lane), so first/last are pure boundary gathers
+            data = cd[starts_c]
+            out_valid = vl[starts_c] & group_live
+        elif spec.kind == LAST:
+            data = cd[ends_c]
+            out_valid = vl[ends_c] & group_live
+        elif spec.kind in (MIN, MAX):
+            is_min = spec.kind == MIN
+            if isinstance(dt, t.DoubleType) and d.dtype == jnp.int64:
+                o = _bits_total_order(d)
+                ident = jnp.int64(_ORDER_MAX if is_min else _ORDER_MIN)
+                o = jnp.where(vl, o, ident)
+                data = _bits_from_order(reduce_lane(o, is_min))
+            elif t.is_floating(dt):
+                if scatter_free:
+                    data = _segment_minmax_float_sorted(
+                        cd, vl, boundary, ends_c, is_min)
+                else:
+                    data = _segment_minmax_float(cd, vl, seg(),
+                                                 num_segments, is_min)
+            else:
+                if isinstance(dt, t.BooleanType):
+                    ident = jnp.asarray(is_min)
+                else:
+                    info = np.iinfo(np.dtype(cd.dtype))
+                    ident = jnp.asarray(info.max if is_min else info.min,
+                                        cd.dtype)
+                data = reduce_lane(jnp.where(vl, cd, ident), is_min)
+        elif spec.kind in (FIRST_NN, LAST_NN):
+            is_first = spec.kind == FIRST_NN
+            masked = jnp.where(vl, iota, big if is_first else -1)
+            pick = jnp.clip(reduce_lane(masked, is_first), 0,
+                            capacity - 1)
+            data = cd[pick]
+            out_valid = vl[pick] & group_live
+        elif spec.kind == ANY:
+            data = reduce_lane(
+                jnp.where(vl, cd, False).astype(jnp.int8), False) > 0
+        elif spec.kind == EVERY:
+            data = reduce_lane(
+                jnp.where(vl, cd, True).astype(jnp.int8), True) > 0
+        else:
+            raise ValueError(f"unknown agg kind {spec.kind}")
+        outs.append((data, out_valid))
+    return outs
+
+
 def _packed_key_lane(keys, keys_valid, pack_spec):
     """Fold the statically-bounded keys into ONE int64 lane (slot 0 per
     key = null; values offset by -lo+1).  TPU sort compile time AND run
@@ -213,7 +363,7 @@ def _packed_key_lane(keys, keys_valid, pack_spec):
 
 
 def packed_groupby_trace(pack_spec, key_lanes_info, agg_specs,
-                         num_segments, capacity):
+                         num_segments, capacity, scatter_free=True):
     """All-keys-packed group-by: ONE sort lane, NO scatters for the
     sum/count family, group keys decoded arithmetically.
 
@@ -236,9 +386,10 @@ def packed_groupby_trace(pack_spec, key_lanes_info, agg_specs,
 
     int64 cumsum-diff is exact for any group sum that fits int64
     (two's-complement wraparound cancels in the subtraction), matching
-    segment_sum semantics.  MIN/MAX/ignore-null FIRST/LAST and ANY/EVERY
-    keep their segment (scatter) reductions — they are rare in hot
-    aggregations; the sum/count family is what TPC-H grinds on."""
+    segment_sum semantics.  MIN/MAX, ignore-null FIRST/LAST, ANY/EVERY
+    and f64 sums run through the same scatter-free sorted-run layer
+    (sorted_agg_outputs): segmented scans gathered at run ends, so the
+    whole program emits ZERO scatters when `scatter_free` holds."""
     spans = [s[1] for s in pack_spec]
     los = [s[0] for s in pack_spec]
     strides = []
@@ -302,119 +453,30 @@ def packed_groupby_trace(pack_spec, key_lanes_info, agg_specs,
             else:
                 spec_vls.append((None, s_live))
 
-        # ---- sum/count family ----
-        # ints/counts: ONE stacked cumsum + two small boundary gathers
-        # (int64 wraparound cancels in the diff — exact whenever the
-        # group sum fits int64, segment_sum's own contract).  floats:
-        # cumsum-diff would let one group's sum be absorbed by preceding
-        # groups' magnitudes (running total ulp >> group sum), so f64
-        # keeps the per-segment scatter reduction.
-        int_lanes, int_slots, f64_lanes, f64_slots = _queue_sum_lanes(
-            agg_specs, spec_vls, s_live)
-
-        int_out = f64_out = None
-        if int_lanes:
-            cs = blocked_cumsum(jnp.stack(int_lanes, axis=1))
-            hi = cs[ends_c]
-            lo_ = jnp.where((starts_c > 0)[:, None],
-                            cs[jnp.maximum(starts_c - 1, 0)], 0)
-            int_out = hi - lo_
-        if f64_lanes:
-            f64_out = jax.ops.segment_sum(
-                jnp.stack(f64_lanes, axis=1),
-                blocked_cumsum(boundary.astype(jnp.int32)) - 1,
-                num_segments=num_segments)
-
-        def sum_of(key, is_float):
-            return (f64_out[:, f64_slots[key]] if is_float
-                    else int_out[:, int_slots[key]])
-
-        # ---- the rare holistic kinds keep segment (scatter) reductions
-        seg_ids = None
-
-        def seg():
-            nonlocal seg_ids
-            if seg_ids is None:
-                seg_ids = blocked_cumsum(boundary.astype(jnp.int32)) - 1
-                # dead rows continue the last segment; their vl is False
-                seg_ids = jnp.clip(seg_ids, 0, num_segments - 1)
-            return seg_ids
-
-        outs = []
-        for si, spec in enumerate(agg_specs):
-            d, vl = spec_vls[si]
-            dt = spec.dtype
-            if spec.kind in (COUNT, COUNT_ALL):
-                outs.append((sum_of(("cnt", si), False), group_live))
-                continue
-            valid_count = sum_of(("vc", spec.input_idx), False)
-            out_valid = (valid_count > 0) & group_live
-            cd = compute_view(d, dt)
-            if spec.kind == SUM:
-                data = sum_of(("sum", si), t.is_floating(dt))
-            elif spec.kind == FIRST:
-                data = cd[starts_c]
-                out_valid = vl[starts_c] & group_live
-            elif spec.kind == LAST:
-                data = cd[ends_c]
-                out_valid = vl[ends_c] & group_live
-            elif spec.kind in (MIN, MAX):
-                is_min = spec.kind == MIN
-                if isinstance(dt, t.DoubleType) and d.dtype == jnp.int64:
-                    o = _bits_total_order(d)
-                    ident = jnp.int64(_ORDER_MAX if is_min else _ORDER_MIN)
-                    o = jnp.where(vl, o, ident)
-                    red = (jax.ops.segment_min if is_min
-                           else jax.ops.segment_max)(
-                        o, seg(), num_segments=num_segments)
-                    data = _bits_from_order(red)
-                elif t.is_floating(dt):
-                    data = _segment_minmax_float(cd, vl, seg(),
-                                                 num_segments, is_min)
-                else:
-                    if isinstance(dt, t.BooleanType):
-                        ident = jnp.asarray(is_min)
-                    else:
-                        info = np.iinfo(np.dtype(cd.dtype))
-                        ident = jnp.asarray(info.max if is_min
-                                            else info.min, cd.dtype)
-                    acc = jnp.where(vl, cd, ident)
-                    data = (jax.ops.segment_min if is_min
-                            else jax.ops.segment_max)(
-                        acc, seg(), num_segments=num_segments)
-            elif spec.kind in (FIRST_NN, LAST_NN):
-                big = jnp.int32(capacity)
-                is_first = spec.kind == FIRST_NN
-                masked = jnp.where(vl, iota, big if is_first else -1)
-                pick = (jax.ops.segment_min if is_first
-                        else jax.ops.segment_max)(
-                    masked, seg(), num_segments=num_segments)
-                pick = jnp.clip(pick, 0, capacity - 1)
-                data = cd[pick]
-                out_valid = vl[pick] & group_live
-            elif spec.kind == ANY:
-                data = jax.ops.segment_max(
-                    jnp.where(vl, cd, False).astype(jnp.int8), seg(),
-                    num_segments=num_segments) > 0
-            elif spec.kind == EVERY:
-                data = jax.ops.segment_min(
-                    jnp.where(vl, cd, True).astype(jnp.int8), seg(),
-                    num_segments=num_segments) > 0
-            else:
-                raise ValueError(f"unknown agg kind {spec.kind}")
-            outs.append((data, out_valid))
+        # ---- every aggregate kind through the shared sorted-run layer
+        # (scatter-free segmented scans + boundary gathers by default;
+        # the knob flips back to segment scatters for A/B comparison)
+        outs = sorted_agg_outputs(agg_specs, spec_vls, s_live, boundary,
+                                  starts_c, ends_c, group_live,
+                                  num_segments, capacity, scatter_free)
         return out_keys, outs, num_groups
 
     return run
 
 
 def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity,
-                  pack_spec=None):
+                  pack_spec=None, scatter_free=True,
+                  max_sort_operands=2):
     """Build the traced groupby fn for jit.
 
     key_lanes_info: list of (dtype, has_validity, lane_dtype_str) — static.
     pack_spec: optional per-key (lo, span) or None — keys with exact
     static bounds fold into one packed sort lane (_packed_key_lane).
+    scatter_free: route every segment reduction through the sorted-run
+    scan layer (sorted_agg_outputs) — no jax.ops.segment_* scatters.
+    max_sort_operands: cap on emitted sort width; the unpacked key sort
+    chains stable 2-operand sorts instead of one variadic lexsort
+    (segments.lexsort_capped — TPU sort compile scales with operands).
     Returns fn(keys_data, keys_valid, agg_data, agg_valid, live) ->
       (perm_keys (data, valid) per key, agg outs (data, valid) per spec,
        num_groups scalar)
@@ -431,7 +493,8 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity,
             tot *= span
         if tot <= (1 << 62):
             return packed_groupby_trace(pack_spec, key_lanes_info,
-                                        agg_specs, num_segments, capacity)
+                                        agg_specs, num_segments, capacity,
+                                        scatter_free=scatter_free)
 
     def key_sort_lanes(keys, keys_valid):
         """[(lanes...)] for sorting/boundaries: packed keys collapse into
@@ -451,9 +514,10 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity,
         from .filter import grouped_take, take_keys_valid
         # --- 1. sort ---
         lanes = key_sort_lanes(keys, keys_valid)
-        # lexsort: LAST key is primary -> order [secondary..., primary]
+        # lexsort: LAST key is primary -> order [secondary..., primary];
+        # emitted as a chain of <=max_sort_operands stable sorts
         sort_keys = list(reversed(lanes)) + [(~live).astype(jnp.int8)]
-        perm = jnp.lexsort(sort_keys)
+        perm = lexsort_capped(sort_keys, max_sort_operands)
         # ONE stacked gather pass per dtype class for every permuted lane
         # (keys, key validity, liveness) — TPU gathers pay per row, not
         # per byte, so per-lane takes multiply a ~20ms/1M latency cost
@@ -461,8 +525,7 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity,
             keys, keys_valid, [live], perm)
 
         # --- 2. boundaries ---
-        boundary = jnp.zeros((capacity,), bool)
-        boundary = boundary.at[0].set(True)
+        boundary = row0_true(capacity)
         for lane in key_sort_lanes(s_keys, s_keys_valid):
             boundary = boundary | _eq_prev(lane)
         # first padding row opens its own (dead) segment
@@ -481,8 +544,9 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity,
         # boundary positions — no segment_min scatter
         big = jnp.int32(capacity)
         iota = jnp.arange(capacity, dtype=jnp.int32)
-        start_idx = jnp.sort(jnp.where(boundary, iota, big))[:num_segments]
-        start_idx = jnp.clip(start_idx, 0, capacity - 1)
+        start_raw = jnp.sort(jnp.where(boundary, iota, big))[:num_segments]
+        end_idx = segment_ends(start_raw, count, capacity)
+        start_idx = jnp.clip(start_raw, 0, capacity - 1)
         group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
         okds, okvs, _ = take_keys_valid(s_keys, s_keys_valid, [],
                                         start_idx)
@@ -507,70 +571,9 @@ def groupby_trace(key_lanes_info, agg_specs, num_segments, capacity,
                 spec_vls.append(s_in[spec.input_idx])
             else:
                 spec_vls.append((None, s_live))
-        sum_of = _batched_sums(agg_specs, spec_vls, s_live, seg_ids,
-                               num_segments, lambda a: a)
-
-        outs = []
-        for si, spec in enumerate(agg_specs):
-            d, vl = spec_vls[si]
-            dt = spec.dtype
-            if spec.kind in (COUNT, COUNT_ALL):
-                outs.append((sum_of(("cnt", si), False), group_live))
-                continue
-            valid_count = sum_of(("vc", spec.input_idx), False)
-            out_valid = (valid_count > 0) & group_live
-            cd = compute_view(d, dt)
-            if spec.kind == SUM:
-                data = sum_of(("sum", si), t.is_floating(dt))
-            elif spec.kind in (MIN, MAX):
-                is_min = spec.kind == MIN
-                if isinstance(dt, t.DoubleType) and d.dtype == jnp.int64:
-                    o = _bits_total_order(d)
-                    ident = jnp.int64(_ORDER_MAX if is_min else _ORDER_MIN)
-                    o = jnp.where(vl, o, ident)
-                    red = (jax.ops.segment_min if is_min
-                           else jax.ops.segment_max)(
-                        o, seg_ids, num_segments=num_segments)
-                    data = _bits_from_order(red)
-                elif t.is_floating(dt):
-                    data = _segment_minmax_float(cd, vl, seg_ids,
-                                                 num_segments, is_min)
-                else:
-                    info = np.iinfo(np.dtype(cd.dtype)) if not \
-                        isinstance(dt, t.BooleanType) else None
-                    if isinstance(dt, t.BooleanType):
-                        ident = jnp.asarray(True if is_min else False)
-                        acc = cd
-                    else:
-                        ident = jnp.asarray(info.max if is_min else info.min,
-                                            cd.dtype)
-                        acc = cd
-                    acc = jnp.where(vl, acc, ident)
-                    data = (jax.ops.segment_min if is_min
-                            else jax.ops.segment_max)(
-                        acc, seg_ids, num_segments=num_segments)
-            elif spec.kind in (FIRST, LAST, FIRST_NN, LAST_NN):
-                idx = jnp.arange(capacity, dtype=jnp.int32)
-                is_first = spec.kind in (FIRST, FIRST_NN)
-                sel = vl if spec.kind in (FIRST_NN, LAST_NN) else s_live
-                masked = jnp.where(sel, idx, big if is_first else -1)
-                pick = (jax.ops.segment_min if is_first
-                        else jax.ops.segment_max)(
-                    masked, seg_ids, num_segments=num_segments)
-                pick = jnp.clip(pick, 0, capacity - 1)
-                data = cd[pick]
-                out_valid = vl[pick] & group_live
-            elif spec.kind == ANY:
-                data = jax.ops.segment_max(
-                    jnp.where(vl, cd, False).astype(jnp.int8), seg_ids,
-                    num_segments=num_segments) > 0
-            elif spec.kind == EVERY:
-                data = jax.ops.segment_min(
-                    jnp.where(vl, cd, True).astype(jnp.int8), seg_ids,
-                    num_segments=num_segments) > 0
-            else:
-                raise ValueError(f"unknown agg kind {spec.kind}")
-            outs.append((data, out_valid))
+        outs = sorted_agg_outputs(agg_specs, spec_vls, s_live, boundary,
+                                  start_idx, end_idx, group_live,
+                                  num_segments, capacity, scatter_free)
         return out_keys, outs, num_groups
 
     return run

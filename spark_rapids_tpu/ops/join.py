@@ -25,8 +25,10 @@ dictionary size for strings; packed-lane span for composite keys):
      recovered by scatter + cummax, not search) and are *verified*
      lane-by-lane, so hash collisions cannot produce wrong results, they
      only cost a masked-out row;
-  5. outer/semi/anti variants derive from verified-match flags via
-     segment/scatter max — never from the (overcounted) candidate ranges.
+  5. outer/semi/anti variants derive from verified-match flags — a
+     sorted index lane + merge-rank difference (segments.matched_flags;
+     scatter reductions only behind the legacy knob) — never from the
+     (overcounted) candidate ranges.
 
 One host sync per probe batch fetches the candidate-pair count (the
 reference syncs identically to size its gather maps); unique-build and
@@ -196,7 +198,9 @@ class BuildTable:
                  lanes_override: Optional[List[jax.Array]] = None,
                  domain: Optional[Tuple[int, int]] = None,
                  unique: bool = False,
-                 extra_valid: Optional[jax.Array] = None):
+                 extra_valid: Optional[jax.Array] = None,
+                 dense_via_sort: bool = True,
+                 matched_via_merge: bool = True):
         self.batch = batch
         lanes = lanes_override if lanes_override is not None \
             else key_cols_lanes(key_cols)
@@ -206,6 +210,11 @@ class BuildTable:
         self.lanes = lanes
         self.key_valid = valid
         self.unique = unique
+        # scatter-avoidance knobs (config.py JOIN_DENSE_BUILD_VIA_SORT /
+        # JOIN_MATCHED_VIA_MERGE): dense tables from a sorted lane +
+        # merge-rank, matched flags from merge-rank differences
+        self.dense_via_sort = dense_via_sort
+        self.matched_via_merge = matched_via_merge
         if domain is not None and len(lanes) == 1:
             self.domain = (int(domain[0]), int(domain[1]))
         else:
@@ -233,29 +242,56 @@ class BuildTable:
     @property
     def slot(self) -> Optional[jax.Array]:
         """Dense-unique direct table: slot[k-lo] = build row of key k,
-        -1 for absent keys.  None unless (domain and unique)."""
+        -1 for absent keys.  None unless (domain and unique).
+
+        Sort-built by default: the row id at each key's offset in the
+        pos-sorted order (dense_via_sort) — the scatter-built table
+        lands in an S(1)-space buffer whose per-probe gathers then run
+        ~200 MB/s."""
         if self.domain is None or not self.unique:
             return None
         if self._slot is None:
-            tgt, _inb = self._dense_pos()
-            self._slot = jnp.full((self.span,), -1, jnp.int32).at[tgt].set(
-                jnp.arange(self.capacity, dtype=jnp.int32), mode="drop")
+            if self.dense_via_sort:
+                offs = self.offs
+                first = jnp.take(self.perm,
+                                 jnp.clip(offs[:-1], 0,
+                                          self.capacity - 1))
+                occupied = offs[1:] > offs[:-1]
+                self._slot = jnp.where(occupied,
+                                       first.astype(jnp.int32), -1)
+            else:
+                tgt, _inb = self._dense_pos()
+                self._slot = jnp.full(
+                    (self.span,), -1, jnp.int32).at[tgt].set(
+                    jnp.arange(self.capacity, dtype=jnp.int32),
+                    mode="drop")
         return self._slot
 
     @property
     def offs(self) -> Optional[jax.Array]:
         """Dense per-key start offsets into the key-sorted order
         (span+1,); key k's build rows are perm[offs[k-lo]:offs[k-lo+1]].
-        None without a domain."""
+        None without a domain.
+
+        Sort-built by default: offs[k] = rank of k among the sorted
+        domain positions — ONE single-lane sort + a merge-rank (two
+        2-operand sorts) instead of a count scatter + cumsum."""
         if self.domain is None:
             return None
         if self._offs is None:
             tgt, _inb = self._dense_pos()
-            counts = jnp.zeros((self.span,), jnp.int32).at[tgt].add(
-                jnp.int32(1), mode="drop")
-            self._offs = jnp.concatenate(
-                [jnp.zeros((1,), jnp.int32),
-                 blocked_cumsum(counts.astype(jnp.int32))])
+            if self.dense_via_sort:
+                sorted_pos = jnp.sort(tgt)
+                self._offs = _merge_rank(
+                    sorted_pos.astype(jnp.uint64),
+                    jnp.arange(self.span + 1, dtype=jnp.uint64),
+                    side="left").astype(jnp.int32)
+            else:
+                counts = jnp.zeros((self.span,), jnp.int32).at[tgt].add(
+                    jnp.int32(1), mode="drop")
+                self._offs = jnp.concatenate(
+                    [jnp.zeros((1,), jnp.int32),
+                     blocked_cumsum(counts.astype(jnp.int32))])
         return self._offs
 
     @property
@@ -286,11 +322,15 @@ class BuildTable:
             self._sorted_hash = None    # dense probes never search
             return
         h = composite_hash(self.lanes)
-        # dead/null-key rows get MAX and liveness-primary lexsort, so the
+        # dead/null-key rows get MAX and liveness-primary order, so the
         # array is globally non-decreasing (searchsorted-safe) and the
-        # searchable region is exactly [0, valid_count)
+        # searchable region is exactly [0, valid_count); emitted as two
+        # chained 2-operand stable sorts (TPU sort compile scales with
+        # operand count — segments.lexsort_capped)
+        from .segments import lexsort_capped
         sort_h = jnp.where(self.key_valid, h, jnp.uint64(2**64 - 1))
-        perm = jnp.lexsort([sort_h, (~self.key_valid).astype(jnp.int8)])
+        perm = lexsort_capped(
+            [sort_h, (~self.key_valid).astype(jnp.int8)], 2)
         self._perm = perm
         self._sorted_hash = jnp.take(sort_h, perm)
 
@@ -501,8 +541,9 @@ def expand_pairs(build: BuildTable, probe_lanes: List[jax.Array],
     # per-pair verification against collisions, and probe_matched is just
     # counts>0 — skip one of the two segment reductions
     exact = len(build.lanes) == 1
+    via_merge = build.matched_via_merge
     sig = ("expand", build.capacity, probe_valid.shape[0], out_cap,
-           len(probe_lanes), exact)
+           len(probe_lanes), exact, via_merge)
     fn = _PROBE_CACHE.get(sig)
     if fn is None:
         pcap = probe_valid.shape[0]
@@ -545,11 +586,20 @@ def expand_pairs(build: BuildTable, probe_lanes: List[jax.Array],
                                jnp.take(pl, probe_idx))
                 ok = ok & jnp.take(p_valid, probe_idx) & \
                     jnp.take(b_key_valid, build_idx)
-                probe_matched = jax.ops.segment_max(
-                    ok.astype(jnp.int32), probe_idx,
-                    num_segments=pcap, indices_are_sorted=True) > 0
-            build_matched = jax.ops.segment_max(
-                ok.astype(jnp.int32), build_idx, num_segments=bcap) > 0
+                if via_merge:
+                    from .segments import matched_flags
+                    probe_matched = matched_flags(probe_idx, ok, pcap)
+                else:
+                    probe_matched = jax.ops.segment_max(
+                        ok.astype(jnp.int32), probe_idx,
+                        num_segments=pcap, indices_are_sorted=True) > 0
+            if via_merge:
+                from .segments import matched_flags
+                build_matched = matched_flags(build_idx, ok, bcap)
+            else:
+                build_matched = jax.ops.segment_max(
+                    ok.astype(jnp.int32), build_idx,
+                    num_segments=bcap) > 0
             return probe_idx, build_idx, ok, probe_matched, build_matched
         fn = jax.jit(run, static_argnames=())
         _PROBE_CACHE[sig] = fn

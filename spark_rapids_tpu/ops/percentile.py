@@ -3,15 +3,18 @@
 Role of the reference's GpuPercentile / Histogram JNI kernel
 (GpuPercentile.scala, SURVEY §2.5 aggregate set) and of
 GpuApproximatePercentile's t-digest: this engine computes EXACT
-percentiles on device — the values sort as an extra minor lexsort lane
-under the group keys, so every group's values are contiguous ascending
-runs and each requested percentile is two gathers + a lerp.  Exact
-results trivially satisfy approx_percentile's rank-error contract.
+percentiles on device — the values sort as an extra minor lane under
+the group keys, so every group's values are contiguous ascending runs
+and each requested percentile is two gathers + a lerp.  Exact results
+trivially satisfy approx_percentile's rank-error contract.
 
 Ordering follows Spark's double sort: values ascending with NaN
 greatest; null values sort after everything inside their group and are
 excluded from the count.  A group with zero non-null values yields
 null.
+
+The shared sort-segment core (`sorted_segments`) lives in
+ops/segments.py; this module keeps a re-export for older callers.
 """
 from __future__ import annotations
 
@@ -22,120 +25,38 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as t
-from .groupby import _eq_prev, _null_first_key_lanes
+from .groupby import _eq_prev
 from .kernels import blocked_cumsum, compute_view
+from .segments import (SegRuns, seg_sums_sorted,            # noqa: F401
+                       sorted_segments)
 
 
-def sorted_segments(key_lanes_info, keys, keys_valid, live,
-                    minor_lanes, capacity: int, num_segments: int,
-                    pack_spec=None):
-    """Shared sort-segment core for holistic aggregates (percentile,
-    count-distinct, collect): lexsort rows by (dead-last, group keys,
-    minor_lanes most-minor-first), find group boundaries, return
+def _value_order_lanes(val, val_valid, live):
+    """(vlive, minor lanes, minor spec) ordering a group's values the
+    Spark way: values ascending, NaN after all values, nulls last.  The
+    NaN and null flags FOLD into one small int lane (z), so the minor
+    order is two lanes — one fewer emitted sort on the chained path."""
+    vlive = live & val_valid
+    isnan = jnp.isnan(val)
+    clean = jnp.where(isnan, 0.0, val)
+    z = isnan.astype(jnp.int8) + 2 * (~vlive).astype(jnp.int8)
+    return vlive, [clean, z], [None, (0, 4)]
 
-      (perm, s_live, s_keys, s_keys_valid, seg_ids, start_idx,
-       out_keys, num_groups, group_live)
 
-    `minor_lanes` order rows WITHIN a group (value lanes, null flags);
-    they do not contribute to boundaries.
-
-    pack_spec: per-key (lo, span) covering EVERY key (exec layer: plan
-    range stats, dictionary sizes, bools) folds the whole key tuple plus
-    liveness into ONE sort lane — TPU sort compile time scales with
-    operand count (a 9-operand lexsort at 1M is minutes; the packed form
-    is seconds), group keys decode arithmetically (zero key gathers),
-    and the boundary compare touches one lane."""
-    from .filter import take_keys_valid
-    packed_all = pack_spec is not None and len(pack_spec) == \
-        len(key_lanes_info) and all(s is not None for s in pack_spec)
-    if packed_all:
-        from .groupby import _packed_key_lane
-        spans = [s[1] for s in pack_spec]
-        total = 1
-        for sp in spans:
-            total *= sp
-        packed = _packed_key_lane(keys, keys_valid, pack_spec)
-        key_lane = jnp.where(live, packed, jnp.int64(total))
-        if total < (1 << 31) - 1:
-            key_lane = key_lane.astype(jnp.int32)
-        sort_keys = list(minor_lanes) + [key_lane]
-        perm = jnp.lexsort(sort_keys)
-        s_key = key_lane[perm]
-        s_live = s_key < jnp.asarray(total, s_key.dtype)
-        boundary = _eq_prev(s_key)
-        seg_ids = blocked_cumsum(boundary.astype(jnp.int32)) - 1
-        count = jnp.sum(live, dtype=jnp.int32)
-        num_groups = jnp.where(count > 0,
-                               seg_ids[jnp.maximum(count - 1, 0)] + 1, 0)
-        group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
-        start_idx = jnp.sort(jnp.where(
-            boundary & s_live, jnp.arange(capacity, dtype=jnp.int32),
-            jnp.int32(capacity)))[:num_segments]
-        start_idx = jnp.clip(start_idx, 0, capacity - 1)
-        # keys decode from the packed value at segment starts
-        strides = []
-        tot = 1
-        for sp in reversed(spans):
-            strides.append(tot)
-            tot *= sp
-        strides.reverse()
-        pk = s_key[start_idx].astype(jnp.int64)
-        out_keys = []
-        for (dt, _hv, lane_dt), (lo, span), stride in zip(
-                key_lanes_info, pack_spec, strides):
-            slot = (pk // jnp.int64(stride)) % jnp.int64(span)
-            okd = (slot - 1 + jnp.int64(lo)).astype(jnp.dtype(lane_dt))
-            out_keys.append((okd, (slot > 0) & group_live))
-        return (perm, s_live, None, None, seg_ids, start_idx,
-                out_keys, num_groups, group_live)
-
-    lanes = []
-    for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, keys, keys_valid):
-        sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
-        lanes.extend([l for l in sub if l is not None])
-    # lexsort: LAST key is primary
-    sort_keys = list(minor_lanes) + list(reversed(lanes)) + \
-        [(~live).astype(jnp.int8)]
-    perm = jnp.lexsort(sort_keys)
-    # one stacked gather pass per dtype class (TPU gathers pay per row,
-    # ~20ms per 1M-row pass — per-lane takes multiply that)
-    s_keys, s_keys_valid, (s_live,) = take_keys_valid(
-        keys, keys_valid, [live], perm)
-
-    boundary = jnp.zeros((capacity,), bool).at[0].set(True)
-    for (dt, _hv, _ld), kd, kv in zip(key_lanes_info, s_keys,
-                                      s_keys_valid):
-        sub = _null_first_key_lanes(compute_view(kd, dt), kv, dt)
-        for lane in sub:
-            if lane is not None:
-                boundary = boundary | _eq_prev(lane)
-    pad_start = jnp.concatenate([jnp.ones((1,), bool),
-                                 s_live[1:] != s_live[:-1]])
-    boundary = boundary | pad_start
-    seg_ids = blocked_cumsum(boundary.astype(jnp.int32)) - 1
-    count = jnp.sum(live, dtype=jnp.int32)
-    num_groups = jnp.where(count > 0,
-                           seg_ids[jnp.maximum(count - 1, 0)] + 1, 0)
-    group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
-
-    # seg ids rise with position, so the g-th boundary IS segment g's
-    # start: a single-lane sort compacts them (no segment_min scatter —
-    # scatter outputs land in slow S(1) buffers on this platform)
-    start_idx = jnp.sort(jnp.where(
-        boundary, jnp.arange(capacity, dtype=jnp.int32),
-        jnp.int32(capacity)))[:num_segments]
-    start_idx = jnp.clip(start_idx, 0, capacity - 1)
-    okds, okvs, _ = take_keys_valid(s_keys, s_keys_valid, [], start_idx)
-    out_keys = []
-    for okd, okv in zip(okds, okvs):
-        okv = jnp.ones((capacity,), bool) if okv is None else okv
-        out_keys.append((okd, okv & group_live))
-    return (perm, s_live, s_keys, s_keys_valid, seg_ids, start_idx,
-            out_keys, num_groups, group_live)
+def _seg_valid_counts(s_vlive, runs: SegRuns, num_segments: int,
+                      scatter_free: bool):
+    """Per-segment non-null count: stacked-cumsum boundary diff when
+    scatter-free, legacy segment_sum scatter otherwise."""
+    if scatter_free:
+        return seg_sums_sorted([s_vlive.astype(jnp.int32)],
+                               runs.start_idx, runs.end_idx)[:, 0]
+    return jax.ops.segment_sum(s_vlive.astype(jnp.int32), runs.seg_ids,
+                               num_segments=num_segments)
 
 
 def sketch_trace(key_lanes_info, k: int, num_segments: int,
-                 capacity: int, pack_spec=None):
+                 capacity: int, pack_spec=None, scatter_free=True,
+                 max_sort_operands=2):
     """Traced PARTIAL of the mergeable approx_percentile: per group, the
     non-null count and k equi-rank order statistics
     (ops/quantile_sketch.py; reference GpuApproximatePercentile.scala
@@ -144,51 +65,45 @@ def sketch_trace(key_lanes_info, k: int, num_segments: int,
     from .quantile_sketch import sketch_gather
 
     def run(keys, keys_valid, val, val_valid, live):
-        vlive = live & val_valid
-        isnan = jnp.isnan(val)
-        clean = jnp.where(isnan, 0.0, val)
-        minor = [clean, isnan.astype(jnp.int8), (~vlive).astype(jnp.int8)]
-        (perm, _s_live, _sk, _skv, seg_ids, start_idx, out_keys,
-         num_groups, _group_live) = sorted_segments(
+        vlive, minor, minor_spec = _value_order_lanes(val, val_valid,
+                                                      live)
+        runs = sorted_segments(
             key_lanes_info, keys, keys_valid, live, minor, capacity,
-            num_segments, pack_spec=pack_spec)
-        s_vlive = vlive[perm]
-        s_val = val[perm]
-        cnt = jax.ops.segment_sum(s_vlive.astype(jnp.int32), seg_ids,
-                                  num_segments=num_segments)
-        pts = sketch_gather(s_val, start_idx, cnt, k, num_segments,
+            num_segments, pack_spec=pack_spec, minor_spec=minor_spec,
+            max_sort_operands=max_sort_operands)
+        s_vlive = vlive[runs.perm]
+        s_val = val[runs.perm]
+        cnt = _seg_valid_counts(s_vlive, runs, num_segments,
+                                scatter_free)
+        pts = sketch_gather(s_val, runs.start_idx, cnt, k, num_segments,
                             capacity)
-        return out_keys, cnt, pts, num_groups
+        return runs.out_keys, cnt, pts, runs.num_groups
 
     return run
 
 
 def percentile_trace(key_lanes_info, qs: Sequence[float],
-                     num_segments: int, capacity: int, pack_spec=None):
+                     num_segments: int, capacity: int, pack_spec=None,
+                     scatter_free=True, max_sort_operands=2):
     """Traced fn: (keys, keys_valid, val_f64, val_valid, live) ->
     (out_keys [(data, valid)...], [(vals, valid) per q], num_groups).
     With zero keys this is the global single-group reduction."""
     qs = [float(q) for q in qs]
 
     def run(keys, keys_valid, val, val_valid, live):
-        vlive = live & val_valid
-        isnan = jnp.isnan(val)
-        # neutralize NaN for the comparator; a separate flag lane orders
-        # them greatest-within-group (Spark double ordering)
-        clean = jnp.where(isnan, 0.0, val)
-        # minor order within group: values asc, NaN after, nulls last
-        minor = [clean, isnan.astype(jnp.int8),
-                 (~vlive).astype(jnp.int8)]
-        (perm, s_live, _sk, _skv, seg_ids, start_idx, out_keys,
-         num_groups, group_live) = sorted_segments(
+        vlive, minor, minor_spec = _value_order_lanes(val, val_valid,
+                                                      live)
+        runs = sorted_segments(
             key_lanes_info, keys, keys_valid, live, minor, capacity,
-            num_segments, pack_spec=pack_spec)
-        s_vlive = vlive[perm]
-        s_val = val[perm]
+            num_segments, pack_spec=pack_spec, minor_spec=minor_spec,
+            max_sort_operands=max_sort_operands)
+        s_vlive = vlive[runs.perm]
+        s_val = val[runs.perm]
 
         # non-null values per group sit at [start, start + cnt)
-        cnt = jax.ops.segment_sum(s_vlive.astype(jnp.int32), seg_ids,
-                                  num_segments=num_segments)
+        cnt = _seg_valid_counts(s_vlive, runs, num_segments,
+                                scatter_free)
+        start_idx = runs.start_idx
         out = []
         for q in qs:
             pos = (cnt - 1).astype(jnp.float64) * jnp.float64(q)
@@ -205,14 +120,15 @@ def percentile_trace(key_lanes_info, qs: Sequence[float],
             # hi endpoint must not contaminate (NaN * 0 is NaN)
             res = jnp.where(frac == 0.0, v_lo,
                             v_lo + (v_hi - v_lo) * frac)
-            out.append((res, (cnt > 0) & group_live))
-        return out_keys, out, num_groups
+            out.append((res, (cnt > 0) & runs.group_live))
+        return runs.out_keys, out, runs.num_groups
 
     return run
 
 
 def collect_trace(key_lanes_info, num_segments: int, capacity: int,
-                  distinct: bool, val_dtype, pack_spec=None):
+                  distinct: bool, val_dtype, pack_spec=None,
+                  max_sort_operands=2):
     """Traced collect_list / collect_set as a group-by emitting a RAGGED
     column (reference GpuAggregateExec.scala collect ops over cuDF
     lists).  Sort-by-(key[, value], position) makes every group's kept
@@ -234,10 +150,12 @@ def collect_trace(key_lanes_info, num_segments: int, capacity: int,
             minor = [idx] + list(vlanes) + [(~vlive).astype(jnp.int8)]
         else:
             minor = [idx, (~vlive).astype(jnp.int8)]
-        (perm, _s_live, _sk, _skv, seg_ids, _start, out_keys,
-         num_groups, group_live) = sorted_segments(
+        runs = sorted_segments(
             key_lanes_info, keys, keys_valid, live, minor, capacity,
-            num_segments, pack_spec=pack_spec)
+            num_segments, pack_spec=pack_spec,
+            max_sort_operands=max_sort_operands)
+        perm, seg_ids = runs.perm, runs.seg_ids
+        group_live = runs.group_live
         s_vlive = vlive[perm]
         s_val = val[perm]
         keep = s_vlive
@@ -268,6 +186,7 @@ def collect_trace(key_lanes_info, num_segments: int, capacity: int,
             jnp.arange(num_segments + 1, dtype=jnp.uint64),
             side="left").astype(jnp.int32)
         elem_valid = jnp.arange(capacity, dtype=jnp.int32) < n_kept
-        return out_keys, values, offs, elem_valid, num_groups, group_live
+        return (runs.out_keys, values, offs, elem_valid,
+                runs.num_groups, group_live)
 
     return run

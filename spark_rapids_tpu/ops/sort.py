@@ -5,7 +5,9 @@ radix/merge sort with per-key ascending/descending + null ordering.
 
 TPU-first realization: every key column is mapped to an *order lane* — an
 integer (or float) lane whose ascending order equals the requested logical
-order — and one `jnp.lexsort` produces the permutation:
+order — and an operand-capped lexsort chain (ops/segments.py) produces
+the permutation, so no emitted sort exceeds the configured operand
+budget (TPU sort compile time scales with operand count):
 
   * ints/dates/timestamps/bools: the lane is the value itself (descending =
     bitwise negation on the unsigned view, exact for all values incl. MIN).
@@ -124,15 +126,24 @@ def _null_lane(validity: jax.Array, nulls_first: bool) -> jax.Array:
 _SORT_CACHE = {}
 
 
-def sort_permutation(db: DeviceBatch, keys: Sequence[SortKey]) -> jax.Array:
-    """Permutation putting live rows in key order, padding at the end."""
+def sort_permutation(db: DeviceBatch, keys: Sequence[SortKey],
+                     conf: TpuConf = DEFAULT_CONF) -> jax.Array:
+    """Permutation putting live rows in key order, padding at the end.
+
+    Emitted as a chain of <= spark.rapids.tpu.sql.sort.maxSortOperands
+    stable sorts (segments.lexsort_capped): a k-key ORDER BY used to
+    lower to ONE variadic lexsort whose XLA compile time scales
+    brutally with operand count (3xi64 at 1M: 164s)."""
+    from ..config import MAX_SORT_OPERANDS
+    from .segments import lexsort_capped
+    max_ops = conf.get(MAX_SORT_OPERANDS)
     rank_tables = {}
     for k in keys:
         col = db.columns[k.col_index]
         if isinstance(col.dtype, t.StringType):
             rank_tables[k.col_index] = jnp.asarray(
                 dictionary_ranks(col.dictionary))
-    sig = ("sortperm", db.capacity, tuple(keys),
+    sig = ("sortperm", db.capacity, tuple(keys), max_ops,
            tuple((str(c.data.dtype), c.dtype.simple_string,
                   c.data_hi is not None) for c in db.columns),
            tuple((i, rt.shape) for i, rt in rank_tables.items()))
@@ -154,7 +165,7 @@ def sort_permutation(db: DeviceBatch, keys: Sequence[SortKey]) -> jax.Array:
                                          ranks.get(k.col_index)))
             # lexsort: last key is primary -> [minor..., major, liveness]
             sort_keys = list(reversed(lanes)) + [(~live).astype(jnp.int8)]
-            return jnp.lexsort(sort_keys)
+            return lexsort_capped(sort_keys, max_ops)
 
         fn = jax.jit(run)
         _SORT_CACHE[sig] = fn
@@ -191,4 +202,4 @@ def permute_batch(db: DeviceBatch, perm: jax.Array) -> DeviceBatch:
 def sort_batch(db: DeviceBatch, keys: Sequence[SortKey],
                conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
     """Fully sort one device batch by the given keys."""
-    return permute_batch(db, sort_permutation(db, keys))
+    return permute_batch(db, sort_permutation(db, keys, conf))

@@ -5,11 +5,13 @@ reference (window/GpuWindowExec.scala:146, GpuRunningWindowExec.scala:220,
 GpuBatchedBoundedWindowExec.scala:220) — re-designed for XLA:
 
   * the input batch arrives sorted by (partition keys, order keys)
-    (ops/sort.py lexsort); partition and peer boundaries are equality
-    flags on adjacent rows (same trick as the sort-segment groupby);
-  * running frames  = segmented inclusive scans via `lax.associative_scan`
-    with a boundary-reset combiner (one log-depth pass, no scatter);
-  * unbounded frames = segment reductions broadcast back through seg ids;
+    (ops/sort.py operand-capped lexsort); partition and peer boundaries
+    are equality flags on adjacent rows (same trick as the sort-segment
+    groupby);
+  * running frames  = blocked segmented inclusive scans (ops/segments.py
+    — compiles in seconds where associative_scan ran minutes at 1M);
+  * unbounded frames = the forward scan gathered at each row's segment
+    end (scatter-free segment reduction over sorted runs);
   * bounded ROWS sums/counts = global prefix-sum differences with the
     window clamped to the partition span (exact: clamping keeps both
     gathers inside the current partition);
@@ -35,19 +37,16 @@ from ..plan.window import WindowFrame
 from .groupby import (_bits_from_order, _bits_total_order,
                       _null_first_key_lanes, _ORDER_MAX, _ORDER_MIN)
 from .kernels import blocked_cumsum, compute_view
+from .segments import blocked_seg_scan, row0_true
 
 
 def _seg_scan(vals: jax.Array, boundary: jax.Array, op) -> jax.Array:
     """Segmented inclusive scan: resets at rows where boundary is True.
 
-    The combiner on (value, start-flag) pairs is the standard segmented-scan
-    monoid (associative, so log-depth associative_scan applies)."""
-    def combine(a, b):
-        av, af = a
-        bv, bf = b
-        return jnp.where(bf, bv, op(av, bv)), af | bf
-    out, _ = jax.lax.associative_scan(combine, (vals, boundary))
-    return out
+    Runs as the blocked two-level segmented scan (ops/segments.py) — the
+    `lax.associative_scan` formulation it replaces compiled in ~80 s at
+    1M rows on this platform; the blocked form is seconds."""
+    return blocked_seg_scan(vals, boundary, op)
 
 
 def _seg_scan_rev(vals: jax.Array, boundary: jax.Array, op) -> jax.Array:
@@ -60,7 +59,7 @@ def _seg_scan_rev(vals: jax.Array, boundary: jax.Array, op) -> jax.Array:
 
 def _boundary_from_lanes(lanes: List[jax.Array], capacity: int) -> jax.Array:
     """True where any lane differs from the previous row (row 0 True)."""
-    b = jnp.zeros((capacity,), bool).at[0].set(True)
+    b = row0_true(capacity)
     for lane in lanes:
         if lane is None:
             continue
@@ -139,18 +138,17 @@ def _nan_restore(red, frame_cnt, frame_nan, is_min):
 
 
 def _merge_rank_counts(seg, u, query, query_first: bool, part_start,
-                       capacity: int):
+                       capacity: int, max_sort_operands: int = 2):
     """Per-row count of in-segment key values < query (query_first) or
-    <= query (not query_first), computed without binary search: one
-    variadic sort merges the key lane with the query lane per segment
-    (reference GpuBatchedBoundedWindowExec.scala:220 sizes value-offset
-    frames with per-row searches; log-step searchsorted is the slowest
-    access pattern on TPU, a merge sort rides the fast sort network)."""
-    idx = jnp.arange(capacity, dtype=jnp.int32)
+    <= query (not query_first), computed without binary search: a sort
+    merges the key lane with the query lane per segment (reference
+    GpuBatchedBoundedWindowExec.scala:220 sizes value-offset frames with
+    per-row searches; log-step searchsorted is the slowest access
+    pattern on TPU, a merge sort rides the fast sort network)."""
     # tie order rides STABILITY (query-before-key = concat queries
     # first), not a tag lane, and the inversion back to row order is a
-    # second 2-operand sort — TPU sort compile scales with operand
-    # count, and scatter outputs land in slow S(1) buffers
+    # 2-operand sort — TPU sort compile scales with operand count, and
+    # scatter outputs land in slow S(1) buffers
     if query_first:
         segs = jnp.concatenate([seg, seg])
         vals = jnp.concatenate([query, u])
@@ -160,8 +158,16 @@ def _merge_rank_counts(seg, u, query, query_first: bool, part_start,
         vals = jnp.concatenate([u, query])
         qlo = capacity
     ids = jnp.arange(2 * capacity, dtype=jnp.int32)
-    _sg, _vl, s_ids = jax.lax.sort((segs, vals, ids), num_keys=2,
-                                   is_stable=True)
+    if max_sort_operands >= 3:
+        _sg, _vl, s_ids = jax.lax.sort((segs, vals, ids), num_keys=2,
+                                       is_stable=True)
+    else:
+        # chained 2-operand form of the same (seg, val) order: sort by
+        # value with the id payload, then stably by segment — the id
+        # payload of the second sort IS the merged order
+        _v1, p1 = jax.lax.sort((vals, ids), num_keys=1, is_stable=True)
+        _s2, s_ids = jax.lax.sort((segs[p1], p1), num_keys=1,
+                                  is_stable=True)
     is_key = (s_ids < qlo) | (s_ids >= qlo + capacity)
     cum = blocked_cumsum(is_key.astype(jnp.int32))
     # every batch row is a key, so keys in earlier segments == the
@@ -173,7 +179,8 @@ def _merge_rank_counts(seg, u, query, query_first: bool, part_start,
 
 def _range_value_bounds(order_lane, order_valid, asc: bool,
                         nulls_first: bool, frame, seg, part_start,
-                        part_end, peer_start, peer_end, capacity: int):
+                        part_end, peer_start, peer_end, capacity: int,
+                        max_sort_operands: int = 2):
     """Per-row inclusive [lo, hi] row bounds of a value-offset RANGE
     frame over the single (int-lane) order key.  frame.lower/upper are
     SIGNED value offsets (None = unbounded, 0 = current peer group).
@@ -211,7 +218,8 @@ def _range_value_bounds(order_lane, order_valid, asc: bool,
         cnt = _merge_rank_counts(seg, u, query(int(frame.lower)),
                                  query_first=True,
                                  part_start=part_start,
-                                 capacity=capacity)
+                                 capacity=capacity,
+                                 max_sort_operands=max_sort_operands)
         lo = part_start + cnt
     if frame.upper is None:
         hi = part_end
@@ -221,7 +229,8 @@ def _range_value_bounds(order_lane, order_valid, asc: bool,
         cnt = _merge_rank_counts(seg, u, query(int(frame.upper)),
                                  query_first=False,
                                  part_start=part_start,
-                                 capacity=capacity)
+                                 capacity=capacity,
+                                 max_sort_operands=max_sort_operands)
         hi = part_start + cnt - 1
     if order_valid is not None:
         lo = jnp.where(order_valid, lo, peer_start)
@@ -265,12 +274,15 @@ def _sparse_minmax(o, ident, lo, hi, op, capacity: int):
 
 
 def window_trace(part_info, order_info, val_info, specs_frames,
-                 capacity: int, order_dirs=()):
+                 capacity: int, order_dirs=(), scatter_free=True,
+                 max_sort_operands=2):
     """Build the traced window program.
 
     part_info/order_info/val_info: tuples of (dtype,) per column (static).
     specs_frames: list of (spec, resolved WindowFrame, input_idx); input_idx
     indexes the value columns, -1 for input-less functions.
+    scatter_free: partition/peer extents and whole-frame reductions ride
+    segmented scans (+ per-row end gathers) instead of segment_* scatters.
 
     Returns fn(part_data, part_valid, order_data, order_valid,
                val_data, val_valid, live) -> [(data, valid)] per spec,
@@ -291,16 +303,21 @@ def window_trace(part_info, order_info, val_info, specs_frames,
             if order_lanes else part_b
 
         part_start = _seg_scan(idx, part_b, jnp.minimum)
-        part_end = _gather(jax.ops.segment_max(idx, seg,
-                                               num_segments=capacity),
-                           seg, capacity)
-        part_rows = (part_end - part_start + 1).astype(jnp.int64)
-
         pg = blocked_cumsum(peer_b.astype(jnp.int32)) - 1
         peer_start = _seg_scan(idx, peer_b, jnp.minimum)
-        peer_end = _gather(jax.ops.segment_max(idx, pg,
-                                               num_segments=capacity),
-                           pg, capacity)
+        if scatter_free:
+            # a reverse max-scan IS the per-row segment end — no
+            # segment_max scatter, no broadcast gather
+            part_end = _seg_scan_rev(idx, part_b, jnp.maximum)
+            peer_end = _seg_scan_rev(idx, peer_b, jnp.maximum)
+        else:
+            part_end = _gather(jax.ops.segment_max(idx, seg,
+                                                   num_segments=capacity),
+                               seg, capacity)
+            peer_end = _gather(jax.ops.segment_max(idx, pg,
+                                                   num_segments=capacity),
+                               pg, capacity)
+        part_rows = (part_end - part_start + 1).astype(jnp.int64)
 
         rn0 = idx - part_start                     # 0-based row number
 
@@ -316,7 +333,8 @@ def window_trace(part_info, order_info, val_info, specs_frames,
                     lo, hi = _range_value_bounds(
                         compute_view(order_data[0], order_info[0][0]),
                         ov, asc, nf, frame, seg, part_start, part_end,
-                        peer_start, peer_end, capacity)
+                        peer_start, peer_end, capacity,
+                        max_sort_operands=max_sort_operands)
                     return (jnp.clip(lo, part_start, part_end + 1),
                             jnp.clip(hi, part_start - 1, part_end))
                 lo = part_start if frame.lower is None else peer_start
@@ -399,7 +417,7 @@ def window_trace(part_info, order_info, val_info, specs_frames,
                 outs.append(_framed_agg(
                     kind, spec, frame, cd, vl, dt, d, idx, part_b,
                     frame_bounds, seg, pg, peer_end, peer_start, live,
-                    capacity))
+                    capacity, peer_b, part_end, scatter_free))
             else:
                 raise ValueError(f"unknown window kind {kind}")
         return outs
@@ -408,7 +426,9 @@ def window_trace(part_info, order_info, val_info, specs_frames,
 
 
 def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
-                frame_bounds, seg, pg, peer_end, peer_start, live, capacity):
+                frame_bounds, seg, pg, peer_end, peer_start, live,
+                capacity, peer_b=None, part_end=None,
+                scatter_free=True):
     """sum/count/min/max/avg over a frame; returns (data, valid)."""
     is_min = kind == "agg_min"
     count_all = kind == "agg_count" and spec.child is None
@@ -439,21 +459,31 @@ def _framed_agg(kind, spec, frame, cd, vl, dt, raw_data, idx, part_b,
         and frame.upper == 0
     if frame.is_unbounded_both or peers_only:
         ids = pg if peers_only else seg
+        b = peer_b if peers_only else part_b
+        end = peer_end if peers_only else part_end
 
-        def bcast(x):
-            return _gather(x, ids, capacity)
-        c = bcast(jax.ops.segment_sum(cnt_lane, ids, num_segments=capacity))
+        def red_bcast(lane, op):
+            """Whole-segment reduce broadcast to every member row."""
+            if scatter_free:
+                # the forward scan's value at the segment END is the
+                # full reduction; `end` is already per-row — scan + one
+                # gather, no segment_* scatter
+                return _gather(_seg_scan(lane, b, op), end, capacity)
+            red = {jnp.add: jax.ops.segment_sum,
+                   jnp.minimum: jax.ops.segment_min,
+                   jnp.maximum: jax.ops.segment_max}[op](
+                lane, ids, num_segments=capacity)
+            return _gather(red, ids, capacity)
+
+        c = red_bcast(cnt_lane, jnp.add)
         if kind == "agg_count":
             return c, live
         if kind in ("agg_sum", "agg_avg"):
-            s = bcast(jax.ops.segment_sum(acc, ids, num_segments=capacity))
-            return finish(s, c)
+            return finish(red_bcast(acc, jnp.add), c)
         o, _ident, back, nan_lane = _minmax_lanes(cd, vl, dt, raw_data,
                                                   is_min)
-        red = bcast((jax.ops.segment_min if is_min else jax.ops.segment_max)(
-            o, ids, num_segments=capacity))
-        fnan = None if nan_lane is None else bcast(
-            jax.ops.segment_sum(nan_lane, ids, num_segments=capacity))
+        red = red_bcast(o, jnp.minimum if is_min else jnp.maximum)
+        fnan = None if nan_lane is None else red_bcast(nan_lane, jnp.add)
         return _nan_restore(back(red), c, fnan, is_min), (c > 0) & live
 
     # --- running frames (incl. RANGE ..CURRENT ROW via peer-end gather) ---

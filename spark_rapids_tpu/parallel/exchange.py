@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import types as t
 from ..ops import groupby as G
 from ..ops.hashing import hash_int64
-from .mesh import SHARD_AXIS
+from .mesh import shard_map, SHARD_AXIS
 
 
 def partition_ids(keys: jax.Array, valid: jax.Array, num_parts: int,
@@ -123,7 +123,7 @@ def distributed_groupby_step(mesh: Mesh, key_dtype: t.DataType,
 
     axis = mesh.axis_names[0]
     shard = NamedSharding(mesh, P(axis))
-    fn = jax.shard_map(step, mesh=mesh,
+    fn = shard_map(step, mesh=mesh,
                        in_specs=(P(axis), P(axis), P(axis), P(axis)),
                        out_specs=((P(axis), P(axis)),
                                   [(P(axis), P(axis)) for _ in agg_specs],
@@ -226,7 +226,7 @@ class RaggedExchange:
         self._spec = spec
         self._lane_specs = lane_specs
         prep = ragged_prepare(self.nparts)
-        self._prep = jax.jit(jax.shard_map(
+        self._prep = jax.jit(shard_map(
             lambda lanes, live, dest: prep(lanes, live, dest, axis),
             mesh=mesh, in_specs=(lane_specs, spec, spec),
             out_specs=(lane_specs, spec, spec, spec, spec),
@@ -238,7 +238,7 @@ class RaggedExchange:
         if fn is None:
             rnd = ragged_round(self.nparts, self.cap, self.quota, recv_cap)
             axis = self._axis
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map(
                 lambda s_lanes, offsets, counts, in_counts, recv, rlive, r:
                 rnd(s_lanes, offsets, counts, in_counts, recv, rlive, r,
                     axis),
@@ -311,7 +311,7 @@ def distributed_sort(mesh: Mesh, keys, vals, live, boundaries):
         order = jnp.lexsort((k, (~lv).astype(jnp.int8)))
         return k[order], v[order], lv[order]
 
-    fn = jax.jit(jax.shard_map(local_sort, mesh=mesh,
+    fn = jax.jit(shard_map(local_sort, mesh=mesh,
                                in_specs=(spec, spec, spec),
                                out_specs=(spec, spec, spec),
                                check_vma=False))
@@ -353,7 +353,7 @@ def co_partitioned_join_count(mesh: Mesh, lk, llive, rk, rlive):
         return jnp.sum(jnp.where(llv, hi - lo, 0),
                        dtype=jnp.int64)[None]
 
-    fn = jax.jit(jax.shard_map(local_count, mesh=mesh,
+    fn = jax.jit(shard_map(local_count, mesh=mesh,
                                in_specs=(spec, spec, spec, spec),
                                out_specs=spec, check_vma=False))
     return fn(elk, ellive, erk, errive)
@@ -394,7 +394,7 @@ def distributed_groupby_ragged(mesh: Mesh, key_dtype: t.DataType,
     n_lanes = 2 + 2 * nspecs
     # single prefix specs cover whole pytree subtrees (vals lists vary in
     # length with how many distinct input columns the aggs read)
-    partial_fn = jax.jit(jax.shard_map(
+    partial_fn = jax.jit(shard_map(
         partial_step, mesh=mesh,
         in_specs=(spec, spec, spec, spec),
         out_specs=(spec, spec, spec), check_vma=False))
@@ -418,7 +418,7 @@ def distributed_groupby_ragged(mesh: Mesh, key_dtype: t.DataType,
                                                  r_vv, rlive)
                 return m_keys[0], m_outs, m_groups[None]
 
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map(
                 merge_step, mesh=mesh, in_specs=(spec, spec),
                 out_specs=(spec, spec, spec), check_vma=False))
             merge_fns[rc] = fn
@@ -482,7 +482,7 @@ def distributed_window_rank(mesh: Mesh, part_keys, order_keys, live):
         inv = jnp.argsort(order)
         return pk, ok, s_rank[inv], lv
 
-    fn = jax.jit(jax.shard_map(local_rank, mesh=mesh,
+    fn = jax.jit(shard_map(local_rank, mesh=mesh,
                                in_specs=(spec, spec, spec),
                                out_specs=(spec, spec, spec, spec)))
     return fn(pk, ok, rlive)

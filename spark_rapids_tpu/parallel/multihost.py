@@ -33,6 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import shard_map
+
 DCN_AXIS = "dcn"
 ICI_AXIS = "ici"
 
@@ -179,7 +181,7 @@ def two_level_all_to_all(mesh: Mesh, lanes, live, dest):
 
     shard = cluster_row_sharding(mesh)
     spec = P((DCN_AXIS, ICI_AXIS))
-    fn = jax.shard_map(prog, mesh=mesh,
+    fn = shard_map(prog, mesh=mesh,
                        in_specs=tuple([spec] * (len(lanes) + 2)),
                        out_specs=tuple([spec] * (len(lanes) + 1)))
     put = lambda a: jax.device_put(a, shard)

@@ -61,6 +61,58 @@ def assert_device_cpu_equal(exprs: Sequence[Expression], data: Dict,
     return out
 
 
+# ---------------------------------------------------------------------------
+# jaxpr program lints: sort-operand budget and scatter census
+# ---------------------------------------------------------------------------
+# The two compile/runtime cliffs of this platform are directly visible in
+# the emitted jaxpr: variadic `sort` equations whose operand count blows
+# up XLA compile time, and `scatter*` equations whose outputs land in
+# slow S(1)-space buffers (docs/PERF.md §1).  These walkers turn both
+# into assertable numbers for tier-1 tests and bench.py.
+
+_SCATTER_PRIMS = ("scatter", "scatter-add", "scatter-mul", "scatter-min",
+                  "scatter-max")
+
+
+def _iter_eqns(jaxpr):
+    """Every equation of a (Closed)Jaxpr, recursing into sub-jaxprs
+    (pjit bodies, scan/while/cond branches, custom call wrappers)."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (tuple, list)) else (v,)
+            for sub in vs:
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    yield from _iter_eqns(sub)
+
+
+def jaxpr_sort_operands(jaxpr) -> int:
+    """Largest operand count of any `sort` equation (0 when sort-free)."""
+    return max((len(e.invars) for e in _iter_eqns(jaxpr)
+                if e.primitive.name == "sort"), default=0)
+
+
+def jaxpr_scatter_count(jaxpr) -> int:
+    """Number of scatter-family equations in the program."""
+    return sum(1 for e in _iter_eqns(jaxpr)
+               if e.primitive.name in _SCATTER_PRIMS)
+
+
+def plan_program_stats(physical, ctx=None) -> Dict:
+    """{'sort_operand_max', 'scatter_op_count'} for a PhysicalQuery's
+    device plan traced as ONE whole-plan XLA program
+    (exec.compiled.CompiledPlan.make_jaxpr) — the same program shape the
+    TPU backend dispatches.  Raises jax tracer errors for plans that
+    need host decisions (callers treat those as not-traceable)."""
+    from .exec.compiled import CompiledPlan
+    from .exec.plan import ExecContext
+    ctx = ctx or ExecContext(physical.conf)
+    jx = CompiledPlan(physical.root, physical.conf).make_jaxpr(ctx)
+    return {"sort_operand_max": jaxpr_sort_operands(jx),
+            "scatter_op_count": jaxpr_scatter_count(jx)}
+
+
 def assert_filter_matches(cond: Expression, data: Dict,
                           conf: TpuConf = DEFAULT_CONF):
     """Device filter vs CPU mask-filter row-set comparison."""

@@ -15,10 +15,19 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 # surface as Python exceptions.
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
+# 8 virtual CPU devices: jax>=0.5 spells this jax_num_cpu_devices; older
+# jaxlibs only honor the XLA flag, which applies as long as no backend has
+# initialized yet (sitecustomize only imports jax, it does not create one).
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass                       # pre-0.5 jax: the XLA flag above covers it
 jax.config.update("jax_enable_x64", True)
 
 # Persistent compilation cache: jit compiles dominate suite wall time; with a

@@ -1,0 +1,83 @@
+"""Suite-wide program lints over every TPC-H plan (tier-1).
+
+The two platform cliffs are visible in the emitted jaxpr (docs/PERF.md
+§1): variadic sorts whose XLA compile time scales brutally with operand
+count, and scatters whose outputs land in slow S(1) buffers.  These
+tests pin both numbers for all 22 queries, so any kernel change that
+re-introduces a wide lexsort or a segment scatter fails tier-1 instead
+of silently costing minutes of compile at the next bench round.
+"""
+import pytest
+
+from spark_rapids_tpu import tpch
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.testing import plan_program_stats
+
+ALL_QUERIES = sorted(tpch.QUERIES, key=lambda q: int(q[1:]))
+
+# With default knobs the ONLY remaining scatters live in the dense-domain
+# (no-sort) group-by, which trades them deliberately for zero sorts and
+# zero row gathers; these queries hit it via low-cardinality
+# dictionary/bool keys.  Everything else — packed/sorted group-bys,
+# MIN/MAX and ignore-null FIRST/LAST reductions, count-distinct,
+# percentile, joins (dense build tables, expand_pairs matched flags),
+# window frames — must emit ZERO scatters.
+DENSE_GROUPBY_QUERIES = {"q1", "q4", "q5", "q12", "q21", "q22"}
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return tpch.gen_tables(scale=0.001)
+
+
+@pytest.fixture(scope="module")
+def suite_stats(tables):
+    s = TpuSession()
+    out = {}
+    for name in ALL_QUERIES:
+        q = tpch.QUERIES[name](s, tables).physical()
+        out[name] = plan_program_stats(q)
+    return out
+
+
+def test_sort_operand_budget_suite_wide(suite_stats):
+    """No emitted TPC-H program contains a sort with more than 2
+    operands (1 key + the payload/iota lane)."""
+    wide = {n: st["sort_operand_max"] for n, st in suite_stats.items()
+            if st["sort_operand_max"] > 2}
+    assert not wide, f"sorts wider than 2 operands: {wide}"
+
+
+def test_scatter_free_outside_dense_groupby(suite_stats):
+    """Group-by MIN/MAX, count-distinct, expand_pairs, window and join
+    paths emit zero scatters; only the dense-domain group-by queries
+    may carry them (its no-sort trade — flip-testable below)."""
+    dirty = {n: st["scatter_op_count"] for n, st in suite_stats.items()
+             if st["scatter_op_count"] and n not in DENSE_GROUPBY_QUERIES}
+    assert not dirty, f"unexpected scatters: {dirty}"
+
+
+def test_dense_via_sort_makes_whole_suite_scatter_free(tables):
+    """Flipping agg.denseDomainViaSort removes the last scatters: the
+    bounded domains run through the packed single-sort-lane kernel and
+    the full 22-query suite emits no scatter at all."""
+    s = TpuSession({"spark.rapids.tpu.sql.agg.denseDomainViaSort": "true"})
+    for name in sorted(DENSE_GROUPBY_QUERIES, key=lambda q: int(q[1:])):
+        q = tpch.QUERIES[name](s, tables).physical()
+        st = plan_program_stats(q)
+        assert st["scatter_op_count"] == 0, (name, st)
+        assert st["sort_operand_max"] <= 2, (name, st)
+
+
+def test_dense_via_sort_oracle_match(tables):
+    """The dense->packed swap is a pure layout change: device results
+    must equal the CPU oracle exactly on the dense-domain queries."""
+    dev = TpuSession(
+        {"spark.rapids.tpu.sql.agg.denseDomainViaSort": "true"})
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    from spark_rapids_tpu.session import DataFrame
+    for name in ("q1", "q12", "q22"):
+        df = tpch.QUERIES[name](dev, tables)
+        got = df.collect().to_pydict()
+        want = DataFrame(df._plan, cpu).collect().to_pydict()
+        assert got == want, name
