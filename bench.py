@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Headline benchmark: the full 22-query TPC-H suite at SF>=1.
+"""Headline benchmark: the TPC-H suite (default) or the TPC-DS tranche
+(--suite tpcds) at SF>=1.
 
 Prints a running JSON summary line after EVERY query (flushed), so a
 timeout kill at any point still leaves a complete, parseable result as
@@ -31,7 +32,13 @@ Methodology.
     total; queries that don't fit are listed in "skipped" rather than
     silently absent.
 
-Run: python bench.py [scale] [--queries q1,q6,...]
+--suite tpcds additionally reports the operator-coverage matrix the
+BASELINE.md staged config #2 asks for: per-query fallback reasons (from
+the overrides tagger), sort_operand_max and scatter_op_count (jaxpr
+lints, testing.py), and a top-level coverage summary splitting queries
+into device-clean / with-fallbacks / not-whole-plan-traceable.
+
+Run: python bench.py [scale] [--queries q1,q6,...] [--suite tpch|tpcds]
 """
 import json
 import os
@@ -43,9 +50,13 @@ import numpy as np
 import jax
 
 # persistent compile cache: cold compiles (minutes/query over the
-# tunnel) are paid once per (plan, shape); later runs trace + load
+# tunnel) are paid once per (plan, shape); later runs trace + load.
+# Separate dir from the test suite's .jax_cache: bench runs under a
+# different device topology (1 chip / no 8-device CPU mesh flag), and
+# XLA_FLAGS topology is NOT part of the cache key — sharing a dir lets
+# one topology's executables segfault the other's deserializer.
 jax.config.update("jax_compilation_cache_dir",
-                  __file__.rsplit("/", 1)[0] + "/.jax_cache")
+                  __file__.rsplit("/", 1)[0] + "/.jax_cache_bench")
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
@@ -103,13 +114,44 @@ def time_warm(fn, iters=3):
     return min(times)
 
 
+def fallback_reasons(meta) -> list:
+    """Every tagger reason in the plan's meta tree (depth-first) — the
+    structured form of the '!Exec ... because ...' explain lines."""
+    out, stack = [], [meta]
+    while stack:
+        m = stack.pop()
+        for r in m.reasons:
+            if r not in out:
+                out.append(r)
+        stack.extend(getattr(m, "children", ()))
+    return out
+
+
 class Suite:
-    def __init__(self, scale: float, rtt: float):
+    def __init__(self, name: str, scale: float, rtt: float):
+        self.name = name
         self.scale = scale
         self.rtt = rtt
         self.per_q = {}
         self.skipped = []
         self.compiled_ct = 0
+
+    def coverage(self) -> dict:
+        """Operator-coverage matrix: which queries run device-clean,
+        which carry fallbacks (and why), which cannot trace as one
+        whole-plan program (per-query stats stay None for those)."""
+        clean, with_fb, untraceable = [], {}, []
+        for name, v in self.per_q.items():
+            fb = v.get("fallback_reasons") or []
+            if fb:
+                with_fb[name] = fb
+            else:
+                clean.append(name)
+            if v.get("sort_operand_max") is None and "error" not in v:
+                untraceable.append(name)
+        return {"device_clean": sorted(clean),
+                "with_fallbacks": with_fb,
+                "not_whole_plan_traceable": sorted(untraceable)}
 
     def emit(self, final: bool = False):
         speedups = [v["speedup"] for v in self.per_q.values()
@@ -122,13 +164,16 @@ class Suite:
         med_cold = colds[len(colds) // 2] if colds else None
         scale = self.scale
         out = {
-            "metric": f"tpch_sf{scale:g}_suite_geomean_speedup_vs_cpu",
+            "metric": f"{self.name}_sf{scale:g}_suite_geomean_speedup"
+                      f"_vs_cpu",
             "value": round(geomean, 3),
             "unit": "x",
             "vs_baseline": round(geomean, 3),
-            "tpch_suite_scale": scale,
-            "tpch_suite_queries": self.per_q,
-            "tpch_suite_geomean_speedup": round(geomean, 3),
+            "suite": self.name,
+            f"{self.name}_suite_scale": scale,
+            f"{self.name}_suite_queries": self.per_q,
+            f"{self.name}_suite_geomean_speedup": round(geomean, 3),
+            "coverage": self.coverage(),
             "queries_measured": len(self.per_q),
             "errors": errors,
             "skipped": self.skipped,
@@ -155,8 +200,9 @@ class Suite:
         print(json.dumps(out), flush=True)
 
 
-def run_suite(scale: float, query_names):
-    from spark_rapids_tpu import tpch
+def run_suite(suite_name: str, scale: float, query_names):
+    import importlib
+    workload = importlib.import_module(f"spark_rapids_tpu.{suite_name}")
     from spark_rapids_tpu.exec.plan import ExecContext
     from spark_rapids_tpu.session import DataFrame, TpuSession
 
@@ -165,21 +211,22 @@ def run_suite(scale: float, query_names):
           f"per host sync", file=sys.stderr)
 
     t0 = time.perf_counter()
-    tables = tpch.gen_tables(scale=scale)
+    tables = workload.gen_tables(scale=scale)
     gen_s = time.perf_counter() - t0
-    print(f"# datagen SF{scale}: {gen_s:.1f}s "
-          f"lineitem={tables['lineitem'].num_rows}", file=sys.stderr)
+    biggest = max(tables, key=lambda k: tables[k].num_rows)
+    print(f"# datagen {suite_name} SF{scale}: {gen_s:.1f}s "
+          f"{biggest}={tables[biggest].num_rows}", file=sys.stderr)
 
     dev = TpuSession()          # wholePlan AUTO -> on for the TPU backend
     cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
 
-    suite = Suite(scale, rtt)
+    suite = Suite(suite_name, scale, rtt)
     for name in query_names:
         if left() < 20:
             suite.skipped.append(name)
             continue
         try:
-            dfq = tpch.QUERIES[name](dev, tables)
+            dfq = workload.QUERIES[name](dev, tables)
             q = dfq.physical()
             # cold: compile (or cache load) + device upload + first run
             t0 = time.perf_counter()
@@ -213,7 +260,9 @@ def run_suite(scale: float, query_names):
                                  "speedup": round(ct / dt, 2),
                                  "cold_s": round(cold_s, 1),
                                  "compiled": bool(compiled),
-                                 "match": match, **pstats}
+                                 "match": match,
+                                 "fallback_reasons":
+                                     fallback_reasons(q.meta), **pstats}
             print(f"# {name}: device={dt*1e3:.0f}ms cpu={ct*1e3:.0f}ms "
                   f"x{ct/dt:.2f} cold={cold_s:.1f}s "
                   f"compiled={bool(compiled)} match={match}",
@@ -236,6 +285,7 @@ def run_suite(scale: float, query_names):
 def main():
     scale = 1.0
     names = None
+    suite_name = "tpch"
     args = list(sys.argv[1:])
     i = 0
     while i < len(args):
@@ -246,13 +296,24 @@ def main():
             else:
                 i += 1
                 names = args[i].split(",")
+        elif a.startswith("--suite"):
+            if "=" in a:
+                suite_name = a.split("=", 1)[1]
+            else:
+                i += 1
+                suite_name = args[i]
         else:
             scale = float(a)
         i += 1
-    from spark_rapids_tpu import tpch
-    query_names = names or sorted(tpch.QUERIES, key=lambda q: int(q[1:]))
+    if suite_name not in ("tpch", "tpcds"):
+        raise SystemExit(f"unknown suite {suite_name!r} "
+                         f"(expected tpch or tpcds)")
+    import importlib
+    workload = importlib.import_module(f"spark_rapids_tpu.{suite_name}")
+    query_names = names or sorted(workload.QUERIES,
+                                  key=lambda q: int(q[1:]))
 
-    suite = run_suite(scale, query_names)
+    suite = run_suite(suite_name, scale, query_names)
     suite.emit(final=True)
 
 

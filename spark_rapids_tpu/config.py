@@ -556,7 +556,26 @@ def generate_docs() -> str:
             continue
         doc = e.doc.replace("|", "\\|").replace("\n", " ")
         lines.append(f"| `{e.key}` | `{e.default}` | {doc} |")
-    lines.append("")
+    lines += [
+        "", "## Benchmark harness (bench.py)", "",
+        "`python bench.py [scale] [--queries q1,q6,...] "
+        "[--suite tpch|tpcds]`", "",
+        "| flag / env | default | meaning |", "|---|---|---|",
+        "| `--suite` | `tpch` | Workload: the 22-query TPC-H suite or "
+        "the TPC-DS tranche (spark_rapids_tpu/tpcds.py). The tpcds "
+        "report adds the operator-coverage matrix: per-query fallback "
+        "reasons plus the sort_operand_max / scatter_op_count jaxpr "
+        "lints, and a summary splitting queries into device-clean / "
+        "with-fallbacks / not-whole-plan-traceable. |",
+        "| `--queries` | all registered | Comma-separated subset of the "
+        "suite's QUERIES registry. |",
+        "| `scale` | `1.0` | Linear datagen scale factor (SF1-ish row "
+        "counts at 1.0; fixed-size dimensions never scale). |",
+        "| `BENCH_BUDGET_S` | `1800` | Total wall budget; queries that "
+        "do not fit are listed in `skipped`, and the last stdout line "
+        "is always a complete parseable JSON result. |",
+        "",
+    ]
     return "\n".join(lines)
 
 

@@ -401,7 +401,8 @@ class DeltaTable:
         files = self.snapshot_files()
         if not files:
             return self.version()
-        tbl = pa.concat_tables([pq.read_table(p) for p in files])
+        tbl = pa.concat_tables([pq.read_table(p, partitioning=None)
+                                for p in files])
         if zorder_by:
             from ..ops.zorder import zorder_sort_indices
             cols = [_zorder_lane(tbl.column(name), name)
@@ -484,7 +485,11 @@ class DeltaTable:
         logical rename (column mapping), deletion-vector row mask,
         null-fill for columns the file predates (schema evolution —
         column mapping exists precisely to allow add/rename/drop)."""
-        tbl = pq.read_table(os.path.join(self.path, add["path"]))
+        # partitioning=None: pyarrow >= 13 infers hive partitioning from
+        # k=v path segments and would resurrect partition columns the
+        # writer deliberately dropped (they come from partitionValues)
+        tbl = pq.read_table(os.path.join(self.path, add["path"]),
+                            partitioning=None)
         if phys:
             # physical -> logical for the columns present in the file
             rename = {p: l for l, p in phys.items()}

@@ -234,6 +234,44 @@ class CpuFilterExec(HostNode):
         return f"CpuFilterExec[{self.condition!r}]"
 
 
+class CpuSampleExec(HostNode):
+    """Bernoulli sample on the host stream.  Shares the device path's
+    counter-based hash (exec.plan.sample_hash_u32) so CPU and device
+    keep exactly the same rows for a given seed."""
+
+    def __init__(self, fraction: float, seed: int, child: HostNode):
+        super().__init__(child)
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        import numpy as np
+        from .plan import sample_hash_u32, sample_threshold
+        threshold = np.uint32(sample_threshold(self.fraction))
+        offset = 0
+        for rb in self.child.execute(ctx):
+            n = rb.num_rows
+            if n == 0:
+                continue
+            if self.fraction >= 1.0:
+                yield rb
+                offset += n
+                continue
+            idx = (offset + np.arange(n, dtype=np.int64)).astype(np.uint32)
+            offset += n
+            keep = sample_hash_u32(idx, self.seed) < threshold
+            tbl = pa.Table.from_batches([rb]).filter(pa.array(keep))
+            for out in tbl.combine_chunks().to_batches():
+                yield out
+
+    def describe(self):
+        return f"CpuSampleExec[{self.fraction}, seed={self.seed}]"
+
+
 def _clear_scan_provenance():
     """Materializing operators (sort/agg/join/window) drain their whole
     input before emitting, so per-batch scan provenance no longer

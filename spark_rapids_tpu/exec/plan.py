@@ -373,6 +373,83 @@ class FilterExec(PlanNode):
         return f"FilterExec[{self.condition!r}]"
 
 
+def sample_hash_u32(idx_u32, seed: int):
+    """Murmur3 finalizer over the global live-row index mixed with the
+    seed.  Pure uint32 lattice ops, so numpy (CPU path) and jnp (device
+    path) produce bit-identical hashes — both engines keep exactly the
+    same rows for a given seed."""
+    h = idx_u32 ^ ((seed * 0x9E3779B9) & 0xFFFFFFFF)
+    h = h ^ (h >> 16)
+    h = h * 0x85EBCA6B
+    h = h ^ (h >> 13)
+    h = h * 0xC2B2AE35
+    h = h ^ (h >> 16)
+    return h
+
+
+def sample_threshold(fraction: float) -> int:
+    """uint32 keep-threshold for a Bernoulli fraction (callers special-
+    case fraction >= 1.0: everything is kept, no compare)."""
+    return min(int(round(fraction * 2.0 ** 32)), 2 ** 32 - 1)
+
+
+class SampleExec(PlanNode):
+    """GpuSampleExec (basicPhysicalOperators.scala:838): Bernoulli
+    row sampling without replacement.  The keep decision is a counter-
+    based hash of the row's global live position — no RNG state, so the
+    result is deterministic per seed, independent of batch boundaries,
+    and identical to the CPU fallback's (CpuSampleExec shares
+    sample_hash_u32)."""
+
+    def __init__(self, fraction: float, seed: int, child: PlanNode):
+        super().__init__(child)
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+
+    @property
+    def output_schema(self) -> t.StructType:
+        return self.child.output_schema
+
+    def keys_unique(self, names):
+        return self.child.keys_unique(names)   # subset of rows
+
+    def column_range(self, name):
+        return self.child.column_range(name)   # subset of values
+
+    def row_upper_bound(self):
+        return self.child.row_upper_bound()    # sampling only shrinks
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from ..ops.filter import compact_batch
+        from ..ops.kernels import live_mask
+        threshold = sample_threshold(self.fraction)
+        offset = jnp.int64(0)
+        for db in self.child.execute(ctx):
+            if isinstance(db.num_rows, int) and db.num_rows == 0:
+                continue
+            if self.fraction >= 1.0:
+                yield db
+                offset = offset + jnp.asarray(db.num_rows, jnp.int64)
+                continue
+            cap = db.capacity
+            if db.sel is not None:
+                # lazy selection: live rows are sel-True, their global
+                # position is the running count of earlier True lanes
+                live = db.sel
+                pos = jnp.cumsum(live.astype(jnp.int64)) - 1
+            else:
+                live = live_mask(cap, jnp.asarray(db.num_rows))
+                pos = jnp.arange(cap, dtype=jnp.int64)
+            idx32 = (offset + pos).astype(jnp.uint32)
+            keep = live & (sample_hash_u32(idx32, self.seed)
+                           < jnp.uint32(threshold))
+            offset = offset + jnp.asarray(db.num_rows, jnp.int64)
+            yield compact_batch(db, keep, ctx.conf)
+
+    def describe(self):
+        return f"SampleExec[{self.fraction}, seed={self.seed}]"
+
+
 class HashAggregateExec(PlanNode):
     """GpuHashAggregateExec (GpuAggregateExec.scala:1711): streaming partial
     aggregation per batch, concat+merge regroup, final projection."""

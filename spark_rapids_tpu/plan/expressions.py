@@ -1234,12 +1234,76 @@ class CaseWhen(Expression):
             self.dtype = self.children[-1].dtype if self.has_else else t.NULL
 
     def unsupported_reasons(self, conf):
-        if isinstance(self.dtype, t.StringType):
-            return ["string-valued case/when not yet on device"]
         return []
+
+    def _value_slots(self):
+        """Indices of the branch-value (and else) children."""
+        out = [2 * i + 1 for i in range(self.n_branches)]
+        if self.has_else:
+            out.append(len(self.children) - 1)
+        return out
+
+    def _prepare(self, pctx, kids):
+        """String CASE: unify the branch-value dictionaries on host (the
+        engine's string convention — eval-time code remaps ride the aux
+        channel, the output dictionary rides HostVal, exactly as In and
+        concat do)."""
+        if not isinstance(self.dtype, t.StringType):
+            return HostVal()
+        from ..ops.batch_ops import unify_dictionaries
+        slots = self._value_slots()
+        for i in slots:
+            e, v = self.children[i], kids[i]
+            if v.dictionary is None and \
+                    not isinstance(e.dtype, t.NullType) and \
+                    not (isinstance(e, Literal) and e.value is None):
+                raise TypeError(
+                    "device CASE over a dictionary-less string value")
+        unified, remaps = unify_dictionaries(
+            [kids[i].dictionary for i in slots])
+        for r in remaps:
+            pctx.add(self, r.astype(np.int32))
+        if len(unified) == 0:
+            # all-null result: codes never read where invalid, but the
+            # dictionary must stay indexable
+            unified = pa.array([""], pa.string())
+        return HostVal(unified)
+
+    def _eval_dev_string(self, ctx, kids):
+        """String CASE on device: branch values are dict-encoded, so the
+        result is their codes remapped into ONE unified dictionary and
+        selected per row (the hierarchy-masking shape rollup/grouping
+        queries project — CASE WHEN grouping(c)=0 THEN c END)."""
+        from ..ops.kernels import valid_or_true
+        cap = ctx.capacity
+        vals = [kids[i] for i in self._value_slots()]
+        tables = ctx.aux_of(self)
+        codes = []
+        for v, table in zip(vals, tables):
+            codes.append(table[jnp.clip(v.data.astype(jnp.int32), 0,
+                                        table.shape[0] - 1)])
+        if self.has_else:
+            data = codes[-1]
+            valid = valid_or_true(vals[-1].validity, cap)
+        else:
+            data = jnp.zeros((cap,), jnp.int32)
+            valid = jnp.zeros((cap,), bool)
+        decided = jnp.zeros((cap,), bool)
+        for i in range(self.n_branches):
+            c, v = kids[2 * i], vals[i]
+            cv = valid_or_true(c.validity, cap)
+            hit = c.data & cv & ~decided
+            data = jnp.where(hit, codes[i], data)
+            valid = jnp.where(hit, valid_or_true(v.validity, cap), valid)
+            decided = decided | hit
+        if not self.has_else:
+            valid = valid & decided
+        return DevVal(data, valid, self.dtype)
 
     def _eval_dev(self, ctx, kids):
         from ..ops.kernels import valid_or_true
+        if isinstance(self.dtype, t.StringType):
+            return self._eval_dev_string(ctx, kids)
         cap = ctx.capacity
         data = jnp.zeros((cap,), compute_dtype(self.dtype))
         valid = jnp.zeros((cap,), bool)

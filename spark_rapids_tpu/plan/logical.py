@@ -233,6 +233,27 @@ class LogicalJoin(LogicalPlan):
         return f"Join[{self.join_type}, keys={len(self.left_keys)}]"
 
 
+class LogicalSample(LogicalPlan):
+    """Bernoulli row sample (reference GpuSampleExec,
+    basicPhysicalOperators.scala:838): each row kept independently with
+    probability `fraction`, decided by a counter-based hash of
+    (seed, global row position) — deterministic for a given seed AND
+    identical on the device and CPU paths."""
+
+    def __init__(self, fraction: float, seed: int, child: LogicalPlan):
+        super().__init__(child)
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"sample fraction {fraction} not in [0, 1]")
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+
+    def _resolve_schema(self):
+        return self.child.schema
+
+    def describe(self):
+        return f"Sample[{self.fraction}, seed={self.seed}]"
+
+
 class LogicalUnion(LogicalPlan):
     def __init__(self, *children: LogicalPlan):
         super().__init__(*children)

@@ -42,7 +42,7 @@ from ..io.text import (CpuTextScanExec, LogicalCsvScan,
 from ..exec.plan import (CoalesceBatchesExec, ExecContext, ExpandExec,
                          FilterExec, GlobalLimitExec, HashAggregateExec,
                          HostScanExec, PlanNode, ProjectExec, RangeExec,
-                         SortExec, UnionExec)
+                         SampleExec, SortExec, UnionExec)
 from . import expressions as E
 from . import logical as L
 from .aggregates import (AggregateFunction, Average, BoolAnd, BoolOr, Count,
@@ -345,6 +345,8 @@ exec_rule(L.LogicalJoin, _COMMON, "hash join")
 exec_rule(L.LogicalUnion, _DEVICE_SIMPLE, "union")
 exec_rule(L.LogicalRange, _DEVICE_SIMPLE, "range generator")
 exec_rule(L.LogicalExpand, _COMMON, "expand (grouping sets)")
+exec_rule(L.LogicalSample, _DEVICE_SIMPLE,
+          "bernoulli sample (counter-based hash, seed-deterministic)")
 exec_rule(L.LogicalWindow, _COMMON,
           "window functions (partition-sorted segmented scans)")
 
@@ -853,6 +855,16 @@ class ExpandMeta(PlanMeta):
                                self._host_child())
 
 
+class SampleMeta(PlanMeta):
+    def to_device(self):
+        return SampleExec(self.node.fraction, self.node.seed,
+                          self._device_child())
+
+    def to_host(self):
+        return H.CpuSampleExec(self.node.fraction, self.node.seed,
+                               self._host_child())
+
+
 class ParquetScanMeta(PlanMeta):
     def tag_self(self):
         if not self.conf.get(ENABLED_FORMATS["parquet"]):
@@ -1130,6 +1142,7 @@ _META_FOR: Dict[type, Type[PlanMeta]] = {
     L.LogicalUnion: UnionMeta,
     L.LogicalRange: RangeMeta,
     L.LogicalExpand: ExpandMeta,
+    L.LogicalSample: SampleMeta,
     L.LogicalWindow: WindowMeta,
     L.LogicalGenerate: GenerateMeta,
     L.LogicalMapInPandas: MapInPandasMeta,
@@ -1595,6 +1608,20 @@ def generate_supported_ops() -> str:
     for cls, rule in sorted(_AGG_RULES.items(), key=lambda kv: kv[0].__name__):
         lines.append(f"| {cls.__name__} | "
                      f"{', '.join(sorted(rule.input_sig.tags))} |")
+    lines += ["", "## TPC-DS tranche status", "",
+              "First tranche of the TPC-DS corpus "
+              "(spark_rapids_tpu/tpcds.py QUERIES); every registered "
+              "query is tier-1 oracle-tested at tiny scale "
+              "(tests/test_tpcds.py) and benchmarked by "
+              "`bench.py --suite tpcds`, which also emits the "
+              "fallback/coverage matrix.", "",
+              "| query | operator shape |", "|---|---|"]
+    from .. import tpcds
+    for name in sorted(tpcds.QUERIES, key=lambda q: int(q[1:])):
+        doc = (tpcds.QUERIES[name].__doc__ or "").strip()
+        para = " ".join(ln.strip()
+                        for ln in doc.split("\n\n")[0].splitlines())
+        lines.append(f"| {name} | {para} |")
     lines.append("")
     return "\n".join(lines)
 

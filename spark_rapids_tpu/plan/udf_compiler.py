@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dis
 import math
+import sys
 from typing import Callable, List, Optional, Sequence
 
 from .. import types as t
@@ -57,6 +58,21 @@ _BINARY = {
     "+": E.Add, "-": E.Subtract, "*": E.Multiply, "/": E.Divide,
     "//": E.IntegralDivide, "%": E.Remainder, "**": E.Pow,
 }
+# CPython 3.11 folded the per-operator opcodes into BINARY_OP; 3.10
+# still emits one opcode per operator (and the INPLACE_ twins for
+# augmented assignment, which on immutable Expression values are the
+# same pure operation).
+_LEGACY_BINOPS = {
+    "BINARY_ADD": "+", "BINARY_SUBTRACT": "-", "BINARY_MULTIPLY": "*",
+    "BINARY_TRUE_DIVIDE": "/", "BINARY_FLOOR_DIVIDE": "//",
+    "BINARY_MODULO": "%", "BINARY_POWER": "**",
+    "INPLACE_ADD": "+", "INPLACE_SUBTRACT": "-", "INPLACE_MULTIPLY": "*",
+    "INPLACE_TRUE_DIVIDE": "/", "INPLACE_FLOOR_DIVIDE": "//",
+    "INPLACE_MODULO": "%", "INPLACE_POWER": "**",
+}
+# 3.11+ oparg low bits carry push-NULL flags on LOAD_GLOBAL/LOAD_ATTR;
+# on 3.10 the arg is a plain name index and must not be bit-tested.
+_PY311 = sys.version_info >= (3, 11)
 _COMPARE = {
     "==": E.EqualTo, "!=": E.NotEqual, "<": E.LessThan,
     "<=": E.LessThanOrEqual, ">": E.GreaterThan, ">=": E.GreaterThanOrEqual,
@@ -164,7 +180,7 @@ class _Compiler:
                     raise UntranslatableUDF(f"returning {v!r}")
                 return v
             elif op == "LOAD_GLOBAL":
-                if ins.arg & 1:           # 3.11+: pushes NULL too
+                if _PY311 and ins.arg & 1:   # 3.11+: pushes NULL too
                     stack.append(_Null())
                 name = ins.argval
                 if name in _GLOBAL_FNS:
@@ -186,9 +202,8 @@ class _Compiler:
                         obj.name == "__module_math__":
                     if name in _MATH_FNS:
                         stack.append(_Callable(f"math.{name}"))
-                        if op == "LOAD_ATTR" and not (ins.arg & 1):
-                            pass
-                        else:
+                        if _PY311 and not (op == "LOAD_ATTR"
+                                           and not (ins.arg & 1)):
                             stack.append(_Null())
                     elif name in _MATH_CONSTS:
                         stack.append(E.Literal(_MATH_CONSTS[name]))
@@ -196,7 +211,7 @@ class _Compiler:
                         raise UntranslatableUDF(f"math.{name}")
                 elif isinstance(obj, E.Expression) and name in _STR_METHODS:
                     stack.append(_Callable(name, self_expr=obj))
-                    if op == "LOAD_ATTR" and (ins.arg & 1):
+                    if _PY311 and op == "LOAD_ATTR" and (ins.arg & 1):
                         stack.append(_Null())
                 else:
                     raise UntranslatableUDF(f"attribute {name!r}")
@@ -220,6 +235,17 @@ class _Compiler:
                 if frame:                  # bound self pushed after fn
                     args = frame[::-1] + args
                 stack.append(self._call(fn, args))
+            elif op in ("CALL_FUNCTION", "CALL_METHOD"):
+                # 3.10 call shape: [callable, (NULL,) args...]; the
+                # bound self (string methods) lives inside _Callable
+                n = ins.arg
+                args = stack[len(stack) - n:]
+                del stack[len(stack) - n:]
+                while stack and isinstance(stack[-1], _Null):
+                    stack.pop()
+                if not stack or not isinstance(stack[-1], _Callable):
+                    raise UntranslatableUDF("call of non-callable")
+                stack.append(self._call(stack.pop(), args))
             elif op == "BINARY_OP":
                 rhs, lhs = stack.pop(), stack.pop()
                 sym = ins.argrepr.rstrip("=")
@@ -230,6 +256,9 @@ class _Compiler:
                 if cls is None:
                     raise UntranslatableUDF(f"operator {ins.argrepr!r}")
                 stack.append(cls(lhs, rhs))
+            elif op in _LEGACY_BINOPS:
+                rhs, lhs = stack.pop(), stack.pop()
+                stack.append(_BINARY[_LEGACY_BINOPS[op]](lhs, rhs))
             elif op == "COMPARE_OP":
                 rhs, lhs = stack.pop(), stack.pop()
                 sym = ins.argval if isinstance(ins.argval, str) \
@@ -261,18 +290,50 @@ class _Compiler:
                 stack.pop()
             elif op == "COPY":
                 stack.append(stack[-ins.arg])
+            elif op == "DUP_TOP":
+                stack.append(stack[-1])
             elif op == "SWAP":
                 stack[-1], stack[-ins.arg] = stack[-ins.arg], stack[-1]
+            elif op == "ROT_TWO":
+                stack[-1], stack[-2] = stack[-2], stack[-1]
+            elif op == "ROT_THREE":
+                stack[-1], stack[-2], stack[-3] = \
+                    stack[-2], stack[-3], stack[-1]
             elif op in ("JUMP_FORWARD", "JUMP_BACKWARD_NO_INTERRUPT"):
                 i = self.by_offset[ins.argval]
                 continue
             elif op == "JUMP_BACKWARD":
                 raise UntranslatableUDF("loops are not translatable")
+            elif op == "JUMP_ABSOLUTE":
+                # 3.10 spells both loop back-edges and if/else merges as
+                # absolute jumps; only the backward ones are loops
+                tgt = self.by_offset[ins.argval]
+                if tgt <= i:
+                    raise UntranslatableUDF("loops are not translatable")
+                i = tgt
+                continue
+            elif op in ("JUMP_IF_TRUE_OR_POP", "JUMP_IF_FALSE_OR_POP"):
+                # 3.10/3.11 and/or in value position: the jump path keeps
+                # the condition as the expression value
+                self.forks += 1
+                if self.forks > _MAX_FORKS:
+                    raise UntranslatableUDF("too many branches")
+                tgt = self.by_offset[ins.argval]
+                if tgt <= i:
+                    raise UntranslatableUDF("loops are not translatable")
+                cond = _as_bool(stack.pop(), self.schema)
+                taken = self._exec(tgt, list(stack) + [cond], dict(lcls))
+                fallthrough = self._exec(i + 1, list(stack), dict(lcls))
+                if op == "JUMP_IF_TRUE_OR_POP":
+                    return E.If(cond, taken, fallthrough)
+                return E.If(cond, fallthrough, taken)
             elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE",
                         "POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
                 self.forks += 1
                 if self.forks > _MAX_FORKS:
                     raise UntranslatableUDF("too many branches")
+                if self.by_offset[ins.argval] <= i:
+                    raise UntranslatableUDF("loops are not translatable")
                 raw = stack.pop()
                 if op.endswith("_NONE"):
                     cond = E.IsNull(raw) if op.endswith("IF_NONE") \

@@ -113,6 +113,48 @@ def plan_program_stats(physical, ctx=None) -> Dict:
             "scatter_op_count": jaxpr_scatter_count(jx)}
 
 
+# ---------------------------------------------------------------------------
+# Compiled-program cache hygiene
+# ---------------------------------------------------------------------------
+# Every engine module memoizes its jitted kernels in module-level *_CACHE
+# dicts, which keep the XLA LoadedExecutables alive for the process
+# lifetime.  A long-lived process that compiles many thousands of
+# distinct programs (the full tier-1 suite now crosses ~8k with the
+# TPC-DS tranche aboard) can exhaust the JIT's executable code space and
+# crash inside XLA.  These helpers let harnesses bound that growth.
+
+def compiled_cache_entries() -> int:
+    """Total entries across every engine *_CACHE module dict."""
+    import sys
+    total = 0
+    for name, mod in list(sys.modules.items()):
+        if not name.startswith("spark_rapids_tpu"):
+            continue
+        for attr, val in list(vars(mod).items()):
+            if attr.endswith("_CACHE") and isinstance(val, dict):
+                total += len(val)
+    return total
+
+
+def clear_compiled_caches() -> int:
+    """Drop every engine *_CACHE dict and jax's own jit caches, freeing
+    the compiled executables they pin.  Returns the number of entries
+    released.  Safe at any quiescent point: kernels recompile (or
+    reload from the persistent cache) on next use."""
+    import sys
+    import jax
+    released = 0
+    for name, mod in list(sys.modules.items()):
+        if not name.startswith("spark_rapids_tpu"):
+            continue
+        for attr, val in list(vars(mod).items()):
+            if attr.endswith("_CACHE") and isinstance(val, dict):
+                released += len(val)
+                val.clear()
+    jax.clear_caches()
+    return released
+
+
 def assert_filter_matches(cond: Expression, data: Dict,
                           conf: TpuConf = DEFAULT_CONF):
     """Device filter vs CPU mask-filter row-set comparison."""

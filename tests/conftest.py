@@ -41,6 +41,32 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; full-tranche bench paths opt out here
+    config.addinivalue_line(
+        "markers", "slow: full-scale suite/bench paths excluded from "
+                   "tier-1 (run explicitly or via bench.py)")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jit_code_memory():
+    """Bound per-process compiled-executable accumulation.
+
+    The engine memoizes every jitted kernel for the process lifetime;
+    the full suite now compiles enough distinct programs (TPC-H +
+    TPC-DS + kernels) to exhaust the JIT's executable code space and
+    segfault inside XLA near the end of a single-process run.  Dropping
+    the caches between modules once accumulation passes a threshold
+    keeps the process far from the cliff; shared kernels re-jit (or
+    reload from the persistent cache) in a few seconds per clear.
+    """
+    yield
+    from spark_rapids_tpu.testing import (clear_compiled_caches,
+                                          compiled_cache_entries)
+    if compiled_cache_entries() > 1200:
+        clear_compiled_caches()
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

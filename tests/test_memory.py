@@ -231,7 +231,10 @@ def test_sort_unlimited_budget_single_pass():
 # ---------------------------------------------------------------------------
 
 def test_agg_high_cardinality_fallback():
-    n = 30_000          # ~30k distinct groups >> 1024-row target batches
+    # distinct groups >> 1024-row target batches (10k keeps the
+    # repartition fallback firing at a third of the old wall cost —
+    # tier-1 must fit its 870s budget with the TPC-DS tranche aboard)
+    n = 10_000
     rng = np.random.default_rng(9)
     keys = rng.permutation(n).astype(np.int64)
     tbl = pa.table({"k": pa.array(keys), "v": pa.array(np.ones(n))})

@@ -1,0 +1,92 @@
+"""SampleExec: deterministic Bernoulli sampling, device vs CPU.
+
+The reference GpuSampleExec (basicPhysicalOperators.scala:838) samples
+with a per-partition RNG; this engine uses a counter-based hash of
+(seed, global row position), so device and CPU fallback keep EXACTLY
+the same rows — assertable with plain equality, no statistical slack.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.session import DataFrame, TpuSession
+
+
+@pytest.fixture(scope="module")
+def table():
+    rng = np.random.default_rng(7)
+    n = 5000
+    return pa.table({
+        "k": pa.array(np.arange(n), pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+        "s": pa.array([f"row{i % 37}" for i in range(n)]),
+    })
+
+
+def cpu_collect(df):
+    s = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    return DataFrame(df._plan, s).collect()
+
+
+def test_sample_deterministic_same_seed(table):
+    s = TpuSession()
+    a = s.from_arrow(table).sample(0.25, seed=123).collect()
+    b = s.from_arrow(table).sample(0.25, seed=123).collect()
+    assert a.to_pydict() == b.to_pydict()
+    assert 0 < a.num_rows < table.num_rows
+
+
+def test_sample_different_seeds_differ(table):
+    s = TpuSession()
+    a = s.from_arrow(table).sample(0.25, seed=1).collect()
+    b = s.from_arrow(table).sample(0.25, seed=2).collect()
+    assert a.to_pydict() != b.to_pydict()
+
+
+def test_sample_device_matches_cpu_exactly(table):
+    s = TpuSession()
+    for frac, seed in ((0.1, 0), (0.5, 99), (0.9, 7)):
+        df = s.from_arrow(table).sample(frac, seed=seed)
+        dev = df.collect()
+        cpu = cpu_collect(df)
+        assert dev.to_pydict() == cpu.to_pydict(), (frac, seed)
+
+
+def test_sample_fraction_bounds(table):
+    s = TpuSession()
+    assert s.from_arrow(table).sample(0.0).collect().num_rows == 0
+    assert s.from_arrow(table).sample(1.0).collect().num_rows == \
+        table.num_rows
+    with pytest.raises(ValueError):
+        s.from_arrow(table).sample(1.5)
+
+
+def test_sample_fraction_statistics(table):
+    """Keep-rate concentrates around the fraction (hash uniformity)."""
+    s = TpuSession()
+    n = s.from_arrow(table).sample(0.3, seed=5).collect().num_rows
+    assert abs(n / table.num_rows - 0.3) < 0.05
+
+
+def test_sample_runs_on_device(table):
+    s = TpuSession()
+    text = s.from_arrow(table).sample(0.5, seed=3).physical().explain()
+    assert "!Exec <Sample>" not in text
+    assert "*Exec <Sample> will run on TPU" in text
+
+
+def test_sample_composes_with_filter_and_agg(table):
+    """Sample above a filter (a sel-vector / lazy-count producer) and
+    below an aggregate — the global row index must follow LIVE rows."""
+    from spark_rapids_tpu.plan import expressions as E
+    from spark_rapids_tpu.plan.aggregates import Count, Sum
+    from spark_rapids_tpu.session import col
+    s = TpuSession()
+    df = (s.from_arrow(table)
+          .filter(E.GreaterThan(col("v"), E.Literal(500)))
+          .sample(0.4, seed=11)
+          .agg((Count(None), "n"), (Sum(col("v")), "sv")))
+    dev = df.collect()
+    cpu = cpu_collect(df)
+    assert dev.to_pydict() == cpu.to_pydict()
+    assert dev.column("n").to_pylist()[0] > 0
