@@ -32,6 +32,12 @@ Methodology.
     total; queries that don't fit are listed in "skipped" rather than
     silently absent.
 
+Every per-query record embeds a "profile" summary from one extra traced
+(untimed) collect — the compile/execute/transition/shuffle wall split,
+top operators by self time, data-movement bytes, memory high-water and
+runtime incidents (obs/profile.py) — so the JSON explains where each
+query's time goes, not just how much there is.
+
 --suite tpcds additionally reports the operator-coverage matrix the
 BASELINE.md staged config #2 asks for: per-query fallback reasons (from
 the overrides tagger), sort_operand_max and scatter_op_count (jaxpr
@@ -114,17 +120,18 @@ def time_warm(fn, iters=3):
     return min(times)
 
 
-def fallback_reasons(meta) -> list:
-    """Every tagger reason in the plan's meta tree (depth-first) — the
-    structured form of the '!Exec ... because ...' explain lines."""
-    out, stack = [], [meta]
-    while stack:
-        m = stack.pop()
-        for r in m.reasons:
-            if r not in out:
-                out.append(r)
-        stack.extend(getattr(m, "children", ()))
-    return out
+def query_profile(q, conf) -> dict:
+    """One traced (untimed) collect -> the compact QueryProfile summary
+    embedded per query, so BENCH_*.json explains its own numbers: the
+    compile/execute/transition/shuffle split, top operators by self
+    time, data-movement bytes and memory high-water.  Runs AFTER the
+    warm timing so span collection can't perturb the headline number."""
+    from spark_rapids_tpu.config import TRACE_ENABLED, TpuConf
+    from spark_rapids_tpu.exec.plan import ExecContext
+    from spark_rapids_tpu.obs.profile import QueryProfile
+    pctx = ExecContext(TpuConf({**conf._raw, TRACE_ENABLED.key: "true"}))
+    q.collect(pctx)
+    return QueryProfile.from_context(pctx).summary()
 
 
 class Suite:
@@ -254,6 +261,13 @@ def run_suite(suite_name: str, scale: float, query_names):
             except Exception:                # noqa: BLE001
                 pstats = {"sort_operand_max": None,
                           "scatter_op_count": None}
+            # the traced profile run is untimed and budget-gated: its
+            # absence loses explanation, never measurement
+            try:
+                profile = query_profile(q, dev.conf) if left() > 30 \
+                    else None
+            except Exception as e:           # noqa: BLE001
+                profile = {"error": f"{type(e).__name__}: {e}"[:200]}
             match = approx_equal(out, oracle)
             suite.per_q[name] = {"device_ms": round(dt * 1e3, 1),
                                  "cpu_ms": round(ct * 1e3, 1),
@@ -262,7 +276,8 @@ def run_suite(suite_name: str, scale: float, query_names):
                                  "compiled": bool(compiled),
                                  "match": match,
                                  "fallback_reasons":
-                                     fallback_reasons(q.meta), **pstats}
+                                     q.fallback_reasons(),
+                                 "profile": profile, **pstats}
             print(f"# {name}: device={dt*1e3:.0f}ms cpu={ct*1e3:.0f}ms "
                   f"x{ct/dt:.2f} cold={cold_s:.1f}s "
                   f"compiled={bool(compiled)} match={match}",

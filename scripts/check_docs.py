@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Docs lint: every public `conf(...)` entry must appear in docs/configs.md.
+
+The config registry is the source of truth (config.py `_REGISTRY`, plus
+the entries modules register at import — runtime/failure.py); docs are
+generated (`python -m spark_rapids_tpu.config`) but can silently drift
+when a knob lands without a regen.  This lint fails on any non-internal
+key missing from docs/configs.md, and runs in tier-1 via
+tests/test_tracing.py so new knobs can't ship undocumented.
+
+Usage:
+    python scripts/check_docs.py          # exit 1 + list when stale
+"""
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def missing_keys() -> list:
+    """Non-internal registered conf keys absent from docs/configs.md."""
+    from spark_rapids_tpu import config
+    # modules that register conf entries at import time must be imported
+    # so the registry is complete (same set as config.__main__)
+    from spark_rapids_tpu.runtime import failure  # noqa: F401
+    doc = open(os.path.join(_ROOT, "docs", "configs.md")).read()
+    return [e.key for e in config.all_entries()
+            if not e.internal and f"`{e.key}`" not in doc]
+
+
+def main() -> int:
+    missing = missing_keys()
+    if missing:
+        print("docs/configs.md is missing documented conf entries "
+              "(run `python -m spark_rapids_tpu.config` to regenerate):")
+        for k in missing:
+            print(f"  {k}")
+        return 1
+    print("docs/configs.md covers every public conf entry")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Offline query-profile reports from JSONL event logs — the RAPIDS
+profiling-tool analogue (SURVEY §5).
+
+Input: one `query_<id>.jsonl` written under `spark.rapids.tpu.eventLog.dir`,
+or a directory of them.  For each log it renders the QueryProfile: the
+compile/execute/transition/shuffle wall split, the per-node-id operator
+table (top operators by self time), data-movement bytes, memory
+high-water, runtime incidents (OOM retries / splits / spills) and the
+fallback summary.  The sibling `query_<id>.trace.json` opens directly in
+perfetto (https://ui.perfetto.dev) or chrome://tracing.
+
+Usage:
+    python scripts/profile_report.py <event_log.jsonl | dir> [--json]
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log_paths(target: str) -> list:
+    if os.path.isdir(target):
+        paths = sorted(glob.glob(os.path.join(target, "*.jsonl")))
+        if not paths:
+            raise SystemExit(f"no *.jsonl event logs under {target}")
+        return paths
+    if not os.path.exists(target):
+        raise SystemExit(f"no such file: {target}")
+    return [target]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("target", help="event-log .jsonl file or directory")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full profile dict as JSON instead of "
+                         "the text report")
+    args = ap.parse_args(argv)
+
+    from spark_rapids_tpu.obs.profile import QueryProfile
+
+    for path in log_paths(args.target):
+        prof = QueryProfile.from_event_log(path)
+        if args.json:
+            print(json.dumps({"log": path, **prof.to_dict()}))
+        else:
+            print(f"### {path}")
+            print(prof.render())
+            trace = path.removesuffix(".jsonl") + ".trace.json"
+            if os.path.exists(trace):
+                print(f"perfetto trace: {trace}")
+            print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

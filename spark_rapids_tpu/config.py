@@ -335,6 +335,23 @@ PROFILE_PATH = conf(
     "this directory (the NVTX/CUPTI Profiler analogue; open in "
     "XProf/perfetto).")
 
+TRACE_ENABLED = conf(
+    "spark.rapids.tpu.trace.enabled", False,
+    "Collect query-lifecycle spans in memory (plan/compile/execute/"
+    "transition/shuffle ranges, runtime incident events, data-movement "
+    "counters) for TpuSession.last_query_profile() / DataFrame.metrics() "
+    "without writing files. Off by default; the disabled path is a "
+    "no-op tracer (obs/tracer.py).", commonly_used=True)
+
+EVENT_LOG_DIR = conf(
+    "spark.rapids.tpu.eventLog.dir", "",
+    "When set, every query writes a structured JSONL event log "
+    "(query_<id>.jsonl — the Spark history-server event-log analogue) "
+    "and a Chrome trace-event JSON (query_<id>.trace.json, openable in "
+    "perfetto — the NVTX/nsys analogue) into this directory. Implies "
+    "span tracing for the query. Render reports with "
+    "scripts/profile_report.py.", commonly_used=True)
+
 RESULT_HEAD_ROWS = conf(
     "spark.rapids.tpu.sql.fetch.headRows", 4096,
     "Result-fetch head size: one speculative round trip ships the row "
@@ -585,8 +602,14 @@ def all_entries() -> List[ConfEntry]:
 
 if __name__ == "__main__":
     import pathlib
-    from .runtime import failure as _failure   # registers its conf entries
+    # regenerate through the CANONICAL module: running `-m ...config`
+    # executes this file as __main__ with its own empty _REGISTRY, while
+    # imported modules (runtime/failure.py) register their entries into
+    # the sys.modules copy — generating from __main__'s registry would
+    # silently drop them (scripts/check_docs.py guards this)
+    from spark_rapids_tpu import config as _cfg
+    from spark_rapids_tpu.runtime import failure as _failure  # noqa: F401
     out = pathlib.Path(__file__).resolve().parent.parent / "docs"
     out.mkdir(exist_ok=True)
-    (out / "configs.md").write_text(generate_docs())
+    (out / "configs.md").write_text(_cfg.generate_docs())
     print(f"wrote {out / 'configs.md'}")

@@ -210,9 +210,13 @@ class CompiledPlan:
                 continue
             cached = getattr(node, "_device_cache", None)
             if cached is None:
-                cached = _shared_scan_upload(node, ctx.conf)
-                if self.mesh is not None:
-                    cached = [_shard_batch(db, self.mesh) for db in cached]
+                with ctx.tracer.span("upload", "transition"):
+                    cached = _shared_scan_upload(node, ctx.conf)
+                    if self.mesh is not None:
+                        cached = [_shard_batch(db, self.mesh)
+                                  for db in cached]
+                ctx.tracer.add_bytes(
+                    "h2d_bytes", sum(hb.rb.nbytes for hb in node.batches))
                 node._device_cache = cached
             pairs.append((node, cached))
         return pairs
@@ -284,15 +288,25 @@ class CompiledPlan:
         flat_in, in_specs = self._flatten_inputs(pairs)
 
         if self._compiled is None:
+            import time as _time
             self._input_specs = [(n, list(s)) for n, s in in_specs]
             out_holder: Dict[str, list] = {}
-            compiled = jax.jit(self._make_runner(in_specs, ctx,
-                                                 out_holder))
-            flat_res = compiled(flat_in)         # traces on first call
+            t0 = _time.perf_counter()
+            with ctx.tracer.span("trace+compile", "compile",
+                                 root=self.root.name()):
+                compiled = jax.jit(self._make_runner(in_specs, ctx,
+                                                     out_holder))
+                flat_res = compiled(flat_in)     # traces on first call
+            ctx.metrics["compile_ms"] = ctx.metrics.get(
+                "compile_ms", 0.0) + (_time.perf_counter() - t0) * 1000.0
+            ctx.bump("compile_cache_misses")
             self._out_specs = out_holder["specs"]
             self._compiled = compiled
         else:
-            flat_res = self._compiled(flat_in)
+            ctx.bump("compile_cache_hits")
+            with ctx.tracer.span("execute", "execute",
+                                 root=self.root.name()):
+                flat_res = self._compiled(flat_in)
 
         outs = []
         i = 0
@@ -306,7 +320,13 @@ class CompiledPlan:
         from ..columnar.host import struct_to_schema
         outs = self.execute(ctx)
         bound = self.root.row_upper_bound()
-        hbs = [fetch_result_batch(db, bound, ctx.conf) for db in outs]
+        hbs = []
+        for db in outs:
+            with ctx.tracer.span("fetch", "transition"):
+                hb = fetch_result_batch(db, bound, ctx.conf)
+            ctx.bump("d2h_rows", hb.num_rows)
+            ctx.tracer.add_bytes("d2h_bytes", hb.rb.nbytes)
+            hbs.append(hb)
         batches = [hb.rb for hb in hbs if hb.num_rows > 0]
         if not batches:
             return pa.Table.from_batches(
@@ -600,14 +620,18 @@ def collect_with_fallback(root: PlanNode, ctx: ExecContext,
         holder._compiled_plan = plan
         ctx.bump("whole_plan_compiled_queries")
         return out
-    except _TRACE_FALLBACK_ERRORS:
+    except _TRACE_FALLBACK_ERRORS as e:
         holder._compiled_plan = False
         ctx.bump("whole_plan_fallbacks")
+        ctx.tracer.instant("whole_plan_fallback", "runtime",
+                           reason=type(e).__name__)
         return None
     except Exception as e:               # noqa: BLE001
         from ..runtime.memory import is_oom_error
         ctx.bump("whole_plan_fallbacks")
         if is_oom_error(e):
+            ctx.tracer.instant("whole_plan_fallback", "runtime",
+                               reason="device_oom")
             return None                  # eager engine has spill/retry;
                                          # compiled stays eligible
         holder._compiled_plan = False

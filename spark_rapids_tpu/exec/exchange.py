@@ -61,9 +61,16 @@ class ShuffleExchangeExec(PlanNode):
             if int(db.num_rows) == 0:
                 continue
             ids = self.partitioning.partition_ids(db, ctx.conf)
-            hb = to_host(db)
-            mgr.write_batch(sid, hb, ids, n, codec)
+            with ctx.tracer.span("shuffle_fetch", "transition",
+                                 node=getattr(self, "_node_id", None)):
+                hb = to_host(db)
+            ctx.tracer.add_bytes("d2h_bytes", hb.rb.nbytes)
+            with ctx.tracer.span("shuffle_write", "shuffle",
+                                 node=getattr(self, "_node_id", None)):
+                nbytes = mgr.write_batch(sid, hb, ids, n, codec)
             ctx.bump("shuffle_rows_written", int(db.num_rows))
+            ctx.bump("shuffle_bytes_written", nbytes)
+            ctx.tracer.add_bytes("shuffle_bytes_written", nbytes)
         self.shuffle_id = sid
         return sid
 
@@ -118,11 +125,17 @@ class ShuffleReadExec(PlanNode):
         for unit in self.partitions:
             # a unit is a whole partition id or a (partition, block_lo,
             # block_hi) skew sub-read (plan_coalesced_reads)
-            if isinstance(unit, tuple):
-                p, lo, hi = unit
-                rbs = mgr.read_partition(sid, p, block_range=(lo, hi))
-            else:
-                rbs = mgr.read_partition(sid, unit)
+            with ctx.tracer.span("shuffle_read", "shuffle",
+                                 node=getattr(self, "_node_id", None)):
+                if isinstance(unit, tuple):
+                    p, lo, hi = unit
+                    rbs = mgr.read_partition(sid, p, block_range=(lo, hi))
+                    nbytes = sum(mgr.block_sizes(sid, p)[lo:hi])
+                else:
+                    rbs = mgr.read_partition(sid, unit)
+                    nbytes = sum(mgr.block_sizes(sid, unit))
+            ctx.bump("shuffle_bytes_read", nbytes)
+            ctx.tracer.add_bytes("shuffle_bytes_read", nbytes)
             for rb in rbs:
                 if rb.num_rows == 0:
                     continue
@@ -141,7 +154,10 @@ class ShuffleReadExec(PlanNode):
                            {n: [] for n in tbl.schema.names},
                            schema=tbl.schema))
         ctx.bump("shuffle_rows_read", hb.num_rows)
-        return to_device(hb, ctx.conf)
+        ctx.tracer.add_bytes("h2d_bytes", hb.rb.nbytes)
+        with ctx.tracer.span("upload", "transition",
+                             node=getattr(self, "_node_id", None)):
+            return to_device(hb, ctx.conf)
 
     def describe(self):
         return f"ShuffleReadExec[{len(self.partitions)} parts]"

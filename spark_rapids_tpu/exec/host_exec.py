@@ -122,7 +122,11 @@ class HostToDeviceExec(PlanNode):
                 if rb.num_rows and sl.num_rows == 0:
                     continue
                 ctx.bump("h2d_rows", sl.num_rows)
-                yield to_device(HostBatch(sl), ctx.conf)
+                ctx.tracer.add_bytes("h2d_bytes", sl.nbytes)
+                with ctx.tracer.span("upload", "transition",
+                                     node=getattr(self, "_node_id", None)):
+                    db = to_device(HostBatch(sl), ctx.conf)
+                yield db
 
     def tree_string(self, indent: int = 0) -> str:
         lines = ["  " * indent + "HostToDeviceExec"]
@@ -146,7 +150,11 @@ class DeviceToHostExec(HostNode):
             if int(db.num_rows) == 0:
                 continue
             ctx.bump("d2h_rows", int(db.num_rows))
-            yield to_host(db).rb
+            with ctx.tracer.span("fetch", "transition",
+                                 node=getattr(self, "_node_id", None)):
+                rb = to_host(db).rb
+            ctx.tracer.add_bytes("d2h_bytes", rb.nbytes)
+            yield rb
 
     def tree_string(self, indent: int = 0) -> str:
         lines = ["  " * indent + "DeviceToHostExec"]

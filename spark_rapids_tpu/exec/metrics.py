@@ -6,9 +6,17 @@ Reference: GpuExec declares metric sets surfaced in the Spark UI
 semaphore-wait / spill / retry accumulators, and NVTX ranges mark
 operator spans for nsys (NvtxWithMetrics.scala).
 
-TPU shape: `instrument(root, ctx)` wraps every PlanNode/HostNode execute
-stream with wall-time + row counters keyed `<ExecName>.op_time_ms` /
-`.output_rows` in ctx.metrics (enabled at metrics level >= OPERATOR), and
+TPU shape: `instrument(root, ctx)` assigns every PlanNode/HostNode a
+STABLE node id (`<ExecName>#<preorder>` — two `HashAggregateExec`s in one
+plan keep separate counters instead of merging by class name) and wraps
+its execute stream with wall-time + row + batch counters, keyed both
+per-node-id (`HashAggregateExec#3.op_time_ms`) and aggregated per class
+(`HashAggregateExec.op_time_ms`, the pre-node-id compatible keys).
+Row counts accumulate LAZILY — a device-scalar num_rows folds into the
+running device sum instead of being skipped — and coerce in the one
+batched fetch at query end (plan/overrides.py), so lazy-count operators
+no longer silently under-report.  Each operator also reports one span
+(cat=operator) to the query tracer (obs/tracer.py) when tracing is on.
 `profile_trace(conf)` wraps a query in a jax-profiler trace (the
 NVTX/CUPTI analogue — open the trace in XProf/perfetto) when
 `spark.rapids.tpu.profile.path` is set."""
@@ -18,20 +26,76 @@ import time
 from contextlib import contextmanager, nullcontext
 
 from ..config import METRICS_LEVEL, PROFILE_PATH, TpuConf
+from ..obs.tracer import NULL_TRACER
+
+
+def _child_nodes(node):
+    for c in getattr(node, "children", []):
+        yield c
+    for attr in ("host_child", "device_child"):
+        c = getattr(node, attr, None)
+        if c is not None:
+            yield c
+
+
+def assign_node_ids(root) -> None:
+    """Preorder `<ExecName>#<i>` ids over the physical tree (device and
+    host nodes).  Stable for a given plan shape; idempotent."""
+    if getattr(root, "_node_id", None) is not None:
+        return
+    i = 0
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        if getattr(n, "_node_id", None) is None:
+            n._node_id = f"{type(n).__name__}#{i}"
+            i += 1
+        # preorder: children pushed reversed so left-most pops first
+        stack.extend(reversed(list(_child_nodes(n))))
+
+
+def plan_node_table(root) -> list:
+    """[{id, name, parent}] rows for the profile's self-time computation
+    (QueryProfile.operators) — requires assign_node_ids first."""
+    out = []
+
+    def walk(n, parent):
+        nid = getattr(n, "_node_id", None)
+        out.append({"id": nid, "name": type(n).__name__,
+                    "parent": parent})
+        for c in _child_nodes(n):
+            walk(c, nid)
+    walk(root, None)
+    return out
+
+
+def _bump(metrics: dict, key: str, v):
+    metrics[key] = metrics.get(key, 0) + v
 
 
 def instrument(node, ctx) -> None:
     """Wrap the execute() of every node in the tree (device and host)
-    with op-time and output-row metrics.  Idempotent per node object."""
+    with op-time / row / batch metrics.  Idempotent per node object."""
+    assign_node_ids(node)
+    tr = getattr(ctx, "tracer", NULL_TRACER)
+    if getattr(tr, "enabled", False):
+        tr.meta.setdefault("plan_nodes", plan_node_table(node))
+    _instrument_node(node, ctx)
+
+
+def _instrument_node(node, ctx) -> None:
     if getattr(node, "_metered", False):
         return
     node._metered = True
     name = type(node).__name__
+    nid = node._node_id
     inner = node.execute
 
     def metered(c):
         t0 = time.perf_counter()
         rows = 0
+        batches = 0
+        op_ms = 0.0
         try:
             it = inner(c)
             while True:
@@ -41,31 +105,30 @@ def instrument(node, ctx) -> None:
                 except StopIteration:
                     return
                 finally:
-                    c.metrics[f"{name}.op_time_ms"] = c.metrics.get(
-                        f"{name}.op_time_ms", 0.0) + \
-                        (time.perf_counter() - t1) * 1000.0
+                    op_ms += (time.perf_counter() - t1) * 1000.0
+                batches += 1
                 n = getattr(out, "num_rows", None)
                 if n is not None:
-                    try:
-                        rows += int(n)
-                    except Exception:       # lazy device count: skip sync
-                        pass
+                    # a lazy device count folds into the running (device)
+                    # sum — no sync here, ONE batched fetch at query end
+                    rows = rows + n
                 yield out
         finally:
-            c.metrics[f"{name}.total_time_ms"] = c.metrics.get(
-                f"{name}.total_time_ms", 0.0) + \
-                (time.perf_counter() - t0) * 1000.0
-            c.metrics[f"{name}.output_rows"] = c.metrics.get(
-                f"{name}.output_rows", 0) + rows
+            total_ms = (time.perf_counter() - t0) * 1000.0
+            m = c.metrics
+            for key in (nid, name):     # per-node-id + class aggregate
+                _bump(m, f"{key}.op_time_ms", op_ms)
+                _bump(m, f"{key}.total_time_ms", total_ms)
+                _bump(m, f"{key}.output_rows", rows)
+                _bump(m, f"{key}.output_batches", batches)
+            tr = getattr(c, "tracer", NULL_TRACER)
+            tr.add_span(name, "operator",
+                        t0, t0 + total_ms / 1e3, node=nid,
+                        op_time_ms=round(op_ms, 3), output_batches=batches)
 
     node.execute = metered
-    for attr in ("children",):
-        for c in getattr(node, attr, []):
-            instrument(c, ctx)
-    for attr in ("host_child", "device_child"):
-        c = getattr(node, attr, None)
-        if c is not None:
-            instrument(c, ctx)
+    for c in _child_nodes(node):
+        _instrument_node(c, ctx)
 
 
 def should_instrument(conf: TpuConf) -> bool:

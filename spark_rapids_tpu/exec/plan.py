@@ -40,6 +40,14 @@ class ExecContext:
     conf: TpuConf = DEFAULT_CONF
     metrics: dict = dataclasses.field(default_factory=dict)
     _budget: object = None
+    # query-lifecycle span tracer (obs/tracer.py); NULL when tracing is
+    # off so record calls cost one no-op method dispatch
+    tracer: object = None
+
+    def __post_init__(self):
+        if self.tracer is None:
+            from ..obs.tracer import NULL_TRACER
+            self.tracer = NULL_TRACER
 
     @property
     def budget(self):
@@ -137,7 +145,11 @@ class PlanNode:
         for db in self.execute(ctx):
             if isinstance(db.num_rows, int) and db.num_rows == 0:
                 continue
-            hbs.append(fetch_result_batch(db, bound, ctx.conf))
+            with ctx.tracer.span("fetch", "transition"):
+                hb = fetch_result_batch(db, bound, ctx.conf)
+            ctx.bump("d2h_rows", hb.num_rows)
+            ctx.tracer.add_bytes("d2h_bytes", hb.rb.nbytes)
+            hbs.append(hb)
         schema = None
         batches = []
         for hb in hbs:
@@ -200,7 +212,11 @@ class HostScanExec(PlanNode):
             return
         for hb in self.batches:
             ctx.bump("scanned_rows", hb.num_rows)
-            yield to_device(hb, ctx.conf)
+            with ctx.tracer.span("upload", "transition"):
+                db = to_device(hb, ctx.conf)
+            ctx.bump("h2d_rows", hb.num_rows)
+            ctx.tracer.add_bytes("h2d_bytes", hb.rb.nbytes)
+            yield db
 
     def describe(self):
         return f"HostScanExec[{len(self.batches)} batches]"

@@ -1,0 +1,28 @@
+"""Query-lifecycle observability: span tracer, event log, profiles.
+
+The reference surfaces behavior through three channels — per-operator
+`GpuMetric` sets in the Spark UI, `GpuTaskMetrics` accumulators
+(semaphore-wait / spill / retry), and NVTX ranges consumed by nsys plus
+the offline profiling tool (SURVEY §5).  This package is the TPU-native
+consolidation of all three:
+
+  tracer.py  — `QueryTracer` span/event collection threaded through the
+               whole lifecycle (plan, compile, execute, transitions,
+               shuffle, runtime events), serialized as a per-query JSONL
+               event log (`spark.rapids.tpu.eventLog.dir`, the
+               history-server event-log analogue) and a Chrome
+               trace-event JSON openable in perfetto (the NVTX/nsys
+               analogue).
+  profile.py — `QueryProfile` aggregate over the spans + metrics: the
+               compile/execute/transition/shuffle wall split, the
+               per-node-id operator table, fallback summary and memory
+               high-water (the offline profiling-tool analogue;
+               `scripts/profile_report.py` is its CLI).
+"""
+from .tracer import (NULL_TRACER, EventLog, QueryTracer, Span, get_active,
+                     make_tracer, read_event_log, set_active)
+from .profile import QueryProfile
+
+__all__ = ["NULL_TRACER", "EventLog", "QueryTracer", "QueryProfile",
+           "Span", "get_active", "make_tracer", "read_event_log",
+           "set_active"]

@@ -1,0 +1,259 @@
+"""QueryProfile: the offline profiling-tool aggregate over raw spans.
+
+Reference: the RAPIDS Accelerator ships a profiling tool that replays
+Spark event logs into per-SQL operator/time breakdowns (SURVEY §5).
+`QueryProfile` is that aggregate for one query: the
+compile/execute/transition/shuffle wall-time split, a per-node-id
+operator table (two `HashAggregateExec`s stay two rows), the fallback
+summary, data-movement counters and the memory high-water.  Build it
+from a live ExecContext (`from_context`) or a written event log
+(`from_event_log`); `scripts/profile_report.py` renders it from disk,
+`bench.py` embeds `summary()` per query.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from .tracer import EventLog, NULL_TRACER, QueryTracer, read_event_log
+
+#: metric keys of the per-node-id operator counters (exec/metrics.py)
+_NODE_METRIC_RE = re.compile(
+    r"^(?P<name>\w+)#(?P<nid>\d+)\.(?P<field>op_time_ms|total_time_ms|"
+    r"output_rows|output_batches)$")
+
+#: span categories that are measured directly; "execute" is the residual
+_SPLIT_CATS = ("compile", "transition", "shuffle")
+
+
+def _union_ms(ivals: List[tuple]) -> float:
+    """Total covered milliseconds of possibly-overlapping intervals."""
+    if not ivals:
+        return 0.0
+    ivals = sorted(ivals)
+    total, lo, hi = 0.0, ivals[0][0], ivals[0][1]
+    for a, b in ivals[1:]:
+        if a > hi:
+            total += hi - lo
+            lo, hi = a, b
+        else:
+            hi = max(hi, b)
+    total += hi - lo
+    return total * 1000.0
+
+
+class QueryProfile:
+    def __init__(self, spans, events, counters, metrics, meta):
+        self.spans = list(spans)
+        self.events = list(events)
+        self.counters = dict(counters)
+        self.metrics = dict(metrics or {})
+        self.meta = dict(meta or {})
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_context(cls, ctx) -> "QueryProfile":
+        """From a collected query's ExecContext (tracer may be NULL —
+        the metrics-only tables still populate)."""
+        tr = getattr(ctx, "tracer", NULL_TRACER)
+        if isinstance(tr, QueryTracer):
+            return cls(tr.spans, tr.events, tr.counters,
+                       tr.metrics if tr.metrics is not None
+                       else ctx.metrics, tr.meta)
+        return cls([], [], {}, ctx.metrics, {})
+
+    @classmethod
+    def from_event_log(cls, path_or_log) -> "QueryProfile":
+        log = path_or_log if isinstance(path_or_log, EventLog) \
+            else read_event_log(path_or_log)
+        return cls(log.spans, log.events, log.counters, log.metrics,
+                   log.meta)
+
+    # -- aggregates --------------------------------------------------------
+    def wall_ms(self) -> float:
+        roots = [s for s in self.spans if s.cat == "query"]
+        if roots:
+            return sum(s.dur_ms for s in roots)
+        if self.spans:
+            return (max(s.t1 for s in self.spans) -
+                    min(s.t0 for s in self.spans)) * 1000.0
+        return 0.0
+
+    def time_split(self) -> Dict[str, float]:
+        """compile / execute / transition / shuffle / plan split.
+
+        compile, transition and shuffle sum their spans' interval UNION
+        clipped to the query span (nested same-cat spans never double
+        count); execute is the residual query wall.  plan covers the
+        wrap/tag/convert phases, which run before the query span."""
+        roots = [s for s in self.spans if s.cat == "query"]
+        q0 = min((s.t0 for s in roots), default=None)
+        q1 = max((s.t1 for s in roots), default=None)
+        out = {"wall_ms": round(self.wall_ms(), 3),
+               "plan_ms": round(sum(s.dur_ms for s in self.spans
+                                    if s.cat == "plan"), 3)}
+        covered = []
+        for cat in _SPLIT_CATS:
+            ivals = []
+            for s in self.spans:
+                if s.cat != cat:
+                    continue
+                t0, t1 = s.t0, s.t1
+                if q0 is not None:
+                    t0, t1 = max(t0, q0), min(t1, q1)
+                if t1 > t0:
+                    ivals.append((t0, t1))
+            out[f"{cat}_ms"] = round(_union_ms(ivals), 3)
+            covered.extend(ivals)
+        out["execute_ms"] = round(
+            max(0.0, out["wall_ms"] - _union_ms(covered)), 3)
+        return out
+
+    def operators(self) -> List[Dict[str, Any]]:
+        """Per-node-id operator table from the instrumented metrics,
+        sorted by self time (total minus children) descending."""
+        rows: Dict[str, Dict[str, Any]] = {}
+        for k, v in self.metrics.items():
+            m = _NODE_METRIC_RE.match(k)
+            if not m:
+                continue
+            node = f"{m.group('name')}#{m.group('nid')}"
+            row = rows.setdefault(node, {"node": node,
+                                         "name": m.group("name"),
+                                         "nid": int(m.group("nid"))})
+            row[m.group("field")] = v
+        children: Dict[Optional[str], List[str]] = {}
+        for n in self.meta.get("plan_nodes", []):
+            children.setdefault(n.get("parent"), []).append(n["id"])
+
+        def measured_descendants_ms(node: str) -> float:
+            """Totals of the nearest MEASURED descendants — skipping
+            through unmeasured nodes (fused filters, pass-throughs whose
+            metered execute never ran) so their children still subtract
+            from this operator's self time."""
+            total = 0.0
+            stack = list(children.get(node, []))
+            while stack:
+                c = stack.pop()
+                if c in rows:
+                    total += float(rows[c].get("total_time_ms", 0.0))
+                else:
+                    stack.extend(children.get(c, []))
+            return total
+
+        for node, row in rows.items():
+            total = float(row.get("total_time_ms", 0.0))
+            sub = measured_descendants_ms(node) if children else 0.0
+            row["self_time_ms"] = round(max(0.0, total - sub), 3)
+        return sorted(rows.values(),
+                      key=lambda r: (-r["self_time_ms"], r["nid"]))
+
+    def fallbacks(self) -> List[str]:
+        return list(self.meta.get("fallbacks", []))
+
+    def compile_stats(self) -> Dict[str, Any]:
+        return {
+            "cache_misses": int(self.metrics.get("compile_cache_misses",
+                                                 0)),
+            "cache_hits": int(self.metrics.get("compile_cache_hits", 0)),
+            "compile_ms": round(float(self.metrics.get("compile_ms",
+                                                       0.0)), 3),
+        }
+
+    def data_movement(self) -> Dict[str, int]:
+        keys = ("h2d_bytes", "d2h_bytes", "shuffle_bytes_written",
+                "shuffle_bytes_read", "ici_exchange_bytes")
+        out = {}
+        for k in keys:
+            v = self.counters.get(k, self.metrics.get(k, 0))
+            if v:
+                out[k] = int(v)
+        for k in ("h2d_rows", "d2h_rows", "shuffle_rows_written",
+                  "shuffle_rows_read", "scanned_rows"):
+            v = self.metrics.get(k)
+            if v:
+                out[k] = int(v)
+        return out
+
+    def memory(self) -> Dict[str, Any]:
+        out = {}
+        for k, v in self.metrics.items():
+            if k.startswith("memory."):
+                out[k.removeprefix("memory.")] = v
+        return out
+
+    def incidents(self) -> Dict[str, int]:
+        """Instant-event histogram: oom_retry / batch_split / spill /
+        whole_plan_fallback / semaphore_wait counts."""
+        out: Dict[str, int] = {}
+        for e in self.events:
+            out[e.name] = out.get(e.name, 0) + 1
+        return out
+
+    # -- presentation ------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {"time_split": self.time_split(),
+                "operators": self.operators(),
+                "compile": self.compile_stats(),
+                "data_movement": self.data_movement(),
+                "memory": self.memory(),
+                "incidents": self.incidents(),
+                "fallbacks": self.fallbacks()}
+
+    def summary(self, top_n: int = 5) -> Dict[str, Any]:
+        """Compact per-query embedding for BENCH_*.json."""
+        ops = self.operators()
+        return {"time_split": self.time_split(),
+                "top_operators": [
+                    {"node": o["node"],
+                     "self_time_ms": o["self_time_ms"],
+                     "output_rows": o.get("output_rows", 0)}
+                    for o in ops[:top_n]],
+                "compile": self.compile_stats(),
+                "data_movement": self.data_movement(),
+                "memory_peak_bytes": self.memory().get("peak_bytes", 0),
+                "incidents": self.incidents(),
+                "fallback_count": len(self.fallbacks())}
+
+    def render(self) -> str:
+        """The human report: time split, top operators, fallbacks,
+        memory high-water — the profiling-tool output."""
+        split = self.time_split()
+        lines = ["== query profile ==",
+                 f"wall              {split['wall_ms']:.1f} ms",
+                 f"  plan (pre-wall) {split['plan_ms']:.1f} ms",
+                 f"  compile         {split['compile_ms']:.1f} ms",
+                 f"  execute         {split['execute_ms']:.1f} ms",
+                 f"  transition      {split['transition_ms']:.1f} ms",
+                 f"  shuffle         {split['shuffle_ms']:.1f} ms"]
+        cs = self.compile_stats()
+        lines.append(f"compile cache     {cs['cache_hits']} hits / "
+                     f"{cs['cache_misses']} misses")
+        ops = self.operators()
+        if ops:
+            lines.append("-- top operators (self time) --")
+            for o in ops[:10]:
+                lines.append(
+                    f"  {o['node']:<32} {o['self_time_ms']:>9.1f} ms  "
+                    f"rows={o.get('output_rows', 0)} "
+                    f"batches={o.get('output_batches', 0)}")
+        dm = self.data_movement()
+        if dm:
+            lines.append("-- data movement --")
+            for k, v in dm.items():
+                lines.append(f"  {k:<24} {v}")
+        mem = self.memory()
+        if mem:
+            lines.append(f"memory high-water {mem.get('peak_bytes', 0)} "
+                         f"bytes; spilled {mem.get('spilled_batches', 0)} "
+                         f"batches / {mem.get('spilled_bytes', 0)} bytes")
+        inc = self.incidents()
+        if inc:
+            lines.append("-- incidents --")
+            for k, v in sorted(inc.items()):
+                lines.append(f"  {k:<24} {v}")
+        fb = self.fallbacks()
+        lines.append(f"-- fallbacks ({len(fb)}) --")
+        for r in fb:
+            lines.append(f"  ! {r}")
+        return "\n".join(lines)

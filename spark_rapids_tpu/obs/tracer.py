@@ -1,0 +1,348 @@
+"""Query-scoped span tracer — the NVTX-range + event-log role.
+
+Reference: NvtxWithMetrics.scala wraps operator work in NVTX ranges nsys
+consumes; Spark's event log feeds the history server and the RAPIDS
+profiling tool replays it offline (SURVEY §5).  Here one `QueryTracer`
+rides the ExecContext through a query: lifecycle phases (plan, compile,
+execute, transitions, shuffle) record `Span`s, runtime incidents (OOM
+retry, batch split, spill, semaphore wait, whole-plan fallback) record
+instant events, and data-movement accounting (H2D/D2H/shuffle/ICI bytes)
+accumulates in counters.
+
+Serialization is two-way:
+  * a JSONL structured event log per query under
+    `spark.rapids.tpu.eventLog.dir` (`query_<id>.jsonl`) — parse it back
+    with `read_event_log()`;
+  * a Chrome trace-event JSON (`query_<id>.trace.json`) openable in
+    perfetto / chrome://tracing.
+
+Tracing is OFF by default (`NULL_TRACER` no-ops keep the disabled path
+near-free); enable with `spark.rapids.tpu.trace.enabled` (in-memory, for
+`TpuSession.last_query_profile()`) or by setting the event-log dir.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager, nullcontext
+from typing import Any, Dict, List, Optional
+
+from ..config import EVENT_LOG_DIR, TRACE_ENABLED, TpuConf
+
+
+@dataclasses.dataclass
+class Span:
+    """One timed range. t0/t1 are time.perf_counter() seconds; `node` is
+    the stable plan-node id (`ClassName#preorder`) for operator spans."""
+    sid: int
+    parent: Optional[int]
+    name: str
+    cat: str                      # plan | compile | execute | operator |
+                                  # transition | shuffle | query
+    t0: float
+    t1: float
+    node: Optional[str] = None
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def dur_ms(self) -> float:
+        return (self.t1 - self.t0) * 1000.0
+
+
+@dataclasses.dataclass
+class Event:
+    """An instant incident (OOM retry, spill, fallback, ...)."""
+    name: str
+    cat: str
+    t: float
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def _jsonable(v):
+    """Numbers stay numbers (numpy scalars included), everything else
+    stringifies — the event log must always serialize."""
+    if isinstance(v, bool) or v is None or isinstance(v, (int, float, str)):
+        return v
+    item = getattr(v, "item", None)
+    if item is not None:
+        try:
+            return item()
+        except Exception:                        # noqa: BLE001
+            pass
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    return str(v)
+
+
+class QueryTracer:
+    """Span/event/counter collector for ONE query execution.
+
+    Thread-safe: shuffle writer/reader threads and spill workers record
+    into the same tracer; parent attribution uses a per-thread span
+    stack (a worker thread's spans parent to the root query span)."""
+
+    def __init__(self, query_id: int):
+        self.query_id = query_id
+        self.enabled = True
+        self.spans: List[Span] = []
+        self.events: List[Event] = []
+        self.counters: Dict[str, float] = {}
+        self.meta: Dict[str, Any] = {}
+        self.metrics: Optional[dict] = None   # bound to ctx.metrics
+        self.wall_start_unix = time.time()
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._next_sid = 0
+        self._root_sid: Optional[int] = None
+
+    # -- recording ---------------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _parent(self) -> Optional[int]:
+        st = self._stack()
+        return st[-1] if st else self._root_sid
+
+    def add_span(self, name: str, cat: str, t0: float, t1: float,
+                 node: Optional[str] = None, parent: Optional[int] = None,
+                 **attrs) -> Span:
+        """Record an already-measured range (operator wrappers time
+        themselves and report at stream exhaustion)."""
+        with self._lock:
+            sid = self._next_sid
+            self._next_sid += 1
+            sp = Span(sid, parent if parent is not None else self._parent(),
+                      name, cat, t0, t1, node,
+                      {k: _jsonable(v) for k, v in attrs.items()})
+            self.spans.append(sp)
+            return sp
+
+    @contextmanager
+    def span(self, name: str, cat: str, node: Optional[str] = None,
+             **attrs):
+        """Time a range; nested spans parent to it (per-thread)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            sid = self._next_sid
+            self._next_sid += 1
+        parent = self._parent()
+        if cat == "query" and self._root_sid is None:
+            self._root_sid = sid
+        self._stack().append(sid)
+        try:
+            yield
+        finally:
+            self._stack().pop()
+            t1 = time.perf_counter()
+            with self._lock:
+                self.spans.append(Span(
+                    sid, parent, name, cat, t0, t1, node,
+                    {k: _jsonable(v) for k, v in attrs.items()}))
+
+    def instant(self, name: str, cat: str, **attrs) -> None:
+        with self._lock:
+            self.events.append(Event(name, cat, time.perf_counter(),
+                                     {k: _jsonable(v)
+                                      for k, v in attrs.items()}))
+
+    def add_bytes(self, key: str, n: int) -> None:
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + int(n)
+
+    def finish(self, metrics: Optional[dict] = None) -> None:
+        """Snapshot the query's final metrics (call after lazy device
+        metric coercion so every value is a host number)."""
+        if metrics is not None:
+            self.metrics = {k: _jsonable(v) for k, v in metrics.items()}
+
+    # -- serialization -----------------------------------------------------
+    def _origin(self) -> float:
+        ts = [s.t0 for s in self.spans] + [e.t for e in self.events]
+        return min(ts) if ts else 0.0
+
+    def to_jsonl_lines(self) -> List[str]:
+        """The structured event log: one JSON object per line, starting
+        with a query_start header and ending with query_end (metrics +
+        counters + meta)."""
+        org = self._origin()
+        lines = [json.dumps({
+            "type": "query_start", "query_id": self.query_id,
+            "wall_start_unix": self.wall_start_unix})]
+        for s in sorted(self.spans, key=lambda s: s.t0):
+            rec = {"type": "span", "id": s.sid, "parent": s.parent,
+                   "name": s.name, "cat": s.cat,
+                   "t0_ms": round((s.t0 - org) * 1e3, 3),
+                   "dur_ms": round(s.dur_ms, 3)}
+            if s.node is not None:
+                rec["node"] = s.node
+            if s.attrs:
+                rec["attrs"] = s.attrs
+            lines.append(json.dumps(rec))
+        for e in self.events:
+            rec = {"type": "instant", "name": e.name, "cat": e.cat,
+                   "t_ms": round((e.t - org) * 1e3, 3)}
+            if e.attrs:
+                rec["attrs"] = e.attrs
+            lines.append(json.dumps(rec))
+        lines.append(json.dumps(_jsonable({
+            "type": "query_end", "query_id": self.query_id,
+            "metrics": self.metrics or {}, "counters": self.counters,
+            "meta": self.meta})))
+        return lines
+
+    def to_chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (ph=X complete events, ph=i instants)
+        — open in perfetto.  Operator spans get their own tid so the
+        per-node lanes render side by side."""
+        org = self._origin()
+        tids = {}                # node id -> stable small tid
+
+        def tid_for(s: Span) -> int:
+            if s.node is None:
+                return 0
+            return tids.setdefault(s.node, len(tids) + 1)
+
+        evs = [{"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+                "args": {"name": f"query_{self.query_id}"}}]
+        for s in sorted(self.spans, key=lambda s: s.t0):
+            evs.append({"name": s.name, "cat": s.cat, "ph": "X",
+                        "ts": round((s.t0 - org) * 1e6, 1),
+                        "dur": round((s.t1 - s.t0) * 1e6, 1),
+                        "pid": 1, "tid": tid_for(s),
+                        "args": {**s.attrs,
+                                 **({"node": s.node} if s.node else {})}})
+        for e in self.events:
+            evs.append({"name": e.name, "cat": e.cat, "ph": "i",
+                        "ts": round((e.t - org) * 1e6, 1), "pid": 1,
+                        "tid": 0, "s": "p", "args": e.attrs})
+        return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+    def write(self, dir_path: str) -> Dict[str, str]:
+        """Write both artifacts under dir_path; returns their paths."""
+        os.makedirs(dir_path, exist_ok=True)
+        base = os.path.join(dir_path, f"query_{self.query_id}")
+        jsonl = base + ".jsonl"
+        with open(jsonl, "w") as f:
+            f.write("\n".join(self.to_jsonl_lines()) + "\n")
+        trace = base + ".trace.json"
+        with open(trace, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return {"jsonl": jsonl, "chrome_trace": trace}
+
+
+@dataclasses.dataclass
+class EventLog:
+    """Parsed form of one query's JSONL event log."""
+    query_id: int
+    wall_start_unix: float
+    spans: List[Span]
+    events: List[Event]
+    counters: Dict[str, float]
+    metrics: Dict[str, Any]
+    meta: Dict[str, Any]
+
+    def span_tree(self) -> set:
+        """Structural fingerprint for round-trip tests: one (id, parent,
+        name, cat, node) tuple per span."""
+        return {(s.sid, s.parent, s.name, s.cat, s.node)
+                for s in self.spans}
+
+
+def read_event_log(path: str) -> EventLog:
+    """Parse a query_<id>.jsonl event log back into spans/events/metrics
+    (the profiling tool's input — see scripts/profile_report.py)."""
+    spans: List[Span] = []
+    events: List[Event] = []
+    qid, start, counters, metrics, meta = 0, 0.0, {}, {}, {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            typ = rec.get("type")
+            if typ == "query_start":
+                qid = rec.get("query_id", 0)
+                start = rec.get("wall_start_unix", 0.0)
+            elif typ == "span":
+                t0 = rec["t0_ms"] / 1e3
+                spans.append(Span(rec["id"], rec.get("parent"),
+                                  rec["name"], rec["cat"], t0,
+                                  t0 + rec["dur_ms"] / 1e3,
+                                  rec.get("node"), rec.get("attrs", {})))
+            elif typ == "instant":
+                events.append(Event(rec["name"], rec["cat"],
+                                    rec["t_ms"] / 1e3,
+                                    rec.get("attrs", {})))
+            elif typ == "query_end":
+                counters = rec.get("counters", {})
+                metrics = rec.get("metrics", {})
+                meta = rec.get("meta", {})
+    return EventLog(qid, start, spans, events, counters, metrics, meta)
+
+
+class NullTracer:
+    """Disabled-path tracer: every record call is a no-op.  This is what
+    keeps default-conf overhead under the <2% budget — call sites never
+    branch, they just call into nothing."""
+
+    enabled = False
+    metrics: Optional[dict] = None
+    meta: Dict[str, Any] = {}
+    _null_cm = nullcontext()
+
+    def span(self, name: str, cat: str, node=None, **attrs):
+        return self._null_cm
+
+    def add_span(self, *a, **k):
+        return None
+
+    def instant(self, *a, **k):
+        return None
+
+    def add_bytes(self, *a, **k):
+        return None
+
+    def finish(self, *a, **k):
+        return None
+
+
+NULL_TRACER = NullTracer()
+
+_QUERY_ID_LOCK = threading.Lock()
+_NEXT_QUERY_ID = 0
+
+# The process-wide active tracer: runtime subsystems that have no
+# ExecContext in reach (shuffle manager threads, the ICI exchange, the
+# retry/spill machinery) report here.  Set for the duration of a query's
+# instrumented scope (plan/overrides.py); NULL outside it.
+_ACTIVE: object = NULL_TRACER
+
+
+def set_active(tracer) -> None:
+    global _ACTIVE
+    _ACTIVE = tracer
+
+
+def get_active():
+    return _ACTIVE
+
+
+def make_tracer(conf: TpuConf):
+    """A real tracer when tracing is on for this conf (trace.enabled or
+    an event-log dir), else the shared NULL_TRACER."""
+    if not (conf.get(TRACE_ENABLED) or conf.get(EVENT_LOG_DIR)):
+        return NULL_TRACER
+    global _NEXT_QUERY_ID
+    with _QUERY_ID_LOCK:
+        _NEXT_QUERY_ID += 1
+        qid = _NEXT_QUERY_ID
+    return QueryTracer(qid)

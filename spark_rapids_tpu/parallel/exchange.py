@@ -266,6 +266,17 @@ class RaggedExchange:
         while per_shard_in > recv_cap:
             recv_cap *= 2
         rounds = -(-max_cnt // self.quota) if max_cnt else 0
+        # ICI data-movement accounting (obs/tracer.py): each round ships
+        # one (P, quota) slab per lane through the all_to_all — masked
+        # slots transit too, so this is actual wire bytes, not live rows
+        from ..obs.tracer import get_active
+        tr = get_active()
+        if rounds:
+            slab = sum(self.nparts * self.quota * s.dtype.itemsize
+                       for s in s_lanes)
+            tr.add_bytes("ici_exchange_bytes", rounds * slab)
+            tr.instant("ici_exchange", "shuffle", rounds=rounds,
+                       bytes=rounds * slab, recv_cap=recv_cap)
         round_fn = self._round_fn(recv_cap)
         n = self.nparts * recv_cap
         shard = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))

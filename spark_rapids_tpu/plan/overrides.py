@@ -1192,6 +1192,10 @@ class PhysicalQuery:
         self.kind = kind           # "device" | "host" at the root
         self.root = root
         self.conf = conf
+        # (name, t0, t1) perf_counter ranges of the planning phases
+        # (wrap/tag/convert), stamped by apply_overrides; the tracer
+        # replays them as cat=plan spans at collect time
+        self.plan_phases: List[tuple] = []
 
     def explain(self) -> str:
         return "\n".join(self.meta.explain_lines())
@@ -1199,32 +1203,69 @@ class PhysicalQuery:
     def physical_tree(self) -> str:
         return self.root.tree_string()
 
+    def fallback_reasons(self) -> List[str]:
+        """Every tagger reason in the meta tree (depth-first) — the
+        structured form of the '!Exec ... because ...' explain lines."""
+        out, stack = [], [self.meta]
+        while stack:
+            m = stack.pop()
+            for r in m.reasons:
+                if r not in out:
+                    out.append(r)
+            stack.extend(getattr(m, "children", ()))
+        return out
+
     def _instrumented(self, ctx: ExecContext):
-        """Shared observability wiring: per-op metrics, profiler trace,
-        concurrency permit, budget counters (GpuTaskMetrics role)."""
+        """Shared observability wiring: span tracer, per-op metrics,
+        profiler trace, concurrency permit, budget counters
+        (GpuTaskMetrics role).  The tracer gates on ctx.conf (not the
+        planning conf) so a caller can profile one collect of an
+        already-planned query."""
         from contextlib import contextmanager
+        from ..config import EVENT_LOG_DIR
         from ..exec.metrics import (instrument, profile_trace,
                                     should_instrument)
+        from ..obs.tracer import NULL_TRACER, make_tracer, set_active
         from ..runtime.semaphore import device_permit
 
         @contextmanager
         def scope():
-            if should_instrument(self.conf):
-                instrument(self.root, ctx)
-            with profile_trace(self.conf), \
-                    device_permit(self.conf, ctx.metrics):
-                yield
-            # metrics accumulated as device scalars (lazy counts) coerce
-            # in ONE batched fetch at query end
-            import jax
-            lazy = {k: v for k, v in ctx.metrics.items()
-                    if isinstance(v, jax.Array)}
-            if lazy:
-                for k, v in zip(lazy, jax.device_get(list(lazy.values()))):
-                    ctx.metrics[k] = v.item()
-            if ctx._budget is not None:
-                for k, v in ctx.budget.metrics.items():
-                    ctx.metrics[f"memory.{k}"] = v
+            tracer = make_tracer(ctx.conf)
+            ctx.tracer = tracer
+            if tracer.enabled:
+                tracer.metrics = ctx.metrics
+                tracer.meta["fallbacks"] = self.fallback_reasons()
+                tracer.meta["plan_kind"] = self.kind
+                for name, t0, t1 in self.plan_phases:
+                    tracer.add_span(name, "plan", t0, t1)
+            set_active(tracer)
+            try:
+                if should_instrument(self.conf):
+                    instrument(self.root, ctx)
+                with profile_trace(self.conf), \
+                        device_permit(self.conf, ctx.metrics):
+                    with tracer.span("query", "query"):
+                        yield
+                # metrics accumulated as device scalars (lazy counts)
+                # coerce in ONE batched fetch at query end
+                import jax
+                lazy = {k: v for k, v in ctx.metrics.items()
+                        if isinstance(v, jax.Array)}
+                if lazy:
+                    for k, v in zip(lazy,
+                                    jax.device_get(list(lazy.values()))):
+                        ctx.metrics[k] = v.item()
+                if ctx._budget is not None:
+                    for k, v in ctx.budget.metrics.items():
+                        ctx.metrics[f"memory.{k}"] = v
+            finally:
+                set_active(NULL_TRACER)
+                if tracer.enabled:
+                    tracer.finish(ctx.metrics)
+                    log_dir = str(ctx.conf.get(EVENT_LOG_DIR) or "")
+                    if log_dir:
+                        ctx.metrics["event_log_files"] = \
+                            tracer.write(log_dir)
         return scope()
 
     def _whole_plan_enabled(self) -> bool:
@@ -1511,7 +1552,14 @@ def _walk(plan: L.LogicalPlan):
 
 def apply_overrides(plan: L.LogicalPlan,
                     conf: TpuConf = DEFAULT_CONF) -> PhysicalQuery:
-    """wrapAndTagPlan + doConvertPlan + explain logging."""
+    """wrapAndTagPlan + doConvertPlan + explain logging.
+
+    Phase wall times (rewrite / wrap+tag / convert) are stamped on the
+    returned PhysicalQuery; the query tracer replays them as cat=plan
+    spans so the profile shows planning cost next to execution."""
+    import time as _time
+    phases = []
+    t0 = _time.perf_counter()
     if conf.sql_enabled:
         # nested-type shatter only matters for device placement; the
         # pure-CPU engine (oracle) keeps the original nested plan
@@ -1525,6 +1573,8 @@ def apply_overrides(plan: L.LogicalPlan,
         # input_file_name forces the per-file reader
         from ..config import PARQUET_READER_TYPE
         conf = TpuConf({**conf._raw, PARQUET_READER_TYPE.key: "PERFILE"})
+    t1 = _time.perf_counter()
+    phases.append(("plan.rewrite", t0, t1))
     meta = wrap_plan(plan, conf)
     meta.tag()
     from ..config import CBO_ENABLED
@@ -1536,12 +1586,17 @@ def apply_overrides(plan: L.LogicalPlan,
         for line in meta.explain_lines():
             if mode == "ALL" or line.lstrip().startswith("!"):
                 log.info(line)
+    t2 = _time.perf_counter()
+    phases.append(("plan.wrap_tag", t1, t2))
     kind, root = meta.convert()
     if kind == "device":
         from ..config import JOIN_LAZY_SELECTION
         if conf.get(JOIN_LAZY_SELECTION):
             _negotiate_lazy_sel(root)
-    return PhysicalQuery(meta, kind, root, conf)
+    phases.append(("plan.convert", t2, _time.perf_counter()))
+    pq = PhysicalQuery(meta, kind, root, conf)
+    pq.plan_phases = phases
+    return pq
 
 
 def _negotiate_lazy_sel(root) -> None:

@@ -87,7 +87,8 @@ class MemoryBudget:
         self._inject_at = conf.get(TEST_INJECT_RETRY_OOM)
         self._reservations = 0
         self.metrics = {"spilled_batches": 0, "spilled_bytes": 0,
-                        "disk_batches": 0, "oom_retries": 0}
+                        "disk_batches": 0, "oom_retries": 0,
+                        "batch_splits": 0, "peak_bytes": 0}
 
     # -- registration ------------------------------------------------------
     def register(self, sp: "Spillable") -> int:
@@ -119,6 +120,8 @@ class MemoryBudget:
                                   f"(reservation #{self._reservations})")
             if not self.limit:
                 self.live += nbytes
+                if self.live > self.metrics["peak_bytes"]:
+                    self.metrics["peak_bytes"] = self.live
                 return
             while self.live + nbytes > self.limit:
                 if not self._spill_one():
@@ -127,6 +130,9 @@ class MemoryBudget:
                         f"+ {nbytes} > limit={self.limit} with nothing "
                         "left to spill")
             self.live += nbytes
+            # device-memory high-water (the profile's peak-usage line)
+            if self.live > self.metrics["peak_bytes"]:
+                self.metrics["peak_bytes"] = self.live
 
     def release(self, nbytes: int):
         with self._lock:
@@ -215,6 +221,9 @@ class Spillable:
             self._budget.release(self._nbytes)
             self._budget.metrics["spilled_batches"] += 1
             self._budget.metrics["spilled_bytes"] += self._nbytes
+            from ..obs.tracer import get_active
+            get_active().instant("spill", "runtime", tier="host",
+                                 bytes=self._nbytes)
             self._hb = hb
             self._budget.host_reserve(hb.rb.nbytes)
 
@@ -233,6 +242,9 @@ class Spillable:
         native.spill_write(path, sink.getvalue())   # zero-copy pa.Buffer
         self._budget.host_release(self._hb.rb.nbytes)
         self._budget.metrics["disk_batches"] += 1
+        from ..obs.tracer import get_active
+        get_active().instant("spill", "runtime", tier="disk",
+                             bytes=self._hb.rb.nbytes)
         self._hb = None
         self._path = path
 

@@ -66,6 +66,9 @@ def with_retry(budget: MemoryBudget, conf: TpuConf,
         if not is_oom_error(e):
             raise
         budget.metrics["oom_retries"] += 1
+        from ..obs.tracer import get_active
+        get_active().instant("oom_retry", "runtime",
+                             error=type(e).__name__)
         budget.spill_all()
         return attempt()
 
@@ -80,6 +83,7 @@ def with_split_retry(budget: MemoryBudget, conf: TpuConf,
     if not conf.get(RETRY_ENABLED):
         yield attempt(batch)
         return
+    from ..obs.tracer import get_active
     max_splits = conf.get(RETRY_MAX_SPLITS)
     pending: List[tuple] = [(batch, 0)]          # (batch, splits so far)
     while pending:
@@ -91,6 +95,7 @@ def with_split_retry(budget: MemoryBudget, conf: TpuConf,
             if not is_oom_error(e):
                 raise
         budget.metrics["oom_retries"] += 1
+        get_active().instant("oom_retry", "runtime", depth=depth)
         budget.spill_all()
         try:
             yield attempt(b)
@@ -101,5 +106,7 @@ def with_split_retry(budget: MemoryBudget, conf: TpuConf,
             if depth >= max_splits:
                 raise TpuRetryOOM(
                     f"OOM persists after {depth} splits") from e
+        budget.metrics["batch_splits"] += 1
+        get_active().instant("batch_split", "runtime", depth=depth + 1)
         halves = split_batch(b, conf)
         pending[:0] = [(h, depth + 1) for h in halves]

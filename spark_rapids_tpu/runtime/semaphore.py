@@ -38,8 +38,17 @@ def _semaphore(conf: TpuConf) -> threading.BoundedSemaphore:
 def device_permit(conf: TpuConf, metrics: Optional[dict] = None):
     """Hold one device permit; blocks when concurrentTpuTasks are active.
     Time spent blocked is surfaced as the semaphore-wait metric
-    (GpuTaskMetrics semaphore-wait analogue)."""
+    (GpuTaskMetrics semaphore-wait analogue).
+
+    `metrics` defaults to the active query's metrics dict (the tracer
+    binds ExecContext.metrics for its scope), so call sites that cannot
+    reach an ExecContext — shuffle/scan worker threads — still populate
+    the wait accumulator instead of silently dropping it."""
     import time
+    from ..obs.tracer import get_active
+    tracer = get_active()
+    if metrics is None:
+        metrics = getattr(tracer, "metrics", None)
     sem = _semaphore(conf)
     t0 = time.perf_counter()
     sem.acquire()
@@ -47,6 +56,9 @@ def device_permit(conf: TpuConf, metrics: Optional[dict] = None):
     if metrics is not None:
         metrics["semaphore_wait_ms"] = metrics.get(
             "semaphore_wait_ms", 0.0) + waited * 1000.0
+    if waited >= 0.001:
+        tracer.instant("semaphore_wait", "runtime",
+                       wait_ms=round(waited * 1000.0, 3))
     try:
         yield
     finally:

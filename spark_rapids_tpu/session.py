@@ -31,11 +31,26 @@ from .plan.overrides import PhysicalQuery, apply_overrides
 class TpuSession:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = conf if isinstance(conf, TpuConf) else TpuConf(conf)
+        self._last_ctx: Optional[ExecContext] = None
 
     def set_conf(self, key: str, value) -> None:
         raw = dict(self.conf._raw)
         raw[key] = value
         self.conf = TpuConf(raw)
+
+    def last_query_profile(self):
+        """QueryProfile of the most recent collect()/count() on this
+        session, or None before the first one.  Span-level detail (time
+        split, incidents) needs `spark.rapids.tpu.trace.enabled` (or an
+        eventLog.dir); the per-node-id operator table and data-movement
+        counters populate from plain metrics either way."""
+        if self._last_ctx is None:
+            return None
+        from .obs.profile import QueryProfile
+        return QueryProfile.from_context(self._last_ctx)
+
+    def _record_query(self, ctx: ExecContext) -> None:
+        self._last_ctx = ctx
 
     # -- sources -----------------------------------------------------------
     def from_arrow(self, table: pa.Table) -> "DataFrame":
@@ -408,7 +423,28 @@ class DataFrame:
         return apply_overrides(self._plan, self._session.conf)
 
     def collect(self) -> pa.Table:
-        return self.physical().collect()
+        q = self.physical()
+        ctx = ExecContext(q.conf)
+        out = q.collect(ctx)
+        self._last_ctx = ctx
+        self._session._record_query(ctx)
+        return out
+
+    def metrics(self) -> Optional[dict]:
+        """Structured metrics of this DataFrame's most recent collect()
+        (per-node-id operator counters, transition/shuffle accounting,
+        compile cache stats, memory.*), or None before the first one."""
+        ctx = getattr(self, "_last_ctx", None)
+        return None if ctx is None else dict(ctx.metrics)
+
+    def profile(self):
+        """QueryProfile of this DataFrame's most recent collect(), or
+        None before the first one (see TpuSession.last_query_profile)."""
+        ctx = getattr(self, "_last_ctx", None)
+        if ctx is None:
+            return None
+        from .obs.profile import QueryProfile
+        return QueryProfile.from_context(ctx)
 
     def to_pydict(self) -> dict:
         return self.collect().to_pydict()

@@ -105,23 +105,27 @@ class ShuffleManager:
 
     def write_batch(self, shuffle_id: int, hb: HostBatch,
                     part_ids: np.ndarray, num_partitions: int,
-                    codec: str = "none") -> None:
+                    codec: str = "none") -> int:
         """Split one host batch by partition id and store each slice
-        (serialization + compression fan out on the thread pool)."""
+        (serialization + compression fan out on the thread pool).
+        Returns the total serialized bytes written — the MapStatus-bytes
+        number the shuffle metrics and AQE planning both consume."""
         rb = hb.rb
         order = np.argsort(part_ids, kind="stable")
         sorted_ids = part_ids[order]
         bounds = np.searchsorted(sorted_ids, np.arange(num_partitions + 1))
         idx_arr = pa.array(order)
 
-        def ser(p: int):
+        def ser(p: int) -> int:
             s, e = bounds[p], bounds[p + 1]
             if s == e:
-                return
+                return 0
             sl = rb.take(idx_arr.slice(s, e - s))
-            self.store.put(shuffle_id, p, serialize_batch(sl, codec))
+            payload = serialize_batch(sl, codec)
+            self.store.put(shuffle_id, p, payload)
+            return len(payload)
 
-        list(self.pool.map(ser, range(num_partitions)))
+        return sum(self.pool.map(ser, range(num_partitions)))
 
     def read_partition(self, shuffle_id: int, part_id: int,
                        block_range=None) -> List[pa.RecordBatch]:
