@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Chaos-coverage lint: every registered fault-injection site must be
+exercised by at least one chaos test.
+
+The site registry is the source of truth
+(spark_rapids_tpu.runtime.faults.SITES — the names the conf grammar
+accepts); tests/test_chaos.py is the chaos suite.  A site that gains an
+injection point in the engine but no chaos test is an UNTESTED recovery
+path — exactly the gap this PR exists to close — so this lint fails the
+build on it.  Runs in tier-1 via tests/test_chaos.py.
+
+A site counts as covered when the chaos suite arms a fault spec at it
+(`"<site>:<kind>"`) or fires it directly (`fire("<site>")` /
+`fire_active("<site>")`).
+
+Usage:
+    python scripts/check_fault_sites.py      # exit 1 + list when gaps
+"""
+import os
+import re
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def uncovered_sites() -> list:
+    from spark_rapids_tpu.runtime.faults import KINDS, SITES
+    src = open(os.path.join(_ROOT, "tests", "test_chaos.py")).read()
+    missing = []
+    kind_alt = "|".join(KINDS)
+    for site in sorted(SITES):
+        armed = re.search(rf"\b{site}:(?:{kind_alt}):", src)
+        fired = re.search(rf"fire(?:_active)?\(\s*['\"]{site}['\"]", src)
+        if not armed and not fired:
+            missing.append(site)
+    return missing
+
+
+def main() -> int:
+    missing = uncovered_sites()
+    if missing:
+        print("fault sites registered in runtime/faults.py with NO chaos "
+              "test in tests/test_chaos.py:")
+        for site in missing:
+            print(f"  {site}")
+        print("add a chaos case arming '<site>:<kind>:<trigger>' (or a "
+              "direct fire()) for each.")
+        return 1
+    print("every registered fault site is exercised by the chaos suite")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -252,10 +252,59 @@ RETRY_MAX_SPLITS = conf(
     "Maximum times a batch may be halved before the OOM is rethrown.",
     checker=_positive)
 
+RETRY_MAX_ATTEMPTS = conf(
+    "spark.rapids.tpu.sql.retry.maxAttempts", 2,
+    "Attempt-ladder depth of the OOM retry framework: how many times one "
+    "unit of device work runs (spilling everything between attempts) "
+    "before the ladder escalates — with_split_retry halves the batch, "
+    "with_retry rethrows. The reference replays exactly once; raising "
+    "this trades replay work for survival under sustained pressure.",
+    checker=lambda v: None if v >= 1 else "must be >= 1")
+
+RETRY_IO_ATTEMPTS = conf(
+    "spark.rapids.tpu.retry.io.maxAttempts", 3,
+    "Bounded retry for transient host-IO failures (spill block "
+    "read/write, shuffle fetch, host<->device transfers): total attempts "
+    "per IO unit before the OSError propagates (classified as class "
+    "'io' by runtime.failure.classify). 1 disables retry.",
+    checker=lambda v: None if v >= 1 else "must be >= 1")
+
+RETRY_IO_BACKOFF_MS = conf(
+    "spark.rapids.tpu.retry.io.backoffMs", 10,
+    "Initial backoff before the first IO retry, in milliseconds; each "
+    "further retry multiplies it by retry.io.backoffMultiplier.",
+    checker=_non_negative)
+
+RETRY_IO_BACKOFF_MULT = conf(
+    "spark.rapids.tpu.retry.io.backoffMultiplier", 2.0,
+    "Multiplier applied to the IO retry backoff after every attempt.",
+    checker=_positive)
+
 TEST_INJECT_RETRY_OOM = conf(
     "spark.rapids.tpu.sql.test.injectRetryOOM", 0,
     "Test-only: force a synthetic device OOM on the Nth retryable block "
     "(reference spark.rapids.sql.test.injectRetryOOM).", internal=True)
+
+TEST_FAULTS = conf(
+    "spark.rapids.tpu.test.faults", "",
+    "Site-addressable deterministic fault injection (chaos harness, "
+    "runtime/faults.py): a ';'-separated list of `site:kind:trigger` "
+    "rules, e.g. `spill_read:corrupt:nth=2`, `reserve:oom:every=3`, "
+    "`shuffle_fetch:ioerror:p=0.1,seed=7`. Sites name the layer that "
+    "fails (reserve, compile, execute, h2d, d2h, spill_write, "
+    "spill_read, shuffle_write, shuffle_fetch, exchange); kinds pick "
+    "the failure (oom, ioerror, corrupt, fatal, error); triggers are "
+    "nth=N (once, on the Nth hit), every=N, p=F[,seed=N], or always. "
+    "Every injection and recovery emits an obs instant. Empty disables "
+    "injection (the default path is a no-op).",
+    checker=lambda v: _check_fault_spec(v))
+
+
+def _check_fault_spec(v):
+    # deferred: the grammar lives with the injector (runtime/faults.py);
+    # the checker only runs at conf.get() time, after imports settle
+    from .runtime.faults import check_spec
+    return check_spec(v)
 
 SHUFFLE_MODE = conf(
     "spark.rapids.tpu.shuffle.mode", "MULTITHREADED",

@@ -210,8 +210,11 @@ class CompiledPlan:
                 continue
             cached = getattr(node, "_device_cache", None)
             if cached is None:
+                from ..runtime.retry import retry_io
                 with ctx.tracer.span("upload", "transition"):
-                    cached = _shared_scan_upload(node, ctx.conf)
+                    cached = retry_io(
+                        ctx.conf, "h2d",
+                        lambda: _shared_scan_upload(node, ctx.conf))
                     if self.mesh is not None:
                         cached = [_shard_batch(db, self.mesh)
                                   for db in cached]
@@ -289,6 +292,11 @@ class CompiledPlan:
 
         if self._compiled is None:
             import time as _time
+            from ..runtime.faults import get_injector
+            # chaos site: a whole-plan compile failure — injected `oom`
+            # exercises the eager-engine fallback, `fatal` the crash
+            # capture (collect_with_fallback owns both ladders)
+            get_injector(ctx.conf).fire("compile")
             self._input_specs = [(n, list(s)) for n, s in in_specs]
             out_holder: Dict[str, list] = {}
             t0 = _time.perf_counter()
@@ -318,12 +326,15 @@ class CompiledPlan:
     def collect(self, ctx: ExecContext) -> pa.Table:
         from ..columnar.device import fetch_result_batch
         from ..columnar.host import struct_to_schema
+        from ..runtime.retry import retry_io
         outs = self.execute(ctx)
         bound = self.root.row_upper_bound()
         hbs = []
         for db in outs:
             with ctx.tracer.span("fetch", "transition"):
-                hb = fetch_result_batch(db, bound, ctx.conf)
+                hb = retry_io(ctx.conf, "d2h",
+                              lambda: fetch_result_batch(db, bound,
+                                                         ctx.conf))
             ctx.bump("d2h_rows", hb.num_rows)
             ctx.tracer.add_bytes("d2h_bytes", hb.rb.nbytes)
             hbs.append(hb)
@@ -342,11 +353,14 @@ def _trace_context(ctx: ExecContext) -> ExecContext:
     are tracers and must never escape the jit (host numbers are copied
     back by the caller)."""
     from ..config import (HBM_BUDGET_BYTES, RUNTIME_FILTER_ENABLED,
-                          TEST_INJECT_RETRY_OOM)
+                          TEST_FAULTS, TEST_INJECT_RETRY_OOM)
     raw = dict(ctx.conf._raw)
     raw[HBM_BUDGET_BYTES.key] = 1 << 62
     raw[RUNTIME_FILTER_ENABLED.key] = False
     raw[TEST_INJECT_RETRY_OOM.key] = 0
+    # fault injection under jit tracing would bake a synthetic failure
+    # into the compiled program; chaos targets the runtime layers only
+    raw[TEST_FAULTS.key] = ""
     return ExecContext(TpuConf(raw))
 
 

@@ -53,6 +53,7 @@ class ShuffleExchangeExec(PlanNode):
         if self.shuffle_id is not None:
             return self.shuffle_id
         from ..config import SHUFFLE_COMPRESSION
+        from ..runtime.retry import retry_io
         mgr = get_shuffle_manager()
         sid = mgr.new_shuffle()
         n = self.partitioning.num_partitions
@@ -63,11 +64,15 @@ class ShuffleExchangeExec(PlanNode):
             ids = self.partitioning.partition_ids(db, ctx.conf)
             with ctx.tracer.span("shuffle_fetch", "transition",
                                  node=getattr(self, "_node_id", None)):
-                hb = to_host(db)
+                hb = retry_io(ctx.conf, "d2h", lambda: to_host(db))
             ctx.tracer.add_bytes("d2h_bytes", hb.rb.nbytes)
             with ctx.tracer.span("shuffle_write", "shuffle",
                                  node=getattr(self, "_node_id", None)):
-                nbytes = mgr.write_batch(sid, hb, ids, n, codec)
+                # write_batch is transactional (nothing published until
+                # every slice serialized) so the retry cannot duplicate
+                nbytes = retry_io(
+                    ctx.conf, "shuffle_write",
+                    lambda: mgr.write_batch(sid, hb, ids, n, codec))
             ctx.bump("shuffle_rows_written", int(db.num_rows))
             ctx.bump("shuffle_bytes_written", nbytes)
             ctx.tracer.add_bytes("shuffle_bytes_written", nbytes)
@@ -118,6 +123,7 @@ class ShuffleReadExec(PlanNode):
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         sid = self.shuffle_id if self.shuffle_id is not None \
             else self.exchange.materialize(ctx)
+        from ..runtime.retry import retry_io
         mgr = get_shuffle_manager()
         target = ctx.conf.batch_size_rows
         pending: List[pa.RecordBatch] = []
@@ -129,10 +135,15 @@ class ShuffleReadExec(PlanNode):
                                  node=getattr(self, "_node_id", None)):
                 if isinstance(unit, tuple):
                     p, lo, hi = unit
-                    rbs = mgr.read_partition(sid, p, block_range=(lo, hi))
+                    rbs = retry_io(
+                        ctx.conf, "shuffle_fetch",
+                        lambda: mgr.read_partition(sid, p,
+                                                   block_range=(lo, hi)))
                     nbytes = sum(mgr.block_sizes(sid, p)[lo:hi])
                 else:
-                    rbs = mgr.read_partition(sid, unit)
+                    rbs = retry_io(
+                        ctx.conf, "shuffle_fetch",
+                        lambda: mgr.read_partition(sid, unit))
                     nbytes = sum(mgr.block_sizes(sid, unit))
             ctx.bump("shuffle_bytes_read", nbytes)
             ctx.tracer.add_bytes("shuffle_bytes_read", nbytes)
@@ -148,6 +159,7 @@ class ShuffleReadExec(PlanNode):
             yield self._upload(pending, ctx)
 
     def _upload(self, rbs: List[pa.RecordBatch], ctx) -> DeviceBatch:
+        from ..runtime.retry import retry_io
         tbl = pa.Table.from_batches(rbs).combine_chunks()
         hb = HostBatch(tbl.to_batches()[0] if tbl.num_rows else
                        pa.RecordBatch.from_pydict(
@@ -157,7 +169,8 @@ class ShuffleReadExec(PlanNode):
         ctx.tracer.add_bytes("h2d_bytes", hb.rb.nbytes)
         with ctx.tracer.span("upload", "transition",
                              node=getattr(self, "_node_id", None)):
-            return to_device(hb, ctx.conf)
+            return retry_io(ctx.conf, "h2d",
+                            lambda: to_device(hb, ctx.conf))
 
     def describe(self):
         return f"ShuffleReadExec[{len(self.partitions)} parts]"

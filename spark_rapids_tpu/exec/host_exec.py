@@ -115,6 +115,7 @@ class HostToDeviceExec(PlanNode):
         return self.host_child.output_schema
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from ..runtime.retry import retry_io
         target = ctx.conf.batch_size_rows
         for rb in self.host_child.execute(ctx):
             for off in range(0, max(rb.num_rows, 1), target):
@@ -125,7 +126,9 @@ class HostToDeviceExec(PlanNode):
                 ctx.tracer.add_bytes("h2d_bytes", sl.nbytes)
                 with ctx.tracer.span("upload", "transition",
                                      node=getattr(self, "_node_id", None)):
-                    db = to_device(HostBatch(sl), ctx.conf)
+                    db = retry_io(ctx.conf, "h2d",
+                                  lambda: to_device(HostBatch(sl),
+                                                    ctx.conf))
                 yield db
 
     def tree_string(self, indent: int = 0) -> str:
@@ -146,13 +149,15 @@ class DeviceToHostExec(HostNode):
         return self.device_child.output_schema
 
     def execute(self, ctx: ExecContext) -> Iterator[pa.RecordBatch]:
+        from ..runtime.retry import retry_io
         for db in self.device_child.execute(ctx):
             if int(db.num_rows) == 0:
                 continue
             ctx.bump("d2h_rows", int(db.num_rows))
             with ctx.tracer.span("fetch", "transition",
                                  node=getattr(self, "_node_id", None)):
-                rb = to_host(db).rb
+                rb = retry_io(ctx.conf, "d2h",
+                              lambda: to_host(db)).rb
             ctx.tracer.add_bytes("d2h_bytes", rb.nbytes)
             yield rb
 

@@ -140,13 +140,16 @@ class PlanNode:
         count+head-prefix trip (columnar.device.fetch_result_batch)."""
         ctx = ctx or ExecContext()
         from ..columnar.device import fetch_result_batch
+        from ..runtime.retry import retry_io
         bound = self.row_upper_bound()
         hbs = []
         for db in self.execute(ctx):
             if isinstance(db.num_rows, int) and db.num_rows == 0:
                 continue
             with ctx.tracer.span("fetch", "transition"):
-                hb = fetch_result_batch(db, bound, ctx.conf)
+                hb = retry_io(ctx.conf, "d2h",
+                              lambda: fetch_result_batch(db, bound,
+                                                         ctx.conf))
             ctx.bump("d2h_rows", hb.num_rows)
             ctx.tracer.add_bytes("d2h_bytes", hb.rb.nbytes)
             hbs.append(hb)
@@ -210,10 +213,12 @@ class HostScanExec(PlanNode):
         if self._trace_batches is not None:   # under whole-plan tracing
             yield from self._trace_batches
             return
+        from ..runtime.retry import retry_io
         for hb in self.batches:
             ctx.bump("scanned_rows", hb.num_rows)
             with ctx.tracer.span("upload", "transition"):
-                db = to_device(hb, ctx.conf)
+                db = retry_io(ctx.conf, "h2d",
+                              lambda: to_device(hb, ctx.conf))
             ctx.bump("h2d_rows", hb.num_rows)
             ctx.tracer.add_bytes("h2d_bytes", hb.rb.nbytes)
             yield db

@@ -255,6 +255,8 @@ class RaggedExchange:
         shape.  Returns (recv lanes [(n_devices*recv_cap,)], recv live,
         in_counts (n_devices*P,))."""
         import numpy as np
+        from ..runtime.faults import fire_active
+        fire_active("exchange")     # chaos site: the collective fabric
         s_lanes, s_live, counts, offsets, in_counts = \
             self._prep(lanes, live, dest)
         max_cnt = int(np.asarray(counts).max())

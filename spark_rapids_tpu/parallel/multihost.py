@@ -129,6 +129,8 @@ def two_level_all_to_all(mesh: Mesh, lanes, live, dest):
     shape.  Rows land grouped by source, order within a chip is not
     specified (exchange semantics, same contract as a flat all_to_all).
     """
+    from ..runtime.faults import fire_active
+    fire_active("exchange")     # chaos site: the DCN/ICI collective hop
     n_hosts, ici = mesh.devices.shape
 
     def stage(axis: str, n_groups: int, group_of, chip_lanes, chip_live,

@@ -1226,12 +1226,16 @@ class PhysicalQuery:
         from ..exec.metrics import (instrument, profile_trace,
                                     should_instrument)
         from ..obs.tracer import NULL_TRACER, make_tracer, set_active
+        from ..runtime import faults
         from ..runtime.semaphore import device_permit
 
         @contextmanager
         def scope():
             tracer = make_tracer(ctx.conf)
             ctx.tracer = tracer
+            # chaos: conf-less sites (mesh exchange collectives) fire on
+            # the active injector for this query's scope
+            faults.set_active(faults.get_injector(ctx.conf))
             if tracer.enabled:
                 tracer.metrics = ctx.metrics
                 tracer.meta["fallbacks"] = self.fallback_reasons()
@@ -1260,6 +1264,7 @@ class PhysicalQuery:
                         ctx.metrics[f"memory.{k}"] = v
             finally:
                 set_active(NULL_TRACER)
+                faults.set_active(faults.NULL_INJECTOR)
                 if tracer.enabled:
                     tracer.finish(ctx.metrics)
                     log_dir = str(ctx.conf.get(EVENT_LOG_DIR) or "")
@@ -1290,12 +1295,36 @@ class PhysicalQuery:
         from ..runtime.failure import crash_capture, install_fault_injection
         install_fault_injection(self.root, self.conf)
         with self._instrumented(ctx), crash_capture(self.conf, ctx):
-            if self.kind == "device" and self._whole_plan_enabled():
-                from ..exec.compiled import collect_with_fallback
-                out = collect_with_fallback(self.root, ctx, cache_on=self)
-                if out is not None:
-                    return out
-            return self.root.collect(ctx)
+            return self._collect_with_query_retry(ctx)
+
+    def _collect_once(self, ctx: ExecContext) -> pa.Table:
+        if self.kind == "device" and self._whole_plan_enabled():
+            from ..exec.compiled import collect_with_fallback
+            out = collect_with_fallback(self.root, ctx, cache_on=self)
+            if out is not None:
+                return out
+        return self.root.collect(ctx)
+
+    def _collect_with_query_retry(self, ctx: ExecContext) -> pa.Table:
+        """The query-level rung of the recovery ladder (the task-retry
+        role): an OOM that escapes every operator-level retry gets ONE
+        whole-query replay after a spill-everything.  Plans replay
+        idempotently (pure operators; exchanges reuse their materialized
+        shuffle ids), so the rerun is safe; anything non-OOM — or a
+        second OOM — propagates for classification."""
+        from ..config import RETRY_ENABLED
+        from ..runtime.memory import is_oom_error
+        try:
+            return self._collect_once(ctx)
+        except Exception as e:                   # noqa: BLE001
+            if not ctx.conf.get(RETRY_ENABLED) or not is_oom_error(e):
+                raise
+            if ctx._budget is not None:
+                ctx.budget.spill_all()
+            ctx.bump("query_oom_replays")
+            ctx.tracer.instant("query_replay", "runtime",
+                               error=type(e).__name__)
+            return self._collect_once(ctx)
 
     def execute_host_batches(self, ctx: Optional[ExecContext] = None):
         """Stream results as pyarrow RecordBatches (same permit/metrics

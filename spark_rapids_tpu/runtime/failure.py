@@ -27,6 +27,7 @@ TPU translation:
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import time
@@ -35,7 +36,7 @@ from contextlib import contextmanager
 from typing import Optional
 
 from ..config import TpuConf, conf as _conf, _positive
-from .memory import is_oom_error
+from .memory import CorruptBlockError, is_oom_error
 
 COREDUMP_PATH = _conf(
     "spark.rapids.tpu.coredump.path", "",
@@ -51,12 +52,18 @@ INJECT_FATAL = _conf(
 RETRYABLE = "retryable"
 FATAL_DEVICE = "fatal_device"
 QUERY = "query"
+IO = "io"                      # transient host IO — the retry.io ladder
+CORRUPTION = "corruption"      # checksummed block failed verification:
+                               # data loss, fail the query cleanly
 
 _FATAL_MARKERS = (
     "INTERNAL:", "DATA_LOSS", "device halted", "Device halted",
     "FAILED_PRECONDITION: The program continuator has halted",
     "XLA:TPU compile permanent error", "tpu driver",
 )
+
+
+_DUMP_SEQ = itertools.count()
 
 
 class FatalDeviceError(RuntimeError):
@@ -75,6 +82,8 @@ class InjectedFatalError(Exception):
 def classify(exc: BaseException) -> str:
     if isinstance(exc, (FatalDeviceError, InjectedFatalError)):
         return FATAL_DEVICE
+    if isinstance(exc, CorruptBlockError):
+        return CORRUPTION
     if is_oom_error(exc):
         return RETRYABLE
     s = str(exc)
@@ -83,6 +92,8 @@ def classify(exc: BaseException) -> str:
                            or "XlaRuntimeError" in type(exc).__name__)
     if from_device_runtime and any(m in s for m in _FATAL_MARKERS):
         return FATAL_DEVICE
+    if isinstance(exc, OSError):
+        return IO
     return QUERY
 
 
@@ -119,9 +130,18 @@ def write_crash_dump(conf: TpuConf, exc: BaseException,
         budget = getattr(ctx, "_budget", None)
         if budget is not None:
             info["memory_budget"] = dict(getattr(budget, "metrics", {}))
+    # the injected-fault record: when chaos is armed, a post-mortem must
+    # show exactly which synthetic faults fired before the crash
+    from .faults import get_active_injector, get_injector
+    for inj in (get_active_injector(), get_injector(conf)):
+        if getattr(inj, "log", None):
+            info["injected_faults"] = list(inj.log)
+            break
+    # pid+second collides when two failures land in the same second: a
+    # process-monotonic sequence keeps every dump
     path = os.path.join(dump_dir,
                         f"tpu-coredump-{os.getpid()}-{int(time.time())}"
-                        f".json")
+                        f"-{next(_DUMP_SEQ)}.json")
     with open(path, "w") as f:
         json.dump(info, f, indent=2, default=str)
     return path
@@ -146,10 +166,15 @@ def crash_capture(conf: TpuConf, ctx=None):
 
 
 def install_fault_injection(root, conf: TpuConf) -> None:
-    """Wrap a physical root's execute stream with the batch-count fatal
-    injector when the test conf asks for it (injectRetryOOM's sibling)."""
+    """Wrap a physical root's execute stream with the per-batch fault
+    sites: the legacy batch-count fatal injector (injectRetryOOM's
+    sibling) and the chaos harness's `execute` site (runtime/faults.py),
+    which fires once per device batch the root emits."""
+    from .faults import get_injector
+    chaos = get_injector(conf)
     thr = int(conf.get(INJECT_FATAL))
-    if not thr or getattr(root, "_fatal_injected", False):
+    if (not thr and not chaos.has_site("execute")) or \
+            getattr(root, "_fatal_injected", False):
         return
     inj = FatalInjector(conf)
     orig = root.execute
@@ -157,6 +182,7 @@ def install_fault_injection(root, conf: TpuConf) -> None:
     def wrapped(ctx):
         for b in orig(ctx):
             inj.tick()
+            chaos.fire("execute")
             yield b
 
     root.execute = wrapped

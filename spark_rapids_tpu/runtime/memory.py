@@ -23,6 +23,7 @@ import os
 import tempfile
 import threading
 from collections import OrderedDict
+from contextlib import contextmanager
 from typing import Optional
 
 import pyarrow as pa
@@ -40,6 +41,17 @@ class TpuRetryOOM(RuntimeError):
 
 class TpuSplitAndRetryOOM(TpuRetryOOM):
     """Retry after splitting the input — the GpuSplitAndRetryOOM analogue."""
+
+
+class CorruptBlockError(RuntimeError):
+    """A checksummed spill/shuffle block failed verification: the bytes
+    the query needs are gone, so retrying cannot help — fail the query
+    cleanly with a classified error (runtime.failure CORRUPTION class)
+    instead of surfacing the raw native IO error."""
+
+    def __init__(self, msg: str, path: Optional[str] = None):
+        super().__init__(msg)
+        self.path = path
 
 
 def is_oom_error(exc: BaseException) -> bool:
@@ -86,9 +98,16 @@ class MemoryBudget:
         # once — the reference's spark.rapids.sql.test.injectRetryOOM
         self._inject_at = conf.get(TEST_INJECT_RETRY_OOM)
         self._reservations = 0
+        # chaos harness: the `reserve` fault site fires per admission
+        from .faults import get_injector
+        self._injector = get_injector(conf)
+        # per-thread stack of attempt scopes (retry-ladder rollback)
+        self._tls = threading.local()
         self.metrics = {"spilled_batches": 0, "spilled_bytes": 0,
                         "disk_batches": 0, "oom_retries": 0,
-                        "batch_splits": 0, "peak_bytes": 0}
+                        "batch_splits": 0, "peak_bytes": 0,
+                        "release_underflow": 0, "io_retries": 0,
+                        "attempt_rollback_bytes": 0}
 
     # -- registration ------------------------------------------------------
     def register(self, sp: "Spillable") -> int:
@@ -107,8 +126,40 @@ class MemoryBudget:
             if sid in self._spillables:
                 self._spillables.move_to_end(sid)
 
+    # -- attempt scopes (retry-ladder rollback) ----------------------------
+    def _scopes(self) -> list:
+        st = getattr(self._tls, "scopes", None)
+        if st is None:
+            st = self._tls.scopes = []
+        return st
+
+    @contextmanager
+    def track_attempt(self):
+        """Track this thread's net *naked* reservations (direct reserve/
+        release pairs; Spillable-owned bytes are excluded — the spillable
+        owns their lifecycle) so the retry ladder can release what a
+        failed attempt leaked before replaying or escaping
+        (runtime/retry.py)."""
+        scope = _AttemptScope()
+        st = self._scopes()
+        st.append(scope)
+        try:
+            yield scope
+        finally:
+            st.pop()
+
+    def rollback_attempt(self, scope: "_AttemptScope"):
+        """Release the positive leftover of a failed attempt's naked
+        reservations (call after the scope exits)."""
+        leftover = scope.naked
+        if leftover > 0:
+            self.release(leftover, _tracked=False)
+            with self._lock:
+                self.metrics["attempt_rollback_bytes"] += leftover
+        scope.naked = 0
+
     # -- accounting --------------------------------------------------------
-    def reserve(self, nbytes: int):
+    def reserve(self, nbytes: int, _tracked: bool = True):
         """Admit `nbytes` of new device data, spilling LRU batches first.
         Raises TpuRetryOOM when the budget cannot fit even after spilling
         everything (the DeviceMemoryEventHandler contract)."""
@@ -118,25 +169,33 @@ class MemoryBudget:
                 self.metrics["oom_retries"] += 1
                 raise TpuRetryOOM("injected OOM "
                                   f"(reservation #{self._reservations})")
-            if not self.limit:
-                self.live += nbytes
-                if self.live > self.metrics["peak_bytes"]:
-                    self.metrics["peak_bytes"] = self.live
-                return
-            while self.live + nbytes > self.limit:
-                if not self._spill_one():
-                    raise TpuRetryOOM(
-                        f"HBM budget exhausted: live={self.live} "
-                        f"+ {nbytes} > limit={self.limit} with nothing "
-                        "left to spill")
+            self._injector.fire("reserve")
+            if self.limit:
+                while self.live + nbytes > self.limit:
+                    if not self._spill_one():
+                        raise TpuRetryOOM(
+                            f"HBM budget exhausted: live={self.live} "
+                            f"+ {nbytes} > limit={self.limit} with "
+                            "nothing left to spill")
             self.live += nbytes
+            if _tracked:
+                for scope in self._scopes():
+                    scope.naked += nbytes
             # device-memory high-water (the profile's peak-usage line)
             if self.live > self.metrics["peak_bytes"]:
                 self.metrics["peak_bytes"] = self.live
 
-    def release(self, nbytes: int):
+    def release(self, nbytes: int, _tracked: bool = True):
         with self._lock:
             self.live -= nbytes
+            if _tracked:
+                for scope in self._scopes():
+                    scope.naked -= nbytes
+            if self.live < 0:
+                # double-release: clamp so the budget doesn't silently
+                # widen, and count it — chaos/regression tests assert 0
+                self.metrics["release_underflow"] += 1
+                self.live = 0
 
     def _spill_one(self) -> bool:
         for sp in self._spillables.values():
@@ -165,6 +224,9 @@ class MemoryBudget:
     def host_release(self, nbytes: int):
         with self._lock:
             self.host_live -= nbytes
+            if self.host_live < 0:
+                self.metrics["release_underflow"] += 1
+                self.host_live = 0
 
     def _disk_one(self) -> bool:
         for sp in self._spillables.values():
@@ -177,6 +239,16 @@ class MemoryBudget:
         if self._disk_dir is None:
             self._disk_dir = tempfile.mkdtemp(prefix="srtpu_spill_")
         return self._disk_dir
+
+
+class _AttemptScope:
+    """Net naked-reservation delta of one retry-ladder attempt on one
+    thread (see MemoryBudget.track_attempt)."""
+
+    __slots__ = ("naked",)
+
+    def __init__(self):
+        self.naked = 0
 
 
 class Spillable:
@@ -193,7 +265,9 @@ class Spillable:
         # lazily coerced: a device-resident row count stays on device
         # until someone actually needs the host value (spill does anyway)
         self._num_rows = db.num_rows
-        budget.reserve(self._nbytes)
+        # untracked: the spillable owns these bytes' lifecycle; attempt
+        # scopes roll back only naked reservations (track_attempt)
+        budget.reserve(self._nbytes, _tracked=False)
         self._sid = budget.register(self)
 
     @property
@@ -218,35 +292,45 @@ class Spillable:
                 return
             hb = to_host(self._db)
             self._db = None
-            self._budget.release(self._nbytes)
+            self._budget.release(self._nbytes, _tracked=False)
             self._budget.metrics["spilled_batches"] += 1
             self._budget.metrics["spilled_bytes"] += self._nbytes
             from ..obs.tracer import get_active
             get_active().instant("spill", "runtime", tier="host",
                                  bytes=self._nbytes)
-            self._hb = hb
+            # reserve BEFORE publishing the host tier: host_reserve may
+            # drive _disk_one(), and finding THIS batch on_host would
+            # release bytes that were never added (host-budget underflow)
             self._budget.host_reserve(hb.rb.nbytes)
+            self._hb = hb
 
     def to_disk(self):
         """host -> disk tier: Arrow IPC payload inside a checksummed
         native block (native/spillio.cpp — the RapidsDiskStore writes;
-        the C write path releases the GIL under spill worker threads)."""
-        if self._hb is None:
-            return
-        from .. import native
-        path = os.path.join(self._budget.disk_dir(),
-                            f"spill_{self._sid}.blk")
-        sink = pa.BufferOutputStream()
-        with pa.ipc.new_stream(sink, self._hb.rb.schema) as w:
-            w.write_batch(self._hb.rb)
-        native.spill_write(path, sink.getvalue())   # zero-copy pa.Buffer
-        self._budget.host_release(self._hb.rb.nbytes)
-        self._budget.metrics["disk_batches"] += 1
-        from ..obs.tracer import get_active
-        get_active().instant("spill", "runtime", tier="disk",
-                             bytes=self._hb.rb.nbytes)
-        self._hb = None
-        self._path = path
+        the C write path releases the GIL under spill worker threads).
+        Holds the budget lock: a concurrent reserve() driving
+        _disk_one() must not race the owner's get()."""
+        with self._budget._lock:
+            if self._hb is None:
+                return
+            from .. import native
+            from .retry import retry_io
+            path = os.path.join(self._budget.disk_dir(),
+                                f"spill_{self._sid}.blk")
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, self._hb.rb.schema) as w:
+                w.write_batch(self._hb.rb)
+            payload = sink.getvalue()               # zero-copy pa.Buffer
+            retry_io(self._budget.conf, "spill_write",
+                     lambda: native.spill_write(path, payload),
+                     budget=self._budget)
+            self._budget.host_release(self._hb.rb.nbytes)
+            self._budget.metrics["disk_batches"] += 1
+            from ..obs.tracer import get_active
+            get_active().instant("spill", "runtime", tier="disk",
+                                 bytes=self._hb.rb.nbytes)
+            self._hb = None
+            self._path = path
 
     def get(self) -> DeviceBatch:
         """Materialize on device (re-uploading through the budget).  The
@@ -275,7 +359,27 @@ class Spillable:
             return self._hb
         assert self._path is not None, "spillable lost all tiers"
         from .. import native
-        payload = native.spill_read(self._path)     # checksum-verified
+        from .retry import retry_io
+        path = self._path
+
+        def _read():
+            try:
+                return native.spill_read(path)      # checksum-verified
+            except OSError as e:
+                if "checksum" in str(e) or "magic" in str(e):
+                    # verification failure is data loss, not a transient
+                    # fault: classify and fail the query cleanly (the
+                    # IO retry ladder must not spin on it)
+                    from ..obs.tracer import get_active
+                    get_active().instant("corrupt_block", "runtime",
+                                         path=path)
+                    raise CorruptBlockError(
+                        f"spill block failed checksum verification: "
+                        f"{path} ({e})", path=path) from e
+                raise
+
+        payload = retry_io(self._budget.conf, "spill_read", _read,
+                           budget=self._budget, info={"path": path})
         reader = pa.ipc.open_stream(pa.BufferReader(payload))
         rb = reader.read_next_batch()
         return HostBatch(rb)

@@ -17,15 +17,56 @@ gathers, so replaying is safe by construction.
 """
 from __future__ import annotations
 
-from typing import Callable, Iterator, List, TypeVar
+import time
+from typing import Callable, Iterator, List, Optional, TypeVar
 
 import jax.numpy as jnp
 
 from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
-from ..config import RETRY_ENABLED, RETRY_MAX_SPLITS, TpuConf
+from ..config import (RETRY_ENABLED, RETRY_IO_ATTEMPTS,
+                      RETRY_IO_BACKOFF_MS, RETRY_IO_BACKOFF_MULT,
+                      RETRY_MAX_ATTEMPTS, RETRY_MAX_SPLITS, TpuConf)
 from .memory import MemoryBudget, TpuRetryOOM, is_oom_error
 
 T = TypeVar("T")
+
+
+def retry_io(conf: TpuConf, site: str, attempt: Callable[[], T],
+             budget: Optional[MemoryBudget] = None,
+             info: Optional[dict] = None) -> T:
+    """Bounded retry-with-backoff for transient host IO (spill block
+    read/write, shuffle write/fetch, host<->device transfers) — the
+    `spark.rapids.tpu.retry.io.*` ladder.
+
+    Each attempt first fires the chaos injector's `site` (so injected
+    IO faults land inside the retried unit and the recovery path is the
+    one under test), then runs `attempt`.  OSErrors retry up to
+    maxAttempts with exponential backoff, emitting an `io_retry` obs
+    instant per recovery; anything else (including CorruptBlockError —
+    verification failure is data loss, not transience) escapes
+    immediately."""
+    from .faults import get_injector
+    inj = get_injector(conf)
+    attempts = int(conf.get(RETRY_IO_ATTEMPTS))
+    backoff = float(conf.get(RETRY_IO_BACKOFF_MS)) / 1000.0
+    mult = float(conf.get(RETRY_IO_BACKOFF_MULT))
+    kw = info or {}
+    for i in range(max(attempts, 1)):
+        try:
+            inj.fire(site, **kw)
+            return attempt()
+        except OSError as e:
+            if i + 1 >= max(attempts, 1):
+                raise
+            from ..obs.tracer import get_active
+            get_active().instant("io_retry", "runtime", site=site,
+                                 attempt=i + 1, error=type(e).__name__)
+            if budget is not None:
+                budget.metrics["io_retries"] += 1
+            if backoff > 0:
+                time.sleep(backoff)
+            backoff *= mult
+    raise AssertionError("unreachable")
 
 
 def split_batch(db: DeviceBatch, conf: TpuConf) -> List[DeviceBatch]:
@@ -56,21 +97,28 @@ def slice_batch(db: DeviceBatch, start: int, stop: int,
 
 def with_retry(budget: MemoryBudget, conf: TpuConf,
                attempt: Callable[[], T]) -> T:
-    """Replay `attempt` once after a spill-everything on OOM
-    (withRetryNoSplit)."""
+    """Replay `attempt` after a spill-everything on OOM, up to the
+    configured attempt ladder depth (withRetryNoSplit upgraded:
+    spark.rapids.tpu.sql.retry.maxAttempts rungs; a failed attempt's
+    partial naked reservations are released before replay or escape)."""
     if not conf.get(RETRY_ENABLED):
         return attempt()
-    try:
-        return attempt()
-    except Exception as e:                       # noqa: BLE001
-        if not is_oom_error(e):
-            raise
+    from ..obs.tracer import get_active
+    max_attempts = max(int(conf.get(RETRY_MAX_ATTEMPTS)), 1)
+    for i in range(max_attempts):
+        with budget.track_attempt() as scope:
+            try:
+                return attempt()
+            except Exception as e:               # noqa: BLE001
+                err, oom = e, is_oom_error(e)
+        budget.rollback_attempt(scope)
+        if not oom or i + 1 >= max_attempts:
+            raise err
         budget.metrics["oom_retries"] += 1
-        from ..obs.tracer import get_active
         get_active().instant("oom_retry", "runtime",
-                             error=type(e).__name__)
+                             error=type(err).__name__, attempt=i + 1)
         budget.spill_all()
-        return attempt()
+    raise AssertionError("unreachable")
 
 
 def with_split_retry(budget: MemoryBudget, conf: TpuConf,
@@ -85,27 +133,36 @@ def with_split_retry(budget: MemoryBudget, conf: TpuConf,
         return
     from ..obs.tracer import get_active
     max_splits = conf.get(RETRY_MAX_SPLITS)
+    max_attempts = max(int(conf.get(RETRY_MAX_ATTEMPTS)), 1)
     pending: List[tuple] = [(batch, 0)]          # (batch, splits so far)
     while pending:
         b, depth = pending.pop(0)
-        try:
-            yield attempt(b)
+        done = False
+        last_oom = None
+        for i in range(max_attempts):
+            with budget.track_attempt() as scope:
+                try:
+                    result = attempt(b)
+                    done = True
+                except Exception as e:           # noqa: BLE001
+                    err, oom = e, is_oom_error(e)
+            if done:
+                yield result
+                break
+            budget.rollback_attempt(scope)
+            if not oom:
+                raise err
+            last_oom = err
+            if i + 1 < max_attempts:
+                budget.metrics["oom_retries"] += 1
+                get_active().instant("oom_retry", "runtime", depth=depth,
+                                     attempt=i + 1)
+                budget.spill_all()
+        if done:
             continue
-        except Exception as e:                   # noqa: BLE001
-            if not is_oom_error(e):
-                raise
-        budget.metrics["oom_retries"] += 1
-        get_active().instant("oom_retry", "runtime", depth=depth)
-        budget.spill_all()
-        try:
-            yield attempt(b)
-            continue
-        except Exception as e:                   # noqa: BLE001
-            if not is_oom_error(e):
-                raise
-            if depth >= max_splits:
-                raise TpuRetryOOM(
-                    f"OOM persists after {depth} splits") from e
+        if depth >= max_splits:
+            raise TpuRetryOOM(
+                f"OOM persists after {depth} splits") from last_oom
         budget.metrics["batch_splits"] += 1
         get_active().instant("batch_split", "runtime", depth=depth + 1)
         halves = split_batch(b, conf)

@@ -109,23 +109,34 @@ class ShuffleManager:
         """Split one host batch by partition id and store each slice
         (serialization + compression fan out on the thread pool).
         Returns the total serialized bytes written — the MapStatus-bytes
-        number the shuffle metrics and AQE planning both consume."""
+        number the shuffle metrics and AQE planning both consume.
+
+        Writes are transactional per call: every slice serializes first,
+        then all payloads publish to the store together — a failure
+        mid-serialization leaves nothing behind, so the IO retry ladder
+        (runtime/retry.py retry_io) can replay the whole call without
+        duplicating partitions."""
         rb = hb.rb
         order = np.argsort(part_ids, kind="stable")
         sorted_ids = part_ids[order]
         bounds = np.searchsorted(sorted_ids, np.arange(num_partitions + 1))
         idx_arr = pa.array(order)
 
-        def ser(p: int) -> int:
+        def ser(p: int):
             s, e = bounds[p], bounds[p + 1]
             if s == e:
-                return 0
+                return None
             sl = rb.take(idx_arr.slice(s, e - s))
-            payload = serialize_batch(sl, codec)
-            self.store.put(shuffle_id, p, payload)
-            return len(payload)
+            return serialize_batch(sl, codec)
 
-        return sum(self.pool.map(ser, range(num_partitions)))
+        payloads = list(self.pool.map(ser, range(num_partitions)))
+        total = 0
+        for p, payload in enumerate(payloads):
+            if payload is None:
+                continue
+            self.store.put(shuffle_id, p, payload)
+            total += len(payload)
+        return total
 
     def read_partition(self, shuffle_id: int, part_id: int,
                        block_range=None) -> List[pa.RecordBatch]:

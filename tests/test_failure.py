@@ -8,12 +8,14 @@ import pyarrow as pa
 import pytest
 
 from spark_rapids_tpu.config import TpuConf
-from spark_rapids_tpu.runtime.failure import (FATAL_DEVICE, QUERY,
-                                              RETRYABLE, FatalDeviceError,
+from spark_rapids_tpu.runtime.failure import (CORRUPTION, FATAL_DEVICE, IO,
+                                              QUERY, RETRYABLE,
+                                              FatalDeviceError,
+                                              FatalInjector,
                                               InjectedFatalError, classify,
                                               crash_capture,
                                               write_crash_dump)
-from spark_rapids_tpu.runtime.memory import TpuRetryOOM
+from spark_rapids_tpu.runtime.memory import CorruptBlockError, TpuRetryOOM
 from spark_rapids_tpu.session import TpuSession, col
 from spark_rapids_tpu.plan import expressions as E
 
@@ -29,6 +31,76 @@ def test_classify_fatal_and_query():
     assert classify(ValueError("user bug")) == QUERY
     # a plain python error mentioning INTERNAL: is NOT device-fatal
     assert classify(ValueError("INTERNAL: not from xla")) == QUERY
+
+
+def test_classify_io_and_corruption():
+    assert classify(IOError("disk gone away")) == IO
+    assert classify(OSError(5, "Input/output error")) == IO
+    assert classify(CorruptBlockError("checksum mismatch",
+                                      path="/x.blk")) == CORRUPTION
+    # corruption wins over the generic OSError bucket for causes chained
+    # through CorruptBlockError
+    assert CorruptBlockError("x").path is None
+
+
+class XlaRuntimeError(Exception):
+    """Stand-in with the runtime's type name — classify matches on the
+    name, the way it sees the real jaxlib class."""
+
+
+def test_classify_realistic_xla_runtime_errors():
+    # real-world XlaRuntimeError payloads (SURVEY §5 / jax issue trackers)
+    fatal_msgs = [
+        "INTERNAL: Failed to execute XLA Runtime executable",
+        "FAILED_PRECONDITION: The program continuator has halted "
+        "unexpectedly",
+        "INTERNAL: Accelerator device halted prematurely",
+        "UNKNOWN: XLA:TPU compile permanent error: Ran out of memory "
+        "in memory space hbm (but marked permanent)",
+        "ABORTED: tpu driver terminated unexpectedly",
+    ]
+    for msg in fatal_msgs:
+        assert classify(XlaRuntimeError(msg)) == FATAL_DEVICE, msg
+    # retryable/query payloads with the same type must NOT be fatal
+    assert classify(XlaRuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 1073741824 "
+        "bytes")) == RETRYABLE
+    assert classify(XlaRuntimeError(
+        "INVALID_ARGUMENT: Argument does not match host shape")) == QUERY
+    # fatal markers in a NON-device exception type stay query errors
+    for msg in fatal_msgs:
+        assert classify(RuntimeError(msg)) == QUERY, msg
+
+
+def test_fatal_injector_one_shot():
+    conf = TpuConf({"spark.rapids.tpu.test.injectFatalError": "3"})
+    inj = FatalInjector(conf)
+    inj.tick()
+    inj.tick()
+    with pytest.raises(InjectedFatalError):
+        inj.tick()
+    # one-shot: once fired, the injector disarms for good
+    for _ in range(5):
+        inj.tick()
+    assert inj.threshold == 0
+
+
+def test_fatal_injector_disabled_never_fires():
+    inj = FatalInjector(TpuConf())
+    for _ in range(10):
+        inj.tick()
+
+
+def test_crash_dump_names_never_collide(tmp_path):
+    # two failures in the same epoch second must both keep their dumps
+    # (the <seq> suffix): pid+second alone collided before
+    conf = TpuConf({"spark.rapids.tpu.coredump.path": str(tmp_path)})
+    paths = {write_crash_dump(conf, InjectedFatalError(f"boom {i}"))
+             for i in range(5)}
+    assert len(paths) == 5
+    assert all(os.path.exists(p) for p in paths)
+    contents = {json.load(open(p))["exception"] for p in paths}
+    assert len(contents) == 5
 
 
 def test_crash_capture_writes_dump(tmp_path):

@@ -417,6 +417,157 @@ def test_agg_fallback_limit_no_spill_leak():
     assert len(ctx.budget._spillables) == 0
 
 
+def test_release_underflow_clamped_and_counted():
+    """A double-release must not drive `live` negative (silently widening
+    the budget) — it clamps at 0 and counts in release_underflow."""
+    budget = MemoryBudget(small_conf())
+    budget.reserve(100)
+    budget.release(100)
+    budget.release(50)                 # the double release
+    assert budget.live == 0
+    assert budget.metrics["release_underflow"] == 1
+    budget.host_reserve(10)
+    budget.host_release(10)
+    budget.host_release(10)
+    assert budget.host_live == 0
+    assert budget.metrics["release_underflow"] == 2
+
+
+def test_clean_paths_never_underflow():
+    """The engine's own spill/close lifecycle must be underflow-free —
+    the clamp is a tripwire, not a crutch."""
+    conf = small_conf(budget=1 << 16,
+                      **{"spark.rapids.tpu.memory.host.spillStorageSize":
+                         1 << 14})
+    budget = MemoryBudget(conf)
+    sps = [Spillable(make_batch(1000, conf, seed=i), budget)
+           for i in range(6)]
+    for sp in sps:
+        assert int(sp.get().num_rows) == 1000
+        sp.spill()
+    for sp in sps:
+        sp.close()
+    assert budget.metrics["release_underflow"] == 0
+    assert budget.live == 0 and budget.host_live == 0
+
+
+def test_to_disk_holds_budget_lock_against_concurrent_get():
+    """A reserve()-driven _disk_one() racing the owner's get() must
+    serialize on the budget lock (satellite: to_disk previously wrote
+    and dropped the host tier without the lock)."""
+    import threading
+    conf = small_conf(budget=1 << 20,
+                      **{"spark.rapids.tpu.memory.host.spillStorageSize":
+                         1 << 13})
+    budget = MemoryBudget(conf)
+    sp = Spillable(make_batch(2000, conf), budget)
+    sp.spill()                          # host tier, eligible for disk
+    errors = []
+
+    def hammer_get():
+        try:
+            for _ in range(20):
+                assert int(sp.get().num_rows) == 2000
+                sp.spill()
+        except Exception as e:          # noqa: BLE001
+            errors.append(e)
+
+    def hammer_disk():
+        try:
+            for _ in range(20):
+                sp.to_disk()
+        except Exception as e:          # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer_get),
+               threading.Thread(target=hammer_disk)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert int(sp.get().num_rows) == 2000
+    sp.close()
+    assert budget.metrics["release_underflow"] == 0
+
+
+def test_with_retry_rolls_back_naked_reservations_on_query_error():
+    budget = MemoryBudget(small_conf())
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        budget.reserve(256)             # leaked by the failure below
+        raise ValueError("not an OOM")
+
+    with pytest.raises(ValueError):
+        with_retry(budget, small_conf(), attempt)
+    assert len(calls) == 1              # non-OOM never replays
+    assert budget.live == 0
+    assert budget.metrics["attempt_rollback_bytes"] == 256
+
+
+def test_with_retry_attempt_ladder_depth():
+    conf = small_conf(**{"spark.rapids.tpu.sql.retry.maxAttempts": 4})
+    budget = MemoryBudget(conf)
+    n = []
+
+    def attempt():
+        n.append(1)
+        budget.reserve(64)
+        raise TpuRetryOOM("persistent")
+
+    with pytest.raises(TpuRetryOOM):
+        with_retry(budget, conf, attempt)
+    assert len(n) == 4
+    assert budget.live == 0             # every rung rolled back
+    assert budget.metrics["oom_retries"] == 3
+
+
+def test_with_split_retry_rolls_back_between_attempts():
+    conf = small_conf()
+    budget = MemoryBudget(conf)
+    db = make_batch(1000, conf)
+    seen = []
+
+    def attempt(b):
+        n = int(b.num_rows)
+        budget.reserve(128)
+        if n > 300:
+            seen.append(n)
+            raise TpuRetryOOM(f"too big: {n}")
+        budget.release(128)
+        return n
+
+    outs = list(with_split_retry(budget, conf, db, attempt))
+    assert sum(outs) == 1000
+    assert budget.live == 0
+    assert budget.metrics["attempt_rollback_bytes"] >= 128 * len(seen)
+
+
+def test_spillable_bytes_not_rolled_back():
+    """Rollback must only release NAKED reservations: bytes owned by a
+    Spillable created during the attempt belong to its lifecycle."""
+    conf = small_conf()
+    budget = MemoryBudget(conf)
+    holder = []
+
+    def attempt():
+        if not holder:
+            holder.append(Spillable(make_batch(500, conf), budget))
+            raise TpuRetryOOM("first attempt fails after registering")
+        return "ok"
+
+    assert with_retry(budget, conf, attempt) == "ok"
+    sp = holder[0]
+    # the retry's spill_all may have demoted it, but it stays readable
+    # and its accounting intact (no rollback double-release)
+    assert int(sp.get().num_rows) == 500
+    sp.close()
+    assert budget.live == 0
+    assert budget.metrics["release_underflow"] == 0
+
+
 def test_variance_nan_propagates():
     from spark_rapids_tpu.plan import logical as L
     from spark_rapids_tpu.plan.overrides import apply_overrides
