@@ -62,6 +62,63 @@ def is_oom_error(exc: BaseException) -> bool:
     return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s
 
 
+class _YieldableRLock:
+    """Re-entrant budget lock whose full hold can be temporarily yielded.
+
+    The spill chain (reserve -> _spill_one -> spill -> host_reserve ->
+    _disk_one -> to_disk) holds the budget lock re-entrantly, so an
+    inner frame cannot drop a plain threading.RLock around an IO
+    backoff sleep.  `yielded()` releases the whole re-entrant hold for
+    the duration of the sleep and restores it afterwards, so a retried
+    disk write (retry_io) never stalls other threads' reserve/release
+    traffic behind its backoff."""
+
+    def __init__(self):
+        self._block = threading.Lock()
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self) -> bool:
+        me = threading.get_ident()
+        if self._owner == me:
+            self._count += 1
+            return True
+        self._block.acquire()
+        self._owner = me
+        self._count = 1
+        return True
+
+    def release(self):
+        if self._owner != threading.get_ident():
+            raise RuntimeError("release of un-acquired budget lock")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+            self._block.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    @contextmanager
+    def yielded(self):
+        """Fully release this thread's hold for the body, then restore
+        it at the same re-entrancy depth (no-op for a non-holder)."""
+        me = threading.get_ident()
+        if self._owner != me:
+            yield
+            return
+        count, self._count, self._owner = self._count, 0, None
+        self._block.release()
+        try:
+            yield
+        finally:
+            self._block.acquire()
+            self._owner = me
+            self._count = count
+
+
 def device_hbm_bytes() -> Optional[int]:
     """Total bytes of the addressable device's memory, if discoverable."""
     import jax
@@ -90,7 +147,7 @@ class MemoryBudget:
         self.conf = conf
         self.live = 0                 # bytes of registered device batches
         self.host_live = 0
-        self._lock = threading.RLock()
+        self._lock = _YieldableRLock()
         self._spillables: "OrderedDict[int, Spillable]" = OrderedDict()
         self._next_id = 0
         self._disk_dir: Optional[str] = None
@@ -156,6 +213,11 @@ class MemoryBudget:
             self.release(leftover, _tracked=False)
             with self._lock:
                 self.metrics["attempt_rollback_bytes"] += leftover
+                # reserve() counted these bytes into every scope on the
+                # stack, so the enclosing rungs of a nested ladder must
+                # not release them a second time
+                for outer in self._scopes():
+                    outer.naked -= leftover
         scope.naked = 0
 
     # -- accounting --------------------------------------------------------
@@ -230,7 +292,9 @@ class MemoryBudget:
 
     def _disk_one(self) -> bool:
         for sp in self._spillables.values():
-            if sp.on_host:
+            # skip spillables whose disk write is mid-backoff with the
+            # lock yielded: a second to_disk would double-write
+            if sp.on_host and not sp._writing:
                 sp.to_disk()
                 return True
         return False
@@ -269,6 +333,7 @@ class Spillable:
         # scopes roll back only naked reservations (track_attempt)
         budget.reserve(self._nbytes, _tracked=False)
         self._sid = budget.register(self)
+        self._writing = False            # disk write in flight (to_disk)
 
     @property
     def num_rows(self) -> int:
@@ -309,26 +374,43 @@ class Spillable:
         native block (native/spillio.cpp — the RapidsDiskStore writes;
         the C write path releases the GIL under spill worker threads).
         Holds the budget lock: a concurrent reserve() driving
-        _disk_one() must not race the owner's get()."""
+        _disk_one() must not race the owner's get().  The retried
+        write's backoff sleeps yield the lock (retry_io) so the budget
+        stays responsive; the _writing flag keeps a concurrent
+        _disk_one() off this spillable meanwhile, and the host tier is
+        only dropped if it survived the yield unchanged."""
         with self._budget._lock:
-            if self._hb is None:
+            if self._hb is None or self._writing:
                 return
             from .. import native
             from .retry import retry_io
+            hb = self._hb
             path = os.path.join(self._budget.disk_dir(),
                                 f"spill_{self._sid}.blk")
             sink = pa.BufferOutputStream()
-            with pa.ipc.new_stream(sink, self._hb.rb.schema) as w:
-                w.write_batch(self._hb.rb)
+            with pa.ipc.new_stream(sink, hb.rb.schema) as w:
+                w.write_batch(hb.rb)
             payload = sink.getvalue()               # zero-copy pa.Buffer
-            retry_io(self._budget.conf, "spill_write",
-                     lambda: native.spill_write(path, payload),
-                     budget=self._budget)
-            self._budget.host_release(self._hb.rb.nbytes)
+            self._writing = True
+            try:
+                retry_io(self._budget.conf, "spill_write",
+                         lambda: native.spill_write(path, payload),
+                         budget=self._budget, lock=self._budget._lock)
+            finally:
+                self._writing = False
+            if self._hb is not hb:
+                # the owner re-uploaded or closed while the lock was
+                # yielded: the host tier moved on, the block is stale
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return
+            self._budget.host_release(hb.rb.nbytes)
             self._budget.metrics["disk_batches"] += 1
             from ..obs.tracer import get_active
             get_active().instant("spill", "runtime", tier="disk",
-                                 bytes=self._hb.rb.nbytes)
+                                 bytes=hb.rb.nbytes)
             self._hb = None
             self._path = path
 
@@ -339,11 +421,17 @@ class Spillable:
         with self._budget._lock:
             if self._db is None:
                 hb = self._host_batch()
-                self._budget.reserve(self._nbytes)
-                self._db = to_device(hb, self._budget.conf)
-                if self._hb is not None:
-                    self._budget.host_release(self._hb.rb.nbytes)
-                self._hb = None
+                # recheck: a disk read's backoff yields the lock, so a
+                # concurrent get() may have re-uploaded already
+                if self._db is None:
+                    # untracked like __init__/spill: the spillable owns
+                    # these bytes, so a failed attempt's rollback must
+                    # not release them while the batch is live on device
+                    self._budget.reserve(self._nbytes, _tracked=False)
+                    self._db = to_device(hb, self._budget.conf)
+                    if self._hb is not None:
+                        self._budget.host_release(self._hb.rb.nbytes)
+                    self._hb = None
             self._budget.touch(self._sid)
             return self._db
 
@@ -379,7 +467,8 @@ class Spillable:
                 raise
 
         payload = retry_io(self._budget.conf, "spill_read", _read,
-                           budget=self._budget, info={"path": path})
+                           budget=self._budget, info={"path": path},
+                           lock=self._budget._lock)
         reader = pa.ipc.open_stream(pa.BufferReader(payload))
         rb = reader.read_next_batch()
         return HostBatch(rb)
@@ -388,7 +477,10 @@ class Spillable:
         with self._budget._lock:
             self._budget.unregister(self._sid)
             if self._db is not None:
-                self._budget.release(self._nbytes)
+                # untracked for the same reason __init__/spill are: an
+                # attempt scope must not mistake this spillable-owned
+                # release for a naked reservation being returned
+                self._budget.release(self._nbytes, _tracked=False)
                 self._db = None
             if self._hb is not None:
                 self._budget.host_release(self._hb.rb.nbytes)

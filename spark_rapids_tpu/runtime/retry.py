@@ -33,7 +33,7 @@ T = TypeVar("T")
 
 def retry_io(conf: TpuConf, site: str, attempt: Callable[[], T],
              budget: Optional[MemoryBudget] = None,
-             info: Optional[dict] = None) -> T:
+             info: Optional[dict] = None, lock=None) -> T:
     """Bounded retry-with-backoff for transient host IO (spill block
     read/write, shuffle write/fetch, host<->device transfers) — the
     `spark.rapids.tpu.retry.io.*` ladder.
@@ -44,7 +44,13 @@ def retry_io(conf: TpuConf, site: str, attempt: Callable[[], T],
     maxAttempts with exponential backoff, emitting an `io_retry` obs
     instant per recovery; anything else (including CorruptBlockError —
     verification failure is data loss, not transience) escapes
-    immediately."""
+    immediately.
+
+    `lock` (the budget's yieldable re-entrant lock) is fully released
+    for the duration of each backoff sleep and restored after, so a
+    spill read/write that retries inside the budget-locked spill chain
+    does not stall every other thread's reserve/release behind its
+    backoff (memory.py _YieldableRLock)."""
     from .faults import get_injector
     inj = get_injector(conf)
     attempts = int(conf.get(RETRY_IO_ATTEMPTS))
@@ -64,7 +70,11 @@ def retry_io(conf: TpuConf, site: str, attempt: Callable[[], T],
             if budget is not None:
                 budget.metrics["io_retries"] += 1
             if backoff > 0:
-                time.sleep(backoff)
+                if lock is not None:
+                    with lock.yielded():
+                        time.sleep(backoff)
+                else:
+                    time.sleep(backoff)
             backoff *= mult
     raise AssertionError("unreachable")
 

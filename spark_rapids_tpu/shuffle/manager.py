@@ -36,6 +36,16 @@ class ShuffleBlockStore:
         with self._lock:
             self._blocks.setdefault((shuffle_id, part_id), []).append(payload)
 
+    def put_all(self, shuffle_id: int, payloads: Dict[int, bytes]) -> None:
+        """Publish every partition of one map-task write as a single
+        store transaction: the lock is held across all of them and the
+        in-memory appends cannot fail partway, so a retried write_batch
+        never observes — or duplicates — a half-published call."""
+        with self._lock:
+            for part_id, payload in payloads.items():
+                self._blocks.setdefault((shuffle_id, part_id),
+                                        []).append(payload)
+
     def get(self, shuffle_id: int, part_id: int) -> List[bytes]:
         with self._lock:
             return list(self._blocks.get((shuffle_id, part_id), []))
@@ -112,10 +122,10 @@ class ShuffleManager:
         number the shuffle metrics and AQE planning both consume.
 
         Writes are transactional per call: every slice serializes first,
-        then all payloads publish to the store together — a failure
-        mid-serialization leaves nothing behind, so the IO retry ladder
-        (runtime/retry.py retry_io) can replay the whole call without
-        duplicating partitions."""
+        then all payloads publish in one atomic store transaction
+        (put_all) — a failure anywhere leaves nothing behind, so the IO
+        retry ladder (runtime/retry.py retry_io) can replay the whole
+        call without duplicating partitions."""
         rb = hb.rb
         order = np.argsort(part_ids, kind="stable")
         sorted_ids = part_ids[order]
@@ -130,13 +140,10 @@ class ShuffleManager:
             return serialize_batch(sl, codec)
 
         payloads = list(self.pool.map(ser, range(num_partitions)))
-        total = 0
-        for p, payload in enumerate(payloads):
-            if payload is None:
-                continue
-            self.store.put(shuffle_id, p, payload)
-            total += len(payload)
-        return total
+        out = {p: payload for p, payload in enumerate(payloads)
+               if payload is not None}
+        self.store.put_all(shuffle_id, out)
+        return sum(len(p) for p in out.values())
 
     def read_partition(self, shuffle_id: int, part_id: int,
                        block_range=None) -> List[pa.RecordBatch]:

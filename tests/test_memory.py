@@ -568,6 +568,128 @@ def test_spillable_bytes_not_rolled_back():
     assert budget.metrics["release_underflow"] == 0
 
 
+def test_spillable_reupload_not_rolled_back():
+    """get()'s re-upload reservation is spillable-owned, not naked: a
+    failed attempt's rollback must not release bytes still live on
+    device (the subsequent spill_all would release them a second time
+    and permanently under-account the budget)."""
+    conf = small_conf()
+    budget = MemoryBudget(conf)
+    sp = Spillable(make_batch(500, conf), budget)
+    sp.spill()
+    assert budget.live == 0
+
+    def attempt():
+        sp.get()                        # re-upload through the budget
+        raise ValueError("not an OOM")
+
+    with pytest.raises(ValueError):
+        with_retry(budget, conf, attempt)
+    # the batch is still on device, so its bytes must still be counted
+    assert budget.live == sp._nbytes
+    assert budget.metrics["attempt_rollback_bytes"] == 0
+    budget.spill_all()
+    assert budget.live == 0
+    sp.close()
+    assert budget.live == 0 and budget.host_live == 0
+    assert budget.metrics["release_underflow"] == 0
+
+
+def test_spillable_close_keeps_naked_accounting():
+    """close() inside an attempt releases spillable-owned bytes; they
+    must not cancel out genuinely naked reservations in the scope."""
+    conf = small_conf()
+    budget = MemoryBudget(conf)
+    with budget.track_attempt() as scope:
+        budget.reserve(100)             # genuinely leaked
+        sp = Spillable(make_batch(400, conf), budget)
+        sp.close()
+        assert scope.naked == 100
+    budget.rollback_attempt(scope)
+    assert budget.live == 0
+    assert budget.metrics["attempt_rollback_bytes"] == 100
+    assert budget.metrics["release_underflow"] == 0
+
+
+def test_nested_attempt_rollback_consistent():
+    """reserve() counts into every scope on the stack, so an inner
+    rung's rollback must deduct from the enclosing scopes — otherwise
+    the outer rollback releases the same bytes twice."""
+    budget = MemoryBudget(small_conf())
+    with budget.track_attempt() as outer:
+        budget.reserve(50)
+        with budget.track_attempt() as inner:
+            budget.reserve(100)
+        budget.rollback_attempt(inner)
+        assert outer.naked == 50
+    budget.rollback_attempt(outer)
+    assert budget.live == 0
+    assert budget.metrics["release_underflow"] == 0
+    assert budget.metrics["attempt_rollback_bytes"] == 150
+
+
+def test_yieldable_budget_lock():
+    """_YieldableRLock: re-entrant hold, full release across yielded(),
+    restored depth afterwards."""
+    import threading
+    from spark_rapids_tpu.runtime.memory import _YieldableRLock
+    lk = _YieldableRLock()
+    got = threading.Event()
+    order = []
+
+    def contender():
+        with lk:
+            order.append("contender")
+        got.set()
+
+    with lk:
+        with lk:                        # depth 2
+            t = threading.Thread(target=contender)
+            t.start()
+            assert not got.wait(0.05)   # blocked while held
+            with lk.yielded():
+                assert got.wait(5.0)    # runs while yielded
+            order.append("owner")
+    t.join()
+    assert order == ["contender", "owner"]
+    # a non-holder's yielded() is a no-op
+    with lk.yielded():
+        pass
+
+
+def test_spill_write_backoff_does_not_stall_budget():
+    """A spill-write backoff sleep must not hold the budget lock:
+    other threads' reserve/release keep flowing while the retried
+    write backs off (retry_io yields the re-entrant hold)."""
+    import threading
+    import time
+    conf = small_conf(
+        **{"spark.rapids.tpu.test.faults": "spill_write:ioerror:nth=1",
+           "spark.rapids.tpu.retry.io.backoffMs": 1500,
+           "spark.rapids.tpu.retry.io.backoffMultiplier": 1.0})
+    budget = MemoryBudget(conf)
+    sp = Spillable(make_batch(500, conf), budget)
+    sp.spill()
+    t = threading.Thread(target=sp.to_disk)
+    t.start()
+    # wait until the injected first-attempt failure has been recovered
+    # into the backoff sleep
+    deadline = time.monotonic() + 10
+    while budget.metrics["io_retries"] < 1:
+        assert time.monotonic() < deadline, "injected fault never fired"
+        time.sleep(0.005)
+    t0 = time.monotonic()
+    budget.reserve(1)                   # must not wait out the backoff
+    budget.release(1)
+    took = time.monotonic() - t0
+    t.join()
+    assert took < 1.0, f"budget stalled {took:.2f}s behind the backoff"
+    assert budget.metrics["disk_batches"] == 1
+    assert int(sp.get().num_rows) == 500
+    sp.close()
+    assert budget.metrics["release_underflow"] == 0
+
+
 def test_variance_nan_propagates():
     from spark_rapids_tpu.plan import logical as L
     from spark_rapids_tpu.plan.overrides import apply_overrides

@@ -158,3 +158,36 @@ def test_exchange_applies_conf_codec():
         sizes[codec] = sum(
             get_shuffle_manager().partition_sizes(sid).values())
     assert sizes["zstd"] < sizes["none"] / 3
+
+
+def test_write_batch_atomic_publish_retry_no_duplicates():
+    """write_batch publishes via a single store transaction (put_all):
+    a publish-time failure leaves nothing behind, so the IO retry
+    replay at the shuffle_write site cannot duplicate partitions."""
+    from spark_rapids_tpu.runtime.retry import retry_io
+    from spark_rapids_tpu.shuffle.manager import (ShuffleManager,
+                                                  deserialize_batches)
+    mgr = ShuffleManager(num_threads=2)
+    sid = mgr.new_shuffle()
+    tbl = table(200).combine_chunks()
+    hb = HostBatch(tbl.to_batches()[0])
+    ids = np.asarray(RNG.integers(0, 5, 200), dtype=np.int64)
+    real = mgr.store.put_all
+    failures = []
+
+    def flaky_put_all(shuffle_id, payloads):
+        if not failures:
+            failures.append(1)
+            raise OSError("transient publish failure")
+        real(shuffle_id, payloads)
+
+    mgr.store.put_all = flaky_put_all
+    retry_io(DEFAULT_CONF, "shuffle_write",
+             lambda: mgr.write_batch(sid, hb, ids, 5))
+    assert failures                     # the first publish attempt died
+    rows = 0
+    for p in range(5):
+        blocks = mgr.store.get(sid, p)
+        assert len(blocks) <= 1, "replay duplicated a partition"
+        rows += sum(rb.num_rows for rb in deserialize_batches(blocks))
+    assert rows == 200
