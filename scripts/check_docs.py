@@ -1,12 +1,16 @@
 #!/usr/bin/env python
-"""Docs lint: every public `conf(...)` entry must appear in docs/configs.md.
+"""Docs lint: every public `conf(...)` entry must appear in
+docs/configs.md, and every metric family registered in the always-on
+`MetricsRegistry` must appear in docs/METRICS.md.
 
-The config registry is the source of truth (config.py `_REGISTRY`, plus
-the entries modules register at import — runtime/failure.py); docs are
-generated (`python -m spark_rapids_tpu.config`) but can silently drift
-when a knob lands without a regen.  This lint fails on any non-internal
-key missing from docs/configs.md, and runs in tier-1 via
-tests/test_tracing.py so new knobs can't ship undocumented.
+The registries are the source of truth (config.py `_REGISTRY` plus the
+entries modules register at import — runtime/failure.py; and
+obs/registry.py's central metric catalog); docs are generated/curated
+but can silently drift when a knob or metric lands without a doc.  This
+lint fails on any non-internal conf key missing from docs/configs.md
+and any `REGISTRY.family_names()` entry missing from docs/METRICS.md,
+and runs in tier-1 (tests/test_tracing.py, tests/test_metrics_plane.py)
+so neither can ship undocumented.
 
 Usage:
     python scripts/check_docs.py          # exit 1 + list when stale
@@ -29,16 +33,40 @@ def missing_keys() -> list:
             if not e.internal and f"`{e.key}`" not in doc]
 
 
+def missing_metric_docs() -> list:
+    """Registry metric family names absent from docs/METRICS.md (the
+    metric catalog — obs/registry.py declares every family at import,
+    so importing the module yields the complete name set)."""
+    from spark_rapids_tpu.obs.registry import REGISTRY
+    path = os.path.join(_ROOT, "docs", "METRICS.md")
+    try:
+        doc = open(path).read()
+    except OSError:
+        return list(REGISTRY.family_names())
+    return [n for n in REGISTRY.family_names() if f"`{n}`" not in doc]
+
+
 def main() -> int:
+    rc = 0
     missing = missing_keys()
     if missing:
         print("docs/configs.md is missing documented conf entries "
               "(run `python -m spark_rapids_tpu.config` to regenerate):")
         for k in missing:
             print(f"  {k}")
-        return 1
-    print("docs/configs.md covers every public conf entry")
-    return 0
+        rc = 1
+    else:
+        print("docs/configs.md covers every public conf entry")
+    missing_m = missing_metric_docs()
+    if missing_m:
+        print("docs/METRICS.md is missing registered metric families "
+              "(document each name in the catalog table):")
+        for n in missing_m:
+            print(f"  {n}")
+        rc = 1
+    else:
+        print("docs/METRICS.md covers every registered metric family")
+    return rc
 
 
 if __name__ == "__main__":

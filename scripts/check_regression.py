@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare a bench.py result against the
+committed BENCH_r*/MULTICHIP_r* trajectory and fail on per-query
+`device_ms` regressions.
+
+The perf trajectory is the product (ROADMAP north star: as fast as the
+hardware allows); a PR that silently doubles a query's device time must
+fail CI, not wait for a human to eyeball BENCH_r{N}.json.  This gate:
+
+  1. loads every trajectory file (three accepted shapes: the driver
+     wrapper `{parsed, tail, ...}`, a raw bench.py final-line dict, or
+     a `{"<suite>_suite_queries": ...}` fragment).  Wrapper files whose
+     `parsed` is null still contribute: per-query records are recovered
+     from the truncated `tail` text (the last stdout line is a complete
+     JSON result, but the driver keeps only its tail — individual
+     `"qN": {...}` objects inside it are intact and parse alone);
+  2. builds the per-query baseline: the MINIMUM `device_ms` each query
+     ever achieved across the baseline files (the best the engine has
+     demonstrated on this hardware);
+  3. compares the current result: a query REGRESSES when its device_ms
+     exceeds baseline * (1 + threshold) — default threshold 0.25 —
+     and exceeds the absolute noise floor (--min-ms, default 50 ms, so
+     sub-frame jitter cannot fail the gate).
+
+With no --current, the newest trajectory file that carries per-query
+data is the "current" result and the older files are the baseline, so
+running the script bare answers "did the latest round regress?" and
+exits 0 on a healthy trajectory.
+
+Queries only present on one side are reported but never fail the gate
+(coverage growth must not look like a regression).  Exit codes: 0 ok,
+1 regressions found, 2 usage/no-data.
+
+Usage:
+    python scripts/check_regression.py                  # gate the trajectory
+    python scripts/check_regression.py --current out.json [traj.json ...]
+    python scripts/check_regression.py --threshold 0.25 --min-ms 50
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: per-query records inside a (possibly head-truncated) bench JSON line
+_QREC_RE = re.compile(r'"(q\d+[a-z]?)":\s*(\{[^{}]*\})')
+
+
+def extract_queries(doc) -> dict:
+    """query name -> device_ms from any accepted result shape; {} when
+    the document carries no per-query timings."""
+    out = {}
+    if not isinstance(doc, dict):
+        return out
+    for key, val in doc.items():
+        if key.endswith("_suite_queries") and isinstance(val, dict):
+            for q, rec in val.items():
+                if isinstance(rec, dict) and rec.get("device_ms"):
+                    out[q] = float(rec["device_ms"])
+    if out:
+        return out
+    # driver wrapper: prefer the parsed final line, else mine the tail
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        out = extract_queries(parsed)
+        if out:
+            return out
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for m in _QREC_RE.finditer(tail):
+            try:
+                rec = json.loads(m.group(2))
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("device_ms"):
+                # later matches win: the FINAL summary line is printed
+                # last and covers every query measured
+                out[m.group(1)] = float(rec["device_ms"])
+    return out
+
+
+def load_file(path: str) -> dict:
+    with open(path) as f:
+        return extract_queries(json.load(f))
+
+
+def default_trajectory() -> list:
+    return (sorted(glob.glob(os.path.join(_ROOT, "BENCH_r*.json"))) +
+            sorted(glob.glob(os.path.join(_ROOT, "MULTICHIP_r*.json"))))
+
+
+def compare(current: dict, baseline: dict, threshold: float,
+            min_ms: float) -> dict:
+    """-> {regressions, improved, ok, only_current, only_baseline}."""
+    regressions, improved, ok = [], [], []
+    for q in sorted(set(current) & set(baseline),
+                    key=lambda s: (len(s), s)):
+        cur, base = current[q], baseline[q]
+        ratio = cur / base if base else float("inf")
+        row = {"query": q, "device_ms": cur, "baseline_ms": base,
+               "ratio": round(ratio, 3)}
+        if cur > base * (1.0 + threshold) and cur > min_ms:
+            regressions.append(row)
+        elif ratio < 1.0:
+            improved.append(row)
+        else:
+            ok.append(row)
+    return {"regressions": regressions, "improved": improved, "ok": ok,
+            "only_current": sorted(set(current) - set(baseline)),
+            "only_baseline": sorted(set(baseline) - set(current))}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trajectory", nargs="*",
+                    help="baseline result files (default: the committed "
+                         "BENCH_r*/MULTICHIP_r* trajectory)")
+    ap.add_argument("--current",
+                    help="bench result to gate (default: the newest "
+                         "trajectory file with per-query data)")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="fractional device_ms regression that fails "
+                         "(default 0.25 = +25%%)")
+    ap.add_argument("--min-ms", type=float, default=50.0,
+                    help="absolute floor below which timings are noise, "
+                         "never regressions (default 50)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as JSON")
+    args = ap.parse_args(argv)
+
+    paths = args.trajectory or default_trajectory()
+    per_file = {}
+    for p in paths:
+        try:
+            qs = load_file(p)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"# skipping unreadable {p}: {e}", file=sys.stderr)
+            continue
+        per_file[p] = qs
+    with_data = [p for p in per_file if per_file[p]]
+
+    if args.current:
+        try:
+            current = load_file(args.current)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read --current {args.current}: {e}",
+                  file=sys.stderr)
+            return 2
+        current_name = args.current
+        baseline_files = with_data
+    else:
+        if not with_data:
+            print("no trajectory file carries per-query device_ms — "
+                  "nothing to gate", file=sys.stderr)
+            return 2
+        current_name = with_data[-1]
+        current = per_file[current_name]
+        baseline_files = with_data[:-1]
+    if not current:
+        print(f"{current_name} carries no per-query device_ms",
+              file=sys.stderr)
+        return 2
+
+    baseline = {}
+    for p in baseline_files:
+        for q, v in per_file[p].items():
+            baseline[q] = min(baseline.get(q, v), v)
+
+    res = compare(current, baseline, args.threshold, args.min_ms)
+    if args.json:
+        print(json.dumps({"current": current_name,
+                          "baseline_files": baseline_files,
+                          "threshold": args.threshold, **res}))
+    else:
+        print(f"current:  {current_name} ({len(current)} queries)")
+        print(f"baseline: best-of {len(baseline_files)} file(s), "
+              f"{len(baseline)} queries; threshold "
+              f"+{args.threshold:.0%}, noise floor {args.min_ms:g} ms")
+        for row in res["regressions"]:
+            print(f"  REGRESSION {row['query']}: {row['device_ms']:.1f} ms"
+                  f" vs {row['baseline_ms']:.1f} ms "
+                  f"(x{row['ratio']:.2f})")
+        for row in res["improved"]:
+            print(f"  improved   {row['query']}: {row['device_ms']:.1f} ms"
+                  f" vs {row['baseline_ms']:.1f} ms "
+                  f"(x{row['ratio']:.2f})")
+        for row in res["ok"]:
+            print(f"  ok         {row['query']}: {row['device_ms']:.1f} ms"
+                  f" vs {row['baseline_ms']:.1f} ms "
+                  f"(x{row['ratio']:.2f})")
+        if res["only_current"]:
+            print(f"  new (no baseline): {', '.join(res['only_current'])}")
+        if not baseline:
+            print("  (empty baseline — nothing to regress against)")
+    if res["regressions"]:
+        print(f"{len(res['regressions'])} per-query regression(s) beyond "
+              f"+{args.threshold:.0%}")
+        return 1
+    print("no per-query device_ms regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

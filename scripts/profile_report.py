@@ -44,7 +44,31 @@ def main(argv=None) -> int:
     from spark_rapids_tpu.obs.profile import QueryProfile
 
     for path in log_paths(args.target):
-        prof = QueryProfile.from_event_log(path)
+        # a directory can hold non-query JSONL (metrics heartbeats),
+        # truncated crash-time logs, or logs from fallback-only queries
+        # with no spans — none of those may take the report down
+        try:
+            prof = QueryProfile.from_event_log(path)
+        except Exception as e:                   # noqa: BLE001
+            if args.json:
+                print(json.dumps({"log": path, "error":
+                                  f"{type(e).__name__}: {e}"}))
+            else:
+                print(f"### {path}")
+                print(f"  unreadable as a query event log "
+                      f"({type(e).__name__}: {e})")
+                print()
+            continue
+        if not prof.spans and not prof.metrics and not prof.events:
+            if args.json:
+                print(json.dumps({"log": path, "skipped":
+                                  "no query trace data"}))
+            else:
+                print(f"### {path}")
+                print("  no query trace data (not an event log, or a "
+                      "fallback-only query with tracing off)")
+                print()
+            continue
         if args.json:
             print(json.dumps({"log": path, **prof.to_dict()}))
         else:
@@ -53,6 +77,8 @@ def main(argv=None) -> int:
             trace = path.removesuffix(".jsonl") + ".trace.json"
             if os.path.exists(trace):
                 print(f"perfetto trace: {trace}")
+            else:
+                print("(no perfetto trace file for this query)")
             print()
     return 0
 

@@ -401,6 +401,41 @@ EVENT_LOG_DIR = conf(
     "span tracing for the query. Render reports with "
     "scripts/profile_report.py.", commonly_used=True)
 
+METRICS_ENABLED = conf(
+    "spark.rapids.tpu.metrics.enabled", True,
+    "The always-on metrics plane (obs/registry.py + obs/recorder.py): "
+    "process-wide counters/gauges/log2-histograms every runtime "
+    "subsystem publishes into, plus the flight-recorder ring embedded "
+    "in crash dumps. False turns every publish call into one attribute "
+    "check (the A/B overhead knob bench.py reports against).",
+    commonly_used=True)
+
+METRICS_PORT = conf(
+    "spark.rapids.tpu.metrics.port", 0,
+    "TCP port for the on-demand Prometheus text-format endpoint "
+    "(stdlib http.server thread, obs/export.py): GET /metrics for the "
+    "exposition text, /metrics.json for the structured snapshot, "
+    "/flight for the flight-recorder tail. 0 disables the server.",
+    checker=_non_negative)
+
+METRICS_REPORT_INTERVAL_S = conf(
+    "spark.rapids.tpu.metrics.reportIntervalS", 10.0,
+    "Seconds between JSONL heartbeat snapshots of the metrics registry "
+    "(obs/export.py Heartbeat) appended to metrics.heartbeatPath — the "
+    "always-on metrics-sink cadence.", checker=_positive)
+
+METRICS_HEARTBEAT_PATH = conf(
+    "spark.rapids.tpu.metrics.heartbeatPath", "",
+    "File the metrics heartbeat appends one JSON line to every "
+    "reportIntervalS seconds ({ts, registry, flight_len}). Empty "
+    "disables the heartbeat thread.")
+
+METRICS_FLIGHT_EVENTS = conf(
+    "spark.rapids.tpu.metrics.flightRecorderEvents", 1024,
+    "Capacity of the always-on flight-recorder ring buffer (last N "
+    "spans/instants across all queries, embedded in crash dumps).",
+    checker=_positive)
+
 RESULT_HEAD_ROWS = conf(
     "spark.rapids.tpu.sql.fetch.headRows", 4096,
     "Result-fetch head size: one speculative round trip ships the row "

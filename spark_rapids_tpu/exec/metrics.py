@@ -22,6 +22,7 @@ NVTX/CUPTI analogue — open the trace in XProf/perfetto) when
 `spark.rapids.tpu.profile.path` is set."""
 from __future__ import annotations
 
+import re
 import time
 from contextlib import contextmanager, nullcontext
 
@@ -133,6 +134,42 @@ def _instrument_node(node, ctx) -> None:
 
 def should_instrument(conf: TpuConf) -> bool:
     return conf.get(METRICS_LEVEL) in ("MODERATE", "DEBUG")
+
+
+#: operator CLASS-aggregate metric keys (no '#' — per-node-id detail
+#: stays in the query dicts; the process registry aggregates by class)
+_CLASS_METRIC_RE = re.compile(
+    r"^(?P<op>[A-Za-z_]\w*)\.(?P<field>op_time_ms|output_rows|"
+    r"output_batches)$")
+
+
+def publish_registry(ctx) -> None:
+    """Fold one finished query's operator/class metrics into the
+    always-on process registry (obs/registry.py) — called by the
+    instrumented scope AFTER lazy device row counts coerced, so every
+    value is a host number and nothing here forces a device sync.
+    The per-query ctx.metrics dict stays untouched (the compat view)."""
+    from ..obs.registry import (COMPILES_TOTAL, OPERATOR_BATCHES,
+                                OPERATOR_ROWS, OPERATOR_TIME_MS, REGISTRY)
+    if not REGISTRY.enabled:
+        return
+    for key, v in list(ctx.metrics.items()):
+        m = _CLASS_METRIC_RE.match(key)
+        if not m or not isinstance(v, (int, float)):
+            continue
+        op, field = m.group("op"), m.group("field")
+        if field == "output_rows":
+            OPERATOR_ROWS.inc(int(v), op=op)
+        elif field == "output_batches":
+            OPERATOR_BATCHES.inc(int(v), op=op)
+        else:
+            OPERATOR_TIME_MS.inc(float(v), op=op)
+    hits = ctx.metrics.get("compile_cache_hits", 0)
+    misses = ctx.metrics.get("compile_cache_misses", 0)
+    if hits:
+        COMPILES_TOTAL.inc(int(hits), outcome="hit")
+    if misses:
+        COMPILES_TOTAL.inc(int(misses), outcome="miss")
 
 
 @contextmanager

@@ -1,28 +1,50 @@
-"""Query-lifecycle observability: span tracer, event log, profiles.
+"""Observability: always-on metrics plane + query-lifecycle tracing.
 
 The reference surfaces behavior through three channels — per-operator
 `GpuMetric` sets in the Spark UI, `GpuTaskMetrics` accumulators
 (semaphore-wait / spill / retry), and NVTX ranges consumed by nsys plus
-the offline profiling tool (SURVEY §5).  This package is the TPU-native
-consolidation of all three:
+the offline profiling tool (SURVEY §5) — and all of them ride Spark's
+*always-on* metric sinks, not just opt-in traces.  This package is the
+TPU-native consolidation:
 
+  registry.py — `MetricsRegistry`: the process-wide always-on plane
+               (counters, gauges, bounded log2-bucket histograms with
+               bounded label cardinality) every runtime subsystem
+               publishes into; the single source of truth the per-query
+               dicts are compat views over (docs/METRICS.md catalog).
+  recorder.py — `FlightRecorder`: a fixed-memory ring of the last N
+               spans/instants across ALL queries, embedded verbatim in
+               crash dumps (runtime/failure.py) — the black box.
+  export.py  — JSONL heartbeat snapshots every
+               `spark.rapids.tpu.metrics.reportIntervalS` seconds plus
+               the on-demand Prometheus text endpoint behind
+               `spark.rapids.tpu.metrics.port` (the metrics-sink /
+               UI-endpoint role).
   tracer.py  — `QueryTracer` span/event collection threaded through the
                whole lifecycle (plan, compile, execute, transitions,
                shuffle, runtime events), serialized as a per-query JSONL
                event log (`spark.rapids.tpu.eventLog.dir`, the
                history-server event-log analogue) and a Chrome
                trace-event JSON openable in perfetto (the NVTX/nsys
-               analogue).
+               analogue).  OFF by default; its instants and byte
+               counters feed the always-on plane either way.
   profile.py — `QueryProfile` aggregate over the spans + metrics: the
                compile/execute/transition/shuffle wall split, the
                per-node-id operator table, fallback summary and memory
                high-water (the offline profiling-tool analogue;
                `scripts/profile_report.py` is its CLI).
 """
+from .recorder import FLIGHT_RECORDER, FlightRecorder
+from .registry import REGISTRY, MetricsRegistry, bucket_index, bucket_le
 from .tracer import (NULL_TRACER, EventLog, QueryTracer, Span, get_active,
                      make_tracer, read_event_log, set_active)
+from .export import (configure_plane, flight_record, prometheus_text,
+                     registry_snapshot)
 from .profile import QueryProfile
 
-__all__ = ["NULL_TRACER", "EventLog", "QueryTracer", "QueryProfile",
-           "Span", "get_active", "make_tracer", "read_event_log",
-           "set_active"]
+__all__ = ["FLIGHT_RECORDER", "FlightRecorder", "MetricsRegistry",
+           "NULL_TRACER", "EventLog", "QueryProfile", "QueryTracer",
+           "REGISTRY", "Span", "bucket_index", "bucket_le",
+           "configure_plane", "flight_record", "get_active",
+           "make_tracer", "prometheus_text", "read_event_log",
+           "registry_snapshot", "set_active"]

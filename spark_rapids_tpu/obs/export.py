@@ -1,0 +1,191 @@
+"""Metrics-plane export: JSONL heartbeat + Prometheus HTTP endpoint.
+
+Reference: the plugin's metrics ride Spark's always-on sink pipeline
+(console/CSV/JMX sinks on a reporting interval) and its UI/history
+endpoints (SURVEY §5).  The TPU-native pair:
+
+  * `Heartbeat` — a daemon thread appending one JSON line
+    ({ts, registry, flight_len}) to
+    `spark.rapids.tpu.metrics.heartbeatPath` every
+    `spark.rapids.tpu.metrics.reportIntervalS` seconds, so an operator
+    tailing one file sees the live registry between queries (and the
+    last line before a death is a crash-adjacent snapshot).
+  * `MetricsHttpServer` — a stdlib `http.server` thread behind
+    `spark.rapids.tpu.metrics.port` serving `/metrics` (Prometheus
+    exposition text), `/metrics.json` (the structured snapshot) and
+    `/flight` (the flight-recorder tail) for scrape-on-demand.
+
+`configure_plane(conf)` is the single idempotent entry point
+(TpuSession.__init__ and every query's instrumented scope call it): it
+applies the enabled flag + recorder capacity and starts whichever
+exporters the conf asks for, exactly once per process.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import List, Optional
+
+from ..config import (METRICS_ENABLED, METRICS_FLIGHT_EVENTS,
+                      METRICS_HEARTBEAT_PATH, METRICS_PORT,
+                      METRICS_REPORT_INTERVAL_S, TpuConf)
+from .recorder import FLIGHT_RECORDER
+from .registry import REGISTRY
+
+
+def registry_snapshot(compact: bool = False) -> dict:
+    """The process registry as a dict: structured families, or the
+    compact `name{labels} -> value` form (`compact=True`) that
+    heartbeat lines, bench output and event-log query_end records
+    embed."""
+    return REGISTRY.flat() if compact else REGISTRY.snapshot()
+
+
+def flight_record(n: Optional[int] = None) -> List[dict]:
+    """The newest `n` flight-recorder events (all when None)."""
+    return FLIGHT_RECORDER.tail(n)
+
+
+def prometheus_text() -> str:
+    return REGISTRY.prometheus_text()
+
+
+class Heartbeat:
+    """Appends registry snapshots to a JSONL file on an interval."""
+
+    def __init__(self, path: str, interval_s: float):
+        self.path = path
+        self.interval_s = max(float(interval_s), 0.01)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpu-metrics-heartbeat")
+
+    def start(self) -> "Heartbeat":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.beat()
+            self._stop.wait(self.interval_s)
+
+    def beat(self) -> None:
+        """Write one snapshot line (also called directly by tests)."""
+        line = json.dumps({"ts": time.time(), "type": "heartbeat",
+                           "registry": REGISTRY.flat(),
+                           "flight_len": len(FLIGHT_RECORDER)},
+                          default=str)
+        try:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            # the sink must never take the engine down (full disk,
+            # unlinked dir); the next beat retries
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+
+
+class MetricsHttpServer:
+    """On-demand Prometheus endpoint on a daemon thread."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self._httpd = None
+        self._thread = None
+
+    def start(self) -> int:
+        """Bind + serve; returns the actual port (port 0 binds an
+        ephemeral one — tests use that)."""
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):                    # noqa: N802
+                if self.path.startswith("/metrics.json"):
+                    body = json.dumps(REGISTRY.snapshot(),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/flight"):
+                    body = json.dumps(FLIGHT_RECORDER.tail(),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    body = REGISTRY.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):            # silence per-request spam
+                return
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="tpu-metrics-http")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+_EXPORT_LOCK = threading.Lock()
+_HEARTBEAT: Optional[Heartbeat] = None
+_HTTP: Optional[MetricsHttpServer] = None
+
+
+def configure_plane(conf: TpuConf) -> None:
+    """Apply a conf to the process metrics plane (idempotent, cheap:
+    conf reads are cached per TpuConf).  Enabled flag and recorder
+    capacity follow the MOST RECENT conf applied (the plane is
+    process-wide); exporters start once per process on first demand."""
+    global _HEARTBEAT, _HTTP
+    enabled = bool(conf.get(METRICS_ENABLED))
+    REGISTRY.enabled = enabled
+    FLIGHT_RECORDER.enabled = enabled
+    FLIGHT_RECORDER.resize(conf.get(METRICS_FLIGHT_EVENTS))
+    if not enabled:
+        return
+    hb_path = str(conf.get(METRICS_HEARTBEAT_PATH) or "")
+    port = int(conf.get(METRICS_PORT))
+    if hb_path or port:
+        with _EXPORT_LOCK:
+            if hb_path and _HEARTBEAT is None:
+                _HEARTBEAT = Heartbeat(
+                    hb_path,
+                    float(conf.get(METRICS_REPORT_INTERVAL_S))).start()
+            if port and _HTTP is None:
+                try:
+                    srv = MetricsHttpServer(port)
+                    srv.start()
+                    _HTTP = srv
+                except OSError:
+                    # a busy port must not fail queries; the snapshot
+                    # surfaces remain available in-process
+                    pass
+
+
+def shutdown_exporters() -> None:
+    """Stop the process exporters (tests / clean embedding teardown)."""
+    global _HEARTBEAT, _HTTP
+    with _EXPORT_LOCK:
+        if _HEARTBEAT is not None:
+            _HEARTBEAT.stop()
+            _HEARTBEAT = None
+        if _HTTP is not None:
+            _HTTP.stop()
+            _HTTP = None

@@ -43,12 +43,17 @@ def _union_ms(ivals: List[tuple]) -> float:
 
 
 class QueryProfile:
-    def __init__(self, spans, events, counters, metrics, meta):
+    def __init__(self, spans, events, counters, metrics, meta,
+                 registry=None, truncated=False):
         self.spans = list(spans)
         self.events = list(events)
         self.counters = dict(counters)
         self.metrics = dict(metrics or {})
         self.meta = dict(meta or {})
+        #: metrics-plane snapshot from the event log's query_end record
+        #: (PR 5); empty for live contexts and truncated logs
+        self.registry = dict(registry or {})
+        self.truncated = bool(truncated)
 
     # -- constructors ------------------------------------------------------
     @classmethod
@@ -67,7 +72,8 @@ class QueryProfile:
         log = path_or_log if isinstance(path_or_log, EventLog) \
             else read_event_log(path_or_log)
         return cls(log.spans, log.events, log.counters, log.metrics,
-                   log.meta)
+                   log.meta, registry=log.registry,
+                   truncated=log.truncated)
 
     # -- aggregates --------------------------------------------------------
     def wall_ms(self) -> float:
@@ -192,13 +198,18 @@ class QueryProfile:
 
     # -- presentation ------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
-        return {"time_split": self.time_split(),
-                "operators": self.operators(),
-                "compile": self.compile_stats(),
-                "data_movement": self.data_movement(),
-                "memory": self.memory(),
-                "incidents": self.incidents(),
-                "fallbacks": self.fallbacks()}
+        out = {"time_split": self.time_split(),
+               "operators": self.operators(),
+               "compile": self.compile_stats(),
+               "data_movement": self.data_movement(),
+               "memory": self.memory(),
+               "incidents": self.incidents(),
+               "fallbacks": self.fallbacks()}
+        if self.registry:
+            out["registry"] = self.registry
+        if self.truncated:
+            out["truncated"] = True
+        return out
 
     def summary(self, top_n: int = 5) -> Dict[str, Any]:
         """Compact per-query embedding for BENCH_*.json."""
@@ -219,7 +230,9 @@ class QueryProfile:
         """The human report: time split, top operators, fallbacks,
         memory high-water — the profiling-tool output."""
         split = self.time_split()
-        lines = ["== query profile ==",
+        lines = ["== query profile =="
+                 + (" (TRUNCATED log — prefix only)"
+                    if self.truncated else ""),
                  f"wall              {split['wall_ms']:.1f} ms",
                  f"  plan (pre-wall) {split['plan_ms']:.1f} ms",
                  f"  compile         {split['compile_ms']:.1f} ms",
@@ -256,4 +269,14 @@ class QueryProfile:
         lines.append(f"-- fallbacks ({len(fb)}) --")
         for r in fb:
             lines.append(f"  ! {r}")
+        if self.registry:
+            # the always-on plane's state at log-write time, largest
+            # counters first (docs/METRICS.md catalog)
+            lines.append("-- metrics registry (process, at log write) --")
+            scalars = [(k, v) for k, v in self.registry.items()
+                       if isinstance(v, (int, float))]
+            for k, v in sorted(scalars, key=lambda kv: -abs(kv[1]))[:12]:
+                lines.append(f"  {k:<52} {round(v, 3)}")
+            if len(scalars) > 12:
+                lines.append(f"  ... {len(scalars) - 12} more series")
         return "\n".join(lines)

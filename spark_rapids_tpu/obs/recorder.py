@@ -1,0 +1,95 @@
+"""Flight recorder: a fixed-memory ring of the last N observability
+events across ALL queries — the black box a post-mortem reads.
+
+Reference motivation (SURVEY §5): GpuCoreDumpHandler streams a GPU core
+dump out as the executor dies so the driver can do a post-mortem; the
+dump shows device state but not *what the runtime was doing* in the
+seconds before death.  The tracer (obs/tracer.py) knows, but it is
+query-scoped and off by default — at crash time under default conf
+there is nothing to read.
+
+`FlightRecorder` closes that gap: a bounded `collections.deque` ring
+that every tracer instant (tracing on or off), every span from an
+enabled tracer, and the always-on query lifecycle markers
+(plan/overrides.py) append to.  Overhead is one lock + dict + deque
+append per event; memory is capped by `maxlen`
+(`spark.rapids.tpu.metrics.flightRecorderEvents`), so it stays on
+permanently.  `runtime/failure.py` embeds `tail()` verbatim in crash
+dumps: under default conf the last record of a chaos-injected fatal
+crash is the `fault_injected` instant itself (with tracing enabled,
+operator spans unwinding over the fault close after it and trail it).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+def _plain(v):
+    """Ring records must always JSON-serialize later: numbers/strings
+    pass through (numpy scalars coerce), everything else stringifies."""
+    if isinstance(v, bool) or v is None or isinstance(v, (int, float, str)):
+        return v
+    item = getattr(v, "item", None)
+    if item is not None:
+        try:
+            return item()
+        except Exception:                        # noqa: BLE001
+            pass
+    return str(v)
+
+
+class FlightRecorder:
+    """Bounded ring buffer of observability events (newest last)."""
+
+    def __init__(self, capacity: int = 1024):
+        self.enabled = True
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=max(int(capacity), 1))
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen
+
+    def resize(self, capacity: int) -> None:
+        """Adjust the ring size, keeping the newest events."""
+        capacity = max(int(capacity), 1)
+        with self._lock:
+            if capacity != self._buf.maxlen:
+                self._buf = deque(self._buf, maxlen=capacity)
+
+    def record(self, kind: str, name: str, cat: str,
+               attrs: Optional[Dict[str, Any]] = None,
+               query: Optional[int] = None) -> None:
+        """Append one event; `kind` is "instant" or "span"."""
+        if not self.enabled:
+            return
+        rec: Dict[str, Any] = {"kind": kind, "name": name, "cat": cat,
+                               "t": time.time()}
+        if query is not None:
+            rec["query"] = query
+        if attrs:
+            rec["attrs"] = {str(k): _plain(v) for k, v in attrs.items()}
+        with self._lock:
+            self._buf.append(rec)
+
+    def tail(self, n: Optional[int] = None) -> List[dict]:
+        """The newest `n` events (all when n is None), oldest first —
+        the crash-dump payload."""
+        with self._lock:
+            out = list(self._buf)
+        return out if n is None else out[-int(n):]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+
+#: THE process-wide recorder (independent instances only in tests)
+FLIGHT_RECORDER = FlightRecorder()

@@ -1,0 +1,422 @@
+"""Process-wide always-on metrics registry — the Spark metrics-sink role.
+
+Reference (SURVEY §5): the plugin surfaces per-operator GPU metrics
+through Spark's *always-on* metric sinks and the history server, not
+just opt-in traces.  The query tracer (obs/tracer.py, OFF by default)
+covers the per-query deep dive; this registry is the complement: one
+process-wide `MetricsRegistry` that every runtime subsystem publishes
+into unconditionally — visible between queries, across queries and at
+crash time (runtime/failure.py embeds a snapshot in crash dumps).
+
+Three metric kinds, Prometheus-shaped:
+
+  * Counter   — monotonically increasing totals (`.inc`);
+  * Gauge     — point-in-time levels (`.set`) and high-waters (`.max`);
+  * Histogram — bounded log2-bucket distributions (`.observe`): bucket
+    `i` counts values in (2^(i-1), 2^i], so a byte-skew or wait-time
+    distribution costs at most `_MAX_BUCKET`+1 integers per series,
+    never a per-observation list.
+
+Series carry labels (query id, device index, operator class, ...).
+Label cardinality is BOUNDED: past `max_series` distinct label sets per
+metric, further sets collapse into one `~overflow` series, so a label
+mistake (or a million query ids) cannot grow memory — the registry is
+fixed-cost by construction, which is what lets it stay always-on.
+
+Export lives in obs/export.py (JSONL heartbeat + Prometheus text
+endpoint); `spark.rapids.tpu.metrics.enabled=false` turns every publish
+call into one attribute check for A/B overhead runs.
+
+Every family registered here must be documented in docs/METRICS.md —
+scripts/check_docs.py lints `REGISTRY.family_names()` against it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: log2 buckets 0..50: bucket 0 is (-inf, 1], bucket i is (2^(i-1), 2^i];
+#: 2^50 covers a petabyte of bytes or ~35 years of milliseconds
+_MAX_BUCKET = 50
+
+#: label-set value a metric's series collapse into past max_series
+OVERFLOW = "~overflow"
+
+
+def bucket_index(v: float) -> int:
+    """Log2 bucket of one observation (shared with tests: the
+    independently-computed distributions use this same mapping)."""
+    if v <= 1:
+        return 0
+    n = int(v) if float(v).is_integer() else int(v) + 1
+    return min((n - 1).bit_length(), _MAX_BUCKET)
+
+
+def bucket_le(i: int) -> int:
+    """Inclusive upper bound of bucket `i` (the Prometheus `le`)."""
+    return 1 << i if i else 1
+
+
+class _HistogramState:
+    __slots__ = ("count", "sum", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.buckets: Dict[int, int] = {}
+
+
+class Metric:
+    """One metric family: a name + kind + label names, holding every
+    labeled series.  Publish methods are self-locking and no-op when
+    the owning registry is disabled."""
+
+    def __init__(self, registry: "MetricsRegistry", name: str, kind: str,
+                 help_: str, labelnames: Tuple[str, ...]):
+        self._reg = registry
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._series: Dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, Any]) -> tuple:
+        key = tuple(str(labels.get(n, "")) for n in self.labelnames)
+        if key not in self._series and \
+                len(self._series) >= self._reg.max_series:
+            # bounded cardinality: late label sets share one series
+            return tuple(OVERFLOW for _ in self.labelnames)
+        return key
+
+    # -- publish (each checks the registry's enabled flag first) ----------
+    def inc(self, v: float = 1, **labels) -> None:
+        if not self._reg.enabled:
+            return
+        with self._lock:
+            k = self._key(labels)
+            self._series[k] = self._series.get(k, 0) + v
+
+    def set(self, v: float, **labels) -> None:
+        if not self._reg.enabled:
+            return
+        with self._lock:
+            self._series[self._key(labels)] = v
+
+    def max(self, v: float, **labels) -> None:
+        """High-water update: keep the larger of current and `v`."""
+        if not self._reg.enabled:
+            return
+        with self._lock:
+            k = self._key(labels)
+            if v > self._series.get(k, float("-inf")):
+                self._series[k] = v
+
+    def add(self, v: float, **labels) -> None:
+        """Gauge delta (active-count style: add(+1)/add(-1))."""
+        if not self._reg.enabled:
+            return
+        with self._lock:
+            k = self._key(labels)
+            self._series[k] = self._series.get(k, 0) + v
+
+    def observe(self, v: float, **labels) -> None:
+        if not self._reg.enabled:
+            return
+        with self._lock:
+            k = self._key(labels)
+            st = self._series.get(k)
+            if st is None:
+                st = self._series[k] = _HistogramState()
+            st.count += 1
+            st.sum += float(v)
+            i = bucket_index(v)
+            st.buckets[i] = st.buckets.get(i, 0) + 1
+
+    # -- read -------------------------------------------------------------
+    def value(self, **labels):
+        """Current value of one series (0 / None when never published)."""
+        key = tuple(str(labels.get(n, "")) for n in self.labelnames)
+        with self._lock:
+            v = self._series.get(key)
+        if isinstance(v, _HistogramState):
+            return {"count": v.count, "sum": v.sum,
+                    "buckets": dict(v.buckets)}
+        return 0 if v is None and self.kind == "counter" else v
+
+    def series(self) -> List[dict]:
+        with self._lock:
+            items = list(self._series.items())
+        out = []
+        for key, v in items:
+            labels = dict(zip(self.labelnames, key))
+            if isinstance(v, _HistogramState):
+                out.append({"labels": labels, "count": v.count,
+                            "sum": v.sum,
+                            "buckets": [[bucket_le(i), c] for i, c in
+                                        sorted(v.buckets.items())]})
+            else:
+                out.append({"labels": labels, "value": v})
+        return out
+
+
+class MetricsRegistry:
+    """The process-wide family registry (one global `REGISTRY` below;
+    independent instances exist only for tests)."""
+
+    def __init__(self, max_series: int = 64):
+        self.enabled = True
+        self.max_series = max_series
+        self._families: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, name: str, kind: str, help_: str,
+                  labelnames: Tuple[str, ...]) -> Metric:
+        with self._lock:
+            m = self._families.get(name)
+            if m is not None:
+                if m.kind != kind or m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} re-registered with a different "
+                        f"shape ({m.kind}{m.labelnames} vs "
+                        f"{kind}{tuple(labelnames)})")
+                return m
+            m = Metric(self, name, kind, help_, tuple(labelnames))
+            self._families[name] = m
+            return m
+
+    def counter(self, name: str, help_: str, labelnames=()) -> Metric:
+        return self._register(name, "counter", help_, tuple(labelnames))
+
+    def gauge(self, name: str, help_: str, labelnames=()) -> Metric:
+        return self._register(name, "gauge", help_, tuple(labelnames))
+
+    def histogram(self, name: str, help_: str, labelnames=()) -> Metric:
+        return self._register(name, "histogram", help_, tuple(labelnames))
+
+    def family_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._families)
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._families.get(name)
+
+    def reset(self) -> None:
+        """Drop every series (families stay registered) — test isolation
+        for exact-distribution assertions."""
+        with self._lock:
+            fams = list(self._families.values())
+        for m in fams:
+            with m._lock:
+                m._series.clear()
+
+    # -- export -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Structured snapshot: every family with its labeled series."""
+        with self._lock:
+            fams = list(self._families.values())
+        return {"ts": time.time(),
+                "enabled": self.enabled,
+                "families": [{"name": m.name, "kind": m.kind,
+                              "help": m.help,
+                              "labels": list(m.labelnames),
+                              "series": m.series()}
+                             for m in fams if m.series()]}
+
+    def flat(self) -> Dict[str, Any]:
+        """Compact `name{a=b}` -> value view (heartbeat lines, bench
+        embedding, event-log query_end records).  Histograms flatten to
+        `.count` / `.sum` entries."""
+        out: Dict[str, Any] = {}
+        for fam in self.snapshot()["families"]:
+            for s in fam["series"]:
+                lbl = ",".join(f"{k}={v}" for k, v in s["labels"].items()
+                               if v != "")
+                key = f"{fam['name']}{{{lbl}}}" if lbl else fam["name"]
+                if "value" in s:
+                    out[key] = s["value"]
+                else:
+                    out[key + ".count"] = s["count"]
+                    out[key + ".sum"] = round(s["sum"], 3)
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition text format (served by the stdlib HTTP
+        endpoint, obs/export.py)."""
+        lines: List[str] = []
+        for fam in self.snapshot()["families"]:
+            name = fam["name"]
+            lines.append(f"# HELP {name} {fam['help']}")
+            lines.append(f"# TYPE {name} {fam['kind']}")
+            for s in fam["series"]:
+                lbl = ",".join(f'{k}="{v}"'
+                               for k, v in s["labels"].items())
+                if "value" in s:
+                    lines.append(f"{name}{{{lbl}}} {s['value']}"
+                                 if lbl else f"{name} {s['value']}")
+                    continue
+                cum = 0
+                for le, c in s["buckets"]:
+                    cum += c
+                    ls = (lbl + "," if lbl else "") + f'le="{le}"'
+                    lines.append(f"{name}_bucket{{{ls}}} {cum}")
+                ls = (lbl + "," if lbl else "") + 'le="+Inf"'
+                lines.append(f"{name}_bucket{{{ls}}} {s['count']}")
+                lines.append(f"{name}_sum{{{lbl}}} {s['sum']}"
+                             if lbl else f"{name}_sum {s['sum']}")
+                lines.append(f"{name}_count{{{lbl}}} {s['count']}"
+                             if lbl else f"{name}_count {s['count']}")
+        return "\n".join(lines) + "\n"
+
+
+#: THE process-wide registry every subsystem publishes into
+REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Metric catalog: central declarations so the full family set exists at
+# import time (scripts/check_docs.py lints these names against
+# docs/METRICS.md) and call sites share one handle per family.
+# ---------------------------------------------------------------------------
+
+QUERIES_TOTAL = REGISTRY.counter(
+    "tpu_queries_total",
+    "Completed query collects by terminal status and root plan kind.",
+    ("status", "kind"))
+
+ACTIVE_QUERIES = REGISTRY.gauge(
+    "tpu_active_queries",
+    "Queries currently inside their instrumented execution scope.")
+
+QUERY_WALL_MS = REGISTRY.histogram(
+    "tpu_query_wall_ms",
+    "End-to-end wall milliseconds per query collect (log2 buckets).")
+
+DATA_BYTES = REGISTRY.counter(
+    "tpu_data_movement_bytes_total",
+    "Bytes moved per channel (h2d, d2h, shuffle_write, shuffle_read, "
+    "ici_exchange) — fed by every tracer byte-counter call site, "
+    "tracing on or off.",
+    ("channel",))
+
+RUNTIME_EVENTS = REGISTRY.counter(
+    "tpu_runtime_events_total",
+    "Runtime incident instants (oom_retry, spill, batch_split, io_retry, "
+    "semaphore_wait, fault_injected, ...) by event name and category.",
+    ("event", "cat"))
+
+HBM_LIVE_BYTES = REGISTRY.gauge(
+    "tpu_hbm_live_bytes",
+    "Device bytes currently admitted by the HBM budget, per device.",
+    ("device",))
+
+HBM_PEAK_BYTES = REGISTRY.gauge(
+    "tpu_hbm_peak_bytes",
+    "Process-lifetime high-water of budget-admitted device bytes, per "
+    "device.",
+    ("device",))
+
+HOST_SPILL_LIVE_BYTES = REGISTRY.gauge(
+    "tpu_host_spill_live_bytes",
+    "Bytes currently resident in the host spill tier.")
+
+SPILL_BATCHES = REGISTRY.counter(
+    "tpu_spill_batches_total",
+    "Batches demoted per tier (host = device->host, disk = host->disk).",
+    ("tier",))
+
+SPILL_BYTES = REGISTRY.counter(
+    "tpu_spill_bytes_total",
+    "Bytes demoted per tier (host = device->host, disk = host->disk).",
+    ("tier",))
+
+SPILL_MS = REGISTRY.histogram(
+    "tpu_spill_ms",
+    "Milliseconds spent moving one spillable between tiers (op = spill "
+    "| to_disk | read), log2 buckets — the spill wait-time histogram.",
+    ("op",))
+
+OOM_RETRIES = REGISTRY.counter(
+    "tpu_oom_retries_total",
+    "OOM-retry ladder replays (spill-everything-and-replay rungs).")
+
+BATCH_SPLITS = REGISTRY.counter(
+    "tpu_batch_splits_total",
+    "Batches halved by the split-and-retry rung.")
+
+IO_RETRIES = REGISTRY.counter(
+    "tpu_io_retries_total",
+    "Transient host-IO retries by injection/retry site.",
+    ("site",))
+
+RELEASE_UNDERFLOWS = REGISTRY.counter(
+    "tpu_release_underflows_total",
+    "Budget double-releases clamped to zero (should stay 0).")
+
+SEMAPHORE_WAIT_MS = REGISTRY.histogram(
+    "tpu_semaphore_wait_ms",
+    "Milliseconds blocked acquiring a concurrentTpuTasks device permit, "
+    "log2 buckets, one observation per acquisition.")
+
+SHUFFLE_BYTES = REGISTRY.counter(
+    "tpu_shuffle_bytes_total",
+    "Serialized shuffle bytes by direction (written / read).",
+    ("direction",))
+
+SHUFFLE_PARTITION_BYTES = REGISTRY.histogram(
+    "tpu_shuffle_partition_bytes",
+    "Serialized bytes of each shuffle partition slice written by one "
+    "map-task call, log2 buckets — the per-partition byte-skew "
+    "distribution.")
+
+ICI_EXCHANGE_BYTES = REGISTRY.counter(
+    "tpu_ici_exchange_bytes_total",
+    "Wire bytes each mesh device ships through ragged all_to_all "
+    "exchange rounds (masked slots transit too), per device index.",
+    ("device",))
+
+OPERATOR_ROWS = REGISTRY.counter(
+    "tpu_operator_output_rows_total",
+    "Output rows per operator class (published at query end, after "
+    "lazy device counts coerce).",
+    ("op",))
+
+OPERATOR_BATCHES = REGISTRY.counter(
+    "tpu_operator_output_batches_total",
+    "Output batches per operator class.",
+    ("op",))
+
+OPERATOR_TIME_MS = REGISTRY.counter(
+    "tpu_operator_time_ms_total",
+    "Operator wall milliseconds per operator class.",
+    ("op",))
+
+COMPILES_TOTAL = REGISTRY.counter(
+    "tpu_compiles_total",
+    "Whole-plan XLA compile-cache outcomes (hit / miss).",
+    ("outcome",))
+
+FAULTS_INJECTED = REGISTRY.counter(
+    "tpu_faults_injected_total",
+    "Chaos-harness faults fired, by injection site and kind.",
+    ("site", "kind"))
+
+CRASH_DUMPS = REGISTRY.counter(
+    "tpu_crash_dumps_total",
+    "Fatal-device crash dumps written by runtime/failure.py.")
+
+
+_QUERY_SEQ_LOCK = threading.Lock()
+_QUERY_SEQ = 0
+
+
+def next_query_seq() -> int:
+    """Process-monotonic query sequence number — the always-on query id
+    the flight recorder tags lifecycle events with (the tracer's own
+    query ids only exist when tracing is enabled)."""
+    global _QUERY_SEQ
+    with _QUERY_SEQ_LOCK:
+        _QUERY_SEQ += 1
+        return _QUERY_SEQ

@@ -31,6 +31,30 @@ from contextlib import contextmanager, nullcontext
 from typing import Any, Dict, List, Optional
 
 from ..config import EVENT_LOG_DIR, TRACE_ENABLED, TpuConf
+from .recorder import FLIGHT_RECORDER
+from .registry import DATA_BYTES, RUNTIME_EVENTS
+
+#: tracer byte-counter key -> always-on registry data-movement channel
+_BYTE_CHANNELS = {
+    "h2d_bytes": "h2d",
+    "d2h_bytes": "d2h",
+    "shuffle_bytes_written": "shuffle_write",
+    "shuffle_bytes_read": "shuffle_read",
+    "ici_exchange_bytes": "ici_exchange",
+}
+
+
+def _publish_instant(name: str, cat: str, attrs: dict,
+                     query=None) -> None:
+    """Always-on half of every instant: the flight-recorder ring and
+    the process registry see the incident whether or not a per-query
+    tracer is collecting it."""
+    FLIGHT_RECORDER.record("instant", name, cat, attrs, query=query)
+    RUNTIME_EVENTS.inc(1, event=name, cat=cat)
+
+
+def _publish_bytes(key: str, n: int) -> None:
+    DATA_BYTES.inc(int(n), channel=_BYTE_CHANNELS.get(key, key))
 
 
 @dataclasses.dataclass
@@ -123,7 +147,11 @@ class QueryTracer:
                       name, cat, t0, t1, node,
                       {k: _jsonable(v) for k, v in attrs.items()})
             self.spans.append(sp)
-            return sp
+        FLIGHT_RECORDER.record(
+            "span", name, cat,
+            {"dur_ms": round(sp.dur_ms, 3),
+             **({"node": node} if node else {})}, query=self.query_id)
+        return sp
 
     @contextmanager
     def span(self, name: str, cat: str, node: Optional[str] = None,
@@ -146,22 +174,31 @@ class QueryTracer:
                 self.spans.append(Span(
                     sid, parent, name, cat, t0, t1, node,
                     {k: _jsonable(v) for k, v in attrs.items()}))
+            FLIGHT_RECORDER.record(
+                "span", name, cat,
+                {"dur_ms": round((t1 - t0) * 1e3, 3),
+                 **({"node": node} if node else {})},
+                query=self.query_id)
 
     def instant(self, name: str, cat: str, **attrs) -> None:
         with self._lock:
             self.events.append(Event(name, cat, time.perf_counter(),
                                      {k: _jsonable(v)
                                       for k, v in attrs.items()}))
+        _publish_instant(name, cat, attrs, query=self.query_id)
 
     def add_bytes(self, key: str, n: int) -> None:
         with self._lock:
             self.counters[key] = self.counters.get(key, 0) + int(n)
+        _publish_bytes(key, n)
 
     def finish(self, metrics: Optional[dict] = None) -> None:
         """Snapshot the query's final metrics (call after lazy device
         metric coercion so every value is a host number)."""
         if metrics is not None:
-            self.metrics = {k: _jsonable(v) for k, v in metrics.items()}
+            snap = {k: _jsonable(v) for k, v in metrics.items()}
+            with self._lock:
+                self.metrics = snap
 
     # -- serialization -----------------------------------------------------
     def _origin(self) -> float:
@@ -192,10 +229,14 @@ class QueryTracer:
             if e.attrs:
                 rec["attrs"] = e.attrs
             lines.append(json.dumps(rec))
+        from .registry import REGISTRY
         lines.append(json.dumps(_jsonable({
             "type": "query_end", "query_id": self.query_id,
             "metrics": self.metrics or {}, "counters": self.counters,
-            "meta": self.meta})))
+            "meta": self.meta,
+            # the process metrics-plane snapshot at log-write time, so
+            # one event log is post-mortem self-contained
+            "registry": REGISTRY.flat()})))
         return lines
 
     def to_chrome_trace(self) -> dict:
@@ -248,6 +289,11 @@ class EventLog:
     counters: Dict[str, float]
     metrics: Dict[str, Any]
     meta: Dict[str, Any]
+    #: metrics-plane snapshot from the query_end record (PR 5)
+    registry: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: the final line failed to parse (crash-time logs end mid-write);
+    #: spans/events hold the intact prefix
+    truncated: bool = False
 
     def span_tree(self) -> set:
         """Structural fingerprint for round-trip tests: one (id, parent,
@@ -258,41 +304,62 @@ class EventLog:
 
 def read_event_log(path: str) -> EventLog:
     """Parse a query_<id>.jsonl event log back into spans/events/metrics
-    (the profiling tool's input — see scripts/profile_report.py)."""
+    (the profiling tool's input — see scripts/profile_report.py).
+
+    Crash-time logs end mid-write: a final line that fails to JSON-parse
+    is tolerated — the intact prefix is returned with `truncated=True`
+    instead of surfacing a raw json.JSONDecodeError.  A malformed line
+    ANYWHERE ELSE still raises (that is corruption, not truncation)."""
     spans: List[Span] = []
     events: List[Event] = []
-    qid, start, counters, metrics, meta = 0, 0.0, {}, {}, {}
+    qid, start = 0, 0.0
+    counters: Dict[str, float] = {}
+    metrics: Dict[str, Any] = {}
+    meta: Dict[str, Any] = {}
+    registry: Dict[str, Any] = {}
+    truncated = False
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
+        lines = [ln.strip() for ln in f]
+    lines = [ln for ln in lines if ln]
+    for i, line in enumerate(lines):
+        try:
             rec = json.loads(line)
-            typ = rec.get("type")
-            if typ == "query_start":
-                qid = rec.get("query_id", 0)
-                start = rec.get("wall_start_unix", 0.0)
-            elif typ == "span":
-                t0 = rec["t0_ms"] / 1e3
-                spans.append(Span(rec["id"], rec.get("parent"),
-                                  rec["name"], rec["cat"], t0,
-                                  t0 + rec["dur_ms"] / 1e3,
-                                  rec.get("node"), rec.get("attrs", {})))
-            elif typ == "instant":
-                events.append(Event(rec["name"], rec["cat"],
-                                    rec["t_ms"] / 1e3,
-                                    rec.get("attrs", {})))
-            elif typ == "query_end":
-                counters = rec.get("counters", {})
-                metrics = rec.get("metrics", {})
-                meta = rec.get("meta", {})
-    return EventLog(qid, start, spans, events, counters, metrics, meta)
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                truncated = True
+                break
+            raise
+        typ = rec.get("type")
+        if typ == "query_start":
+            qid = rec.get("query_id", 0)
+            start = rec.get("wall_start_unix", 0.0)
+        elif typ == "span":
+            t0 = rec.get("t0_ms", 0.0) / 1e3
+            spans.append(Span(rec.get("id", len(spans)),
+                              rec.get("parent"),
+                              rec.get("name", "?"), rec.get("cat", "?"),
+                              t0, t0 + rec.get("dur_ms", 0.0) / 1e3,
+                              rec.get("node"), rec.get("attrs", {})))
+        elif typ == "instant":
+            events.append(Event(rec.get("name", "?"), rec.get("cat", "?"),
+                                rec.get("t_ms", 0.0) / 1e3,
+                                rec.get("attrs", {})))
+        elif typ == "query_end":
+            counters = rec.get("counters", {})
+            metrics = rec.get("metrics", {})
+            meta = rec.get("meta", {})
+            registry = rec.get("registry", {})
+    return EventLog(qid, start, spans, events, counters, metrics, meta,
+                    registry=registry, truncated=truncated)
 
 
 class NullTracer:
-    """Disabled-path tracer: every record call is a no-op.  This is what
-    keeps default-conf overhead under the <2% budget — call sites never
-    branch, they just call into nothing."""
+    """Disabled-path tracer: span collection is a no-op (no timing, no
+    allocation — what keeps default-conf overhead under the <2% budget),
+    but instants and byte counters still feed the ALWAYS-ON metrics
+    plane (flight recorder + process registry, PR 5): incidents and
+    data movement stay visible with tracing off, at the cost of one
+    enabled-flag check plus a dict/deque append per event."""
 
     enabled = False
     metrics: Optional[dict] = None
@@ -305,11 +372,11 @@ class NullTracer:
     def add_span(self, *a, **k):
         return None
 
-    def instant(self, *a, **k):
-        return None
+    def instant(self, name: str, cat: str, **attrs) -> None:
+        _publish_instant(name, cat, attrs)
 
-    def add_bytes(self, *a, **k):
-        return None
+    def add_bytes(self, key: str, n: int) -> None:
+        _publish_bytes(key, n)
 
     def finish(self, *a, **k):
         return None

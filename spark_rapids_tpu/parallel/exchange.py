@@ -279,6 +279,11 @@ class RaggedExchange:
             tr.add_bytes("ici_exchange_bytes", rounds * slab)
             tr.instant("ici_exchange", "shuffle", rounds=rounds,
                        bytes=rounds * slab, recv_cap=recv_cap)
+            # always-on per-device wire accounting: every chip ships one
+            # (P, quota) slab per lane per round through the collective
+            from ..obs.registry import ICI_EXCHANGE_BYTES
+            for d in self.mesh.devices.flatten():
+                ICI_EXCHANGE_BYTES.inc(rounds * slab, device=d.id)
         round_fn = self._round_fn(recv_cap)
         n = self.nparts * recv_cap
         shard = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))

@@ -1221,16 +1221,30 @@ class PhysicalQuery:
         (GpuTaskMetrics role).  The tracer gates on ctx.conf (not the
         planning conf) so a caller can profile one collect of an
         already-planned query."""
+        import time as _time
         from contextlib import contextmanager
         from ..config import EVENT_LOG_DIR
         from ..exec.metrics import (instrument, profile_trace,
-                                    should_instrument)
+                                    publish_registry, should_instrument)
+        from ..obs.export import configure_plane
+        from ..obs.recorder import FLIGHT_RECORDER
+        from ..obs.registry import (ACTIVE_QUERIES, QUERIES_TOTAL,
+                                    QUERY_WALL_MS, next_query_seq)
         from ..obs.tracer import NULL_TRACER, make_tracer, set_active
         from ..runtime import faults
         from ..runtime.semaphore import device_permit
 
         @contextmanager
         def scope():
+            # always-on plane: apply this query's conf (enabled flag,
+            # recorder capacity, exporter start) before anything records
+            configure_plane(ctx.conf)
+            qseq = next_query_seq()
+            t_start = _time.perf_counter()
+            status = "ok"
+            ACTIVE_QUERIES.add(1)
+            FLIGHT_RECORDER.record("instant", "query_start", "query",
+                                   {"plan_kind": self.kind}, query=qseq)
             tracer = make_tracer(ctx.conf)
             ctx.tracer = tracer
             # chaos: conf-less sites (mesh exchange collectives) fire on
@@ -1262,6 +1276,10 @@ class PhysicalQuery:
                 if ctx._budget is not None:
                     for k, v in ctx.budget.metrics.items():
                         ctx.metrics[f"memory.{k}"] = v
+                publish_registry(ctx)
+            except BaseException:
+                status = "error"
+                raise
             finally:
                 set_active(NULL_TRACER)
                 faults.set_active(faults.NULL_INJECTOR)
@@ -1271,6 +1289,19 @@ class PhysicalQuery:
                     if log_dir:
                         ctx.metrics["event_log_files"] = \
                             tracer.write(log_dir)
+                wall_ms = (_time.perf_counter() - t_start) * 1e3
+                ACTIVE_QUERIES.add(-1)
+                QUERY_WALL_MS.observe(wall_ms)
+                QUERIES_TOTAL.inc(status=status, kind=self.kind)
+                # NOTE: the crash-dump writer (runtime/failure.py) runs
+                # before this finally (crash_capture is the inner cm),
+                # so a fatal fault's dump never contains this marker —
+                # under default conf its last flight event stays the
+                # fault instant itself
+                FLIGHT_RECORDER.record(
+                    "instant", "query_end", "query",
+                    {"status": status, "wall_ms": round(wall_ms, 3)},
+                    query=qseq)
         return scope()
 
     def _whole_plan_enabled(self) -> bool:

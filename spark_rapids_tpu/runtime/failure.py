@@ -105,6 +105,12 @@ def write_crash_dump(conf: TpuConf, exc: BaseException,
     if not dump_dir:
         return None
     os.makedirs(dump_dir, exist_ok=True)
+    # the flight recorder FIRST: its tail must show what the runtime
+    # was doing up to the fault — the fault's own instant is the last
+    # event, and nothing the dump writer does below may append past it
+    from ..obs.recorder import FLIGHT_RECORDER
+    from ..obs.registry import CRASH_DUMPS, REGISTRY
+    flight_tail = FLIGHT_RECORDER.tail()
     info = {
         "ts": time.time(),
         "pid": os.getpid(),
@@ -112,7 +118,10 @@ def write_crash_dump(conf: TpuConf, exc: BaseException,
         "traceback": traceback.format_exception(
             type(exc), exc, exc.__traceback__),
         "classification": classify(exc),
+        "flight_recorder": flight_tail,
+        "metrics_registry": REGISTRY.flat(),
     }
+    CRASH_DUMPS.inc()
     try:
         import jax
         d = jax.devices()[0]

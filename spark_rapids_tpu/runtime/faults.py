@@ -226,6 +226,8 @@ class FaultInjector:
             rec.update({k: str(v) for k, v in info.items()})
             if len(self.log) < 256:
                 self.log.append(rec)
+        from ..obs.registry import FAULTS_INJECTED
+        FAULTS_INJECTED.inc(site=site, kind=fired.kind)
         from ..obs.tracer import get_active
         get_active().instant("fault_injected", "chaos", site=site,
                              kind=fired.kind, hit=fired.hits)

@@ -22,6 +22,7 @@ from __future__ import annotations
 import os
 import tempfile
 import threading
+import time
 from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Optional
@@ -32,6 +33,19 @@ from ..columnar.device import DeviceBatch, to_device, to_host
 from ..columnar.host import HostBatch
 from ..config import (HBM_BUDGET_BYTES, HBM_BUDGET_FRACTION,
                       HOST_SPILL_LIMIT_BYTES, TEST_INJECT_RETRY_OOM, TpuConf)
+from ..obs.registry import (HBM_LIVE_BYTES, HBM_PEAK_BYTES,
+                            HOST_SPILL_LIVE_BYTES, RELEASE_UNDERFLOWS,
+                            SPILL_BATCHES, SPILL_BYTES, SPILL_MS)
+
+
+def _device_label() -> str:
+    """Index of the chip whose HBM this process budgets (the per-device
+    label on the registry's HBM gauges)."""
+    try:
+        import jax
+        return str(jax.devices()[0].id)
+    except Exception:                            # noqa: BLE001
+        return "0"
 
 
 class TpuRetryOOM(RuntimeError):
@@ -160,11 +174,14 @@ class MemoryBudget:
         self._injector = get_injector(conf)
         # per-thread stack of attempt scopes (retry-ladder rollback)
         self._tls = threading.local()
+        # per-query compat view; the process-wide truth lives in the
+        # always-on registry (obs/registry.py) this budget also feeds
         self.metrics = {"spilled_batches": 0, "spilled_bytes": 0,
                         "disk_batches": 0, "oom_retries": 0,
                         "batch_splits": 0, "peak_bytes": 0,
                         "release_underflow": 0, "io_retries": 0,
                         "attempt_rollback_bytes": 0}
+        self._device = _device_label()
 
     # -- registration ------------------------------------------------------
     def register(self, sp: "Spillable") -> int:
@@ -246,6 +263,8 @@ class MemoryBudget:
             # device-memory high-water (the profile's peak-usage line)
             if self.live > self.metrics["peak_bytes"]:
                 self.metrics["peak_bytes"] = self.live
+            HBM_LIVE_BYTES.set(self.live, device=self._device)
+            HBM_PEAK_BYTES.max(self.live, device=self._device)
 
     def release(self, nbytes: int, _tracked: bool = True):
         with self._lock:
@@ -257,7 +276,9 @@ class MemoryBudget:
                 # double-release: clamp so the budget doesn't silently
                 # widen, and count it — chaos/regression tests assert 0
                 self.metrics["release_underflow"] += 1
+                RELEASE_UNDERFLOWS.inc()
                 self.live = 0
+            HBM_LIVE_BYTES.set(self.live, device=self._device)
 
     def _spill_one(self) -> bool:
         for sp in self._spillables.values():
@@ -282,13 +303,16 @@ class MemoryBudget:
                 if not self._disk_one():
                     break        # disk tier is unbounded; never refuse
             self.host_live += nbytes
+            HOST_SPILL_LIVE_BYTES.set(self.host_live)
 
     def host_release(self, nbytes: int):
         with self._lock:
             self.host_live -= nbytes
             if self.host_live < 0:
                 self.metrics["release_underflow"] += 1
+                RELEASE_UNDERFLOWS.inc()
                 self.host_live = 0
+            HOST_SPILL_LIVE_BYTES.set(self.host_live)
 
     def _disk_one(self) -> bool:
         for sp in self._spillables.values():
@@ -355,11 +379,15 @@ class Spillable:
         with self._budget._lock:
             if self._db is None:
                 return
+            t0 = time.perf_counter()
             hb = to_host(self._db)
             self._db = None
             self._budget.release(self._nbytes, _tracked=False)
             self._budget.metrics["spilled_batches"] += 1
             self._budget.metrics["spilled_bytes"] += self._nbytes
+            SPILL_BATCHES.inc(tier="host")
+            SPILL_BYTES.inc(self._nbytes, tier="host")
+            SPILL_MS.observe((time.perf_counter() - t0) * 1e3, op="spill")
             from ..obs.tracer import get_active
             get_active().instant("spill", "runtime", tier="host",
                                  bytes=self._nbytes)
@@ -392,12 +420,15 @@ class Spillable:
                 w.write_batch(hb.rb)
             payload = sink.getvalue()               # zero-copy pa.Buffer
             self._writing = True
+            t0 = time.perf_counter()
             try:
                 retry_io(self._budget.conf, "spill_write",
                          lambda: native.spill_write(path, payload),
                          budget=self._budget, lock=self._budget._lock)
             finally:
                 self._writing = False
+            SPILL_MS.observe((time.perf_counter() - t0) * 1e3,
+                             op="to_disk")
             if self._hb is not hb:
                 # the owner re-uploaded or closed while the lock was
                 # yielded: the host tier moved on, the block is stale
@@ -408,6 +439,8 @@ class Spillable:
                 return
             self._budget.host_release(hb.rb.nbytes)
             self._budget.metrics["disk_batches"] += 1
+            SPILL_BATCHES.inc(tier="disk")
+            SPILL_BYTES.inc(hb.rb.nbytes, tier="disk")
             from ..obs.tracer import get_active
             get_active().instant("spill", "runtime", tier="disk",
                                  bytes=hb.rb.nbytes)
@@ -466,9 +499,11 @@ class Spillable:
                         f"{path} ({e})", path=path) from e
                 raise
 
+        t0 = time.perf_counter()
         payload = retry_io(self._budget.conf, "spill_read", _read,
                            budget=self._budget, info={"path": path},
                            lock=self._budget._lock)
+        SPILL_MS.observe((time.perf_counter() - t0) * 1e3, op="read")
         reader = pa.ipc.open_stream(pa.BufferReader(payload))
         rb = reader.read_next_batch()
         return HostBatch(rb)

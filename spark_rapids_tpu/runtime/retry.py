@@ -26,6 +26,7 @@ from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
 from ..config import (RETRY_ENABLED, RETRY_IO_ATTEMPTS,
                       RETRY_IO_BACKOFF_MS, RETRY_IO_BACKOFF_MULT,
                       RETRY_MAX_ATTEMPTS, RETRY_MAX_SPLITS, TpuConf)
+from ..obs.registry import BATCH_SPLITS, IO_RETRIES, OOM_RETRIES
 from .memory import MemoryBudget, TpuRetryOOM, is_oom_error
 
 T = TypeVar("T")
@@ -67,6 +68,7 @@ def retry_io(conf: TpuConf, site: str, attempt: Callable[[], T],
             from ..obs.tracer import get_active
             get_active().instant("io_retry", "runtime", site=site,
                                  attempt=i + 1, error=type(e).__name__)
+            IO_RETRIES.inc(site=site)
             if budget is not None:
                 budget.metrics["io_retries"] += 1
             if backoff > 0:
@@ -125,6 +127,7 @@ def with_retry(budget: MemoryBudget, conf: TpuConf,
         if not oom or i + 1 >= max_attempts:
             raise err
         budget.metrics["oom_retries"] += 1
+        OOM_RETRIES.inc()
         get_active().instant("oom_retry", "runtime",
                              error=type(err).__name__, attempt=i + 1)
         budget.spill_all()
@@ -165,6 +168,7 @@ def with_split_retry(budget: MemoryBudget, conf: TpuConf,
             last_oom = err
             if i + 1 < max_attempts:
                 budget.metrics["oom_retries"] += 1
+                OOM_RETRIES.inc()
                 get_active().instant("oom_retry", "runtime", depth=depth,
                                      attempt=i + 1)
                 budget.spill_all()
@@ -174,6 +178,7 @@ def with_split_retry(budget: MemoryBudget, conf: TpuConf,
             raise TpuRetryOOM(
                 f"OOM persists after {depth} splits") from last_oom
         budget.metrics["batch_splits"] += 1
+        BATCH_SPLITS.inc()
         get_active().instant("batch_split", "runtime", depth=depth + 1)
         halves = split_batch(b, conf)
         pending[:0] = [(h, depth + 1) for h in halves]

@@ -45,6 +45,7 @@ def device_permit(conf: TpuConf, metrics: Optional[dict] = None):
     reach an ExecContext — shuffle/scan worker threads — still populate
     the wait accumulator instead of silently dropping it."""
     import time
+    from ..obs.registry import SEMAPHORE_WAIT_MS
     from ..obs.tracer import get_active
     tracer = get_active()
     if metrics is None:
@@ -53,6 +54,9 @@ def device_permit(conf: TpuConf, metrics: Optional[dict] = None):
     t0 = time.perf_counter()
     sem.acquire()
     waited = time.perf_counter() - t0
+    # always-on wait distribution: one observation per acquisition, so
+    # count == acquisitions and contention shows up in the tail buckets
+    SEMAPHORE_WAIT_MS.observe(waited * 1000.0)
     if metrics is not None:
         metrics["semaphore_wait_ms"] = metrics.get(
             "semaphore_wait_ms", 0.0) + waited * 1000.0

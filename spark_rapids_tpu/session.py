@@ -32,11 +32,33 @@ class TpuSession:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = conf if isinstance(conf, TpuConf) else TpuConf(conf)
         self._last_ctx: Optional[ExecContext] = None
+        # always-on metrics plane: apply the enabled flag / recorder
+        # capacity and start any conf'd exporters (heartbeat JSONL,
+        # Prometheus endpoint) as soon as a session exists
+        from .obs.export import configure_plane
+        configure_plane(self.conf)
 
     def set_conf(self, key: str, value) -> None:
         raw = dict(self.conf._raw)
         raw[key] = value
         self.conf = TpuConf(raw)
+        from .obs.export import configure_plane
+        configure_plane(self.conf)
+
+    def metrics_snapshot(self, compact: bool = False) -> dict:
+        """The process-wide always-on metrics registry: every counter,
+        gauge and log2-bucket histogram the runtime publishes
+        (obs/registry.py; catalog in docs/METRICS.md).  `compact=True`
+        returns the flat `name{labels} -> value` form."""
+        from .obs.export import registry_snapshot
+        return registry_snapshot(compact)
+
+    def flight_record(self, n: Optional[int] = None):
+        """The newest `n` flight-recorder events (all when None) — the
+        bounded always-on ring of spans/instants across ALL queries
+        that crash dumps embed (obs/recorder.py)."""
+        from .obs.export import flight_record
+        return flight_record(n)
 
     def last_query_profile(self):
         """QueryProfile of the most recent collect()/count() on this

@@ -23,6 +23,7 @@ import numpy as np
 import pyarrow as pa
 
 from ..columnar.host import HostBatch
+from ..obs.registry import SHUFFLE_BYTES, SHUFFLE_PARTITION_BYTES
 
 
 class ShuffleBlockStore:
@@ -143,7 +144,13 @@ class ShuffleManager:
         out = {p: payload for p, payload in enumerate(payloads)
                if payload is not None}
         self.store.put_all(shuffle_id, out)
-        return sum(len(p) for p in out.values())
+        total = sum(len(p) for p in out.values())
+        # always-on telemetry: per-partition byte-SKEW distribution (one
+        # observation per written slice) + the write-direction total
+        for payload in out.values():
+            SHUFFLE_PARTITION_BYTES.observe(len(payload))
+        SHUFFLE_BYTES.inc(total, direction="written")
+        return total
 
     def read_partition(self, shuffle_id: int, part_id: int,
                        block_range=None) -> List[pa.RecordBatch]:
@@ -153,6 +160,8 @@ class ShuffleManager:
         if block_range is not None:
             lo, hi = block_range
             payloads = payloads[lo:hi]
+        SHUFFLE_BYTES.inc(sum(len(p) for p in payloads),
+                          direction="read")
         return deserialize_batches(payloads)
 
     def partition_sizes(self, shuffle_id: int) -> Dict[int, int]:
