@@ -14,10 +14,15 @@ fail CI, not wait for a human to eyeball BENCH_r{N}.json.  This gate:
      from the truncated `tail` text (the last stdout line is a complete
      JSON result, but the driver keeps only its tail — individual
      `"qN": {...}` objects inside it are intact and parse alone);
-  2. builds the per-query baseline: the MINIMUM `device_ms` each query
-     ever achieved across the baseline files (the best the engine has
-     demonstrated on this hardware);
-  3. compares the current result: a query REGRESSES when its device_ms
+  2. normalizes every timing to NET-OF-FLOOR milliseconds — the
+     emitted `device_ms_net` when present, else `device_ms` minus that
+     result's own `tunnel_rtt_ms` — so the ~121ms harness round trip
+     can neither hide nor manufacture a regression, and builds the
+     per-query baseline as the MINIMUM each query ever achieved across
+     baseline files from the SAME backend (a cpu-backend run never
+     gates against tunneled-TPU numbers; files predating the `backend`
+     field count as the tunnel's 'axon' platform);
+  3. compares the current result: a query REGRESSES when its net ms
      exceeds baseline * (1 + threshold) — default threshold 0.25 —
      and exceeds the absolute noise floor (--min-ms, default 50 ms, so
      sub-frame jitter cannot fail the gate).
@@ -47,42 +52,76 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: per-query records inside a (possibly head-truncated) bench JSON line
 _QREC_RE = re.compile(r'"(q\d+[a-z]?)":\s*(\{[^{}]*\})')
+_RTT_RE = re.compile(r'"tunnel_rtt_ms":\s*([0-9.]+)')
+_BACKEND_RE = re.compile(r'"backend":\s*"(\w+)"')
+
+#: files predating the "backend" field all came from the tunneled-TPU
+#: harness ('axon' platform) — tag them so timings are only ever
+#: compared against runs on the SAME hardware
+_DEFAULT_BACKEND = "axon"
 
 
-def extract_queries(doc) -> dict:
-    """query name -> device_ms from any accepted result shape; {} when
-    the document carries no per-query timings."""
+def _rec_ms(rec: dict, rtt_ms: float):
+    """Net-of-floor milliseconds for one per-query record: the explicit
+    `device_ms_net` when the bench emitted it, else `device_ms` minus
+    the result's own tunnel RTT (older trajectory files) — so a ~121ms
+    harness round trip can neither hide a regression in a fast query
+    nor manufacture one when the tunnel changes."""
+    if rec.get("device_ms_net"):
+        return float(rec["device_ms_net"])
+    if rec.get("device_ms"):
+        return max(float(rec["device_ms"]) - rtt_ms, 0.001)
+    return None
+
+
+def extract_queries(doc):
+    """-> (query name -> net device_ms, backend tag) from any accepted
+    result shape; ({}, backend) when the document carries no per-query
+    timings."""
     out = {}
     if not isinstance(doc, dict):
-        return out
+        return out, _DEFAULT_BACKEND
+    rtt_ms = float(doc.get("tunnel_rtt_ms") or 0.0)
     for key, val in doc.items():
         if key.endswith("_suite_queries") and isinstance(val, dict):
             for q, rec in val.items():
-                if isinstance(rec, dict) and rec.get("device_ms"):
-                    out[q] = float(rec["device_ms"])
+                if isinstance(rec, dict):
+                    ms = _rec_ms(rec, rtt_ms)
+                    if ms is not None:
+                        out[q] = ms
     if out:
-        return out
+        return out, str(doc.get("backend") or _DEFAULT_BACKEND)
     # driver wrapper: prefer the parsed final line, else mine the tail
     parsed = doc.get("parsed")
     if isinstance(parsed, dict):
-        out = extract_queries(parsed)
+        out, backend = extract_queries(parsed)
         if out:
-            return out
+            return out, backend
     tail = doc.get("tail")
     if isinstance(tail, str):
+        m_rtt = None
+        for m_rtt in _RTT_RE.finditer(tail):
+            pass                      # last match wins (final line)
+        rtt_ms = float(m_rtt.group(1)) if m_rtt else 0.0
         for m in _QREC_RE.finditer(tail):
             try:
                 rec = json.loads(m.group(2))
             except json.JSONDecodeError:
                 continue
-            if isinstance(rec, dict) and rec.get("device_ms"):
-                # later matches win: the FINAL summary line is printed
-                # last and covers every query measured
-                out[m.group(1)] = float(rec["device_ms"])
-    return out
+            if isinstance(rec, dict):
+                ms = _rec_ms(rec, rtt_ms)
+                if ms is not None:
+                    # later matches win: the FINAL summary line is
+                    # printed last and covers every query measured
+                    out[m.group(1)] = ms
+        m_b = None
+        for m_b in _BACKEND_RE.finditer(tail):
+            pass
+        return out, (m_b.group(1) if m_b else _DEFAULT_BACKEND)
+    return out, _DEFAULT_BACKEND
 
 
-def load_file(path: str) -> dict:
+def load_file(path: str):
     with open(path) as f:
         return extract_queries(json.load(f))
 
@@ -133,18 +172,20 @@ def main(argv=None) -> int:
 
     paths = args.trajectory or default_trajectory()
     per_file = {}
+    backends = {}
     for p in paths:
         try:
-            qs = load_file(p)
+            qs, backend = load_file(p)
         except (OSError, json.JSONDecodeError) as e:
             print(f"# skipping unreadable {p}: {e}", file=sys.stderr)
             continue
         per_file[p] = qs
+        backends[p] = backend
     with_data = [p for p in per_file if per_file[p]]
 
     if args.current:
         try:
-            current = load_file(args.current)
+            current, cur_backend = load_file(args.current)
         except (OSError, json.JSONDecodeError) as e:
             print(f"cannot read --current {args.current}: {e}",
                   file=sys.stderr)
@@ -158,11 +199,24 @@ def main(argv=None) -> int:
             return 2
         current_name = with_data[-1]
         current = per_file[current_name]
+        cur_backend = backends[current_name]
         baseline_files = with_data[:-1]
     if not current:
         print(f"{current_name} carries no per-query device_ms",
               file=sys.stderr)
         return 2
+
+    # milliseconds only compare on the SAME hardware: a cpu-backend CI
+    # run gating against tunneled-TPU baselines (or vice versa) would
+    # manufacture regressions/improvements out of the platform change
+    same_hw = [p for p in baseline_files if backends[p] == cur_backend]
+    skipped_hw = [p for p in baseline_files if backends[p] != cur_backend]
+    if skipped_hw:
+        print(f"# backend={cur_backend}: skipping "
+              f"{len(skipped_hw)} baseline file(s) from other backends "
+              f"({', '.join(sorted({backends[p] for p in skipped_hw}))})",
+              file=sys.stderr)
+    baseline_files = same_hw
 
     baseline = {}
     for p in baseline_files:

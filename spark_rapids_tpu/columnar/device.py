@@ -150,9 +150,16 @@ class DeviceBatch:
     # entirely.  Prefix-assuming operators (fetch, concat, slicing)
     # compact on entry via ops.batch_ops.ensure_prefix.
     sel: object = None   # Optional[jax.Array]
+    # LATE-MATERIALIZATION state (columnar/lanes.py ThinState): when
+    # set, columns listed in thin.pending are ZERO-capacity placeholders
+    # backed by (source batch, row-id lane) pairs; sinks resolve them
+    # with one composed gather per source via lanes.materialize_batch.
+    thin: object = None  # Optional[lanes.ThinState]
 
     @property
     def capacity(self) -> int:
+        if self.thin is not None:
+            return self.thin.capacity
         return self.columns[0].capacity if self.columns else 0
 
     @property
@@ -173,10 +180,15 @@ class DeviceBatch:
     def select(self, indices: Sequence[int]) -> "DeviceBatch":
         return DeviceBatch([self.columns[i] for i in indices], self.num_rows,
                            [self.names[i] for i in indices],
-                           self.origin_file, sel=self.sel)
+                           self.origin_file, sel=self.sel,
+                           thin=None if self.thin is None
+                           else self.thin.select(indices))
 
     def nbytes(self) -> int:
-        return sum(c.nbytes() for c in self.columns)
+        n = sum(c.nbytes() for c in self.columns)
+        if self.thin is not None:
+            n += self.thin.nbytes()
+        return n
 
     def row_mask(self) -> jax.Array:
         """Bool mask of logically-live rows: the selection vector when
@@ -421,7 +433,7 @@ def to_host(db: DeviceBatch, fetch_rows: Optional[int] = None) -> HostBatch:
 def _fetch_lanes(db: DeviceBatch, fetch_rows: Optional[int]):
     """device_get count + lanes in one round trip; lanes prefix-sliced to
     fetch_rows when given.  Returns (clamped live count, fetched lists)."""
-    if db.sel is not None:
+    if db.sel is not None or db.thin is not None:
         from ..ops.batch_ops import ensure_prefix
         db = ensure_prefix(db)
     cols = db.columns

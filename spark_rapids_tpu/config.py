@@ -559,6 +559,17 @@ JOIN_MATCHED_VIA_MERGE = conf(
     "segment_max scatters (ops/segments.py matched_flags). Off "
     "restores the scatter reductions.")
 
+JOIN_LATE_MATERIALIZATION = conf(
+    "spark.rapids.tpu.sql.join.lateMaterialization.enabled", True,
+    "Let equi-joins emit THIN batches: payload columns ride as per-side "
+    "row-id selection lanes (the gather indices the join computed "
+    "anyway) and materialize only at a pipeline sink (aggregate build, "
+    "sort, exchange, collect) via one composed gather per source batch "
+    "— row gathers are the dominant device cost on TPU, and a join "
+    "chain otherwise re-gathers every payload column per join. Columns "
+    "a mid-pipeline condition or projection needs are materialized "
+    "early, and only those (plan/overrides.py legality pass).")
+
 
 class TpuConf:
     """An immutable-ish view over a dict of raw settings with typed access.

@@ -69,11 +69,13 @@ class _BloomFilterStage(PlanNode):
     absent from the build side (ops/bloom.py).  Only wrapped around
     joins where unmatched probe rows never reach the output."""
 
-    def __init__(self, child: PlanNode, bits, key_cols_fn, k: int):
+    def __init__(self, child: PlanNode, bits, key_cols_fn, k: int,
+                 key_exprs=None):
         super().__init__(child)
         self.bits = bits
         self.key_cols_fn = key_cols_fn
         self.k = k
+        self.key_exprs = list(key_exprs or [])
 
     @property
     def output_schema(self) -> t.StructType:
@@ -82,13 +84,31 @@ class _BloomFilterStage(PlanNode):
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from ..ops.bloom import bloom_might_contain
         from ..ops.filter import compact_batch
+        import jax.numpy as jnp
         for db in self.child.execute(ctx):
+            if db.thin is not None and self.key_exprs:
+                # a THIN probe stream: the bloom probe needs dense key
+                # columns — materialize exactly those; payload lanes
+                # stay live (the wrapped join composes them)
+                from ..columnar.lanes import materialize_refs
+                db = materialize_refs(db, self.key_exprs, ctx.conf)
             mask = bloom_might_contain(self.bits, self.key_cols_fn(db),
                                        db, self.k) & db.row_mask()
+            if db.thin is not None:
+                # preserve the lanes: compose the bloom verdict into the
+                # selection vector instead of compacting (a compaction is
+                # the row-gather pass late materialization exists to skip)
+                ctx.bump("bloom_filtered_rows",
+                         jnp.int64(db.num_rows) -
+                         jnp.sum(mask, dtype=jnp.int64))
+                yield DeviceBatch(list(db.columns),
+                                  jnp.sum(mask, dtype=jnp.int32),
+                                  db.names, db.origin_file, sel=mask,
+                                  thin=db.thin)
+                continue
             out = compact_batch(db, mask, ctx.conf)
             # lazy metric: accumulate on device, coerced ONCE at query end
             # (PhysicalQuery._instrumented) instead of a sync per batch
-            import jax.numpy as jnp
             ctx.bump("bloom_filtered_rows",
                      jnp.int64(db.num_rows) - jnp.int64(out.num_rows))
             yield out
@@ -112,6 +132,10 @@ class AdaptiveShuffledJoinExec(PlanNode):
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
         self.lazy_sel = False      # forwarded to the inner HashJoinExec
+        # late-materialization allowance (plan/overrides.py
+        # _negotiate_thin), forwarded to the inner HashJoinExec; the
+        # mirror swap is invisible (thin state remaps through select)
+        self.thin_payload = None
 
     @property
     def left(self) -> PlanNode:
@@ -192,6 +216,7 @@ class AdaptiveShuffledJoinExec(PlanNode):
                                  self.left),
                     probe_conds=right_conds, build_conds=left_conds)
                 join.lazy_sel = self.lazy_sel
+                join.thin_payload = self.thin_payload
                 self._maybe_bloom(join, jt, left_stage,
                                   max(rbytes, 1), lbytes, ctx)
                 n_r = len(self.right.output_schema.fields)
@@ -209,6 +234,7 @@ class AdaptiveShuffledJoinExec(PlanNode):
                                  self.right.output_schema, self.right),
                     probe_conds=left_conds, build_conds=right_conds)
                 join.lazy_sel = self.lazy_sel
+                join.thin_payload = self.thin_payload
                 self._maybe_bloom(join, self.join_type, right_stage,
                                   max(lbytes, 1), rbytes, ctx)
                 yield from join.execute(ctx)
@@ -273,7 +299,8 @@ class AdaptiveShuffledJoinExec(PlanNode):
         # the probe child was just constructed by execute(); wrapping it
         # here keeps key binding (done in HashJoinExec.__init__) intact
         join.children[0] = _BloomFilterStage(
-            join.children[0], bits, probe_keys, k)
+            join.children[0], bits, probe_keys, k,
+            key_exprs=join.left_keys)
         ctx.metrics["bloom_filter_slots"] = m
 
     def describe(self):

@@ -262,6 +262,13 @@ class CompiledPlan:
             flat_out = []
             specs = []
             for db in outs:
+                if db.thin is not None:
+                    # the program boundary is a pipeline SINK: resolve
+                    # deferred columns INSIDE the traced program (the
+                    # composed gathers fuse into the whole-plan XLA
+                    # program; the flat output layer carries no lanes)
+                    from ..columnar.lanes import materialize_batch
+                    db = materialize_batch(db, ctx.conf)
                 arrays, spec = _flatten_batch(db)
                 flat_out.extend(arrays)
                 specs.append(spec)
@@ -538,9 +545,9 @@ class SplitCompiledPlan:
     def _shrink(outs: List[DeviceBatch], ctx) -> List[DeviceBatch]:
         sliced = []
         for db in outs:
-            if db.sel is not None:
+            if db.sel is not None or db.thin is not None:
                 # lazy-join seam output: the seam re-buckets anyway, so
-                # materialize the selection vector here
+                # materialize the selection vector / deferred lanes here
                 from ..ops.batch_ops import ensure_prefix
                 db = ensure_prefix(db, ctx.conf)
             if any(c.offsets is not None for c in db.columns):

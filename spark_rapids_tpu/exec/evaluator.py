@@ -15,7 +15,7 @@ cache; the bounded row-bucket set bounds total compiles.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -149,6 +149,12 @@ def _jit_key(exprs, db, aux, conf, tag):
 def evaluate_projection(exprs: Sequence[Expression], names: Sequence[str],
                         db: DeviceBatch, conf: TpuConf) -> DeviceBatch:
     """Project `db` through bound expressions -> new DeviceBatch."""
+    if db.thin is not None:
+        # late materialization: referenced deferred columns resolve here
+        # (ONE composed gather per lane source); unreferenced ones stay
+        # zero-capacity placeholders the traced program never reads
+        from ..columnar.lanes import materialize_refs
+        db = materialize_refs(db, exprs, conf)
     if db.sel is not None and any(c.offsets is not None
                                   for c in db.columns):
         # ragged kernels bound live VALUES by offsets[num_rows] — a
@@ -202,9 +208,58 @@ def evaluate_projection(exprs: Sequence[Expression], names: Sequence[str],
                        sel=db.sel)
 
 
+def project_batch(exprs: Sequence[Expression], names: Sequence[str],
+                  db: DeviceBatch, conf: TpuConf) -> DeviceBatch:
+    """ProjectExec entry point: evaluate_projection, except deferred
+    columns referenced ONLY as plain pass-through refs STAY THIN — the
+    placeholder and its lane bookkeeping move to the output position, so
+    a projection between two joins doesn't force the materialization the
+    join chain deferred.  Computed expressions still materialize exactly
+    the columns they reference (early materialization)."""
+    if db.thin is None:
+        return evaluate_projection(exprs, names, db, conf)
+    if any(c.offsets is not None for c in db.columns):
+        # ragged lanes can force an internal prefix compaction whose
+        # row order would desync from pass-through lanes — stay dense
+        return evaluate_projection(exprs, names, db, conf)
+    from ..columnar.lanes import (ThinState, materialize_refs,
+                                  passthrough_positions)
+    pass_map = passthrough_positions(db, exprs)
+    eval_idx = [i for i in range(len(exprs)) if i not in pass_map]
+    db = materialize_refs(db, [exprs[i] for i in eval_idx], conf)
+    ts = db.thin
+    if ts is not None and pass_map:
+        # a computed expr may have materialized a pass-through column too
+        pass_map = {oi: p for oi, p in pass_map.items() if p in ts.pending}
+    if ts is None or not pass_map:
+        return evaluate_projection(exprs, names, db, conf)
+    cols: List[Optional[DeviceColumn]] = [None] * len(exprs)
+    if eval_idx:
+        ev = evaluate_projection([exprs[i] for i in eval_idx],
+                                 [names[i] for i in eval_idx], db, conf)
+        for i, c in zip(eval_idx, ev.columns):
+            cols[i] = c
+    used: List = []
+    src_map: Dict[int, int] = {}
+    new_pending: Dict[int, Tuple[int, int]] = {}
+    for oi, p in pass_map.items():
+        s, c = ts.pending[p]
+        if s not in src_map:
+            src_map[s] = len(used)
+            used.append(ts.sources[s])
+        cols[oi] = db.columns[p]          # the zero-capacity placeholder
+        new_pending[oi] = (src_map[s], c)
+    thin = ThinState(ts.capacity, used, new_pending)
+    return DeviceBatch(cols, db.num_rows, list(names), db.origin_file,
+                       sel=db.sel, thin=thin)
+
+
 def compute_predicate(cond: Expression, db: DeviceBatch,
                       conf: TpuConf) -> jax.Array:
     """Evaluate a boolean expression -> keep-mask (False for null/padding)."""
+    if db.thin is not None:
+        from ..columnar.lanes import materialize_refs
+        db = materialize_refs(db, [cond], conf)
     if db.sel is not None and any(c.offsets is not None
                                   for c in db.columns):
         from ..ops.batch_ops import ensure_prefix

@@ -59,6 +59,13 @@ class ShuffleExchangeExec(PlanNode):
         n = self.partitioning.num_partitions
         codec = str(ctx.conf.get(SHUFFLE_COMPRESSION)).lower()
         for db in self.child.execute(ctx):
+            if db.sel is not None or db.thin is not None:
+                # exchange is a pipeline SINK: partition ids must align
+                # row-for-row with the serialized prefix, so lazy
+                # selection vectors compact and deferred columns resolve
+                # (one composed gather per lane source) before splitting
+                from ..ops.batch_ops import ensure_prefix
+                db = ensure_prefix(db, ctx.conf)
             if int(db.num_rows) == 0:
                 continue
             ids = self.partitioning.partition_ids(db, ctx.conf)

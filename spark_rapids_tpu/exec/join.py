@@ -116,6 +116,12 @@ class HashJoinExec(PlanNode):
         # post-pass) lets this join emit a selection vector instead of
         # compacting its output
         self.lazy_sel = False
+        # LATE MATERIALIZATION (columnar/lanes.py): output column names
+        # the parent pipeline allows to ride as row-id lanes instead of
+        # gathered payloads.  None = disabled; set by the overrides
+        # legality pass (_negotiate_thin) only when every consumer up to
+        # the pipeline sink handles thin batches.
+        self.thin_payload = None
         self.probe_conds = list(probe_conds or [])
         self.build_conds = list(build_conds or [])
         if join_type not in (INNER_TYPES := {J.INNER, J.LEFT_OUTER,
@@ -344,6 +350,13 @@ class HashJoinExec(PlanNode):
         raw_pos = self._raw_key_positions()
 
         def scatter(db, exprs, conds, buckets):
+            if db.thin is not None:
+                # key/condition columns must be dense before bucketing;
+                # remaining deferred columns resolve inside the bucket
+                # compaction (compact_thin — one composed gather)
+                from ..columnar.lanes import materialize_refs
+                db = materialize_refs(db, list(exprs) + list(conds),
+                                      ctx.conf)
             keys = self._key_cols(db, exprs, raw_pos, ctx)
             ids = _join_partition_ids(keys, db, k)
             # fused filters apply here — bucket batches are post-filter,
@@ -398,6 +411,67 @@ class HashJoinExec(PlanNode):
             for part in build_parts + probe_parts:
                 for sp in part:
                     sp.close()
+
+    # -- late materialization helpers --------------------------------------
+
+    def _thin_transparent(self) -> bool:
+        """Whether this join can carry a THIN probe stream through (pass
+        lanes along / compose them) instead of materializing on entry."""
+        return self.thin_payload is not None and self.join_type in (
+            J.INNER, J.LEFT_OUTER, J.LEFT_SEMI, J.LEFT_ANTI)
+
+    def _defer_right(self) -> List[int]:
+        """Right-side column indices this join defers behind a build
+        row-id lane (inner/left-outer only: their null-extension falls
+        out of the -1 lane; right/full outer emit a dense build tail)."""
+        if self.thin_payload is None or \
+                self.join_type not in (J.INNER, J.LEFT_OUTER):
+            return []
+        return [j for j, f in enumerate(self.right.output_schema.fields)
+                if f.name in self.thin_payload]
+
+    def _prep_probe(self, pb: DeviceBatch, probe_conds,
+                    ctx: ExecContext) -> DeviceBatch:
+        """Normalize an incoming probe batch for this join: a thin batch
+        materializes fully unless this join is thin-transparent; a
+        transparent join still forces early materialization of exactly
+        the deferred columns its keys/conditions reference, plus any
+        pending column the parent pipeline disallowed."""
+        if pb.thin is None:
+            return pb
+        from ..columnar.lanes import materialize_batch, materialize_refs
+        if not self._thin_transparent():
+            return materialize_batch(pb, ctx.conf)
+        pb = materialize_refs(pb, list(self.left_keys) + list(probe_conds),
+                              ctx.conf)
+        if pb.thin is not None:
+            allowed = self.thin_payload
+            bad = [p for p in pb.thin.pending
+                   if pb.names[p] not in allowed]
+            if bad:
+                ctx.bump("join_thin_early_materialized", len(bad))
+                pb = materialize_batch(pb, ctx.conf, bad)
+        return pb
+
+    @staticmethod
+    def _make_thin(out_capacity: int, probe_thin, build_batch, build_lane,
+                   defer_right, nleft: int, probe_sources=None):
+        """ThinState for a join output: probe-side lane sources ride
+        through (pass-through, or pre-composed through the pair
+        expansion), the build side appends one new source addressed by
+        `build_lane`.  None when nothing ends up pending."""
+        from ..columnar.lanes import LaneSource, ThinState
+        sources = list(probe_sources if probe_sources is not None
+                       else (probe_thin.sources if probe_thin else []))
+        pending = dict(probe_thin.pending) if probe_thin else {}
+        if defer_right:
+            ord_b = len(sources)
+            sources.append(LaneSource(build_batch, build_lane))
+            for j in defer_right:
+                pending[nleft + j] = (ord_b, j)
+        if not pending:
+            return None
+        return ThinState(out_capacity, sources, pending)
 
     def _join_stream(self, build_batch: DeviceBatch, probe_iter,
                      ctx: ExecContext, build_conds=(), probe_conds=()
@@ -458,9 +532,36 @@ class HashJoinExec(PlanNode):
 
         build_matched_acc = jnp.zeros((build_batch.capacity,), bool)
 
+        # late materialization: right-side columns in `defer_right` ride
+        # as a build row-id lane instead of being gathered per probe
+        # batch; a thin probe stream passes its lanes through
+        transparent = self._thin_transparent()
+        defer_right = self._defer_right()
+        defer_set = frozenset(defer_right)
+        nleft = len(self.left.output_schema.names)
+        nright = len(self.right.output_schema.fields) \
+            if self.join_type not in (J.LEFT_SEMI, J.LEFT_ANTI) else 0
+        if defer_right:
+            from ..obs.registry import DEFERRED_GATHERS
+            from ..columnar.lanes import deferred_column
+            mat_right = [j for j in range(nright) if j not in defer_set]
+            right_placeholders = {
+                j: deferred_column(build_batch.columns[j])
+                for j in defer_right}
+
+        def right_out_cols(gathered):
+            """Interleave gathered (materialized) right columns with the
+            deferred placeholders, in schema order."""
+            if not defer_right:
+                return list(gathered)
+            it = iter(gathered)
+            return [right_placeholders[j] if j in defer_set else next(it)
+                    for j in range(nright)]
+
         for pb in probe_iter:
             if isinstance(pb.num_rows, int) and pb.num_rows == 0:
                 continue
+            pb = self._prep_probe(pb, probe_conds, ctx)
             probe_keys = self._key_cols(pb, self.left_keys, raw_pos, ctx)
             for i, s in enumerate(has_str):
                 if s:
@@ -494,13 +595,15 @@ class HashJoinExec(PlanNode):
                             cum, out_cap, total)
                 keep = matched if self.join_type == J.LEFT_SEMI \
                     else pre & ~matched
-                if self.lazy_sel:
+                if self.lazy_sel or (transparent and pb.thin is not None):
                     # mask-aware parent (aggregation live mask / another
-                    # join's probe liveness): skip the compaction — row
-                    # gathers are the dominant device cost
+                    # join's probe liveness) or a thin stream: skip the
+                    # compaction — row gathers are the dominant device
+                    # cost; thin lanes stay output-aligned
                     yield DeviceBatch(list(pb.columns),
                                       jnp.sum(keep, dtype=jnp.int32),
-                                      out_names, pb.origin_file, sel=keep)
+                                      out_names, pb.origin_file, sel=keep,
+                                      thin=pb.thin)
                     continue
                 out = compact_batch(pb, keep, ctx.conf)
                 yield DeviceBatch(out.columns, out.num_rows, out_names)
@@ -512,9 +615,25 @@ class HashJoinExec(PlanNode):
                 # a masked probe's live rows are NOT a prefix: gather with
                 # every position live; sel excludes dead rows downstream
                 out_rows = pb.capacity if pb.sel is not None else pb.num_rows
-                rg = gather_batch(build_batch,
-                                  jnp.where(ok, build_idx, -1),
-                                  out_rows, null_out_of_bounds=True)
+                build_lane = jnp.where(ok, build_idx,
+                                       jnp.int32(-1)).astype(jnp.int32)
+                if defer_right:
+                    # deferred right columns ride the lane; only the
+                    # early-needed ones are gathered per probe batch
+                    ctx.bump("join_deferred_gathers", len(defer_right))
+                    DEFERRED_GATHERS.inc(len(defer_right))
+                    rg_cols = right_out_cols(
+                        gather_batch(build_batch.select(mat_right),
+                                     build_lane, out_rows,
+                                     null_out_of_bounds=True).columns
+                        if mat_right else [])
+                else:
+                    rg_cols = gather_batch(build_batch, build_lane,
+                                           out_rows,
+                                           null_out_of_bounds=True).columns
+                thin = self._make_thin(pb.capacity, pb.thin, build_batch,
+                                       build_lane, defer_right, nleft) \
+                    if (defer_right or pb.thin is not None) else None
                 if self.join_type in (J.RIGHT_OUTER, J.FULL_OUTER):
                     if build.matched_via_merge:
                         from ..ops.segments import matched_flags
@@ -528,28 +647,29 @@ class HashJoinExec(PlanNode):
                     build_matched_acc = build_matched_acc | hit
                 if self.join_type == J.LEFT_OUTER:
                     # all (filter-surviving) probe rows survive; unmatched
-                    # rows carry null right columns (the -1 gather)
-                    out = DeviceBatch(list(pb.columns) + rg.columns,
-                                      pb.num_rows, out_names)
+                    # rows carry null right columns (the -1 gather/lane)
+                    out = DeviceBatch(list(pb.columns) + rg_cols,
+                                      pb.num_rows, out_names, thin=thin)
                     if not probe_conds:
                         # a masked probe's liveness must survive verbatim
                         yield out if pb.sel is None else DeviceBatch(
                             out.columns, pb.num_rows, out_names,
-                            sel=pb.sel)
-                    elif self.lazy_sel:
+                            sel=pb.sel, thin=thin)
+                    elif self.lazy_sel or thin is not None:
                         yield DeviceBatch(out.columns,
                                           jnp.sum(pre, dtype=jnp.int32),
-                                          out_names, sel=pre)
+                                          out_names, sel=pre, thin=thin)
                     else:
                         yield compact_batch(out, pre, ctx.conf)
                 else:   # inner / right_outer / full_outer matched part
-                    pairs = DeviceBatch(list(pb.columns) + rg.columns,
-                                        pb.num_rows, out_names)
+                    pairs = DeviceBatch(list(pb.columns) + rg_cols,
+                                        pb.num_rows, out_names, thin=thin)
                     keep = ok & pre
-                    if self.lazy_sel and self.join_type == J.INNER:
+                    if self.join_type == J.INNER and \
+                            (self.lazy_sel or thin is not None):
                         yield DeviceBatch(pairs.columns,
                                           jnp.sum(keep, dtype=jnp.int32),
-                                          out_names, sel=keep)
+                                          out_names, sel=keep, thin=thin)
                     else:
                         yield compact_batch(pairs, keep, ctx.conf)
                     if self.join_type == J.FULL_OUTER:
@@ -564,28 +684,92 @@ class HashJoinExec(PlanNode):
 
             lo, counts, cum, total = J.probe_counts(build, probe_lanes,
                                                     probe_valid)
+            go_thin = defer_right or (transparent and pb.thin is not None)
             if total > 0:
                 out_cap = bucket_capacity(total, ctx.conf)
                 probe_idx, build_idx, ok, probe_matched, build_matched = \
                     J.expand_pairs(build, probe_lanes, probe_valid, lo,
                                    counts, cum, out_cap, total)
                 build_matched_acc = build_matched_acc | build_matched
-                lg = gather_batch(pb, probe_idx, total)
-                rg = gather_batch(build_batch, build_idx, total)
-                pairs = DeviceBatch(lg.columns + rg.columns, total, out_names)
-                pairs = compact_batch(pairs, ok, ctx.conf)
-                yield pairs
+                if go_thin:
+                    # thin pair expansion: gather only materialized
+                    # columns; upstream probe lanes COMPOSE through
+                    # probe_idx (one int32 take per source) and the
+                    # deferred right columns ride the new build lane
+                    from ..columnar.lanes import LaneSource
+                    pend_l = pb.thin.pending if pb.thin is not None else {}
+                    mat_l = [i for i in range(len(pb.columns))
+                             if i not in pend_l]
+                    lg = gather_batch(pb.select(mat_l), probe_idx, total)
+                    safe_p = jnp.clip(probe_idx, 0,
+                                      max(pb.capacity - 1, 0))
+                    probe_sources = []
+                    if pb.thin is not None:
+                        for s in pb.thin.sources:
+                            comp = jnp.take(s.lane, safe_p)
+                            probe_sources.append(LaneSource(
+                                s.batch,
+                                jnp.where(ok, comp, jnp.int32(-1))))
+                    build_lane = jnp.where(ok, build_idx, jnp.int32(-1))
+                    if defer_right:
+                        gathered = gather_batch(
+                            build_batch.select(mat_right), build_lane,
+                            total, null_out_of_bounds=True).columns \
+                            if mat_right else []
+                        rg_cols = right_out_cols(gathered)
+                        ctx.bump("join_deferred_gathers", len(defer_right))
+                        DEFERRED_GATHERS.inc(len(defer_right))
+                    else:
+                        rg_cols = gather_batch(
+                            build_batch, build_lane, total,
+                            null_out_of_bounds=True).columns
+                    left_cols = []
+                    lgi = iter(lg.columns)
+                    for i in range(nleft):
+                        left_cols.append(pb.columns[i] if i in pend_l
+                                         else next(lgi))
+                    thin = self._make_thin(out_cap, pb.thin, build_batch,
+                                           build_lane, defer_right, nleft,
+                                           probe_sources=probe_sources)
+                    yield DeviceBatch(left_cols + rg_cols,
+                                      jnp.sum(ok, dtype=jnp.int32),
+                                      out_names, sel=ok, thin=thin)
+                else:
+                    lg = gather_batch(pb, probe_idx, total)
+                    rg = gather_batch(build_batch, build_idx, total)
+                    pairs = DeviceBatch(lg.columns + rg.columns, total,
+                                        out_names)
+                    pairs = compact_batch(pairs, ok, ctx.conf)
+                    yield pairs
             else:
                 probe_matched = jnp.zeros((pb.capacity,), bool)
 
             if self.join_type in (J.LEFT_OUTER, J.FULL_OUTER):
                 unmatched = pre & ~probe_matched
                 left_cols = list(pb.columns)
-                right_nulls = _null_columns(self.right.output_schema,
-                                            pb.capacity)
-                padded = DeviceBatch(left_cols + right_nulls, pb.num_rows,
-                                     out_names)
-                yield compact_batch(padded, unmatched, ctx.conf)
+                if go_thin and self.join_type == J.LEFT_OUTER:
+                    # unmatched probe rows: deferred right columns keep a
+                    # -1 (null) lane, upstream lanes pass through
+                    null_lane = jnp.full((pb.capacity,), -1, jnp.int32)
+                    rn_cols = right_out_cols(_null_columns(
+                        t.StructType([
+                            f for j, f in enumerate(
+                                self.right.output_schema.fields)
+                            if j not in defer_set]),
+                        pb.capacity)) if defer_right else _null_columns(
+                        self.right.output_schema, pb.capacity)
+                    thin = self._make_thin(pb.capacity, pb.thin,
+                                           build_batch, null_lane,
+                                           defer_right, nleft)
+                    yield DeviceBatch(left_cols + rn_cols,
+                                      jnp.sum(unmatched, dtype=jnp.int32),
+                                      out_names, sel=unmatched, thin=thin)
+                else:
+                    right_nulls = _null_columns(self.right.output_schema,
+                                                pb.capacity)
+                    padded = DeviceBatch(left_cols + right_nulls,
+                                         pb.num_rows, out_names)
+                    yield compact_batch(padded, unmatched, ctx.conf)
 
         if self.join_type in (J.RIGHT_OUTER, J.FULL_OUTER):
             unmatched = build_pre & ~build_matched_acc
@@ -616,18 +800,22 @@ class HashJoinExec(PlanNode):
         for pb in probe_iter:
             if int(pb.num_rows) == 0:
                 continue
+            if pb.thin is not None and not self._thin_transparent():
+                from ..columnar.lanes import materialize_batch
+                pb = materialize_batch(pb, ctx.conf)
             if probe_conds:
                 pb = compact_batch(
                     pb, self._conds_mask(probe_conds, pb, pb.row_mask(),
                                          ctx), ctx.conf)
             if self.join_type == J.LEFT_ANTI:
                 yield DeviceBatch(pb.columns, pb.num_rows, out_names,
-                                  sel=pb.sel)
+                                  sel=pb.sel, thin=pb.thin)
             else:   # left/full outer
                 right_nulls = _null_columns(self.right.output_schema,
                                             pb.capacity)
                 yield DeviceBatch(list(pb.columns) + right_nulls,
-                                  pb.num_rows, out_names, sel=pb.sel)
+                                  pb.num_rows, out_names, sel=pb.sel,
+                                  thin=pb.thin)
 
     def describe(self):
         return (f"HashJoinExec[{self.join_type}, "

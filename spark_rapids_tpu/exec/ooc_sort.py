@@ -69,6 +69,11 @@ class OutOfCoreSorter:
         return self._window_rows
 
     def add(self, db: DeviceBatch):
+        if db.thin is not None:
+            # sort sink: resolve deferred columns before run building
+            # (runs slice/spill column lanes directly)
+            from ..ops.batch_ops import ensure_prefix
+            db = ensure_prefix(db, self.conf)
         n = int(db.num_rows)
         if n == 0:
             return

@@ -355,8 +355,11 @@ class ProjectExec(PlanNode):
                              for n, e in zip(self.names, self.exprs)])
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from .evaluator import project_batch
         for db in self.child.execute(ctx):
-            yield evaluate_projection(self.exprs, self.names, db, ctx.conf)
+            # thin-aware: plain refs to deferred columns pass through as
+            # lanes (project_batch); computed exprs materialize their refs
+            yield project_batch(self.exprs, self.names, db, ctx.conf)
 
     def describe(self):
         return f"ProjectExec[{', '.join(self.names)}]"
@@ -386,7 +389,29 @@ class FilterExec(PlanNode):
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from .evaluator import compute_predicate
         for db in self.child.execute(ctx):
-            keep = compute_predicate(self.condition, db, ctx.conf)
+            if db.thin is not None:
+                # thin input: referenced deferred columns materialize
+                # early (just those); the mask then COMPOSES into the
+                # selection vector instead of compacting, so the lanes
+                # stay live to the pipeline sink
+                from ..columnar.lanes import materialize_refs
+                db = materialize_refs(db, [self.condition], ctx.conf)
+                if db.thin is not None and db.sel is not None and \
+                        any(c.offsets is not None for c in db.columns):
+                    # ragged+sel forces an internal prefix compaction in
+                    # compute_predicate whose row order would desync
+                    # from the lanes — resolve them first
+                    from ..ops.batch_ops import ensure_prefix
+                    db = ensure_prefix(db, ctx.conf)
+                keep = compute_predicate(self.condition, db, ctx.conf)
+                if db.thin is not None:
+                    yield DeviceBatch(list(db.columns),
+                                      jnp.sum(keep, dtype=jnp.int32),
+                                      db.names, db.origin_file, sel=keep,
+                                      thin=db.thin)
+                    continue
+            else:
+                keep = compute_predicate(self.condition, db, ctx.conf)
             # lazy row count: downstream device ops keep running sync-free
             yield compact_batch(db, keep, ctx.conf)
 
@@ -595,6 +620,15 @@ class HashAggregateExec(PlanNode):
             if isinstance(db.num_rows, int) and db.num_rows == 0:
                 continue
             seen = True
+            if db.thin is not None:
+                # aggregation is a pipeline SINK: deferred columns the
+                # keys/inputs/fused conds reference materialize here with
+                # one composed gather per lane source; unreferenced ones
+                # stay zero-capacity placeholders no program reads
+                from ..columnar.lanes import materialize_refs
+                db = materialize_refs(
+                    db, list(conds) + list(self.key_exprs) +
+                    list(agg.input_exprs), ctx.conf)
             if agg.can_fuse_filter(db):
                 p = agg.partial_fused(db, conds)
             else:
@@ -739,6 +773,12 @@ class HashAggregateExec(PlanNode):
         for db in source.execute(ctx):
             if isinstance(db.num_rows, int) and db.num_rows == 0:
                 continue
+            if db.thin is not None:
+                # same sink rule as execute(): deferred columns the
+                # fused conds/inputs reference materialize here
+                from ..columnar.lanes import materialize_refs
+                db = materialize_refs(db, list(conds) +
+                                      list(agg.input_exprs), ctx.conf)
             raw.append(agg.partial_fused(db, conds, raw=True))
         if not raw:
             empty = empty_device_batch(source.output_schema, ctx.conf)

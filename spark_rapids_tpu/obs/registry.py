@@ -407,6 +407,34 @@ CRASH_DUMPS = REGISTRY.counter(
     "tpu_crash_dumps_total",
     "Fatal-device crash dumps written by runtime/failure.py.")
 
+GATHER_ROWS = REGISTRY.counter(
+    "tpu_gather_rows_total",
+    "Row gathers performed per site (rows x columns, capacity-based): "
+    "probe/build = join-side payload gathers, late = deferred columns "
+    "resolved at a pipeline sink through composed row-id lanes "
+    "(columnar/lanes.py).",
+    ("site",))
+
+GATHER_BYTES = REGISTRY.counter(
+    "tpu_gather_bytes_total",
+    "Bytes moved by row gathers per site (data + validity + hi lanes at "
+    "batch capacity) — the dominant device cost of join pipelines.",
+    ("site",))
+
+DEFERRED_GATHERS = REGISTRY.counter(
+    "tpu_deferred_gathers_total",
+    "Payload-column gathers a join SKIPPED by emitting a thin batch "
+    "(late materialization): the column rides as a row-id lane and "
+    "materializes at the pipeline sink — or never, if nothing "
+    "references it.")
+
+DICT_REMAPS = REGISTRY.counter(
+    "tpu_join_dict_remaps_total",
+    "Host dictionary remap/unification computations (index_in + "
+    "uniqueness unify). Cached per dictionary identity pair, so this "
+    "counts cache MISSES — per-probe-batch recomputation regressions "
+    "show up here.")
+
 
 _QUERY_SEQ_LOCK = threading.Lock()
 _QUERY_SEQ = 0

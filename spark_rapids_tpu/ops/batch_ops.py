@@ -43,31 +43,72 @@ def remap_string_column(col: DeviceColumn, remap: np.ndarray,
     return DeviceColumn(data, col.validity, col.dtype, unified)
 
 
+# Dictionary-identity caches: the SAME pa.Array dictionary object flows
+# through every batch of a scan (and every sub-partition bucket of a
+# materialized build side), so the O(dictionary) host work — uniqueness
+# unification, cross-dictionary remap tables — is computed once per
+# dictionary (pair), not once per probe batch.  Entries pin the
+# dictionaries so id() reuse cannot alias a stale hit; tracers are never
+# cached (whole-plan tracing).  The ``dict_remaps`` registry counter
+# counts actual host computations, so a regression back to per-batch
+# remapping is visible in the metrics plane.
+
+_UNIQUE_DICT_CACHE: dict = {}
+_REMAP_TABLE_CACHE: dict = {}
+
+
+def _count_dict_remap() -> None:
+    from ..obs.registry import DICT_REMAPS
+    DICT_REMAPS.inc()
+
+
 def ensure_unique_dict(col: DeviceColumn) -> DeviceColumn:
     """Code-equality == string-equality requires a duplicate-free dict."""
     d = col.dictionary
     if d is None:
         return col
-    unified, remaps = unify_dictionaries([d])
-    if len(unified) == len(d):
+    hit = _UNIQUE_DICT_CACHE.get(id(d))
+    if hit is not None and hit[0] is d:
+        unified, remap = hit[1], hit[2]
+    else:
+        _count_dict_remap()
+        unified, remaps = unify_dictionaries([d])
+        remap = None if len(unified) == len(d) else remaps[0]
+        if len(_UNIQUE_DICT_CACHE) > 1024:
+            _UNIQUE_DICT_CACHE.clear()
+        _UNIQUE_DICT_CACHE[id(d)] = (d, unified, remap)
+    if remap is None:
         return col
-    return remap_string_column(col, remaps[0], unified)
+    return remap_string_column(col, remap, unified)
 
 
 def remap_codes_into(col: DeviceColumn, target_dict: pa.Array) -> DeviceColumn:
     """Remap a string column's codes into `target_dict`'s code space; codes
     whose string is absent from the target map to -1 (equal to no valid
     code).  Lets a join probe stream remap against a build-side dictionary
-    unified ONCE instead of re-unifying build+probe per batch."""
+    unified ONCE instead of re-unifying build+probe per batch; the remap
+    table itself is cached per (source, target) dictionary pair so
+    repeated probe batches (and sub-partition buckets) sharing
+    dictionaries never recompute the host index_in."""
     src = col.dictionary
     if src is None:
         raise ValueError("remap_codes_into needs a dictionary column")
-    idx = pc.index_in(src.cast(pa.string()), value_set=target_dict)
-    table = np.asarray(idx.fill_null(-1).to_numpy(zero_copy_only=False),
-                       dtype=np.int32)
-    if not len(table):
-        table = np.full(1, -1, np.int32)
-    dev = jnp.asarray(table)
+    key = (id(src), id(target_dict))
+    hit = _REMAP_TABLE_CACHE.get(key)
+    if hit is not None and hit[0] is src and hit[1] is target_dict:
+        dev = hit[2]
+    else:
+        _count_dict_remap()
+        idx = pc.index_in(src.cast(pa.string()), value_set=target_dict)
+        table = np.asarray(idx.fill_null(-1).to_numpy(zero_copy_only=False),
+                           dtype=np.int32)
+        if not len(table):
+            table = np.full(1, -1, np.int32)
+        dev = jnp.asarray(table)
+        if not isinstance(dev, jax.core.Tracer):
+            if len(_REMAP_TABLE_CACHE) > 1024:
+                _REMAP_TABLE_CACHE.clear()
+            _REMAP_TABLE_CACHE[key] = (src, target_dict, dev)
     data = dev[jnp.clip(col.data, 0, dev.shape[0] - 1)]
     return DeviceColumn(data, col.validity, col.dtype, target_dict)
 
@@ -87,11 +128,16 @@ def _hi_lane_of(col: DeviceColumn, upto=None) -> "jax.Array":
 
 def ensure_prefix(db: DeviceBatch, conf: TpuConf = DEFAULT_CONF
                   ) -> DeviceBatch:
-    """Materialize a lazy selection vector (DeviceBatch.sel) into the
-    front-prefix liveness every slicing/concat/fetch path assumes."""
+    """Materialize a lazy selection vector (DeviceBatch.sel) and any
+    deferred columns (DeviceBatch.thin) into the dense front-prefix form
+    every slicing/concat/fetch path assumes."""
     if db.sel is None:
-        return db
+        if db.thin is None:
+            return db
+        from ..columnar.lanes import materialize_batch
+        return materialize_batch(db, conf)
     from .filter import compact_batch
+    # compact_batch resolves thin state in the same pass (compact_thin)
     return compact_batch(db, db.sel, conf)
 
 

@@ -117,6 +117,15 @@ def compact_batch(db: DeviceBatch, keep: jax.Array,
     work when selectivity is high).
     """
     from .batch_ops import shrink_to_rows
+    if db.thin is not None:
+        # thin batch: deferred columns gather straight from their lane
+        # sources into compacted position — one pass, no
+        # materialize-then-compact double gather
+        from ..columnar.lanes import compact_thin
+        db = compact_thin(db, keep, conf)
+        if not sync:
+            return db
+        return shrink_to_rows(db, int(db.num_rows), conf)
     has_hi = tuple(c.data_hi is not None for c in db.columns)
     sig = (db.num_columns, has_hi, db.capacity,
            tuple(str(c.data.dtype) for c in db.columns))

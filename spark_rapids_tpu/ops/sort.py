@@ -202,4 +202,10 @@ def permute_batch(db: DeviceBatch, perm: jax.Array) -> DeviceBatch:
 def sort_batch(db: DeviceBatch, keys: Sequence[SortKey],
                conf: TpuConf = DEFAULT_CONF) -> DeviceBatch:
     """Fully sort one device batch by the given keys."""
+    if db.thin is not None:
+        # sort is a pipeline SINK for late-materialized join output:
+        # resolve deferred columns (one composed gather per lane source)
+        # before permuting
+        from .batch_ops import ensure_prefix
+        db = ensure_prefix(db, conf)
     return permute_batch(db, sort_permutation(db, keys, conf))

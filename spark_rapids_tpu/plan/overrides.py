@@ -1650,9 +1650,11 @@ def apply_overrides(plan: L.LogicalPlan,
     phases.append(("plan.wrap_tag", t1, t2))
     kind, root = meta.convert()
     if kind == "device":
-        from ..config import JOIN_LAZY_SELECTION
+        from ..config import JOIN_LATE_MATERIALIZATION, JOIN_LAZY_SELECTION
         if conf.get(JOIN_LAZY_SELECTION):
             _negotiate_lazy_sel(root)
+        if conf.get(JOIN_LATE_MATERIALIZATION):
+            _negotiate_thin(root)
     phases.append(("plan.convert", t2, _time.perf_counter()))
     pq = PhysicalQuery(meta, kind, root, conf)
     pq.plan_phases = phases
@@ -1697,6 +1699,69 @@ def _negotiate_lazy_sel(root) -> None:
             walk(c)
 
     walk(root)
+
+
+def _negotiate_thin(root) -> None:
+    """Per-pipeline legality pass for join LATE MATERIALIZATION
+    (columnar/lanes.py): mark every equi-join whose consumer chain —
+    through the thin-TRANSPARENT operators (project passes deferred
+    refs through as lanes, filter composes its mask into the selection
+    vector) — terminates in a thin-aware pipeline SINK (one that
+    resolves deferred columns with composed gathers: aggregate build,
+    sort, exchange, coalesce/limit, another join, or the whole-plan
+    program boundary).  A marked join emits THIN batches: payload
+    columns ride as row-id lanes instead of being gathered per probe
+    batch; runtime hooks force early materialization of exactly the
+    columns a mid-pipeline condition/projection/key actually references,
+    so the pass only needs chain SAFETY, not per-column reference
+    tracking.  Consumers not on the lists below (windows, generate,
+    python/host boundaries, user-facing device streams) keep dense
+    inputs — their producing joins simply stay unmarked."""
+    from ..exec.adaptive import AdaptiveShuffledJoinExec
+    from ..exec.collect import CollectAggregateExec
+    from ..exec.distinct import DistinctAggregateExec
+    from ..exec.exchange import (BroadcastExchangeExec,
+                                 ShuffleExchangeExec, ShuffleReadExec)
+    from ..exec.join import HashJoinExec
+    from ..exec.plan import (CoalesceBatchesExec, ExpandExec, FilterExec,
+                             HashAggregateExec, LocalLimitExec,
+                             ProjectExec, SortExec, TopNExec)
+
+    transparent = (ProjectExec, FilterExec)
+    sinks = (HashAggregateExec, SortExec, TopNExec, CoalesceBatchesExec,
+             LocalLimitExec, ShuffleExchangeExec, ShuffleReadExec,
+             BroadcastExchangeExec, CollectAggregateExec,
+             DistinctAggregateExec, ExpandExec)
+
+    allowed: dict = {}       # id(join) -> AND over every consumer path
+    joins: dict = {}
+
+    def walk(node, thin_ok: bool):
+        if isinstance(node, (HashJoinExec, AdaptiveShuffledJoinExec)):
+            allowed[id(node)] = allowed.get(id(node), True) and thin_ok
+            joins[id(node)] = node
+            for c in node.children:
+                # both sides handle thin inputs: the probe path via
+                # _prep_probe (pass lanes through or materialize refs),
+                # the build path via concat/scatter materialization
+                walk(c, True)
+        elif isinstance(node, transparent):
+            walk(node.child, thin_ok)
+        elif isinstance(node, sinks):
+            for c in node.children:
+                walk(c, True)
+        else:
+            for c in node.children:
+                walk(c, False)
+
+    # the root's own consumer is the result boundary: the compiled
+    # program materializes thin outputs inside the trace and the eager
+    # fetch path resolves them in to_host — but execute_device_batches
+    # hands raw batches to users, so the root chain stays conservative
+    walk(root, False)
+    for nid, node in joins.items():
+        if allowed[nid]:
+            node.thin_payload = frozenset(node.output_schema.names)
 
 
 # ---------------------------------------------------------------------------

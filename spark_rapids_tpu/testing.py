@@ -99,6 +99,35 @@ def jaxpr_scatter_count(jaxpr) -> int:
                if e.primitive.name in _SCATTER_PRIMS)
 
 
+def jaxpr_gather_count(jaxpr) -> int:
+    """Number of `gather` equations in the program — the descriptor-
+    driven row-gather passes that dominate join-pipeline device time
+    (docs/PERF.md; each gathered lane moves at DMA rather than vector
+    bandwidth).  Late materialization (columnar/lanes.py) exists to
+    shrink this number: its per-query budget lint asserts the q3/q9/
+    q15/q16-class programs emit FEWER gathers with the feature on."""
+    return sum(1 for e in _iter_eqns(jaxpr)
+               if e.primitive.name == "gather")
+
+
+def jaxpr_gather_elems(jaxpr) -> int:
+    """Total OUTPUT elements across every `gather` equation — the
+    volume proxy for row-gather device cost (rows x lanes actually
+    moved through descriptor DMA).  Late materialization shrinks this
+    even where the equation COUNT ties (a deferred column's sink gather
+    replaces a per-join gather 1:1 but the skipped re-gathers of chained
+    probe payloads don't), so the per-query budget lint compares
+    volume."""
+    import numpy as np
+    total = 0
+    for e in _iter_eqns(jaxpr):
+        if e.primitive.name == "gather":
+            for ov in e.outvars:
+                shape = getattr(ov.aval, "shape", ())
+                total += int(np.prod(shape)) if shape else 1
+    return total
+
+
 def plan_program_stats(physical, ctx=None) -> Dict:
     """{'sort_operand_max', 'scatter_op_count'} for a PhysicalQuery's
     device plan traced as ONE whole-plan XLA program
@@ -110,7 +139,9 @@ def plan_program_stats(physical, ctx=None) -> Dict:
     ctx = ctx or ExecContext(physical.conf)
     jx = CompiledPlan(physical.root, physical.conf).make_jaxpr(ctx)
     return {"sort_operand_max": jaxpr_sort_operands(jx),
-            "scatter_op_count": jaxpr_scatter_count(jx)}
+            "scatter_op_count": jaxpr_scatter_count(jx),
+            "gather_op_count": jaxpr_gather_count(jx),
+            "gather_out_elems": jaxpr_gather_elems(jx)}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,235 @@
+"""Late-materialization join pipelines (columnar/lanes.py).
+
+Chained equi-joins must produce oracle-identical results whether payload
+columns materialize eagerly (lateMaterialization.enabled=false) or ride
+as row-id lanes to the pipeline sink (default).  The scenarios cover the
+shapes the legality pass (plan/overrides.py _negotiate_thin) admits:
+outer/semi/anti joins chained 2+ deep, null-extended rows, filters and
+projections BETWEEN the joins — including a mid-chain filter that
+references a deferred column and therefore forces early materialization
+of exactly that column — and aggregate / sort / whole-plan-boundary
+sinks."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.exec.adaptive import AdaptiveShuffledJoinExec
+from spark_rapids_tpu.exec.join import HashJoinExec
+from spark_rapids_tpu.exec.plan import ExecContext
+from spark_rapids_tpu.plan.aggregates import Count, Sum
+from spark_rapids_tpu.session import DataFrame, TpuSession, col, lit
+
+OFF = {"spark.rapids.tpu.sql.join.lateMaterialization.enabled": "false"}
+CPU = {"spark.rapids.tpu.sql.enabled": "false"}
+
+
+def _tables(seed=7, n_fact=3000, n_d1=60, n_d2=35):
+    rng = np.random.default_rng(seed)
+    fact = pa.table({
+        # keys range past the dimension domains (unmatched rows) and
+        # carry nulls (never match, null-extend under outer joins)
+        "fk1": pa.array(rng.integers(0, n_d1 + 8, n_fact), pa.int64(),
+                        mask=rng.random(n_fact) < 0.06),
+        "fk2": pa.array(rng.integers(0, n_d2 + 8, n_fact), pa.int64(),
+                        mask=rng.random(n_fact) < 0.06),
+        "fv": pa.array(rng.integers(0, 1000, n_fact), pa.int64()),
+    })
+    d1 = pa.table({
+        "k1": pa.array(np.arange(n_d1), pa.int64()),
+        "p1": pa.array(rng.integers(0, 100, n_d1), pa.int64()),
+        "s1": pa.array([f"grp_{i % 7}" for i in range(n_d1)]),
+    })
+    d2 = pa.table({
+        "k2": pa.array(np.arange(n_d2), pa.int64()),
+        "p2": pa.array(rng.integers(0, 50, n_d2), pa.int64()),
+    })
+    return fact, d1, d2
+
+
+def _norm(t: pa.Table):
+    rows = [tuple(row) for row in
+            zip(*[t.column(c).to_pylist() for c in t.schema.names])]
+    return sorted(rows, key=lambda r: tuple(
+        (v is None, v if v is not None else 0) for v in r))
+
+
+def _check(build_df, extra_conf=None):
+    """Run the same logical plan on (device, thin ON), (device, thin
+    OFF) and the CPU oracle; all three row sets must agree.  Returns the
+    ON-run ExecContext for metric assertions."""
+    dev_on = TpuSession(dict(extra_conf or {}))
+    dev_off = TpuSession({**OFF, **(extra_conf or {})})
+    cpu = TpuSession(CPU)
+    df = build_df(dev_on)
+    q = df.physical()
+    ctx = ExecContext(dev_on.conf)
+    got_on = q.collect(ctx)
+    got_off = DataFrame(df._plan, dev_off).collect()
+    want = DataFrame(df._plan, cpu).collect()
+    assert got_on.schema.names == want.schema.names
+    assert _norm(got_on) == _norm(want), "thin path != oracle"
+    assert _norm(got_off) == _norm(want), "dense path != oracle"
+    return q, ctx
+
+
+def _joins(plan_node, out=None):
+    out = [] if out is None else out
+    if isinstance(plan_node, (HashJoinExec, AdaptiveShuffledJoinExec)):
+        out.append(plan_node)
+    for c in plan_node.children:
+        _joins(c, out)
+    return out
+
+
+@pytest.mark.parametrize("how1,how2", [
+    ("inner", "inner"), ("left_outer", "inner"),
+    ("inner", "left_outer"), ("left_outer", "left_outer")])
+def test_chained_joins_with_filters_match_oracle(how1, how2):
+    """fact ⋈ d1 → filter → ⋈ d2 → sort: two chained joins with a
+    filter between them and null-extended rows, against the oracle."""
+    fact, d1, d2 = _tables()
+
+    def build(s):
+        f = s.from_arrow(fact)
+        j1 = f.join(s.from_arrow(d1), how=how1,
+                    left_on=["fk1"], right_on=["k1"])
+        j1 = j1.filter(col("fv") > lit(200))      # probe-side column
+        j2 = j1.join(s.from_arrow(d2), how=how2,
+                     left_on=["fk2"], right_on=["k2"])
+        return j2.sort(("fv", False), ("fk1", False))
+
+    q, ctx = _check(build)
+    joins = _joins(q.root)
+    assert joins and all(j.thin_payload for j in joins), \
+        "legality pass should mark both chained joins thin"
+
+
+def test_mid_chain_filter_on_deferred_column():
+    """The filter BETWEEN the joins references d1's payload column p1 —
+    deferred by join 1, so the filter must force early materialization
+    of exactly that column (materialize_refs), while s1 stays thin to
+    the sort sink."""
+    fact, d1, d2 = _tables()
+
+    def build(s):
+        f = s.from_arrow(fact)
+        j1 = f.join(s.from_arrow(d1), how="left_outer",
+                    left_on=["fk1"], right_on=["k1"])
+        # p1 is a DEFERRED right-side column here; null-extended rows
+        # must stay dropped by the filter (null > 30 is not true)
+        j1 = j1.filter(col("p1") > lit(30))
+        j2 = j1.join(s.from_arrow(d2), how="inner",
+                     left_on=["fk2"], right_on=["k2"])
+        return j2.sort(("fv", False), ("p2", False))
+
+    q, ctx = _check(build)
+    assert ctx.metrics.get("join_deferred_gathers", 0) > 0, \
+        "the chain should actually defer payload gathers"
+
+
+def test_semi_anti_through_chain():
+    """semi/anti joins pass a thin probe stream through unchanged."""
+    fact, d1, d2 = _tables()
+
+    def build(s):
+        f = s.from_arrow(fact)
+        j1 = f.join(s.from_arrow(d1), how="left_outer",
+                    left_on=["fk1"], right_on=["k1"])
+        semi = j1.join(s.from_arrow(d2), how="left_semi",
+                       left_on=["fk2"], right_on=["k2"])
+        anti = j1.join(s.from_arrow(d2), how="left_anti",
+                       left_on=["fk2"], right_on=["k2"])
+        return semi.union(anti).sort(("fv", False), ("fk1", False)) \
+            if hasattr(semi, "union") else semi.sort(("fv", False),
+                                                     ("fk1", False))
+
+    _check(build)
+
+
+def test_aggregate_sink_materializes_referenced_only():
+    """Group-by over a deferred dimension column: the aggregate sink
+    materializes the key/input columns through the composed lanes."""
+    fact, d1, d2 = _tables()
+
+    def build(s):
+        f = s.from_arrow(fact)
+        j1 = f.join(s.from_arrow(d1), how="inner",
+                    left_on=["fk1"], right_on=["k1"])
+        j2 = j1.join(s.from_arrow(d2), how="left_outer",
+                     left_on=["fk2"], right_on=["k2"])
+        return (j2.group_by("s1")
+                .agg((Sum(col("fv")), "sv"), (Count(col("p2")), "cnt"))
+                .sort(("s1", False)))
+
+    q, ctx = _check(build)
+    assert ctx.metrics.get("join_deferred_gathers", 0) > 0
+
+
+def test_projection_passes_deferred_columns_through():
+    """A projection between the joins: plain refs to deferred columns
+    pass through as lanes (project_batch), computed exprs materialize
+    exactly their refs."""
+    fact, d1, d2 = _tables()
+
+    def build(s):
+        f = s.from_arrow(fact)
+        j1 = f.join(s.from_arrow(d1), how="left_outer",
+                    left_on=["fk1"], right_on=["k1"])
+        proj = j1.select(col("fk2"), col("fv"),
+                         (col("fv") + lit(1)), col("s1"), col("p1"),
+                         names=["fk2", "fv", "fv2", "s1", "p1"])
+        j2 = proj.join(s.from_arrow(d2), how="inner",
+                       left_on=["fk2"], right_on=["k2"])
+        return j2.sort(("fv", False), ("p1", False))
+
+    _check(build)
+
+
+def test_whole_plan_compiled_thin_pipeline():
+    """The compiled program boundary is a sink: thin outputs materialize
+    INSIDE the traced program; results equal the oracle."""
+    fact, d1, d2 = _tables(seed=11, n_fact=1500)
+    conf = {"spark.rapids.tpu.sql.compile.wholePlan": "ON"}
+
+    def build(s):
+        f = s.from_arrow(fact)
+        j1 = f.join(s.from_arrow(d1), how="left_outer",
+                    left_on=["fk1"], right_on=["k1"])
+        j1 = j1.filter(col("fv") > lit(100))
+        j2 = j1.join(s.from_arrow(d2), how="inner",
+                     left_on=["fk2"], right_on=["k2"])
+        return (j2.group_by("s1")
+                .agg((Sum(col("fv")), "sv"), (Count(col("p1")), "c1"))
+                .sort(("s1", False)))
+
+    q, ctx = _check(build, extra_conf=conf)
+    assert ctx.metrics.get("whole_plan_compiled_queries", 0) == 1
+
+
+def test_off_switch_disables_thin():
+    fact, d1, _d2 = _tables()
+    s = TpuSession(OFF)
+    df = s.from_arrow(fact).join(s.from_arrow(d1), how="inner",
+                                 left_on=["fk1"], right_on=["k1"]) \
+        .group_by("s1").agg((Sum(col("fv")), "sv"))
+    q = df.physical()
+    assert all(j.thin_payload is None for j in _joins(q.root))
+
+
+def test_deferred_string_rides_as_codes():
+    """A deferred dictionary-coded string column keeps its dictionary on
+    the placeholder and materializes as codes at the sink — values must
+    round-trip exactly (incl. null-extended outer rows)."""
+    fact, d1, d2 = _tables(seed=23)
+
+    def build(s):
+        f = s.from_arrow(fact)
+        j1 = f.join(s.from_arrow(d1), how="left_outer",
+                    left_on=["fk1"], right_on=["k1"])
+        j2 = j1.join(s.from_arrow(d2), how="left_outer",
+                     left_on=["fk2"], right_on=["k2"])
+        return j2.select(col("fv"), col("s1"), col("p2"),
+                         names=["fv", "s1", "p2"]) \
+            .sort(("fv", False), ("s1", False), ("p2", False))
+
+    _check(build)

@@ -657,13 +657,17 @@ def test_check_regression_gate(tmp_path, capsys):
     capsys.readouterr()
 
     # build the 2x fixture from the real trajectory's newest data
+    # (load_file -> (queries, backend); net-of-RTT ms since the gate
+    # compares floor-subtracted values)
     files = mod.default_trajectory()
-    per_file = [(p, mod.load_file(p)) for p in files]
-    newest = [qs for _, qs in per_file if qs][-1]
-    assert newest, "no committed trajectory data to build the fixture"
-    slow = {q: {"device_ms": ms * 2.0} for q, ms in newest.items()}
+    per_file = [(p, *mod.load_file(p)) for p in files]
+    newest = [(qs, backend) for _, qs, backend in per_file if qs][-1]
+    assert newest[0], "no committed trajectory data to build the fixture"
+    slow = {q: {"device_ms_net": ms * 2.0}
+            for q, ms in newest[0].items()}
     fixture = tmp_path / "slow.json"
-    fixture.write_text(json.dumps({"tpch_suite_queries": slow}))
+    fixture.write_text(json.dumps({"tpch_suite_queries": slow,
+                                   "backend": newest[1]}))
     rc = mod.main(["--current", str(fixture)])
     out = capsys.readouterr().out
     assert rc == 1

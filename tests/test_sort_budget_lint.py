@@ -71,6 +71,37 @@ def test_dense_via_sort_makes_whole_suite_scatter_free(tables):
 
 
 # ---------------------------------------------------------------------------
+# gather budget: late materialization must keep paying for itself
+# ---------------------------------------------------------------------------
+
+# The BENCH_r05 tail (q3/q9-class join pipelines at 0.2-0.4x) is gather
+# volume: chained joins re-gathering payload columns per join.  Late
+# materialization (columnar/lanes.py) defers payloads behind row-id
+# lanes and resolves them once at the pipeline sink; these are the
+# queries whose programs must emit strictly LESS gather volume with the
+# feature on, so the win cannot silently regress.
+GATHER_BUDGET_QUERIES = ("q3", "q9", "q15", "q16")
+
+
+def test_late_materialization_gather_budget(tables, suite_stats):
+    """Per-query gather budget: the q3/q9/q15/q16 programs move
+    strictly fewer gathered elements (and never MORE gather equations)
+    with lateMaterialization on — suite_stats is the default (ON)
+    conf, compared here against a fresh OFF trace."""
+    off = TpuSession(
+        {"spark.rapids.tpu.sql.join.lateMaterialization.enabled":
+         "false"})
+    for name in GATHER_BUDGET_QUERIES:
+        st_on = suite_stats[name]
+        st_off = plan_program_stats(tpch.QUERIES[name](off, tables)
+                                    .physical())
+        assert st_on["gather_out_elems"] < st_off["gather_out_elems"], \
+            (name, st_on, st_off)
+        assert st_on["gather_op_count"] <= st_off["gather_op_count"], \
+            (name, st_on, st_off)
+
+
+# ---------------------------------------------------------------------------
 # TPC-DS tranche: the same two budgets over the new workload
 # ---------------------------------------------------------------------------
 
