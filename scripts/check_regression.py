@@ -74,6 +74,28 @@ def _rec_ms(rec: dict, rtt_ms: float):
     return None
 
 
+def extract_compile_ms(doc) -> list:
+    """Per-query COLD compile milliseconds (compile_ms_cold) of a
+    result document — [] for documents predating the field.  The gate
+    compares the MEDIAN, so one pathological query cannot fail it and
+    coverage growth cannot hide a fleet-wide compile regression."""
+    out = []
+    if not isinstance(doc, dict):
+        return out
+    for key, val in doc.items():
+        if key.endswith("_suite_queries") and isinstance(val, dict):
+            for rec in val.values():
+                if isinstance(rec, dict) and \
+                        rec.get("compile_ms_cold") is not None:
+                    out.append(float(rec["compile_ms_cold"]))
+    if out:
+        return out
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        return extract_compile_ms(parsed)
+    return out
+
+
 def extract_queries(doc):
     """-> (query name -> net device_ms, backend tag) from any accepted
     result shape; ({}, backend) when the document carries no per-query
@@ -123,7 +145,14 @@ def extract_queries(doc):
 
 def load_file(path: str):
     with open(path) as f:
-        return extract_queries(json.load(f))
+        doc = json.load(f)
+    qs, backend = extract_queries(doc)
+    return qs, backend, extract_compile_ms(doc)
+
+
+def _median(vals: list):
+    vals = sorted(vals)
+    return vals[len(vals) // 2] if vals else None
 
 
 def default_trajectory() -> list:
@@ -166,6 +195,13 @@ def main(argv=None) -> int:
     ap.add_argument("--min-ms", type=float, default=50.0,
                     help="absolute floor below which timings are noise, "
                          "never regressions (default 50)")
+    ap.add_argument("--compile-threshold", type=float, default=0.5,
+                    help="fractional MEDIAN compile_ms_cold regression "
+                         "that fails (default 0.5 = +50%%; compile wall "
+                         "is noisier than device wall)")
+    ap.add_argument("--compile-min-ms", type=float, default=1000.0,
+                    help="median compile floor below which compile "
+                         "timings never regress (default 1000)")
     ap.add_argument("--json", action="store_true",
                     help="emit the comparison as JSON")
     args = ap.parse_args(argv)
@@ -173,19 +209,21 @@ def main(argv=None) -> int:
     paths = args.trajectory or default_trajectory()
     per_file = {}
     backends = {}
+    compile_ms = {}
     for p in paths:
         try:
-            qs, backend = load_file(p)
+            qs, backend, cms = load_file(p)
         except (OSError, json.JSONDecodeError) as e:
             print(f"# skipping unreadable {p}: {e}", file=sys.stderr)
             continue
         per_file[p] = qs
         backends[p] = backend
+        compile_ms[p] = cms
     with_data = [p for p in per_file if per_file[p]]
 
     if args.current:
         try:
-            current, cur_backend = load_file(args.current)
+            current, cur_backend, cur_compile = load_file(args.current)
         except (OSError, json.JSONDecodeError) as e:
             print(f"cannot read --current {args.current}: {e}",
                   file=sys.stderr)
@@ -200,6 +238,7 @@ def main(argv=None) -> int:
         current_name = with_data[-1]
         current = per_file[current_name]
         cur_backend = backends[current_name]
+        cur_compile = compile_ms[current_name]
         baseline_files = with_data[:-1]
     if not current:
         print(f"{current_name} carries no per-query device_ms",
@@ -249,9 +288,31 @@ def main(argv=None) -> int:
             print(f"  new (no baseline): {', '.join(res['only_current'])}")
         if not baseline:
             print("  (empty baseline — nothing to regress against)")
-    if res["regressions"]:
-        print(f"{len(res['regressions'])} per-query regression(s) beyond "
-              f"+{args.threshold:.0%}")
+    # -- compile-latency gate: median cold compile_ms, same backend rule
+    compile_reg = False
+    cur_med = _median(cur_compile)
+    base_meds = [_median(compile_ms.get(p) or []) for p in baseline_files]
+    base_meds = [m for m in base_meds if m is not None]
+    if cur_med is not None and base_meds:
+        base_med = min(base_meds)
+        if cur_med > base_med * (1.0 + args.compile_threshold) and \
+                cur_med > args.compile_min_ms:
+            compile_reg = True
+            print(f"  COMPILE REGRESSION: median compile_ms_cold "
+                  f"{cur_med:.0f} vs {base_med:.0f} "
+                  f"(x{cur_med / base_med:.2f}, threshold "
+                  f"+{args.compile_threshold:.0%})")
+        else:
+            print(f"  compile ok: median compile_ms_cold {cur_med:.0f} "
+                  f"vs baseline {base_med:.0f}")
+    elif cur_med is not None:
+        print(f"  compile: median compile_ms_cold {cur_med:.0f} "
+              f"(no baseline carries compile data)")
+
+    if res["regressions"] or compile_reg:
+        if res["regressions"]:
+            print(f"{len(res['regressions'])} per-query regression(s) "
+                  f"beyond +{args.threshold:.0%}")
         return 1
     print("no per-query device_ms regressions")
     return 0

@@ -58,11 +58,26 @@ def merge_origin(origins) -> str:
 def bucket_capacity(n: int, conf: TpuConf = DEFAULT_CONF) -> int:
     """Smallest static-shape bucket >= n.
 
-    Buckets grow geometrically (x growth) up to batchSizeRows, then x2 above
-    it to halve worst-case padding waste: batches above the target size are
-    expected to be split upstream (coalesce/retry machinery), so the >target
-    regime only exists transiently.
+    An explicit `spark.rapids.tpu.sql.shape.buckets` set wins when
+    configured: capacities quantize onto exactly that list (doubling
+    past its largest entry), so one compiled program serves every input
+    size inside a bucket and cross-scale-factor runs land on the same
+    shapes — the compile-cache hit the persistent cache needs.
+
+    Otherwise buckets grow geometrically (x growth) up to batchSizeRows,
+    then x2 above it to halve worst-case padding waste: batches above
+    the target size are expected to be split upstream (coalesce/retry
+    machinery), so the >target regime only exists transiently.
     """
+    explicit = conf.bucket_set
+    if explicit:
+        for cap in explicit:
+            if cap >= n:
+                return cap
+        cap = explicit[-1]
+        while cap < n:
+            cap *= 2
+        return cap
     cap = conf.bucket_min_rows
     growth = conf.bucket_growth
     target = conf.batch_size_rows

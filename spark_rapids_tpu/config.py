@@ -559,6 +559,108 @@ JOIN_MATCHED_VIA_MERGE = conf(
     "segment_max scatters (ops/segments.py matched_flags). Off "
     "restores the scatter reductions.")
 
+COMPILE_CONST_LIFT = conf(
+    "spark.rapids.tpu.sql.compile.constantLifting", True,
+    "Lift plan literals (filter constants, projection scalars) out of "
+    "traced device programs into runtime arguments, and key compiled "
+    "programs on expression STRUCTURE instead of literal values — two "
+    "queries differing only in literals (the dashboard / parameterized "
+    "traffic shape) share one XLA executable instead of each paying a "
+    "cold compile. Applies to both the per-operator jit cache and the "
+    "whole-plan program cache (exec/compiled.py). Literals in positions "
+    "whose kernels specialize on the host value (string patterns, IN "
+    "lists, array lambdas) stay baked into the program and keyed by "
+    "value.", commonly_used=True)
+
+COMPILE_CACHE_DIR = conf(
+    "spark.rapids.tpu.compile.cacheDir", "",
+    "Directory for the engine-level PERSISTENT compile cache: XLA "
+    "executables are AOT-serialized here (jax compilation cache) so a "
+    "fresh process replays warmed queries with zero XLA compiles. The "
+    "engine scopes entries under a topology-hashed subdirectory "
+    "(backend, device count/kinds, process count, XLA_FLAGS) because "
+    "XLA's own cache key does NOT hash the device topology — sharing "
+    "one directory across topologies can crash the executable "
+    "deserializer. Empty disables the engine-managed cache (jax's own "
+    "jax_compilation_cache_dir, if set, still applies).",
+    commonly_used=True)
+
+COMPILE_BG_ENABLED = conf(
+    "spark.rapids.tpu.compile.background.enabled", True,
+    "Compile downstream whole-plan SEGMENTS ahead of time on the "
+    "background compile service (runtime/compile_service.py) while "
+    "earlier segments execute: when a split plan compiles segment i, "
+    "candidate programs for segment i+1 are speculatively AOT-compiled "
+    "(lower().compile() over placeholder shapes) for the predicted "
+    "seam output buckets, so the seam sync usually finds the next "
+    "program ready. Mispredicted candidates are dropped; injected "
+    "`compile` faults from background tasks surface on the consuming "
+    "thread with the same recovery ladder as inline compiles.")
+
+COMPILE_BG_THREADS = conf(
+    "spark.rapids.tpu.compile.background.threads", 2,
+    "Thread-pool size of the background compile service (XLA compiles "
+    "release the GIL, so threads overlap real compile work — also the "
+    "concurrency of bench.py --compile-only cache warmup).",
+    checker=_positive)
+
+COMPILE_BG_SPECULATE = conf(
+    "spark.rapids.tpu.compile.background.speculateBuckets", 2,
+    "Maximum candidate output buckets speculatively compiled per plan "
+    "seam (the aggregate/join row-collapse points). Each candidate "
+    "costs one background compile; a hit hides the next segment's "
+    "compile behind the current segment's execution.",
+    checker=_positive, internal=True)
+
+PLAN_CACHE_ENTRIES = conf(
+    "spark.rapids.tpu.compile.planCacheEntries", 256,
+    "Bound on the process-wide whole-plan executable cache (canonical "
+    "structure key -> compiled XLA program). LRU beyond it.",
+    checker=_positive, internal=True)
+
+SHAPE_BUCKETS = conf(
+    "spark.rapids.tpu.sql.shape.buckets", "",
+    "Explicit static-shape row-bucket set as ascending comma-separated "
+    "capacities (e.g. `4096,65536,1048576,4194304`): device batches pad "
+    "to the smallest listed bucket >= their row count (doubling past "
+    "the largest), REPLACING the geometric minBucketRows/bucketGrowth "
+    "ladder. A small coarse set quantizes many input sizes onto few "
+    "compiled programs — the cross-scale-factor compile-cache hit — at "
+    "the price of more padding. Empty keeps the geometric ladder.",
+    checker=lambda v: _check_bucket_set(v))
+
+SCAN_UPLOAD_CACHE_BYTES = conf(
+    "spark.rapids.tpu.sql.scan.uploadCacheBytes", 4 << 30,
+    "Byte cap on the shared scan-upload cache (one device copy per hot "
+    "source table, exec/compiled.py): past it, least-recently-used "
+    "table uploads evict (tpu_scan_upload_evictions_total counts them) "
+    "so long multi-table sessions cannot grow device-pinned uploads "
+    "without bound. 0 disables the cache entirely.",
+    checker=_non_negative)
+
+
+def _check_bucket_set(v):
+    s = str(v).strip()
+    if not s:
+        return None
+    try:
+        caps = [int(x) for x in s.split(",")]
+    except ValueError:
+        return f"must be comma-separated integers, got {v!r}"
+    if any(c <= 0 for c in caps):
+        return "bucket capacities must be positive"
+    if caps != sorted(caps) or len(set(caps)) != len(caps):
+        return "bucket capacities must be strictly ascending"
+    return None
+
+
+def parse_bucket_set(raw: str):
+    """Parsed ascending bucket list of a shape.buckets value ([] when
+    unset) — shared by the conf checker and columnar.device."""
+    s = str(raw or "").strip()
+    return [int(x) for x in s.split(",")] if s else []
+
+
 JOIN_LATE_MATERIALIZATION = conf(
     "spark.rapids.tpu.sql.join.lateMaterialization.enabled", True,
     "Let equi-joins emit THIN batches: payload columns ride as per-side "
@@ -645,6 +747,15 @@ class TpuConf:
         (ShimLoader role, shims.py)."""
         from .shims import get_shims
         return get_shims(str(self.get(SPARK_VERSION)))
+
+    @property
+    def bucket_set(self):
+        """Explicit shape.buckets capacities ([] = geometric ladder),
+        parsed once per conf."""
+        if "__bucket_set" not in self._cache:
+            self._cache["__bucket_set"] = parse_bucket_set(
+                self.get(SHAPE_BUCKETS))
+        return self._cache["__bucket_set"]
 
     @property
     def bucket_min_rows(self):

@@ -34,6 +34,7 @@ benchmark measures (BASELINE.md).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -153,7 +154,12 @@ def _shard_batch(db: DeviceBatch, mesh) -> DeviceBatch:
     return DeviceBatch(cols, db.num_rows, db.names, db.origin_file)
 
 
+#: key -> (weakref(table), device batches, nbytes); insertion order IS
+#: the LRU order (hits re-insert).  Byte-capped: long multi-table
+#: sessions evict cold uploads instead of pinning device memory per
+#: table forever (tpu_scan_upload_evictions_total counts evictions).
 _SCAN_UPLOAD_CACHE: Dict[object, tuple] = {}
+_SCAN_UPLOAD_LOCK = threading.Lock()
 
 
 def _shared_scan_upload(node: HostScanExec, conf: TpuConf
@@ -162,23 +168,269 @@ def _shared_scan_upload(node: HostScanExec, conf: TpuConf
     re-planned query over the same pyarrow table shares one device copy —
     the buffer-cache role for hot inputs (reference FileCache /
     spill-framework device tier).  Weakref-keyed so device memory is
-    released with the table."""
+    released with the table; LRU byte-capped by
+    spark.rapids.tpu.sql.scan.uploadCacheBytes."""
     import weakref
+    from ..config import SCAN_UPLOAD_CACHE_BYTES
+    cap_bytes = conf.get(SCAN_UPLOAD_CACHE_BYTES)
     tbl = node._source_table
-    if tbl is None:
+    if tbl is None or cap_bytes == 0:
         return [to_device(hb, conf) for hb in node.batches]
     key = (id(tbl), conf.batch_size_rows)
-    hit = _SCAN_UPLOAD_CACHE.get(key)
-    if hit is not None and hit[0]() is tbl:
-        return hit[1]
+    with _SCAN_UPLOAD_LOCK:
+        hit = _SCAN_UPLOAD_CACHE.pop(key, None)
+        if hit is not None and hit[0]() is tbl:
+            _SCAN_UPLOAD_CACHE[key] = hit          # re-insert: now MRU
+            return hit[1]
     dbs = [to_device(hb, conf) for hb in node.batches]
     try:
         ref = weakref.ref(tbl, lambda _r, k=key:
                           _SCAN_UPLOAD_CACHE.pop(k, None))
     except TypeError:
         return dbs
-    _SCAN_UPLOAD_CACHE[key] = (ref, dbs)
+    nbytes = sum(db.nbytes() for db in dbs)
+    with _SCAN_UPLOAD_LOCK:
+        _SCAN_UPLOAD_CACHE[key] = (ref, dbs, nbytes)
+        total = sum(e[2] for e in _SCAN_UPLOAD_CACHE.values())
+        while total > cap_bytes and len(_SCAN_UPLOAD_CACHE) > 1:
+            _k = next(iter(_SCAN_UPLOAD_CACHE))
+            if _k == key:                          # never evict the new entry
+                break
+            total -= _SCAN_UPLOAD_CACHE.pop(_k)[2]
+            from ..obs.registry import SCAN_UPLOAD_EVICTIONS
+            SCAN_UPLOAD_EVICTIONS.inc()
     return dbs
+
+
+# ---------------------------------------------------------------------------
+# Constant-lifted canonical plan keys + the process-wide executable cache
+# ---------------------------------------------------------------------------
+# Two queries that differ only in literals (dashboard traffic, bench
+# reruns, parameterized filters) trace byte-identical programs once the
+# literal values are runtime arguments.  `plan_cache_key` canonicalizes
+# the whole physical plan — node structure + canonical expression
+# fingerprints (lifted literal values erased) + the flattened input
+# signature + the session conf — and `_PLAN_EXEC_CACHE` maps that key to
+# the compiled XLA executable, its output specs and the trace-time host
+# metrics.  Identity anchors (source tables, input dictionaries) guard
+# the host data the traced program baked in: a hit requires the SAME
+# objects, so a structurally identical plan over different tables never
+# reuses another table's dictionaries.
+
+def _canon_fp(e) -> str:
+    fp = e.__dict__.get("_canon_fp_cache")
+    if fp is None:
+        fp = e.canonical_fingerprint()
+        e.__dict__["_canon_fp_cache"] = fp
+    return fp
+
+
+def _collect_lits(e, lift_ok: bool, out: list) -> None:
+    """Preorder liftable-literal collection mirroring BOTH the canonical
+    fingerprint and Literal._prepare's lift decision — slot order is the
+    contract between the cache key and the runtime argument vector."""
+    from ..plan.expressions import Literal
+    if isinstance(e, Literal):
+        if lift_ok and e.lift_type_ok():
+            out.append(e)
+        return
+    child_ok = type(e).lifts_literal_children
+    for c in e.children:
+        _collect_lits(c, child_ok, out)
+
+
+def _node_exprs(node) -> Optional[list]:
+    """The bound expression trees a physical node evaluates VERBATIM
+    (projection lists, filter predicates, aggregate/join key lanes) in a
+    deterministic order — the trees whose canonical fingerprints may
+    erase lifted literal values.  Aggregate INPUT expressions are not
+    here: the aggregate machinery evaluates derived wrappings of them,
+    so their literals stay value-keyed (_node_extras).  None marks a
+    node class the canonical key does not understand (its plans keep
+    per-holder caching only)."""
+    from .adaptive import AdaptiveShuffledJoinExec
+    from .collect import CollectAggregateExec
+    from .distinct import DistinctAggregateExec
+    from .exchange import BroadcastExchangeExec
+    from .join import CrossJoinExec, HashJoinExec
+    from .percentile import PercentileAggregateExec
+    from .plan import (CoalesceBatchesExec, ExpandExec, FilterExec,
+                       GlobalLimitExec, HashAggregateExec, LocalLimitExec,
+                       ProjectExec, RangeExec, SampleExec, SortExec,
+                       TopNExec, UnionExec)
+    if isinstance(node, ProjectExec):
+        return list(node.exprs)
+    if isinstance(node, FilterExec):
+        return [node.condition]
+    if isinstance(node, (HashAggregateExec, CollectAggregateExec,
+                         DistinctAggregateExec, PercentileAggregateExec)):
+        return list(getattr(node, "key_exprs", ()) or ())
+    if isinstance(node, (HashJoinExec, AdaptiveShuffledJoinExec)):
+        return (list(node.left_keys) + list(node.right_keys)
+                + list(getattr(node, "probe_conds", None) or ())
+                + list(getattr(node, "build_conds", None) or ()))
+    if isinstance(node, ExpandExec):
+        return [e for p in node.projections for e in p]
+    if isinstance(node, (HostScanExec, DeviceResidentScanExec, SortExec,
+                         TopNExec, GlobalLimitExec, LocalLimitExec,
+                         UnionExec, CoalesceBatchesExec, RangeExec,
+                         SampleExec, CrossJoinExec, BroadcastExchangeExec)):
+        return []
+    return None
+
+
+def _node_extras(node) -> tuple:
+    """Non-expression structure that changes the traced program."""
+    from .plan import (CoalesceBatchesExec, GlobalLimitExec,
+                       LocalLimitExec, RangeExec, SampleExec, SortExec,
+                       TopNExec)
+    extras: list = []
+    if isinstance(node, (SortExec, TopNExec)):
+        extras.append(tuple(node.keys))
+        extras.append(getattr(node, "global_sort", None))
+        extras.append(getattr(node, "limit", None))
+    if isinstance(node, (GlobalLimitExec, LocalLimitExec)):
+        extras.append(node.limit)
+    if isinstance(node, CoalesceBatchesExec):
+        extras.append((node.target_rows,
+                       getattr(node, "require_single", None)))
+    if isinstance(node, RangeExec):
+        extras.append((node.start, node.end, node.step, node.col_name,
+                       node.batch_rows))
+    if isinstance(node, SampleExec):
+        extras.append((node.fraction, node.seed))
+    jt = getattr(node, "join_type", None)
+    if jt is not None:
+        extras.append(("join", jt, getattr(node, "lazy_sel", None),
+                       getattr(node, "thin_payload", None)))
+    names = getattr(node, "names", None) or getattr(node, "key_names", None)
+    if names is not None:
+        extras.append(tuple(names))
+    # aggregate functions: class + output name + every non-expression
+    # parameter (ignore_nulls, percentage, ...) + FULL fingerprints of
+    # the input trees — agg inputs are evaluated through derived
+    # wrappings, so their literals stay value-keyed (never erased)
+    from ..plan.expressions import Expression as _Expr
+    agg_sig = []
+    for fn, name in getattr(node, "aggs", ()) or ():
+        params = tuple(sorted(
+            (k, repr(v)) for k, v in fn.__dict__.items()
+            if k != "_shims" and not isinstance(v, _Expr)))
+        kids = tuple(c.fingerprint()
+                     for c in (getattr(fn, "child", None),
+                               getattr(fn, "child2", None))
+                     if c is not None)
+        agg_sig.append((type(fn).__name__, name, params, kids))
+    if agg_sig:
+        extras.append(tuple(agg_sig))
+    return tuple(extras)
+
+
+def collect_plan_literals(root: PlanNode) -> Optional[List[object]]:
+    """Every liftable Literal of a physical plan in canonical preorder,
+    or None when the plan contains a node class the canonical key does
+    not cover (those plans skip the process-wide cache)."""
+    out: list = []
+    seen = set()
+
+    def walk(node):
+        if id(node) in seen:
+            return True
+        seen.add(id(node))
+        exprs = _node_exprs(node)
+        if exprs is None:
+            return False
+        for e in exprs:
+            _collect_lits(e, True, out)
+        return all(walk(c) for c in node.children)
+
+    return out if walk(root) else None
+
+
+def plan_structure_key(root: PlanNode, conf: TpuConf) -> Optional[tuple]:
+    """Canonical structural key of a device plan (literal values erased
+    for lifted positions), or None for uncovered plans."""
+    parts: list = []
+    seen: dict = {}
+
+    def walk(node):
+        if id(node) in seen:
+            # shared subtree (a broadcast build reused twice): mark the
+            # revisit positionally instead of re-walking it
+            parts.append(("shared", seen[id(node)]))
+            return True
+        seen[id(node)] = len(seen)
+        exprs = _node_exprs(node)
+        if exprs is None:
+            return False
+        parts.append((type(node).__name__,
+                      tuple(_canon_fp(e) for e in exprs),
+                      _node_extras(node),
+                      len(node.children)))
+        return all(walk(c) for c in node.children)
+
+    if not walk(root):
+        return None
+    conf_sig = tuple(sorted((k, str(v)) for k, v in conf._raw.items()))
+    return (tuple(parts), conf_sig, jax.default_backend())
+
+
+def _plan_anchors(root: PlanNode, pairs) -> Optional[list]:
+    """Host objects the traced program specializes on: scan source
+    tables and every input dictionary.  Returned as weakrefs paired with
+    the live object id; a cache hit must present the SAME objects."""
+    import weakref
+    anchors = []
+    objs = []
+    for node, dbs in pairs:
+        if isinstance(node, HostScanExec) and node._source_table is not None:
+            objs.append(node._source_table)
+        for db in dbs:
+            for c in db.columns:
+                if c.dictionary is not None:
+                    objs.append(c.dictionary)
+    try:
+        for o in objs:
+            anchors.append(weakref.ref(o))
+    except TypeError:
+        return None               # un-weakref-able anchor: don't cache
+    return anchors
+
+
+def _anchors_match(anchors, root: PlanNode, pairs) -> bool:
+    cur = _plan_anchors(root, pairs)
+    if cur is None or len(cur) != len(anchors):
+        return False
+    return all(a() is c() and a() is not None
+               for a, c in zip(anchors, cur))
+
+
+#: canonical plan key -> (compiled executable, out_specs, host metrics,
+#: anchors).  Name ends in _CACHE so testing.clear_compiled_caches()
+#: releases the pinned executables with everything else.
+_PLAN_EXEC_CACHE: Dict[tuple, tuple] = {}
+_PLAN_EXEC_LOCK = threading.Lock()
+
+
+def _plan_cache_get(key, root, pairs):
+    with _PLAN_EXEC_LOCK:
+        entry = _PLAN_EXEC_CACHE.pop(key, None)
+        if entry is not None:
+            _PLAN_EXEC_CACHE[key] = entry          # MRU
+    if entry is None:
+        return None
+    if not _anchors_match(entry[-1], root, pairs):
+        return None
+    return entry
+
+
+def _plan_cache_put(key, entry: tuple, conf: TpuConf) -> None:
+    from ..config import PLAN_CACHE_ENTRIES
+    bound = conf.get(PLAN_CACHE_ENTRIES)
+    with _PLAN_EXEC_LOCK:
+        _PLAN_EXEC_CACHE[key] = entry
+        while len(_PLAN_EXEC_CACHE) > bound:
+            _PLAN_EXEC_CACHE.pop(next(iter(_PLAN_EXEC_CACHE)))
 
 
 class CompiledPlan:
@@ -192,19 +444,37 @@ class CompiledPlan:
     annotate-shardings-and-let-XLA-insert-collectives recipe, playing the
     reference's shuffle-exchange fabric role (RapidsShuffleManager/UCX)."""
 
-    def __init__(self, root: PlanNode, conf: TpuConf, mesh=None):
+    def __init__(self, root: PlanNode, conf: TpuConf, mesh=None,
+                 leaf_overrides: Optional[Dict[int, list]] = None):
         self.root = root
         self.conf = conf
         self.mesh = mesh
         self._out_specs: Optional[list] = None
         self._compiled = None
         self._input_specs = None
+        self._out_layout = None        # [(shape, dtype str)] of flat outputs
+        self._host_metrics: Dict[str, object] = {}
+        # background speculative compiles trace over PLACEHOLDER batches
+        # (id(leaf) -> batches of ShapeDtypeStruct lanes) without touching
+        # the shared plan tree; cleared after compile so execution reads
+        # the real leaf state
+        self._leaf_overrides = dict(leaf_overrides or {})
+        from ..config import COMPILE_CONST_LIFT
+        self._lift = bool(conf.get(COMPILE_CONST_LIFT))
+        self._literals = (collect_plan_literals(root) or []) \
+            if self._lift else []
+        self._cache_key = None         # lazily built at first compile
+        self._fresh = False            # compiled/adopted THIS collect
 
     # -- leaves ------------------------------------------------------------
     def _leaf_batches(self, ctx: ExecContext
                       ) -> List[Tuple[HostScanExec, List[DeviceBatch]]]:
         pairs = []
         for node in _find_scans(self.root):
+            override = self._leaf_overrides.get(id(node))
+            if override is not None:
+                pairs.append((node, override))
+                continue
             if isinstance(node, DeviceResidentScanExec):
                 pairs.append((node, node.batches))   # already on device
                 continue
@@ -224,6 +494,15 @@ class CompiledPlan:
             pairs.append((node, cached))
         return pairs
 
+    def _lift_values(self) -> list:
+        """The lifted literal values as 0-d device scalars, in canonical
+        slot order — the runtime-argument tail of the flat input vector."""
+        import numpy as np
+        from ..ops.kernels import compute_dtype
+        return [jnp.asarray(np.asarray(l._physical_value(),
+                                       dtype=compute_dtype(l.dtype)))
+                for l in self._literals]
+
     def _flatten_inputs(self, pairs):
         flat_in: List[jax.Array] = []
         in_specs = []
@@ -234,12 +513,25 @@ class CompiledPlan:
                 flat_in.extend(arrays)
                 node_specs.append(spec)
             in_specs.append((node, node_specs))
+        # constant lifting: literal values ride as the flat tail, so the
+        # compiled program (and its cache key) is literal-value-agnostic
+        flat_in.extend(self._lift_values())
         return flat_in, in_specs
 
     def _make_runner(self, in_specs, ctx: ExecContext,
                      out_holder: Dict[str, list]):
         """The traced whole-plan function over flattened leaf lanes."""
+        lit_ids = [id(l) for l in self._literals]
+
         def run(flat):
+            from ..plan.expressions import set_literal_bindings
+            base = len(flat) - len(lit_ids)
+            if lit_ids:
+                # Literal._prepare hands these traced scalars into the
+                # aux channel — inner-program ARGUMENTS, so the lifted
+                # values never bake into the XLA program as constants
+                set_literal_bindings(
+                    {lid: flat[base + k] for k, lid in enumerate(lit_ids)})
             # rebuild leaf batches from traced arrays and install them
             i = 0
             for node, node_specs in in_specs:
@@ -248,17 +540,20 @@ class CompiledPlan:
                     db, i = _rebuild_batch(flat, spec, i)
                     batches.append(db)
                 node._trace_batches = batches
+            trace_ctx = _trace_context(ctx)
             try:
-                trace_ctx = _trace_context(ctx)
                 outs = list(self.root.execute(trace_ctx))
             finally:
+                if lit_ids:
+                    set_literal_bindings(None)
                 for node, _ in in_specs:
                     node._trace_batches = None
                 # copy ONLY host numbers back: a traced metric value
                 # escaping the jit would be a leaked tracer
-                for k, v in trace_ctx.metrics.items():
-                    if isinstance(v, (int, float)):
-                        ctx.metrics[k] = v
+                host_metrics = {k: v for k, v in trace_ctx.metrics.items()
+                                if isinstance(v, (int, float))}
+                out_holder["host_metrics"] = host_metrics
+                ctx.metrics.update(host_metrics)
             flat_out = []
             specs = []
             for db in outs:
@@ -273,6 +568,8 @@ class CompiledPlan:
                 flat_out.extend(arrays)
                 specs.append(spec)
             out_holder["specs"] = specs
+            out_holder["layout"] = [(tuple(x.shape), str(x.dtype))
+                                    for x in flat_out]
             return flat_out
         return run
 
@@ -289,6 +586,108 @@ class CompiledPlan:
             flat_in)
 
     # -- compile + run -----------------------------------------------------
+    def _build_cache_key(self, flat_in, in_specs) -> Optional[tuple]:
+        """Canonical process-wide cache key, or None when this plan is
+        outside the cacheable envelope (mesh SPMD, uncovered node class,
+        lifting off)."""
+        if not self._lift or self.mesh is not None:
+            return None
+        skey = plan_structure_key(self.root, self.conf)
+        if skey is None:
+            return None
+        spec_sig = []
+        for node, node_specs in in_specs:
+            per = []
+            for cols, names, static_rows, origin, has_sel in node_specs:
+                per.append((tuple((dt.simple_string, d is not None, hi, off)
+                                  for dt, d, hi, off in cols),
+                            tuple(names), static_rows, origin, has_sel))
+            spec_sig.append((type(node).__name__, tuple(per)))
+        input_sig = tuple((tuple(a.shape), str(a.dtype)) for a in flat_in)
+        return (skey, tuple(spec_sig), input_sig)
+
+    def _try_plan_cache(self, ctx: ExecContext, pairs, flat_in,
+                        in_specs) -> bool:
+        """Adopt a process-cached executable compiled from a canonically
+        identical plan over the SAME host objects (tables/dictionaries).
+        The python trace never re-runs: lifted literal values arrive
+        through the flat argument tail."""
+        self._cache_key = self._build_cache_key(flat_in, in_specs)
+        if self._cache_key is None:
+            return False
+        entry = _plan_cache_get(self._cache_key, self.root, pairs)
+        if entry is None:
+            return False
+        (self._compiled, self._out_specs, self._out_layout,
+         self._host_metrics, _anchors) = entry
+        self._input_specs = [(n, list(s)) for n, s in in_specs]
+        ctx.metrics.update(self._host_metrics)
+        ctx.bump("compile_cache_hits")
+        ctx.bump("whole_plan_structure_hits")
+        from ..obs.registry import PLAN_CACHE
+        PLAN_CACHE.inc(outcome="hit")
+        self._fresh = True
+        return True
+
+    def aot_compile(self, ctx: ExecContext, flat_in=None, in_specs=None,
+                    pairs=None) -> None:
+        """Trace + AOT-compile the whole-plan program (no execution:
+        jax.jit(...).lower().compile(), so placeholder-shape inputs work
+        and the persistent cache serves cold starts).  Fires the
+        `compile` chaos site; raises tracer errors for host-decision
+        plans exactly as execute() used to."""
+        import time as _time
+        from ..runtime.faults import get_injector
+        if flat_in is None:
+            pairs = self._leaf_batches(ctx)
+            flat_in, in_specs = self._flatten_inputs(pairs)
+        # chaos site: a whole-plan compile failure — injected `oom`
+        # exercises the eager-engine fallback, `fatal` the crash
+        # capture (collect_with_fallback owns both ladders); background
+        # segment compiles fire here too, on the service thread
+        get_injector(ctx.conf).fire("compile")
+        self._input_specs = [(n, list(s)) for n, s in in_specs]
+        out_holder: Dict[str, list] = {}
+        t0 = _time.perf_counter()
+        with ctx.tracer.span("trace+compile", "compile",
+                             root=self.root.name()):
+            lowered = jax.jit(self._make_runner(in_specs, ctx,
+                                                out_holder)).lower(flat_in)
+            compiled = lowered.compile()
+        ctx.metrics["compile_ms"] = ctx.metrics.get(
+            "compile_ms", 0.0) + (_time.perf_counter() - t0) * 1000.0
+        ctx.bump("compile_cache_misses")
+        self._out_specs = out_holder["specs"]
+        self._out_layout = out_holder["layout"]
+        self._host_metrics = out_holder.get("host_metrics", {})
+        self._compiled = compiled
+        self._fresh = True
+        # placeholder leaves only exist to shape the lowering; execution
+        # must read the real leaf state installed by the caller
+        self._leaf_overrides = {}
+        if self._cache_key is None:
+            self._cache_key = self._build_cache_key(flat_in, in_specs)
+        if self._cache_key is not None and pairs is not None:
+            anchors = _plan_anchors(self.root, pairs)
+            if anchors is not None:
+                from ..obs.registry import PLAN_CACHE
+                PLAN_CACHE.inc(outcome="miss")
+                _plan_cache_put(self._cache_key,
+                                (compiled, self._out_specs,
+                                 self._out_layout, self._host_metrics,
+                                 anchors), self.conf)
+
+    def ensure_compiled(self, ctx: ExecContext) -> None:
+        """Compile (or adopt a cached executable) without executing —
+        the hook the split-plan pipeline uses to order 'compile, then
+        speculate downstream, then execute'."""
+        if self._compiled is not None:
+            return
+        pairs = self._leaf_batches(ctx)
+        flat_in, in_specs = self._flatten_inputs(pairs)
+        if not self._try_plan_cache(ctx, pairs, flat_in, in_specs):
+            self.aot_compile(ctx, flat_in, in_specs, pairs)
+
     def execute(self, ctx: ExecContext) -> List[DeviceBatch]:
         """Run the whole plan as one XLA program; returns device batches.
 
@@ -298,29 +697,22 @@ class CompiledPlan:
         flat_in, in_specs = self._flatten_inputs(pairs)
 
         if self._compiled is None:
-            import time as _time
-            from ..runtime.faults import get_injector
-            # chaos site: a whole-plan compile failure — injected `oom`
-            # exercises the eager-engine fallback, `fatal` the crash
-            # capture (collect_with_fallback owns both ladders)
-            get_injector(ctx.conf).fire("compile")
-            self._input_specs = [(n, list(s)) for n, s in in_specs]
-            out_holder: Dict[str, list] = {}
-            t0 = _time.perf_counter()
-            with ctx.tracer.span("trace+compile", "compile",
-                                 root=self.root.name()):
-                compiled = jax.jit(self._make_runner(in_specs, ctx,
-                                                     out_holder))
-                flat_res = compiled(flat_in)     # traces on first call
-            ctx.metrics["compile_ms"] = ctx.metrics.get(
-                "compile_ms", 0.0) + (_time.perf_counter() - t0) * 1000.0
-            ctx.bump("compile_cache_misses")
-            self._out_specs = out_holder["specs"]
-            self._compiled = compiled
-        else:
+            if not self._try_plan_cache(ctx, pairs, flat_in, in_specs):
+                self.aot_compile(ctx, flat_in, in_specs, pairs)
+        elif not self._fresh:
             ctx.bump("compile_cache_hits")
-            with ctx.tracer.span("execute", "execute",
-                                 root=self.root.name()):
+        self._fresh = False
+
+        with ctx.tracer.span("execute", "execute",
+                             root=self.root.name()):
+            try:
+                flat_res = self._compiled(flat_in)
+            except TypeError:
+                # AOT signature drift (a speculative lowering's avals
+                # not matching the real inputs): recompile inline once
+                self._compiled = None
+                self._cache_key = None
+                self.aot_compile(ctx, flat_in, in_specs, pairs)
                 flat_res = self._compiled(flat_in)
 
         outs = []
@@ -532,14 +924,144 @@ class SplitCompiledPlan:
         self._programs: List[Dict[tuple, CompiledPlan]] = \
             [{} for _ in range(len(self.seams) + 1)]
 
+    # -- tree swaps ---------------------------------------------------------
+    def _install_leaves(self) -> None:
+        """Swap every seam for its DeviceResidentScanExec leaf UP FRONT
+        (restored in collect's finally): background compiles of
+        downstream segments must see the seam leaf in the tree before
+        the main thread reaches it.  Segment i's own program roots AT
+        seams[i], so the swap above it never changes what segment i
+        traces."""
+        for (parent, ci), leaf in zip(self._parent_idx, self.leaves):
+            parent.children[ci] = leaf
+
+    def _restore_leaves(self) -> None:
+        for (parent, ci), seam in zip(self._parent_idx, self.seams):
+            parent.children[ci] = seam
+
     def _segment(self, i: int, key: tuple, ctx) -> CompiledPlan:
         progs = self._programs[i]
         plan = progs.get(key)
+        if plan is None and i > 0:
+            # a background speculative compile may have this program
+            # ready (or in flight — wait overlaps its tail); its
+            # exception (injected compile faults included) re-raises
+            # HERE, on the consuming thread
+            from ..runtime.compile_service import (background_enabled,
+                                                   get_service)
+            if background_enabled(ctx.conf):
+                task = get_service(ctx.conf).take((id(self), i, key))
+                if task is not None:
+                    try:
+                        plan = task.wait()
+                        progs[key] = plan
+                        ctx.bump("compile_background_used")
+                    except TimeoutError:
+                        plan = None      # hung pool: compile inline
         if plan is None:
             seg_root = self.seams[i] if i < len(self.seams) else self.root
             plan = CompiledPlan(seg_root, ctx.conf)
             progs[key] = plan
         return plan
+
+    # -- background speculation --------------------------------------------
+    @staticmethod
+    def _lane_dtypes(spec, layout) -> List[str]:
+        """Per-column data-lane dtype strings of one output batch,
+        recovered from the flat layout in _flatten_batch order."""
+        cols_spec = spec[0]
+        dts = []
+        j = 0
+        for _dt, _d, has_hi, has_off in cols_spec:
+            dts.append(layout[j][1])
+            j += 2                       # data + validity
+            if has_hi:
+                j += 1
+            if has_off:
+                j += 2
+        return dts
+
+    @staticmethod
+    def _placeholder_batch(spec, lane_dtypes, cap: int) -> DeviceBatch:
+        """A post-shrink-shaped stand-in batch of ShapeDtypeStruct lanes
+        (capacity `cap`, dynamic row count, real dictionaries): enough
+        for jit(...).lower() to trace the next segment without data."""
+        import numpy as np
+        cols_spec, names, _static, origin, _sel = spec
+        cols = []
+        for (dt, dictionary, has_hi, _off), lane_dt in zip(cols_spec,
+                                                           lane_dtypes):
+            cols.append(DeviceColumn(
+                jax.ShapeDtypeStruct((cap,), np.dtype(lane_dt)),
+                jax.ShapeDtypeStruct((cap,), np.dtype(bool)),
+                dt, dictionary,
+                jax.ShapeDtypeStruct((cap,), np.dtype(np.int64))
+                if has_hi else None))
+        return DeviceBatch(cols,
+                           jax.ShapeDtypeStruct((), np.dtype(np.int32)),
+                           list(names), origin)
+
+    def _candidate_caps(self, i: int, cap_in: int, conf) -> List[int]:
+        """Predicted post-shrink buckets for seam i's output: exact when
+        plan statistics bound the row count, else the two structural
+        guesses — full collapse (aggregates: thousands of groups from
+        millions of rows) and no collapse."""
+        from ..config import COMPILE_BG_SPECULATE
+        seam = self.seams[i]
+        cands: List[int] = []
+        r = seam.static_row_count()
+        if r is None:
+            r = seam.row_upper_bound()
+        if r is not None:
+            cands.append(min(bucket_capacity(max(int(r), 1), conf),
+                             cap_in))
+        cands.append(min(bucket_capacity(1, conf), cap_in))
+        cands.append(cap_in)
+        out: List[int] = []
+        for c in cands:
+            if c not in out:
+                out.append(c)
+        return out[:int(conf.get(COMPILE_BG_SPECULATE))]
+
+    def _speculate(self, i: int, seg: CompiledPlan, ctx) -> None:
+        """AOT-compile candidate programs for segment i+1 on the compile
+        service while segment i executes — the seam sync then usually
+        finds the next program ready instead of paying its compile on
+        the critical path."""
+        nxt = i + 1
+        if nxt > len(self.seams):
+            return
+        from ..runtime.compile_service import (background_enabled,
+                                               get_service)
+        if not background_enabled(ctx.conf):
+            return
+        specs, layout = seg._out_specs, seg._out_layout
+        if not specs or layout is None or len(specs) != 1:
+            return                       # multi-batch seams: no prediction
+        spec = specs[0]
+        if any(off for _dt, _d, _hi, off in spec[0]):
+            return                       # ragged seam output never splits
+        lane_dtypes = self._lane_dtypes(spec, layout)
+        cap_in = layout[0][0][0] if layout[0][0] else 0
+        if not cap_in:
+            return
+        service = get_service(ctx.conf)
+        seg_root = self.seams[nxt] if nxt < len(self.seams) else self.root
+        conf = ctx.conf
+        for cap in self._candidate_caps(i, cap_in, conf):
+            key = (cap,)
+            if key in self._programs[nxt]:
+                continue
+            placeholder = [self._placeholder_batch(spec, lane_dtypes, cap)]
+            plan = CompiledPlan(
+                seg_root, conf,
+                leaf_overrides={id(self.leaves[i]): placeholder})
+
+            def thunk(plan=plan, conf=conf):
+                plan.aot_compile(ExecContext(conf))
+                return plan
+
+            service.submit((id(self), nxt, key), thunk)
 
     @staticmethod
     def _shrink(outs: List[DeviceBatch], ctx) -> List[DeviceBatch]:
@@ -563,22 +1085,24 @@ class SplitCompiledPlan:
         return sliced
 
     def collect(self, ctx: ExecContext) -> pa.Table:
-        mutated = []
+        self._install_leaves()
         try:
             key: tuple = ()
-            for i, (leaf, (parent, ci)) in enumerate(
-                    zip(self.leaves, self._parent_idx)):
+            for i, leaf in enumerate(self.leaves):
                 seg = self._segment(i, key, ctx)
+                # compile first, THEN speculate: the next segment's
+                # placeholder shapes need this segment's traced output
+                # specs (dtypes, dictionaries).  Its compiles overlap
+                # this segment's device execution + seam sync below.
+                seg.ensure_compiled(ctx)
+                self._speculate(i, seg, ctx)
                 outs = seg.execute(ctx)
                 sliced = self._shrink(outs, ctx)
                 leaf.batches = sliced
-                parent.children[ci] = leaf
-                mutated.append((parent, ci, self.seams[i]))
                 key = tuple(db.capacity for db in sliced)
             out = self._segment(len(self.seams), key, ctx).collect(ctx)
         finally:
-            for parent, ci, orig in mutated:
-                parent.children[ci] = orig
+            self._restore_leaves()
         ctx.bump("whole_plan_split_queries")
         return out
 
@@ -600,6 +1124,17 @@ def session_mesh(conf: TpuConf):
     return make_mesh(n)
 
 
+def build_plan(root: PlanNode, ctx: ExecContext):
+    """The whole-plan execution object for this root under this conf:
+    a SplitCompiledPlan when row-collapse seams pay for themselves,
+    else one CompiledPlan (mesh-sharded when SPMD is on)."""
+    mesh = session_mesh(ctx.conf)
+    seams = [] if mesh is not None \
+        else _find_split_seams(root, ctx.conf)
+    return SplitCompiledPlan(root, seams, ctx.conf) if seams \
+        else CompiledPlan(root, ctx.conf, mesh=mesh)
+
+
 def collect_with_fallback(root: PlanNode, ctx: ExecContext,
                           cache_on: Optional[object] = None
                           ) -> Optional[pa.Table]:
@@ -611,11 +1146,7 @@ def collect_with_fallback(root: PlanNode, ctx: ExecContext,
     if plan is False:                    # previously failed to trace
         return None
     if plan is None:
-        mesh = session_mesh(ctx.conf)
-        seams = [] if mesh is not None \
-            else _find_split_seams(root, ctx.conf)
-        plan = SplitCompiledPlan(root, seams, ctx.conf) if seams \
-            else CompiledPlan(root, ctx.conf, mesh=mesh)
+        plan = build_plan(root, ctx)
     try:
         out = plan.collect(ctx)
     except _SplitUnsupported:
@@ -660,3 +1191,100 @@ def collect_with_fallback(root: PlanNode, ctx: ExecContext,
     holder._compiled_plan = plan
     ctx.bump("whole_plan_compiled_queries")
     return out
+
+
+# ---------------------------------------------------------------------------
+# Persistent compile cache: topology-safe on-disk AOT executables
+# ---------------------------------------------------------------------------
+# jax's compilation cache serializes every XLA executable to disk, so a
+# fresh process REPLAYS warmed queries with zero XLA compiles (trace +
+# deserialize only).  Two engine problems with using it raw:
+#
+#   1. XLA's cache key does NOT hash the device topology or XLA_FLAGS —
+#      one directory shared between a 1-chip bench and the tests' forced
+#      8-device CPU mesh can hand one topology's serialized executable
+#      to the other's deserializer and crash it (the bench.py incident
+#      that split `.jax_cache_bench` off by hand).  The engine scopes
+#      entries under a `topo-<hash>` subdirectory instead, hashing
+#      backend, device count/kinds, process count and XLA_FLAGS.
+#   2. There was no counter proving "this run compiled nothing" — the
+#      monitoring listener below publishes persistent hit/miss into the
+#      always-on registry (tpu_compile_cache_persistent_*), which
+#      bench.py reports per run.
+
+_PERSIST_STATE = {"listener": False, "dir": None}
+
+
+def topology_fingerprint() -> str:
+    """Stable hash of everything that changes serialized-executable
+    compatibility but is absent from XLA's own cache key."""
+    import hashlib
+    import json
+    import os
+    devs = jax.devices()
+    try:
+        nproc = jax.process_count()
+    except Exception:                    # noqa: BLE001
+        nproc = 1
+    sig = json.dumps(
+        [jax.default_backend(), len(devs),
+         sorted({d.device_kind for d in devs}), nproc,
+         os.environ.get("XLA_FLAGS", "")], sort_keys=True)
+    return hashlib.sha256(sig.encode()).hexdigest()[:12]
+
+
+def _install_persistent_listener() -> None:
+    if _PERSIST_STATE["listener"]:
+        return
+    _PERSIST_STATE["listener"] = True
+    from jax._src import monitoring
+    from ..obs.registry import (COMPILE_PERSISTENT_HITS,
+                                COMPILE_PERSISTENT_MISSES)
+
+    def _cb(event, **_kw):
+        # the request event fires before the lookup, the hit event after
+        # it: count every request as a miss, then retract on the hit
+        if event == "/jax/compilation_cache/compile_requests_use_cache":
+            COMPILE_PERSISTENT_MISSES.add(1)
+        elif event == "/jax/compilation_cache/cache_hits":
+            COMPILE_PERSISTENT_HITS.inc()
+            COMPILE_PERSISTENT_MISSES.add(-1)
+
+    monitoring.register_event_listener(_cb)
+
+
+def configure_persistent_cache(conf: TpuConf) -> Optional[str]:
+    """Point jax's compilation cache at the conf'd engine cache dir,
+    scoped by topology; idempotent per resulting path.  Returns the
+    active topology-scoped path, or None when unset."""
+    import os
+    from ..config import COMPILE_CACHE_DIR
+    base = str(conf.get(COMPILE_CACHE_DIR) or "")
+    if not base:
+        return None
+    _install_persistent_listener()
+    path = os.path.join(base, f"topo-{topology_fingerprint()}")
+    if _PERSIST_STATE["dir"] == path:
+        return path
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache EVERYTHING: the point is zero compiles on replay, and tiny
+    # entries (scalar fetch programs) recompile as often as big ones
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()                # drop the handle to any old dir
+    except Exception:                    # noqa: BLE001
+        pass
+    _PERSIST_STATE["dir"] = path
+    return path
+
+
+def persistent_cache_stats() -> Dict[str, int]:
+    """{'hits', 'misses'} of the persistent compile cache this process
+    (the bench/CI proof counters)."""
+    from ..obs.registry import (COMPILE_PERSISTENT_HITS,
+                                COMPILE_PERSISTENT_MISSES)
+    return {"hits": int(COMPILE_PERSISTENT_HITS.value() or 0),
+            "misses": int(COMPILE_PERSISTENT_MISSES.value() or 0)}

@@ -39,7 +39,7 @@ _SMALL_AUX_CACHE = {}
 _SCALAR_CACHE = {}
 
 
-def _upload_aux(a: np.ndarray) -> jax.Array:
+def _upload_aux(a) -> jax.Array:
     """Device copy of a host aux array, cached by content.
 
     Aux arrays (literal values, dictionary rank tables) repeat identically
@@ -48,6 +48,12 @@ def _upload_aux(a: np.ndarray) -> jax.Array:
     high-latency link.  Tiny scalars (e.g. monotonically_increasing_id's
     per-batch base) churn a DIFFERENT value every batch — they get their
     own small cache so they cannot evict the big shared uploads."""
+    if isinstance(a, (jax.Array, jax.core.Tracer)):
+        # already on device, or a lifted-literal tracer of the enclosing
+        # whole-plan trace: pass through as a positional jit ARGUMENT
+        # (caching a tracer would leak it into later eager calls)
+        return a
+    a = np.asarray(a)
     key = (a.dtype.str, a.shape, a.tobytes())
     cache = _SMALL_AUX_CACHE if a.nbytes <= 16 else _AUX_DEVICE_CACHE
     buf = cache.get(key)
@@ -77,11 +83,16 @@ def _num_rows_scalar(num_rows) -> jax.Array:
     return buf
 
 
+def _lift_enabled(conf: TpuConf) -> bool:
+    from ..config import COMPILE_CONST_LIFT
+    return bool(conf.get(COMPILE_CONST_LIFT))
+
+
 def _prepare(exprs: Sequence[Expression], db: DeviceBatch, conf: TpuConf):
     dicts = {n: c.dictionary for n, c in zip(db.names, db.columns)}
-    pctx = PrepCtx(conf, dicts, batch=db)
+    pctx = PrepCtx(conf, dicts, batch=db, lift_literals=_lift_enabled(conf))
     hostvals = [e.prepare(pctx) for e in exprs]
-    aux = tuple(_upload_aux(np.asarray(a)) for a in pctx.aux)
+    aux = tuple(_upload_aux(a) for a in pctx.aux)
     return pctx, hostvals, aux
 
 
@@ -132,14 +143,25 @@ def _expr_fp(e) -> str:
     return fp
 
 
+def _expr_canon_fp(e) -> str:
+    fp = e.__dict__.get("_canon_fp_cache")
+    if fp is None:
+        fp = e.canonical_fingerprint()
+        e.__dict__["_canon_fp_cache"] = fp
+    return fp
+
+
 def _jit_key(exprs, db, aux, conf, tag):
     # keyed on expression STRUCTURE (fingerprint), not object identity:
     # re-planned queries (every bench iteration, every AQE re-plan) must hit
     # the compiled program, not re-trace it.  Batch layout (column names,
     # logical dtypes) is part of the key — ColumnRefs resolve positionally
     # at trace time, so same-shaped batches with different layouts must not
-    # share a program.
-    return (tag, tuple(_expr_fp(e) for e in exprs), db.capacity,
+    # share a program.  Under constant lifting the CANONICAL fingerprint
+    # erases lifted literal values (they are runtime aux arguments), so
+    # literal-only-different expressions share one program.
+    fp = _expr_canon_fp if _lift_enabled(conf) else _expr_fp
+    return (tag, tuple(fp(e) for e in exprs), db.capacity,
             tuple(db.names),
             tuple(c.dtype.simple_string for c in db.columns),
             _input_sig(db), tuple((a.shape, str(a.dtype)) for a in aux),

@@ -398,6 +398,38 @@ COMPILES_TOTAL = REGISTRY.counter(
     "Whole-plan XLA compile-cache outcomes (hit / miss).",
     ("outcome",))
 
+PLAN_CACHE = REGISTRY.counter(
+    "tpu_plan_cache_total",
+    "Process-wide whole-plan executable cache outcomes (canonical "
+    "constant-lifted structure key, exec/compiled.py): hit = a query "
+    "adopted another query's compiled program (literal-only variants, "
+    "re-planned repeats); miss = a cacheable plan paid a fresh compile.",
+    ("outcome",))
+
+COMPILE_PERSISTENT_HITS = REGISTRY.counter(
+    "tpu_compile_cache_persistent_hits_total",
+    "XLA compiles served from the on-disk persistent compile cache "
+    "(jax compilation cache under spark.rapids.tpu.compile.cacheDir's "
+    "topology-scoped subdirectory).")
+
+COMPILE_PERSISTENT_MISSES = REGISTRY.gauge(
+    "tpu_compile_cache_persistent_misses",
+    "XLA compiles that consulted the persistent cache and missed "
+    "(requests minus hits — maintained as a gauge: +1 per cache-using "
+    "compile request, -1 when the request resolves to a hit).  0 on a "
+    "fully warmed process: the zero-XLA-compiles replay proof.")
+
+COMPILE_BG_MS = REGISTRY.histogram(
+    "tpu_compile_background_ms",
+    "Wall milliseconds of each background compile-service task "
+    "(speculative split-plan segment compiles, --compile-only warmup), "
+    "log2 buckets (runtime/compile_service.py).")
+
+SCAN_UPLOAD_EVICTIONS = REGISTRY.counter(
+    "tpu_scan_upload_evictions_total",
+    "Hot-table device uploads evicted from the byte-capped shared "
+    "scan-upload cache (spark.rapids.tpu.sql.scan.uploadCacheBytes).")
+
 FAULTS_INJECTED = REGISTRY.counter(
     "tpu_faults_injected_total",
     "Chaos-harness faults fired, by injection site and kind.",

@@ -28,6 +28,7 @@ sorting; see individual ops).
 from __future__ import annotations
 
 import math
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -45,16 +46,53 @@ class PrepCtx:
     """Host-phase context: collects device aux arrays in deterministic order."""
 
     def __init__(self, conf: TpuConf, dicts: Dict[str, Optional[pa.Array]],
-                 batch=None):
+                 batch=None, lift_literals: bool = False):
         self.conf = conf
         self.dicts = dicts            # input column name -> dictionary or None
         self.batch = batch            # the DeviceBatch under evaluation
         self.aux: List[np.ndarray] = []
         self.node_slots: Dict[int, List[int]] = {}
+        # constant lifting (sql.compile.constantLifting): eligible
+        # Literals route their value through the aux channel — a runtime
+        # ARGUMENT of the compiled program — instead of a baked constant,
+        # so programs key on expression structure, not literal values
+        self.lift_literals = lift_literals
+        self._parents: List["Expression"] = []
 
-    def add(self, node: "Expression", arr: np.ndarray) -> None:
+    def add(self, node: "Expression", arr) -> None:
         self.node_slots.setdefault(id(node), []).append(len(self.aux))
-        self.aux.append(np.asarray(arr))
+        # whole-plan tracing hands lifted literal values in as TRACERS of
+        # the outer program — pass them through untouched (they become
+        # arguments of the inner jit, never closure-captured constants)
+        if not isinstance(arr, (jax.Array, jax.core.Tracer)):
+            arr = np.asarray(arr)
+        self.aux.append(arr)
+
+    def current_parent(self) -> Optional["Expression"]:
+        """The expression whose children are being prepared (None at a
+        projection/predicate root)."""
+        return self._parents[-1] if self._parents else None
+
+
+# -- whole-plan literal bindings --------------------------------------------
+# While exec/compiled.py traces a whole-plan program, lifted literal
+# values enter the program as flat TOP-LEVEL inputs; the binding maps
+# each Literal (by identity) to its traced scalar so Literal._prepare
+# hands the tracer — not the host value — into the aux channel.
+# Thread-local: background compiles trace concurrently.
+
+_LIFT_BINDINGS = threading.local()
+
+
+def set_literal_bindings(bindings: Optional[Dict[int, object]]) -> None:
+    """Install (or clear, with None) the id(Literal) -> traced scalar
+    map for the whole-plan trace running on THIS thread."""
+    _LIFT_BINDINGS.map = bindings
+
+
+def get_literal_binding(lit: "Expression"):
+    m = getattr(_LIFT_BINDINGS, "map", None)
+    return None if m is None else m.get(id(lit))
 
 
 class HostVal:
@@ -107,6 +145,12 @@ class Expression:
     children: Tuple["Expression", ...] = ()
     dtype: t.DataType = None
     nullable: bool = True
+    #: True when this node consumes literal children ONLY through their
+    #: traced DevVal (never reading `.value` on the host to specialize a
+    #: kernel) — the gate for constant lifting.  Conservative default
+    #: False: an unmarked parent keeps its literal children baked into
+    #: the program and keyed by value.
+    lifts_literal_children = False
 
     # ---- resolution ----
     def bind(self, schema: t.StructType) -> "Expression":
@@ -141,7 +185,14 @@ class Expression:
 
     # ---- host phase ----
     def prepare(self, pctx: PrepCtx) -> HostVal:
-        kids = [c.prepare(pctx) for c in self.children]
+        # the parent stack lets Literal._prepare see WHOSE child it is:
+        # lifting is only legal under parents that never host-read the
+        # literal value (lifts_literal_children)
+        pctx._parents.append(self)
+        try:
+            kids = [c.prepare(pctx) for c in self.children]
+        finally:
+            pctx._parents.pop()
         return self._prepare(pctx, kids)
 
     def _prepare(self, pctx: PrepCtx, kids: List[HostVal]) -> HostVal:
@@ -166,6 +217,18 @@ class Expression:
     # ---- identity ----
     def fingerprint(self) -> str:
         kids = ",".join(c.fingerprint() for c in self.children)
+        return f"{type(self).__name__}({self._fp_extra()};{kids})"
+
+    def canonical_fingerprint(self, lift_ok: bool = True) -> str:
+        """Structure fingerprint with LIFTED literal values erased to a
+        dtype-only slot marker: the compile-cache key under constant
+        lifting.  `lift_ok` carries the parent-safety bit down the tree
+        (top-level call = root position = liftable) and must mirror
+        Literal._prepare's lift decision exactly — a value this
+        fingerprint hides is a value the program receives at runtime."""
+        kids = ",".join(
+            c.canonical_fingerprint(self.lifts_literal_children)
+            for c in self.children)
         return f"{type(self).__name__}({self._fp_extra()};{kids})"
 
     def _fp_extra(self) -> str:
@@ -333,13 +396,50 @@ class Literal(Expression):
     def _resolve(self):
         pass
 
+    def lift_type_ok(self) -> bool:
+        """Value/dtype half of lift eligibility: a non-null literal with
+        one flat numeric device lane.  Strings carry dictionaries (host
+        data the program specializes on), wide decimals a second lane,
+        nulls an all-false validity shape — all stay baked."""
+        if self.value is None:
+            return False
+        dt = self.dtype
+        if isinstance(dt, (t.StringType, t.NullType)):
+            return False
+        if isinstance(dt, t.DecimalType) and dt.is_wide:
+            return False
+        return isinstance(dt, (t.ByteType, t.ShortType, t.IntegerType,
+                               t.LongType, t.FloatType, t.DoubleType,
+                               t.BooleanType, t.DateType, t.TimestampType,
+                               t.DecimalType))
+
+    def _lifted(self, pctx: PrepCtx) -> bool:
+        if not pctx.lift_literals or not self.lift_type_ok():
+            return False
+        parent = pctx.current_parent()
+        return parent is None or parent.lifts_literal_children
+
     def _prepare(self, pctx, kids):
         if isinstance(self.dtype, t.StringType) and self.value is not None:
             return HostVal(pa.array([self.value], pa.string()))
+        if self._lifted(pctx):
+            bound = get_literal_binding(self)
+            if bound is None:
+                bound = np.asarray(self._physical_value(),
+                                   dtype=compute_dtype(self.dtype))
+            pctx.add(self, bound)
         return HostVal()
 
     def _eval_dev(self, ctx, kids):
         cap = ctx.capacity
+        slots = ctx.aux_of(self)
+        if slots:
+            # lifted: the value arrives as a 0-d runtime argument — the
+            # broadcast is shape-only, so the compiled program is
+            # literal-value-agnostic
+            scalar = slots[0].astype(compute_dtype(self.dtype))
+            return DevVal(jnp.broadcast_to(scalar, (cap,)), None,
+                          self.dtype)
         if self.value is None:
             dt = self.dtype if not isinstance(self.dtype, t.NullType) else t.INT
             data = jnp.zeros((cap,), dtype=compute_dtype(dt))
@@ -351,6 +451,11 @@ class Literal(Expression):
         data = jnp.full((cap,), self._physical_value(),
                         dtype=compute_dtype(self.dtype))
         return DevVal(data, None, self.dtype)
+
+    def canonical_fingerprint(self, lift_ok: bool = True) -> str:
+        if lift_ok and self.lift_type_ok():
+            return f"Literal(?:{self.dtype.simple_string};)"
+        return self.fingerprint()
 
     def _eval_cpu(self, rb, kids):
         from ..columnar.host import dtype_to_arrow
@@ -369,6 +474,7 @@ class Literal(Expression):
 
 
 class Alias(Expression):
+    lifts_literal_children = True
     def __init__(self, child: Expression, name: str):
         self.children = (child,)
         self.name = name
@@ -441,6 +547,7 @@ def _cpu_promote(arr: pa.Array, dst: t.DataType) -> pa.Array:
 
 
 class BinaryArithmetic(Expression):
+    lifts_literal_children = True
     symbol = "?"
     #: ops/decimal.py result-type rule; None -> decimal unsupported here
     decimal_rule = None
@@ -775,6 +882,7 @@ class Remainder(BinaryArithmetic):
 
 
 class UnaryMinus(Expression):
+    lifts_literal_children = True
     def __init__(self, child):
         self.children = (child,)
 
@@ -790,6 +898,7 @@ class UnaryMinus(Expression):
 
 
 class Abs(Expression):
+    lifts_literal_children = True
     def __init__(self, child):
         self.children = (child,)
 
@@ -809,6 +918,7 @@ class Abs(Expression):
 # ---------------------------------------------------------------------------
 
 class BinaryComparison(Expression):
+    lifts_literal_children = True
     symbol = "?"
 
     def __init__(self, left, right):
@@ -1018,6 +1128,7 @@ class EqualNullSafe(BinaryComparison):
 # ---------------------------------------------------------------------------
 
 class And(Expression):
+    lifts_literal_children = True
     def __init__(self, l, r):
         self.children = (l, r)
 
@@ -1043,6 +1154,7 @@ class And(Expression):
 
 
 class Or(Expression):
+    lifts_literal_children = True
     def __init__(self, l, r):
         self.children = (l, r)
 
@@ -1065,6 +1177,7 @@ class Or(Expression):
 
 
 class Not(Expression):
+    lifts_literal_children = True
     def __init__(self, child):
         self.children = (child,)
 
@@ -1084,6 +1197,7 @@ class Not(Expression):
 # ---------------------------------------------------------------------------
 
 class IsNull(Expression):
+    lifts_literal_children = True
     nullable = False
 
     def __init__(self, child):
@@ -1102,6 +1216,7 @@ class IsNull(Expression):
 
 
 class IsNotNull(Expression):
+    lifts_literal_children = True
     nullable = False
 
     def __init__(self, child):
@@ -1138,6 +1253,7 @@ class IsNaN(Expression):
 
 
 class Coalesce(Expression):
+    lifts_literal_children = True
     def __init__(self, *children):
         self.children = tuple(children)
 
@@ -1176,6 +1292,7 @@ class Coalesce(Expression):
 # ---------------------------------------------------------------------------
 
 class If(Expression):
+    lifts_literal_children = True
     def __init__(self, pred, then, other):
         self.children = (pred, then, other)
 
@@ -1211,6 +1328,8 @@ class If(Expression):
 
 class CaseWhen(Expression):
     """CASE WHEN c1 THEN v1 [WHEN c2 THEN v2]* [ELSE e] END."""
+
+    lifts_literal_children = True
 
     def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
                  otherwise: Optional[Expression] = None):
@@ -1535,6 +1654,7 @@ class Pow(Expression):
 # ---------------------------------------------------------------------------
 
 class Cast(Expression):
+    lifts_literal_children = True
     def __init__(self, child, to: t.DataType):
         self.children = (child,)
         self.to = to
@@ -1944,6 +2064,8 @@ class Atan2(Expression):
 class Greatest(Expression):
     """greatest(...): Spark skips nulls, null only when ALL inputs null;
     NaN is greatest (Java ordering)."""
+
+    lifts_literal_children = True
     _is_greatest = True
 
     def __init__(self, *items):

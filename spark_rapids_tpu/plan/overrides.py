@@ -1328,6 +1328,41 @@ class PhysicalQuery:
         with self._instrumented(ctx), crash_capture(self.conf, ctx):
             return self._collect_with_query_retry(ctx)
 
+    def prewarm(self, ctx: Optional[ExecContext] = None) -> bool:
+        """AOT-compile this query's whole-plan program WITHOUT executing
+        it — the --compile-only warmup hook (bench.py) and the serving
+        plane's ahead-of-traffic compile.  Populates the in-process
+        structure cache and, when spark.rapids.tpu.compile.cacheDir is
+        set, the persistent on-disk cache.  For split plans only the
+        first segment is statically known; later segments compile at
+        run time (the background service pipelines them).  Returns True
+        when a program is ready, False when this plan cannot compile
+        ahead of time (host-kind, whole-plan off, host-decision plan)."""
+        ctx = ctx or ExecContext(self.conf)
+        if self.kind != "device" or not self._whole_plan_enabled():
+            return False
+        from ..exec.compiled import (_TRACE_FALLBACK_ERRORS, CompiledPlan,
+                                     SplitCompiledPlan, build_plan)
+        plan = getattr(self, "_compiled_plan", None)
+        if plan is False:
+            return False
+        if plan is None:
+            plan = build_plan(self.root, ctx)
+        try:
+            if isinstance(plan, SplitCompiledPlan):
+                plan._install_leaves()
+                try:
+                    plan._segment(0, (), ctx).ensure_compiled(ctx)
+                finally:
+                    plan._restore_leaves()
+            else:
+                plan.ensure_compiled(ctx)
+        except _TRACE_FALLBACK_ERRORS:
+            self._compiled_plan = False
+            return False
+        self._compiled_plan = plan
+        return True
+
     def _collect_once(self, ctx: ExecContext) -> pa.Table:
         if self.kind == "device" and self._whole_plan_enabled():
             from ..exec.compiled import collect_with_fallback

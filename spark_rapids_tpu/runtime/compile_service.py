@@ -1,0 +1,132 @@
+"""Background compile service: AOT compilation off the query thread.
+
+ROADMAP item 3's last leg: whole-plan compiles are the cold-start wall,
+and a split plan (exec/compiled.py SplitCompiledPlan) compiles its
+segments strictly in sequence — segment i+1's compile starts only after
+segment i's seam sync.  XLA compilation releases the GIL, so a small
+thread pool can overlap that work with device execution (and with other
+compiles: bench.py --compile-only drives the whole suite's cold
+compiles through this pool concurrently to pre-populate the persistent
+cache).
+
+Contract:
+
+  * `submit(key, fn)` runs `fn` on the pool exactly once per live key
+    (duplicate submissions return the in-flight task).  `fn` returns
+    the compiled object; any exception — including injected `compile`
+    chaos faults, which fire inside `fn` on the service thread via the
+    submitting query's own injector — is captured and re-raised on the
+    CONSUMING thread by `task.wait()`, so the existing recovery ladders
+    (OOM -> eager fallback, fatal -> crash capture) see background
+    failures exactly where they would see inline ones.
+  * `take(key)` pops the task for consumption; mispredicted speculative
+    tasks that nobody takes age out of the bounded task map (their
+    threads still finish; the results are just dropped).
+  * Every task's wall time lands in the `tpu_compile_background_ms`
+    histogram (obs/registry.py).
+
+The pool is process-wide and lazily sized from the FIRST conf that
+touches it (spark.rapids.tpu.compile.background.threads).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..config import (COMPILE_BG_ENABLED, COMPILE_BG_THREADS, TpuConf)
+
+#: dropped-oldest bound on the task map: speculative keys nobody
+#: consumes must not accumulate across a long session
+_MAX_TASKS = 128
+
+
+class CompileTask:
+    """One background compile: an Event-guarded (result | exception)."""
+
+    __slots__ = ("key", "done", "result", "exc", "ms")
+
+    def __init__(self, key):
+        self.key = key
+        self.done = threading.Event()
+        self.result = None
+        self.exc: Optional[BaseException] = None
+        self.ms = 0.0
+
+    def wait(self, timeout: Optional[float] = 600.0):
+        """Block for the compile; re-raise its exception on THIS thread
+        (the chaos-threading seam: an injected fault crosses the pool
+        boundary here)."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"background compile {self.key!r} did not finish "
+                f"within {timeout}s")
+        if self.exc is not None:
+            raise self.exc
+        return self.result
+
+
+class CompileService:
+    def __init__(self, threads: int):
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(
+            max_workers=threads, thread_name_prefix="tpu-compile")
+        self._tasks: Dict[object, CompileTask] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, key, fn: Callable[[], object]) -> CompileTask:
+        """Schedule `fn` under `key` (idempotent per live key)."""
+        with self._lock:
+            task = self._tasks.get(key)
+            if task is not None:
+                return task
+            task = CompileTask(key)
+            self._tasks[key] = task
+            while len(self._tasks) > _MAX_TASKS:
+                self._tasks.pop(next(iter(self._tasks)))
+
+        def run():
+            t0 = time.perf_counter()
+            try:
+                task.result = fn()
+            except BaseException as e:              # noqa: BLE001
+                task.exc = e
+            finally:
+                task.ms = (time.perf_counter() - t0) * 1000.0
+                try:
+                    from ..obs.registry import COMPILE_BG_MS
+                    COMPILE_BG_MS.observe(task.ms)
+                finally:
+                    task.done.set()
+
+        self._pool.submit(run)
+        return task
+
+    def take(self, key) -> Optional[CompileTask]:
+        """Pop the task for `key` — the consumer owns its result (and
+        its exception).  None when never submitted / already aged out."""
+        with self._lock:
+            return self._tasks.pop(key, None)
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(1 for t in self._tasks.values()
+                       if not t.done.is_set())
+
+
+_SERVICE: Optional[CompileService] = None
+_SERVICE_LOCK = threading.Lock()
+
+
+def get_service(conf: TpuConf) -> CompileService:
+    """The process-wide compile service (pool sized by the first conf)."""
+    global _SERVICE
+    if _SERVICE is None:
+        with _SERVICE_LOCK:
+            if _SERVICE is None:
+                _SERVICE = CompileService(int(conf.get(COMPILE_BG_THREADS)))
+    return _SERVICE
+
+
+def background_enabled(conf: TpuConf) -> bool:
+    return bool(conf.get(COMPILE_BG_ENABLED))

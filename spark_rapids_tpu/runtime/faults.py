@@ -55,7 +55,11 @@ from .memory import TpuRetryOOM
 #: lint `scripts/check_fault_sites.py` asserts chaos tests exercise)
 SITES: Dict[str, str] = {
     "reserve": "MemoryBudget.reserve admission (runtime/memory.py)",
-    "compile": "whole-plan XLA compile (exec/compiled.py)",
+    "compile": "whole-plan XLA compile (exec/compiled.py) — fires on "
+               "the compiling thread, including background segment "
+               "compiles on the compile service "
+               "(runtime/compile_service.py), whose faults re-raise on "
+               "the consuming query thread",
     "execute": "per-batch physical root stream (runtime/failure.py "
                "install_fault_injection)",
     "h2d": "host->device upload transitions",

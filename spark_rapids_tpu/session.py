@@ -37,6 +37,11 @@ class TpuSession:
         # Prometheus endpoint) as soon as a session exists
         from .obs.export import configure_plane
         configure_plane(self.conf)
+        # engine-level persistent compile cache (topology-scoped AOT
+        # executables; spark.rapids.tpu.compile.cacheDir) — a no-op
+        # when the conf is unset
+        from .exec.compiled import configure_persistent_cache
+        configure_persistent_cache(self.conf)
 
     def set_conf(self, key: str, value) -> None:
         raw = dict(self.conf._raw)
@@ -44,6 +49,8 @@ class TpuSession:
         self.conf = TpuConf(raw)
         from .obs.export import configure_plane
         configure_plane(self.conf)
+        from .exec.compiled import configure_persistent_cache
+        configure_persistent_cache(self.conf)
 
     def metrics_snapshot(self, compact: bool = False) -> dict:
         """The process-wide always-on metrics registry: every counter,

@@ -186,6 +186,63 @@ def test_compile_oom_falls_back_to_eager():
     assert df.metrics().get("whole_plan_fallbacks", 0) >= 1
 
 
+SPLIT_BG = {
+    "spark.rapids.tpu.sql.compile.wholePlan": "ON",
+    "spark.rapids.tpu.sql.compile.seamSplitMinRows": "1024",
+    # ONE speculative candidate -> deterministic fire ordering: hit #1
+    # is segment 0's inline compile, hit #2 the background segment task
+    "spark.rapids.tpu.compile.background.speculateBuckets": "1",
+}
+
+
+def _split_build(s):
+    # ONE-bucket inputs (1000 rows -> the 1024 minimum bucket): every
+    # seam output re-buckets to the single speculative candidate's
+    # prediction, so the background task the fault fires in is the one
+    # the seam CONSUMES — a mispredicted candidate would swallow the
+    # injection and the query would sail through
+    n = 1000
+    t1 = pa.table({"k": (np.arange(n) % 20).astype(np.int64),
+                   "v": np.arange(n, dtype=np.float64)})
+    t2 = pa.table({"k": np.arange(20, dtype=np.int64),
+                   "w": np.arange(20, dtype=np.float64)})
+    from spark_rapids_tpu.plan.aggregates import Sum
+    from spark_rapids_tpu.session import lit
+    return (s.from_arrow(t1).join(s.from_arrow(t2), on="k")
+            .filter(col("v") > lit(100.0))
+            .group_by("k").agg((Sum(col("w")), "sw"))
+            .sort(("k", True, True)))
+
+
+def test_background_compile_oom_falls_back_bit_identical():
+    """An injected OOM inside a BACKGROUND segment compile re-raises on
+    the consuming query thread at the seam and rides the normal ladder:
+    whole-plan falls back to the eager engine, bit-identical output."""
+    clean, _, _ = run_query(_split_build, SPLIT_BG)
+    chaos, s, df = run_query(_split_build, SPLIT_BG,
+                             faults="compile:oom:nth=2")
+    assert_identical(clean, chaos)
+    inj = get_injector(s.conf)
+    assert [r["site"] for r in inj.log] == ["compile"]
+    assert inj.log[0]["hit"] == 2       # fired in the background task
+    assert df.metrics().get("whole_plan_fallbacks", 0) >= 1
+
+
+def test_background_compile_fatal_crash_dump(tmp_path):
+    """A fatal fault in the background compile service surfaces as a
+    classified FatalDeviceError on the query thread, with the injected-
+    fault record in the crash dump — same contract as inline compiles."""
+    with pytest.raises(FatalDeviceError) as ei:
+        run_query(_split_build,
+                  {**SPLIT_BG,
+                   "spark.rapids.tpu.coredump.path": str(tmp_path)},
+                  faults="compile:fatal:nth=2")
+    assert classify(ei.value) == FATAL_DEVICE
+    dump = json.load(open(ei.value.dump_path))
+    rec = dump["injected_faults"]
+    assert rec and rec[0]["site"] == "compile" and rec[0]["hit"] == 2
+
+
 def test_exchange_fault_site(eight_devices):
     # the collective fabric has no conf in reach: it fires on the ACTIVE
     # injector (installed per query scope; armed directly here)

@@ -1,0 +1,451 @@
+"""Compile-latency plane (exec/compiled.py + runtime/compile_service.py).
+
+Covers the four co-designed mechanisms:
+  * constant-lifted canonical cache keys — literal-only query variants
+    share ONE executable (whole-plan structure cache + eager jit cache),
+    with oracle-checked results and no false sharing across tables;
+  * bucket quantization — an explicit shape.buckets set snaps capacities
+    onto few compiled shapes and matches the CPU oracle at off-bucket
+    row counts;
+  * the topology-safe persistent cache — a SECOND PROCESS replays a
+    warmed query with zero XLA compiles (subprocess round trip on a
+    shared spark.rapids.tpu.compile.cacheDir);
+  * background segment compilation — split plans adopt programs the
+    compile service AOT-compiled speculatively, bit-identical to the
+    inline path.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec.plan import ExecContext
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.aggregates import Sum
+from spark_rapids_tpu.session import DataFrame, TpuSession, col, lit
+
+ON = {"spark.rapids.tpu.sql.compile.wholePlan": "ON"}
+CPU = {"spark.rapids.tpu.sql.enabled": "false"}
+LIFT_OFF = {"spark.rapids.tpu.sql.compile.constantLifting": "false"}
+
+
+def _approx_eq(a: pa.Table, b: pa.Table) -> bool:
+    """Row-order-insensitive table equality with a float tail (group-by
+    output order is engine-defined)."""
+    da, db = a.to_pydict(), b.to_pydict()
+    if set(da) != set(db) or a.num_rows != b.num_rows:
+        return False
+    cols = sorted(da)
+    rows_a = sorted(zip(*(da[c] for c in cols)), key=repr)
+    rows_b = sorted(zip(*(db[c] for c in cols)), key=repr)
+    for ra, rb in zip(rows_a, rows_b):
+        for x, y in zip(ra, rb):
+            if x == y:
+                continue
+            if isinstance(x, float) and isinstance(y, float) and \
+                    abs(x - y) <= 1e-9 * max(1.0, abs(x), abs(y)):
+                continue
+            return False
+    return True
+
+
+def _oracle(df):
+    return DataFrame(df._plan, TpuSession(CPU)).collect()
+
+
+# ---------------------------------------------------------------------------
+# constant-lifted canonical keys
+# ---------------------------------------------------------------------------
+
+def test_literal_variants_share_whole_plan_executable():
+    """Two queries differing ONLY in literals compile once: the second
+    adopts the first's executable from the process-wide structure cache
+    (the acceptance criterion)."""
+    rng = np.random.default_rng(11)
+    tbl = pa.table({"k": np.arange(400, dtype=np.int64) % 9,
+                    "v": rng.random(400)})
+    s = TpuSession(ON)
+
+    def q(th):
+        return (s.from_arrow(tbl).filter(col("v") > lit(th))
+                .group_by("k").agg((Sum(col("v")), "sv")))
+
+    d1, d2 = q(0.25), q(0.75)
+    c1, c2 = ExecContext(s.conf), ExecContext(s.conf)
+    r1 = d1.physical().collect(c1)
+    r2 = d2.physical().collect(c2)
+    assert c1.metrics.get("compile_cache_misses") == 1
+    assert c1.metrics.get("whole_plan_compiled_queries") == 1
+    # the literal-variant query: ZERO compiles, one structure-cache hit
+    assert not c2.metrics.get("compile_cache_misses")
+    assert c2.metrics.get("whole_plan_structure_hits") == 1
+    assert c2.metrics.get("whole_plan_compiled_queries") == 1
+    assert _approx_eq(r1, _oracle(d1))
+    assert _approx_eq(r2, _oracle(d2))
+
+
+def test_literal_variants_with_lifting_off_compile_separately():
+    rng = np.random.default_rng(12)
+    tbl = pa.table({"v": rng.random(300)})
+    s = TpuSession({**ON, **LIFT_OFF})
+
+    def q(th):
+        return s.from_arrow(tbl).filter(col("v") > lit(th)) \
+            .agg((Sum(col("v")), "sv"))
+
+    c1, c2 = ExecContext(s.conf), ExecContext(s.conf)
+    r1 = q(0.2).physical().collect(c1)
+    r2 = q(0.8).physical().collect(c2)
+    assert c1.metrics.get("compile_cache_misses") == 1
+    assert c2.metrics.get("compile_cache_misses") == 1
+    assert not c2.metrics.get("whole_plan_structure_hits")
+    assert _approx_eq(r1, _oracle(q(0.2)))
+    assert _approx_eq(r2, _oracle(q(0.8)))
+
+
+def test_eager_jit_cache_shares_literal_variants():
+    """The per-operator jit cache keys canonically too: literal-variant
+    filters/projections reuse the same programs on the eager engine."""
+    from spark_rapids_tpu.exec import evaluator
+    from spark_rapids_tpu.testing import clear_compiled_caches
+    tbl = pa.table({"x": list(range(64))})
+    s = TpuSession()                   # AUTO on CPU backend -> eager
+
+    def q(a, b):
+        return s.from_arrow(tbl).filter(col("x") > lit(a)) \
+            .select(col("x") * lit(b), names=["y"])
+
+    clear_compiled_caches()
+    r1 = q(5, 3).collect()
+    n1 = len(evaluator._JIT_CACHE)
+    r2 = q(50, 7).collect()
+    assert len(evaluator._JIT_CACHE) == n1
+    assert r1.to_pydict()["y"] == [x * 3 for x in range(6, 64)]
+    assert r2.to_pydict()["y"] == [x * 7 for x in range(51, 64)]
+
+
+def test_no_false_sharing_across_tables():
+    """Same canonical structure over DIFFERENT tables (different string
+    dictionaries) must NOT reuse the other table's executable — the
+    identity anchors guard the host data baked at trace time."""
+    s = TpuSession(ON)
+    t1 = pa.table({"g": ["a", "b", "a", "c"] * 25,
+                   "v": np.arange(100, dtype=np.float64)})
+    t2 = pa.table({"g": ["x", "y", "z", "x"] * 25,
+                   "v": np.arange(100, dtype=np.float64)})
+
+    def q(tbl):
+        return s.from_arrow(tbl).filter(col("v") > lit(10.0)) \
+            .group_by("g").agg((Sum(col("v")), "sv"))
+
+    r1 = q(t1).collect()
+    r2 = q(t2).collect()
+    assert set(r1.column("g").to_pylist()) == {"a", "b", "c"}
+    assert set(r2.column("g").to_pylist()) == {"x", "y", "z"}
+    assert _approx_eq(r1, _oracle(q(t1)))
+    assert _approx_eq(r2, _oracle(q(t2)))
+
+
+def test_canonical_fingerprint_erases_only_lifted_positions():
+    schema = t.StructType([t.StructField("x", t.LONG)])
+    safe = E.GreaterThan(E.ColumnRef("x"), E.Literal(5)).bind(schema)
+    also = E.GreaterThan(E.ColumnRef("x"), E.Literal(9)).bind(schema)
+    assert safe.canonical_fingerprint() == also.canonical_fingerprint()
+    assert safe.fingerprint() != also.fingerprint()
+    # In consumes its items on host -> value-keyed either way
+    in5 = E.In(E.ColumnRef("x"), [5]).bind(schema)
+    in9 = E.In(E.ColumnRef("x"), [9]).bind(schema)
+    assert in5.canonical_fingerprint() != in9.canonical_fingerprint()
+    # null / string literals never lift
+    s5 = E.EqualTo(E.ColumnRef("x"), E.Literal(None, t.LONG)).bind(schema)
+    assert "None" in s5.canonical_fingerprint()
+
+
+def test_lifted_literal_expressions_match_cpu_oracle():
+    """Sweep literal positions under the lift whitelist against the
+    per-expression CPU oracle (lifting changes how values enter the
+    program, never what they compute)."""
+    from spark_rapids_tpu.testing import assert_device_cpu_equal
+    data = {"x": [1, 2, None, 4, 5], "f": [0.5, -1.5, 2.5, None, 4.0]}
+    exprs = [
+        E.Add(E.ColumnRef("x"), E.Literal(7)),
+        E.Multiply(E.ColumnRef("f"), E.Literal(2.5)),
+        E.GreaterThan(E.ColumnRef("x"), E.Literal(2)),
+        E.If(E.LessThan(E.ColumnRef("f"), E.Literal(0.0)),
+             E.Literal(-1.0), E.ColumnRef("f")),
+        E.Coalesce(E.ColumnRef("x"), E.Literal(99)),
+        E.CaseWhen([(E.GreaterThan(E.ColumnRef("x"), E.Literal(3)),
+                     E.Literal(1))], E.Literal(0)),
+        E.Literal(42),                 # top-level projection scalar
+    ]
+    assert_device_cpu_equal(exprs, data, approx_float=True)
+
+
+# ---------------------------------------------------------------------------
+# bucket quantization
+# ---------------------------------------------------------------------------
+
+def test_explicit_bucket_set_quantizes_capacities():
+    from spark_rapids_tpu.columnar.device import bucket_capacity
+    conf = TpuConf({"spark.rapids.tpu.sql.shape.buckets": "1024,8192"})
+    assert bucket_capacity(1, conf) == 1024
+    assert bucket_capacity(1024, conf) == 1024
+    assert bucket_capacity(1025, conf) == 8192
+    assert bucket_capacity(8192, conf) == 8192
+    assert bucket_capacity(8193, conf) == 16384      # doubles past top
+    assert bucket_capacity(40000, conf) == 65536
+
+
+def test_bucket_set_conf_validation():
+    for bad in ("8192,1024", "12,12", "a,b", "-4"):
+        with pytest.raises(ValueError):
+            TpuConf({"spark.rapids.tpu.sql.shape.buckets": bad}) \
+                .bucket_set  # noqa: B018
+
+
+@pytest.mark.parametrize("rows", [1, 1023, 1025, 2999, 9000])
+def test_bucket_quantized_execution_matches_oracle(rows):
+    """Off-bucket row counts pad onto the quantized set and still match
+    the CPU oracle (whole-plan path)."""
+    rng = np.random.default_rng(rows)
+    tbl = pa.table({"k": (np.arange(rows) % 5).astype(np.int64),
+                    "v": rng.random(rows)})
+    s = TpuSession({**ON, "spark.rapids.tpu.sql.shape.buckets":
+                    "1024,8192"})
+    df = s.from_arrow(tbl).filter(col("v") > lit(0.5)) \
+        .group_by("k").agg((Sum(col("v")), "sv"))
+    ctx = ExecContext(s.conf)
+    out = df.physical().collect(ctx)
+    assert ctx.metrics.get("whole_plan_compiled_queries") == 1
+    got = dict(zip(out.column("k").to_pylist(),
+                   out.column("sv").to_pylist()))
+    o = _oracle(df)
+    want = dict(zip(o.column("k").to_pylist(),
+                    o.column("sv").to_pylist()))
+    assert set(got) == set(want)
+    assert all(abs(got[k] - want[k]) < 1e-9 * max(1.0, abs(want[k]))
+               for k in want)
+
+
+def test_same_bucket_row_counts_share_program():
+    """Two tables whose row counts land in ONE explicit bucket produce
+    identically-shaped programs — here visible as a second-query
+    whole-plan compile that still matches the oracle, and (numeric-only
+    columns, no dictionaries) as equal flat input signatures."""
+    s = TpuSession({**ON, "spark.rapids.tpu.sql.shape.buckets": "8192"})
+    for rows in (2000, 7000):          # both -> capacity 8192
+        tbl = pa.table({"v": np.arange(rows, dtype=np.float64)})
+        df = s.from_arrow(tbl).filter(col("v") > lit(3.0)) \
+            .agg((Sum(col("v")), "sv"))
+        out = df.physical().collect(ExecContext(s.conf))
+        assert _approx_eq(out, _oracle(df))
+
+
+# ---------------------------------------------------------------------------
+# persistent cache: subprocess round trip (zero XLA compiles on replay)
+# ---------------------------------------------------------------------------
+
+_SUBPROC = r"""
+import json, sys
+import numpy as np, pyarrow as pa
+from spark_rapids_tpu.session import TpuSession, col, lit
+from spark_rapids_tpu.exec.plan import ExecContext
+from spark_rapids_tpu.plan.aggregates import Sum
+s = TpuSession({"spark.rapids.tpu.sql.compile.wholePlan": "ON",
+                "spark.rapids.tpu.compile.cacheDir": sys.argv[1]})
+t = pa.table({"k": np.arange(3000) % 7,
+              "v": np.arange(3000, dtype=np.float64)})
+df = s.from_arrow(t).filter(col("v") > lit(100.0)) \
+     .group_by("k").agg((Sum(col("v")), "sv"))
+ctx = ExecContext(s.conf)
+out = df.physical().collect(ctx)
+from spark_rapids_tpu.exec.compiled import persistent_cache_stats
+print(json.dumps({"stats": persistent_cache_stats(),
+                  "compiled": ctx.metrics.get(
+                      "whole_plan_compiled_queries", 0),
+                  "sv": sorted(out.column("sv").to_pylist())}))
+"""
+
+
+def test_persistent_cache_second_process_zero_compiles(tmp_path):
+    """TPC-H-shaped proof at test scale: process A populates the
+    topology-scoped persistent cache; process B replays the same query
+    with ZERO XLA compiles (persistent misses == 0, hits > 0) and
+    identical results."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "JAX_ENABLE_X64": "1",
+           "PYTHONPATH": os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__)))}
+    env.pop("XLA_FLAGS", None)         # single topology for both runs
+
+    def run():
+        res = subprocess.run(
+            [sys.executable, "-c", _SUBPROC, str(tmp_path / "cache")],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert res.returncode == 0, res.stderr[-2000:]
+        return json.loads(res.stdout.strip().splitlines()[-1])
+
+    a = run()
+    assert a["compiled"] == 1
+    assert a["stats"]["misses"] > 0    # cold: really compiled
+    b = run()
+    assert b["compiled"] == 1
+    assert b["stats"]["misses"] == 0, \
+        f"warm replay performed XLA compiles: {b['stats']}"
+    assert b["stats"]["hits"] > 0
+    assert b["sv"] == a["sv"]
+    # entries live under a topology-scoped subdirectory
+    subdirs = os.listdir(tmp_path / "cache")
+    assert subdirs and all(d.startswith("topo-") for d in subdirs)
+
+
+def test_topology_fingerprint_is_stable():
+    from spark_rapids_tpu.exec.compiled import topology_fingerprint
+    assert topology_fingerprint() == topology_fingerprint()
+    assert len(topology_fingerprint()) == 12
+
+
+# ---------------------------------------------------------------------------
+# background segment compilation
+# ---------------------------------------------------------------------------
+
+def _split_conf(extra=None):
+    return TpuSession({
+        **ON,
+        "spark.rapids.tpu.sql.compile.seamSplitMinRows": "1024",
+        **(extra or {})})
+
+
+def _split_query(s):
+    n = 5000
+    t1 = pa.table({"k": (np.arange(n) % 50).astype(np.int64),
+                   "v": np.random.default_rng(0).random(n)})
+    t2 = pa.table({"k": np.arange(50, dtype=np.int64),
+                   "w": np.arange(50, dtype=np.float64)})
+    return (s.from_arrow(t1).join(s.from_arrow(t2), on="k")
+            .filter(col("v") > lit(0.5))
+            .group_by("k").agg((Sum(col("w")), "sw"))
+            .sort(("sw", False, False)).limit(10))
+
+
+def test_background_segment_compiles_are_adopted_and_correct():
+    s = _split_conf()
+    df = _split_query(s)
+    ctx = ExecContext(s.conf)
+    out = df.physical().collect(ctx)
+    assert ctx.metrics.get("whole_plan_split_queries") == 1
+    # downstream segments came from the background compile service
+    assert ctx.metrics.get("compile_background_used", 0) >= 1
+    o = _oracle(df)
+    assert out.column("k").to_pylist() == o.column("k").to_pylist()
+    assert all(abs(a - b) < 1e-9 * max(1.0, abs(b))
+               for a, b in zip(out.column("sw").to_pylist(),
+                               o.column("sw").to_pylist()))
+
+
+def test_background_disabled_still_correct():
+    s = _split_conf({"spark.rapids.tpu.compile.background.enabled":
+                     "false"})
+    df = _split_query(s)
+    ctx = ExecContext(s.conf)
+    out = df.physical().collect(ctx)
+    assert ctx.metrics.get("whole_plan_split_queries") == 1
+    assert not ctx.metrics.get("compile_background_used")
+    o = _oracle(df)
+    assert out.column("k").to_pylist() == o.column("k").to_pylist()
+
+
+def test_compile_service_dedupes_and_reraises():
+    from spark_rapids_tpu.config import DEFAULT_CONF
+    from spark_rapids_tpu.runtime.compile_service import get_service
+    svc = get_service(DEFAULT_CONF)
+    t1 = svc.submit(("t", 1), lambda: 41 + 1)
+    t1b = svc.submit(("t", 1), lambda: 0)     # deduped: same task
+    assert t1 is t1b
+    assert t1.wait() == 42
+
+    def boom():
+        raise ValueError("injected")
+
+    t2 = svc.submit(("t", 2), boom)
+    with pytest.raises(ValueError, match="injected"):
+        t2.wait()
+    svc.take(("t", 1))
+    svc.take(("t", 2))
+
+
+# ---------------------------------------------------------------------------
+# scan-upload LRU (satellite)
+# ---------------------------------------------------------------------------
+
+def test_scan_upload_cache_byte_cap_evicts_lru():
+    from spark_rapids_tpu.exec import compiled as C
+    from spark_rapids_tpu.obs.registry import SCAN_UPLOAD_EVICTIONS
+    C._SCAN_UPLOAD_CACHE.clear()
+    # cap small enough for ~one table's upload (1000 f64 rows ~ 9KB+)
+    s = TpuSession({**ON,
+                    "spark.rapids.tpu.sql.scan.uploadCacheBytes":
+                    str(32 * 1024)})
+    before = SCAN_UPLOAD_EVICTIONS.value() or 0
+    tables = [pa.table({"v": np.arange(2000, dtype=np.float64) + i})
+              for i in range(4)]
+    for tbl in tables:
+        df = s.from_arrow(tbl).agg((Sum(col("v")), "sv"))
+        df.collect()
+    after = SCAN_UPLOAD_EVICTIONS.value() or 0
+    assert after > before
+    total = sum(e[2] for e in C._SCAN_UPLOAD_CACHE.values())
+    assert total <= 32 * 1024 or len(C._SCAN_UPLOAD_CACHE) == 1
+
+
+def test_prewarm_compiles_without_executing():
+    tbl = pa.table({"v": np.arange(500, dtype=np.float64)})
+    s = TpuSession(ON)
+    df = s.from_arrow(tbl).filter(col("v") > lit(9.0)) \
+        .agg((Sum(col("v")), "sv"))
+    q = df.physical()
+    assert q.prewarm() is True
+    ctx = ExecContext(s.conf)
+    out = q.collect(ctx)
+    # the collect found the program ready: no compile this collect
+    assert not ctx.metrics.get("compile_cache_misses")
+    assert _approx_eq(out, _oracle(df))
+
+
+# ---------------------------------------------------------------------------
+# CI: the compile-latency regression gate
+# ---------------------------------------------------------------------------
+
+def test_check_regression_gates_median_compile_ms(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_regression", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "check_regression.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def fixture(name, compile_ms, backend="cpu", device_ms=10.0):
+        path = tmp_path / name
+        path.write_text(json.dumps({
+            "backend": backend,
+            "tpch_suite_queries": {
+                f"q{i}": {"device_ms_net": device_ms,
+                          "compile_ms_cold": compile_ms}
+                for i in range(1, 6)}}))
+        return str(path)
+
+    base = fixture("base.json", 8000.0)
+    ok = fixture("ok.json", 9000.0)          # +12.5% < +50% threshold
+    slow = fixture("slow.json", 20000.0)     # 2.5x the baseline median
+    assert mod.main(["--current", ok, base]) == 0
+    rc = mod.main(["--current", slow, base])
+    assert rc == 1
+    # backend separation: an axon baseline never gates a cpu run
+    other = fixture("axon.json", 1000.0, backend="axon")
+    assert mod.main(["--current", slow, other]) == 0

@@ -657,11 +657,11 @@ def test_check_regression_gate(tmp_path, capsys):
     capsys.readouterr()
 
     # build the 2x fixture from the real trajectory's newest data
-    # (load_file -> (queries, backend); net-of-RTT ms since the gate
-    # compares floor-subtracted values)
+    # (load_file -> (queries, backend, compile_ms); net-of-RTT ms since
+    # the gate compares floor-subtracted values)
     files = mod.default_trajectory()
     per_file = [(p, *mod.load_file(p)) for p in files]
-    newest = [(qs, backend) for _, qs, backend in per_file if qs][-1]
+    newest = [(qs, backend) for _, qs, backend, _cms in per_file if qs][-1]
     assert newest[0], "no committed trajectory data to build the fixture"
     slow = {q: {"device_ms_net": ms * 2.0}
             for q, ms in newest[0].items()}
