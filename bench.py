@@ -449,6 +449,8 @@ def main():
     names = None
     suite_name = "tpch"
     compile_only = False
+    multichip = False
+    multichip_sf = 10.0
     args = list(sys.argv[1:])
     i = 0
     while i < len(args):
@@ -467,9 +469,25 @@ def main():
                 suite_name = args[i]
         elif a == "--compile-only":
             compile_only = True
+        elif a == "--multichip-suite":
+            multichip = True
+        elif a.startswith("--multichip-sf"):
+            if "=" in a:
+                multichip_sf = float(a.split("=", 1)[1])
+            else:
+                i += 1
+                multichip_sf = float(args[i])
         else:
             scale = float(a)
         i += 1
+    if multichip:
+        # 8-virtual-device mesh + sharded TPC-H at --multichip-sf: must
+        # run before any jax backend init (device-count config), so it
+        # owns the whole process — spark_rapids_tpu/multichip.py
+        from spark_rapids_tpu.multichip import run_multichip_suite
+        run_multichip_suite(sf=multichip_sf, queries=names,
+                            budget_s=TOTAL_BUDGET_S)
+        return
     if suite_name not in ("tpch", "tpcds"):
         raise SystemExit(f"unknown suite {suite_name!r} "
                          f"(expected tpch or tpcds)")

@@ -42,6 +42,7 @@ Usage:
     python scripts/check_regression.py --threshold 0.25 --min-ms 50
 """
 import argparse
+import ast
 import glob
 import json
 import os
@@ -59,6 +60,48 @@ _BACKEND_RE = re.compile(r'"backend":\s*"(\w+)"')
 #: harness ('axon' platform) — tag them so timings are only ever
 #: compared against runs on the SAME hardware
 _DEFAULT_BACKEND = "axon"
+
+#: multichip dry runs force the CPU backend (8 virtual devices) — files
+#: predating the "backend" field compare against cpu-backend rounds
+_MULTICHIP_BACKEND = "cpu"
+
+
+def extract_multichip(doc):
+    """-> ({'mc:<timing key>': ms}, backend or None) from a multichip
+    result: the fused-groupby / ragged / window / mesh-query seconds in
+    `multichip_timings_s` become gate-able millisecond entries under an
+    `mc:` prefix (never colliding with single-chip qN names).  Accepts
+    the suite runner's JSON line, the driver wrapper, and the legacy
+    dryrun tail (a python-repr dict — ast.literal_eval parses it)."""
+    if not isinstance(doc, dict):
+        return {}, None
+    tim = doc.get("multichip_timings_s")
+    if isinstance(tim, dict):
+        out = {f"mc:{k}": float(v) * 1e3 for k, v in tim.items()
+               if isinstance(v, (int, float))}
+        return out, str(doc.get("backend") or _MULTICHIP_BACKEND)
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        out, backend = extract_multichip(parsed)
+        if out:
+            return out, backend
+    tail = doc.get("tail")
+    if isinstance(tail, str) and "multichip_timings_s" in tail:
+        for line in reversed(tail.splitlines()):
+            if "multichip_timings_s" not in line:
+                continue
+            try:
+                rec = ast.literal_eval(line.strip())
+            except (ValueError, SyntaxError):
+                try:
+                    rec = json.loads(line.strip())
+                except json.JSONDecodeError:
+                    continue
+            if isinstance(rec, dict):
+                out, backend = extract_multichip(rec)
+                if out:
+                    return out, backend
+    return {}, None
 
 
 def _rec_ms(rec: dict, rtt_ms: float):
@@ -147,6 +190,14 @@ def load_file(path: str):
     with open(path) as f:
         doc = json.load(f)
     qs, backend = extract_queries(doc)
+    mc, mc_backend = extract_multichip(doc)
+    if mc:
+        # multichip timings gate alongside per-query device_ms under
+        # their mc: prefix; a pure-multichip file takes the multichip
+        # backend tag (cpu for pre-backend dryrun rounds)
+        qs = {**qs, **mc}
+        if not backend or backend == _DEFAULT_BACKEND:
+            backend = mc_backend
     return qs, backend, extract_compile_ms(doc)
 
 

@@ -328,6 +328,51 @@ SHUFFLE_COMPRESSION = conf(
     "TableCompressionCodec.scala:42), so readers are codec-agnostic.",
     checker=_enum_checker("ZSTD", "LZ4", "NONE"))
 
+EXCHANGE_COMPRESS = conf(
+    "spark.rapids.tpu.exchange.compress.enabled", True,
+    "Compress lanes on-device BEFORE the mesh all_to_all collective "
+    "(the nvcomp-before-UCX analog): validity/flag lanes pack to 1 bit "
+    "per row, integer lanes narrow to frame-of-reference uint8/16/32 "
+    "words when their global live range allows (the range rides the "
+    "exchange's own count fetch), and all narrow lanes fuse into one "
+    "wide byte-word collective per round.  The "
+    "tpu_exchange_wire_bytes_{pre,post}_compress metric families "
+    "report the achieved ratio.", commonly_used=True)
+
+EXCHANGE_QUOTA_AUTO = conf(
+    "spark.rapids.tpu.exchange.quota.auto", True,
+    "Derive each ragged-exchange round's slab quota from the exchanged "
+    "per-destination count matrix (pow2-quantized): a uniform exchange "
+    "finishes in one small round, and a hot destination widens the "
+    "quota (bounded by the receive-buffer commitment) instead of "
+    "forcing max_count/quota rounds on every chip.  false restores the "
+    "fixed 2*cap/P fudge quota.")
+
+EXCHANGE_QUOTA_ROWS = conf(
+    "spark.rapids.tpu.exchange.quota.rows", 0,
+    "Fixed per-round slab quota (rows per destination) for the ragged "
+    "exchange; 0 sizes it from capacity (2*cap/P, pow2-rounded). "
+    "Explicit values are pow2-rounded so compiled round variants stay "
+    "bounded.", checker=_non_negative)
+
+EXCHANGE_DONATE = conf(
+    "spark.rapids.tpu.exchange.donate", "AUTO",
+    "Donate the ragged exchange's receive buffers through each round "
+    "program (double-buffering: rounds update the buffers in place "
+    "instead of allocating and round-tripping fresh copies).  AUTO "
+    "enables it on backends with buffer donation (TPU); ON/OFF force.",
+    checker=_enum_checker("AUTO", "ON", "OFF"))
+
+EXCHANGE_SPLIT_RETRY = conf(
+    "spark.rapids.tpu.exchange.skew.splitRetry", True,
+    "Skew mitigation for the distributed groupby: when the planned "
+    "exchange would GROW a receive buffer (one hot hash partition), "
+    "salt rows across destination pairs, merge, and re-exchange the "
+    "(small) merged groups to their true owners — receive memory stays "
+    "bounded by actual groups instead of the hot key's row count. "
+    "Applies only when every merge kind is order-insensitive "
+    "(sum/min/max/any/every; first/last keep the direct path).")
+
 HOST_SPILL_LIMIT_BYTES = conf(
     "spark.rapids.tpu.memory.host.spillStorageSize", 8 << 30,
     "Host spill store byte limit before batches overflow to disk "

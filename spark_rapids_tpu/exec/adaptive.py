@@ -373,4 +373,8 @@ def plan_coalesced_reads(exchange, ctx: ExecContext,
     ctx.metrics["adaptive_coalesced_groups"] = len(groups)
     if skew_splits:
         ctx.metrics["adaptive_skew_split_partitions"] = skew_splits
+        # always-on plane: skew mitigation engaged (the reduce-side
+        # counterpart of the mesh exchange's exchange_skew_split)
+        ctx.tracer.instant("shuffle_skew_split", "shuffle",
+                           partitions=skew_splits)
     return groups

@@ -167,11 +167,17 @@ class ShuffleReadExec(PlanNode):
 
     def _upload(self, rbs: List[pa.RecordBatch], ctx) -> DeviceBatch:
         from ..runtime.retry import retry_io
-        tbl = pa.Table.from_batches(rbs).combine_chunks()
-        hb = HostBatch(tbl.to_batches()[0] if tbl.num_rows else
-                       pa.RecordBatch.from_pydict(
-                           {n: [] for n in tbl.schema.names},
-                           schema=tbl.schema))
+        if len(rbs) == 1 and rbs[0].num_rows:
+            # one payload (AQE-coalesced group, skew sub-read): upload
+            # it directly — the Table round trip below would copy every
+            # column through combine_chunks for nothing
+            hb = HostBatch(rbs[0])
+        else:
+            tbl = pa.Table.from_batches(rbs).combine_chunks()
+            hb = HostBatch(tbl.to_batches()[0] if tbl.num_rows else
+                           pa.RecordBatch.from_pydict(
+                               {n: [] for n in tbl.schema.names},
+                               schema=tbl.schema))
         ctx.bump("shuffle_rows_read", hb.num_rows)
         ctx.tracer.add_bytes("h2d_bytes", hb.rb.nbytes)
         with ctx.tracer.span("upload", "transition",

@@ -373,9 +373,30 @@ SHUFFLE_PARTITION_BYTES = REGISTRY.histogram(
 
 ICI_EXCHANGE_BYTES = REGISTRY.counter(
     "tpu_ici_exchange_bytes_total",
-    "Wire bytes each mesh device ships through ragged all_to_all "
-    "exchange rounds (masked slots transit too), per device index.",
-    ("device",))
+    "Total post-compression wire bytes the mesh ships through ragged "
+    "all_to_all exchange rounds and one-time dictionary gathers, summed "
+    "across devices (masked slots transit too) — emitted once per "
+    "exchange, off the per-device hot path.")
+
+EXCHANGE_WIRE_PRE = REGISTRY.counter(
+    "tpu_exchange_wire_bytes_pre_compress_total",
+    "Wire bytes the planned exchange rounds WOULD have shipped at the "
+    "logical lane widths (flags as int8, full-width integers), summed "
+    "across devices — the numerator baseline of the on-wire "
+    "compression ratio (spark.rapids.tpu.exchange.compress.enabled).")
+
+EXCHANGE_WIRE_POST = REGISTRY.counter(
+    "tpu_exchange_wire_bytes_post_compress_total",
+    "Wire bytes actually shipped after bit-packing flag lanes and "
+    "frame-of-reference narrowing integer lanes, summed across devices "
+    "— post/pre is the achieved on-wire compression ratio.")
+
+EXCHANGE_ROUNDS = REGISTRY.histogram(
+    "tpu_exchange_rounds",
+    "all_to_all rounds per ragged exchange call (log2 buckets): the "
+    "skew-aware quota scheduler's output — uniform exchanges land in "
+    "bucket 1, a hot destination no longer inflates everyone's round "
+    "count.")
 
 OPERATOR_ROWS = REGISTRY.counter(
     "tpu_operator_output_rows_total",

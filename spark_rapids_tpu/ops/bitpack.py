@@ -1,0 +1,128 @@
+"""On-device compression kernels for the exchange wire format.
+
+The reference's shuffle compresses partition payloads on-GPU (nvcomp)
+before they hit UCX; Theseus (PAPERS.md) argues the whole distributed
+tier wins or loses on exactly this.  TPU-native, the wire is an XLA
+collective, so the codecs must be jit-traceable tensor programs:
+
+  * bit packing     — bool/validity lanes ride 1 bit per row instead of
+                      the 1-byte `int8` lanes the exchange used to ship;
+  * frame-of-reference (FOR) width narrowing — an integer lane whose
+    global [min, max] span fits a narrower word ships as `value - min`
+    in uint8/16/32 (the cascaded-codec primitive nvcomp applies first);
+  * run-length encoding — sorted or low-cardinality lanes collapse into
+    (value, run_length) pairs at a static capacity.
+
+All kernels are static-shape (capacity in, capacity out) so they can
+live inside `shard_map` collective programs (parallel/exchange.py).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BIT_WEIGHTS = 1 << np.arange(8, dtype=np.uint8)
+
+
+def pack_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """Pack a bool array's LAST axis (length divisible by 8) into uint8
+    bytes: (..., N) bool -> (..., N // 8) uint8, bit b of byte i holding
+    row 8*i + b."""
+    n = x.shape[-1]
+    assert n % 8 == 0, f"pack_bits needs a multiple of 8 rows, got {n}"
+    g = x.reshape(x.shape[:-1] + (n // 8, 8)).astype(jnp.uint8)
+    return (g * jnp.asarray(_BIT_WEIGHTS)).sum(-1).astype(jnp.uint8)
+
+
+def unpack_bits(packed: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of `pack_bits`: (..., M) uint8 -> (..., 8 * M) bool."""
+    bits = (packed[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    return bits.reshape(packed.shape[:-1] + (packed.shape[-1] * 8,)) \
+        .astype(bool)
+
+
+def wire_dtype_for(lo: int, hi: int, logical: np.dtype) -> np.dtype:
+    """Narrowest unsigned frame-of-reference wire dtype for an integer
+    lane with global live range [lo, hi] — the host-side plan step (the
+    range rides the same fetch as the exchange's count matrix).  Returns
+    the LOGICAL dtype when narrowing does not save bytes (already
+    narrow, empty lane handled by caller passing lo > hi)."""
+    logical = np.dtype(logical)
+    if lo > hi:                    # no live rows: cheapest legal width
+        return np.dtype(np.uint8) if logical.itemsize > 1 else logical
+    span = int(hi) - int(lo)
+    for cand in (np.uint8, np.uint16, np.uint32):
+        c = np.dtype(cand)
+        if c.itemsize < logical.itemsize and span <= np.iinfo(c).max:
+            return c
+    return logical
+
+
+def for_encode(x: jnp.ndarray, bias, wire_dtype) -> jnp.ndarray:
+    """Frame-of-reference encode: `(x - bias)` cast to the planned wire
+    dtype.  Masked (dead) slots may wrap — receivers drop them."""
+    if np.dtype(wire_dtype) == np.dtype(x.dtype):
+        return x
+    return (x - bias).astype(wire_dtype)
+
+
+def for_decode(w: jnp.ndarray, bias, logical_dtype) -> jnp.ndarray:
+    """Inverse of `for_encode` back to the logical dtype."""
+    if np.dtype(w.dtype) == np.dtype(logical_dtype):
+        return w
+    return (w.astype(logical_dtype) + jnp.asarray(bias).astype(
+        logical_dtype))
+
+
+def bytes_to_words(x: jnp.ndarray) -> jnp.ndarray:
+    """View any fixed-width lane slab (..., Q) as wire bytes
+    (..., Q, itemsize) so heterogeneous lanes concatenate into ONE wide
+    word per slot — one collective dispatch instead of one per lane."""
+    if x.dtype == jnp.uint8:
+        return x[..., None]
+    return jax.lax.bitcast_convert_type(x, jnp.uint8)
+
+
+def words_to_lane(w: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Inverse of `bytes_to_words` for one lane's byte slice."""
+    dtype = np.dtype(dtype)
+    if dtype == np.dtype(np.uint8):
+        return w[..., 0]
+    return jax.lax.bitcast_convert_type(w, dtype)
+
+
+def _exclusive_cumsum(x):
+    return jnp.concatenate([jnp.zeros((1,), x.dtype), jnp.cumsum(x)[:-1]])
+
+
+def rle_encode(x: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run-length encode a lane at static capacity: returns
+    (run_values (C,), run_lengths (C,) int32, n_runs () int32) where
+    only the first `n_runs` entries are meaningful.  Sorted or
+    low-cardinality lanes (dictionary-run keys after the exchange's
+    dest-lexsort) collapse to n_runs << C."""
+    cap = x.shape[0]
+    b = jnp.concatenate([jnp.ones((1,), bool), x[1:] != x[:-1]])
+    run_id = jnp.cumsum(b.astype(jnp.int32)) - 1
+    n_runs = jnp.sum(b, dtype=jnp.int32)
+    lengths = jax.ops.segment_sum(jnp.ones_like(run_id), run_id,
+                                  num_segments=cap)
+    starts = jnp.sort(jnp.where(b, jnp.arange(cap, dtype=jnp.int32),
+                                jnp.int32(cap)))
+    values = x[jnp.clip(starts, 0, cap - 1)]
+    return values, lengths.astype(jnp.int32), n_runs
+
+
+def rle_decode(values: jnp.ndarray, lengths: jnp.ndarray,
+               cap: int) -> jnp.ndarray:
+    """Expand (run_values, run_lengths) back to a (cap,) lane.  Rows
+    past the encoded total replicate the final run's value (callers
+    carry a live mask, same convention as every exchange lane)."""
+    starts = _exclusive_cumsum(lengths)
+    idx = jnp.searchsorted(starts, jnp.arange(cap, dtype=lengths.dtype),
+                           side="right") - 1
+    return values[jnp.clip(idx, 0, values.shape[0] - 1)]

@@ -128,21 +128,28 @@ class ShuffleManager:
         retry ladder (runtime/retry.py retry_io) can replay the whole
         call without duplicating partitions."""
         rb = hb.rb
-        order = np.argsort(part_ids, kind="stable")
-        sorted_ids = part_ids[order]
-        bounds = np.searchsorted(sorted_ids, np.arange(num_partitions + 1))
-        idx_arr = pa.array(order)
+        if len(part_ids) and part_ids.min() == part_ids.max():
+            # single-destination batch (small dim table under hash
+            # partitioning, a range boundary case): no row movement
+            # needed — serialize the batch whole, skip the sort + take
+            out = {int(part_ids[0]): serialize_batch(rb, codec)}
+        else:
+            order = np.argsort(part_ids, kind="stable")
+            sorted_ids = part_ids[order]
+            bounds = np.searchsorted(sorted_ids,
+                                     np.arange(num_partitions + 1))
+            idx_arr = pa.array(order)
 
-        def ser(p: int):
-            s, e = bounds[p], bounds[p + 1]
-            if s == e:
-                return None
-            sl = rb.take(idx_arr.slice(s, e - s))
-            return serialize_batch(sl, codec)
+            def ser(p: int):
+                s, e = bounds[p], bounds[p + 1]
+                if s == e:
+                    return None
+                sl = rb.take(idx_arr.slice(s, e - s))
+                return serialize_batch(sl, codec)
 
-        payloads = list(self.pool.map(ser, range(num_partitions)))
-        out = {p: payload for p, payload in enumerate(payloads)
-               if payload is not None}
+            payloads = list(self.pool.map(ser, range(num_partitions)))
+            out = {p: payload for p, payload in enumerate(payloads)
+                   if payload is not None}
         self.store.put_all(shuffle_id, out)
         total = sum(len(p) for p in out.values())
         # always-on telemetry: per-partition byte-SKEW distribution (one

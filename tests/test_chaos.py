@@ -269,6 +269,83 @@ def test_exchange_fault_site(eight_devices):
     assert [r["site"] for r in inj.log] == ["exchange"]
 
 
+def _ragged_fixture(eight_devices):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from spark_rapids_tpu.parallel.exchange import RaggedExchange
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(8)
+    cap, n = 64, 8 * 64
+    rng = np.random.default_rng(51)
+    vals = rng.integers(0, 3000, n).astype(np.int64)
+    flag = rng.random(n) < 0.5
+    live = rng.random(n) < 0.9
+    dest = rng.integers(0, 8, n).astype(np.int32)
+    shard = NamedSharding(mesh, P(mesh.axis_names[0]))
+    put = lambda a: jax.device_put(jnp.asarray(a), shard)  # noqa: E731
+    ex = RaggedExchange(mesh, nlanes=2, cap=cap, kinds=["raw", "flag"])
+    args = ([put(vals), put(flag)], put(live), put(dest))
+    exp = sorted(zip(vals[live].tolist(), flag[live].tolist()))
+    return ex, args, exp
+
+
+def _ragged_rows(out):
+    (rv, rf), rlive, _ = out
+    rl = np.asarray(rlive)
+    return sorted(zip(np.asarray(rv)[rl].tolist(),
+                      np.asarray(rf)[rl].tolist()))
+
+
+def test_exchange_fault_site_ragged_compressed(eight_devices):
+    """The exchange site fires on the COMPRESSED ragged path (bitpacked
+    flag lane + FOR-narrowed value lane) and the replay recovers
+    bit-identically."""
+    ex, args, exp = _ragged_fixture(eight_devices)
+    inj = FaultInjector("exchange:error:nth=1")
+    set_active(inj)
+    try:
+        with pytest.raises(InjectedQueryError):
+            ex(*args)
+        assert _ragged_rows(ex(*args)) == exp    # one-shot, bit-identical
+    finally:
+        set_active(NULL_INJECTOR)
+    assert [r["site"] for r in inj.log] == ["exchange"]
+    assert ex.last_stats["wire_post"] < ex.last_stats["wire_pre"]
+
+
+def test_exchange_fatal_dump_embeds_round_state(eight_devices, tmp_path):
+    """A fatal on the exchange fabric: the crash dump's flight-recorder
+    tail carries the per-round `exchange_round` instants, so the
+    post-mortem shows exactly which round of which schedule died."""
+    from spark_rapids_tpu.runtime.failure import crash_capture
+    ex, args, exp = _ragged_fixture(eight_devices)
+    clean = _ragged_rows(ex(*args))              # also warms programs
+    assert clean == exp
+    conf = TpuConf({"spark.rapids.tpu.coredump.path": str(tmp_path)})
+    # nth=2: hit #1 is the plan-time site check, hit #2 fires INSIDE
+    # the round loop — after round 0's state instant hit the recorder
+    inj = FaultInjector("exchange:fatal:nth=2")
+    set_active(inj)
+    try:
+        with pytest.raises(FatalDeviceError) as ei:
+            with crash_capture(conf):
+                ex(*args)                        # dies mid-round 0
+    finally:
+        set_active(NULL_INJECTOR)
+    dump = json.load(open(ei.value.dump_path))
+    rec = dump["injected_faults"]
+    assert rec and rec[0]["site"] == "exchange" and \
+        rec[0]["kind"] == "fatal" and rec[0].get("round") == "0"
+    rounds = [e for e in dump["flight_recorder"]
+              if e.get("name") == "exchange_round"]
+    assert rounds, "dump carries no exchange round state"
+    attrs = rounds[-1]["attrs"]
+    assert {"r", "rounds", "quota", "recv_cap"} <= set(attrs)
+    # recovery after the one-shot fatal: same bits as the clean run
+    assert _ragged_rows(ex(*args)) == exp
+
+
 # ---------------------------------------------------------------------------
 # fatal / corruption classes: clean classified failure + dump record
 # ---------------------------------------------------------------------------

@@ -453,16 +453,18 @@ def test_shuffle_partition_skew_histogram_matches_independent():
     assert len(expected) > 1
 
 
-def test_ici_exchange_publishes_per_device_bytes(eight_devices):
+def test_ici_exchange_publishes_wire_bytes_once(eight_devices):
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from spark_rapids_tpu.obs.registry import ICI_EXCHANGE_BYTES
+    from spark_rapids_tpu.obs.registry import (EXCHANGE_WIRE_POST,
+                                               EXCHANGE_WIRE_PRE,
+                                               ICI_EXCHANGE_BYTES)
     from spark_rapids_tpu.parallel.exchange import RaggedExchange
     from spark_rapids_tpu.parallel.mesh import make_mesh
     mesh = make_mesh(8)
-    dev_ids = [str(d.id) for d in mesh.devices.flatten()]
-    before = {d: ICI_EXCHANGE_BYTES.value(device=d) for d in dev_ids}
+    before = ICI_EXCHANGE_BYTES.value()
+    pre0, post0 = EXCHANGE_WIRE_PRE.value(), EXCHANGE_WIRE_POST.value()
 
     cap, n = 64, 8 * 64
     shard = NamedSharding(mesh, P(mesh.axis_names[0]))
@@ -472,12 +474,14 @@ def test_ici_exchange_publishes_per_device_bytes(eight_devices):
     dest = jax.device_put(jnp.zeros(n, jnp.int32), shard)
     ex([dk], dl, dest)
 
-    deltas = {d: ICI_EXCHANGE_BYTES.value(device=d) - before[d]
-              for d in dev_ids}
-    # every chip ships the same slab volume per round (masked slots
-    # transit too): all 8 devices advance, by the same amount
-    assert all(v > 0 for v in deltas.values()), deltas
-    assert len(set(deltas.values())) == 1, deltas
+    # ONE emit per exchange, totalled across the mesh (no per-device
+    # python loop on the hot path): the counter advances by exactly the
+    # post-compress wire volume the exchange reports
+    delta = ICI_EXCHANGE_BYTES.value() - before
+    assert delta == ex.last_stats["wire_post"] > 0
+    assert EXCHANGE_WIRE_POST.value() - post0 == delta
+    pre_delta = EXCHANGE_WIRE_PRE.value() - pre0
+    assert pre_delta == ex.last_stats["wire_pre"] >= delta
 
 
 # ---------------------------------------------------------------------------
@@ -676,3 +680,38 @@ def test_check_regression_gate(tmp_path, capsys):
     # an unreadable --current is usage error 2, not a crash
     missing = tmp_path / "nope.json"
     assert mod.main(["--current", str(missing)]) == 2
+
+
+def test_check_regression_gates_multichip_timings(tmp_path, capsys):
+    """MULTICHIP rounds gate like per-query device_ms: timings mine out
+    of the legacy dryrun tail (a python-repr dict), land under the mc:
+    prefix, and a slowed fused-groupby fails the gate — on the same
+    backend only."""
+    mod = _load_script("check_regression")
+    base = tmp_path / "MULTICHIP_a.json"
+    base.write_text(json.dumps({"n_devices": 8, "tail":
+        "{'multichip_timings_s': {'groupby_8_rows_per_device': 10.0, "
+        "'mesh_query_q1': 1.0}, 'peak_rss_mb': 1}\n"}))
+    qs, backend, _ = mod.load_file(str(base))
+    assert qs == {"mc:groupby_8_rows_per_device": 10000.0,
+                  "mc:mesh_query_q1": 1000.0}
+    assert backend == "cpu"              # dryrun rounds force cpu
+
+    cur = tmp_path / "MULTICHIP_b.json"  # the suite runner's shape
+    cur.write_text(json.dumps(
+        {"multichip_timings_s": {"groupby_8_rows_per_device": 30.0,
+                                 "mesh_query_q1": 0.9},
+         "backend": "cpu"}))
+    rc = mod.main(["--current", str(cur), str(base)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSION mc:groupby_8_rows_per_device" in out
+    assert "improved   mc:mesh_query_q1" in out
+
+    # a different backend never gates against this baseline
+    cur2 = tmp_path / "MULTICHIP_c.json"
+    cur2.write_text(json.dumps(
+        {"multichip_timings_s": {"groupby_8_rows_per_device": 30.0},
+         "backend": "tpu"}))
+    assert mod.main(["--current", str(cur2), str(base)]) == 0
+    capsys.readouterr()

@@ -1,0 +1,42 @@
+"""The multichip suite runner (bench.py --multichip-suite): sharded
+datagen key spaces in tier-1; a toy end-to-end suite run marked slow.
+"""
+import numpy as np
+import pytest
+
+
+def test_gen_tables_sharded_coherent_key_spaces():
+    from spark_rapids_tpu import tpch
+    from spark_rapids_tpu.multichip import gen_tables_sharded
+    t = gen_tables_sharded(0.008, 4)
+    ok = t["orders"]["o_orderkey"].to_numpy()
+    assert len(set(ok.tolist())) == len(ok)       # globally unique
+    lo = t["lineitem"]["l_orderkey"].to_numpy()
+    assert set(lo.tolist()) <= set(ok.tolist())   # fk integrity holds
+    # shard s owns the contiguous order-key range [s*N, (s+1)*N)
+    per = tpch.gen_tables(scale=0.002)
+    n_ord_s = per["orders"].num_rows
+    assert ok.max() == 4 * n_ord_s - 1
+    # fact volume is the SUM of the shard chunks; dims stay shard-scale
+    assert t["lineitem"].num_rows == 4 * per["lineitem"].num_rows
+    assert t["customer"].num_rows == per["customer"].num_rows
+    # every fact fk resolves against the shard-scale dimensions
+    assert t["lineitem"]["l_partkey"].to_numpy().max() < \
+        t["part"].num_rows
+    assert t["orders"]["o_custkey"].to_numpy().max() < \
+        t["customer"].num_rows
+
+
+@pytest.mark.slow
+def test_multichip_suite_end_to_end_toy(eight_devices, capsys):
+    from spark_rapids_tpu.multichip import run_multichip_suite
+    doc = run_multichip_suite(sf=0.01, queries=["q1", "q6"],
+                              budget_s=600, micro_scale=0.005,
+                              oracle_budget_s=30)
+    tim = doc["multichip_timings_s"]
+    assert any(k.startswith("groupby_") for k in tim)
+    assert {"mesh_query_q1", "mesh_query_q6", "mesh_query_q12"} <= \
+        set(tim)
+    assert doc["multichip_suite_queries"]["q6"]["match"] is True
+    assert doc["exchange"]["post"] <= doc["exchange"]["pre"]
+    assert doc["final"] is True

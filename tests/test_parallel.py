@@ -12,28 +12,39 @@ import pytest
 
 from spark_rapids_tpu import types as t
 from spark_rapids_tpu.ops import groupby as G
-from spark_rapids_tpu.parallel.exchange import (bucketize,
+from spark_rapids_tpu.parallel.exchange import (RaggedExchange,
                                                 distributed_groupby_step,
                                                 partition_ids)
 from spark_rapids_tpu.parallel.mesh import make_mesh
 
 
-def test_bucketize_roundtrip():
+def test_rank_prepare_describes_dest_segments(eight_devices):
+    """The per-destination ranks that replaced the (P, C) bucket stack
+    (P full stable argsorts): per shard, each live row holds a unique
+    rank within its destination segment and the exchanged counts match
+    the segment sizes exactly — the slab layout without any sort."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = make_mesh(8)
+    cap, nparts = 64, 8
+    n = nparts * cap
     rng = np.random.default_rng(7)
-    cap, nparts = 64, 4
-    keys = rng.integers(0, 100, cap)
-    valid = rng.random(cap) < 0.9
-    dest = partition_ids(jnp.asarray(keys), jnp.asarray(valid), nparts)
-    (b_keys, b_dest), bvalid = bucketize(
-        [jnp.asarray(keys), dest], jnp.asarray(valid), dest, nparts)
-    b_keys, b_dest, bvalid = map(np.asarray, (b_keys, b_dest, bvalid))
-    seen = []
-    for p in range(nparts):
-        rows = b_keys[p][bvalid[p]]
-        assert (b_dest[p][bvalid[p]] == p).all()
-        seen.extend(rows.tolist())
-    want = sorted(keys[valid].tolist())
-    assert sorted(seen) == want
+    keys = rng.integers(0, 100, n).astype(np.int64)
+    valid = rng.random(n) < 0.9
+    shard = NamedSharding(mesh, P(mesh.axis_names[0]))
+    dk = jax.device_put(jnp.asarray(keys), shard)
+    dl = jax.device_put(jnp.asarray(valid), shard)
+    dest = jax.jit(lambda k, lv: partition_ids(k, lv, nparts))(dk, dl)
+    ex = RaggedExchange(mesh, nlanes=1, cap=cap)
+    st = ex.plan_call([dk], dl, dest)
+    rank = np.asarray(st.rank).reshape(nparts, cap)
+    counts = np.asarray(st.counts_dev).reshape(nparts, nparts)
+    dn, vn = (np.asarray(dest).reshape(nparts, cap),
+              valid.reshape(nparts, cap))
+    for s in range(nparts):
+        for p in range(nparts):
+            rows = rank[s][vn[s] & (dn[s] == p)]
+            assert sorted(rows.tolist()) == list(range(counts[s][p]))
+    assert st.max_cnt == int(counts.max())
 
 
 def test_distributed_groupby_matches_numpy(eight_devices):
