@@ -124,12 +124,16 @@ def query_profile(q, conf) -> dict:
     """One traced (untimed) collect -> the compact QueryProfile summary
     embedded per query, so BENCH_*.json explains its own numbers: the
     compile/execute/transition/shuffle split, top operators by self
-    time, data-movement bytes and memory high-water.  Runs AFTER the
+    time, PER-SEGMENT measured device ms (profile.segments forced on
+    for this collect — the attribution check_regression/profile_diff
+    cite), data-movement bytes and memory high-water.  Runs AFTER the
     warm timing so span collection can't perturb the headline number."""
-    from spark_rapids_tpu.config import TRACE_ENABLED, TpuConf
+    from spark_rapids_tpu.config import (PROFILE_SEGMENTS, TRACE_ENABLED,
+                                         TpuConf)
     from spark_rapids_tpu.exec.plan import ExecContext
     from spark_rapids_tpu.obs.profile import QueryProfile
-    pctx = ExecContext(TpuConf({**conf._raw, TRACE_ENABLED.key: "true"}))
+    pctx = ExecContext(TpuConf({**conf._raw, TRACE_ENABLED.key: "true",
+                                PROFILE_SEGMENTS.key: "true"}))
     q.collect(pctx)
     return QueryProfile.from_context(pctx).summary()
 

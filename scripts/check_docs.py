@@ -46,6 +46,15 @@ def missing_metric_docs() -> list:
     return [n for n in REGISTRY.family_names() if f"`{n}`" not in doc]
 
 
+def missing_attribution() -> list:
+    """Registered exec node classes in neither the attribution plane's
+    covered set nor its explicit exemption list (obs/attribution.py).
+    A new operator must be added to one of them DELIBERATELY, so plan
+    time can never silently fall outside EXPLAIN ANALYZE."""
+    from spark_rapids_tpu.obs.attribution import attribution_coverage_gaps
+    return attribution_coverage_gaps()
+
+
 def main() -> int:
     rc = 0
     missing = missing_keys()
@@ -66,6 +75,17 @@ def main() -> int:
         rc = 1
     else:
         print("docs/METRICS.md covers every registered metric family")
+    missing_a = missing_attribution()
+    if missing_a:
+        print("attribution coverage gaps: exec classes in neither "
+              "ATTRIBUTION_COVERED nor ATTRIBUTION_EXEMPT "
+              "(obs/attribution.py):")
+        for n in missing_a:
+            print(f"  {n}")
+        rc = 1
+    else:
+        print("every registered exec class is attribution-covered or "
+              "explicitly exempted")
     return rc
 
 

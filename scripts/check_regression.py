@@ -139,6 +139,33 @@ def extract_compile_ms(doc) -> list:
     return out
 
 
+def extract_segments(doc) -> dict:
+    """-> {query: {segment node: device_ms}} from the per-query profile
+    summaries bench embeds (profile.segments runs, PR 9) — {} for
+    records predating the attribution plane.  When the gate fails a
+    query, the worst-regressed SEGMENT is cited from these."""
+    out = {}
+    if not isinstance(doc, dict):
+        return out
+    for key, val in doc.items():
+        if key.endswith("_suite_queries") and isinstance(val, dict):
+            for q, rec in val.items():
+                prof = rec.get("profile") if isinstance(rec, dict) \
+                    else None
+                segs = (prof or {}).get("segments") \
+                    if isinstance(prof, dict) else None
+                if segs:
+                    out[q] = {s["node"]: float(s.get("device_ms", 0.0))
+                              for s in segs
+                              if isinstance(s, dict) and "node" in s}
+    if out:
+        return out
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        return extract_segments(parsed)
+    return out
+
+
 def extract_queries(doc):
     """-> (query name -> net device_ms, backend tag) from any accepted
     result shape; ({}, backend) when the document carries no per-query
@@ -199,6 +226,36 @@ def load_file(path: str):
         if not backend or backend == _DEFAULT_BACKEND:
             backend = mc_backend
     return qs, backend, extract_compile_ms(doc)
+
+
+def load_segments(path: str) -> dict:
+    """{query: {segment: device_ms}} of one trajectory file ({} on any
+    read problem — segment citation is best-effort color, never a gate
+    failure of its own)."""
+    try:
+        with open(path) as f:
+            return extract_segments(json.load(f))
+    except (OSError, json.JSONDecodeError, ValueError):
+        return {}
+
+
+def worst_segment_line(q: str, cur_segs: dict, base_segs: dict):
+    """The segment-level citation for one regressed query: the segment
+    with the largest device_ms growth vs baseline (or the dominant
+    current segment when the baseline has no segment data)."""
+    cur = cur_segs.get(q) or {}
+    if not cur:
+        return None
+    base = base_segs.get(q) or {}
+    shared = set(cur) & set(base)
+    if shared:
+        node = max(shared, key=lambda n: cur[n] - base[n])
+        return (f"    worst segment: {node} "
+                f"{base[node]:.1f} -> {cur[node]:.1f} ms "
+                f"(+{cur[node] - base[node]:.1f})")
+    node = max(cur, key=cur.get)
+    return (f"    dominant segment: {node} {cur[node]:.1f} ms "
+            f"(no baseline segment data)")
 
 
 def _median(vals: list):
@@ -313,7 +370,22 @@ def main(argv=None) -> int:
         for q, v in per_file[p].items():
             baseline[q] = min(baseline.get(q, v), v)
 
+    # segment-level attribution (best-effort): when a query regresses,
+    # cite the worst-regressed SEGMENT from the embedded profiles
+    cur_segs = load_segments(current_name) \
+        if os.path.exists(current_name) else {}
+    base_segs = {}
+    for p in baseline_files:
+        for q, per in load_segments(p).items():
+            tgt = base_segs.setdefault(q, {})
+            for n, v in per.items():
+                tgt[n] = min(tgt.get(n, v), v)
+
     res = compare(current, baseline, args.threshold, args.min_ms)
+    for row in res["regressions"]:
+        cite = worst_segment_line(row["query"], cur_segs, base_segs)
+        if cite:
+            row["worst_segment"] = cite.strip()
     if args.json:
         print(json.dumps({"current": current_name,
                           "baseline_files": baseline_files,
@@ -327,6 +399,8 @@ def main(argv=None) -> int:
             print(f"  REGRESSION {row['query']}: {row['device_ms']:.1f} ms"
                   f" vs {row['baseline_ms']:.1f} ms "
                   f"(x{row['ratio']:.2f})")
+            if row.get("worst_segment"):
+                print(f"    {row['worst_segment']}")
         for row in res["improved"]:
             print(f"  improved   {row['query']}: {row['device_ms']:.1f} ms"
                   f" vs {row['baseline_ms']:.1f} ms "

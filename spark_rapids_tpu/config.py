@@ -429,6 +429,29 @@ PROFILE_PATH = conf(
     "this directory (the NVTX/CUPTI Profiler analogue; open in "
     "XProf/perfetto).")
 
+PROFILE_SEGMENTS = conf(
+    "spark.rapids.tpu.profile.segments", False,
+    "Per-segment DEVICE-TIME attribution (exec/compiled.py): every "
+    "compiled program execution blocks until its outputs are ready and "
+    "records measured device wall, output rows and bytes against the "
+    "segment's stable plan-node-id range — tracer `segment` spans, the "
+    "tpu_segment_* registry families, and the explain_analyze() plan "
+    "annotations all read it.  Whole-plan programs additionally "
+    "RE-SPLIT at the seam boundaries the split compiler already knows "
+    "(ignoring compile.seamSplitMinRows) so join subtrees and "
+    "aggregates time separately.  Off by default: the disabled path is "
+    "one conf check per program dispatch (no sync, no re-split).",
+    commonly_used=True)
+
+PROFILE_COST_ANALYSIS = conf(
+    "spark.rapids.tpu.profile.costAnalysis", True,
+    "Capture XLA cost_analysis()/memory_analysis() (FLOPs, bytes "
+    "accessed, peak temp allocation) per compiled segment at COMPILE "
+    "time and surface it next to measured device time "
+    "(explain_analyze(), segment.* metrics) so predicted-vs-actual "
+    "skew flags mis-fused segments.  Compile-time only: zero cost on "
+    "the execute path.")
+
 TRACE_ENABLED = conf(
     "spark.rapids.tpu.trace.enabled", False,
     "Collect query-lifecycle spans in memory (plan/compile/execute/"

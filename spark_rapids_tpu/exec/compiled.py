@@ -405,9 +405,10 @@ def _anchors_match(anchors, root: PlanNode, pairs) -> bool:
                for a, c in zip(anchors, cur))
 
 
-#: canonical plan key -> (compiled executable, out_specs, host metrics,
-#: anchors).  Name ends in _CACHE so testing.clear_compiled_caches()
-#: releases the pinned executables with everything else.
+#: canonical plan key -> (compiled executable, out_specs, out layout,
+#: host metrics, static cost, anchors).  Name ends in _CACHE so
+#: testing.clear_compiled_caches() releases the pinned executables with
+#: everything else.
 _PLAN_EXEC_CACHE: Dict[tuple, tuple] = {}
 _PLAN_EXEC_LOCK = threading.Lock()
 
@@ -422,6 +423,37 @@ def _plan_cache_get(key, root, pairs):
     if not _anchors_match(entry[-1], root, pairs):
         return None
     return entry
+
+
+def _compiled_cost(compiled) -> Dict[str, float]:
+    """Static XLA cost surface of one compiled executable: FLOPs and
+    bytes accessed from `cost_analysis()`, peak temp / output /
+    argument bytes from `memory_analysis()`.  Best-effort — backends
+    and jax versions that expose neither yield {} rather than failing
+    the compile path."""
+    out: Dict[str, float] = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            if ca.get("flops"):
+                out["flops"] = float(ca["flops"])
+            if ca.get("bytes accessed"):
+                out["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:                    # noqa: BLE001
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for attr, name in (("temp_size_in_bytes", "peak_temp_bytes"),
+                           ("output_size_in_bytes", "output_bytes"),
+                           ("argument_size_in_bytes", "argument_bytes")):
+            v = getattr(ma, attr, None)
+            if v:
+                out[name] = float(v)
+    except Exception:                    # noqa: BLE001
+        pass
+    return out
 
 
 def _plan_cache_put(key, entry: tuple, conf: TpuConf) -> None:
@@ -454,6 +486,9 @@ class CompiledPlan:
         self._input_specs = None
         self._out_layout = None        # [(shape, dtype str)] of flat outputs
         self._host_metrics: Dict[str, object] = {}
+        #: static XLA cost surface (flops / bytes accessed / peak temp)
+        #: captured at compile time for the attribution plane
+        self._cost: Dict[str, float] = {}
         # background speculative compiles trace over PLACEHOLDER batches
         # (id(leaf) -> batches of ShapeDtypeStruct lanes) without touching
         # the shared plan tree; cleared after compile so execution reads
@@ -619,7 +654,7 @@ class CompiledPlan:
         if entry is None:
             return False
         (self._compiled, self._out_specs, self._out_layout,
-         self._host_metrics, _anchors) = entry
+         self._host_metrics, self._cost, _anchors) = entry
         self._input_specs = [(n, list(s)) for n, s in in_specs]
         ctx.metrics.update(self._host_metrics)
         ctx.bump("compile_cache_hits")
@@ -661,6 +696,9 @@ class CompiledPlan:
         self._out_layout = out_holder["layout"]
         self._host_metrics = out_holder.get("host_metrics", {})
         self._compiled = compiled
+        from ..config import PROFILE_COST_ANALYSIS
+        self._cost = _compiled_cost(compiled) \
+            if self.conf.get(PROFILE_COST_ANALYSIS) else {}
         self._fresh = True
         # placeholder leaves only exist to shape the lowering; execution
         # must read the real leaf state installed by the caller
@@ -675,7 +713,7 @@ class CompiledPlan:
                 _plan_cache_put(self._cache_key,
                                 (compiled, self._out_specs,
                                  self._out_layout, self._host_metrics,
-                                 anchors), self.conf)
+                                 self._cost, anchors), self.conf)
 
     def ensure_compiled(self, ctx: ExecContext) -> None:
         """Compile (or adopt a cached executable) without executing —
@@ -692,7 +730,15 @@ class CompiledPlan:
         """Run the whole plan as one XLA program; returns device batches.
 
         Raises jax tracer errors (ConcretizationTypeError & friends) when
-        the plan needs host decisions — callers fall back to eager."""
+        the plan needs host decisions — callers fall back to eager.
+
+        With `spark.rapids.tpu.profile.segments` on, the dispatch blocks
+        until the outputs are ready and the measured device wall is
+        attributed to this program's plan-node-id range (the
+        attribution plane — tracer `segment` span, tpu_segment_*
+        registry families, segment.* query metrics)."""
+        import time as _time
+        from ..config import PROFILE_SEGMENTS
         pairs = self._leaf_batches(ctx)
         flat_in, in_specs = self._flatten_inputs(pairs)
 
@@ -703,6 +749,8 @@ class CompiledPlan:
             ctx.bump("compile_cache_hits")
         self._fresh = False
 
+        prof = bool(ctx.conf.get(PROFILE_SEGMENTS))
+        t0 = _time.perf_counter()
         with ctx.tracer.span("execute", "execute",
                              root=self.root.name()):
             try:
@@ -714,13 +762,64 @@ class CompiledPlan:
                 self._cache_key = None
                 self.aot_compile(ctx, flat_in, in_specs, pairs)
                 flat_res = self._compiled(flat_in)
+            if prof:
+                # the sync that turns dispatch wall into DEVICE wall;
+                # profiling-only — the default path stays async
+                jax.block_until_ready(flat_res)
+        t1 = _time.perf_counter()
 
         outs = []
         i = 0
         for spec in self._out_specs:
             db, i = _rebuild_batch(flat_res, spec, i)
             outs.append(db)
+        if prof:
+            self._record_segment(ctx, t0, t1, outs)
         return outs
+
+    def _record_segment(self, ctx: ExecContext, t0: float, t1: float,
+                        outs: List[DeviceBatch]) -> None:
+        """Attribute one measured program execution to its plan segment:
+        the root node id + the preorder node-id range the program covers
+        in the CURRENT tree (split-seam leaves excluded), output rows
+        and bytes, and the compile-time static cost overlay."""
+        from ..obs.registry import SEGMENT_DEVICE_MS, SEGMENT_ROWS
+        from .metrics import node_id_range
+        dev_ms = (t1 - t0) * 1e3
+        nid = getattr(self.root, "_node_id", None)
+        lo, hi = node_id_range(self.root)
+        rows = 0
+        out_bytes = 0
+        for db in outs:
+            try:
+                rows += int(db.num_rows)     # already synced: prof path
+            except Exception:                # noqa: BLE001
+                pass
+            try:
+                out_bytes += int(db.nbytes())
+            except Exception:                # noqa: BLE001
+                pass
+        cls = type(self.root).__name__
+        SEGMENT_DEVICE_MS.observe(dev_ms, segment=cls)
+        if rows:
+            SEGMENT_ROWS.inc(rows, segment=cls)
+        key = nid or cls
+        m = ctx.metrics
+        for field, v in (("device_ms", dev_ms), ("rows", rows),
+                         ("out_bytes", out_bytes), ("executions", 1)):
+            mk = f"segment.{key}.{field}"
+            m[mk] = m.get(mk, 0) + v
+        attrs = {"device_ms": round(dev_ms, 3), "rows": rows,
+                 "out_bytes": out_bytes}
+        if lo is not None:
+            attrs["node_lo"], attrs["node_hi"] = lo, hi
+        for k in ("flops", "bytes_accessed", "peak_temp_bytes"):
+            v = (self._cost or {}).get(k)
+            if v:
+                m[f"segment.{key}.{k}"] = v
+                attrs[k] = v
+        ctx.tracer.add_span("segment", "execute", t0, t1, node=nid,
+                            **attrs)
 
     def collect(self, ctx: ExecContext) -> pa.Table:
         from ..columnar.device import fetch_result_batch
@@ -839,11 +938,17 @@ def _find_split_seams(root: PlanNode, conf=None) -> List[PlanNode]:
     # extra program dispatch; with sub-capacity inputs the padding the
     # seam would trim is worth less than the round trips (q11: 75 ms of
     # device work behind ~450 ms of seam/dispatch latency), so only
-    # split when the subtree actually carries big buckets
-    from ..config import DEFAULT_CONF, SEAM_SPLIT_MIN_ROWS
-    min_rows = (conf or DEFAULT_CONF).get(SEAM_SPLIT_MIN_ROWS)
-    if _max_leaf_capacity(agg, conf) < min_rows:
-        return []
+    # split when the subtree actually carries big buckets.  Profiling
+    # (`profile.segments`) overrides the floor: the attribution plane
+    # wants the SAME seam boundaries the split compiler knows at every
+    # scale, so whole-plan programs re-split at profile time and join
+    # subtrees / aggregates time as separate segments.
+    from ..config import DEFAULT_CONF, PROFILE_SEGMENTS, SEAM_SPLIT_MIN_ROWS
+    c = conf or DEFAULT_CONF
+    if not c.get(PROFILE_SEGMENTS):
+        min_rows = c.get(SEAM_SPLIT_MIN_ROWS)
+        if _max_leaf_capacity(agg, conf) < min_rows:
+            return []
     seams: List[PlanNode] = []
     source = agg.child
     while isinstance(source, FilterExec):

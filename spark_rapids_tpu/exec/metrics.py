@@ -50,9 +50,33 @@ def assign_node_ids(root) -> None:
         n = stack.pop()
         if getattr(n, "_node_id", None) is None:
             n._node_id = f"{type(n).__name__}#{i}"
+            n._node_preorder = i
             i += 1
         # preorder: children pushed reversed so left-most pops first
         stack.extend(reversed(list(_child_nodes(n))))
+
+
+def node_id_range(root):
+    """(lo, hi) preorder-index range of the nodes reachable under `root`
+    in the CURRENT tree — the segment's plan-addressable span.  Nodes
+    without an assigned preorder (split-seam leaves swapped in after
+    id assignment) are skipped, so a split segment's range covers
+    exactly the original plan nodes its program traces.  (None, None)
+    when nothing under root carries an id."""
+    lo = hi = None
+    stack = [root]
+    seen = set()
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        i = getattr(n, "_node_preorder", None)
+        if i is not None:
+            lo = i if lo is None else min(lo, i)
+            hi = i if hi is None else max(hi, i)
+        stack.extend(_child_nodes(n))
+    return lo, hi
 
 
 def plan_node_table(root) -> list:

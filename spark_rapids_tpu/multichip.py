@@ -91,7 +91,37 @@ def _timed(timings: dict, name: str):
     return _T()
 
 
-def _primitives(mesh, timings: dict, scale: float = 1.0) -> None:
+class _mesh_traced:
+    """Collect the mesh exchange timeline of one measured block: a
+    QueryTracer is made ACTIVE for the block so every ragged-exchange
+    round / dictionary gather / skew split lands in it, and the parsed
+    timeline (QueryProfile.mesh_timeline) is stored under `name` —
+    the per-round exchange telemetry the MULTICHIP records embed."""
+
+    def __init__(self, timelines: dict, name: str):
+        self.timelines = timelines
+        self.name = name
+
+    def __enter__(self):
+        from .obs.tracer import QueryTracer, set_active
+        self.tr = QueryTracer(0)
+        set_active(self.tr)
+        return self.tr
+
+    def __exit__(self, *a):
+        from .obs.profile import QueryProfile
+        from .obs.tracer import NULL_TRACER, set_active
+        set_active(NULL_TRACER)
+        prof = QueryProfile(self.tr.spans, self.tr.events,
+                            self.tr.counters, {}, {})
+        tl = prof.mesh_timeline()
+        tl["ici_exchange_bytes"] = int(
+            self.tr.counters.get("ici_exchange_bytes", 0))
+        self.timelines[self.name] = tl
+
+
+def _primitives(mesh, timings: dict, scale: float = 1.0,
+                timelines: Optional[dict] = None) -> None:
     """The r05-compatible primitive benchmarks: fused groupby at 1M
     rows/device (the retired bucket stack's headline case), ragged
     groupby + window rank at 64k rows/device."""
@@ -126,8 +156,10 @@ def _primitives(mesh, timings: dict, scale: float = 1.0) -> None:
     bkeys[rng.random(nb) < 0.4] = 3
     bkey_valid = rng.random(nb) < 0.9
     bvals = rng.integers(-10, 10, nb).astype(np.int64)
+    timelines = {} if timelines is None else timelines
     fn, shard = distributed_groupby_step(mesh, t.LONG, specs, big_cap)
-    with _timed(timings, f"groupby_{big_cap}_rows_per_device"):
+    with _timed(timings, f"groupby_{big_cap}_rows_per_device"), \
+            _mesh_traced(timelines, f"groupby_{big_cap}_rows_per_device"):
         (kd, kv), outs, ngroups = fn(
             jax.device_put(jnp.asarray(bkeys), shard),
             jax.device_put(jnp.asarray(bkey_valid), shard),
@@ -144,7 +176,9 @@ def _primitives(mesh, timings: dict, scale: float = 1.0) -> None:
     vals = rng.integers(-10, 10, n).astype(np.int64)
     run, shard2 = distributed_groupby_ragged(mesh, t.LONG, specs,
                                              local_cap)
-    with _timed(timings, f"ragged_groupby_{local_cap}_rows_per_device"):
+    with _timed(timings, f"ragged_groupby_{local_cap}_rows_per_device"), \
+            _mesh_traced(timelines,
+                         f"ragged_groupby_{local_cap}_rows_per_device"):
         (kd2, _), outs2, ngroups2 = run(
             jax.device_put(jnp.asarray(keys), shard2),
             jax.device_put(jnp.asarray(key_valid), shard2),
@@ -157,7 +191,9 @@ def _primitives(mesh, timings: dict, scale: float = 1.0) -> None:
     wpk[rng.random(n) < 0.4] = 7
     wok = rng.integers(0, 50, n).astype(np.int64)
     wlv = rng.random(n) < 0.9
-    with _timed(timings, f"window_rank_{local_cap}_rows_per_device"):
+    with _timed(timings, f"window_rank_{local_cap}_rows_per_device"), \
+            _mesh_traced(timelines,
+                         f"window_rank_{local_cap}_rows_per_device"):
         _, _, rank, _ = distributed_window_rank(
             mesh, jax.device_put(jnp.asarray(wpk), shard2),
             jax.device_put(jnp.asarray(wok), shard2),
@@ -239,7 +275,13 @@ def run_multichip_suite(n_devices: int = 8, sf: float = 10.0,
     doc["rows_per_device"] = {
         "fused_groupby": max(1024, int((1 << 20) * micro_scale)),
         "other_primitives": max(64, int((1 << 16) * micro_scale))}
-    _primitives(mesh, timings, scale=micro_scale)
+    # per-round exchange timelines (round quotas, wire bytes pre/post
+    # compress, arrival counts, staging vs collective ms) ride the
+    # record next to the wall timings they explain
+    prim_timelines: Dict[str, dict] = {}
+    doc["primitives_mesh_timeline"] = prim_timelines
+    _primitives(mesh, timings, scale=micro_scale,
+                timelines=prim_timelines)
     from .obs.registry import REGISTRY
     doc["exchange"] = {
         k: REGISTRY.get(f"tpu_exchange_wire_bytes_{k}_compress_total")
@@ -287,12 +329,25 @@ def run_multichip_suite(n_devices: int = 8, sf: float = 10.0,
         try:
             dfq = tpch.QUERIES[name](sdev, tables)
             q = dfq.physical()
-            ctx = ExecContext(sdev.conf)
+            # cold collect runs TRACED so the record embeds the query's
+            # mesh exchange timeline + per-query ICI byte attribution
+            # (cold wall includes compile anyway; tracer cost is noise)
+            from .config import TRACE_ENABLED
+            ctx = ExecContext(TpuConf({**sdev.conf._raw,
+                                       TRACE_ENABLED.key: True}))
             t0 = time.perf_counter()
             out = q.collect(ctx)
             rec["cold_s"] = round(time.perf_counter() - t0, 2)
             rec["compiled"] = bool(
                 ctx.metrics.get("whole_plan_compiled_queries", 0))
+            from .obs.profile import QueryProfile
+            prof = QueryProfile.from_context(ctx)
+            tl = prof.mesh_timeline()
+            if tl["exchanges"] or tl["skew_splits"]:
+                rec["mesh_timeline"] = tl
+            ici = prof.counters.get("ici_exchange_bytes", 0)
+            if ici:
+                rec["ici_exchange_bytes"] = int(ici)
             t0 = time.perf_counter()
             q.collect(ExecContext(sdev.conf))
             warm = time.perf_counter() - t0

@@ -137,10 +137,18 @@ class MetricsHttpServer:
         return self.port
 
     def stop(self) -> None:
+        """Shut down AND join: after stop() returns, the serving thread
+        is gone and the port is closed — repeated open/close in one
+        process cannot accumulate threads or leak listen sockets
+        (TpuSession.close / shutdown_exporters)."""
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            if self._thread.is_alive():
+                self._thread.join(timeout=2.0)
+            self._thread = None
 
 
 _EXPORT_LOCK = threading.Lock()

@@ -22,6 +22,12 @@ _NODE_METRIC_RE = re.compile(
     r"^(?P<name>\w+)#(?P<nid>\d+)\.(?P<field>op_time_ms|total_time_ms|"
     r"output_rows|output_batches)$")
 
+#: metric keys of the per-segment attribution counters (exec/compiled.py
+#: _record_segment; populated when spark.rapids.tpu.profile.segments on)
+_SEGMENT_METRIC_RE = re.compile(
+    r"^segment\.(?P<node>[\w#]+)\.(?P<field>device_ms|rows|out_bytes|"
+    r"executions|flops|bytes_accessed|peak_temp_bytes)$")
+
 #: span categories that are measured directly; "execute" is the residual
 _SPLIT_CATS = ("compile", "transition", "shuffle")
 
@@ -154,6 +160,95 @@ class QueryProfile:
         return sorted(rows.values(),
                       key=lambda r: (-r["self_time_ms"], r["nid"]))
 
+    # -- the attribution plane (per-segment device time) -------------------
+    def segments(self) -> List[Dict[str, Any]]:
+        """Per-segment device-time attribution table: one row per
+        compiled program segment ({node, device_ms, rows, out_bytes,
+        executions, pct, node_lo/node_hi, static cost overlay}), sorted
+        by device_ms descending.  Populated only from runs with
+        `spark.rapids.tpu.profile.segments` on; merges the segment.*
+        metrics with span-level node ranges."""
+        rows: Dict[str, Dict[str, Any]] = {}
+        for k, v in self.metrics.items():
+            m = _SEGMENT_METRIC_RE.match(k)
+            if not m or not isinstance(v, (int, float)):
+                continue
+            row = rows.setdefault(m.group("node"),
+                                  {"node": m.group("node")})
+            row[m.group("field")] = v
+        from_metrics = set(rows)
+        for s in self.spans:
+            if s.name != "segment" or s.cat != "execute":
+                continue
+            node = s.node or "?"
+            row = rows.setdefault(node, {"node": node})
+            if node not in from_metrics:
+                # span-only fallback (e.g. a metrics-stripped log):
+                # accumulate the per-execution attrs
+                row["device_ms"] = row.get("device_ms", 0.0) + \
+                    float(s.attrs.get("device_ms", s.dur_ms))
+                row["rows"] = row.get("rows", 0) + s.attrs.get("rows", 0)
+                row["out_bytes"] = row.get("out_bytes", 0) + \
+                    s.attrs.get("out_bytes", 0)
+            if "node_lo" not in row and "node_lo" in s.attrs:
+                row["node_lo"] = s.attrs["node_lo"]
+                row["node_hi"] = s.attrs.get("node_hi")
+        total = sum(float(r.get("device_ms", 0.0)) for r in rows.values())
+        for r in rows.values():
+            r["device_ms"] = round(float(r.get("device_ms", 0.0)), 3)
+            r["pct"] = round(100.0 * r["device_ms"] / total, 1) \
+                if total else 0.0
+        return sorted(rows.values(), key=lambda r: -r["device_ms"])
+
+    def attributed_device_pct(self) -> Optional[float]:
+        """Fraction of the measured device wall (the union of
+        cat=execute spans) covered by NAMED plan segments (`segment`
+        spans carrying a node id) — the explain_analyze attribution
+        bar.  None when the run carried no execute spans (eager path,
+        or tracing off)."""
+        ex = [(s.t0, s.t1) for s in self.spans if s.cat == "execute"]
+        total = _union_ms(ex)
+        if not total:
+            return None
+        seg = [(s.t0, s.t1) for s in self.spans
+               if s.name == "segment" and s.cat == "execute"
+               and (s.node or s.attrs.get("node_lo") is not None)]
+        return min(1.0, _union_ms(seg) / total)
+
+    def mesh_timeline(self) -> Dict[str, Any]:
+        """Per-query mesh/collective timeline from the exchange
+        instants (parallel/exchange.py): one record per ragged exchange
+        call (round schedule, quotas, wire bytes pre/post compress,
+        per-device arrival counts, per-round staging vs collective ms)
+        plus one-time dictionary gathers and skew-split events."""
+        exchanges: List[Dict[str, Any]] = []
+        skew: List[Dict[str, Any]] = []
+        cur: Optional[Dict[str, Any]] = None
+        org = min([s.t0 for s in self.spans] +
+                  [e.t for e in self.events], default=0.0)
+        for e in self.events:
+            t_ms = round((e.t - org) * 1e3, 3)
+            if e.name == "ici_exchange":
+                cur = {"kind": "exchange", "t_ms": t_ms, **e.attrs,
+                       "round_events": []}
+                exchanges.append(cur)
+            elif e.name == "exchange_round" and cur is not None:
+                cur["round_events"].append({"t_ms": t_ms, **e.attrs})
+            elif e.name == "exchange_timing" and cur is not None:
+                stage = e.attrs.get("stage_ms") or []
+                coll = e.attrs.get("collective_ms") or []
+                for rec, sm, cm in zip(cur["round_events"], stage, coll):
+                    rec["stage_ms"] = sm
+                    rec["collective_ms"] = cm
+                cur["stage_ms_total"] = round(sum(stage), 3)
+                cur["collective_ms_total"] = round(sum(coll), 3)
+            elif e.name == "ici_dict_gather":
+                exchanges.append({"kind": "dict_gather", "t_ms": t_ms,
+                                  **e.attrs})
+            elif e.name == "exchange_skew_split":
+                skew.append({"t_ms": t_ms, **e.attrs})
+        return {"exchanges": exchanges, "skew_splits": skew}
+
     def fallbacks(self) -> List[str]:
         return list(self.meta.get("fallbacks", []))
 
@@ -205,6 +300,15 @@ class QueryProfile:
                "memory": self.memory(),
                "incidents": self.incidents(),
                "fallbacks": self.fallbacks()}
+        segs = self.segments()
+        if segs:
+            out["segments"] = segs
+            pct = self.attributed_device_pct()
+            if pct is not None:
+                out["attributed_device_pct"] = round(pct * 100, 1)
+        mesh = self.mesh_timeline()
+        if mesh["exchanges"] or mesh["skew_splits"]:
+            out["mesh_timeline"] = mesh
         if self.registry:
             out["registry"] = self.registry
         if self.truncated:
@@ -214,17 +318,29 @@ class QueryProfile:
     def summary(self, top_n: int = 5) -> Dict[str, Any]:
         """Compact per-query embedding for BENCH_*.json."""
         ops = self.operators()
-        return {"time_split": self.time_split(),
-                "top_operators": [
-                    {"node": o["node"],
-                     "self_time_ms": o["self_time_ms"],
-                     "output_rows": o.get("output_rows", 0)}
-                    for o in ops[:top_n]],
-                "compile": self.compile_stats(),
-                "data_movement": self.data_movement(),
-                "memory_peak_bytes": self.memory().get("peak_bytes", 0),
-                "incidents": self.incidents(),
-                "fallback_count": len(self.fallbacks())}
+        out = {"time_split": self.time_split(),
+               "top_operators": [
+                   {"node": o["node"],
+                    "self_time_ms": o["self_time_ms"],
+                    "output_rows": o.get("output_rows", 0)}
+                   for o in ops[:top_n]],
+               "compile": self.compile_stats(),
+               "data_movement": self.data_movement(),
+               "memory_peak_bytes": self.memory().get("peak_bytes", 0),
+               "incidents": self.incidents(),
+               "fallback_count": len(self.fallbacks())}
+        segs = self.segments()
+        if segs:
+            # the segment-level attribution rides into the bench record
+            # so profile_diff.py / check_regression.py can cite the
+            # regressed SEGMENT, not just the query
+            out["segments"] = [
+                {k: s[k] for k in ("node", "device_ms", "pct", "rows")
+                 if k in s} for s in segs[:top_n]]
+            pct = self.attributed_device_pct()
+            if pct is not None:
+                out["attributed_device_pct"] = round(pct * 100, 1)
+        return out
 
     def render(self) -> str:
         """The human report: time split, top operators, fallbacks,
@@ -250,6 +366,40 @@ class QueryProfile:
                     f"  {o['node']:<32} {o['self_time_ms']:>9.1f} ms  "
                     f"rows={o.get('output_rows', 0)} "
                     f"batches={o.get('output_batches', 0)}")
+        segs = self.segments()
+        if segs:
+            pct = self.attributed_device_pct()
+            hdr = "-- segments (measured device time) --"
+            if pct is not None:
+                hdr += f"  [{pct * 100:.1f}% of device wall attributed]"
+            lines.append(hdr)
+            for sg in segs[:10]:
+                rng = ""
+                if sg.get("node_lo") is not None:
+                    rng = f" nodes #{sg['node_lo']}-#{sg.get('node_hi')}"
+                cost = ""
+                if sg.get("flops"):
+                    cost = f" flops={sg['flops']:.3g}"
+                lines.append(
+                    f"  {sg['node']:<32} {sg['device_ms']:>9.1f} ms "
+                    f"({sg['pct']:>5.1f}%) rows={sg.get('rows', 0)}"
+                    f"{rng}{cost}")
+        mesh = self.mesh_timeline()
+        if mesh["exchanges"]:
+            lines.append("-- mesh timeline --")
+            for ex in mesh["exchanges"][:12]:
+                if ex.get("kind") == "dict_gather":
+                    lines.append(f"  dict_gather bytes={ex.get('bytes', 0)}")
+                    continue
+                lines.append(
+                    f"  exchange rounds={ex.get('rounds', 0)} "
+                    f"quota={ex.get('quota', 0)} "
+                    f"bytes={ex.get('bytes', 0)} "
+                    f"(pre={ex.get('bytes_pre_compress', 0)}) "
+                    f"stage={ex.get('stage_ms_total', 0)}ms "
+                    f"collective={ex.get('collective_ms_total', 0)}ms")
+            if mesh["skew_splits"]:
+                lines.append(f"  skew splits: {len(mesh['skew_splits'])}")
         dm = self.data_movement()
         if dm:
             lines.append("-- data movement --")

@@ -481,6 +481,22 @@ DEFERRED_GATHERS = REGISTRY.counter(
     "materializes at the pipeline sink — or never, if nothing "
     "references it.")
 
+SEGMENT_DEVICE_MS = REGISTRY.histogram(
+    "tpu_segment_device_ms",
+    "Measured device wall milliseconds per compiled plan segment "
+    "(dispatch + block_until_ready), log2 buckets, labeled by the "
+    "segment's root operator class — populated only when "
+    "spark.rapids.tpu.profile.segments is on (the attribution plane, "
+    "exec/compiled.py).",
+    ("segment",))
+
+SEGMENT_ROWS = REGISTRY.counter(
+    "tpu_segment_out_rows_total",
+    "Output rows per compiled plan segment (root operator class), "
+    "counted at the segment boundary when "
+    "spark.rapids.tpu.profile.segments is on.",
+    ("segment",))
+
 DICT_REMAPS = REGISTRY.counter(
     "tpu_join_dict_remaps_total",
     "Host dictionary remap/unification computations (index_in + "

@@ -49,7 +49,7 @@ from .. import types as t
 from ..config import (EXCHANGE_COMPRESS, EXCHANGE_DONATE,
                       EXCHANGE_QUOTA_AUTO, EXCHANGE_QUOTA_ROWS,
                       EXCHANGE_SPLIT_RETRY)
-from ..obs.registry import (DATA_BYTES, EXCHANGE_ROUNDS, EXCHANGE_WIRE_POST,
+from ..obs.registry import (EXCHANGE_ROUNDS, EXCHANGE_WIRE_POST,
                             EXCHANGE_WIRE_PRE, ICI_EXCHANGE_BYTES)
 from ..obs.tracer import get_active
 from ..ops import groupby as G
@@ -237,7 +237,8 @@ class _PlanState:
     inspect counts BEFORE committing to the rounds."""
     __slots__ = ("lanes", "rank", "dest", "live", "counts_dev",
                  "in_counts", "biases", "plan", "schedule", "recv_cap",
-                 "max_cnt", "per_shard_in", "would_grow", "stats")
+                 "max_cnt", "per_shard_in", "would_grow", "stats",
+                 "arrivals")
 
     def __init__(self):
         self.would_grow = False
@@ -401,9 +402,12 @@ class RaggedExchange:
         st.in_counts = in_counts
         st.stats = np.asarray(stats_h).reshape(self.nparts, nl, 2)
         st.max_cnt = int(np.asarray(counts_h).max())
-        st.per_shard_in = int(np.asarray(in_h)
-                              .reshape(self.nparts, self.nparts)
-                              .sum(1).max())
+        per_shard = np.asarray(in_h).reshape(self.nparts,
+                                             self.nparts).sum(1)
+        # per-device arrival counts ride into the mesh timeline: the
+        # skew picture an operator needs to read a slow exchange
+        st.arrivals = [int(x) for x in per_shard]
+        st.per_shard_in = int(per_shard.max())
         # receive buffers size to the ACTUAL arrival volume (pow2-
         # quantized so downstream capacity-keyed traces stay bounded):
         # a partial-aggregated exchange at 1M rows/device receives ~5k
@@ -452,12 +456,21 @@ class RaggedExchange:
         tr.add_bytes("ici_exchange_bytes", post)
         tr.instant("ici_exchange", "shuffle", rounds=rounds, quota=q,
                    bytes=post, bytes_pre_compress=pre,
-                   recv_cap=st.recv_cap)
+                   recv_cap=st.recv_cap,
+                   arrivals=getattr(st, "arrivals", None))
 
     def run_rounds(self, st: _PlanState):
         """Execute the planned rounds: staging for round r+1 overlaps
         round r's collective (two async dispatches per round), receive
-        buffers donate through every round."""
+        buffers donate through every round.
+
+        Per-round host dispatch wall (staging vs collective) is
+        recorded into one `exchange_timing` instant after the loop —
+        the per-round half of the query mesh timeline
+        (QueryProfile.mesh_timeline).  The pre-round `exchange_round`
+        state instants stay FIRST so a fatal mid-round still dumps its
+        round state (test_chaos)."""
+        import time as _time
         self._account(st)
         recv_cap = st.recv_cap
         n = self.nparts * recv_cap
@@ -472,20 +485,36 @@ class RaggedExchange:
             q = st.schedule[0]
             stage = self._stage_fn(q, st.plan)
             rnd = self._round_fn(q, recv_cap, st.plan)
+            stage_ms: List[float] = []
+            coll_ms: List[float] = []
+            t0 = _time.perf_counter()
             slab = stage(st.lanes, st.rank, st.dest, st.live,
                          st.counts_dev, biases, jnp.int32(0))
+            pending_stage = _time.perf_counter() - t0
             for r in range(rounds):
                 # round state into the flight recorder: a fatal mid-
                 # exchange dumps exactly which round died (test_chaos)
                 tr.instant("exchange_round", "shuffle", r=r,
                            rounds=rounds, quota=q, recv_cap=recv_cap)
                 fire_active("exchange", round=r)
+                t0 = _time.perf_counter()
                 nxt = stage(st.lanes, st.rank, st.dest, st.live,
                             st.counts_dev, biases, jnp.int32(r + 1)) \
                     if r + 1 < rounds else None
+                t1 = _time.perf_counter()
                 recv, rlive = rnd(slab[0], slab[1], st.in_counts,
                                   biases, recv, rlive, jnp.int32(r))
+                t2 = _time.perf_counter()
+                # round r's staging was dispatched the PREVIOUS
+                # iteration (the double buffer) — attribute it to r,
+                # and hold this iteration's dispatch for round r+1
+                stage_ms.append(round(pending_stage * 1e3, 3))
+                pending_stage = t1 - t0
+                coll_ms.append(round((t2 - t1) * 1e3, 3))
                 slab = nxt
+            tr.instant("exchange_timing", "shuffle", rounds=rounds,
+                       quota=q, recv_cap=recv_cap, stage_ms=stage_ms,
+                       collective_ms=coll_ms)
         return recv, rlive, st.in_counts
 
     def __call__(self, lanes, live, dest):
@@ -521,7 +550,14 @@ def exchange_dictionary(mesh: Mesh, dict_lane, dict_cap: int,
     ICI_EXCHANGE_BYTES.inc(nbytes)
     EXCHANGE_WIRE_PRE.inc(nbytes)
     EXCHANGE_WIRE_POST.inc(nbytes)
-    DATA_BYTES.inc(nbytes, channel="ici_exchange")
+    # through the ACTIVE tracer, not the bare registry channel: the
+    # wire bytes attribute to the owning query's counters (and the
+    # tracer publishes the same registry channel underneath), and the
+    # gather lands on the query's mesh timeline
+    tr = get_active()
+    tr.add_bytes("ici_exchange_bytes", nbytes)
+    tr.instant("ici_dict_gather", "shuffle", bytes=nbytes,
+               dict_cap=dict_cap)
     return out
 
 

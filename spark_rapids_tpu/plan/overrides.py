@@ -1200,6 +1200,18 @@ class PhysicalQuery:
     def explain(self) -> str:
         return "\n".join(self.meta.explain_lines())
 
+    def explain_analyze(self, conf_overrides: Optional[Dict] = None):
+        """EXPLAIN ANALYZE: run ONE profiled collect (trace.enabled +
+        profile.segments forced on — whole-plan programs re-split at the
+        known seam boundaries and every program execution records
+        measured DEVICE wall) and return the attribution report: the
+        plan tree annotated with measured ms, rows, bytes, gather
+        volume and % of query wall per segment, plus the XLA static
+        cost overlay (obs/attribution.py).  The caller's cached compiled
+        plan is left untouched."""
+        from ..obs.attribution import run_explain_analyze
+        return run_explain_analyze(self, conf_overrides)
+
     def physical_tree(self) -> str:
         return self.root.tree_string()
 

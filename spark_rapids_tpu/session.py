@@ -52,6 +52,31 @@ class TpuSession:
         from .exec.compiled import configure_persistent_cache
         configure_persistent_cache(self.conf)
 
+    def close(self) -> None:
+        """Shut the session's process-wide exporters down cleanly: the
+        JSONL heartbeat and Prometheus endpoint threads are stopped AND
+        joined, and the listen port is released — so repeated session
+        open/close in one process cannot leak threads or ports.  The
+        metrics registry itself (process-wide, cheap) stays; a later
+        TpuSession restarts exporters from its conf.  Idempotent."""
+        from .obs.export import shutdown_exporters
+        shutdown_exporters()
+
+    def __enter__(self) -> "TpuSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def explain_analyze(self, df: "DataFrame",
+                        conf_overrides: Optional[Dict] = None):
+        """EXPLAIN ANALYZE for a DataFrame built on this session (the
+        engine's query handle — there is no SQL string frontend): plans
+        it under the session conf, runs one profiled collect and
+        returns the device-time attribution report
+        (see DataFrame.explain_analyze / obs/attribution.py)."""
+        return df.physical().explain_analyze(conf_overrides)
+
     def metrics_snapshot(self, compact: bool = False) -> dict:
         """The process-wide always-on metrics registry: every counter,
         gauge and log2-bucket histogram the runtime publishes
@@ -486,6 +511,20 @@ class DataFrame:
     def explain(self) -> str:
         q = self.physical()
         return q.explain() + "\n\nPhysical plan:\n" + q.physical_tree()
+
+    def explain_analyze(self, conf_overrides: Optional[Dict] = None):
+        """EXPLAIN ANALYZE: execute this query ONCE with profiling on
+        (trace.enabled + profile.segments — compiled programs re-split
+        at the known seam boundaries and each segment's DEVICE wall is
+        measured) and return an ExplainAnalyzeReport: the physical plan
+        tree annotated with measured ms, rows, bytes, gather volume and
+        % of query wall, the per-segment XLA static-cost overlay
+        (FLOPs / bytes accessed / peak temp vs measured time, skew
+        flagged), and the mesh exchange timeline when the query ran on
+        a mesh.  `print(df.explain_analyze())` renders the report;
+        `.segments` / `.attributed_pct` / `.to_dict()` expose the data
+        (obs/attribution.py)."""
+        return self.physical().explain_analyze(conf_overrides)
 
     def logical_tree(self) -> str:
         return self._plan.tree_string()

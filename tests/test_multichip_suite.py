@@ -40,3 +40,13 @@ def test_multichip_suite_end_to_end_toy(eight_devices, capsys):
     assert doc["multichip_suite_queries"]["q6"]["match"] is True
     assert doc["exchange"]["post"] <= doc["exchange"]["pre"]
     assert doc["final"] is True
+    # the record embeds per-round exchange timelines for the primitives
+    # (PR 9 attribution plane): round schedule + wire bytes + per-round
+    # staging vs collective ms
+    prim = doc["primitives_mesh_timeline"]
+    gb = next(v for k, v in prim.items() if k.startswith("groupby_"))
+    ex0 = next(e for e in gb["exchanges"] if e.get("kind") == "exchange")
+    assert ex0["rounds"] >= 1 and len(ex0["arrivals"]) == 8
+    assert len(ex0["round_events"]) == ex0["rounds"]
+    assert all("collective_ms" in r for r in ex0["round_events"])
+    assert gb["ici_exchange_bytes"] > 0
