@@ -104,6 +104,41 @@ def extract_multichip(doc):
     return {}, None
 
 
+def extract_serving(doc):
+    """-> ({'sv:<entry>': ms}, backend or None) from a bench.py
+    --serving result: the `serving_latency_ms` gate dict (per-level
+    p99/mean client-observed latency, lower = better) becomes `sv:`-
+    prefixed entries that gate like per-query device_ms under the same
+    backend-separation rule (never colliding with qN / mc: names).
+    Accepts the runner's JSON line, the driver wrapper, and a tail."""
+    if not isinstance(doc, dict):
+        return {}, None
+    lat = doc.get("serving_latency_ms")
+    if isinstance(lat, dict) and lat:
+        out = {f"sv:{k}": float(v) for k, v in lat.items()
+               if isinstance(v, (int, float))}
+        return out, str(doc.get("backend") or _DEFAULT_BACKEND)
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        out, backend = extract_serving(parsed)
+        if out:
+            return out, backend
+    tail = doc.get("tail")
+    if isinstance(tail, str) and "serving_latency_ms" in tail:
+        for line in reversed(tail.splitlines()):
+            if "serving_latency_ms" not in line:
+                continue
+            try:
+                rec = json.loads(line.strip())
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out, backend = extract_serving(rec)
+                if out:
+                    return out, backend
+    return {}, None
+
+
 def _rec_ms(rec: dict, rtt_ms: float):
     """Net-of-floor milliseconds for one per-query record: the explicit
     `device_ms_net` when the bench emitted it, else `device_ms` minus
@@ -225,6 +260,13 @@ def load_file(path: str):
         qs = {**qs, **mc}
         if not backend or backend == _DEFAULT_BACKEND:
             backend = mc_backend
+    sv, sv_backend = extract_serving(doc)
+    if sv:
+        # serving latency entries gate under their sv: prefix; a pure
+        # serving record carries its own backend tag
+        qs = {**qs, **sv}
+        if (not backend or backend == _DEFAULT_BACKEND) and sv_backend:
+            backend = sv_backend
     return qs, backend, extract_compile_ms(doc)
 
 
@@ -265,7 +307,8 @@ def _median(vals: list):
 
 def default_trajectory() -> list:
     return (sorted(glob.glob(os.path.join(_ROOT, "BENCH_r*.json"))) +
-            sorted(glob.glob(os.path.join(_ROOT, "MULTICHIP_r*.json"))))
+            sorted(glob.glob(os.path.join(_ROOT, "MULTICHIP_r*.json"))) +
+            sorted(glob.glob(os.path.join(_ROOT, "SERVING_r*.json"))))
 
 
 def compare(current: dict, baseline: dict, threshold: float,

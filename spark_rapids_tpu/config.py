@@ -729,6 +729,72 @@ def parse_bucket_set(raw: str):
     return [int(x) for x in s.split(",")] if s else []
 
 
+# --------------------------------------------------------------------------
+# Concurrent serving plane (serving/runtime.py + serving/cache.py)
+# --------------------------------------------------------------------------
+
+SERVING_WORKERS = conf(
+    "spark.rapids.tpu.serving.workers", 8,
+    "Pipeline worker threads of the ServingRuntime: each admitted query "
+    "runs its plan / result-cache probe / compile / device-execute "
+    "phases on one worker, so up to this many queries are in SOME phase "
+    "concurrently (XLA compiles release the GIL — one query's compile "
+    "overlaps another's device execution).", checker=_positive)
+
+SERVING_QUEUE_DEPTH = conf(
+    "spark.rapids.tpu.serving.queueDepth", 64,
+    "Bound on admitted-but-unfinished queries across all tenants. At "
+    "the bound, submit() blocks (backpressure) up to "
+    "serving.admitTimeoutMs and then raises AdmissionTimeout — load "
+    "sheds at admission with a clean signal instead of a device OOM "
+    "mid-query.", checker=_positive, commonly_used=True)
+
+SERVING_ADMIT_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.serving.admitTimeoutMs", 10000,
+    "Longest one submit() blocks for an admission slot when the queue "
+    "is at queueDepth before AdmissionTimeout is raised (the "
+    "backpressure signal; TenantSession.collect retries it once).",
+    checker=_positive)
+
+SERVING_DEVICE_SLOTS = conf(
+    "spark.rapids.tpu.serving.deviceSlots", 0,
+    "Concurrent device-execute grants the fair-share scheduler hands "
+    "out. 0 (default) = auto: sql.concurrentTpuTasks (the GpuSemaphore "
+    "sizing — one query's host tail overlaps another's device compute) "
+    "on accelerator backends, but 1 on the CPU backend, where 'device "
+    "compute' shares the host cores and concurrent XLA programs thrash "
+    "each other's intra-op thread pools. Each grant still holds a "
+    "semaphore permit inside the query, so the HBM story is unchanged.",
+    checker=_non_negative)
+
+SERVING_STARVATION_BOUND = conf(
+    "spark.rapids.tpu.serving.starvationBound", 4,
+    "Starvation bound of the weighted-deficit scheduler: a tenant with "
+    "a runnable query is never passed over more than this many "
+    "consecutive device grants — after that it is scheduled regardless "
+    "of its deficit (the fairness invariant tests/test_serving.py's "
+    "hammer asserts).", checker=_positive)
+
+SERVING_RESULT_CACHE_BYTES = conf(
+    "spark.rapids.tpu.serving.resultCache.bytes", 256 << 20,
+    "Byte cap of the serving plan+result cache (LRU past it): repeated "
+    "dashboard-style queries — same canonical plan STRUCTURE, same "
+    "lifted literal values, same live source tables — return the cached "
+    "result without touching the device. Entries are checksummed Arrow "
+    "IPC payloads, invalidated the moment a source-table anchor is "
+    "garbage collected. 0 disables the cache.",
+    checker=_non_negative, commonly_used=True)
+
+SERVING_ADMIT_WORKING_SET_FACTOR = conf(
+    "spark.rapids.tpu.serving.admitWorkingSetFactor", 3.0,
+    "HBM admission estimate: a query's device working set is assumed "
+    "to be this factor times its source-table bytes, and the scheduler "
+    "only overlaps device phases whose summed estimates fit the HBM "
+    "budget (memory.tpu.budgetBytes / allocFraction) — queueing instead "
+    "of betting on the OOM retry ladder. A query too big to ever fit "
+    "still runs, alone.", checker=_positive, internal=True)
+
+
 JOIN_LATE_MATERIALIZATION = conf(
     "spark.rapids.tpu.sql.join.lateMaterialization.enabled", True,
     "Let equi-joins emit THIN batches: payload columns ride as per-side "
@@ -860,6 +926,12 @@ def generate_docs() -> str:
         "with-fallbacks / not-whole-plan-traceable. |",
         "| `--queries` | all registered | Comma-separated subset of the "
         "suite's QUERIES registry. |",
+        "| `--serving` | off | Concurrent serving sweep: closed-loop "
+        "clients (one tenant each) over the query mix at concurrency "
+        "1/2/4/8 through the ServingRuntime, vs the same multiset "
+        "serially through the single-query path; reports p50/p99 "
+        "latency, QPS, device utilization and result-cache outcomes "
+        "(docs/SERVING.md; gated via check_regression sv: entries). |",
         "| `scale` | `1.0` | Linear datagen scale factor (SF1-ish row "
         "counts at 1.0; fixed-size dimensions never scale). |",
         "| `BENCH_BUDGET_S` | `1800` | Total wall budget; queries that "

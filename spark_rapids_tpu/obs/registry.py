@@ -497,6 +497,44 @@ SEGMENT_ROWS = REGISTRY.counter(
     "spark.rapids.tpu.profile.segments is on.",
     ("segment",))
 
+SERVING_QUEUE_DEPTH = REGISTRY.gauge(
+    "tpu_serving_queue_depth",
+    "Admitted-but-unfinished queries in the ServingRuntime (the bounded "
+    "admission queue's current depth, serving/runtime.py).")
+
+SERVING_ADMIT_WAIT_MS = REGISTRY.histogram(
+    "tpu_serving_admission_wait_ms",
+    "Milliseconds one submit() blocked for an admission slot, log2 "
+    "buckets, one observation per successful admission — queue "
+    "backpressure shows up in the tail.")
+
+SERVING_TENANT_DEVICE_US = REGISTRY.counter(
+    "tpu_serving_tenant_device_us_total",
+    "Measured device-execute MICROseconds per serving tenant (integer, "
+    "so concurrent publication order cannot perturb the total — the "
+    "fair-share hammer asserts exact equality against per-ticket sums).",
+    ("tenant",))
+
+SERVING_QUERIES = REGISTRY.counter(
+    "tpu_serving_queries_total",
+    "Serving-plane queries by tenant and terminal status (ok | error | "
+    "admission_timeout | cache_hit).",
+    ("tenant", "status"))
+
+SERVING_RESULT_CACHE = REGISTRY.counter(
+    "tpu_serving_result_cache_total",
+    "Plan+result cache outcomes (serving/cache.py): hit, miss, store, "
+    "evict (byte-cap LRU), invalidate (source-table anchor died), "
+    "corrupt (checksum verification rejected a damaged payload — "
+    "treated as a miss and recomputed).",
+    ("outcome",))
+
+SERVING_DEVICE_BUSY_US = REGISTRY.counter(
+    "tpu_serving_device_busy_us_total",
+    "Microseconds a serving device-execute grant was active (summed "
+    "across slots) — device utilization is this over wall time, the "
+    "overlap-is-real number bench.py --serving reports.")
+
 DICT_REMAPS = REGISTRY.counter(
     "tpu_join_dict_remaps_total",
     "Host dictionary remap/unification computations (index_in + "

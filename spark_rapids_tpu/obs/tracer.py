@@ -267,12 +267,25 @@ class QueryTracer:
         return {"traceEvents": evs, "displayTimeUnit": "ms"}
 
     def write(self, dir_path: str) -> Dict[str, str]:
-        """Write both artifacts under dir_path; returns their paths."""
+        """Write both artifacts under dir_path; returns their paths.
+
+        Collision-proof: query ids are process-unique and monotonic
+        (make_tracer allocates under one lock), but several PROCESSES —
+        or a process restart — may share one event-log directory, so an
+        existing `query_<id>.jsonl` gets a monotonic `-<n>` suffix
+        instead of being overwritten (the crash-dump filename rule,
+        runtime/failure.py)."""
         os.makedirs(dir_path, exist_ok=True)
-        base = os.path.join(dir_path, f"query_{self.query_id}")
-        jsonl = base + ".jsonl"
-        with open(jsonl, "w") as f:
-            f.write("\n".join(self.to_jsonl_lines()) + "\n")
+        with _WRITE_LOCK:
+            base = os.path.join(dir_path, f"query_{self.query_id}")
+            n = 0
+            while os.path.exists(base + ".jsonl"):
+                n += 1
+                base = os.path.join(
+                    dir_path, f"query_{self.query_id}-{n}")
+            jsonl = base + ".jsonl"
+            with open(jsonl, "w") as f:
+                f.write("\n".join(self.to_jsonl_lines()) + "\n")
         trace = base + ".trace.json"
         with open(trace, "w") as f:
             json.dump(self.to_chrome_trace(), f)
@@ -386,21 +399,50 @@ NULL_TRACER = NullTracer()
 
 _QUERY_ID_LOCK = threading.Lock()
 _NEXT_QUERY_ID = 0
+_WRITE_LOCK = threading.Lock()
 
-# The process-wide active tracer: runtime subsystems that have no
-# ExecContext in reach (shuffle manager threads, the ICI exchange, the
-# retry/spill machinery) report here.  Set for the duration of a query's
+# The ACTIVE tracer: runtime subsystems that have no ExecContext in
+# reach (shuffle manager threads, the ICI exchange, the retry/spill
+# machinery) report here.  Set for the duration of a query's
 # instrumented scope (plan/overrides.py); NULL outside it.
-_ACTIVE: object = NULL_TRACER
+#
+# Concurrency (the serving plane runs many instrumented scopes at once):
+# the binding is THREAD-LOCAL — each query's own thread (semaphore
+# waits, retry ladders, spill chains all run on it) always attributes to
+# its own tracer, and one query finishing can no longer null out another
+# query's active binding.  Threads with no binding of their own (shared
+# shuffle/spill/compile pool workers) fall back to the single active
+# tracer when exactly ONE query is in scope process-wide — the
+# single-query behavior every existing call site was built on — and to
+# NULL_TRACER when several are (ambiguous attribution is dropped, never
+# misassigned; the always-on registry still sees those events).
+_TLS_ACTIVE = threading.local()
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_SET: dict = {}            # id(tracer) -> tracer, currently in scope
+_FALLBACK: object = NULL_TRACER   # the unique in-scope tracer, else NULL
 
 
 def set_active(tracer) -> None:
-    global _ACTIVE
-    _ACTIVE = tracer
+    """Bind `tracer` as the calling thread's active tracer
+    (NULL_TRACER unbinds).  Balanced bind/unbind pairs per scope keep
+    the process-wide fallback exact."""
+    global _FALLBACK
+    prev = getattr(_TLS_ACTIVE, "tracer", None)
+    _TLS_ACTIVE.tracer = tracer
+    with _ACTIVE_LOCK:
+        if prev is not None and getattr(prev, "enabled", False):
+            _ACTIVE_SET.pop(id(prev), None)
+        if getattr(tracer, "enabled", False):
+            _ACTIVE_SET[id(tracer)] = tracer
+        _FALLBACK = (next(iter(_ACTIVE_SET.values()))
+                     if len(_ACTIVE_SET) == 1 else NULL_TRACER)
 
 
 def get_active():
-    return _ACTIVE
+    tracer = getattr(_TLS_ACTIVE, "tracer", None)
+    if tracer is not None and tracer is not NULL_TRACER:
+        return tracer
+    return _FALLBACK
 
 
 def make_tracer(conf: TpuConf):

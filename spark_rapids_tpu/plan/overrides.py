@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
+import weakref
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Type
 
 import pyarrow as pa
@@ -1496,6 +1498,34 @@ def _expr_refs(e, out: set) -> None:
             _expr_refs(c, out)
 
 
+#: (id(source table), kept column names) -> (weakref(source), pruned
+#: table).  Replanning the same query used to build a FRESH
+#: `table.select(...)` per plan, which broke every identity-anchored
+#: cache downstream — the shared scan-upload cache re-uploaded per
+#: replan, the PR 7 plan-executable anchors never matched, and the
+#: serving result cache keyed each submit differently.  Memoizing the
+#: pruned view (zero-copy: select() shares the source's buffers) makes
+#: the pruned table a stable identity for the source's lifetime.
+_PRUNED_SCAN_TABLES: dict = {}
+_PRUNED_SCAN_LOCK = threading.Lock()
+
+
+def _pruned_scan_table(table, names) -> object:
+    key = (id(table), tuple(names))
+    with _PRUNED_SCAN_LOCK:
+        hit = _PRUNED_SCAN_TABLES.get(key)
+        if hit is not None and hit[0]() is table:
+            return hit[1]
+    pruned = table.select(list(names))
+    try:
+        ref = weakref.ref(table, lambda _r, k=key:
+                          _PRUNED_SCAN_TABLES.pop(k, None))
+    except TypeError:
+        return pruned
+    with _PRUNED_SCAN_LOCK:
+        return _PRUNED_SCAN_TABLES.setdefault(key, (ref, pruned))[1]
+
+
 def prune_columns(plan: L.LogicalPlan, required=None) -> L.LogicalPlan:
     """Column-pruning pre-pass: narrow every in-memory scan to the
     columns the query actually reads (the Catalyst ColumnPruning /
@@ -1517,7 +1547,7 @@ def prune_columns(plan: L.LogicalPlan, required=None) -> L.LogicalPlan:
             return plan
         if not names:                 # keep row counts representable
             names = plan.table.schema.names[:1]
-        return L.LogicalScan(plan.table.select(names))
+        return L.LogicalScan(_pruned_scan_table(plan.table, names))
     if type(plan) is L.LogicalProject:
         keep = [i for i, n in enumerate(plan.names) if n in required]
         if not keep:

@@ -69,13 +69,26 @@ SITES: Dict[str, str] = {
     "shuffle_write": "shuffle map-output write (exec/exchange.py)",
     "shuffle_fetch": "shuffle reduce-side fetch (exec/exchange.py)",
     "exchange": "mesh/multihost collective exchange (parallel/)",
+    "serving": "ServingRuntime admission (serving/runtime.py) — fires "
+               "per submit; kind 'timeout' raises the admission-timeout "
+               "backpressure signal (TenantSession.collect retries it "
+               "once, the bounded-admission recovery rung)",
+    "result_cache": "serving plan+result cache read (serving/cache.py) "
+                    "— kind 'corrupt' flips a byte in the cached IPC "
+                    "payload so the REAL checksum verification detects "
+                    "it, drops the entry and recomputes",
 }
 
-KINDS = ("oom", "ioerror", "corrupt", "fatal", "error")
+KINDS = ("oom", "ioerror", "corrupt", "fatal", "error", "timeout")
 
 #: kinds the corrupt action makes sense for: it needs an on-disk block
-#: path in the fire() info to flip bytes in
-_CORRUPT_SITES = ("spill_read",)
+#: path (spill_read) or an in-memory payload bytearray (result_cache)
+#: in the fire() info to flip bytes in
+_CORRUPT_SITES = ("spill_read", "result_cache")
+
+#: the timeout kind models admission backpressure; only the serving
+#: admission site has that semantic
+_TIMEOUT_SITES = ("serving",)
 
 
 class InjectedIOError(OSError):
@@ -145,6 +158,9 @@ def parse_spec(spec: str) -> List[FaultRule]:
         if kind == "corrupt" and site not in _CORRUPT_SITES:
             raise ValueError(f"kind 'corrupt' only applies to sites "
                              f"{list(_CORRUPT_SITES)}, got {site!r}")
+        if kind == "timeout" and site not in _TIMEOUT_SITES:
+            raise ValueError(f"kind 'timeout' only applies to sites "
+                             f"{list(_TIMEOUT_SITES)}, got {site!r}")
         rule = FaultRule(site, kind)
         if trigger == "always":
             rule.always = True
@@ -227,7 +243,8 @@ class FaultInjector:
                 return
             rec = {"site": site, "kind": fired.kind, "hit": fired.hits,
                    "ts": time.time()}
-            rec.update({k: str(v) for k, v in info.items()})
+            rec.update({k: str(v) for k, v in info.items()
+                        if k != "payload"})   # bulk bytes stay out of logs
             if len(self.log) < 256:
                 self.log.append(rec)
         from ..obs.registry import FAULTS_INJECTED
@@ -251,7 +268,18 @@ class FaultInjector:
             raise InjectedFatalError(msg)
         if kind == "error":
             raise InjectedQueryError(msg)
+        if kind == "timeout":
+            from ..serving.runtime import InjectedAdmissionTimeout
+            raise InjectedAdmissionTimeout(msg)
         if kind == "corrupt":
+            payload = info.get("payload")
+            if isinstance(payload, bytearray) and payload:
+                # in-memory block (serving result cache): flip a payload
+                # byte past the Arrow IPC stream header so the REAL
+                # checksum verification path detects the damage
+                off = min(64, len(payload) - 1)
+                payload[off] ^= 0xFF
+                return
             path = info.get("path")
             if path and os.path.exists(path):
                 _corrupt_block(path)
@@ -300,22 +328,39 @@ def get_injector(conf: TpuConf):
     return inj
 
 
-# The process-wide active injector: sites with no conf in reach (the
-# mesh/multihost exchange collectives) report here.  Installed for the
-# duration of a query's instrumented scope (plan/overrides.py), mirroring
-# the active tracer.
-_ACTIVE: object = NULL_INJECTOR
+# The ACTIVE injector: sites with no conf in reach (the mesh/multihost
+# exchange collectives) report here.  Installed for the duration of a
+# query's instrumented scope (plan/overrides.py), mirroring the active
+# tracer — and like it, the binding is THREAD-LOCAL with a
+# single-active-scope process fallback, so concurrent queries (the
+# serving plane) cannot arm each other's chaos rules or disarm a still-
+# running query's injector at scope exit.
+_TLS_ACTIVE = threading.local()
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_SET: dict = {}           # id(injector) -> injector, in scope
+_FALLBACK: object = NULL_INJECTOR
 
 
 def set_active(injector) -> None:
-    global _ACTIVE
-    _ACTIVE = injector
+    global _FALLBACK
+    prev = getattr(_TLS_ACTIVE, "injector", None)
+    _TLS_ACTIVE.injector = injector
+    with _ACTIVE_LOCK:
+        if prev is not None and getattr(prev, "enabled", False):
+            _ACTIVE_SET.pop(id(prev), None)
+        if getattr(injector, "enabled", False):
+            _ACTIVE_SET[id(injector)] = injector
+        _FALLBACK = (next(iter(_ACTIVE_SET.values()))
+                     if len(_ACTIVE_SET) == 1 else NULL_INJECTOR)
 
 
 def get_active_injector():
-    return _ACTIVE
+    inj = getattr(_TLS_ACTIVE, "injector", None)
+    if inj is not None and inj is not NULL_INJECTOR:
+        return inj
+    return _FALLBACK
 
 
 def fire_active(site: str, **info) -> None:
     """Fire `site` on the active injector (conf-less call sites)."""
-    _ACTIVE.fire(site, **info)
+    get_active_injector().fire(site, **info)

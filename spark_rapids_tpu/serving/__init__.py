@@ -1,0 +1,13 @@
+"""Concurrent serving plane: multi-tenant admission, fair-share device
+scheduling and a plan+result cache over the single-query engine.
+
+Entry point: `TpuSession.serving()` -> ServingRuntime;
+`runtime.tenant(name, weight)` -> TenantSession handles.  See
+docs/SERVING.md for the architecture walkthrough.
+"""
+from .cache import ResultCache
+from .runtime import (AdmissionTimeout, InjectedAdmissionTimeout,
+                      QueryTicket, ServingRuntime, TenantSession)
+
+__all__ = ["AdmissionTimeout", "InjectedAdmissionTimeout", "QueryTicket",
+           "ResultCache", "ServingRuntime", "TenantSession"]

@@ -1,0 +1,185 @@
+"""Plan+result cache for repeated dashboard-style traffic.
+
+Reference shape: interactive BI fleets re-issue the same parameterized
+queries against slowly-changing tables ("Accelerating Presto with GPUs",
+PAPERS.md); serving them from a result cache is the cheapest query the
+device never runs.  The cache key reuses the compile plane's canonical
+machinery (PR 7, exec/compiled.py):
+
+  * `plan_structure_key` — the canonical constant-lifted plan structure
+    (node classes, canonical expression fingerprints, conf signature,
+    backend) with LIFTED literal values erased;
+  * the lifted literal VALUES in canonical preorder (erased from the
+    structure key, but results obviously depend on them);
+  * source-table identity — the key carries `id()` of every scan's
+    source table, and weakref ANCHORS invalidate the entry the moment
+    any of those tables is garbage collected, so a structurally
+    identical query over new data can never see stale rows.
+
+Entries are Arrow IPC stream payloads with a CRC32: a hit deserializes a
+fresh table (bit-identical to the cold run — the IPC round trip is
+exact, and returning a new table means no caller can mutate the cached
+copy), and checksum verification rejects damaged payloads (the
+`result_cache` chaos site corrupts them deliberately) by recomputing.
+Byte-capped LRU; every outcome lands in the always-on
+`tpu_serving_result_cache_total` family.
+"""
+from __future__ import annotations
+
+import io
+import threading
+import weakref
+import zlib
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+import pyarrow as pa
+
+from ..obs.registry import SERVING_RESULT_CACHE
+
+
+class _Entry:
+    __slots__ = ("data", "crc", "nbytes", "refs")
+
+    def __init__(self, data: bytearray, crc: int, refs: list):
+        self.data = data
+        self.crc = crc
+        self.nbytes = len(data)
+        self.refs = refs
+
+
+def result_cache_key(root, conf) -> Optional[Tuple[tuple, list]]:
+    """(key, anchor objects) for a device plan, or None when the plan is
+    not canonically coverable (unknown node classes, un-liftable
+    shapes) — those queries simply bypass the cache."""
+    from ..exec.compiled import collect_plan_literals, plan_structure_key
+    skey = plan_structure_key(root, conf)
+    if skey is None:
+        return None
+    lits = collect_plan_literals(root)
+    if lits is None:
+        return None
+    lit_vals = tuple((type(e.value).__name__, repr(e.value), repr(e.dtype))
+                     for e in lits)
+    anchors = _source_tables(root)
+    key = (skey, lit_vals, tuple(id(a) for a in anchors))
+    return key, anchors
+
+
+def _source_tables(root) -> list:
+    from ..exec.plan import HostScanExec
+    out, stack, seen = [], [root], set()
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        if isinstance(n, HostScanExec) and n._source_table is not None:
+            out.append(n._source_table)
+        stack.extend(getattr(n, "children", ()))
+    return out
+
+
+class ResultCache:
+    """Byte-capped LRU of serialized query results (cap 0 disables)."""
+
+    def __init__(self, cap_bytes: int):
+        self.cap_bytes = int(cap_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._bytes = 0
+
+    # -- stats -------------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "cap_bytes": self.cap_bytes}
+
+    # -- read --------------------------------------------------------------
+    def get(self, key, injector=None) -> Optional[pa.Table]:
+        """The cached result table, or None (miss / anchor died /
+        checksum mismatch — each with its own outcome count)."""
+        if key is None or self.cap_bytes == 0:
+            return None
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._entries[key] = entry          # re-insert: now MRU
+        if entry is None:
+            SERVING_RESULT_CACHE.inc(outcome="miss")
+            return None
+        if any(r() is None for r in entry.refs):
+            # an anchor died between the weakref callback queueing and
+            # now — treat as invalidated, never serve stale data
+            self._drop(key, entry, "invalidate")
+            return None
+        if injector is not None:
+            # chaos `result_cache` site: kind corrupt flips a byte in
+            # THIS entry's payload so the verification below is real
+            injector.fire("result_cache", payload=entry.data)
+        if zlib.crc32(bytes(entry.data)) != entry.crc:
+            self._drop(key, entry, "corrupt")
+            return None
+        table = pa.ipc.open_stream(io.BytesIO(bytes(entry.data))).read_all()
+        SERVING_RESULT_CACHE.inc(outcome="hit")
+        return table
+
+    # -- write -------------------------------------------------------------
+    def put(self, key, table: pa.Table, anchors: List[object]) -> bool:
+        if key is None or self.cap_bytes == 0:
+            return False
+        sink = io.BytesIO()
+        with pa.ipc.new_stream(sink, table.schema) as w:
+            w.write_table(table)
+        data = bytearray(sink.getvalue())
+        if len(data) > self.cap_bytes:
+            return False                 # bigger than the whole cache
+        try:
+            refs = [weakref.ref(a, lambda _r, k=key: self.invalidate(k))
+                    for a in anchors]
+        except TypeError:
+            return False                 # un-weakref-able anchor
+        entry = _Entry(data, zlib.crc32(bytes(data)), refs)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            while self._bytes > self.cap_bytes and len(self._entries) > 1:
+                k, e = next(iter(self._entries.items()))
+                if k == key:             # never evict the fresh entry
+                    break
+                del self._entries[k]
+                self._bytes -= e.nbytes
+                SERVING_RESULT_CACHE.inc(outcome="evict")
+        SERVING_RESULT_CACHE.inc(outcome="store")
+        return True
+
+    # -- invalidation ------------------------------------------------------
+    def invalidate(self, key) -> None:
+        """Drop one entry (weakref death callback / explicit)."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._bytes -= entry.nbytes
+        if entry is not None:
+            SERVING_RESULT_CACHE.inc(outcome="invalidate")
+
+    def _drop(self, key, entry: _Entry, outcome: str) -> None:
+        with self._lock:
+            cur = self._entries.pop(key, None)
+            if cur is entry:
+                self._bytes -= entry.nbytes
+            elif cur is not None:        # replaced concurrently: keep it
+                self._entries[key] = cur
+        SERVING_RESULT_CACHE.inc(outcome=outcome)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0

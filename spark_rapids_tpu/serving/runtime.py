@@ -1,0 +1,525 @@
+"""ServingRuntime: many queries, many tenants, one engine.
+
+The single-query path (`DataFrame.collect`) runs plan -> compile ->
+upload -> execute strictly in sequence and one query at a time; under
+interactive traffic the device idles through every host phase.  This
+runtime is the concurrency layer the ROADMAP's "millions of users" item
+asks for, built ON TOP of the existing substrate rather than beside it:
+
+  * ADMISSION — a bounded queue (`serving.queueDepth`) with a blocking
+    timeout (`serving.admitTimeoutMs`): at the bound, `submit()` applies
+    backpressure and then raises `AdmissionTimeout` — load sheds with a
+    clean, retryable signal at the door instead of a device OOM halfway
+    through a query.  Device-phase overlap is additionally gated by an
+    HBM working-set estimate against the memory budget
+    (`runtime/memory.py` sizing), so concurrent queries queue for HBM
+    instead of betting on the OOM retry ladder.
+  * CONF SNAPSHOT — every query's `TpuConf` is captured at admission; a
+    mid-flight `TpuSession.set_conf` affects only queries admitted
+    after it (TpuConf instances are immutable, `set_conf` swaps them).
+  * PHASE OVERLAP — each admitted query runs its pipeline (plan ->
+    result-cache probe -> compile -> scan upload -> device execute) on
+    a worker thread; compilation routes through the background compile
+    service (`runtime/compile_service.py`, keyed by canonical plan
+    structure so identical-shape tenants' queries compile once) and XLA
+    compiles release the GIL — one query compiles while another holds
+    the device, which is where the `bench.py --serving` QPS-over-serial
+    win comes from.
+  * FAIR SHARE — device-execute grants go through a weighted
+    virtual-time scheduler: each tenant accumulates measured device
+    microseconds divided by its weight, and the runnable tenant with
+    the LEAST virtual time runs next, with a hard starvation bound
+    (`serving.starvationBound` consecutive pass-overs forces a grant).
+    Per-tenant device time feeds `tpu_serving_tenant_device_us_total`
+    from the same integer measurement the ticket records, so registry
+    totals and per-ticket sums agree exactly.
+  * RESULT CACHE — see serving/cache.py.
+
+Surfaces: `TpuSession.serving()` -> ServingRuntime;
+`runtime.tenant("bi", weight=2.0)` -> TenantSession with
+`submit()`/`collect()`; `runtime.stats()` for the live picture; the
+`tpu_serving_*` metric families for Prometheus.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+from ..config import (HBM_BUDGET_BYTES, HBM_BUDGET_FRACTION,
+                      SERVING_ADMIT_TIMEOUT_MS,
+                      SERVING_ADMIT_WORKING_SET_FACTOR,
+                      SERVING_DEVICE_SLOTS, SERVING_QUEUE_DEPTH,
+                      SERVING_RESULT_CACHE_BYTES, SERVING_STARVATION_BOUND,
+                      SERVING_WORKERS, TpuConf)
+from ..obs.registry import (SERVING_ADMIT_WAIT_MS, SERVING_DEVICE_BUSY_US,
+                            SERVING_QUERIES, SERVING_TENANT_DEVICE_US)
+from ..obs.registry import SERVING_QUEUE_DEPTH as QUEUE_DEPTH_GAUGE
+from .cache import ResultCache, result_cache_key
+
+
+class AdmissionTimeout(RuntimeError):
+    """The admission queue stayed at queueDepth past admitTimeoutMs —
+    the backpressure signal.  Retryable by construction: nothing was
+    admitted, nothing ran."""
+
+
+class InjectedAdmissionTimeout(AdmissionTimeout):
+    """Chaos-harness form (`serving:timeout:...`,  runtime/faults.py)."""
+
+
+class QueryTicket:
+    """One admitted query's handle: state, timings, result."""
+
+    _SEQ_LOCK = threading.Lock()
+    _SEQ = 0
+
+    def __init__(self, plan, conf: TpuConf, tenant: str):
+        with QueryTicket._SEQ_LOCK:
+            QueryTicket._SEQ += 1
+            self.id = QueryTicket._SEQ
+        self.plan = plan                  # logical plan (DataFrame._plan)
+        self.conf = conf                  # admission-time snapshot
+        self.tenant = tenant
+        self.cache = "bypass"             # hit | miss | store | bypass
+        self.plan_kind = None             # "device" | "host" once planned
+        self.device_us = 0                # measured device-execute micros
+        self.skips = 0                    # scheduler pass-overs at grant
+        self.admit_wait_ms = 0.0
+        self.phases: Dict[str, float] = {}     # phase -> wall seconds
+        self.error: Optional[BaseException] = None
+        self._table: Optional[pa.Table] = None
+        self._done = threading.Event()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = 600.0) -> pa.Table:
+        """Block for the result; re-raises the query's failure here."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"serving query #{self.id} (tenant {self.tenant!r}) did "
+                f"not finish within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self._table
+
+    def _complete(self, table: pa.Table) -> None:
+        self._table = table
+        self._done.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self.error = exc
+        self._done.set()
+
+
+class _TenantState:
+    __slots__ = ("name", "weight", "vtime_us", "skips", "queue",
+                 "queries", "device_us")
+
+    def __init__(self, name: str, weight: float):
+        self.name = name
+        self.weight = float(weight)
+        self.vtime_us = 0.0          # device_us / weight, accumulated
+        self.skips = 0               # consecutive pass-overs while runnable
+        self.queue: List[QueryTicket] = []
+        self.queries = 0
+        self.device_us = 0
+
+
+class TenantSession:
+    """Per-tenant handle: the unit client code holds.
+
+    `collect()` retries ONE AdmissionTimeout (genuine backpressure and
+    the chaos `serving:timeout` site both surface there) — a dashboard
+    refresh should survive a momentary full queue without caller retry
+    loops; sustained overload still raises."""
+
+    def __init__(self, runtime: "ServingRuntime", name: str):
+        self._runtime = runtime
+        self.name = name
+
+    def submit(self, df) -> QueryTicket:
+        return self._runtime.submit(df, tenant=self.name)
+
+    def collect(self, df, timeout: Optional[float] = 600.0) -> pa.Table:
+        try:
+            ticket = self.submit(df)
+        except AdmissionTimeout:
+            ticket = self.submit(df)      # one bounded re-admission
+        return ticket.result(timeout)
+
+
+class ServingRuntime:
+    def __init__(self, session, conf_overrides: Optional[dict] = None):
+        self._session = session
+        rconf = session.conf
+        if conf_overrides:
+            rconf = TpuConf({**rconf._raw, **conf_overrides})
+        self._rconf = rconf
+        self._overrides = dict(conf_overrides or {})
+        # merged-conf cache: ONE TpuConf per session-conf instance, so
+        # the fault injector / typed-value caches riding the conf keep
+        # stable counters across submits (a fresh merge per submit
+        # would reset deterministic nth= chaos triggers)
+        self._merged = (None, None)
+        self._queue_depth = rconf.get(SERVING_QUEUE_DEPTH)
+        self._admit_timeout_s = rconf.get(SERVING_ADMIT_TIMEOUT_MS) / 1e3
+        self._device_slots = rconf.get(SERVING_DEVICE_SLOTS)
+        if self._device_slots == 0:
+            # auto: on an accelerator, the GpuSemaphore sizing
+            # (concurrentTpuTasks) — the chip pipelines dispatches and
+            # one query's host tail overlaps another's compute.  On the
+            # CPU backend "device compute" IS host compute: concurrent
+            # XLA CPU programs each size their intra-op pool to all
+            # cores and thrash (measured 5x throughput collapse), so
+            # device phases serialize and only host phases overlap.
+            import jax
+            if jax.default_backend() == "cpu":
+                self._device_slots = 1
+            else:
+                from ..config import CONCURRENT_TPU_TASKS
+                self._device_slots = rconf.get(CONCURRENT_TPU_TASKS)
+        self._starvation_bound = rconf.get(SERVING_STARVATION_BOUND)
+        self._ws_factor = rconf.get(SERVING_ADMIT_WORKING_SET_FACTOR)
+        self.cache = ResultCache(rconf.get(SERVING_RESULT_CACHE_BYTES))
+        self._hbm_limit = self._device_budget_bytes(rconf)
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(
+            max_workers=rconf.get(SERVING_WORKERS),
+            thread_name_prefix="tpu-serving")
+        self._cond = threading.Condition()
+        self._tenants: Dict[str, _TenantState] = {}
+        self._inflight = 0               # admitted, not yet finished
+        self._device_active = 0
+        self._device_bytes = 0           # working-set estimates admitted
+        self._closed = False
+        # -- stats (under _cond) -------------------------------------
+        self._t0 = time.perf_counter()
+        self._busy_us = 0
+        self._max_skips = 0
+        self._max_depth = 0
+        self._completed = 0
+        self._admission_timeouts = 0
+        #: recent (phase, ticket id, t0, t1) intervals — the overlap
+        #: proof stats()["overlap_observed"] is computed from
+        self._intervals: List[tuple] = []
+
+    # -- construction helpers ---------------------------------------------
+    @staticmethod
+    def _device_budget_bytes(conf: TpuConf) -> int:
+        """The HBM byte budget device-phase admission schedules against
+        (0 = undiscoverable = unlimited) — same sizing rule as
+        runtime/memory.py MemoryBudget."""
+        limit = conf.get(HBM_BUDGET_BYTES)
+        if limit == 0:
+            from ..runtime.memory import device_hbm_bytes
+            hbm = device_hbm_bytes()
+            limit = int(hbm * conf.get(HBM_BUDGET_FRACTION)) if hbm else 0
+        return limit
+
+    def tenant(self, name: str, weight: float = 1.0) -> TenantSession:
+        """The tenant handle (registers the tenant; weight sticks —
+        re-calling with a new weight updates it)."""
+        with self._cond:
+            st = self._tenants.get(name)
+            if st is None:
+                self._tenants[name] = _TenantState(name, weight)
+            else:
+                st.weight = float(weight)
+        return TenantSession(self, name)
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, df, tenant: str = "default",
+               conf: Optional[TpuConf] = None) -> QueryTicket:
+        """Admit one query (blocking up to admitTimeoutMs when the queue
+        is full) and start its pipeline.  `df` is a DataFrame or a
+        logical plan; the session conf is SNAPSHOT here, at admission."""
+        if self._closed:
+            raise RuntimeError("ServingRuntime is closed")
+        # the snapshot: TpuConf instances are immutable — grabbing the
+        # reference pins this query's behavior against later set_conf
+        snap = conf or self._session.conf
+        if conf is None and self._overrides:
+            with self._cond:
+                if self._merged[0] is not snap:
+                    self._merged = (
+                        snap, TpuConf({**snap._raw, **self._overrides}))
+                snap = self._merged[1]
+        from ..runtime.faults import get_injector
+        injector = get_injector(snap)
+        injector.fire("serving", tenant=tenant)
+        plan = getattr(df, "_plan", df)
+        ticket = QueryTicket(plan, snap, tenant)
+        t0 = time.perf_counter()
+        deadline = t0 + self._admit_timeout_s
+        with self._cond:
+            while self._inflight >= self._queue_depth:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    self._admission_timeouts += 1
+                    SERVING_QUERIES.inc(tenant=tenant,
+                                        status="admission_timeout")
+                    raise AdmissionTimeout(
+                        f"serving queue at depth {self._queue_depth} for "
+                        f"{self._admit_timeout_s:.1f}s (tenant "
+                        f"{tenant!r}) — shed load or raise "
+                        f"spark.rapids.tpu.serving.queueDepth")
+                self._cond.wait(remaining)
+            if self._closed:
+                raise RuntimeError("ServingRuntime is closed")
+            self._inflight += 1
+            self._max_depth = max(self._max_depth, self._inflight)
+            if tenant not in self._tenants:
+                self._tenants[tenant] = _TenantState(tenant, 1.0)
+        waited_ms = (time.perf_counter() - t0) * 1e3
+        ticket.admit_wait_ms = waited_ms
+        SERVING_ADMIT_WAIT_MS.observe(waited_ms)
+        QUEUE_DEPTH_GAUGE.set(self._inflight)
+        self._pool.submit(self._run, ticket, injector)
+        return ticket
+
+    # -- the per-query pipeline (one worker thread) ------------------------
+    def _run(self, ticket: QueryTicket, injector) -> None:
+        try:
+            out = self._pipeline(ticket, injector)
+            ticket._complete(out)
+            SERVING_QUERIES.inc(
+                tenant=ticket.tenant,
+                status="cache_hit" if ticket.cache == "hit" else "ok")
+        except BaseException as e:                   # noqa: BLE001
+            ticket._fail(e)
+            SERVING_QUERIES.inc(tenant=ticket.tenant, status="error")
+        finally:
+            with self._cond:
+                self._inflight -= 1
+                self._completed += 1
+                self._cond.notify_all()
+            QUEUE_DEPTH_GAUGE.set(self._inflight)
+
+    def _phase(self, name: str, ticket: QueryTicket):
+        runtime = self
+
+        @contextmanager
+        def scope():
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                t1 = time.perf_counter()
+                ticket.phases[name] = ticket.phases.get(name, 0.0) \
+                    + (t1 - t0)
+                with runtime._cond:
+                    runtime._intervals.append((name, ticket.id, t0, t1))
+                    if len(runtime._intervals) > 4096:
+                        del runtime._intervals[:2048]
+        return scope()
+
+    def _pipeline(self, ticket: QueryTicket, injector) -> pa.Table:
+        from ..plan.overrides import apply_overrides
+        with self._phase("plan", ticket):
+            q = apply_overrides(ticket.plan, ticket.conf)
+        ticket.plan_kind = q.kind
+        keyed = None
+        if self.cache.cap_bytes and q.kind == "device":
+            keyed = result_cache_key(q.root, ticket.conf)
+        if keyed is not None:
+            hit = self.cache.get(keyed[0], injector)
+            if hit is not None:
+                ticket.cache = "hit"
+                return hit
+            ticket.cache = "miss"
+        with self._phase("compile", ticket):
+            self._compile(q, ticket)
+        with self._phase("upload", ticket):
+            est_bytes = self._upload(q, ticket)
+        with self._device_grant(ticket, est_bytes):
+            with self._phase("execute", ticket):
+                from ..exec.plan import ExecContext
+                ctx = ExecContext(ticket.conf)
+                ctx.metrics["serving.tenant"] = ticket.tenant
+                t0 = time.perf_counter()
+                out = q.collect(ctx)
+                ticket.device_us = int(
+                    (time.perf_counter() - t0) * 1e6)
+        if keyed is not None and ticket.error is None:
+            if self.cache.put(keyed[0], out, keyed[1]):
+                ticket.cache = "store"
+        return out
+
+    def _compile(self, q, ticket: QueryTicket) -> None:
+        """AOT-compile the whole-plan program through the background
+        compile service: dedupe-keyed by canonical plan structure, so N
+        tenants submitting the same dashboard shape pay ONE compile;
+        injected `compile` chaos faults re-raise here, on the consuming
+        thread, where the existing recovery ladders live."""
+        if q.kind != "device" or not q._whole_plan_enabled():
+            return
+        from ..exec.compiled import plan_structure_key
+        from ..runtime.compile_service import get_service
+        skey = plan_structure_key(q.root, ticket.conf)
+        key = ("serving-compile", skey if skey is not None else ticket.id)
+        task = get_service(ticket.conf).submit(key, q.prewarm)
+        task.wait()
+        get_service(ticket.conf).take(key)
+
+    def _upload(self, q, ticket: QueryTicket) -> int:
+        """Host-IO phase: push every scan's source table through the
+        shared upload cache NOW, outside the device grant, so uploads
+        overlap other queries' device execution.  Returns the HBM
+        working-set estimate admission schedules with."""
+        src_bytes = 0
+        if q.kind == "device":
+            from ..exec.compiled import _shared_scan_upload
+            from ..exec.plan import HostScanExec
+            stack, seen = [q.root], set()
+            while stack:
+                n = stack.pop()
+                if id(n) in seen:
+                    continue
+                seen.add(id(n))
+                if isinstance(n, HostScanExec) and \
+                        n._source_table is not None:
+                    src_bytes += int(n._source_table.nbytes)
+                    try:
+                        _shared_scan_upload(n, ticket.conf)
+                    except Exception:                # noqa: BLE001
+                        pass      # the execute path re-tries with retry
+                stack.extend(getattr(n, "children", ()))
+        return int(src_bytes * self._ws_factor)
+
+    # -- fair-share device scheduling --------------------------------------
+    def _runnable(self, st: _TenantState) -> bool:
+        """A tenant's head ticket can run now: a device slot argument is
+        checked by the caller; here only the HBM-fit gate (a query that
+        can never fit runs alone — progress over perfection)."""
+        if not st.queue:
+            return False
+        est = st.queue[0]._grant_est
+        if self._hbm_limit <= 0:
+            return True
+        if self._device_bytes + est <= self._hbm_limit:
+            return True
+        return self._device_active == 0      # too big: run it solo
+
+    def _try_grant(self, ticket: QueryTicket) -> bool:
+        """Under _cond: grant `ticket` the next device slot iff the
+        weighted virtual-time scheduler (with the starvation override)
+        picks it right now.  Mutates skip counters exactly once per
+        actual grant."""
+        if self._device_active >= self._device_slots:
+            return False
+        runnable = [st for st in self._tenants.values()
+                    if self._runnable(st)]
+        if not runnable:
+            return False
+        starving = [st for st in runnable
+                    if st.skips >= self._starvation_bound]
+        if starving:
+            pick = max(starving, key=lambda s: (s.skips, -s.vtime_us))
+        else:
+            pick = min(runnable, key=lambda s: (s.vtime_us, s.name))
+        if pick.queue[0] is not ticket:
+            return False
+        # commit: this ticket runs — exactly one skip bump per grant
+        pick.queue.pop(0)
+        ticket.skips = pick.skips
+        self._max_skips = max(self._max_skips, pick.skips)
+        pick.skips = 0
+        for st in runnable:
+            if st is not pick and st.queue:
+                st.skips += 1
+        self._device_active += 1
+        self._device_bytes += ticket._grant_est
+        return True
+
+    @contextmanager
+    def _device_grant(self, ticket: QueryTicket, est_bytes: int):
+        ticket._grant_est = int(est_bytes)
+        with self._cond:
+            st = self._tenants[ticket.tenant]
+            st.queue.append(ticket)
+            # state changed: a waiter whose tenant just became the
+            # scheduler's pick must re-evaluate
+            self._cond.notify_all()
+            while not self._try_grant(ticket):
+                if self._closed:
+                    st.queue.remove(ticket)
+                    raise RuntimeError("ServingRuntime closed while "
+                                       "waiting for a device grant")
+                self._cond.wait(0.5)
+        try:
+            yield
+        finally:
+            with self._cond:
+                st.vtime_us += ticket.device_us / st.weight
+                st.queries += 1
+                st.device_us += ticket.device_us
+                self._busy_us += ticket.device_us
+                self._device_active -= 1
+                self._device_bytes -= ticket._grant_est
+                self._cond.notify_all()
+            SERVING_TENANT_DEVICE_US.inc(ticket.device_us,
+                                         tenant=ticket.tenant)
+            SERVING_DEVICE_BUSY_US.inc(ticket.device_us)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        with self._cond:
+            wall_s = time.perf_counter() - self._t0
+            tenants = {st.name: {"weight": st.weight,
+                                 "queries": st.queries,
+                                 "device_us": st.device_us,
+                                 "vtime_us": round(st.vtime_us, 1),
+                                 "waiting": len(st.queue)}
+                       for st in self._tenants.values()}
+            intervals = list(self._intervals)
+            busy_us = self._busy_us
+            out = {"inflight": self._inflight,
+                   "completed": self._completed,
+                   "max_queue_depth": self._max_depth,
+                   "max_skips": self._max_skips,
+                   "admission_timeouts": self._admission_timeouts,
+                   "device_slots": self._device_slots,
+                   "hbm_limit_bytes": self._hbm_limit,
+                   "wall_s": round(wall_s, 3),
+                   "device_busy_us": busy_us,
+                   "device_utilization": round(
+                       busy_us / 1e6 / (wall_s * self._device_slots), 4)
+                   if wall_s > 0 else 0.0,
+                   "tenants": tenants,
+                   "result_cache": self.cache.stats()}
+        out["overlap_observed"] = _overlap_observed(intervals)
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, wait: bool = True) -> None:
+        """Stop accepting queries; `wait` drains in-flight ones."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._pool.shutdown(wait=wait)
+
+    def __enter__(self) -> "ServingRuntime":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _overlap_observed(intervals: List[tuple]) -> bool:
+    """True when any host-side phase (plan/compile/upload) of one query
+    ran concurrently with another query's device execute — the
+    structural proof the pipeline actually overlaps phases."""
+    execs = [(t0, t1, tid) for name, tid, t0, t1 in intervals
+             if name == "execute"]
+    for name, tid, t0, t1 in intervals:
+        if name == "execute":
+            continue
+        for e0, e1, etid in execs:
+            if etid != tid and t0 < e1 and e0 < t1:
+                return True
+    return False

@@ -15,6 +15,7 @@ DataFrames whose `collect()` runs wrap->tag->convert->execute.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional, Sequence, Tuple
 
 import pyarrow as pa
@@ -32,6 +33,8 @@ class TpuSession:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = conf if isinstance(conf, TpuConf) else TpuConf(conf)
         self._last_ctx: Optional[ExecContext] = None
+        self._conf_lock = threading.Lock()
+        self._serving = None
         # always-on metrics plane: apply the enabled flag / recorder
         # capacity and start any conf'd exporters (heartbeat JSONL,
         # Prometheus endpoint) as soon as a session exists
@@ -44,13 +47,39 @@ class TpuSession:
         configure_persistent_cache(self.conf)
 
     def set_conf(self, key: str, value) -> None:
-        raw = dict(self.conf._raw)
-        raw[key] = value
-        self.conf = TpuConf(raw)
+        """Atomic conf swap: TpuConf instances are immutable, so a
+        query that snapshot the old instance (every query snapshots at
+        plan/admission time — DataFrame.physical, ServingRuntime.submit)
+        keeps its behavior for its whole flight; only queries admitted
+        AFTER this call see the new value.  The lock serializes
+        concurrent set_conf calls so neither's key is lost."""
+        with self._conf_lock:
+            raw = dict(self.conf._raw)
+            raw[key] = value
+            self.conf = TpuConf(raw)
+            new_conf = self.conf
         from .obs.export import configure_plane
-        configure_plane(self.conf)
+        configure_plane(new_conf)
         from .exec.compiled import configure_persistent_cache
-        configure_persistent_cache(self.conf)
+        configure_persistent_cache(new_conf)
+
+    def serving(self, conf_overrides: Optional[Dict] = None):
+        """The session's ServingRuntime (created on first call): the
+        concurrent serving plane — multi-tenant admission with bounded
+        backpressure, fair-share device scheduling, phase-overlapped
+        execution and the plan+result cache (serving/runtime.py,
+        docs/SERVING.md).
+
+            rt = session.serving()
+            bi = rt.tenant("bi", weight=2.0)
+            table = bi.collect(df)        # or bi.submit(df).result()
+
+        `conf_overrides` apply only on the CREATING call (they shape the
+        runtime: worker counts, queue depth, cache bytes)."""
+        if self._serving is None:
+            from .serving.runtime import ServingRuntime
+            self._serving = ServingRuntime(self, conf_overrides)
+        return self._serving
 
     def close(self) -> None:
         """Shut the session's process-wide exporters down cleanly: the
@@ -58,7 +87,12 @@ class TpuSession:
         joined, and the listen port is released — so repeated session
         open/close in one process cannot leak threads or ports.  The
         metrics registry itself (process-wide, cheap) stays; a later
-        TpuSession restarts exporters from its conf.  Idempotent."""
+        TpuSession restarts exporters from its conf.  Idempotent.
+        A serving runtime created by `serving()` is drained and closed
+        first."""
+        if self._serving is not None:
+            self._serving.close()
+            self._serving = None
         from .obs.export import shutdown_exporters
         shutdown_exporters()
 

@@ -529,6 +529,116 @@ def test_tpcds_full_recoverable_sweep(tpcds_tables, qname, faults):
 
 
 # ---------------------------------------------------------------------------
+# serving plane: admission-timeout and result-cache corruption recovery
+# ---------------------------------------------------------------------------
+
+def _serving_fixture(faults=None, **serving_settings):
+    settings = {"spark.rapids.tpu.sql.compile.wholePlan": "ON",
+                **serving_settings}
+    if faults:
+        settings["spark.rapids.tpu.test.faults"] = faults
+    s = TpuSession(settings)
+    from spark_rapids_tpu.plan.aggregates import Sum
+    tbl = pa.table({"k": [i % 5 for i in range(400)],
+                    "x": [float(i) for i in range(400)]})
+    build = lambda: s.from_arrow(tbl).filter(       # noqa: E731
+        E.GreaterThan(col("x"), E.Literal(7.0))).group_by("k").agg(
+        (Sum(col("x")), "sx"))
+    return s, build
+
+
+def test_serving_admission_timeout_recovers_bit_identical():
+    """`serving:timeout:nth=1` (the admission-backpressure fault): the
+    tenant handle's single bounded re-admission recovers and the result
+    is bit-identical to the clean run — under CONCURRENT load, every
+    other in-flight query unaffected."""
+    from spark_rapids_tpu.serving import InjectedAdmissionTimeout
+    s_clean, build_clean = _serving_fixture()
+    clean = build_clean().collect()
+    s, build = _serving_fixture(
+        faults="serving:timeout:nth=3",
+        **{"spark.rapids.tpu.serving.workers": "4",
+           "spark.rapids.tpu.serving.resultCache.bytes": "0"})
+    try:
+        rt = s.serving()
+        a = rt.tenant("a")
+        # 6 concurrent submits through collect(): hit #3 fires the
+        # injected timeout; the handle re-admits once and succeeds
+        import threading
+        results, errs = [], []
+
+        def client():
+            try:
+                results.append(a.collect(build()))
+            except Exception as e:                   # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(6)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs, errs
+        assert len(results) == 6
+        for r in results:
+            assert_identical(clean, r)
+        log = get_injector(s.conf).log
+        assert [r["site"] for r in log] == ["serving"]
+        assert log[0]["kind"] == "timeout"
+        # the raw submit path DOES surface the classified signal
+        s2, build2 = _serving_fixture(faults="serving:timeout:nth=1")
+        rt2 = s2.serving()
+        with pytest.raises(InjectedAdmissionTimeout):
+            rt2.submit(build2())
+        s2.close()
+    finally:
+        s.close()
+        s_clean.close()
+
+
+def test_result_cache_corrupt_recomputes_bit_identical():
+    """`result_cache:corrupt:nth=1`: the first cache READ gets its IPC
+    payload corrupted in place; the REAL checksum verification rejects
+    it, the entry drops, the query recomputes — bit-identical — and the
+    refreshed entry serves the next hit."""
+    from spark_rapids_tpu.obs.registry import SERVING_RESULT_CACHE
+    s_clean, build_clean = _serving_fixture()
+    clean = build_clean().collect()
+    s, build = _serving_fixture(faults="result_cache:corrupt:nth=1")
+    try:
+        rt = s.serving()
+        a = rt.tenant("a")
+        c0 = SERVING_RESULT_CACHE.value(outcome="corrupt") or 0
+        h0 = SERVING_RESULT_CACHE.value(outcome="hit") or 0
+        first = a.collect(build())       # miss + store
+        second = a.collect(build())      # read -> corrupt -> recompute
+        third = a.collect(build())       # clean hit off the re-store
+        for r in (first, second, third):
+            assert_identical(clean, r)
+        assert (SERVING_RESULT_CACHE.value(outcome="corrupt") or 0) \
+            - c0 == 1
+        assert (SERVING_RESULT_CACHE.value(outcome="hit") or 0) - h0 >= 1
+        log = get_injector(s.conf).log
+        assert [r["site"] for r in log] == ["result_cache"]
+        assert "payload" not in log[0]   # bulk bytes stay out of logs
+    finally:
+        s.close()
+        s_clean.close()
+
+
+def test_serving_fault_kind_gates():
+    """`timeout` only means something at the admission site; `corrupt`
+    only at sites with a payload (a disk block or a cached result)."""
+    parse_spec("serving:timeout:nth=1")
+    parse_spec("result_cache:corrupt:nth=1")
+    parse_spec("spill_read:corrupt:nth=1")
+    with pytest.raises(ValueError):
+        parse_spec("reserve:timeout:nth=1")
+    with pytest.raises(ValueError):
+        parse_spec("execute:corrupt:nth=1")
+
+
+# ---------------------------------------------------------------------------
 # coverage lint: every registered site is exercised by this file
 # ---------------------------------------------------------------------------
 

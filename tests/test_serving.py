@@ -1,0 +1,530 @@
+"""Concurrent serving plane: admission, fair share, the plan+result
+cache, conf snapshots and concurrent event logs (serving/runtime.py,
+serving/cache.py — docs/SERVING.md).
+"""
+import gc
+import glob
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.obs.registry import (SERVING_RESULT_CACHE,
+                                           SERVING_TENANT_DEVICE_US)
+from spark_rapids_tpu.plan.aggregates import Count, Sum
+from spark_rapids_tpu.serving import AdmissionTimeout
+from spark_rapids_tpu.session import TpuSession, col, lit
+
+WHOLE_PLAN = {"spark.rapids.tpu.sql.compile.wholePlan": "ON"}
+
+
+def _table(n=600, seed=0):
+    return pa.table({"k": [(i + seed) % 7 for i in range(n)],
+                     "x": [float(i % 101) for i in range(n)],
+                     "y": list(range(n))})
+
+
+def _query(session, table, cut=10):
+    return (session.from_arrow(table)
+            .filter(col("y") > lit(cut))
+            .group_by("k").agg((Sum(col("x")), "sx"),
+                               (Count(None), "ct")))
+
+
+def _outcome(name):
+    return SERVING_RESULT_CACHE.value(outcome=name) or 0
+
+
+def _rows(table):
+    """Order-insensitive row multiset (group-by output order differs
+    between the device and host engines)."""
+    d = table.to_pydict()
+    names = sorted(d)
+    return sorted(zip(*(d[n] for n in names)))
+
+
+# ---------------------------------------------------------------------------
+# basics
+# ---------------------------------------------------------------------------
+
+def test_submit_collect_matches_plain():
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        t = _table()
+        df = _query(s, t)
+        expected = df.collect()
+        rt = s.serving()
+        got = rt.tenant("a").collect(df)
+        assert got.to_pydict() == expected.to_pydict()
+        st = rt.stats()
+        assert st["completed"] == 1 and st["inflight"] == 0
+        assert st["tenants"]["a"]["queries"] == 1
+    finally:
+        s.close()
+
+
+def test_result_cache_hit_bit_identical():
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        t = _table()
+        df = _query(s, t)
+        rt = s.serving()
+        a = rt.tenant("a")
+        h0, s0 = _outcome("hit"), _outcome("store")
+        cold = a.collect(df)
+        warm = a.collect(df)
+        assert _outcome("store") - s0 >= 1
+        assert _outcome("hit") - h0 >= 1
+        # bit-identical: the IPC round trip preserves exact bytes
+        assert warm.equals(cold.select(warm.column_names)) or \
+            warm.to_pydict() == cold.to_pydict()
+        assert warm.schema == cold.schema
+    finally:
+        s.close()
+
+
+def test_result_cache_literal_variants_no_false_sharing():
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        t = _table()
+        rt = s.serving()
+        a = rt.tenant("a")
+        r10 = a.collect(_query(s, t, cut=10))
+        r50 = a.collect(_query(s, t, cut=50))
+        assert r10.to_pydict() == _query(s, t, cut=10).collect().to_pydict()
+        assert r50.to_pydict() == _query(s, t, cut=50).collect().to_pydict()
+        assert r10.to_pydict() != r50.to_pydict()
+        # and each repeat still hits its OWN entry
+        assert a.collect(_query(s, t, cut=10)).to_pydict() == \
+            r10.to_pydict()
+    finally:
+        s.close()
+
+
+def test_result_cache_invalidated_when_table_dies():
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        rt = s.serving()
+        a = rt.tenant("a")
+        i0 = _outcome("invalidate")
+        t2 = _table(seed=3)
+        tk = a.submit(_query(s, t2))
+        tk.result()
+        assert len(rt.cache) >= 1
+        before = len(rt.cache)
+        del tk, t2
+        gc.collect()
+        assert len(rt.cache) == before - 1
+        assert _outcome("invalidate") - i0 >= 1
+    finally:
+        s.close()
+
+
+def test_result_cache_byte_cap_evicts_lru():
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        rt = s.serving(
+            {"spark.rapids.tpu.serving.resultCache.bytes": "900"})
+        a = rt.tenant("a")
+        e0 = _outcome("evict")
+        t = _table()
+        a.collect(_query(s, t, cut=10))
+        a.collect(_query(s, t, cut=50))
+        a.collect(_query(s, t, cut=90))
+        assert _outcome("evict") - e0 >= 1
+        assert rt.cache.stats()["bytes"] <= 900
+    finally:
+        s.close()
+
+
+def test_result_cache_disabled_bypasses():
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        rt = s.serving(
+            {"spark.rapids.tpu.serving.resultCache.bytes": "0"})
+        a = rt.tenant("a")
+        t = _table()
+        tk = a.submit(_query(s, t))
+        tk.result()
+        assert tk.cache == "bypass"
+        assert len(rt.cache) == 0
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# admission / backpressure
+# ---------------------------------------------------------------------------
+
+def test_admission_backpressure_times_out():
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        rt = s.serving({
+            "spark.rapids.tpu.serving.queueDepth": "1",
+            "spark.rapids.tpu.serving.admitTimeoutMs": "120",
+            "spark.rapids.tpu.serving.workers": "1",
+            "spark.rapids.tpu.serving.resultCache.bytes": "0"})
+        a = rt.tenant("a")
+        slow = s.from_arrow(_table(64)).map_in_pandas(
+            lambda it: (_sleep_frame(f) for f in it),
+            pa.schema([("k", pa.int64()), ("x", pa.float64()),
+                       ("y", pa.int64())]))
+        tk = a.submit(slow)                      # fills the queue
+        with pytest.raises(AdmissionTimeout):
+            a.submit(_query(s, _table()))
+        tk.result()                              # drains
+        # and a post-drain submit admits instantly again
+        got = a.collect(_query(s, _table()))
+        assert got.num_rows > 0
+        assert rt.stats()["admission_timeouts"] == 1
+    finally:
+        s.close()
+
+
+def _sleep_frame(f):
+    time.sleep(1.0)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# conf snapshot at admission (satellite: set_conf vs in-flight queries)
+# ---------------------------------------------------------------------------
+
+def test_conf_snapshot_at_admission_beats_set_conf_race():
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        rt = s.serving({
+            "spark.rapids.tpu.serving.workers": "1",
+            "spark.rapids.tpu.serving.resultCache.bytes": "0"})
+        a = rt.tenant("a")
+        t = _table()
+        expected = _rows(_query(s, t).collect())
+        # occupy the single worker so tk1 PLANS after the conf flip
+        slow = s.from_arrow(_table(64)).map_in_pandas(
+            lambda it: (_sleep_frame(f) for f in it),
+            pa.schema([("k", pa.int64()), ("x", pa.float64()),
+                       ("y", pa.int64())]))
+        tk0 = a.submit(slow)
+        tk1 = a.submit(_query(s, t))     # snapshot taken HERE
+        s.set_conf("spark.rapids.tpu.sql.enabled", "false")
+        tk2 = a.submit(_query(s, t))     # admitted after the flip
+        tk0.result()
+        r1, r2 = tk1.result(), tk2.result()
+        # tk1 planned AFTER the flip but was admitted before it: its
+        # snapshot keeps the device plan; tk2 honors the new conf
+        assert tk1.plan_kind == "device"
+        assert tk2.plan_kind == "host"
+        assert _rows(r1) == expected
+        assert _rows(r2) == expected
+    finally:
+        s.set_conf("spark.rapids.tpu.sql.enabled", "true")
+        s.close()
+
+
+def test_set_conf_concurrent_flips_never_corrupt_results():
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        rt = s.serving(
+            {"spark.rapids.tpu.serving.resultCache.bytes": "0"})
+        a = rt.tenant("a")
+        t = _table()
+        expected = _rows(_query(s, t).collect())
+        stop = threading.Event()
+
+        def flipper():
+            i = 0
+            while not stop.is_set():
+                s.set_conf("spark.rapids.tpu.sql.enabled",
+                           "false" if i % 2 else "true")
+                i += 1
+                time.sleep(0.002)
+
+        th = threading.Thread(target=flipper)
+        th.start()
+        try:
+            tickets = [a.submit(_query(s, t)) for _ in range(12)]
+            results = [tk.result() for tk in tickets]
+        finally:
+            stop.set()
+            th.join()
+        for r in results:
+            assert _rows(r) == expected
+    finally:
+        s.set_conf("spark.rapids.tpu.sql.enabled", "true")
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# event logs under concurrency (satellite: filename/id collisions)
+# ---------------------------------------------------------------------------
+
+def test_concurrent_event_logs_distinct_ids(tmp_path):
+    s = TpuSession({**WHOLE_PLAN,
+                    "spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    try:
+        t = _table()
+        dfs = [_query(s, t, cut=10), _query(s, t, cut=50)]
+        errs = []
+        barrier = threading.Barrier(2)
+
+        def run(df):
+            try:
+                barrier.wait()          # same-instant starts
+                df.collect()
+            except Exception as e:      # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=run, args=(df,)) for df in dfs]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs
+        logs = sorted(glob.glob(str(tmp_path / "*.jsonl")))
+        assert len(logs) == 2, logs
+        from spark_rapids_tpu.obs.tracer import read_event_log
+        parsed = [read_event_log(p) for p in logs]
+        ids = [p.query_id for p in parsed]
+        assert len(set(ids)) == 2       # process-unique, no collision
+        for p in parsed:
+            # each log is self-consistent: exactly one root query span,
+            # its own metrics, no cross-contamination from the sibling
+            roots = [sp for sp in p.spans if sp.cat == "query"]
+            assert len(roots) == 1
+            assert not p.truncated
+    finally:
+        s.close()
+
+
+def test_event_log_write_never_overwrites(tmp_path):
+    """Two processes (or a restart) sharing one log dir: same id twice
+    must yield two files, not one overwritten file."""
+    from spark_rapids_tpu.obs.tracer import QueryTracer, read_event_log
+    tr = QueryTracer(7)
+    with tr.span("query", "query"):
+        pass
+    p1 = tr.write(str(tmp_path))["jsonl"]
+    p2 = tr.write(str(tmp_path))["jsonl"]
+    assert p1 != p2
+    assert read_event_log(p1).query_id == read_event_log(p2).query_id == 7
+
+
+def test_query_ids_monotonic_across_threads(tmp_path):
+    from spark_rapids_tpu.obs.tracer import make_tracer
+    conf = TpuConf({"spark.rapids.tpu.trace.enabled": "true"})
+    out = []
+    lock = threading.Lock()
+
+    def grab():
+        for _ in range(50):
+            tr = make_tracer(conf)
+            with lock:
+                out.append(tr.query_id)
+
+    threads = [threading.Thread(target=grab) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(set(out)) == 200         # unique under contention
+    assert max(out) - min(out) == 199   # and monotonic (no gaps/reuse)
+
+
+# ---------------------------------------------------------------------------
+# fair share: the 8-thread hammer
+# ---------------------------------------------------------------------------
+
+def test_fair_share_hammer_eight_threads():
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        rt = s.serving({
+            "spark.rapids.tpu.serving.workers": "8",
+            "spark.rapids.tpu.serving.resultCache.bytes": "0"})
+        t = _table()
+        tenants = ["bi", "etl", "adhoc", "batch"]
+        weights = {"bi": 2.0, "etl": 1.0, "adhoc": 1.0, "batch": 0.5}
+        handles = {n: rt.tenant(n, weight=weights[n]) for n in tenants}
+        cuts = {"bi": 5, "etl": 25, "adhoc": 45, "batch": 65}
+        expected = {n: _query(s, t, cut=cuts[n]).collect().to_pydict()
+                    for n in tenants}
+        d0 = {n: SERVING_TENANT_DEVICE_US.value(tenant=n) or 0
+              for n in tenants}
+        tickets = {n: [] for n in tenants}
+        errs = []
+        barrier = threading.Barrier(8)
+
+        def client(name, reps=4):
+            try:
+                barrier.wait()
+                for _ in range(reps):
+                    tk = handles[name].submit(_query(s, t, cut=cuts[name]))
+                    tk.result()
+                    with lock:
+                        tickets[name].append(tk)
+            except Exception as e:       # noqa: BLE001
+                errs.append(e)
+
+        lock = threading.Lock()
+        threads = [threading.Thread(target=client, args=(n,))
+                   for n in tenants for _ in range(2)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs, errs
+        st = rt.stats()
+        # (a) starvation bound: a runnable tenant is never passed over
+        # more than starvationBound grants (+ one round when several hit
+        # the bound together)
+        bound = 4 + len(tenants)
+        assert st["max_skips"] <= bound, st
+        for name in tenants:
+            for tk in tickets[name]:
+                assert tk.skips <= bound
+        # (b) per-tenant device time: registry total == per-ticket sum
+        # EXACTLY (integer microseconds; publication order cannot
+        # perturb an integer counter)
+        for name in tenants:
+            reg = (SERVING_TENANT_DEVICE_US.value(tenant=name) or 0) \
+                - d0[name]
+            assert reg == sum(tk.device_us for tk in tickets[name])
+        # (c) zero cross-tenant result leakage: every ticket's rows are
+        # its own tenant's query's rows
+        for name in tenants:
+            assert len(tickets[name]) == 8
+            for tk in tickets[name]:
+                assert tk.result().to_pydict() == expected[name]
+        assert st["completed"] == 32
+    finally:
+        s.close()
+
+
+def test_scheduler_prefers_least_weighted_vtime_and_starving():
+    """White-box scheduler unit: min virtual time wins; a tenant past
+    the starvation bound preempts everyone."""
+    from spark_rapids_tpu.serving.runtime import QueryTicket, _TenantState
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        rt = s.serving({"spark.rapids.tpu.serving.workers": "1"})
+        a, b = _TenantState("a", 1.0), _TenantState("b", 1.0)
+        rt._tenants = {"a": a, "b": b}
+        ta = QueryTicket(None, s.conf, "a")
+        tb = QueryTicket(None, s.conf, "b")
+        ta._grant_est = tb._grant_est = 0
+        a.vtime_us, b.vtime_us = 100.0, 50.0
+        a.queue, b.queue = [ta], [tb]
+        with rt._cond:
+            assert not rt._try_grant(ta)     # b has less virtual time
+            assert rt._try_grant(tb)
+            rt._device_active = 0
+            # starving a overrides b's lower vtime
+            b.queue = [tb]
+            a.skips = rt._starvation_bound
+            b.vtime_us = 0.0
+            assert not rt._try_grant(tb)
+            assert rt._try_grant(ta)
+            assert ta.skips == rt._starvation_bound
+            rt._device_active = 0
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# phase overlap
+# ---------------------------------------------------------------------------
+
+def test_phases_overlap_across_queries():
+    """The structural overlap proof: with several workers, some query's
+    host phase (plan/compile/upload) runs while ANOTHER query holds the
+    device — the device-never-idles-while-compiling property the
+    serving plane exists for."""
+    s = TpuSession(dict(WHOLE_PLAN))
+    try:
+        rt = s.serving({
+            "spark.rapids.tpu.serving.workers": "4",
+            "spark.rapids.tpu.serving.resultCache.bytes": "0"})
+        a = rt.tenant("a")
+        t = _table(2000)
+        # distinct plan STRUCTURES so each pays its own plan+compile
+        dfs = [
+            _query(s, t, cut=10),
+            s.from_arrow(t).filter(col("x") > lit(1.0))
+             .group_by("k").agg((Count(None), "n")),
+            s.from_arrow(t).join(s.from_arrow(_table(50, seed=1)),
+                                 on="k").group_by("k")
+             .agg((Sum(col("x")), "sx")),
+            s.from_arrow(t).sort(col("y")).limit(17),
+        ] * 2
+        tickets = [a.submit(df) for df in dfs]
+        for tk in tickets:
+            tk.result()
+        assert rt.stats()["overlap_observed"], rt.stats()
+    finally:
+        s.close()
+
+
+def test_check_regression_gates_sv_entries(tmp_path):
+    """scripts/check_regression.py mines `serving_latency_ms` into
+    sv:-prefixed entries and fails on a 2x p99 regression, under the
+    same backend-separation rule as qN / mc: timings."""
+    import json
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(root, "scripts", "check_regression.py")
+    base = {"backend": "cpu",
+            "serving_latency_ms": {"c8_p99": 1000.0, "c8_mean": 400.0}}
+    good = {"backend": "cpu",
+            "serving_latency_ms": {"c8_p99": 1050.0, "c8_mean": 380.0}}
+    bad = {"backend": "cpu",
+           "serving_latency_ms": {"c8_p99": 2000.0, "c8_mean": 900.0}}
+    other_hw = {"backend": "tpu",
+                "serving_latency_ms": {"c8_p99": 2000.0,
+                                       "c8_mean": 900.0}}
+    paths = {}
+    for name, doc in (("base", base), ("good", good), ("bad", bad),
+                      ("other", other_hw)):
+        p = tmp_path / f"{name}.json"
+        p.write_text(json.dumps(doc))
+        paths[name] = str(p)
+
+    def gate(current, trajectory):
+        return subprocess.run(
+            [sys.executable, script, "--current", current, *trajectory],
+            capture_output=True, text=True)
+
+    r = gate(paths["good"], [paths["base"]])
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = gate(paths["bad"], [paths["base"]])
+    assert r.returncode == 1
+    assert "sv:c8_p99" in r.stdout
+    # backend separation: a tpu-tagged 2x result never gates against
+    # the cpu baseline
+    r = gate(paths["other"], [paths["base"]])
+    assert r.returncode == 2 or "skipping" in r.stdout + r.stderr
+
+
+def test_hbm_admission_gates_device_overlap():
+    """With a tiny HBM budget, working-set estimates serialize device
+    phases instead of overlapping them — and everything still
+    completes correctly (queue, don't OOM)."""
+    s = TpuSession({**WHOLE_PLAN,
+                    "spark.rapids.tpu.memory.tpu.budgetBytes":
+                        str(1 << 30)})
+    try:
+        rt = s.serving({
+            "spark.rapids.tpu.serving.workers": "4",
+            "spark.rapids.tpu.serving.deviceSlots": "2",
+            "spark.rapids.tpu.serving.resultCache.bytes": "0"})
+        assert rt._hbm_limit == (1 << 30)
+        a = rt.tenant("a")
+        t = _table()
+        expected = _query(s, t).collect().to_pydict()
+        tickets = [a.submit(_query(s, t)) for _ in range(6)]
+        for tk in tickets:
+            assert tk.result().to_pydict() == expected
+    finally:
+        s.close()
