@@ -146,6 +146,7 @@ class Suite:
         self.per_q = {}
         self.skipped = []
         self.compiled_ct = 0
+        self.extra_conf = {}
         # metrics-plane A/B: q6 warm wall with the always-on registry +
         # flight recorder active vs spark.rapids.tpu.metrics.enabled=false
         # (the overhead bound the metrics plane claims — docs/METRICS.md)
@@ -202,6 +203,7 @@ class Suite:
             f"{self.name}_suite_geomean_speedup": round(geomean, 3),
             f"{self.name}_suite_geomean_speedup_net": round(geomean_net, 3),
             "backend": jax.default_backend(),
+            "extra_conf": self.extra_conf,
             "coverage": self.coverage(),
             "queries_measured": len(self.per_q),
             "errors": errors,
@@ -244,6 +246,12 @@ class Suite:
         print(json.dumps(out), flush=True)
 
 
+#: --conf key=value session overrides (applied to the DEVICE session
+#: only; the CPU oracle baseline never sees them) — how the committed
+#: kernel-tier bench rounds flip spark.rapids.tpu.sql.kernels.pallas.*
+EXTRA_CONF = {}
+
+
 def run_suite(suite_name: str, scale: float, query_names):
     import importlib
     workload = importlib.import_module(f"spark_rapids_tpu.{suite_name}")
@@ -267,10 +275,12 @@ def run_suite(suite_name: str, scale: float, query_names):
     # engine than the one the headline number claims to measure
     from spark_rapids_tpu.config import COMPILE_CACHE_DIR, WHOLE_PLAN_COMPILE
     dev = TpuSession({WHOLE_PLAN_COMPILE.key: "ON",
-                      COMPILE_CACHE_DIR.key: BENCH_CACHE_DIR})
+                      COMPILE_CACHE_DIR.key: BENCH_CACHE_DIR,
+                      **EXTRA_CONF})
     cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
 
     suite = Suite(suite_name, scale, rtt)
+    suite.extra_conf = dict(EXTRA_CONF)
     for name in query_names:
         if left() < 20:
             suite.skipped.append(name)
@@ -383,7 +393,8 @@ def run_compile_only(suite_name: str, scale: float, query_names):
 
     tables = workload.gen_tables(scale=scale)
     dev = TpuSession({WHOLE_PLAN_COMPILE.key: "ON",
-                      COMPILE_CACHE_DIR.key: BENCH_CACHE_DIR})
+                      COMPILE_CACHE_DIR.key: BENCH_CACHE_DIR,
+                      **EXTRA_CONF})
     service = get_service(dev.conf)
     tasks = []
     for name in query_names:
@@ -416,6 +427,122 @@ def run_compile_only(suite_name: str, scale: float, query_names):
            "elapsed_s": round(time.perf_counter() - _T0, 1),
            "final": True}
     print(json.dumps(out), flush=True)
+
+
+#: --kernels microbench sizes (rows) and skew levels
+KERNEL_SIZES = {"256k": 1 << 18, "1m": 1 << 20, "4m": 1 << 22}
+KERNEL_SKEWS = ("uniform", "skewed")
+
+
+def run_kernels():
+    """--kernels: Pallas-vs-sorted A/B microbenchmarks of the three
+    kernel families (ISSUE 11) at 3 sizes x 2 skew levels, emitting
+    `kernel_timings_ms` entries scripts/check_regression.py gates under
+    the `kn:` prefix (same backend-separation rule as qN device_ms).
+
+    Shapes: probe = hash-probe join primitive (build table + aligned
+    probe of N rows against an N/8-row build side) vs the sorted-lane
+    merge-rank probe; segagg = 32-bucket segmented int64 sums (the
+    block-accumulate matmul kernel vs jax.ops.segment_sum); compact =
+    10%-selectivity compaction order (rank search vs keep-mask
+    argsort).  'skewed' concentrates 90% of probe/segment rows on 1%
+    of the key space — the collision/hot-bucket regime.  Pallas
+    kernels run interpreted off-TPU (the same discharged bodies the
+    query path dispatches)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.join import _merge_rank
+    from spark_rapids_tpu.ops.pallas import hashjoin as HK
+    from spark_rapids_tpu.ops.pallas.compact import \
+        compaction_order as pallas_order
+    from spark_rapids_tpu.ops.pallas.segagg import _seg_matmul_sums
+    from spark_rapids_tpu.ops.filter import compaction_order
+    interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(17)
+    out = {}
+
+    def timed(name, fn):
+        jax.block_until_ready(fn())                      # compile+warm
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            times.append(time.perf_counter() - t0)
+        out[name] = round(min(times) * 1e3, 2)
+        print(f"# {name}: {out[name]}ms", file=sys.stderr)
+
+    for sname, n in KERNEL_SIZES.items():
+        if left() < 60:
+            print(f"# budget: skipping kernel size {sname}",
+                  file=sys.stderr)
+            continue
+        b = n // 8
+        for skew in KERNEL_SKEWS:
+            if skew == "uniform":
+                pk = rng.integers(0, b, n)
+            else:
+                hot = rng.integers(0, max(b // 100, 1), n)
+                cold = rng.integers(0, b, n)
+                pk = np.where(rng.random(n) < 0.9, hot, cold)
+            bkeys = jnp.asarray(np.arange(b) * 7 + 3, jnp.int64)
+            pkeys = jnp.asarray(pk * 7 + 3, jnp.int64)
+            bvalid = jnp.ones((b,), bool)
+            pvalid = jnp.ones((n,), bool)
+
+            def probe_pallas():
+                tbl = HK.build_table(bkeys, bvalid, interpret)
+                return HK.probe_first(tbl, pkeys, pvalid)
+
+            @jax.jit
+            def probe_sorted(bkeys, pkeys):
+                sh = jnp.sort(HK.mix64(bkeys))
+                return _merge_rank(sh, HK.mix64(pkeys), side="left")
+
+            timed(f"probe_{sname}_{skew}_pallas", probe_pallas)
+            timed(f"probe_{sname}_{skew}_sorted",
+                  lambda: probe_sorted(bkeys, pkeys))
+
+            seg = jnp.asarray(pk % 32, jnp.int32)
+            lanes = [jnp.asarray(rng.integers(-(10 ** 12), 10 ** 12, n),
+                                 jnp.int64) for _ in range(4)]
+
+            def segagg_pallas():
+                return _seg_matmul_sums(seg, lanes, [], 32, n, interpret)
+
+            @jax.jit
+            def segagg_scatter(seg, stacked):
+                return jax.ops.segment_sum(stacked, seg, num_segments=32)
+            stacked = jnp.stack(lanes, axis=1)
+            timed(f"segagg_{sname}_{skew}_pallas", segagg_pallas)
+            timed(f"segagg_{sname}_{skew}_scatter",
+                  lambda: segagg_scatter(seg, stacked))
+
+            keep = jnp.asarray(rng.random(n) < 0.1)
+            timed(f"compact_{sname}_{skew}_pallas",
+                  lambda: pallas_order(keep, interpret))
+            timed(f"compact_{sname}_{skew}_sorted",
+                  lambda: compaction_order(keep))
+
+    ratios = {}
+    for k in sorted(out):
+        if k.endswith("_pallas"):
+            base = out.get(k.replace("_pallas", "_sorted"),
+                           out.get(k.replace("_pallas", "_scatter")))
+            if base:
+                ratios[k[:-7]] = round(out[k] / base, 3)
+    print(json.dumps({
+        "mode": "kernels",
+        "metric": "kernel_microbench_pallas_vs_sorted",
+        "value": round(float(np.exp(np.mean(np.log(
+            [max(r, 1e-6) for r in ratios.values()])))), 3)
+        if ratios else None,
+        "unit": "x (pallas/sorted, lower is better)",
+        "backend": jax.default_backend(),
+        "interpret": interpret,
+        "kernel_timings_ms": out,
+        "pallas_over_sorted_ratio": ratios,
+        "elapsed_s": round(time.perf_counter() - _T0, 1),
+        "final": True}), flush=True)
 
 
 #: default serving mix: a fast, join/agg-diverse TPC-H tranche (clients
@@ -662,13 +789,24 @@ def main():
     suite_name = "tpch"
     compile_only = False
     serving = False
+    kernels = False
     multichip = False
     multichip_sf = 10.0
     args = list(sys.argv[1:])
     i = 0
     while i < len(args):
         a = args[i]
-        if a.startswith("--queries"):
+        if a.startswith("--conf"):
+            if a.startswith("--conf="):
+                kv = a[len("--conf="):]
+            else:
+                i += 1
+                kv = args[i]
+            k, _, v = kv.partition("=")
+            EXTRA_CONF[k] = v
+        elif a == "--kernels":
+            kernels = True
+        elif a.startswith("--queries"):
             if "=" in a:
                 names = a.split("=", 1)[1].split(",")
             else:
@@ -711,6 +849,10 @@ def main():
     query_names = names or sorted(workload.QUERIES,
                                   key=lambda q: int(q[1:]))
 
+    if kernels:
+        # Pallas-vs-sorted kernel microbench A/B (KERNELS_r*.json)
+        run_kernels()
+        return
     if serving:
         # concurrent closed-loop serving sweep (names = the mix)
         run_serving(suite_name, scale, names)

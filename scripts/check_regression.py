@@ -139,6 +139,42 @@ def extract_serving(doc):
     return {}, None
 
 
+def extract_kernels(doc):
+    """-> ({'kn:<entry>': ms}, backend or None) from a bench.py
+    --kernels result: the `kernel_timings_ms` A/B dict (pallas vs
+    sorted per kernel family / size / skew, lower = better) becomes
+    `kn:`-prefixed entries that gate like per-query device_ms under
+    the same backend-separation rule (never colliding with qN / mc: /
+    sv: names).  Accepts the runner's JSON line, the driver wrapper,
+    and a tail."""
+    if not isinstance(doc, dict):
+        return {}, None
+    tim = doc.get("kernel_timings_ms")
+    if isinstance(tim, dict) and tim:
+        out = {f"kn:{k}": float(v) for k, v in tim.items()
+               if isinstance(v, (int, float))}
+        return out, str(doc.get("backend") or _DEFAULT_BACKEND)
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        out, backend = extract_kernels(parsed)
+        if out:
+            return out, backend
+    tail = doc.get("tail")
+    if isinstance(tail, str) and "kernel_timings_ms" in tail:
+        for line in reversed(tail.splitlines()):
+            if "kernel_timings_ms" not in line:
+                continue
+            try:
+                rec = json.loads(line.strip())
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out, backend = extract_kernels(rec)
+                if out:
+                    return out, backend
+    return {}, None
+
+
 def _rec_ms(rec: dict, rtt_ms: float):
     """Net-of-floor milliseconds for one per-query record: the explicit
     `device_ms_net` when the bench emitted it, else `device_ms` minus
@@ -267,6 +303,13 @@ def load_file(path: str):
         qs = {**qs, **sv}
         if (not backend or backend == _DEFAULT_BACKEND) and sv_backend:
             backend = sv_backend
+    kn, kn_backend = extract_kernels(doc)
+    if kn:
+        # kernel-microbench entries gate under their kn: prefix; a pure
+        # kernels record carries its own backend tag
+        qs = {**qs, **kn}
+        if (not backend or backend == _DEFAULT_BACKEND) and kn_backend:
+            backend = kn_backend
     return qs, backend, extract_compile_ms(doc)
 
 
@@ -308,7 +351,8 @@ def _median(vals: list):
 def default_trajectory() -> list:
     return (sorted(glob.glob(os.path.join(_ROOT, "BENCH_r*.json"))) +
             sorted(glob.glob(os.path.join(_ROOT, "MULTICHIP_r*.json"))) +
-            sorted(glob.glob(os.path.join(_ROOT, "SERVING_r*.json"))))
+            sorted(glob.glob(os.path.join(_ROOT, "SERVING_r*.json"))) +
+            sorted(glob.glob(os.path.join(_ROOT, "KERNELS_r*.json"))))
 
 
 def compare(current: dict, baseline: dict, threshold: float,

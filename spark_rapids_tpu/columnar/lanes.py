@@ -285,10 +285,13 @@ def compact_thin(db: DeviceBatch, keep: jax.Array,
     compaction order as usual; each deferred column is gathered ONCE,
     straight from its source into compacted position (the lane composes
     with the order — no materialize-then-compact double pass)."""
-    from ..ops.filter import compaction_order, grouped_take
+    from ..ops.filter import (compaction_order, grouped_take,
+                              pallas_compact_order)
     ts = db.thin
     assert ts is not None
-    order = compaction_order(keep)
+    order = pallas_compact_order(keep, conf)
+    if order is None:
+        order = compaction_order(keep)
     count = jnp.sum(keep, dtype=jnp.int32)
     live_out = jnp.arange(db.capacity, dtype=jnp.int32) < count
     out_cols: List[Optional[DeviceColumn]] = [None] * len(db.columns)

@@ -191,13 +191,37 @@ def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
     else:
         pack = None if domains is not None \
             else _key_pack_spec(key_cols, key_ranges)
+    # Pallas block-accumulate segmented aggregation (ops/pallas/segagg):
+    # any fully-bounded key tuple — dense domains or a complete pack —
+    # whose span product fits the block accumulator aggregates with no
+    # sort, no scatter and no row permutation at all
+    pallas_interp = None
+    full_pack = pack if (pack is not None and
+                         all(s is not None for s in pack)) else \
+        (_domains_as_pack(domains) if domains is not None else None)
+    if full_pack is not None and conf is not None:
+        total = 1
+        for _lo, span in full_pack:
+            total *= int(span)
+        from ..ops.pallas import elect_segagg
+        has_float_sum = any(s.kind == G.SUM and t.is_floating(s.dtype)
+                            for s in specs)
+        ptier = elect_segagg(conf, total, has_float_sum)
+        if ptier is not None:
+            pack, domains = full_pack, None
+            pallas_interp = ptier.interpret
     sig = (info, tuple((s.kind, s.input_idx, s.dtype) for s in specs),
            capacity, tuple(str(c.data.dtype) for c in agg_cols),
            tuple(domains) if domains else None, pack, scatter_free,
-           max_ops)
+           max_ops, pallas_interp)
     fn = _GROUPBY_CACHE.get(sig)
     if fn is None:
-        if domains is not None:
+        if pallas_interp is not None:
+            from ..ops.pallas.segagg import pallas_groupby_trace
+            fn = jax.jit(pallas_groupby_trace(pack, list(info),
+                                              list(specs), capacity,
+                                              capacity, pallas_interp))
+        elif domains is not None:
             fn = jax.jit(G.dense_groupby_trace(list(domains), list(specs),
                                                capacity))
         else:
@@ -426,6 +450,24 @@ class HashAggregate:
             pack, dense_domains = _domains_as_pack(dense_domains), None
         elif dense_domains is None:
             pack = _fused_pack_spec(self.key_exprs, self.key_ranges)
+        # Pallas block-accumulate election, mirroring _run_groupby
+        pallas_interp = None
+        full_pack = pack if (pack is not None and self.key_exprs and
+                             all(s is not None for s in pack)) else \
+            (_domains_as_pack(dense_domains)
+             if dense_domains is not None else None)
+        if full_pack is not None:
+            total = 1
+            for _lo, span in full_pack:
+                total *= int(span)
+            from ..ops.pallas import elect_segagg
+            has_float_sum = any(
+                s.kind == G.SUM and t.is_floating(s.dtype)
+                for s in self.update_specs)
+            ptier = elect_segagg(self.conf, total, has_float_sum)
+            if ptier is not None:
+                pack, dense_domains = full_pack, None
+                pallas_interp = ptier.interpret
         has_sel = db.sel is not None
         from ..config import AGG_INPUT_NARROWING
         _narrow_on = self.conf.get(AGG_INPUT_NARROWING)
@@ -438,7 +480,8 @@ class HashAggregate:
                        ("fpartial", spec_sig, len(conds),
                         len(self.key_exprs),
                         tuple(dense_domains) if dense_domains else None,
-                        pack, has_sel, narrow, scatter_free, max_ops))
+                        pack, has_sel, narrow, scatter_free, max_ops,
+                        pallas_interp))
         fn = _JIT_CACHE.get(key)
         if fn is None:
             capacity = db.capacity
@@ -484,7 +527,12 @@ class HashAggregate:
                     kds.append(dv.data)
                     kvs.append(valid_or_true(dv.validity, capacity))
                     kinfo.append((e.dtype, True, str(dv.data.dtype)))
-                if dense_domains is not None:
+                if pallas_interp is not None:
+                    from ..ops.pallas.segagg import pallas_groupby_trace
+                    gb = pallas_groupby_trace(pack, kinfo, specs,
+                                              capacity, capacity,
+                                              pallas_interp)
+                elif dense_domains is not None:
                     gb = G.dense_groupby_trace(list(dense_domains), specs,
                                                capacity)
                 else:

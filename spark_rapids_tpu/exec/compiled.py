@@ -372,7 +372,15 @@ def plan_structure_key(root: PlanNode, conf: TpuConf) -> Optional[tuple]:
     if not walk(root):
         return None
     conf_sig = tuple(sorted((k, str(v)) for k, v in conf._raw.items()))
-    return (tuple(parts), conf_sig, jax.default_backend())
+    # kernel-tier discriminant: the RESOLVED Pallas tier (which depends
+    # on backend AUTO rules, not just the raw conf strings already in
+    # conf_sig) keys the executable, so cached programs compiled with
+    # hand-written kernels can never cross-load into a sort-tier
+    # session or vice versa (ops/pallas.tier_discriminant; None when
+    # the tier is fully off)
+    from ..ops.pallas import tier_discriminant
+    return (tuple(parts), conf_sig, jax.default_backend(),
+            tier_discriminant(conf))
 
 
 def _plan_anchors(root: PlanNode, pairs) -> Optional[list]:

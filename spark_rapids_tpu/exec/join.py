@@ -506,6 +506,19 @@ class HashJoinExec(PlanNode):
                 pack_span, build_batch.capacity) else None
         else:
             domain = self._dense_domain(build_keys, build_batch.capacity)
+        # Pallas hash-probe tier: replaces the sorted-build + merge-rank
+        # search always, and the dense direct-address tables under the
+        # denseReplace policy (span-sized offs sorts dominate the dense
+        # build past ~4x the build rows; below it its one-gather probes
+        # win).  Single-exact-lane legality finishes inside BuildTable.
+        from ..ops.pallas import elect_join
+        dense_span = None if domain is None \
+            else int(domain[1]) - int(domain[0]) + 1
+        pallas_tier = elect_join(ctx.conf, build_batch.capacity,
+                                 dense_span=dense_span)
+        if pallas_tier is not None:
+            domain = None               # the hash table takes the join
+            ctx.bump("join_pallas_hash")
         unique = domain is not None and self._build_unique()
         if domain is not None:
             ctx.bump("join_dense_domain")
@@ -517,7 +530,8 @@ class HashJoinExec(PlanNode):
                              dense_via_sort=ctx.conf.get(
                                  JOIN_DENSE_BUILD_VIA_SORT),
                              matched_via_merge=ctx.conf.get(
-                                 JOIN_MATCHED_VIA_MERGE))
+                                 JOIN_MATCHED_VIA_MERGE),
+                             pallas_tier=pallas_tier)
         out_names = list(self.output_schema.names)
         # Sync-free probe-aligned path: a build side whose keys are unique
         # (exact plan statistics — dimension scans, group-by outputs) makes

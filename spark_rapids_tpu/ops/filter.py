@@ -77,9 +77,26 @@ def take_keys_valid(keys, keys_valid, extra, idx):
     return moved[:nk], out_kv, moved[nk + len(kv):]
 
 
-def _compact_trace(ncols: int, has_hi: Tuple[bool, ...]):
+def pallas_compact_order(keep: jax.Array, conf: TpuConf):
+    """Elected Pallas compaction order for this keep-mask, or None on
+    the sorted tier (ops/pallas/compact.py — prefix sum + rank search
+    instead of the keep-mask argsort)."""
+    from .pallas import elect_compact
+    tier = elect_compact(conf, int(keep.shape[0]))
+    if tier is None:
+        return None
+    from .pallas.compact import compaction_order as pallas_order
+    return pallas_order(keep, tier.interpret)
+
+
+def _compact_trace(ncols: int, has_hi: Tuple[bool, ...],
+                   pallas_interpret=None):
     def run(datas, valids, his, keep):
-        order = compaction_order(keep)
+        if pallas_interpret is not None:
+            from .pallas.compact import compaction_order as pallas_order
+            order = pallas_order(keep, pallas_interpret)
+        else:
+            order = compaction_order(keep)
         count = jnp.sum(keep, dtype=jnp.int32)
         lanes = []
         for i in range(ncols):
@@ -127,11 +144,16 @@ def compact_batch(db: DeviceBatch, keep: jax.Array,
             return db
         return shrink_to_rows(db, int(db.num_rows), conf)
     has_hi = tuple(c.data_hi is not None for c in db.columns)
+    from .pallas import elect_compact
+    tier = elect_compact(conf, db.capacity)
+    pallas_interpret = None if tier is None else tier.interpret
     sig = (db.num_columns, has_hi, db.capacity,
-           tuple(str(c.data.dtype) for c in db.columns))
+           tuple(str(c.data.dtype) for c in db.columns),
+           pallas_interpret)
     fn = _COMPACT_CACHE.get(sig)
     if fn is None:
-        fn = jax.jit(_compact_trace(db.num_columns, has_hi))
+        fn = jax.jit(_compact_trace(db.num_columns, has_hi,
+                                    pallas_interpret))
         _COMPACT_CACHE[sig] = fn
     if any(has_hi):
         zeros = jnp.zeros((db.capacity,), jnp.int64)

@@ -1,0 +1,87 @@
+"""Selection compaction: prefix-sum + rank-search order, in Pallas.
+
+The portable compaction is ONE stable int8-key argsort of the inverted
+keep-mask (ops/filter.compaction_order) — sort-shaped because XLA
+lowers it well, but still an O(n log n) full-lane sort for what is
+logically a prefix sum.  This kernel replaces it for selective
+predicates: the keep-mask's blocked inclusive prefix sum assigns every
+kept row its output rank; the kernel grids over OUTPUT blocks and
+finds, for each output slot j < count, the source row via a vectorized
+binary search over the monotone rank lane — log2(capacity) rounds of
+gathers on ONE int32 lane, instead of sorting every row of every lane
+class.  Slots past the kept count keep identity order (their validity
+dies under the live mask downstream, exactly like the argsort tail).
+
+The fused shape the filter path gets: mask evaluate (already traced
+into the same program) -> blocked_cumsum -> this kernel -> the shared
+grouped_take gather — no sort equation in the emitted program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..kernels import blocked_cumsum
+
+_COMPACT_CACHE = {}
+
+
+def _out_block(capacity: int) -> int:
+    capacity = max(capacity, 1)
+    blk = min(capacity, 1 << 20)
+    while blk > 1 and capacity % blk:
+        blk >>= 1
+    return blk if capacity // blk <= 64 else capacity
+
+
+def compaction_order(keep: jax.Array, interpret: bool) -> jax.Array:
+    """Indices bringing keep=True rows to the front, stably — the
+    Pallas analogue of ops/filter.compaction_order.  The tail (slots
+    >= count) is identity, not the dropped rows: every consumer masks
+    validity beyond the kept count, so only the front order is
+    contractual."""
+    cap = int(keep.shape[0])
+    sig = ("order", cap, interpret)
+    fn = _COMPACT_CACHE.get(sig)
+    if fn is None:
+        fn = jax.jit(_order_trace(cap, interpret))
+        _COMPACT_CACHE[sig] = fn
+    return fn(keep)
+
+
+def _order_trace(cap: int, interpret: bool):
+    blk = _out_block(cap)
+    grid = max(1, cap // blk)
+    rounds = max(1, (max(cap, 1) - 1).bit_length() + 1)
+
+    def kernel(cum_ref, ord_ref):
+        j = pl.program_id(0) * blk + \
+            jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)[:, 0]
+        tgt = j + 1
+        lo = jnp.zeros((blk,), jnp.int32)
+        hi = jnp.full((blk,), cap, jnp.int32)
+
+        def body(_, c):
+            lo, hi = c
+            mid = jnp.minimum((lo + hi) // 2, cap - 1)
+            go_hi = cum_ref[mid] < tgt
+            return (jnp.where(go_hi, mid + 1, lo),
+                    jnp.where(go_hi, hi, mid))
+
+        lo, _ = jax.lax.fori_loop(0, rounds, body, (lo, hi))
+        total = cum_ref[cap - 1]
+        ord_ref[...] = jnp.where(j < total,
+                                 jnp.minimum(lo, cap - 1), j)
+
+    def run(keep):
+        cum = blocked_cumsum(keep.astype(jnp.int32))
+        return pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((cap,), lambda i: (0,))],
+            out_specs=pl.BlockSpec((blk,), lambda i: (i,)),
+            out_shape=jax.ShapeDtypeStruct((cap,), jnp.int32),
+            interpret=interpret,
+        )(cum)
+    return run

@@ -1,0 +1,393 @@
+"""Hash-probe equi-join kernels: murmur3 open addressing in Pallas.
+
+The portable tier probes a SORTED build lane with `_merge_rank` — two
+2-operand sorts over build+probe rows per probe op — because binary
+search's log2(n) dependent gathers are the slowest access pattern on
+TPU.  This kernel family replaces the search with a real open-
+addressing hash table in the layout TPUs (and the interpreter) like:
+
+  * slot = top bits of the murmur3 (fmix64) finalizer of the canonical
+    int64 key lane.  fmix64 is a BIJECTION on 64 bits, so two lanes are
+    equal iff their hashes are equal — no collision verification pass.
+  * The table is built in HASH ORDER: build rows sort once by hash
+    (dead/null-key rows last), each row's final slot is
+    `i + prefix_max(ideal_slot - i)` — the classic linear-probing
+    invariant materialized by one blocked prefix max, no insertion
+    loop, no contention.  Equal keys land in CONSECUTIVE slots in
+    ascending build-row order (stable sort), so duplicate handling is
+    run-length arithmetic, never chain walking: a probe row's matches
+    are table positions [first, first+count) and pair expansion is a
+    pure gather.
+  * Probes grid over probe blocks: each block walks `slot, slot+1, ...`
+    with vectorized gathers until every lane hit its key or an empty
+    slot (row == -1).  The linear-probing invariant guarantees no gap
+    between a key's ideal slot and its run.
+
+Table sizing: S = 2^ceil(log2(2*cap)) home slots (load factor <= 0.5)
+plus a cap-row overflow tail so pushed runs never wrap — probes only
+ever walk forward.  Contracts mirror ops/join exactly: `probe_first`
+is the unique-build aligned probe, `probe_matched` the semi/anti flag,
+`probe_counts`/`expand_pairs` the sized gather-map path; all outputs
+are bit-identical to the sorted tier (same pair order: probe-major,
+build rows ascending within a key).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..kernels import blocked_cummax, blocked_cumsum
+
+_HASH_CACHE = {}
+
+
+def mix64(x: jax.Array) -> jax.Array:
+    """murmur3 fmix64 finalizer over uint64 lanes (a 64-bit bijection:
+    equal hashes <=> equal lanes, so probes never verify twice)."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> 33)) * jnp.uint64(0xFF51AFD7ED558CCD)
+    x = (x ^ (x >> 33)) * jnp.uint64(0xC4CEB9FE1A85EC53)
+    return x ^ (x >> 33)
+
+
+class HashTable(NamedTuple):
+    """Hash-ordered open-addressing table over one canonical int64 lane.
+
+    keys[s] is the lane value at slot s (meaningful only where
+    rows[s] >= 0); rows[s] is the build row id, -1 = empty.  nbits is
+    the home-slot width (S = 1 << nbits), span the physical slot count
+    (S + build capacity overflow tail)."""
+    keys: jax.Array          # int64[span]
+    rows: jax.Array          # int32[span]
+    nbits: int
+    span: int
+    interpret: bool
+
+
+def _probe_block(capacity: int) -> int:
+    """Probe grid block: the largest power-of-two divisor of capacity
+    up to 512k rows — big blocks amortize the interpreter's
+    per-grid-step carry copies, and an exact divisor means the
+    interpreter never pads blocks with uninitialized rows (the build
+    kernel stores at computed positions, so junk rows must not exist).
+    Falls back to one whole-capacity block for odd capacities."""
+    capacity = max(capacity, 1)
+    blk = min(capacity, 1 << 19)
+    while blk > 1 and capacity % blk:
+        blk >>= 1
+    # pathological (odd) capacities would degrade to a huge grid of
+    # tiny blocks; one whole-capacity block beats that everywhere
+    return blk if capacity // blk <= 64 else capacity
+
+
+def _grid_blocks(capacity: int, blk: int) -> int:
+    return max(1, (capacity + blk - 1) // blk)
+
+
+def build_table(lane: jax.Array, valid: jax.Array,
+                interpret: bool) -> HashTable:
+    """Build the table for one canonical build lane: one hash-order
+    sort chain (two 2-operand stable sorts — hash, then liveness) and
+    ONE Pallas layout kernel computing final slots by blocked prefix
+    max and storing (key, row) pairs.  Dead rows ride the sort to the
+    end and store row = -1 in the overflow tail, indistinguishable
+    from empty slots."""
+    cap = int(lane.shape[0])
+    nbits = max(4, (2 * max(cap, 1) - 1).bit_length())
+    span = (1 << nbits) + cap
+    sig = ("build", cap, nbits, interpret)
+    fn = _HASH_CACHE.get(sig)
+    if fn is None:
+        fn = jax.jit(_build_trace(cap, nbits, span, interpret))
+        _HASH_CACHE[sig] = fn
+    keys, rows = fn(lane, valid)
+    return HashTable(keys, rows, nbits, span, interpret)
+
+
+def _build_trace(cap: int, nbits: int, span: int, interpret: bool):
+    S = 1 << nbits
+    shift = np.uint64(64 - nbits)
+    blk = _probe_block(cap)
+    grid = _grid_blocks(cap, blk)
+
+    def kernel(ideal_ref, lane_ref, rid_ref, keys_ref, rows_ref,
+               carry_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            keys_ref[...] = jnp.zeros((span,), jnp.int64)
+            rows_ref[...] = jnp.full((span,), -1, jnp.int32)
+            carry_ref[0] = jnp.int32(-(2 ** 31) + 1)
+        i = pl.program_id(0) * blk + \
+            jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)[:, 0]
+        # linear-probing layout: final = i + running_max(ideal - i);
+        # strictly increasing, == ideal when unpushed, contiguous when
+        # pushed — the invariant probes rely on (module docstring)
+        rel = ideal_ref[...] - i
+        prefix = jnp.maximum(blocked_cummax(rel), carry_ref[0])
+        carry_ref[0] = prefix[-1]
+        final = jnp.clip(i + prefix, 0, span - 1)
+        keys_ref[final] = lane_ref[...]
+        rows_ref[final] = rid_ref[...]
+
+    def run(lane, valid):
+        from ..segments import lexsort_capped
+        h = mix64(lane)
+        dead = ~valid
+        perm = lexsort_capped([h, dead.astype(jnp.int8)], 2)
+        hs = jnp.take(h, perm)
+        dead_s = jnp.take(dead, perm)
+        lane_s = jnp.take(lane, perm)
+        ideal = jnp.where(dead_s, jnp.int32(S),
+                          (hs >> shift).astype(jnp.int32))
+        rid = jnp.where(dead_s, jnp.int32(-1), perm.astype(jnp.int32))
+        return pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((blk,), lambda i: (i,)),
+                      pl.BlockSpec((blk,), lambda i: (i,)),
+                      pl.BlockSpec((blk,), lambda i: (i,))],
+            out_specs=[pl.BlockSpec((span,), lambda i: (0,)),
+                       pl.BlockSpec((span,), lambda i: (0,))],
+            out_shape=[jax.ShapeDtypeStruct((span,), jnp.int64),
+                       jax.ShapeDtypeStruct((span,), jnp.int32)],
+            scratch_shapes=[_smem_scratch((1,), jnp.int32)],
+            interpret=interpret,
+        )(ideal, lane_s, rid)
+    return run
+
+
+def _smem_scratch(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.SMEM(shape, dtype)
+
+
+def probe_first(table: HashTable, lane: jax.Array, valid: jax.Array):
+    """(build_row, found) per probe row: the FIRST build row (ascending
+    row id) whose key equals the probe lane — the unique-build aligned
+    probe (ops/join.probe_aligned contract)."""
+    cap = int(lane.shape[0])
+    sig = ("first", table.span, table.nbits, cap, table.interpret)
+    fn = _HASH_CACHE.get(sig)
+    if fn is None:
+        fn = jax.jit(_probe_first_trace(cap, table.nbits, table.span,
+                                        table.interpret))
+        _HASH_CACHE[sig] = fn
+    return fn(table.keys, table.rows, lane, valid)
+
+
+def _probe_first_trace(cap: int, nbits: int, span: int, interpret: bool):
+    shift = np.uint64(64 - nbits)
+    blk = _probe_block(cap)
+    grid = _grid_blocks(cap, blk)
+
+    def kernel(tk_ref, tr_ref, lane_ref, valid_ref, row_ref, ok_ref):
+        keys = lane_ref[...]
+        slot0 = (mix64(keys) >> shift).astype(jnp.int32)
+
+        def cond(c):
+            _, _, pending, steps = c
+            return jnp.logical_and(jnp.any(pending), steps < span)
+
+        def body(c):
+            slot, out, pending, steps = c
+            r = tr_ref[slot]
+            k = tk_ref[slot]
+            occupied = r >= 0
+            hit = pending & occupied & (k == keys)
+            out = jnp.where(hit, r, out)
+            pending = pending & occupied & ~hit
+            slot = jnp.where(pending, jnp.minimum(slot + 1, span - 1),
+                             slot)
+            return slot, out, pending, steps + 1
+
+        _, out, _, _ = jax.lax.while_loop(
+            cond, body, (slot0, jnp.full((blk,), -1, jnp.int32),
+                         valid_ref[...], 0))
+        row_ref[...] = out
+        ok_ref[...] = out >= 0
+
+    def run(tk, tr, lane, valid):
+        return pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((span,), lambda i: (0,)),
+                      pl.BlockSpec((span,), lambda i: (0,)),
+                      pl.BlockSpec((blk,), lambda i: (i,)),
+                      pl.BlockSpec((blk,), lambda i: (i,))],
+            out_specs=[pl.BlockSpec((blk,), lambda i: (i,)),
+                       pl.BlockSpec((blk,), lambda i: (i,))],
+            out_shape=[jax.ShapeDtypeStruct((cap,), jnp.int32),
+                       jax.ShapeDtypeStruct((cap,), jnp.bool_)],
+            interpret=interpret,
+        )(tk, tr, lane, valid)
+    return run
+
+
+def probe_matched(table: HashTable, lane: jax.Array, valid: jax.Array):
+    """Per-probe-row matched flag (semi/anti joins) — probe_first's ok
+    lane without the row output."""
+    _row, ok = probe_first(table, lane, valid)
+    return ok
+
+
+def probe_counts(table: HashTable, lane: jax.Array, valid: jax.Array):
+    """(first_pos, counts, cum) per probe row: first TABLE position and
+    run length of the probe key's matches (duplicates are consecutive
+    by construction).  counts is 0 for invalid/unmatched rows; cum is
+    the inclusive blocked prefix sum the expansion searches."""
+    cap = int(lane.shape[0])
+    sig = ("counts", table.span, table.nbits, cap, table.interpret)
+    fn = _HASH_CACHE.get(sig)
+    if fn is None:
+        fn = jax.jit(_probe_counts_trace(cap, table.nbits, table.span,
+                                         table.interpret))
+        _HASH_CACHE[sig] = fn
+    first, counts = fn(table.keys, table.rows, lane, valid)
+    return first, counts, blocked_cumsum(counts)
+
+
+def _probe_counts_trace(cap: int, nbits: int, span: int,
+                        interpret: bool):
+    shift = np.uint64(64 - nbits)
+    blk = _probe_block(cap)
+    grid = _grid_blocks(cap, blk)
+
+    def kernel(tk_ref, tr_ref, lane_ref, valid_ref, first_ref, cnt_ref):
+        keys = lane_ref[...]
+        slot0 = (mix64(keys) >> shift).astype(jnp.int32)
+
+        def cond(c):
+            _, _, _, pending, steps = c
+            return jnp.logical_and(jnp.any(pending), steps < span)
+
+        def body(c):
+            slot, first, cnt, pending, steps = c
+            r = tr_ref[slot]
+            k = tk_ref[slot]
+            occupied = r >= 0
+            hit = pending & occupied & (k == keys)
+            first = jnp.where(hit & (cnt == 0), slot, first)
+            cnt = cnt + hit.astype(jnp.int32)
+            # stop at the first empty slot OR the first non-matching
+            # slot after the run started (equal keys are consecutive)
+            pending = pending & occupied & (hit | (cnt == 0))
+            slot = jnp.where(pending, jnp.minimum(slot + 1, span - 1),
+                             slot)
+            return slot, first, cnt, pending, steps + 1
+
+        _, first, cnt, _, _ = jax.lax.while_loop(
+            cond, body, (slot0, jnp.zeros((blk,), jnp.int32),
+                         jnp.zeros((blk,), jnp.int32), valid_ref[...], 0))
+        first_ref[...] = first
+        cnt_ref[...] = cnt
+
+    def run(tk, tr, lane, valid):
+        return pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((span,), lambda i: (0,)),
+                      pl.BlockSpec((span,), lambda i: (0,)),
+                      pl.BlockSpec((blk,), lambda i: (i,)),
+                      pl.BlockSpec((blk,), lambda i: (i,))],
+            out_specs=[pl.BlockSpec((blk,), lambda i: (i,)),
+                       pl.BlockSpec((blk,), lambda i: (i,))],
+            out_shape=[jax.ShapeDtypeStruct((cap,), jnp.int32),
+                       jax.ShapeDtypeStruct((cap,), jnp.int32)],
+            interpret=interpret,
+        )(tk, tr, lane, valid)
+    return run
+
+
+def expand_pairs(table: HashTable, first: jax.Array, counts: jax.Array,
+                 cum: jax.Array, out_cap: int, total):
+    """(probe_idx, build_idx, ok) for the sized pair expansion: output
+    slot j's owning probe row falls out of a vectorized rank search
+    over `cum` (log2 rounds of gathers — cheap because cum is ONE
+    monotone int32 lane), its build row is a pure gather at
+    first[p] + (j - start(p)) since duplicate matches are consecutive
+    table slots.  Pair order is identical to the sorted tier:
+    probe-major, build rows ascending within a key."""
+    pcap = int(first.shape[0])
+    sig = ("expand", table.span, pcap, out_cap, table.interpret)
+    fn = _HASH_CACHE.get(sig)
+    if fn is None:
+        fn = jax.jit(_expand_trace(pcap, out_cap, table.span,
+                                   table.interpret))
+        _HASH_CACHE[sig] = fn
+    return fn(table.rows, first, counts, cum, jnp.int32(total))
+
+
+def _expand_trace(pcap: int, out_cap: int, span: int, interpret: bool):
+    blk = _probe_block(out_cap)
+    grid = _grid_blocks(out_cap, blk)
+    rounds = max(1, (max(pcap, 1) - 1).bit_length() + 1)
+
+    def kernel(tr_ref, first_ref, cnt_ref, cum_ref, total_ref,
+               p_ref, b_ref, ok_ref):
+        j = pl.program_id(0) * blk + \
+            jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)[:, 0]
+        tgt = j + 1
+        lo = jnp.zeros((blk,), jnp.int32)
+        hi = jnp.full((blk,), pcap, jnp.int32)
+
+        def body(_, c):
+            lo, hi = c
+            mid = jnp.minimum((lo + hi) // 2, pcap - 1)
+            go_hi = cum_ref[mid] < tgt
+            return (jnp.where(go_hi, mid + 1, lo),
+                    jnp.where(go_hi, hi, mid))
+
+        lo, _ = jax.lax.fori_loop(0, rounds, body, (lo, hi))
+        p = jnp.minimum(lo, pcap - 1)
+        start = cum_ref[p] - cnt_ref[p]
+        pos = jnp.clip(first_ref[p] + (j - start), 0, span - 1)
+        live = j < total_ref[0]
+        p_ref[...] = jnp.where(live, p, 0)
+        b_ref[...] = jnp.where(live, jnp.maximum(tr_ref[pos], 0), 0)
+        ok_ref[...] = live
+
+    def run(tr, first, counts, cum, total):
+        return pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((span,), lambda i: (0,)),
+                      pl.BlockSpec((pcap,), lambda i: (0,)),
+                      pl.BlockSpec((pcap,), lambda i: (0,)),
+                      pl.BlockSpec((pcap,), lambda i: (0,)),
+                      pl.BlockSpec((1,), lambda i: (0,))],
+            out_specs=[pl.BlockSpec((blk,), lambda i: (i,)),
+                       pl.BlockSpec((blk,), lambda i: (i,)),
+                       pl.BlockSpec((blk,), lambda i: (i,))],
+            out_shape=[jax.ShapeDtypeStruct((out_cap,), jnp.int32),
+                       jax.ShapeDtypeStruct((out_cap,), jnp.int32),
+                       jax.ShapeDtypeStruct((out_cap,), jnp.bool_)],
+            interpret=interpret,
+        )(tr, first, counts, cum, total.reshape((1,)))
+    return run
+
+
+def build_matched_flags(table: HashTable, first: jax.Array,
+                        counts: jax.Array,
+                        build_capacity: int) -> jax.Array:
+    """Per-BUILD-row matched flags (right/full outer) from the counted
+    probe runs, expansion-free: each probe row's matches are the table
+    interval [first, first+count), so interval-difference marking (+1
+    at starts, -1 past ends, blocked cumsum > 0) yields per-SLOT
+    matched flags, carried back to rows through the table's row lane —
+    two small scatters + one scan instead of a segment reduction over
+    the expanded pair set."""
+    span = table.span
+    has = counts > 0
+    delta = jnp.zeros((span + 1,), jnp.int32)
+    delta = delta.at[jnp.where(has, first, span + 1)].add(1, mode="drop")
+    delta = delta.at[jnp.where(has, first + counts, span + 1)].add(
+        -1, mode="drop")
+    occ = blocked_cumsum(delta[:span]) > 0
+    tgt = jnp.where(occ & (table.rows >= 0), table.rows,
+                    jnp.int32(build_capacity))
+    return jnp.zeros((build_capacity,), bool).at[tgt].set(
+        True, mode="drop")

@@ -1217,6 +1217,15 @@ class PhysicalQuery:
     def physical_tree(self) -> str:
         return self.root.tree_string()
 
+    def kernel_plan(self) -> List[str]:
+        """Static Pallas kernel-tier dispatch plan: one line per
+        candidate operator (`<Exec> -> pallas:<kernel>` /
+        `sorted:<reason>` / `runtime:<fact>`) — empty when the tier is
+        off or the plan runs on the host engine."""
+        if self.kind != "device":
+            return []
+        return kernel_tier_plan(self.root, self.conf)
+
     def fallback_reasons(self) -> List[str]:
         """Every tagger reason in the meta tree (depth-first) — the
         structured form of the '!Exec ... because ...' explain lines."""
@@ -1732,6 +1741,9 @@ def apply_overrides(plan: L.LogicalPlan,
             _negotiate_lazy_sel(root)
         if conf.get(JOIN_LATE_MATERIALIZATION):
             _negotiate_thin(root)
+        if mode == "ALL":
+            for line in kernel_tier_plan(root, conf):
+                log.info(f"kernel-tier: {line}")
     phases.append(("plan.convert", t2, _time.perf_counter()))
     pq = PhysicalQuery(meta, kind, root, conf)
     pq.plan_phases = phases
@@ -1839,6 +1851,65 @@ def _negotiate_thin(root) -> None:
     for nid, node in joins.items():
         if allowed[nid]:
             node.thin_payload = frozenset(node.output_schema.names)
+
+
+def kernel_tier_plan(root, conf: TpuConf) -> List[str]:
+    """Plan-level legality report for the Pallas kernel tier
+    (ops/pallas/): one line per candidate operator stating where it
+    will dispatch and, for the sort-tier outcomes, WHY — the static
+    half of the negotiation (batch-dependent facts like dictionary
+    domains and adaptive build-side swaps resolve at runtime and are
+    reported as `runtime:`).  Logged under explain=ALL when the tier
+    is on; bench.py --kernels and the tier tests read it through
+    PhysicalQuery.kernel_plan()."""
+    from ..exec.adaptive import AdaptiveShuffledJoinExec
+    from ..exec.join import HashJoinExec, key_ref_names
+    from ..exec.plan import FilterExec, HashAggregateExec
+    from ..ops.pallas import kernel_tier
+    tier = kernel_tier(conf)
+    lines: List[str] = []
+    if not tier.any_enabled:
+        return lines
+    seen = set()
+
+    def join_line(node) -> str:
+        if not tier.join:
+            return "sorted:join_family_off"
+        if not isinstance(node, HashJoinExec):
+            # the adaptive join picks its build side (and so its key
+            # shape) from measured inputs at run time
+            return "runtime:adaptive_build_side"
+        single = len(node.right_keys) == 1
+        packable = single or (isinstance(node, HashJoinExec) and
+                              node._range_pack_spec() is not None)
+        if not packable:
+            return "sorted:multi_lane"
+        return "pallas:hash_probe_join"
+
+    def walk(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        if isinstance(node, (HashJoinExec, AdaptiveShuffledJoinExec)):
+            lines.append(f"{type(node).__name__} -> {join_line(node)}")
+        elif isinstance(node, HashAggregateExec):
+            if not tier.segagg:
+                lines.append("HashAggregateExec -> "
+                             "sorted:segagg_family_off")
+            elif not node.key_exprs:
+                lines.append("HashAggregateExec -> sorted:no_keys")
+            else:
+                lines.append("HashAggregateExec -> "
+                             "runtime:packed_domain_bound")
+        elif isinstance(node, FilterExec):
+            lines.append("FilterExec -> " + (
+                "pallas:compact" if tier.compact
+                else "sorted:compact_family_off"))
+        for c in node.children:
+            walk(c)
+
+    walk(root)
+    return lines
 
 
 # ---------------------------------------------------------------------------

@@ -77,6 +77,14 @@ SITES: Dict[str, str] = {
                     "— kind 'corrupt' flips a byte in the cached IPC "
                     "payload so the REAL checksum verification detects "
                     "it, drops the entry and recomputes",
+    "kernel": "Pallas kernel-tier dispatch (ops/pallas/) — fires each "
+              "time an operator elects a hand-written kernel, with the "
+              "kernel family in the injected-fault record. Kind 'oom' "
+              "is caught by the dispatch gate itself: the operator "
+              "sheds to the sort-based portable tier bit-identically "
+              "(tpu_kernel_fallback_total{reason=oom}); 'fatal' "
+              "surfaces as a classified FATAL_DEVICE crash dump whose "
+              "injected-fault record names the kernel",
 }
 
 KINDS = ("oom", "ioerror", "corrupt", "fatal", "error", "timeout")

@@ -93,6 +93,28 @@ def jaxpr_sort_operands(jaxpr) -> int:
                 if e.primitive.name == "sort"), default=0)
 
 
+def jaxpr_sort_operand_total(jaxpr) -> int:
+    """TOTAL operands across every `sort` equation — the whole-program
+    sort volume proxy.  The Pallas kernel tier exists to shrink this on
+    the join/filter-heavy tail (each replaced merge-rank probe was two
+    2-operand sorts over build+probe rows); its budget lint asserts the
+    q3/q9/q15-class programs emit strictly fewer sort operands with the
+    tier on."""
+    return sum(len(e.invars) for e in _iter_eqns(jaxpr)
+               if e.primitive.name == "sort")
+
+
+def jaxpr_pallas_calls(jaxpr) -> int:
+    """Number of `pallas_call` equations — the hand-written kernel
+    dispatches actually embedded in the program (interpret-mode calls
+    included: the primitive is the same, only its lowering differs).
+    Note _iter_eqns recurses INTO kernel bodies via the equation's
+    jaxpr param, so sorts/scatters inside a kernel would still be
+    counted by the census walkers above."""
+    return sum(1 for e in _iter_eqns(jaxpr)
+               if e.primitive.name == "pallas_call")
+
+
 def jaxpr_scatter_count(jaxpr) -> int:
     """Number of scatter-family equations in the program."""
     return sum(1 for e in _iter_eqns(jaxpr)
@@ -139,9 +161,11 @@ def plan_program_stats(physical, ctx=None) -> Dict:
     ctx = ctx or ExecContext(physical.conf)
     jx = CompiledPlan(physical.root, physical.conf).make_jaxpr(ctx)
     return {"sort_operand_max": jaxpr_sort_operands(jx),
+            "sort_operand_total": jaxpr_sort_operand_total(jx),
             "scatter_op_count": jaxpr_scatter_count(jx),
             "gather_op_count": jaxpr_gather_count(jx),
-            "gather_out_elems": jaxpr_gather_elems(jx)}
+            "gather_out_elems": jaxpr_gather_elems(jx),
+            "pallas_call_count": jaxpr_pallas_calls(jx)}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,382 @@
+"""Pallas kernel tier: parity property tests (ISSUE 11 satellite).
+
+Every kernel family runs here in INTERPRET mode — pl.pallas_call
+interpret=True discharges the real kernel bodies into XLA ops, so
+tier-1 exercises the actual probe/accumulate/compact logic on the CPU
+container — and every result is compared against the sort-based tier
+(bit-identical contract) and/or a numpy/pyarrow oracle, over the
+adversarial distributions the issue names: collision-heavy keys,
+all-null lanes, empty build sides, dict-coded string keys, and
+capacity-boundary row counts.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.ops.pallas import kernel_tier, tier_discriminant
+from spark_rapids_tpu.ops.pallas import hashjoin as HK
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.aggregates import (BoolAnd, BoolOr, Count,
+                                              First, Last, Max, Min, Sum)
+from spark_rapids_tpu.session import DataFrame, TpuSession, col
+
+PALLAS_ON = {
+    "spark.rapids.tpu.sql.kernels.pallas.enabled": "true",
+    # segagg AUTO keeps itself off under interpretation (XLA-CPU
+    # scatters beat the interpreted accumulator); force it so tier-1
+    # exercises the kernel bodies
+    "spark.rapids.tpu.sql.kernels.pallas.segagg": "ON",
+    # tiny-scale fixtures: every span fits a dense table, so force
+    # the replacement the AUTO span policy reserves for big spans
+    "spark.rapids.tpu.sql.kernels.pallas.join.denseReplace": "ON",
+}
+
+
+def _sessions(extra=None):
+    on = TpuSession({**PALLAS_ON, **(extra or {})})
+    off = TpuSession(dict(extra or {}))
+    return on, off
+
+
+def _same(df_on, off_session):
+    got = df_on.collect().to_pydict()
+    want = DataFrame(df_on._plan, off_session).collect().to_pydict()
+    assert got == want
+    return got
+
+
+# ---------------------------------------------------------------------------
+# tier resolution
+# ---------------------------------------------------------------------------
+
+class TestTierResolution:
+    def test_off_by_default(self):
+        tier = kernel_tier(TpuConf())
+        assert not tier.any_enabled
+        assert tier_discriminant(TpuConf()) is None
+
+    def test_auto_on_cpu_backend(self):
+        tier = kernel_tier(TpuConf(PALLAS_ON))
+        # cpu backend: interpret mode, join+compact on, segagg forced ON
+        assert tier.interpret
+        assert tier.join and tier.compact and tier.segagg
+        assert tier.mode == "interpret"
+
+    def test_segagg_auto_stays_off_under_interpretation(self):
+        tier = kernel_tier(TpuConf(
+            {"spark.rapids.tpu.sql.kernels.pallas.enabled": "true"}))
+        assert tier.join and tier.compact and not tier.segagg
+
+    def test_interpret_off_disables_tier_off_tpu(self):
+        tier = kernel_tier(TpuConf(
+            {"spark.rapids.tpu.sql.kernels.pallas.enabled": "true",
+             "spark.rapids.tpu.sql.kernels.pallas.interpret": "OFF"}))
+        assert not tier.any_enabled
+
+    def test_discriminant_keys_resolved_tier(self):
+        a = tier_discriminant(TpuConf(PALLAS_ON))
+        b = tier_discriminant(TpuConf(
+            {"spark.rapids.tpu.sql.kernels.pallas.enabled": "true"}))
+        assert a is not None and b is not None and a != b
+
+
+# ---------------------------------------------------------------------------
+# hash table unit properties (numpy oracle)
+# ---------------------------------------------------------------------------
+
+def _np_first(bkeys, bvalid, pkeys, pvalid):
+    lut = {}
+    for i, (k, v) in enumerate(zip(bkeys, bvalid)):
+        if v and int(k) not in lut:
+            lut[int(k)] = i
+    return np.array([lut.get(int(k), -1) if v else -1
+                     for k, v in zip(pkeys, pvalid)], np.int32)
+
+
+def _np_counts(bkeys, bvalid, pkeys, pvalid):
+    from collections import Counter
+    cnt = Counter(int(k) for k, v in zip(bkeys, bvalid) if v)
+    return np.array([cnt.get(int(k), 0) if v else 0
+                     for k, v in zip(pkeys, pvalid)], np.int32)
+
+
+def _table(bkeys, bvalid):
+    return HK.build_table(jnp.asarray(bkeys, jnp.int64),
+                          jnp.asarray(bvalid, bool), interpret=True)
+
+
+CASES = {
+    # collision-heavy: few distinct keys, heavy duplication
+    "collision_heavy": (np.repeat(np.arange(7, dtype=np.int64) * 1000, 37),
+                        np.arange(-5, 300, dtype=np.int64) * 500),
+    # adversarial bit patterns incl. int64 extremes (emptiness rides the
+    # ROW sentinel, not a key sentinel — any int64 value is a legal key)
+    "extreme_values": (np.array([0, -1, 2 ** 62, -(2 ** 62), 1, 2, 3,
+                                 2 ** 63 - 1, -(2 ** 63)], np.int64),
+                       np.array([0, -1, 2 ** 62, 7, 2 ** 63 - 1,
+                                 -(2 ** 63), -42], np.int64)),
+    # capacity-boundary: exactly one row / pow2 +- 1 spans
+    "one_row": (np.array([42], np.int64), np.array([42, 41], np.int64)),
+    "pow2_edge": (np.arange(255, dtype=np.int64),
+                  np.arange(-3, 260, dtype=np.int64)),
+}
+
+
+class TestHashTableUnits:
+    @pytest.mark.parametrize("case", sorted(CASES))
+    def test_probe_first_counts_match_numpy(self, case):
+        bkeys, pkeys = CASES[case]
+        rng = np.random.default_rng(7)
+        bvalid = rng.random(len(bkeys)) > 0.15
+        pvalid = rng.random(len(pkeys)) > 0.15
+        tbl = _table(bkeys, bvalid)
+        row, ok = HK.probe_first(tbl, jnp.asarray(pkeys, jnp.int64),
+                                 jnp.asarray(pvalid, bool))
+        want = _np_first(bkeys, bvalid, pkeys, pvalid)
+        assert np.array_equal(np.asarray(ok), want >= 0)
+        assert np.array_equal(np.where(np.asarray(ok),
+                                       np.asarray(row), -1), want)
+        first, counts, cum = HK.probe_counts(
+            tbl, jnp.asarray(pkeys, jnp.int64), jnp.asarray(pvalid, bool))
+        assert np.array_equal(np.asarray(counts),
+                              _np_counts(bkeys, bvalid, pkeys, pvalid))
+
+    def test_all_null_build(self):
+        bkeys = np.arange(100, dtype=np.int64)
+        tbl = _table(bkeys, np.zeros(100, bool))
+        row, ok = HK.probe_first(tbl, jnp.asarray(bkeys),
+                                 jnp.ones(100, bool))
+        assert not np.asarray(ok).any()
+
+    def test_expand_pairs_order_and_content(self):
+        # duplicates must expand probe-major, build rows ascending —
+        # the exact order the sorted tier emits
+        bkeys = np.array([5, 3, 5, 5, 3, 9], np.int64)
+        pkeys = np.array([3, 5, 8, 3], np.int64)
+        tbl = _table(bkeys, np.ones(len(bkeys), bool))
+        first, counts, cum = HK.probe_counts(
+            tbl, jnp.asarray(pkeys), jnp.ones(len(pkeys), bool))
+        total = int(np.asarray(cum)[-1])
+        assert total == 7
+        p, b, ok = HK.expand_pairs(tbl, first, counts, cum, 8,
+                                   jnp.int32(total))
+        pairs = [(int(x), int(y)) for x, y, o in
+                 zip(np.asarray(p), np.asarray(b), np.asarray(ok)) if o]
+        assert pairs == [(0, 1), (0, 4), (1, 0), (1, 2), (1, 3),
+                         (3, 1), (3, 4)]
+        matched = HK.build_matched_flags(tbl, first, counts, len(bkeys))
+        assert np.asarray(matched).tolist() == [True, True, True, True,
+                                                True, False]
+
+
+# ---------------------------------------------------------------------------
+# exec-level parity: joins (bit-identical to the sorted tier)
+# ---------------------------------------------------------------------------
+
+def _join_frames(s, n=5000, null_every=11, seed=3):
+    rng = np.random.default_rng(seed)
+    # collision-heavy: ~50 distinct keys over 5000 fact rows
+    fk = rng.integers(0, 50, n)
+    fkv = [None if i % null_every == 0 else int(v)
+           for i, v in enumerate(fk)]
+    fact = s.from_arrow(pa.table({
+        "fk": pa.array(fkv, pa.int64()),
+        "v": pa.array(rng.standard_normal(n))}))
+    dk = list(range(0, 60))
+    dim = s.from_arrow(pa.table({
+        "k": pa.array(dk, pa.int64()),
+        "name": pa.array([f"n{i}" for i in dk])}))
+    return fact, dim
+
+
+@pytest.mark.parametrize("how", ["inner", "left_outer", "left_semi",
+                                 "left_anti", "right_outer",
+                                 "full_outer"])
+def test_join_variants_bit_identical(how):
+    on, off = _sessions()
+    fact, dim = _join_frames(on)
+    df = fact.join(dim, left_on=["fk"], right_on=["k"], how=how) \
+        .sort(("v", True, True))
+    _same(df, off)
+
+
+def test_join_duplicate_build_rows_bit_identical():
+    # non-unique build side forces the sized expand path
+    on, off = _sessions()
+    rng = np.random.default_rng(5)
+    left = on.from_arrow(pa.table({
+        "k": pa.array(rng.integers(0, 20, 997), pa.int64()),
+        "x": pa.array(np.arange(997))}))
+    right = on.from_arrow(pa.table({
+        "k2": pa.array(np.repeat(np.arange(25), 3), pa.int64()),
+        "y": pa.array(np.arange(75))}))
+    df = left.join(right, left_on=["k"], right_on=["k2"], how="inner") \
+        .sort(("x", True, True), ("y", True, True))
+    _same(df, off)
+
+
+def test_join_dict_coded_string_keys_bit_identical():
+    on, off = _sessions()
+    names = [f"name_{i % 13}" for i in range(400)]
+    left = on.from_arrow(pa.table({
+        "s": pa.array(names), "x": pa.array(np.arange(400))}))
+    right = on.from_arrow(pa.table({
+        "s2": pa.array([f"name_{i}" for i in range(20)]),
+        "y": pa.array(np.arange(20))}))
+    df = left.join(right, left_on=["s"], right_on=["s2"], how="inner") \
+        .sort(("x", True, True))
+    _same(df, off)
+
+
+def test_join_empty_build_side():
+    on, off = _sessions()
+    left = on.from_arrow(pa.table({
+        "k": pa.array([1, 2, 3], pa.int64()),
+        "x": pa.array([1.0, 2.0, 3.0])}))
+    right = on.from_arrow(pa.table({
+        "k2": pa.array([], pa.int64()), "y": pa.array([], pa.int64())}))
+    for how in ("inner", "left_outer", "left_anti"):
+        df = left.join(right, left_on=["k"], right_on=["k2"], how=how) \
+            .sort(("x", True, True))
+        _same(df, off)
+
+
+def test_join_all_null_probe_keys():
+    on, off = _sessions()
+    left = on.from_arrow(pa.table({
+        "k": pa.array([None, None, None], pa.int64()),
+        "x": pa.array([1, 2, 3])}))
+    right = on.from_arrow(pa.table({
+        "k2": pa.array([1, 2], pa.int64()), "y": pa.array([10, 20])}))
+    for how in ("inner", "left_outer", "left_semi", "left_anti"):
+        df = left.join(right, left_on=["k"], right_on=["k2"], how=how) \
+            .sort(("x", True, True))
+        _same(df, off)
+
+
+# ---------------------------------------------------------------------------
+# segagg parity (float sums compare to tolerance: block combine
+# re-associates, the variableFloatAgg contract)
+# ---------------------------------------------------------------------------
+
+def _agg_frame(s, n=4096):
+    rng = np.random.default_rng(11)
+    flags = pa.array([["A", "B", "C", None][i % 4] for i in range(n)])
+    return s.from_arrow(pa.table({
+        "flag": flags,
+        "qty": pa.array(rng.integers(-(10 ** 12), 10 ** 12, n),
+                        pa.int64()),
+        "price": pa.array(rng.standard_normal(n)),
+    }))
+
+
+def test_segagg_int_sums_exact_and_floats_close():
+    on, off = _sessions()
+    df = _agg_frame(on).group_by("flag").agg(
+        (Sum(col("qty")), "sq"), (Min(col("qty")), "mn"),
+        (Max(col("qty")), "mx"), (Sum(col("price")), "sp"),
+        (Count(col("qty")), "c")).sort(("flag", True, True))
+    got = df.collect().to_pydict()
+    want = DataFrame(df._plan, off).collect().to_pydict()
+    assert set(got) == set(want)
+    for k in got:
+        if k == "sp":
+            for g, w in zip(got[k], want[k]):
+                assert g == pytest.approx(w, rel=1e-12)
+        else:
+            # int sums/min/max/count and keys: EXACT (split-f64 matmul)
+            assert got[k] == want[k], k
+
+
+def test_segagg_first_last_any_every_parity():
+    on, off = _sessions()
+    n = 2048
+    tbl = pa.table({
+        "g": pa.array([i % 5 for i in range(n)], pa.int64()),
+        "b": pa.array([i % 3 == 0 for i in range(n)]),
+        "v": pa.array([None if i % 7 == 0 else i for i in range(n)],
+                      pa.int64())})
+    df = on.from_arrow(tbl).group_by("g").agg(
+        (First(col("v")), "f"), (Last(col("v")), "l"),
+        (BoolOr(col("b")), "anyb"), (BoolAnd(col("b")), "allb"),
+        (Count(col("v")), "c")).sort(("g", True, True))
+    _same(df, off)
+
+
+def test_segagg_domain_gate_falls_back():
+    # a domain past maxDomain must keep the sort tier (and match it)
+    on, off = _sessions(
+        {"spark.rapids.tpu.sql.kernels.pallas.segagg.maxDomain": "4"})
+    df = _agg_frame(on).group_by("flag").agg(
+        (Sum(col("qty")), "sq")).sort(("flag", True, True))
+    _same(df, off)
+
+
+def test_tpch_q1_segagg_dispatches():
+    from spark_rapids_tpu import tpch
+    from spark_rapids_tpu.obs.registry import KERNEL_DISPATCH
+    tables = tpch.gen_tables(scale=0.001)
+    base = KERNEL_DISPATCH.value(kernel="segagg", mode="interpret")
+    on, off = _sessions()
+    df = tpch.QUERIES["q1"](on, tables)
+    got = df.collect().to_pydict()
+    want = DataFrame(df._plan, off).collect().to_pydict()
+    assert set(got) == set(want)
+    for k in got:
+        for g, w in zip(got[k], want[k]):
+            if isinstance(g, float):
+                assert g == pytest.approx(w, rel=1e-9)
+            else:
+                assert g == w
+    assert KERNEL_DISPATCH.value(kernel="segagg",
+                                 mode="interpret") > base
+
+
+# ---------------------------------------------------------------------------
+# compact parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,sel", [(1024, 0.5), (4096, 0.03),
+                                   (4097, 0.5), (2048, 0.0),
+                                   (2048, 1.0)])
+def test_compact_bit_identical(n, sel):
+    on, off = _sessions()
+    rng = np.random.default_rng(int(n * 1000 + sel * 10))
+    tbl = pa.table({"v": pa.array(rng.random(n)),
+                    "i": pa.array(np.arange(n))})
+    df = on.from_arrow(tbl).filter(
+        E.LessThan(col("v"), E.Literal(float(sel)))) \
+        .sort(("i", True, True))
+    _same(df, off)
+
+
+def test_compact_order_unit():
+    from spark_rapids_tpu.ops.pallas.compact import compaction_order
+    from spark_rapids_tpu.ops.filter import compaction_order as sorted_ord
+    rng = np.random.default_rng(2)
+    for n in (1024, 1536, 4096):
+        keep = jnp.asarray(rng.random(n) < 0.2)
+        got = np.asarray(compaction_order(keep, interpret=True))
+        want = np.asarray(sorted_ord(keep))
+        cnt = int(np.asarray(keep).sum())
+        # contractual region: the kept-row front, stably ordered
+        assert np.array_equal(got[:cnt], want[:cnt])
+        assert (got >= 0).all() and (got < n).all()
+
+
+# ---------------------------------------------------------------------------
+# plan-level negotiation surface
+# ---------------------------------------------------------------------------
+
+def test_kernel_plan_report():
+    from spark_rapids_tpu import tpch
+    tables = tpch.gen_tables(scale=0.001)
+    on, _ = _sessions()
+    q = tpch.QUERIES["q3"](on, tables).physical()
+    lines = q.kernel_plan()
+    assert any("pallas" in ln for ln in lines), lines
+    off_q = tpch.QUERIES["q3"](TpuSession(), tables).physical()
+    assert off_q.kernel_plan() == []

@@ -71,6 +71,54 @@ def test_dense_via_sort_makes_whole_suite_scatter_free(tables):
 
 
 # ---------------------------------------------------------------------------
+# Pallas kernel-tier sort budget: the hash/accumulate kernels must keep
+# removing sorts from the join/agg-heavy tail (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+# The attribution plane pinned the suite tail on these queries' sort-
+# lowered probe/aggregate segments; the kernel tier replaces merge-rank
+# probes, dense-table builds and packed group-by sorts, so their whole-
+# plan programs must emit strictly FEWER sort operands with it on.
+PALLAS_BUDGET_QUERIES = ("q3", "q9", "q15")
+
+PALLAS_ON = {
+    "spark.rapids.tpu.sql.kernels.pallas.enabled": "true",
+    "spark.rapids.tpu.sql.kernels.pallas.segagg": "ON",
+    # tiny-scale fixtures: every span fits a dense table, so force
+    # the replacement the AUTO span policy reserves for big spans
+    "spark.rapids.tpu.sql.kernels.pallas.join.denseReplace": "ON",
+}
+
+
+def test_pallas_tier_sort_operand_budget(tables, suite_stats):
+    """With the kernel tier on, q3/q9/q15 emit strictly fewer total
+    sort operands (and real pallas_call kernels), while the per-sort
+    width budget (<= 2 operands) still holds program-wide."""
+    on = TpuSession(PALLAS_ON)
+    for name in PALLAS_BUDGET_QUERIES:
+        st_off = suite_stats[name]
+        st_on = plan_program_stats(tpch.QUERIES[name](on, tables)
+                                   .physical())
+        assert st_on["sort_operand_total"] < \
+            st_off["sort_operand_total"], (name, st_on, st_off)
+        assert st_on["pallas_call_count"] > 0, (name, st_on)
+        assert st_on["sort_operand_max"] <= 2, (name, st_on)
+        assert st_off["pallas_call_count"] == 0, (name, st_off)
+
+
+def test_pallas_off_programs_identical_to_default(tables, suite_stats):
+    """kernels.pallas.enabled=false is the default: a session with the
+    conf explicitly off emits byte-equal program stats to the default
+    session (the bit-identical-plans half of the acceptance gate)."""
+    off = TpuSession(
+        {"spark.rapids.tpu.sql.kernels.pallas.enabled": "false"})
+    for name in PALLAS_BUDGET_QUERIES:
+        st = plan_program_stats(tpch.QUERIES[name](off, tables)
+                                .physical())
+        assert st == suite_stats[name], name
+
+
+# ---------------------------------------------------------------------------
 # gather budget: late materialization must keep paying for itself
 # ---------------------------------------------------------------------------
 
