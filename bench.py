@@ -151,6 +151,9 @@ class Suite:
         # flight recorder active vs spark.rapids.tpu.metrics.enabled=false
         # (the overhead bound the metrics plane claims — docs/METRICS.md)
         self.metrics_overhead = None
+        # performance-history store stats when --history-dir recorded
+        # this run (structures, records, calibration)
+        self.history = None
 
     def coverage(self) -> dict:
         """Operator-coverage matrix: which queries run device-clean,
@@ -221,6 +224,7 @@ class Suite:
             "pcache": pcache,
             "tunnel_rtt_ms": round(self.rtt * 1e3, 1),
             "metrics_overhead": self.metrics_overhead,
+            "history": self.history,
             "elapsed_s": round(time.perf_counter() - _T0, 1),
             "note": "warm single-shot wall per query (one whole-plan XLA "
                     "dispatch + one fetch, device-resident tables, compile "
@@ -295,6 +299,10 @@ def run_suite(suite_name: str, scale: float, query_names):
             # of a warmed replay (0 misses = zero XLA compiles)
             pc0 = persistent_cache_stats()
             cctx = ExecContext(dev.conf)
+            # history-plane label: the recorded structure carries the
+            # query name so history_report / drift citations read qN,
+            # not a bare digest (no-op when the plane is off)
+            cctx.metrics["history.label"] = name
             t0 = time.perf_counter()
             out = q.collect(cctx)
             cold_s = time.perf_counter() - t0
@@ -372,6 +380,13 @@ def run_suite(suite_name: str, scale: float, query_names):
         suite.emit()
     suite.metrics_overhead = measure_metrics_overhead(workload, tables,
                                                       suite, dev)
+    try:
+        from spark_rapids_tpu.obs.history import get_store
+        store = get_store(dev.conf)
+        if store is not None:
+            suite.history = store.stats()
+    except Exception:                        # noqa: BLE001
+        pass
     return suite
 
 
@@ -806,6 +821,17 @@ def main():
             EXTRA_CONF[k] = v
         elif a == "--kernels":
             kernels = True
+        elif a.startswith("--history-dir"):
+            # persistent performance-history plane: every measured query
+            # records its structure-keyed device time (obs/history.py)
+            # so later rounds/admissions estimate from measured cost —
+            # scripts/history_report.py renders the dir
+            if "=" in a:
+                hd = a.split("=", 1)[1]
+            else:
+                i += 1
+                hd = args[i]
+            EXTRA_CONF["spark.rapids.tpu.history.dir"] = hd
         elif a.startswith("--queries"):
             if "=" in a:
                 names = a.split("=", 1)[1].split(",")

@@ -397,6 +397,13 @@ def main(argv=None) -> int:
     ap.add_argument("--compile-min-ms", type=float, default=1000.0,
                     help="median compile floor below which compile "
                          "timings never regress (default 1000)")
+    ap.add_argument("--history-dir",
+                    help="performance-history dir "
+                         "(spark.rapids.tpu.history.dir): when the "
+                         "gate fails, cite the drifted plan "
+                         "STRUCTURES and their measured history "
+                         "(scripts/history_report.py drift detection) "
+                         "next to the regressed queries")
     ap.add_argument("--json", action="store_true",
                     help="emit the comparison as JSON")
     args = ap.parse_args(argv)
@@ -525,9 +532,47 @@ def main(argv=None) -> int:
         if res["regressions"]:
             print(f"{len(res['regressions'])} per-query regression(s) "
                   f"beyond +{args.threshold:.0%}")
+        _cite_history_drift(args.history_dir)
         return 1
     print("no per-query device_ms regressions")
     return 0
+
+
+def _cite_history_drift(history_dir) -> None:
+    """Gate-failure color from the performance-history plane: name the
+    plan structures whose own measured history drifted — a regressed
+    query almost always means one of these, and the structure key is
+    reproducible triage (best-effort: a missing/empty history never
+    changes the exit code)."""
+    if not history_dir:
+        return
+    try:
+        sys.path.insert(0, _ROOT)
+        from spark_rapids_tpu.obs.history import (HISTORY_FILE,
+                                                  PerfHistoryStore)
+        path = history_dir if not os.path.isdir(history_dir) \
+            else os.path.join(history_dir, HISTORY_FILE)
+        if not os.path.exists(path):
+            print(f"  (no history file at {path} — drift citation "
+                  f"skipped)")
+            return
+        drifted = PerfHistoryStore(path).drifted(2.0)
+        slower = [d for d in drifted if d["slower"]]
+        if not slower:
+            print("  history: no structure drifted slower than 2x its "
+                  "own measured history (regression may be "
+                  "environmental)")
+            return
+        print("  history drift (structures measured >2x slower than "
+              "their own history — scripts/history_report.py):")
+        for d in slower[:5]:
+            name = d["label"] or d["key"]
+            print(f"    {name}: {d['history_us'] / 1e3:.1f}ms -> "
+                  f"{d['last_us'] / 1e3:.1f}ms (x{d['ratio']:g}, "
+                  f"{d['runs']} runs) [{d['key']}]")
+    except Exception as e:                   # noqa: BLE001
+        print(f"  (history drift citation unavailable: "
+              f"{type(e).__name__}: {e})")
 
 
 if __name__ == "__main__":

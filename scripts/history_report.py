@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Performance-history report: the cost oracle's offline triage surface.
+
+Reads the persistent performance-history store (the JSONL file under
+`spark.rapids.tpu.history.dir`, obs/history.py) and renders:
+
+  * TOP STRUCTURES by cumulative measured device time — where this
+    deployment's device seconds actually go, with per-structure run
+    counts, warm decayed device-us, compile ms and labels (bench runs
+    stamp query names);
+  * the CALIBRATION CURVE — per estimate basis (exact_history /
+    static_cost), how far admission-time predictions landed from the
+    measured runs (log2-bucketed error-ratio histogram + mean), the
+    offline twin of `tpu_history_prediction_error_ratio`;
+  * DRIFT DETECTION — structures whose newest WARM measurement shifted
+    more than the threshold (default 2x) from their own decayed warm
+    history, the regression-triage entry point: a structure drifting
+    slower is a perf regression with a named, reproducible plan shape
+    (`check_regression.py --history-dir` cites these when a gate
+    fails).
+
+Exit codes: 0 ok, 1 drift found with --fail-on-drift, 2 usage/no data.
+
+Usage:
+    python scripts/history_report.py <history dir | perf_history.jsonl>
+                                     [--top N] [--drift-threshold R]
+                                     [--json] [--fail-on-drift]
+    python scripts/history_report.py --self-test
+"""
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def load_store(target: str):
+    """PerfHistoryStore over a history dir or a direct .jsonl path."""
+    from spark_rapids_tpu.obs.history import HISTORY_FILE, PerfHistoryStore
+    path = target
+    if os.path.isdir(target):
+        path = os.path.join(target, HISTORY_FILE)
+    if not os.path.exists(path):
+        raise SystemExit(f"no history file at {path}")
+    return PerfHistoryStore(path)
+
+
+def report_data(store, top: int = 10, drift_threshold: float = 2.0
+                ) -> dict:
+    """The structured report: top structures, calibration, drift."""
+    rows = []
+    for key, agg in store.aggregates().items():
+        rows.append({"key": key, "label": agg.label, "kind": agg.kind,
+                     "backend": agg.backend, "runs": agg.runs,
+                     "warm_runs": agg.warm_runs,
+                     "total_device_ms": round(agg.total_device_us / 1e3,
+                                              1),
+                     "device_us": round(agg.predicted_us(), 1),
+                     "compile_ms": round(agg.compile_ms, 1),
+                     "src_bytes": int(agg.src_bytes),
+                     "segments": {n: round(v, 2)
+                                  for n, v in agg.segments.items()},
+                     "drift_ratio": agg.drift_ratio()})
+    rows.sort(key=lambda r: -r["total_device_ms"])
+    return {"stats": store.stats(),
+            "top_structures": rows[:top],
+            "structures": len(rows),
+            "calibration": store.calibration(),
+            "drift": store.drifted(drift_threshold)}
+
+
+def render(data: dict, drift_threshold: float) -> str:
+    st = data["stats"]
+    lines = ["== performance history =="]
+    lines.append(f"store            {st['path']}")
+    lines.append(f"structures       {data['structures']} "
+                 f"({st['records_loaded']} records loaded, "
+                 f"{st['corrupt_lines']} corrupt line(s) tolerated, "
+                 f"{st['compactions']} compaction(s))")
+    if st.get("us_per_byte"):
+        lines.append(f"fitted static    {st['us_per_byte']:.6f} us/byte "
+                     f"(the static_cost fallback coefficient)")
+    lines.append("-- top structures by cumulative device time --")
+    for r in data["top_structures"]:
+        name = r["label"] or r["key"]
+        lines.append(
+            f"  {name:<28} {r['total_device_ms']:>10.1f} ms total  "
+            f"runs={r['runs']}({r['warm_runs']} warm) "
+            f"warm={r['device_us'] / 1e3:.1f}ms "
+            f"compile={r['compile_ms']:.0f}ms"
+            + (f"  [{r['key']}]" if r["label"] else ""))
+        for node, ms in sorted(r["segments"].items(),
+                               key=lambda kv: -kv[1])[:3]:
+            lines.append(f"      seg {node:<30} {ms:>8.1f} ms")
+    calib = data["calibration"]
+    if calib:
+        lines.append("-- calibration (prediction vs measured) --")
+        for basis, c in sorted(calib.items()):
+            curve = " ".join(f"<=2^{b}:{n}" for b, n in
+                             sorted(c["buckets"].items()))
+            lines.append(f"  {basis:<16} n={c['n']} "
+                         f"mean_error=x{c['mean_ratio']}  {curve}")
+    else:
+        lines.append("-- calibration: no predictions recorded yet "
+                     "(serving admission stamps them) --")
+    drift = data["drift"]
+    lines.append(f"-- drift (> x{drift_threshold:g} vs own warm "
+                 f"history) --")
+    if not drift:
+        lines.append("  none — every structure tracks its history")
+    for d in drift:
+        name = d["label"] or d["key"]
+        direction = "SLOWER" if d["slower"] else "faster"
+        lines.append(
+            f"  DRIFT {name:<24} x{d['ratio']:<7g} {direction}: "
+            f"history {d['history_us'] / 1e3:.1f}ms -> last "
+            f"{d['last_us'] / 1e3:.1f}ms over {d['runs']} runs "
+            f"[{d['key']}]")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# self test (tier-1 via tests/test_history.py; synthetic fixtures only)
+# ---------------------------------------------------------------------------
+
+def self_test() -> int:
+    """Built-in proof on synthetic fixtures: (1) a drifted structure is
+    flagged and a clean one is not; (2) corrupt/truncated lines are
+    tolerated on load; (3) compaction enforces the entry cap with LRU
+    order; (4) calibration records aggregate into the per-basis
+    curve."""
+    import tempfile
+    from spark_rapids_tpu.obs.history import PerfHistoryStore
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "perf_history.jsonl")
+
+        # 1: drift fixture — "steady" holds ~100ms, "drifty" jumps 5x
+        st = PerfHistoryStore(path, decay=0.3)
+        for i in range(6):
+            st.record("steady", {"device_us": 100_000.0 + i * 500,
+                                 "wall_ms": 101.0, "compile_ms": 0.0,
+                                 "src_bytes": 1 << 20,
+                                 "label": "steady_q"})
+        for us in (100_000.0, 101_000.0, 99_500.0, 500_000.0):
+            st.record("drifty", {"device_us": us, "wall_ms": us / 1e3,
+                                 "compile_ms": 0.0,
+                                 "src_bytes": 1 << 20,
+                                 "label": "drifty_q"})
+        data = report_data(st, drift_threshold=2.0)
+        flagged = {d["key"] for d in data["drift"]}
+        assert flagged == {"drifty"}, \
+            f"drift fixture mis-flagged: {flagged}"
+        assert data["drift"][0]["slower"] is True
+        assert data["drift"][0]["ratio"] > 2.0
+        # the clean fixture is genuinely clean, not just unmeasured
+        steady = next(r for r in data["top_structures"]
+                      if r["key"] == "steady")
+        assert steady["warm_runs"] == 6 and steady["drift_ratio"] is not None
+
+        # 2: corrupt + truncated tail tolerated on reload
+        with open(path, "a") as f:
+            f.write("%% not json at all %%\n")
+            f.write('{"k": "steady", "device_us": 12')   # truncated
+        st2 = PerfHistoryStore(path)
+        assert st2.corrupt_lines == 2, st2.corrupt_lines
+        assert st2.get("steady").runs == 6
+        assert st2.get("drifty").runs == 4
+
+        # 3: entry-capped LRU compaction — 5 keys into a 2-entry store
+        path3 = os.path.join(td, "cap.jsonl")
+        st3 = PerfHistoryStore(path3, max_entries=2, decay=0.5)
+        for i in range(5):
+            st3.record(f"k{i}", {"device_us": 1000.0 + i,
+                                 "wall_ms": 1.0, "compile_ms": 0.0})
+        assert st3.compactions >= 1
+        keys = set(st3.aggregates())
+        assert keys == {"k3", "k4"}, keys          # newest survive
+        st3b = PerfHistoryStore(path3)             # and reload intact
+        assert set(st3b.aggregates()) == {"k3", "k4"}
+        assert st3b.get("k4").runs == 1
+
+        # 4: calibration curve from predicted records
+        path4 = os.path.join(td, "cal.jsonl")
+        st4 = PerfHistoryStore(path4)
+        for _ in range(4):
+            st4.record("c", {"device_us": 200_000.0, "wall_ms": 200.0,
+                             "compile_ms": 0.0,
+                             "predicted_us": 100_000.0,
+                             "basis": "exact_history"})
+        cal = st4.calibration()["exact_history"]
+        assert cal["n"] == 4 and abs(cal["mean_ratio"] - 2.0) < 1e-6
+
+    print("history_report self-test OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("target", nargs="?",
+                    help="history dir (spark.rapids.tpu.history.dir) "
+                         "or perf_history.jsonl path")
+    ap.add_argument("--top", type=int, default=10,
+                    help="structures shown in the cumulative-time table")
+    ap.add_argument("--drift-threshold", type=float, default=2.0,
+                    help="flag structures whose newest warm measurement "
+                         "shifted more than this factor from their "
+                         "history (default 2.0)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--fail-on-drift", action="store_true",
+                    help="exit 1 when any structure drifted SLOWER "
+                         "(CI guard)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the built-in synthetic self test "
+                         "(tier-1 wired)")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        return self_test()
+    if not args.target:
+        ap.print_usage()
+        return 2
+    store = load_store(args.target)
+    data = report_data(store, args.top, args.drift_threshold)
+    if args.json:
+        print(json.dumps(data, default=str))
+    else:
+        print(render(data, args.drift_threshold))
+    if args.fail_on_drift and any(d["slower"] for d in data["drift"]):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

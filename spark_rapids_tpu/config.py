@@ -890,6 +890,47 @@ PALLAS_JOIN_MAX_BUILD = conf(
     "sorted-lane fallback.", checker=_positive)
 
 
+# --------------------------------------------------------------------------
+# Persistent performance-history plane (obs/history.py + obs/estimator.py)
+# --------------------------------------------------------------------------
+
+HISTORY_DIR = conf(
+    "spark.rapids.tpu.history.dir", "",
+    "Directory for the persistent performance-history store "
+    "(obs/history.py): every completed query appends one JSONL record — "
+    "measured device wall, per-segment device ms, compile ms, source "
+    "bytes, peak HBM reservation — keyed by the canonical plan "
+    "STRUCTURE (PR 7 constant-lifted structure key + resolved kernel "
+    "tier + leaf shape bucket), so a fresh process serves calibrated "
+    "cost estimates (obs/estimator.py, serving admission prediction) "
+    "with zero re-measurement. Corrupt/truncated lines are tolerated "
+    "on load; the file is byte/entry-capped with LRU compaction "
+    "(history.maxBytes / history.maxEntries). Empty disables the plane "
+    "(the disabled path is one cached conf check per query).",
+    commonly_used=True)
+
+HISTORY_MAX_BYTES = conf(
+    "spark.rapids.tpu.history.maxBytes", 16 << 20,
+    "Byte cap on the on-disk performance-history file: past it the "
+    "store compacts — per-structure decay-weighted aggregates replace "
+    "raw records and least-recently-updated structures drop first "
+    "(the LRU half of the cap).", checker=_positive)
+
+HISTORY_MAX_ENTRIES = conf(
+    "spark.rapids.tpu.history.maxEntries", 4096,
+    "Bound on distinct plan structures the history store tracks; "
+    "beyond it, compaction drops least-recently-updated structures.",
+    checker=_positive)
+
+HISTORY_DECAY = conf(
+    "spark.rapids.tpu.history.decay", 0.3,
+    "Weight of the NEWEST observation in the store's exponentially "
+    "decayed aggregates (device us, compile ms, working set): higher "
+    "adapts faster to drift, lower smooths noise. In (0, 1].",
+    checker=lambda v: None if 0 < v <= 1 else "must be in (0, 1]",
+    internal=True)
+
+
 JOIN_LATE_MATERIALIZATION = conf(
     "spark.rapids.tpu.sql.join.lateMaterialization.enabled", True,
     "Let equi-joins emit THIN batches: payload columns ride as per-side "

@@ -775,6 +775,12 @@ class CompiledPlan:
                 # profiling-only — the default path stays async
                 jax.block_until_ready(flat_res)
         t1 = _time.perf_counter()
+        # always-on program-execution wall (device wall when profiling
+        # syncs, the dispatch floor otherwise): the performance-history
+        # plane's per-structure measured-cost feed (obs/history.py)
+        m = ctx.metrics
+        m["exec_device_ms"] = m.get("exec_device_ms", 0.0) \
+            + (t1 - t0) * 1e3
 
         outs = []
         i = 0

@@ -196,6 +196,21 @@ def publish_registry(ctx) -> None:
         COMPILES_TOTAL.inc(int(misses), outcome="miss")
 
 
+def record_history(pq, ctx, wall_ms: float) -> None:
+    """Feed one completed query into the persistent performance-history
+    store (obs/history.py) — called at query end from
+    PhysicalQuery.collect, INSIDE the crash-capture scope so the
+    `history` chaos site's fatal kind produces a classified dump while
+    its ioerror kind skips the entry with the query unaffected.  The
+    disabled path (spark.rapids.tpu.history.dir unset) is one cached
+    conf check."""
+    from ..obs.history import get_store
+    store = get_store(ctx.conf)
+    if store is None:
+        return
+    store.record_query(pq, ctx, wall_ms)
+
+
 @contextmanager
 def profile_trace(conf: TpuConf):
     """jax profiler trace around a query when profile.path is set —

@@ -136,19 +136,34 @@ class ExplainAnalyzeReport:
     mesh_timeline: Dict[str, Any]
     metrics: Dict[str, Any]
     profile: object                 # the QueryProfile
+    #: admission-style cost-oracle estimate taken BEFORE the profiled
+    #: run (obs/estimator.py) — the predicted column next to measured;
+    #: None when the history plane is off
+    predicted: Optional[Dict[str, Any]] = None
+    #: node id -> resolved Pallas kernel-tier decision (kernel_plan())
+    kernel_tiers: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         return {"tree": self.tree, "segments": self.segments,
                 "attributed_device_pct": self.attributed_pct,
                 "wall_ms": self.wall_ms, "device_ms": self.device_ms,
                 "gathers": self.gathers,
-                "mesh_timeline": self.mesh_timeline}
+                "mesh_timeline": self.mesh_timeline,
+                "predicted": self.predicted,
+                "kernel_tiers": self.kernel_tiers}
 
     def render(self) -> str:
         head = [f"== EXPLAIN ANALYZE ==",
                 f"query wall        {self.wall_ms:.1f} ms",
                 f"device wall       {self.device_ms:.1f} ms (measured, "
                 f"union of program executions)"]
+        if self.predicted:
+            p = self.predicted
+            head.append(
+                f"predicted device  {p['device_us'] / 1e3:.1f} ms "
+                f"(basis={p['basis']}, confidence="
+                f"{p.get('confidence', 0)}, runs={p.get('runs', 0)} — "
+                f"the history oracle's admission-time answer)")
         if self.attributed_pct is not None:
             head.append(f"attributed        {self.attributed_pct:.1f}% "
                         f"of device wall to named plan segments")
@@ -208,11 +223,16 @@ def _flag_skew(segments: List[Dict[str, Any]]) -> None:
 
 def _render_tree(root, metrics: Dict[str, Any],
                  seg_by_node: Dict[str, Dict[str, Any]],
-                 wall_ms: float) -> str:
+                 wall_ms: float,
+                 kernel_tiers: Optional[Dict[str, str]] = None,
+                 pred_segments: Optional[Dict[str, float]] = None) -> str:
     """The annotated physical tree: every node with its measured per-node
     metrics, segment anchors with device time / % of wall / rows /
-    bytes / static cost."""
+    bytes / static cost / predicted-from-history ms, and the resolved
+    Pallas kernel-tier decision where one applies."""
     from ..exec.metrics import _child_nodes
+    kernel_tiers = kernel_tiers or {}
+    pred_segments = pred_segments or {}
     lines: List[str] = []
 
     def annotate(n) -> str:
@@ -225,6 +245,8 @@ def _render_tree(root, metrics: Dict[str, Any],
                 rng = f" nodes #{seg['node_lo']}-#{seg.get('node_hi')}"
             s = (f"<segment{rng}: {seg['device_ms']:.1f} ms device"
                  f" ({seg['pct']:.1f}%)")
+            if nid in pred_segments:
+                s += f", pred={pred_segments[nid]:.1f} ms"
             if seg.get("rows"):
                 s += f", rows={seg['rows']}"
             if seg.get("out_bytes"):
@@ -242,6 +264,9 @@ def _render_tree(root, metrics: Dict[str, Any],
                 s += (f" | SKEW x{seg['cost_skew']:g} vs predicted "
                       f"(mis-fused?)")
             parts.append(s + ">")
+        kt = kernel_tiers.get(nid)
+        if kt is not None:
+            parts.append(f"[kernel: {kt}]")
         op_ms = metrics.get(f"{nid}.op_time_ms")
         rows = metrics.get(f"{nid}.output_rows")
         ann = []
@@ -285,6 +310,27 @@ def run_explain_analyze(pq, conf_overrides: Optional[dict] = None
     prof_conf = TpuConf(raw)
     assign_node_ids(pq.root)
 
+    # the history oracle's admission-time answer, taken BEFORE the run
+    # so the report shows prediction next to what actually happened
+    predicted = None
+    try:
+        from .estimator import estimate_query
+        predicted = estimate_query(pq)
+    except Exception:                        # noqa: BLE001
+        predicted = None
+
+    # resolved Pallas kernel-tier decision per node (PR 11 kernel_plan)
+    kernel_tiers: Dict[str, str] = {}
+    if pq.kind == "device":
+        try:
+            from ..plan.overrides import kernel_tier_decisions
+            for node, decision in kernel_tier_decisions(pq.root, pq.conf):
+                nid = getattr(node, "_node_id", None)
+                if nid:
+                    kernel_tiers[nid] = decision
+        except Exception:                    # noqa: BLE001
+            pass
+
     def _gather_totals() -> Dict[str, int]:
         out = {}
         for name, fam in (("gather_rows", GATHER_ROWS),
@@ -310,11 +356,17 @@ def run_explain_analyze(pq, conf_overrides: Optional[dict] = None
     device_ms = _union_ms([(s.t0, s.t1) for s in profile.spans
                            if s.cat == "execute"])
     pct = profile.attributed_device_pct()
+    pred_segments = {}
+    if predicted:
+        pred_segments = {n: float(v) for n, v in
+                         (predicted.get("segments") or {}).items()}
     tree = _render_tree(pq.root, ctx.metrics, seg_by_node,
-                        split["wall_ms"])
+                        split["wall_ms"], kernel_tiers=kernel_tiers,
+                        pred_segments=pred_segments)
     return ExplainAnalyzeReport(
         tree=tree, segments=segments,
         attributed_pct=None if pct is None else round(pct * 100, 1),
         wall_ms=split["wall_ms"], device_ms=round(device_ms, 3),
         gathers=gathers, mesh_timeline=profile.mesh_timeline(),
-        metrics=dict(ctx.metrics), profile=profile)
+        metrics=dict(ctx.metrics), profile=profile,
+        predicted=predicted, kernel_tiers=kernel_tiers)

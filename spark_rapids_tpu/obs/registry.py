@@ -553,6 +553,44 @@ SERVING_DEVICE_BUSY_US = REGISTRY.counter(
     "across slots) — device utilization is this over wall time, the "
     "overlap-is-real number bench.py --serving reports.")
 
+HISTORY_RECORDS = REGISTRY.counter(
+    "tpu_history_records_total",
+    "Performance-history store outcomes per completed query "
+    "(obs/history.py): ok = one JSONL record appended and folded into "
+    "the structure's decay aggregate; io_error = the write failed (or "
+    "a `history` chaos ioerror fired) and the entry was SKIPPED with "
+    "the query unaffected; unkeyed = the plan produced no structure "
+    "key (nothing recorded).",
+    ("outcome",))
+
+HISTORY_ESTIMATES = REGISTRY.counter(
+    "tpu_history_estimates_total",
+    "Cost-oracle estimate calls by basis (obs/estimator.py): "
+    "exact_history = the structure key hit the persistent history and "
+    "the decay-weighted measurement answered; static_cost = never-seen "
+    "structure, answered from the static source-byte cost scaled by "
+    "the continuously-fitted us-per-byte coefficient — the per-basis "
+    "hit/miss/fallback counters of the admission oracle.",
+    ("basis",))
+
+HISTORY_PREDICTION_ERROR = REGISTRY.histogram(
+    "tpu_history_prediction_error_ratio",
+    "Prediction-vs-actual calibration of the cost oracle: one "
+    "observation per executed query that carried an admission-time "
+    "prediction, of max(predicted, measured) / min(predicted, "
+    "measured) device-us (>= 1; 1 = perfect), log2 buckets, labeled "
+    "by estimate basis — the how-wrong-is-the-oracle histogram "
+    "stats(), the heartbeat and the Prometheus endpoint expose.",
+    ("basis",))
+
+SERVING_TENANT_PREDICTED_US = REGISTRY.counter(
+    "tpu_serving_tenant_predicted_device_us_total",
+    "Admission-time PREDICTED device microseconds per serving tenant "
+    "(integer, summed over admitted queries) — read next to "
+    "tpu_serving_tenant_device_us_total, the measured counter, for the "
+    "per-tenant predicted-vs-measured calibration view.",
+    ("tenant",))
+
 DICT_REMAPS = REGISTRY.counter(
     "tpu_join_dict_remaps_total",
     "Host dictionary remap/unification computations (index_in + "

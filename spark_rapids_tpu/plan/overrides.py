@@ -1279,6 +1279,22 @@ class PhysicalQuery:
                 tracer.meta["plan_kind"] = self.kind
                 for name, t0, t1 in self.plan_phases:
                     tracer.add_span(name, "plan", t0, t1)
+                if self.kind == "device":
+                    try:
+                        kp = self.kernel_plan()
+                        if kp:       # the resolved Pallas tier decisions
+                            tracer.meta["kernel_plan"] = kp
+                    except Exception:        # noqa: BLE001
+                        pass
+            # an admission-time cost prediction (serving seeds
+            # predicted.* into ctx.metrics before collect) rides the
+            # trace + event log next to what actually happened
+            pred = {k: v for k, v in ctx.metrics.items()
+                    if k.startswith("predicted.")}
+            if pred:
+                if tracer.enabled:
+                    tracer.meta["prediction"] = pred
+                tracer.instant("admission_prediction", "serving", **pred)
             set_active(tracer)
             try:
                 if should_instrument(self.conf):
@@ -1349,7 +1365,15 @@ class PhysicalQuery:
         from ..runtime.failure import crash_capture, install_fault_injection
         install_fault_injection(self.root, self.conf)
         with self._instrumented(ctx), crash_capture(self.conf, ctx):
-            return self._collect_with_query_retry(ctx)
+            import time as _time
+            from ..exec.metrics import record_history
+            t0 = _time.perf_counter()
+            out = self._collect_with_query_retry(ctx)
+            # the performance-history feed: runs INSIDE crash_capture
+            # (the `history` chaos site's fatal kind dumps classified;
+            # ioerror skips the entry, the result below is untouched)
+            record_history(self, ctx, (_time.perf_counter() - t0) * 1e3)
+            return out
 
     def prewarm(self, ctx: Optional[ExecContext] = None) -> bool:
         """AOT-compile this query's whole-plan program WITHOUT executing
@@ -1853,23 +1877,20 @@ def _negotiate_thin(root) -> None:
             node.thin_payload = frozenset(node.output_schema.names)
 
 
-def kernel_tier_plan(root, conf: TpuConf) -> List[str]:
-    """Plan-level legality report for the Pallas kernel tier
-    (ops/pallas/): one line per candidate operator stating where it
-    will dispatch and, for the sort-tier outcomes, WHY — the static
-    half of the negotiation (batch-dependent facts like dictionary
-    domains and adaptive build-side swaps resolve at runtime and are
-    reported as `runtime:`).  Logged under explain=ALL when the tier
-    is on; bench.py --kernels and the tier tests read it through
-    PhysicalQuery.kernel_plan()."""
+def kernel_tier_decisions(root, conf: TpuConf) -> List[tuple]:
+    """Static Pallas kernel-tier dispatch decisions as (node, decision)
+    pairs in plan preorder — the structured form behind
+    `kernel_tier_plan` (the explain=ALL / bench lines) and the
+    per-node `kernel=` annotations EXPLAIN ANALYZE renders next to
+    each segment (obs/attribution.py).  Empty when the tier is off."""
     from ..exec.adaptive import AdaptiveShuffledJoinExec
-    from ..exec.join import HashJoinExec, key_ref_names
+    from ..exec.join import HashJoinExec
     from ..exec.plan import FilterExec, HashAggregateExec
     from ..ops.pallas import kernel_tier
     tier = kernel_tier(conf)
-    lines: List[str] = []
+    out: List[tuple] = []
     if not tier.any_enabled:
-        return lines
+        return out
     seen = set()
 
     def join_line(node) -> str:
@@ -1891,25 +1912,35 @@ def kernel_tier_plan(root, conf: TpuConf) -> List[str]:
             return
         seen.add(id(node))
         if isinstance(node, (HashJoinExec, AdaptiveShuffledJoinExec)):
-            lines.append(f"{type(node).__name__} -> {join_line(node)}")
+            out.append((node, join_line(node)))
         elif isinstance(node, HashAggregateExec):
             if not tier.segagg:
-                lines.append("HashAggregateExec -> "
-                             "sorted:segagg_family_off")
+                out.append((node, "sorted:segagg_family_off"))
             elif not node.key_exprs:
-                lines.append("HashAggregateExec -> sorted:no_keys")
+                out.append((node, "sorted:no_keys"))
             else:
-                lines.append("HashAggregateExec -> "
-                             "runtime:packed_domain_bound")
+                out.append((node, "runtime:packed_domain_bound"))
         elif isinstance(node, FilterExec):
-            lines.append("FilterExec -> " + (
-                "pallas:compact" if tier.compact
-                else "sorted:compact_family_off"))
+            out.append((node, "pallas:compact" if tier.compact
+                        else "sorted:compact_family_off"))
         for c in node.children:
             walk(c)
 
     walk(root)
-    return lines
+    return out
+
+
+def kernel_tier_plan(root, conf: TpuConf) -> List[str]:
+    """Plan-level legality report for the Pallas kernel tier
+    (ops/pallas/): one line per candidate operator stating where it
+    will dispatch and, for the sort-tier outcomes, WHY — the static
+    half of the negotiation (batch-dependent facts like dictionary
+    domains and adaptive build-side swaps resolve at runtime and are
+    reported as `runtime:`).  Logged under explain=ALL when the tier
+    is on; bench.py --kernels and the tier tests read it through
+    PhysicalQuery.kernel_plan()."""
+    return [f"{type(node).__name__} -> {decision}"
+            for node, decision in kernel_tier_decisions(root, conf)]
 
 
 # ---------------------------------------------------------------------------

@@ -77,6 +77,15 @@ SITES: Dict[str, str] = {
                     "— kind 'corrupt' flips a byte in the cached IPC "
                     "payload so the REAL checksum verification detects "
                     "it, drops the entry and recomputes",
+    "history": "performance-history store write (obs/history.py) — "
+               "fires once per recorded query on the JSONL append "
+               "path. Kind 'ioerror' is absorbed by the store itself: "
+               "the entry is SKIPPED "
+               "(tpu_history_records_total{outcome=io_error}) and the "
+               "query's result is untouched — telemetry must never "
+               "fail work. 'fatal' propagates through the query's "
+               "crash-capture scope as a classified FATAL_DEVICE dump "
+               "naming the site",
     "kernel": "Pallas kernel-tier dispatch (ops/pallas/) — fires each "
               "time an operator elects a hand-written kernel, with the "
               "kernel family in the injected-fault record. Kind 'oom' "

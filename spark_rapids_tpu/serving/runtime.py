@@ -56,7 +56,8 @@ from ..config import (HBM_BUDGET_BYTES, HBM_BUDGET_FRACTION,
                       SERVING_RESULT_CACHE_BYTES, SERVING_STARVATION_BOUND,
                       SERVING_WORKERS, TpuConf)
 from ..obs.registry import (SERVING_ADMIT_WAIT_MS, SERVING_DEVICE_BUSY_US,
-                            SERVING_QUERIES, SERVING_TENANT_DEVICE_US)
+                            SERVING_QUERIES, SERVING_TENANT_DEVICE_US,
+                            SERVING_TENANT_PREDICTED_US)
 from ..obs.registry import SERVING_QUEUE_DEPTH as QUEUE_DEPTH_GAUGE
 from .cache import ResultCache, result_cache_key
 
@@ -86,6 +87,10 @@ class QueryTicket:
         self.tenant = tenant
         self.cache = "bypass"             # hit | miss | store | bypass
         self.plan_kind = None             # "device" | "host" once planned
+        #: admission-time cost prediction (obs/estimator.py), or None
+        #: when the history plane is off: {device_us, working_set_bytes,
+        #: compile_ms, confidence, basis, ...}
+        self.predicted: Optional[dict] = None
         self.device_us = 0                # measured device-execute micros
         self.skips = 0                    # scheduler pass-overs at grant
         self.admit_wait_ms = 0.0
@@ -323,6 +328,20 @@ class ServingRuntime:
         with self._phase("plan", ticket):
             q = apply_overrides(ticket.plan, ticket.conf)
         ticket.plan_kind = q.kind
+        # admission-time cost prediction: the structure-keyed history
+        # oracle (obs/estimator.py) answers BEFORE anything runs; the
+        # prediction rides the ticket, the per-tenant predicted-us
+        # counter, and (below) the query's tracer/event log — the
+        # execution record closes the calibration loop
+        try:
+            from ..obs.estimator import estimate_query
+            ticket.predicted = estimate_query(q)
+        except Exception:                            # noqa: BLE001
+            ticket.predicted = None      # the oracle must never fail a query
+        pred = ticket.predicted
+        if pred:
+            SERVING_TENANT_PREDICTED_US.inc(int(pred["device_us"]),
+                                            tenant=ticket.tenant)
         keyed = None
         if self.cache.cap_bytes and q.kind == "device":
             keyed = result_cache_key(q.root, ticket.conf)
@@ -336,11 +355,28 @@ class ServingRuntime:
             self._compile(q, ticket)
         with self._phase("upload", ticket):
             est_bytes = self._upload(q, ticket)
+        if pred and pred["basis"] == "exact_history":
+            # a measured working set beats the source-bytes heuristic:
+            # admission schedules against the LARGER of the two (the
+            # oracle can tighten later once calibration earns trust)
+            est_bytes = max(est_bytes,
+                            int(pred.get("working_set_bytes") or 0))
         with self._device_grant(ticket, est_bytes):
             with self._phase("execute", ticket):
                 from ..exec.plan import ExecContext
                 ctx = ExecContext(ticket.conf)
                 ctx.metrics["serving.tenant"] = ticket.tenant
+                if pred:
+                    # stamped pre-collect so the instrumented scope
+                    # embeds the prediction in the trace + event log
+                    # and the history record calibrates against it
+                    ctx.metrics["predicted.device_us"] = \
+                        int(pred["device_us"])
+                    ctx.metrics["predicted.basis"] = pred["basis"]
+                    ctx.metrics["predicted.working_set_bytes"] = \
+                        int(pred.get("working_set_bytes") or 0)
+                    ctx.metrics["predicted.confidence"] = \
+                        pred.get("confidence")
                 t0 = time.perf_counter()
                 out = q.collect(ctx)
                 ticket.device_us = int(
@@ -493,6 +529,13 @@ class ServingRuntime:
                    "tenants": tenants,
                    "result_cache": self.cache.stats()}
         out["overlap_observed"] = _overlap_observed(intervals)
+        # oracle trustworthiness: per-basis estimate counts + the
+        # prediction-error summary (obs/estimator.py / history plane)
+        try:
+            from ..obs.estimator import prediction_stats
+            out["prediction"] = prediction_stats()
+        except Exception:                            # noqa: BLE001
+            pass
         return out
 
     # -- lifecycle ---------------------------------------------------------

@@ -45,6 +45,11 @@ class TpuSession:
         # when the conf is unset
         from .exec.compiled import configure_persistent_cache
         configure_persistent_cache(self.conf)
+        # persistent performance-history store (structure-keyed measured
+        # cost, spark.rapids.tpu.history.dir) — warms the on-disk load
+        # so the first query/estimate pays nothing; no-op when unset
+        from .obs.history import configure_history
+        configure_history(self.conf)
 
     def set_conf(self, key: str, value) -> None:
         """Atomic conf swap: TpuConf instances are immutable, so a
@@ -62,6 +67,8 @@ class TpuSession:
         configure_plane(new_conf)
         from .exec.compiled import configure_persistent_cache
         configure_persistent_cache(new_conf)
+        from .obs.history import configure_history
+        configure_history(new_conf)
 
     def serving(self, conf_overrides: Optional[Dict] = None):
         """The session's ServingRuntime (created on first call): the
@@ -110,6 +117,25 @@ class TpuSession:
         returns the device-time attribution report
         (see DataFrame.explain_analyze / obs/attribution.py)."""
         return df.physical().explain_analyze(conf_overrides)
+
+    def cost_estimate(self, df: "DataFrame"):
+        """Admission-style cost estimate for a DataFrame from the
+        persistent performance-history oracle (obs/estimator.py):
+        {device_us, working_set_bytes, compile_ms, confidence, basis,
+        ...} — basis 'exact_history' when the query's canonical
+        structure has recorded runs, 'static_cost' otherwise.  None
+        when the history plane is off
+        (spark.rapids.tpu.history.dir unset)."""
+        from .obs.estimator import estimate_query
+        return estimate_query(df.physical())
+
+    def perf_history_stats(self):
+        """The persistent performance-history store's state (structure
+        count, records, corrupt lines tolerated, calibration curves,
+        fitted static coefficient), or None when the plane is off."""
+        from .obs.history import get_store
+        store = get_store(self.conf)
+        return None if store is None else store.stats()
 
     def metrics_snapshot(self, compact: bool = False) -> dict:
         """The process-wide always-on metrics registry: every counter,

@@ -717,6 +717,56 @@ def test_kernel_error_kind_propagates_as_query_error():
 
 
 # ---------------------------------------------------------------------------
+# history site: the performance-history plane must never fail work
+# ---------------------------------------------------------------------------
+
+def _history_build(tbl):
+    return lambda s: s.from_arrow(tbl).filter(
+        E.GreaterThan(col("v"), E.Literal(0.0))).sort(("v", True, True))
+
+
+def test_history_ioerror_skips_entry_query_unaffected(tmp_path):
+    """`history:ioerror:always`: every history append fails — the store
+    skips the entry (tpu_history_records_total{outcome=io_error}), the
+    file never materializes, and the query result is BIT-IDENTICAL to
+    the clean run: telemetry loss must never cost work."""
+    from spark_rapids_tpu.obs.registry import HISTORY_RECORDS
+    tbl = sort_tbl(2_000, seed=31)
+    clean, _s, _df = run_query(_history_build(tbl))
+    hd = tmp_path / "hist"
+    io0 = HISTORY_RECORDS.value(outcome="io_error") or 0
+    chaos, s, _df = run_query(
+        _history_build(tbl),
+        {"spark.rapids.tpu.history.dir": str(hd)},
+        faults="history:ioerror:always")
+    assert_identical(clean, chaos)
+    assert "history" in fired_sites(s)
+    assert (HISTORY_RECORDS.value(outcome="io_error") or 0) - io0 >= 1
+    from spark_rapids_tpu.obs.history import get_store
+    store = get_store(s.conf)
+    assert store is not None and store.recorded == 0
+    assert not os.path.exists(store.path)
+
+
+def test_history_fatal_classified_dump(tmp_path):
+    """`history:fatal:nth=1`: a fatal on the history write path surfaces
+    through the query's crash-capture scope as a classified
+    FatalDeviceError whose dump's injected-fault record names the
+    site."""
+    tbl = sort_tbl(1_500, seed=33)
+    with pytest.raises(FatalDeviceError) as ei:
+        run_query(
+            _history_build(tbl),
+            {"spark.rapids.tpu.history.dir": str(tmp_path / "hist"),
+             "spark.rapids.tpu.coredump.path": str(tmp_path)},
+            faults="history:fatal:nth=1")
+    dump = json.load(open(ei.value.dump_path))
+    rec = dump["injected_faults"]
+    assert rec and rec[0]["site"] == "history" and \
+        rec[0]["kind"] == "fatal"
+
+
+# ---------------------------------------------------------------------------
 # coverage lint: every registered site is exercised by this file
 # ---------------------------------------------------------------------------
 

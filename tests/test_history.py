@@ -1,0 +1,487 @@
+"""Persistent performance-history plane (ISSUE 12): the structure-keyed
+cost oracle — store round trips (in-process, cross-process, corrupt
+recovery), warm-suite calibration bound, static-cost fallback, serving
+admission prediction + calibration under concurrency, EXPLAIN ANALYZE's
+predicted column + kernel-tier annotations, and the history_report /
+check_regression triage hooks."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec.plan import ExecContext
+from spark_rapids_tpu.obs.history import (PerfHistoryStore,
+                                          compute_history_key, get_store,
+                                          history_key)
+from spark_rapids_tpu.plan.aggregates import Count, Sum
+from spark_rapids_tpu.session import TpuSession, col, lit
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WHOLE = {"spark.rapids.tpu.sql.compile.wholePlan": "ON"}
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _session(tmp_path, extra=None):
+    return TpuSession({**WHOLE,
+                       "spark.rapids.tpu.history.dir":
+                           str(tmp_path / "hist"),
+                       **(extra or {})})
+
+
+def _tbl(n=3000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({"k": pa.array(rng.integers(0, 7, n), pa.int64()),
+                     "v": pa.array(rng.standard_normal(n))})
+
+
+def _query(s, tbl, cut=0.0):
+    return (s.from_arrow(tbl).filter(col("v") > lit(cut))
+            .group_by("k").agg((Sum(col("v")), "sv"),
+                               (Count(None), "ct")))
+
+
+# ---------------------------------------------------------------------------
+# the structure key
+# ---------------------------------------------------------------------------
+
+def test_history_key_stable_and_observability_neutral(tmp_path):
+    """Same structure -> same digest; literal-only variants share it
+    (constant lifting); observability conf (trace/profile/eventLog/
+    history/serving) never changes it — an EXPLAIN ANALYZE run, a
+    serving admission and a plain collect feed ONE history line."""
+    s = _session(tmp_path)
+    t = _tbl()
+    qa = _query(s, t, cut=0.0).physical()
+    qb = _query(s, t, cut=0.5).physical()      # literal variant
+    ka, kb = history_key(qa), history_key(qb)
+    assert ka is not None and ka == kb
+    # a different structure keys differently
+    qc = (s.from_arrow(t).group_by("k")
+          .agg((Count(None), "ct"))).physical()
+    assert history_key(qc) != ka
+    # observability-only conf keys are neutral
+    noisy = TpuConf({**s.conf._raw,
+                     "spark.rapids.tpu.trace.enabled": "true",
+                     "spark.rapids.tpu.profile.segments": "true",
+                     "spark.rapids.tpu.eventLog.dir": "/tmp/x",
+                     "spark.rapids.tpu.serving.queueDepth": "7"})
+    assert compute_history_key(qa.root, noisy, qa.kind) == ka
+    # an engine-semantics key is NOT neutral
+    other = TpuConf({**s.conf._raw,
+                     "spark.rapids.tpu.sql.segments.scatterFree."
+                     "enabled": "false"})
+    assert compute_history_key(qa.root, other, qa.kind) != ka
+
+
+# ---------------------------------------------------------------------------
+# record -> estimate round trip + the warm calibration bound
+# ---------------------------------------------------------------------------
+
+def test_record_estimate_roundtrip_and_static_fallback(tmp_path):
+    s = _session(tmp_path)
+    t = _tbl()
+    df = _query(s, t)
+    # never-seen structure: static_cost, never an error
+    est0 = s.cost_estimate(df)
+    assert est0["basis"] == "static_cost"
+    assert est0["device_us"] > 0 and est0["runs"] == 0
+    q = df.physical()
+    q.collect(ExecContext(s.conf))             # cold (recorded)
+    q.collect(ExecContext(s.conf))             # warm (recorded)
+    est = s.cost_estimate(df)
+    assert est["basis"] == "exact_history"
+    assert est["runs"] == 2 and est["warm_runs"] >= 1
+    assert est["working_set_bytes"] > 0
+    st = s.perf_history_stats()
+    assert st["structures"] >= 1 and st["records_appended"] == 2
+    # the fitted static coefficient now answers for unseen structures
+    assert st["us_per_byte"] and st["us_per_byte"] > 0
+    df2 = s.from_arrow(t).group_by("k").agg((Count(None), "c2"))
+    est2 = s.cost_estimate(df2)
+    assert est2["basis"] == "static_cost" and est2["confidence"] > 0
+
+
+def test_warm_suite_calibration_bound_tpch_q6(tmp_path):
+    """The tier-1 acceptance bound: after one recorded warm run of a
+    TPC-H query, the estimator's predicted device-us for the identical
+    structure is within 2x of the next measured run, on the
+    exact-history basis — and a never-seen TPC-H structure answers
+    static_cost instead of erroring."""
+    from spark_rapids_tpu import tpch
+    tables = tpch.gen_tables(scale=0.01)
+    s = _session(tmp_path)
+    df = tpch.QUERIES["q6"](s, tables)
+    q = df.physical()
+    ctx = ExecContext(s.conf)
+    q.collect(ctx)                             # cold (recorded)
+    q.collect(ExecContext(s.conf))             # warm (recorded)
+    est = s.cost_estimate(df)
+    assert est["basis"] == "exact_history"
+    # next measured run, through the SAME definition the store records
+    store = get_store(s.conf)
+    key = history_key(q)
+    t0 = time.perf_counter()
+    q.collect(ExecContext(s.conf))
+    _ = (time.perf_counter() - t0)
+    measured_us = store.get(key).last_warm_us
+    assert measured_us > 0
+    ratio = max(est["device_us"], measured_us) / \
+        min(est["device_us"], measured_us)
+    assert ratio < 2.0, (est, measured_us)
+    # never-seen TPC-H structure: static basis, no error
+    est_q1 = s.cost_estimate(tpch.QUERIES["q1"](s, tables))
+    assert est_q1["basis"] == "static_cost"
+
+
+# ---------------------------------------------------------------------------
+# persistence: second process, corrupt recovery, compaction
+# ---------------------------------------------------------------------------
+
+_SUBPROC = r"""
+import json, sys
+import numpy as np, pyarrow as pa
+from spark_rapids_tpu.session import TpuSession, col, lit
+from spark_rapids_tpu.exec.plan import ExecContext
+from spark_rapids_tpu.plan.aggregates import Count, Sum
+s = TpuSession({"spark.rapids.tpu.sql.compile.wholePlan": "ON",
+                "spark.rapids.tpu.history.dir": sys.argv[1]})
+rng = np.random.default_rng(0)
+t = pa.table({"k": pa.array(rng.integers(0, 7, 3000), pa.int64()),
+              "v": pa.array(rng.standard_normal(3000))})
+df = (s.from_arrow(t).filter(col("v") > lit(0.0))
+      .group_by("k").agg((Sum(col("v")), "sv"), (Count(None), "ct")))
+mode = sys.argv[2]
+if mode == "record":
+    q = df.physical()
+    q.collect(ExecContext(s.conf))
+    q.collect(ExecContext(s.conf))
+    from spark_rapids_tpu.obs.history import get_store, history_key
+    agg = get_store(s.conf).get(history_key(q))
+    print(json.dumps({"stats": s.perf_history_stats(),
+                      "warm_us": agg.last_warm_us}))
+else:
+    est = s.cost_estimate(df)          # NO collect: zero re-measurement
+    print(json.dumps({"est": est, "stats": s.perf_history_stats()}))
+"""
+
+
+def test_second_process_serves_calibrated_estimate(tmp_path):
+    """Persistence proof (the PR 7 persistent-cache subprocess mirror):
+    process A records two runs; process B loads the store from disk and
+    serves an exact-history estimate within 2x of A's warm measurement
+    with ZERO re-measurement (it never collects)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "JAX_ENABLE_X64": "1",
+           "PYTHONPATH": _ROOT}
+
+    def run(mode):
+        res = subprocess.run(
+            [sys.executable, "-c", _SUBPROC, str(tmp_path / "hist"),
+             mode],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert res.returncode == 0, res.stderr[-2000:]
+        return json.loads(res.stdout.strip().splitlines()[-1])
+
+    a = run("record")
+    assert a["stats"]["records_appended"] == 2
+    assert a["warm_us"] > 0
+    b = run("estimate")
+    assert b["stats"]["records_loaded"] == 2      # from disk
+    assert b["stats"]["records_appended"] == 0    # zero re-measurement
+    est = b["est"]
+    assert est["basis"] == "exact_history" and est["runs"] == 2
+    ratio = max(est["device_us"], a["warm_us"]) / \
+        min(est["device_us"], a["warm_us"])
+    assert ratio < 2.0, (est, a)
+
+
+def test_corrupt_and_truncated_history_recovery(tmp_path):
+    """A damaged history file (garbage line mid-file + truncated final
+    line, the crash-time shape) loads: intact records win, damage is
+    counted, estimates still serve."""
+    s = _session(tmp_path)
+    t = _tbl(seed=3)
+    df = _query(s, t)
+    q = df.physical()
+    q.collect(ExecContext(s.conf))
+    q.collect(ExecContext(s.conf))
+    store = get_store(s.conf)
+    key = history_key(q)
+    with open(store.path, "a") as f:
+        f.write("##### NOT JSON #####\n")
+        f.write('{"k": "' + key + '", "device_us": 99')  # truncated
+    fresh = PerfHistoryStore(store.path)
+    assert fresh.corrupt_lines == 2
+    agg = fresh.get(key)
+    assert agg is not None and agg.runs == 2
+    assert agg.warm_runs >= 1 and agg.predicted_us() > 0
+
+
+def test_store_compaction_lru_entry_and_byte_caps(tmp_path):
+    """Past the caps the store compacts to per-structure aggregate
+    summaries, dropping least-recently-updated structures first, and
+    the compacted file round-trips."""
+    path = str(tmp_path / "perf_history.jsonl")
+    st = PerfHistoryStore(path, max_entries=3, decay=0.5)
+    for i in range(7):
+        for _ in range(2):
+            st.record(f"k{i}", {"device_us": 1000.0 * (i + 1),
+                                "wall_ms": i + 1.0, "compile_ms": 0.0,
+                                "src_bytes": 4096})
+    assert st.compactions >= 1
+    assert set(st.aggregates()) == {"k4", "k5", "k6"}
+    reloaded = PerfHistoryStore(path, max_entries=3)
+    assert set(reloaded.aggregates()) == {"k4", "k5", "k6"}
+    assert reloaded.get("k6").runs == 2
+    assert reloaded.us_per_byte is not None   # fit state survives
+    # byte cap: a tiny cap forces every append into compaction and the
+    # file stays bounded
+    path2 = str(tmp_path / "tiny.jsonl")
+    st2 = PerfHistoryStore(path2, max_bytes=2048, max_entries=1000)
+    for i in range(40):
+        st2.record(f"s{i}", {"device_us": 10.0, "wall_ms": 1.0,
+                             "compile_ms": 0.0})
+    assert os.path.getsize(path2) <= 4096
+    assert len(st2.aggregates()) < 40
+
+
+# ---------------------------------------------------------------------------
+# serving: admission predictions, calibration, zero cross-tenant leakage
+# ---------------------------------------------------------------------------
+
+def test_serving_admission_prediction_hammer(tmp_path):
+    """8 threads x 8 tenants through the serving plane with the history
+    oracle on: every ticket carries an admission-time prediction, the
+    prediction-error histogram populates from the executed runs, and
+    the per-tenant PREDICTED counter equals that tenant's own ticket
+    sum exactly — zero cross-tenant leakage."""
+    from spark_rapids_tpu.obs.registry import (HISTORY_PREDICTION_ERROR,
+                                               SERVING_TENANT_PREDICTED_US)
+    s = _session(tmp_path, {
+        # every query must EXECUTE (a cache hit records nothing)
+        "spark.rapids.tpu.serving.resultCache.bytes": "0"})
+    try:
+        t = _tbl(seed=11)
+        df = _query(s, t)
+        # seed the history so most predictions ride the exact basis
+        q = df.physical()
+        q.collect(ExecContext(s.conf))
+        q.collect(ExecContext(s.conf))
+
+        def err_count():
+            return sum(sr["count"]
+                       for sr in HISTORY_PREDICTION_ERROR.series())
+
+        e0 = err_count()
+        rt = s.serving()
+        tenants = [f"ht{i}" for i in range(8)]
+        pred0 = {tn: SERVING_TENANT_PREDICTED_US.value(tenant=tn) or 0
+                 for tn in tenants}
+        per_tenant_tickets = {tn: [] for tn in tenants}
+        errors = []
+
+        def client(tn):
+            try:
+                h = rt.tenant(tn)
+                for _ in range(3):
+                    tk = h.submit(df)
+                    tk.result(120)
+                    per_tenant_tickets[tn].append(tk)
+            except Exception as e:               # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(tn,))
+                   for tn in tenants]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(180)
+        assert not errors, errors
+
+        for tn in tenants:
+            tickets = per_tenant_tickets[tn]
+            assert len(tickets) == 3
+            for tk in tickets:
+                assert tk.predicted is not None
+                assert tk.predicted["basis"] in ("exact_history",
+                                                 "static_cost")
+                assert tk.predicted["device_us"] > 0
+            # zero cross-tenant leakage: the registry's per-tenant
+            # predicted total IS this tenant's own ticket sum, exactly
+            expect = sum(int(tk.predicted["device_us"])
+                         for tk in tickets)
+            got = (SERVING_TENANT_PREDICTED_US.value(tenant=tn) or 0) \
+                - pred0[tn]
+            assert got == expect, (tn, got, expect)
+        # calibration populated: one observation per executed query
+        assert err_count() - e0 >= 24
+        st = rt.stats()
+        assert st["prediction"]["calibration"]["count"] >= 24
+        assert st["prediction"]["estimates"]
+    finally:
+        s.close()
+
+
+def test_serving_prediction_stamped_into_event_log(tmp_path):
+    """The admission prediction rides the query's trace + event log:
+    query_end metrics carry predicted.* and meta carries the
+    prediction block."""
+    log_dir = tmp_path / "events"
+    s = _session(tmp_path, {
+        "spark.rapids.tpu.eventLog.dir": str(log_dir),
+        "spark.rapids.tpu.serving.resultCache.bytes": "0"})
+    try:
+        df = _query(s, _tbl(seed=13))
+        rt = s.serving()
+        rt.tenant("evt").collect(df)
+        logs = [p for p in os.listdir(log_dir) if p.endswith(".jsonl")]
+        assert logs
+        from spark_rapids_tpu.obs.tracer import read_event_log
+        found = False
+        for p in logs:
+            log = read_event_log(str(log_dir / p))
+            if "predicted.device_us" in (log.metrics or {}):
+                found = True
+                assert log.metrics["predicted.basis"] in \
+                    ("exact_history", "static_cost")
+                assert "prediction" in log.meta
+        assert found, "no event log carries the admission prediction"
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE: predicted column + kernel-tier decisions
+# ---------------------------------------------------------------------------
+
+def test_explain_analyze_predicted_and_kernel_annotations(tmp_path):
+    s = _session(tmp_path, {
+        "spark.rapids.tpu.sql.kernels.pallas.enabled": "true"})
+    t = _tbl(seed=17)
+    df = _query(s, t)
+    df.collect()                                # seed history (recorded)
+    df.collect()
+    rep = df.explain_analyze()
+    assert rep.predicted is not None
+    assert rep.predicted["basis"] == "exact_history"
+    text = rep.render()
+    assert "predicted device" in text
+    # the kernel-tier decision annotates the owning node in the tree
+    assert rep.kernel_tiers, "no kernel-tier decisions on a pallas plan"
+    assert "[kernel: " in rep.tree
+    assert any(d.startswith(("pallas:", "sorted:", "runtime:"))
+               for d in rep.kernel_tiers.values())
+
+
+def test_event_log_carries_kernel_plan_meta(tmp_path):
+    """With tracing on and the Pallas tier resolved, the event log's
+    meta embeds kernel_plan() so profile_report renders per-query
+    kernel-tier decisions offline."""
+    log_dir = tmp_path / "events"
+    s = _session(tmp_path, {
+        "spark.rapids.tpu.eventLog.dir": str(log_dir),
+        "spark.rapids.tpu.sql.kernels.pallas.enabled": "true"})
+    _query(s, _tbl(seed=19)).collect()
+    logs = [p for p in os.listdir(log_dir) if p.endswith(".jsonl")]
+    assert logs
+    from spark_rapids_tpu.obs.tracer import read_event_log
+    metas = [read_event_log(str(log_dir / p)).meta for p in logs]
+    assert any(m.get("kernel_plan") for m in metas)
+    # and the offline report surfaces them
+    mod = _load_script("profile_report")
+    lines = mod.kernel_plan_section(
+        next(m for m in metas if m.get("kernel_plan")))
+    assert lines and "kernel tier decisions" in lines[0]
+
+
+# ---------------------------------------------------------------------------
+# triage scripts (CI satellites)
+# ---------------------------------------------------------------------------
+
+def test_history_report_self_test(capsys):
+    mod = _load_script("history_report")
+    assert mod.main(["--self-test"]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_history_report_renders_real_store(tmp_path, capsys):
+    s = _session(tmp_path)
+    df = _query(s, _tbl(seed=23))
+    df.collect()
+    df.collect()
+    mod = _load_script("history_report")
+    assert mod.main([str(tmp_path / "hist")]) == 0
+    out = capsys.readouterr().out
+    assert "top structures by cumulative device time" in out
+    assert "drift" in out
+
+
+def test_profile_diff_self_test_covers_kernels_and_serving(capsys):
+    mod = _load_script("profile_diff")
+    assert mod.self_test() == 0
+
+
+def test_check_regression_cites_history_drift(tmp_path, capsys):
+    """When the gate fails and --history-dir is given, the failure
+    cites the plan structures that drifted >2x from their own measured
+    history — the regression-triage entry point."""
+    base = tmp_path / "BENCH_r01.json"
+    cur = tmp_path / "current.json"
+    json.dump({"backend": "cpu", "final": True,
+               "tpch_suite_queries": {
+                   "q1": {"device_ms_net": 100.0}}}, open(base, "w"))
+    json.dump({"backend": "cpu", "final": True,
+               "tpch_suite_queries": {
+                   "q1": {"device_ms_net": 300.0}}}, open(cur, "w"))
+    hist = tmp_path / "hist"
+    hist.mkdir()
+    st = PerfHistoryStore(str(hist / "perf_history.jsonl"), decay=0.3)
+    for us in (100_000.0, 101_000.0, 99_000.0, 320_000.0):
+        st.record("deadbeefdeadbeef",
+                  {"device_us": us, "wall_ms": us / 1e3,
+                   "compile_ms": 0.0, "label": "q1"})
+    mod = _load_script("check_regression")
+    rc = mod.main(["--current", str(cur), str(base),
+                   "--history-dir", str(hist), "--min-ms", "10"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSION q1" in out
+    assert "history drift" in out
+    assert "q1:" in out and "deadbeefdeadbeef" in out
+
+
+# ---------------------------------------------------------------------------
+# disabled-path + fault-spec sanity
+# ---------------------------------------------------------------------------
+
+def test_disabled_history_is_inert():
+    s = TpuSession(dict(WHOLE))
+    assert get_store(s.conf) is None
+    assert s.perf_history_stats() is None
+    assert s.cost_estimate(_query(s, _tbl(seed=29))) is None
+    # cached: the second check is one dict hit
+    assert get_store(s.conf) is None
+
+
+def test_history_site_in_fault_grammar():
+    from spark_rapids_tpu.runtime.faults import SITES, parse_spec
+    assert "history" in SITES
+    parse_spec("history:ioerror:always")
+    parse_spec("history:fatal:nth=1")
+    with pytest.raises(ValueError):
+        parse_spec("history:corrupt:nth=1")     # no payload at this site
