@@ -560,6 +560,170 @@ def run_kernels():
         "final": True}), flush=True)
 
 
+#: --encodings microbench sizes (rows) and selectivities
+ENCODING_ROWS = 1 << 20
+ENCODING_SELECTIVITIES = {"sel1": 0.01, "sel50": 0.5}
+
+
+def run_encodings():
+    """--encodings: encoded-vs-decode-first A/B microbenchmarks of the
+    compressed device-resident execution layer (ISSUE 13) over
+    predicate/join/agg x dict/RLE/FOR x 2 selectivities, emitting
+    `encoding_timings_ms` entries scripts/check_regression.py gates
+    under the `en:` prefix (same backend-separation rule as qN
+    device_ms).
+
+    Shapes per encoding:
+      * dict — predicate: code-space equality (one scalar compare) vs
+        the decode-first per-row remap-table gather; join: probe of
+        dictionary-coded keys on codes vs probing decoded rank lanes;
+        agg: 32-group code-keyed segment sums vs rank-decoded keys.
+      * RLE  — predicate evaluated per RUN + rank-search mask expansion
+        (ops/encodings.rle_predicate_mask) vs rle_decode-then-compare.
+      * FOR  — predicate/arith on the value-preserving narrow lane
+        (range-guarded compare, exact-width add) vs widen-then-compute.
+    Selectivity levels move the predicate cut point (sel1 ~1% true,
+    sel50 ~50% true) — code/narrow compares are selectivity-invariant,
+    the decode-first gathers are too, so the ratio isolates the decode
+    cost itself."""
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.bitpack import rle_decode
+    from spark_rapids_tpu.ops.encodings import (narrow_compare,
+                                                rle_predicate_mask)
+    rng = np.random.default_rng(23)
+    n = ENCODING_ROWS
+    out = {}
+
+    def timed(name, fn):
+        jax.block_until_ready(fn())                      # compile+warm
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            times.append(time.perf_counter() - t0)
+        out[name] = round(min(times) * 1e3, 2)
+        print(f"# {name}: {out[name]}ms", file=sys.stderr)
+
+    dict_size = 1024
+    codes = jnp.asarray(rng.integers(0, dict_size, n), jnp.int32)
+    remap = jnp.asarray(rng.permutation(dict_size).astype(np.int32))
+
+    for sname, sel in ENCODING_SELECTIVITIES.items():
+        if left() < 45:
+            print(f"# budget: skipping encodings level {sname}",
+                  file=sys.stderr)
+            continue
+        cut = max(int(dict_size * sel), 1)
+
+        # -- dict: predicate (code-space vs remap-decode-first)
+        @jax.jit
+        def dict_pred_encoded(codes):
+            return codes < cut                    # ordered dict: code IS rank
+
+        @jax.jit
+        def dict_pred_decoded(codes, remap):
+            ranks = remap[jnp.clip(codes, 0, remap.shape[0] - 1)]
+            return ranks < cut
+
+        timed(f"dict_pred_{sname}_encoded", lambda: dict_pred_encoded(codes))
+        timed(f"dict_pred_{sname}_decoded",
+              lambda: dict_pred_decoded(codes, remap))
+
+        # -- dict: join probe on codes vs on decoded rank lanes
+        from spark_rapids_tpu.ops.join import _merge_rank
+        bkeys = jnp.asarray(np.arange(dict_size), jnp.int64)
+
+        @jax.jit
+        def dict_join_encoded(codes):
+            return _merge_rank(bkeys.astype(jnp.uint64),
+                               codes.astype(jnp.uint64), side="left")
+
+        @jax.jit
+        def dict_join_decoded(codes, remap):
+            lane = remap[jnp.clip(codes, 0, remap.shape[0] - 1)]
+            return _merge_rank(jnp.sort(remap.astype(jnp.uint64)),
+                               lane.astype(jnp.uint64), side="left")
+
+        timed(f"dict_join_{sname}_encoded", lambda: dict_join_encoded(codes))
+        timed(f"dict_join_{sname}_decoded",
+              lambda: dict_join_decoded(codes, remap))
+
+        # -- dict: 32-group segment sums keyed by codes vs decoded ranks
+        vals = jnp.asarray(rng.integers(0, 1000, n), jnp.int64)
+
+        @jax.jit
+        def dict_agg_encoded(codes, vals):
+            return jax.ops.segment_sum(vals, codes % 32, num_segments=32)
+
+        @jax.jit
+        def dict_agg_decoded(codes, remap, vals):
+            lane = remap[jnp.clip(codes, 0, remap.shape[0] - 1)]
+            return jax.ops.segment_sum(vals, lane % 32, num_segments=32)
+
+        timed(f"dict_agg_{sname}_encoded",
+              lambda: dict_agg_encoded(codes, vals))
+        timed(f"dict_agg_{sname}_decoded",
+              lambda: dict_agg_decoded(codes, remap, vals))
+
+        # -- RLE: run-domain predicate vs decode-then-compare
+        n_runs = n // 64
+        run_vals = jnp.asarray(rng.integers(0, 1000, n_runs), jnp.int64)
+        run_lens = jnp.asarray(np.full(n_runs, 64), jnp.int32)
+        thr = int(1000 * sel)
+
+        @jax.jit
+        def rle_encoded(run_vals, run_lens):
+            return rle_predicate_mask(run_vals, run_lens, n,
+                                      lambda v: v < thr)
+
+        @jax.jit
+        def rle_decoded(run_vals, run_lens):
+            return rle_decode(run_vals, run_lens, n) < thr
+
+        timed(f"rle_pred_{sname}_encoded",
+              lambda: rle_encoded(run_vals, run_lens))
+        timed(f"rle_pred_{sname}_decoded",
+              lambda: rle_decoded(run_vals, run_lens))
+
+        # -- FOR: narrow-lane predicate + exact-width add vs widened
+        narrow = jnp.asarray(rng.integers(-1000, 1000, n), jnp.int16)
+        thr16 = jnp.asarray(int(2000 * sel) - 1000, jnp.int64)
+
+        @jax.jit
+        def for_encoded(narrow):
+            keep = narrow_compare("<", narrow, thr16)
+            s = narrow.astype(jnp.int32) + narrow.astype(jnp.int32)
+            return keep, s
+
+        @jax.jit
+        def for_decoded(narrow):
+            wide = narrow.astype(jnp.int64)
+            return wide < thr16, wide + wide
+
+        timed(f"for_pred_{sname}_encoded", lambda: for_encoded(narrow))
+        timed(f"for_pred_{sname}_decoded", lambda: for_decoded(narrow))
+
+    ratios = {}
+    for k in sorted(out):
+        if k.endswith("_encoded"):
+            base = out.get(k.replace("_encoded", "_decoded"))
+            if base:
+                ratios[k[:-8]] = round(out[k] / base, 3)
+    print(json.dumps({
+        "mode": "encodings",
+        "metric": "encoding_microbench_encoded_vs_decoded",
+        "value": round(float(np.exp(np.mean(np.log(
+            [max(r, 1e-6) for r in ratios.values()])))), 3)
+        if ratios else None,
+        "unit": "x (encoded/decode-first, lower is better)",
+        "backend": jax.default_backend(),
+        "encoding_timings_ms": out,
+        "encoded_over_decoded_ratio": ratios,
+        "elapsed_s": round(time.perf_counter() - _T0, 1),
+        "final": True}), flush=True)
+
+
 #: default serving mix: a fast, join/agg-diverse TPC-H tranche (clients
 #: rotate through it; --queries overrides)
 SERVING_MIX = ["q1", "q3", "q6", "q12", "q14", "q19"]
@@ -805,6 +969,7 @@ def main():
     compile_only = False
     serving = False
     kernels = False
+    encodings = False
     multichip = False
     multichip_sf = 10.0
     args = list(sys.argv[1:])
@@ -821,6 +986,8 @@ def main():
             EXTRA_CONF[k] = v
         elif a == "--kernels":
             kernels = True
+        elif a == "--encodings":
+            encodings = True
         elif a.startswith("--history-dir"):
             # persistent performance-history plane: every measured query
             # records its structure-keyed device time (obs/history.py)
@@ -878,6 +1045,10 @@ def main():
     if kernels:
         # Pallas-vs-sorted kernel microbench A/B (KERNELS_r*.json)
         run_kernels()
+        return
+    if encodings:
+        # encoded-vs-decode-first microbench A/B (ENCODINGS_r*.json)
+        run_encodings()
         return
     if serving:
         # concurrent closed-loop serving sweep (names = the mix)

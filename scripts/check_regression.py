@@ -175,6 +175,42 @@ def extract_kernels(doc):
     return {}, None
 
 
+def extract_encodings(doc):
+    """-> ({'en:<entry>': ms}, backend or None) from a bench.py
+    --encodings result: the `encoding_timings_ms` A/B dict (encoded vs
+    decode-first per encoding family / operator / selectivity, lower =
+    better) becomes `en:`-prefixed entries that gate like per-query
+    device_ms under the same backend-separation rule (never colliding
+    with qN / mc: / sv: / kn: names).  Accepts the runner's JSON line,
+    the driver wrapper, and a tail."""
+    if not isinstance(doc, dict):
+        return {}, None
+    tim = doc.get("encoding_timings_ms")
+    if isinstance(tim, dict) and tim:
+        out = {f"en:{k}": float(v) for k, v in tim.items()
+               if isinstance(v, (int, float))}
+        return out, str(doc.get("backend") or _DEFAULT_BACKEND)
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        out, backend = extract_encodings(parsed)
+        if out:
+            return out, backend
+    tail = doc.get("tail")
+    if isinstance(tail, str) and "encoding_timings_ms" in tail:
+        for line in reversed(tail.splitlines()):
+            if "encoding_timings_ms" not in line:
+                continue
+            try:
+                rec = json.loads(line.strip())
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out, backend = extract_encodings(rec)
+                if out:
+                    return out, backend
+    return {}, None
+
+
 def _rec_ms(rec: dict, rtt_ms: float):
     """Net-of-floor milliseconds for one per-query record: the explicit
     `device_ms_net` when the bench emitted it, else `device_ms` minus
@@ -310,6 +346,13 @@ def load_file(path: str):
         qs = {**qs, **kn}
         if (not backend or backend == _DEFAULT_BACKEND) and kn_backend:
             backend = kn_backend
+    en, en_backend = extract_encodings(doc)
+    if en:
+        # encoded-execution microbench entries gate under their en:
+        # prefix; a pure encodings record carries its own backend tag
+        qs = {**qs, **en}
+        if (not backend or backend == _DEFAULT_BACKEND) and en_backend:
+            backend = en_backend
     return qs, backend, extract_compile_ms(doc)
 
 
@@ -352,7 +395,8 @@ def default_trajectory() -> list:
     return (sorted(glob.glob(os.path.join(_ROOT, "BENCH_r*.json"))) +
             sorted(glob.glob(os.path.join(_ROOT, "MULTICHIP_r*.json"))) +
             sorted(glob.glob(os.path.join(_ROOT, "SERVING_r*.json"))) +
-            sorted(glob.glob(os.path.join(_ROOT, "KERNELS_r*.json"))))
+            sorted(glob.glob(os.path.join(_ROOT, "KERNELS_r*.json"))) +
+            sorted(glob.glob(os.path.join(_ROOT, "ENCODINGS_r*.json"))))
 
 
 def compare(current: dict, baseline: dict, threshold: float,

@@ -115,6 +115,15 @@ class DeviceColumn:
     data_hi: Optional[jax.Array] = None
     offsets: Optional[jax.Array] = None
     elem_valid: Optional[jax.Array] = None
+    # ENCODED-lane metadata (ops/encodings.py, informational only —
+    # correctness NEVER depends on it): ("for", lo, hi) marks a
+    # VALUE-PRESERVING narrowed integer lane (data dtype smaller than
+    # the logical physical dtype, values exact, live range [lo, hi]);
+    # ("dict_sorted",) marks an order-preserving dictionary upload.
+    # Paths that rebuild columns may drop it freely: every consumer
+    # either understands narrow lanes or widens via plain dtype
+    # promotion, which is exact.
+    enc: Optional[tuple] = None
 
     @property
     def capacity(self) -> int:
@@ -256,7 +265,14 @@ def _pad(np_arr: np.ndarray, capacity: int, fill=0) -> np.ndarray:
 
 
 def _arrow_column_to_device(arr: pa.Array, dt: t.DataType, capacity: int,
-                            device=None) -> DeviceColumn:
+                            device=None, policy=None,
+                            narrow_ok: bool = False) -> DeviceColumn:
+    """`policy` (ops/encodings.EncodingPolicy) turns on the ENCODED
+    upload forms: order-preserving (sorted) dictionaries for strings and
+    — when `narrow_ok` (negotiated per scan column by
+    plan/overrides._negotiate_encoded) — value-preserving FOR-narrowed
+    integer lanes.  None keeps the pre-encoding representation
+    bit-identically."""
     import pyarrow.compute as pc
     n = len(arr)
     validity_np = np.zeros(capacity, dtype=bool)
@@ -264,17 +280,33 @@ def _arrow_column_to_device(arr: pa.Array, dt: t.DataType, capacity: int,
         validity_np[:n] = pc.is_valid(arr).to_numpy(zero_copy_only=False)
 
     if isinstance(dt, t.ArrayType):
-        return _arrow_list_to_device(arr, dt, capacity, validity_np, device)
+        return _arrow_list_to_device(arr, dt, capacity, validity_np, device,
+                                     policy)
 
     dictionary = None
     hi = None
+    enc = None
     if isinstance(dt, t.StringType):
-        if not pa.types.is_dictionary(arr.type):
-            arr = pc.dictionary_encode(arr)
-        codes_arr = arr.indices.fill_null(0) if arr.null_count else arr.indices
-        data_np = _pad(codes_arr.to_numpy(zero_copy_only=False).astype(np.int32),
-                       capacity)
-        dictionary = arr.dictionary.cast(pa.string())
+        if policy is not None and policy.dict_sort_scan:
+            from ..ops.encodings import (count_dispatch, is_ordered_dict,
+                                         sort_dictionary_encode)
+            codes_np, dictionary, _m = sort_dictionary_encode(arr)
+            data_np = _pad(codes_np, capacity)
+            if len(dictionary):
+                # publish orderedness under the identity pin so later
+                # prepare-time checks are one dict hit
+                is_ordered_dict(dictionary)
+            enc = ("dict_sorted",)
+            count_dispatch("dict_sort_upload")
+        else:
+            if not pa.types.is_dictionary(arr.type):
+                arr = pc.dictionary_encode(arr)
+            codes_arr = arr.indices.fill_null(0) if arr.null_count \
+                else arr.indices
+            data_np = _pad(
+                codes_arr.to_numpy(zero_copy_only=False).astype(np.int32),
+                capacity)
+            dictionary = arr.dictionary.cast(pa.string())
     elif isinstance(dt, t.DecimalType):
         if dt.is_wide:
             lanes = _decimal128_lanes(arr)
@@ -305,13 +337,34 @@ def _arrow_column_to_device(arr: pa.Array, dt: t.DataType, capacity: int,
         data_np = _pad(a.to_numpy(zero_copy_only=False).astype(np_dt, copy=False),
                        capacity)
 
+    # FOR-narrowing (value-preserving): integer-family lanes whose live
+    # range fits a smaller signed dtype upload narrow — fewer H2D bytes,
+    # narrow-domain predicates/arithmetic — and widen exactly via plain
+    # dtype promotion wherever full width is needed.  DOUBLE's int64
+    # lane is a BITCAST (never narrowed); string codes stay int32.
+    if (policy is not None and policy.narrow_lanes and narrow_ok and
+            enc is None and hi is None and n and
+            data_np.dtype.kind == "i" and
+            not isinstance(dt, (t.DoubleType, t.StringType, t.NullType))):
+        live = data_np[:n][validity_np[:n]]
+        if live.size:
+            from ..ops.encodings import count_dispatch, narrow_np_dtype
+            lo_v, hi_v = int(live.min()), int(live.max())
+            ndt = narrow_np_dtype(min(lo_v, 0), max(hi_v, 0),
+                                  data_np.dtype)
+            if ndt is not None:
+                data_np = data_np.astype(ndt)
+                enc = ("for", lo_v, hi_v)
+                count_dispatch("narrow_upload")
+
     put = (lambda x: jax.device_put(x, device)) if device is not None else jnp.asarray
-    return DeviceColumn(put(data_np), put(validity_np), dt, dictionary, hi)
+    return DeviceColumn(put(data_np), put(validity_np), dt, dictionary, hi,
+                        enc=enc)
 
 
 def _arrow_list_to_device(arr: pa.Array, dt: t.ArrayType, capacity: int,
-                          validity_np: np.ndarray, device=None
-                          ) -> DeviceColumn:
+                          validity_np: np.ndarray, device=None,
+                          policy=None) -> DeviceColumn:
     """ListArray -> ragged device column: int32 offsets (row capacity+1)
     + flat values lane in its own bucket.  Null rows get empty spans so
     kernels never need the row validity to bound a segment."""
@@ -341,7 +394,10 @@ def _arrow_list_to_device(arr: pa.Array, dt: t.ArrayType, capacity: int,
         off = np.zeros(capacity + 1, np.int32)
 
     vcap = bucket_capacity(max(len(values), 1))
-    vcol = _arrow_column_to_device(values, dt.element_type, vcap, device)
+    # ragged value lanes keep sorted-dict encoding but never narrow
+    # (offset/value-lane plumbing assumes physical dtypes)
+    vcol = _arrow_column_to_device(values, dt.element_type, vcap, device,
+                                   policy=policy, narrow_ok=False)
     put = (lambda x: jax.device_put(x, device)) if device is not None \
         else jnp.asarray
     return DeviceColumn(vcol.data, put(validity_np), dt,
@@ -350,11 +406,23 @@ def _arrow_list_to_device(arr: pa.Array, dt: t.ArrayType, capacity: int,
 
 
 def to_device(hb: HostBatch, conf: TpuConf = DEFAULT_CONF,
-              capacity: Optional[int] = None, device=None) -> DeviceBatch:
+              capacity: Optional[int] = None, device=None,
+              encoded_cols=None) -> DeviceBatch:
+    """`encoded_cols`: column names approved for FOR-narrowed lanes by
+    the _negotiate_encoded legality pass (plan/overrides.py); None means
+    no narrowing (un-negotiated uploads stay full width).  Sorted-
+    dictionary encoding applies to every upload when the policy is on —
+    a pure representation change, safe for any consumer."""
     cap = capacity or bucket_capacity(max(hb.num_rows, 1), conf)
+    from ..ops.encodings import encoding_policy
+    pol = encoding_policy(conf)
+    if not pol.any_enabled:
+        pol = None
     cols = []
     for i, f in enumerate(hb.schema.fields):
-        cols.append(_arrow_column_to_device(hb.rb.column(i), f.data_type, cap, device))
+        cols.append(_arrow_column_to_device(
+            hb.rb.column(i), f.data_type, cap, device, policy=pol,
+            narrow_ok=encoded_cols is not None and f.name in encoded_cols))
     return DeviceBatch(cols, hb.num_rows, list(hb.schema.names))
 
 

@@ -620,6 +620,17 @@ JOIN_DENSE_BUILD_VIA_SORT = conf(
     "probe-side gathers run ~200MB/s, while sort outputs stay in fast "
     "memory. Off restores the scatter builders.")
 
+JOIN_MATCHED_VIA_PRESENCE = conf(
+    "spark.rapids.tpu.sql.join.matchedViaPresence", True,
+    "Answer semi/anti-join matched flags over a dense key domain from a "
+    "PRESENCE bitmap (one bool scatter over build rows + a 1-byte "
+    "gather per probe row) instead of the sorted per-key offs table — "
+    "the flag needs key existence only, so the build-sized sort + "
+    "merge-rank behind the table never pays for itself (q21/q22-class "
+    "anti joins against a 2M-row build drop ~10x on the cpu backend). "
+    "Off restores the sorted offs path (the all-scatter-free "
+    "configuration, with agg.denseDomainViaSort).")
+
 JOIN_MATCHED_VIA_MERGE = conf(
     "spark.rapids.tpu.sql.join.matchedViaMerge", True,
     "Derive per-build/per-probe matched flags for outer and expanded "
@@ -888,6 +899,67 @@ PALLAS_JOIN_MAX_BUILD = conf(
     "table (the open-addressing table holds ~3 slots per build row at "
     "load factor 0.5 plus the overflow tail); larger builds keep the "
     "sorted-lane fallback.", checker=_positive)
+
+
+# --------------------------------------------------------------------------
+# Compressed device-resident execution (ops/encodings.py): operators run
+# directly on dictionary codes and FOR-narrowed integer lanes instead of
+# decoding to full-width materialized columns first
+# --------------------------------------------------------------------------
+
+ENCODED_EXECUTION = conf(
+    "spark.rapids.tpu.sql.encoded.execution.enabled", True,
+    "Master switch for compressed device-resident execution "
+    "(ops/encodings.py): equality/IN/range predicates on dictionary "
+    "columns rewrite to CODE-SPACE predicates (the literal translates "
+    "through the dictionary once at prepare time — no per-row remap "
+    "gather), scan dictionaries upload ORDER-PRESERVING (sorted) so "
+    "range predicates and ORDER BY compare codes directly, integer scan "
+    "lanes FOR-narrow to the smallest value-preserving dtype (decode is "
+    "a fused widen sunk to the consumer that truly needs width), and "
+    "joins/group-bys keep hashing/accumulating codes. Off disables "
+    "every encoded path — plans and results are bit-identical to the "
+    "pre-encoding engine. Dispatch/fallback decisions are counted in "
+    "tpu_encoded_dispatch_total / tpu_decode_bytes_total.",
+    commonly_used=True)
+
+ENCODED_DICT_PREDICATES = conf(
+    "spark.rapids.tpu.sql.encoded.dict.predicates", "AUTO",
+    "Code-space predicate rewrites on dictionary columns (needs "
+    "encoded.execution.enabled): a literal comparison translates the "
+    "literal through the column's dictionary at prepare time and "
+    "compares codes (equality/IN: always; </<= ranges: against a rank "
+    "bound when the dictionary is order-preserving, else through a "
+    "per-dictionary rank table — the decode fallback, still on "
+    "device). AUTO/ON behave the same today; OFF keeps the legacy "
+    "unified-remap gathers.", checker=_enum_checker("AUTO", "ON", "OFF"))
+
+ENCODED_DICT_SORT_SCAN = conf(
+    "spark.rapids.tpu.sql.encoded.dict.sortOnScan", True,
+    "Upload string dictionaries in SORTED (order-preserving) order at "
+    "the host->device boundary (needs encoded.execution.enabled): codes "
+    "then ARE ranks, so ORDER BY on dictionary columns skips its "
+    "per-row rank-table gather and range predicates compare codes "
+    "against one scalar bound. Pure representation change — decoded "
+    "values are identical.")
+
+ENCODED_NARROW_LANES = conf(
+    "spark.rapids.tpu.sql.encoded.narrow.lanes", "AUTO",
+    "FOR-narrow integer/date scan lanes to the smallest VALUE-PRESERVING "
+    "signed dtype their live range fits (needs "
+    "encoded.execution.enabled; the _negotiate_encoded legality pass "
+    "approves columns per consumer chain): uploads ship fewer bytes, "
+    "comparisons/arithmetic evaluate in the narrow dtype with "
+    "overflow-checked promotion only when the exact result needs width, "
+    "and sinks that need full width widen inside the fused program. "
+    "AUTO/ON enable, OFF keeps full-width lanes.",
+    checker=_enum_checker("AUTO", "ON", "OFF"))
+
+ENCODED_IN_MAX_CODES = conf(
+    "spark.rapids.tpu.sql.encoded.dict.inMaxCodes", 16,
+    "Largest IN-list size rewritten to per-code equality comparisons "
+    "(zero gathers); larger lists keep the per-dictionary membership "
+    "mask gather.", checker=_positive)
 
 
 # --------------------------------------------------------------------------

@@ -181,6 +181,13 @@ def _run_groupby(key_cols: List[DeviceColumn], agg_cols: List[DeviceColumn],
                  specs: List[G.AggSpec], live, capacity: int,
                  key_ranges=None, conf=None):
     key_cols = [ensure_unique_dict(c) for c in key_cols]
+    if conf is not None and any(c.dictionary is not None for c in key_cols):
+        # dictionary group keys aggregate UNDECODED (codes hash/pack/
+        # accumulate directly) — count the encoded dispatch so a
+        # regression back to decoded keys is visible in the plane
+        from ..ops.encodings import count_dispatch, encoding_policy
+        if encoding_policy(conf).enabled:
+            count_dispatch("groupby_codes")
     info = tuple((c.dtype, True, str(c.data.dtype)) for c in key_cols)
     scatter_free, max_ops, dense_sort = _seg_knobs(conf)
     domains = _dense_domains(key_cols, conf)
@@ -486,6 +493,7 @@ class HashAggregate:
         if fn is None:
             capacity = db.capacity
             node_slots = dict(pctx.node_slots)
+            node_info = dict(pctx.node_info)
             conf = self.conf
             conds_t = tuple(conds)
             keys_t = tuple(self.key_exprs)
@@ -496,7 +504,8 @@ class HashAggregate:
             def run(col_data, col_valid, num_rows, aux_arrs, *sel_opt):
                 inputs, raw = _build_inputs(meta, col_data, col_valid)
                 ctx = E.EvalCtx(capacity, num_rows, inputs, aux_arrs,
-                                node_slots, conf, raw)
+                                node_slots, conf, raw,
+                                node_info=node_info)
                 # lazy join output: liveness is the selection vector
                 live = sel_opt[0] if sel_opt \
                     else live_mask(capacity, num_rows)

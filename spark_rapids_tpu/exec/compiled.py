@@ -174,15 +174,23 @@ def _shared_scan_upload(node: HostScanExec, conf: TpuConf
     from ..config import SCAN_UPLOAD_CACHE_BYTES
     cap_bytes = conf.get(SCAN_UPLOAD_CACHE_BYTES)
     tbl = node._source_table
+    enc_cols = getattr(node, "encoded_cols", None)
     if tbl is None or cap_bytes == 0:
-        return [to_device(hb, conf) for hb in node.batches]
-    key = (id(tbl), conf.batch_size_rows)
+        return [to_device(hb, conf, encoded_cols=enc_cols)
+                for hb in node.batches]
+    # the encoded-upload form (sorted dictionaries, FOR-narrowed lanes —
+    # ops/encodings.py) changes lane dtypes and dictionary order: plans
+    # negotiated differently must never share a device copy
+    from ..ops.encodings import encoding_discriminant
+    key = (id(tbl), conf.batch_size_rows, encoding_discriminant(conf),
+           None if enc_cols is None else tuple(sorted(enc_cols)))
     with _SCAN_UPLOAD_LOCK:
         hit = _SCAN_UPLOAD_CACHE.pop(key, None)
         if hit is not None and hit[0]() is tbl:
             _SCAN_UPLOAD_CACHE[key] = hit          # re-insert: now MRU
             return hit[1]
-    dbs = [to_device(hb, conf) for hb in node.batches]
+    dbs = [to_device(hb, conf, encoded_cols=enc_cols)
+           for hb in node.batches]
     try:
         ref = weakref.ref(tbl, lambda _r, k=key:
                           _SCAN_UPLOAD_CACHE.pop(k, None))
@@ -378,9 +386,19 @@ def plan_structure_key(root: PlanNode, conf: TpuConf) -> Optional[tuple]:
     # hand-written kernels can never cross-load into a sort-tier
     # session or vice versa (ops/pallas.tier_discriminant; None when
     # the tier is fully off)
+    from ..ops.encodings import encoding_discriminant
     from ..ops.pallas import tier_discriminant
+    # encoded-execution discriminant mirrors the kernel tier's: the
+    # RESOLVED policy (AUTO rules included) keys the executable so
+    # encoded-representation programs never cross-load into a decoded
+    # session or vice versa; None when fully off keeps the key
+    # byte-identical to pre-encoding builds
+    enc = encoding_discriminant(conf)
+    if enc is None:
+        return (tuple(parts), conf_sig, jax.default_backend(),
+                tier_discriminant(conf))
     return (tuple(parts), conf_sig, jax.default_backend(),
-            tier_discriminant(conf))
+            tier_discriminant(conf), enc)
 
 
 def _plan_anchors(root: PlanNode, pairs) -> Optional[list]:
@@ -1000,12 +1018,24 @@ def _slice_batch(db: DeviceBatch, cap: int, n: int) -> DeviceBatch:
 
 
 def _swap_child(root: PlanNode, old: PlanNode, new: PlanNode):
-    """(parent, index) of `old` under `root`; caller mutates + restores."""
+    """EVERY (parent, index) link to `old` under `root`; caller mutates
+    + restores.  Plan-level CSE (plan/overrides._dedupe_agg_twins) can
+    give a seam node several parents — a q15-class grouped view read
+    both directly and under its MAX subquery — and ALL of them must see
+    the seam leaf, else one consumer re-executes the whole collapsed
+    subtree inside its own segment."""
+    links = []
+    seen = set()
     for n in [root] + [d for d in _walk_nodes(root)]:
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
         for i, c in enumerate(n.children):
             if c is old:
-                return n, i
-    raise ValueError("split node not found under root")
+                links.append((n, i))
+    if not links:
+        raise ValueError("split node not found under root")
+    return links
 
 
 def _walk_nodes(n: PlanNode):
@@ -1050,13 +1080,16 @@ class SplitCompiledPlan:
         downstream segments must see the seam leaf in the tree before
         the main thread reaches it.  Segment i's own program roots AT
         seams[i], so the swap above it never changes what segment i
-        traces."""
-        for (parent, ci), leaf in zip(self._parent_idx, self.leaves):
-            parent.children[ci] = leaf
+        traces.  A seam with several parents (shared subtree) swaps at
+        every link."""
+        for links, leaf in zip(self._parent_idx, self.leaves):
+            for parent, ci in links:
+                parent.children[ci] = leaf
 
     def _restore_leaves(self) -> None:
-        for (parent, ci), seam in zip(self._parent_idx, self.seams):
-            parent.children[ci] = seam
+        for links, seam in zip(self._parent_idx, self.seams):
+            for parent, ci in links:
+                parent.children[ci] = seam
 
     def _segment(self, i: int, key: tuple, ctx) -> CompiledPlan:
         progs = self._programs[i]

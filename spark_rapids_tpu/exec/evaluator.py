@@ -119,6 +119,8 @@ def _col_lanes(db: DeviceBatch):
 
 
 def _build_inputs(meta, col_data, col_valid):
+    import numpy as _np
+    from .. import types as t
     inputs = {}
     raw = {}
     for (name, dtype, dictionary), d, v in zip(meta, col_data, col_valid):
@@ -129,8 +131,23 @@ def _build_inputs(meta, col_data, col_valid):
             else:
                 d, hi = d
         view = d if offsets is not None else compute_view(d, dtype)
+        narrow = None
+        if offsets is None and hi is None and \
+                not isinstance(dtype, (t.StringType, t.DoubleType,
+                                       t.BooleanType, t.NullType)):
+            # FOR-narrowed lane (value-preserving, ops/encodings.py):
+            # expose the full-width view for generic consumers — the
+            # widen is a fused convert, DCE'd when every consumer stays
+            # narrow — and the narrow lane for encoded-aware ones
+            phys = _np.dtype(t.physical_np_dtype(dtype))
+            lane = _np.dtype(view.dtype)
+            if lane.kind == "i" and phys.kind == "i" and \
+                    lane.itemsize < phys.itemsize:
+                narrow = view
+                view = view.astype(phys)
         inputs[name] = DevVal(view, v, dtype, dictionary, hi,
-                              offsets=offsets, elem_valid=elem_valid)
+                              offsets=offsets, elem_valid=elem_valid,
+                              narrow=narrow)
         raw[name] = d          # storage lane (f64-bits stay int64)
     return inputs, raw
 
@@ -190,21 +207,28 @@ def evaluate_projection(exprs: Sequence[Expression], names: Sequence[str],
     if fn is None:
         capacity = db.capacity
         node_slots = dict(pctx.node_slots)
+        node_info = dict(pctx.node_info)
         exprs_t = tuple(exprs)
         meta = _batch_meta(db)
 
         def run(col_data, col_valid, num_rows, aux_arrs, *sel_opt):
             inputs, raw = _build_inputs(meta, col_data, col_valid)
             ctx = EvalCtx(capacity, num_rows, inputs, aux_arrs, node_slots,
-                          conf, raw)
+                          conf, raw, node_info=node_info)
             # a selection vector replaces prefix liveness (lazy join
             # output: live rows are sel-True, not a front prefix)
             live = sel_opt[0] if sel_opt else live_mask(capacity, num_rows)
             outs = []
             for e in exprs_t:
                 dv = e.eval_dev(ctx)
-                data = dv.data if dv.offsets is not None \
-                    else storage_view(dv.data, e.dtype)
+                if dv.offsets is not None:
+                    data = dv.data
+                elif dv.narrow is not None:
+                    # FOR-narrowed lane rides through the projection
+                    # un-widened (the decode stays sunk downstream)
+                    data = dv.narrow
+                else:
+                    data = storage_view(dv.data, e.dtype)
                 valid = dv.validity if dv.validity is not None \
                     else jnp.ones((capacity,), bool)
                 # two-lane wide decimals keep their hi lane through the
@@ -293,12 +317,13 @@ def compute_predicate(cond: Expression, db: DeviceBatch,
     if fn is None:
         capacity = db.capacity
         node_slots = dict(pctx.node_slots)
+        node_info = dict(pctx.node_info)
         meta = _batch_meta(db)
 
         def run(col_data, col_valid, num_rows, aux_arrs, *sel_opt):
             inputs, raw = _build_inputs(meta, col_data, col_valid)
             ctx = EvalCtx(capacity, num_rows, inputs, aux_arrs, node_slots,
-                          conf, raw)
+                          conf, raw, node_info=node_info)
             dv = cond.eval_dev(ctx)
             keep = dv.data
             if dv.validity is not None:

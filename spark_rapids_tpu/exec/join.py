@@ -511,11 +511,24 @@ class HashJoinExec(PlanNode):
         # denseReplace policy (span-sized offs sorts dominate the dense
         # build past ~4x the build rows; below it its one-gather probes
         # win).  Single-exact-lane legality finishes inside BuildTable.
-        from ..ops.pallas import elect_join
+        from ..config import JOIN_MATCHED_VIA_PRESENCE
+        from ..ops.pallas import count_fallback, elect_join
         dense_span = None if domain is None \
             else int(domain[1]) - int(domain[0]) + 1
-        pallas_tier = elect_join(ctx.conf, build_batch.capacity,
-                                 dense_span=dense_span)
+        via_presence = ctx.conf.get(JOIN_MATCHED_VIA_PRESENCE)
+        matched_only = self.join_type in (J.LEFT_SEMI, J.LEFT_ANTI)
+        if matched_only and domain is not None and via_presence:
+            # semi/anti over a dense domain: the probe needs a PRESENCE
+            # bitmap only (ops/join.py BuildTable.present — one bool
+            # scatter), which beats both the hash table and the sorted
+            # offs table regardless of span; skip the kernel election
+            pallas_tier = None
+            from ..ops.pallas import kernel_tier
+            if kernel_tier(ctx.conf).join:
+                count_fallback("hash_probe_join", "dense_matched")
+        else:
+            pallas_tier = elect_join(ctx.conf, build_batch.capacity,
+                                     dense_span=dense_span)
         if pallas_tier is not None:
             domain = None               # the hash table takes the join
             ctx.bump("join_pallas_hash")
@@ -531,6 +544,7 @@ class HashJoinExec(PlanNode):
                                  JOIN_DENSE_BUILD_VIA_SORT),
                              matched_via_merge=ctx.conf.get(
                                  JOIN_MATCHED_VIA_MERGE),
+                             matched_via_presence=via_presence,
                              pallas_tier=pallas_tier)
         out_names = list(self.output_schema.names)
         # Sync-free probe-aligned path: a build side whose keys are unique

@@ -180,6 +180,10 @@ class HostScanExec(PlanNode):
         # device batches, and tracer stand-ins installed during jit trace
         self._device_cache = None
         self._trace_batches = None
+        # columns approved for FOR-narrowed encoded upload by the
+        # _negotiate_encoded legality pass (plan/overrides.py); None =
+        # un-negotiated, lanes stay full width
+        self.encoded_cols = None
 
     @classmethod
     def from_table(cls, table: pa.Table, max_rows: Optional[int] = None
@@ -218,7 +222,8 @@ class HostScanExec(PlanNode):
             ctx.bump("scanned_rows", hb.num_rows)
             with ctx.tracer.span("upload", "transition"):
                 db = retry_io(ctx.conf, "h2d",
-                              lambda: to_device(hb, ctx.conf))
+                              lambda: to_device(hb, ctx.conf,
+                                                encoded_cols=self.encoded_cols))
             ctx.bump("h2d_rows", hb.num_rows)
             ctx.tracer.add_bytes("h2d_bytes", hb.rb.nbytes)
             yield db

@@ -431,11 +431,30 @@ KERNEL_FALLBACK = REGISTRY.counter(
     "tpu_kernel_fallback_total",
     "Dispatches that consulted the enabled Pallas kernel tier but fell "
     "back to the sort-based portable tier, by kernel family and reason "
-    "(multi_lane, dense_domain, build_too_large, domain_too_large, "
-    "float_exact, backend, oom). The 'oom' reason is the chaos-visible "
-    "recovery rung: a kernel-site OOM sheds the query to the sort tier "
-    "bit-identically instead of failing it.",
+    "(multi_lane, dense_domain, dense_matched, build_too_large, "
+    "domain_too_large, float_exact, backend, oom). The 'oom' reason is "
+    "the chaos-visible recovery rung: a kernel-site OOM sheds the query "
+    "to the sort tier bit-identically instead of failing it.",
     ("kernel", "reason"))
+
+ENCODED_DISPATCH = REGISTRY.counter(
+    "tpu_encoded_dispatch_total",
+    "Operator dispatches that stayed in the compressed domain "
+    "(ops/encodings.py), by site (predicate_code, predicate_range, "
+    "in_codes, predicate_narrow, arith_narrow, sort_codes, "
+    "groupby_codes, narrow_upload, dict_sort_upload) and outcome "
+    "(encoded = computed on codes/narrow lanes; decode = fell back to "
+    "a rank-table/remap gather or full-width widen; oom_shed = a "
+    "kernel-site chaos OOM shed the dispatch onto the decoded tier).",
+    ("site", "outcome"))
+
+DECODE_BYTES = REGISTRY.counter(
+    "tpu_decode_bytes_total",
+    "Bytes materialized by DECODING encoded columns (per-row rank/remap "
+    "table gathers, full-width widens of FOR-narrowed lanes), by site — "
+    "the volume the encoded-execution layer exists to shrink; counted "
+    "at capacity scale when the decode is emitted into a program.",
+    ("site",))
 
 PLAN_CACHE = REGISTRY.counter(
     "tpu_plan_cache_total",

@@ -52,7 +52,16 @@ def remap_string_column(col: DeviceColumn, remap: np.ndarray,
 # cached (whole-plan tracing).  The ``dict_remaps`` registry counter
 # counts actual host computations, so a regression back to per-batch
 # remapping is visible in the metrics plane.
+#
+# LOOKUP AND PUBLISH hold one lock: serving prepares plans concurrently,
+# and without it two tenants preparing the same scan could interleave a
+# miss-path compute with the eviction clear() (or each observe the other
+# mid-publish) — the compute must be decided and the finished table
+# published under a single critical section.
 
+import threading
+
+_DICT_CACHE_LOCK = threading.Lock()
 _UNIQUE_DICT_CACHE: dict = {}
 _REMAP_TABLE_CACHE: dict = {}
 
@@ -67,16 +76,17 @@ def ensure_unique_dict(col: DeviceColumn) -> DeviceColumn:
     d = col.dictionary
     if d is None:
         return col
-    hit = _UNIQUE_DICT_CACHE.get(id(d))
-    if hit is not None and hit[0] is d:
-        unified, remap = hit[1], hit[2]
-    else:
-        _count_dict_remap()
-        unified, remaps = unify_dictionaries([d])
-        remap = None if len(unified) == len(d) else remaps[0]
-        if len(_UNIQUE_DICT_CACHE) > 1024:
-            _UNIQUE_DICT_CACHE.clear()
-        _UNIQUE_DICT_CACHE[id(d)] = (d, unified, remap)
+    with _DICT_CACHE_LOCK:
+        hit = _UNIQUE_DICT_CACHE.get(id(d))
+        if hit is not None and hit[0] is d:
+            unified, remap = hit[1], hit[2]
+        else:
+            _count_dict_remap()
+            unified, remaps = unify_dictionaries([d])
+            remap = None if len(unified) == len(d) else remaps[0]
+            if len(_UNIQUE_DICT_CACHE) > 1024:
+                _UNIQUE_DICT_CACHE.clear()
+            _UNIQUE_DICT_CACHE[id(d)] = (d, unified, remap)
     if remap is None:
         return col
     return remap_string_column(col, remap, unified)
@@ -93,11 +103,17 @@ def remap_codes_into(col: DeviceColumn, target_dict: pa.Array) -> DeviceColumn:
     src = col.dictionary
     if src is None:
         raise ValueError("remap_codes_into needs a dictionary column")
+    if src is target_dict:
+        # same dictionary object: codes are ALREADY in target space —
+        # the common same-scan self-join / shared-upload case needs
+        # neither table nor per-row gather
+        return col
     key = (id(src), id(target_dict))
-    hit = _REMAP_TABLE_CACHE.get(key)
-    if hit is not None and hit[0] is src and hit[1] is target_dict:
-        dev = hit[2]
-    else:
+    with _DICT_CACHE_LOCK:
+        hit = _REMAP_TABLE_CACHE.get(key)
+        dev = hit[2] if hit is not None and hit[0] is src and \
+            hit[1] is target_dict else None
+    if dev is None:
         _count_dict_remap()
         idx = pc.index_in(src.cast(pa.string()), value_set=target_dict)
         table = np.asarray(idx.fill_null(-1).to_numpy(zero_copy_only=False),
@@ -106,9 +122,10 @@ def remap_codes_into(col: DeviceColumn, target_dict: pa.Array) -> DeviceColumn:
             table = np.full(1, -1, np.int32)
         dev = jnp.asarray(table)
         if not isinstance(dev, jax.core.Tracer):
-            if len(_REMAP_TABLE_CACHE) > 1024:
-                _REMAP_TABLE_CACHE.clear()
-            _REMAP_TABLE_CACHE[key] = (src, target_dict, dev)
+            with _DICT_CACHE_LOCK:
+                if len(_REMAP_TABLE_CACHE) > 1024:
+                    _REMAP_TABLE_CACHE.clear()
+                _REMAP_TABLE_CACHE[key] = (src, target_dict, dev)
     data = dev[jnp.clip(col.data, 0, dev.shape[0] - 1)]
     return DeviceColumn(data, col.validity, col.dtype, target_dict)
 

@@ -201,6 +201,7 @@ class BuildTable:
                  extra_valid: Optional[jax.Array] = None,
                  dense_via_sort: bool = True,
                  matched_via_merge: bool = True,
+                 matched_via_presence: bool = True,
                  pallas_tier=None):
         self.batch = batch
         lanes = lanes_override if lanes_override is not None \
@@ -232,6 +233,7 @@ class BuildTable:
         # merge-rank, matched flags from merge-rank differences
         self.dense_via_sort = dense_via_sort
         self.matched_via_merge = matched_via_merge
+        self.matched_via_presence = matched_via_presence
         if domain is not None and len(lanes) == 1:
             self.domain = (int(domain[0]), int(domain[1]))
         else:
@@ -241,6 +243,7 @@ class BuildTable:
         self._valid_count = None
         self._slot = None
         self._offs = None
+        self._present = None
 
     @property
     def span(self) -> int:
@@ -310,6 +313,22 @@ class BuildTable:
                     [jnp.zeros((1,), jnp.int32),
                      blocked_cumsum(counts.astype(jnp.int32))])
         return self._offs
+
+    @property
+    def present(self) -> Optional[jax.Array]:
+        """Dense-domain PRESENCE bitmap: present[k-lo] = some valid
+        build row carries key k.  Matched-only probes (semi/anti) need
+        exactly this — one bool scatter over build rows and a 1-byte
+        gather per probe row, instead of the sorted offs table (a
+        build-sized sort + merge-rank the flag never uses).  None
+        without a domain."""
+        if self.domain is None:
+            return None
+        if self._present is None:
+            tgt, _inb = self._dense_pos()
+            self._present = jnp.zeros((self.span,), bool).at[tgt].set(
+                True, mode="drop")
+        return self._present
 
     @property
     def perm(self) -> jax.Array:
@@ -494,6 +513,22 @@ def probe_matched_lazy(build: BuildTable, probe_lanes: List[jax.Array],
         return HK.probe_matched(build.hash_table,
                                 probe_lanes[0].astype(jnp.int64),
                                 probe_valid)
+    if build.domain is not None and build.matched_via_presence:
+        # presence bitmap, not the offs table: the flag needs key
+        # EXISTENCE only, so the build-sized sort + merge-rank behind
+        # `offs` never pays for itself here (q21/q22-class anti joins:
+        # a 2M-row build answered by one span-sized bool scatter)
+        lo, hi = build.domain
+        sig = ("matched_present", build.span, probe_valid.shape[0], lo,
+               hi)
+        fn = _PROBE_CACHE.get(sig)
+        if fn is None:
+            def run(present, p_lane, p_valid):
+                pos, inb = _dense_probe_pos(p_lane, p_valid, lo, hi)
+                return inb & jnp.take(present, pos)
+            fn = jax.jit(run)
+            _PROBE_CACHE[sig] = fn
+        return fn(build.present, probe_lanes[0], probe_valid)
     if build.domain is not None:
         lo, hi = build.domain
         sig = ("matched_dense", build.span, probe_valid.shape[0], lo, hi)

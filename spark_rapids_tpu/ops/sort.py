@@ -77,9 +77,13 @@ def order_lanes(col: DeviceColumn, asc: bool, nulls_first: bool,
     dt = col.dtype
     data = col.data
     if isinstance(dt, t.StringType):
-        assert rank_table is not None
-        lane = rank_table[jnp.clip(data, 0, rank_table.shape[0] - 1)]
-        lane = _to_unsigned_comparable(lane)
+        if rank_table is None:
+            # ORDER-PRESERVING dictionary (ops/encodings.py): codes ARE
+            # ranks, so the per-row rank-table gather disappears
+            lane = _to_unsigned_comparable(data)
+        else:
+            lane = rank_table[jnp.clip(data, 0, rank_table.shape[0] - 1)]
+            lane = _to_unsigned_comparable(lane)
     elif isinstance(dt, t.DoubleType):
         cv = compute_view(data, dt)
         if cv.dtype == jnp.float64:
@@ -137,10 +141,17 @@ def sort_permutation(db: DeviceBatch, keys: Sequence[SortKey],
     from ..config import MAX_SORT_OPERANDS
     from .segments import lexsort_capped
     max_ops = conf.get(MAX_SORT_OPERANDS)
+    from .encodings import count_dispatch, encoding_policy, is_ordered_dict
+    pol = encoding_policy(conf)
     rank_tables = {}
     for k in keys:
         col = db.columns[k.col_index]
         if isinstance(col.dtype, t.StringType):
+            if pol.enabled and pol.dict_sort_scan and \
+                    is_ordered_dict(col.dictionary):
+                # order-preserving dictionary: order by CODES, no table
+                count_dispatch("sort_codes")
+                continue
             rank_tables[k.col_index] = jnp.asarray(
                 dictionary_ranks(col.dictionary))
     sig = ("sortperm", db.capacity, tuple(keys), max_ops,

@@ -420,7 +420,7 @@ class _HigherOrder(ArrayExpression):
                          col.dictionary)
         ectx = EvalCtx(col.value_capacity, n_vals,
                        {self.var: elem_dv}, ctx.aux, ctx.node_slots,
-                       ctx.conf)
+                       ctx.conf, node_info=ctx.node_info)
         return col, self.body.eval_dev(ectx)
 
     def _flat_eval(self, kids):

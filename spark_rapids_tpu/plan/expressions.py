@@ -52,6 +52,10 @@ class PrepCtx:
         self.batch = batch            # the DeviceBatch under evaluation
         self.aux: List[np.ndarray] = []
         self.node_slots: Dict[int, List[int]] = {}
+        # per-node prepare-time decisions eval_dev must follow exactly
+        # (encoded-execution path choices: code-space vs rank-table vs
+        # legacy remap — ops/encodings.py); keyed like node_slots
+        self.node_info: Dict[int, object] = {}
         # constant lifting (sql.compile.constantLifting): eligible
         # Literals route their value through the aux channel — a runtime
         # ARGUMENT of the compiled program — instead of a baked constant,
@@ -106,7 +110,7 @@ class EvalCtx:
     """Device-phase context available while tracing eval_dev."""
 
     def __init__(self, capacity: int, num_rows, inputs, aux, node_slots,
-                 conf, raw=None):
+                 conf, raw=None, node_info=None):
         self.capacity = capacity
         self.num_rows = num_rows
         self.inputs = inputs          # name -> DevVal
@@ -116,9 +120,14 @@ class EvalCtx:
         # name -> STORAGE lane (DOUBLE keeps its int64 f64-bits form when
         # host-scanned) — consumers needing bit-exact lanes (hash) read it
         self.raw = raw or {}
+        # prepare-time encoded-path decisions (PrepCtx.node_info)
+        self.node_info = node_info or {}
 
     def aux_of(self, node: "Expression") -> List[jax.Array]:
         return [self.aux[i] for i in self.node_slots.get(id(node), [])]
+
+    def info_of(self, node: "Expression"):
+        return self.node_info.get(id(node))
 
 
 class DevVal:
@@ -131,7 +140,7 @@ class DevVal:
 
     def __init__(self, data, validity, dtype: t.DataType,
                  dictionary: Optional[pa.Array] = None, hi=None,
-                 offsets=None, elem_valid=None):
+                 offsets=None, elem_valid=None, narrow=None):
         self.data = data
         self.validity = validity      # None = all rows valid
         self.dtype = dtype
@@ -139,6 +148,11 @@ class DevVal:
         self.hi = hi
         self.offsets = offsets
         self.elem_valid = elem_valid
+        # FOR-narrowed storage lane (ops/encodings.py): same values as
+        # `data` in a smaller signed dtype; encoded-aware consumers
+        # (comparisons, narrow arithmetic) compute on it, everything
+        # else reads the full-width `data` view
+        self.narrow = narrow
 
 
 class Expression:
@@ -592,6 +606,23 @@ class BinaryArithmetic(Expression):
                             r.data.astype(jnp.int64), sb, self.dtype)
             return DevVal(data, merge_validity(l.validity, r.validity, ok),
                           self.dtype)
+        if l.narrow is not None and r.narrow is not None:
+            # FOR-narrowed operands: compute in the EXACT result width
+            # (overflow-checked promotion, ops/encodings.py) — promote to
+            # the full logical dtype only when the exact width needs it
+            op = {"+": "add", "-": "add", "*": "mul"}.get(self.symbol)
+            if op is not None:
+                from ..ops.encodings import (count_dispatch,
+                                             exact_arith_dtype)
+                adt = exact_arith_dtype(l.narrow.dtype, r.narrow.dtype,
+                                        op, compute_dtype(self.dtype))
+                if adt is not None:
+                    data, _ = self._op_dev(l.narrow.astype(adt),
+                                           r.narrow.astype(adt))
+                    count_dispatch("arith_narrow")
+                    return DevVal(data.astype(compute_dtype(self.dtype)),
+                                  merge_validity(l.validity, r.validity),
+                                  self.dtype, narrow=data)
         ld = _cast_dev(l.data, l.dtype, self.dtype)
         rd = _cast_dev(r.data, r.dtype, self.dtype)
         data, extra_valid = self._op_dev(ld, rd)
@@ -927,12 +958,37 @@ class BinaryComparison(Expression):
     def _resolve(self):
         self.dtype = t.BOOLEAN
 
+    def _string_literal_side(self):
+        """Index of a non-null string Literal child whose sibling is a
+        plain (possibly aliased) column reference, or None — the shape
+        the encoded code-space predicate rewrites cover."""
+        for lit_i in (1, 0):
+            lit = self.children[lit_i]
+            if isinstance(lit, Literal) and \
+                    isinstance(lit.dtype, t.StringType) and \
+                    lit.value is not None:
+                other = self.children[1 - lit_i]
+                inner = other.children[0] if isinstance(other, Alias) \
+                    else other
+                if isinstance(inner, ColumnRef):
+                    return lit_i
+        return None
+
     def unsupported_reasons(self, conf):
         l, r = self.children
         if isinstance(l.dtype, t.StringType) or isinstance(r.dtype, t.StringType):
             # String comparisons route through the dictionary machinery in
             # strings.py subclasses; plain comparison handles non-strings.
             if type(self) in (EqualTo, NotEqual, EqualNullSafe):
+                return []
+            # encoded execution (ops/encodings.py): literal range
+            # predicates evaluate in code/rank space on device — against
+            # one scalar bound when the dictionary is order-preserving,
+            # through a rank table otherwise
+            from ..ops.encodings import encoding_policy
+            pol = encoding_policy(conf)
+            if pol.enabled and pol.dict_predicates and \
+                    self._string_literal_side() is not None:
                 return []
             return ["string ordering comparison not yet on device"]
         for c in self.children:
@@ -964,40 +1020,162 @@ class BinaryComparison(Expression):
         rd, ok_b = D.rescale(r.data.astype(jnp.int64), sb, common.scale)
         return ld, rd, ok_a & ok_b
 
-    # -- string-vs-string equality via unified dictionary remap
-    def _prepare(self, pctx, kids):
+    # -- string comparisons: code-space rewrites (ops/encodings.py) with
+    # the unified-dictionary remap as the decoded fallback
+    def _prepare_string(self, pctx, kids):
+        """Choose the string-comparison path and register its aux slots;
+        returns the node_info tag _eval_dev follows exactly:
+
+          ("code", lit_i)          equality vs literal: ONE 0-d code aux
+                                   (the literal translated through the
+                                   column's dictionary) — zero gathers
+          ("range_ordered", lit_i) range vs literal, order-preserving
+                                   dictionary: two 0-d rank bounds
+          ("range_ranks", lit_i)   range vs literal, unordered dict: a
+                                   rank table (the decode rung) + bounds
+          None                     legacy unified-remap equality
+        """
+        from ..ops import encodings as ENC
         l, r = kids
+        is_eq = type(self) in (EqualTo, NotEqual, EqualNullSafe)
+        lit_i = self._string_literal_side()
+        pol = ENC.encoding_policy(pctx.conf)
+        if pol.enabled and pol.dict_predicates and lit_i is not None:
+            d = kids[1 - lit_i].dictionary
+            value = self.children[lit_i].value
+            if d is not None:
+                if is_eq:
+                    # code equality == value equality needs a duplicate-
+                    # free dictionary (computed dictionaries may repeat)
+                    if ENC.is_unique_dict(d) and \
+                            ENC.elect_encoded(pctx.conf, "predicate_code"):
+                        pctx.add(self, np.int32(ENC.literal_code(d, value)))
+                        return ("code", lit_i)
+                else:
+                    less, leq = ENC.rank_bounds(d, value)
+                    if ENC.is_ordered_dict(d) and \
+                            ENC.elect_encoded(pctx.conf, "predicate_range"):
+                        pctx.add(self, np.int32(less))
+                        pctx.add(self, np.int32(leq))
+                        return ("range_ordered", lit_i)
+                    # decode rung: rank-table gather, still on device
+                    ranks = ENC.rank_table(d)
+                    ENC.count_decode(
+                        "predicate_range",
+                        (pctx.batch.capacity if pctx.batch is not None
+                         else len(ranks)) * 4)
+                    pctx.add(self, ranks)
+                    pctx.add(self, np.int32(less))
+                    pctx.add(self, np.int32(leq))
+                    return ("range_ranks", lit_i)
+        if not is_eq:
+            # a range comparison only reaches the device behind the
+            # encoded policy gate (unsupported_reasons); a dictionary-less
+            # column side (a lambda variable) cannot be rank-translated
+            raise TypeError("device string ordering comparison needs a "
+                            "dictionary column and a string literal")
+        dl = l.dictionary if l.dictionary is not None else pa.array([], pa.string())
+        dr = r.dictionary if r.dictionary is not None else pa.array([], pa.string())
+        combined = pa.concat_arrays([dl.cast(pa.string()), dr.cast(pa.string())])
+        enc = pc.dictionary_encode(combined)
+        codes = enc.indices.to_numpy(zero_copy_only=False).astype(np.int32)
+        map_l = codes[:len(dl)] if len(dl) else np.zeros(1, np.int32)
+        map_r = codes[len(dl):] if len(dr) else np.zeros(1, np.int32)
+        pctx.add(self, map_l)
+        pctx.add(self, map_r)
+        return None
+
+    def _prepare(self, pctx, kids):
         if isinstance(self.children[0].dtype, t.StringType) or \
            isinstance(self.children[1].dtype, t.StringType):
-            dl = l.dictionary if l.dictionary is not None else pa.array([], pa.string())
-            dr = r.dictionary if r.dictionary is not None else pa.array([], pa.string())
-            combined = pa.concat_arrays([dl.cast(pa.string()), dr.cast(pa.string())])
-            enc = pc.dictionary_encode(combined)
-            codes = enc.indices.to_numpy(zero_copy_only=False).astype(np.int32)
-            map_l = codes[:len(dl)] if len(dl) else np.zeros(1, np.int32)
-            map_r = codes[len(dl):] if len(dr) else np.zeros(1, np.int32)
-            pctx.add(self, map_l)
-            pctx.add(self, map_r)
+            info = self._prepare_string(pctx, kids)
+            if info is not None:
+                pctx.node_info[id(self)] = info
         return HostVal()
+
+    def _string_op_dev(self, ctx, kids):
+        """Traced string comparison following _prepare_string's choice."""
+        l, r = kids
+        info = ctx.info_of(self)
+        if info is None:                      # legacy unified remap
+            map_l, map_r = ctx.aux_of(self)
+            lc = map_l[jnp.clip(l.data, 0, map_l.shape[0] - 1)]
+            rc = map_r[jnp.clip(r.data, 0, map_r.shape[0] - 1)]
+            return self._op_dev(lc, rc)
+        kind, lit_i = info
+        col = kids[1 - lit_i]
+        if kind == "code":
+            (code,) = ctx.aux_of(self)
+            lc, rc = (col.data, code) if lit_i == 1 else (code, col.data)
+            return self._op_dev(lc, rc)
+        if kind == "range_ordered":
+            less, leq = ctx.aux_of(self)
+            rank = col.data
+        else:                                 # "range_ranks"
+            ranks, less, leq = ctx.aux_of(self)
+            rank = ranks[jnp.clip(col.data, 0, ranks.shape[0] - 1)]
+        # col OP lit in rank space:  col <  lit  <=>  rank <  less
+        #                            col <= lit  <=>  rank <  leq
+        sym = self.symbol if lit_i == 1 else \
+            {"<": ">", "<=": ">=", ">": "<", ">=": "<="}[self.symbol]
+        return {"<": rank < less, "<=": rank < leq,
+                ">": rank >= leq, ">=": rank >= less}[sym]
 
     def _eval_dev(self, ctx, kids):
         l, r = kids
         extra = None
         if isinstance(l.dtype, t.StringType) or isinstance(r.dtype, t.StringType):
-            map_l, map_r = ctx.aux_of(self)
-            lc = map_l[jnp.clip(l.data, 0, map_l.shape[0] - 1)]
-            rc = map_r[jnp.clip(r.data, 0, map_r.shape[0] - 1)]
-            data = self._op_dev(lc, rc)
+            data = self._string_op_dev(ctx, kids)
         else:
             common = self._common()
-            if isinstance(common, t.DecimalType):
+            narrow = self._narrow_op_dev(kids, common)
+            if narrow is not None:
+                data = narrow
+            elif isinstance(common, t.DecimalType):
                 ld, rd, extra = self._decimal_lanes(kids, common)
+                data = self._op_dev(ld, rd)
             else:
                 ld = _cast_dev(l.data, l.dtype, common)
                 rd = _cast_dev(r.data, r.dtype, common)
-            data = self._op_dev(ld, rd)
+                data = self._op_dev(ld, rd)
         return DevVal(data, merge_validity(l.validity, r.validity, extra),
                       t.BOOLEAN)
+
+    def _narrow_op_dev(self, kids, common):
+        """FOR-narrowed comparison (ops/encodings.py): both lanes narrow
+        -> compare in their common narrow dtype; one narrow lane vs a
+        full-width lane (a literal broadcast, lifted or baked) -> range-
+        guarded narrow compare.  None = take the full-width path.
+        Decisions depend only on lane dtypes, so compiled programs stay
+        literal-value-agnostic (constant lifting holds)."""
+        if isinstance(common, (t.DecimalType, t.StringType)) or \
+                not isinstance(common, (t.ByteType, t.ShortType,
+                                        t.IntegerType, t.LongType,
+                                        t.DateType, t.TimestampType)):
+            return None
+        l, r = kids
+        if l.narrow is None and r.narrow is None:
+            return None
+        from ..ops.encodings import (common_narrow_dtype, count_dispatch,
+                                     narrow_compare)
+        if l.narrow is not None and r.narrow is not None:
+            cdt = common_narrow_dtype(l.narrow.dtype, r.narrow.dtype)
+            if cdt is None:
+                return None
+            count_dispatch("predicate_narrow")
+            return self._op_dev(l.narrow.astype(cdt), r.narrow.astype(cdt))
+        nar, wide = (l, r) if l.narrow is not None else (r, l)
+        sym = self.symbol
+        if nar is r:
+            sym = {"=": "=", "!=": "!=", "<": ">", "<=": ">=",
+                   ">": "<", ">=": "<="}[sym]
+        if sym not in ("=", "!=", "<", "<=", ">", ">="):
+            return None
+        wd = _cast_dev(wide.data, wide.dtype, common)
+        if np.dtype(wd.dtype).kind != "i":
+            return None
+        count_dispatch("predicate_narrow")
+        return narrow_compare(sym, nar.narrow, wd)
 
     def _eval_cpu(self, rb, kids):
         l, r = kids
@@ -1100,9 +1278,19 @@ class EqualNullSafe(BinaryComparison):
         l, r = kids
         common = self._common()
         if isinstance(common, t.StringType):
-            map_l, map_r = ctx.aux_of(self)
-            ld = map_l[jnp.clip(l.data, 0, map_l.shape[0] - 1)]
-            rd = map_r[jnp.clip(r.data, 0, map_r.shape[0] - 1)]
+            info = ctx.info_of(self)
+            if info is not None and info[0] == "code":
+                # code-space equality (ops/encodings.py): the literal's
+                # translated code vs the column lane, zero gathers
+                kind, lit_i = info
+                (code,) = ctx.aux_of(self)
+                col = kids[1 - lit_i]
+                ld, rd = (col.data, code) if lit_i == 1 \
+                    else (code, col.data)
+            else:
+                map_l, map_r = ctx.aux_of(self)
+                ld = map_l[jnp.clip(l.data, 0, map_l.shape[0] - 1)]
+                rd = map_r[jnp.clip(r.data, 0, map_r.shape[0] - 1)]
         else:
             ld = _cast_dev(l.data, l.dtype, common)
             rd = _cast_dev(r.data, r.dtype, common)
@@ -1478,8 +1666,25 @@ class In(Expression):
         child = self.children[0]
         if isinstance(child.dtype, t.StringType):
             d = kids[0].dictionary
+            non_null = [x for x in self.items if x is not None]
+            # encoded execution: a small IN-list translates its ITEMS
+            # through the dictionary once (host) and ORs per-code
+            # equality on device — no per-dictionary membership-mask
+            # gather (ops/encodings.py)
+            from ..ops import encodings as ENC
+            pol = ENC.encoding_policy(pctx.conf)
+            if pol.enabled and pol.dict_predicates and d is not None \
+                    and len(non_null) <= pol.in_max_codes and \
+                    ENC.is_unique_dict(d) and \
+                    ENC.elect_encoded(pctx.conf, "in_codes"):
+                codes = np.array(
+                    sorted(ENC.literal_code(d, x) for x in non_null)
+                    or [ENC.ABSENT_CODE], np.int32)
+                pctx.add(self, codes)
+                pctx.node_info[id(self)] = ("codes",)
+                return HostVal()
             d = d.cast(pa.string()) if d is not None else pa.array([], pa.string())
-            items = set(x for x in self.items if x is not None)
+            items = set(non_null)
             mask = np.array([v.as_py() in items for v in d] or [False], bool)
             pctx.add(self, mask)
         return HostVal()
@@ -1489,13 +1694,26 @@ class In(Expression):
         v = kids[0]
         has_null_item = any(x is None for x in self.items)
         if isinstance(self.children[0].dtype, t.StringType):
-            (mask,) = ctx.aux_of(self)
-            data = mask[jnp.clip(v.data, 0, mask.shape[0] - 1)]
+            (aux,) = ctx.aux_of(self)
+            info = ctx.info_of(self)
+            if info is not None and info[0] == "codes":
+                data = jnp.zeros((ctx.capacity,), bool)
+                for j in range(aux.shape[0]):
+                    data = data | (v.data == aux[j])
+            else:
+                data = aux[jnp.clip(v.data, 0, aux.shape[0] - 1)]
         else:
             data = jnp.zeros((ctx.capacity,), bool)
+            narrow = v.narrow
             for x in self.items:
                 if x is not None:
-                    data = data | (v.data == jnp.asarray(x, v.data.dtype))
+                    if narrow is not None:
+                        from ..ops.encodings import narrow_compare
+                        data = data | narrow_compare(
+                            "=", narrow,
+                            jnp.asarray(x, v.data.dtype))
+                    else:
+                        data = data | (v.data == jnp.asarray(x, v.data.dtype))
         vv = valid_or_true(v.validity, ctx.capacity)
         valid = vv & (data | ~jnp.asarray(has_null_item))
         return DevVal(data & vv, valid if has_null_item else vv, t.BOOLEAN)

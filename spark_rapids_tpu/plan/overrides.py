@@ -1761,10 +1761,14 @@ def apply_overrides(plan: L.LogicalPlan,
     kind, root = meta.convert()
     if kind == "device":
         from ..config import JOIN_LATE_MATERIALIZATION, JOIN_LAZY_SELECTION
+        _dedupe_agg_twins(root)
         if conf.get(JOIN_LAZY_SELECTION):
             _negotiate_lazy_sel(root)
         if conf.get(JOIN_LATE_MATERIALIZATION):
             _negotiate_thin(root)
+        from ..ops.encodings import encoding_policy
+        if encoding_policy(conf).narrow_lanes:
+            _negotiate_encoded(root)
         if mode == "ALL":
             for line in kernel_tier_plan(root, conf):
                 log.info(f"kernel-tier: {line}")
@@ -1875,6 +1879,120 @@ def _negotiate_thin(root) -> None:
     for nid, node in joins.items():
         if allowed[nid]:
             node.thin_payload = frozenset(node.output_schema.names)
+
+
+def _dedupe_agg_twins(root) -> None:
+    """Plan-level CSE for aggregate subtrees: a grouped view referenced
+    several times in one query (q15's revenue view — read directly AND
+    under its own MAX subquery) converts into structurally identical
+    but SEPARATE physical subtrees, so every execution tier pays the
+    expensive collapse once per reference.  Re-point later references
+    at the FIRST subtree object: whole-plan traces emit the shared ops
+    once (XLA CSE holds by construction), and the seam-split compiler
+    materializes the shared aggregate in ONE segment with every parent
+    reading the seam leaf (exec/compiled._swap_child replaces all
+    links) — measured 2x on q15 at SF1.  Identity = FULL expression
+    fingerprints + node extras + SOURCE-TABLE identity per scan (the
+    structural-key walk of exec/compiled.py, with literal values and
+    tables kept: q56-class per-channel aggregates are shape-identical
+    over DIFFERENT fact tables and must never merge); any node class
+    outside the canonical key's coverage makes its subtree
+    non-dedupable.  Sharing is sound because physical nodes hold no
+    per-execution state."""
+    from ..exec.compiled import _node_exprs, _node_extras
+    from ..exec.plan import HashAggregateExec, HostScanExec
+
+    def fp(n) -> "Optional[str]":
+        exprs = _node_exprs(n)
+        if exprs is None:
+            return None
+        parts = [type(n).__name__,
+                 ";".join(e.fingerprint() for e in exprs),
+                 repr(_node_extras(n))]
+        if isinstance(n, HostScanExec):
+            if n._source_table is None:
+                return None           # no stable source identity
+            parts.append(f"tbl{id(n._source_table)}")
+        for c in n.children:
+            cfp = fp(c)
+            if cfp is None:
+                return None
+            parts.append(cfp)
+        return "(" + "|".join(parts) + ")"
+
+    by_fp: dict = {}
+    seen = set()
+
+    def walk(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for i, c in enumerate(node.children):
+            if isinstance(c, HashAggregateExec):
+                cfp = fp(c)
+                if cfp is not None:
+                    first = by_fp.get(cfp)
+                    if first is None:
+                        by_fp[cfp] = c
+                    elif first is not c:
+                        node.children[i] = c = first
+            walk(c)
+
+    walk(root)
+
+
+def _negotiate_encoded(root) -> None:
+    """Per-pipeline legality pass for ENCODED scan uploads
+    (ops/encodings.py FOR-narrowed lanes), mirroring _negotiate_thin:
+    a scan's columns may stay encoded (value-preserving narrow dtypes)
+    while every consumer up the chain either computes on encoded lanes
+    (comparisons/arithmetic in plan/expressions.py), is representation-
+    agnostic (filters, compaction, joins and group-bys over canonical
+    int64 lanes, sorts — all promote via plain dtype widening, which is
+    exact for value-preserving narrowing), or is a SINK that decodes on
+    entry (host fetch, exchange serialization).  Consumers outside the
+    whitelist — window partitioning, generate, python/host boundaries,
+    device-resident seams whose representation another program already
+    baked — keep full-width scans: the decode is sunk to the scan
+    instead of risking a consumer that assumes physical dtypes.  The
+    verdict is per SCAN; sorted-dictionary encoding needs no
+    negotiation (a pure representation change every consumer already
+    handles)."""
+    from ..exec.adaptive import AdaptiveShuffledJoinExec
+    from ..exec.collect import CollectAggregateExec
+    from ..exec.distinct import DistinctAggregateExec
+    from ..exec.exchange import (BroadcastExchangeExec,
+                                 ShuffleExchangeExec, ShuffleReadExec)
+    from ..exec.join import CrossJoinExec, HashJoinExec
+    from ..exec.plan import (CoalesceBatchesExec, ExpandExec, FilterExec,
+                             GlobalLimitExec, HashAggregateExec,
+                             HostScanExec, LocalLimitExec, ProjectExec,
+                             SampleExec, SortExec, TopNExec, UnionExec)
+
+    safe = (ProjectExec, FilterExec, HashJoinExec,
+            AdaptiveShuffledJoinExec, CrossJoinExec, HashAggregateExec,
+            CollectAggregateExec, DistinctAggregateExec, SortExec,
+            TopNExec, CoalesceBatchesExec, GlobalLimitExec,
+            LocalLimitExec, UnionExec, ExpandExec, SampleExec,
+            ShuffleExchangeExec, ShuffleReadExec, BroadcastExchangeExec)
+
+    allowed: dict = {}
+    scans: dict = {}
+
+    def walk(node, enc_ok: bool):
+        if isinstance(node, HostScanExec):
+            allowed[id(node)] = allowed.get(id(node), True) and enc_ok
+            scans[id(node)] = node
+            return
+        ok = enc_ok and isinstance(node, safe)
+        for c in node.children:
+            walk(c, ok)
+
+    # the root boundary is fine encoded: result fetch widens on host
+    walk(root, True)
+    for nid, node in scans.items():
+        node.encoded_cols = frozenset(node.output_schema.names) \
+            if allowed[nid] else None
 
 
 def kernel_tier_decisions(root, conf: TpuConf) -> List[tuple]:

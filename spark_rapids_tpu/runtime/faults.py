@@ -86,12 +86,16 @@ SITES: Dict[str, str] = {
                "fail work. 'fatal' propagates through the query's "
                "crash-capture scope as a classified FATAL_DEVICE dump "
                "naming the site",
-    "kernel": "Pallas kernel-tier dispatch (ops/pallas/) — fires each "
-              "time an operator elects a hand-written kernel, with the "
-              "kernel family in the injected-fault record. Kind 'oom' "
+    "kernel": "Pallas kernel-tier dispatch (ops/pallas/) and encoded-"
+              "execution dispatch (ops/encodings.py) — fires each "
+              "time an operator elects a hand-written kernel or a "
+              "code-space/narrow-lane path, with the kernel family / "
+              "encoded site in the injected-fault record. Kind 'oom' "
               "is caught by the dispatch gate itself: the operator "
-              "sheds to the sort-based portable tier bit-identically "
-              "(tpu_kernel_fallback_total{reason=oom}); 'fatal' "
+              "sheds to the sort-based portable tier (or the encoded "
+              "dispatch to the decoded tier) bit-identically "
+              "(tpu_kernel_fallback_total{reason=oom} / "
+              "tpu_encoded_dispatch_total{outcome=oom_shed}); 'fatal' "
               "surfaces as a classified FATAL_DEVICE crash dump whose "
               "injected-fault record names the kernel",
 }

@@ -115,6 +115,46 @@ def jaxpr_pallas_calls(jaxpr) -> int:
                if e.primitive.name == "pallas_call")
 
 
+def _gather_sizes(eqn):
+    """(operand elems, output elems) of a gather equation."""
+    import numpy as np
+    op_shape = getattr(eqn.invars[0].aval, "shape", ())
+    out = 0
+    for ov in eqn.outvars:
+        shape = getattr(ov.aval, "shape", ())
+        out += int(np.prod(shape)) if shape else 1
+    return (int(np.prod(op_shape)) if op_shape else 1), out
+
+
+def jaxpr_decode_count(jaxpr) -> int:
+    """Number of DECODE-signature gathers: gather equations whose
+    operand is SMALLER than their output — a per-row lookup through a
+    table below row count (dictionary remap/rank/membership tables,
+    dense direct-address probes).  The encoded-execution layer
+    (ops/encodings.py) exists to shrink the dictionary-decode share of
+    these: its per-query budget lint asserts the q1/q3/q9-class
+    programs emit strictly less decode VOLUME with the feature on."""
+    return sum(1 for e in _iter_eqns(jaxpr)
+               if e.primitive.name == "gather"
+               and _gather_sizes(e)[0] < _gather_sizes(e)[1])
+
+
+def jaxpr_decode_elems(jaxpr) -> int:
+    """Total OUTPUT elements across decode-signature gathers — the
+    decode-volume proxy (rows actually expanded through sub-row-count
+    tables).  Code-space predicates and order-preserving dictionaries
+    remove remap/rank tables outright, so volume strictly drops where
+    the rewrites engage while invariant table-gathers (join
+    direct-address probes) cancel in the on/off comparison."""
+    total = 0
+    for e in _iter_eqns(jaxpr):
+        if e.primitive.name == "gather":
+            osz, out = _gather_sizes(e)
+            if osz < out:
+                total += out
+    return total
+
+
 def jaxpr_scatter_count(jaxpr) -> int:
     """Number of scatter-family equations in the program."""
     return sum(1 for e in _iter_eqns(jaxpr)
@@ -165,6 +205,8 @@ def plan_program_stats(physical, ctx=None) -> Dict:
             "scatter_op_count": jaxpr_scatter_count(jx),
             "gather_op_count": jaxpr_gather_count(jx),
             "gather_out_elems": jaxpr_gather_elems(jx),
+            "decode_op_count": jaxpr_decode_count(jx),
+            "decode_out_elems": jaxpr_decode_elems(jx),
             "pallas_call_count": jaxpr_pallas_calls(jx)}
 
 

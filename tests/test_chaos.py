@@ -716,6 +716,45 @@ def test_kernel_error_kind_propagates_as_query_error():
                   faults="kernel:error:nth=1")
 
 
+def _encoded_probe_df(s):
+    """A code-space pipeline: dictionary equality predicate feeding a
+    dict-key join probe — every string stage runs in code space
+    (ops/encodings.py) under the default encoded policy."""
+    rng = np.random.default_rng(29)
+    keys = ["k%02d" % i for i in range(30)]
+    fact = s.from_arrow(pa.table({
+        "fk": pa.array([keys[i] for i in rng.integers(0, 30, 2000)],
+                       pa.string()),
+        "v": pa.array(rng.standard_normal(2000))}))
+    dim = s.from_arrow(pa.table({
+        "k": pa.array(keys, pa.string()),
+        "w": pa.array(np.arange(30) * 1.5)}))
+    return (fact.filter(E.NotEqual(col("fk"), E.Literal("k07")))
+            .join(dim, left_on=["fk"], right_on=["k"], how="inner")
+            .sort(("v", True, True)))
+
+
+def test_kernel_oom_sheds_encoded_probe_to_decoded_tier():
+    """ISSUE 13 chaos rung: an injected OOM at the kernel site during a
+    CODE-SPACE dispatch (the dictionary-predicate election feeding the
+    join probe) sheds that dispatch onto the DECODED tier — the legacy
+    remap-gather path — and the query completes BIT-IDENTICAL,
+    observable as tpu_encoded_dispatch_total{outcome=oom_shed}."""
+    from spark_rapids_tpu.obs.registry import ENCODED_DISPATCH
+    clean, _s, _df = run_query(_encoded_probe_df)
+    base = ENCODED_DISPATCH.value(site="predicate_code",
+                                  outcome="oom_shed") or 0
+    chaos, s, _df = run_query(_encoded_probe_df,
+                              faults="kernel:oom:nth=1")
+    assert_identical(clean, chaos)
+    assert (ENCODED_DISPATCH.value(site="predicate_code",
+                                   outcome="oom_shed") or 0) > base
+    log = get_injector(s.conf).log
+    assert log[0]["site"] == "kernel"
+    # the injected-fault record names the encoded dispatch that shed
+    assert log[0]["kernel"] == "predicate_code"
+
+
 # ---------------------------------------------------------------------------
 # history site: the performance-history plane must never fail work
 # ---------------------------------------------------------------------------

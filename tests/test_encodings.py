@@ -1,0 +1,479 @@
+"""Compressed device-resident execution (ops/encodings.py, ISSUE 13).
+
+Oracle sweep over the encoded-domain paths: code-space dictionary
+equality/IN/range predicates (ordered + unordered dictionaries, null
+codes, all-null columns), dictionary-key joins through all 6 variants,
+FOR-narrowed overflow-edge arithmetic and comparisons — each checked
+bit-identical against BOTH the decoded path (encoded.execution.enabled=
+false) and the CPU oracle, plus the policy/discriminant/off-switch
+machinery the acceptance gate locks.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as t
+from spark_rapids_tpu.ops import encodings as ENC
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.session import DataFrame, TpuSession
+
+
+def col(n):
+    return E.ColumnRef(n)
+
+
+OFF = {"spark.rapids.tpu.sql.encoded.execution.enabled": "false"}
+
+
+def _cell(x):
+    if x is None:
+        return (2, "")
+    if isinstance(x, float) and x != x:
+        return (1, "nan")
+    return (0, repr(x))
+
+
+def _rows(table: pa.Table):
+    d = table.to_pydict()
+    names = sorted(d)
+    return sorted(
+        zip(*(tuple(_cell(x) for x in d[n]) for n in names))) \
+        if names else []
+
+
+def run_three_ways(build, extra_on=None):
+    """device(encoded on) == device(encoded off) == CPU oracle."""
+    on = TpuSession(extra_on or {})
+    off = TpuSession(OFF)
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    df = build(on)
+    got_on = df.collect()
+    got_off = DataFrame(df._plan, off).collect()
+    want = DataFrame(df._plan, cpu).collect()
+    assert _rows(got_on) == _rows(want), "encoded-on vs CPU oracle"
+    assert _rows(got_off) == _rows(want), "encoded-off vs CPU oracle"
+    return got_on
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+def str_table(n=3000, seed=11, with_nulls=True):
+    rng = np.random.default_rng(seed)
+    words = ["apple", "pear", "zed", "banana", "kiwi", "melon", "apple2",
+             "", "a", "zz"]
+    vals = [words[i] for i in rng.integers(0, len(words), n)]
+    if with_nulls:
+        for i in rng.integers(0, n, n // 10):
+            vals[i] = None
+    return pa.table({
+        "s": pa.array(vals, pa.string()),
+        "x": pa.array(rng.integers(-120, 120, n), pa.int64()),
+        "y": pa.array(rng.integers(0, 60, n), pa.int32()),
+        "d": pa.array(rng.integers(8000, 11000, n).astype(np.int32),
+                      pa.date32()),
+    })
+
+
+# ---------------------------------------------------------------------------
+# host-side encoding utilities
+# ---------------------------------------------------------------------------
+
+def test_policy_resolution_and_discriminant():
+    on = TpuSession().conf
+    off = TpuSession(OFF).conf
+    pol = ENC.encoding_policy(on)
+    assert pol.enabled and pol.dict_predicates and pol.dict_sort_scan \
+        and pol.narrow_lanes
+    assert ENC.encoding_discriminant(on) is not None
+    # OFF: no policy, and the cache-key discriminant is None — the
+    # plan_structure_key stays byte-identical to pre-encoding builds
+    assert ENC.encoding_policy(off) is ENC.NO_ENCODING
+    assert ENC.encoding_discriminant(off) is None
+
+
+def test_ordered_unique_literal_code_rank_bounds():
+    d = pa.array(["a", "b", "d", "z"])
+    assert ENC.is_ordered_dict(d) and ENC.is_unique_dict(d)
+    un = pa.array(["d", "a", "z", "b"])
+    assert not ENC.is_ordered_dict(un) and ENC.is_unique_dict(un)
+    dup = pa.array(["a", "a", "b"])
+    assert not ENC.is_ordered_dict(dup) and not ENC.is_unique_dict(dup)
+    assert ENC.literal_code(d, "d") == 2
+    assert ENC.literal_code(d, "c") == ENC.ABSENT_CODE
+    # rank bounds: col < "c" <=> rank < 2; col <= "b" <=> rank < 2
+    assert ENC.rank_bounds(d, "c") == (2, 2)
+    assert ENC.rank_bounds(d, "b") == (1, 2)
+    assert ENC.rank_bounds(un, "b") == (1, 2)
+    ranks = ENC.rank_table(un)
+    assert list(ranks) == [2, 0, 3, 1]
+
+
+def test_sorted_dictionary_upload_is_order_preserving():
+    from spark_rapids_tpu.columnar import HostBatch, to_device, to_host
+    hb = HostBatch.from_pydict(
+        {"s": ["pear", "apple", None, "zed", "apple"]})
+    db = to_device(hb, TpuSession().conf)
+    c = db.columns[0]
+    assert c.enc == ("dict_sorted",)
+    assert ENC.is_ordered_dict(c.dictionary)
+    assert to_host(db).rb.column(0).to_pylist() == \
+        ["pear", "apple", None, "zed", "apple"]
+    # off: first-occurrence dictionary order, no enc marker
+    db_off = to_device(hb, TpuSession(OFF).conf)
+    assert db_off.columns[0].enc is None
+    assert db_off.columns[0].dictionary.to_pylist() == \
+        ["pear", "apple", "zed"]
+
+
+def test_narrow_upload_value_preserving_and_negotiated():
+    from spark_rapids_tpu.columnar import HostBatch, to_device, to_host
+    hb = HostBatch.from_pydict({"x": [5, -3, None, 120]})
+    conf = TpuSession().conf
+    # un-negotiated: full width
+    db = to_device(hb, conf)
+    assert str(db.columns[0].data.dtype) == "int64"
+    # negotiated: narrow to int8 (range [-3, 120]), values exact
+    db_n = to_device(hb, conf, encoded_cols=frozenset(["x"]))
+    c = db_n.columns[0]
+    assert str(c.data.dtype) == "int8"
+    assert c.enc == ("for", -3, 120)
+    assert to_host(db_n).rb.column(0).to_pylist() == [5, -3, None, 120]
+
+
+def test_narrow_dtype_and_exact_arith_rules():
+    assert ENC.narrow_np_dtype(-3, 120, np.dtype(np.int64)) == np.int8
+    assert ENC.narrow_np_dtype(0, 300, np.dtype(np.int64)) == np.int16
+    assert ENC.narrow_np_dtype(-2**40, 5, np.dtype(np.int64)) is None
+    assert ENC.narrow_np_dtype(0, 5, np.dtype(np.float64)) is None
+    import jax.numpy as jnp
+    # int16+int16 needs int32 < int64 logical -> narrow compute
+    assert ENC.exact_arith_dtype(np.int16, np.int16, "add",
+                                 np.int64) == jnp.int32
+    # int32*int32 needs int64 == logical width -> promote as usual
+    assert ENC.exact_arith_dtype(np.int32, np.int32, "mul",
+                                 np.int64) is None
+    assert ENC.exact_arith_dtype(np.int8, np.int16, "mul",
+                                 np.int64) == jnp.int32
+
+
+# ---------------------------------------------------------------------------
+# encoded-domain predicate oracle sweep
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mk", [
+    lambda: E.EqualTo(col("s"), E.Literal("pear")),
+    lambda: E.EqualTo(E.Literal("apple"), col("s")),
+    lambda: E.NotEqual(col("s"), E.Literal("kiwi")),
+    lambda: E.EqualNullSafe(col("s"), E.Literal("zed")),
+    lambda: E.EqualTo(col("s"), E.Literal("missing")),
+    lambda: E.In(col("s"), ["pear", "zed", "missing"]),
+    lambda: E.In(col("s"), ["pear", None]),
+    lambda: E.LessThan(col("s"), E.Literal("kiwi")),
+    lambda: E.LessThanOrEqual(col("s"), E.Literal("kiwi")),
+    lambda: E.GreaterThan(col("s"), E.Literal("b")),
+    lambda: E.GreaterThanOrEqual(E.Literal("melon"), col("s")),
+    lambda: E.LessThan(col("s"), E.Literal("")),
+    lambda: E.GreaterThan(col("s"), E.Literal("zzzz")),
+])
+def test_dict_predicates_oracle(mk):
+    tbl = str_table()
+    run_three_ways(lambda s: s.from_arrow(tbl).filter(mk()))
+
+
+def test_dict_range_predicate_unordered_dictionary():
+    """Mid-plan dictionaries lose scan order (concat unification) — the
+    rank-table decode rung must produce identical rows."""
+    tbl = str_table()
+    run_three_ways(
+        lambda s: s.from_arrow(tbl).filter(
+            E.LessThan(col("s"), E.Literal("kiwi"))),
+        extra_on={"spark.rapids.tpu.sql.encoded.dict.sortOnScan":
+                  "false"})
+
+
+def test_dict_predicates_all_null_column():
+    tbl = pa.table({"s": pa.array([None, None, None], pa.string()),
+                    "x": pa.array([1, 2, 3], pa.int64())})
+    for mk in (lambda: E.EqualTo(col("s"), E.Literal("a")),
+               lambda: E.LessThan(col("s"), E.Literal("a")),
+               lambda: E.In(col("s"), ["a", "b"])):
+        run_three_ways(lambda s: s.from_arrow(tbl).filter(mk()))
+
+
+def test_duplicate_value_dictionary_falls_back():
+    """A COMPUTED dictionary can repeat values (q22's substring
+    prefix): code-space equality must not engage — results stay
+    oracle-exact through the mask/remap path."""
+    from spark_rapids_tpu.plan.strings import Substring
+    tbl = str_table(with_nulls=False)
+    run_three_ways(
+        lambda s: s.from_arrow(tbl)
+        .select(E.Alias(Substring(col("s"), 1, 1), "p"), col("x"),
+                names=["p", "x"])
+        .filter(E.In(col("p"), ["a", "z"])))
+    run_three_ways(
+        lambda s: s.from_arrow(tbl)
+        .select(E.Alias(Substring(col("s"), 1, 1), "p"), col("x"),
+                names=["p", "x"])
+        .filter(E.EqualTo(col("p"), E.Literal("a"))))
+
+
+# ---------------------------------------------------------------------------
+# FOR-narrowed lanes: comparisons and overflow-edge arithmetic
+# ---------------------------------------------------------------------------
+
+def narrow_edge_table():
+    # int8/int16 boundary values: the overflow edges the exact-width
+    # promotion rule must survive
+    xs = [127, -128, 126, -127, 0, 1, -1, 100, -100, None] * 20
+    ys = [32767, -32768, 1000, -1000, 0, 7, -7, 32000, -32000, None] * 20
+    return pa.table({"a": pa.array(xs, pa.int64()),
+                     "b": pa.array(ys, pa.int64())})
+
+
+@pytest.mark.parametrize("mk", [
+    lambda: E.LessThan(col("a"), E.Literal(5)),
+    lambda: E.LessThan(col("a"), E.Literal(1000)),      # above int8 range
+    lambda: E.GreaterThan(col("a"), E.Literal(-1000)),  # below int8 range
+    lambda: E.EqualTo(col("a"), E.Literal(-128)),
+    lambda: E.GreaterThanOrEqual(col("b"), E.Literal(32767)),
+    lambda: E.NotEqual(col("b"), E.Literal(123456)),    # out of range
+    lambda: E.LessThan(col("a"), col("b")),             # narrow vs narrow
+    lambda: E.In(col("a"), [127, -128, 5000]),
+])
+def test_narrow_compare_oracle(mk):
+    tbl = narrow_edge_table()
+    run_three_ways(lambda s: s.from_arrow(tbl).filter(mk()))
+
+
+def test_narrow_arith_overflow_edge_oracle():
+    """int8+int8 and int8*int16 at dtype extremes: exact-width narrow
+    compute must equal the wide path and the CPU oracle exactly."""
+    tbl = narrow_edge_table()
+    run_three_ways(
+        lambda s: s.from_arrow(tbl).select(
+            E.Alias(E.Add(col("a"), col("a")), "aa"),
+            E.Alias(E.Subtract(col("a"), col("b")), "ab"),
+            E.Alias(E.Multiply(col("a"), col("b")), "m"),
+            names=["aa", "ab", "m"]))
+
+
+def test_narrow_date_predicate_oracle():
+    tbl = str_table()
+    import datetime as dt
+    run_three_ways(lambda s: s.from_arrow(tbl).filter(
+        E.LessThanOrEqual(col("d"), E.Literal(dt.date(1995, 6, 1)))))
+
+
+# ---------------------------------------------------------------------------
+# dictionary-key joins: all 6 variants, encoded on == off == oracle
+# ---------------------------------------------------------------------------
+
+JOIN_HOWS = ("inner", "left_outer", "right_outer", "full_outer",
+             "left_semi", "left_anti")
+
+
+@pytest.mark.parametrize("how", JOIN_HOWS)
+def test_dict_key_joins_oracle(how):
+    rng = np.random.default_rng(31)
+    keys = ["k%02d" % i for i in range(40)]
+    left = pa.table({
+        "lk": pa.array([keys[i] for i in rng.integers(0, 40, 500)]
+                       + [None] * 10, pa.string()),
+        "lv": pa.array(rng.integers(0, 1000, 510), pa.int64())})
+    # build side misses some keys + adds strangers + duplicates
+    rk = [keys[i] for i in rng.integers(0, 30, 60)] + ["zzz", None]
+    right = pa.table({
+        "rk": pa.array(rk, pa.string()),
+        "rv": pa.array(rng.integers(0, 1000, len(rk)), pa.int64())})
+    run_three_ways(
+        lambda s: s.from_arrow(left).join(
+            s.from_arrow(right), left_on=["lk"], right_on=["rk"],
+            how=how))
+
+
+def test_dict_key_join_with_code_space_predicate():
+    """Predicate + dict-key join + group-by on a dict key: the whole
+    pipeline stays in code space; on == off == oracle."""
+    from spark_rapids_tpu.plan.aggregates import Count, Sum
+    rng = np.random.default_rng(37)
+    keys = ["k%02d" % i for i in range(25)]
+    fact = pa.table({
+        "fk": pa.array([keys[i] for i in rng.integers(0, 25, 800)],
+                       pa.string()),
+        "v": pa.array(rng.integers(0, 100, 800), pa.int64())})
+    dim = pa.table({
+        "k": pa.array(keys, pa.string()),
+        "name": pa.array(["n_" + k for k in keys], pa.string())})
+
+    def build(s):
+        return (s.from_arrow(fact)
+                .filter(E.GreaterThanOrEqual(col("fk"), E.Literal("k05")))
+                .join(s.from_arrow(dim), left_on=["fk"], right_on=["k"],
+                      how="inner")
+                .group_by("name")
+                .agg((Count(None), "n"), (Sum(col("v")), "sv"))
+                .sort("name"))
+    run_three_ways(build)
+
+
+# ---------------------------------------------------------------------------
+# program-shape lints: the decode win + the off-switch
+# ---------------------------------------------------------------------------
+
+def test_code_space_predicate_removes_decode_gathers():
+    """Same filter traced both ways: the encoded program emits strictly
+    fewer decode-signature gathers (the jaxpr_decode_* walkers bench.py
+    and the q1/q3/q9 lint consume)."""
+    from spark_rapids_tpu.testing import plan_program_stats
+    tbl = str_table(1200, with_nulls=False)
+    counts = {}
+    for label, sess in (("on", TpuSession()), ("off", TpuSession(OFF))):
+        q = sess.from_arrow(tbl).filter(
+            E.EqualTo(col("s"), E.Literal("pear"))).physical()
+        st = plan_program_stats(q)
+        counts[label] = (st["decode_op_count"], st["decode_out_elems"])
+    assert counts["on"][1] < counts["off"][1], counts
+    assert counts["on"][0] < counts["off"][0], counts
+
+
+def test_scan_upload_cache_keys_by_encoding():
+    """One source table uploaded under encoded-on and encoded-off confs
+    must not alias (the representation differs)."""
+    from spark_rapids_tpu.exec.compiled import _shared_scan_upload
+    from spark_rapids_tpu.exec.plan import HostScanExec
+    tbl = pa.table({"s": pa.array(["b", "a", "c"] * 50, pa.string())})
+    node = HostScanExec.from_table(tbl)
+    on = _shared_scan_upload(node, TpuSession().conf)
+    off = _shared_scan_upload(node, TpuSession(OFF).conf)
+    assert on[0].columns[0].enc == ("dict_sorted",)
+    assert off[0].columns[0].enc is None
+    assert on[0].columns[0].dictionary.to_pylist() == ["a", "b", "c"]
+    assert off[0].columns[0].dictionary.to_pylist() == ["b", "a", "c"]
+
+
+def test_negotiate_encoded_marks_scans():
+    """The legality pass approves scans whose consumer chains stay in
+    the narrow-safe whitelist and leaves others full width."""
+    tbl = pa.table({"x": pa.array(list(range(100)), pa.int64()),
+                    "g": pa.array(["a", "b"] * 50, pa.string())})
+    from spark_rapids_tpu.exec.plan import HostScanExec
+
+    def scans_of(q):
+        out = []
+
+        def walk(n):
+            if isinstance(n, HostScanExec):
+                out.append(n)
+            for c in n.children:
+                walk(c)
+        walk(q.root)
+        return out
+
+    s = TpuSession()
+    q = s.from_arrow(tbl).filter(
+        E.GreaterThan(col("x"), E.Literal(5))).physical()
+    assert all(sc.encoded_cols for sc in scans_of(q))
+    # a window consumer is OUTSIDE the whitelist -> scan stays wide
+    from spark_rapids_tpu.plan.window import RowNumber
+    qw = s.from_arrow(tbl).window(
+        [(RowNumber(), "rn")], partition_by=["g"],
+        order_by=[("x", True, True)]).physical()
+    assert all(sc.encoded_cols is None for sc in scans_of(qw))
+
+
+def test_remap_codes_into_identity_fast_path_and_lock():
+    """Same dictionary object: no table, no gather; and the dictionary
+    caches survive a concurrent hammer without serving a half-built
+    entry (the serving-plane race the lock closes)."""
+    import threading
+    from spark_rapids_tpu.columnar import HostBatch, to_device
+    from spark_rapids_tpu.ops.batch_ops import (ensure_unique_dict,
+                                                remap_codes_into)
+    conf = TpuSession().conf
+    db = to_device(HostBatch.from_pydict({"s": ["a", "b", "c"] * 10}),
+                   conf)
+    c = db.columns[0]
+    assert remap_codes_into(c, c.dictionary) is c
+    target = pa.array(["c", "a"])
+    errs = []
+    outs = []
+
+    def worker():
+        try:
+            for _ in range(50):
+                out = remap_codes_into(c, target)
+                outs.append(np.asarray(out.data)[:3].tolist())
+                ensure_unique_dict(c)
+        except Exception as e:               # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    # 'a','b','c' -> codes into ["c","a"]: a->1, b->-1, c->0
+    assert all(o == [1, -1, 0] for o in outs)
+
+
+def test_off_switch_programs_and_results_identical():
+    """encoded.execution.enabled=false: program stats carry zero
+    encoded markers (sorted dictionaries, narrow lanes, code-space
+    predicates) and results equal the CPU oracle — the bit-identical-
+    to-main half of the acceptance gate; the strict decode-volume lint
+    lives in test_sort_budget_lint.py."""
+    from spark_rapids_tpu import tpch
+    from spark_rapids_tpu.testing import plan_program_stats
+    tables = tpch.gen_tables(scale=0.001)
+    off = TpuSession(OFF)
+    st = plan_program_stats(tpch.QUERIES["q3"](off, tables).physical())
+    on = TpuSession()
+    st_on = plan_program_stats(tpch.QUERIES["q3"](on, tables).physical())
+    assert st["decode_out_elems"] > st_on["decode_out_elems"]
+    # and the upload representation is untouched when off
+    from spark_rapids_tpu.exec.plan import HostScanExec
+    q = tpch.QUERIES["q3"](off, tables).physical()
+
+    def any_encoded(n):
+        if isinstance(n, HostScanExec) and n.encoded_cols:
+            return True
+        return any(any_encoded(c) for c in n.children)
+    assert not any_encoded(q.root)
+
+
+def test_metrics_families_populate():
+    from spark_rapids_tpu.obs.registry import (DECODE_BYTES,
+                                               ENCODED_DISPATCH)
+    tbl = str_table(500)
+    base = ENCODED_DISPATCH.value(site="predicate_code",
+                                  outcome="encoded") or 0
+    s = TpuSession()
+    s.from_arrow(tbl).filter(
+        E.EqualTo(col("s"), E.Literal("pear"))).collect()
+    assert (ENCODED_DISPATCH.value(site="predicate_code",
+                                   outcome="encoded") or 0) > base
+    # the unordered-dictionary rank rung counts decode bytes
+    d0 = DECODE_BYTES.value(site="predicate_range") or 0
+    un = TpuSession({"spark.rapids.tpu.sql.encoded.dict.sortOnScan":
+                     "false"})
+    un.from_arrow(tbl).filter(
+        E.LessThan(col("s"), E.Literal("kiwi"))).collect()
+    assert (DECODE_BYTES.value(site="predicate_range") or 0) > d0
+
+
+def test_rle_predicate_mask_matches_decode_first():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.bitpack import rle_decode
+    rng = np.random.default_rng(5)
+    values = jnp.asarray(rng.integers(0, 50, 64), jnp.int64)
+    lengths = jnp.asarray(rng.integers(1, 9, 64), jnp.int32)
+    n = 1024
+    got = ENC.rle_predicate_mask(values, lengths, n, lambda v: v < 25)
+    total = int(np.asarray(lengths).sum())
+    dec = np.asarray(rle_decode(values, lengths, n)) < 25
+    dec[min(total, n):] = False
+    assert np.array_equal(np.asarray(got), dec)

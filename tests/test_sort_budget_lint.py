@@ -16,14 +16,21 @@ from spark_rapids_tpu.testing import plan_program_stats
 ALL_QUERIES = sorted(tpch.QUERIES, key=lambda q: int(q[1:]))
 ALL_DS_QUERIES = sorted(tpcds.QUERIES, key=lambda q: int(q[1:]))
 
-# With default knobs the ONLY remaining scatters live in the dense-domain
-# (no-sort) group-by, which trades them deliberately for zero sorts and
-# zero row gathers; these queries hit it via low-cardinality
-# dictionary/bool keys.  Everything else — packed/sorted group-bys,
-# MIN/MAX and ignore-null FIRST/LAST reductions, count-distinct,
-# percentile, joins (dense build tables, expand_pairs matched flags),
-# window frames — must emit ZERO scatters.
+# With default knobs the ONLY remaining scatters live in two deliberate
+# trades: the dense-domain (no-sort) group-by, which swaps them for zero
+# sorts and zero row gathers (low-cardinality dictionary/bool keys), and
+# the dense-domain semi/anti PRESENCE bitmap (join.matchedViaPresence —
+# one bool scatter replaces the build-sized sort + merge-rank behind the
+# offs table, ~10x on q21/q22-class anti joins).  Everything else —
+# packed/sorted group-bys, MIN/MAX and ignore-null FIRST/LAST
+# reductions, count-distinct, percentile, inner/outer joins, window
+# frames — must emit ZERO scatters.
 DENSE_GROUPBY_QUERIES = {"q1", "q4", "q5", "q12", "q21", "q22"}
+# queries whose plans carry a dense-domain LEFT_SEMI/LEFT_ANTI at lint
+# scale (CBO semi rewrites included)
+DENSE_MATCHED_JOIN_QUERIES = {"q2", "q3", "q4", "q5", "q8", "q9", "q11",
+                              "q16", "q17", "q18", "q20", "q21", "q22"}
+SCATTER_ALLOWED = DENSE_GROUPBY_QUERIES | DENSE_MATCHED_JOIN_QUERIES
 
 
 @pytest.fixture(scope="module")
@@ -50,20 +57,25 @@ def test_sort_operand_budget_suite_wide(suite_stats):
 
 
 def test_scatter_free_outside_dense_groupby(suite_stats):
-    """Group-by MIN/MAX, count-distinct, expand_pairs, window and join
-    paths emit zero scatters; only the dense-domain group-by queries
-    may carry them (its no-sort trade — flip-testable below)."""
+    """Group-by MIN/MAX, count-distinct, expand_pairs, window and
+    inner/outer join paths emit zero scatters; only the dense-domain
+    group-by and dense-matched semi/anti queries may carry them (the
+    two no-sort trades — flip-testable below)."""
     dirty = {n: st["scatter_op_count"] for n, st in suite_stats.items()
-             if st["scatter_op_count"] and n not in DENSE_GROUPBY_QUERIES}
+             if st["scatter_op_count"] and n not in SCATTER_ALLOWED}
     assert not dirty, f"unexpected scatters: {dirty}"
 
 
 def test_dense_via_sort_makes_whole_suite_scatter_free(tables):
-    """Flipping agg.denseDomainViaSort removes the last scatters: the
-    bounded domains run through the packed single-sort-lane kernel and
-    the full 22-query suite emits no scatter at all."""
-    s = TpuSession({"spark.rapids.tpu.sql.agg.denseDomainViaSort": "true"})
-    for name in sorted(DENSE_GROUPBY_QUERIES, key=lambda q: int(q[1:])):
+    """Flipping agg.denseDomainViaSort + join.matchedViaPresence=false
+    removes the last scatters: bounded group-by domains run through the
+    packed single-sort-lane kernel, semi/anti matched flags go back to
+    the sorted offs table, and the full 22-query suite emits no scatter
+    at all — the all-scatter-free configuration stays available."""
+    s = TpuSession({"spark.rapids.tpu.sql.agg.denseDomainViaSort": "true",
+                    "spark.rapids.tpu.sql.join.matchedViaPresence":
+                        "false"})
+    for name in sorted(SCATTER_ALLOWED, key=lambda q: int(q[1:])):
         q = tpch.QUERIES[name](s, tables).physical()
         st = plan_program_stats(q)
         assert st["scatter_op_count"] == 0, (name, st)
@@ -150,13 +162,71 @@ def test_late_materialization_gather_budget(tables, suite_stats):
 
 
 # ---------------------------------------------------------------------------
+# decode budget: encoded execution must keep paying for itself
+# ---------------------------------------------------------------------------
+
+# The attribution plane pins residual decode volume on these queries:
+# q1 rank-gathers its ORDER BY dictionary keys, q3 remap-gathers the
+# c_mktsegment equality per row, q9 pays rank tables on the n_name sort
+# and remap tables around its string predicate.  Encoded execution
+# (ops/encodings.py: code-space predicates + order-preserving scan
+# dictionaries) removes those table gathers, so their programs must
+# emit strictly LESS decode volume with the feature on (default).
+ENCODED_BUDGET_QUERIES = ("q1", "q3", "q9")
+
+
+def test_encoded_execution_decode_budget(tables, suite_stats):
+    """Per-query decode budget: q1/q3/q9 programs expand strictly fewer
+    elements through decode-signature gathers (and never MORE decode
+    equations) with encoded execution on — suite_stats is the default
+    (ON) conf, compared against a fresh OFF trace."""
+    off = TpuSession(
+        {"spark.rapids.tpu.sql.encoded.execution.enabled": "false"})
+    for name in ENCODED_BUDGET_QUERIES:
+        st_on = suite_stats[name]
+        st_off = plan_program_stats(tpch.QUERIES[name](off, tables)
+                                    .physical())
+        assert st_on["decode_out_elems"] < st_off["decode_out_elems"], \
+            (name, st_on, st_off)
+        assert st_on["decode_op_count"] <= st_off["decode_op_count"], \
+            (name, st_on, st_off)
+
+
+def test_encoded_off_key_discriminant_is_neutral(tables):
+    """The off-switch half of the acceptance gate: with the conf off
+    the resolved policy is inert — the plan cache key carries NO
+    encoding discriminant (byte-identical to pre-encoding builds) and
+    no scan is marked for encoded upload."""
+    from spark_rapids_tpu.exec.compiled import plan_structure_key
+    from spark_rapids_tpu.exec.plan import HostScanExec
+    from spark_rapids_tpu.ops.encodings import encoding_discriminant
+    off = TpuSession(
+        {"spark.rapids.tpu.sql.encoded.execution.enabled": "false"})
+    assert encoding_discriminant(off.conf) is None
+    for name in ENCODED_BUDGET_QUERIES:
+        q = tpch.QUERIES[name](off, tables).physical()
+        key = plan_structure_key(q.root, off.conf)
+        assert key is None or len(key) == 4, name  # no 5th enc element
+
+        def walk(n):
+            if isinstance(n, HostScanExec):
+                assert n.encoded_cols is None, name
+            for c in n.children:
+                walk(c)
+        walk(q.root)
+
+
+# ---------------------------------------------------------------------------
 # TPC-DS tranche: the same two budgets over the new workload
 # ---------------------------------------------------------------------------
 
 # Dense-domain group-by scatters (the deliberate no-sort trade), hit via
 # low-cardinality keys: demographic averages (q7/q26), the day-name
-# pivot (q43), and the per-channel union re-aggregations (q56/q60).
-DS_DENSE_GROUPBY_QUERIES = {"q7", "q26", "q43", "q56", "q60"}
+# pivot (q43), and the per-channel union re-aggregations (q56/q60);
+# plus the dense-matched semi/anti presence scatters
+# (join.matchedViaPresence) in the date_dim semi-filter shapes.
+DS_DENSE_GROUPBY_QUERIES = {"q7", "q26", "q43", "q56", "q60",
+                            "q19", "q33", "q55", "q65", "q73", "q96"}
 
 # Not traceable as ONE whole-plan XLA program yet: window execs make
 # host partition decisions (q12/q20/q36/q70/q86/q98) and q93's join
