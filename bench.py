@@ -362,6 +362,13 @@ def run_suite(suite_name: str, scale: float, query_names):
                                  "fallback_reasons":
                                      q.fallback_reasons(),
                                  "profile": profile, **pstats}
+            # per-query HBM attribution (memattr plane, measured during
+            # the profiled collect): top-level so check_regression.py
+            # can gate >25% HBM-peak regressions next to device_ms
+            if isinstance(profile, dict):
+                for hk in ("hbm_peak_bytes", "hbm_measured_working_set"):
+                    if profile.get(hk):
+                        suite.per_q[name][hk] = int(profile[hk])
             print(f"# {name}: device={dt*1e3:.0f}ms cpu={ct*1e3:.0f}ms "
                   f"x{ct/dt:.2f} cold={cold_s:.1f}s "
                   f"compiled={bool(compiled)} match={match}",

@@ -273,6 +273,39 @@ def extract_segments(doc) -> dict:
     return out
 
 
+def extract_hbm(doc) -> dict:
+    """-> {query: hbm_peak_bytes} from the per-query measured-HBM
+    fields bench/multichip records embed (the memory-attribution
+    plane, ISSUE 14) — {} for records predating it.  Gated like
+    device_ms under the same backend-separation rule: a PR that
+    silently doubles a query's working set fails CI even when its
+    wall time holds."""
+    out = {}
+    if not isinstance(doc, dict):
+        return out
+    for key, val in doc.items():
+        if key.endswith("_suite_queries") and isinstance(val, dict):
+            for q, rec in val.items():
+                if isinstance(rec, dict) and rec.get("hbm_peak_bytes"):
+                    out[q] = float(rec["hbm_peak_bytes"])
+    if out:
+        return out
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        return extract_hbm(parsed)
+    return out
+
+
+def load_hbm(path: str) -> dict:
+    """{query: hbm_peak_bytes} of one trajectory file ({} on any read
+    problem — like segments, absence never fails the gate by itself)."""
+    try:
+        with open(path) as f:
+            return extract_hbm(json.load(f))
+    except (OSError, json.JSONDecodeError, ValueError):
+        return {}
+
+
 def extract_queries(doc):
     """-> (query name -> net device_ms, backend tag) from any accepted
     result shape; ({}, backend) when the document carries no per-query
@@ -441,6 +474,13 @@ def main(argv=None) -> int:
     ap.add_argument("--compile-min-ms", type=float, default=1000.0,
                     help="median compile floor below which compile "
                          "timings never regress (default 1000)")
+    ap.add_argument("--hbm-threshold", type=float, default=0.25,
+                    help="fractional per-query hbm_peak_bytes growth "
+                         "that fails (default 0.25 = +25%%; the "
+                         "memory-attribution plane's measured peaks)")
+    ap.add_argument("--hbm-min-bytes", type=float, default=float(1 << 20),
+                    help="absolute floor below which HBM peaks are "
+                         "noise, never regressions (default 1 MiB)")
     ap.add_argument("--history-dir",
                     help="performance-history dir "
                          "(spark.rapids.tpu.history.dir): when the "
@@ -572,7 +612,31 @@ def main(argv=None) -> int:
         print(f"  compile: median compile_ms_cold {cur_med:.0f} "
               f"(no baseline carries compile data)")
 
-    if res["regressions"] or compile_reg:
+    # -- HBM-peak gate: per-query measured working-set peaks (the
+    # memory-attribution plane), best-of baseline, same backend rule
+    hbm_regs = []
+    cur_hbm = load_hbm(current_name) if os.path.exists(current_name) \
+        else {}
+    if cur_hbm:
+        base_hbm = {}
+        for p in baseline_files:
+            for q, v in load_hbm(p).items():
+                base_hbm[q] = min(base_hbm.get(q, v), v)
+        for q in sorted(set(cur_hbm) & set(base_hbm),
+                        key=lambda s: (len(s), s)):
+            cur_b, base_b = cur_hbm[q], base_hbm[q]
+            if cur_b > base_b * (1.0 + args.hbm_threshold) and \
+                    cur_b > args.hbm_min_bytes:
+                hbm_regs.append((q, cur_b, base_b))
+                print(f"  HBM REGRESSION {q}: peak {cur_b:.0f} bytes "
+                      f"vs {base_b:.0f} (x{cur_b / base_b:.2f}, "
+                      f"threshold +{args.hbm_threshold:.0%})")
+        if not hbm_regs and base_hbm:
+            print(f"  hbm ok: {len(set(cur_hbm) & set(base_hbm))} "
+                  f"query peak(s) within +{args.hbm_threshold:.0%} of "
+                  f"baseline")
+
+    if res["regressions"] or compile_reg or hbm_regs:
         if res["regressions"]:
             print(f"{len(res['regressions'])} per-query regression(s) "
                   f"beyond +{args.threshold:.0%}")

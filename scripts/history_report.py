@@ -60,6 +60,8 @@ def report_data(store, top: int = 10, drift_threshold: float = 2.0
                      "device_us": round(agg.predicted_us(), 1),
                      "compile_ms": round(agg.compile_ms, 1),
                      "src_bytes": int(agg.src_bytes),
+                     "ws_bytes": int(agg.ws_bytes),
+                     "ws_runs": agg.ws_runs,
                      "segments": {n: round(v, 2)
                                   for n, v in agg.segments.items()},
                      "drift_ratio": agg.drift_ratio()})
@@ -68,6 +70,7 @@ def report_data(store, top: int = 10, drift_threshold: float = 2.0
             "top_structures": rows[:top],
             "structures": len(rows),
             "calibration": store.calibration(),
+            "ws_calibration": store.ws_calibration(),
             "drift": store.drifted(drift_threshold)}
 
 
@@ -105,6 +108,15 @@ def render(data: dict, drift_threshold: float) -> str:
     else:
         lines.append("-- calibration: no predictions recorded yet "
                      "(serving admission stamps them) --")
+    ws_calib = data.get("ws_calibration") or {}
+    if ws_calib:
+        lines.append("-- working-set calibration (reservation vs "
+                     "measured HBM) --")
+        for basis, c in sorted(ws_calib.items()):
+            curve = " ".join(f"<=2^{b}:{n}" for b, n in
+                             sorted(c["buckets"].items()))
+            lines.append(f"  {basis:<16} n={c['n']} "
+                         f"mean_error=x{c['mean_ratio']}  {curve}")
     drift = data["drift"]
     lines.append(f"-- drift (> x{drift_threshold:g} vs own warm "
                  f"history) --")
@@ -192,6 +204,32 @@ def self_test() -> int:
                              "basis": "exact_history"})
         cal = st4.calibration()["exact_history"]
         assert cal["n"] == 4 and abs(cal["mean_ratio"] - 2.0) < 1e-6
+
+        # 5: working-set calibration — a measured-ws record carrying a
+        # working-set prediction folds the reservation-vs-actual curve
+        # and the aggregate serves a measured-basis working set
+        path5 = os.path.join(td, "ws.jsonl")
+        st5 = PerfHistoryStore(path5)
+        for _ in range(3):
+            st5.record("w", {"device_us": 50_000.0, "wall_ms": 50.0,
+                             "compile_ms": 0.0,
+                             "ws_bytes": 1 << 20, "ws_basis": "measured",
+                             "predicted_ws": float(1 << 22),
+                             "ws_pred_basis": "source",
+                             "label": "ws_q"})
+        ws_cal = st5.ws_calibration()["source"]
+        assert ws_cal["n"] == 3 and abs(ws_cal["mean_ratio"] - 4.0) < 1e-6
+        agg = st5.get("w")
+        assert agg.ws_runs == 3 and abs(agg.ws_bytes - (1 << 20)) < 1
+        data5 = report_data(st5)
+        row = data5["top_structures"][0]
+        assert row["ws_bytes"] == 1 << 20 and row["ws_runs"] == 3
+        assert data5["ws_calibration"]["source"]["n"] == 3
+        # and the curve survives a compaction round trip
+        st5._compact()
+        st5b = PerfHistoryStore(path5)
+        assert st5b.ws_calibration()["source"]["n"] == 3
+        assert st5b.get("w").ws_runs == 3
 
     print("history_report self-test OK")
     return 0

@@ -66,9 +66,10 @@ def _eventlog_families(path: str) -> dict:
 
 
 def _bench_families(path: str) -> dict:
-    from check_regression import (extract_compile_ms, extract_kernels,
-                                  extract_multichip, extract_queries,
-                                  extract_segments, extract_serving)
+    from check_regression import (extract_compile_ms, extract_hbm,
+                                  extract_kernels, extract_multichip,
+                                  extract_queries, extract_segments,
+                                  extract_serving)
     with open(path) as f:
         doc = json.load(f)
     fams = {}
@@ -91,6 +92,11 @@ def _bench_families(path: str) -> dict:
                  for node, ms in per.items()}
     if flat_segs:
         fams["segments"] = flat_segs
+    # per-query measured HBM peaks (memory-attribution plane): diff
+    # working sets across bench rounds the same way device time diffs
+    hbm = extract_hbm(doc)
+    if hbm:
+        fams["hbm"] = hbm
     cms = extract_compile_ms(doc)
     if cms:
         fams["compile"] = {"median_compile_ms":
@@ -224,6 +230,23 @@ def self_test() -> int:
         assert res["serving"]["regressed"][0]["entry"] == "sv:c8_p99", \
             res["serving"]
         assert abs(res["serving"]["regressed"][0]["ratio"] - 3.0) < 1e-9
+
+    # 3b: per-query HBM peaks diff as their own family (the memattr
+    # plane's bench fields — check_regression gates them, this names
+    # the query whose working set moved)
+    def hbm_doc(q3_bytes):
+        return {"backend": "cpu", "tpch_suite_queries": {
+            "q3": {"device_ms_net": 100.0, "hbm_peak_bytes": q3_bytes},
+            "q6": {"device_ms_net": 50.0, "hbm_peak_bytes": 1 << 20}}}
+    with tempfile.TemporaryDirectory() as td:
+        ha = os.path.join(td, "BENCH_a.json")
+        hb = os.path.join(td, "BENCH_b.json")
+        json.dump(hbm_doc(2 << 20), open(ha, "w"))
+        json.dump(hbm_doc(8 << 20), open(hb, "w"))
+        res = diff_families(load_families(ha), load_families(hb))
+        reg = res["hbm"]["regressed"]
+        assert reg and reg[0]["entry"] == "q3", res["hbm"]
+        assert abs(reg[0]["ratio"] - 4.0) < 1e-9
 
     # 4: the committed trajectory reproduces the PR 8 groupby win
     r05 = os.path.join(_ROOT, "MULTICHIP_r05.json")

@@ -55,12 +55,16 @@ def render_mesh_timeline(tl: dict, indent: str = "  ") -> list:
             lines.append(f"{indent}dict_gather t={ex.get('t_ms', 0)}ms "
                          f"bytes={ex.get('bytes', 0)}")
             continue
+        hbm = ""
+        if ex.get("slab_bytes") or ex.get("recv_buffer_bytes"):
+            hbm = (f" slab={ex.get('slab_bytes', 0)}B "
+                   f"recv_buf={ex.get('recv_buffer_bytes', 0)}B")
         lines.append(
             f"{indent}exchange t={ex.get('t_ms', 0)}ms "
             f"rounds={ex.get('rounds', 0)} quota={ex.get('quota', 0)} "
             f"wire={ex.get('bytes', 0)}B "
             f"(pre-compress {ex.get('bytes_pre_compress', 0)}B) "
-            f"recv_cap={ex.get('recv_cap', 0)} "
+            f"recv_cap={ex.get('recv_cap', 0)}{hbm} "
             f"arrivals={ex.get('arrivals', '?')}")
         for r in ex.get("round_events", []):
             lines.append(

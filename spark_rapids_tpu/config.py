@@ -452,6 +452,25 @@ PROFILE_COST_ANALYSIS = conf(
     "skew flags mis-fused segments.  Compile-time only: zero cost on "
     "the execute path.")
 
+PROFILE_MEMORY = conf(
+    "spark.rapids.tpu.profile.memory", True,
+    "Device-MEMORY attribution (obs/memattr.py), active when "
+    "profile.segments is on: every compiled segment dispatch is "
+    "bracketed by a MemoryBudget census (resident, naked and "
+    "spillable-resident bytes, peak delta across the window) and its "
+    "XLA memory_analysis() bytes, building the per-query HBM timeline "
+    "(reserve/release/spill/OOM watermarks with plan-node attribution) "
+    "the EXPLAIN ANALYZE `hbm=` column, the segment.*.hbm_* metrics, "
+    "tpu_segment_hbm_peak_bytes and the crash-dump forensics read "
+    "from.  With profile.segments off this knob is never consulted — "
+    "the execute path stays one conf check per dispatch.")
+
+PROFILE_MEMORY_TIMELINE_EVENTS = conf(
+    "spark.rapids.tpu.profile.memoryTimelineEvents", 512,
+    "Bound on the per-query HBM-timeline event list (obs/memattr.py): "
+    "past it further watermark samples are dropped and counted, so a "
+    "reserve storm cannot grow query memory.", checker=_positive)
+
 TRACE_ENABLED = conf(
     "spark.rapids.tpu.trace.enabled", False,
     "Collect query-lifecycle spans in memory (plan/compile/execute/"

@@ -473,13 +473,26 @@ def _compiled_cost(compiled) -> Dict[str, float]:
         ma = compiled.memory_analysis()
         for attr, name in (("temp_size_in_bytes", "peak_temp_bytes"),
                            ("output_size_in_bytes", "output_bytes"),
-                           ("argument_size_in_bytes", "argument_bytes")):
+                           ("argument_size_in_bytes", "argument_bytes"),
+                           ("generated_code_size_in_bytes",
+                            "generated_code_bytes")):
             v = getattr(ma, attr, None)
             if v:
                 out[name] = float(v)
     except Exception:                    # noqa: BLE001
         pass
     return out
+
+
+def analysis_hbm_bytes(cost: Optional[Dict[str, float]]) -> int:
+    """The XLA memory_analysis() working set of one compiled program:
+    arguments + output + temp scratch + generated code — what the
+    program itself holds in HBM while it runs (0 when the backend
+    exposed no analysis)."""
+    c = cost or {}
+    return int(sum(c.get(k) or 0.0
+                   for k in ("argument_bytes", "output_bytes",
+                             "peak_temp_bytes", "generated_code_bytes")))
 
 
 def _plan_cache_put(key, entry: tuple, conf: TpuConf) -> None:
@@ -776,6 +789,29 @@ class CompiledPlan:
         self._fresh = False
 
         prof = bool(ctx.conf.get(PROFILE_SEGMENTS))
+        mrec = None
+        if prof:
+            # memory-attribution bracket (obs/memattr.py): census the
+            # query's budget before the dispatch so the segment's
+            # measured working set covers resident batches + this
+            # program's own footprint.  The `memattr` chaos site fires
+            # on the census read: an injected ioerror skips THIS
+            # sample (query bit-identical), fatal propagates to crash
+            # capture with the partial timeline embedded.
+            mrec = getattr(ctx, "_memattr", None)
+            if mrec is not None:
+                from ..obs.memattr import budget_census
+                from ..runtime.faults import get_injector
+                nid = getattr(self.root, "_node_id", None)
+                try:
+                    get_injector(ctx.conf).fire(
+                        "memattr", segment=nid or self.root.name())
+                    mrec.open_segment(nid or type(self.root).__name__,
+                                      budget_census(ctx)["live"])
+                except OSError:
+                    mrec.skipped += 1
+                    ctx.bump("memattr_census_skipped")
+                    mrec = None
         t0 = _time.perf_counter()
         with ctx.tracer.span("execute", "execute",
                              root=self.root.name()):
@@ -799,6 +835,16 @@ class CompiledPlan:
         m = ctx.metrics
         m["exec_device_ms"] = m.get("exec_device_ms", 0.0) \
             + (t1 - t0) * 1e3
+        # always-on measured working-set floor: the largest XLA
+        # memory_analysis() footprint this query dispatched (args +
+        # output + temp + code, captured at compile time — no conf
+        # check, no sync).  The history plane records it so admission
+        # can serve a MEASURED working set instead of the source-bytes
+        # heuristic (obs/history.py ws_bytes, obs/estimator.py)
+        if self._cost:
+            ws = analysis_hbm_bytes(self._cost)
+            if ws > m.get("exec_hbm_bytes", 0):
+                m["exec_hbm_bytes"] = ws
 
         outs = []
         i = 0
@@ -806,16 +852,20 @@ class CompiledPlan:
             db, i = _rebuild_batch(flat_res, spec, i)
             outs.append(db)
         if prof:
-            self._record_segment(ctx, t0, t1, outs)
+            self._record_segment(ctx, t0, t1, outs, mrec)
         return outs
 
     def _record_segment(self, ctx: ExecContext, t0: float, t1: float,
-                        outs: List[DeviceBatch]) -> None:
+                        outs: List[DeviceBatch], mrec=None) -> None:
         """Attribute one measured program execution to its plan segment:
         the root node id + the preorder node-id range the program covers
         in the CURRENT tree (split-seam leaves excluded), output rows
-        and bytes, and the compile-time static cost overlay."""
-        from ..obs.registry import SEGMENT_DEVICE_MS, SEGMENT_ROWS
+        and bytes, the compile-time static cost overlay, and — when the
+        memory-attribution bracket is open — the segment's measured
+        HBM working set (XLA memory_analysis bytes vs the budget peak
+        delta across the dispatch window, obs/memattr.py)."""
+        from ..obs.registry import (SEGMENT_DEVICE_MS, SEGMENT_HBM_PEAK,
+                                    SEGMENT_ROWS)
         from .metrics import node_id_range
         dev_ms = (t1 - t0) * 1e3
         nid = getattr(self.root, "_node_id", None)
@@ -850,6 +900,20 @@ class CompiledPlan:
             if v:
                 m[f"segment.{key}.{k}"] = v
                 attrs[k] = v
+        if mrec is not None:
+            from ..obs.memattr import budget_census
+            analysis = analysis_hbm_bytes(self._cost)
+            hbm = mrec.close_segment(key, analysis,
+                                     budget_census(ctx)["live"])
+            SEGMENT_HBM_PEAK.observe(hbm["hbm_peak_bytes"], segment=cls)
+            for field, v in (("hbm_bytes", analysis),
+                             ("hbm_peak_bytes", hbm["hbm_peak_bytes"]),
+                             ("hbm_resident_pre", hbm["resident_pre"])):
+                mk = f"segment.{key}.{field}"
+                if v > m.get(mk, 0):         # max, not sum: a repeated
+                    m[mk] = v                # dispatch reuses its HBM
+            attrs["hbm_bytes"] = analysis
+            attrs["hbm_peak_bytes"] = hbm["hbm_peak_bytes"]
         ctx.tracer.add_span("segment", "execute", t0, t1, node=nid,
                             **attrs)
 

@@ -196,6 +196,44 @@ def publish_registry(ctx) -> None:
         COMPILES_TOTAL.inc(int(misses), outcome="miss")
 
 
+def finish_memattr(ctx) -> None:
+    """Query-end half of the memory-attribution plane (obs/memattr.py),
+    called from the instrumented scope after lazy metrics coerced:
+
+      * fold the recorder's measured working set + timeline into the
+        query metrics / tracer meta (the `memory.hbm_*` keys
+        QueryProfile.hbm() and the history feed read);
+      * the residual-leak check — ALWAYS on, one counter read: naked
+        (directly reserved, non-Spillable) budget bytes still live at
+        query end are a leak, flagged in the profile and counted in
+        tpu_hbm_residual_bytes."""
+    m = ctx.metrics
+    rec = getattr(ctx, "_memattr", None)
+    if rec is not None:
+        summ = rec.summary()
+        peak = max(int(summ["query_peak_bytes"]),
+                   int(m.get("exec_hbm_bytes", 0) or 0))
+        if peak:
+            m["memory.hbm_measured_working_set"] = peak
+        if summ["skipped"]:
+            m["memory.hbm_census_skipped"] = summ["skipped"]
+        if summ["events"] > 1:           # beyond the start marker
+            m["memory.hbm_timeline_events"] = summ["events"]
+        tr = getattr(ctx, "tracer", NULL_TRACER)
+        if getattr(tr, "enabled", False):
+            tr.meta["hbm_timeline"] = rec.timeline()
+            tr.meta["hbm_summary"] = summ
+    b = getattr(ctx, "_budget", None)
+    if b is not None:
+        resid = int(getattr(b, "naked_live", 0) or 0)
+        if resid > 0:
+            from ..obs.registry import HBM_RESIDUAL
+            HBM_RESIDUAL.inc(resid)
+            m["memory.residual_naked_bytes"] = resid
+            getattr(ctx, "tracer", NULL_TRACER).instant(
+                "hbm_leak", "runtime", bytes=resid)
+
+
 def record_history(pq, ctx, wall_ms: float) -> None:
     """Feed one completed query into the persistent performance-history
     store (obs/history.py) — called at query end from

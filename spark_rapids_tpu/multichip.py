@@ -348,6 +348,18 @@ def run_multichip_suite(n_devices: int = 8, sf: float = 10.0,
             ici = prof.counters.get("ici_exchange_bytes", 0)
             if ici:
                 rec["ici_exchange_bytes"] = int(ici)
+            # per-query HBM attribution from the traced cold collect:
+            # the budget peak + the XLA memory_analysis working-set
+            # floor ride the record so check_regression.py can gate
+            # HBM-peak regressions on the mesh suite too
+            hbm_peak = max(int(ctx.metrics.get("memory.peak_bytes")
+                               or 0),
+                           int(ctx.metrics.get("exec_hbm_bytes") or 0))
+            if hbm_peak:
+                rec["hbm_peak_bytes"] = hbm_peak
+            mws = int(ctx.metrics.get("exec_hbm_bytes") or 0)
+            if mws:
+                rec["hbm_measured_working_set"] = mws
             t0 = time.perf_counter()
             q.collect(ExecContext(sdev.conf))
             warm = time.perf_counter() - t0
@@ -384,11 +396,17 @@ def run_multichip_suite(n_devices: int = 8, sf: float = 10.0,
             continue
         rec = per_q.setdefault(name, {})
         try:
+            sctx = ExecContext(sspill.conf)
             t0 = time.perf_counter()
-            tpch.QUERIES[name](sspill, tables).physical().collect(
-                ExecContext(sspill.conf))
+            tpch.QUERIES[name](sspill, tables).physical().collect(sctx)
             rec["spill_leg_wall_ms"] = round(
                 (time.perf_counter() - t0) * 1e3, 1)
+            # the spill leg's budget peak is the interesting HBM
+            # number at suite scale (the eager engine actually
+            # reserves): ride it next to the wall
+            speak = int(sctx.metrics.get("memory.peak_bytes") or 0)
+            if speak:
+                rec["spill_leg_hbm_peak_bytes"] = speak
         except Exception as e:                   # noqa: BLE001
             rec["spill_leg_error"] = f"{type(e).__name__}: {e}"[:200]
     spill_after = sum(s_["value"] for s_ in spill0.series()) \

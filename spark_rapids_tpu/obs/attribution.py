@@ -136,6 +136,11 @@ class ExplainAnalyzeReport:
     mesh_timeline: Dict[str, Any]
     metrics: Dict[str, Any]
     profile: object                 # the QueryProfile
+    #: the memory-attribution view (obs/memattr.py): measured query
+    #: peak, sum of per-segment HBM peaks and the attributed fraction
+    #: (the acceptance bar: summed segment peaks account for >=90% of
+    #: the measured peak); {} when the plane was off
+    hbm: Dict[str, Any] = dataclasses.field(default_factory=dict)
     #: admission-style cost-oracle estimate taken BEFORE the profiled
     #: run (obs/estimator.py) — the predicted column next to measured;
     #: None when the history plane is off
@@ -150,7 +155,8 @@ class ExplainAnalyzeReport:
                 "gathers": self.gathers,
                 "mesh_timeline": self.mesh_timeline,
                 "predicted": self.predicted,
-                "kernel_tiers": self.kernel_tiers}
+                "kernel_tiers": self.kernel_tiers,
+                "hbm": self.hbm}
 
     def render(self) -> str:
         head = [f"== EXPLAIN ANALYZE ==",
@@ -167,6 +173,13 @@ class ExplainAnalyzeReport:
         if self.attributed_pct is not None:
             head.append(f"attributed        {self.attributed_pct:.1f}% "
                         f"of device wall to named plan segments")
+        if self.hbm.get("measured_peak_bytes"):
+            h = self.hbm
+            head.append(
+                f"hbm peak          {h['measured_peak_bytes']} bytes "
+                f"measured (segment peaks sum "
+                f"{h.get('segment_sum_bytes', 0)}, "
+                f"{h.get('attributed_pct', 0):.1f}% attributed)")
         if self.gathers.get("gather_bytes"):
             head.append(f"gather volume     "
                         f"{self.gathers['gather_bytes']} bytes / "
@@ -251,6 +264,13 @@ def _render_tree(root, metrics: Dict[str, Any],
                 s += f", rows={seg['rows']}"
             if seg.get("out_bytes"):
                 s += f", bytes={seg['out_bytes']}"
+            if seg.get("hbm_peak_bytes"):
+                # the memory-attribution column: this segment's
+                # measured HBM working set; the largest one carries
+                # the query's peak flag
+                s += f", hbm={int(seg['hbm_peak_bytes'])}"
+                if seg.get("hbm_peak_segment"):
+                    s += " <-- hbm peak"
             cost = []
             if seg.get("flops"):
                 cost.append(f"flops={seg['flops']:.3g}")
@@ -350,6 +370,24 @@ def run_explain_analyze(pq, conf_overrides: Optional[dict] = None
     profile = QueryProfile.from_context(ctx)
     segments = profile.segments()
     _flag_skew(segments)
+    # memory attribution (obs/memattr.py): flag the peak segment and
+    # compute the acceptance ratio — summed per-segment HBM peaks vs
+    # the query's measured peak (resident + in-flight program)
+    hbm: Dict[str, Any] = {}
+    with_hbm = [s for s in segments if s.get("hbm_peak_bytes")]
+    if with_hbm:
+        max(with_hbm,
+            key=lambda s: s["hbm_peak_bytes"])["hbm_peak_segment"] = True
+        seg_sum = int(sum(s["hbm_peak_bytes"] for s in with_hbm))
+        measured = int(ctx.metrics.get("memory.hbm_measured_working_set")
+                       or 0)
+        measured = max(measured,
+                       int(ctx.metrics.get("memory.peak_bytes") or 0))
+        hbm = {"measured_peak_bytes": measured,
+               "segment_sum_bytes": seg_sum,
+               "attributed_pct": round(
+                   min(seg_sum / measured, 1.0) * 100, 1)
+               if measured else 0.0}
     seg_by_node = {s["node"]: s for s in segments}
     split = profile.time_split()
     from ..obs.profile import _union_ms
@@ -369,4 +407,4 @@ def run_explain_analyze(pq, conf_overrides: Optional[dict] = None
         wall_ms=split["wall_ms"], device_ms=round(device_ms, 3),
         gathers=gathers, mesh_timeline=profile.mesh_timeline(),
         metrics=dict(ctx.metrics), profile=profile,
-        predicted=predicted, kernel_tiers=kernel_tiers)
+        predicted=predicted, kernel_tiers=kernel_tiers, hbm=hbm)

@@ -72,12 +72,22 @@ class CostEstimator:
         if drift is not None and (drift >= DRIFT_CUT
                                   or drift <= 1.0 / DRIFT_CUT):
             confidence = min(confidence, 0.25)
-        ws = max(agg.peak_bytes, agg.src_bytes)
+        # working set: a MEASURED history (memattr query peaks / XLA
+        # memory_analysis floors folded at record time) beats the
+        # reserved-peak/source-bytes heuristic — ws_basis tells the
+        # serving admission gate which one it is getting
+        if agg.ws_runs > 0 and agg.ws_bytes > 0:
+            ws = agg.ws_bytes
+            ws_basis = "measured"
+        else:
+            ws = max(agg.peak_bytes, agg.src_bytes)
+            ws_basis = "reserved"
         return {"basis": "exact_history", "key": key,
                 "device_us": max(round(agg.predicted_us(), 1), 1.0),
                 "wall_ms": round(agg.wall_ms, 3),
                 "compile_ms": round(agg.compile_ms, 3),
                 "working_set_bytes": int(ws),
+                "ws_basis": ws_basis,
                 "confidence": round(confidence, 3),
                 "runs": agg.runs, "warm_runs": agg.warm_runs,
                 "drift_ratio": None if drift is None else round(drift, 3),
@@ -95,6 +105,7 @@ class CostEstimator:
                 "wall_ms": None,
                 "compile_ms": None,
                 "working_set_bytes": int(src * ws_factor),
+                "ws_basis": "source",
                 "confidence": 0.25 if fitted else 0.0,
                 "runs": 0,
                 "segments": {}}

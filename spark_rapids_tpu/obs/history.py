@@ -144,6 +144,7 @@ class _Agg:
     __slots__ = ("runs", "warm_runs", "last_ts", "device_us",
                  "warm_device_us", "prev_warm_us", "last_warm_us",
                  "wall_ms", "compile_ms", "src_bytes", "peak_bytes",
+                 "ws_bytes", "ws_runs",
                  "total_device_us", "segments", "label", "kind",
                  "backend")
 
@@ -159,6 +160,10 @@ class _Agg:
         self.compile_ms = 0.0       # decayed over COLD runs (compile cost)
         self.src_bytes = 0.0
         self.peak_bytes = 0.0
+        self.ws_bytes = 0.0         # decayed MEASURED working set
+        self.ws_runs = 0            # runs that carried one (memattr /
+                                    # XLA memory_analysis — not the
+                                    # source-bytes heuristic)
         self.total_device_us = 0.0  # lifetime sum (report ranking)
         self.segments: Dict[str, float] = {}   # node -> decayed device ms
         self.label: Optional[str] = None
@@ -183,6 +188,11 @@ class _Agg:
         self.peak_bytes = self._ewma(self.peak_bytes,
                                      float(rec.get("peak_bytes") or 0.0),
                                      self.runs == 0, decay)
+        ws = float(rec.get("ws_bytes") or 0.0)
+        if ws > 0:
+            self.ws_bytes = self._ewma(self.ws_bytes, ws,
+                                       self.ws_runs == 0, decay)
+            self.ws_runs += 1
         if _is_warm(rec):
             self.prev_warm_us = self.warm_device_us
             self.last_warm_us = dus
@@ -235,6 +245,8 @@ class _Agg:
                "compile_ms": round(self.compile_ms, 3),
                "src_bytes": round(self.src_bytes, 1),
                "peak_bytes": round(self.peak_bytes, 1),
+               "ws_bytes": round(self.ws_bytes, 1),
+               "ws_runs": self.ws_runs,
                "total_device_us": round(self.total_device_us, 1),
                "segments": {n: round(v, 3)
                             for n, v in self.segments.items()}}
@@ -258,6 +270,8 @@ class _Agg:
         a.compile_ms = float(d.get("compile_ms") or 0.0)
         a.src_bytes = float(d.get("src_bytes") or 0.0)
         a.peak_bytes = float(d.get("peak_bytes") or 0.0)
+        a.ws_bytes = float(d.get("ws_bytes") or 0.0)
+        a.ws_runs = int(d.get("ws_runs") or 0)
         a.total_device_us = float(d.get("total_device_us")
                                   or a.device_us * a.runs)
         a.segments = {str(n): float(v)
@@ -286,6 +300,10 @@ class PerfHistoryStore:
         self._aggs: Dict[str, _Agg] = {}
         #: per-basis calibration: {"n", "sum_ratio", "buckets": {le: n}}
         self._calib: Dict[str, dict] = {}
+        #: reservation-vs-actual WORKING-SET calibration, same shape —
+        #: how far admission's working_set_bytes predictions land from
+        #: the measured HBM footprint (tpu_hbm_prediction_error_ratio)
+        self._calib_ws: Dict[str, dict] = {}
         self.corrupt_lines = 0
         self.loaded_records = 0          # raw records replayed from disk
         self.recorded = 0                # records appended live
@@ -328,14 +346,16 @@ class PerfHistoryStore:
                 self.us_per_byte = float(fit["us_per_byte"])
                 self._fit_n = int(fit.get("n") or 1)
             return
-        if rec.get("calib"):
-            for basis, c in rec["calib"].items():
-                self._calib[basis] = {
-                    "n": int(c.get("n") or 0),
-                    "sum_ratio": float(c.get("sum_ratio") or 0.0),
-                    "buckets": {int(k): int(v) for k, v in
-                                (c.get("buckets") or {}).items()}}
-            return
+        for field, target in (("calib", self._calib),
+                              ("calib_ws", self._calib_ws)):
+            if rec.get(field):
+                for basis, c in rec[field].items():
+                    target[basis] = {
+                        "n": int(c.get("n") or 0),
+                        "sum_ratio": float(c.get("sum_ratio") or 0.0),
+                        "buckets": {int(k): int(v) for k, v in
+                                    (c.get("buckets") or {}).items()}}
+                return
         if not key:
             return
         if rec.get("agg"):
@@ -366,24 +386,38 @@ class PerfHistoryStore:
         self._fit_n += 1
 
     def _calibrate(self, rec: dict) -> None:
+        from .registry import (HBM_PREDICTION_ERROR,
+                               HISTORY_PREDICTION_ERROR, bucket_index)
+        basis = str(rec.get("basis") or "?")
         pred = rec.get("predicted_us")
         dus = float(rec.get("device_us") or 0.0)
-        if not pred or dus <= 0:
-            return
-        pred = float(pred)
-        if pred <= 0:
-            return
-        ratio = max(pred, dus) / min(pred, dus)
-        basis = str(rec.get("basis") or "?")
-        c = self._calib.setdefault(
-            basis, {"n": 0, "sum_ratio": 0.0, "buckets": {}})
-        c["n"] += 1
-        c["sum_ratio"] += ratio
-        from .registry import bucket_index
-        b = bucket_index(ratio)
-        c["buckets"][b] = c["buckets"].get(b, 0) + 1
-        from .registry import HISTORY_PREDICTION_ERROR
-        HISTORY_PREDICTION_ERROR.observe(ratio, basis=basis)
+        if pred and float(pred) > 0 and dus > 0:
+            pred = float(pred)
+            ratio = max(pred, dus) / min(pred, dus)
+            c = self._calib.setdefault(
+                basis, {"n": 0, "sum_ratio": 0.0, "buckets": {}})
+            c["n"] += 1
+            c["sum_ratio"] += ratio
+            b = bucket_index(ratio)
+            c["buckets"][b] = c["buckets"].get(b, 0) + 1
+            HISTORY_PREDICTION_ERROR.observe(ratio, basis=basis)
+        # reservation-vs-actual: admission's working-set prediction vs
+        # the run's measured HBM footprint (the curve that tells the
+        # serving gate how much to trust the oracle's bytes)
+        pred_ws = rec.get("predicted_ws")
+        meas_ws = float(rec.get("ws_bytes") or rec.get("peak_bytes")
+                        or 0.0)
+        if pred_ws and float(pred_ws) > 0 and meas_ws > 0:
+            pred_ws = float(pred_ws)
+            ratio = max(pred_ws, meas_ws) / min(pred_ws, meas_ws)
+            ws_basis = str(rec.get("ws_pred_basis") or basis)
+            c = self._calib_ws.setdefault(
+                ws_basis, {"n": 0, "sum_ratio": 0.0, "buckets": {}})
+            c["n"] += 1
+            c["sum_ratio"] += ratio
+            b = bucket_index(ratio)
+            c["buckets"][b] = c["buckets"].get(b, 0) + 1
+            HBM_PREDICTION_ERROR.observe(ratio, basis=ws_basis)
 
     # -- record ------------------------------------------------------------
     def record(self, key: str, rec: dict, conf: Optional[TpuConf] = None
@@ -454,6 +488,16 @@ class PerfHistoryStore:
                "peak_bytes": _peak_bytes(ctx),
                "segments": {n: round(float(f.get("device_ms", 0.0)), 3)
                             for n, f in segments.items()}}
+        # the MEASURED working set, when this run produced one: the
+        # memattr query peak (profiled runs) or the XLA
+        # memory_analysis floor (every compiled run) — max'd with the
+        # budget peak so spill-leg reservations count too.  ws_basis
+        # marks it measured, the estimator's trust discriminant.
+        ws = max(num("memory.hbm_measured_working_set"),
+                 num("exec_hbm_bytes"))
+        if ws > 0:
+            rec["ws_bytes"] = int(max(ws, num("memory.peak_bytes")))
+            rec["ws_basis"] = "measured"
         seg_rows = {n: int(f["rows"]) for n, f in segments.items()
                     if isinstance(f.get("rows"), (int, float))}
         if seg_rows:
@@ -473,6 +517,12 @@ class PerfHistoryStore:
         if isinstance(pred, (int, float)) and pred > 0:
             rec["predicted_us"] = float(pred)
             rec["basis"] = str(m.get("predicted.basis") or "?")
+        pred_ws = m.get("predicted.working_set_bytes")
+        if isinstance(pred_ws, (int, float)) and pred_ws > 0:
+            rec["predicted_ws"] = float(pred_ws)
+            wb = m.get("predicted.ws_basis")
+            if isinstance(wb, str) and wb:
+                rec["ws_pred_basis"] = wb
         self.record(key, rec, conf=ctx.conf)
 
     # -- compaction --------------------------------------------------------
@@ -505,6 +555,9 @@ class PerfHistoryStore:
                          "n": self._fit_n}}))
         if self._calib:
             head.append(json.dumps({"calib": self._calib}, default=str))
+        if self._calib_ws:
+            head.append(json.dumps({"calib_ws": self._calib_ws},
+                                   default=str))
         for k in keys:
             lines.append(json.dumps({"k": k,
                                      "agg": self._aggs[k].to_dict()}))
@@ -540,9 +593,19 @@ class PerfHistoryStore:
 
     def calibration(self) -> Dict[str, dict]:
         """Per-basis calibration: {basis: {n, mean_ratio, buckets}}."""
+        return self._render_calib(self._calib)
+
+    def ws_calibration(self) -> Dict[str, dict]:
+        """The reservation-vs-actual working-set curve: per basis, how
+        far admission's predicted working_set_bytes landed from the
+        measured HBM footprint (the offline
+        tpu_hbm_prediction_error_ratio)."""
+        return self._render_calib(self._calib_ws)
+
+    def _render_calib(self, calib: Dict[str, dict]) -> Dict[str, dict]:
         with self._lock:
             out = {}
-            for basis, c in self._calib.items():
+            for basis, c in calib.items():
                 out[basis] = {
                     "n": c["n"],
                     "mean_ratio": round(c["sum_ratio"] / c["n"], 3)
@@ -584,7 +647,8 @@ class PerfHistoryStore:
                     "file_bytes": fsize,
                     "us_per_byte": round(self.us_per_byte, 6)
                     if self.us_per_byte else None,
-                    "calibration": self.calibration()}
+                    "calibration": self.calibration(),
+                    "ws_calibration": self.ws_calibration()}
 
 
 def source_bytes(root) -> int:

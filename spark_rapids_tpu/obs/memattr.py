@@ -1,0 +1,282 @@
+"""Device-memory attribution — the measured-HBM half of the
+observability stack.
+
+PR 9/12 attribute device *time* end to end; device *memory* was still
+guessed: serving admission sized working sets as `admitWorkingSetFactor
+x source bytes`, compiled programs never reported what they actually
+hold, and spills/OOMs left no record of WHO owned the pressure.
+Sparkle's memory-tier placement and Theseus' data-movement scheduling
+(PAPERS.md) both start from measured per-stage footprints — this module
+is that measurement layer, the prerequisite for ROADMAP 2b (mesh budget
+integration) and 4 (the out-of-core tier):
+
+  * `DeviceCensus` — the process-wide truth about budget-admitted HBM.
+    Every `MemoryBudget` feeds its live-byte DELTAS here, so the
+    `tpu_hbm_live_bytes` / `tpu_hbm_peak_bytes` gauges report the SUM
+    across all concurrent queries (serving tenants included) instead
+    of whichever budget wrote last.  Per-query peaks stay per-budget
+    (`memory.peak_bytes`): a concurrent tenant's reservations can
+    never inflate another query's reported peak, and the global gauge
+    stays the global gauge.
+  * `MemAttrRecorder` — the per-query HBM timeline: a bounded sequence
+    of watermark samples (reserve / release / spill / oom / segment
+    brackets / exchange footprints) each stamped with the live level
+    and the plan-node range that owned the pressure at that instant.
+    Active only under `spark.rapids.tpu.profile.segments` (+
+    `profile.memory`); the disabled path stays one conf check per
+    dispatch.  Segment BRACKETS wrap each compiled program dispatch:
+    the budget census at open, the peak delta across the window, and
+    the program's XLA `memory_analysis()` bytes together are the
+    segment's measured working set (`segment.<id>.hbm_*` metrics,
+    `tpu_segment_hbm_peak_bytes`, the EXPLAIN ANALYZE `hbm=` column).
+  * forensics — crash dumps embed the recorder's timeline tail
+    (runtime/failure.py), every spill/OOM event carries its owning
+    node range, and the query-end leak check flags nonzero residual
+    naked reservations (`tpu_hbm_residual_bytes`,
+    `memory.residual_naked_bytes` in the profile).
+
+The `memattr` chaos site fires on each segment census read: an injected
+`ioerror` skips that sample (query bit-identical), `fatal` propagates
+through crash capture as a classified dump embedding the partial
+timeline (runtime/faults.py SITES).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+from ..config import (PROFILE_MEMORY, PROFILE_MEMORY_TIMELINE_EVENTS,
+                      PROFILE_SEGMENTS, TpuConf)
+
+
+# ---------------------------------------------------------------------------
+# The process-wide census: budget-admitted bytes summed across queries
+# ---------------------------------------------------------------------------
+
+class DeviceCensus:
+    """Aggregate live-byte accounting over every MemoryBudget in the
+    process.  Budgets report deltas (they already hold their own lock);
+    a finalizer retires a collected budget's remaining live bytes so a
+    leaked context cannot pin the census."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.peak = 0
+
+    def register(self, budget) -> list:
+        """-> a mutable cell `[live_bytes, device_label]` the budget
+        keeps mirrored; retired automatically when the budget is GC'd."""
+        cell = [0, getattr(budget, "_device", "0")]
+        weakref.finalize(budget, self._retire, cell)
+        return cell
+
+    def _retire(self, cell: list) -> None:
+        self.adjust(-int(cell[0]), cell[1])
+        cell[0] = 0
+
+    def adjust(self, delta: int, device: str) -> int:
+        """Apply one budget's live-byte delta; returns the new process
+        total.  Feeds the per-device registry gauges — the GLOBAL view,
+        kept deliberately separate from per-query peak deltas."""
+        from .registry import HBM_LIVE_BYTES, HBM_PEAK_BYTES
+        with self._lock:
+            self.total += int(delta)
+            if self.total < 0:
+                self.total = 0
+            if self.total > self.peak:
+                self.peak = self.total
+            total = self.total
+        HBM_LIVE_BYTES.set(total, device=device)
+        HBM_PEAK_BYTES.max(total, device=device)
+        return total
+
+    def totals(self) -> Dict[str, int]:
+        with self._lock:
+            return {"live_bytes": self.total, "peak_bytes": self.peak}
+
+
+#: THE census every MemoryBudget reports into
+CENSUS = DeviceCensus()
+
+
+# ---------------------------------------------------------------------------
+# The per-query recorder: HBM timeline + segment brackets
+# ---------------------------------------------------------------------------
+
+class MemAttrRecorder:
+    """HBM timeline + per-segment memory attribution for ONE query.
+
+    Thread-safe (spill chains and shuffle workers report budget events
+    from their own threads).  The event list is bounded: past
+    `max_events` further samples are dropped and counted, so a
+    pathological reserve storm cannot grow query memory."""
+
+    enabled = True
+
+    def __init__(self, max_events: int = 512):
+        self._lock = threading.Lock()
+        self.max_events = int(max_events)
+        self._t0 = time.perf_counter()
+        self.events: List[Dict[str, Any]] = []
+        self.dropped = 0
+        self.skipped = 0               # census samples chaos skipped
+        #: node key of the segment bracket currently open (attribution
+        #: for budget events landing inside the window)
+        self._bracket: Optional[str] = None
+        self._bracket_pre = 0          # budget live at bracket open
+        self._bracket_peak = 0         # max budget live inside the window
+        #: per-segment measured rows: key -> {resident_pre, peak_delta,
+        #: analysis_bytes, hbm_peak_bytes}
+        self.segments: Dict[str, Dict[str, int]] = {}
+        #: the query's measured HBM peak: max over budget watermarks and
+        #: bracket (resident + program analysis) candidates
+        self.query_peak_bytes = 0
+        self._event("start", 0, 0, 0)
+
+    # -- events ------------------------------------------------------------
+    def _event(self, ev: str, nbytes: int, live: int, naked: int,
+               **extra) -> None:
+        rec = {"t_ms": round((time.perf_counter() - self._t0) * 1e3, 3),
+               "ev": ev, "bytes": int(nbytes), "live": int(live)}
+        if naked:
+            rec["naked"] = int(naked)
+        if self._bracket is not None:
+            rec["node"] = self._bracket
+        rec.update(extra)
+        if len(self.events) < self.max_events:
+            self.events.append(rec)
+        else:
+            self.dropped += 1
+
+    def on_budget_event(self, ev: str, nbytes: int, live: int,
+                        naked: int) -> None:
+        """One budget watermark sample (reserve/release/spill/oom),
+        attributed to the open segment bracket when one exists."""
+        with self._lock:
+            self._event(ev, nbytes, live, naked)
+            if live > self.query_peak_bytes:
+                self.query_peak_bytes = live
+            if self._bracket is not None and live > self._bracket_peak:
+                self._bracket_peak = live
+
+    def on_external(self, ev: str, **attrs) -> None:
+        """Non-budget footprint events (mesh exchange slab/recv
+        buffers) ride the same timeline."""
+        with self._lock:
+            self._event(ev, int(attrs.pop("bytes", 0)), 0, 0, **attrs)
+
+    # -- segment brackets --------------------------------------------------
+    def open_segment(self, key: str, resident_pre: int) -> None:
+        with self._lock:
+            self._bracket = key
+            self._bracket_pre = int(resident_pre)
+            self._bracket_peak = int(resident_pre)
+            self._event("segment_open", 0, resident_pre, 0)
+
+    def close_segment(self, key: str, analysis_bytes: int,
+                      resident_post: int) -> Dict[str, int]:
+        """Close the bracket and fold the segment's measured working
+        set: the larger of the program's XLA memory_analysis bytes and
+        the budget peak delta observed across the dispatch window."""
+        with self._lock:
+            pre = self._bracket_pre
+            peak_delta = max(self._bracket_peak - pre, 0,
+                             int(resident_post) - pre)
+            hbm_peak = max(int(analysis_bytes), peak_delta)
+            self._event("segment_close", hbm_peak, resident_post, 0)
+            self._bracket = None
+            row = self.segments.setdefault(
+                key, {"resident_pre": 0, "peak_delta": 0,
+                      "analysis_bytes": 0, "hbm_peak_bytes": 0})
+            row["resident_pre"] = max(row["resident_pre"], pre)
+            row["peak_delta"] = max(row["peak_delta"], peak_delta)
+            row["analysis_bytes"] = max(row["analysis_bytes"],
+                                        int(analysis_bytes))
+            row["hbm_peak_bytes"] = max(row["hbm_peak_bytes"], hbm_peak)
+            # the query-level measured peak candidate: what the device
+            # held while THIS program ran (resident batches + the
+            # program's own arguments/outputs/scratch)
+            cand = pre + max(int(analysis_bytes), peak_delta)
+            if cand > self.query_peak_bytes:
+                self.query_peak_bytes = cand
+            return {"resident_pre": pre, "peak_delta": peak_delta,
+                    "hbm_peak_bytes": hbm_peak}
+
+    # -- read --------------------------------------------------------------
+    def timeline(self, tail: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            evs = list(self.events)
+        return evs[-tail:] if tail else evs
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"query_peak_bytes": self.query_peak_bytes,
+                    "events": len(self.events),
+                    "dropped": self.dropped,
+                    "skipped": self.skipped,
+                    "segments": {k: dict(v)
+                                 for k, v in self.segments.items()}}
+
+
+def budget_census(ctx) -> Dict[str, int]:
+    """Point-in-time census of a query's OWN budget: live bytes, naked
+    (directly reserved) bytes, spillable-resident device bytes and the
+    host spill tier.  Never creates a budget — a pure whole-plan query
+    reports zeros."""
+    b = getattr(ctx, "_budget", None)
+    if b is None:
+        return {"live": 0, "naked": 0, "spillable_resident": 0,
+                "host_spill": 0}
+    with b._lock:
+        resident = sum(sp._nbytes for sp in b._spillables.values()
+                       if sp.on_device)
+        return {"live": int(b.live), "naked": int(b.naked_live),
+                "spillable_resident": int(resident),
+                "host_spill": int(b.host_live)}
+
+
+# ---------------------------------------------------------------------------
+# Active-recorder plumbing (mirrors obs/tracer.py set_active/get_active:
+# thread-local binding + single-active-scope process fallback, so the
+# serving plane's concurrent queries never cross-attribute samples)
+# ---------------------------------------------------------------------------
+
+_TLS_ACTIVE = threading.local()
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_SET: dict = {}
+_FALLBACK: Optional[MemAttrRecorder] = None
+_UNBOUND = object()
+
+
+def set_active(rec: Optional[MemAttrRecorder]) -> None:
+    global _FALLBACK
+    prev = getattr(_TLS_ACTIVE, "rec", None)
+    _TLS_ACTIVE.rec = rec if rec is not None else _UNBOUND
+    with _ACTIVE_LOCK:
+        if isinstance(prev, MemAttrRecorder):
+            _ACTIVE_SET.pop(id(prev), None)
+        if rec is not None:
+            _ACTIVE_SET[id(rec)] = rec
+        _FALLBACK = (next(iter(_ACTIVE_SET.values()))
+                     if len(_ACTIVE_SET) == 1 else None)
+
+
+def get_active_recorder() -> Optional[MemAttrRecorder]:
+    rec = getattr(_TLS_ACTIVE, "rec", None)
+    if isinstance(rec, MemAttrRecorder):
+        return rec
+    if rec is _UNBOUND:
+        return None
+    return _FALLBACK
+
+
+def make_recorder(conf: TpuConf) -> Optional[MemAttrRecorder]:
+    """A recorder when the memory-attribution plane is on for this conf
+    (profile.segments AND profile.memory), else None — checked once per
+    query, never per dispatch."""
+    if not (conf.get(PROFILE_SEGMENTS) and conf.get(PROFILE_MEMORY)):
+        return None
+    return MemAttrRecorder(conf.get(PROFILE_MEMORY_TIMELINE_EVENTS))

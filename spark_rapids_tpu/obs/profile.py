@@ -26,7 +26,8 @@ _NODE_METRIC_RE = re.compile(
 #: _record_segment; populated when spark.rapids.tpu.profile.segments on)
 _SEGMENT_METRIC_RE = re.compile(
     r"^segment\.(?P<node>[\w#]+)\.(?P<field>device_ms|rows|out_bytes|"
-    r"executions|flops|bytes_accessed|peak_temp_bytes)$")
+    r"executions|flops|bytes_accessed|peak_temp_bytes|hbm_bytes|"
+    r"hbm_peak_bytes|hbm_resident_pre)$")
 
 #: span categories that are measured directly; "execute" is the residual
 _SPLIT_CATS = ("compile", "transition", "shuffle")
@@ -283,6 +284,38 @@ class QueryProfile:
                 out[k.removeprefix("memory.")] = v
         return out
 
+    # -- the memory-attribution plane (obs/memattr.py) ---------------------
+    def hbm(self) -> Dict[str, Any]:
+        """The query's measured-HBM view: the measured working set
+        (memattr query peak / XLA memory_analysis floor), the budget
+        peak reservation, residual-leak bytes and the per-segment
+        memory table — empty for runs without the plane armed."""
+        mem = self.memory()
+        out: Dict[str, Any] = {}
+        mws = mem.get("hbm_measured_working_set") \
+            or self.metrics.get("exec_hbm_bytes")
+        if mws:
+            out["measured_working_set_bytes"] = int(mws)
+        if mem.get("peak_bytes"):
+            out["peak_reservation_bytes"] = int(mem["peak_bytes"])
+        if mem.get("residual_naked_bytes"):
+            out["residual_naked_bytes"] = int(mem["residual_naked_bytes"])
+        if mem.get("hbm_census_skipped"):
+            out["census_skipped"] = int(mem["hbm_census_skipped"])
+        segs = [{k: s[k] for k in ("node", "hbm_bytes", "hbm_peak_bytes",
+                                   "hbm_resident_pre") if k in s}
+                for s in self.segments() if s.get("hbm_peak_bytes")]
+        if segs:
+            out["segments"] = segs
+        return out
+
+    def hbm_timeline(self) -> List[Dict[str, Any]]:
+        """The per-query HBM timeline (reserve/release/spill/OOM
+        watermarks + segment brackets with node attribution) embedded
+        in the event-log meta by the memattr recorder."""
+        tl = self.meta.get("hbm_timeline")
+        return list(tl) if isinstance(tl, list) else []
+
     def incidents(self) -> Dict[str, int]:
         """Instant-event histogram: oom_retry / batch_split / spill /
         whole_plan_fallback / semaphore_wait counts."""
@@ -309,6 +342,12 @@ class QueryProfile:
         mesh = self.mesh_timeline()
         if mesh["exchanges"] or mesh["skew_splits"]:
             out["mesh_timeline"] = mesh
+        hbm = self.hbm()
+        if hbm:
+            out["hbm"] = hbm
+        tl = self.hbm_timeline()
+        if tl:
+            out["hbm_timeline"] = tl
         if self.registry:
             out["registry"] = self.registry
         if self.truncated:
@@ -340,6 +379,18 @@ class QueryProfile:
             pct = self.attributed_device_pct()
             if pct is not None:
                 out["attributed_device_pct"] = round(pct * 100, 1)
+        hbm = self.hbm()
+        if hbm.get("peak_reservation_bytes"):
+            # per-query HBM fields bench.py lifts into BENCH records so
+            # check_regression.py can gate HBM-peak regressions
+            out["hbm_peak_bytes"] = max(
+                hbm["peak_reservation_bytes"],
+                hbm.get("measured_working_set_bytes", 0))
+        elif hbm.get("measured_working_set_bytes"):
+            out["hbm_peak_bytes"] = hbm["measured_working_set_bytes"]
+        if hbm.get("measured_working_set_bytes"):
+            out["hbm_measured_working_set"] = \
+                hbm["measured_working_set_bytes"]
         return out
 
     def render(self) -> str:
@@ -400,6 +451,42 @@ class QueryProfile:
                     f"collective={ex.get('collective_ms_total', 0)}ms")
             if mesh["skew_splits"]:
                 lines.append(f"  skew splits: {len(mesh['skew_splits'])}")
+        hbm = self.hbm()
+        if hbm:
+            lines.append("-- hbm (memory attribution) --")
+            if hbm.get("measured_working_set_bytes"):
+                lines.append(f"  measured working set    "
+                             f"{hbm['measured_working_set_bytes']} bytes")
+            if hbm.get("peak_reservation_bytes"):
+                lines.append(f"  peak budget reservation "
+                             f"{hbm['peak_reservation_bytes']} bytes")
+            if hbm.get("residual_naked_bytes"):
+                lines.append(f"  ! RESIDUAL LEAK         "
+                             f"{hbm['residual_naked_bytes']} bytes of "
+                             f"naked reservations at query end")
+            if hbm.get("census_skipped"):
+                lines.append(f"  (census samples skipped: "
+                             f"{hbm['census_skipped']})")
+            for sg in hbm.get("segments", [])[:10]:
+                lines.append(
+                    f"  {sg['node']:<32} hbm_peak="
+                    f"{sg.get('hbm_peak_bytes', 0)} "
+                    f"analysis={sg.get('hbm_bytes', 0)} "
+                    f"resident_pre={sg.get('hbm_resident_pre', 0)}")
+            tl = self.hbm_timeline()
+            if tl:
+                by_ev: Dict[str, int] = {}
+                for e in tl:
+                    by_ev[e.get("ev", "?")] = by_ev.get(
+                        e.get("ev", "?"), 0) + 1
+                peak_ev = max(tl, key=lambda e: e.get("live", 0))
+                lines.append(
+                    f"  timeline: {len(tl)} events ("
+                    + ", ".join(f"{k}={v}" for k, v in sorted(by_ev.items()))
+                    + f"); watermark peak {peak_ev.get('live', 0)} bytes"
+                    + (f" at t={peak_ev.get('t_ms', 0)}ms"
+                       f" node={peak_ev.get('node')}"
+                       if peak_ev.get("node") else ""))
         dm = self.data_movement()
         if dm:
             lines.append("-- data movement --")

@@ -534,6 +534,35 @@ SEGMENT_ROWS = REGISTRY.counter(
     "spark.rapids.tpu.profile.segments is on.",
     ("segment",))
 
+SEGMENT_HBM_PEAK = REGISTRY.histogram(
+    "tpu_segment_hbm_peak_bytes",
+    "Measured HBM working set per compiled plan segment: the larger "
+    "of the program's XLA memory_analysis() bytes (arguments + output "
+    "+ temp + generated code) and the budget peak delta observed "
+    "across its dispatch window, log2 buckets, labeled by the "
+    "segment's root operator class — populated only when "
+    "spark.rapids.tpu.profile.segments is on (the memory-attribution "
+    "plane, obs/memattr.py).",
+    ("segment",))
+
+HBM_RESIDUAL = REGISTRY.counter(
+    "tpu_hbm_residual_bytes",
+    "Naked (directly reserved, non-Spillable) budget bytes still live "
+    "at query end — the leak check (obs/memattr.py): every completed "
+    "query whose direct reserve/release pairs did not balance adds "
+    "its residual here and flags memory.residual_naked_bytes in the "
+    "profile.  Should stay 0.")
+
+HBM_PREDICTION_ERROR = REGISTRY.histogram(
+    "tpu_hbm_prediction_error_ratio",
+    "Working-set-prediction calibration of the admission oracle: one "
+    "observation per executed query that carried an admission-time "
+    "working_set_bytes prediction, of max(predicted, measured) / "
+    "min(predicted, measured) HBM bytes (>= 1; 1 = perfect), log2 "
+    "buckets, labeled by estimate basis — the reservation-vs-actual "
+    "curve scripts/history_report.py renders offline.",
+    ("basis",))
+
 SERVING_QUEUE_DEPTH = REGISTRY.gauge(
     "tpu_serving_queue_depth",
     "Admitted-but-unfinished queries in the ServingRuntime (the bounded "

@@ -445,9 +445,21 @@ class RaggedExchange:
         slots = rounds * self.nparts * q
         pre = int(slots * logical_row) * self.nparts
         post = int(slots * wire_row) * self.nparts
+        # per-device HBM footprints of the exchange machinery itself —
+        # the mesh half of the memory-attribution timeline: the staged
+        # send slab one round holds (wire widths, double-buffered so up
+        # to 2x live) and the receive buffers that persist across every
+        # round (decoded lane widths at recv_cap)
+        slab_bytes = int(self.nparts * q * wire_row)
+        decoded_row = sum(np.dtype(s[1]).itemsize
+                          if s[0] == RAW and s[1] != "bool" else 1
+                          for s in st.plan) + 1       # + live bool
+        recv_buffer_bytes = int(self.nparts * st.recv_cap * decoded_row)
         self.last_stats = {"rounds": rounds, "quota": q,
                            "wire_pre": pre, "wire_post": post,
-                           "recv_cap": st.recv_cap}
+                           "recv_cap": st.recv_cap,
+                           "slab_bytes": slab_bytes,
+                           "recv_buffer_bytes": recv_buffer_bytes}
         EXCHANGE_WIRE_PRE.inc(pre)
         EXCHANGE_WIRE_POST.inc(post)
         EXCHANGE_ROUNDS.observe(rounds)
@@ -457,7 +469,14 @@ class RaggedExchange:
         tr.instant("ici_exchange", "shuffle", rounds=rounds, quota=q,
                    bytes=post, bytes_pre_compress=pre,
                    recv_cap=st.recv_cap,
+                   slab_bytes=slab_bytes,
+                   recv_buffer_bytes=recv_buffer_bytes,
                    arrivals=getattr(st, "arrivals", None))
+        from ..obs.memattr import get_active_recorder
+        rec = get_active_recorder()
+        if rec is not None:
+            rec.on_external("exchange", bytes=recv_buffer_bytes,
+                            slab_bytes=slab_bytes, rounds=rounds)
 
     def run_rounds(self, st: _PlanState):
         """Execute the planned rounds: staging for round r+1 overlaps

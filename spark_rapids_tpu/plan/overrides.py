@@ -1273,6 +1273,13 @@ class PhysicalQuery:
             # chaos: conf-less sites (mesh exchange collectives) fire on
             # the active injector for this query's scope
             faults.set_active(faults.get_injector(ctx.conf))
+            # memory-attribution recorder (obs/memattr.py): armed only
+            # under profile.segments + profile.memory; set active so
+            # the lazily-created MemoryBudget binds its watermark
+            # events to THIS query's HBM timeline
+            from ..obs import memattr
+            ctx._memattr = memattr.make_recorder(ctx.conf)
+            memattr.set_active(ctx._memattr)
             if tracer.enabled:
                 tracer.metrics = ctx.metrics
                 tracer.meta["fallbacks"] = self.fallback_reasons()
@@ -1315,6 +1322,10 @@ class PhysicalQuery:
                 if ctx._budget is not None:
                     for k, v in ctx.budget.metrics.items():
                         ctx.metrics[f"memory.{k}"] = v
+                # measured working set + HBM timeline + the residual
+                # naked-reservation leak check (exec/metrics.py)
+                from ..exec.metrics import finish_memattr
+                finish_memattr(ctx)
                 publish_registry(ctx)
             except BaseException:
                 status = "error"
@@ -1322,6 +1333,7 @@ class PhysicalQuery:
             finally:
                 set_active(NULL_TRACER)
                 faults.set_active(faults.NULL_INJECTOR)
+                memattr.set_active(None)
                 if tracer.enabled:
                     tracer.finish(ctx.metrics)
                     log_dir = str(ctx.conf.get(EVENT_LOG_DIR) or "")

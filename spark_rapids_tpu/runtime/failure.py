@@ -139,6 +139,19 @@ def write_crash_dump(conf: TpuConf, exc: BaseException,
         budget = getattr(ctx, "_budget", None)
         if budget is not None:
             info["memory_budget"] = dict(getattr(budget, "metrics", {}))
+            info["memory_budget"]["naked_live"] = int(
+                getattr(budget, "naked_live", 0) or 0)
+    # spill/OOM forensics (obs/memattr.py): the HBM-timeline tail —
+    # which node-id ranges owned the memory pressure in the window
+    # before the fault — rides the dump when the plane was armed
+    from ..obs import memattr
+    rec = getattr(ctx, "_memattr", None) if ctx is not None else None
+    if rec is None:
+        rec = memattr.get_active_recorder()
+    if rec is not None:
+        info["hbm_timeline"] = rec.timeline(tail=64)
+        info["hbm_summary"] = rec.summary()
+    info["hbm_census"] = memattr.CENSUS.totals()
     # the injected-fault record: when chaos is armed, a post-mortem must
     # show exactly which synthetic faults fired before the crash
     from .faults import get_active_injector, get_injector

@@ -86,6 +86,17 @@ SITES: Dict[str, str] = {
                "fail work. 'fatal' propagates through the query's "
                "crash-capture scope as a classified FATAL_DEVICE dump "
                "naming the site",
+    "memattr": "memory-attribution census read (obs/memattr.py via "
+               "exec/compiled.py) — fires once per profiled segment "
+               "dispatch when the plane is armed "
+               "(profile.segments + profile.memory). Kind 'ioerror' "
+               "is absorbed at the bracket: that dispatch's HBM "
+               "sample is SKIPPED (memattr_census_skipped metric) and "
+               "the query result is bit-identical — sampling must "
+               "never cost work. 'fatal' propagates through the "
+               "query's crash-capture scope as a classified "
+               "FATAL_DEVICE dump embedding the PARTIAL HBM timeline "
+               "collected up to the fault",
     "kernel": "Pallas kernel-tier dispatch (ops/pallas/) and encoded-"
               "execution dispatch (ops/encodings.py) — fires each "
               "time an operator elects a hand-written kernel or a "

@@ -33,8 +33,7 @@ from ..columnar.device import DeviceBatch, to_device, to_host
 from ..columnar.host import HostBatch
 from ..config import (HBM_BUDGET_BYTES, HBM_BUDGET_FRACTION,
                       HOST_SPILL_LIMIT_BYTES, TEST_INJECT_RETRY_OOM, TpuConf)
-from ..obs.registry import (HBM_LIVE_BYTES, HBM_PEAK_BYTES,
-                            HOST_SPILL_LIVE_BYTES, RELEASE_UNDERFLOWS,
+from ..obs.registry import (HOST_SPILL_LIVE_BYTES, RELEASE_UNDERFLOWS,
                             SPILL_BATCHES, SPILL_BYTES, SPILL_MS)
 
 
@@ -160,6 +159,8 @@ class MemoryBudget:
         self.host_limit = conf.get(HOST_SPILL_LIMIT_BYTES)
         self.conf = conf
         self.live = 0                 # bytes of registered device batches
+        self.naked_live = 0           # direct (non-Spillable) reservations
+                                      # still live — the leak-check basis
         self.host_live = 0
         self._lock = _YieldableRLock()
         self._spillables: "OrderedDict[int, Spillable]" = OrderedDict()
@@ -182,6 +183,15 @@ class MemoryBudget:
                         "release_underflow": 0, "io_retries": 0,
                         "attempt_rollback_bytes": 0}
         self._device = _device_label()
+        # memory-attribution plane (obs/memattr.py): the process census
+        # sums live bytes across ALL budgets (the global gauges — a
+        # serving tenant's bytes never inflate another query's peak),
+        # and the active per-query recorder, when profiling armed one,
+        # receives watermark events for the HBM timeline
+        from ..obs.memattr import CENSUS, get_active_recorder
+        self._attr = get_active_recorder()
+        self._census_cell = CENSUS.register(self)
+        self._census = CENSUS
 
     # -- registration ------------------------------------------------------
     def register(self, sp: "Spillable") -> int:
@@ -229,6 +239,10 @@ class MemoryBudget:
         if leftover > 0:
             self.release(leftover, _tracked=False)
             with self._lock:
+                # the rolled-back bytes WERE naked (tracked at reserve):
+                # the untracked release above did not retire them from
+                # the leak-check counter, so do it here
+                self.naked_live = max(0, self.naked_live - leftover)
                 self.metrics["attempt_rollback_bytes"] += leftover
                 # reserve() counted these bytes into every scope on the
                 # stack, so the enclosing rungs of a nested ladder must
@@ -252,24 +266,41 @@ class MemoryBudget:
             if self.limit:
                 while self.live + nbytes > self.limit:
                     if not self._spill_one():
+                        if self._attr is not None:
+                            # forensics: who owned the pressure (the
+                            # open segment bracket, if any) and what
+                            # the watermark was at the OOM instant
+                            self._attr.on_budget_event(
+                                "oom", nbytes, self.live, self.naked_live)
                         raise TpuRetryOOM(
                             f"HBM budget exhausted: live={self.live} "
                             f"+ {nbytes} > limit={self.limit} with "
                             "nothing left to spill")
             self.live += nbytes
             if _tracked:
+                self.naked_live += nbytes
                 for scope in self._scopes():
                     scope.naked += nbytes
-            # device-memory high-water (the profile's peak-usage line)
+            # device-memory high-water (the profile's peak-usage line);
+            # PER-QUERY by construction — the process-wide view is the
+            # census sum below, kept separate so concurrent tenants
+            # never inflate each other's reported peaks
             if self.live > self.metrics["peak_bytes"]:
                 self.metrics["peak_bytes"] = self.live
-            HBM_LIVE_BYTES.set(self.live, device=self._device)
-            HBM_PEAK_BYTES.max(self.live, device=self._device)
+            self._census_cell[0] = self.live
+            self._census.adjust(nbytes, self._device)
+            if self._attr is not None:
+                self._attr.on_budget_event("reserve", nbytes, self.live,
+                                           self.naked_live)
 
     def release(self, nbytes: int, _tracked: bool = True):
         with self._lock:
+            prev = self.live
             self.live -= nbytes
             if _tracked:
+                self.naked_live -= nbytes
+                if self.naked_live < 0:
+                    self.naked_live = 0
                 for scope in self._scopes():
                     scope.naked -= nbytes
             if self.live < 0:
@@ -278,7 +309,11 @@ class MemoryBudget:
                 self.metrics["release_underflow"] += 1
                 RELEASE_UNDERFLOWS.inc()
                 self.live = 0
-            HBM_LIVE_BYTES.set(self.live, device=self._device)
+            self._census_cell[0] = self.live
+            self._census.adjust(self.live - prev, self._device)
+            if self._attr is not None:
+                self._attr.on_budget_event("release", nbytes, self.live,
+                                           self.naked_live)
 
     def _spill_one(self) -> bool:
         for sp in self._spillables.values():
@@ -391,6 +426,12 @@ class Spillable:
             from ..obs.tracer import get_active
             get_active().instant("spill", "runtime", tier="host",
                                  bytes=self._nbytes)
+            if self._budget._attr is not None:
+                # forensics: the spill instant on the HBM timeline,
+                # attributed to the open segment bracket (if any)
+                self._budget._attr.on_budget_event(
+                    "spill", self._nbytes, self._budget.live,
+                    self._budget.naked_live)
             # reserve BEFORE publishing the host tier: host_reserve may
             # drive _disk_one(), and finding THIS batch on_host would
             # release bytes that were never added (host-budget underflow)

@@ -355,10 +355,19 @@ class ServingRuntime:
             self._compile(q, ticket)
         with self._phase("upload", ticket):
             est_bytes = self._upload(q, ticket)
-        if pred and pred["basis"] == "exact_history":
-            # a measured working set beats the source-bytes heuristic:
-            # admission schedules against the LARGER of the two (the
-            # oracle can tighten later once calibration earns trust)
+        if pred and pred.get("ws_basis") == "measured" and \
+                int(pred.get("working_set_bytes") or 0) > 0:
+            # MEASURED-basis working set (memattr query peaks / XLA
+            # memory_analysis floors folded through the history plane):
+            # it REPLACES the admitWorkingSetFactor x source-bytes
+            # heuristic — the gate tightens to what the structure
+            # actually held, so more queries overlap without betting
+            # on the OOM ladder
+            est_bytes = int(pred["working_set_bytes"])
+        elif pred and pred["basis"] == "exact_history":
+            # reserved-basis history: schedule against the LARGER of
+            # the heuristic and the recorded peak (no measured data
+            # yet — over-reserve rather than over-commit)
             est_bytes = max(est_bytes,
                             int(pred.get("working_set_bytes") or 0))
         with self._device_grant(ticket, est_bytes):
@@ -375,6 +384,8 @@ class ServingRuntime:
                     ctx.metrics["predicted.basis"] = pred["basis"]
                     ctx.metrics["predicted.working_set_bytes"] = \
                         int(pred.get("working_set_bytes") or 0)
+                    ctx.metrics["predicted.ws_basis"] = \
+                        str(pred.get("ws_basis") or "?")
                     ctx.metrics["predicted.confidence"] = \
                         pred.get("confidence")
                 t0 = time.perf_counter()

@@ -806,6 +806,61 @@ def test_history_fatal_classified_dump(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# memattr site: memory-attribution sampling must never cost work
+# ---------------------------------------------------------------------------
+
+#: profiled whole-plan conf — the memattr census fires per segment
+#: dispatch only when the plane is armed
+MEMATTR_ON = {"spark.rapids.tpu.sql.compile.wholePlan": "ON",
+              "spark.rapids.tpu.profile.segments": "true"}
+
+
+def _memattr_build(tbl):
+    return lambda s: s.from_arrow(tbl).filter(
+        E.GreaterThan(col("v"), E.Literal(0.0))).sort(("v", True, True))
+
+
+def test_memattr_ioerror_skips_sample_query_bit_identical():
+    """`memattr:ioerror:always`: every segment census read fails — the
+    HBM sample is SKIPPED (memattr_census_skipped) and the query
+    result is BIT-IDENTICAL to the clean profiled run: memory
+    sampling must never cost work."""
+    tbl = sort_tbl(2_000, seed=35)
+    clean, _s, _df = run_query(_memattr_build(tbl), MEMATTR_ON)
+    chaos, s, df = run_query(_memattr_build(tbl), MEMATTR_ON,
+                             faults="memattr:ioerror:always")
+    assert_identical(clean, chaos)
+    assert "memattr" in fired_sites(s)
+    m = df.metrics()
+    assert m.get("memattr_census_skipped", 0) >= 1
+    # skipped means skipped: no segment hbm attribution recorded
+    assert not any(k.endswith(".hbm_peak_bytes") for k in m), sorted(m)
+
+
+def test_memattr_fatal_dump_embeds_partial_timeline(tmp_path):
+    """`memattr:fatal:nth=1`: a fatal on the census read surfaces as a
+    classified FATAL_DEVICE crash dump that embeds the PARTIAL HBM
+    timeline collected up to the fault (the forensics contract)."""
+    tbl = sort_tbl(1_500, seed=37)
+    with pytest.raises(FatalDeviceError) as ei:
+        run_query(
+            _memattr_build(tbl),
+            {**MEMATTR_ON,
+             "spark.rapids.tpu.coredump.path": str(tmp_path)},
+            faults="memattr:fatal:nth=1")
+    assert classify(ei.value) == FATAL_DEVICE
+    dump = json.load(open(ei.value.dump_path))
+    rec = dump["injected_faults"]
+    assert rec and rec[0]["site"] == "memattr" and \
+        rec[0]["kind"] == "fatal"
+    # the partial timeline rides the dump (at least the start marker)
+    assert isinstance(dump.get("hbm_timeline"), list)
+    assert dump["hbm_timeline"] and \
+        dump["hbm_timeline"][0]["ev"] == "start"
+    assert "hbm_census" in dump
+
+
+# ---------------------------------------------------------------------------
 # coverage lint: every registered site is exercised by this file
 # ---------------------------------------------------------------------------
 

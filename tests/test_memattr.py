@@ -1,0 +1,439 @@
+"""Device-memory attribution plane (ISSUE 14): measured per-segment
+working sets, the HBM timeline, reservation-vs-actual calibration and
+spill/OOM forensics (obs/memattr.py + the instrumentation threaded
+through runtime/memory.py, exec/compiled.py, serving/runtime.py)."""
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec.plan import ExecContext
+from spark_rapids_tpu.plan.aggregates import Count, Sum
+from spark_rapids_tpu.session import TpuSession, col, lit
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WHOLE = {"spark.rapids.tpu.sql.compile.wholePlan": "ON"}
+PROF = {**WHOLE, "spark.rapids.tpu.profile.segments": "true"}
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def tpch_tables():
+    from spark_rapids_tpu import tpch
+    return tpch.gen_tables(scale=0.003)
+
+
+def _tbl(n=4000, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({"k": pa.array(rng.integers(0, 8, n), pa.int64()),
+                     "v": pa.array(rng.standard_normal(n))})
+
+
+def _agg_df(s, n=4000):
+    return (s.from_arrow(_tbl(n)).filter(col("v") > lit(0.0))
+            .group_by("k").agg((Sum(col("v")), "sv"), (Count(None), "c")))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar: q3/q18 per-segment hbm= attribution >= 90%
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("qname", ["q3", "q18"])
+def test_tpch_hbm_attribution_bar(qname, tpch_tables):
+    """EXPLAIN ANALYZE shows per-segment `hbm=` attribution whose
+    summed segment peaks account for >=90% of the query's measured
+    peak (the ISSUE 14 acceptance criterion, on the spill-leg
+    queries)."""
+    from spark_rapids_tpu import tpch
+    s = TpuSession(WHOLE)
+    rep = tpch.QUERIES[qname](s, tpch_tables).explain_analyze()
+    assert rep.hbm.get("measured_peak_bytes", 0) > 0, rep.hbm
+    assert rep.hbm["segment_sum_bytes"] > 0
+    assert rep.hbm["attributed_pct"] >= 90.0, rep.hbm
+    text = rep.render()
+    assert "hbm=" in text and "<-- hbm peak" in text
+    assert "hbm peak" in text                    # the head line
+    with_hbm = [sg for sg in rep.segments if sg.get("hbm_peak_bytes")]
+    assert len(with_hbm) >= 2, rep.segments      # re-split plans
+
+
+def test_measured_vs_memory_analysis_consistency(tpch_tables):
+    """Per-segment measured working sets are grounded in the program's
+    XLA memory_analysis: every named segment carries analysis bytes,
+    the peak is never below them, and the analysis covers at least the
+    segment's own measured output bytes (output is part of the
+    program's footprint)."""
+    from spark_rapids_tpu import tpch
+    s = TpuSession(WHOLE)
+    rep = tpch.QUERIES["q3"](s, tpch_tables).explain_analyze()
+    with_hbm = [sg for sg in rep.segments if sg.get("hbm_peak_bytes")]
+    assert with_hbm
+    for sg in with_hbm:
+        assert sg["hbm_bytes"] > 0, sg
+        assert sg["hbm_peak_bytes"] >= sg["hbm_bytes"], sg
+        if sg.get("out_bytes"):
+            assert sg["hbm_bytes"] >= sg["out_bytes"], sg
+    # per-segment peaks sum to (at least) the query peak within the
+    # 90% tolerance — the segment table explains the query number
+    total = sum(sg["hbm_peak_bytes"] for sg in with_hbm)
+    assert total >= 0.9 * rep.hbm["measured_peak_bytes"], rep.hbm
+
+
+def test_segment_hbm_registry_family():
+    s = TpuSession(PROF)
+    _agg_df(s).collect()
+    from spark_rapids_tpu.obs.registry import REGISTRY
+    fam = REGISTRY.get("tpu_segment_hbm_peak_bytes")
+    assert fam is not None and fam.series()
+    assert any(s_["sum"] > 0 for s_ in fam.series())
+
+
+# ---------------------------------------------------------------------------
+# census + per-query peak isolation (the serving-concurrency fix)
+# ---------------------------------------------------------------------------
+
+def test_two_tenant_peak_isolation():
+    """Two budgets reserving CONCURRENTLY (the serving shape): each
+    query's reported peak counts only its OWN bytes, while the process
+    census — the global gauge — sees the sum."""
+    from spark_rapids_tpu.obs.memattr import CENSUS
+    from spark_rapids_tpu.runtime.memory import MemoryBudget
+    conf = TpuConf({})
+    b1, b2 = MemoryBudget(conf), MemoryBudget(conf)
+    c0 = CENSUS.totals()["live_bytes"]
+    barrier = threading.Barrier(2)
+    errs = []
+
+    def tenant(budget, nbytes):
+        try:
+            budget.reserve(nbytes, _tracked=False)
+            barrier.wait(timeout=30)           # both live at once
+            budget.release(nbytes, _tracked=False)
+        except Exception as e:                 # noqa: BLE001
+            errs.append(e)
+
+    t1 = threading.Thread(target=tenant, args=(b1, 1 << 20))
+    t2 = threading.Thread(target=tenant, args=(b2, 2 << 20))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert not errs, errs
+    # per-query peaks are ISOLATED: the concurrent tenant's bytes never
+    # inflate the other budget's reported peak
+    assert b1.metrics["peak_bytes"] == 1 << 20
+    assert b2.metrics["peak_bytes"] == 2 << 20
+    # the census saw both at once (the global high-water is the sum)
+    assert CENSUS.totals()["peak_bytes"] >= c0 + (3 << 20)
+    assert CENSUS.totals()["live_bytes"] == c0
+
+
+def test_census_feeds_global_gauges():
+    from spark_rapids_tpu.obs.memattr import CENSUS
+    from spark_rapids_tpu.obs.registry import HBM_LIVE_BYTES
+    from spark_rapids_tpu.runtime.memory import (MemoryBudget,
+                                                 _device_label)
+    b = MemoryBudget(TpuConf({}))
+    b.reserve(12345, _tracked=False)
+    assert HBM_LIVE_BYTES.value(device=_device_label()) == \
+        CENSUS.totals()["live_bytes"]
+    b.release(12345, _tracked=False)
+    assert HBM_LIVE_BYTES.value(device=_device_label()) == \
+        CENSUS.totals()["live_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# history round trip -> measured-basis admission (the calibration loop)
+# ---------------------------------------------------------------------------
+
+def test_history_round_trip_measured_working_set(tmp_path):
+    """Two runs feed the history store a MEASURED working set; the next
+    estimate serves it (ws_basis=measured), and a serving submit's
+    ticket prediction carries the basis — the acceptance assertion
+    'admission uses a measured-basis estimate after one warm run'."""
+    s = TpuSession({**WHOLE,
+                    "spark.rapids.tpu.history.dir": str(tmp_path)})
+    df = _agg_df(s, 3000)
+    q = df.physical()
+    q.collect(ExecContext(s.conf))             # cold (recorded)
+    q.collect(ExecContext(s.conf))             # warm (recorded)
+    est = s.cost_estimate(df)
+    assert est["basis"] == "exact_history"
+    assert est["ws_basis"] == "measured"
+    assert est["working_set_bytes"] > 0
+    # sanity: the measured working set is grounded in what the run
+    # actually dispatched, not the source-bytes heuristic
+    ctx = ExecContext(s.conf)
+    q.collect(ctx)
+    measured = ctx.metrics.get("exec_hbm_bytes", 0)
+    assert measured > 0
+    ratio = max(est["working_set_bytes"], measured) / \
+        min(est["working_set_bytes"], measured)
+    assert ratio < 2.0, (est, measured)
+    # serving admission: the ticket prediction asserts the basis
+    rt = s.serving()
+    try:
+        ticket = rt.submit(df)
+        ticket.result()
+        assert ticket.predicted["ws_basis"] == "measured"
+        assert ticket.predicted["working_set_bytes"] > 0
+    finally:
+        rt.close()
+    s.close()
+
+
+def test_ws_calibration_curve_closes_loop(tmp_path):
+    """A serving-predicted run records predicted-vs-measured working
+    sets: the store's reservation-vs-actual curve and the
+    tpu_hbm_prediction_error_ratio family both populate."""
+    from spark_rapids_tpu.obs.history import get_store
+    from spark_rapids_tpu.obs.registry import HBM_PREDICTION_ERROR
+    before = sum(s_["count"] for s_ in HBM_PREDICTION_ERROR.series())
+    s = TpuSession({**WHOLE,
+                    "spark.rapids.tpu.history.dir": str(tmp_path)})
+    df = _agg_df(s, 2500)
+    q = df.physical()
+    q.collect(ExecContext(s.conf))             # seed the history
+    rt = s.serving()
+    try:
+        rt.submit(df).result()                 # predicted + recorded
+    finally:
+        rt.close()
+    store = get_store(s.conf)
+    ws_cal = store.ws_calibration()
+    assert ws_cal and any(c["n"] >= 1 for c in ws_cal.values()), ws_cal
+    assert sum(s_["count"]
+               for s_ in HBM_PREDICTION_ERROR.series()) > before
+    # the report renders the curve
+    data = _load_script("history_report").report_data(store)
+    assert data["ws_calibration"] == ws_cal
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# forensics: leak check, timeline in event logs / crash surface
+# ---------------------------------------------------------------------------
+
+def test_leak_check_fires_on_leaked_reservation():
+    """An intentionally leaked naked reservation is flagged at query
+    end: memory.residual_naked_bytes in the profile and
+    tpu_hbm_residual_bytes in the registry."""
+    from spark_rapids_tpu.obs.registry import HBM_RESIDUAL
+    before = HBM_RESIDUAL.value() or 0
+    s = TpuSession({"spark.rapids.tpu.sql.compile.wholePlan": "OFF"})
+    q = _agg_df(s, 1500).physical()
+    orig = q.root.execute
+
+    def leaky(ctx):
+        ctx.budget.reserve(12345)              # tracked, never released
+        yield from orig(ctx)
+
+    q.root.execute = leaky
+    ctx = ExecContext(s.conf)
+    q.collect(ctx)
+    assert ctx.metrics.get("memory.residual_naked_bytes") == 12345
+    assert (HBM_RESIDUAL.value() or 0) - before == 12345
+
+
+def test_clean_query_leaves_no_residual():
+    s = TpuSession({"spark.rapids.tpu.sql.compile.wholePlan": "OFF"})
+    q = _agg_df(s, 1500).physical()
+    ctx = ExecContext(s.conf)
+    q.collect(ctx)
+    assert "memory.residual_naked_bytes" not in ctx.metrics
+    if ctx._budget is not None:
+        assert ctx._budget.naked_live == 0
+
+
+def test_hbm_timeline_rides_event_log(tmp_path):
+    """The HBM timeline serializes into the event log and the offline
+    profile renders the memory-attribution section from it."""
+    s = TpuSession({**PROF,
+                    "spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    _agg_df(s).collect()
+    from spark_rapids_tpu.obs.profile import QueryProfile
+    logs = sorted(str(p) for p in tmp_path.glob("*.jsonl"))
+    assert logs
+    prof = QueryProfile.from_event_log(logs[0])
+    tl = prof.hbm_timeline()
+    assert tl and tl[0]["ev"] == "start"
+    assert any(e["ev"] == "segment_close" for e in tl)
+    hbm = prof.hbm()
+    assert hbm.get("measured_working_set_bytes", 0) > 0
+    assert hbm.get("segments"), hbm
+    text = prof.render()
+    assert "hbm (memory attribution)" in text
+    assert "timeline:" in text
+    # scripts/profile_report.py renders the same log without error
+    assert _load_script("profile_report").main([logs[0]]) == 0
+
+
+def test_spill_and_oom_events_attributed():
+    """Budget pressure under the memattr plane lands on the timeline:
+    spills and the OOM instant carry the watermark (and the owning
+    segment bracket when one is open)."""
+    from spark_rapids_tpu.obs.memattr import (MemAttrRecorder,
+                                              get_active_recorder,
+                                              set_active)
+    from spark_rapids_tpu.columnar.device import to_device
+    from spark_rapids_tpu.columnar.host import HostBatch
+    from spark_rapids_tpu.runtime.memory import (MemoryBudget, Spillable,
+                                                 TpuRetryOOM)
+    rec = MemAttrRecorder()
+    set_active(rec)
+    try:
+        assert get_active_recorder() is rec
+        conf = TpuConf({"spark.rapids.tpu.memory.tpu.budgetBytes":
+                        1 << 16})
+        budget = MemoryBudget(conf)
+        rb = pa.record_batch([pa.array(np.arange(4096, dtype=np.int64))],
+                             names=["x"])
+        sp = Spillable(to_device(HostBatch(rb), conf), budget)
+        rec.open_segment("HashJoinExec#2", budget.live)
+        with pytest.raises(TpuRetryOOM):
+            budget.reserve(1 << 20)            # cannot fit: spill + OOM
+        rec.close_segment("HashJoinExec#2", 0, budget.live)
+        evs = rec.timeline()
+        spill = [e for e in evs if e["ev"] == "spill"]
+        oom = [e for e in evs if e["ev"] == "oom"]
+        assert spill and oom
+        # the forensic question: which node owned the pressure
+        assert oom[0]["node"] == "HashJoinExec#2"
+        assert spill[0]["node"] == "HashJoinExec#2"
+        sp.close()
+    finally:
+        set_active(None)
+
+
+def test_exchange_footprints_on_timeline(eight_devices):
+    """The mesh exchange reports its per-round slab and recv-buffer
+    HBM footprints into the ici_exchange instant (the mesh half of the
+    memory timeline)."""
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu import types as t
+    from spark_rapids_tpu.obs.tracer import (NULL_TRACER, QueryTracer,
+                                             set_active)
+    from spark_rapids_tpu.ops import groupby as G
+    from spark_rapids_tpu.parallel.exchange import \
+        distributed_groupby_ragged
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(8)
+    cap = 256
+    n = 8 * cap
+    rng = np.random.default_rng(0)
+    run, shard = distributed_groupby_ragged(
+        mesh, t.LONG, [G.AggSpec(G.SUM, 0, t.LONG)], cap)
+    tr = QueryTracer(1)
+    set_active(tr)
+    try:
+        (kd, _), _outs, _ng = run(
+            jax.device_put(jnp.asarray(
+                rng.integers(0, 7, n).astype(np.int64)), shard),
+            jax.device_put(jnp.ones(n, bool), shard),
+            [jax.device_put(jnp.asarray(
+                rng.integers(-5, 5, n).astype(np.int64)), shard)],
+            [jax.device_put(jnp.ones(n, bool), shard)])
+        jax.block_until_ready(kd)
+    finally:
+        set_active(NULL_TRACER)
+    ex = [e for e in tr.events if e.name == "ici_exchange"]
+    assert ex
+    assert ex[0].attrs["slab_bytes"] > 0
+    assert ex[0].attrs["recv_buffer_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# disabled-path inertness + bench/gate satellites
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_one_conf_check_per_dispatch():
+    """Default conf: the compiled execute path consults exactly ONE
+    conf entry (profile.segments) per dispatch — no census, no
+    recorder, no hbm metrics."""
+    s = TpuSession(WHOLE)
+    q = _agg_df(s).physical()
+    q.collect(ExecContext(s.conf))             # warm the program
+    plan = q._compiled_plan
+    from spark_rapids_tpu.exec.compiled import CompiledPlan
+    assert isinstance(plan, CompiledPlan)
+    calls = []
+    orig_get = TpuConf.get
+
+    def counting(self, entry):
+        if entry.key == "spark.rapids.tpu.profile.segments":
+            calls.append(entry.key)
+        return orig_get(self, entry)
+
+    TpuConf.get = counting
+    try:
+        ctx = ExecContext(s.conf)
+        plan.execute(ctx)
+    finally:
+        TpuConf.get = orig_get
+    assert len(calls) == 1, calls
+    assert getattr(ctx, "_memattr", None) is None
+    assert not any(".hbm" in k or k.startswith("memory.hbm")
+                   for k in ctx.metrics), sorted(ctx.metrics)
+
+
+def test_bench_fields_and_hbm_gate(tmp_path):
+    """Bench records carrying per-query hbm_peak_bytes gate >25%
+    HBM regressions (same backend-separation rule) and diff as their
+    own profile_diff family."""
+    gate = _load_script("check_regression")
+
+    def doc(hbm, backend="cpu"):
+        return {"tpch_suite_queries": {
+            "q3": {"device_ms_net": 100.0, "hbm_peak_bytes": hbm}},
+            "backend": backend}
+    base = tmp_path / "base.json"
+    cur = tmp_path / "cur.json"
+    base.write_text(json.dumps(doc(4 << 20)))
+    cur.write_text(json.dumps(doc(16 << 20)))
+    assert gate.main(["--current", str(cur), str(base)]) == 1
+    # within threshold: green
+    cur.write_text(json.dumps(doc(int(4.2 * (1 << 20)))))
+    assert gate.main(["--current", str(cur), str(base)]) == 0
+    # other-backend baselines never cross-gate
+    cur.write_text(json.dumps(doc(16 << 20, backend="tpu")))
+    assert gate.main(["--current", str(cur), str(base)]) == 0
+    # extractor shape
+    assert gate.extract_hbm(doc(123)) == {"q3": 123.0}
+
+
+def test_profile_summary_embeds_hbm_fields():
+    """QueryProfile.summary() (what bench embeds per query) carries
+    the hbm_peak_bytes / hbm_measured_working_set fields."""
+    s = TpuSession({**PROF, "spark.rapids.tpu.trace.enabled": "true"})
+    q = _agg_df(s).physical()
+    ctx = ExecContext(s.conf)
+    q.collect(ctx)
+    from spark_rapids_tpu.obs.profile import QueryProfile
+    summ = QueryProfile.from_context(ctx).summary()
+    assert summ.get("hbm_measured_working_set", 0) > 0, summ
+    assert summ.get("hbm_peak_bytes", 0) >= \
+        summ["hbm_measured_working_set"] * 0  # present
+    assert summ["hbm_peak_bytes"] > 0
+
+
+def test_profile_diff_self_test(capsys):
+    mod = _load_script("profile_diff")
+    assert mod.main(["--self-test"]) == 0
+    assert "self-test OK" in capsys.readouterr().out
+
+
+def test_history_report_self_test(capsys):
+    mod = _load_script("history_report")
+    assert mod.main(["--self-test"]) == 0
+    assert "self-test OK" in capsys.readouterr().out

@@ -327,18 +327,24 @@ def test_metrics_disabled_is_a_noop_plane():
 
 
 def test_hbm_gauges_follow_budget():
+    """The HBM gauges report the process CENSUS — the SUM across all
+    live budgets (obs/memattr.py), so serving tenants' budgets no
+    longer stomp each other's gauge writes — and the high-water
+    sticks."""
+    from spark_rapids_tpu.obs.memattr import CENSUS
     from spark_rapids_tpu.obs.registry import (HBM_LIVE_BYTES,
                                                HBM_PEAK_BYTES)
     from spark_rapids_tpu.runtime.memory import MemoryBudget, _device_label
     conf = TpuConf({"spark.rapids.tpu.memory.tpu.budgetBytes": 1 << 20})
     budget = MemoryBudget(conf)
     dev = _device_label()
+    live0 = CENSUS.totals()["live_bytes"]
     budget.reserve(1000)
-    assert HBM_LIVE_BYTES.value(device=dev) == budget.live
-    assert HBM_PEAK_BYTES.value(device=dev) >= budget.live
+    assert HBM_LIVE_BYTES.value(device=dev) == live0 + 1000
+    assert HBM_PEAK_BYTES.value(device=dev) >= live0 + 1000
     peak = HBM_PEAK_BYTES.value(device=dev)
     budget.release(1000)
-    assert HBM_LIVE_BYTES.value(device=dev) == budget.live
+    assert HBM_LIVE_BYTES.value(device=dev) == live0
     assert HBM_PEAK_BYTES.value(device=dev) == peak   # high-water sticks
 
 
