@@ -731,6 +731,130 @@ def run_encodings():
         "final": True}), flush=True)
 
 
+#: --ooc leg queries: the join+aggregation classes whose working sets
+#: the out-of-core tier must carry (ISSUE 15; --queries overrides)
+OOC_QUERIES = ["q3", "q9", "q18"]
+
+#: HBM budget for the capped leg = measured peak / this divisor (the
+#: budget lands well below the per-operator working sets — a gentler
+#: divisor only pressures the staging spill path, never the
+#: partition-tier gates)
+OOC_CAP_DIVISOR = 16
+
+
+def run_ooc(suite_name: str, scale: float, query_names):
+    """--ooc: memory-capped out-of-core leg (ISSUE 15).  Each query runs
+    once UNCAPPED (the resident baseline and the oracle — its measured
+    budget peak is the working-set reference) and once with the HBM
+    budget forced to peak/OOC_CAP_DIVISOR, floored so a single target
+    batch still fits (a budget below one batch is unsatisfiable by ANY
+    tier).  The capped run must oracle-match, engage the out-of-core
+    tier (`ooc.*` ctx counters / tpu_ooc_* families) and never reach
+    the query-level replay rung.  Emits `ooc_timings_ms` entries
+    ({qN}_capped / {qN}_uncapped, lower = better) that
+    scripts/check_regression.py gates under the `oc:` prefix with the
+    same backend-separation rule as qN device_ms."""
+    import importlib
+    workload = importlib.import_module(f"spark_rapids_tpu.{suite_name}")
+    from spark_rapids_tpu.exec.plan import ExecContext
+    from spark_rapids_tpu.session import TpuSession
+
+    tables = workload.gen_tables(scale=scale)
+    names = [n for n in (query_names or OOC_QUERIES)
+             if n in workload.QUERIES]
+    # the capped leg runs SMALLER batches (scale-aware) so the
+    # unsatisfiable floor — one staged batch must fit the budget —
+    # stays far below the cap; the uncapped baseline keeps the default
+    base = TpuSession(dict(EXTRA_CONF))
+    bsr = base.conf.batch_size_rows
+    rows_max = max(t.num_rows for t in tables.values())
+    bsr_capped = min(bsr, max(4096, rows_max // 64))
+    row_w = max(t.nbytes // max(t.num_rows, 1) for t in tables.values())
+    batch_floor = 2 * bsr_capped * max(row_w, 8)
+    out = {}
+    timings = {}
+    all_match = True
+    for name in names:
+        if left() < 120:
+            print(f"# budget: skipping ooc query {name}", file=sys.stderr)
+            continue
+        # -- uncapped baseline + working-set reference
+        s0 = TpuSession(dict(EXTRA_CONF))
+        df0 = workload.QUERIES[name](s0, tables)
+        t0 = time.perf_counter()
+        oracle = df0.collect()
+        un_ms = (time.perf_counter() - t0) * 1e3
+        m0 = df0.metrics()
+        peak = int(m0.get("memory.peak_bytes") or 0)
+        src = sum(t.nbytes for t in tables.values())
+        cap = max(max(peak, src // 4) // OOC_CAP_DIVISOR, batch_floor)
+        # -- capped run: the OOC tier must carry it
+        s1 = TpuSession({**EXTRA_CONF,
+                         "spark.rapids.tpu.memory.tpu.budgetBytes":
+                             str(cap),
+                         "spark.rapids.tpu.sql.batchSizeRows":
+                             str(bsr_capped)})
+        df1 = workload.QUERIES[name](s1, tables)
+        t0 = time.perf_counter()
+        try:
+            capped = df1.collect()
+            err = None
+        except Exception as e:                       # noqa: BLE001
+            capped, err = None, f"{type(e).__name__}: {e}"[:200]
+        cap_ms = (time.perf_counter() - t0) * 1e3
+        m1 = df1.metrics() if capped is not None else {}
+        ooc = {k[4:]: v for k, v in m1.items() if k.startswith("ooc.")}
+        match = capped is not None and approx_equal(oracle, capped)
+        all_match = all_match and match
+        timings[f"{name}_uncapped"] = round(un_ms, 1)
+        timings[f"{name}_capped"] = round(cap_ms, 1)
+        out[name] = {
+            "uncapped_ms": round(un_ms, 1),
+            "capped_ms": round(cap_ms, 1),
+            "degradation_x": round(cap_ms / un_ms, 2) if un_ms else None,
+            "budget_bytes": cap,
+            "working_set_peak_bytes": peak,
+            "match": match,
+            "error": err,
+            "ooc": ooc,
+            "ooc_engaged": any(k.endswith("_elections") for k in ooc),
+            "spilled_batches": m1.get("memory.spilled_batches"),
+            "query_oom_replays": m1.get("query_oom_replays", 0),
+            "query_ooc_escalations": m1.get("query_ooc_escalations", 0),
+        }
+        print(f"# ooc {name}: uncapped={un_ms:.0f}ms capped={cap_ms:.0f}ms"
+              f" budget={cap} match={match} ooc={ooc}", file=sys.stderr)
+        _emit_ooc(suite_name, scale, out, timings, all_match, final=False)
+    _emit_ooc(suite_name, scale, out, timings, all_match, final=True)
+
+
+def _emit_ooc(suite_name, scale, out, timings, all_match, final):
+    """Running JSON line after every --ooc query (same lossless-kill
+    discipline as the suite runner: the last stdout line is always a
+    complete, parseable record covering everything measured)."""
+    print(json.dumps({
+        "mode": "ooc",
+        "metric": f"{suite_name}_sf{scale:g}_ooc_capped_geomean_x",
+        "value": round(float(np.exp(np.mean(np.log(
+            [max(v["degradation_x"], 1e-6) for v in out.values()
+             if v.get("degradation_x")])))), 3)
+        if any(v.get("degradation_x") for v in out.values()) else None,
+        "unit": "x (capped/uncapped wall, lower is better)",
+        "suite": suite_name,
+        f"{suite_name}_suite_scale": scale,
+        "backend": jax.default_backend(),
+        "queries": out,
+        "ooc_timings_ms": timings,
+        "all_match": all_match,
+        "all_engaged": all(v.get("ooc_engaged") for v in out.values())
+        if out else False,
+        "zero_replay_rung": all(
+            not v.get("query_oom_replays") for v in out.values()),
+        "extra_conf": dict(EXTRA_CONF),
+        "elapsed_s": round(time.perf_counter() - _T0, 1),
+        "final": final}), flush=True)
+
+
 #: default serving mix: a fast, join/agg-diverse TPC-H tranche (clients
 #: rotate through it; --queries overrides)
 SERVING_MIX = ["q1", "q3", "q6", "q12", "q14", "q19"]
@@ -977,6 +1101,7 @@ def main():
     serving = False
     kernels = False
     encodings = False
+    ooc = False
     multichip = False
     multichip_sf = 10.0
     args = list(sys.argv[1:])
@@ -995,6 +1120,8 @@ def main():
             kernels = True
         elif a == "--encodings":
             encodings = True
+        elif a == "--ooc":
+            ooc = True
         elif a.startswith("--history-dir"):
             # persistent performance-history plane: every measured query
             # records its structure-keyed device time (obs/history.py)
@@ -1056,6 +1183,10 @@ def main():
     if encodings:
         # encoded-vs-decode-first microbench A/B (ENCODINGS_r*.json)
         run_encodings()
+        return
+    if ooc:
+        # memory-capped out-of-core leg (OOC_r*.json, oc: gate entries)
+        run_ooc(suite_name, scale, names)
         return
     if serving:
         # concurrent closed-loop serving sweep (names = the mix)

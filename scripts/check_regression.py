@@ -211,6 +211,42 @@ def extract_encodings(doc):
     return {}, None
 
 
+def extract_ooc(doc):
+    """-> ({'oc:<entry>': ms}, backend or None) from a bench.py --ooc
+    result: the `ooc_timings_ms` dict ({qN}_capped = memory-capped wall
+    through the out-of-core tier, {qN}_uncapped = the resident
+    baseline, lower = better) becomes `oc:`-prefixed entries that gate
+    like per-query device_ms under the same backend-separation rule
+    (never colliding with qN / mc: / sv: / kn: / en: names).  Accepts
+    the runner's JSON line, the driver wrapper, and a tail."""
+    if not isinstance(doc, dict):
+        return {}, None
+    tim = doc.get("ooc_timings_ms")
+    if isinstance(tim, dict) and tim:
+        out = {f"oc:{k}": float(v) for k, v in tim.items()
+               if isinstance(v, (int, float))}
+        return out, str(doc.get("backend") or _DEFAULT_BACKEND)
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        out, backend = extract_ooc(parsed)
+        if out:
+            return out, backend
+    tail = doc.get("tail")
+    if isinstance(tail, str) and "ooc_timings_ms" in tail:
+        for line in reversed(tail.splitlines()):
+            if "ooc_timings_ms" not in line:
+                continue
+            try:
+                rec = json.loads(line.strip())
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out, backend = extract_ooc(rec)
+                if out:
+                    return out, backend
+    return {}, None
+
+
 def _rec_ms(rec: dict, rtt_ms: float):
     """Net-of-floor milliseconds for one per-query record: the explicit
     `device_ms_net` when the bench emitted it, else `device_ms` minus
@@ -386,6 +422,13 @@ def load_file(path: str):
         qs = {**qs, **en}
         if (not backend or backend == _DEFAULT_BACKEND) and en_backend:
             backend = en_backend
+    oc, oc_backend = extract_ooc(doc)
+    if oc:
+        # memory-capped out-of-core leg entries gate under their oc:
+        # prefix; a pure ooc record carries its own backend tag
+        qs = {**qs, **oc}
+        if (not backend or backend == _DEFAULT_BACKEND) and oc_backend:
+            backend = oc_backend
     return qs, backend, extract_compile_ms(doc)
 
 
@@ -429,7 +472,8 @@ def default_trajectory() -> list:
             sorted(glob.glob(os.path.join(_ROOT, "MULTICHIP_r*.json"))) +
             sorted(glob.glob(os.path.join(_ROOT, "SERVING_r*.json"))) +
             sorted(glob.glob(os.path.join(_ROOT, "KERNELS_r*.json"))) +
-            sorted(glob.glob(os.path.join(_ROOT, "ENCODINGS_r*.json"))))
+            sorted(glob.glob(os.path.join(_ROOT, "ENCODINGS_r*.json"))) +
+            sorted(glob.glob(os.path.join(_ROOT, "OOC_r*.json"))))
 
 
 def compare(current: dict, baseline: dict, threshold: float,

@@ -586,6 +586,43 @@ OOC_SORT_WINDOW_ROWS = conf(
     "from the HBM budget (the GpuOutOfCoreSortIterator splitUntilSmaller "
     "role).", checker=_non_negative)
 
+OOC_ENABLED = conf(
+    "spark.rapids.tpu.sql.ooc.enabled", True,
+    "Out-of-core execution tier: budget-driven graceful degradation for "
+    "hash join and aggregation.  When an operator's measured working set "
+    "exceeds the resident window (ooc.residentFraction x the HBM "
+    "budget), both sides stream through budget-registered spillable "
+    "partitions instead of materializing on device; the "
+    "TpuSplitAndRetryOOM ladder also escalates into this tier before "
+    "the query-level replay rung (docs/ROBUSTNESS.md).")
+
+OOC_RESIDENT_FRACTION = conf(
+    "spark.rapids.tpu.sql.ooc.residentFraction", 0.5,
+    "Fraction of the HBM budget one out-of-core operator may hold "
+    "resident at a time (the Theseus-style byte-budgeted window the "
+    "spill-partition count is derived from).",
+    checker=lambda v: None if 0.0 < v <= 1.0 else "must be in (0, 1]")
+
+OOC_MAX_PARTITIONS = conf(
+    "spark.rapids.tpu.sql.ooc.maxPartitions", 64,
+    "Upper bound on spill partitions one out-of-core join/aggregation "
+    "pass fans out to (partition count = measured bytes / resident "
+    "window, pow2-rounded; skewed buckets re-partition recursively "
+    "instead of widening past this).", checker=_positive)
+
+OOC_MAX_DEPTH = conf(
+    "spark.rapids.tpu.sql.ooc.maxDepth", 3,
+    "Maximum recursive re-partition depth for an out-of-core bucket "
+    "that still exceeds the resident window (re-salted hash per level "
+    "so key skew cannot map a bucket onto itself); past it the "
+    "split-retry ladder owns the remainder.", checker=_positive)
+
+OOC_FORCE = conf(
+    "spark.rapids.tpu.sql.ooc.force", False,
+    "Force the out-of-core tier for every eligible hash join and "
+    "aggregation regardless of measured bytes (test/ops knob; the "
+    "bench --ooc leg and the chaos suite pin behavior with it).")
+
 DELTA_OPTIMIZE_TARGET_ROWS = conf(
     "spark.rapids.tpu.delta.optimize.targetFileRows", 1 << 20,
     "Row target per output file for Delta OPTIMIZE / ZORDER compaction "

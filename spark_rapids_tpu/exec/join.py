@@ -85,13 +85,15 @@ def join_column_range(join_type: str, left, right, name):
 
 
 def _join_partition_ids(key_cols: List[DeviceColumn], db: DeviceBatch,
-                        num_buckets: int) -> jax.Array:
+                        num_buckets: int, salt: int = 0) -> jax.Array:
     """Bucket ids from join-key columns; value-stable across sides and
-    batches (reuses the agg fallback's lane-normalized hash)."""
+    batches (reuses the agg fallback's lane-normalized hash).  `salt`
+    decorrelates recursive re-partitions of a skewed bucket — the same
+    hash would map the bucket onto itself."""
     from .plan import _agg_partition_ids
     kb = DeviceBatch(list(key_cols), db.num_rows,
                      [f"_k{i}" for i in range(len(key_cols))])
-    return _agg_partition_ids(kb, len(key_cols), num_buckets)
+    return _agg_partition_ids(kb, len(key_cols), num_buckets, salt)
 
 
 class HashJoinExec(PlanNode):
@@ -314,42 +316,75 @@ class HashJoinExec(PlanNode):
             return
 
         from ..config import HASH_SUBPARTITION_FALLBACK
+        from . import ooc as O
         build_rows_bound = sum(b.capacity for b in right_batches)
-        if ctx.conf.get(HASH_SUBPARTITION_FALLBACK) and \
-                build_rows_bound > 2 * ctx.conf.batch_size_rows:
+        if ctx.conf.get(HASH_SUBPARTITION_FALLBACK):
             # Oversized build side: re-hash-partition BOTH sides into
             # independent sub-joins (GpuSubPartitionHashJoin.scala:32) —
             # equal keys hash to the same bucket on both sides, so the
-            # union of bucket joins is the join.
-            build_rows = sum(int(b.num_rows) for b in right_batches)
-            if build_rows > 2 * ctx.conf.batch_size_rows:
-                yield from self._sub_partition_join(
-                    right_batches, left_src, build_conds, probe_conds, ctx)
-                return
-            right_batches = [b for b in right_batches if int(b.num_rows)]
-            if not right_batches:
-                yield from self._empty_build_output(left_src, probe_conds,
-                                                    ctx)
-                return
+            # union of bucket joins is the join.  The gate sizes by
+            # BYTES against the out-of-core resident window (measured
+            # row width from the batches — wide payload rows used to
+            # blow past the row count before it tripped), with the
+            # legacy 2-target-batch row gate kept as the floor and the
+            # escalated/forced context tripping unconditionally.
+            policy = O.ooc_policy(ctx)
+            rows_trip = build_rows_bound > 2 * ctx.conf.batch_size_rows
+            bytes_trip = policy.bytes_trip(
+                sum(b.nbytes() for b in right_batches))
+            if rows_trip or bytes_trip or policy.force:
+                build_rows = sum(int(b.num_rows) for b in right_batches)
+                build_bytes = sum(O.batch_bytes(b) for b in right_batches)
+                if build_rows > 2 * ctx.conf.batch_size_rows or \
+                        policy.bytes_trip(build_bytes) or policy.force:
+                    yield from self._sub_partition_join(
+                        right_batches, left_src, build_conds, probe_conds,
+                        ctx, policy)
+                    return
+                right_batches = [b for b in right_batches
+                                 if int(b.num_rows)]
+                if not right_batches:
+                    yield from self._empty_build_output(
+                        left_src, probe_conds, ctx)
+                    return
 
         build_batch = concat_batches(right_batches, ctx.conf)
         yield from self._join_stream(build_batch, left_src.execute(ctx),
                                      ctx, build_conds, probe_conds)
 
     def _sub_partition_join(self, right_batches, left_src, build_conds,
-                            probe_conds, ctx: ExecContext
+                            probe_conds, ctx: ExecContext, policy=None
                             ) -> Iterator[DeviceBatch]:
+        """Budget-sized partitioned-spill join (the out-of-core tier):
+        both sides hash-scatter into budget-registered spillable
+        buckets; the partition count derives from measured build BYTES
+        vs the resident window (exec/ooc.py), and a bucket whose build
+        side still exceeds the window re-partitions recursively with a
+        re-salted hash (bounded depth) so key skew cannot OOM it —
+        past the depth bound the split-retry ladder owns the rest."""
         from ..runtime.memory import Spillable
+        from . import ooc as O
         conf = ctx.conf
+        if policy is None:
+            policy = O.ooc_policy(ctx)
         build_rows = sum(int(b.num_rows) for b in right_batches)
-        k = 1 << max(1, (build_rows // conf.batch_size_rows)
-                     .bit_length() - 1)
-        k = min(k, 32)
+        build_bytes = sum(O.batch_bytes(b) for b in right_batches)
+        # legacy row-derived fan-out floors the byte-derived count so
+        # budget-less configurations keep their old partition sizing
+        rows_k = 1 << max(1, (build_rows // conf.batch_size_rows)
+                          .bit_length() - 1)
+        rows_k = min(rows_k, 32)
+        k = O.partition_count(build_bytes, policy, rows_k=rows_k)
         ctx.bump("join_subpartition_fallbacks")
+        O.record_election(
+            ctx, "join",
+            "bytes" if policy.bytes_trip(build_bytes) else
+            ("forced" if policy.force and
+             build_rows <= 2 * conf.batch_size_rows else "rows"))
 
         raw_pos = self._raw_key_positions()
 
-        def scatter(db, exprs, conds, buckets):
+        def scatter(db, exprs, conds, buckets, nparts, salt) -> int:
             if db.thin is not None:
                 # key/condition columns must be dense before bucketing;
                 # remaining deferred columns resolve inside the bucket
@@ -358,56 +393,111 @@ class HashJoinExec(PlanNode):
                 db = materialize_refs(db, list(exprs) + list(conds),
                                       ctx.conf)
             keys = self._key_cols(db, exprs, raw_pos, ctx)
-            ids = _join_partition_ids(keys, db, k)
+            ids = _join_partition_ids(keys, db, nparts, salt)
             # fused filters apply here — bucket batches are post-filter,
             # so the bucket joins run with no conds
             live = self._conds_mask(conds, db, db.row_mask(), ctx)
-            for p in range(k):
+            scattered = 0
+            for p in range(nparts):
                 part = compact_batch(db, (ids == p) & live, ctx.conf)
                 from ..ops.batch_ops import shrink_to_rows
                 part = shrink_to_rows(part, int(part.num_rows), ctx.conf)
                 if int(part.num_rows):
-                    buckets[p].append(Spillable(part, ctx.budget))
+                    sp = Spillable(part, ctx.budget)
+                    # live-row-scaled size rides the handle: bucket
+                    # recursion must size by actual rows, not the
+                    # min-bucket capacity padding of many tiny slices
+                    sp.live_nbytes = O.batch_bytes(part)
+                    buckets[p].append(sp)
+                    scattered += sp.live_nbytes
+            return scattered
+
+        def process(bl, pl, depth):
+            """Join one (build, probe) bucket pair, re-partitioning
+            recursively while its build side exceeds the window."""
+            if not bl and not pl:
+                return
+            bucket_bytes = sum(getattr(sp, "live_nbytes", sp.nbytes)
+                               for sp in bl)
+            if bl and policy.bytes_trip(bucket_bytes) and \
+                    depth < policy.max_depth and \
+                    sum(sp.num_rows for sp in bl) > 1:
+                # skewed bucket: re-salted recursive re-partition
+                O.record_recursion(ctx, "join")
+                k2 = O.partition_count(bucket_bytes, policy)
+                sub_b = [[] for _ in range(k2)]
+                sub_p = [[] for _ in range(k2)]
+                try:
+                    sbytes = 0
+                    for sp in bl:
+                        b = sp.get()
+                        sp.close()
+                        sbytes += scatter(b, self.right_keys, (), sub_b,
+                                          k2, depth + 1)
+                    for sp in pl:
+                        b = sp.get()
+                        sp.close()
+                        sbytes += scatter(b, self.left_keys, (), sub_p,
+                                          k2, depth + 1)
+                    O.record_partitions(ctx, "join", k2, sbytes)
+                    for p in range(k2):
+                        if not sub_b[p] and not sub_p[p]:
+                            continue
+                        O.fire(ctx, "join", bucket=p, k=k2,
+                               depth=depth + 1)
+                        yield from process(sub_b[p], sub_p[p], depth + 1)
+                finally:
+                    for part in sub_b + sub_p:
+                        for sp in part:
+                            sp.close()
+                return
+
+            def probes():
+                for sp in pl:
+                    b = sp.get()
+                    sp.close()
+                    yield b
+            if not bl:
+                if self.join_type in (J.INNER, J.LEFT_SEMI,
+                                      J.RIGHT_OUTER):
+                    # nothing to emit: release without re-uploading
+                    for sp in pl:
+                        sp.close()
+                    return
+                # empty build bucket: the empty-build rule decides
+                yield from self._empty_build_stream(probes(), ctx)
+                return
+            bbs = [sp.get() for sp in bl]
+            build_batch = concat_batches(bbs, ctx.conf) \
+                if len(bbs) > 1 else bbs[0]
+            for sp in bl:
+                sp.close()
+            yield from self._join_stream(build_batch, probes(), ctx)
 
         build_parts = [[] for _ in range(k)]
         probe_parts = [[] for _ in range(k)]
         try:
+            sbytes = 0
             for db in right_batches:
-                scatter(db, self.right_keys, build_conds, build_parts)
+                sbytes += scatter(db, self.right_keys, build_conds,
+                                  build_parts, k, 0)
             for db in left_src.execute(ctx):
                 if int(db.num_rows) == 0:
                     continue
-                scatter(db, self.left_keys, probe_conds, probe_parts)
-
+                sbytes += scatter(db, self.left_keys, probe_conds,
+                                  probe_parts, k, 0)
+            O.record_partitions(ctx, "join", k, sbytes)
             for p in range(k):
                 bl, pl = build_parts[p], probe_parts[p]
                 if not bl and not pl:
                     continue
-
-                def probes():
-                    for sp in pl:
-                        b = sp.get()
-                        sp.close()
-                        yield b
-                if not bl:
-                    if self.join_type in (J.INNER, J.LEFT_SEMI,
-                                          J.RIGHT_OUTER):
-                        # nothing to emit: release without re-uploading
-                        for sp in pl:
-                            sp.close()
-                        continue
-                    # empty build bucket: the empty-build rule decides
-                    yield from self._empty_build_stream(probes(), ctx)
-                    continue
-                bbs = [sp.get() for sp in bl]
-                build_batch = concat_batches(bbs, ctx.conf) \
-                    if len(bbs) > 1 else bbs[0]
-                for sp in bl:
-                    sp.close()
-                yield from self._join_stream(build_batch, probes(), ctx)
+                O.fire(ctx, "join", bucket=p, k=k, depth=0)
+                yield from process(bl, pl, 0)
         finally:
             # early generator abandonment (e.g. LIMIT above the join) must
-            # not leak registered spillables / disk spill files
+            # not leak registered spillables / disk spill files; close is
+            # idempotent by contract (runtime/memory.py), so handles the
+            # bucket loop already consumed release nothing twice
             for part in build_parts + probe_parts:
                 for sp in part:
                     sp.close()

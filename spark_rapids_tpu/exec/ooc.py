@@ -1,0 +1,168 @@
+"""Out-of-core execution tier: shared budget/partition policy.
+
+Reference: GpuSubPartitionHashJoin.scala:32 (re-hash-partition both
+sides into sub-joins) and the reference's spill framework sizing;
+Sparkle's memory tiering (PAPERS.md) is the degradation model, Theseus
+(PAPERS.md) the argument for sizing the resident window from a *byte*
+budget rather than row counts.
+
+This module centralizes what the three out-of-core operators (hash
+join `exec/join.py`, spill-partitioned aggregation `exec/ooc_agg.py`,
+out-of-core sort `exec/ooc_sort.py`) share:
+
+  * the **resident window** — `sql.ooc.residentFraction` x the HBM
+    budget: the bytes one operator may hold on device at a time.  The
+    spill-partition count is derived from measured bytes vs this
+    window (`partition_count`), never from `2 x batch_size_rows` rows
+    (wide payload rows used to blow past the row gate before it
+    tripped);
+  * the **`ooc` chaos site** — `fire()` emits an `ooc_state` instant
+    (so a fatal crash dump's flight-recorder tail embeds the bucket
+    state the pass was in) and then fires the injector;
+  * the **`tpu_ooc_*` metric families** (obs/registry.py) every
+    election/partition pass publishes, which the acceptance tests and
+    `bench.py --ooc` assert the tier — not the query-level replay rung
+    — carried an oversized query.
+
+The degradation ladder placement (docs/ROBUSTNESS.md): operators elect
+OOC *proactively* when measured bytes exceed the window (or the cost
+oracle predicted they will — `elect_proactive`), and the query-level
+retry escalates into the OOC rung (`ctx.ooc_force`) before the final
+whole-query replay rung when an OOM still escapes the operator ladders.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..config import (OOC_ENABLED, OOC_FORCE, OOC_MAX_DEPTH,
+                      OOC_MAX_PARTITIONS, OOC_RESIDENT_FRACTION)
+
+
+@dataclasses.dataclass(frozen=True)
+class OocPolicy:
+    """Resolved out-of-core policy for one ExecContext."""
+    enabled: bool
+    force: bool                  # sql.ooc.force OR an escalated context
+    window: Optional[int]        # resident window bytes; None = unlimited
+    max_partitions: int
+    max_depth: int
+
+    def bytes_trip(self, nbytes: int) -> bool:
+        """Whether `nbytes` of working set exceeds the resident window."""
+        return self.enabled and self.window is not None and \
+            nbytes > self.window
+
+
+def ooc_policy(ctx) -> OocPolicy:
+    """The out-of-core policy for this query context.  `window` derives
+    from the SAME budget instance the operators register spillables
+    with, so electing OOC and fitting under the budget agree."""
+    conf = ctx.conf
+    enabled = bool(conf.get(OOC_ENABLED))
+    force = enabled and (bool(conf.get(OOC_FORCE)) or
+                         bool(getattr(ctx, "ooc_force", False)))
+    window = None
+    if enabled:
+        limit = ctx.budget.limit
+        if limit:
+            window = max(int(limit * float(conf.get(OOC_RESIDENT_FRACTION))),
+                         1 << 14)
+    return OocPolicy(enabled, force, window,
+                     int(conf.get(OOC_MAX_PARTITIONS)),
+                     int(conf.get(OOC_MAX_DEPTH)))
+
+
+def batch_bytes(db) -> int:
+    """Approximate LIVE bytes of a device batch (row-scaled: padding
+    does not count toward the working set the window must hold)."""
+    cap = max(int(db.capacity), 1)
+    rows = db.num_rows
+    rows = int(rows) if isinstance(rows, int) else cap
+    return max((db.nbytes() * min(rows, cap)) // cap, 0)
+
+
+def partition_count(total_bytes: int, policy: OocPolicy,
+                    rows_k: int = 1) -> int:
+    """Spill-partition fan-out for `total_bytes` of working set: enough
+    pow2 buckets that each holds ~one resident window, floored by the
+    legacy row-derived count `rows_k` and clamped to
+    sql.ooc.maxPartitions (skew re-partitions recursively instead of
+    widening past the clamp)."""
+    k_bytes = 1
+    if policy.window:
+        need = -(-max(total_bytes, 1) // policy.window)    # ceil div
+        k_bytes = 1 << max(need - 1, 0).bit_length()
+    k = max(rows_k, k_bytes, 2)
+    return min(k, max(policy.max_partitions, 2))
+
+
+def fire(ctx, op: str, **state) -> None:
+    """One out-of-core pass boundary: publish the bucket state to the
+    flight recorder FIRST (`ooc_state` instant — a fatal dump's tail
+    then shows exactly which pass died), then fire the `ooc` chaos
+    site with the same state in the injected-fault record."""
+    ctx.tracer.instant("ooc_state", "runtime", op=op, **state)
+    from ..runtime.faults import get_injector
+    get_injector(ctx.conf).fire("ooc", op=op, **state)
+
+
+def record_election(ctx, op: str, mode: str) -> None:
+    from ..obs.registry import OOC_ELECTIONS
+    OOC_ELECTIONS.inc(op=op, mode=mode)
+    ctx.bump(f"ooc.{op}_elections")
+
+
+def record_partitions(ctx, op: str, k: int, nbytes: int) -> None:
+    from ..obs.registry import OOC_BYTES, OOC_PARTITIONS
+    OOC_PARTITIONS.inc(k, op=op)
+    if nbytes > 0:
+        OOC_BYTES.inc(nbytes, op=op)
+    ctx.bump(f"ooc.{op}_partitions", k)
+    ctx.bump(f"ooc.{op}_bytes", nbytes)
+
+
+def record_recursion(ctx, op: str) -> None:
+    from ..obs.registry import OOC_RECURSIONS
+    OOC_RECURSIONS.inc(op=op)
+    ctx.bump(f"ooc.{op}_recursions")
+
+
+def escalate(ctx) -> bool:
+    """Arm the OOC rung on an escaped OOM (the ladder step between
+    operator retries and the whole-query replay): forces every eligible
+    operator out-of-core on the replay.  Returns False when the tier is
+    disabled or already forced (the caller then falls through to the
+    query-replay rung)."""
+    if not ctx.conf.get(OOC_ENABLED) or getattr(ctx, "ooc_force", False):
+        return False
+    ctx.ooc_force = True
+    ctx.bump("query_ooc_escalations")
+    record_election(ctx, "query", "reactive")
+    ctx.tracer.instant("ooc_escalation", "runtime")
+    return True
+
+
+def elect_proactive(pq, ctx) -> bool:
+    """Plan-time OOC election from the cost oracle (obs/estimator.py):
+    when the structure's MEASURED working-set history exceeds the HBM
+    budget, run spilled from the start instead of discovering the OOM
+    mid-query.  One cached conf check when the history plane is off."""
+    if not ctx.conf.get(OOC_ENABLED) or getattr(ctx, "ooc_force", False):
+        return False
+    try:
+        from ..obs.estimator import estimate_query
+        est = estimate_query(pq)
+    except Exception:                                    # noqa: BLE001
+        return False                 # the oracle must never fail a query
+    if not est or est.get("ws_basis") != "measured":
+        return False
+    ws = int(est.get("working_set_bytes") or 0)
+    limit = ctx.budget.limit
+    if not limit or ws <= limit:
+        return False
+    ctx.ooc_force = True
+    record_election(ctx, "query", "proactive")
+    ctx.tracer.instant("ooc_proactive", "runtime", working_set_bytes=ws,
+                       budget_bytes=limit)
+    return True

@@ -57,13 +57,18 @@ class OutOfCoreSorter:
     def _resolve_window(self, db: DeviceBatch) -> int:
         if self._window_rows is None:
             from ..config import OOC_SORT_WINDOW_ROWS
+            from . import ooc as O
             forced = self.conf.get(OOC_SORT_WINDOW_ROWS)
+            policy = O.ooc_policy(self.ctx)
             if forced:
                 self._window_rows = forced
-            elif self.budget.limit:
+            elif policy.window is not None:
+                # the shared out-of-core resident window (exec/ooc.py:
+                # ooc.residentFraction x the HBM budget), in rows of
+                # the measured width
                 self._window_rows = max(
                     self.conf.batch_size_rows // 8,
-                    (self.budget.limit // 2) // _row_bytes(db))
+                    policy.window // _row_bytes(db))
             else:
                 self._window_rows = 1 << 62      # unlimited: single run
         return self._window_rows
@@ -132,8 +137,19 @@ class OutOfCoreSorter:
                 self._merge_pending = None
 
     def _merge(self) -> Iterator[DeviceBatch]:
+        from . import ooc as O
         runs = self._runs
+        O.record_election(self.ctx, "sort", "bytes")
+        passno = 0
         while True:
+            # one merge pass = one out-of-core window: publish the run
+            # state to the flight recorder, then give the chaos harness
+            # its shot MID-SPILL (the `ooc` site) — recoverable kinds
+            # must come back bit-identical, fatal dumps embed the state
+            O.fire(self.ctx, "sort", merge_pass=passno,
+                   runs=sum(1 for r in runs if r),
+                   chunks=sum(len(r) for r in runs))
+            passno += 1
             window: List[DeviceBatch] = []
             if self._merge_pending is not None:
                 window.append(self._merge_pending.get())

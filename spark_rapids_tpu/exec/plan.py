@@ -43,6 +43,10 @@ class ExecContext:
     # query-lifecycle span tracer (obs/tracer.py); NULL when tracing is
     # off so record calls cost one no-op method dispatch
     tracer: object = None
+    # out-of-core escalation flag (exec/ooc.py): set by the query-level
+    # OOM ladder / proactive election / serving admission; every
+    # eligible hash join and aggregation then runs spill-partitioned
+    ooc_force: bool = False
 
     def __post_init__(self):
         if self.tracer is None:
@@ -606,6 +610,8 @@ class HashAggregateExec(PlanNode):
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from ..config import AGG_FALLBACK_PARTITIONS
+        from . import ooc as O
+        from .ooc_agg import OutOfCoreAggregator
         agg = HashAggregate(self.key_exprs, self.key_names, self.aggs,
                             ctx.conf, key_ranges=self._key_ranges())
         agg._input_ranges_by_expr = self._input_ranges(agg)
@@ -617,10 +623,20 @@ class HashAggregateExec(PlanNode):
         # the single-program fuse can't take (host dictionary work) still
         # skip the compact: the mask evaluates as its own program.
         source, conds = self._strip_filters(True)
+        policy = O.ooc_policy(ctx)
         partials: List[DeviceBatch] = []
-        buckets = None          # repartition-fallback state
-        num_buckets = 0
+        partial_bytes = 0
+        oocagg: "OutOfCoreAggregator | None" = None
         seen = False
+
+        def start_ooc(mode: str) -> OutOfCoreAggregator:
+            k = max(ctx.conf.get(AGG_FALLBACK_PARTITIONS),
+                    O.partition_count(partial_bytes, policy))
+            ctx.bump("agg_repartition_fallbacks")
+            O.record_election(ctx, "agg", mode)
+            return OutOfCoreAggregator(agg, len(self.key_names), ctx,
+                                       policy, k)
+
         for db in source.execute(ctx):
             if isinstance(db.num_rows, int) and db.num_rows == 0:
                 continue
@@ -644,10 +660,24 @@ class HashAggregateExec(PlanNode):
                     for c in conds:
                         live = live & compute_predicate(c, db, ctx.conf)
                 p = agg.partial(db, live)
-            if buckets is not None:
-                self._scatter(p, buckets, num_buckets, ctx)
+            if oocagg is not None:
+                oocagg.add(p)
                 continue
             partials.append(p)
+            partial_bytes += O.batch_bytes(p)
+            # OOC byte gate / forced context: the accumulated partial
+            # working set exceeds the resident window (or the query is
+            # escalated/forced out-of-core) — spill-partition by key NOW
+            # instead of betting the merge below still reduces; key-
+            # disjoint buckets make the union exact (exec/ooc_agg.py)
+            if self.key_exprs and \
+                    (policy.force or policy.bytes_trip(partial_bytes)):
+                oocagg = start_ooc(
+                    "forced" if policy.force else "bytes")
+                for q in partials:
+                    oocagg.add(q)
+                partials = []
+                continue
             # Bound the pending set: merge when the partials would overflow
             # one target batch (the reference's tryMergeAggregatedBatches).
             # Capacity is a host fact, so the gate never syncs; it bounds
@@ -662,24 +692,16 @@ class HashAggregateExec(PlanNode):
                     # repartition-based path): merging no longer reduces, so
                     # hash-split the merged partials into independently
                     # mergeable buckets held as spillables.
-                    num_buckets = ctx.conf.get(AGG_FALLBACK_PARTITIONS)
-                    buckets = [[] for _ in range(num_buckets)]
-                    self._scatter(merged, buckets, num_buckets, ctx)
+                    oocagg = start_ooc("rows")
+                    oocagg.add(merged)
                     partials = []
-                    ctx.bump("agg_repartition_fallbacks")
                 else:
                     partials = [merged]
-        if buckets is not None:
-            try:
-                for blist in buckets:
-                    if blist:
-                        yield from self._finalize_bucket(agg, blist, ctx, 1)
-            finally:
-                # early abandonment / errors must release every registered
-                # spillable (close is idempotent)
-                for blist in buckets:
-                    for sp in blist:
-                        sp.close()
+                    partial_bytes = O.batch_bytes(merged)
+        if oocagg is not None:
+            # results() owns the cleanup sweep (idempotent closes), so a
+            # LIMIT above this aggregation leaks no spill files
+            yield from oocagg.results()
             return
         if not seen:
             if self.key_exprs:
@@ -689,76 +711,6 @@ class HashAggregateExec(PlanNode):
             partials = [agg.partial(empty)]
         merged = agg.merge(partials) if len(partials) > 1 else partials[0]
         yield agg.final(merged)
-
-    def _scatter(self, pb: DeviceBatch, buckets, num_buckets: int,
-                 ctx: ExecContext, salt: int = 0):
-        """Split a partial batch into hash buckets of its group keys
-        (value-stable across batches: string keys hash dictionary VALUES,
-        not per-batch codes)."""
-        from ..runtime.memory import Spillable
-        ids = _agg_partition_ids(pb, len(self.key_names), num_buckets, salt)
-        live = pb.row_mask()
-        for k in range(num_buckets):
-            part = compact_batch(pb, (ids == k) & live, ctx.conf)
-            part = shrink_to_rows(part, int(part.num_rows), ctx.conf)
-            if int(part.num_rows):
-                buckets[k].append(Spillable(part, ctx.budget))
-
-    _MAX_SCATTER_DEPTH = 3
-
-    def _finalize_bucket(self, agg, blist, ctx: ExecContext, depth: int):
-        """Merge + finalize one fallback bucket.  Oversized buckets
-        re-scatter with a different hash salt (the reference re-partitions
-        recursively); merges are rolling and retry-wrapped so the working
-        set stays at two batches."""
-        from ..config import AGG_FALLBACK_PARTITIONS
-        from ..runtime.memory import Spillable
-        from ..runtime.retry import with_retry
-        conf = ctx.conf
-        total = sum(sp.num_rows for sp in blist)
-        sub = []
-        acc = None
-        try:
-            if depth < self._MAX_SCATTER_DEPTH and len(blist) > 1 and \
-                    total > 2 * conf.batch_size_rows:
-                k = conf.get(AGG_FALLBACK_PARTITIONS)
-                sub = [[] for _ in range(k)]
-                for sp in blist:
-                    b = sp.get()
-                    sp.close()
-                    self._scatter(b, sub, k, ctx, salt=depth)
-                ctx.bump("agg_repartition_fallbacks")
-                for sl in sub:
-                    if sl:
-                        yield from self._finalize_bucket(agg, sl, ctx,
-                                                         depth + 1)
-                return
-            acc = blist[0]
-            for sp in blist[1:]:
-                # both inputs stay REGISTERED during the merge attempt so
-                # the retry's spill_all can actually demote them (the
-                # reference's "inputs must be spillable" contract); get()
-                # inside the attempt re-materializes after a spill
-                a, b = acc, sp
-                merged = with_retry(ctx.budget, conf,
-                                    lambda: agg.merge([a.get(), b.get()]))
-                nxt = Spillable(merged, ctx.budget)
-                a.close()
-                b.close()
-                acc = nxt
-            out = acc.get()
-            acc.close()
-            yield agg.final(out)
-        finally:
-            # early abandonment / mid-merge failure: release everything
-            # still registered (close is idempotent)
-            for sp in blist:
-                sp.close()
-            for sl in sub:
-                for sp in sl:
-                    sp.close()
-            if acc is not None:
-                acc.close()
 
     def collect_device(self, ctx: Optional[ExecContext] = None):
         """Dispatch a global (no-key) aggregation fully async: returns

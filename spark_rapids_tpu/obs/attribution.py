@@ -147,6 +147,10 @@ class ExplainAnalyzeReport:
     predicted: Optional[Dict[str, Any]] = None
     #: node id -> resolved Pallas kernel-tier decision (kernel_plan())
     kernel_tiers: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: out-of-core tier activity of the profiled run (exec/ooc.py):
+    #: per-op election/partition/byte/recursion counters from
+    #: ctx.metrics `ooc.*` entries; {} when the tier never engaged
+    ooc: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         return {"tree": self.tree, "segments": self.segments,
@@ -156,6 +160,7 @@ class ExplainAnalyzeReport:
                 "mesh_timeline": self.mesh_timeline,
                 "predicted": self.predicted,
                 "kernel_tiers": self.kernel_tiers,
+                "ooc": self.ooc,
                 "hbm": self.hbm}
 
     def render(self) -> str:
@@ -180,6 +185,21 @@ class ExplainAnalyzeReport:
                 f"measured (segment peaks sum "
                 f"{h.get('segment_sum_bytes', 0)}, "
                 f"{h.get('attributed_pct', 0):.1f}% attributed)")
+        if self.ooc:
+            o = self.ooc
+            parts = []
+            for op in ("join", "agg", "sort"):
+                if o.get(f"{op}_elections") or o.get(f"{op}_partitions"):
+                    s = f"{op} k={o.get(f'{op}_partitions', 0)}"
+                    if o.get(f"{op}_bytes"):
+                        s += f" spilled={o[f'{op}_bytes']}B"
+                    if o.get(f"{op}_recursions"):
+                        s += f" recursions={o[f'{op}_recursions']}"
+                    parts.append(s)
+            if o.get("query_elections"):
+                parts.append("query-escalated")
+            head.append("ooc               " + "; ".join(parts) +
+                        " (budget-driven out-of-core tier)")
         if self.gathers.get("gather_bytes"):
             head.append(f"gather volume     "
                         f"{self.gathers['gather_bytes']} bytes / "
@@ -401,10 +421,14 @@ def run_explain_analyze(pq, conf_overrides: Optional[dict] = None
     tree = _render_tree(pq.root, ctx.metrics, seg_by_node,
                         split["wall_ms"], kernel_tiers=kernel_tiers,
                         pred_segments=pred_segments)
+    # out-of-core tier activity: the ctx.metrics `ooc.*` counters the
+    # operators bump (exec/ooc.py) plus the query-rung escalation count
+    ooc = {k[len("ooc."):]: v for k, v in ctx.metrics.items()
+           if k.startswith("ooc.") and v}
     return ExplainAnalyzeReport(
         tree=tree, segments=segments,
         attributed_pct=None if pct is None else round(pct * 100, 1),
         wall_ms=split["wall_ms"], device_ms=round(device_ms, 3),
         gathers=gathers, mesh_timeline=profile.mesh_timeline(),
         metrics=dict(ctx.metrics), profile=profile,
-        predicted=predicted, kernel_tiers=kernel_tiers, hbm=hbm)
+        predicted=predicted, kernel_tiers=kernel_tiers, hbm=hbm, ooc=ooc)

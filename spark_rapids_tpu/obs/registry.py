@@ -646,6 +646,40 @@ DICT_REMAPS = REGISTRY.counter(
     "counts cache MISSES — per-probe-batch recomputation regressions "
     "show up here.")
 
+OOC_ELECTIONS = REGISTRY.counter(
+    "tpu_ooc_elections_total",
+    "Out-of-core tier elections by operator (join | agg | sort | "
+    "query) and mode: bytes = the measured working set exceeded the "
+    "resident window at execution time, rows = the legacy row-count "
+    "gate tripped, forced = sql.ooc.force / an escalated context, "
+    "proactive = the cost oracle's measured-basis working set elected "
+    "OOC at plan time, admission = serving admitted an oversized query "
+    "in OOC mode instead of running it solo, reactive = the "
+    "TpuSplitAndRetryOOM ladder escalated into the OOC rung.",
+    ("op", "mode"))
+
+OOC_PARTITIONS = REGISTRY.counter(
+    "tpu_ooc_partitions_total",
+    "Spill partitions created by out-of-core join/aggregation passes "
+    "(one increment per bucket per pass, recursive re-partitions "
+    "included), by operator.",
+    ("op",))
+
+OOC_BYTES = REGISTRY.counter(
+    "tpu_ooc_bytes_total",
+    "Bytes routed through budget-registered spillable partitions by "
+    "the out-of-core tier (both join sides, scattered aggregation "
+    "partials), by operator — the degraded-but-running volume.",
+    ("op",))
+
+OOC_RECURSIONS = REGISTRY.counter(
+    "tpu_ooc_recursions_total",
+    "Out-of-core buckets that still exceeded the resident window and "
+    "re-partitioned recursively with a re-salted hash (key skew), by "
+    "operator.  Depth is bounded by sql.ooc.maxDepth; past it the "
+    "split-retry ladder owns the remainder.",
+    ("op",))
+
 
 _QUERY_SEQ_LOCK = threading.Lock()
 _QUERY_SEQ = 0

@@ -1378,7 +1378,13 @@ class PhysicalQuery:
         install_fault_injection(self.root, self.conf)
         with self._instrumented(ctx), crash_capture(self.conf, ctx):
             import time as _time
+            from ..exec import ooc as O
             from ..exec.metrics import record_history
+            if self.kind == "device":
+                # proactive OOC election: the cost oracle's MEASURED
+                # working-set history vs the HBM budget — an oversized
+                # query runs spilled from the start (exec/ooc.py)
+                O.elect_proactive(self, ctx)
             t0 = _time.perf_counter()
             out = self._collect_with_query_retry(ctx)
             # the performance-history feed: runs INSIDE crash_capture
@@ -1423,7 +1429,12 @@ class PhysicalQuery:
         return True
 
     def _collect_once(self, ctx: ExecContext) -> pa.Table:
-        if self.kind == "device" and self._whole_plan_enabled():
+        if self.kind == "device" and self._whole_plan_enabled() and \
+                not ctx.ooc_force:
+            # an OOC-escalated context runs the EAGER batch engine: the
+            # out-of-core tier (budget-registered spillables, partition
+            # recursion) lives there, while compiled whole-plan programs
+            # allocate their intermediates outside the budget's reach
             from ..exec.compiled import collect_with_fallback
             out = collect_with_fallback(self.root, ctx, cache_on=self)
             if out is not None:
@@ -1431,19 +1442,35 @@ class PhysicalQuery:
         return self.root.collect(ctx)
 
     def _collect_with_query_retry(self, ctx: ExecContext) -> pa.Table:
-        """The query-level rung of the recovery ladder (the task-retry
-        role): an OOM that escapes every operator-level retry gets ONE
-        whole-query replay after a spill-everything.  Plans replay
-        idempotently (pure operators; exchanges reuse their materialized
-        shuffle ids), so the rerun is safe; anything non-OOM — or a
-        second OOM — propagates for classification."""
+        """The query-level rungs of the recovery ladder (the task-retry
+        role).  An OOM that escapes every operator-level retry — the
+        TpuSplitAndRetryOOM the exhausted split ladder raises included —
+        first escalates into the OUT-OF-CORE rung: spill everything and
+        replay with `ctx.ooc_force` armed, so every eligible hash join
+        and aggregation runs spill-partitioned (exec/ooc.py).  Only an
+        OOM that survives the OOC replay reaches the final whole-query
+        replay rung.  Plans replay idempotently (pure operators;
+        exchanges reuse their materialized shuffle ids), so the reruns
+        are safe; anything non-OOM — or an OOM past the last rung —
+        propagates for classification."""
         from ..config import RETRY_ENABLED
+        from ..exec import ooc as O
         from ..runtime.memory import is_oom_error
         try:
             return self._collect_once(ctx)
         except Exception as e:                   # noqa: BLE001
             if not ctx.conf.get(RETRY_ENABLED) or not is_oom_error(e):
                 raise
+            if O.escalate(ctx):
+                # the OOC rung: replay degraded instead of solo
+                if ctx._budget is not None:
+                    ctx.budget.spill_all()
+                try:
+                    return self._collect_once(ctx)
+                except Exception as e2:          # noqa: BLE001
+                    if not is_oom_error(e2):
+                        raise
+                    e = e2
             if ctx._budget is not None:
                 ctx.budget.spill_all()
             ctx.bump("query_oom_replays")

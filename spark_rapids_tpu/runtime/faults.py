@@ -97,6 +97,17 @@ SITES: Dict[str, str] = {
                "query's crash-capture scope as a classified "
                "FATAL_DEVICE dump embedding the PARTIAL HBM timeline "
                "collected up to the fault",
+    "ooc": "out-of-core tier boundaries (exec/ooc.py via exec/join.py "
+           "hash-join spill partitioning, exec/ooc_agg.py spill-"
+           "partitioned aggregation, exec/ooc_sort.py merge passes) — "
+           "fires once per partition pass / merge pass with the "
+           "operator, bucket and depth in the injected-fault record, "
+           "AFTER the matching `ooc_state` instant hit the flight "
+           "recorder.  Kind 'oom' rides the normal OOM ladder (the "
+           "query replays bit-identically — the OOC context is already "
+           "forced on the replay); 'fatal' surfaces as a classified "
+           "FATAL_DEVICE crash dump whose flight-recorder tail embeds "
+           "the OOC bucket state the pass was in",
     "kernel": "Pallas kernel-tier dispatch (ops/pallas/) and encoded-"
               "execution dispatch (ops/encodings.py) — fires each "
               "time an operator elects a hand-written kernel or a "

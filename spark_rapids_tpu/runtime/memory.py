@@ -393,6 +393,7 @@ class Spillable:
         budget.reserve(self._nbytes, _tracked=False)
         self._sid = budget.register(self)
         self._writing = False            # disk write in flight (to_disk)
+        self._closed = False             # see close(): idempotent contract
 
     @property
     def num_rows(self) -> int:
@@ -407,6 +408,17 @@ class Spillable:
     @property
     def on_host(self) -> bool:
         return self._hb is not None
+
+    @property
+    def nbytes(self) -> int:
+        """Device-resident byte size this spillable reserves when
+        materialized — the out-of-core tier sizes partitions from it."""
+        return self._nbytes
+
+    @property
+    def closed(self) -> bool:
+        """Whether close() already released every tier (see close)."""
+        return self._closed
 
     def spill(self):
         """device -> host tier (holds the budget lock: spill can be driven
@@ -550,7 +562,18 @@ class Spillable:
         return HostBatch(rb)
 
     def close(self):
+        """Release every tier this spillable still holds (device
+        reservation, host bytes, disk block file).
+
+        IDEMPOTENT BY CONTRACT: out-of-core operators close handles
+        both at consumption time (inside their bucket loops) and again
+        in their `finally` cleanup sweeps — early generator abandonment
+        (a LIMIT above an OOC join) reaches the sweep with some handles
+        already closed.  A second close must release nothing twice:
+        every tier is nulled before its release path can re-run, and
+        the `closed` flag makes the state observable to tests."""
         with self._budget._lock:
+            self._closed = True
             self._budget.unregister(self._sid)
             if self._db is not None:
                 # untracked for the same reason __init__/spill are: an

@@ -27,7 +27,8 @@ from ..config import (RETRY_ENABLED, RETRY_IO_ATTEMPTS,
                       RETRY_IO_BACKOFF_MS, RETRY_IO_BACKOFF_MULT,
                       RETRY_MAX_ATTEMPTS, RETRY_MAX_SPLITS, TpuConf)
 from ..obs.registry import BATCH_SPLITS, IO_RETRIES, OOM_RETRIES
-from .memory import MemoryBudget, TpuRetryOOM, is_oom_error
+from .memory import (MemoryBudget, TpuRetryOOM, TpuSplitAndRetryOOM,
+                     is_oom_error)
 
 T = TypeVar("T")
 
@@ -175,7 +176,11 @@ def with_split_retry(budget: MemoryBudget, conf: TpuConf,
         if done:
             continue
         if depth >= max_splits:
-            raise TpuRetryOOM(
+            # the split ladder is exhausted: escalate as the SPLIT
+            # variant so the query-level ladder (plan/overrides.py)
+            # knows splitting cannot help and replays through the
+            # out-of-core rung before the final whole-query replay
+            raise TpuSplitAndRetryOOM(
                 f"OOM persists after {depth} splits") from last_oom
         budget.metrics["batch_splits"] += 1
         BATCH_SPLITS.inc()

@@ -91,6 +91,11 @@ class QueryTicket:
         #: when the history plane is off: {device_us, working_set_bytes,
         #: compile_ms, confidence, basis, ...}
         self.predicted: Optional[dict] = None
+        #: admitted in OUT-OF-CORE mode: the working-set estimate
+        #: exceeded the HBM budget, so instead of running solo (and
+        #: serializing the queue) the query executes with the OOC tier
+        #: forced and a grant sized to the OOC resident window
+        self.ooc = False
         self.device_us = 0                # measured device-execute micros
         self.skips = 0                    # scheduler pass-overs at grant
         self.admit_wait_ms = 0.0
@@ -209,6 +214,7 @@ class ServingRuntime:
         self._max_depth = 0
         self._completed = 0
         self._admission_timeouts = 0
+        self._ooc_admissions = 0         # oversized queries admitted OOC
         #: recent (phase, ticket id, t0, t1) intervals — the overlap
         #: proof stats()["overlap_observed"] is computed from
         self._intervals: List[tuple] = []
@@ -370,10 +376,29 @@ class ServingRuntime:
             # yet — over-reserve rather than over-commit)
             est_bytes = max(est_bytes,
                             int(pred.get("working_set_bytes") or 0))
+        # OVERSIZED working set: instead of waiting for a solo slot
+        # (the `_runnable` escape hatch — one big query serializing the
+        # whole queue), admit in OUT-OF-CORE mode (ROADMAP 4's last
+        # clause): the query runs with the OOC tier forced, its actual
+        # resident footprint is the OOC window, and the grant is sized
+        # to that window so small-tenant queries keep overlapping it
+        if self._hbm_limit > 0 and est_bytes > self._hbm_limit:
+            from ..config import OOC_ENABLED, OOC_RESIDENT_FRACTION
+            if ticket.conf.get(OOC_ENABLED):
+                ticket.ooc = True
+                est_bytes = max(
+                    int(self._hbm_limit *
+                        float(ticket.conf.get(OOC_RESIDENT_FRACTION))), 1)
+                with self._cond:
+                    self._ooc_admissions += 1
+                from ..obs.registry import OOC_ELECTIONS
+                OOC_ELECTIONS.inc(op="query", mode="admission")
         with self._device_grant(ticket, est_bytes):
             with self._phase("execute", ticket):
                 from ..exec.plan import ExecContext
                 ctx = ExecContext(ticket.conf)
+                if ticket.ooc:
+                    ctx.ooc_force = True
                 ctx.metrics["serving.tenant"] = ticket.tenant
                 if pred:
                     # stamped pre-collect so the instrumented scope
@@ -530,6 +555,7 @@ class ServingRuntime:
                    "max_queue_depth": self._max_depth,
                    "max_skips": self._max_skips,
                    "admission_timeouts": self._admission_timeouts,
+                   "ooc_admissions": self._ooc_admissions,
                    "device_slots": self._device_slots,
                    "hbm_limit_bytes": self._hbm_limit,
                    "wall_s": round(wall_s, 3),

@@ -97,10 +97,15 @@ def test_reserve_oom_recovers_spilling_sort():
     assert "reserve" in fired_sites(s)
     m = df.metrics()
     assert m.get("memory.oom_retries", 0) + \
+        m.get("query_ooc_escalations", 0) + \
         m.get("query_oom_replays", 0) >= 1
 
 
 def test_execute_oom_replays_query():
+    """An OOM escaping every operator rung now escalates into the
+    OUT-OF-CORE rung first (ISSUE 15 ladder): the replay runs with the
+    OOC context forced, bit-identical, and the final whole-query replay
+    rung stays in reserve."""
     tbl = sort_tbl(2_000, seed=9)
     build = lambda s: s.from_arrow(tbl).filter(
         E.GreaterThan(col("v"), E.Literal(0.0)))
@@ -108,7 +113,15 @@ def test_execute_oom_replays_query():
     chaos, s, df = run_query(build, faults="execute:oom:nth=1")
     assert_identical(clean, chaos)
     assert "execute" in fired_sites(s)
-    assert df.metrics().get("query_oom_replays") == 1
+    assert df.metrics().get("query_ooc_escalations") == 1
+    assert df.metrics().get("query_oom_replays") is None
+
+    # with the OOC tier disabled the legacy replay rung still owns it
+    chaos2, s2, df2 = run_query(
+        build, {"spark.rapids.tpu.sql.ooc.enabled": "false"},
+        faults="execute:oom:nth=1")
+    assert_identical(clean, chaos2)
+    assert df2.metrics().get("query_oom_replays") == 1
 
 
 def test_h2d_ioerror_recovers():
@@ -753,6 +766,246 @@ def test_kernel_oom_sheds_encoded_probe_to_decoded_tier():
     assert log[0]["site"] == "kernel"
     # the injected-fault record names the encoded dispatch that shed
     assert log[0]["kernel"] == "predicate_code"
+
+
+# ---------------------------------------------------------------------------
+# ooc site: chaos INSIDE the out-of-core window (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+#: forces the OOC tier through small inputs (join byte gate + agg)
+OOC_CONF = {
+    "spark.rapids.tpu.memory.tpu.budgetBytes": 1 << 17,
+    "spark.rapids.tpu.sql.batchSizeRows": 1024,
+    "spark.rapids.tpu.sql.shape.minBucketRows": 256,
+    "spark.rapids.tpu.sql.ooc.force": "true",
+    "spark.rapids.tpu.retry.io.backoffMs": 0,
+}
+
+
+def _ooc_join_agg_df(s):
+    from spark_rapids_tpu.plan.aggregates import Sum
+    rng = np.random.default_rng(43)
+    fact = s.from_arrow(pa.table({
+        "fk": pa.array(rng.integers(0, 40, 4000), pa.int64()),
+        "v": pa.array(rng.standard_normal(4000))}))
+    dim = s.from_arrow(pa.table({
+        "k": pa.array(np.arange(50), pa.int64()),
+        "w": pa.array(np.arange(50) * 1.5)}))
+    return (fact.join(dim, left_on=["fk"], right_on=["k"], how="inner")
+            .group_by("fk").agg((Sum(col("v")), "sv")))
+
+
+def test_ooc_oom_mid_join_recovers_bit_identical():
+    """`ooc:oom:nth=1` fires at the FIRST out-of-core partition pass
+    (after its `ooc_state` instant): the OOM rides the ladder into the
+    OOC escalation rung and the replay — already spill-partitioned —
+    is bit-identical to the clean degraded run."""
+    clean, _s, _df = run_query(_ooc_join_agg_df, OOC_CONF)
+    chaos, s, df = run_query(_ooc_join_agg_df, OOC_CONF,
+                             faults="ooc:oom:nth=1")
+    assert_identical(clean, chaos)
+    log = get_injector(s.conf).log
+    assert log and log[0]["site"] == "ooc"
+    assert log[0]["op"] in ("join", "agg", "sort")
+    assert df.metrics().get("query_ooc_escalations", 0) == 1
+
+
+def test_ooc_fatal_dump_embeds_bucket_state(tmp_path):
+    """kind 'fatal' at the ooc site: the classified crash dump's
+    flight-recorder tail carries the `ooc_state` instants, so the
+    post-mortem names the exact partition pass that died."""
+    settings = {**OOC_CONF,
+                "spark.rapids.tpu.coredump.path": str(tmp_path)}
+    with pytest.raises(FatalDeviceError) as ei:
+        run_query(_ooc_join_agg_df, settings, faults="ooc:fatal:nth=2")
+    assert classify(ei.value) == FATAL_DEVICE
+    dump = json.load(open(ei.value.dump_path))
+    rec = dump["injected_faults"]
+    assert rec and rec[0]["site"] == "ooc" and rec[0]["kind"] == "fatal"
+    states = [e for e in dump["flight_recorder"]
+              if e.get("name") == "ooc_state"]
+    assert states, "dump carries no ooc bucket state"
+    attrs = states[-1]["attrs"]
+    assert "op" in attrs and "bucket" in attrs and "depth" in attrs
+
+
+# ---------------------------------------------------------------------------
+# mid-merge chaos inside the OutOfCoreSorter window (ISSUE 15 satellite:
+# the sweeps above never fired INSIDE the OOC merge — these do, by
+# splitting each site's deterministic hit counter at the add->merge
+# phase boundary and scheduling nth= just past it)
+# ---------------------------------------------------------------------------
+
+OOC_SORT_CONF = {
+    "spark.rapids.tpu.memory.tpu.budgetBytes": 1 << 16,
+    "spark.rapids.tpu.memory.host.spillStorageSize": 1 << 14,
+    "spark.rapids.tpu.sql.batchSizeRows": 1024,
+    "spark.rapids.tpu.sql.shape.minBucketRows": 256,
+    "spark.rapids.tpu.retry.io.backoffMs": 0,
+}
+
+#: never-firing counting rules: one per site whose add/merge hit split
+#: the scheduler below needs (hits increment identically in every run
+#: up to the first fire, so a dry run's counters place later runs'
+#: nth= triggers INSIDE the merge window deterministically)
+_COUNTING_SPEC = ("spill_read:ioerror:nth=999983;"
+                  "spill_write:ioerror:nth=999983;"
+                  "reserve:oom:nth=999983")
+
+
+def _drive_ooc_sorter(faults, n=24_000, seed=61):
+    """Feed the OutOfCoreSorter directly, recording each armed site's
+    hit counter AT THE ADD->MERGE BOUNDARY, then drain the merge.
+    Returns (values, ctx, injector, marks_at_merge_start)."""
+    from spark_rapids_tpu.columnar.device import to_host
+    from spark_rapids_tpu.exec.ooc_sort import OutOfCoreSorter
+    from spark_rapids_tpu.exec.plan import ExecContext, HostScanExec
+    from spark_rapids_tpu.ops.sort import SortKey
+    settings = dict(OOC_SORT_CONF)
+    settings["spark.rapids.tpu.test.faults"] = faults
+    conf = TpuConf(settings)
+    ctx = ExecContext(conf)
+    rng = np.random.default_rng(seed)
+    tbl = pa.table({"v": pa.array(rng.standard_normal(n))})
+    scan = HostScanExec.from_table(tbl, max_rows=1024)
+    sorter = OutOfCoreSorter([SortKey(0, True, True)], ctx)
+    for db in scan.execute(ctx):
+        sorter.add(db)
+    inj = get_injector(conf)
+    marks = {}
+    for r in getattr(inj, "rules", []):
+        marks[r.site] = marks.get(r.site, 0) + r.hits
+    out = []
+    for b in sorter.results():
+        hb = to_host(b)
+        out.extend(hb.rb.column(0).to_pylist()[:int(b.num_rows)])
+    return out, ctx, inj, marks
+
+
+def test_ooc_sorter_merge_actually_hits_spill_sites():
+    """Dry run (never-firing counters): the merge phase itself drives
+    spill reads/writes and budget reservations — the window the armed
+    tests below schedule their faults into."""
+    out, ctx, inj, marks = _drive_ooc_sorter(_COUNTING_SPEC)
+    assert out == sorted(out) and len(out) == 24_000
+    assert ctx.metrics.get("sort_merge_passes", 0) >= 2
+    totals = {r.site: r.hits for r in inj.rules}
+    for site in ("spill_read", "reserve"):
+        assert totals[site] > marks[site], \
+            f"{site} never fired inside the merge window"
+    # cache the split for the armed runs (deterministic per spec)
+    global _MERGE_MARKS
+    _MERGE_MARKS = marks
+
+
+_MERGE_MARKS = None
+
+
+def _merge_mark(site):
+    global _MERGE_MARKS
+    if _MERGE_MARKS is None:
+        _drive = _drive_ooc_sorter(_COUNTING_SPEC)
+        _MERGE_MARKS = _drive[3]
+    return _MERGE_MARKS[site]
+
+
+def test_spill_read_ioerror_mid_merge_recovers():
+    clean, _, _, _ = _drive_ooc_sorter(_COUNTING_SPEC)
+    nth = _merge_mark("spill_read") + 1
+    out, ctx, inj, _ = _drive_ooc_sorter(f"spill_read:ioerror:nth={nth}")
+    assert out == clean                    # bit-identical through retry.io
+    assert inj.log and inj.log[0]["site"] == "spill_read"
+    assert inj.log[0]["hit"] == nth        # fired INSIDE the merge
+    assert ctx.budget.metrics["io_retries"] >= 1
+
+
+def test_spill_write_ioerror_mid_merge_recovers():
+    clean, _, _, _ = _drive_ooc_sorter(_COUNTING_SPEC)
+    nth = _merge_mark("spill_write") + 1
+    out, ctx, inj, _ = _drive_ooc_sorter(
+        f"spill_write:ioerror:nth={nth}")
+    assert out == clean
+    assert inj.log and inj.log[0]["site"] == "spill_write"
+    assert inj.log[0]["hit"] == nth
+
+
+def test_reserve_oom_mid_merge_replays_bit_identical():
+    """A budget OOM INSIDE the merge window escapes the sorter; the
+    query ladder's answer is spill-everything + replay — re-driving the
+    sorter after spill_all reproduces the clean output bit-for-bit
+    (the one-shot rule already fired)."""
+    from spark_rapids_tpu.runtime.memory import TpuRetryOOM
+    from spark_rapids_tpu.columnar.device import to_host
+    from spark_rapids_tpu.exec.ooc_sort import OutOfCoreSorter
+    from spark_rapids_tpu.exec.plan import ExecContext, HostScanExec
+    from spark_rapids_tpu.ops.sort import SortKey
+    clean, _, _, _ = _drive_ooc_sorter(_COUNTING_SPEC)
+    nth = _merge_mark("reserve") + 1
+    settings = dict(OOC_SORT_CONF)
+    settings["spark.rapids.tpu.test.faults"] = f"reserve:oom:nth={nth}"
+    conf = TpuConf(settings)
+    ctx = ExecContext(conf)
+    rng = np.random.default_rng(61)
+    tbl = pa.table({"v": pa.array(rng.standard_normal(24_000))})
+
+    def drive():
+        scan = HostScanExec.from_table(tbl, max_rows=1024)
+        sorter = OutOfCoreSorter([SortKey(0, True, True)], ctx)
+        for db in scan.execute(ctx):
+            sorter.add(db)
+        out = []
+        for b in sorter.results():
+            hb = to_host(b)
+            out.extend(hb.rb.column(0).to_pylist()[:int(b.num_rows)])
+        return out
+
+    with pytest.raises(TpuRetryOOM):
+        drive()                            # dies INSIDE the merge
+    inj = get_injector(conf)
+    assert inj.log and inj.log[0]["site"] == "reserve" and \
+        inj.log[0]["hit"] == nth
+    ctx.budget.spill_all()                 # the ladder's replay recipe
+    assert drive() == clean
+
+
+def test_spill_read_corrupt_mid_merge_classified():
+    nth = _merge_mark("spill_read") + 1
+    with pytest.raises(CorruptBlockError) as ei:
+        _drive_ooc_sorter(f"spill_read:corrupt:nth={nth}")
+    assert classify(ei.value) == CORRUPTION
+    assert ei.value.path and "spill" in os.path.basename(ei.value.path)
+
+
+def test_ooc_fatal_mid_sorter_merge_dump_names_pass(tmp_path):
+    """`ooc:fatal:nth=2`: the SECOND merge pass dies; the crash dump's
+    flight tail shows the sort-window state (op=sort, merge_pass)."""
+    from spark_rapids_tpu.exec.ooc_sort import OutOfCoreSorter
+    from spark_rapids_tpu.exec.plan import ExecContext, HostScanExec
+    from spark_rapids_tpu.ops.sort import SortKey
+    from spark_rapids_tpu.runtime.failure import crash_capture
+    conf = TpuConf({**OOC_SORT_CONF,
+                    "spark.rapids.tpu.test.faults": "ooc:fatal:nth=2",
+                    "spark.rapids.tpu.coredump.path": str(tmp_path)})
+    ctx = ExecContext(conf)
+    rng = np.random.default_rng(61)
+    tbl = pa.table({"v": pa.array(rng.standard_normal(24_000))})
+    with pytest.raises(FatalDeviceError) as ei:
+        with crash_capture(conf):       # same conf: the dump embeds the
+            scan = HostScanExec.from_table(tbl, max_rows=1024)
+            sorter = OutOfCoreSorter([SortKey(0, True, True)], ctx)
+            for db in scan.execute(ctx):    # injected-fault record
+                sorter.add(db)
+            for _ in sorter.results():
+                pass
+    dump = json.load(open(ei.value.dump_path))
+    rec = dump["injected_faults"]
+    assert rec and rec[0]["site"] == "ooc" and rec[0]["kind"] == "fatal"
+    assert rec[0]["op"] == "sort" and rec[0]["merge_pass"] == "1"
+    states = [e for e in dump["flight_recorder"]
+              if e.get("name") == "ooc_state" and
+              e["attrs"].get("op") == "sort"]
+    assert states and states[-1]["attrs"].get("merge_pass") == 1
+    assert "runs" in states[-1]["attrs"]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,460 @@
+"""Out-of-core execution tier (ISSUE 15): budget-driven graceful
+degradation for hash join and aggregation.
+
+The contract under test: a query whose working set exceeds the HBM
+budget completes via spill-partitioned joins/aggregations (the
+`tpu_ooc_*` families prove the TIER carried it, not the query-level
+replay rung) and oracle-matches the resident run bit-for-bit; the
+sub-partition gate sizes by BYTES (wide payload rows trip it before
+the budget OOMs); skewed buckets re-partition recursively with a
+re-salted hash; and early abandonment (LIMIT) leaks neither budget
+bytes nor spill files — `Spillable.close` is idempotent by contract.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec import ooc as O
+from spark_rapids_tpu.exec.join import HashJoinExec
+from spark_rapids_tpu.exec.plan import (ExecContext, HashAggregateExec,
+                                        HostScanExec)
+from spark_rapids_tpu.obs.registry import (OOC_BYTES, OOC_ELECTIONS,
+                                           OOC_PARTITIONS, OOC_RECURSIONS)
+from spark_rapids_tpu.plan import expressions as E
+from spark_rapids_tpu.plan.aggregates import Count, Sum
+from spark_rapids_tpu.session import TpuSession, col
+
+
+def _fam_total(fam, **labels):
+    return sum(s["value"] for s in fam.series()
+               if all(s["labels"].get(k) == v for k, v in labels.items()))
+
+
+def _rows(tbl: pa.Table):
+    d = tbl.to_pydict()
+    names = sorted(d)
+    return sorted(
+        tuple(-1e18 if x is None else round(x, 6)
+              if isinstance(x, float) else x for x in row)
+        for row in zip(*(d[n] for n in names)))
+
+
+# ---------------------------------------------------------------------------
+# policy units
+# ---------------------------------------------------------------------------
+
+def test_partition_count_derives_from_bytes():
+    pol = O.OocPolicy(True, False, 1 << 20, 64, 3)
+    assert O.partition_count(1 << 20, pol) == 2        # fits one window
+    assert O.partition_count(5 << 20, pol) == 8        # ceil(5) -> pow2
+    assert O.partition_count(100 << 20, pol) == 64     # clamped
+    assert O.partition_count(0, pol) == 2              # floor
+    # the legacy row-derived count floors the byte-derived one
+    assert O.partition_count(1 << 20, pol, rows_k=16) == 16
+    # no window (no budget): rows decide, floored at 2
+    pol_inf = O.OocPolicy(True, False, None, 64, 3)
+    assert O.partition_count(1 << 40, pol_inf) == 2
+    assert O.partition_count(1 << 40, pol_inf, rows_k=8) == 8
+
+
+def test_policy_resolution_and_bytes_trip():
+    ctx = ExecContext(TpuConf(
+        {"spark.rapids.tpu.memory.tpu.budgetBytes": 1 << 20}))
+    pol = O.ooc_policy(ctx)
+    assert pol.window == 1 << 19            # residentFraction 0.5 default
+    assert pol.bytes_trip((1 << 19) + 1) and not pol.bytes_trip(1 << 19)
+    assert not pol.force
+    # escalated context forces; disabled tier never trips
+    ctx.ooc_force = True
+    assert O.ooc_policy(ctx).force
+    off = ExecContext(TpuConf(
+        {"spark.rapids.tpu.memory.tpu.budgetBytes": 1 << 20,
+         "spark.rapids.tpu.sql.ooc.enabled": False}))
+    pol_off = O.ooc_policy(off)
+    assert pol_off.window is None and not pol_off.bytes_trip(1 << 40)
+
+
+# ---------------------------------------------------------------------------
+# satellite: the sub-partition gate sizes by BYTES, not rows
+# ---------------------------------------------------------------------------
+
+def _wide_tables(n_left=1500, n_right=900, ncols=24, seed=7):
+    """Build side BELOW the legacy 2 x batchSizeRows row gate but far
+    above a small resident window in BYTES (wide payload rows)."""
+    rng = np.random.default_rng(seed)
+    lt = pa.table({"lk": pa.array(rng.integers(0, 300, n_left), pa.int64()),
+                   "lv": pa.array(rng.standard_normal(n_left))})
+    rcols = {"rk": pa.array(rng.integers(0, 300, n_right), pa.int64())}
+    for i in range(ncols):
+        rcols[f"w{i}"] = pa.array(rng.standard_normal(n_right))
+    return lt, pa.table(rcols)
+
+
+def _wide_conf(**extra):
+    return TpuConf({"spark.rapids.tpu.sql.batchSizeRows": 1024,
+                    "spark.rapids.tpu.sql.shape.minBucketRows": 256,
+                    "spark.rapids.tpu.memory.tpu.budgetBytes": 1 << 17,
+                    **extra})
+
+
+def test_wide_rows_trip_the_byte_gate():
+    lt, rt = _wide_tables()
+    ctx = ExecContext(_wide_conf())
+    j = HashJoinExec("inner", [E.ColumnRef("lk")], [E.ColumnRef("rk")],
+                     HostScanExec.from_table(lt, 512),
+                     HostScanExec.from_table(rt, 512))
+    got = j.collect(ctx)
+    # 900 build rows < 2 x 1024: the OLD row gate never tripped here —
+    # the measured-byte gate did (build bytes >> 64 KiB window)
+    assert ctx.metrics.get("join_subpartition_fallbacks", 0) == 1
+    assert ctx.metrics.get("ooc.join_elections", 0) == 1
+    assert ctx.metrics.get("ooc.join_partitions", 0) >= 2
+
+    # oracle: same join with the OOC tier off (resident build)
+    ctx2 = ExecContext(_wide_conf(
+        **{"spark.rapids.tpu.sql.ooc.enabled": False}))
+    j2 = HashJoinExec("inner", [E.ColumnRef("lk")], [E.ColumnRef("rk")],
+                      HostScanExec.from_table(lt, 512),
+                      HostScanExec.from_table(rt, 512))
+    exp = j2.collect(ctx2)
+    assert ctx2.metrics.get("join_subpartition_fallbacks", 0) == 0
+    assert _rows(got) == _rows(exp)
+
+
+def test_skewed_bucket_recursively_repartitions():
+    """One hot key owns the whole build side: the first scatter cannot
+    shrink its bucket, so the OOC join re-partitions it recursively
+    with a re-salted hash (bounded depth) instead of OOMing it."""
+    rng = np.random.default_rng(11)
+    n_r = 6000
+    rt = pa.table({"rk": pa.array(np.full(n_r, 42), pa.int64()),
+                   "rv": pa.array(rng.standard_normal(n_r)),
+                   "rw": pa.array(rng.standard_normal(n_r))})
+    lk = np.where(rng.random(2000) < 0.5, 42, 7).astype(np.int64)
+    lt = pa.table({"lk": pa.array(lk)})
+    conf = TpuConf({"spark.rapids.tpu.sql.batchSizeRows": 1024,
+                    "spark.rapids.tpu.sql.shape.minBucketRows": 256,
+                    "spark.rapids.tpu.memory.tpu.budgetBytes": 1 << 16})
+    r0 = _fam_total(OOC_RECURSIONS, op="join")
+    ctx = ExecContext(conf)
+    j = HashJoinExec("left_semi", [E.ColumnRef("lk")], [E.ColumnRef("rk")],
+                     HostScanExec.from_table(lt, 512),
+                     HostScanExec.from_table(rt, 512))
+    got = j.collect(ctx)
+    assert ctx.metrics.get("ooc.join_recursions", 0) >= 1
+    assert _fam_total(OOC_RECURSIONS, op="join") > r0
+    assert got.num_rows == int((lk == 42).sum())
+    assert set(got.column("lk").to_pylist()) == {42}
+
+
+# ---------------------------------------------------------------------------
+# satellite: close idempotent by contract; LIMIT leaks nothing
+# ---------------------------------------------------------------------------
+
+def test_spillable_close_is_idempotent_by_contract():
+    from spark_rapids_tpu.runtime.memory import MemoryBudget, Spillable
+    conf = TpuConf({"spark.rapids.tpu.memory.tpu.budgetBytes": 1 << 20})
+    budget = MemoryBudget(conf)
+    ctx = ExecContext(conf)
+    scan = HostScanExec.from_table(
+        pa.table({"v": pa.array(np.arange(100.0))}), 128)
+    db = next(iter(scan.execute(ctx)))
+    sp = Spillable(db, budget)
+    assert not sp.closed and sp.nbytes > 0
+    sp.close()
+    assert sp.closed and budget.live == 0
+    sp.close()                       # second close: releases nothing
+    sp.close()
+    assert budget.live == 0
+    assert budget.metrics["release_underflow"] == 0
+
+
+def test_limit_above_ooc_join_leaks_no_spill_files():
+    """LIMIT above a byte-gated OOC join abandons the generator early:
+    the cleanup sweep (which re-closes handles the bucket loop already
+    consumed — the idempotent-close contract) must leave zero budget
+    bytes, zero registered spillables and zero disk blocks."""
+    import os
+    lt, rt = _wide_tables(seed=13)
+    # tiny host tier forces the disk rung too
+    ctx = ExecContext(_wide_conf(
+        **{"spark.rapids.tpu.memory.host.spillStorageSize": 1 << 14,
+           "spark.rapids.tpu.retry.io.backoffMs": 0}))
+    j = HashJoinExec("inner", [E.ColumnRef("lk")], [E.ColumnRef("rk")],
+                     HostScanExec.from_table(lt, 512),
+                     HostScanExec.from_table(rt, 512))
+    it = j.execute(ctx)
+    next(it)                  # consume ONE batch
+    it.close()                # LIMIT-style abandonment
+    assert ctx.metrics.get("join_subpartition_fallbacks", 0) == 1
+    assert ctx.budget.live == 0, "leaked device budget bytes"
+    assert len(ctx.budget._spillables) == 0, "leaked spillable handles"
+    ddir = ctx.budget._disk_dir
+    assert ddir is None or os.listdir(ddir) == [], "leaked spill blocks"
+
+
+def test_limit_above_ooc_agg_leaks_nothing():
+    rng = np.random.default_rng(17)
+    n = 20_000
+    tbl = pa.table({"k": pa.array(rng.permutation(n).astype(np.int64)),
+                    "v": pa.array(np.ones(n))})
+    conf = TpuConf({"spark.rapids.tpu.sql.batchSizeRows": 1024,
+                    "spark.rapids.tpu.sql.shape.minBucketRows": 256,
+                    "spark.rapids.tpu.memory.tpu.budgetBytes": 1 << 17})
+    ctx = ExecContext(conf)
+    agg = HashAggregateExec([E.ColumnRef("k")], ["k"],
+                            [(Count(None), "c")],
+                            HostScanExec.from_table(tbl, 1024))
+    it = agg.execute(ctx)
+    next(it)
+    it.close()
+    assert ctx.metrics.get("ooc.agg_elections", 0) >= 1
+    assert ctx.budget.live == 0
+    assert len(ctx.budget._spillables) == 0
+
+
+# ---------------------------------------------------------------------------
+# OOC aggregation: byte gate + exact union
+# ---------------------------------------------------------------------------
+
+def test_ooc_agg_byte_gate_matches_resident_run():
+    """WIDE aggregation buffers: accumulated partial bytes exceed the
+    resident window while the row count alone would not have tripped
+    yet — the election records mode=bytes, and the key-disjoint bucket
+    union is exact."""
+    rng = np.random.default_rng(19)
+    n = 12_000
+    cols = {"k": pa.array(rng.integers(0, 1500, n), pa.int64())}
+    for i in range(12):
+        cols[f"v{i}"] = pa.array(rng.standard_normal(n))
+    tbl = pa.table(cols)
+
+    def run(extra):
+        ctx = ExecContext(TpuConf(
+            {"spark.rapids.tpu.sql.batchSizeRows": 1024,
+             "spark.rapids.tpu.sql.shape.minBucketRows": 256, **extra}))
+        agg = HashAggregateExec(
+            [E.ColumnRef("k")], ["k"],
+            [(Sum(E.ColumnRef(f"v{i}")), f"s{i}") for i in range(12)],
+            HostScanExec.from_table(tbl, 1024))
+        return agg.collect(ctx), ctx
+
+    b0 = _fam_total(OOC_ELECTIONS, op="agg", mode="bytes")
+    got, ctx = run({"spark.rapids.tpu.memory.tpu.budgetBytes": 1 << 17})
+    assert ctx.metrics.get("ooc.agg_elections", 0) >= 1
+    assert ctx.metrics.get("ooc.agg_partitions", 0) >= 2
+    assert _fam_total(OOC_ELECTIONS, op="agg", mode="bytes") > b0
+
+    b1 = _fam_total(OOC_ELECTIONS, op="agg", mode="bytes")
+    exp, ctx2 = run({})                    # unlimited budget: resident
+    assert _fam_total(OOC_ELECTIONS, op="agg", mode="bytes") == b1
+    assert _rows(got) == _rows(exp)
+
+
+# ---------------------------------------------------------------------------
+# forced / escalated / proactive election
+# ---------------------------------------------------------------------------
+
+def _join_agg_query(s):
+    rng = np.random.default_rng(23)
+    fact = s.from_arrow(pa.table({
+        "fk": pa.array(rng.integers(0, 50, 4000), pa.int64()),
+        "v": pa.array(rng.standard_normal(4000))}))
+    dim = s.from_arrow(pa.table({
+        "k": pa.array(np.arange(60), pa.int64()),
+        "w": pa.array(np.arange(60) * 1.5)}))
+    return (fact.join(dim, left_on=["fk"], right_on=["k"], how="inner")
+            .group_by("fk").agg((Sum(col("v")), "sv"), (Count(None), "c")))
+
+
+def test_forced_ooc_bit_identical_and_annotated():
+    s0 = TpuSession({})
+    clean = _join_agg_query(s0).collect()
+    f0 = _fam_total(OOC_ELECTIONS, mode="forced")
+    p0 = _fam_total(OOC_PARTITIONS)
+    b0 = _fam_total(OOC_BYTES)
+    s = TpuSession({"spark.rapids.tpu.sql.ooc.force": "true",
+                    "spark.rapids.tpu.memory.tpu.budgetBytes":
+                        str(1 << 20)})
+    df = _join_agg_query(s)
+    got = df.collect()
+    assert _rows(got) == _rows(clean)
+    assert _fam_total(OOC_ELECTIONS, mode="forced") > f0
+    assert _fam_total(OOC_PARTITIONS) > p0
+    assert _fam_total(OOC_BYTES) > b0
+    # EXPLAIN ANALYZE carries the ooc head line for the degraded run
+    rep = df.physical().explain_analyze()
+    assert rep.ooc, "report carries no ooc section"
+    assert any(line.startswith("ooc ")
+               for line in rep.render().splitlines())
+
+
+def test_proactive_election_from_measured_working_set(monkeypatch):
+    """The cost oracle's MEASURED-basis working set above the budget
+    elects OOC at plan time (exec/ooc.py elect_proactive)."""
+    from spark_rapids_tpu.obs import estimator as est_mod
+    calls = {}
+
+    def fake_estimate(pq):
+        calls["n"] = calls.get("n", 0) + 1
+        return {"ws_basis": "measured", "working_set_bytes": 1 << 30,
+                "basis": "exact_history"}
+
+    monkeypatch.setattr(est_mod, "estimate_query", fake_estimate)
+    conf = TpuConf({"spark.rapids.tpu.memory.tpu.budgetBytes": 1 << 20})
+    ctx = ExecContext(conf)
+
+    class FakePQ:
+        pass
+
+    assert O.elect_proactive(FakePQ(), ctx) is True
+    assert ctx.ooc_force is True
+    assert ctx.metrics.get("ooc.query_elections") == 1
+    # below the budget, or a non-measured basis: no election
+    ctx2 = ExecContext(conf)
+    monkeypatch.setattr(
+        est_mod, "estimate_query",
+        lambda pq: {"ws_basis": "measured", "working_set_bytes": 1})
+    assert O.elect_proactive(FakePQ(), ctx2) is False
+    monkeypatch.setattr(
+        est_mod, "estimate_query",
+        lambda pq: {"ws_basis": "source", "working_set_bytes": 1 << 30})
+    assert O.elect_proactive(FakePQ(), ctx2) is False
+    assert not ctx2.ooc_force
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a q3-class join+aggregation under a budget smaller than
+# its working set completes via the OOC tier, not the replay rung
+# ---------------------------------------------------------------------------
+
+def test_q3_class_query_under_budget_runs_via_ooc_tier():
+    """Acceptance bar (tier-1 form): a join+aggregation query whose
+    working set exceeds the HBM budget completes and oracle-matches
+    VIA the OOC tier — spill-partitioned join (byte-gated: the build
+    is wide, not long) and spill-partitioned aggregation — with the
+    query-level replay rung never firing."""
+    rng = np.random.default_rng(29)
+    n_f, n_d = 15_000, 1500
+    fact = pa.table({"fk": pa.array(rng.integers(0, n_d, n_f), pa.int64()),
+                     "g": pa.array(rng.integers(0, 4000, n_f),
+                                   pa.int64()),
+                     "v": pa.array(rng.standard_normal(n_f))})
+    dcols = {"k": pa.array(np.arange(n_d), pa.int64())}
+    for i in range(10):
+        dcols[f"w{i}"] = pa.array(rng.standard_normal(n_d))
+    dim = pa.table(dcols)
+
+    def build(s):
+        f = s.from_arrow(fact)
+        d = s.from_arrow(dim)
+        # every wide dim column is aggregated, so column pruning keeps
+        # the build side wide — the BYTE gate, not the row gate, is
+        # what elects the OOC join (900-odd build rows per batch)
+        return (f.join(d, left_on=["fk"], right_on=["k"], how="inner")
+                .group_by("g").agg((Sum(col("v")), "sv"),
+                                   *[(Sum(col(f"w{i}")), f"sw{i}")
+                                     for i in range(10)],
+                                   (Count(None), "c")))
+
+    s_clean = TpuSession({})
+    clean = build(s_clean).collect()
+
+    p0 = _fam_total(OOC_PARTITIONS)
+    e0 = _fam_total(OOC_ELECTIONS)
+    s = TpuSession({"spark.rapids.tpu.memory.tpu.budgetBytes":
+                        str(1 << 18),
+                    "spark.rapids.tpu.sql.batchSizeRows": "1024",
+                    "spark.rapids.tpu.sql.shape.minBucketRows": "256"})
+    df = build(s)
+    got = df.collect()
+    assert _rows(got) == _rows(clean)
+    m = df.metrics()
+    # the TIER carried it: ooc elections + partitions happened, spilling
+    # happened, and the query-level replay rung never fired
+    assert m.get("ooc.join_elections", 0) >= 1
+    assert m.get("ooc.agg_elections", 0) >= 1
+    assert m.get("ooc.agg_partitions", 0) + m.get("ooc.join_partitions",
+                                                  0) >= 4
+    assert m.get("memory.spilled_batches", 0) >= 1
+    assert m.get("query_oom_replays") is None
+    assert _fam_total(OOC_PARTITIONS) > p0
+    assert _fam_total(OOC_ELECTIONS) > e0
+
+
+def test_check_regression_gates_oc_entries(tmp_path):
+    """scripts/check_regression.py mines `ooc_timings_ms` into
+    oc:-prefixed entries and fails on a 2x capped-leg regression, under
+    the same backend-separation rule as qN / mc: / sv: / kn: / en:
+    timings."""
+    import json
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(root, "scripts", "check_regression.py")
+    base = {"backend": "cpu",
+            "ooc_timings_ms": {"q3_capped": 5000.0, "q3_uncapped": 800.0}}
+    good = {"backend": "cpu",
+            "ooc_timings_ms": {"q3_capped": 5200.0, "q3_uncapped": 790.0}}
+    bad = {"backend": "cpu",
+           "ooc_timings_ms": {"q3_capped": 10000.0,
+                              "q3_uncapped": 820.0}}
+    other_hw = {"backend": "tpu",
+                "ooc_timings_ms": {"q3_capped": 10000.0,
+                                   "q3_uncapped": 820.0}}
+    paths = {}
+    for name, doc in (("base", base), ("good", good), ("bad", bad),
+                      ("other", other_hw)):
+        p = tmp_path / f"{name}.json"
+        p.write_text(json.dumps(doc))
+        paths[name] = str(p)
+
+    def gate(current, trajectory):
+        return subprocess.run(
+            [sys.executable, script, "--current", current, *trajectory],
+            capture_output=True, text=True)
+
+    r = gate(paths["good"], [paths["base"]])
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = gate(paths["bad"], [paths["base"]])
+    assert r.returncode == 1
+    assert "oc:q3_capped" in r.stdout
+    # backend separation: a tpu-tagged 2x result never gates against
+    # the cpu baseline
+    r = gate(paths["other"], [paths["base"]])
+    assert r.returncode == 2 or "skipping" in r.stdout + r.stderr
+    # the COMMITTED record parses and carries gate entries
+    committed = os.path.join(root, "OOC_r15.json")
+    if os.path.exists(committed):
+        sys.path.insert(0, root)
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("check_reg", script)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        qs, backend, _ = mod.load_file(committed)
+        assert any(k.startswith("oc:") for k in qs) and backend == "cpu"
+
+
+@pytest.mark.slow
+def test_tpch_q3_under_budget_via_ooc_tier_slow():
+    """The real-workload form of the acceptance bar: TPC-H q3 at SF0.01
+    under a 100 KB budget (well below its measured multi-MB working
+    set) oracle-matches through the OOC tier; `bench.py --ooc` runs the
+    q3/q9/q18 leg at benchmark scale."""
+    from spark_rapids_tpu import tpch
+    tables = tpch.gen_tables(scale=0.01)
+    s_clean = TpuSession({})
+    clean = tpch.QUERIES["q3"](s_clean, tables).collect()
+    s = TpuSession({"spark.rapids.tpu.memory.tpu.budgetBytes": "100000",
+                    "spark.rapids.tpu.sql.batchSizeRows": "2048",
+                    "spark.rapids.tpu.sql.shape.minBucketRows": "256"})
+    df = tpch.QUERIES["q3"](s, tables)
+    got = df.collect()
+    assert _rows(got) == _rows(clean)
+    m = df.metrics()
+    assert m.get("ooc.join_elections", 0) + \
+        m.get("ooc.agg_elections", 0) >= 1
+    assert m.get("memory.spilled_batches", 0) >= 1
+    assert m.get("query_oom_replays") is None

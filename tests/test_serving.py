@@ -507,6 +507,85 @@ def test_check_regression_gates_sv_entries(tmp_path):
     assert r.returncode == 2 or "skipping" in r.stdout + r.stderr
 
 
+def test_oversized_query_admitted_ooc_does_not_serialize_queue():
+    """ISSUE 15 serving regression: a query whose working-set estimate
+    exceeds the HBM budget used to run SOLO — while it executed,
+    `_device_bytes` sat above the limit and every small tenant waited.
+    Now it is admitted in OUT-OF-CORE mode: the grant is sized to the
+    OOC resident window, the query executes with the OOC tier forced
+    (spilling, not betting on the OOM ladder), and small-tenant queries
+    keep overlapping its execute phase with bounded latency."""
+    import numpy as np
+    rng = np.random.default_rng(47)
+    n = 300_000
+    big_tbl = pa.table({"k": pa.array(rng.integers(0, 20_000, n),
+                                      pa.int64()),
+                        "x": pa.array(rng.standard_normal(n)),
+                        "y": pa.array(np.arange(n))})
+    small_tbl = _table(400)
+    s = TpuSession({"spark.rapids.tpu.memory.tpu.budgetBytes":
+                        str(1 << 20)})
+    try:
+        rt = s.serving({
+            "spark.rapids.tpu.serving.workers": "6",
+            "spark.rapids.tpu.serving.deviceSlots": "4",
+            "spark.rapids.tpu.serving.resultCache.bytes": "0"})
+        big = rt.tenant("big")
+        small = rt.tenant("small")
+        big_df = _query(s, big_tbl)
+        small_df = _query(s, small_tbl)
+        expected_big = _rows(_query(s, big_tbl).collect())
+        expected_small = _rows(small_df.collect())
+
+        t0 = time.perf_counter()
+        tk_big = big.submit(big_df)
+        # wait until the big query actually holds a device grant
+        deadline = time.perf_counter() + 60
+        while rt._device_active == 0 and not tk_big.done() and \
+                time.perf_counter() < deadline:
+            time.sleep(0.005)
+        small_lat = []
+        lock = threading.Lock()
+
+        def client():
+            c0 = time.perf_counter()
+            out = small.collect(_query(s, small_tbl))
+            with lock:
+                small_lat.append(time.perf_counter() - c0)
+                assert _rows(out) == expected_small
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert _rows(tk_big.result(300)) == expected_big
+        big_wall = time.perf_counter() - t0
+
+        # admitted OOC, grant capped to the resident window
+        assert tk_big.ooc is True
+        assert tk_big._grant_est <= (1 << 20) // 2
+        st = rt.stats()
+        assert st["ooc_admissions"] == 1
+        # NOT serialized: at least one small execute interval overlaps
+        # the big query's execute interval
+        with rt._cond:
+            intervals = list(rt._intervals)
+        big_exec = [iv for iv in intervals
+                    if iv[0] == "execute" and iv[1] == tk_big.id]
+        small_exec = [iv for iv in intervals
+                      if iv[0] == "execute" and iv[1] != tk_big.id]
+        assert big_exec and small_exec
+        e0, e1 = big_exec[0][2], big_exec[0][3]
+        assert any(t0_ < e1 and e0 < t1_
+                   for _, _, t0_, t1_ in small_exec), \
+            "small tenants serialized behind the oversized query"
+        # small-tenant latency bounded while the big query spills
+        assert max(small_lat) < big_wall
+    finally:
+        s.close()
+
+
 def test_hbm_admission_gates_device_overlap():
     """With a tiny HBM budget, working-set estimates serialize device
     phases instead of overlapping them — and everything still
