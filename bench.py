@@ -154,6 +154,23 @@ class Suite:
         # performance-history store stats when --history-dir recorded
         # this run (structures, records, calibration)
         self.history = None
+        # measured per-backend dispatch floor (exec/compiled.py), the
+        # irreducible ms one compiled-program launch costs here
+        self.dispatch_floor_ms = None
+
+    def overhead_share(self):
+        """Suite-level fixed-overhead fraction: dispatch + seam + pad
+        waste over the summed profiled walls of queries that carried a
+        wall_breakdown embed; None before any did."""
+        ov = wall = 0.0
+        for v in self.per_q.values():
+            bd = v.get("wall_breakdown")
+            if isinstance(bd, dict) and bd.get("wall_ms"):
+                wall += float(bd["wall_ms"])
+                ov += float(bd.get("dispatch_ms", 0.0)) \
+                    + float(bd.get("seam_ms", 0.0)) \
+                    + float(bd.get("pad_waste_ms", 0.0))
+        return round(ov / wall, 4) if wall else None
 
     def coverage(self) -> dict:
         """Operator-coverage matrix: which queries run device-clean,
@@ -224,6 +241,8 @@ class Suite:
             "pcache": pcache,
             "tunnel_rtt_ms": round(self.rtt * 1e3, 1),
             "metrics_overhead": self.metrics_overhead,
+            "dispatch_floor_ms": self.dispatch_floor_ms,
+            "overhead_share": self.overhead_share(),
             "history": self.history,
             "elapsed_s": round(time.perf_counter() - _T0, 1),
             "note": "warm single-shot wall per query (one whole-plan XLA "
@@ -265,6 +284,16 @@ def run_suite(suite_name: str, scale: float, query_names):
     rtt = measure_rtt()
     print(f"# backend={jax.default_backend()} tunnel RTT ~{rtt*1e3:.0f}ms "
           f"per host sync", file=sys.stderr)
+    # the measured per-backend dispatch floor: header context for every
+    # per-query wall_breakdown embed below (fail-soft — its absence
+    # loses one report line, never the run)
+    try:
+        from spark_rapids_tpu.exec.compiled import dispatch_floor_ms
+        floor = round(dispatch_floor_ms(), 4)
+        print(f"# dispatch floor ~{floor:.3f}ms per compiled-program "
+              f"launch on {jax.default_backend()}", file=sys.stderr)
+    except Exception:                        # noqa: BLE001
+        floor = None
 
     t0 = time.perf_counter()
     tables = workload.gen_tables(scale=scale)
@@ -285,6 +314,7 @@ def run_suite(suite_name: str, scale: float, query_names):
 
     suite = Suite(suite_name, scale, rtt)
     suite.extra_conf = dict(EXTRA_CONF)
+    suite.dispatch_floor_ms = floor
     for name in query_names:
         if left() < 20:
             suite.skipped.append(name)
@@ -369,6 +399,12 @@ def run_suite(suite_name: str, scale: float, query_names):
                 for hk in ("hbm_peak_bytes", "hbm_measured_working_set"):
                     if profile.get(hk):
                         suite.per_q[name][hk] = int(profile[hk])
+                # the wall-decomposition embed: top-level per query so
+                # check_regression.py can gate seam-count and
+                # pad-waste-share growth next to device_ms and hbm
+                bd = profile.get("wall_breakdown")
+                if isinstance(bd, dict) and bd.get("wall_ms"):
+                    suite.per_q[name]["wall_breakdown"] = bd
             print(f"# {name}: device={dt*1e3:.0f}ms cpu={ct*1e3:.0f}ms "
                   f"x{ct/dt:.2f} cold={cold_s:.1f}s "
                   f"compiled={bool(compiled)} match={match}",

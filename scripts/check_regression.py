@@ -342,6 +342,51 @@ def load_hbm(path: str) -> dict:
         return {}
 
 
+def extract_overheads(doc) -> dict:
+    """-> {query: {seam_count, seam_ms, dispatch_ms, pad_waste_ms,
+    pad_waste_share}} from the per-query wall_breakdown embeds bench
+    records carry (the wall-decomposition plane, ISSUE 18) — {} for
+    records predating it.  seam_count and pad_waste_share gate like
+    device_ms under the same backend-separation rule: a PR that adds a
+    seam round-trip or blows up bucket padding fails CI even when its
+    wall time holds at this scale."""
+    out = {}
+    if not isinstance(doc, dict):
+        return out
+    for key, val in doc.items():
+        if key.endswith("_suite_queries") and isinstance(val, dict):
+            for q, rec in val.items():
+                bd = rec.get("wall_breakdown") \
+                    if isinstance(rec, dict) else None
+                if not isinstance(bd, dict) or not bd.get("wall_ms"):
+                    continue
+                wall = float(bd["wall_ms"])
+                pad = float(bd.get("pad_waste_ms") or 0.0)
+                out[q] = {
+                    "seam_count": int(bd.get("seam_count") or 0),
+                    "seam_ms": float(bd.get("seam_ms") or 0.0),
+                    "dispatch_ms": float(bd.get("dispatch_ms") or 0.0),
+                    "pad_waste_ms": pad,
+                    "pad_waste_share": pad / wall if wall else 0.0,
+                }
+    if out:
+        return out
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        return extract_overheads(parsed)
+    return out
+
+
+def load_overheads(path: str) -> dict:
+    """{query: overhead fields} of one trajectory file ({} on any read
+    problem — like hbm, absence never fails the gate by itself)."""
+    try:
+        with open(path) as f:
+            return extract_overheads(json.load(f))
+    except (OSError, json.JSONDecodeError, ValueError):
+        return {}
+
+
 def extract_queries(doc):
     """-> (query name -> net device_ms, backend tag) from any accepted
     result shape; ({}, backend) when the document carries no per-query
@@ -525,6 +570,21 @@ def main(argv=None) -> int:
     ap.add_argument("--hbm-min-bytes", type=float, default=float(1 << 20),
                     help="absolute floor below which HBM peaks are "
                          "noise, never regressions (default 1 MiB)")
+    ap.add_argument("--seam-threshold", type=float, default=0.25,
+                    help="fractional per-query seam-count growth that "
+                         "fails (default 0.25 = +25%%; the "
+                         "wall-decomposition plane's seam brackets)")
+    ap.add_argument("--seam-min", type=float, default=2,
+                    help="seam-count floor below which growth is noise, "
+                         "never a regression (default 2: 1 -> 1 never "
+                         "fails, 1 -> 2 does)")
+    ap.add_argument("--pad-threshold", type=float, default=0.25,
+                    help="fractional per-query pad-waste-share growth "
+                         "that fails (default 0.25 = +25%%; share = "
+                         "pad_waste_ms / profiled wall)")
+    ap.add_argument("--pad-min-share", type=float, default=0.05,
+                    help="pad-waste share floor below which growth is "
+                         "noise, never a regression (default 0.05)")
     ap.add_argument("--history-dir",
                     help="performance-history dir "
                          "(spark.rapids.tpu.history.dir): when the "
@@ -680,7 +740,49 @@ def main(argv=None) -> int:
                   f"query peak(s) within +{args.hbm_threshold:.0%} of "
                   f"baseline")
 
-    if res["regressions"] or compile_reg or hbm_regs:
+    # -- overhead gates: per-query seam count and pad-waste share (the
+    # wall-decomposition plane), best-of baseline, same backend rule
+    overhead_regs = []
+    cur_ov = load_overheads(current_name) \
+        if os.path.exists(current_name) else {}
+    if cur_ov:
+        base_ov = {}
+        for p in baseline_files:
+            for q, rec in load_overheads(p).items():
+                tgt = base_ov.get(q)
+                if tgt is None:
+                    base_ov[q] = dict(rec)
+                else:
+                    for fk in ("seam_count", "pad_waste_share"):
+                        tgt[fk] = min(tgt[fk], rec[fk])
+        shared = sorted(set(cur_ov) & set(base_ov),
+                        key=lambda s: (len(s), s))
+        for q in shared:
+            cur_n = cur_ov[q]["seam_count"]
+            base_n = base_ov[q]["seam_count"]
+            if cur_n > base_n * (1.0 + args.seam_threshold) and \
+                    cur_n >= args.seam_min:
+                overhead_regs.append((q, "seam_count", cur_n, base_n))
+                print(f"  SEAM REGRESSION {q}: {cur_n} seam(s) vs "
+                      f"{base_n} baseline (each seam is a host "
+                      f"round-trip + re-bucket; threshold "
+                      f"+{args.seam_threshold:.0%})")
+            cur_s = cur_ov[q]["pad_waste_share"]
+            base_s = base_ov[q]["pad_waste_share"]
+            if cur_s > base_s * (1.0 + args.pad_threshold) and \
+                    cur_s > args.pad_min_share:
+                overhead_regs.append((q, "pad_waste_share", cur_s,
+                                      base_s))
+                print(f"  PAD-WASTE REGRESSION {q}: "
+                      f"{cur_s:.1%} of profiled wall vs {base_s:.1%} "
+                      f"baseline (bucket-quantization tax; threshold "
+                      f"+{args.pad_threshold:.0%})")
+        if not overhead_regs and shared:
+            print(f"  overhead ok: {len(shared)} query breakdown(s) "
+                  f"within +{args.seam_threshold:.0%} seams / "
+                  f"+{args.pad_threshold:.0%} pad share of baseline")
+
+    if res["regressions"] or compile_reg or hbm_regs or overhead_regs:
         if res["regressions"]:
             print(f"{len(res['regressions'])} per-query regression(s) "
                   f"beyond +{args.threshold:.0%}")

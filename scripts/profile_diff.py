@@ -62,14 +62,24 @@ def _eventlog_families(path: str) -> dict:
     inc = {k: float(v) for k, v in prof.incidents().items()}
     if inc:
         fams["incidents"] = inc
+    # the wall-decomposition plane: fixed-overhead tail per category
+    # (seam wall, dispatch floor x launches, padding waste) — a diff
+    # here names the overhead a refactor added or removed even when
+    # device ms held still
+    bd = prof.wall_breakdown()
+    ov = {k: float(bd[k]) for k in ("seam_ms", "dispatch_ms",
+                                    "pad_waste_ms", "seam_count")
+          if bd.get(k)}
+    if ov:
+        fams["overhead"] = ov
     return fams
 
 
 def _bench_families(path: str) -> dict:
     from check_regression import (extract_compile_ms, extract_hbm,
                                   extract_kernels, extract_multichip,
-                                  extract_queries, extract_segments,
-                                  extract_serving)
+                                  extract_overheads, extract_queries,
+                                  extract_segments, extract_serving)
     with open(path) as f:
         doc = json.load(f)
     fams = {}
@@ -97,6 +107,14 @@ def _bench_families(path: str) -> dict:
     hbm = extract_hbm(doc)
     if hbm:
         fams["hbm"] = hbm
+    # per-query overhead tails (wall_breakdown embeds): seam/dispatch/
+    # pad-waste ms keyed q/field, so "q4 gained a seam" reads directly
+    ovs = extract_overheads(doc)
+    flat_ov = {f"{q}/{k}": float(v) for q, per in ovs.items()
+               for k, v in per.items()
+               if k != "pad_waste_share" and v}
+    if flat_ov:
+        fams["overhead"] = flat_ov
     cms = extract_compile_ms(doc)
     if cms:
         fams["compile"] = {"median_compile_ms":
@@ -175,7 +193,8 @@ def self_test() -> int:
     """Built-in proof the diff works end to end: (1) a synthetic A/B
     orders regressions and improvements correctly; (2) a synthetic
     event-log pair diffs per segment; (3) kernel (kn:) and serving
-    (sv:) records load and diff as their own families; (4) the
+    (sv:) records load and diff as their own families; (3c) a
+    seam-elimination win surfaces in the overhead family; (4) the
     committed MULTICHIP trajectory reproduces the PR 8 fused-groupby
     win (119.4s -> 11.1s) as an `mc:`-keyed improvement, and the
     committed KERNELS record loads as a kernels family."""
@@ -247,6 +266,28 @@ def self_test() -> int:
         reg = res["hbm"]["regressed"]
         assert reg and reg[0]["entry"] == "q3", res["hbm"]
         assert abs(reg[0]["ratio"] - 4.0) < 1e-9
+
+    # 3c: seam-elimination win (wall-decomposition plane): B fuses the
+    # plan so one row-collapse seam disappears — the overhead family
+    # must show q4's seam wall and seam count improving even though
+    # net device ms is unchanged
+    def seam_doc(seam_count, seam_ms):
+        return {"backend": "cpu", "tpch_suite_queries": {
+            "q4": {"device_ms_net": 80.0, "wall_breakdown": {
+                "wall_ms": 200.0, "seam_ms": seam_ms,
+                "seam_count": seam_count, "dispatch_ms": 3.0,
+                "pad_waste_ms": 2.0}}}}
+    with tempfile.TemporaryDirectory() as td:
+        sa = os.path.join(td, "BENCH_a.json")
+        sb = os.path.join(td, "BENCH_b.json")
+        json.dump(seam_doc(2, 24.0), open(sa, "w"))
+        json.dump(seam_doc(1, 6.0), open(sb, "w"))
+        res = diff_families(load_families(sa), load_families(sb))
+        imp = res["overhead"]["improved"]
+        assert imp and imp[0]["entry"] == "q4/seam_ms", res["overhead"]
+        assert abs(imp[0]["ratio"] - 0.25) < 1e-9
+        assert any(r["entry"] == "q4/seam_count" for r in imp), imp
+        assert not res["overhead"]["regressed"], res["overhead"]
 
     # 4: the committed trajectory reproduces the PR 8 groupby win
     r05 = os.path.join(_ROOT, "MULTICHIP_r05.json")

@@ -414,6 +414,12 @@ def to_device(hb: HostBatch, conf: TpuConf = DEFAULT_CONF,
     dictionary encoding applies to every upload when the policy is on —
     a pure representation change, safe for any consumer."""
     cap = capacity or bucket_capacity(max(hb.num_rows, 1), conf)
+    if cap > hb.num_rows:
+        # always-on pad accounting at bucket time: the rows the capacity
+        # bucket adds over the live count (the overhead plane's upload
+        # site; profiled segment dispatches price this padding in ms)
+        from ..obs.registry import PAD_ROWS
+        PAD_ROWS.inc(cap - hb.num_rows, site="upload")
     from ..ops.encodings import encoding_policy
     pol = encoding_policy(conf)
     if not pol.any_enabled:

@@ -48,6 +48,41 @@ from ..config import TpuConf
 from .plan import ExecContext, HostScanExec, PlanNode
 
 
+_DISPATCH_FLOOR: Dict[str, float] = {}
+_DISPATCH_FLOOR_LOCK = threading.Lock()
+
+
+def dispatch_floor_ms(backend: Optional[str] = None) -> float:
+    """Measured per-backend floor of one compiled-program dispatch, in ms.
+
+    Times a trivially small pre-compiled program (warm, synced) and keeps
+    the best of a few repeats — everything below this floor is runtime
+    plumbing (argument flattening, executable call, stream sync), not
+    compute, so it is the irreducible per-dispatch tax the overhead
+    attribution plane charges to the `dispatch` category.  Cached per
+    backend for the process lifetime; the microbenchmark itself costs a
+    few ms once, so it only runs lazily from profiled paths."""
+    import time as _time
+    b = backend or jax.default_backend()
+    v = _DISPATCH_FLOOR.get(b)
+    if v is not None:
+        return v
+    with _DISPATCH_FLOOR_LOCK:
+        v = _DISPATCH_FLOOR.get(b)
+        if v is not None:
+            return v
+        fn = jax.jit(lambda x: x + 1)
+        x = jnp.zeros(8, jnp.int32)
+        jax.block_until_ready(fn(x))          # compile outside the timing
+        best = float("inf")
+        for _ in range(5):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(fn(x))
+            best = min(best, _time.perf_counter() - t0)
+        v = _DISPATCH_FLOOR[b] = best * 1e3
+    return v
+
+
 def _find_scans(root: PlanNode) -> List[PlanNode]:
     """Leaves whose batches become jit inputs: host scans (uploaded) and
     device-resident split seams (already on device)."""
@@ -835,6 +870,10 @@ class CompiledPlan:
         m = ctx.metrics
         m["exec_device_ms"] = m.get("exec_device_ms", 0.0) \
             + (t1 - t0) * 1e3
+        # always-on dispatch count: the overhead plane (and the history
+        # feed) multiplies it by the measured per-backend dispatch floor
+        # when no profiled decomposition exists for this run
+        m["exec_dispatches"] = m.get("exec_dispatches", 0) + 1
         # always-on measured working-set floor: the largest XLA
         # memory_analysis() footprint this query dispatched (args +
         # output + temp + code, captured at compile time — no conf
@@ -852,11 +891,12 @@ class CompiledPlan:
             db, i = _rebuild_batch(flat_res, spec, i)
             outs.append(db)
         if prof:
-            self._record_segment(ctx, t0, t1, outs, mrec)
+            self._record_segment(ctx, t0, t1, outs, mrec, pairs)
         return outs
 
     def _record_segment(self, ctx: ExecContext, t0: float, t1: float,
-                        outs: List[DeviceBatch], mrec=None) -> None:
+                        outs: List[DeviceBatch], mrec=None,
+                        pairs=None) -> None:
         """Attribute one measured program execution to its plan segment:
         the root node id + the preorder node-id range the program covers
         in the CURRENT tree (split-seam leaves excluded), output rows
@@ -885,14 +925,48 @@ class CompiledPlan:
         SEGMENT_DEVICE_MS.observe(dev_ms, segment=cls)
         if rows:
             SEGMENT_ROWS.inc(rows, segment=cls)
+        # overhead decomposition (profiled runs only): the measured
+        # per-backend dispatch floor bounds the host launch tax inside
+        # this program's wall, and padded-minus-live INPUT rows price the
+        # bucket-quantization tax at this segment's own per-row device
+        # cost.  Pad waste is a slice of device compute, not an additive
+        # wall category — wall_breakdown() subtracts it back out.
+        from ..obs.registry import PAD_ROWS, PAD_WASTE_MS
+        floor = dispatch_floor_ms()
+        disp_ms = min(floor, dev_ms)
+        pad_rows = 0
+        cap_rows = 0
+        for _leaf, dbs in (pairs or ()):
+            for db in dbs:
+                cap = int(db.capacity)
+                cap_rows += cap
+                try:
+                    live = int(db.num_rows)  # concrete post-sync scalar
+                except Exception:            # noqa: BLE001
+                    live = cap
+                pad_rows += max(cap - min(live, cap), 0)
+        pad_ms = (dev_ms - disp_ms) * (pad_rows / cap_rows) \
+            if cap_rows else 0.0
+        if pad_rows:
+            PAD_ROWS.inc(pad_rows, site="segment")
+            PAD_WASTE_MS.observe(pad_ms, segment=cls)
         key = nid or cls
         m = ctx.metrics
+        m["overhead.dispatch_floor_ms"] = floor
         for field, v in (("device_ms", dev_ms), ("rows", rows),
-                         ("out_bytes", out_bytes), ("executions", 1)):
+                         ("out_bytes", out_bytes), ("executions", 1),
+                         ("dispatch_ms", disp_ms), ("pad_rows", pad_rows),
+                         ("pad_waste_ms", pad_ms)):
             mk = f"segment.{key}.{field}"
             m[mk] = m.get(mk, 0) + v
+        for field, v in (("overhead.dispatch_ms", disp_ms),
+                         ("overhead.pad_rows", pad_rows),
+                         ("overhead.pad_waste_ms", pad_ms)):
+            m[field] = m.get(field, 0) + v
         attrs = {"device_ms": round(dev_ms, 3), "rows": rows,
-                 "out_bytes": out_bytes}
+                 "out_bytes": out_bytes,
+                 "dispatch_ms": round(disp_ms, 4), "pad_rows": pad_rows,
+                 "pad_waste_ms": round(pad_ms, 4)}
         if lo is not None:
             attrs["node_lo"], attrs["node_hi"] = lo, hi
         for k in ("flops", "bytes_accessed", "peak_temp_bytes"):
@@ -918,6 +992,7 @@ class CompiledPlan:
                             **attrs)
 
     def collect(self, ctx: ExecContext) -> pa.Table:
+        import time as _time
         from ..columnar.device import fetch_result_batch
         from ..columnar.host import struct_to_schema
         from ..runtime.retry import retry_io
@@ -925,10 +1000,14 @@ class CompiledPlan:
         bound = self.root.row_upper_bound()
         hbs = []
         for db in outs:
+            t0 = _time.perf_counter()
             with ctx.tracer.span("fetch", "transition"):
                 hb = retry_io(ctx.conf, "d2h",
                               lambda: fetch_result_batch(db, bound,
                                                          ctx.conf))
+            ctx.metrics["overhead.fetch_ms"] = ctx.metrics.get(
+                "overhead.fetch_ms", 0.0) \
+                + (_time.perf_counter() - t0) * 1e3
             ctx.bump("d2h_rows", hb.num_rows)
             ctx.tracer.add_bytes("d2h_bytes", hb.rb.nbytes)
             hbs.append(hb)
@@ -1169,7 +1248,13 @@ class SplitCompiledPlan:
                 task = get_service(ctx.conf).take((id(self), i, key))
                 if task is not None:
                     try:
-                        plan = task.wait()
+                        # the wait IS compile wall from the query's
+                        # point of view (the background thread has no
+                        # tracer): bracket it under the compile
+                        # category so wall_breakdown() attributes it
+                        with ctx.tracer.span("compile.wait", "compile",
+                                             segment=i):
+                            plan = task.wait()
                         progs[key] = plan
                         ctx.bump("compile_background_used")
                     except TimeoutError:
@@ -1301,6 +1386,7 @@ class SplitCompiledPlan:
         return sliced
 
     def collect(self, ctx: ExecContext) -> pa.Table:
+        import time as _time
         self._install_leaves()
         try:
             key: tuple = ()
@@ -1313,9 +1399,36 @@ class SplitCompiledPlan:
                 seg.ensure_compiled(ctx)
                 self._speculate(i, seg, ctx)
                 outs = seg.execute(ctx)
+                # the seam bracket (always-on: two clock reads around
+                # host work the seam pays anyway): one host row-count
+                # sync + re-bucket per batch, the dominant fixed cost of
+                # split plans on small inputs — overhead.seam_* feeds
+                # wall_breakdown(), the history plane, and the seam gate
+                t0 = _time.perf_counter()
                 sliced = self._shrink(outs, ctx)
                 leaf.batches = sliced
                 key = tuple(db.capacity for db in sliced)
+                t1 = _time.perf_counter()
+                rows = 0
+                nbytes = 0
+                for db in sliced:
+                    try:
+                        rows += int(db.num_rows)  # concrete post-sync
+                        nbytes += int(db.nbytes())
+                    except Exception:             # noqa: BLE001
+                        pass
+                m = ctx.metrics
+                m["overhead.seam_ms"] = m.get(
+                    "overhead.seam_ms", 0.0) + (t1 - t0) * 1e3
+                m["overhead.seam_count"] = m.get(
+                    "overhead.seam_count", 0) + 1
+                m["overhead.seam_rows"] = m.get(
+                    "overhead.seam_rows", 0) + rows
+                m["overhead.seam_bytes"] = m.get(
+                    "overhead.seam_bytes", 0) + nbytes
+                ctx.tracer.add_span(
+                    "seam", "transition", t0, t1, seam=i, rows=rows,
+                    bytes=nbytes, seam_ms=round((t1 - t0) * 1e3, 4))
             out = self._segment(len(self.seams), key, ctx).collect(ctx)
         finally:
             self._restore_leaves()

@@ -194,6 +194,16 @@ def publish_registry(ctx) -> None:
         COMPILES_TOTAL.inc(int(hits), outcome="hit")
     if misses:
         COMPILES_TOTAL.inc(int(misses), outcome="miss")
+    # wall-decomposition plane: one observation per finished query per
+    # nonzero overhead category (brackets in exec/compiled.py; dispatch
+    # and pad_waste populate on profiled runs, seam is always-on)
+    from ..obs.registry import OVERHEAD_MS
+    for cat, key in (("dispatch", "overhead.dispatch_ms"),
+                     ("seam", "overhead.seam_ms"),
+                     ("pad_waste", "overhead.pad_waste_ms")):
+        v = ctx.metrics.get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            OVERHEAD_MS.observe(float(v), category=cat)
 
 
 def finish_memattr(ctx) -> None:

@@ -143,6 +143,7 @@ class PlanNode:
         exactly-sized trip, unknown counts via a speculative
         count+head-prefix trip (columnar.device.fetch_result_batch)."""
         ctx = ctx or ExecContext()
+        import time as _time
         from ..columnar.device import fetch_result_batch
         from ..runtime.retry import retry_io
         bound = self.row_upper_bound()
@@ -150,10 +151,16 @@ class PlanNode:
         for db in self.execute(ctx):
             if isinstance(db.num_rows, int) and db.num_rows == 0:
                 continue
+            t0 = _time.perf_counter()
             with ctx.tracer.span("fetch", "transition"):
                 hb = retry_io(ctx.conf, "d2h",
                               lambda: fetch_result_batch(db, bound,
                                                          ctx.conf))
+            # always-on result-fetch bracket: the tail host sync every
+            # query pays (overhead plane, obs/profile.wall_breakdown)
+            ctx.metrics["overhead.fetch_ms"] = ctx.metrics.get(
+                "overhead.fetch_ms", 0.0) \
+                + (_time.perf_counter() - t0) * 1e3
             ctx.bump("d2h_rows", hb.num_rows)
             ctx.tracer.add_bytes("d2h_bytes", hb.rb.nbytes)
             hbs.append(hb)

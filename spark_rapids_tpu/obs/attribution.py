@@ -151,11 +151,23 @@ class ExplainAnalyzeReport:
     #: per-op election/partition/byte/recursion counters from
     #: ctx.metrics `ooc.*` entries; {} when the tier never engaged
     ooc: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: the wall-decomposition plane (QueryProfile.wall_breakdown): the
+    #: end-to-end wall split into named categories — device compute,
+    #: dispatch floor, seam time, compile, fetch, host prep — with an
+    #: unattributed residual and the pad-waste overlay; {} when the
+    #: profile carried no query span
+    wall_breakdown: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    #: attributed_wall_pct over the FULL query span (0..100) — the
+    #: honest bar next to attributed_pct's execute-span-only view
+    attributed_wall_pct: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {"tree": self.tree, "segments": self.segments,
                 "attributed_device_pct": self.attributed_pct,
+                "attributed_wall_pct": self.attributed_wall_pct,
                 "wall_ms": self.wall_ms, "device_ms": self.device_ms,
+                "wall_breakdown": self.wall_breakdown,
                 "gathers": self.gathers,
                 "mesh_timeline": self.mesh_timeline,
                 "predicted": self.predicted,
@@ -178,6 +190,16 @@ class ExplainAnalyzeReport:
         if self.attributed_pct is not None:
             head.append(f"attributed        {self.attributed_pct:.1f}% "
                         f"of device wall to named plan segments")
+        if self.attributed_wall_pct is not None:
+            head.append(f"attributed (wall) {self.attributed_wall_pct:.1f}"
+                        f"% of end-to-end wall to named categories")
+        if self.predicted and self.predicted.get("overhead_us"):
+            ov_ms = self.predicted["overhead_us"] / 1e3
+            head.append(f"predicted overhead {ov_ms:.2f} ms "
+                        f"(dispatch+seam+pad, history oracle)")
+        if self.wall_breakdown:
+            from .profile import render_wall_breakdown
+            head.extend(render_wall_breakdown(self.wall_breakdown))
         if self.hbm.get("measured_peak_bytes"):
             h = self.hbm
             head.append(
@@ -425,10 +447,15 @@ def run_explain_analyze(pq, conf_overrides: Optional[dict] = None
     # operators bump (exec/ooc.py) plus the query-rung escalation count
     ooc = {k[len("ooc."):]: v for k, v in ctx.metrics.items()
            if k.startswith("ooc.") and v}
+    breakdown = profile.wall_breakdown()
+    wpct = profile.attributed_wall_pct()
     return ExplainAnalyzeReport(
         tree=tree, segments=segments,
         attributed_pct=None if pct is None else round(pct * 100, 1),
         wall_ms=split["wall_ms"], device_ms=round(device_ms, 3),
         gathers=gathers, mesh_timeline=profile.mesh_timeline(),
         metrics=dict(ctx.metrics), profile=profile,
-        predicted=predicted, kernel_tiers=kernel_tiers, hbm=hbm, ooc=ooc)
+        predicted=predicted, kernel_tiers=kernel_tiers, hbm=hbm, ooc=ooc,
+        wall_breakdown=breakdown if breakdown.get("wall_ms") else {},
+        attributed_wall_pct=None if wpct is None
+        else round(wpct * 100, 1))

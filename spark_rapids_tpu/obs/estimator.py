@@ -2,8 +2,14 @@
 
 `estimate(pq)` answers, BEFORE a query runs, what it will cost:
 
-    {device_us, wall_ms, compile_ms, working_set_bytes,
+    {device_us, overhead_us, wall_ms, compile_ms, working_set_bytes,
      confidence, basis, key, runs, segments}
+
+`overhead_us` is the wall-decomposition plane's admission signal: the
+structure's measured fixed-overhead tail (dispatch floor x launches +
+seam wall + pad waste, obs/history.py overhead fields) — nonzero on
+`exact_history` once a run has measured it (`overhead_basis:
+"measured"`), always 0.0 with basis "none" for static answers.
 
 Two bases, counted per call in `tpu_history_estimates_total`:
 
@@ -82,16 +88,31 @@ class CostEstimator:
         else:
             ws = max(agg.peak_bytes, agg.src_bytes)
             ws_basis = "reserved"
-        return {"basis": "exact_history", "key": key,
-                "device_us": max(round(agg.predicted_us(), 1), 1.0),
-                "wall_ms": round(agg.wall_ms, 3),
-                "compile_ms": round(agg.compile_ms, 3),
-                "working_set_bytes": int(ws),
-                "ws_basis": ws_basis,
-                "confidence": round(confidence, 3),
-                "runs": agg.runs, "warm_runs": agg.warm_runs,
-                "drift_ratio": None if drift is None else round(drift, 3),
-                "segments": dict(agg.segments)}
+        out = {"basis": "exact_history", "key": key,
+               "device_us": max(round(agg.predicted_us(), 1), 1.0),
+               "wall_ms": round(agg.wall_ms, 3),
+               "compile_ms": round(agg.compile_ms, 3),
+               "working_set_bytes": int(ws),
+               "ws_basis": ws_basis,
+               "confidence": round(confidence, 3),
+               "runs": agg.runs, "warm_runs": agg.warm_runs,
+               "drift_ratio": None if drift is None else round(drift, 3),
+               "segments": dict(agg.segments)}
+        # the wall-decomposition plane's admission signal (ROADMAP 1b):
+        # this structure's measured fixed-overhead tail — dispatch floor
+        # x launches + seam wall + pad waste — next to its device_us, so
+        # a small-plan fast-path election can see a query that is mostly
+        # overhead BEFORE running it.  overhead_basis marks it measured.
+        out["overhead_us"] = round(agg.overhead_us, 1) \
+            if agg.overhead_runs > 0 else 0.0
+        out["overhead_basis"] = "measured" if agg.overhead_runs > 0 \
+            else "none"
+        if agg.seam_count:
+            out["seam_count"] = agg.seam_count
+            out["seam_ms"] = round(agg.seam_ms, 3)
+        if agg.dispatch_floor_ms:
+            out["dispatch_floor_ms"] = round(agg.dispatch_floor_ms, 4)
+        return out
 
     def _static(self, key, pq) -> Dict[str, object]:
         src = source_bytes(pq.root)
@@ -108,6 +129,8 @@ class CostEstimator:
                 "ws_basis": "source",
                 "confidence": 0.25 if fitted else 0.0,
                 "runs": 0,
+                "overhead_us": 0.0,
+                "overhead_basis": "none",
                 "segments": {}}
 
 

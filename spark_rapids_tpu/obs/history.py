@@ -145,6 +145,8 @@ class _Agg:
                  "warm_device_us", "prev_warm_us", "last_warm_us",
                  "wall_ms", "compile_ms", "src_bytes", "peak_bytes",
                  "ws_bytes", "ws_runs",
+                 "overhead_us", "overhead_runs", "seam_count",
+                 "seam_ms", "dispatch_floor_ms",
                  "total_device_us", "segments", "label", "kind",
                  "backend")
 
@@ -164,6 +166,15 @@ class _Agg:
         self.ws_runs = 0            # runs that carried one (memattr /
                                     # XLA memory_analysis — not the
                                     # source-bytes heuristic)
+        # the overhead plane (wall decomposition, exec/compiled.py):
+        # decayed dispatch+seam+pad overhead of runs that measured it,
+        # plus the structure's seam shape and the backend's measured
+        # per-dispatch floor — the small-plan fast-path admission signal
+        self.overhead_us = 0.0      # decayed, measured runs only
+        self.overhead_runs = 0
+        self.seam_count = 0         # newest observed seam count
+        self.seam_ms = 0.0          # decayed seam wall
+        self.dispatch_floor_ms = 0.0  # newest measured backend floor
         self.total_device_us = 0.0  # lifetime sum (report ranking)
         self.segments: Dict[str, float] = {}   # node -> decayed device ms
         self.label: Optional[str] = None
@@ -193,6 +204,18 @@ class _Agg:
             self.ws_bytes = self._ewma(self.ws_bytes, ws,
                                        self.ws_runs == 0, decay)
             self.ws_runs += 1
+        ov = float(rec.get("overhead_us") or 0.0)
+        if ov > 0:
+            self.overhead_us = self._ewma(self.overhead_us, ov,
+                                          self.overhead_runs == 0, decay)
+            self.overhead_runs += 1
+        if rec.get("seam_count"):
+            self.seam_count = int(rec["seam_count"])
+            self.seam_ms = self._ewma(self.seam_ms,
+                                      float(rec.get("seam_ms") or 0.0),
+                                      self.seam_ms == 0.0, decay)
+        if rec.get("dispatch_floor_ms"):
+            self.dispatch_floor_ms = float(rec["dispatch_floor_ms"])
         if _is_warm(rec):
             self.prev_warm_us = self.warm_device_us
             self.last_warm_us = dus
@@ -247,6 +270,11 @@ class _Agg:
                "peak_bytes": round(self.peak_bytes, 1),
                "ws_bytes": round(self.ws_bytes, 1),
                "ws_runs": self.ws_runs,
+               "overhead_us": round(self.overhead_us, 1),
+               "overhead_runs": self.overhead_runs,
+               "seam_count": self.seam_count,
+               "seam_ms": round(self.seam_ms, 3),
+               "dispatch_floor_ms": round(self.dispatch_floor_ms, 4),
                "total_device_us": round(self.total_device_us, 1),
                "segments": {n: round(v, 3)
                             for n, v in self.segments.items()}}
@@ -272,6 +300,11 @@ class _Agg:
         a.peak_bytes = float(d.get("peak_bytes") or 0.0)
         a.ws_bytes = float(d.get("ws_bytes") or 0.0)
         a.ws_runs = int(d.get("ws_runs") or 0)
+        a.overhead_us = float(d.get("overhead_us") or 0.0)
+        a.overhead_runs = int(d.get("overhead_runs") or 0)
+        a.seam_count = int(d.get("seam_count") or 0)
+        a.seam_ms = float(d.get("seam_ms") or 0.0)
+        a.dispatch_floor_ms = float(d.get("dispatch_floor_ms") or 0.0)
         a.total_device_us = float(d.get("total_device_us")
                                   or a.device_us * a.runs)
         a.segments = {str(n): float(v)
@@ -502,6 +535,34 @@ class PerfHistoryStore:
                     if isinstance(f.get("rows"), (int, float))}
         if seg_rows:
             rec["segment_rows"] = seg_rows
+        # the overhead plane's loop-closer: this structure's measured
+        # fixed-overhead tail (dispatch floor x launches + seam wall +
+        # pad waste) so the estimator can serve overhead_us next to
+        # device_us (the ROADMAP 1(b) fast-path admission signal).
+        # seam_ms is always-on; dispatch/pad need a profiled run, but an
+        # unprofiled run still prices its launches when the floor has
+        # been measured in this process.
+        floor = num("overhead.dispatch_floor_ms")
+        if not floor:
+            try:                             # already-measured cache only:
+                import jax                   # never runs the microbench
+                from ..exec.compiled import _DISPATCH_FLOOR
+                floor = _DISPATCH_FLOOR.get(jax.default_backend(), 0.0)
+            except Exception:                # noqa: BLE001
+                floor = 0.0
+        dispatch_ms = num("overhead.dispatch_ms")
+        if not dispatch_ms and floor:
+            dispatch_ms = floor * num("exec_dispatches")
+        seam_ms = num("overhead.seam_ms")
+        overhead_us = (dispatch_ms + seam_ms
+                       + num("overhead.pad_waste_ms")) * 1e3
+        if overhead_us > 0:
+            rec["overhead_us"] = round(overhead_us, 1)
+        if num("overhead.seam_count"):
+            rec["seam_count"] = int(num("overhead.seam_count"))
+            rec["seam_ms"] = round(seam_ms, 3)
+        if floor:
+            rec["dispatch_floor_ms"] = round(floor, 4)
         try:
             import jax
             rec["backend"] = jax.default_backend()

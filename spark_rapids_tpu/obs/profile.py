@@ -27,7 +27,8 @@ _NODE_METRIC_RE = re.compile(
 _SEGMENT_METRIC_RE = re.compile(
     r"^segment\.(?P<node>[\w#]+)\.(?P<field>device_ms|rows|out_bytes|"
     r"executions|flops|bytes_accessed|peak_temp_bytes|hbm_bytes|"
-    r"hbm_peak_bytes|hbm_resident_pre)$")
+    r"hbm_peak_bytes|hbm_resident_pre|dispatch_ms|pad_rows|"
+    r"pad_waste_ms)$")
 
 #: span categories that are measured directly; "execute" is the residual
 _SPLIT_CATS = ("compile", "transition", "shuffle")
@@ -216,6 +217,122 @@ class QueryProfile:
                and (s.node or s.attrs.get("node_lo") is not None)]
         return min(1.0, _union_ms(seg) / total)
 
+    def attributed_wall_pct(self) -> Optional[float]:
+        """Fraction of the END-TO-END query wall covered by named
+        wall-breakdown categories — the honest attribution bar.
+        `attributed_device_pct` divides by the execute-span union only,
+        so a fixed-overhead-tail query (q2/q16 class) can report 90%+
+        while 99% of its wall is seams and dispatch; this one divides by
+        the full query span.  None without a query span."""
+        if not any(s.cat == "query" for s in self.spans):
+            return None
+        bd = self.wall_breakdown()
+        return min(1.0, bd["attributed_pct"] / 100.0)
+
+    # -- the overhead attribution plane (wall decomposition) ---------------
+    def overheads(self) -> Dict[str, float]:
+        """The overhead.* accumulators (exec brackets): seam_ms /
+        seam_count / seam_rows / seam_bytes (always-on), dispatch_ms /
+        dispatch_floor_ms / pad_rows / pad_waste_ms (profiled runs),
+        host_prep_ms, fetch_ms."""
+        out: Dict[str, float] = {}
+        for k, v in self.metrics.items():
+            if k.startswith("overhead.") and isinstance(v, (int, float)):
+                out[k.removeprefix("overhead.")] = v
+        return out
+
+    def wall_breakdown(self) -> Dict[str, Any]:
+        """Decompose the end-to-end query wall into named, summing
+        categories (the fixed-overhead-tail view, ROADMAP item 1):
+
+          device_compute_ms  measured wall inside compiled segments,
+                             net of the per-dispatch floor
+          dispatch_ms        measured per-backend dispatch floor x
+                             program launches
+          seam_ms            host sync + re-bucket at every
+                             SplitCompiledPlan boundary
+          compile_ms         trace+compile span union (in-wall)
+          fetch_ms           d2h/h2d transition span union (seams
+                             excluded — they have their own line)
+          shuffle_ms         shuffle span union
+          host_prep_ms       in-wall setup before execution
+          unattributed_ms    the residual
+
+        `pad_waste_ms`/`pad_rows` ride along as informational fields: the
+        bucket-quantization tax is a SLICE of device_compute_ms, not an
+        additive category.  `plan_ms` and `semaphore_wait_ms` happen
+        before the query span opens and are reported as pre-wall lines.
+        Works from a live context or an event log; dispatch/pad fields
+        populate only on profiled (profile.segments) runs."""
+        roots = [s for s in self.spans if s.cat == "query"]
+        q0 = min((s.t0 for s in roots), default=None)
+        q1 = max((s.t1 for s in roots), default=None)
+        wall = self.wall_ms()
+        ov = self.overheads()
+
+        def cat_union(cat: str, exclude_name: Optional[str] = None
+                      ) -> float:
+            ivals = []
+            for s in self.spans:
+                if s.cat != cat or \
+                        (exclude_name and s.name == exclude_name):
+                    continue
+                t0, t1 = s.t0, s.t1
+                if q0 is not None:
+                    t0, t1 = max(t0, q0), min(t1, q1)
+                if t1 > t0:
+                    ivals.append((t0, t1))
+            return _union_ms(ivals)
+
+        seg_dev = sum(float(r.get("device_ms", 0.0))
+                      for r in self.segments())
+        dispatch_ms = float(ov.get("dispatch_ms", 0.0))
+        if seg_dev <= 0.0:
+            # unprofiled run: exec_device_ms is the dispatch wall; the
+            # measured floor x launch count bounds its overhead share
+            seg_dev = float(self.metrics.get("exec_device_ms", 0.0))
+            floor = float(ov.get("dispatch_floor_ms", 0.0))
+            if not dispatch_ms and floor:
+                dispatch_ms = floor * float(
+                    self.metrics.get("exec_dispatches", 0))
+        dispatch_ms = min(dispatch_ms, seg_dev)
+        pad_ms = min(float(ov.get("pad_waste_ms", 0.0)),
+                     max(seg_dev - dispatch_ms, 0.0))
+        seam_ms = float(ov.get("seam_ms", 0.0))
+        cats = {
+            "device_compute_ms": max(seg_dev - dispatch_ms, 0.0),
+            "dispatch_ms": dispatch_ms,
+            "seam_ms": seam_ms,
+            "compile_ms": cat_union("compile"),
+            "fetch_ms": cat_union("transition", exclude_name="seam"),
+            "shuffle_ms": cat_union("shuffle"),
+            "host_prep_ms": float(ov.get("host_prep_ms", 0.0)),
+        }
+        named = sum(cats.values())
+        out: Dict[str, Any] = {"wall_ms": round(wall, 3)}
+        out.update({k: round(v, 3) for k, v in cats.items()})
+        out["unattributed_ms"] = round(max(wall - named, 0.0), 3)
+        out["attributed_pct"] = round(100.0 * min(named / wall, 1.0), 1) \
+            if wall > 0 else 0.0
+        out["pad_waste_ms"] = round(float(ov.get("pad_waste_ms", 0.0)), 3)
+        for k in ("pad_rows", "seam_count", "seam_rows", "seam_bytes"):
+            if ov.get(k):
+                out[k] = int(ov[k])
+        if ov.get("dispatch_floor_ms"):
+            out["dispatch_floor_ms"] = round(
+                float(ov["dispatch_floor_ms"]), 4)
+        n_disp = self.metrics.get("exec_dispatches")
+        if n_disp:
+            out["dispatches"] = int(n_disp)
+        # pre-wall lines: planning and the device-permit queue wait both
+        # happen before the query span opens
+        out["plan_ms"] = round(sum(s.dur_ms for s in self.spans
+                                   if s.cat == "plan"), 3)
+        sem = self.metrics.get("semaphore_wait_ms")
+        if sem:
+            out["semaphore_wait_ms"] = round(float(sem), 3)
+        return out
+
     def mesh_timeline(self) -> Dict[str, Any]:
         """Per-query mesh/collective timeline from the exchange
         instants (parallel/exchange.py): one record per ragged exchange
@@ -327,6 +444,7 @@ class QueryProfile:
     # -- presentation ------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
         out = {"time_split": self.time_split(),
+               "wall_breakdown": self.wall_breakdown(),
                "operators": self.operators(),
                "compile": self.compile_stats(),
                "data_movement": self.data_movement(),
@@ -339,6 +457,9 @@ class QueryProfile:
             pct = self.attributed_device_pct()
             if pct is not None:
                 out["attributed_device_pct"] = round(pct * 100, 1)
+        wpct = self.attributed_wall_pct()
+        if wpct is not None:
+            out["attributed_wall_pct"] = round(wpct * 100, 1)
         mesh = self.mesh_timeline()
         if mesh["exchanges"] or mesh["skew_splits"]:
             out["mesh_timeline"] = mesh
@@ -358,6 +479,7 @@ class QueryProfile:
         """Compact per-query embedding for BENCH_*.json."""
         ops = self.operators()
         out = {"time_split": self.time_split(),
+               "wall_breakdown": self.wall_breakdown(),
                "top_operators": [
                    {"node": o["node"],
                     "self_time_ms": o["self_time_ms"],
@@ -409,6 +531,9 @@ class QueryProfile:
         cs = self.compile_stats()
         lines.append(f"compile cache     {cs['cache_hits']} hits / "
                      f"{cs['cache_misses']} misses")
+        bd = self.wall_breakdown()
+        if bd["wall_ms"] > 0:
+            lines.extend(render_wall_breakdown(bd))
         ops = self.operators()
         if ops:
             lines.append("-- top operators (self time) --")
@@ -517,3 +642,44 @@ class QueryProfile:
             if len(scalars) > 12:
                 lines.append(f"  ... {len(scalars) - 12} more series")
         return "\n".join(lines)
+
+
+#: wall-breakdown category -> report label, render order
+_BREAKDOWN_LABELS = (
+    ("device_compute_ms", "device compute"),
+    ("dispatch_ms", "dispatch overhead"),
+    ("seam_ms", "seam time"),
+    ("compile_ms", "compile"),
+    ("fetch_ms", "fetch/upload"),
+    ("shuffle_ms", "shuffle"),
+    ("host_prep_ms", "host prep"),
+    ("unattributed_ms", "unattributed"),
+)
+
+
+def render_wall_breakdown(bd: Dict[str, Any]) -> List[str]:
+    """Text lines for one wall_breakdown() dict — shared by
+    QueryProfile.render() and EXPLAIN ANALYZE (obs/attribution.py)."""
+    wall = bd.get("wall_ms") or 0.0
+    lines = [f"-- wall breakdown (end-to-end, {wall:.1f} ms, "
+             f"{bd.get('attributed_pct', 0.0):.1f}% attributed) --"]
+    for key, label in _BREAKDOWN_LABELS:
+        v = float(bd.get(key, 0.0))
+        pct = 100.0 * v / wall if wall else 0.0
+        extra = ""
+        if key == "device_compute_ms" and bd.get("pad_waste_ms"):
+            extra = (f"  [pad waste {bd['pad_waste_ms']:.2f} ms over "
+                     f"{bd.get('pad_rows', 0)} pad rows]")
+        elif key == "dispatch_ms" and bd.get("dispatch_floor_ms"):
+            extra = (f"  [floor {bd['dispatch_floor_ms']:.3f} ms x "
+                     f"{bd.get('dispatches', 0)} dispatches]")
+        elif key == "seam_ms" and bd.get("seam_count"):
+            extra = (f"  [{bd['seam_count']} seams, "
+                     f"{bd.get('seam_rows', 0)} rows, "
+                     f"{bd.get('seam_bytes', 0)} bytes re-bucketed]")
+        lines.append(f"  {label:<18} {v:>9.2f} ms ({pct:>5.1f}%){extra}")
+    pre = [f"plan {bd.get('plan_ms', 0.0):.1f} ms"]
+    if bd.get("semaphore_wait_ms"):
+        pre.append(f"queue wait {bd['semaphore_wait_ms']:.1f} ms")
+    lines.append("  (pre-wall: " + ", ".join(pre) + ")")
+    return lines

@@ -545,6 +545,35 @@ SEGMENT_HBM_PEAK = REGISTRY.histogram(
     "plane, obs/memattr.py).",
     ("segment",))
 
+OVERHEAD_MS = REGISTRY.histogram(
+    "tpu_overhead_ms",
+    "Per-query wall milliseconds attributed to a fixed-overhead "
+    "category by the wall-decomposition plane (exec/compiled.py, "
+    "obs/profile.py wall_breakdown): `dispatch` = measured per-backend "
+    "dispatch floor x program launches, `seam` = host sync + re-bucket "
+    "at every SplitCompiledPlan boundary, `pad_waste` = the "
+    "bucket-quantization tax (padded-minus-live rows priced at the "
+    "segment's per-row device cost).  One observation per finished "
+    "query per nonzero category, log2 buckets.",
+    ("category",))
+
+PAD_ROWS = REGISTRY.counter(
+    "tpu_pad_rows_total",
+    "Padded-minus-live rows per site: `upload` counts padding added "
+    "when host batches are bucketed onto the device "
+    "(columnar/device.py to_device, always-on), `segment` counts the "
+    "padded input rows each profiled compiled-segment dispatch "
+    "computed over (exec/compiled.py).",
+    ("site",))
+
+PAD_WASTE_MS = REGISTRY.histogram(
+    "tpu_pad_waste_ms",
+    "Estimated device milliseconds a profiled compiled segment spent "
+    "computing over padding (device wall x padded input fraction), "
+    "log2 buckets, labeled by the segment's root operator class — "
+    "populated only when spark.rapids.tpu.profile.segments is on.",
+    ("segment",))
+
 HBM_RESIDUAL = REGISTRY.counter(
     "tpu_hbm_residual_bytes",
     "Naked (directly reserved, non-Spillable) budget bytes still live "

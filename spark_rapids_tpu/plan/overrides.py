@@ -1378,6 +1378,7 @@ class PhysicalQuery:
         install_fault_injection(self.root, self.conf)
         with self._instrumented(ctx), crash_capture(self.conf, ctx):
             import time as _time
+            t_prep = _time.perf_counter()
             from ..exec import ooc as O
             from ..exec.metrics import record_history
             if self.kind == "device":
@@ -1386,6 +1387,11 @@ class PhysicalQuery:
                 # query runs spilled from the start (exec/ooc.py)
                 O.elect_proactive(self, ctx)
             t0 = _time.perf_counter()
+            # host-prep bracket: in-wall setup before execution starts
+            # (OOC election, fault wiring) — a named category of the
+            # wall decomposition (obs/profile.wall_breakdown)
+            ctx.metrics["overhead.host_prep_ms"] = ctx.metrics.get(
+                "overhead.host_prep_ms", 0.0) + (t0 - t_prep) * 1e3
             out = self._collect_with_query_retry(ctx)
             # the performance-history feed: runs INSIDE crash_capture
             # (the `history` chaos site's fatal kind dumps classified;
