@@ -933,6 +933,14 @@ def run_serving(suite_name: str, scale: float, query_names):
     nocache QPS ~= serial is the expected reading, with the serving win
     carried by the cache + structure-shared compiles.  Latency is
     client-observed submit->result wall (admission waits included).
+    `mp2` / `mp4` levels run the same mix through the SUPERVISED
+    WORKER POOL (`serving.pool.processes`, docs/SERVING.md): device
+    execution in 2/4 worker processes — the fault-isolation
+    architecture's throughput cost (dispatch serialization + per-worker
+    warmup; the result cache is bypassed by construction).  `mp2_kill`
+    additionally SIGKILLs one worker mid-query (`worker:kill:nth=1`)
+    and must stay oracle-matching: the lost query redrives on the
+    survivor (docs/ROBUSTNESS.md).
     Gate entries: `serving_latency_ms` (sv:-prefixed in
     scripts/check_regression.py, lower = better, same-backend rule)."""
     import importlib
@@ -980,15 +988,26 @@ def run_serving(suite_name: str, scale: float, query_names):
     print(f"# serial baseline: {serial_n} queries in {serial_s:.1f}s "
           f"({serial_qps:.2f} QPS)", file=sys.stderr)
 
-    def run_level(c: int, cache_on: bool) -> dict:
+    def run_level(c: int, cache_on: bool, procs: int = 0,
+                  faults: str = "") -> dict:
         # workers: 3 pipelines keep one query in a host phase while
         # another executes; more just multiplies GIL-bound planners
         # contending with the executing query (measured — the worker
         # sweep in docs/SERVING.md)
-        rt = ServingRuntime(dev, {
+        ov = {
             "spark.rapids.tpu.serving.workers": str(min(3, max(2, c))),
             "spark.rapids.tpu.serving.resultCache.bytes":
-                "0" if not cache_on else str(256 << 20)})
+                "0" if not cache_on else str(256 << 20)}
+        if procs:
+            # multi-process pool level: device execution moves into
+            # `procs` supervised worker processes (docs/SERVING.md);
+            # the result cache is bypassed by construction there
+            ov["spark.rapids.tpu.serving.pool.processes"] = str(procs)
+        if faults:
+            # chaos leg: e.g. worker:kill:nth=1 SIGKILLs one worker
+            # mid-query — the level must stay oracle-matching (redrive)
+            ov["spark.rapids.tpu.test.faults"] = faults
+        rt = ServingRuntime(dev, ov)
         lats, errs, mismatches = [], [], []
         lock = threading.Lock()
 
@@ -1033,6 +1052,13 @@ def run_serving(suite_name: str, scale: float, query_names):
                  "max_skips": stats["max_skips"],
                  "result_cache": stats["result_cache"],
                  "cache_on": cache_on}
+        if procs:
+            pool = stats.get("pool") or {}
+            level.update(
+                pool_processes=procs,
+                worker_restarts=pool.get("restarts"),
+                redrives=pool.get("redrives"),
+                faults=faults or None)
         print(f"# serving c={c} cache={'on' if cache_on else 'off'}: "
               f"{n} queries {wall:.1f}s qps={level['qps']} "
               f"p50={level['p50_ms']}ms p99={level['p99_ms']}ms "
@@ -1048,6 +1074,25 @@ def run_serving(suite_name: str, scale: float, query_names):
         levels[f"c{c}"] = run_level(c, cache_on=True)
     if left() > 45:
         levels["c8_nocache"] = run_level(8, cache_on=False)
+    # multi-process pool levels (docs/SERVING.md): same mix through the
+    # supervised worker pool — the fault-isolation architecture's
+    # throughput cost vs the in-process path — plus a chaos leg that
+    # SIGKILLs one worker mid-query and must stay oracle-matching via
+    # redrive.  Pool levels ship source tables over the dispatch socket
+    # and pay per-worker session warmup, so they are budget-gated
+    # harder than the in-process levels.
+    for procs in (2, 4):
+        if left() < 150:
+            print(f"# budget: skipping serving level mp{procs}",
+                  file=sys.stderr)
+            continue
+        levels[f"mp{procs}"] = run_level(4, cache_on=True, procs=procs)
+    if left() > 150:
+        levels["mp2_kill"] = run_level(4, cache_on=True, procs=2,
+                                       faults="worker:kill:nth=1")
+    else:
+        print("# budget: skipping serving level mp2_kill",
+              file=sys.stderr)
 
     c8 = levels.get("c8") or {}
     c8_nc = levels.get("c8_nocache") or {}
@@ -1077,6 +1122,12 @@ def run_serving(suite_name: str, scale: float, query_names):
            if c8_nc.get("qps") else None,
            "serving_beats_serial": bool(c8.get("qps") and
                                         c8["qps"] > serial_qps),
+           # the crash-containment headline: the kill leg lost one
+           # worker mid-query and still matched the oracle everywhere
+           "mp_kill_contained": bool(
+               (kl := levels.get("mp2_kill"))
+               and not kl["errors"] and not kl["mismatches"]
+               and (kl.get("worker_restarts") or {}).get("crash")),
            "overlap_observed": bool(c8_nc.get("overlap_observed") or
                                     c8.get("overlap_observed")),
            "all_match": all(v["match"] for v in per_q.values()),

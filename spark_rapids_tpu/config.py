@@ -280,6 +280,16 @@ RETRY_IO_BACKOFF_MULT = conf(
     "Multiplier applied to the IO retry backoff after every attempt.",
     checker=_positive)
 
+RETRY_IO_JITTER = conf(
+    "spark.rapids.tpu.retry.io.jitterFraction", 0.25,
+    "Deterministic seeded jitter applied to every IO-retry backoff "
+    "sleep: each sleep is scaled by a factor in [1-f, 1+f] drawn from a "
+    "splitmix64 stream seeded by (pid, site) — N worker processes "
+    "replaying the same transient host-IO fault desynchronize instead "
+    "of thundering-herding the spill disk, while any single process's "
+    "backoff sequence stays exactly reproducible. 0 disables jitter.",
+    checker=lambda v: None if 0.0 <= v <= 1.0 else "must be in [0, 1]")
+
 TEST_INJECT_RETRY_OOM = conf(
     "spark.rapids.tpu.sql.test.injectRetryOOM", 0,
     "Test-only: force a synthetic device OOM on the Nth retryable block "
@@ -498,12 +508,16 @@ METRICS_ENABLED = conf(
     commonly_used=True)
 
 METRICS_PORT = conf(
-    "spark.rapids.tpu.metrics.port", 0,
+    "spark.rapids.tpu.metrics.port", -1,
     "TCP port for the on-demand Prometheus text-format endpoint "
     "(stdlib http.server thread, obs/export.py): GET /metrics for the "
     "exposition text, /metrics.json for the structured snapshot, "
-    "/flight for the flight-recorder tail. 0 disables the server.",
-    checker=_non_negative)
+    "/flight for the flight-recorder tail. 0 binds an EPHEMERAL port — "
+    "N serving worker processes on one host cannot race a fixed port — "
+    "and the bound port is reported by obs.export.bound_metrics_port(), "
+    "ServingRuntime.stats() and every heartbeat line. -1 (default) "
+    "disables the server.",
+    checker=lambda v: None if v >= -1 else "must be >= -1")
 
 METRICS_REPORT_INTERVAL_S = conf(
     "spark.rapids.tpu.metrics.reportIntervalS", 10.0,
@@ -852,6 +866,65 @@ SERVING_RESULT_CACHE_BYTES = conf(
     "garbage collected. 0 disables the cache.",
     checker=_non_negative, commonly_used=True)
 
+SERVING_DEADLINE_MS = conf(
+    "spark.rapids.tpu.serving.deadlineMs", 0.0,
+    "Per-query wall-clock deadline for serving queries, in milliseconds "
+    "(0 disables). The clock starts when execution begins (queue wait "
+    "is bounded separately by admitTimeoutMs); execution checks it "
+    "at cooperative cancellation checkpoints — the compiled-plan seam "
+    "brackets, the per-batch result stream, out-of-core partition/merge "
+    "passes, exchange rounds and spill-all sweeps — and past the "
+    "deadline raises QueryDeadlineExceeded, releasing the ticket's full "
+    "device reservation (DeviceCensus shows zero residual). Per-submit "
+    "override: TenantSession.submit(df, deadline_ms=...).",
+    checker=_non_negative, commonly_used=True)
+
+SERVING_POOL_PROCS = conf(
+    "spark.rapids.tpu.serving.pool.processes", 0,
+    "Fault-isolated multi-process serving (serving/workers.py): when "
+    "> 0, the ServingRuntime supervises this many WORKER PROCESSES, "
+    "each owning its own TpuSession / MemoryBudget / device slice, and "
+    "dispatches admitted queries to them over an authenticated local "
+    "socket. A fatal XLA error, SIGKILL or segfault in one worker loses "
+    "only its in-flight queries — they redrive on a surviving worker "
+    "(serving.redrive.maxAttempts) while other tenants' queries "
+    "complete uninterrupted. Workers share the persistent compile "
+    "cache and history store; their budgets reconcile through "
+    "heartbeat-reported DeviceCensus totals so admission gates on the "
+    "truthful cross-process HBM picture. 0 (default) keeps the "
+    "single-process thread pipeline.",
+    checker=_non_negative, commonly_used=True)
+
+SERVING_REDRIVE_MAX = conf(
+    "spark.rapids.tpu.serving.redrive.maxAttempts", 2,
+    "How many times one serving query may REDRIVE onto a surviving "
+    "worker after losing its worker process mid-flight (crash, "
+    "SIGKILL, heartbeat-timeout hang, fatal device dump). Queries are "
+    "read-only and deterministic, so a redriven result is bit-identical "
+    "to an undisturbed run; past the bound the ticket fails with the "
+    "worker-loss error (the Spark task-retry bound analogue).",
+    checker=_non_negative)
+
+SERVING_POOL_HEARTBEAT_MS = conf(
+    "spark.rapids.tpu.serving.pool.heartbeatMs", 250,
+    "Interval at which each serving worker process heartbeats the "
+    "supervisor (pid, in-flight query, DeviceCensus live/peak bytes, "
+    "bound metrics port).", checker=_positive)
+
+SERVING_POOL_HEARTBEAT_MISSES = conf(
+    "spark.rapids.tpu.serving.pool.heartbeatMisses", 12,
+    "A worker whose last heartbeat is older than this many heartbeat "
+    "intervals is declared HUNG: the supervisor SIGKILLs it, redrives "
+    "its in-flight queries on surviving workers and (pool.restart) "
+    "spawns a replacement.", checker=_positive)
+
+SERVING_POOL_RESTART = conf(
+    "spark.rapids.tpu.serving.pool.restart", True,
+    "Supervised restart: replace a dead serving worker process (crash, "
+    "kill, hang, fatal self-termination) with a fresh one so the pool "
+    "holds its size. False leaves the pool smaller after each death "
+    "(drain/teardown mode).")
+
 SERVING_ADMIT_WORKING_SET_FACTOR = conf(
     "spark.rapids.tpu.serving.admitWorkingSetFactor", 3.0,
     "HBM admission estimate: a query's device working set is assumed "
@@ -1195,7 +1268,11 @@ def generate_docs() -> str:
         "1/2/4/8 through the ServingRuntime, vs the same multiset "
         "serially through the single-query path; reports p50/p99 "
         "latency, QPS, device utilization and result-cache outcomes "
-        "(docs/SERVING.md; gated via check_regression sv: entries). |",
+        "(docs/SERVING.md; gated via check_regression sv: entries). "
+        "Adds mp2/mp4 multi-process pool levels "
+        "(serving.pool.processes) plus an mp2_kill chaos leg that "
+        "SIGKILLs one worker mid-query and must stay oracle-matching "
+        "via redrive (docs/ROBUSTNESS.md). |",
         "| `scale` | `1.0` | Linear datagen scale factor (SF1-ish row "
         "counts at 1.0; fixed-size dimensions never scale). |",
         "| `BENCH_BUDGET_S` | `1800` | Total wall budget; queries that "

@@ -996,10 +996,15 @@ class CompiledPlan:
         from ..columnar.device import fetch_result_batch
         from ..columnar.host import struct_to_schema
         from ..runtime.retry import retry_io
+        # cancellation checkpoint before the program dispatches: a
+        # deadline that expired in the queue cancels without paying for
+        # the whole dispatch (single-program plans have no seams)
+        ctx.checkpoint("program")
         outs = self.execute(ctx)
         bound = self.root.row_upper_bound()
         hbs = []
         for db in outs:
+            ctx.checkpoint("fetch")
             t0 = _time.perf_counter()
             with ctx.tracer.span("fetch", "transition"):
                 hb = retry_io(ctx.conf, "d2h",
@@ -1391,6 +1396,10 @@ class SplitCompiledPlan:
         try:
             key: tuple = ()
             for i, leaf in enumerate(self.leaves):
+                # seam bracket doubles as a cancellation checkpoint: a
+                # deadline-armed query cancels between segments, never
+                # mid-dispatch (the reservation picture stays clean)
+                ctx.checkpoint("seam")
                 seg = self._segment(i, key, ctx)
                 # compile first, THEN speculate: the next segment's
                 # placeholder shapes need this segment's traced output

@@ -101,10 +101,14 @@ def fire(ctx, op: str, **state) -> None:
     """One out-of-core pass boundary: publish the bucket state to the
     flight recorder FIRST (`ooc_state` instant — a fatal dump's tail
     then shows exactly which pass died), then fire the `ooc` chaos
-    site with the same state in the injected-fault record."""
+    site with the same state in the injected-fault record.  Each pass
+    boundary is also a cooperative cancellation checkpoint: a
+    deadline-armed query cancels between buckets, with every spilled
+    bucket's reservation released by the unwinding scopes."""
     ctx.tracer.instant("ooc_state", "runtime", op=op, **state)
     from ..runtime.faults import get_injector
     get_injector(ctx.conf).fire("ooc", op=op, **state)
+    ctx.checkpoint(f"ooc_{op}")
 
 
 def record_election(ctx, op: str, mode: str) -> None:

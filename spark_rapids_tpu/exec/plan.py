@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
+from contextlib import contextmanager
 from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -34,6 +36,52 @@ from .evaluator import evaluate_projection
 _BUDGET_INIT_LOCK = threading.Lock()
 
 
+class QueryDeadlineExceeded(RuntimeError):
+    """The query ran past its per-query deadline (serving.deadlineMs /
+    submit(deadline_ms=...)) and a cooperative cancellation checkpoint
+    cancelled it.  Classified 'query': the ticket fails cleanly, every
+    reservation its budget held is released (DeviceCensus shows zero
+    residual), and the hosting worker keeps serving."""
+
+
+class InjectedDeadlineExceeded(QueryDeadlineExceeded):
+    """Chaos-harness form (`deadline:timeout:...`, runtime/faults.py):
+    a synthetic deadline expiry at the Nth checkpoint."""
+
+
+class QueryCancelled(QueryDeadlineExceeded):
+    """Cooperative cancellation (ExecContext.cancel event set) — the
+    graceful-drain / client-abandoned form of the same checkpoint
+    contract."""
+
+
+#: the executing thread's context, for cancellation checkpoints at
+#: conf-less brackets (exchange rounds, spill sweeps) — registered for
+#: the duration of a deadline-armed execute (cancel_scope)
+_TLS_CTX = threading.local()
+
+
+@contextmanager
+def cancel_scope(ctx: "ExecContext"):
+    """Register `ctx` as the executing thread's active context so
+    conf-less brackets (parallel/exchange.py rounds, runtime/memory.py
+    spill sweeps) can reach its cancellation checkpoint."""
+    prev = getattr(_TLS_CTX, "ctx", None)
+    _TLS_CTX.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _TLS_CTX.ctx = prev
+
+
+def checkpoint_active(bracket: str = "") -> None:
+    """Fire the active context's cancellation checkpoint (no-op when no
+    deadline-armed query runs on this thread)."""
+    ctx = getattr(_TLS_CTX, "ctx", None)
+    if ctx is not None:
+        ctx.checkpoint(bracket)
+
+
 @dataclasses.dataclass
 class ExecContext:
     """Per-query execution state threaded through the plan."""
@@ -47,11 +95,45 @@ class ExecContext:
     # OOM ladder / proactive election / serving admission; every
     # eligible hash join and aggregation then runs spill-partitioned
     ooc_force: bool = False
+    # cooperative cancellation (serving deadlines / graceful drain):
+    # absolute time.monotonic() deadline (0 = none) and an optional
+    # threading.Event — checkpoint() raises past either
+    deadline: float = 0.0
+    cancel: object = None
 
     def __post_init__(self):
         if self.tracer is None:
             from ..obs.tracer import NULL_TRACER
             self.tracer = NULL_TRACER
+
+    def arm_deadline(self, deadline_ms: float,
+                     started: Optional[float] = None) -> None:
+        """Arm the per-query deadline `deadline_ms` milliseconds after
+        `started` (time.monotonic(); now when None)."""
+        if deadline_ms and deadline_ms > 0:
+            base = time.monotonic() if started is None else started
+            self.deadline = base + float(deadline_ms) / 1e3
+
+    def checkpoint(self, bracket: str = "") -> None:
+        """Cooperative cancellation checkpoint — called at the seam /
+        per-batch / OOC-pass / exchange-round / spill brackets.  Fires
+        the `deadline` chaos site when armed, then raises
+        QueryCancelled / QueryDeadlineExceeded when the cancel event is
+        set or the deadline has passed.  The disabled path is two
+        attribute checks."""
+        from ..runtime.faults import get_injector
+        inj = get_injector(self.conf)
+        if inj.enabled:
+            inj.fire("deadline", bracket=bracket or "?")
+        if self.cancel is not None and self.cancel.is_set():
+            self.bump("deadline_checkpoints_cancelled")
+            raise QueryCancelled(
+                f"query cancelled at the {bracket or '?'} checkpoint")
+        if self.deadline and time.monotonic() > self.deadline:
+            self.bump("deadline_checkpoints_cancelled")
+            raise QueryDeadlineExceeded(
+                f"query deadline exceeded at the {bracket or '?'} "
+                f"checkpoint (serving.deadlineMs)")
 
     @property
     def budget(self):
@@ -149,6 +231,7 @@ class PlanNode:
         bound = self.row_upper_bound()
         hbs = []
         for db in self.execute(ctx):
+            ctx.checkpoint("batch")
             if isinstance(db.num_rows, int) and db.num_rows == 0:
                 continue
             t0 = _time.perf_counter()

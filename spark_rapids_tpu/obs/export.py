@@ -23,6 +23,7 @@ exporters the conf asks for, exactly once per process.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import List, Optional
@@ -73,6 +74,8 @@ class Heartbeat:
     def beat(self) -> None:
         """Write one snapshot line (also called directly by tests)."""
         line = json.dumps({"ts": time.time(), "type": "heartbeat",
+                           "pid": os.getpid(),
+                           "metrics_port": bound_metrics_port(),
                            "registry": REGISTRY.flat(),
                            "flight_len": len(FLIGHT_RECORDER)},
                           default=str)
@@ -170,13 +173,16 @@ def configure_plane(conf: TpuConf) -> None:
         return
     hb_path = str(conf.get(METRICS_HEARTBEAT_PATH) or "")
     port = int(conf.get(METRICS_PORT))
-    if hb_path or port:
+    if hb_path or port >= 0:
         with _EXPORT_LOCK:
             if hb_path and _HEARTBEAT is None:
                 _HEARTBEAT = Heartbeat(
                     hb_path,
                     float(conf.get(METRICS_REPORT_INTERVAL_S))).start()
-            if port and _HTTP is None:
+            # port 0 binds an EPHEMERAL port (concurrent worker
+            # processes on one host never race a fixed port); the
+            # bound port is reported by bound_metrics_port()
+            if port >= 0 and _HTTP is None:
                 try:
                     srv = MetricsHttpServer(port)
                     srv.start()
@@ -185,6 +191,15 @@ def configure_plane(conf: TpuConf) -> None:
                     # a busy port must not fail queries; the snapshot
                     # surfaces remain available in-process
                     pass
+
+
+def bound_metrics_port() -> Optional[int]:
+    """The ACTUALLY BOUND Prometheus endpoint port of this process, or
+    None when no server runs — with metrics.port=0 (ephemeral) this is
+    the only way to learn the port; heartbeat lines, worker-pool
+    heartbeat frames and ServingRuntime.stats() embed it."""
+    srv = _HTTP
+    return srv.port if srv is not None else None
 
 
 def shutdown_exporters() -> None:

@@ -598,6 +598,15 @@ class PerfHistoryStore:
         if over_entries or over_bytes:
             self._compact()
 
+    def checkpoint(self) -> None:
+        """Durably checkpoint the store NOW: rewrite the file as one
+        atomic aggregate summary (tmp + os.replace, same primitive the
+        cap-driven compaction uses).  Graceful drain calls this in
+        every serving worker and in the supervisor, so a restart/deploy
+        loses no folded history even mid-append."""
+        with self._lock:
+            self._compact()
+
     def _compact(self) -> None:
         """Rewrite the file as one aggregate summary per kept structure
         (+ the fit/calibration state), dropping least-recently-updated

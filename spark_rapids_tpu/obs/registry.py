@@ -668,6 +668,49 @@ SERVING_TENANT_PREDICTED_US = REGISTRY.counter(
     "per-tenant predicted-vs-measured calibration view.",
     ("tenant",))
 
+SERVING_WORKERS_LIVE = REGISTRY.gauge(
+    "tpu_serving_workers_live",
+    "Live worker processes in the supervised serving pool "
+    "(serving/workers.py): heartbeating and accepting dispatches. "
+    "Dips below serving.pool.processes only for the crash-to-restart "
+    "window.")
+
+SERVING_WORKER_RESTARTS = REGISTRY.counter(
+    "tpu_serving_worker_restarts_total",
+    "Worker-process deaths handled by the supervisor, by reason: "
+    "crash = the process died or its connection dropped (SIGKILL, "
+    "segfault, injected worker:kill), hang = the heartbeat-miss window "
+    "elapsed and the supervisor killed it, fatal = the worker "
+    "self-terminated after a classified FATAL_DEVICE crash dump. Each "
+    "death redrives the worker's in-flight queries; with pool.restart "
+    "a replacement is spawned.",
+    ("reason",))
+
+SERVING_REDRIVES = REGISTRY.counter(
+    "tpu_serving_redrives_total",
+    "Queries re-dispatched onto a surviving worker after losing their "
+    "worker process mid-flight (serving.redrive.maxAttempts bounds "
+    "attempts per query; results stay bit-identical — queries are "
+    "read-only and deterministic).",
+    ("reason",))
+
+SERVING_DEADLINE_CANCELS = REGISTRY.counter(
+    "tpu_serving_deadline_cancellations_total",
+    "Serving queries cancelled at a cooperative cancellation "
+    "checkpoint: deadline = serving.deadlineMs (or the per-submit "
+    "override) elapsed, injected = the deadline:timeout chaos site "
+    "fired, drain = cancelled by an explicit cancel event. The "
+    "cancelled ticket's full device reservation is released "
+    "(DeviceCensus shows zero residual).",
+    ("reason",))
+
+SERVING_WORKER_HEARTBEATS = REGISTRY.counter(
+    "tpu_serving_worker_heartbeats_total",
+    "Worker-pool heartbeat frames the supervisor consumed (each "
+    "carries the worker's pid, in-flight query and DeviceCensus "
+    "live/peak bytes — the cross-process HBM picture admission "
+    "reconciles against).")
+
 DICT_REMAPS = REGISTRY.counter(
     "tpu_join_dict_remaps_total",
     "Host dictionary remap/unification computations (index_in + "

@@ -516,6 +516,10 @@ class RaggedExchange:
                 tr.instant("exchange_round", "shuffle", r=r,
                            rounds=rounds, quota=q, recv_cap=recv_cap)
                 fire_active("exchange", round=r)
+                # exchange-round cancellation checkpoint: a deadline-
+                # armed query cancels between collective rounds
+                from ..exec.plan import checkpoint_active
+                checkpoint_active("exchange_round")
                 t0 = _time.perf_counter()
                 nxt = stage(st.lanes, st.rank, st.dest, st.live,
                             st.counts_dev, biases, jnp.int32(r + 1)) \

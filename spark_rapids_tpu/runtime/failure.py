@@ -114,6 +114,9 @@ def write_crash_dump(conf: TpuConf, exc: BaseException,
     info = {
         "ts": time.time(),
         "pid": os.getpid(),
+        # the supervised serving pool stamps each worker process's id
+        # into its environment: a post-mortem maps dump -> pool slot
+        "worker_id": os.environ.get("SPARK_RAPIDS_TPU_WORKER_ID"),
         "exception": repr(exc),
         "traceback": traceback.format_exception(
             type(exc), exc, exc.__traceback__),
@@ -159,8 +162,9 @@ def write_crash_dump(conf: TpuConf, exc: BaseException,
         if getattr(inj, "log", None):
             info["injected_faults"] = list(inj.log)
             break
-    # pid+second collides when two failures land in the same second: a
-    # process-monotonic sequence keeps every dump
+    # the pid keeps CONCURRENT WORKER PROCESSES sharing one dump dir
+    # from colliding; the process-monotonic -<seq> suffix keeps two
+    # same-second failures in ONE process from overwriting each other
     path = os.path.join(dump_dir,
                         f"tpu-coredump-{os.getpid()}-{int(time.time())}"
                         f"-{next(_DUMP_SEQ)}.json")

@@ -17,7 +17,8 @@ sites:
 
 Sites (the layers that can actually fail — see `SITES`):
   reserve, compile, execute, h2d, d2h, spill_write, spill_read,
-  shuffle_write, shuffle_fetch, exchange.
+  shuffle_write, shuffle_fetch, exchange, serving, result_cache,
+  history, memattr, ooc, kernel, worker, deadline.
 
 Kinds:
   oom     -> TpuRetryOOM       (the OOM retry ladder owns recovery)
@@ -26,8 +27,16 @@ Kinds:
   corrupt -> flips a payload byte in the on-disk block so the REAL
              checksum verification path detects it (spill_read only)
   fatal   -> InjectedFatalError (classified FATAL_DEVICE: crash dump +
-                                 FatalDeviceError, runtime/failure.py)
+                                 FatalDeviceError, runtime/failure.py);
+             at the worker site: the victim worker process dies with a
+             classified dump and its queries redrive
   error   -> InjectedQueryError (a plain query error, class QUERY)
+  timeout -> serving: the AdmissionTimeout backpressure signal;
+             deadline: a synthetic per-query deadline expiry at a
+             cancellation checkpoint
+  kill    -> (worker only) SIGKILL the victim worker process mid-query
+  hang    -> (worker only) wedge the victim worker (heartbeats stop;
+             the health monitor kills it past the miss window)
 
 Triggers fire deterministically: `nth=N` fires exactly once on the Nth
 hit of the site; `every=N` on every Nth hit; `p=F[,seed=N]` per-hit with
@@ -108,6 +117,29 @@ SITES: Dict[str, str] = {
            "forced on the replay); 'fatal' surfaces as a classified "
            "FATAL_DEVICE crash dump whose flight-recorder tail embeds "
            "the OOC bucket state the pass was in",
+    "worker": "serving worker-process dispatch (serving/workers.py) — "
+              "fires SUPERVISOR-side, once per query dispatched to a "
+              "worker process (redrives fire it again), so nth= "
+              "triggers stay deterministic across the pool. Kind "
+              "'kill' SIGKILLs the victim worker the moment its "
+              "'started' frame confirms the query is mid-flight; "
+              "'hang' wedges the victim (heartbeats and request "
+              "processing stop — the health monitor detects the "
+              "missed-heartbeat window and kills it); 'fatal' arms "
+              "the in-worker fatal injector so the query dies with a "
+              "classified FATAL_DEVICE crash dump and the worker "
+              "self-terminates. All three lose only the victim's "
+              "in-flight queries, which REDRIVE on a surviving worker "
+              "(serving.redrive.maxAttempts) bit-identically",
+    "deadline": "cooperative cancellation checkpoints (exec/plan.py "
+                "ExecContext.checkpoint): the compiled-plan seam "
+                "brackets, the per-batch result stream, out-of-core "
+                "partition/merge passes, exchange rounds and spill-all "
+                "sweeps. Kind 'timeout' injects a synthetic deadline "
+                "expiry at the Nth checkpoint — the query cancels "
+                "exactly as if serving.deadlineMs had elapsed there, "
+                "and the ticket's whole device reservation is released "
+                "(DeviceCensus shows zero residual)",
     "kernel": "Pallas kernel-tier dispatch (ops/pallas/) and encoded-"
               "execution dispatch (ops/encodings.py) — fires each "
               "time an operator elects a hand-written kernel or a "
@@ -122,16 +154,22 @@ SITES: Dict[str, str] = {
               "injected-fault record names the kernel",
 }
 
-KINDS = ("oom", "ioerror", "corrupt", "fatal", "error", "timeout")
+KINDS = ("oom", "ioerror", "corrupt", "fatal", "error", "timeout",
+         "kill", "hang")
 
 #: kinds the corrupt action makes sense for: it needs an on-disk block
 #: path (spill_read) or an in-memory payload bytearray (result_cache)
 #: in the fire() info to flip bytes in
 _CORRUPT_SITES = ("spill_read", "result_cache")
 
-#: the timeout kind models admission backpressure; only the serving
-#: admission site has that semantic
-_TIMEOUT_SITES = ("serving",)
+#: the timeout kind models admission backpressure (serving) and
+#: deadline expiry (the cancellation checkpoints)
+_TIMEOUT_SITES = ("serving", "deadline")
+
+#: process-level faults: only the supervised worker pool can SIGKILL or
+#: wedge a process, so kill/hang arm only at the worker site — and the
+#: worker site accepts only process-level kinds
+_WORKER_KINDS = ("kill", "hang", "fatal")
 
 
 class InjectedIOError(OSError):
@@ -141,6 +179,18 @@ class InjectedIOError(OSError):
 
 class InjectedQueryError(RuntimeError):
     """Synthetic plain query error (classified 'query')."""
+
+
+class InjectedWorkerFault(Exception):
+    """Control-flow signal for `worker:{kill,hang,fatal}` rules: raised
+    by fire('worker') SUPERVISOR-side at dispatch; the WorkerPool
+    catches it and acts on the victim process (SIGKILL after the
+    started frame / wedge the worker / arm the in-worker fatal
+    injector).  Never escapes the pool."""
+
+    def __init__(self, kind: str, msg: str):
+        super().__init__(msg)
+        self.kind = kind
 
 
 @dataclasses.dataclass
@@ -204,6 +254,12 @@ def parse_spec(spec: str) -> List[FaultRule]:
         if kind == "timeout" and site not in _TIMEOUT_SITES:
             raise ValueError(f"kind 'timeout' only applies to sites "
                              f"{list(_TIMEOUT_SITES)}, got {site!r}")
+        if kind in ("kill", "hang") and site != "worker":
+            raise ValueError(f"kind {kind!r} only applies to site "
+                             f"'worker', got {site!r}")
+        if site == "worker" and kind not in _WORKER_KINDS:
+            raise ValueError(f"site 'worker' only takes process-level "
+                             f"kinds {list(_WORKER_KINDS)}, got {kind!r}")
         rule = FaultRule(site, kind)
         if trigger == "always":
             rule.always = True
@@ -302,6 +358,14 @@ class FaultInjector:
         kind = rule.kind
         msg = (f"injected {kind} at fault site {site!r} "
                f"(hit #{rule.hits}, spark.rapids.tpu.test.faults)")
+        if site == "worker":
+            # process-level faults (kill/hang/fatal) act on the VICTIM
+            # process, not the firing thread: the supervisor catches
+            # this and kills/wedges/arms the dispatched worker
+            raise InjectedWorkerFault(kind, msg)
+        if kind == "timeout" and site == "deadline":
+            from ..exec.plan import InjectedDeadlineExceeded
+            raise InjectedDeadlineExceeded(msg)
         if kind == "oom":
             raise TpuRetryOOM(msg)
         if kind == "ioerror":

@@ -324,9 +324,14 @@ class MemoryBudget:
 
     def spill_all(self):
         """Reactive path (retry framework): push every held batch off
-        device before replaying the failed attempt."""
+        device before replaying the failed attempt.  Each spillable is
+        a cancellation checkpoint: a deadline-armed query cancels
+        BETWEEN spills (every block fully written or not started), so
+        a long spill sweep cannot pin a cancelled query's device slot."""
+        from ..exec.plan import checkpoint_active
         with self._lock:
             for sp in list(self._spillables.values()):
+                checkpoint_active("spill")
                 if sp.on_device:
                     sp.spill()
 

@@ -17,7 +17,10 @@ gathers, so replaying is safe by construction.
 """
 from __future__ import annotations
 
+import itertools
+import os
 import time
+import zlib
 from typing import Callable, Iterator, List, Optional, TypeVar
 
 import jax.numpy as jnp
@@ -25,12 +28,37 @@ import jax.numpy as jnp
 from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
 from ..config import (RETRY_ENABLED, RETRY_IO_ATTEMPTS,
                       RETRY_IO_BACKOFF_MS, RETRY_IO_BACKOFF_MULT,
-                      RETRY_MAX_ATTEMPTS, RETRY_MAX_SPLITS, TpuConf)
+                      RETRY_IO_JITTER, RETRY_MAX_ATTEMPTS,
+                      RETRY_MAX_SPLITS, TpuConf)
 from ..obs.registry import BATCH_SPLITS, IO_RETRIES, OOM_RETRIES
 from .memory import (MemoryBudget, TpuRetryOOM, TpuSplitAndRetryOOM,
                      is_oom_error)
 
 T = TypeVar("T")
+
+#: per-process backoff-draw counter: each sleep advances the stream, so
+#: one process's jitter sequence is exactly reproducible while distinct
+#: processes (distinct pid seeds) desynchronize
+_JITTER_SEQ = itertools.count(1)
+
+
+def _jittered_backoff_s(backoff_s: float, fraction: float, seed: int,
+                        draw: int) -> float:
+    """`backoff_s` scaled by a deterministic factor in
+    [1-fraction, 1+fraction]: the splitmix64 stream (runtime/faults.py
+    — NOT python's salted hash) keyed by (seed, draw).  N worker
+    processes replaying the SAME injected host-IO fault sleep different
+    amounts (pid-distinct seeds) instead of thundering-herding the
+    spill disk; re-running one process replays its exact sequence."""
+    if fraction <= 0.0:
+        return backoff_s
+    from .faults import _splitmix_uniform
+    u = _splitmix_uniform(seed, draw)
+    return backoff_s * (1.0 + fraction * (2.0 * u - 1.0))
+
+
+def _io_jitter_seed(site: str) -> int:
+    return os.getpid() ^ zlib.crc32(site.encode())
 
 
 def retry_io(conf: TpuConf, site: str, attempt: Callable[[], T],
@@ -73,11 +101,14 @@ def retry_io(conf: TpuConf, site: str, attempt: Callable[[], T],
             if budget is not None:
                 budget.metrics["io_retries"] += 1
             if backoff > 0:
+                sleep_s = _jittered_backoff_s(
+                    backoff, float(conf.get(RETRY_IO_JITTER)),
+                    _io_jitter_seed(site), next(_JITTER_SEQ))
                 if lock is not None:
                     with lock.yielded():
-                        time.sleep(backoff)
+                        time.sleep(sleep_s)
                 else:
-                    time.sleep(backoff)
+                    time.sleep(sleep_s)
             backoff *= mult
     raise AssertionError("unreachable")
 

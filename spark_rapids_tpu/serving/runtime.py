@@ -34,6 +34,23 @@ asks for, built ON TOP of the existing substrate rather than beside it:
     from the same integer measurement the ticket records, so registry
     totals and per-ticket sums agree exactly.
   * RESULT CACHE — see serving/cache.py.
+  * FAULT ISOLATION (`serving.pool.processes` > 0) — queries execute in
+    a SUPERVISED POOL of worker processes (serving/workers.py): each
+    worker owns its own TpuSession / MemoryBudget / device slice while
+    sharing the persistent compile cache and history store; a crash,
+    hang or fatal device error in one worker loses only its in-flight
+    queries, which REDRIVE on survivors (serving.redrive.maxAttempts)
+    bit-identically.  Admission stays here, in the supervisor, and the
+    HBM gate reconciles its estimates against the pool's heartbeat-
+    reported DeviceCensus totals — a truthful cross-process picture.
+  * DEADLINES (`serving.deadlineMs`, or per-submit) — cooperative
+    cancellation at the engine's natural brackets (seam / batch / OOC
+    pass / exchange round / spill sweep, ExecContext.checkpoint):
+    an expired query raises QueryDeadlineExceeded at the next
+    checkpoint and its FULL device reservation releases.
+  * GRACEFUL DRAIN — `drain()` stops admitting, lets in-flight queries
+    finish (or redrive), checkpoints the history store, and reaps every
+    worker process: empty queue, no orphans.
 
 Surfaces: `TpuSession.serving()` -> ServingRuntime;
 `runtime.tenant("bi", weight=2.0)` -> TenantSession with
@@ -52,11 +69,13 @@ import pyarrow as pa
 from ..config import (HBM_BUDGET_BYTES, HBM_BUDGET_FRACTION,
                       SERVING_ADMIT_TIMEOUT_MS,
                       SERVING_ADMIT_WORKING_SET_FACTOR,
-                      SERVING_DEVICE_SLOTS, SERVING_QUEUE_DEPTH,
+                      SERVING_DEADLINE_MS, SERVING_DEVICE_SLOTS,
+                      SERVING_POOL_PROCS, SERVING_QUEUE_DEPTH,
                       SERVING_RESULT_CACHE_BYTES, SERVING_STARVATION_BOUND,
                       SERVING_WORKERS, TpuConf)
-from ..obs.registry import (SERVING_ADMIT_WAIT_MS, SERVING_DEVICE_BUSY_US,
-                            SERVING_QUERIES, SERVING_TENANT_DEVICE_US,
+from ..obs.registry import (SERVING_ADMIT_WAIT_MS, SERVING_DEADLINE_CANCELS,
+                            SERVING_DEVICE_BUSY_US, SERVING_QUERIES,
+                            SERVING_TENANT_DEVICE_US,
                             SERVING_TENANT_PREDICTED_US)
 from ..obs.registry import SERVING_QUEUE_DEPTH as QUEUE_DEPTH_GAUGE
 from .cache import ResultCache, result_cache_key
@@ -96,6 +115,12 @@ class QueryTicket:
         #: serializing the queue) the query executes with the OOC tier
         #: forced and a grant sized to the OOC resident window
         self.ooc = False
+        #: per-query deadline (serving.deadlineMs or the submit
+        #: override); 0 = none.  Cooperative: enforced at the engine's
+        #: checkpoint brackets, not by thread preemption.
+        self.deadline_ms = 0.0
+        self.redrives = 0                 # worker losses survived (MP mode)
+        self.worker = None                # worker id that answered (MP mode)
         self.device_us = 0                # measured device-execute micros
         self.skips = 0                    # scheduler pass-overs at grant
         self.admit_wait_ms = 0.0
@@ -152,14 +177,18 @@ class TenantSession:
         self._runtime = runtime
         self.name = name
 
-    def submit(self, df) -> QueryTicket:
-        return self._runtime.submit(df, tenant=self.name)
+    def submit(self, df,
+               deadline_ms: Optional[float] = None) -> QueryTicket:
+        return self._runtime.submit(df, tenant=self.name,
+                                    deadline_ms=deadline_ms)
 
-    def collect(self, df, timeout: Optional[float] = 600.0) -> pa.Table:
+    def collect(self, df, timeout: Optional[float] = 600.0,
+                deadline_ms: Optional[float] = None) -> pa.Table:
         try:
-            ticket = self.submit(df)
+            ticket = self.submit(df, deadline_ms=deadline_ms)
         except AdmissionTimeout:
-            ticket = self.submit(df)      # one bounded re-admission
+            # one bounded re-admission
+            ticket = self.submit(df, deadline_ms=deadline_ms)
         return ticket.result(timeout)
 
 
@@ -178,8 +207,19 @@ class ServingRuntime:
         self._merged = (None, None)
         self._queue_depth = rconf.get(SERVING_QUEUE_DEPTH)
         self._admit_timeout_s = rconf.get(SERVING_ADMIT_TIMEOUT_MS) / 1e3
+        self._deadline_ms = float(rconf.get(SERVING_DEADLINE_MS))
+        #: serving.pool.processes > 0 = MULTI-PROCESS mode: queries
+        #: execute in the supervised worker pool (serving/workers.py);
+        #: the pool itself starts lazily, on the first submit
+        self._pool_procs = int(rconf.get(SERVING_POOL_PROCS))
+        self._worker_pool = None
         self._device_slots = rconf.get(SERVING_DEVICE_SLOTS)
-        if self._device_slots == 0:
+        if self._pool_procs > 0:
+            # each worker process owns its own device slice + budget:
+            # device phases genuinely run in parallel across processes,
+            # so the grant width IS the pool width
+            self._device_slots = self._pool_procs
+        elif self._device_slots == 0:
             # auto: on an accelerator, the GpuSemaphore sizing
             # (concurrentTpuTasks) — the chip pipelines dispatches and
             # one query's host tail overlaps another's compute.  On the
@@ -207,6 +247,8 @@ class ServingRuntime:
         self._device_active = 0
         self._device_bytes = 0           # working-set estimates admitted
         self._closed = False
+        self._draining = False           # drain(): admission closed, in-
+                                         # flight queries still finishing
         # -- stats (under _cond) -------------------------------------
         self._t0 = time.perf_counter()
         self._busy_us = 0
@@ -215,6 +257,7 @@ class ServingRuntime:
         self._completed = 0
         self._admission_timeouts = 0
         self._ooc_admissions = 0         # oversized queries admitted OOC
+        self._deadline_cancels = 0       # deadline/injected cancellations
         #: recent (phase, ticket id, t0, t1) intervals — the overlap
         #: proof stats()["overlap_observed"] is computed from
         self._intervals: List[tuple] = []
@@ -245,12 +288,17 @@ class ServingRuntime:
 
     # -- admission ---------------------------------------------------------
     def submit(self, df, tenant: str = "default",
-               conf: Optional[TpuConf] = None) -> QueryTicket:
+               conf: Optional[TpuConf] = None,
+               deadline_ms: Optional[float] = None) -> QueryTicket:
         """Admit one query (blocking up to admitTimeoutMs when the queue
         is full) and start its pipeline.  `df` is a DataFrame or a
-        logical plan; the session conf is SNAPSHOT here, at admission."""
+        logical plan; the session conf is SNAPSHOT here, at admission.
+        `deadline_ms` overrides serving.deadlineMs for this query."""
         if self._closed:
             raise RuntimeError("ServingRuntime is closed")
+        if self._draining:
+            raise RuntimeError("ServingRuntime is draining: admission "
+                               "is closed, in-flight queries finishing")
         # the snapshot: TpuConf instances are immutable — grabbing the
         # reference pins this query's behavior against later set_conf
         snap = conf or self._session.conf
@@ -265,6 +313,10 @@ class ServingRuntime:
         injector.fire("serving", tenant=tenant)
         plan = getattr(df, "_plan", df)
         ticket = QueryTicket(plan, snap, tenant)
+        ticket.deadline_ms = float(self._deadline_ms
+                                   if deadline_ms is None else deadline_ms)
+        if self._pool_procs > 0:
+            self._ensure_pool()
         t0 = time.perf_counter()
         deadline = t0 + self._admit_timeout_s
         with self._cond:
@@ -293,6 +345,23 @@ class ServingRuntime:
         self._pool.submit(self._run, ticket, injector)
         return ticket
 
+    def _ensure_pool(self):
+        """The supervised worker pool, started on first demand (worker
+        processes each build a full TpuSession — seconds, paid once)."""
+        with self._cond:
+            pool = self._worker_pool
+            if pool is not None:
+                return pool
+        from .workers import WorkerPool
+        pool = WorkerPool(self._rconf, dict(self._rconf._raw),
+                          self._pool_procs).start()
+        with self._cond:
+            if self._worker_pool is None:
+                self._worker_pool = pool
+                return pool
+        pool.close()                     # lost the race: keep the first
+        return self._worker_pool
+
     # -- the per-query pipeline (one worker thread) ------------------------
     def _run(self, ticket: QueryTicket, injector) -> None:
         try:
@@ -303,6 +372,16 @@ class ServingRuntime:
                 status="cache_hit" if ticket.cache == "hit" else "ok")
         except BaseException as e:                   # noqa: BLE001
             ticket._fail(e)
+            from ..exec.plan import (InjectedDeadlineExceeded,
+                                     QueryCancelled, QueryDeadlineExceeded)
+            if isinstance(e, QueryDeadlineExceeded):
+                reason = ("injected"
+                          if isinstance(e, InjectedDeadlineExceeded)
+                          else "drain" if isinstance(e, QueryCancelled)
+                          else "deadline")
+                SERVING_DEADLINE_CANCELS.inc(reason=reason)
+                with self._cond:
+                    self._deadline_cancels += 1
             SERVING_QUERIES.inc(tenant=ticket.tenant, status="error")
         finally:
             with self._cond:
@@ -348,6 +427,13 @@ class ServingRuntime:
         if pred:
             SERVING_TENANT_PREDICTED_US.inc(int(pred["device_us"]),
                                             tenant=ticket.tenant)
+        if self._pool_procs > 0:
+            # MULTI-PROCESS mode: the query executes in the supervised
+            # worker pool.  The result cache is bypassed (the mp tier
+            # trades it for fault isolation — the persistent compile
+            # cache still dedupes across workers); admission, fair
+            # share and the HBM gate stay here in the supervisor.
+            return self._pipeline_mp(ticket, injector, q, pred)
         keyed = None
         if self.cache.cap_bytes and q.kind == "device":
             keyed = result_cache_key(q.root, ticket.conf)
@@ -361,6 +447,47 @@ class ServingRuntime:
             self._compile(q, ticket)
         with self._phase("upload", ticket):
             est_bytes = self._upload(q, ticket)
+        est_bytes = self._admit_working_set(ticket, est_bytes, pred)
+        with self._device_grant(ticket, est_bytes):
+            with self._phase("execute", ticket):
+                from ..exec.plan import ExecContext, cancel_scope
+                ctx = ExecContext(ticket.conf)
+                # cooperative deadline: checked at every checkpoint
+                # bracket (seam/batch/OOC/exchange/spill); the clock
+                # starts HERE, at the device grant — queue wait does
+                # not consume the budget
+                ctx.arm_deadline(ticket.deadline_ms)
+                if ticket.ooc:
+                    ctx.ooc_force = True
+                ctx.metrics["serving.tenant"] = ticket.tenant
+                if pred:
+                    # stamped pre-collect so the instrumented scope
+                    # embeds the prediction in the trace + event log
+                    # and the history record calibrates against it
+                    ctx.metrics["predicted.device_us"] = \
+                        int(pred["device_us"])
+                    ctx.metrics["predicted.basis"] = pred["basis"]
+                    ctx.metrics["predicted.working_set_bytes"] = \
+                        int(pred.get("working_set_bytes") or 0)
+                    ctx.metrics["predicted.ws_basis"] = \
+                        str(pred.get("ws_basis") or "?")
+                    ctx.metrics["predicted.confidence"] = \
+                        pred.get("confidence")
+                t0 = time.perf_counter()
+                with cancel_scope(ctx):
+                    out = q.collect(ctx)
+                ticket.device_us = int(
+                    (time.perf_counter() - t0) * 1e6)
+        if keyed is not None and ticket.error is None:
+            if self.cache.put(keyed[0], out, keyed[1]):
+                ticket.cache = "store"
+        return out
+
+    def _admit_working_set(self, ticket: QueryTicket, est_bytes: int,
+                           pred: Optional[dict]) -> int:
+        """Tighten the heuristic working-set estimate against the
+        history oracle, then make the OVERSIZED call (shared by the
+        thread and multi-process pipelines)."""
         if pred and pred.get("ws_basis") == "measured" and \
                 int(pred.get("working_set_bytes") or 0) > 0:
             # MEASURED-basis working set (memattr query peaks / XLA
@@ -393,33 +520,35 @@ class ServingRuntime:
                     self._ooc_admissions += 1
                 from ..obs.registry import OOC_ELECTIONS
                 OOC_ELECTIONS.inc(op="query", mode="admission")
+        return est_bytes
+
+    def _pipeline_mp(self, ticket: QueryTicket, injector, q,
+                     pred: Optional[dict]) -> pa.Table:
+        """The multi-process tail of the pipeline: size the grant from
+        the LOGICAL plan (uploads happen inside whichever worker wins
+        the dispatch, against that worker's own budget), then dispatch
+        through the pool's redrive loop under a supervisor grant."""
+        src_bytes = 0
+        if q.kind == "device":
+            from ..exec.plan import HostScanExec
+            stack, seen = [q.root], set()
+            while stack:
+                n = stack.pop()
+                if id(n) in seen:
+                    continue
+                seen.add(id(n))
+                if isinstance(n, HostScanExec) and \
+                        n._source_table is not None:
+                    src_bytes += int(n._source_table.nbytes)
+                stack.extend(getattr(n, "children", ()))
+        est_bytes = self._admit_working_set(
+            ticket, int(src_bytes * self._ws_factor), pred)
+        pool = self._ensure_pool()
         with self._device_grant(ticket, est_bytes):
             with self._phase("execute", ticket):
-                from ..exec.plan import ExecContext
-                ctx = ExecContext(ticket.conf)
-                if ticket.ooc:
-                    ctx.ooc_force = True
-                ctx.metrics["serving.tenant"] = ticket.tenant
-                if pred:
-                    # stamped pre-collect so the instrumented scope
-                    # embeds the prediction in the trace + event log
-                    # and the history record calibrates against it
-                    ctx.metrics["predicted.device_us"] = \
-                        int(pred["device_us"])
-                    ctx.metrics["predicted.basis"] = pred["basis"]
-                    ctx.metrics["predicted.working_set_bytes"] = \
-                        int(pred.get("working_set_bytes") or 0)
-                    ctx.metrics["predicted.ws_basis"] = \
-                        str(pred.get("ws_basis") or "?")
-                    ctx.metrics["predicted.confidence"] = \
-                        pred.get("confidence")
-                t0 = time.perf_counter()
-                out = q.collect(ctx)
-                ticket.device_us = int(
-                    (time.perf_counter() - t0) * 1e6)
-        if keyed is not None and ticket.error is None:
-            if self.cache.put(keyed[0], out, keyed[1]):
-                ticket.cache = "store"
+                out, device_us = pool.execute(ticket, injector,
+                                              ticket.deadline_ms)
+                ticket.device_us = int(device_us)
         return out
 
     def _compile(self, q, ticket: QueryTicket) -> None:
@@ -473,7 +602,14 @@ class ServingRuntime:
         est = st.queue[0]._grant_est
         if self._hbm_limit <= 0:
             return True
-        if self._device_bytes + est <= self._hbm_limit:
+        used = self._device_bytes
+        if self._worker_pool is not None:
+            # the truthful cross-process picture: the pool's heartbeat-
+            # reported DeviceCensus live bytes, reconciled against the
+            # supervisor's own reservations — gate on whichever says
+            # MORE (estimates can undershoot; the census can lag)
+            used = max(used, self._worker_pool.live_bytes())
+        if used + est <= self._hbm_limit:
             return True
         return self._device_active == 0      # too big: run it solo
 
@@ -550,12 +686,15 @@ class ServingRuntime:
                        for st in self._tenants.values()}
             intervals = list(self._intervals)
             busy_us = self._busy_us
+            pool = self._worker_pool
             out = {"inflight": self._inflight,
                    "completed": self._completed,
                    "max_queue_depth": self._max_depth,
                    "max_skips": self._max_skips,
                    "admission_timeouts": self._admission_timeouts,
                    "ooc_admissions": self._ooc_admissions,
+                   "deadline_cancellations": self._deadline_cancels,
+                   "draining": self._draining,
                    "device_slots": self._device_slots,
                    "hbm_limit_bytes": self._hbm_limit,
                    "wall_s": round(wall_s, 3),
@@ -565,6 +704,11 @@ class ServingRuntime:
                    if wall_s > 0 else 0.0,
                    "tenants": tenants,
                    "result_cache": self.cache.stats()}
+        from ..obs.export import bound_metrics_port
+        out["metrics_port"] = bound_metrics_port()
+        if pool is not None:
+            out["pool"] = pool.stats()
+            out["census"] = pool.census()
         out["overlap_observed"] = _overlap_observed(intervals)
         # oracle trustworthiness: per-basis estimate counts + the
         # prediction-error summary (obs/estimator.py / history plane)
@@ -576,12 +720,45 @@ class ServingRuntime:
         return out
 
     # -- lifecycle ---------------------------------------------------------
+    def drain(self, timeout: float = 120.0) -> None:
+        """GRACEFUL shutdown: stop admitting (new submits raise), let
+        every in-flight query finish — in multi-process mode a query on
+        a dying worker still REDRIVES during drain — then checkpoint
+        the history store (atomic aggregate rewrite) and reap every
+        worker process.  On return: empty queue, no orphans, runtime
+        closed.  Unlike close(), grant waiters are NOT aborted."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = time.perf_counter() + timeout
+        with self._cond:
+            while self._inflight > 0:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"drain: {self._inflight} queries still in "
+                        f"flight after {timeout}s")
+                self._cond.wait(min(remaining, 0.5))
+        from ..obs.history import get_store
+        store = get_store(self._rconf)
+        if store is not None:
+            store.checkpoint()
+        pool = self._worker_pool
+        if pool is not None:
+            pool.drain()                 # workers checkpoint + exit 0
+            self._worker_pool = None
+        self.close()
+
     def close(self, wait: bool = True) -> None:
         """Stop accepting queries; `wait` drains in-flight ones."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
         self._pool.shutdown(wait=wait)
+        pool = self._worker_pool
+        if pool is not None:
+            pool.close()
+            self._worker_pool = None
 
     def __enter__(self) -> "ServingRuntime":
         return self

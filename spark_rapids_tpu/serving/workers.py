@@ -1,0 +1,657 @@
+"""Fault-isolated multi-process serving: the supervised worker pool.
+
+One Python process is one fault domain: a fatal XLA error, a native
+crash or a SIGKILL takes down every tenant it hosts, and throughput
+cannot scale past one GIL.  The reference engine survives this class of
+failure *structurally* — Spark retries tasks and replaces dead
+executors; Theseus (PAPERS.md) runs a distributed GPU query platform
+whose worker processes are replaceable units behind one admission
+plane.  Our queries are read-only and deterministic with oracle-checked
+results, so REDRIVE-ON-CRASH is safe by construction.
+
+This module is both sides of that boundary:
+
+  * `WorkerPool` — the SUPERVISOR, embedded in the ServingRuntime when
+    `serving.pool.processes` > 0: spawns N worker processes, dispatches
+    admitted queries to the least-loaded live worker over an
+    authenticated local socket (the plugin/worker.py framing), consumes
+    heartbeats (pid, in-flight query, DeviceCensus totals, bound
+    metrics port), detects death three ways (connection EOF, process
+    exit, heartbeat-miss window) and REDRIVES the dead worker's
+    in-flight queries on survivors up to `serving.redrive.maxAttempts`.
+    With `serving.pool.restart` it spawns a replacement so the pool
+    holds its size.
+  * `main()` — the WORKER: builds its own TpuSession (own MemoryBudget,
+    own device slice, own metrics plane) from the conf the supervisor
+    ships, shares the PERSISTENT compile cache and history store with
+    its siblings (topology-keyed dirs; JSONL appends and the aggregate
+    summary rewrite are multi-process safe), executes one query per
+    request under the full single-query substrate (crash_capture, retry
+    ladders, OOC tier), and SELF-TERMINATES after a classified
+    FATAL_DEVICE dump — the Plugin.scala executor-self-termination
+    contract, with the supervisor as the cluster manager that replaces
+    it.
+
+Chaos (`worker:{kill,hang,fatal}:trigger`, runtime/faults.py) fires
+SUPERVISOR-side at dispatch so nth= triggers stay deterministic across
+the pool; `kill` SIGKILLs the victim the moment its `started` frame
+confirms the query is mid-flight, `hang` wedges it (the heartbeat-miss
+window detects it), `fatal` arms the in-worker fatal injector.  All
+three lose only the victim's in-flight queries, which redrive
+bit-identically while other tenants' queries complete uninterrupted.
+
+Graceful drain: the runtime stops admitting, in-flight queries finish
+or redrive, then every worker checkpoints the history store (atomic
+aggregate rewrite) and exits 0 — no orphan processes, nothing lost.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..config import (SERVING_POOL_HEARTBEAT_MISSES,
+                      SERVING_POOL_HEARTBEAT_MS, SERVING_POOL_RESTART,
+                      SERVING_REDRIVE_MAX, TpuConf)
+from ..plugin.worker import recv_frame, send_frame
+
+_ENV_ID = "SPARK_RAPIDS_TPU_WORKER_ID"
+_ENV_ADDR = "SPARK_RAPIDS_TPU_WORKER_ADDR"
+_ENV_TOKEN = "SPARK_RAPIDS_TPU_WORKER_TOKEN"
+
+#: worker exit codes: the supervisor's restart accounting reads these
+EXIT_DRAINED = 0
+EXIT_FATAL = 13
+
+
+class WorkerLost(RuntimeError):
+    """A dispatched query's worker process died before answering
+    (crash / SIGKILL / hang-kill / fatal self-termination).  Caught by
+    the redrive loop, never by client code."""
+
+    def __init__(self, msg: str, reason: str):
+        super().__init__(msg)
+        self.reason = reason
+
+
+class ServingWorkerError(RuntimeError):
+    """A query exhausted `serving.redrive.maxAttempts` worker losses —
+    the terminal form the ticket fails with."""
+
+
+def _frame(obj: dict) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _unframe(data: bytes) -> dict:
+    return pickle.loads(data)
+
+
+# ===========================================================================
+# Supervisor side
+# ===========================================================================
+
+class _Dispatch:
+    """One in-flight query on one worker (supervisor bookkeeping)."""
+
+    __slots__ = ("qid", "event", "reply", "lost", "kill_on_start",
+                 "started")
+
+    def __init__(self, qid: int, kill_on_start: bool = False):
+        self.qid = qid
+        self.event = threading.Event()
+        self.reply: Optional[dict] = None
+        self.lost: Optional[WorkerLost] = None
+        self.kill_on_start = kill_on_start
+        self.started = threading.Event()
+
+
+class _WorkerHandle:
+    """Supervisor-side state for one worker process."""
+
+    def __init__(self, wid: str, proc: subprocess.Popen):
+        self.wid = wid
+        self.proc = proc
+        self.conn: Optional[socket.socket] = None
+        self.send_lock = threading.Lock()
+        self.pid: Optional[int] = None
+        self.metrics_port: Optional[int] = None
+        self.ready = threading.Event()       # hello received, conf sent
+        self.alive = False                   # ready and not declared dead
+        self.last_hb = time.monotonic()
+        self.census: Dict[str, int] = {"live_bytes": 0, "peak_bytes": 0}
+        self.inflight: Dict[int, _Dispatch] = {}     # qid -> dispatch
+        self.draining = False
+
+    def send(self, obj: dict) -> None:
+        with self.send_lock:
+            send_frame(self.conn, _frame(obj))
+
+
+class WorkerPool:
+    """Supervises `procs` worker processes behind the admission front
+    (serving/runtime.py owns admission, conf snapshots, fair-share
+    grants and tickets; the pool owns dispatch, health, redrive, and
+    the cross-process census picture)."""
+
+    def __init__(self, rconf: TpuConf, conf_raw: dict, procs: int):
+        self._rconf = rconf
+        self._conf_raw = dict(conf_raw)
+        self.procs = int(procs)
+        self._hb_s = float(rconf.get(SERVING_POOL_HEARTBEAT_MS)) / 1e3
+        self._hb_misses = int(rconf.get(SERVING_POOL_HEARTBEAT_MISSES))
+        self._restart = bool(rconf.get(SERVING_POOL_RESTART))
+        self._redrive_max = int(rconf.get(SERVING_REDRIVE_MAX))
+        self._cond = threading.Condition()
+        self._workers: Dict[str, _WorkerHandle] = {}
+        self._wid_seq = 0
+        self._srv: Optional[socket.socket] = None
+        self._token = b""
+        self._closed = False
+        self._draining = False
+        self._restarts: Dict[str, int] = {}          # reason -> count
+        self._redrives = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, timeout: float = 120.0) -> "WorkerPool":
+        import secrets
+        self._token = secrets.token_hex(16).encode()
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="tpu-pool-accept").start()
+        threading.Thread(target=self._monitor_loop, daemon=True,
+                         name="tpu-pool-monitor").start()
+        for _ in range(self.procs):
+            self._spawn()
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._live_count() < self.procs:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.close()
+                    raise RuntimeError(
+                        f"serving worker pool: only {self._live_count()}"
+                        f"/{self.procs} workers came up in {timeout}s")
+                self._cond.wait(min(remaining, 0.5))
+        return self
+
+    def _spawn(self) -> _WorkerHandle:
+        with self._cond:
+            self._wid_seq += 1
+            wid = f"w{self._wid_seq}"
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env[_ENV_ID] = wid
+        env[_ENV_ADDR] = "%s:%d" % self._srv.getsockname()
+        env[_ENV_TOKEN] = self._token.decode()
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "spark_rapids_tpu.serving.workers"],
+            env=env, stdin=subprocess.DEVNULL)
+        h = _WorkerHandle(wid, proc)
+        with self._cond:
+            self._workers[wid] = h
+        return h
+
+    def _accept_loop(self) -> None:
+        import hmac
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            try:
+                hello = recv_frame(conn)
+                if hello is None:
+                    conn.close()
+                    continue
+                msg = _unframe(hello)
+                if not hmac.compare_digest(
+                        msg.get("token", "").encode(), self._token):
+                    conn.close()
+                    continue
+                wid = msg["worker_id"]
+                with self._cond:
+                    h = self._workers.get(wid)
+                if h is None:
+                    conn.close()
+                    continue
+                h.conn = conn
+                h.pid = msg.get("pid")
+                h.metrics_port = msg.get("metrics_port")
+                h.send({"op": "conf", "conf": self._conf_raw,
+                        "hb_ms": self._hb_s * 1e3})
+                h.last_hb = time.monotonic()
+                with self._cond:
+                    h.alive = True
+                    h.ready.set()
+                    self._cond.notify_all()
+                self._set_live_gauge()
+                threading.Thread(target=self._reader_loop, args=(h,),
+                                 daemon=True,
+                                 name=f"tpu-pool-read-{wid}").start()
+            except Exception:                        # noqa: BLE001
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _reader_loop(self, h: _WorkerHandle) -> None:
+        from ..obs.registry import SERVING_WORKER_HEARTBEATS
+        while True:
+            try:
+                data = recv_frame(h.conn)
+            except OSError:
+                data = None
+            if data is None:
+                self._declare_dead(h, "crash")
+                return
+            try:
+                msg = _unframe(data)
+            except Exception:                        # noqa: BLE001
+                self._declare_dead(h, "crash")
+                return
+            op = msg.get("op")
+            if op == "hb":
+                h.last_hb = time.monotonic()
+                h.census = dict(msg.get("census") or {})
+                if msg.get("metrics_port") is not None:
+                    h.metrics_port = msg["metrics_port"]
+                SERVING_WORKER_HEARTBEATS.inc()
+            elif op == "started":
+                d = h.inflight.get(msg.get("qid"))
+                if d is not None:
+                    d.started.set()
+                    if d.kill_on_start:
+                        # worker:kill — the victim is now PROVABLY
+                        # mid-query; lose the whole process
+                        try:
+                            h.proc.kill()
+                        except OSError:
+                            pass
+            elif op in ("result", "error"):
+                qid = msg.get("qid")
+                d = h.inflight.pop(qid, None)
+                if op == "error" and \
+                        msg.get("classification") == "fatal_device":
+                    # the worker wrote its classified dump and is
+                    # self-terminating: its query REDRIVES (the dump
+                    # names the pid; the redrive conf carries no
+                    # injected fatal), exactly like a plain crash
+                    if d is not None:
+                        d.lost = WorkerLost(
+                            f"worker {h.wid} hit a fatal device error "
+                            f"(dump: {msg.get('dump_path')})", "fatal")
+                        d.event.set()
+                    self._declare_dead(h, "fatal")
+                    return
+                if d is not None:
+                    d.reply = msg
+                    d.event.set()
+                with self._cond:
+                    self._cond.notify_all()
+            elif op == "drained":
+                h.draining = True
+                with self._cond:
+                    self._cond.notify_all()
+
+    def _monitor_loop(self) -> None:
+        while not self._closed:
+            time.sleep(self._hb_s)
+            now = time.monotonic()
+            with self._cond:
+                handles = list(self._workers.values())
+            for h in handles:
+                if not h.alive:
+                    continue
+                if h.proc.poll() is not None:
+                    self._declare_dead(h, "crash")
+                elif not h.draining and \
+                        now - h.last_hb > self._hb_s * self._hb_misses:
+                    # hung: heartbeats stopped but the process lives —
+                    # SIGKILL it and treat exactly like a crash
+                    try:
+                        h.proc.kill()
+                    except OSError:
+                        pass
+                    self._declare_dead(h, "hang")
+
+    def _declare_dead(self, h: _WorkerHandle, reason: str) -> None:
+        from ..obs.registry import SERVING_WORKER_RESTARTS
+        with self._cond:
+            if not h.alive and h.ready.is_set():
+                return                   # already handled
+            h.alive = False
+            self._workers.pop(h.wid, None)
+            pending = list(h.inflight.values())
+            h.inflight.clear()
+            self._restarts[reason] = self._restarts.get(reason, 0) + 1
+            self._cond.notify_all()
+        SERVING_WORKER_RESTARTS.inc(reason=reason)
+        self._set_live_gauge()
+        try:
+            if h.conn is not None:
+                h.conn.close()
+        except OSError:
+            pass
+        for d in pending:
+            if d.lost is None:
+                d.lost = WorkerLost(
+                    f"worker {h.wid} (pid {h.pid}) died mid-query "
+                    f"({reason})", reason)
+            d.event.set()
+        if self._restart and not self._draining and not self._closed:
+            self._spawn()
+
+    def _set_live_gauge(self) -> None:
+        from ..obs.registry import SERVING_WORKERS_LIVE
+        SERVING_WORKERS_LIVE.set(self._live_count())
+
+    def _live_count(self) -> int:
+        return sum(1 for h in list(self._workers.values()) if h.alive)
+
+    # -- dispatch ----------------------------------------------------------
+    def _pick(self, timeout: float = 60.0) -> _WorkerHandle:
+        """The least-loaded live worker (blocks for a restart when the
+        whole pool is momentarily down)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                live = [h for h in self._workers.values()
+                        if h.alive and not h.draining]
+                if live:
+                    return min(live, key=lambda h: (len(h.inflight),
+                                                    h.wid))
+                if self._closed or self._draining:
+                    raise ServingWorkerError("worker pool is closed")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ServingWorkerError(
+                        f"no live serving worker within {timeout}s")
+                self._cond.wait(min(remaining, 0.5))
+
+    def execute(self, ticket, injector, deadline_ms: float = 0.0):
+        """Run one admitted query on the pool: dispatch, await, REDRIVE
+        on worker loss up to serving.redrive.maxAttempts.  Returns
+        (pa.Table, device_us).  Chaos `worker` fires here, supervisor-
+        side, once per dispatch."""
+        from ..obs.registry import SERVING_REDRIVES
+        from ..runtime.faults import InjectedWorkerFault
+        losses = 0
+        while True:
+            fault_kind = None
+            try:
+                injector.fire("worker", query=ticket.id,
+                              tenant=ticket.tenant)
+            except InjectedWorkerFault as f:
+                fault_kind = f.kind
+            h = self._pick()
+            d = _Dispatch(ticket.id,
+                          kill_on_start=(fault_kind == "kill"))
+            extra = {}
+            if fault_kind == "fatal":
+                # arm the in-worker fatal injector for THIS dispatch
+                # only — the redrive conf is clean
+                extra["spark.rapids.tpu.test.injectFatalError"] = "1"
+            h.inflight[ticket.id] = d
+            try:
+                h.send({"op": "query", "qid": ticket.id,
+                        "plan": ticket.plan, "extra": extra,
+                        "deadline_ms": float(deadline_ms or 0.0),
+                        "ooc": bool(ticket.ooc),
+                        "hang": fault_kind == "hang"})
+            except (OSError, pickle.PicklingError) as e:
+                h.inflight.pop(ticket.id, None)
+                if isinstance(e, pickle.PicklingError):
+                    raise
+                d.lost = WorkerLost(f"worker {h.wid} unreachable "
+                                    f"at dispatch: {e}", "crash")
+                d.event.set()
+            while not d.event.wait(0.5):
+                pass
+            if d.lost is None:
+                msg = d.reply
+                if msg["op"] == "result":
+                    ticket.worker = h.wid
+                    return msg["table"], int(msg.get("device_us") or 0)
+                exc = msg.get("exc")
+                if exc is None:
+                    exc = RuntimeError(
+                        f"[worker {h.wid}] {msg.get('error_class')}: "
+                        f"{msg.get('message')}")
+                raise exc
+            # worker loss: redrive on a survivor, bit-identically —
+            # queries are read-only and deterministic
+            losses += 1
+            ticket.redrives = losses
+            SERVING_REDRIVES.inc(reason=d.lost.reason)
+            with self._cond:
+                self._redrives += 1
+            if losses > self._redrive_max:
+                raise ServingWorkerError(
+                    f"query #{ticket.id} lost its worker {losses} times "
+                    f"(> serving.redrive.maxAttempts="
+                    f"{self._redrive_max}); last: {d.lost}") \
+                    from d.lost
+
+    # -- the cross-process HBM picture ------------------------------------
+    def live_bytes(self) -> int:
+        with self._cond:
+            return sum(int(h.census.get("live_bytes") or 0)
+                       for h in self._workers.values() if h.alive)
+
+    def census(self) -> dict:
+        with self._cond:
+            per = {h.wid: {"pid": h.pid,
+                           "live_bytes": int(
+                               h.census.get("live_bytes") or 0),
+                           "peak_bytes": int(
+                               h.census.get("peak_bytes") or 0)}
+                   for h in self._workers.values() if h.alive}
+        return {"live_bytes": sum(w["live_bytes"] for w in per.values()),
+                "peak_bytes": sum(w["peak_bytes"] for w in per.values()),
+                "workers": per}
+
+    def stats(self) -> dict:
+        with self._cond:
+            now = time.monotonic()
+            workers = {h.wid: {"pid": h.pid,
+                               "inflight": len(h.inflight),
+                               "metrics_port": h.metrics_port,
+                               "last_heartbeat_ms": round(
+                                   (now - h.last_hb) * 1e3, 1)}
+                       for h in self._workers.values() if h.alive}
+            return {"processes": self.procs,
+                    "live": len(workers),
+                    "restarts": dict(self._restarts),
+                    "redrives": self._redrives,
+                    "workers": workers}
+
+    # -- drain / close -----------------------------------------------------
+    def drain(self, timeout: float = 60.0) -> None:
+        """Graceful: every worker checkpoints the history store (atomic
+        aggregate rewrite) and exits 0; the supervisor reaps them all —
+        no orphan processes."""
+        with self._cond:
+            self._draining = True
+            handles = [h for h in self._workers.values() if h.alive]
+        for h in handles:
+            try:
+                h.send({"op": "drain"})
+            except OSError:
+                pass
+        deadline = time.monotonic() + timeout
+        for h in handles:
+            remaining = max(deadline - time.monotonic(), 0.1)
+            try:
+                h.proc.wait(remaining)
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+                h.proc.wait(5.0)
+            with self._cond:
+                h.alive = False
+                self._workers.pop(h.wid, None)
+        self._set_live_gauge()
+        self.close()
+
+    def close(self) -> None:
+        self._closed = True
+        with self._cond:
+            handles = list(self._workers.values())
+            self._workers.clear()
+            self._cond.notify_all()
+        for h in handles:
+            try:
+                h.proc.kill()
+            except OSError:
+                pass
+            try:
+                h.proc.wait(5.0)
+            except Exception:                        # noqa: BLE001
+                pass
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+        self._set_live_gauge()
+
+
+# ===========================================================================
+# Worker side
+# ===========================================================================
+
+def _worker_heartbeat(conn, send_lock: threading.Lock, hb_s: float,
+                      stop: threading.Event, state: dict) -> None:
+    from ..obs.export import bound_metrics_port
+    from ..obs.memattr import CENSUS
+    while not stop.wait(hb_s):
+        try:
+            with send_lock:
+                send_frame(conn, _frame({
+                    "op": "hb", "pid": os.getpid(),
+                    "census": CENSUS.totals(),
+                    "metrics_port": bound_metrics_port(),
+                    "inflight": state.get("qid")}))
+        except OSError:
+            # supervisor is gone: a worker must never outlive it
+            os._exit(EXIT_DRAINED)
+
+
+def _run_one(session, base_raw: dict, req: dict) -> dict:
+    """Execute one dispatched query under the full single-query
+    substrate (crash_capture, retry ladders, OOC tier, history feed)."""
+    from ..exec.plan import ExecContext, cancel_scope
+    from ..plan.overrides import apply_overrides
+    extra = req.get("extra") or {}
+    conf = TpuConf({**base_raw, **extra}) if extra else session.conf
+    q = apply_overrides(req["plan"], conf)
+    ctx = ExecContext(conf)
+    ctx.arm_deadline(float(req.get("deadline_ms") or 0.0))
+    if req.get("ooc"):
+        ctx.ooc_force = True
+    t0 = time.perf_counter()
+    with cancel_scope(ctx):
+        out = q.collect(ctx)
+    device_us = int((time.perf_counter() - t0) * 1e6)
+    return {"op": "result", "qid": req["qid"], "table": out,
+            "device_us": device_us}
+
+
+def main() -> int:
+    wid = os.environ.get(_ENV_ID, "w?")
+    host, port = os.environ[_ENV_ADDR].rsplit(":", 1)
+    token = os.environ.get(_ENV_TOKEN, "")
+    conn = socket.create_connection((host, int(port)))
+    send_lock = threading.Lock()
+    from ..obs.export import bound_metrics_port
+    send_frame(conn, _frame({"op": "hello", "token": token,
+                             "worker_id": wid, "pid": os.getpid(),
+                             "metrics_port": bound_metrics_port()}))
+    cfg = _unframe(recv_frame(conn))
+    base_raw = dict(cfg["conf"])
+    # failure.py registers its conf keys (coredump.path, the fatal
+    # injector) at import — they must exist before the shipped conf
+    # (already validated supervisor-side) is re-validated here
+    from ..runtime.failure import classify          # noqa: F401
+    # this worker owns its own session: budget, device slice, metrics
+    # plane, and the SHARED persistent compile cache + history store
+    from ..session import TpuSession
+    session = TpuSession(base_raw)
+    state: dict = {"qid": None}
+    stop_hb = threading.Event()
+    threading.Thread(target=_worker_heartbeat,
+                     args=(conn, send_lock, float(cfg["hb_ms"]) / 1e3,
+                           stop_hb, state),
+                     daemon=True, name="tpu-worker-hb").start()
+    while True:
+        try:
+            data = recv_frame(conn)
+        except OSError:
+            # supervisor died mid-frame (SIGKILL'd, crashed): same exit
+            # as a clean EOF — a worker never outlives its supervisor
+            return EXIT_DRAINED
+        if data is None:
+            return EXIT_DRAINED            # supervisor closed the pool
+        req = _unframe(data)
+        op = req.get("op")
+        if op == "drain":
+            # checkpoint the shared history store (atomic aggregate
+            # rewrite) so a restart/deploy loses no folded history
+            from ..obs.history import get_store
+            store = get_store(session.conf)
+            if store is not None:
+                store.checkpoint()
+            session.close()
+            with send_lock:
+                send_frame(conn, _frame({"op": "drained"}))
+            return EXIT_DRAINED
+        if op != "query":
+            continue
+        state["qid"] = req["qid"]
+        with send_lock:
+            send_frame(conn, _frame({"op": "started", "qid": req["qid"],
+                                     "pid": os.getpid()}))
+        if req.get("hang"):
+            # chaos worker:hang — wedge: heartbeats stop, requests
+            # stop; the supervisor's miss window kills this process
+            stop_hb.set()
+            while True:
+                time.sleep(60.0)
+        try:
+            reply = _run_one(session, base_raw, req)
+        except BaseException as exc:                 # noqa: BLE001
+            cls = classify(exc)
+            reply = {"op": "error", "qid": req["qid"],
+                     "classification": cls,
+                     "error_class": type(exc).__name__,
+                     "message": str(exc),
+                     "dump_path": getattr(exc, "dump_path", None)}
+            try:
+                pickle.dumps(exc)
+                reply["exc"] = exc
+            except Exception:                        # noqa: BLE001
+                pass                  # supervisor rebuilds from message
+            with send_lock:
+                send_frame(conn, _frame(reply))
+            if cls == "fatal_device":
+                # executor self-termination (Plugin.scala contract):
+                # the dump is written, the error frame is out — exit so
+                # the supervisor replaces this process
+                conn.close()
+                os._exit(EXIT_FATAL)
+            state["qid"] = None
+            continue
+        with send_lock:
+            send_frame(conn, _frame(reply))
+        state["qid"] = None
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1136,3 +1136,161 @@ def test_sites_registry_matches_docs():
     doc = open(os.path.join(root, "docs", "ROBUSTNESS.md")).read()
     missing = [site for site in SITES if f"`{site}`" not in doc]
     assert not missing, f"docs/ROBUSTNESS.md missing sites: {missing}"
+
+
+# ---------------------------------------------------------------------------
+# worker / deadline sites: fault-isolated multi-process serving
+# ---------------------------------------------------------------------------
+
+#: pool shape for the worker-site tests: 2 processes, fast health
+#: detection (the hang window is heartbeatMs x heartbeatMisses)
+MP_POOL = {
+    "spark.rapids.tpu.serving.pool.processes": "2",
+    "spark.rapids.tpu.serving.pool.heartbeatMs": "100",
+    "spark.rapids.tpu.serving.pool.heartbeatMisses": "6",
+}
+
+
+def _serving_tbl(n=400):
+    return pa.table({"k": [i % 5 for i in range(n)],
+                     "x": [float(i % 13) for i in range(n)]})
+
+
+def _serving_query(s, tbl):
+    from spark_rapids_tpu.plan.aggregates import Sum
+    return (s.from_arrow(tbl).filter(col("x") > E.Literal(1.0))
+            .group_by("k").agg((Sum(col("x")), "sx")))
+
+
+def _rows(table):
+    d = table.to_pydict()
+    names = sorted(d)
+    return sorted(zip(*(d[n] for n in names)))
+
+
+def test_worker_kill_mid_query_redrives_bit_identically():
+    """The headline crash-containment proof: `worker:kill` SIGKILLs a
+    worker process the moment its dispatched query is mid-flight;
+    under multi-tenant load ONLY that query redrives — on a surviving
+    worker, bit-identically vs the CPU oracle — while other tenants'
+    queries complete uninterrupted."""
+    tbl = _serving_tbl()
+    s = TpuSession({"spark.rapids.tpu.test.faults": "worker:kill:nth=1"})
+    try:
+        rt = s.serving(dict(MP_POOL))
+        bi, etl = rt.tenant("bi"), rt.tenant("etl")
+        expected = _rows(_serving_query(s, tbl).collect())
+        tickets = [t.submit(_serving_query(s, tbl))
+                   for t in (bi, etl, bi, etl)]
+        for tk in tickets:
+            assert _rows(tk.result(timeout=240)) == expected
+        st = rt.stats()["pool"]
+        assert st["restarts"].get("crash") == 1    # exactly one victim
+        assert st["redrives"] >= 1
+        assert sum(tk.redrives for tk in tickets) >= 1
+        # containment: every query completed, none failed
+        assert all(tk.error is None for tk in tickets)
+    finally:
+        s.close()
+
+
+def test_worker_hang_heartbeat_window_detects_and_redrives():
+    """`worker:hang` wedges a worker (heartbeats stop, the query never
+    answers): the supervisor's heartbeat-miss window SIGKILLs it and
+    the in-flight query redrives bit-identically."""
+    tbl = _serving_tbl()
+    s = TpuSession({"spark.rapids.tpu.test.faults": "worker:hang:nth=1"})
+    try:
+        rt = s.serving(dict(MP_POOL))
+        ses = rt.tenant("bi")
+        expected = _rows(_serving_query(s, tbl).collect())
+        tk = ses.submit(_serving_query(s, tbl))
+        assert _rows(tk.result(timeout=240)) == expected
+        st = rt.stats()["pool"]
+        assert st["restarts"].get("hang") == 1
+        assert st["redrives"] >= 1
+    finally:
+        s.close()
+
+
+def test_worker_fatal_dump_names_worker_then_redrives(tmp_path):
+    """`worker:fatal` arms the in-worker fatal injector: the victim
+    writes a classified crash dump naming its worker id + pid, self-
+    terminates (the executor-self-termination contract), and the query
+    redrives cleanly — the redrive conf carries no injected fatal."""
+    tbl = _serving_tbl()
+    s = TpuSession({"spark.rapids.tpu.test.faults": "worker:fatal:nth=1",
+                    "spark.rapids.tpu.coredump.path": str(tmp_path)})
+    try:
+        rt = s.serving(dict(MP_POOL))
+        ses = rt.tenant("bi")
+        expected = _rows(_serving_query(s, tbl).collect())
+        tk = ses.submit(_serving_query(s, tbl))
+        assert _rows(tk.result(timeout=240)) == expected
+        st = rt.stats()["pool"]
+        assert st["restarts"].get("fatal") == 1
+        assert st["redrives"] >= 1
+        import glob
+        dumps = glob.glob(str(tmp_path / "tpu-coredump-*.json"))
+        assert len(dumps) == 1
+        info = json.load(open(dumps[0]))
+        assert info["classification"] == FATAL_DEVICE
+        assert info["worker_id"] in ("w1", "w2")
+        # dump filename embeds the WORKER's pid, not the supervisor's
+        assert str(info["pid"]) in os.path.basename(dumps[0])
+        assert info["pid"] != os.getpid()
+    finally:
+        s.close()
+
+
+def test_deadline_timeout_injected_cancels_and_releases():
+    """`deadline:timeout` fires a synthetic expiry at a cancellation
+    checkpoint: the query fails with InjectedDeadlineExceeded (a
+    QUERY-class failure — no retry, no dump), its whole device
+    reservation releases (DeviceCensus zero residual), and the runtime
+    keeps serving."""
+    from spark_rapids_tpu.exec.plan import (InjectedDeadlineExceeded,
+                                            QueryDeadlineExceeded)
+    from spark_rapids_tpu.obs.memattr import CENSUS
+    from spark_rapids_tpu.runtime.failure import QUERY
+    tbl = _serving_tbl()
+    s = TpuSession(
+        {"spark.rapids.tpu.test.faults": "deadline:timeout:nth=1"})
+    # CENSUS is process-wide: other tests' not-yet-collected budgets can
+    # hold bytes, so assert zero RESIDUAL GROWTH, not an absolute zero
+    import gc
+    gc.collect()
+    base_live = CENSUS.totals()["live_bytes"]
+    try:
+        rt = s.serving()
+        ses = rt.tenant("bi")
+        tk = ses.submit(_serving_query(s, tbl))
+        with pytest.raises(InjectedDeadlineExceeded):
+            tk.result(timeout=120)
+        assert classify(tk.error) == QUERY     # fails cleanly, no dump
+        assert isinstance(tk.error, QueryDeadlineExceeded)
+        assert rt.stats()["deadline_cancellations"] == 1
+        assert rt._device_bytes == 0
+        gc.collect()
+        assert CENSUS.totals()["live_bytes"] <= base_live
+        assert "deadline" in fired_sites(s)
+        # unharmed: the next query completes
+        expected = _rows(_serving_query(s, tbl).collect())
+        assert _rows(ses.collect(_serving_query(s, tbl),
+                                 timeout=120)) == expected
+    finally:
+        s.close()
+
+
+def test_worker_kinds_grammar_is_site_restricted():
+    """kill/hang are process-level faults: only the `worker` site may
+    carry them, and `worker` carries nothing else."""
+    parse_spec("worker:kill:nth=3")                  # valid
+    parse_spec("worker:hang:always")                 # valid
+    parse_spec("worker:fatal:p=0.5,seed=7")          # valid
+    with pytest.raises(ValueError):
+        parse_spec("seam:kill:always")               # kill off-site
+    with pytest.raises(ValueError):
+        parse_spec("spill:hang:nth=1")               # hang off-site
+    with pytest.raises(ValueError):
+        parse_spec("worker:oom:always")              # non-worker kind

@@ -449,3 +449,37 @@ def test_check_regression_gates_median_compile_ms(tmp_path):
     # backend separation: an axon baseline never gates a cpu run
     other = fixture("axon.json", 1000.0, backend="axon")
     assert mod.main(["--current", slow, other]) == 0
+
+
+def test_persistent_cache_concurrent_multiprocess_writers(tmp_path):
+    """The serving pool's sharing contract: SEVERAL worker processes
+    populate one topology-keyed persistent cache dir CONCURRENTLY
+    (atomic tmp+rename entry writes — no torn entries, no collisions),
+    every writer computes the right answer, and a later process replays
+    with zero XLA compiles."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "JAX_ENABLE_X64": "1",
+           "PYTHONPATH": os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__)))}
+    env.pop("XLA_FLAGS", None)
+    cache = str(tmp_path / "cache")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _SUBPROC, cache],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for _ in range(3)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err[-2000:]
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    # every concurrent writer answered correctly
+    assert all(o["sv"] == outs[0]["sv"] for o in outs)
+    # the cache is intact afterwards: a fresh process is all hits
+    res = subprocess.run(
+        [sys.executable, "-c", _SUBPROC, cache],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-2000:]
+    warm = json.loads(res.stdout.strip().splitlines()[-1])
+    assert warm["stats"]["misses"] == 0, \
+        f"cache torn by concurrent writers: {warm['stats']}"
+    assert warm["stats"]["hits"] > 0
+    assert warm["sv"] == outs[0]["sv"]

@@ -149,3 +149,78 @@ def test_no_injection_query_unaffected(tmp_path):
     tbl = pa.table({"x": pa.array(range(10), pa.int64())})
     assert s.from_arrow(tbl).count() == 10
     assert not os.listdir(tmp_path)
+
+
+def test_crash_dump_filename_embeds_pid_and_worker_id(tmp_path):
+    """Concurrent worker processes share one dump dir: the filename's
+    pid component keeps writers from colliding cross-process (the -<seq>
+    suffix is only process-monotonic), and the dump body records the
+    serving pool's worker id when the process carries one."""
+    conf = TpuConf({"spark.rapids.tpu.coredump.path": str(tmp_path)})
+    path = write_crash_dump(conf, InjectedFatalError("boom"))
+    name = os.path.basename(path)
+    assert name.startswith(f"tpu-coredump-{os.getpid()}-")
+    assert name.endswith(".json")
+    # pid, epoch, seq: three '-'-separated numeric fields after the stem
+    fields = name[len("tpu-coredump-"):-len(".json")].split("-")
+    assert len(fields) == 3 and all(f.isdigit() for f in fields)
+    assert int(fields[0]) == os.getpid()
+    # worker-id enrichment: unset outside a pool worker, stamped inside
+    assert json.load(open(path))["worker_id"] is None
+    os.environ["SPARK_RAPIDS_TPU_WORKER_ID"] = "w7"
+    try:
+        p2 = write_crash_dump(conf, InjectedFatalError("boom2"))
+        assert json.load(open(p2))["worker_id"] == "w7"
+    finally:
+        del os.environ["SPARK_RAPIDS_TPU_WORKER_ID"]
+
+
+def test_retry_io_backoff_jitter_deterministic_and_bounded():
+    """retry.io.jitterFraction decorrelates backoff sleeps across
+    workers: draws are DETERMINISTIC per (seed, draw counter) —
+    replayable forensics — distinct across seeds (different pids/sites
+    desynchronize), bounded to backoff*(1 +/- fraction), and fraction 0
+    restores the exact undithered ladder."""
+    from spark_rapids_tpu.runtime.retry import (_io_jitter_seed,
+                                                _jittered_backoff_s)
+    base, frac = 0.100, 0.25
+    a = [_jittered_backoff_s(base, frac, seed=11, draw=d)
+         for d in range(1, 65)]
+    b = [_jittered_backoff_s(base, frac, seed=11, draw=d)
+         for d in range(1, 65)]
+    assert a == b                                  # deterministic
+    c = [_jittered_backoff_s(base, frac, seed=12, draw=d)
+         for d in range(1, 65)]
+    assert a != c                                  # seeds decorrelate
+    lo, hi = base * (1 - frac), base * (1 + frac)
+    assert all(lo <= s <= hi for s in a + c)
+    assert len(set(a)) > 32                        # actually dithered
+    # fraction 0: the exact deterministic ladder, no perturbation
+    assert _jittered_backoff_s(base, 0.0, seed=11, draw=1) == base
+    # the per-process seed mixes pid and site
+    assert _io_jitter_seed("spill_write") != _io_jitter_seed("d2h")
+
+
+def test_retry_io_sleeps_jittered_backoff(monkeypatch):
+    """End-to-end through retry_io: the slept durations stay inside the
+    jitter envelope of the exponential ladder."""
+    from spark_rapids_tpu.runtime import retry as R
+    sleeps = []
+    monkeypatch.setattr(R.time, "sleep", lambda s: sleeps.append(s))
+    conf = TpuConf({"spark.rapids.tpu.retry.io.maxAttempts": "4",
+                    "spark.rapids.tpu.retry.io.backoffMs": "100",
+                    "spark.rapids.tpu.retry.io.backoffMultiplier": "2.0",
+                    "spark.rapids.tpu.retry.io.jitterFraction": "0.25"})
+    calls = {"n": 0}
+
+    def attempt():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise OSError("transient")
+        return "ok"
+
+    assert R.retry_io(conf, "spill_write", attempt) == "ok"
+    assert len(sleeps) == 3
+    for s, base in zip(sleeps, (0.1, 0.2, 0.4)):
+        assert base * 0.75 <= s <= base * 1.25
+        assert s != base                  # jitter actually applied

@@ -485,3 +485,32 @@ def test_history_site_in_fault_grammar():
     parse_spec("history:fatal:nth=1")
     with pytest.raises(ValueError):
         parse_spec("history:corrupt:nth=1")     # no payload at this site
+
+
+def test_concurrent_multiprocess_recorders_lose_nothing(tmp_path):
+    """The serving pool's sharing contract: SEVERAL worker processes
+    append to one history store CONCURRENTLY (O_APPEND JSONL lines);
+    no record is lost or torn, and a checkpoint() (the graceful-drain
+    hook: the locked atomic aggregate rewrite) preserves every run."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "JAX_ENABLE_X64": "1",
+           "PYTHONPATH": _ROOT}
+    hist = str(tmp_path / "hist")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _SUBPROC, hist, "record"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for _ in range(3)]
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err[-2000:]
+        assert json.loads(out.strip().splitlines()[-1])["warm_us"] > 0
+    conf = TpuConf({"spark.rapids.tpu.history.dir": hist})
+    store = get_store(conf)
+    assert store.corrupt_lines == 0           # no torn appends
+    key = next(iter(store.aggregates()))
+    assert store.get(key).runs == 6           # 3 processes x 2 runs
+    # checkpoint = the drain hook: compact NOW, atomically; a reload
+    # (a restarted worker) sees the folded aggregate, nothing lost
+    store.checkpoint()
+    fresh = PerfHistoryStore(store.path)
+    assert fresh.corrupt_lines == 0
+    assert fresh.get(key).runs == 6

@@ -721,3 +721,37 @@ def test_check_regression_gates_multichip_timings(tmp_path, capsys):
          "backend": "tpu"}))
     assert mod.main(["--current", str(cur2), str(base)]) == 0
     capsys.readouterr()
+
+
+def test_metrics_port_zero_binds_ephemeral_and_reports(tmp_path):
+    """metrics.port=0 binds an EPHEMERAL port (concurrent worker
+    processes on one host never race a fixed port): the bound port is
+    discoverable via bound_metrics_port(), scrapeable, and stamped
+    into every heartbeat line; -1 (the default) starts no server."""
+    from spark_rapids_tpu.obs.export import (Heartbeat,
+                                             bound_metrics_port,
+                                             configure_plane,
+                                             shutdown_exporters)
+    from spark_rapids_tpu.config import TpuConf
+    assert bound_metrics_port() is None            # nothing running
+    configure_plane(TpuConf({}))                   # default -1: still none
+    assert bound_metrics_port() is None
+    configure_plane(TpuConf({"spark.rapids.tpu.metrics.port": "0"}))
+    port = bound_metrics_port()
+    assert isinstance(port, int) and port > 0
+    from spark_rapids_tpu.obs.registry import QUERIES_TOTAL
+    QUERIES_TOTAL.inc(status="ok", kind="device")
+    snap = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics.json", timeout=5).read())
+    assert any(f["name"] == "tpu_queries_total" for f in snap["families"])
+    # heartbeat lines carry the bound port + pid (the serving pool's
+    # supervisor reads them off worker heartbeats the same way)
+    path = tmp_path / "hb.jsonl"
+    hb = Heartbeat(str(path), interval_s=3600)
+    hb.beat()
+    rec = json.loads(path.read_text().splitlines()[0])
+    assert rec["metrics_port"] == port
+    assert rec["pid"] == os.getpid()
+    hb.stop()
+    shutdown_exporters()
+    assert bound_metrics_port() is None            # released cleanly

@@ -607,3 +607,129 @@ def test_hbm_admission_gates_device_overlap():
             assert tk.result().to_pydict() == expected
     finally:
         s.close()
+
+
+# ---------------------------------------------------------------------------
+# fault-isolated multi-process pool (serving/workers.py)
+# ---------------------------------------------------------------------------
+
+MP_FAST = {
+    # fast worker health detection keeps pool tests inside the tier-1
+    # wall budget without weakening what they prove
+    "spark.rapids.tpu.serving.pool.heartbeatMs": "100",
+    "spark.rapids.tpu.serving.pool.heartbeatMisses": "6",
+}
+
+
+def test_pool_mode_matches_plain_and_isolates_sessions():
+    """MULTI-PROCESS serving: queries execute in supervised worker
+    processes (each its own TpuSession/budget) and match the in-process
+    oracle bit-for-bit; the pool's stats and heartbeat-fed census show
+    every live worker."""
+    s = TpuSession({})
+    try:
+        rt = s.serving({"spark.rapids.tpu.serving.pool.processes": "2",
+                        **MP_FAST})
+        a, b = rt.tenant("a"), rt.tenant("b")
+        t = _table()
+        expected = _rows(_query(s, t).collect())
+        tickets = [ses.submit(_query(s, t)) for ses in (a, b, a, b)]
+        for tk in tickets:
+            assert _rows(tk.result(timeout=240)) == expected
+            assert tk.worker is not None       # answered by a pool worker
+            assert tk.redrives == 0
+        st = rt.stats()
+        assert st["pool"]["live"] == 2
+        assert st["pool"]["redrives"] == 0
+        assert set(st["census"]["workers"]) == set(st["pool"]["workers"])
+        # supervisor-side worker pids are real child processes
+        for w in st["pool"]["workers"].values():
+            assert isinstance(w["pid"], int) and w["pid"] > 0
+    finally:
+        s.close()
+
+
+def test_pool_drain_empty_queue_no_orphans():
+    """Graceful drain: admission closes (submit raises), in-flight
+    queries finish, workers checkpoint + exit — and NO worker process
+    survives the drain."""
+    import os as _os
+    s = TpuSession({})
+    try:
+        rt = s.serving({"spark.rapids.tpu.serving.pool.processes": "2",
+                        **MP_FAST})
+        ses = rt.tenant("a")
+        t = _table()
+        expected = _rows(_query(s, t).collect())
+        tk = ses.submit(_query(s, t))
+        pids = [w["pid"] for w in rt.stats()["pool"]["workers"].values()]
+        assert len(pids) == 2
+        assert _rows(tk.result(timeout=240)) == expected
+        rt.drain()
+        with pytest.raises(RuntimeError):
+            ses.submit(_query(s, t))
+        assert rt.stats()["inflight"] == 0
+        orphans = []
+        for pid in pids:
+            try:
+                _os.kill(pid, 0)
+                orphans.append(pid)
+            except ProcessLookupError:
+                pass
+        assert not orphans, f"workers survived drain: {orphans}"
+    finally:
+        s._serving = None      # drained above; close() must not re-drain
+        s.close()
+
+
+def test_deadline_expired_releases_reservation_and_keeps_serving():
+    """A query whose wall-clock deadline expires cancels COOPERATIVELY
+    at the next checkpoint bracket, releases its full device
+    reservation (zero residual in the DeviceCensus and the admission
+    ledger), and the runtime keeps serving."""
+    from spark_rapids_tpu.exec.plan import QueryDeadlineExceeded
+    from spark_rapids_tpu.obs.memattr import CENSUS
+    s = TpuSession(dict(WHOLE_PLAN))
+    # CENSUS is process-wide: other tests' not-yet-collected budgets can
+    # hold bytes, so assert zero RESIDUAL GROWTH, not an absolute zero
+    import gc
+    gc.collect()
+    base_live = CENSUS.totals()["live_bytes"]
+    try:
+        rt = s.serving()
+        ses = rt.tenant("a")
+        t = _table()
+        # an already-expired deadline: the FIRST checkpoint cancels
+        tk = ses.submit(_query(s, t), deadline_ms=1e-6)
+        with pytest.raises(QueryDeadlineExceeded):
+            tk.result(timeout=120)
+        st = rt.stats()
+        assert st["deadline_cancellations"] == 1
+        assert rt._device_bytes == 0       # admission ledger released
+        gc.collect()
+        assert CENSUS.totals()["live_bytes"] <= base_live
+        # the runtime is unharmed: the next (undeadlined) query works
+        expected = _rows(_query(s, t).collect())
+        assert _rows(ses.collect(_query(s, t), timeout=120)) == expected
+        assert rt.stats()["deadline_cancellations"] == 1
+    finally:
+        s.close()
+
+
+def test_serving_deadline_conf_applies_to_every_query():
+    """serving.deadlineMs sets the default per-query deadline; a
+    per-submit deadline_ms overrides it."""
+    from spark_rapids_tpu.exec.plan import QueryDeadlineExceeded
+    s = TpuSession({})
+    try:
+        rt = s.serving({"spark.rapids.tpu.serving.deadlineMs": "0.000001"})
+        ses = rt.tenant("a")
+        t = _table()
+        with pytest.raises(QueryDeadlineExceeded):
+            ses.collect(_query(s, t), timeout=120)
+        # override: a generous explicit deadline lets the query finish
+        expected = _rows(_query(s, t).collect())
+        out = ses.collect(_query(s, t), timeout=120, deadline_ms=600_000)
+        assert _rows(out) == expected
+    finally:
+        s.close()
