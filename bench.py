@@ -913,6 +913,27 @@ def _rows_key(table):
     return sorted(zip(*(d[n] for n in names))) if names else []
 
 
+def _fleet_worker_skew(fleet):
+    """Utilization skew across pool workers: max/min ratio of summed
+    per-worker device-us mined from the federated fleet registry
+    (`tpu_fleet_serving_tenant_device_us_total{worker=..,tenant=..}`).
+    1.0 = perfectly even dispatch; None when fewer than two workers
+    reported work (nothing to compare)."""
+    per = {}
+    for key, v in (fleet or {}).items():
+        if not key.startswith(
+                "tpu_fleet_serving_tenant_device_us_total{"):
+            continue
+        labels = key.split("{", 1)[1].rstrip("}")
+        wid = next((p.split("=", 1)[1] for p in labels.split(",")
+                    if p.startswith("worker=")), None)
+        if wid is not None:
+            per[wid] = per.get(wid, 0) + float(v)
+    if len(per) < 2 or min(per.values()) <= 0:
+        return None
+    return round(max(per.values()) / min(per.values()), 3)
+
+
 def run_serving(suite_name: str, scale: float, query_names):
     """--serving: N concurrent closed-loop clients over a query mix
     through the ServingRuntime, vs the SAME query multiset run serially
@@ -1054,11 +1075,17 @@ def run_serving(suite_name: str, scale: float, query_names):
                  "cache_on": cache_on}
         if procs:
             pool = stats.get("pool") or {}
+            # the federated fleet registry (per-worker-labeled series the
+            # supervisor folded from heartbeat telemetry) rides the level
+            # record so regression mining sees cross-process utilization
+            fleet = stats.get("fleet") or {}
             level.update(
                 pool_processes=procs,
                 worker_restarts=pool.get("restarts"),
                 redrives=pool.get("redrives"),
-                faults=faults or None)
+                faults=faults or None,
+                fleet=fleet or None,
+                worker_skew=_fleet_worker_skew(fleet))
         print(f"# serving c={c} cache={'on' if cache_on else 'off'}: "
               f"{n} queries {wall:.1f}s qps={level['qps']} "
               f"p50={level['p50_ms']}ms p99={level['p99_ms']}ms "
@@ -1116,6 +1143,11 @@ def run_serving(suite_name: str, scale: float, query_names):
            "serial_qps": round(serial_qps, 3),
            "serving_levels": levels,
            "serving_latency_ms": gate,
+           # per-level worker utilization skew from the federated fleet
+           # registry — mined by check_regression under the sv: rules
+           "serving_fleet": {f"{key}_skew": lvl["worker_skew"]
+                             for key, lvl in levels.items()
+                             if lvl.get("worker_skew")},
            "qps_vs_serial": round(c8["qps"] / serial_qps, 3)
            if c8.get("qps") else None,
            "qps_nocache_vs_serial": round(c8_nc["qps"] / serial_qps, 3)

@@ -114,9 +114,21 @@ def extract_serving(doc):
     if not isinstance(doc, dict):
         return {}, None
     lat = doc.get("serving_latency_ms")
-    if isinstance(lat, dict) and lat:
-        out = {f"sv:{k}": float(v) for k, v in lat.items()
+    fleet = doc.get("serving_fleet")
+    if (isinstance(lat, dict) and lat) or \
+            (isinstance(fleet, dict) and fleet):
+        out = {f"sv:{k}": float(v)
+               for k, v in (lat if isinstance(lat, dict) else {}).items()
                if isinstance(v, (int, float))}
+        # cross-process utilization skew from the federated fleet
+        # registry (mp levels): gates under the same sv: rules — a skew
+        # regression means dispatch stopped spreading work.  Scaled
+        # x100 (1.0 -> 100) so a real imbalance clears the --min-ms
+        # noise floor, which raw max/min ratios (~1-3) never would.
+        out.update({f"sv:{k}": float(v) * 100.0
+                    for k, v in (fleet if isinstance(fleet, dict)
+                                 else {}).items()
+                    if isinstance(v, (int, float))})
         return out, str(doc.get("backend") or _DEFAULT_BACKEND)
     parsed = doc.get("parsed")
     if isinstance(parsed, dict):
@@ -124,9 +136,11 @@ def extract_serving(doc):
         if out:
             return out, backend
     tail = doc.get("tail")
-    if isinstance(tail, str) and "serving_latency_ms" in tail:
+    if isinstance(tail, str) and ("serving_latency_ms" in tail
+                                  or "serving_fleet" in tail):
         for line in reversed(tail.splitlines()):
-            if "serving_latency_ms" not in line:
+            if "serving_latency_ms" not in line and \
+                    "serving_fleet" not in line:
                 continue
             try:
                 rec = json.loads(line.strip())

@@ -164,6 +164,60 @@ def render_bench(path: str, suites: dict, inner: dict,
     print()
 
 
+def try_heartbeat_log(path: str):
+    """Parse a .jsonl file as a metrics-heartbeat log (obs/export.py
+    Heartbeat) -> list of beat records, or None.  A supervised pool
+    writes one such log PER PROCESS into a shared directory (the
+    `-w<id>` suffix from `worker_suffixed_path`), so a pool report dir
+    mixes query event logs with supervisor + worker heartbeat logs —
+    these must render as fleet summaries, not as unreadable queries."""
+    if not path.endswith(".jsonl"):
+        return None
+    beats = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if not isinstance(rec, dict) or \
+                        rec.get("type") != "heartbeat":
+                    return None
+                beats.append(rec)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return beats or None
+
+
+def render_heartbeat_log(path: str, beats: list, as_json: bool) -> None:
+    first, last = beats[0], beats[-1]
+    role = last.get("role") or "process"
+    worker = last.get("worker")
+    who = f"{role} {worker}" if worker else role
+    span_s = max(0.0, float(last.get("ts", 0)) - float(first.get("ts", 0)))
+    reg = last.get("registry") if isinstance(last.get("registry"),
+                                             dict) else {}
+    fleet = last.get("fleet") if isinstance(last.get("fleet"), dict) else {}
+    if as_json:
+        print(json.dumps({"log": path, "heartbeats": len(beats),
+                          "role": role, "worker": worker,
+                          "span_s": round(span_s, 3),
+                          "registry_series": len(reg),
+                          "fleet_series": len(fleet)}))
+        return
+    print(f"### {path}")
+    print("== metrics heartbeat log ==")
+    print(f"  {who}: {len(beats)} beat(s) over {span_s:.1f}s, "
+          f"last registry {len(reg)} series"
+          + (f", fleet view {len(fleet)} series" if fleet else ""))
+    workers = sorted({k.split("worker=", 1)[1].split(",", 1)[0]
+                      .split("}", 1)[0] for k in fleet if "worker=" in k})
+    if workers:
+        print(f"  fleet workers seen: {', '.join(workers)}")
+    print()
+
+
 def try_multichip_record(path: str):
     """Parse a .json file as a multichip/bench record -> (mc timings
     dict, full doc) or (None, None).  Reuses the regression gate's
@@ -250,6 +304,13 @@ def main(argv=None) -> int:
         suites, inner = try_bench_record(path)
         if inner is not None:
             render_bench(path, suites, inner, args.json)
+            continue
+        # pool heartbeat logs (supervisor + per-worker) share the dir
+        # with query event logs; render their fleet summary instead of
+        # failing them through the query-profile path
+        beats = try_heartbeat_log(path)
+        if beats:
+            render_heartbeat_log(path, beats, args.json)
             continue
         # a directory can hold non-query JSONL (metrics heartbeats),
         # truncated crash-time logs, or logs from fallback-only queries

@@ -925,6 +925,32 @@ SERVING_POOL_RESTART = conf(
     "holds its size. False leaves the pool smaller after each death "
     "(drain/teardown mode).")
 
+SERVING_POOL_TELEMETRY_ENABLED = conf(
+    "spark.rapids.tpu.serving.pool.telemetry.enabled", True,
+    "Fleet observability federation: worker heartbeat frames piggyback "
+    "a cumulative metrics-registry snapshot and a rolling flight-"
+    "recorder tail, which the supervisor folds into the fleet-view "
+    "registry (per-worker-labeled tpu_fleet_* families on the single "
+    "Prometheus endpoint / stats()['fleet']) and into WorkerLost "
+    "black-box forensics dumps. False keeps heartbeats bare "
+    "(pid + census only, the PR 17 wire shape).")
+
+SERVING_POOL_TELEMETRY_FLIGHT_EVENTS = conf(
+    "spark.rapids.tpu.serving.pool.telemetry.flightEvents", 64,
+    "How many of the newest in-worker flight-recorder events ride each "
+    "heartbeat frame as the worker's black-box snapshot. The supervisor "
+    "keeps only the latest snapshot per worker and embeds it into the "
+    "WorkerLost dump when that worker dies by kill/hang — the cases "
+    "where no in-worker dump is possible.", checker=_positive)
+
+SERVING_POOL_TELEMETRY_MAX_FRAME_BYTES = conf(
+    "spark.rapids.tpu.serving.pool.telemetry.maxFrameBytes", 262144,
+    "Byte bound on one heartbeat frame's telemetry payload. Liveness "
+    "beats observability: when a frame would exceed this, the flight "
+    "snapshot is trimmed oldest-first, then dropped, then the registry "
+    "snapshot is dropped — the bare heartbeat always goes out.",
+    checker=_positive)
+
 SERVING_ADMIT_WORKING_SET_FACTOR = conf(
     "spark.rapids.tpu.serving.admitWorkingSetFactor", 3.0,
     "HBM admission estimate: a query's device working set is assumed "

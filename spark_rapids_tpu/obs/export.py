@@ -32,7 +32,27 @@ from ..config import (METRICS_ENABLED, METRICS_FLIGHT_EVENTS,
                       METRICS_HEARTBEAT_PATH, METRICS_PORT,
                       METRICS_REPORT_INTERVAL_S, TpuConf)
 from .recorder import FLIGHT_RECORDER
-from .registry import REGISTRY
+from .registry import FLEET, REGISTRY
+
+#: worker-id env var (serving/workers.py sets it in worker processes) —
+#: read here so the export plane self-labels without a serving import
+_ENV_WORKER_ID = "SPARK_RAPIDS_TPU_WORKER_ID"
+
+
+def _worker_id() -> Optional[str]:
+    return os.environ.get(_ENV_WORKER_ID) or None
+
+
+def worker_suffixed_path(path: str) -> str:
+    """Pool-mode heartbeat-path de-collision: supervisor and N workers
+    inherit ONE `metrics.heartbeatPath`, so a worker process rewrites
+    it to `<stem>-<worker_id><ext>` — every process appends to its own
+    file and `profile_report.py` merges the mixed directory."""
+    wid = _worker_id()
+    if not path or not wid:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}-{wid}{ext or '.jsonl'}"
 
 
 def registry_snapshot(compact: bool = False) -> dict:
@@ -73,12 +93,18 @@ class Heartbeat:
 
     def beat(self) -> None:
         """Write one snapshot line (also called directly by tests)."""
-        line = json.dumps({"ts": time.time(), "type": "heartbeat",
-                           "pid": os.getpid(),
-                           "metrics_port": bound_metrics_port(),
-                           "registry": REGISTRY.flat(),
-                           "flight_len": len(FLIGHT_RECORDER)},
-                          default=str)
+        wid = _worker_id()
+        rec = {"ts": time.time(), "type": "heartbeat",
+               "role": "worker" if wid else "supervisor",
+               "worker": wid,
+               "pid": os.getpid(),
+               "metrics_port": bound_metrics_port(),
+               "registry": REGISTRY.flat(),
+               "flight_len": len(FLIGHT_RECORDER)}
+        fleet = FLEET.flat()
+        if fleet:
+            rec["fleet"] = fleet
+        line = json.dumps(rec, default=str)
         try:
             with open(self.path, "a") as f:
                 f.write(line + "\n")
@@ -109,15 +135,25 @@ class MetricsHttpServer:
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):                    # noqa: N802
                 if self.path.startswith("/metrics.json"):
-                    body = json.dumps(REGISTRY.snapshot(),
-                                      default=str).encode()
+                    snap = REGISTRY.snapshot()
+                    fl = FLEET.snapshot()
+                    if fl["families"]:
+                        snap["fleet"] = fl
+                    body = json.dumps(snap, default=str).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/flight"):
                     body = json.dumps(FLIGHT_RECORDER.tail(),
                                       default=str).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/metrics"):
-                    body = REGISTRY.prometheus_text().encode()
+                    # ONE endpoint serves the whole pool: the
+                    # supervisor's own families plus the per-worker
+                    # tpu_fleet_* federation (distinct names, so the
+                    # concatenation stays valid exposition text)
+                    text = REGISTRY.prometheus_text()
+                    if FLEET.family_names():
+                        text += FLEET.prometheus_text()
+                    body = text.encode()
                     ctype = "text/plain; version=0.0.4"
                 else:
                     self.send_error(404)
@@ -171,7 +207,8 @@ def configure_plane(conf: TpuConf) -> None:
     FLIGHT_RECORDER.resize(conf.get(METRICS_FLIGHT_EVENTS))
     if not enabled:
         return
-    hb_path = str(conf.get(METRICS_HEARTBEAT_PATH) or "")
+    hb_path = worker_suffixed_path(
+        str(conf.get(METRICS_HEARTBEAT_PATH) or ""))
     port = int(conf.get(METRICS_PORT))
     if hb_path or port >= 0:
         with _EXPORT_LOCK:

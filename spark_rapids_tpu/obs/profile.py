@@ -534,6 +534,41 @@ class QueryProfile:
         bd = self.wall_breakdown()
         if bd["wall_ms"] > 0:
             lines.extend(render_wall_breakdown(bd))
+        if self.meta.get("stitched"):
+            # a supervisor-side STITCHED pool record: render the cross-
+            # process story — admission -> grant -> each execute attempt
+            # (worker-named), with worker_lost instants marking redrives
+            lines.append("-- stitched serving record "
+                         f"(tenant={self.meta.get('tenant')}, "
+                         f"status={self.meta.get('status')}, "
+                         f"redrives={self.meta.get('redrives', 0)}) --")
+            losses = {(e.attrs or {}).get("attempt"): e.attrs or {}
+                      for e in self.events if e.name == "worker_lost"}
+            for s in sorted(self.spans, key=lambda s: s.t0):
+                if s.cat not in ("serving", "execute"):
+                    continue
+                extra = ""
+                if s.cat == "execute":
+                    a = s.attrs or {}
+                    if "lost" in a:
+                        extra = f"  ! LOST ({a['lost']}) -> redrive"
+                    elif a.get("device_us") is not None:
+                        extra = f"  device_us={a['device_us']}"
+                lines.append(f"  {s.name:<24} {s.dur_ms:>9.1f} ms"
+                             f"{extra}")
+            if losses:
+                lines.append(f"  workers: "
+                             f"{self.meta.get('workers')} "
+                             f"(answered by {self.meta.get('worker')})")
+            wp = self.meta.get("worker_profile") or {}
+            if wp:
+                hbm = wp.get("hbm") or {}
+                lines.append(
+                    f"  worker profile: {wp.get('worker')} "
+                    f"pid={wp.get('pid')} "
+                    f"device_us={wp.get('device_us')} "
+                    f"hbm_live={hbm.get('live_bytes', 0)} "
+                    f"hbm_peak={hbm.get('peak_bytes', 0)}")
         ops = self.operators()
         if ops:
             lines.append("-- top operators (self time) --")

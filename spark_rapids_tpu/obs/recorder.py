@@ -91,5 +91,19 @@ class FlightRecorder:
             self._buf.clear()
 
 
+def tail_bounded(recorder: FlightRecorder, n: int,
+                 max_bytes: int) -> List[dict]:
+    """The newest <= n events whose JSON serialization fits max_bytes —
+    the heartbeat-frame black-box snapshot (serving/workers.py).  Drops
+    OLDEST events first; the bound is on the serialized batch, so one
+    pathological event can at worst empty the snapshot, never bloat the
+    frame."""
+    import json
+    events = recorder.tail(int(n))
+    while events and len(json.dumps(events, default=str)) > int(max_bytes):
+        events = events[max(1, len(events) // 4):]
+    return events
+
+
 #: THE process-wide recorder (independent instances only in tests)
 FLIGHT_RECORDER = FlightRecorder()

@@ -134,6 +134,23 @@ class Metric:
             i = bucket_index(v)
             st.buckets[i] = st.buckets.get(i, 0) + 1
 
+    def set_histogram(self, count: int, sum_: float, buckets,
+                      **labels) -> None:
+        """Cumulative SET of one histogram series from a snapshot's
+        `[[le, count], ...]` bucket list — the federation fold: a worker
+        ships its full histogram state each heartbeat and set semantics
+        make a dropped frame self-heal on the next one."""
+        if not self._reg.enabled:
+            return
+        st = _HistogramState()
+        st.count = int(count)
+        st.sum = float(sum_)
+        for le, c in buckets or ():
+            i = int(le).bit_length() - 1 if int(le) > 1 else 0
+            st.buckets[i] = int(c)
+        with self._lock:
+            self._series[self._key(labels)] = st
+
     # -- read -------------------------------------------------------------
     def value(self, **labels):
         """Current value of one series (0 / None when never published)."""
@@ -751,6 +768,87 @@ OOC_RECURSIONS = REGISTRY.counter(
     "operator.  Depth is bounded by sql.ooc.maxDepth; past it the "
     "split-retry ladder owns the remainder.",
     ("op",))
+
+
+FLEET_FRAMES = REGISTRY.counter(
+    "tpu_fleet_frames_total",
+    "Heartbeat telemetry frames the supervisor processed into the "
+    "fleet-view registry, by outcome: folded = the worker's registry "
+    "snapshot merged into the per-worker tpu_fleet_* series, dropped = "
+    "the frame was discarded whole (fleet chaos site: ioerror loses "
+    "one frame, fatal additionally writes a classified dump; cumulative "
+    "set semantics converge on the next beat either way), error = the "
+    "snapshot failed to fold (malformed frame) and was skipped.",
+    ("outcome",))
+
+
+# ---------------------------------------------------------------------------
+# Fleet-view registry (metrics federation, serving/workers.py).
+#
+# Worker heartbeat frames carry the worker's full cumulative
+# REGISTRY.snapshot(); the supervisor folds each family into this
+# SEPARATE registry under the name `tpu_fleet_` + <name minus tpu_> with
+# a leading `worker` label.  Separate because (a) the per-worker shape
+# (extra label) would collide with the supervisor's own identically-
+# named families in one registry, and (b) these families are DYNAMIC —
+# whatever the workers publish — so they stay out of the
+# REGISTRY.family_names() docs lint.  Cumulative-SET folding makes the
+# federation idempotent and self-healing: a dropped frame (fleet chaos
+# site) just means the next beat lands the same-or-later totals, and
+# per-worker counter series sum EXACTLY to the workers' own registries.
+# ---------------------------------------------------------------------------
+
+FLEET = MetricsRegistry(max_series=256)
+
+
+def fleet_family_name(name: str) -> str:
+    """`tpu_serving_x_total` -> `tpu_fleet_serving_x_total`."""
+    return "tpu_fleet_" + (name[4:] if name.startswith("tpu_") else name)
+
+
+def fold_fleet_snapshot(worker: str, snapshot: dict) -> None:
+    """Fold one worker's cumulative registry snapshot into FLEET.
+    Counters and gauges SET per-worker series; histograms set their
+    full bucket state.  A family whose shape conflicts with an earlier
+    fold is skipped — federation never raises into the reader loop."""
+    for fam in (snapshot or {}).get("families") or ():
+        try:
+            name = fleet_family_name(fam["name"])
+            kind = fam.get("kind") or "gauge"
+            labelnames = ("worker",) + tuple(fam.get("labels") or ())
+            reg = {"counter": FLEET.counter, "gauge": FLEET.gauge,
+                   "histogram": FLEET.histogram}[kind]
+            m = reg(name, fam.get("help", ""), labelnames)
+        except (ValueError, KeyError, TypeError, AttributeError):
+            continue
+        for s in fam.get("series") or ():
+            labels = dict(s.get("labels") or {})
+            labels["worker"] = str(worker)
+            try:
+                if "value" in s:
+                    m.set(s["value"], **labels)
+                else:
+                    m.set_histogram(s.get("count", 0), s.get("sum", 0.0),
+                                    s.get("buckets"), **labels)
+            except (TypeError, ValueError):
+                continue
+
+
+def drop_fleet_worker(worker: str) -> None:
+    """A worker died: its GAUGE series (point-in-time state — HBM live,
+    in-flight) died with the process, so drop them.  Counter and
+    histogram series are CUMULATIVE WORK the fleet already did — they
+    stay, and a restarted replacement publishes under a fresh worker
+    id."""
+    w = str(worker)
+    for name in FLEET.family_names():
+        m = FLEET.get(name)
+        if m is None or m.kind != "gauge" or "worker" not in m.labelnames:
+            continue
+        widx = m.labelnames.index("worker")
+        with m._lock:
+            for key in [k for k in m._series if k[widx] == w]:
+                del m._series[key]
 
 
 _QUERY_SEQ_LOCK = threading.Lock()

@@ -1269,6 +1269,18 @@ class PhysicalQuery:
             FLIGHT_RECORDER.record("instant", "query_start", "query",
                                    {"plan_kind": self.kind}, query=qseq)
             tracer = make_tracer(ctx.conf)
+            gq = ctx.metrics.get("serving.query_id")
+            if tracer.enabled and gq is not None:
+                # pool mode: adopt the supervisor's GLOBAL query id so
+                # the event log is query_<gid>.jsonl — worker-local ids
+                # could collide between workers in one pool run dir,
+                # and stitching must be key-exact
+                import os as _os
+                tracer.query_id = int(gq)
+                tracer.meta["global_query_id"] = int(gq)
+                w = _os.environ.get("SPARK_RAPIDS_TPU_WORKER_ID")
+                if w:
+                    tracer.meta["worker"] = w
             ctx.tracer = tracer
             # chaos: conf-less sites (mesh exchange collectives) fire on
             # the active injector for this query's scope

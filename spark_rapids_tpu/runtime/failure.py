@@ -173,6 +173,57 @@ def write_crash_dump(conf: TpuConf, exc: BaseException,
     return path
 
 
+def write_worker_lost_dump(conf: TpuConf, worker_id: str, pid,
+                           reason: str, flight=None, census=None,
+                           inflight=None) -> Optional[str]:
+    """BLACK-BOX forensics for a worker that died by kill/hang — the
+    cases where no in-worker dump is possible.  The supervisor writes
+    this from the victim's last heartbeat-carried flight-recorder
+    snapshot plus the in-flight ticket state it was holding, so a
+    post-mortem sees what the worker was doing right up to its last
+    beat even though the process never got to say goodbye."""
+    dump_dir = conf.get(COREDUMP_PATH)
+    if not dump_dir:
+        return None
+    os.makedirs(dump_dir, exist_ok=True)
+    info = {
+        "ts": time.time(),
+        "type": "worker_lost",
+        "supervisor_pid": os.getpid(),
+        "worker_id": worker_id,
+        "worker_pid": pid,
+        "reason": reason,
+        # the victim's black box: its last-known flight-recorder tail
+        # (heartbeat telemetry) — NOT this process's recorder
+        "flight_recorder": list(flight or ()),
+        "hbm_census": dict(census or {}),
+        # the tickets that were mid-flight on the victim (they redrive)
+        "inflight_tickets": list(inflight or ()),
+        "metrics_registry": None,
+    }
+    try:
+        from ..obs.registry import FLEET
+        fleet = {k: v for k, v in FLEET.flat().items()
+                 if f"worker={worker_id}" in k}
+        info["metrics_registry"] = fleet or None
+    except Exception:                            # noqa: BLE001
+        pass
+    from .faults import get_active_injector, get_injector
+    for inj in (get_active_injector(), get_injector(conf)):
+        if getattr(inj, "log", None):
+            info["injected_faults"] = list(inj.log)
+            break
+    path = os.path.join(dump_dir,
+                        f"tpu-workerlost-{worker_id}-{int(time.time())}"
+                        f"-{next(_DUMP_SEQ)}.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(info, f, indent=2, default=str)
+    except OSError:
+        return None                  # forensics must never break redrive
+    return path
+
+
 @contextmanager
 def crash_capture(conf: TpuConf, ctx=None):
     """On a fatal device error: capture the dump, re-raise as

@@ -131,6 +131,15 @@ SITES: Dict[str, str] = {
               "self-terminates. All three lose only the victim's "
               "in-flight queries, which REDRIVE on a surviving worker "
               "(serving.redrive.maxAttempts) bit-identically",
+    "fleet": "observability federation fold (serving/workers.py "
+             "_reader_loop): fires SUPERVISOR-side once per heartbeat "
+             "frame that carries telemetry (registry snapshot / flight "
+             "tail). Kind 'ioerror' drops that ONE frame whole — "
+             "cumulative-set federation converges on the next beat and "
+             "the in-flight query stays bit-identical; 'fatal' writes "
+             "a classified crash dump naming the site and drops the "
+             "frame, with the supervisor (and the pool) surviving — "
+             "telemetry must never take serving down",
     "deadline": "cooperative cancellation checkpoints (exec/plan.py "
                 "ExecContext.checkpoint): the compiled-plan seam "
                 "brackets, the per-batch result stream, out-of-core "
@@ -170,6 +179,10 @@ _TIMEOUT_SITES = ("serving", "deadline")
 #: wedge a process, so kill/hang arm only at the worker site — and the
 #: worker site accepts only process-level kinds
 _WORKER_KINDS = ("kill", "hang", "fatal")
+
+#: the federation fold can lose a frame (ioerror) or dump-and-survive
+#: (fatal); nothing else is meaningful for pure telemetry
+_FLEET_KINDS = ("ioerror", "fatal")
 
 
 class InjectedIOError(OSError):
@@ -260,6 +273,9 @@ def parse_spec(spec: str) -> List[FaultRule]:
         if site == "worker" and kind not in _WORKER_KINDS:
             raise ValueError(f"site 'worker' only takes process-level "
                              f"kinds {list(_WORKER_KINDS)}, got {kind!r}")
+        if site == "fleet" and kind not in _FLEET_KINDS:
+            raise ValueError(f"site 'fleet' only takes telemetry kinds "
+                             f"{list(_FLEET_KINDS)}, got {kind!r}")
         rule = FaultRule(site, kind)
         if trigger == "always":
             rule.always = True

@@ -121,6 +121,10 @@ class QueryTicket:
         self.deadline_ms = 0.0
         self.redrives = 0                 # worker losses survived (MP mode)
         self.worker = None                # worker id that answered (MP mode)
+        #: compact profile summary from the answering worker's
+        #: completion frame (MP mode): wall breakdown, hbm, serving
+        #: context — folded into the stitched event-log record
+        self.worker_profile: Optional[dict] = None
         self.device_us = 0                # measured device-execute micros
         self.skips = 0                    # scheduler pass-overs at grant
         self.admit_wait_ms = 0.0
@@ -460,6 +464,10 @@ class ServingRuntime:
                 if ticket.ooc:
                     ctx.ooc_force = True
                 ctx.metrics["serving.tenant"] = ticket.tenant
+                # the GLOBAL ticket id: the tracer adopts it
+                # (plan/overrides.py), so event-log filenames are
+                # keyed the same way in-process and across the pool
+                ctx.metrics["serving.query_id"] = ticket.id
                 if pred:
                     # stamped pre-collect so the instrumented scope
                     # embeds the prediction in the trace + event log
@@ -544,12 +552,85 @@ class ServingRuntime:
         est_bytes = self._admit_working_set(
             ticket, int(src_bytes * self._ws_factor), pred)
         pool = self._ensure_pool()
-        with self._device_grant(ticket, est_bytes):
-            with self._phase("execute", ticket):
-                out, device_us = pool.execute(ticket, injector,
-                                              ticket.deadline_ms)
-                ticket.device_us = int(device_us)
-        return out
+        tracer = self._stitch_tracer(ticket)
+        status = "ok"
+        try:
+            t_g0 = time.perf_counter()
+            with self._device_grant(ticket, est_bytes):
+                if tracer is not None:
+                    tracer.add_span("grant", "serving", t_g0,
+                                    time.perf_counter(),
+                                    skips=ticket.skips,
+                                    est_bytes=est_bytes)
+                with self._phase("execute", ticket):
+                    out, device_us = pool.execute(ticket, injector,
+                                                  ticket.deadline_ms,
+                                                  tracer=tracer)
+                    ticket.device_us = int(device_us)
+            return out
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            self._finish_stitch(tracer, ticket, status)
+
+    def _stitch_tracer(self, ticket: QueryTicket):
+        """The supervisor-side STITCHED trace: one event-log record per
+        pool query, keyed by the global ticket id, spanning admission ->
+        grant -> worker execution (-> loss -> redrive) -> completion.
+        The answering worker writes its own deep per-query log under
+        the SAME id; this record is the cross-process head that names
+        every worker the query touched."""
+        from ..config import EVENT_LOG_DIR, TRACE_ENABLED
+        if not (ticket.conf.get(TRACE_ENABLED)
+                or ticket.conf.get(EVENT_LOG_DIR)):
+            return None
+        from ..obs.tracer import QueryTracer
+        tracer = QueryTracer(ticket.id)
+        tracer.meta["stitched"] = True
+        tracer.meta["tenant"] = ticket.tenant
+        if ticket.predicted:
+            tracer.meta["prediction"] = {
+                k: ticket.predicted.get(k)
+                for k in ("device_us", "basis")}
+        # admission already happened: replay it as a span so the record
+        # covers submit -> grant
+        now = time.perf_counter()
+        tracer.add_span("admission", "serving",
+                        now - ticket.admit_wait_ms / 1e3, now,
+                        wait_ms=round(ticket.admit_wait_ms, 3))
+        return tracer
+
+    def _finish_stitch(self, tracer, ticket: QueryTicket,
+                       status: str) -> None:
+        if tracer is None:
+            return
+        from ..config import EVENT_LOG_DIR
+        try:
+            tracer.meta["status"] = status
+            tracer.meta["redrives"] = ticket.redrives
+            tracer.meta["worker"] = ticket.worker
+            tracer.meta["workers"] = [
+                s.attrs.get("worker") for s in tracer.spans
+                if s.cat == "execute"]
+            if ticket.worker_profile:
+                tracer.meta["worker_profile"] = ticket.worker_profile
+            # a root query span over the whole stitched window so
+            # QueryProfile/profile_report render it like any trace
+            ts = [s.t0 for s in tracer.spans] or [time.perf_counter()]
+            with tracer.span("query", "query"):
+                pass
+            root = tracer.spans[-1]
+            root.t0 = min(ts)
+            tracer.finish({"serving.tenant": ticket.tenant,
+                           "serving.query_id": ticket.id,
+                           "serving.redrives": ticket.redrives,
+                           "device_us": ticket.device_us})
+            log_dir = str(ticket.conf.get(EVENT_LOG_DIR) or "")
+            if log_dir:
+                tracer.write(log_dir)
+        except Exception:                            # noqa: BLE001
+            pass          # stitching must never fail a served query
 
     def _compile(self, q, ticket: QueryTicket) -> None:
         """AOT-compile the whole-plan program through the background
@@ -709,6 +790,12 @@ class ServingRuntime:
         if pool is not None:
             out["pool"] = pool.stats()
             out["census"] = pool.census()
+            # the federated fleet view: per-worker-labeled tpu_fleet_*
+            # series folded from worker heartbeats (obs/registry.py)
+            from ..obs.registry import FLEET
+            fleet = FLEET.flat()
+            if fleet:
+                out["fleet"] = fleet
         out["overlap_observed"] = _overlap_observed(intervals)
         # oracle trustworthiness: per-basis estimate counts + the
         # prediction-error summary (obs/estimator.py / history plane)

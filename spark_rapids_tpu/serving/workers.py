@@ -100,15 +100,20 @@ class _Dispatch:
     """One in-flight query on one worker (supervisor bookkeeping)."""
 
     __slots__ = ("qid", "event", "reply", "lost", "kill_on_start",
-                 "started")
+                 "started", "ticket_info")
 
-    def __init__(self, qid: int, kill_on_start: bool = False):
+    def __init__(self, qid: int, kill_on_start: bool = False,
+                 ticket_info: Optional[dict] = None):
         self.qid = qid
         self.event = threading.Event()
         self.reply: Optional[dict] = None
         self.lost: Optional[WorkerLost] = None
         self.kill_on_start = kill_on_start
         self.started = threading.Event()
+        # the supervisor's view of the dispatched ticket (tenant,
+        # attempt, deadline, prediction) — embedded into the WorkerLost
+        # forensics dump when the worker dies holding this query
+        self.ticket_info = dict(ticket_info or {})
 
 
 class _WorkerHandle:
@@ -127,6 +132,10 @@ class _WorkerHandle:
         self.census: Dict[str, int] = {"live_bytes": 0, "peak_bytes": 0}
         self.inflight: Dict[int, _Dispatch] = {}     # qid -> dispatch
         self.draining = False
+        # the worker's last heartbeat-carried flight-recorder snapshot
+        # (black box): embedded into the WorkerLost dump on kill/hang —
+        # the cases where the victim cannot write its own dump
+        self.flight: List[dict] = []
 
     def send(self, obj: dict) -> None:
         with self.send_lock:
@@ -264,7 +273,10 @@ class WorkerPool:
                 if msg.get("metrics_port") is not None:
                     h.metrics_port = msg["metrics_port"]
                 SERVING_WORKER_HEARTBEATS.inc()
+                self._fold_telemetry(h, msg)
             elif op == "started":
+                if msg.get("flight"):
+                    h.flight = list(msg["flight"])
                 d = h.inflight.get(msg.get("qid"))
                 if d is not None:
                     d.started.set()
@@ -322,6 +334,46 @@ class WorkerPool:
                         pass
                     self._declare_dead(h, "hang")
 
+    def _fold_telemetry(self, h: _WorkerHandle, msg: dict) -> None:
+        """Metrics federation + black-box fold of one heartbeat frame.
+        The `fleet` chaos site fires here, SUPERVISOR-side, once per
+        telemetry-carrying frame: ioerror drops THIS frame whole
+        (cumulative-set federation converges on the next beat, the
+        in-flight query untouched); fatal writes a classified dump
+        naming the site and drops the frame — the supervisor (and the
+        pool) survive, telemetry never takes serving down."""
+        if msg.get("registry") is None and msg.get("flight") is None:
+            return
+        from ..obs.registry import (FLEET_FRAMES, fold_fleet_snapshot)
+        from ..runtime.faults import get_injector
+        try:
+            get_injector(self._rconf).fire("fleet", worker=h.wid)
+        except OSError:
+            FLEET_FRAMES.inc(outcome="dropped")
+            return
+        except Exception as exc:                     # noqa: BLE001
+            from ..runtime.failure import (FATAL_DEVICE, classify,
+                                           write_crash_dump)
+            if classify(exc) == FATAL_DEVICE:
+                try:
+                    write_crash_dump(self._rconf, exc)
+                except Exception:                    # noqa: BLE001
+                    pass
+                FLEET_FRAMES.inc(outcome="dropped")
+                return
+            FLEET_FRAMES.inc(outcome="error")
+            return
+        try:
+            if msg.get("registry") is not None:
+                fold_fleet_snapshot(h.wid, msg["registry"])
+            if msg.get("flight") is not None:
+                h.flight = list(msg["flight"])
+            FLEET_FRAMES.inc(outcome="folded")
+        except Exception:                            # noqa: BLE001
+            # a malformed frame must never kill the reader loop (the
+            # worker would be declared dead over telemetry)
+            FLEET_FRAMES.inc(outcome="error")
+
     def _declare_dead(self, h: _WorkerHandle, reason: str) -> None:
         from ..obs.registry import SERVING_WORKER_RESTARTS
         with self._cond:
@@ -331,10 +383,39 @@ class WorkerPool:
             self._workers.pop(h.wid, None)
             pending = list(h.inflight.values())
             h.inflight.clear()
-            self._restarts[reason] = self._restarts.get(reason, 0) + 1
+            # A worker exiting while the pool drains/closes is a CLEAN
+            # shutdown racing the reaper, not a loss: no restart count,
+            # no black-box dump.
+            shutdown = self._draining or self._closed
+            if not shutdown:
+                self._restarts[reason] = self._restarts.get(reason, 0) + 1
             self._cond.notify_all()
-        SERVING_WORKER_RESTARTS.inc(reason=reason)
+        if not shutdown:
+            SERVING_WORKER_RESTARTS.inc(reason=reason)
         self._set_live_gauge()
+        # fleet federation: the dead worker's GAUGE series (point-in-
+        # time state) die with the process; its counters — cumulative
+        # work the fleet did — stay.  A restarted replacement publishes
+        # under a fresh worker id.
+        try:
+            from ..obs.registry import drop_fleet_worker
+            drop_fleet_worker(h.wid)
+        except Exception:                            # noqa: BLE001
+            pass
+        # BLACK-BOX forensics: on kill/hang the victim could not write
+        # its own dump — embed its last heartbeat-carried flight
+        # snapshot + the in-flight ticket state supervisor-side
+        if not shutdown:
+            try:
+                from ..runtime.failure import write_worker_lost_dump
+                write_worker_lost_dump(
+                    self._rconf, h.wid, h.pid, reason,
+                    flight=list(h.flight), census=dict(h.census),
+                    inflight=[dict(d.ticket_info, qid=d.qid,
+                                   started=d.started.is_set())
+                              for d in pending])
+            except Exception:                        # noqa: BLE001
+                pass              # forensics must never break redrive
         try:
             if h.conn is not None:
                 h.conn.close()
@@ -376,15 +457,21 @@ class WorkerPool:
                         f"no live serving worker within {timeout}s")
                 self._cond.wait(min(remaining, 0.5))
 
-    def execute(self, ticket, injector, deadline_ms: float = 0.0):
+    def execute(self, ticket, injector, deadline_ms: float = 0.0,
+                tracer=None):
         """Run one admitted query on the pool: dispatch, await, REDRIVE
         on worker loss up to serving.redrive.maxAttempts.  Returns
         (pa.Table, device_us).  Chaos `worker` fires here, supervisor-
-        side, once per dispatch."""
+        side, once per dispatch.  With a (stitched) tracer, each
+        attempt is one `execute@<wid>` span and each loss a
+        `worker_lost` instant — the redrive chain renders as retry
+        spans naming both workers."""
         from ..obs.registry import SERVING_REDRIVES
         from ..runtime.faults import InjectedWorkerFault
         losses = 0
+        pred = dict(ticket.predicted or {})
         while True:
+            attempt = losses
             fault_kind = None
             try:
                 injector.fire("worker", query=ticket.id,
@@ -393,19 +480,38 @@ class WorkerPool:
                 fault_kind = f.kind
             h = self._pick()
             d = _Dispatch(ticket.id,
-                          kill_on_start=(fault_kind == "kill"))
+                          kill_on_start=(fault_kind == "kill"),
+                          ticket_info={
+                              "tenant": ticket.tenant,
+                              "attempt": attempt,
+                              "deadline_ms": float(deadline_ms or 0.0),
+                              "ooc": bool(ticket.ooc),
+                              "predicted_us": int(
+                                  pred.get("device_us") or 0)})
             extra = {}
             if fault_kind == "fatal":
                 # arm the in-worker fatal injector for THIS dispatch
                 # only — the redrive conf is clean
                 extra["spark.rapids.tpu.test.injectFatalError"] = "1"
             h.inflight[ticket.id] = d
+            t0 = time.perf_counter()
             try:
                 h.send({"op": "query", "qid": ticket.id,
                         "plan": ticket.plan, "extra": extra,
                         "deadline_ms": float(deadline_ms or 0.0),
                         "ooc": bool(ticket.ooc),
-                        "hang": fault_kind == "hang"})
+                        "hang": fault_kind == "hang",
+                        # the supervisor's GLOBAL ticket context: the
+                        # worker tracer adopts the id (key-exact event
+                        # logs) and stamps the serving.* metrics
+                        "ctx": {"query_id": ticket.id,
+                                "tenant": ticket.tenant,
+                                "attempt": attempt,
+                                "admit_wait_ms": round(
+                                    ticket.admit_wait_ms, 3),
+                                "predicted": {
+                                    "device_us": pred.get("device_us"),
+                                    "basis": pred.get("basis")}}})
             except (OSError, pickle.PicklingError) as e:
                 h.inflight.pop(ticket.id, None)
                 if isinstance(e, pickle.PicklingError):
@@ -415,10 +521,19 @@ class WorkerPool:
                 d.event.set()
             while not d.event.wait(0.5):
                 pass
+            t1 = time.perf_counter()
             if d.lost is None:
                 msg = d.reply
                 if msg["op"] == "result":
                     ticket.worker = h.wid
+                    ticket.worker_profile = msg.get("profile")
+                    if tracer is not None and \
+                            getattr(tracer, "enabled", False):
+                        tracer.add_span(f"execute@{h.wid}", "execute",
+                                        t0, t1, worker=h.wid,
+                                        attempt=attempt,
+                                        device_us=int(
+                                            msg.get("device_us") or 0))
                     return msg["table"], int(msg.get("device_us") or 0)
                 exc = msg.get("exc")
                 if exc is None:
@@ -431,6 +546,12 @@ class WorkerPool:
             losses += 1
             ticket.redrives = losses
             SERVING_REDRIVES.inc(reason=d.lost.reason)
+            if tracer is not None and getattr(tracer, "enabled", False):
+                tracer.add_span(f"execute@{h.wid}", "execute", t0, t1,
+                                worker=h.wid, attempt=attempt,
+                                lost=d.lost.reason)
+                tracer.instant("worker_lost", "serving", worker=h.wid,
+                               reason=d.lost.reason, attempt=attempt)
             with self._cond:
                 self._redrives += 1
             if losses > self._redrive_max:
@@ -531,17 +652,60 @@ def _worker_heartbeat(conn, send_lock: threading.Lock, hb_s: float,
                       stop: threading.Event, state: dict) -> None:
     from ..obs.export import bound_metrics_port
     from ..obs.memattr import CENSUS
-    while not stop.wait(hb_s):
+    from ..obs.recorder import FLIGHT_RECORDER, tail_bounded
+    from ..obs.registry import REGISTRY
+    # First beat goes out IMMEDIATELY: a worker killed early in its
+    # first query must already have shipped a black-box snapshot.
+    while True:
+        msg = {"op": "hb", "pid": os.getpid(),
+               "census": CENSUS.totals(),
+               "metrics_port": bound_metrics_port(),
+               "inflight": state.get("qid")}
+        tel = state.get("telemetry")
+        if tel:
+            k_events, max_bytes = tel
+            # federation piggyback: the FULL cumulative registry
+            # snapshot (set semantics supervisor-side make a dropped
+            # frame self-heal) + the rolling black-box flight tail.
+            # Liveness first: trim the flight, then drop it, then drop
+            # the registry — the bare heartbeat always goes out.
+            msg["registry"] = REGISTRY.snapshot()
+            msg["flight"] = tail_bounded(FLIGHT_RECORDER, k_events,
+                                         max(max_bytes // 4, 1024))
+            if len(_frame(msg)) > max_bytes:
+                msg["flight"] = []
+                if len(_frame(msg)) > max_bytes:
+                    msg.pop("registry")
         try:
             with send_lock:
-                send_frame(conn, _frame({
-                    "op": "hb", "pid": os.getpid(),
-                    "census": CENSUS.totals(),
-                    "metrics_port": bound_metrics_port(),
-                    "inflight": state.get("qid")}))
+                send_frame(conn, _frame(msg))
         except OSError:
             # supervisor is gone: a worker must never outlive it
             os._exit(EXIT_DRAINED)
+        if stop.wait(hb_s):
+            return
+
+
+def _profile_summary(ctx, device_us: int, wid: str) -> dict:
+    """Compact, jsonable span-tree/profile summary the completion frame
+    carries home: wall breakdown (overhead.*), memory attribution
+    (memory.*), serving/prediction context and the worker's event-log
+    path — the supervisor folds it into the stitched record's meta."""
+    from ..obs.memattr import CENSUS
+    out = {"worker": wid, "pid": os.getpid(), "device_us": device_us,
+           "hbm": CENSUS.totals()}
+    keep = {}
+    for k, v in (ctx.metrics or {}).items():
+        if not isinstance(v, (int, float, str, bool)) and v is not None:
+            continue
+        if k.startswith(("overhead.", "memory.", "serving.",
+                         "predicted.", "seg.")):
+            keep[k] = v
+    out["metrics"] = keep
+    logf = ctx.metrics.get("event_log_files")
+    if isinstance(logf, dict):
+        out["event_log"] = logf.get("jsonl")
+    return out
 
 
 def _run_one(session, base_raw: dict, req: dict) -> dict:
@@ -556,12 +720,42 @@ def _run_one(session, base_raw: dict, req: dict) -> dict:
     ctx.arm_deadline(float(req.get("deadline_ms") or 0.0))
     if req.get("ooc"):
         ctx.ooc_force = True
+    wid = os.environ.get(_ENV_ID, "w?")
+    dctx = req.get("ctx") or {}
+    if dctx:
+        # the supervisor's ticket context rides ctx.metrics into the
+        # instrumented scope: the tracer adopts the GLOBAL query id
+        # (plan/overrides.py — the event log becomes query_<gid>.jsonl,
+        # key-exact for stitching) and the serving.* keys land in the
+        # trace meta + history record
+        if dctx.get("query_id") is not None:
+            ctx.metrics["serving.query_id"] = int(dctx["query_id"])
+        if dctx.get("tenant"):
+            ctx.metrics["serving.tenant"] = str(dctx["tenant"])
+        ctx.metrics["serving.worker"] = wid
+        ctx.metrics["serving.attempt"] = int(dctx.get("attempt") or 0)
+        if dctx.get("admit_wait_ms") is not None:
+            ctx.metrics["serving.admit_wait_ms"] = dctx["admit_wait_ms"]
+        pred = dctx.get("predicted") or {}
+        if pred.get("device_us") is not None:
+            ctx.metrics["predicted.device_us"] = int(pred["device_us"])
+            ctx.metrics["predicted.basis"] = str(pred.get("basis")
+                                                 or "?")
     t0 = time.perf_counter()
     with cancel_scope(ctx):
         out = q.collect(ctx)
     device_us = int((time.perf_counter() - t0) * 1e6)
+    tenant = dctx.get("tenant")
+    if tenant:
+        # publish the SAME integer the supervisor's grant publishes for
+        # this ticket, so the fleet's per-worker tenant device-us sums
+        # to the supervisor's per-tenant counter EXACTLY (the PR 10
+        # hammer invariant, now across the socket)
+        from ..obs.registry import SERVING_TENANT_DEVICE_US
+        SERVING_TENANT_DEVICE_US.inc(device_us, tenant=str(tenant))
     return {"op": "result", "qid": req["qid"], "table": out,
-            "device_us": device_us}
+            "device_us": device_us,
+            "profile": _profile_summary(ctx, device_us, wid)}
 
 
 def main() -> int:
@@ -585,6 +779,13 @@ def main() -> int:
     from ..session import TpuSession
     session = TpuSession(base_raw)
     state: dict = {"qid": None}
+    from ..config import (SERVING_POOL_TELEMETRY_ENABLED,
+                          SERVING_POOL_TELEMETRY_FLIGHT_EVENTS,
+                          SERVING_POOL_TELEMETRY_MAX_FRAME_BYTES)
+    if bool(session.conf.get(SERVING_POOL_TELEMETRY_ENABLED)):
+        state["telemetry"] = (
+            int(session.conf.get(SERVING_POOL_TELEMETRY_FLIGHT_EVENTS)),
+            int(session.conf.get(SERVING_POOL_TELEMETRY_MAX_FRAME_BYTES)))
     stop_hb = threading.Event()
     threading.Thread(target=_worker_heartbeat,
                      args=(conn, send_lock, float(cfg["hb_ms"]) / 1e3,
@@ -615,9 +816,25 @@ def main() -> int:
         if op != "query":
             continue
         state["qid"] = req["qid"]
+        started = {"op": "started", "qid": req["qid"],
+                   "pid": os.getpid()}
+        tel = state.get("telemetry")
+        if tel:
+            # black-box determinism: a dispatch instant + the current
+            # flight tail ride the started frame itself, so a worker
+            # killed mid-query — even its FIRST, milliseconds in —
+            # always leaves a snapshot naming the query it died on
+            from ..obs.recorder import FLIGHT_RECORDER, tail_bounded
+            FLIGHT_RECORDER.record(
+                "instant", "serving_dispatch", "serving",
+                attrs={"qid": req["qid"],
+                       "tenant": (req.get("ctx") or {}).get("tenant")},
+                query=req["qid"])
+            k_events, max_bytes = tel
+            started["flight"] = tail_bounded(
+                FLIGHT_RECORDER, k_events, max(max_bytes // 4, 1024))
         with send_lock:
-            send_frame(conn, _frame({"op": "started", "qid": req["qid"],
-                                     "pid": os.getpid()}))
+            send_frame(conn, _frame(started))
         if req.get("hang"):
             # chaos worker:hang — wedge: heartbeats stop, requests
             # stop; the supervisor's miss window kills this process

@@ -1294,3 +1294,198 @@ def test_worker_kinds_grammar_is_site_restricted():
         parse_spec("spill:hang:nth=1")               # hang off-site
     with pytest.raises(ValueError):
         parse_spec("worker:oom:always")              # non-worker kind
+
+
+# ---------------------------------------------------------------------------
+# fleet site: observability federation must never cost work
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_grammar_is_telemetry_restricted():
+    """The federation fold can lose a frame (ioerror) or dump-and-
+    survive (fatal); process-level and query-level kinds are illegal
+    at the `fleet` site."""
+    parse_spec("fleet:ioerror:nth=1")                # valid
+    parse_spec("fleet:fatal:always")                 # valid
+    with pytest.raises(ValueError):
+        parse_spec("fleet:kill:nth=1")               # process-level kind
+    with pytest.raises(ValueError):
+        parse_spec("fleet:oom:always")               # non-telemetry kind
+    with pytest.raises(ValueError):
+        parse_spec("fleet:timeout:nth=1")            # timeout off-site
+
+
+def test_fleet_ioerror_drops_one_frame_then_converges():
+    """`fleet:ioerror` drops exactly ONE telemetry heartbeat frame
+    SUPERVISOR-side: the in-flight query stays bit-identical, no worker
+    is falsely declared dead over lost telemetry, and because workers
+    ship CUMULATIVE registry snapshots the fleet view converges on the
+    very next beat — the per-worker tenant counter still lands."""
+    import time as _time
+
+    from spark_rapids_tpu.obs.registry import REGISTRY
+    tbl = _serving_tbl()
+
+    def dropped():
+        return REGISTRY.flat().get(
+            "tpu_fleet_frames_total{outcome=dropped}", 0)
+
+    base_dropped = dropped()
+    s = TpuSession({"spark.rapids.tpu.test.faults": "fleet:ioerror:nth=1"})
+    try:
+        rt = s.serving(dict(MP_POOL))
+        ses = rt.tenant("fleet_io_tenant")
+        expected = _rows(_serving_query(s, tbl).collect())
+        tk = ses.submit(_serving_query(s, tbl))
+        assert _rows(tk.result(timeout=240)) == expected
+        assert tk.error is None and tk.redrives == 0
+        # the drop happened (nth=1: the FIRST telemetry frame died)...
+        deadline = _time.time() + 60
+        while dropped() == base_dropped and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert dropped() == base_dropped + 1
+        # ...and the federation converged anyway: the next beats carry
+        # the same cumulative counters, so the fleet view still shows
+        # this tenant's worker-side device time
+        key_frag = "tenant=fleet_io_tenant"
+        while _time.time() < deadline:
+            fleet = rt.stats().get("fleet") or {}
+            hit = [k for k in fleet
+                   if k.startswith("tpu_fleet_serving_tenant_"
+                                   "device_us_total{")
+                   and key_frag in k]
+            if hit:
+                break
+            _time.sleep(0.05)
+        assert hit, f"fleet view never converged: {sorted(fleet)[:8]}"
+        assert all("worker=" in k for k in hit)
+        # telemetry loss is not worker loss
+        assert rt.stats()["pool"]["restarts"] == {}
+        # the fold fires on the RUNTIME conf's injector (the supervisor
+        # owns the fold), not the submitting session's
+        assert "fleet" in {r["site"]
+                           for r in get_injector(rt._rconf).log}
+    finally:
+        s.close()
+
+
+@pytest.mark.slow
+def test_fleet_fatal_dump_names_site_and_pool_survives(tmp_path):
+    """`fleet:fatal` in the supervisor's fold path writes a classified
+    FATAL_DEVICE dump whose injected-fault record names the site, drops
+    that frame — and the pool keeps serving: telemetry must never take
+    serving down."""
+    import glob
+    import time as _time
+    tbl = _serving_tbl()
+    s = TpuSession({"spark.rapids.tpu.test.faults": "fleet:fatal:nth=1",
+                    "spark.rapids.tpu.coredump.path": str(tmp_path)})
+    try:
+        rt = s.serving(dict(MP_POOL))
+        ses = rt.tenant("bi")
+        expected = _rows(_serving_query(s, tbl).collect())
+        tk = ses.submit(_serving_query(s, tbl))
+        assert _rows(tk.result(timeout=240)) == expected
+        # the fold fires on the heartbeat cadence: wait for the dump
+        deadline = _time.time() + 60
+        dumps = []
+        while not dumps and _time.time() < deadline:
+            dumps = glob.glob(str(tmp_path / "tpu-coredump-*.json"))
+            _time.sleep(0.05)
+        assert len(dumps) == 1
+        info = json.load(open(dumps[0]))
+        assert info["classification"] == FATAL_DEVICE
+        # written by the SUPERVISOR (this process), not a worker
+        assert info["pid"] == os.getpid()
+        assert any(r.get("site") == "fleet"
+                   for r in info.get("injected_faults", []))
+        # the pool survived the telemetry fault: no worker died, and
+        # the next query completes
+        assert rt.stats()["pool"]["restarts"] == {}
+        assert _rows(ses.collect(_serving_query(s, tbl),
+                                 timeout=240)) == expected
+    finally:
+        s.close()
+
+
+def test_worker_kill_stitched_record_and_black_box(tmp_path):
+    """The PR-20 acceptance drill: a `worker:kill` chaos run must leave
+    (a) a WorkerLost black-box dump embedding the victim's last
+    heartbeat-carried flight snapshot plus its in-flight ticket state,
+    and (b) ONE stitched event-log record spanning admission -> worker
+    A execution -> loss -> redrive -> worker B completion, renderable
+    by the profile report."""
+    import glob
+
+    from spark_rapids_tpu.obs.profile import QueryProfile
+    from spark_rapids_tpu.obs.tracer import read_event_log
+    log_dir = tmp_path / "events"
+    dump_dir = tmp_path / "dumps"
+    tbl = _serving_tbl()
+    s = TpuSession({"spark.rapids.tpu.test.faults": "worker:kill:nth=1",
+                    "spark.rapids.tpu.coredump.path": str(dump_dir),
+                    "spark.rapids.tpu.eventLog.dir": str(log_dir)})
+    try:
+        rt = s.serving(dict(MP_POOL))
+        ses = rt.tenant("bi")
+        expected = _rows(_serving_query(s, tbl).collect())
+        tk = ses.submit(_serving_query(s, tbl))
+        assert _rows(tk.result(timeout=240)) == expected
+        assert tk.redrives == 1
+        assert rt.stats()["pool"]["restarts"].get("crash") == 1
+        # (a) the black box: the victim could not write its own dump —
+        # the supervisor wrote it from heartbeat-carried state
+        dumps = glob.glob(str(dump_dir / "tpu-workerlost-*.json"))
+        assert len(dumps) == 1
+        bb = json.load(open(dumps[0]))
+        assert bb["type"] == "worker_lost"
+        assert bb["reason"] == "crash"
+        assert bb["supervisor_pid"] == os.getpid()
+        assert isinstance(bb["flight_recorder"], list)
+        # the dispatch instant rides the `started` frame, so even a
+        # worker killed milliseconds into its FIRST query leaves a
+        # snapshot naming the query it died on
+        assert any(e.get("name") == "serving_dispatch"
+                   and (e.get("attrs") or {}).get("qid") == tk.id
+                   for e in bb["flight_recorder"])
+        infl = bb["inflight_tickets"]
+        assert len(infl) == 1
+        assert infl[0]["qid"] == tk.id
+        assert infl[0]["tenant"] == "bi"
+        assert infl[0]["started"] is True     # killed MID-query
+        # (b) ONE stitched record keyed by the global ticket id
+        stitched = []
+        for p in sorted(glob.glob(str(log_dir / "*.jsonl"))):
+            try:
+                log = read_event_log(p)
+            except Exception:                    # noqa: BLE001
+                continue
+            if (log.meta or {}).get("stitched"):
+                stitched.append((p, log))
+        assert len(stitched) == 1
+        path, log = stitched[0]
+        assert f"query_{tk.id}" in os.path.basename(path)
+        assert log.meta["status"] == "ok"
+        assert log.meta["redrives"] == 1
+        execs = sorted([sp for sp in log.spans if sp.cat == "execute"],
+                       key=lambda sp: sp.t0)
+        assert len(execs) == 2                   # attempt 0 + redrive
+        w_lost = execs[0].attrs["worker"]
+        w_done = execs[1].attrs["worker"]
+        assert w_lost != w_done                  # two distinct workers
+        assert execs[0].attrs["lost"] == "crash"
+        assert "lost" not in execs[1].attrs
+        assert log.meta["workers"] == [w_lost, w_done]
+        assert log.meta["worker"] == w_done
+        losses = [e for e in log.events if e.name == "worker_lost"]
+        assert len(losses) == 1
+        assert losses[0].attrs["worker"] == w_lost
+        names = {sp.name for sp in log.spans}
+        assert {"admission", "grant", "query"} <= names
+        # and the offline report renders the redrive chain
+        text = QueryProfile.from_event_log(path).render()
+        assert "stitched serving record" in text
+        assert "LOST (crash) -> redrive" in text
+        assert f"execute@{w_done}" in text
+    finally:
+        s.close()

@@ -755,3 +755,167 @@ def test_metrics_port_zero_binds_ephemeral_and_reports(tmp_path):
     hb.stop()
     shutdown_exporters()
     assert bound_metrics_port() is None            # released cleanly
+
+
+# ---------------------------------------------------------------------------
+# fleet federation (PR 20): per-worker-labeled fold of registry snapshots
+# ---------------------------------------------------------------------------
+
+def _unitfed_source():
+    """A worker-side registry with unique family names (FLEET is
+    process-global across the pytest run)."""
+    reg = MetricsRegistry()
+    c = reg.counter("tpu_unitfed_queries_total", "h", ("status",))
+    c.inc(3, status="ok")
+    c.inc(1, status="error")
+    g = reg.gauge("tpu_unitfed_live_bytes", "h")
+    g.set(4096)
+    h = reg.histogram("tpu_unitfed_wait_ms", "h", ("tenant",))
+    for v in (0.5, 3.0, 900.0):
+        h.observe(v, tenant="a")
+    return reg
+
+
+def test_fleet_fold_federates_counters_gauges_histograms():
+    from spark_rapids_tpu.obs.registry import (FLEET, drop_fleet_worker,
+                                               fold_fleet_snapshot)
+    src = _unitfed_source()
+    fold_fleet_snapshot("w1", src.snapshot())
+    fold_fleet_snapshot("w2", src.snapshot())
+    flat = FLEET.flat()
+    # per-worker-labeled series, values EXACTLY the worker's own
+    for w in ("w1", "w2"):
+        assert flat[
+            "tpu_fleet_unitfed_queries_total"
+            f"{{worker={w},status=ok}}"] == 3
+        assert flat[
+            "tpu_fleet_unitfed_queries_total"
+            f"{{worker={w},status=error}}"] == 1
+        assert flat[f"tpu_fleet_unitfed_live_bytes{{worker={w}}}"] == 4096
+        assert flat[
+            f"tpu_fleet_unitfed_wait_ms{{worker={w},tenant=a}}"
+            ".count"] == 3
+    # histogram bucket state round-trips through the snapshot
+    m = FLEET.get("tpu_fleet_unitfed_wait_ms")
+    v = m.value(worker="w1", tenant="a")
+    assert v["count"] == 3 and round(v["sum"], 1) == 903.5
+    assert sum(v["buckets"].values()) == 3
+    # folding the SAME cumulative snapshot again is idempotent (set,
+    # not add — a dropped frame self-heals on the next beat)
+    fold_fleet_snapshot("w1", src.snapshot())
+    assert FLEET.flat() == flat
+    # the fleet view renders as ordinary prometheus families
+    text = FLEET.prometheus_text()
+    assert "# TYPE tpu_fleet_unitfed_queries_total counter" in text
+    assert 'worker="w1"' in text
+    # a dead worker loses its GAUGES (point-in-time state), keeps its
+    # counters/histograms (cumulative work the fleet really did)
+    drop_fleet_worker("w1")
+    flat2 = FLEET.flat()
+    assert "tpu_fleet_unitfed_live_bytes{worker=w1}" not in flat2
+    assert flat2["tpu_fleet_unitfed_live_bytes{worker=w2}"] == 4096
+    assert flat2[
+        "tpu_fleet_unitfed_queries_total{worker=w1,status=ok}"] == 3
+
+
+def test_fleet_fold_shape_conflicts_are_skipped_not_raised():
+    """A malformed or shape-conflicting family must never raise into
+    the supervisor's reader loop (the worker would be falsely declared
+    dead over telemetry)."""
+    from spark_rapids_tpu.obs.registry import FLEET, fold_fleet_snapshot
+    reg = MetricsRegistry()
+    reg.counter("tpu_unitfed_conflict_total", "h", ("a",)).inc(1, a="x")
+    fold_fleet_snapshot("w1", reg.snapshot())
+    # same family name, different label shape: skipped silently
+    reg2 = MetricsRegistry()
+    reg2.counter("tpu_unitfed_conflict_total", "h", ("a", "b")) \
+        .inc(1, a="x", b="y")
+    fold_fleet_snapshot("w1", reg2.snapshot())
+    # garbage frames: no raise
+    fold_fleet_snapshot("w1", None)
+    fold_fleet_snapshot("w1", {"families": [{"name": 7}]})
+    fold_fleet_snapshot("w1", {"families": [
+        {"name": "tpu_unitfed_conflict_total", "kind": "bogus"}]})
+    assert FLEET.flat()[
+        "tpu_fleet_unitfed_conflict_total{worker=w1,a=x}"] == 1
+
+
+def test_worker_suffixed_path_keeps_pool_heartbeats_apart(monkeypatch):
+    """Satellite: pool mode pointed every process at ONE heartbeatPath
+    (interleaved, unparseable lines).  Each process now suffixes its
+    worker id before the extension; the supervisor keeps the bare
+    path."""
+    from spark_rapids_tpu.obs.export import worker_suffixed_path
+    monkeypatch.delenv("SPARK_RAPIDS_TPU_WORKER_ID", raising=False)
+    assert worker_suffixed_path("/x/hb.jsonl") == "/x/hb.jsonl"
+    assert worker_suffixed_path("") == ""
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_WORKER_ID", "w7")
+    assert worker_suffixed_path("/x/hb.jsonl") == "/x/hb-w7.jsonl"
+    assert worker_suffixed_path("/x/hb") == "/x/hb-w7.jsonl"
+
+
+def test_heartbeat_lines_carry_role_worker_and_fleet(tmp_path,
+                                                     monkeypatch):
+    from spark_rapids_tpu.obs.export import Heartbeat
+    from spark_rapids_tpu.obs.registry import fold_fleet_snapshot
+    # a worker-role process stamps its id on every line
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_WORKER_ID", "w3")
+    wpath = tmp_path / "hb-w.jsonl"
+    hb = Heartbeat(str(wpath), interval_s=3600)
+    hb.beat()
+    hb.stop()
+    rec = json.loads(wpath.read_text().splitlines()[0])
+    assert rec["role"] == "worker" and rec["worker"] == "w3"
+    # the supervisor's lines embed the non-empty FLEET view
+    monkeypatch.delenv("SPARK_RAPIDS_TPU_WORKER_ID")
+    fold_fleet_snapshot("w3", _unitfed_source().snapshot())
+    spath = tmp_path / "hb-s.jsonl"
+    hb = Heartbeat(str(spath), interval_s=3600)
+    hb.beat()
+    hb.stop()
+    rec = json.loads(spath.read_text().splitlines()[0])
+    assert rec["role"] == "supervisor" and rec["worker"] is None
+    assert any(k.startswith("tpu_fleet_unitfed_")
+               for k in rec["fleet"])
+
+
+def test_fleet_view_served_on_metrics_endpoints():
+    """ONE Prometheus endpoint serves the whole pool: the fleet
+    families ride /metrics (exposition text) and /metrics.json."""
+    from spark_rapids_tpu.obs.export import MetricsHttpServer
+    from spark_rapids_tpu.obs.registry import (QUERIES_TOTAL,
+                                               fold_fleet_snapshot)
+    fold_fleet_snapshot("w9", _unitfed_source().snapshot())
+    QUERIES_TOTAL.inc(status="ok", kind="device")   # ensure a series
+    srv = MetricsHttpServer(0)
+    port = srv.start()
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "# TYPE tpu_fleet_unitfed_queries_total counter" in text
+        assert 'worker="w9"' in text
+        # the single-process families still serve alongside
+        assert "# TYPE tpu_queries_total counter" in text
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics.json", timeout=5).read())
+        assert any(f["name"] == "tpu_fleet_unitfed_queries_total"
+                   for f in snap["fleet"]["families"])
+    finally:
+        srv.stop()
+
+
+def test_flight_tail_bounded_trims_to_byte_budget():
+    """Heartbeat telemetry is byte-bounded: the flight tail shrinks
+    (newest-first survive) until it fits the frame budget."""
+    from spark_rapids_tpu.obs.recorder import tail_bounded
+    rec = FlightRecorder(capacity=256)
+    for i in range(200):
+        rec.record("instant", "e", "cat",
+                   attrs={"payload": "x" * 50, "i": i})
+    full = tail_bounded(rec, 64, 1 << 20)
+    assert len(full) == 64
+    small = tail_bounded(rec, 64, 2048)
+    assert 0 < len(small) < 64
+    # the NEWEST events survive the trim
+    assert small[-1]["attrs"]["i"] == full[-1]["attrs"]["i"]
+    assert len(json.dumps(small, default=str)) <= 2048

@@ -733,3 +733,166 @@ def test_serving_deadline_conf_applies_to_every_query():
         assert _rows(out) == expected
     finally:
         s.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet observability federation (PR 20): one metrics plane for the pool
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_registry_federates_exactly_across_workers():
+    """The federation EXACTNESS invariant: the same worker-measured
+    device-us integer is published on both sides of the socket, so the
+    fleet view's per-worker-labeled tenant counters sum EXACTLY to the
+    supervisor's own per-tenant counter — no sampling, no drift."""
+    s = TpuSession({})
+    # unique tenant names: both registries are process-wide across the
+    # pytest run, so the series must be ours alone
+    tenants = ("fedx_alpha", "fedx_beta")
+    try:
+        rt = s.serving({"spark.rapids.tpu.serving.pool.processes": "2",
+                        **MP_FAST})
+        sessions = [rt.tenant(t) for t in tenants]
+        t = _table()
+        expected = _rows(_query(s, t).collect())
+        tickets = [ses.submit(_query(s, t))
+                   for _ in range(3) for ses in sessions]
+        for tk in tickets:
+            assert _rows(tk.result(timeout=240)) == expected
+
+        def fleet_sums():
+            fleet = rt.stats().get("fleet") or {}
+            sums = {t: 0 for t in tenants}
+            for k, v in fleet.items():
+                if not k.startswith(
+                        "tpu_fleet_serving_tenant_device_us_total{"):
+                    continue
+                for t_ in tenants:
+                    if f"tenant={t_}" in k:
+                        assert "worker=" in k
+                        sums[t_] += int(v)
+            return sums
+
+        sup = {t_: int(SERVING_TENANT_DEVICE_US.value(tenant=t_) or 0)
+               for t_ in tenants}
+        assert all(v > 0 for v in sup.values())
+        # convergence is one heartbeat away: poll BEFORE drain/close
+        deadline = time.time() + 60
+        while fleet_sums() != sup and time.time() < deadline:
+            time.sleep(0.05)
+        assert fleet_sums() == sup       # exactly, to the microsecond
+    finally:
+        s.close()
+
+
+@pytest.mark.slow
+def test_worker_restart_publishes_fresh_fleet_label_and_live_gauge():
+    """A replaced worker federates under a FRESH worker label: the
+    victim's gauge series drop with the process (its counters — work
+    the fleet really did — stay), the replacement's series appear under
+    the new id, and `tpu_serving_workers_live` stays truthful through
+    the restart."""
+    import os as _os
+    import signal as _signal
+
+    from spark_rapids_tpu.obs.registry import SERVING_WORKERS_LIVE
+    s = TpuSession({})
+    try:
+        rt = s.serving({"spark.rapids.tpu.serving.pool.processes": "2",
+                        **MP_FAST})
+        ses = rt.tenant("fedr_tenant")
+        t = _table()
+        expected = _rows(_query(s, t).collect())
+        assert _rows(ses.collect(_query(s, t), timeout=240)) == expected
+        pool = rt.stats()["pool"]
+        assert pool["live"] == 2
+        assert SERVING_WORKERS_LIVE.value() == 2
+        victim_wid, victim = sorted(pool["workers"].items())[0]
+        _os.kill(victim["pid"], _signal.SIGKILL)
+        # the supervisor notices (reader EOF), restarts, and the gauge
+        # tracks the dip and the recovery truthfully
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            pool = rt.stats()["pool"]
+            assert SERVING_WORKERS_LIVE.value() == pool["live"]
+            if pool["live"] == 2 and victim_wid not in pool["workers"]:
+                break
+            time.sleep(0.02)
+        pool = rt.stats()["pool"]
+        assert pool["live"] == 2
+        assert victim_wid not in pool["workers"]
+        fresh = set(pool["workers"]) - {victim_wid}
+        assert fresh
+        assert SERVING_WORKERS_LIVE.value() == 2
+        # hammer enough concurrent work that every live worker serves
+        tickets = [ses.submit(_query(s, t)) for _ in range(8)]
+        for tk in tickets:
+            assert _rows(tk.result(timeout=240)) == expected
+        # the replacement publishes under its own fresh label
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            fleet = rt.stats().get("fleet") or {}
+            new_labels = {w for w in fresh
+                          if any(f"worker={w}" in k for k in fleet)}
+            if new_labels:
+                break
+            time.sleep(0.05)
+        assert new_labels, "replacement worker never federated"
+        # the victim's cumulative counters survive it; its gauges died
+        fleet = rt.stats().get("fleet") or {}
+        victim_keys = [k for k in fleet if f"worker={victim_wid}" in k]
+        for k in victim_keys:
+            assert not k.startswith("tpu_fleet_memory_"), \
+                f"dead worker gauge survived: {k}"
+    finally:
+        s.close()
+
+
+def test_check_regression_gates_fleet_skew_entries(tmp_path):
+    """scripts/check_regression.py mines `serving_fleet` (per-mp-level
+    worker utilization skew from the federated registry) into sv:-
+    prefixed entries under the same backend-separation rules as the
+    latency gates: a dispatch-imbalance regression fails the gate."""
+    import json as _json
+    import os as _os
+    import subprocess
+    import sys as _sys
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    script = _os.path.join(root, "scripts", "check_regression.py")
+    base = {"backend": "cpu",
+            "serving_latency_ms": {"c8_p99": 1000.0},
+            "serving_fleet": {"mp2_skew": 1.2}}
+    good = {"backend": "cpu",
+            "serving_latency_ms": {"c8_p99": 1000.0},
+            "serving_fleet": {"mp2_skew": 1.3}}
+    bad = {"backend": "cpu",
+           "serving_latency_ms": {"c8_p99": 1000.0},
+           "serving_fleet": {"mp2_skew": 3.0}}
+    fleet_only = {"backend": "cpu",
+                  "serving_fleet": {"mp2_skew": 1.2}}
+    other_hw = {"backend": "tpu",
+                "serving_fleet": {"mp2_skew": 4.0}}
+    paths = {}
+    for name, doc in (("base", base), ("good", good), ("bad", bad),
+                      ("fleet_only", fleet_only), ("other", other_hw)):
+        p = tmp_path / f"{name}.json"
+        p.write_text(_json.dumps(doc))
+        paths[name] = str(p)
+
+    def gate(current, trajectory):
+        return subprocess.run(
+            [_sys.executable, script, "--current", current, *trajectory],
+            capture_output=True, text=True)
+
+    r = gate(paths["good"], [paths["base"]])
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = gate(paths["bad"], [paths["base"]])
+    assert r.returncode == 1
+    assert "sv:mp2_skew" in r.stdout
+    # a record carrying ONLY the fleet dict still mines
+    r = gate(paths["fleet_only"], [paths["base"]])
+    assert r.returncode == 0, r.stdout + r.stderr
+    # backend separation: tpu-tagged skew never gates vs a cpu baseline
+    r = gate(paths["other"], [paths["base"]])
+    assert r.returncode == 2 or "skipping" in r.stdout + r.stderr

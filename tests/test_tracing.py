@@ -245,3 +245,37 @@ def test_configs_docs_cover_every_public_entry():
     spec.loader.exec_module(mod)
     assert mod.missing_keys() == [], \
         "docs/configs.md stale — run `python -m spark_rapids_tpu.config`"
+
+
+# ---------------------------------------------------------------------------
+# global query-id adoption (PR 20): pool workers trace under the
+# supervisor's ticket id
+# ---------------------------------------------------------------------------
+
+def test_tracer_adopts_global_query_id(tmp_path):
+    """When the execution context carries `serving.query_id` (stamped
+    by the serving dispatch — supervisor-side AND in pool workers), the
+    tracer adopts it: the event-log filename and query_start record key
+    by the GLOBAL ticket id, not this process's local sequence, so a
+    pool worker's deep log and the supervisor's stitched record land
+    under the same id."""
+    s = TpuSession({"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    q = _agg_df(s, _tbl()).physical()
+    ctx = ExecContext(s.conf)
+    ctx.metrics["serving.query_id"] = 777
+    q.collect(ctx)
+    logs = glob.glob(str(tmp_path / "*.jsonl"))
+    assert [os.path.basename(p) for p in logs] == ["query_777.jsonl"]
+    with open(logs[0]) as f:
+        head = json.loads(f.readline())
+    assert head["query_id"] == 777
+    log = read_event_log(logs[0])
+    assert log.meta["global_query_id"] == 777
+    # a second record under the SAME id (the stitched head next to the
+    # worker's deep log in one shared dir) does not collide
+    ctx2 = ExecContext(s.conf)
+    ctx2.metrics["serving.query_id"] = 777
+    q.collect(ctx2)
+    assert sorted(os.path.basename(p) for p in
+                  glob.glob(str(tmp_path / "*.jsonl"))) == \
+        ["query_777-1.jsonl", "query_777.jsonl"]
